@@ -1,0 +1,26 @@
+"""Test configuration.
+
+JAX-touching tests run on a virtual 8-device CPU mesh so the multi-chip
+sharding paths (slice validator payloads, __graft_entry__.dryrun_multichip)
+are exercised without TPU hardware. Must be set before jax is imported
+anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fake_client():
+    from tpu_operator.kube.fake import FakeClient
+
+    return FakeClient()

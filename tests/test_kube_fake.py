@@ -1,0 +1,130 @@
+"""FakeClient apiserver semantics: CRUD, RV conflicts, watch, GC, selectors."""
+
+import pytest
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import ADDED, DELETED, MODIFIED
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import matches_selector, new_object, set_owner_reference
+
+
+def mk_pod(name, ns="default", labels=None):
+    return new_object("v1", "Pod", name, ns, labels=labels, spec={"containers": []})
+
+
+def test_create_get_roundtrip(fake_client):
+    created = fake_client.create(mk_pod("a"))
+    assert created["metadata"]["uid"].startswith("uid-")
+    assert created["metadata"]["resourceVersion"] == "1"
+    got = fake_client.get("v1", "Pod", "a", "default")
+    assert got["metadata"]["name"] == "a"
+    # returned copies are detached from the store
+    got["spec"]["containers"].append({"name": "x"})
+    assert fake_client.get("v1", "Pod", "a", "default")["spec"]["containers"] == []
+
+
+def test_get_missing_raises(fake_client):
+    with pytest.raises(errors.NotFound):
+        fake_client.get("v1", "Pod", "nope", "default")
+
+
+def test_create_duplicate_raises(fake_client):
+    fake_client.create(mk_pod("a"))
+    with pytest.raises(errors.AlreadyExists):
+        fake_client.create(mk_pod("a"))
+
+
+def test_update_conflict_on_stale_rv(fake_client):
+    obj = fake_client.create(mk_pod("a"))
+    fresh = fake_client.get("v1", "Pod", "a", "default")
+    fresh["spec"]["containers"] = [{"name": "c1"}]
+    fake_client.update(fresh)
+    obj["spec"]["containers"] = [{"name": "stale"}]
+    with pytest.raises(errors.Conflict):
+        fake_client.update(obj)
+
+
+def test_generation_bumps_only_on_spec_change(fake_client):
+    obj = fake_client.create(mk_pod("a"))
+    assert obj["metadata"]["generation"] == 1
+    obj["metadata"]["labels"] = {"x": "y"}
+    obj = fake_client.update(obj)
+    assert obj["metadata"]["generation"] == 1
+    obj["spec"]["containers"] = [{"name": "c"}]
+    obj = fake_client.update(obj)
+    assert obj["metadata"]["generation"] == 2
+
+
+def test_update_does_not_touch_status_and_vice_versa(fake_client):
+    obj = fake_client.create(mk_pod("a"))
+    obj["status"] = {"phase": "Running"}
+    fake_client.update_status(obj)
+    got = fake_client.get("v1", "Pod", "a", "default")
+    assert got["status"]["phase"] == "Running"
+    got["spec"]["containers"] = [{"name": "c"}]
+    got["status"] = {"phase": "Clobbered"}
+    fake_client.update(got)
+    assert fake_client.get("v1", "Pod", "a", "default")["status"]["phase"] == "Running"
+
+
+def test_list_label_selector(fake_client):
+    fake_client.create(mk_pod("a", labels={"app": "x", "tier": "fe"}))
+    fake_client.create(mk_pod("b", labels={"app": "y"}))
+    fake_client.create(mk_pod("c", labels={"app": "x"}))
+    assert [o["metadata"]["name"] for o in fake_client.list("v1", "Pod", label_selector="app=x")] == ["a", "c"]
+    assert [o["metadata"]["name"] for o in fake_client.list("v1", "Pod", label_selector={"app": "x", "tier": "fe"})] == ["a"]
+    assert [o["metadata"]["name"] for o in fake_client.list("v1", "Pod", label_selector="app in (x,y)")] == ["a", "b", "c"]
+    assert [o["metadata"]["name"] for o in fake_client.list("v1", "Pod", label_selector="tier")] == ["a"]
+    assert [o["metadata"]["name"] for o in fake_client.list("v1", "Pod", label_selector="!tier")] == ["b", "c"]
+
+
+def test_field_selector(fake_client):
+    pod = mk_pod("a")
+    pod["spec"]["nodeName"] = "node-1"
+    fake_client.create(pod)
+    fake_client.create(mk_pod("b"))
+    out = fake_client.list("v1", "Pod", field_selector={"spec.nodeName": "node-1"})
+    assert [o["metadata"]["name"] for o in out] == ["a"]
+
+
+def test_watch_events(fake_client):
+    events = []
+    sub = fake_client.watch("v1", "Pod", lambda t, o: events.append((t, o["metadata"]["name"])))
+    fake_client.create(mk_pod("a"))
+    obj = fake_client.get("v1", "Pod", "a", "default")
+    obj["spec"]["containers"] = [{"name": "c"}]
+    fake_client.update(obj)
+    fake_client.delete("v1", "Pod", "a", "default")
+    assert events == [(ADDED, "a"), (MODIFIED, "a"), (DELETED, "a")]
+    sub.stop()
+    fake_client.create(mk_pod("b"))
+    assert len(events) == 3
+
+
+def test_owner_reference_gc(fake_client):
+    owner = fake_client.create(new_object("apps/v1", "DaemonSet", "ds", "default", spec={}))
+    child = mk_pod("child")
+    set_owner_reference(child, owner)
+    fake_client.create(child)
+    orphan = fake_client.create(mk_pod("orphan"))
+    fake_client.delete("apps/v1", "DaemonSet", "ds", "default")
+    with pytest.raises(errors.NotFound):
+        fake_client.get("v1", "Pod", "child", "default")
+    assert fake_client.get("v1", "Pod", "orphan", "default")["metadata"]["uid"] == orphan["metadata"]["uid"]
+
+
+def test_apply_create_then_update(fake_client):
+    obj = new_object("v1", "ConfigMap", "cm", "default", data={"k": "1"})
+    fake_client.apply(obj)
+    obj2 = new_object("v1", "ConfigMap", "cm", "default", data={"k": "2"})
+    fake_client.apply(obj2)
+    assert fake_client.get("v1", "ConfigMap", "cm", "default")["data"]["k"] == "2"
+
+
+def test_selector_parsing_edge_cases():
+    assert matches_selector({"a": "1"}, "a!=2")
+    assert not matches_selector({"a": "2"}, "a!=2")
+    assert matches_selector({"a": "1", "b": "2"}, "a=1,b=2")
+    assert not matches_selector({"a": "1"}, "a=1,b=2")
+    assert matches_selector({}, None)
+    assert matches_selector({"k": "v"}, "k notin (a,b)")

@@ -1,0 +1,141 @@
+"""Workqueue, informer, controller, leader election, manager."""
+
+import threading
+import time
+
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.leader import LeaderElector
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.queue import RateLimitingQueue
+
+
+def test_queue_dedup():
+    q = RateLimitingQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert q.get(0.1) == "a"
+    assert q.get(0.1) == "b"
+    assert q.get(0.05) is None
+
+
+def test_queue_dirty_requeue_while_processing():
+    q = RateLimitingQueue()
+    q.add("a")
+    item = q.get(0.1)
+    q.add("a")  # arrives while processing → dirty
+    assert q.get(0.05) is None  # not ready until done
+    q.done(item)
+    assert q.get(0.1) == "a"
+
+
+def test_queue_add_after():
+    q = RateLimitingQueue()
+    q.add_after("a", 0.1)
+    t0 = time.monotonic()
+    assert q.get(1.0) == "a"
+    assert time.monotonic() - t0 >= 0.09
+
+
+def test_queue_rate_limit_backoff_grows():
+    q = RateLimitingQueue(base_delay=0.02, max_delay=1.0)
+    t0 = time.monotonic()
+    q.add_rate_limited("a")  # 0.02
+    assert q.get(1.0) == "a"
+    q.done("a")
+    q.add_rate_limited("a")  # 0.04
+    assert q.get(1.0) == "a"
+    q.done("a")
+    assert time.monotonic() - t0 >= 0.06
+    q.forget("a")
+    assert q._failures.get("a") is None
+
+
+def test_informer_cache_and_handlers():
+    client = FakeClient()
+    client.create(new_object("v1", "Node", "n1"))
+    inf = Informer(client, "v1", "Node")
+    seen = []
+    inf.add_handler(lambda t, old, new: seen.append((t, new["metadata"]["name"])))
+    inf.start()
+    assert ("ADDED", "n1") in seen
+    client.create(new_object("v1", "Node", "n2"))
+    assert ("ADDED", "n2") in seen
+    assert {o["metadata"]["name"] for o in inf.cached()} == {"n1", "n2"}
+    inf.stop()
+
+
+def test_controller_reconciles_and_requeues():
+    client = FakeClient()
+    calls = []
+    done = threading.Event()
+
+    class Reconciler:
+        def reconcile(self, req):
+            calls.append(req)
+            if len(calls) == 1:
+                return Result(requeue_after=0.05)
+            done.set()
+            return Result()
+
+    ctrl = Controller("test", Reconciler())
+    inf = Informer(client, "v1", "ConfigMap")
+    ctrl.watch(inf)
+    ctrl.start()
+    inf.start()
+    client.create(new_object("v1", "ConfigMap", "cm", "default"))
+    assert done.wait(2.0)
+    assert calls[0] == Request(name="cm", namespace="default")
+    ctrl.stop()
+    inf.stop()
+
+
+def test_generation_changed_predicate():
+    old = new_object("v1", "ConfigMap", "x")
+    old["metadata"]["generation"] = 1
+    new = new_object("v1", "ConfigMap", "x")
+    new["metadata"]["generation"] = 1
+    assert not generation_changed("MODIFIED", old, new)
+    new["metadata"]["generation"] = 2
+    assert generation_changed("MODIFIED", old, new)
+    assert generation_changed("ADDED", None, new)
+
+
+def test_leader_election_single_winner():
+    client = FakeClient()
+    a = LeaderElector(client, namespace="ns", lease_duration=0.5, renew_interval=0.05)
+    b = LeaderElector(client, namespace="ns", lease_duration=0.5, renew_interval=0.05)
+    a.start()
+    assert a.wait_for_leadership(2.0)
+    b.start()
+    time.sleep(0.2)
+    assert not b.is_leader()
+    a.stop()  # releases the lease
+    assert b.wait_for_leadership(3.0)
+    b.stop()
+
+
+def test_manager_lifecycle():
+    client = FakeClient()
+    mgr = Manager(client, namespace="ns")
+    inf = mgr.informer_for("v1", "Node")
+    assert mgr.informer_for("v1", "Node") is inf  # shared
+    hits = []
+
+    class R:
+        def reconcile(self, req):
+            hits.append(req.name)
+            return Result()
+
+    ctrl = Controller("nodes", R())
+    ctrl.watch(inf)
+    mgr.add_controller(ctrl)
+    with mgr:
+        client.create(new_object("v1", "Node", "n1"))
+        deadline = time.monotonic() + 2
+        while "n1" not in hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert "n1" in hits

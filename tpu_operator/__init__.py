@@ -1,0 +1,27 @@
+"""tpu-operator: a TPU-native Kubernetes operator.
+
+A from-scratch rebuild of the capabilities of the NVIDIA GPU Operator
+(reference: elezar/gpu-operator v24.3.0) for Google Cloud TPU nodes. One
+cluster-scoped ClusterPolicy CRD drives an ordered state machine that
+provisions the whole TPU software stack: libtpu installation, the Cloud TPU
+device plugin, tpu-feature-discovery node labels, a slice/topology manager
+for multi-host gang scheduling, a libtpu metrics exporter, and an in-cluster
+validator whose workload check is a JAX ``jax.lax.psum`` allreduce over ICI.
+
+Layout mirrors the reference's architecture (see SURVEY.md):
+
+- ``kube/``        controller-runtime equivalent (clients, informers, manager)
+- ``api/``         CRD types: ClusterPolicy v1, TPUSlice v1alpha1
+- ``render/``      manifest template renderer (reference: internal/render)
+- ``state/``       state engine v2 (reference: internal/state)
+- ``controllers/`` ClusterPolicy / TPUSlice / Upgrade reconcilers
+- ``validator/``   node validator operand + JAX payloads
+- ``tfd/``         tpu-feature-discovery operand (replaces GFD)
+- ``sliceman/``    slice/topology manager operand (replaces mig-manager)
+- ``deviceplugin/``kubelet device plugin for google.com/tpu
+- ``metrics_exporter/`` libtpu metrics exporter (replaces dcgm-exporter)
+"""
+
+from tpu_operator.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,96 @@
+"""Shared label / annotation / path constants.
+
+TPU-native analog of the reference's internal/consts/consts.go:31-67. Where
+the reference keys everything off ``nvidia.com/*`` labels fed by NFD's PCI
+vendor detection (pci-10de), we key off the labels GKE already stamps on TPU
+node pools (``cloud.google.com/gke-tpu-*``) plus our own
+``tpu.google.com/*`` operator labels.
+"""
+
+# ---------------------------------------------------------------------------
+# Node labels provided by the platform (GKE) — consumed, never written.
+# ---------------------------------------------------------------------------
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+OS_RELEASE_ID_LABEL = "feature.node.kubernetes.io/system-os_release.ID"
+OS_RELEASE_VERSION_LABEL = "feature.node.kubernetes.io/system-os_release.VERSION_ID"
+KERNEL_VERSION_LABEL = "feature.node.kubernetes.io/kernel-version.full"
+
+# ---------------------------------------------------------------------------
+# Node labels owned by the operator (reference: state_manager.go:50-117).
+# ---------------------------------------------------------------------------
+TPU_PRESENT_LABEL = "tpu.google.com/tpu.present"
+TPU_WORKLOAD_CONFIG_LABEL = "tpu.google.com/tpu.workload.config"
+COMMON_DEPLOY_LABEL_PREFIX = "tpu.google.com/tpu.deploy."
+
+# Workload config values (reference: gpu-workload-configuration,
+# state_manager.go:86-111). TPUs have no vGPU/passthrough; "container" is the
+# only supported config today but the routing machinery is kept.
+WORKLOAD_CONFIG_CONTAINER = "container"
+DEFAULT_WORKLOAD_CONFIG = WORKLOAD_CONFIG_CONTAINER
+
+# Labels written by tpu-feature-discovery (the GFD analog).
+TFD_ACCELERATOR_TYPE_LABEL = "tpu.google.com/accelerator-type"
+TFD_TOPOLOGY_LABEL = "tpu.google.com/topology"
+TFD_CHIPS_PER_NODE_LABEL = "tpu.google.com/chips-per-node"
+TFD_SLICE_HOSTS_LABEL = "tpu.google.com/slice-hosts"
+TFD_TPU_GENERATION_LABEL = "tpu.google.com/generation"
+TFD_LABELS = (
+    TFD_ACCELERATOR_TYPE_LABEL,
+    TFD_TOPOLOGY_LABEL,
+    TFD_CHIPS_PER_NODE_LABEL,
+    TFD_SLICE_HOSTS_LABEL,
+    TFD_TPU_GENERATION_LABEL,
+)
+
+# Upgrade-state node label (reference: nvidia.com/gpu-driver-upgrade-state,
+# vendor k8s-operator-libs/pkg/upgrade/consts.go).
+UPGRADE_STATE_LABEL = "tpu.google.com/libtpu-upgrade-state"
+UPGRADE_SKIP_DRAIN_POD_LABEL = "tpu.google.com/libtpu-upgrade-drain.skip"
+
+# ---------------------------------------------------------------------------
+# Annotations.
+# ---------------------------------------------------------------------------
+LAST_APPLIED_HASH_ANNOTATION = "tpu.google.com/last-applied-hash"
+DRIVER_AUTO_UPGRADE_ANNOTATION = "tpu.google.com/libtpu-auto-upgrade-enabled"
+STATE_LABEL = "tpu.google.com/operator.state"  # ownership label for cleanup
+
+# ---------------------------------------------------------------------------
+# The extended resource advertised by the device plugin.
+# ---------------------------------------------------------------------------
+TPU_RESOURCE_NAME = "google.com/tpu"
+
+# ---------------------------------------------------------------------------
+# Validation status files (reference: /run/nvidia/validations,
+# validator/main.go:131-166). These are the cross-DaemonSet barrier: every
+# operand's init container polls for the file of the component it needs.
+# ---------------------------------------------------------------------------
+VALIDATION_DIR = "/run/tpu/validations"
+LIBTPU_READY_FILE = "libtpu-ready"
+PLUGIN_READY_FILE = "plugin-ready"
+WORKLOAD_READY_FILE = "workload-ready"
+METRICS_READY_FILE = "metrics-ready"
+ALL_READY_FILE = "all-ready"
+
+# Host paths.
+LIBTPU_INSTALL_DIR = "/home/kubernetes/bin/tpu"  # where libtpu.so lands
+LIBTPU_CTR_READY_FILE = ".libtpu-ctr-ready"
+
+# ---------------------------------------------------------------------------
+# Operator runtime.
+# ---------------------------------------------------------------------------
+OPERATOR_NAMESPACE_ENV = "OPERATOR_NAMESPACE"
+DEFAULT_OPERATOR_NAMESPACE = "tpu-operator"
+CLUSTER_POLICY_NAME_LABEL = "app.kubernetes.io/managed-by"
+OPERATOR_NAME = "tpu-operator"
+
+# Requeue / poll intervals (reference: clusterpolicy_controller.go:165,199).
+REQUEUE_NOT_READY_SECONDS = 5.0
+REQUEUE_NO_TPU_NODES_SECONDS = 45.0
+UPGRADE_REPLAN_SECONDS = 120.0
+
+# Container runtimes (reference: getRuntime state_manager.go:714-751).
+RUNTIME_CONTAINERD = "containerd"
+RUNTIME_CRIO = "crio"
+RUNTIME_DOCKER = "docker"

@@ -1,0 +1,51 @@
+"""A from-scratch controller-runtime equivalent.
+
+The reference operator is built on sigs.k8s.io/controller-runtime; this
+package provides the same building blocks natively: an object model over
+plain dicts (unstructured), a Client interface with an in-memory fake
+apiserver (watch semantics, resourceVersion conflicts, label selectors,
+ownerReference garbage collection) and a real HTTPS client, rate-limited
+workqueues, shared informers, reconciler-based controllers, and a Manager
+with leader election and health/metrics endpoints.
+"""
+
+from tpu_operator.kube.errors import ApiError, Conflict, AlreadyExists, NotFound
+from tpu_operator.kube.objects import (
+    api_group,
+    deep_copy,
+    gvk_of,
+    meta,
+    new_object,
+    object_key,
+    set_owner_reference,
+    matches_selector,
+)
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.queue import RateLimitingQueue
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.manager import Manager
+
+__all__ = [
+    "ApiError",
+    "Conflict",
+    "AlreadyExists",
+    "NotFound",
+    "api_group",
+    "deep_copy",
+    "gvk_of",
+    "meta",
+    "new_object",
+    "object_key",
+    "set_owner_reference",
+    "matches_selector",
+    "Client",
+    "FakeClient",
+    "RateLimitingQueue",
+    "Informer",
+    "Controller",
+    "Request",
+    "Result",
+    "Manager",
+]

@@ -1,0 +1,88 @@
+"""Client interface (controller-runtime client.Client equivalent)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, List, Optional
+
+from tpu_operator.kube.objects import ObjectDict
+
+# Watch event types.
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+WatchHandler = Callable[[str, ObjectDict], None]
+
+
+class WatchSubscription(abc.ABC):
+    @abc.abstractmethod
+    def stop(self) -> None: ...
+
+
+class Client(abc.ABC):
+    """CRUD + watch against an apiserver (real or fake).
+
+    All methods deal in unstructured dicts. ``get``/``list`` return deep
+    copies — mutating them never mutates the store.
+    """
+
+    @abc.abstractmethod
+    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> ObjectDict: ...
+
+    @abc.abstractmethod
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector=None,
+        field_selector: Optional[dict] = None,
+    ) -> List[ObjectDict]: ...
+
+    @abc.abstractmethod
+    def create(self, obj: ObjectDict) -> ObjectDict: ...
+
+    @abc.abstractmethod
+    def update(self, obj: ObjectDict) -> ObjectDict: ...
+
+    @abc.abstractmethod
+    def update_status(self, obj: ObjectDict) -> ObjectDict: ...
+
+    @abc.abstractmethod
+    def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None: ...
+
+    @abc.abstractmethod
+    def watch(
+        self,
+        api_version: str,
+        kind: str,
+        handler: WatchHandler,
+        namespace: Optional[str] = None,
+    ) -> WatchSubscription:
+        """Register a watch; handler is called with (event_type, object)."""
+
+    # -- conveniences -------------------------------------------------------
+
+    def get_or_none(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None):
+        from tpu_operator.kube.errors import NotFound
+
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFound:
+            return None
+
+    def apply(self, obj: ObjectDict) -> ObjectDict:
+        """Create-or-update by name (no hash logic — see state.skel for that)."""
+        from tpu_operator.kube.errors import NotFound
+
+        md = obj.get("metadata", {})
+        try:
+            existing = self.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
+        except NotFound:
+            return self.create(obj)
+        new = dict(obj)
+        new_md = dict(md)
+        new_md["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        new["metadata"] = new_md
+        return self.update(new)

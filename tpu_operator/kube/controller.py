@@ -1,0 +1,107 @@
+"""Controller: informer-driven reconcile loop (controller-runtime builder).
+
+A Controller owns a rate-limited queue of Requests, a set of watches that
+map events to Requests (with optional predicates), and a Reconciler. Workers
+pop requests and call ``reconcile``; the returned Result drives requeueing.
+MaxConcurrentReconciles defaults to 1, like every reconciler in the
+reference (clusterpolicy_controller.go:354).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.kube.queue import RateLimitingQueue
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+
+@dataclasses.dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+# predicate(event_type, old, new) -> bool
+Predicate = Callable[[str, Optional[ObjectDict], ObjectDict], bool]
+# mapper(obj) -> list[Request]
+Mapper = Callable[[ObjectDict], List[Request]]
+
+
+def generation_changed(event_type: str, old: Optional[ObjectDict], new: ObjectDict) -> bool:
+    """GenerationChangedPredicate: skip status/metadata-only updates."""
+    if old is None or event_type != "MODIFIED":
+        return True
+    return old["metadata"].get("generation") != new["metadata"].get("generation")
+
+
+def to_self_request(obj: ObjectDict) -> List[Request]:
+    md = obj["metadata"]
+    return [Request(name=md["name"], namespace=md.get("namespace", ""))]
+
+
+class Controller:
+    def __init__(self, name: str, reconciler, max_concurrent: int = 1):
+        self.name = name
+        self.reconciler = reconciler  # object with .reconcile(Request) -> Result
+        self.queue = RateLimitingQueue()
+        self.max_concurrent = max_concurrent
+        self._watches: List[tuple] = []  # (informer, mapper, predicate)
+        self._threads: List[threading.Thread] = []
+        self._stopping = threading.Event()
+
+    def watch(self, informer: Informer, mapper: Mapper = to_self_request, predicate: Optional[Predicate] = None):
+        informer.add_handler(self._make_handler(mapper, predicate))
+        self._watches.append((informer, mapper, predicate))
+        return self
+
+    def _make_handler(self, mapper: Mapper, predicate: Optional[Predicate]):
+        def handler(event_type, old, new):
+            if predicate is not None and not predicate(event_type, old, new):
+                return
+            for req in mapper(new):
+                self.queue.add(req)
+
+        return handler
+
+    def start(self) -> None:
+        for i in range(self.max_concurrent):
+            t = threading.Thread(target=self._worker, name=f"{self.name}-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _worker(self) -> None:
+        while not self._stopping.is_set():
+            req = self.queue.get()
+            if req is None:
+                return
+            try:
+                result = self.reconciler.reconcile(req) or Result()
+            except Exception:  # noqa: BLE001 — requeue with backoff, like controller-runtime
+                log.exception("[%s] reconcile %s failed", self.name, req)
+                self.queue.add_rate_limited(req)
+                self.queue.done(req)
+                continue
+            self.queue.forget(req)
+            if result.requeue_after > 0:
+                self.queue.add_after(req, result.requeue_after)
+            elif result.requeue:
+                self.queue.add_rate_limited(req)
+            self.queue.done(req)

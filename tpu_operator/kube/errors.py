@@ -1,0 +1,37 @@
+"""API error types mirroring k8s.io/apimachinery/pkg/api/errors."""
+
+
+class ApiError(Exception):
+    """Base class for apiserver-style errors."""
+
+    code = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.__class__.__name__)
+        self.message = message
+
+
+class NotFound(ApiError):
+    code = 404
+
+
+class AlreadyExists(ApiError):
+    code = 409
+
+
+class Conflict(ApiError):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+    code = 409
+
+
+class Invalid(ApiError):
+    code = 422
+
+
+def is_not_found(err: BaseException) -> bool:
+    return isinstance(err, NotFound)
+
+
+def is_conflict(err: BaseException) -> bool:
+    return isinstance(err, Conflict)

@@ -1,0 +1,197 @@
+"""In-memory fake apiserver.
+
+Equivalent of controller-runtime's fake client (used by the reference's unit
+tests, object_controls_test.go:77-124) but with live watch semantics and
+ownerReference garbage collection so a whole Manager can run against it —
+this is what makes the full reconcile loop testable and benchmarkable with
+no cluster, mirroring SURVEY.md §4's "CPU-only kind cluster" insight.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client, WatchHandler, WatchSubscription
+from tpu_operator.kube.objects import (
+    ObjectDict,
+    api_group,
+    deep_copy,
+    matches_selector,
+    nested_get,
+)
+
+
+class _Sub(WatchSubscription):
+    def __init__(self, client: "FakeClient", key, handler: WatchHandler, namespace: Optional[str]):
+        self.client = client
+        self.key = key
+        self.handler = handler
+        self.namespace = namespace
+        self.active = True
+
+    def stop(self) -> None:
+        self.active = False
+        with self.client._lock:
+            subs = self.client._watchers.get(self.key, [])
+            if self in subs:
+                subs.remove(self)
+
+
+class FakeClient(Client):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict = {}  # (group, kind, ns, name) -> obj
+        self._rv = 0
+        self._uid = 0
+        self._watchers: dict = {}  # (group, kind) -> [_Sub]
+
+    # -- internals ----------------------------------------------------------
+
+    def _key(self, api_version: str, kind: str, name: str, namespace: Optional[str]):
+        return api_group(api_version), kind, namespace or "", name
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, events):
+        # Dispatch outside the lock so handlers may call back into the client.
+        for event_type, obj in events:
+            key = (api_group(obj["apiVersion"]), obj["kind"])
+            for sub in list(self._watchers.get(key, [])):
+                if not sub.active:
+                    continue
+                if sub.namespace and obj["metadata"].get("namespace") != sub.namespace:
+                    continue
+                sub.handler(event_type, deep_copy(obj))
+
+    # -- Client API ---------------------------------------------------------
+
+    def get(self, api_version, kind, name, namespace=None):
+        with self._lock:
+            obj = self._store.get(self._key(api_version, kind, name, namespace))
+            if obj is None:
+                raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            return deep_copy(obj)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
+        group = api_group(api_version)
+        out: List[ObjectDict] = []
+        with self._lock:
+            for (g, k, ns, _), obj in self._store.items():
+                if g != group or k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                if not matches_selector(obj["metadata"].get("labels"), label_selector):
+                    continue
+                if field_selector and not all(
+                    nested_get(obj, *path.split(".")) == want for path, want in field_selector.items()
+                ):
+                    continue
+                out.append(deep_copy(obj))
+        out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
+        return out
+
+    def create(self, obj):
+        obj = deep_copy(obj)
+        md = obj.setdefault("metadata", {})
+        key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
+        if not md.get("name"):
+            raise errors.Invalid("metadata.name required")
+        with self._lock:
+            if key in self._store:
+                raise errors.AlreadyExists(f"{obj['kind']} {md.get('name')} already exists")
+            self._uid += 1
+            md.setdefault("uid", f"uid-{self._uid}")
+            md["resourceVersion"] = self._next_rv()
+            md.setdefault("creationTimestamp", _now())
+            md.setdefault("generation", 1)
+            self._store[key] = obj
+            stored = deep_copy(obj)
+        self._notify([(ADDED, stored)])
+        return deep_copy(stored)
+
+    def update(self, obj):
+        obj = deep_copy(obj)
+        md = obj.setdefault("metadata", {})
+        key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
+        with self._lock:
+            existing = self._store.get(key)
+            if existing is None:
+                raise errors.NotFound(f"{obj['kind']} {md.get('name')} not found")
+            if md.get("resourceVersion") and md["resourceVersion"] != existing["metadata"]["resourceVersion"]:
+                raise errors.Conflict(
+                    f"{obj['kind']} {md.get('name')}: resourceVersion {md['resourceVersion']} "
+                    f"!= {existing['metadata']['resourceVersion']}"
+                )
+            md["uid"] = existing["metadata"]["uid"]
+            md["creationTimestamp"] = existing["metadata"].get("creationTimestamp")
+            md["resourceVersion"] = self._next_rv()
+            gen = existing["metadata"].get("generation", 1)
+            if obj.get("spec") != existing.get("spec"):
+                gen += 1
+            md["generation"] = gen
+            # update() does not touch the status subresource
+            if "status" in existing:
+                obj["status"] = deep_copy(existing["status"])
+            elif "status" in obj:
+                del obj["status"]
+            self._store[key] = obj
+            stored = deep_copy(obj)
+        self._notify([(MODIFIED, stored)])
+        return deep_copy(stored)
+
+    def update_status(self, obj):
+        md = obj.get("metadata", {})
+        key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
+        with self._lock:
+            existing = self._store.get(key)
+            if existing is None:
+                raise errors.NotFound(f"{obj['kind']} {md.get('name')} not found")
+            existing["status"] = deep_copy(obj.get("status", {}))
+            existing["metadata"]["resourceVersion"] = self._next_rv()
+            stored = deep_copy(existing)
+        self._notify([(MODIFIED, stored)])
+        return deep_copy(stored)
+
+    def delete(self, api_version, kind, name, namespace=None):
+        events = []
+        with self._lock:
+            key = self._key(api_version, kind, name, namespace)
+            obj = self._store.pop(key, None)
+            if obj is None:
+                raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            events.append((DELETED, obj))
+            events.extend(self._collect_garbage(obj["metadata"].get("uid")))
+        self._notify(events)
+
+    def _collect_garbage(self, owner_uid):
+        """Cascade-delete dependents (background GC semantics)."""
+        events = []
+        if not owner_uid:
+            return events
+        dependents = [
+            key
+            for key, obj in self._store.items()
+            if any(ref.get("uid") == owner_uid for ref in obj["metadata"].get("ownerReferences", []))
+        ]
+        for key in dependents:
+            obj = self._store.pop(key)
+            events.append((DELETED, obj))
+            events.extend(self._collect_garbage(obj["metadata"].get("uid")))
+        return events
+
+    def watch(self, api_version, kind, handler, namespace=None):
+        key = (api_group(api_version), kind)
+        sub = _Sub(self, key, handler, namespace)
+        with self._lock:
+            self._watchers.setdefault(key, []).append(sub)
+        return sub
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

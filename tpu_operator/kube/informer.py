@@ -1,0 +1,70 @@
+"""Shared informer: list+watch a kind, keep a cache, fan out to handlers."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional
+
+from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client
+from tpu_operator.kube.objects import ObjectDict, object_key
+
+log = logging.getLogger(__name__)
+
+# handler(event_type, old_obj_or_None, new_obj)
+EventHandler = Callable[[str, Optional[ObjectDict], ObjectDict], None]
+
+
+class Informer:
+    def __init__(self, client: Client, api_version: str, kind: str, namespace: Optional[str] = None):
+        self.client = client
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self._handlers: List[EventHandler] = []
+        self._cache: dict = {}
+        self._lock = threading.RLock()
+        self._sub = None
+        self._synced = False
+
+    def add_handler(self, handler: EventHandler) -> None:
+        self._handlers.append(handler)
+
+    def start(self) -> None:
+        # Subscribe first so no events are lost between list and watch.
+        self._sub = self.client.watch(self.api_version, self.kind, self._on_event, self.namespace)
+        for obj in self.client.list(self.api_version, self.kind, self.namespace):
+            self._on_event(ADDED, obj)
+        self._synced = True
+
+    def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.stop()
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def _on_event(self, event_type: str, obj: ObjectDict) -> None:
+        key = object_key(obj)
+        with self._lock:
+            old = self._cache.get(key)
+            if event_type == DELETED:
+                self._cache.pop(key, None)
+            else:
+                if old is not None and old["metadata"].get("resourceVersion") == obj["metadata"].get(
+                    "resourceVersion"
+                ):
+                    # duplicate delivery (e.g. list replay after watch) — drop
+                    return
+                self._cache[key] = obj
+        for handler in self._handlers:
+            try:
+                handler(event_type if old is None or event_type == DELETED else MODIFIED, old, obj)
+            except Exception:  # noqa: BLE001 — informer must survive handler bugs
+                log.exception("informer handler failed for %s %s", self.kind, key)
+
+    # -- cache reads --------------------------------------------------------
+
+    def cached(self) -> List[ObjectDict]:
+        with self._lock:
+            return list(self._cache.values())

@@ -1,0 +1,117 @@
+"""Lease-based leader election (client-go leaderelection equivalent).
+
+The reference manager elects on Lease "53822513.nvidia.com"
+(cmd/gpu-operator/main.go:123-131); we use the same mechanism against
+coordination.k8s.io/v1 Lease objects with renew/retry loops.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import new_object
+
+log = logging.getLogger(__name__)
+
+LEASE_API = "coordination.k8s.io/v1"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: Client,
+        lease_name: str = "53822513.tpu.google.com",
+        namespace: str = "tpu-operator",
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+    ):
+        self.client = client
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.identity = f"{lease_name}-{uuid.uuid4().hex[:8]}"
+        self._leading = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="leader-elector", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._release()
+
+    def wait_for_leadership(self, timeout: Optional[float] = None) -> bool:
+        return self._leading.wait(timeout)
+
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    # -- internals ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire_or_renew():
+                self._leading.set()
+            else:
+                self._leading.clear()
+            self._stop.wait(self.renew_interval)
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = time.time()
+        try:
+            lease = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
+        except errors.NotFound:
+            lease = new_object(
+                LEASE_API,
+                "Lease",
+                self.lease_name,
+                self.namespace,
+                spec={
+                    "holderIdentity": self.identity,
+                    "leaseDurationSeconds": int(self.lease_duration),
+                    "acquireTime": now,
+                    "renewTime": now,
+                },
+            )
+            try:
+                self.client.create(lease)
+                return True
+            except errors.AlreadyExists:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = spec.get("renewTime", 0) or 0
+        expired = (now - float(renew)) > self.lease_duration
+        if holder not in (None, "", self.identity) and not expired:
+            return False
+        spec["holderIdentity"] = self.identity
+        spec["renewTime"] = now
+        if holder != self.identity:
+            spec["acquireTime"] = now
+            spec["leaseTransitions"] = spec.get("leaseTransitions", 0) + 1
+        lease["spec"] = spec
+        try:
+            self.client.update(lease)
+            return True
+        except (errors.Conflict, errors.NotFound):
+            return False
+
+    def _release(self) -> None:
+        try:
+            lease = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update(lease)
+        except errors.ApiError:
+            pass

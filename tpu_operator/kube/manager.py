@@ -1,0 +1,139 @@
+"""Manager: wires informers + controllers + leader election + endpoints.
+
+Equivalent of ``ctrl.NewManager`` + ``mgr.Start`` in the reference
+(cmd/gpu-operator/main.go:123-196): health probes on :8081, Prometheus
+metrics on :8080, optional Lease leader election, then run all controllers
+until stopped.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.leader import LeaderElector
+
+log = logging.getLogger(__name__)
+
+
+class Manager:
+    def __init__(
+        self,
+        client: Client,
+        namespace: str = "tpu-operator",
+        leader_election: bool = False,
+        health_addr: Optional[Tuple[str, int]] = None,
+        metrics_addr: Optional[Tuple[str, int]] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self._informers: Dict[Tuple[str, str, str], Informer] = {}
+        self._controllers: List[Controller] = []
+        self._leader: Optional[LeaderElector] = (
+            LeaderElector(client, namespace=namespace) if leader_election else None
+        )
+        self._health_addr = health_addr
+        self._metrics_addr = metrics_addr
+        self._servers: list = []
+        self._started = threading.Event()
+
+    # -- building -----------------------------------------------------------
+
+    def informer_for(self, api_version: str, kind: str, namespace: Optional[str] = None) -> Informer:
+        """Shared informer per (api_version, kind, namespace)."""
+        key = (api_version, kind, namespace or "")
+        if key not in self._informers:
+            self._informers[key] = Informer(self.client, api_version, kind, namespace)
+        return self._informers[key]
+
+    def add_controller(self, controller: Controller) -> Controller:
+        self._controllers.append(controller)
+        return controller
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, wait_for_leader: bool = True) -> None:
+        if self._health_addr:
+            self._servers.append(_serve(self._health_addr, self._health_handler()))
+        if self._metrics_addr:
+            self._servers.append(_serve(self._metrics_addr, self._metrics_handler()))
+        if self._leader:
+            self._leader.start()
+            if wait_for_leader:
+                self._leader.wait_for_leadership()
+        for controller in self._controllers:
+            controller.start()
+        for informer in self._informers.values():
+            informer.start()
+        self._started.set()
+        log.info("manager started: %d controllers, %d informers", len(self._controllers), len(self._informers))
+
+    def stop(self) -> None:
+        for controller in self._controllers:
+            controller.stop()
+        for informer in self._informers.values():
+            informer.stop()
+        if self._leader:
+            self._leader.stop()
+        for server in self._servers:
+            server.shutdown()
+        self._started.clear()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- endpoints ----------------------------------------------------------
+
+    def _health_handler(self):
+        manager = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path in ("/healthz", "/readyz"):
+                    ready = manager._started.is_set() or self.path == "/healthz"
+                    self.send_response(200 if ready else 503)
+                    self.end_headers()
+                    self.wfile.write(b"ok" if ready else b"not ready")
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):  # silence
+                pass
+
+        return Handler
+
+    def _metrics_handler(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    import prometheus_client
+
+                    body = prometheus_client.generate_latest()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        return Handler
+
+
+def _serve(addr: Tuple[str, int], handler) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(addr, handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,236 @@
+"""Unstructured object helpers.
+
+Kubernetes objects are represented as plain dicts in their wire (JSON/YAML)
+form, exactly like apimachinery's ``unstructured.Unstructured``. Typed API
+objects (ClusterPolicy, TPUSlice) convert to/from this form at the client
+boundary.
+"""
+
+from __future__ import annotations
+
+import copy
+import fnmatch
+from typing import Any, Iterable, Optional, Tuple
+
+ObjectDict = dict
+
+# (group, kind) pairs that are cluster-scoped. Everything else is assumed
+# namespaced. Extend as new kinds appear in manifests.
+CLUSTER_SCOPED: set[Tuple[str, str]] = {
+    ("", "Node"),
+    ("", "Namespace"),
+    ("", "PersistentVolume"),
+    ("rbac.authorization.k8s.io", "ClusterRole"),
+    ("rbac.authorization.k8s.io", "ClusterRoleBinding"),
+    ("apiextensions.k8s.io", "CustomResourceDefinition"),
+    ("node.k8s.io", "RuntimeClass"),
+    ("scheduling.k8s.io", "PriorityClass"),
+    ("tpu.google.com", "ClusterPolicy"),
+    ("admissionregistration.k8s.io", "ValidatingWebhookConfiguration"),
+}
+
+
+def api_group(api_version: str) -> str:
+    """'apps/v1' -> 'apps'; 'v1' -> ''."""
+    return api_version.split("/")[0] if "/" in api_version else ""
+
+
+def gvk_of(obj: ObjectDict) -> Tuple[str, str, str]:
+    av = obj.get("apiVersion", "")
+    group = api_group(av)
+    version = av.split("/")[-1]
+    return group, version, obj.get("kind", "")
+
+
+def is_cluster_scoped(obj: ObjectDict) -> bool:
+    group, _, kind = gvk_of(obj)
+    return (group, kind) in CLUSTER_SCOPED
+
+
+def meta(obj: ObjectDict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def object_key(obj: ObjectDict) -> Tuple[str, str, str, str]:
+    """Identity of an object within a cluster: (group, kind, namespace, name)."""
+    group, _, kind = gvk_of(obj)
+    md = obj.get("metadata", {})
+    return group, kind, md.get("namespace", ""), md.get("name", "")
+
+
+def new_object(
+    api_version: str,
+    kind: str,
+    name: str,
+    namespace: Optional[str] = None,
+    labels: Optional[dict] = None,
+    **fields: Any,
+) -> ObjectDict:
+    md: dict = {"name": name}
+    if namespace:
+        md["namespace"] = namespace
+    if labels:
+        md["labels"] = dict(labels)
+    obj: ObjectDict = {"apiVersion": api_version, "kind": kind, "metadata": md}
+    obj.update(fields)
+    return obj
+
+
+def deep_copy(obj: ObjectDict) -> ObjectDict:
+    return copy.deepcopy(obj)
+
+
+def set_owner_reference(obj: ObjectDict, owner: ObjectDict, controller: bool = True) -> None:
+    """SetControllerReference equivalent (reference: object_controls.go:4177)."""
+    ref = {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": owner["metadata"]["name"],
+        "uid": owner["metadata"].get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+    refs = meta(obj).setdefault("ownerReferences", [])
+    for i, existing in enumerate(refs):
+        if existing.get("kind") == ref["kind"] and existing.get("name") == ref["name"]:
+            refs[i] = ref
+            return
+    refs.append(ref)
+
+
+def get_label(obj: ObjectDict, key: str, default: Optional[str] = None) -> Optional[str]:
+    return obj.get("metadata", {}).get("labels", {}).get(key, default)
+
+
+def set_label(obj: ObjectDict, key: str, value: str) -> None:
+    meta(obj).setdefault("labels", {})[key] = value
+
+
+def get_annotation(obj: ObjectDict, key: str, default: Optional[str] = None) -> Optional[str]:
+    return obj.get("metadata", {}).get("annotations", {}).get(key, default)
+
+
+def set_annotation(obj: ObjectDict, key: str, value: str) -> None:
+    meta(obj).setdefault("annotations", {})[key] = value
+
+
+# ---------------------------------------------------------------------------
+# Label selectors.
+# ---------------------------------------------------------------------------
+
+
+def parse_selector(selector: str) -> list:
+    """Parse a kubectl-style label selector string into requirements.
+
+    Supports ``k=v``, ``k==v``, ``k!=v``, bare ``k`` (exists), ``!k``
+    (not exists), ``k in (a,b)``, ``k notin (a,b)``.
+    """
+    reqs = []
+    if not selector:
+        return reqs
+    # split on commas not inside parens
+    parts, depth, cur = [], 0, []
+    for ch in selector:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        if " in " in part or " notin " in part:
+            op = "in" if " in " in part else "notin"
+            key, vals = part.split(f" {op} ", 1)
+            values = [v.strip() for v in vals.strip().strip("()").split(",")]
+            reqs.append((key.strip(), op, values))
+        elif "!=" in part:
+            key, val = part.split("!=", 1)
+            reqs.append((key.strip(), "!=", [val.strip()]))
+        elif "==" in part:
+            key, val = part.split("==", 1)
+            reqs.append((key.strip(), "=", [val.strip()]))
+        elif "=" in part:
+            key, val = part.split("=", 1)
+            reqs.append((key.strip(), "=", [val.strip()]))
+        elif part.startswith("!"):
+            reqs.append((part[1:].strip(), "!exists", []))
+        else:
+            reqs.append((part, "exists", []))
+    return reqs
+
+
+def matches_selector(labels: Optional[dict], selector) -> bool:
+    """Match a label dict against a selector.
+
+    ``selector`` may be a kubectl-style string, a dict of exact matches
+    (matchLabels), or ``None`` (matches everything).
+    """
+    labels = labels or {}
+    if selector is None:
+        return True
+    if isinstance(selector, dict):
+        return all(labels.get(k) == v for k, v in selector.items())
+    for key, op, values in parse_selector(selector):
+        have = key in labels
+        val = labels.get(key)
+        if op == "exists" and not have:
+            return False
+        if op == "!exists" and have:
+            return False
+        if op == "=" and val != values[0]:
+            return False
+        if op == "!=" and val == values[0]:
+            return False
+        if op == "in" and val not in values:
+            return False
+        if op == "notin" and val in values:
+            return False
+    return True
+
+
+def matches_node_selector_terms(labels: Optional[dict], node_selector: Optional[dict]) -> bool:
+    """Match node labels against a pod-spec ``nodeSelector`` map."""
+    return matches_selector(labels, node_selector)
+
+
+# ---------------------------------------------------------------------------
+# Nested field access (unstructured.NestedFieldNoCopy equivalents).
+# ---------------------------------------------------------------------------
+
+
+def nested_get(obj: ObjectDict, *path: str, default: Any = None) -> Any:
+    cur: Any = obj
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return default
+        cur = cur[p]
+    return cur
+
+
+def nested_set(obj: ObjectDict, value: Any, *path: str) -> None:
+    cur = obj
+    for p in path[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[path[-1]] = value
+
+
+def find_container(pod_spec: dict, name_glob: str, init: bool = False) -> Optional[dict]:
+    """Find a container by name (glob allowed) in a pod spec."""
+    key = "initContainers" if init else "containers"
+    for c in pod_spec.get(key, []):
+        if fnmatch.fnmatch(c.get("name", ""), name_glob):
+            return c
+    return None
+
+
+def iter_all_containers(pod_spec: dict) -> Iterable[dict]:
+    yield from pod_spec.get("initContainers", [])
+    yield from pod_spec.get("containers", [])

@@ -1,0 +1,115 @@
+"""Rate-limited work queue (client-go workqueue equivalent).
+
+Deduplicates items, supports delayed adds, and applies per-item exponential
+backoff on failure — base/max mirror the reference's controller rate limiter
+(100 ms – 3 s, clusterpolicy_controller.go:51-52).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Optional
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.1, max_delay: float = 3.0):
+        self._base = base_delay
+        self._max = max_delay
+        self._lock = threading.Condition()
+        self._queue: list = []  # FIFO of ready items
+        self._dirty: set = set()  # items added while being processed
+        self._processing: set = set()
+        self._in_queue: set = set()
+        self._delayed: list = []  # heap of (ready_time, seq, item)
+        self._failures: dict = {}
+        self._seq = 0
+        self._shutdown = False
+
+    # -- producers ----------------------------------------------------------
+
+    def add(self, item: Any) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._in_queue:
+                return
+            self._queue.append(item)
+            self._in_queue.add(item)
+            self._lock.notify()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._lock.notify()
+
+    def add_rate_limited(self, item: Any) -> None:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        self.add_after(item, min(self._base * (2**n), self._max))
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    # -- consumers ----------------------------------------------------------
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Block until an item is ready (or timeout/shutdown → None)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, item = heapq.heappop(self._delayed)
+                    if item not in self._in_queue and item not in self._processing:
+                        self._queue.append(item)
+                        self._in_queue.add(item)
+                    elif item in self._processing:
+                        self._dirty.add(item)
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._in_queue.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = None
+                if self._delayed:
+                    wait = max(0.0, self._delayed[0][0] - now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._lock.wait(wait)
+
+    def done(self, item: Any) -> None:
+        with self._lock:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._in_queue:
+                    self._queue.append(item)
+                    self._in_queue.add(item)
+                    self._lock.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._delayed)

@@ -1,0 +1,3 @@
+"""Operator version string (reference: internal/info/version.go)."""
+
+__version__ = "0.1.0"
