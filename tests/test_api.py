@@ -1,0 +1,118 @@
+"""API-layer tests (reference analog: api/nvidia/v1alpha1/nvidiadriver_types_test.go,
+image-path rules internal/image/image.go tests)."""
+
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.api import (
+    ClusterPolicy,
+    TPUSlice,
+)
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.common import ImageSpec, merge_env
+from tpu_operator.api.crds import all_crds, cluster_policy_crd
+from tpu_operator.api.tpuslice import new_tpu_slice
+
+
+class TestImagePath:
+    def test_repo_image_version(self):
+        s = ImageSpec(repository="gcr.io/tpu-operator", image="libtpu-installer", version="v1.2.3")
+        assert s.image_path() == "gcr.io/tpu-operator/libtpu-installer:v1.2.3"
+
+    def test_digest_version(self):
+        s = ImageSpec(repository="gcr.io/x", image="plugin", version="sha256:" + "a" * 64)
+        assert s.image_path() == "gcr.io/x/plugin@sha256:" + "a" * 64
+
+    def test_image_only(self):
+        s = ImageSpec(image="gcr.io/x/plugin:1.0")
+        assert s.image_path() == "gcr.io/x/plugin:1.0"
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("VALIDATOR_IMAGE", "gcr.io/env/validator@sha256:" + "b" * 64)
+        s = ImageSpec()
+        assert s.image_path("VALIDATOR_IMAGE") == "gcr.io/env/validator@sha256:" + "b" * 64
+
+    def test_empty(self):
+        assert ImageSpec().image_path() == ""
+
+
+class TestClusterPolicy:
+    def test_defaults_from_empty_spec(self):
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy())
+        assert cp.spec.operator.default_runtime == consts.RUNTIME_CONTAINERD
+        assert cp.spec.libtpu.is_enabled()
+        assert cp.spec.device_plugin.is_enabled()
+        assert not cp.spec.psa.is_enabled()
+        assert not cp.spec.multi_slice.is_enabled()
+        assert cp.spec.libtpu.install_dir == consts.LIBTPU_INSTALL_DIR
+        assert cp.spec.daemonsets.priority_class_name == "system-node-critical"
+
+    def test_round_trip(self):
+        obj = new_cluster_policy(
+            spec={
+                "libtpu": {"enabled": False, "repository": "gcr.io/r", "image": "i", "version": "v"},
+                "devicePlugin": {"config": {"name": "plugin-config", "default": "default"}},
+                "metricsExporter": {"serviceMonitor": {"enabled": True, "interval": "30s"}},
+                "daemonsets": {"tolerations": [{"key": "google.com/tpu", "operator": "Exists"}]},
+            }
+        )
+        cp = ClusterPolicy.from_unstructured(obj)
+        assert not cp.spec.libtpu.is_enabled()
+        assert cp.spec.libtpu.image_path() == "gcr.io/r/i:v"
+        assert cp.spec.device_plugin.config.name == "plugin-config"
+        assert cp.spec.metrics_exporter.service_monitor.is_enabled()
+        assert cp.spec.metrics_exporter.service_monitor.interval == "30s"
+        out = cp.to_unstructured()
+        assert out["spec"]["libtpu"]["enabled"] is False
+        assert out["spec"]["devicePlugin"]["config"]["name"] == "plugin-config"
+        assert out["spec"]["daemonsets"]["tolerations"][0]["key"] == "google.com/tpu"
+
+    def test_unknown_fields_tolerated(self):
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy(spec={"bogus": {"x": 1}, "libtpu": {"zzz": 2}}))
+        assert cp.spec.libtpu.is_enabled()
+
+    def test_status_round_trip(self):
+        obj = new_cluster_policy()
+        obj["status"] = {"state": "ready", "namespace": "tpu-operator"}
+        cp = ClusterPolicy.from_unstructured(obj)
+        assert cp.status.state == "ready"
+
+
+class TestTPUSlice:
+    def test_default_node_selector(self):
+        ts = TPUSlice.from_unstructured(new_tpu_slice("default"))
+        assert ts.spec.get_node_selector() == {consts.TPU_PRESENT_LABEL: "true"}
+
+    def test_explicit_node_selector(self):
+        ts = TPUSlice.from_unstructured(
+            new_tpu_slice("v5e", spec={"nodeSelector": {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}})
+        )
+        sel = ts.spec.get_node_selector()
+        assert sel == {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
+
+    def test_env_merge(self):
+        merged = merge_env(
+            [{"name": "A", "value": "1"}, {"name": "B", "value": "2"}],
+            [{"name": "B", "value": "3"}],
+        )
+        assert {e["name"]: e["value"] for e in merged} == {"A": "1", "B": "3"}
+
+
+class TestCRDs:
+    def test_crds_generate_and_serialize(self):
+        crds = all_crds()
+        assert len(crds) == 2
+        names = {c["metadata"]["name"] for c in crds}
+        assert names == {"clusterpolicies.tpu.google.com", "tpuslices.tpu.google.com"}
+        # must be valid YAML round-trippable structures
+        for crd in crds:
+            assert yaml.safe_load(yaml.safe_dump(crd)) == crd
+
+    def test_clusterpolicy_crd_schema_has_subspecs(self):
+        crd = cluster_policy_crd()
+        props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        for key in ("operator", "daemonsets", "libtpu", "devicePlugin", "tfd", "sliceManager",
+                    "metricsExporter", "nodeStatusExporter", "validator", "multiSlice", "psa"):
+            assert key in props, key
+        assert props["libtpu"]["properties"]["installDir"] == {"type": "string"}
+        assert crd["spec"]["scope"] == "Cluster"

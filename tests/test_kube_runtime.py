@@ -139,3 +139,97 @@ def test_manager_lifecycle():
         while "n1" not in hits and time.monotonic() < deadline:
             time.sleep(0.01)
     assert "n1" in hits
+
+
+def test_informer_cache_isolated_from_consumer_mutation():
+    client = FakeClient()
+    inf = Informer(client, "v1", "Node")
+    client.create(new_object("v1", "Node", "n1", labels={"a": "1"}))
+    inf.start()
+    cached = inf.cached()[0]
+    cached["metadata"]["labels"]["a"] = "tampered"
+    assert inf.cached()[0]["metadata"]["labels"]["a"] == "1"
+    inf.stop()
+
+
+def test_informer_rejects_stale_resource_version():
+    client = FakeClient()
+    inf = Informer(client, "v1", "Node")
+    inf.start()
+    fresh = new_object("v1", "Node", "n1")
+    fresh["metadata"]["resourceVersion"] = "7"
+    stale = new_object("v1", "Node", "n1")
+    stale["metadata"]["resourceVersion"] = "5"
+    inf._on_event("ADDED", fresh)
+    inf._on_event("MODIFIED", stale)  # reordered delivery
+    assert inf.cached()[0]["metadata"]["resourceVersion"] == "7"
+    inf.stop()
+
+
+def test_update_status_conflict_on_stale_resource_version():
+    client = FakeClient()
+    created = client.create(new_object("v1", "Node", "n1"))
+    stale = dict(created)
+    client.update(dict(created, spec={"x": 1}, metadata=dict(created["metadata"], resourceVersion=created["metadata"]["resourceVersion"])))
+    import pytest as _pytest
+
+    from tpu_operator.kube import errors as kerrors
+
+    with _pytest.raises(kerrors.Conflict):
+        client.update_status(dict(stale, status={"s": 1}))
+
+
+def test_requeue_true_backoff_grows():
+    q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+
+    class R:
+        def __init__(self):
+            self.calls = 0
+
+        def reconcile(self, req):
+            self.calls += 1
+            return Result(requeue=True)
+
+    r = R()
+    ctrl = Controller("c", r)
+    ctrl.queue = q
+    ctrl.start()
+    q.add(Request(name="x"))
+    time.sleep(0.3)
+    ctrl.stop()
+    # with growing backoff the item cannot have run anywhere near 300ms/10ms times
+    assert 2 <= r.calls <= 12
+    assert q._failures.get(Request(name="x"), 0) >= 2
+
+
+def test_manager_informer_for_after_start_is_live():
+    client = FakeClient()
+    mgr = Manager(client, namespace="ns")
+    hits = []
+    with mgr:
+        inf = mgr.informer_for("v1", "ConfigMap")  # wired after start
+        inf.add_handler(lambda et, old, new: hits.append(new["metadata"]["name"]))
+        client.create(new_object("v1", "ConfigMap", "late", "ns"))
+        deadline = time.monotonic() + 2
+        while "late" not in hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert "late" in hits
+
+
+def test_leader_loss_invokes_on_stopped_leading():
+    client = FakeClient()
+    a = LeaderElector(client, namespace="ns", lease_duration=0.3, renew_interval=0.05)
+    lost = []
+    a.on_stopped_leading = lambda: lost.append(True)
+    a.start()
+    assert a.wait_for_leadership(2.0)
+    # steal the lease out from under A
+    lease = client.get("coordination.k8s.io/v1", "Lease", a.lease_name, "ns")
+    lease["spec"]["holderIdentity"] = "intruder"
+    lease["spec"]["renewTime"] = time.time() + 1000
+    client.update(lease)
+    deadline = time.monotonic() + 3
+    while not lost and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert lost
+    a.stop()

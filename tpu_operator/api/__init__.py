@@ -1,0 +1,22 @@
+"""Typed API layer for the operator's CRDs.
+
+TPU-native analog of the reference's ``api/nvidia`` package
+(api/nvidia/v1/clusterpolicy_types.go, api/nvidia/v1alpha1/nvidiadriver_types.go).
+Objects round-trip to/from their unstructured (dict) wire form at the client
+boundary, the way the reference's typed structs round-trip through
+apimachinery.
+"""
+
+from tpu_operator.api.clusterpolicy import (  # noqa: F401
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+    ClusterPolicySpec,
+    State,
+)
+from tpu_operator.api.tpuslice import (  # noqa: F401
+    TPU_SLICE_API_VERSION,
+    TPU_SLICE_KIND,
+    TPUSlice,
+    TPUSliceSpec,
+)

@@ -1,0 +1,314 @@
+"""ClusterPolicy CRD (tpu.google.com/v1).
+
+TPU-native redesign of the reference's ClusterPolicy
+(api/nvidia/v1/clusterpolicy_types.go:38-91): one cluster-scoped singleton
+whose sub-specs configure each operand the operator deploys. The NVIDIA
+stack maps onto the TPU stack as:
+
+    driver (CUDA kernel modules)        -> libtpu (libtpu installer)
+    toolkit (container runtime hook)    -> (not needed: device plugin mounts
+                                            /dev/accel* + libtpu directly)
+    devicePlugin (k8s-device-plugin)    -> devicePlugin (Cloud TPU plugin)
+    gfd (gpu-feature-discovery)         -> tpuFeatureDiscovery
+    mig/migManager (sub-GPU partition)  -> sliceManager (multi-host slice
+                                            topology + gang placement)
+    dcgm + dcgmExporter                 -> metricsExporter (libtpu metrics)
+    nodeStatusExporter                  -> nodeStatusExporter
+    validator (CUDA vectorAdd)          -> validator (JAX psum over ICI)
+    sandbox/vgpu/vfio/kata/cc           -> out of scope: no TPU analog
+
+Status semantics (State enum, conditions) mirror
+clusterpolicy_types.go:1638-1661 exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.common import (
+    ComponentCommon,
+    ImageSpec,
+    SpecBase,
+    field,
+    sub,
+    sub_optional,
+)
+
+CLUSTER_POLICY_API_VERSION = "tpu.google.com/v1"
+CLUSTER_POLICY_KIND = "ClusterPolicy"
+
+
+class State:
+    """reference: gpuv1.State clusterpolicy_types.go:1638-1645."""
+
+    IGNORED = "ignored"
+    READY = "ready"
+    NOT_READY = "notReady"
+    DISABLED = "disabled"
+
+
+# ---------------------------------------------------------------------------
+# Sub-specs.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OperatorSpec(SpecBase):
+    """reference: OperatorSpec clusterpolicy_types.go:122-145."""
+
+    default_runtime: str = field(json="defaultRuntime", default=consts.RUNTIME_CONTAINERD)
+    init_container: ImageSpec = sub(ImageSpec, json="initContainer")
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class RollingUpdateSpec(SpecBase):
+    max_unavailable: str = field(json="maxUnavailable", default="1")
+
+
+@dataclasses.dataclass
+class DaemonsetsSpec(SpecBase):
+    """Common config stamped onto every operand DaemonSet
+    (reference: DaemonsetsSpec clusterpolicy_types.go:195-228)."""
+
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[dict] = field(default_factory=list)
+    priority_class_name: str = field(json="priorityClassName", default="system-node-critical")
+    update_strategy: str = field(json="updateStrategy", default="RollingUpdate")
+    rolling_update: Optional[RollingUpdateSpec] = sub_optional(RollingUpdateSpec, json="rollingUpdate")
+
+
+@dataclasses.dataclass
+class DrainSpec(SpecBase):
+    """reference: vendored k8s-operator-libs upgrade DrainSpec."""
+
+    enable: bool = field(default=True)
+    force: bool = field(default=False)
+    pod_selector: str = field(json="podSelector", default="")
+    timeout_seconds: int = field(json="timeoutSeconds", default=300)
+    delete_empty_dir: bool = field(json="deleteEmptyDir", default=False)
+
+
+@dataclasses.dataclass
+class PodDeletionSpec(SpecBase):
+    force: bool = field(default=False)
+    timeout_seconds: int = field(json="timeoutSeconds", default=300)
+    delete_empty_dir: bool = field(json="deleteEmptyDir", default=False)
+
+
+@dataclasses.dataclass
+class WaitForCompletionSpec(SpecBase):
+    pod_selector: str = field(json="podSelector", default="")
+    timeout_seconds: int = field(json="timeoutSeconds", default=0)
+
+
+@dataclasses.dataclass
+class UpgradePolicySpec(SpecBase):
+    """Rolling-upgrade policy for libtpu version bumps (reference:
+    DriverUpgradePolicySpec in the vendored upgrade lib)."""
+
+    auto_upgrade: bool = field(json="autoUpgrade", default=False)
+    max_parallel_upgrades: int = field(json="maxParallelUpgrades", default=1)
+    max_unavailable: str = field(json="maxUnavailable", default="25%")
+    wait_for_completion: WaitForCompletionSpec = sub(WaitForCompletionSpec, json="waitForCompletion")
+    pod_deletion: PodDeletionSpec = sub(PodDeletionSpec, json="podDeletion")
+    drain: DrainSpec = sub(DrainSpec)
+
+
+@dataclasses.dataclass
+class LibtpuSpec(ComponentCommon):
+    """The driver-state analog: installs a pinned libtpu.so onto each TPU
+    node (reference: DriverSpec clusterpolicy_types.go:452-570). There are
+    no kernel modules to build — libtpu is a userspace library — so the
+    precompiled/DriverToolkit machinery collapses into a versioned copy.
+    """
+
+    install_dir: str = field(json="installDir", default=consts.LIBTPU_INSTALL_DIR)
+    use_tpu_slice_crd: Optional[bool] = field(json="useTPUSliceCRD", default=None)
+    upgrade_policy: UpgradePolicySpec = sub(UpgradePolicySpec, json="upgradePolicy")
+    startup_probe: Optional[dict] = field(json="startupProbe", default=None)
+    liveness_probe: Optional[dict] = field(json="livenessProbe", default=None)
+
+    def use_slice_crd(self) -> bool:
+        return bool(self.use_tpu_slice_crd)
+
+
+@dataclasses.dataclass
+class DevicePluginConfigSpec(SpecBase):
+    """ConfigMap-based plugin config selection (reference:
+    DevicePluginConfig clusterpolicy_types.go:745-760): ``name`` is a
+    ConfigMap of named configs, ``default`` the fallback config key; nodes
+    opt into a specific config via the plugin-config node label."""
+
+    name: str = field(default="")
+    default: str = field(default="")
+
+
+@dataclasses.dataclass
+class DevicePluginSpec(ComponentCommon):
+    config: DevicePluginConfigSpec = sub(DevicePluginConfigSpec)
+
+
+@dataclasses.dataclass
+class TPUFeatureDiscoverySpec(ComponentCommon):
+    """GFD analog: emits tpu.google.com/{accelerator-type,topology,
+    chips-per-node,slice-hosts,generation} node labels."""
+
+
+@dataclasses.dataclass
+class SliceManagerConfigSpec(SpecBase):
+    name: str = field(default="")
+    default: str = field(default="")
+
+
+@dataclasses.dataclass
+class SliceManagerSpec(ComponentCommon):
+    """MIG-manager analog. TPUs have no sub-chip partitioning; the unit of
+    partitioning is the multi-host slice. The slice manager renders the
+    per-slice gang plumbing (headless Service + worker identity env) and
+    reconciles the per-node ``tpu.google.com/slice.config`` label the way
+    mig-manager reconciles ``nvidia.com/mig.config``."""
+
+    config: SliceManagerConfigSpec = sub(SliceManagerConfigSpec)
+
+
+@dataclasses.dataclass
+class ServiceMonitorSpec(SpecBase):
+    enabled: Optional[bool] = field(default=None)
+    interval: str = field(default="15s")
+    honor_labels: bool = field(json="honorLabels", default=False)
+    additional_labels: Dict[str, str] = field(json="additionalLabels", default_factory=dict)
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+@dataclasses.dataclass
+class MetricsExporterSpec(ComponentCommon):
+    """dcgm + dcgm-exporter analog: one operand scraping libtpu runtime
+    metrics (TensorCore utilization, HBM usage, ICI link bandwidth) into
+    Prometheus exposition format."""
+
+    port: int = field(default=8431)
+    service_monitor: ServiceMonitorSpec = sub(ServiceMonitorSpec, json="serviceMonitor")
+
+
+@dataclasses.dataclass
+class NodeStatusExporterSpec(ComponentCommon):
+    """reference: NodeStatusExporterSpec — per-node validation status
+    metrics served by the validator image."""
+
+
+@dataclasses.dataclass
+class ComponentValidatorSpec(SpecBase):
+    """Per-component validator tuning (reference: PluginValidatorSpec et al.
+    clusterpolicy_types.go:323-383)."""
+
+    env: List[dict] = field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ValidatorSpec(ComponentCommon):
+    """reference: ValidatorSpec clusterpolicy_types.go:255-320. Components:
+    ``libtpu`` (driver analog), ``plugin``, ``workload`` (CUDA analog — JAX
+    device-count smoke), ``slice`` (multi-host psum over ICI)."""
+
+    libtpu: ComponentValidatorSpec = sub(ComponentValidatorSpec)
+    plugin: ComponentValidatorSpec = sub(ComponentValidatorSpec)
+    workload: ComponentValidatorSpec = sub(ComponentValidatorSpec)
+    slice: ComponentValidatorSpec = sub(ComponentValidatorSpec)
+
+
+@dataclasses.dataclass
+class MultiSliceSpec(SpecBase):
+    """Multi-slice (DCN-connected slices) support: the validator and the
+    slice manager wire JAX distributed-coordinator addresses across slices
+    (BASELINE config 5). No reference analog — NVIDIA's cross-node story
+    (NCCL) lives in workload images."""
+
+    enabled: Optional[bool] = field(default=None)
+    coordinator_port: int = field(json="coordinatorPort", default=8476)
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+@dataclasses.dataclass
+class PSASpec(SpecBase):
+    """Pod Security Admission labelling of the operand namespace
+    (reference: PSASpec clusterpolicy_types.go:189-192)."""
+
+    enabled: Optional[bool] = field(default=None)
+
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+
+# ---------------------------------------------------------------------------
+# The spec + object.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ClusterPolicySpec(SpecBase):
+    operator: OperatorSpec = sub(OperatorSpec)
+    daemonsets: DaemonsetsSpec = sub(DaemonsetsSpec)
+    libtpu: LibtpuSpec = sub(LibtpuSpec)
+    device_plugin: DevicePluginSpec = sub(DevicePluginSpec, json="devicePlugin")
+    tpu_feature_discovery: TPUFeatureDiscoverySpec = sub(TPUFeatureDiscoverySpec, json="tfd")
+    slice_manager: SliceManagerSpec = sub(SliceManagerSpec, json="sliceManager")
+    metrics_exporter: MetricsExporterSpec = sub(MetricsExporterSpec, json="metricsExporter")
+    node_status_exporter: NodeStatusExporterSpec = sub(NodeStatusExporterSpec, json="nodeStatusExporter")
+    validator: ValidatorSpec = sub(ValidatorSpec)
+    multi_slice: MultiSliceSpec = sub(MultiSliceSpec, json="multiSlice")
+    psa: PSASpec = sub(PSASpec)
+
+
+@dataclasses.dataclass
+class ClusterPolicyStatus(SpecBase):
+    """reference: ClusterPolicyStatus clusterpolicy_types.go:1648-1661."""
+
+    state: str = field(default="")
+    namespace: str = field(default="")
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClusterPolicy:
+    metadata: dict
+    spec: ClusterPolicySpec
+    status: ClusterPolicyStatus
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @classmethod
+    def from_unstructured(cls, obj: dict) -> "ClusterPolicy":
+        return cls(
+            metadata=obj.get("metadata", {}),
+            spec=ClusterPolicySpec.from_dict(obj.get("spec")),
+            status=ClusterPolicyStatus.from_dict(obj.get("status")),
+        )
+
+    def to_unstructured(self) -> dict:
+        return {
+            "apiVersion": CLUSTER_POLICY_API_VERSION,
+            "kind": CLUSTER_POLICY_KIND,
+            "metadata": self.metadata,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+def new_cluster_policy(name: str = "cluster-policy", spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": CLUSTER_POLICY_API_VERSION,
+        "kind": CLUSTER_POLICY_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

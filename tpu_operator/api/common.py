@@ -1,0 +1,152 @@
+"""Shared spec building blocks.
+
+Mirrors the reference's common spec types (EnvVar, ResourceRequirements,
+image fields + ImagePath resolution — api/nvidia/v1/clusterpolicy_types.go:148-170,
+internal/image/image.go:25-53) in idiomatic Python: every sub-spec is a
+dataclass that tolerantly loads from its unstructured dict form and dumps
+back without empty fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Type, TypeVar
+
+T = TypeVar("T", bound="SpecBase")
+
+
+def _is_empty(value: Any) -> bool:
+    return value is None or value == {} or value == []
+
+
+@dataclasses.dataclass
+class SpecBase:
+    """Base for all spec dataclasses: dict round-tripping with unknown-field
+    tolerance (matching Kubernetes' pruning-off behavior for CRDs)."""
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[dict]) -> T:
+        data = data or {}
+        kwargs = {}
+        for field in dataclasses.fields(cls):
+            if not field.init:
+                continue
+            key = field.metadata.get("json", field.name)
+            if key not in data:
+                continue
+            value = data[key]
+            loader = field.metadata.get("loader")
+            if loader is not None and value is not None:
+                value = loader(value)
+            kwargs[field.name] = value
+        return cls(**kwargs)
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {}
+        for field in dataclasses.fields(self):
+            value = getattr(self, field.name)
+            if _is_empty(value):
+                continue
+            key = field.metadata.get("json", field.name)
+            if isinstance(value, SpecBase):
+                dumped = value.to_dict()
+                if dumped:
+                    out[key] = dumped
+            elif isinstance(value, list) and value and isinstance(value[0], SpecBase):
+                out[key] = [v.to_dict() for v in value]
+            else:
+                out[key] = value
+        return out
+
+
+def field(json: Optional[str] = None, default: Any = None, default_factory: Any = None, loader: Any = None):
+    """Dataclass field with a JSON key and optional nested loader."""
+    metadata: Dict[str, Any] = {}
+    if json:
+        metadata["json"] = json
+    if loader is not None:
+        metadata["loader"] = loader
+    if default_factory is not None:
+        return dataclasses.field(default_factory=default_factory, metadata=metadata)
+    return dataclasses.field(default=default, metadata=metadata)
+
+
+def sub(cls: Type[T], json: Optional[str] = None):
+    """Field holding a nested SpecBase, defaulting to its zero value."""
+    return field(json=json, default_factory=cls, loader=cls.from_dict)
+
+
+def sub_optional(cls: Type[T], json: Optional[str] = None):
+    """Field holding an optional nested SpecBase (None when absent)."""
+    return field(json=json, default=None, loader=cls.from_dict)
+
+
+# ---------------------------------------------------------------------------
+# Env vars. Kept in k8s wire form ({name, value}) since they flow straight
+# into container specs (reference: EnvVar clusterpolicy_types.go:148-154).
+# ---------------------------------------------------------------------------
+
+
+def env_list_to_map(env: Optional[List[dict]]) -> Dict[str, str]:
+    return {e["name"]: e.get("value", "") for e in (env or [])}
+
+
+def merge_env(base: Optional[List[dict]], override: Optional[List[dict]]) -> List[dict]:
+    """Merge env lists; entries in ``override`` win by name."""
+    merged = {e["name"]: dict(e) for e in (base or [])}
+    for e in override or []:
+        merged[e["name"]] = dict(e)
+    return list(merged.values())
+
+
+# ---------------------------------------------------------------------------
+# Image path resolution (reference: internal/image/image.go:25-53 and the
+# CRD-side variant clusterpolicy_types.go:1699+): repository/image/version
+# compose into "repo/image:version", a sha256 "version" becomes a digest
+# reference, and when the CR carries no image fields an env var (OLM-style
+# digest pinning) is consulted.
+# ---------------------------------------------------------------------------
+
+
+class ImageSpecMixin:
+    repository: str
+    image: str
+    version: str
+
+    def image_path(self, env_var: Optional[str] = None) -> str:
+        if self.image:
+            image = f"{self.repository}/{self.image}" if self.repository else self.image
+            if self.version:
+                sep = "@" if self.version.startswith("sha256:") else ":"
+                return f"{image}{sep}{self.version}"
+            return image
+        if env_var:
+            return os.environ.get(env_var, "")
+        return ""
+
+
+@dataclasses.dataclass
+class ImageSpec(SpecBase, ImageSpecMixin):
+    """repository + image + version (+ pull policy/secrets) for one operand."""
+
+    repository: str = field(default="")
+    image: str = field(default="")
+    version: str = field(default="")
+    image_pull_policy: str = field(json="imagePullPolicy", default="IfNotPresent")
+    image_pull_secrets: List[str] = field(json="imagePullSecrets", default_factory=list)
+
+
+@dataclasses.dataclass
+class ComponentCommon(ImageSpec):
+    """Fields shared by every operand sub-spec: enablement, image, scheduling
+    and container knobs (reference pattern repeated across all *Spec types,
+    e.g. DevicePluginSpec clusterpolicy_types.go)."""
+
+    enabled: Optional[bool] = field(default=None)
+    env: List[dict] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    resources: Optional[dict] = field(default=None)
+
+    def is_enabled(self, default: bool = True) -> bool:
+        return default if self.enabled is None else bool(self.enabled)

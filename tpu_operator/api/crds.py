@@ -1,0 +1,135 @@
+"""CustomResourceDefinition objects for the operator's CRDs.
+
+The reference ships generated CRD YAML under
+deployments/gpu-operator/crds/; here the CRDs are generated from the typed
+specs (kubebuilder-style, but at runtime) so `tpuop-cfg crds` and the fake
+apiserver always agree with the dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, List, Optional, get_args, get_origin
+
+from tpu_operator.api import clusterpolicy, tpuslice
+from tpu_operator.api.common import SpecBase
+
+CRD_API_VERSION = "apiextensions.k8s.io/v1"
+GROUP = "tpu.google.com"
+
+
+def _schema_for_type(tp: Any) -> dict:
+    origin = get_origin(tp)
+    if origin is typing.Union:  # Optional[X]
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _schema_for_type(args[0]) if args else {"x-kubernetes-preserve-unknown-fields": True}
+    if origin in (list, List):
+        args = get_args(tp)
+        item = _schema_for_type(args[0]) if args else {"x-kubernetes-preserve-unknown-fields": True}
+        return {"type": "array", "items": item}
+    if origin in (dict, Dict):
+        args = get_args(tp)
+        if args and args[1] is str:
+            return {"type": "object", "additionalProperties": {"type": "string"}}
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if isinstance(tp, type) and issubclass(tp, SpecBase):
+        return _schema_for_spec(tp)
+    if tp is str:
+        return {"type": "string"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is dict:
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    return {"x-kubernetes-preserve-unknown-fields": True}
+
+
+def _schema_for_spec(cls: type) -> dict:
+    hints = typing.get_type_hints(cls)
+    props = {}
+    for f in dataclasses.fields(cls):
+        if not f.init:
+            continue
+        key = f.metadata.get("json", f.name)
+        props[key] = _schema_for_type(hints.get(f.name, dict))
+    return {"type": "object", "properties": props}
+
+
+def _crd(
+    kind: str,
+    plural: str,
+    singular: str,
+    version: str,
+    spec_cls: type,
+    status_cls: type,
+    scope: str = "Cluster",
+    short_names: Optional[List[str]] = None,
+) -> dict:
+    return {
+        "apiVersion": CRD_API_VERSION,
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": singular,
+                **({"shortNames": short_names} if short_names else {}),
+            },
+            "scope": scope,
+            "versions": [
+                {
+                    "name": version,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {"name": "Status", "type": "string", "jsonPath": ".status.state"},
+                        {"name": "Age", "type": "date", "jsonPath": ".metadata.creationTimestamp"},
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": _schema_for_spec(spec_cls),
+                                "status": _schema_for_spec(status_cls),
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def cluster_policy_crd() -> dict:
+    return _crd(
+        kind=clusterpolicy.CLUSTER_POLICY_KIND,
+        plural="clusterpolicies",
+        singular="clusterpolicy",
+        version="v1",
+        spec_cls=clusterpolicy.ClusterPolicySpec,
+        status_cls=clusterpolicy.ClusterPolicyStatus,
+    )
+
+
+def tpu_slice_crd() -> dict:
+    return _crd(
+        kind=tpuslice.TPU_SLICE_KIND,
+        plural="tpuslices",
+        singular="tpuslice",
+        version="v1alpha1",
+        spec_cls=tpuslice.TPUSliceSpec,
+        status_cls=tpuslice.TPUSliceStatus,
+        short_names=["ts"],
+    )
+
+
+def all_crds() -> List[dict]:
+    return [cluster_policy_crd(), tpu_slice_crd()]

@@ -1,0 +1,102 @@
+"""TPUSlice CRD (tpu.google.com/v1alpha1).
+
+Analog of the reference's NVIDIADriver CRD
+(api/nvidia/v1alpha1/nvidiadriver_types.go:40-185): where NVIDIADriver lets
+a cluster run different driver builds on different node pools, TPUSlice
+lets a cluster pin different libtpu versions / slice configurations per
+node pool, each TPUSlice CR selecting a disjoint set of TPU nodes and
+owning the libtpu-installer DaemonSets rendered for them.
+
+Like the reference, a node may be selected by at most one CR
+(internal/validator/validator.go:31-90), and each CR fans out one
+DaemonSet per node pool (internal/state/nodepool.go:55-132) — for TPUs a
+"pool" is the set of nodes sharing accelerator type + topology (one
+multi-host slice family), since libtpu versions must match across a slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.common import ComponentCommon, SpecBase, field, sub
+
+TPU_SLICE_API_VERSION = "tpu.google.com/v1alpha1"
+TPU_SLICE_KIND = "TPUSlice"
+
+
+class SliceType:
+    """reference: DriverType nvidiadriver_types.go:429-441 (gpu / vgpu /
+    vgpu-host-manager). TPUs have no virtualized mode; the distinction that
+    matters is single-host vs multi-host slices."""
+
+    SINGLE_HOST = "single-host"
+    MULTI_HOST = "multi-host"
+
+
+@dataclasses.dataclass
+class TPUSliceSpec(ComponentCommon):
+    """Per-instance libtpu deployment spec (reference:
+    NVIDIADriverSpec nvidiadriver_types.go:40-185)."""
+
+    slice_type: str = field(json="sliceType", default=SliceType.MULTI_HOST)
+    node_selector: Dict[str, str] = field(json="nodeSelector", default_factory=dict)
+    install_dir: str = field(json="installDir", default=consts.LIBTPU_INSTALL_DIR)
+    priority_class_name: str = field(json="priorityClassName", default="system-node-critical")
+    tolerations: List[dict] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_affinity: Optional[dict] = field(json="nodeAffinity", default=None)
+
+    def get_node_selector(self) -> Dict[str, str]:
+        """Default to all TPU nodes when unset (reference:
+        GetNodeSelector nvidiadriver_types.go:504-516)."""
+        if self.node_selector:
+            return dict(self.node_selector)
+        return {consts.TPU_PRESENT_LABEL: "true"}
+
+
+@dataclasses.dataclass
+class TPUSliceStatus(SpecBase):
+    """reference: NVIDIADriverStatus nvidiadriver_types.go:444-460."""
+
+    state: str = field(default="")
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TPUSlice:
+    metadata: dict
+    spec: TPUSliceSpec
+    status: TPUSliceStatus
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @classmethod
+    def from_unstructured(cls, obj: dict) -> "TPUSlice":
+        return cls(
+            metadata=obj.get("metadata", {}),
+            spec=TPUSliceSpec.from_dict(obj.get("spec")),
+            status=TPUSliceStatus.from_dict(obj.get("status")),
+        )
+
+    def to_unstructured(self) -> dict:
+        return {
+            "apiVersion": TPU_SLICE_API_VERSION,
+            "kind": TPU_SLICE_KIND,
+            "metadata": self.metadata,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+def new_tpu_slice(name: str, spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": TPU_SLICE_API_VERSION,
+        "kind": TPU_SLICE_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

@@ -99,9 +99,13 @@ class Controller:
                 self.queue.add_rate_limited(req)
                 self.queue.done(req)
                 continue
-            self.queue.forget(req)
             if result.requeue_after > 0:
+                self.queue.forget(req)
                 self.queue.add_after(req, result.requeue_after)
             elif result.requeue:
+                # no forget: Requeue=true keeps the per-item backoff growing
+                # toward max_delay, like client-go's AddRateLimited path
                 self.queue.add_rate_limited(req)
+            else:
+                self.queue.forget(req)
             self.queue.done(req)

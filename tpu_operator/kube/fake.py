@@ -47,6 +47,8 @@ class FakeClient(Client):
         self._rv = 0
         self._uid = 0
         self._watchers: dict = {}  # (group, kind) -> [_Sub]
+        self._pending: list = []  # events awaiting dispatch, in commit order
+        self._dispatch_lock = threading.RLock()  # reentrant: handlers may mutate and re-enter _notify
 
     # -- internals ----------------------------------------------------------
 
@@ -57,16 +59,26 @@ class FakeClient(Client):
         self._rv += 1
         return str(self._rv)
 
-    def _notify(self, events):
-        # Dispatch outside the lock so handlers may call back into the client.
-        for event_type, obj in events:
-            key = (api_group(obj["apiVersion"]), obj["kind"])
-            for sub in list(self._watchers.get(key, [])):
-                if not sub.active:
-                    continue
-                if sub.namespace and obj["metadata"].get("namespace") != sub.namespace:
-                    continue
-                sub.handler(event_type, deep_copy(obj))
+    def _notify(self):
+        # Events were enqueued (under the store lock, in commit order) into
+        # self._pending by the mutator; dispatch happens outside the store
+        # lock — so handlers may call back into the client — but serialized
+        # under a dedicated dispatch lock draining the shared FIFO, so two
+        # concurrent writers can never deliver a stale object after a newer
+        # one.
+        while True:
+            with self._dispatch_lock:
+                with self._lock:
+                    if not self._pending:
+                        return
+                    event_type, obj = self._pending.pop(0)
+                key = (api_group(obj["apiVersion"]), obj["kind"])
+                for sub in list(self._watchers.get(key, [])):
+                    if not sub.active:
+                        continue
+                    if sub.namespace and obj["metadata"].get("namespace") != sub.namespace:
+                        continue
+                    sub.handler(event_type, deep_copy(obj))
 
     # -- Client API ---------------------------------------------------------
 
@@ -112,7 +124,8 @@ class FakeClient(Client):
             md.setdefault("generation", 1)
             self._store[key] = obj
             stored = deep_copy(obj)
-        self._notify([(ADDED, stored)])
+            self._pending.append((ADDED, stored))
+        self._notify()
         return deep_copy(stored)
 
     def update(self, obj):
@@ -142,7 +155,8 @@ class FakeClient(Client):
                 del obj["status"]
             self._store[key] = obj
             stored = deep_copy(obj)
-        self._notify([(MODIFIED, stored)])
+            self._pending.append((MODIFIED, stored))
+        self._notify()
         return deep_copy(stored)
 
     def update_status(self, obj):
@@ -152,22 +166,28 @@ class FakeClient(Client):
             existing = self._store.get(key)
             if existing is None:
                 raise errors.NotFound(f"{obj['kind']} {md.get('name')} not found")
+            rv = md.get("resourceVersion")
+            if rv and rv != existing["metadata"]["resourceVersion"]:
+                raise errors.Conflict(
+                    f"{obj['kind']} {md.get('name')}: status resourceVersion {rv} "
+                    f"!= {existing['metadata']['resourceVersion']}"
+                )
             existing["status"] = deep_copy(obj.get("status", {}))
             existing["metadata"]["resourceVersion"] = self._next_rv()
             stored = deep_copy(existing)
-        self._notify([(MODIFIED, stored)])
+            self._pending.append((MODIFIED, stored))
+        self._notify()
         return deep_copy(stored)
 
     def delete(self, api_version, kind, name, namespace=None):
-        events = []
         with self._lock:
             key = self._key(api_version, kind, name, namespace)
             obj = self._store.pop(key, None)
             if obj is None:
                 raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
-            events.append((DELETED, obj))
-            events.extend(self._collect_garbage(obj["metadata"].get("uid")))
-        self._notify(events)
+            self._pending.append((DELETED, obj))
+            self._pending.extend(self._collect_garbage(obj["metadata"].get("uid")))
+        self._notify()
 
     def _collect_garbage(self, owner_uid):
         """Cascade-delete dependents (background GC semantics)."""

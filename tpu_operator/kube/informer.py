@@ -7,7 +7,17 @@ import threading
 from typing import Callable, List, Optional
 
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client
-from tpu_operator.kube.objects import ObjectDict, object_key
+from tpu_operator.kube.objects import ObjectDict, deep_copy, object_key
+
+
+def _newer(rv_new, rv_old) -> bool:
+    """True when rv_new is strictly newer than rv_old. resourceVersions are
+    opaque but orderable per apiserver; fall back to inequality when they
+    aren't numeric."""
+    try:
+        return int(rv_new) > int(rv_old)
+    except (TypeError, ValueError):
+        return rv_new != rv_old
 
 log = logging.getLogger(__name__)
 
@@ -51,15 +61,22 @@ class Informer:
             if event_type == DELETED:
                 self._cache.pop(key, None)
             else:
-                if old is not None and old["metadata"].get("resourceVersion") == obj["metadata"].get(
-                    "resourceVersion"
+                if old is not None and not _newer(
+                    obj["metadata"].get("resourceVersion"), old["metadata"].get("resourceVersion")
                 ):
-                    # duplicate delivery (e.g. list replay after watch) — drop
+                    # duplicate or stale delivery (list replay after watch,
+                    # or reordered concurrent notifications) — drop
                     return
-                self._cache[key] = obj
+                self._cache[key] = deep_copy(obj)
         for handler in self._handlers:
             try:
-                handler(event_type if old is None or event_type == DELETED else MODIFIED, old, obj)
+                # each handler gets its own copies so one handler mutating an
+                # object can't corrupt the cache or its peers
+                handler(
+                    event_type if old is None or event_type == DELETED else MODIFIED,
+                    deep_copy(old) if old is not None else None,
+                    deep_copy(obj),
+                )
             except Exception:  # noqa: BLE001 — informer must survive handler bugs
                 log.exception("informer handler failed for %s %s", self.kind, key)
 
@@ -67,4 +84,4 @@ class Informer:
 
     def cached(self) -> List[ObjectDict]:
         with self._lock:
-            return list(self._cache.values())
+            return [deep_copy(obj) for obj in self._cache.values()]

@@ -40,6 +40,10 @@ class LeaderElector:
         self._leading = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Invoked (once) when leadership is LOST after having been held.
+        # client-go treats this as fatal (OnStoppedLeading → exit); the
+        # Manager wires this to a full shutdown.
+        self.on_stopped_leading: Optional[callable] = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="leader-elector", daemon=True)
@@ -64,7 +68,13 @@ class LeaderElector:
             if self._try_acquire_or_renew():
                 self._leading.set()
             else:
+                was_leading = self._leading.is_set()
                 self._leading.clear()
+                if was_leading:
+                    log.error("leader election: lost lease %s", self.lease_name)
+                    if self.on_stopped_leading is not None:
+                        self.on_stopped_leading()
+                    return
             self._stop.wait(self.renew_interval)
 
     def _try_acquire_or_renew(self) -> bool:

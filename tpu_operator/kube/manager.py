@@ -45,10 +45,15 @@ class Manager:
     # -- building -----------------------------------------------------------
 
     def informer_for(self, api_version: str, kind: str, namespace: Optional[str] = None) -> Informer:
-        """Shared informer per (api_version, kind, namespace)."""
+        """Shared informer per (api_version, kind, namespace). If the manager
+        is already running, the informer is started (list+watch) immediately
+        so late wiring never yields a silent dead watch."""
         key = (api_version, kind, namespace or "")
         if key not in self._informers:
-            self._informers[key] = Informer(self.client, api_version, kind, namespace)
+            informer = Informer(self.client, api_version, kind, namespace)
+            self._informers[key] = informer
+            if self._started.is_set():
+                informer.start()
         return self._informers[key]
 
     def add_controller(self, controller: Controller) -> Controller:
@@ -63,15 +68,30 @@ class Manager:
         if self._metrics_addr:
             self._servers.append(_serve(self._metrics_addr, self._metrics_handler()))
         if self._leader:
+            self._leader.on_stopped_leading = self._on_stopped_leading
             self._leader.start()
             if wait_for_leader:
                 self._leader.wait_for_leadership()
+        # Informers first: each Informer.start() lists synchronously, so by
+        # the time workers start every cache has synced — the equivalent of
+        # controller-runtime blocking workers on WaitForCacheSync.
+        self._started.set()
+        for informer in list(self._informers.values()):
+            informer.start()
         for controller in self._controllers:
             controller.start()
-        for informer in self._informers.values():
-            informer.start()
-        self._started.set()
         log.info("manager started: %d controllers, %d informers", len(self._controllers), len(self._informers))
+
+    def _on_stopped_leading(self) -> None:
+        """Losing the lease while running is fatal, like client-go's
+        OnStoppedLeading → exit: a deposed leader must never keep reconciling
+        alongside the new one (split-brain). The manager tears itself down;
+        the process entrypoint exits on ``stopped()``."""
+        log.critical("leader lease lost — stopping manager to avoid split-brain")
+        threading.Thread(target=self.stop, name="leader-loss-shutdown", daemon=True).start()
+
+    def stopped(self) -> bool:
+        return not self._started.is_set()
 
     def stop(self) -> None:
         for controller in self._controllers:
