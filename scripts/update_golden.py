@@ -1,0 +1,34 @@
+#!/usr/bin/env python3
+"""Regenerate golden render files (reference: internal/state/testdata/golden)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml
+
+from tpu_operator.api import ClusterPolicy
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.catalog import InfoCatalog
+from tpu_operator.states import STATE_ORDER, new_cluster_policy_states
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "golden")
+
+
+def main():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    cp = ClusterPolicy.from_unstructured(
+        new_cluster_policy(spec={"metricsExporter": {"serviceMonitor": {"enabled": True}}})
+    )
+    catalog = InfoCatalog(cluster_policy=cp)
+    for state in new_cluster_policy_states():
+        objs = state.renderer.render_objects(state.get_render_data(catalog))
+        path = os.path.join(GOLDEN_DIR, f"{state.name}.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump_all(objs, f, default_flow_style=False, sort_keys=False)
+        print(f"wrote {path} ({len(objs)} objects)")
+    assert set(STATE_ORDER) == {s.name for s in new_cluster_policy_states()}
+
+
+if __name__ == "__main__":
+    main()

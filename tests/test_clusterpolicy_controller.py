@@ -1,0 +1,179 @@
+"""ClusterPolicy controller tests (reference analogs:
+controllers/state_manager_test.go, clusterpolicy_controller behavior,
+and the bash e2e's install→Ready→update→disable flow)."""
+
+import time
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_with_manager,
+)
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+NS = "tpu-operator"
+
+
+def get_cp(client, name="cluster-policy"):
+    return client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, name)
+
+
+def wait_for(fn, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestReconcileDirect:
+    """Single reconcile calls, no manager (fake-client unit style)."""
+
+    def test_no_tpu_nodes_reaches_ready_with_poll_requeue(self):
+        client = FakeClient()
+        client.create(new_object("v1", "Node", "cpu-0"))
+        client.create(new_cluster_policy())
+        r = ClusterPolicyReconciler(client, NS)
+        result = r.reconcile(Request(name="cluster-policy"))
+        assert result.requeue_after == consts.REQUEUE_NO_TPU_NODES_SECONDS
+        cp = get_cp(client)
+        assert cp["status"]["state"] == "ready"
+        reasons = {c["type"]: c["reason"] for c in cp["status"]["conditions"]}
+        assert reasons["Ready"] == "NoTPUNodes"
+        # no operand daemonsets created
+        assert client.list("apps/v1", "DaemonSet", NS) == []
+
+    def test_tpu_nodes_get_labelled(self):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_object("v1", "Node", "cpu-0"))
+        client.create(new_cluster_policy())
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[consts.TPU_PRESENT_LABEL] == "true"
+        assert labels[consts.TPU_WORKLOAD_CONFIG_LABEL] == "container"
+        for op in ("libtpu", "device-plugin", "tfd", "slice-manager",
+                   "metrics-exporter", "node-status-exporter", "operator-validation"):
+            assert labels[consts.COMMON_DEPLOY_LABEL_PREFIX + op] == "true", op
+        cpu_labels = client.get("v1", "Node", "cpu-0")["metadata"].get("labels", {})
+        assert consts.TPU_PRESENT_LABEL not in cpu_labels
+
+    def test_disabled_operand_label_removed_and_ds_deleted(self):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        assert client.get("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS)
+        cp = get_cp(client)
+        cp["spec"]["metricsExporter"] = {"enabled": False}
+        client.update(cp)
+        r.reconcile(Request(name="cluster-policy"))
+        assert client.get_or_none("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS) is None
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert consts.COMMON_DEPLOY_LABEL_PREFIX + "metrics-exporter" not in labels
+
+    def test_node_losing_tpu_is_stripped(self):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        node = client.get("v1", "Node", "tpu-0")
+        del node["metadata"]["labels"]["cloud.google.com/gke-tpu-accelerator"]
+        client.update(node)
+        r.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert consts.TPU_PRESENT_LABEL not in labels
+        assert not any(k.startswith(consts.COMMON_DEPLOY_LABEL_PREFIX) for k in labels)
+
+    def test_singleton_guard_marks_newer_cr_ignored(self):
+        client = FakeClient()
+        client.create(new_cluster_policy("first"))
+        time.sleep(1.1)  # creationTimestamp has 1s resolution
+        client.create(new_cluster_policy("second"))
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="second"))
+        assert get_cp(client, "second")["status"]["state"] == "ignored"
+        r.reconcile(Request(name="first"))
+        assert get_cp(client, "first")["status"]["state"] in ("ready", "notReady")
+
+    def test_workload_config_opt_out_blocks_deploy_labels(self):
+        client = FakeClient()
+        node = make_tpu_node("tpu-0")
+        node["metadata"]["labels"][consts.TPU_WORKLOAD_CONFIG_LABEL] = "none"
+        client.create(node)
+        client.create(new_cluster_policy())
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[consts.TPU_WORKLOAD_CONFIG_LABEL] == "none"  # preserved
+        assert not any(k.startswith(consts.COMMON_DEPLOY_LABEL_PREFIX) for k in labels)
+
+
+class TestEndToEnd:
+    """Full manager + sim: install → Ready (BASELINE config 1/2 shape)."""
+
+    def test_install_to_ready_with_sim(self):
+        client = FakeClient()
+        for i in range(4):  # a v5e-16 slice: 4 hosts
+            client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+        sim = ClusterSim(client, ready_delay=0.1).start()
+        mgr = Manager(client, namespace=NS)
+        reconciler = ClusterPolicyReconciler(client, NS)
+        setup_with_manager(mgr, reconciler)
+        try:
+            mgr.start()
+            client.create(new_cluster_policy())
+
+            def settled():
+                if get_cp(client).get("status", {}).get("state") != "ready":
+                    return False
+                dses = client.list("apps/v1", "DaemonSet", NS)
+                return len(dses) == 7 and all(
+                    ds.get("status", {}).get("desiredNumberScheduled") == 4
+                    and ds["status"].get("numberAvailable") == 4
+                    for ds in dses
+                )
+
+            assert wait_for(settled, timeout=15), get_cp(client).get("status")
+            # sim created operand pods on every node
+            pods = client.list("v1", "Pod", NS)
+            assert len(pods) == 28
+        finally:
+            mgr.stop()
+            sim.stop()
+
+    def test_new_tpu_node_triggers_relabel_via_watch(self):
+        client = FakeClient()
+        sim = ClusterSim(client, ready_delay=0.0).start()
+        mgr = Manager(client, namespace=NS)
+        reconciler = ClusterPolicyReconciler(client, NS)
+        setup_with_manager(mgr, reconciler)
+        try:
+            mgr.start()
+            client.create(new_cluster_policy())
+            assert wait_for(lambda: get_cp(client).get("status", {}).get("state") == "ready", timeout=10)
+            # no TPU nodes yet -> no DSes
+            assert client.list("apps/v1", "DaemonSet", NS) == []
+            client.create(make_tpu_node("tpu-late"))
+            assert wait_for(
+                lambda: client.get("v1", "Node", "tpu-late")["metadata"]["labels"].get(consts.TPU_PRESENT_LABEL)
+                == "true",
+                timeout=10,
+            )
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 7, timeout=10)
+        finally:
+            mgr.stop()
+            sim.stop()

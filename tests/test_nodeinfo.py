@@ -1,0 +1,76 @@
+"""nodeinfo / clusterinfo / nodepool tests (reference analogs:
+internal/nodeinfo tests, internal/state/nodepool.go cases)."""
+
+from tpu_operator import consts
+from tpu_operator.clusterinfo import detect
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.nodeinfo import parse_topology, tfd_labels, tpu_info
+from tpu_operator.nodepool import get_node_pools
+
+
+def test_parse_topology():
+    assert parse_topology("4x4") == [4, 4]
+    assert parse_topology("2x2x2") == [2, 2, 2]
+    assert parse_topology("") == []
+    assert parse_topology("weird") == []
+
+
+def test_tpu_info_v5e_multihost():
+    node = make_tpu_node("n0", "tpu-v5-lite-podslice", "4x4")
+    info = tpu_info(node)
+    assert info.generation == "v5e"
+    assert info.chips_in_slice == 16
+    assert info.chips_per_node == 4
+    assert info.slice_hosts == 4
+    assert info.multi_host
+
+
+def test_tpu_info_v4_single_host():
+    node = make_tpu_node("n0", "tpu-v4-podslice", "2x2x1")
+    info = tpu_info(node)
+    assert info.generation == "v4"
+    assert info.chips_in_slice == 4
+    assert info.slice_hosts == 1
+    assert not info.multi_host
+
+
+def test_non_tpu_node():
+    assert tpu_info(new_object("v1", "Node", "cpu-node")) is None
+
+
+def test_tfd_labels():
+    info = tpu_info(make_tpu_node("n0", "tpu-v5p-slice", "2x2x2"))
+    labels = tfd_labels(info)
+    assert labels[consts.TFD_TPU_GENERATION_LABEL] == "v5p"
+    assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
+    assert labels[consts.TFD_SLICE_HOSTS_LABEL] == "2"
+    assert labels[consts.TFD_TOPOLOGY_LABEL] == "2x2x2"
+
+
+def test_clusterinfo_detect():
+    client = FakeClient()
+    client.create(make_tpu_node("tpu-0"))
+    client.create(new_object("v1", "Node", "cpu-0"))
+    info = detect(client)
+    assert info.container_runtime == "containerd"
+    assert info.is_gke
+    assert info.tpu_node_count == 1
+    assert info.kubernetes_version.startswith("v1.29")
+
+
+def test_node_pools_partition_by_type_topology_pool():
+    nodes = [
+        make_tpu_node("a0", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a"),
+        make_tpu_node("a1", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a"),
+        make_tpu_node("b0", "tpu-v5p-slice", "2x2x2", nodepool="pool-b"),
+        new_object("v1", "Node", "cpu-0"),
+    ]
+    pools = get_node_pools(nodes)
+    assert len(pools) == 2
+    a, b = pools
+    assert a.node_names == ["a0", "a1"]
+    assert a.selector[consts.GKE_TPU_ACCELERATOR_LABEL] == "tpu-v5-lite-podslice"
+    assert a.selector[consts.GKE_TPU_TOPOLOGY_LABEL] == "4x4"
+    assert b.info.generation == "v5p"

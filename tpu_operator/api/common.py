@@ -17,7 +17,9 @@ T = TypeVar("T", bound="SpecBase")
 
 
 def _is_empty(value: Any) -> bool:
-    return value is None or value == {} or value == []
+    """Go omitempty parity: zero-value strings/dicts/lists are omitted on
+    dump (False and 0 are kept — they are meaningful spec values)."""
+    return value is None or value == {} or value == [] or (isinstance(value, str) and value == "")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,26 @@
+"""Info catalog handed to states at sync time.
+
+Reference: the ``InfoCatalog`` built per reconcile
+(nvidiadriver_controller.go:128-134) bundling the cluster facts and the CR
+being reconciled, so states stay free of client plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import ClusterPolicy
+
+
+@dataclasses.dataclass
+class InfoCatalog:
+    cluster_policy: ClusterPolicy
+    namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE
+    runtime: str = consts.RUNTIME_CONTAINERD
+    kubernetes_version: str = ""
+    has_tpu_nodes: bool = True
+    # set by the TPUSlice path: the TPUSlice CR + its node pools
+    tpu_slice: Optional[object] = None
+    node_pools: Optional[list] = None

@@ -1,0 +1,55 @@
+"""Cluster facts provider.
+
+Reference: ``controllers/clusterinfo`` (clusterinfo.go:42-125) — a oneshot
+or live provider of cluster-level facts consumed by the controllers:
+kubernetes version, container runtime, platform flavor. The OpenShift
+machinery (RHCOS versions, DriverToolkit imagestreams, proxy spec) has no
+GKE analog; the GKE-specific fact is whether nodes carry GKE node-pool
+labels at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_operator import consts
+from tpu_operator.kube.client import Client
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    kubernetes_version: str = ""
+    container_runtime: str = consts.RUNTIME_CONTAINERD
+    is_gke: bool = False
+    tpu_node_count: int = 0
+
+
+def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD) -> ClusterInfo:
+    """Oneshot detection from Node objects (reference: getRuntime
+    state_manager.go:714-751 inspects node.status.nodeInfo
+    .containerRuntimeVersion of schedulable nodes)."""
+    from tpu_operator.nodeinfo import is_tpu_node
+
+    nodes = client.list("v1", "Node")
+    runtime = ""
+    k8s_version = ""
+    is_gke = False
+    tpu_nodes = 0
+    for node in nodes:
+        labels = node.get("metadata", {}).get("labels", {}) or {}
+        if consts.GKE_NODEPOOL_LABEL in labels:
+            is_gke = True
+        if is_tpu_node(node):
+            tpu_nodes += 1
+        info = node.get("status", {}).get("nodeInfo", {})
+        if not k8s_version and info.get("kubeletVersion"):
+            k8s_version = info["kubeletVersion"]
+        crv = info.get("containerRuntimeVersion", "")
+        if crv and not runtime:
+            runtime = crv.split(":")[0].replace("://", "")
+    return ClusterInfo(
+        kubernetes_version=k8s_version,
+        container_runtime=runtime or default_runtime,
+        is_gke=is_gke,
+        tpu_node_count=tpu_nodes,
+    )

@@ -1,0 +1,1 @@
+"""Controllers (reference: controllers/ — the three reconcilers)."""

@@ -1,0 +1,236 @@
+"""ClusterPolicy reconciler — the primary control loop.
+
+Reference: ``controllers/clusterpolicy_controller.go:94-235`` +
+``state_manager.go`` — fetch the singleton CR, re-detect cluster facts,
+label TPU nodes with per-operand deploy gates, sync the ordered operand
+states, then publish status/conditions with the reference's requeue
+semantics (5s while NotReady, 45s poll while the cluster has no TPU
+nodes).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from tpu_operator import clusterinfo, consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+    State,
+)
+from tpu_operator.catalog import InfoCatalog
+from tpu_operator.controllers import conditions
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import is_tpu_node
+from tpu_operator.state import StateManager, SyncStates
+from tpu_operator.states import new_cluster_policy_states
+
+log = logging.getLogger(__name__)
+
+# the per-operand deploy gates stamped onto TPU nodes
+# (reference: gpuStateLabels state_manager.go:86-111)
+OPERAND_DEPLOY_KEYS = {
+    "state-libtpu": consts.COMMON_DEPLOY_LABEL_PREFIX + "libtpu",
+    "state-device-plugin": consts.COMMON_DEPLOY_LABEL_PREFIX + "device-plugin",
+    "state-operator-validation": consts.COMMON_DEPLOY_LABEL_PREFIX + "operator-validation",
+    "state-tpu-feature-discovery": consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd",
+    "state-slice-manager": consts.COMMON_DEPLOY_LABEL_PREFIX + "slice-manager",
+    "state-metrics-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "metrics-exporter",
+    "state-node-status-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "node-status-exporter",
+}
+
+
+class ClusterPolicyReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.state_manager = StateManager(new_cluster_policy_states())
+        self.metrics = get_metrics()
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)
+        if obj is None:
+            return Result()  # deleted; operands are GC'd via ownerReferences
+
+        # singleton guard (reference: clusterpolicy_controller.go:121-126):
+        # the oldest CR wins, any other instance is marked ignored
+        if not self._is_primary(obj):
+            self._update_status(obj, State.IGNORED, reason="MultipleClusterPolicies",
+                                message="only the oldest ClusterPolicy is reconciled")
+            return Result()
+
+        cp = ClusterPolicy.from_unstructured(obj)
+
+        # init: re-detect cluster facts + label nodes every reconcile
+        # (reference: init() state_manager.go:753-895)
+        info = clusterinfo.detect(self.client, cp.spec.operator.default_runtime)
+        catalog = InfoCatalog(
+            cluster_policy=cp,
+            namespace=self.namespace,
+            runtime=info.container_runtime,
+            kubernetes_version=info.kubernetes_version,
+            has_tpu_nodes=info.tpu_node_count > 0,
+        )
+        try:
+            self._label_tpu_nodes(cp)
+        except errors.ApiError as e:
+            log.warning("node labelling failed: %s", e)
+            self.metrics.record_failure()
+            return Result(requeue=True)
+        self.metrics.tpu_nodes_total.set(info.tpu_node_count)
+
+        results = self.state_manager.sync_state(self.client, catalog, owner=obj)
+        not_ready = [n for n, r in results.states.items() if r.state == SyncStates.NOT_READY]
+        errored = [n for n, r in results.states.items() if r.state == SyncStates.ERROR]
+        self.metrics.operand_states_not_ready.set(len(not_ready) + len(errored))
+
+        if errored:
+            self.metrics.record_failure()
+            self._update_status(
+                obj, State.NOT_READY, error=True, reason="OperandError",
+                message=f"states errored: {', '.join(sorted(errored))}",
+            )
+            return Result(requeue=True)  # rate-limited backoff
+
+        if not_ready:
+            self.metrics.record_success()
+            self._update_status(
+                obj, State.NOT_READY, reason="OperandNotReady",
+                message=f"waiting on states: {', '.join(sorted(not_ready))}",
+            )
+            return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
+
+        self.metrics.record_success()
+        if not catalog.has_tpu_nodes:
+            # ready with zero accelerator nodes (BASELINE config 1), but keep
+            # polling for TPU nodes to appear (reference: 45s NFD poll,
+            # clusterpolicy_controller.go:199)
+            self._update_status(obj, State.READY, reason="NoTPUNodes",
+                                message="no TPU nodes in cluster; operands idle")
+            return Result(requeue_after=consts.REQUEUE_NO_TPU_NODES_SECONDS)
+        self._update_status(obj, State.READY, reason="Ready",
+                            message="all operand states are ready")
+        return Result()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _is_primary(self, obj: ObjectDict) -> bool:
+        all_cps = self.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        if not all_cps:
+            return True
+        all_cps.sort(key=lambda o: (o["metadata"].get("creationTimestamp", ""), o["metadata"]["name"]))
+        return all_cps[0]["metadata"]["name"] == obj["metadata"]["name"]
+
+    def _update_status(
+        self,
+        obj: ObjectDict,
+        state: str,
+        reason: str = "",
+        message: str = "",
+        error: bool = False,
+    ) -> None:
+        """reference: updateCRState clusterpolicy_controller.go:237 +
+        conditions updater."""
+        status = obj.setdefault("status", {})
+        conds = status.get("conditions", [])
+        if error:
+            conditions.set_error(conds, reason, message)
+        elif state == State.READY:
+            conditions.set_ready(conds, reason, message)
+        else:
+            conditions.set_not_ready(conds, reason or "NotReady", message)
+        changed = status.get("state") != state or status.get("conditions") != conds
+        status.update({"state": state, "namespace": self.namespace, "conditions": conds})
+        if changed:
+            try:
+                self.client.update_status(obj)
+            except errors.Conflict:
+                pass  # next reconcile re-reads and re-publishes
+
+    def _enabled_operand_keys(self, cp: ClusterPolicy) -> List[str]:
+        catalog = InfoCatalog(cluster_policy=cp, namespace=self.namespace)
+        return [
+            OPERAND_DEPLOY_KEYS[s.name]
+            for s in self.state_manager.states
+            if s.name in OPERAND_DEPLOY_KEYS and s.is_enabled(catalog)
+        ]
+
+    def _label_tpu_nodes(self, cp: ClusterPolicy) -> None:
+        """reference: labelGPUNodes state_manager.go:481-581 — stamp
+        tpu.present + per-operand deploy labels on TPU nodes, strip all our
+        labels from nodes that no longer have TPUs. Existing explicit values
+        (e.g. a hand-set \"false\" opt-out) are left alone."""
+        enabled_keys = set(self._enabled_operand_keys(cp))
+        for node in self.client.list("v1", "Node"):
+            labels = node["metadata"].setdefault("labels", {})
+            changed = False
+            if is_tpu_node(node):
+                if labels.get(consts.TPU_PRESENT_LABEL) != "true":
+                    labels[consts.TPU_PRESENT_LABEL] = "true"
+                    changed = True
+                if consts.TPU_WORKLOAD_CONFIG_LABEL not in labels:
+                    labels[consts.TPU_WORKLOAD_CONFIG_LABEL] = consts.DEFAULT_WORKLOAD_CONFIG
+                    changed = True
+                workload = labels[consts.TPU_WORKLOAD_CONFIG_LABEL]
+                for key in OPERAND_DEPLOY_KEYS.values():
+                    want = key in enabled_keys and workload == consts.WORKLOAD_CONFIG_CONTAINER
+                    if want and key not in labels:
+                        labels[key] = "true"
+                        changed = True
+                    elif not want and key in labels:
+                        del labels[key]
+                        changed = True
+            else:
+                ours = [consts.TPU_PRESENT_LABEL, consts.TPU_WORKLOAD_CONFIG_LABEL, *OPERAND_DEPLOY_KEYS.values()]
+                for key in ours:
+                    if key in labels:
+                        del labels[key]
+                        changed = True
+            if changed:
+                try:
+                    self.client.update(node)
+                except errors.Conflict:
+                    # node moved under us; the node watch re-triggers reconcile
+                    log.debug("node %s label update conflicted", node["metadata"]["name"])
+
+
+def node_labels_changed(event_type: str, old: Optional[ObjectDict], new: ObjectDict) -> bool:
+    """Watch predicate (reference: node predicates
+    clusterpolicy_controller.go:283-341): care about node add/delete and
+    label changes only."""
+    if event_type != "MODIFIED" or old is None:
+        return True
+    return old["metadata"].get("labels") != new["metadata"].get("labels")
+
+
+def setup_with_manager(mgr, reconciler: ClusterPolicyReconciler) -> Controller:
+    """reference: SetupWithManager clusterpolicy_controller.go:352-407 —
+    watch the CR (generation-gated), Node label events, and owned
+    DaemonSets, all funnelled into requests for every ClusterPolicy."""
+    ctrl = Controller("clusterpolicy", reconciler)
+
+    def map_to_all_cps(_obj) -> List[Request]:
+        try:
+            cps = reconciler.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=cp["metadata"]["name"]) for cp in cps]
+
+    ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND), predicate=generation_changed)
+    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_all_cps, predicate=node_labels_changed)
+
+    def owned_daemonset(event_type, old, new) -> bool:
+        refs = new["metadata"].get("ownerReferences", [])
+        return any(r.get("kind") == CLUSTER_POLICY_KIND for r in refs)
+
+    ctrl.watch(mgr.informer_for("apps/v1", "DaemonSet"), mapper=map_to_all_cps, predicate=owned_daemonset)
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -1,0 +1,67 @@
+"""Status condition updaters.
+
+Reference: ``internal/conditions`` (conditions.go:31, clusterpolicy.go:32-101,
+nvidiadriver.go:38-114) — set a ``Ready`` and an ``Error`` condition on the
+CR status, meta/v1 semantics (lastTransitionTime only moves when status
+flips).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+READY = "Ready"
+ERROR = "Error"
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def set_condition(conditions: List[dict], type_: str, status: str, reason: str, message: str = "") -> List[dict]:
+    """meta.SetStatusCondition semantics."""
+    for cond in conditions:
+        if cond.get("type") == type_:
+            if cond.get("status") != status:
+                cond["lastTransitionTime"] = _now()
+            cond.update({"status": status, "reason": reason, "message": message})
+            return conditions
+    conditions.append(
+        {
+            "type": type_,
+            "status": status,
+            "reason": reason,
+            "message": message,
+            "lastTransitionTime": _now(),
+        }
+    )
+    return conditions
+
+
+def set_ready(conditions: Optional[List[dict]], reason: str = "Ready", message: str = "") -> List[dict]:
+    conditions = conditions if conditions is not None else []
+    set_condition(conditions, READY, "True", reason, message)
+    set_condition(conditions, ERROR, "False", "NoError", "")
+    return conditions
+
+
+def set_not_ready(conditions: Optional[List[dict]], reason: str, message: str = "") -> List[dict]:
+    conditions = conditions if conditions is not None else []
+    set_condition(conditions, READY, "False", reason, message)
+    set_condition(conditions, ERROR, "False", "NoError", "")
+    return conditions
+
+
+def set_error(conditions: Optional[List[dict]], reason: str, message: str) -> List[dict]:
+    conditions = conditions if conditions is not None else []
+    set_condition(conditions, READY, "False", reason, message)
+    set_condition(conditions, ERROR, "True", reason, message)
+    return conditions
+
+
+def get_condition(conditions: List[dict], type_: str) -> Optional[dict]:
+    for cond in conditions or []:
+        if cond.get("type") == type_:
+            return cond
+    return None

@@ -1,0 +1,83 @@
+"""Operator self-metrics.
+
+Reference: ``controllers/operator_metrics.go:29-201`` — Prometheus gauges /
+counters on the controller-runtime registry, served from the manager's
+:8080 metrics endpoint. Same metric names with the ``gpu``→``tpu`` swap.
+"""
+
+from __future__ import annotations
+
+import time
+
+import prometheus_client
+
+_METRICS = None
+
+
+class OperatorMetrics:
+    def __init__(self, registry=None):
+        reg = registry or prometheus_client.REGISTRY
+        self.tpu_nodes_total = prometheus_client.Gauge(
+            "tpu_operator_tpu_nodes_total",
+            "Number of nodes with TPUs",
+            registry=reg,
+        )
+        self.reconciliation_total = prometheus_client.Counter(
+            "tpu_operator_reconciliation_total",
+            "Total number of ClusterPolicy reconciliations",
+            registry=reg,
+        )
+        self.reconciliation_failed = prometheus_client.Counter(
+            "tpu_operator_reconciliation_failed_total",
+            "Number of failed ClusterPolicy reconciliations",
+            registry=reg,
+        )
+        self.reconciliation_status = prometheus_client.Gauge(
+            "tpu_operator_reconciliation_status",
+            "1 when the last reconciliation was fully successful",
+            registry=reg,
+        )
+        self.reconciliation_last_success_ts = prometheus_client.Gauge(
+            "tpu_operator_reconciliation_last_success_ts_seconds",
+            "Timestamp (seconds since epoch) of the last successful reconciliation",
+            registry=reg,
+        )
+        self.operand_states_not_ready = prometheus_client.Gauge(
+            "tpu_operator_operand_states_not_ready",
+            "Number of operand states not currently Ready",
+            registry=reg,
+        )
+        self.upgrades_in_progress = prometheus_client.Gauge(
+            "tpu_operator_libtpu_upgrades_in_progress",
+            "Nodes currently upgrading libtpu",
+            registry=reg,
+        )
+        self.upgrades_done = prometheus_client.Gauge(
+            "tpu_operator_libtpu_upgrades_done",
+            "Nodes that completed libtpu upgrade",
+            registry=reg,
+        )
+        self.upgrades_failed = prometheus_client.Gauge(
+            "tpu_operator_libtpu_upgrades_failed",
+            "Nodes in libtpu upgrade-failed state",
+            registry=reg,
+        )
+
+    def record_success(self):
+        self.reconciliation_total.inc()
+        self.reconciliation_status.set(1)
+        self.reconciliation_last_success_ts.set(time.time())
+
+    def record_failure(self):
+        self.reconciliation_total.inc()
+        self.reconciliation_failed.inc()
+        self.reconciliation_status.set(0)
+
+
+def get_metrics() -> OperatorMetrics:
+    """Process-wide singleton (the default prometheus registry forbids
+    duplicate registration)."""
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = OperatorMetrics()
+    return _METRICS

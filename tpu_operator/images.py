@@ -1,0 +1,29 @@
+"""Default operand images + env-var fallbacks.
+
+The reference resolves operand images from the CR with an env-var fallback
+for OLM digest pinning (internal/image/image.go:45-49, env names like
+``VALIDATOR_IMAGE``). Same contract here: CR fields win, then the env var,
+then the built-in default.
+"""
+
+from __future__ import annotations
+
+import os
+
+# operand key -> (env var, default image)
+DEFAULTS = {
+    "libtpu": ("LIBTPU_INSTALLER_IMAGE", "gcr.io/tpu-operator/libtpu-installer:1.0.0"),
+    "device_plugin": ("TPU_DEVICE_PLUGIN_IMAGE", "gcr.io/tpu-operator/tpu-device-plugin:1.0.0"),
+    "tfd": ("TPU_FEATURE_DISCOVERY_IMAGE", "gcr.io/tpu-operator/tpu-feature-discovery:1.0.0"),
+    "slice_manager": ("TPU_SLICE_MANAGER_IMAGE", "gcr.io/tpu-operator/tpu-slice-manager:1.0.0"),
+    "metrics_exporter": ("TPU_METRICS_EXPORTER_IMAGE", "gcr.io/tpu-operator/tpu-metrics-exporter:1.0.0"),
+    "node_status_exporter": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
+    "validator": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
+}
+
+
+def resolve(component: str, spec) -> str:
+    """CR image fields -> env fallback -> built-in default."""
+    env_var, default = DEFAULTS[component]
+    path = spec.image_path(env_var)
+    return path or os.environ.get(env_var, "") or default

@@ -48,7 +48,8 @@ class FakeClient(Client):
         self._uid = 0
         self._watchers: dict = {}  # (group, kind) -> [_Sub]
         self._pending: list = []  # events awaiting dispatch, in commit order
-        self._dispatch_lock = threading.RLock()  # reentrant: handlers may mutate and re-enter _notify
+        self._dispatch_lock = threading.Lock()
+        self._dispatcher: Optional[int] = None  # thread id currently draining
 
     # -- internals ----------------------------------------------------------
 
@@ -65,20 +66,29 @@ class FakeClient(Client):
         # lock — so handlers may call back into the client — but serialized
         # under a dedicated dispatch lock draining the shared FIFO, so two
         # concurrent writers can never deliver a stale object after a newer
-        # one.
-        while True:
-            with self._dispatch_lock:
-                with self._lock:
-                    if not self._pending:
-                        return
-                    event_type, obj = self._pending.pop(0)
-                key = (api_group(obj["apiVersion"]), obj["kind"])
-                for sub in list(self._watchers.get(key, [])):
-                    if not sub.active:
-                        continue
-                    if sub.namespace and obj["metadata"].get("namespace") != sub.namespace:
-                        continue
-                    sub.handler(event_type, deep_copy(obj))
+        # one. A handler that mutates re-enters here on the same thread: that
+        # inner call is a no-op (its events were already queued) and the
+        # OUTER drain loop delivers them afterwards, preserving FIFO order —
+        # an RLock instead would let the inner frame jump the queue.
+        if self._dispatcher == threading.get_ident():
+            return
+        with self._dispatch_lock:
+            self._dispatcher = threading.get_ident()
+            try:
+                while True:
+                    with self._lock:
+                        if not self._pending:
+                            return
+                        event_type, obj = self._pending.pop(0)
+                    key = (api_group(obj["apiVersion"]), obj["kind"])
+                    for sub in list(self._watchers.get(key, [])):
+                        if not sub.active:
+                            continue
+                        if sub.namespace and obj["metadata"].get("namespace") != sub.namespace:
+                            continue
+                        sub.handler(event_type, deep_copy(obj))
+            finally:
+                self._dispatcher = None
 
     # -- Client API ---------------------------------------------------------
 

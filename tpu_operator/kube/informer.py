@@ -82,6 +82,19 @@ class Informer:
 
     # -- cache reads --------------------------------------------------------
 
-    def cached(self) -> List[ObjectDict]:
+    def cached(self, copy: bool = True) -> List[ObjectDict]:
+        """Cache snapshot. ``copy=False`` skips the per-object deep copy for
+        hot paths — the caller then MUST treat the objects as read-only
+        (client-go cache convention)."""
         with self._lock:
+            if not copy:
+                return list(self._cache.values())
             return [deep_copy(obj) for obj in self._cache.values()]
+
+    def get(self, name: str, namespace: str = "") -> Optional[ObjectDict]:
+        """Keyed cache read (deep copy of one object, not the whole cache)."""
+        with self._lock:
+            for key, obj in self._cache.items():
+                if key[3] == name and key[2] == (namespace or ""):
+                    return deep_copy(obj)
+        return None

@@ -41,6 +41,10 @@ class Manager:
         self._metrics_addr = metrics_addr
         self._servers: list = []
         self._started = threading.Event()
+        # serializes start/stop/late informer_for so leader-loss teardown can
+        # never interleave with an in-progress start
+        self._lifecycle = threading.RLock()
+        self._stopping = False
 
     # -- building -----------------------------------------------------------
 
@@ -48,13 +52,14 @@ class Manager:
         """Shared informer per (api_version, kind, namespace). If the manager
         is already running, the informer is started (list+watch) immediately
         so late wiring never yields a silent dead watch."""
-        key = (api_version, kind, namespace or "")
-        if key not in self._informers:
-            informer = Informer(self.client, api_version, kind, namespace)
-            self._informers[key] = informer
-            if self._started.is_set():
-                informer.start()
-        return self._informers[key]
+        with self._lifecycle:
+            key = (api_version, kind, namespace or "")
+            if key not in self._informers:
+                informer = Informer(self.client, api_version, kind, namespace)
+                self._informers[key] = informer
+                if self._started.is_set():
+                    informer.start()
+            return self._informers[key]
 
     def add_controller(self, controller: Controller) -> Controller:
         self._controllers.append(controller)
@@ -63,6 +68,13 @@ class Manager:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, wait_for_leader: bool = True) -> None:
+        with self._lifecycle:
+            self._start_locked(wait_for_leader)
+
+    def _start_locked(self, wait_for_leader: bool) -> None:
+        if self._stopping:
+            log.warning("manager stop() already ran; refusing to start")
+            return
         if self._health_addr:
             self._servers.append(_serve(self._health_addr, self._health_handler()))
         if self._metrics_addr:
@@ -74,12 +86,14 @@ class Manager:
                 self._leader.wait_for_leadership()
         # Informers first: each Informer.start() lists synchronously, so by
         # the time workers start every cache has synced — the equivalent of
-        # controller-runtime blocking workers on WaitForCacheSync.
-        self._started.set()
+        # controller-runtime blocking workers on WaitForCacheSync. _started
+        # is set only after this loop; informer_for holds the lifecycle lock,
+        # so an informer is started exactly once.
         for informer in list(self._informers.values()):
             informer.start()
         for controller in self._controllers:
             controller.start()
+        self._started.set()
         log.info("manager started: %d controllers, %d informers", len(self._controllers), len(self._informers))
 
     def _on_stopped_leading(self) -> None:
@@ -94,15 +108,17 @@ class Manager:
         return not self._started.is_set()
 
     def stop(self) -> None:
-        for controller in self._controllers:
-            controller.stop()
-        for informer in self._informers.values():
-            informer.stop()
-        if self._leader:
-            self._leader.stop()
-        for server in self._servers:
-            server.shutdown()
-        self._started.clear()
+        with self._lifecycle:
+            self._stopping = True
+            for controller in list(self._controllers):
+                controller.stop()
+            for informer in list(self._informers.values()):
+                informer.stop()
+            if self._leader:
+                self._leader.stop()
+            for server in self._servers:
+                server.shutdown()
+            self._started.clear()
 
     def __enter__(self):
         self.start()

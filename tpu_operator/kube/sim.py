@@ -1,0 +1,184 @@
+"""Cluster simulator: fake DaemonSet controller + kubelet.
+
+The reference proves the whole reconcile loop is exercisable with fake
+Nodes + fake operand behavior (SURVEY.md §4's key insight; their unit tests
+seed synthetic NFD-labelled nodes, their e2e only adds a real kubelet).
+This module is that missing kubelet for the in-memory apiserver: it
+schedules DaemonSet pods onto matching nodes, flips them Running/available
+after a configurable latency, and keeps DaemonSet status honest — which is
+what lets `bench.py` measure install→Ready end-to-end and lets tests drive
+node churn, rolling updates, and upgrade drains.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import (
+    matches_selector,
+    new_object,
+    set_owner_reference,
+)
+
+
+class ClusterSim:
+    def __init__(
+        self,
+        client: Client,
+        namespace: Optional[str] = None,
+        ready_delay: float = 0.0,
+        tick: float = 0.02,
+        create_pods: bool = True,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.ready_delay = ready_delay
+        self.tick = tick
+        self.create_pods = create_pods
+        self._scheduled_at: Dict[tuple, float] = {}  # (ds key, rv) -> time scheduled
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ClusterSim":
+        self._thread = threading.Thread(target=self._run, name="cluster-sim", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — sim must survive races with the operator
+                pass
+            self._stop.wait(self.tick)
+
+    # -- one simulation step -------------------------------------------------
+
+    def step(self) -> None:
+        nodes = self.client.list("v1", "Node")
+        schedulable = [n for n in nodes if not n.get("spec", {}).get("unschedulable")]
+        for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
+            self._sync_daemonset(ds, schedulable)
+
+    def _sync_daemonset(self, ds: dict, nodes: list) -> None:
+        md = ds["metadata"]
+        template = ds.get("spec", {}).get("template", {})
+        selector = template.get("spec", {}).get("nodeSelector")
+        matching = [
+            n for n in nodes if matches_selector(n["metadata"].get("labels"), selector)
+        ]
+        desired = len(matching)
+        key = (md.get("namespace", ""), md["name"])
+        # key the availability clock on generation: spec changes restart it
+        # (a rolling update makes pods briefly unavailable), while status
+        # writes — including our own — don't
+        gen_key = (key, md.get("generation", 1))
+        if gen_key not in self._scheduled_at:
+            self._scheduled_at = {k: v for k, v in self._scheduled_at.items() if k[0] != key}
+            self._scheduled_at[gen_key] = time.monotonic()
+        available = desired if (time.monotonic() - self._scheduled_at[gen_key]) >= self.ready_delay else 0
+
+        if self.create_pods:
+            self._sync_pods(ds, matching, available > 0)
+
+        status = {
+            "desiredNumberScheduled": desired,
+            "currentNumberScheduled": desired,
+            "updatedNumberScheduled": desired,
+            "numberReady": available,
+            "numberAvailable": available,
+            "numberUnavailable": desired - available,
+            "observedGeneration": md.get("generation", 1),
+        }
+        if ds.get("status") != status:
+            ds["status"] = status
+            try:
+                self.client.update_status(ds)
+            except errors.ApiError:
+                pass
+
+    def _sync_pods(self, ds: dict, matching_nodes: list, ready: bool) -> None:
+        md = ds["metadata"]
+        ns = md.get("namespace", "default")
+        labels = dict(ds.get("spec", {}).get("template", {}).get("metadata", {}).get("labels", {}))
+        labels["sim.tpu.google.com/daemonset"] = md["name"]
+        want_nodes = {n["metadata"]["name"] for n in matching_nodes}
+        have = {}
+        for pod in self.client.list("v1", "Pod", ns, label_selector={"sim.tpu.google.com/daemonset": md["name"]}):
+            have[pod["spec"].get("nodeName", "")] = pod
+        # create missing
+        for node_name in sorted(want_nodes - set(have)):
+            pod = new_object(
+                "v1",
+                "Pod",
+                f"{md['name']}-{node_name}",
+                ns,
+                labels=labels,
+                spec={"nodeName": node_name, "containers": ds["spec"]["template"]["spec"].get("containers", [])},
+                status={"phase": "Running" if ready else "Pending"},
+            )
+            set_owner_reference(pod, ds)
+            try:
+                self.client.create(pod)
+            except errors.AlreadyExists:
+                pass
+        # delete strays
+        for node_name in set(have) - want_nodes:
+            pod_md = have[node_name]["metadata"]
+            try:
+                self.client.delete("v1", "Pod", pod_md["name"], ns)
+            except errors.NotFound:
+                pass
+        # phase transitions
+        for node_name in want_nodes & set(have):
+            pod = have[node_name]
+            phase = "Running" if ready else "Pending"
+            if pod.get("status", {}).get("phase") != phase:
+                pod["status"] = {"phase": phase}
+                try:
+                    self.client.update_status(pod)
+                except errors.ApiError:
+                    pass
+
+
+def make_tpu_node(
+    name: str,
+    accelerator: str = "tpu-v5-lite-podslice",
+    topology: str = "4x4",
+    nodepool: str = "tpu-pool",
+    chips: int = 4,
+    extra_labels: Optional[dict] = None,
+) -> dict:
+    """A synthetic GKE TPU node (the fake analog of the reference's
+    NFD-labelled test nodes, object_controls_test.go:77-82)."""
+    labels = {
+        "cloud.google.com/gke-tpu-accelerator": accelerator,
+        "cloud.google.com/gke-tpu-topology": topology,
+        "cloud.google.com/gke-nodepool": nodepool,
+        "kubernetes.io/hostname": name,
+    }
+    labels.update(extra_labels or {})
+    return new_object(
+        "v1",
+        "Node",
+        name,
+        labels=labels,
+        spec={},
+        status={
+            "allocatable": {"google.com/tpu": str(chips)},
+            "capacity": {"google.com/tpu": str(chips)},
+            "nodeInfo": {
+                "containerRuntimeVersion": "containerd://1.7.10",
+                "kubeletVersion": "v1.29.1-gke.100",
+            },
+        },
+    )

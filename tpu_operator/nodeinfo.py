@@ -1,0 +1,109 @@
+"""TPU node attribute extraction.
+
+Analog of ``internal/nodeinfo`` (node_info.go:34-57, attributes.go:43) —
+but where the reference derives attributes from NFD's PCI scan
+(pci-10de 0x10de = NVIDIA vendor id, state_manager.go:113-117), TPU nodes
+are recognized by the labels GKE stamps on TPU node pools
+(``cloud.google.com/gke-tpu-accelerator``, ``-topology``) and attributes
+come from a built-in accelerator catalog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube.objects import ObjectDict
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorInfo:
+    """Facts about one GKE TPU accelerator family."""
+
+    gke_type: str  # cloud.google.com/gke-tpu-accelerator value
+    generation: str  # v4 / v5e / v5p / v6e
+    chips_per_host: int  # maximum chips attached to one host VM
+    topology_dims: int  # 2 = 2D mesh (v5e/v6e), 3 = 3D torus (v4/v5p)
+
+
+# The accelerator catalog. Values follow Cloud TPU published system
+# architecture (chips per VM / topology family per generation).
+ACCELERATORS: Dict[str, AcceleratorInfo] = {
+    "tpu-v4-podslice": AcceleratorInfo("tpu-v4-podslice", "v4", 4, 3),
+    "tpu-v5-lite-podslice": AcceleratorInfo("tpu-v5-lite-podslice", "v5e", 4, 2),
+    "tpu-v5-lite-device": AcceleratorInfo("tpu-v5-lite-device", "v5e", 8, 2),
+    "tpu-v5p-slice": AcceleratorInfo("tpu-v5p-slice", "v5p", 4, 3),
+    "tpu-v6e-slice": AcceleratorInfo("tpu-v6e-slice", "v6e", 4, 2),
+}
+
+
+def parse_topology(topology: str) -> List[int]:
+    """'4x4' -> [4, 4]; '2x2x2' -> [2, 2, 2]. Empty/invalid -> []."""
+    if not topology:
+        return []
+    try:
+        dims = [int(p) for p in topology.lower().split("x")]
+    except ValueError:
+        return []
+    return dims if all(d > 0 for d in dims) else []
+
+
+@dataclasses.dataclass
+class TPUNodeInfo:
+    """Attributes of one TPU node, derived from its labels."""
+
+    node_name: str
+    accelerator_type: str  # GKE accelerator type
+    topology: str  # e.g. "4x4"
+    generation: str
+    chips_in_slice: int  # product of topology dims
+    chips_per_node: int
+    slice_hosts: int  # hosts forming the slice
+    nodepool: str
+
+    @property
+    def multi_host(self) -> bool:
+        return self.slice_hosts > 1
+
+
+def tpu_info(node: ObjectDict) -> Optional[TPUNodeInfo]:
+    """None when the node carries no GKE TPU accelerator label."""
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    acc_type = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    if not acc_type:
+        return None
+    acc = ACCELERATORS.get(acc_type)
+    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    dims = parse_topology(topology)
+    chips_in_slice = math.prod(dims) if dims else 0
+    chips_per_host = acc.chips_per_host if acc else 4
+    chips_per_node = min(chips_in_slice, chips_per_host) if chips_in_slice else chips_per_host
+    slice_hosts = max(1, math.ceil(chips_in_slice / chips_per_host)) if chips_in_slice else 1
+    return TPUNodeInfo(
+        node_name=node["metadata"]["name"],
+        accelerator_type=acc_type,
+        topology=topology,
+        generation=acc.generation if acc else "unknown",
+        chips_in_slice=chips_in_slice,
+        chips_per_node=chips_per_node,
+        slice_hosts=slice_hosts,
+        nodepool=labels.get(consts.GKE_NODEPOOL_LABEL, ""),
+    )
+
+
+def is_tpu_node(node: ObjectDict) -> bool:
+    return tpu_info(node) is not None
+
+
+def tfd_labels(info: TPUNodeInfo) -> Dict[str, str]:
+    """The labels tpu-feature-discovery publishes for one node
+    (BASELINE config 3)."""
+    return {
+        consts.TFD_ACCELERATOR_TYPE_LABEL: info.accelerator_type,
+        consts.TFD_TOPOLOGY_LABEL: info.topology,
+        consts.TFD_CHIPS_PER_NODE_LABEL: str(info.chips_per_node),
+        consts.TFD_SLICE_HOSTS_LABEL: str(info.slice_hosts),
+        consts.TFD_TPU_GENERATION_LABEL: info.generation,
+    }

@@ -1,0 +1,71 @@
+"""Node pool partitioning for per-pool DaemonSet fan-out.
+
+Reference: ``internal/state/nodepool.go:55-132`` partitions GPU nodes by
+os/kernel/rhcos so each pool gets its own driver DaemonSet. The TPU
+equivalent: libtpu versions must match across every host of a slice, and
+slice topology determines gang size — so nodes partition by
+(accelerator type, topology, GKE node pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from tpu_operator import consts
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import TPUNodeInfo, tpu_info
+
+
+@dataclasses.dataclass
+class NodePool:
+    name: str  # stable, DNS-safe pool key
+    accelerator_type: str
+    topology: str
+    gke_nodepool: str
+    node_names: List[str]
+    info: TPUNodeInfo  # representative node's attributes
+
+    @property
+    def selector(self) -> Dict[str, str]:
+        """nodeSelector matching exactly this pool's nodes."""
+        sel = {consts.GKE_TPU_ACCELERATOR_LABEL: self.accelerator_type}
+        if self.topology:
+            sel[consts.GKE_TPU_TOPOLOGY_LABEL] = self.topology
+        if self.gke_nodepool:
+            sel[consts.GKE_NODEPOOL_LABEL] = self.gke_nodepool
+        return sel
+
+
+def _pool_name(info: TPUNodeInfo) -> str:
+    parts = [info.accelerator_type]
+    if info.topology:
+        parts.append(info.topology.replace("x", "-"))
+    if info.nodepool:
+        parts.append(info.nodepool)
+    return "-".join(parts).lower()
+
+
+def get_node_pools(nodes: List[ObjectDict]) -> List[NodePool]:
+    """reference: getNodePools nodepool.go:55-132."""
+    pools: Dict[str, NodePool] = {}
+    for node in nodes:
+        info = tpu_info(node)
+        if info is None:
+            continue
+        key = _pool_name(info)
+        pool = pools.get(key)
+        if pool is None:
+            pools[key] = NodePool(
+                name=key,
+                accelerator_type=info.accelerator_type,
+                topology=info.topology,
+                gke_nodepool=info.nodepool,
+                node_names=[info.node_name],
+                info=info,
+            )
+        else:
+            pool.node_names.append(info.node_name)
+    for pool in pools.values():
+        pool.node_names.sort()
+    return sorted(pools.values(), key=lambda p: p.name)

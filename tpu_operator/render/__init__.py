@@ -1,0 +1,1 @@
+from tpu_operator.render.render import Renderer, RenderError  # noqa: F401

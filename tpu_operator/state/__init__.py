@@ -1,0 +1,11 @@
+"""State engine (reference: internal/state — the v2 engine).
+
+Per SURVEY.md §7.2 the rebuild adopts the reference's v2 design everywhere:
+every operand is a ``State`` that renders templated manifests into objects
+and create-or-updates them with hash-annotation discipline, rather than the
+v1 typed-``Resources``/``controlFunc`` duplication of
+controllers/object_controls.go.
+"""
+
+from tpu_operator.state.skel import StateSkel, SyncResult, SyncStates  # noqa: F401
+from tpu_operator.state.manager import StateManager  # noqa: F401

@@ -1,0 +1,49 @@
+"""State manager: ordered list of states + aggregate sync.
+
+Reference: ``internal/state/manager.go:31-128`` — ``SyncState`` iterates the
+states and aggregates per-state results into one overall status.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional
+
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.state.skel import StateSkel, SyncResult, SyncStates
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Results:
+    status: str
+    states: dict  # state name -> SyncResult
+
+    @property
+    def ready(self) -> bool:
+        return self.status == SyncStates.READY
+
+
+class StateManager:
+    def __init__(self, states: List[StateSkel]):
+        self.states = list(states)
+
+    def state_names(self) -> List[str]:
+        return [s.name for s in self.states]
+
+    def sync_state(self, client: Client, catalog, owner: Optional[ObjectDict] = None) -> Results:
+        """reference: Manager.SyncState manager.go:75-109."""
+        per_state = {}
+        overall = SyncStates.READY
+        for state in self.states:
+            result = state.sync(client, catalog, owner)
+            per_state[state.name] = result
+            if result.state == SyncStates.ERROR:
+                overall = SyncStates.ERROR
+            elif result.state == SyncStates.NOT_READY and overall != SyncStates.ERROR:
+                overall = SyncStates.NOT_READY
+            log.debug("state %s -> %s", state.name, result.state)
+        return Results(status=overall, states=per_state)

@@ -1,0 +1,242 @@
+"""State skeleton: shared create-or-update / readiness machinery.
+
+Reference: ``stateSkel`` internal/state/state_skel.go:43-50 — render
+manifests, stamp owner references + state labels, apply with the
+last-applied-hash annotation so unchanged objects are never rewritten
+(update-loop / spec-thrash protection, SURVEY.md §7 "hard part (b)"), then
+report readiness per kind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional
+
+from tpu_operator import consts, utils
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import (
+    ObjectDict,
+    get_annotation,
+    object_key,
+    set_annotation,
+    set_label,
+    set_owner_reference,
+)
+from tpu_operator.render import Renderer
+
+log = logging.getLogger(__name__)
+
+
+class SyncStates:
+    """reference: SyncStateReady/NotReady/Ignore/Error (internal/state/types)."""
+
+    READY = "ready"
+    NOT_READY = "notReady"
+    IGNORE = "ignore"
+    ERROR = "error"
+
+
+@dataclasses.dataclass
+class SyncResult:
+    state: str
+    objects: List[ObjectDict] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+
+    @property
+    def ready(self) -> bool:
+        return self.state in (SyncStates.READY, SyncStates.IGNORE)
+
+
+# readiness checker signature: (client, desired_obj) -> bool
+ReadinessCheck = Callable[[Client, ObjectDict], bool]
+
+
+def daemonset_ready(client: Client, obj: ObjectDict) -> bool:
+    """reference: isDaemonSetReady object_controls.go:3439-3515 /
+    state_skel.go:383-444 — a DaemonSet is ready when every scheduled pod is
+    available AND up to date; zero desired pods (no matching nodes) counts
+    as ready so operands no-op on clusters without their nodes."""
+    md = obj["metadata"]
+    try:
+        live = client.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
+    except errors.NotFound:
+        return False
+    status = live.get("status", {})
+    desired = status.get("desiredNumberScheduled", 0)
+    if desired == 0:
+        return True
+    return (
+        status.get("numberAvailable", 0) == desired
+        and status.get("updatedNumberScheduled", 0) == desired
+    )
+
+
+def deployment_ready(client: Client, obj: ObjectDict) -> bool:
+    md = obj["metadata"]
+    try:
+        live = client.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
+    except errors.NotFound:
+        return False
+    want = live.get("spec", {}).get("replicas", 1)
+    return live.get("status", {}).get("availableReplicas", 0) >= want
+
+
+def pod_succeeded_or_running(client: Client, obj: ObjectDict) -> bool:
+    md = obj["metadata"]
+    try:
+        live = client.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
+    except errors.NotFound:
+        return False
+    return live.get("status", {}).get("phase") in ("Running", "Succeeded")
+
+
+READINESS_CHECKS: Dict[str, ReadinessCheck] = {
+    "DaemonSet": daemonset_ready,
+    "Deployment": deployment_ready,
+    "Pod": pod_succeeded_or_running,
+    # everything else (SA/Role/RB/CM/Service/ServiceMonitor/...) is ready on
+    # creation, like the reference's supported-GVK handling
+}
+
+
+def _strip_volatile(obj: ObjectDict) -> ObjectDict:
+    """Content relevant for change detection: everything except server-set
+    metadata and status."""
+    md = obj.get("metadata", {})
+    kept_md = {
+        k: v
+        for k, v in md.items()
+        if k in ("name", "namespace", "labels", "annotations", "ownerReferences")
+    }
+    annotations = dict(kept_md.get("annotations") or {})
+    annotations.pop(consts.LAST_APPLIED_HASH_ANNOTATION, None)
+    if annotations:
+        kept_md["annotations"] = annotations
+    else:
+        kept_md.pop("annotations", None)
+    out = {k: v for k, v in obj.items() if k not in ("metadata", "status")}
+    out["metadata"] = kept_md
+    return out
+
+
+def desired_hash(obj: ObjectDict) -> str:
+    return utils.object_hash(_strip_volatile(obj))
+
+
+class StateSkel:
+    """Base class for all operand states."""
+
+    name: str = ""
+    description: str = ""
+
+    def __init__(self, name: str, manifest_dirs: List[str]):
+        self.name = name
+        self.renderer = Renderer(manifest_dirs)
+
+    # -- hooks ---------------------------------------------------------------
+
+    def get_render_data(self, catalog) -> dict:
+        """Build the templating-data dict from the info catalog (cluster
+        policy spec, cluster facts...). Subclasses override."""
+        return {}
+
+    def is_enabled(self, catalog) -> bool:
+        """Enablement gate (reference: isStateEnabled state_manager.go:990)."""
+        return True
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync(self, client: Client, catalog, owner: Optional[ObjectDict] = None) -> SyncResult:
+        if not self.is_enabled(catalog):
+            self.delete_owned(client, catalog)
+            return SyncResult(state=SyncStates.IGNORE)
+        try:
+            data = self.get_render_data(catalog)
+            objects = self.renderer.render_objects(data)
+        except Exception as e:  # noqa: BLE001 — render failure is a state error
+            log.exception("state %s: render failed", self.name)
+            return SyncResult(state=SyncStates.ERROR, error=str(e))
+        desired_keys = set()
+        for obj in objects:
+            self._decorate(obj, owner)
+            desired_keys.add(object_key(obj))
+            try:
+                self.apply_object(client, obj)
+            except errors.ApiError as e:
+                log.warning("state %s: apply %s failed: %s", self.name, obj["metadata"].get("name"), e)
+                return SyncResult(state=SyncStates.ERROR, objects=objects, error=str(e))
+        self.delete_owned(client, catalog, keep=desired_keys)
+        ready = all(self.check_ready(client, obj) for obj in objects)
+        return SyncResult(state=SyncStates.READY if ready else SyncStates.NOT_READY, objects=objects)
+
+    def _decorate(self, obj: ObjectDict, owner: Optional[ObjectDict]) -> None:
+        set_label(obj, consts.STATE_LABEL, self.name)
+        if owner is not None:
+            set_owner_reference(obj, owner)
+        set_annotation(obj, consts.LAST_APPLIED_HASH_ANNOTATION, desired_hash(obj))
+
+    def apply_object(self, client: Client, obj: ObjectDict) -> None:
+        """Create-or-update gated on the hash annotation
+        (reference: state_skel.go:223-285 + DaemonSet hash discipline
+        object_controls.go:4177-4212)."""
+        md = obj["metadata"]
+        try:
+            existing = client.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
+        except errors.NotFound:
+            client.create(obj)
+            return
+        if get_annotation(existing, consts.LAST_APPLIED_HASH_ANNOTATION) == get_annotation(
+            obj, consts.LAST_APPLIED_HASH_ANNOTATION
+        ):
+            return  # unchanged — never rewrite (no thrash)
+        merged = dict(obj)
+        merged_md = dict(md)
+        merged_md["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        merged.pop("status", None)
+        merged["metadata"] = merged_md
+        client.update(merged)
+
+    def delete_owned(self, client: Client, catalog, keep: Optional[set] = None) -> None:
+        """Delete every object carrying this state's ownership label that is
+        no longer desired (reference: stale cleanup via state label,
+        state_skel.go:62-165 supported-GVK delete list)."""
+        keep = keep or set()
+        selector = {consts.STATE_LABEL: self.name}
+        for api_version, kind in self.owned_kinds():
+            try:
+                for obj in client.list(api_version, kind, label_selector=selector):
+                    if object_key(obj) in keep:
+                        continue
+                    md = obj["metadata"]
+                    try:
+                        client.delete(api_version, kind, md["name"], md.get("namespace"))
+                        log.info("state %s: deleted stale %s %s", self.name, kind, md["name"])
+                    except errors.NotFound:
+                        pass
+            except errors.ApiError:
+                continue
+
+    def owned_kinds(self) -> List[tuple]:
+        """(apiVersion, kind) pairs this state may have created — the delete
+        list scanned for stale objects."""
+        return [
+            ("apps/v1", "DaemonSet"),
+            ("v1", "ServiceAccount"),
+            ("v1", "ConfigMap"),
+            ("v1", "Service"),
+            ("rbac.authorization.k8s.io/v1", "Role"),
+            ("rbac.authorization.k8s.io/v1", "RoleBinding"),
+            ("rbac.authorization.k8s.io/v1", "ClusterRole"),
+            ("rbac.authorization.k8s.io/v1", "ClusterRoleBinding"),
+            ("monitoring.coreos.com/v1", "ServiceMonitor"),
+            ("monitoring.coreos.com/v1", "PrometheusRule"),
+            ("scheduling.k8s.io/v1", "PriorityClass"),
+        ]
+
+    def check_ready(self, client: Client, obj: ObjectDict) -> bool:
+        check = READINESS_CHECKS.get(obj["kind"])
+        if check is None:
+            return True
+        return check(client, obj)

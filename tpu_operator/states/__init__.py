@@ -1,0 +1,5 @@
+from tpu_operator.states.clusterpolicy_states import (  # noqa: F401
+    STATE_ORDER,
+    build_render_data,
+    new_cluster_policy_states,
+)

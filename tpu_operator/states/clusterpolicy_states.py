@@ -1,0 +1,240 @@
+"""The ordered operand states driven by ClusterPolicy.
+
+Reference: the 19-entry state registration in
+controllers/state_manager.go:791-810. The TPU mapping (SURVEY.md §2.5):
+
+    pre-requisites              -> pre-requisites (operand PriorityClass;
+                                   no RuntimeClasses — TPUs need no
+                                   container-runtime hook)
+    state-operator-metrics      -> state-operator-metrics
+    state-driver                -> state-libtpu
+    state-container-toolkit     -> (none: device plugin mounts /dev/accel*
+                                   and libtpu directly)
+    state-operator-validation   -> state-operator-validation
+    state-device-plugin         -> state-device-plugin
+    state-mps-control-daemon    -> (none: no CUDA MPS analog)
+    state-dcgm(-exporter)       -> state-metrics-exporter
+    gpu-feature-discovery       -> state-tpu-feature-discovery
+    state-mig-manager           -> state-slice-manager
+    state-node-status-exporter  -> state-node-status-exporter
+    sandbox/vgpu/vfio/kata/cc   -> (none: no TPU virtualization analog)
+
+Execution order == list order, enablement gates mirror
+``isStateEnabled`` (state_manager.go:990-1034), and operand states are
+skipped while the cluster has no TPU nodes (``hasGPUNodes`` skip,
+object_controls.go:4089-4096).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from tpu_operator import consts, images
+from tpu_operator.catalog import InfoCatalog
+from tpu_operator.state.skel import StateSkel, SyncResult, SyncStates
+
+MANIFEST_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "manifests")
+
+STATE_ORDER = [
+    "pre-requisites",
+    "state-operator-metrics",
+    "state-libtpu",
+    "state-device-plugin",
+    "state-operator-validation",
+    "state-tpu-feature-discovery",
+    "state-slice-manager",
+    "state-metrics-exporter",
+    "state-node-status-exporter",
+]
+
+
+def _component_data(spec, key: str, **extra) -> dict:
+    data = {
+        "image": images.resolve(key, spec),
+        "image_pull_policy": spec.image_pull_policy,
+        "env": spec.env,
+        "args": spec.args,
+        "resources": spec.resources,
+    }
+    data.update(extra)
+    return data
+
+
+def build_render_data(catalog: InfoCatalog) -> dict:
+    """One templating-data dict shared by every state's manifests (the
+    reference's TemplatingData / per-operand Transform funcs collapsed into
+    declarative templates)."""
+    spec = catalog.cluster_policy.spec
+    ds = spec.daemonsets
+    sm_enabled = spec.metrics_exporter.service_monitor.is_enabled()
+    return {
+        "namespace": catalog.namespace,
+        "runtime": catalog.runtime,
+        "tpu_resource": consts.TPU_RESOURCE_NAME,
+        "validation_dir": consts.VALIDATION_DIR,
+        "libtpu_ready_file": consts.LIBTPU_READY_FILE,
+        "plugin_ready_file": consts.PLUGIN_READY_FILE,
+        "workload_ready_file": consts.WORKLOAD_READY_FILE,
+        "all_ready_file": consts.ALL_READY_FILE,
+        "libtpu_ctr_ready_file": consts.LIBTPU_CTR_READY_FILE,
+        "service_monitors_enabled": sm_enabled,
+        "operator_metrics": {"port": 8080},
+        "daemonsets": {
+            "labels": ds.labels,
+            "annotations": ds.annotations,
+            "tolerations": ds.tolerations,
+            "priority_class_name": ds.priority_class_name,
+            "update_strategy": ds.update_strategy,
+            "rolling_update_max_unavailable": (
+                ds.rolling_update.max_unavailable if ds.rolling_update else "1"
+            ),
+        },
+        "libtpu": _component_data(spec.libtpu, "libtpu", install_dir=spec.libtpu.install_dir),
+        "device_plugin": _component_data(
+            spec.device_plugin,
+            "device_plugin",
+            config_name=spec.device_plugin.config.name,
+            config_default=spec.device_plugin.config.default,
+        ),
+        "tfd": _component_data(spec.tpu_feature_discovery, "tfd"),
+        "slice_manager": _component_data(
+            spec.slice_manager,
+            "slice_manager",
+            config_name=spec.slice_manager.config.name,
+            config_default=spec.slice_manager.config.default,
+        ),
+        "metrics_exporter": _component_data(
+            spec.metrics_exporter,
+            "metrics_exporter",
+            port=spec.metrics_exporter.port,
+            service_monitor={
+                "enabled": sm_enabled,
+                "interval": spec.metrics_exporter.service_monitor.interval,
+                "honor_labels": spec.metrics_exporter.service_monitor.honor_labels,
+                "additional_labels": spec.metrics_exporter.service_monitor.additional_labels,
+            },
+        ),
+        "node_status_exporter": _component_data(spec.node_status_exporter, "node_status_exporter", port=8000),
+        "validator": _component_data(
+            spec.validator,
+            "validator",
+            libtpu_env=spec.validator.libtpu.env,
+            plugin_env=spec.validator.plugin.env,
+            workload_env=spec.validator.workload.env,
+            slice_env=spec.validator.slice.env,
+        ),
+        "multi_slice": {
+            "enabled": spec.multi_slice.is_enabled(),
+            "coordinator_port": spec.multi_slice.coordinator_port,
+        },
+    }
+
+
+class ClusterPolicyState(StateSkel):
+    """One operand state of the ClusterPolicy state machine."""
+
+    # operand states deploy per-node DaemonSets and are skipped while the
+    # cluster has no TPU nodes (reference: object_controls.go:4089-4096)
+    requires_tpu_nodes = True
+
+    def __init__(self, name: str):
+        super().__init__(name, [os.path.join(MANIFEST_ROOT, name)])
+
+    def get_render_data(self, catalog: InfoCatalog) -> dict:
+        return build_render_data(catalog)
+
+    def sync(self, client, catalog: InfoCatalog, owner=None) -> SyncResult:
+        if self.requires_tpu_nodes and not catalog.has_tpu_nodes:
+            return SyncResult(state=SyncStates.IGNORE)
+        return super().sync(client, catalog, owner)
+
+
+class PreRequisitesState(ClusterPolicyState):
+    requires_tpu_nodes = False
+
+    def __init__(self):
+        super().__init__("pre-requisites")
+
+
+class OperatorMetricsState(ClusterPolicyState):
+    requires_tpu_nodes = False
+
+    def __init__(self):
+        super().__init__("state-operator-metrics")
+
+
+class LibtpuState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-libtpu")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        spec = catalog.cluster_policy.spec.libtpu
+        # when TPUSlice CRs own libtpu deployment the ClusterPolicy state
+        # steps aside (reference: UseNvidiaDriverCRD gate)
+        return spec.is_enabled() and not spec.use_slice_crd()
+
+
+class DevicePluginState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-device-plugin")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.device_plugin.is_enabled()
+
+
+class OperatorValidationState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-operator-validation")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.validator.is_enabled()
+
+
+class TFDState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-tpu-feature-discovery")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.tpu_feature_discovery.is_enabled()
+
+
+class SliceManagerState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-slice-manager")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.slice_manager.is_enabled()
+
+
+class MetricsExporterState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-metrics-exporter")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.metrics_exporter.is_enabled()
+
+
+class NodeStatusExporterState(ClusterPolicyState):
+    def __init__(self):
+        super().__init__("state-node-status-exporter")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.node_status_exporter.is_enabled()
+
+
+def new_cluster_policy_states() -> List[StateSkel]:
+    """reference: addState x19, state_manager.go:791-810."""
+    states = [
+        PreRequisitesState(),
+        OperatorMetricsState(),
+        LibtpuState(),
+        DevicePluginState(),
+        OperatorValidationState(),
+        TFDState(),
+        SliceManagerState(),
+        MetricsExporterState(),
+        NodeStatusExporterState(),
+    ]
+    assert [s.name for s in states] == STATE_ORDER
+    return states

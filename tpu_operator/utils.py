@@ -1,0 +1,25 @@
+"""Small shared utilities (reference: internal/utils/utils.go)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+FNV64_OFFSET = 0xCBF29CE484222325
+FNV64_PRIME = 0x100000001B3
+
+
+def fnv64a(data: bytes) -> int:
+    h = FNV64_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def object_hash(obj: Any) -> str:
+    """Deterministic content hash of an object (reference: GetObjectHash
+    internal/utils/utils.go:66-77, FNV over the marshalled object). Used
+    for the last-applied-hash annotation that gates spec updates."""
+    payload = json.dumps(obj, sort_keys=True, separators=(",", ":"), default=str)
+    return format(fnv64a(payload.encode()), "x")
