@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Benchmark: ClusterPolicy install -> Ready, end to end.
+
+The reference's only published performance surface is operand-readiness
+time, CI-bounded at 15 minutes for 6 DaemonSets on a real GPU node
+(tests/e2e/gpu_operator_test.go:137, see BASELINE.md). This bench drives
+the identical flow — create ClusterPolicy, operator renders + applies all
+operand states, every DaemonSet schedules and reports available on a
+4-host v5e-16 node pool, CR status flips Ready — against the in-memory
+apiserver + cluster sim (the "CPU-only kind cluster" configuration,
+BASELINE config 1/4 shape), so the number isolates operator overhead:
+reconcile latency, render cost, state-machine passes, watch fan-out.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+vs_baseline is the reference bound (900 s) over our measured time.
+When TPU hardware is visible, a details block adds the on-chip validation
+payloads (smoke matmul, pallas triad HBM bandwidth, psum allreduce).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_READY_BOUND_S = 900.0  # tests/e2e/gpu_operator_test.go:137
+SIM_CONTAINER_START_S = 0.25  # simulated image-pull/container-start latency
+
+
+def bench_install_to_ready(nodes: int = 4) -> float:
+    from tpu_operator.api.clusterpolicy import (
+        CLUSTER_POLICY_API_VERSION,
+        CLUSTER_POLICY_KIND,
+        new_cluster_policy,
+    )
+    from tpu_operator.controllers.clusterpolicy_controller import (
+        ClusterPolicyReconciler,
+        setup_with_manager,
+    )
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.manager import Manager
+    from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+    ns = "tpu-operator"
+    client = FakeClient()
+    for i in range(nodes):  # v5e-16: 4 hosts x 4 chips
+        client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+    sim = ClusterSim(client, ready_delay=SIM_CONTAINER_START_S, tick=0.01).start()
+    mgr = Manager(client, namespace=ns)
+    setup_with_manager(mgr, ClusterPolicyReconciler(client, ns))
+    mgr.start()
+    try:
+        t0 = time.perf_counter()
+        client.create(new_cluster_policy())
+        deadline = t0 + 120
+        while time.perf_counter() < deadline:
+            cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            if cp.get("status", {}).get("state") == "ready":
+                dses = client.list("apps/v1", "DaemonSet", ns)
+                if len(dses) == 7 and all(
+                    ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
+                ):
+                    return time.perf_counter() - t0
+            time.sleep(0.005)
+        raise RuntimeError("ClusterPolicy never became Ready")
+    finally:
+        mgr.stop()
+        sim.stop()
+
+
+def tpu_details() -> dict:
+    """On-chip validation payloads when an accelerator is visible."""
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception as e:  # noqa: BLE001
+        return {"platform": f"unavailable: {e}"}
+    details = {"platform": platform, "devices": len(jax.devices())}
+    if os.environ.get("BENCH_SKIP_DEVICE", ""):
+        return details
+    try:
+        from tpu_operator.workloads.smoke import run_smoke
+
+        t0 = time.perf_counter()
+        run_smoke(size=512)
+        details["smoke_s"] = round(time.perf_counter() - t0, 3)
+        from tpu_operator.workloads.kernels import hbm_bandwidth_probe
+
+        probe = hbm_bandwidth_probe(size_mb=64 if platform != "cpu" else 16, iters=5, warmup=2)
+        details["triad_gbps"] = round(probe["bandwidth_gbps"], 2)
+        from tpu_operator.workloads.allreduce import run_allreduce
+
+        ar = run_allreduce(sizes_mb=(4, 16), iters=5, warmup=2)
+        details["allreduce_busbw_gbps_per_chip"] = round(ar["peak_busbw_gbps_per_chip"], 2)
+    except Exception as e:  # noqa: BLE001 — details are best-effort
+        details["device_error"] = str(e)
+    return details
+
+
+def main() -> None:
+    runs = [bench_install_to_ready() for _ in range(3)]
+    value = statistics.median(runs)
+    out = {
+        "metric": "clusterpolicy_install_to_ready",
+        "value": round(value, 3),
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_READY_BOUND_S / value, 1),
+        "runs": [round(r, 3) for r in runs],
+        "baseline_s": REFERENCE_READY_BOUND_S,
+        "sim_container_start_s": SIM_CONTAINER_START_S,
+        "details": tpu_details(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,19 +2,28 @@
 
 JAX-touching tests run on a virtual 8-device CPU mesh so the multi-chip
 sharding paths (slice validator payloads, __graft_entry__.dryrun_multichip)
-are exercised without TPU hardware. Must be set before jax is imported
-anywhere in the test process.
+are exercised without TPU hardware.
+
+This environment's sitecustomize pre-imports jax and registers the ``axon``
+TPU backend at interpreter startup, so setting ``JAX_PLATFORMS`` via
+os.environ here is too late — ``jax.config.update("jax_platforms", ...)``
+is the override that still works before first backend initialization.
+``XLA_FLAGS`` is read when the CPU client first initializes, so appending
+the host-device-count flag here is still in time.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

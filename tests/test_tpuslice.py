@@ -1,0 +1,140 @@
+"""TPUSlice controller tests (reference analogs:
+internal/state/driver_test.go per-pool rendering,
+internal/validator/validator_test.go:96 conflict cases,
+nvidiadriver_controller behavior)."""
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND, TPUSlice, new_tpu_slice
+from tpu_operator.controllers.tpuslice_controller import TPUSliceReconciler
+from tpu_operator.controllers.tpuslice_validator import (
+    ValidationError,
+    validate_node_selectors,
+)
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import make_tpu_node
+
+import pytest
+
+NS = "tpu-operator"
+
+
+def seed_cluster(client):
+    client.create(new_cluster_policy(spec={"libtpu": {"useTPUSliceCRD": True}}))
+    for i in range(2):
+        client.create(make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a"))
+    client.create(make_tpu_node("v5p-0", "tpu-v5p-slice", "2x2x2", nodepool="pool-b"))
+
+
+class TestValidator:
+    def test_disjoint_ok(self):
+        client = FakeClient()
+        seed_cluster(client)
+        a = TPUSlice.from_unstructured(client.create(new_tpu_slice(
+            "a", spec={"nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}})))
+        client.create(new_tpu_slice(
+            "b", spec={"nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"}}))
+        validate_node_selectors(client, a)  # no raise
+
+    def test_overlap_rejected(self):
+        client = FakeClient()
+        seed_cluster(client)
+        a = TPUSlice.from_unstructured(client.create(new_tpu_slice("a")))  # default: all TPU nodes... none labelled yet
+        client.create(new_tpu_slice(
+            "b", spec={"nodeSelector": {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}}))
+        # make default selector match by labelling nodes tpu.present
+        for n in ("v5e-0", "v5e-1", "v5p-0"):
+            node = client.get("v1", "Node", n)
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.update(node)
+        with pytest.raises(ValidationError, match="already selected"):
+            validate_node_selectors(client, a)
+
+
+class TestReconcile:
+    def test_per_pool_fanout_and_ready(self):
+        client = FakeClient()
+        seed_cluster(client)
+        client.create(new_tpu_slice("all", spec={"nodeSelector": {consts.GKE_NODEPOOL_LABEL: "pool-a"}}))
+        # also a second CR on the other pool: disjoint, both reconcile
+        client.create(new_tpu_slice("other", spec={"nodeSelector": {consts.GKE_NODEPOOL_LABEL: "pool-b"}}))
+        r = TPUSliceReconciler(client, NS)
+        r.reconcile(Request(name="all"))
+        r.reconcile(Request(name="other"))
+        dses = client.list("apps/v1", "DaemonSet", NS)
+        names = sorted(ds["metadata"]["name"] for ds in dses)
+        assert names == [
+            "libtpu-all-tpu-v5-lite-podslice-4-4-pool-a",
+            "libtpu-other-tpu-v5p-slice-2-2-2-pool-b",
+        ]
+        ds = dses[0]
+        sel = ds["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel[consts.GKE_NODEPOOL_LABEL] == "pool-a"
+        assert ds["spec"]["updateStrategy"]["type"] == "OnDelete"
+        env = {e["name"]: e.get("value") for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["SLICE_HOSTS"] == "4"
+        # ready status since fake DS has no scheduled pods (desired==0 -> ready)
+        assert client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "all")["status"]["state"] == "ready"
+
+    def test_stale_pool_daemonset_cleaned_up(self):
+        client = FakeClient()
+        seed_cluster(client)
+        client.create(new_tpu_slice("all", spec={"nodeSelector": {consts.GKE_NODEPOOL_LABEL: "pool-a"}}))
+        r = TPUSliceReconciler(client, NS)
+        r.reconcile(Request(name="all"))
+        assert len(client.list("apps/v1", "DaemonSet", NS)) == 1
+        # pool disappears (nodes deleted)
+        client.delete("v1", "Node", "v5e-0")
+        client.delete("v1", "Node", "v5e-1")
+        r.reconcile(Request(name="all"))
+        assert client.list("apps/v1", "DaemonSet", NS) == []
+
+    def test_requires_cluster_policy(self):
+        client = FakeClient()
+        client.create(new_tpu_slice("a"))
+        r = TPUSliceReconciler(client, NS)
+        result = r.reconcile(Request(name="a"))
+        assert result.requeue_after == consts.REQUEUE_NOT_READY_SECONDS
+        obj = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "a")
+        assert obj["status"]["state"] == "notReady"
+        reasons = {c["type"]: c["reason"] for c in obj["status"]["conditions"]}
+        assert reasons["Ready"] == "NoClusterPolicy"
+
+    def test_conflict_sets_error_condition(self):
+        client = FakeClient()
+        seed_cluster(client)
+        sel = {consts.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+        client.create(new_tpu_slice("a", spec={"nodeSelector": sel}))
+        client.create(new_tpu_slice("b", spec={"nodeSelector": sel}))
+        r = TPUSliceReconciler(client, NS)
+        r.reconcile(Request(name="a"))
+        obj = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "a")
+        conds = {c["type"]: c for c in obj["status"]["conditions"]}
+        assert conds["Error"]["status"] == "True"
+        assert conds["Error"]["reason"] == "NodeSelectorConflict"
+        assert client.list("apps/v1", "DaemonSet", NS) == []
+
+
+class TestStatusTransitions:
+    def test_reason_transition_within_same_state_is_persisted(self):
+        """Regression: conditions list aliasing made same-state transitions
+        (NoClusterPolicy -> NodeSelectorConflict) invisible."""
+        client = FakeClient()
+        client.create(new_tpu_slice("a"))
+        r = TPUSliceReconciler(client, NS)
+        r.reconcile(Request(name="a"))
+        obj = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "a")
+        assert {c["type"]: c["reason"] for c in obj["status"]["conditions"]}["Ready"] == "NoClusterPolicy"
+        # now ClusterPolicy exists but a conflicting CR appears
+        seed_cluster(client)
+        for n in ("v5e-0", "v5e-1", "v5p-0"):
+            node = client.get("v1", "Node", n)
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.update(node)
+        client.create(new_tpu_slice("b"))  # default selector overlaps "a"
+        r.reconcile(Request(name="a"))
+        obj = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "a")
+        conds = {c["type"]: c for c in obj["status"]["conditions"]}
+        assert conds["Ready"]["reason"] == "NodeSelectorConflict"
+        assert conds["Error"]["status"] == "True"

@@ -1,0 +1,187 @@
+"""Upgrade FSM tests (reference analog: the vendored upgrade lib's state
+machine semantics — stateless, idempotent, bounded parallelism)."""
+
+import time
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    UpgradePolicySpec,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler
+from tpu_operator.controllers.upgrade_controller import UpgradeReconciler
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+from tpu_operator.upgrade.fsm import ClusterUpgradeStateManager, UpgradeState
+
+NS = "tpu-operator"
+
+
+def seed(client, nodes=2, auto_upgrade=True):
+    """Cluster with libtpu DS rolled out via sim, then a spec bump making
+    every driver pod outdated."""
+    spec = {"libtpu": {"upgradePolicy": {"autoUpgrade": auto_upgrade, "maxParallelUpgrades": 1,
+                                          "maxUnavailable": "100%",
+                                          "drain": {"enable": False}}}}
+    client.create(new_cluster_policy(spec=spec))
+    for i in range(nodes):
+        client.create(make_tpu_node(f"tpu-{i}"))
+    cp_reconciler = ClusterPolicyReconciler(client, NS)
+    cp_reconciler.reconcile(Request(name="cluster-policy"))
+    sim = ClusterSim(client, namespace=None, ready_delay=0.0)
+    sim.step()  # create driver pods at generation 1
+    return cp_reconciler, sim
+
+
+def bump_libtpu_version(client, cp_reconciler):
+    cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+    cp["spec"].setdefault("libtpu", {}).update(
+        {"repository": "gcr.io/x", "image": "libtpu", "version": "2.0"}
+    )
+    client.update(cp)
+    cp_reconciler.reconcile(Request(name="cluster-policy"))  # re-renders DS (generation bump)
+
+
+def node_state(client, name):
+    return client.get("v1", "Node", name)["metadata"].get("labels", {}).get(consts.UPGRADE_STATE_LABEL, "")
+
+
+class TestBuildState:
+    def test_outdated_pod_marks_upgrade_required(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        state = mgr.build_state()
+        assert state.count(UpgradeState.UPGRADE_REQUIRED) == 0
+        bump_libtpu_version(client, cp_rec)
+        state = mgr.build_state()
+        assert state.count(UpgradeState.UPGRADE_REQUIRED) == 2
+
+    def test_up_to_date_cluster_is_quiet(self):
+        client = FakeClient()
+        seed(client)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        state = mgr.build_state()
+        assert all(n.state == UpgradeState.UNKNOWN for n in state.nodes.values())
+
+
+class TestApplyState:
+    def run_to_completion(self, client, mgr, policy, sim, max_passes=20):
+        for _ in range(max_passes):
+            state = mgr.build_state()
+            if state.nodes and all(n.state == UpgradeState.DONE for n in state.nodes.values()):
+                return True
+            mgr.apply_state(state, policy)
+            sim.step()  # DS controller recreates deleted pods at new generation
+        return False
+
+    def test_full_fsm_rolls_all_nodes(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 2, "maxUnavailable": "100%", "drain": {"enable": False}}
+        )
+        assert self.run_to_completion(client, mgr, policy, sim)
+        for i in range(2):
+            assert node_state(client, f"tpu-{i}") == UpgradeState.DONE
+            assert not client.get("v1", "Node", f"tpu-{i}")["spec"].get("unschedulable")
+        # driver pods recreated at the new generation
+        for pod in client.list("v1", "Pod", NS, label_selector={"app.kubernetes.io/component": "libtpu-installer"}):
+            ds = client.get("apps/v1", "DaemonSet", "libtpu-installer", NS)
+            assert pod["metadata"]["labels"]["pod-template-generation"] == str(ds["metadata"]["generation"])
+
+    def test_max_parallel_respected(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=3)
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%", "drain": {"enable": False}}
+        )
+        state = mgr.build_state()
+        mgr.apply_state(state, policy)
+        # only one node may move past upgrade-required in the first pass
+        states = [node_state(client, f"tpu-{i}") for i in range(3)]
+        moved = [s for s in states if s not in ("", UpgradeState.UPGRADE_REQUIRED)]
+        assert len(moved) == 1, states
+
+    def test_drain_deletes_user_pods_not_daemonset_pods(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        # a user workload pod consuming TPU on tpu-0
+        client.create(new_object(
+            "v1", "Pod", "train-job", "default",
+            spec={"nodeName": "tpu-0",
+                  "containers": [{"name": "t", "resources": {"limits": {"google.com/tpu": "4"}}}]},
+            status={"phase": "Running"},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 2, "maxUnavailable": "100%", "drain": {"enable": True}}
+        )
+        for _ in range(4):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        assert client.get_or_none("v1", "Pod", "train-job", "default") is None
+        # daemonset-owned operand pods survive the drain
+        assert client.list("v1", "Pod", NS, label_selector={"app.kubernetes.io/component": "libtpu-installer"})
+
+    def test_wait_for_jobs_blocks_until_jobs_finish(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=1)
+        client.create(new_object(
+            "v1", "Pod", "job-pod", "default",
+            labels={"job": "training"},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%",
+             "waitForCompletion": {"podSelector": "job=training"}, "drain": {"enable": False}}
+        )
+        mgr.apply_state(mgr.build_state(), policy)
+        mgr.apply_state(mgr.build_state(), policy)
+        assert node_state(client, "tpu-0") == UpgradeState.WAIT_FOR_JOBS_REQUIRED
+        # job finishes
+        pod = client.get("v1", "Pod", "job-pod", "default")
+        pod["status"] = {"phase": "Succeeded"}
+        client.update_status(pod)
+        assert self.run_to_completion(client, mgr, policy, sim)
+
+
+class TestUpgradeReconciler:
+    def test_auto_upgrade_disabled_strips_labels(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, auto_upgrade=False)
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["labels"][consts.UPGRADE_STATE_LABEL] = UpgradeState.UPGRADE_REQUIRED
+        client.update(node)
+        r = UpgradeReconciler(client, NS)
+        result = r.reconcile(Request(name="cluster-policy"))
+        assert result.requeue_after == 0
+        assert node_state(client, "tpu-0") == ""
+
+    def test_reconcile_replans_on_cadence(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        bump_libtpu_version(client, cp_rec)
+        r = UpgradeReconciler(client, NS)
+        result = r.reconcile(Request(name="cluster-policy"))
+        assert result.requeue_after == consts.UPGRADE_REPLAN_SECONDS
+        # first pass moved exactly maxParallel(1) node into the pipeline
+        states = [node_state(client, f"tpu-{i}") for i in range(2)]
+        assert UpgradeState.UPGRADE_REQUIRED in states
+        # loop a few reconciles + sim steps to completion
+        for _ in range(15):
+            r.reconcile(Request(name="cluster-policy"))
+            sim.step()
+        assert all(node_state(client, f"tpu-{i}") == UpgradeState.DONE for i in range(2))

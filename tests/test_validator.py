@@ -1,0 +1,162 @@
+"""Validator operand tests (reference analogs: validator component behavior
+main.go:450-565, status-file barrier semantics, metrics.go watchers)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.validator import status as status_files
+from tpu_operator.validator.main import (
+    Context,
+    run_component,
+    validate_libtpu,
+    validate_plugin,
+    validate_workload,
+)
+from tpu_operator.validator.metrics import NodeMetrics
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    client = FakeClient()
+    client.create(make_tpu_node("tpu-0", chips=4))
+    install = tmp_path / "libtpu"
+    install.mkdir()
+    return Context(
+        client=client,
+        node_name="tpu-0",
+        validation_dir=str(tmp_path / "validations"),
+        install_dir=str(install),
+        retry_interval=0.01,
+        resource_poll_retries=3,
+        pod_wait_retries=5,
+    )
+
+
+def install_libtpu(ctx):
+    import os
+
+    with open(os.path.join(ctx.install_dir, "libtpu.so"), "wb") as f:
+        f.write(b"\x7fELF-fake")
+    with open(os.path.join(ctx.install_dir, consts.LIBTPU_CTR_READY_FILE), "w"):
+        pass
+
+
+class TestStatusFiles:
+    def test_round_trip(self, tmp_path):
+        d = str(tmp_path)
+        assert status_files.read_status("x", d) is None
+        status_files.write_status("x", d, {"ok": True})
+        assert status_files.read_status("x", d) == {"ok": True}
+        status_files.clear_status("x", d)
+        assert status_files.read_status("x", d) is None
+
+    def test_empty_payload(self, tmp_path):
+        status_files.write_status("y", str(tmp_path))
+        assert status_files.read_status("y", str(tmp_path)) == {}
+
+
+class TestLibtpuComponent:
+    def test_fails_without_library(self, ctx):
+        with pytest.raises(RuntimeError, match="libtpu.so not found"):
+            validate_libtpu(ctx)
+
+    def test_passes_and_writes_status(self, ctx):
+        install_libtpu(ctx)
+        payload = run_component("libtpu", ctx, max_attempts=1)
+        assert payload["size"] > 0
+        assert status_files.read_status(consts.LIBTPU_READY_FILE, ctx.validation_dir)["size"] > 0
+
+    def test_retry_until_installed(self, ctx):
+        def install_later():
+            time.sleep(0.05)
+            install_libtpu(ctx)
+
+        t = threading.Thread(target=install_later)
+        t.start()
+        payload = run_component("libtpu", ctx, max_attempts=50)
+        t.join()
+        assert payload["size"] > 0
+
+
+class TestPluginComponent:
+    def test_sees_allocatable_chips(self, ctx):
+        assert validate_plugin(ctx) == {"resource": consts.TPU_RESOURCE_NAME, "chips": 4}
+
+    def test_times_out_without_resource(self, ctx):
+        node = ctx.client.get("v1", "Node", "tpu-0")
+        node["status"]["allocatable"] = {}
+        ctx.client.update_status(node)
+        with pytest.raises(RuntimeError, match="never became allocatable"):
+            validate_plugin(ctx)
+
+
+class TestWorkloadComponent:
+    def test_waits_for_pod_success(self, ctx):
+        def kubelet():
+            # fake kubelet: run the scheduled validation pod to completion
+            for _ in range(200):
+                pods = ctx.client.list("v1", "Pod", ctx.namespace, label_selector={"app": "tpu-workload-validation"})
+                for pod in pods:
+                    if pod.get("status", {}).get("phase") != "Succeeded":
+                        pod["status"] = {"phase": "Succeeded"}
+                        ctx.client.update_status(pod)
+                        return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=kubelet)
+        t.start()
+        payload = validate_workload(ctx)
+        t.join()
+        assert payload["phase"] == "Succeeded"
+        # pod cleaned up
+        assert ctx.client.list("v1", "Pod", ctx.namespace, label_selector={"app": "tpu-workload-validation"}) == []
+
+    def test_failed_pod_raises(self, ctx):
+        def kubelet():
+            for _ in range(200):
+                pods = ctx.client.list("v1", "Pod", ctx.namespace, label_selector={"app": "tpu-workload-validation"})
+                if pods:
+                    pod = pods[0]
+                    pod["status"] = {"phase": "Failed"}
+                    ctx.client.update_status(pod)
+                    return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=kubelet)
+        t.start()
+        with pytest.raises(RuntimeError, match="failed"):
+            validate_workload(ctx)
+        t.join()
+
+
+class TestNodeMetrics:
+    def test_collects_status_and_devices(self, ctx):
+        install_libtpu(ctx)
+        status_files.write_status(consts.LIBTPU_READY_FILE, ctx.validation_dir, {"ok": True})
+        status_files.write_status("slice-ready", ctx.validation_dir, {"peak_busbw_gbps_per_chip": 42.5})
+        nm = NodeMetrics(ctx)
+        nm.collect_status_files()
+        nm.collect_device_count()
+        nm.revalidate_libtpu()
+        sample = {
+            (m.name, tuple(sorted(s.labels.items())), s.value)
+            for m in nm.registry.collect()
+            for s in m.samples
+        }
+        values = {m.name: {tuple(sorted(s.labels.items())): s.value for s in m.samples} for m in nm.registry.collect()}
+        ready = values["tpu_operator_node_component_ready"]
+        assert ready[(("component", consts.LIBTPU_READY_FILE), ("node", "tpu-0"))] == 1
+        assert ready[(("component", consts.PLUGIN_READY_FILE), ("node", "tpu-0"))] == 0
+        assert values["tpu_operator_node_tpu_chips"][(("node", "tpu-0"),)] == 4
+        assert values["tpu_operator_node_slice_allreduce_busbw_gbps"][(("node", "tpu-0"),)] == 42.5
+
+    def test_revalidation_failure_clears_barrier(self, ctx):
+        status_files.write_status(consts.LIBTPU_READY_FILE, ctx.validation_dir, {"ok": True})
+        nm = NodeMetrics(ctx)
+        nm.revalidate_libtpu()  # libtpu.so absent -> must clear the file
+        assert status_files.read_status(consts.LIBTPU_READY_FILE, ctx.validation_dir) is None

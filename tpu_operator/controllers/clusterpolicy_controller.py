@@ -21,8 +21,8 @@ from tpu_operator.api.clusterpolicy import (
     State,
 )
 from tpu_operator.catalog import InfoCatalog
-from tpu_operator.controllers import conditions
 from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
@@ -137,23 +137,11 @@ class ClusterPolicyReconciler:
         message: str = "",
         error: bool = False,
     ) -> None:
-        """reference: updateCRState clusterpolicy_controller.go:237 +
-        conditions updater."""
-        status = obj.setdefault("status", {})
-        conds = status.get("conditions", [])
-        if error:
-            conditions.set_error(conds, reason, message)
-        elif state == State.READY:
-            conditions.set_ready(conds, reason, message)
-        else:
-            conditions.set_not_ready(conds, reason or "NotReady", message)
-        changed = status.get("state") != state or status.get("conditions") != conds
-        status.update({"state": state, "namespace": self.namespace, "conditions": conds})
-        if changed:
-            try:
-                self.client.update_status(obj)
-            except errors.Conflict:
-                pass  # next reconcile re-reads and re-publishes
+        """reference: updateCRState clusterpolicy_controller.go:237."""
+        publish_status(
+            self.client, obj, state, reason, message, error,
+            extra={"namespace": self.namespace},
+        )
 
     def _enabled_operand_keys(self, cp: ClusterPolicy) -> List[str]:
         catalog = InfoCatalog(cluster_policy=cp, namespace=self.namespace)

@@ -1,0 +1,52 @@
+"""Shared CR status publisher used by both reconcilers.
+
+reference: updateCRState (clusterpolicy_controller.go:237) + the
+internal/conditions updaters, as one helper so state/reason/message
+transitions are detected and persisted identically for every CRD.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Optional
+
+from tpu_operator.controllers import conditions
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import ObjectDict
+
+log = logging.getLogger(__name__)
+
+
+def publish_status(
+    client: Client,
+    obj: ObjectDict,
+    state: str,
+    reason: str = "",
+    message: str = "",
+    error: bool = False,
+    extra: Optional[dict] = None,
+) -> None:
+    """Set status.state + Ready/Error conditions, writing only on change.
+    The before-image is snapshotted up front — the condition helpers mutate
+    in place, so comparing against a live alias would always say
+    'unchanged' and swallow reason/message transitions."""
+    status = obj.setdefault("status", {})
+    before = copy.deepcopy(status)
+    conds = status.setdefault("conditions", [])
+    if error:
+        conditions.set_error(conds, reason, message)
+    elif state == "ready":
+        conditions.set_ready(conds, reason, message)
+    else:
+        conditions.set_not_ready(conds, reason or "NotReady", message)
+    status["state"] = state
+    status.update(extra or {})
+    if status == before:
+        return
+    try:
+        client.update_status(obj)
+    except errors.Conflict:
+        # next reconcile re-reads and re-publishes
+        log.debug("status update conflicted for %s", obj["metadata"].get("name"))

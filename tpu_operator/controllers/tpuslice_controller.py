@@ -1,0 +1,123 @@
+"""TPUSlice reconciler.
+
+Reference: ``controllers/nvidiadriver_controller.go:75-207`` — per-CR
+libtpu deployment: require a ClusterPolicy to exist, validate node-selector
+disjointness, partition the CR's nodes into pools, sync the per-pool
+DaemonSet state, publish conditions, requeue 5s while NotReady.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+)
+from tpu_operator.api.tpuslice import (
+    TPU_SLICE_API_VERSION,
+    TPU_SLICE_KIND,
+    TPUSlice,
+)
+from tpu_operator.catalog import InfoCatalog
+from tpu_operator.controllers.status import publish_status
+from tpu_operator.controllers.tpuslice_validator import ValidationError, validate_node_selectors
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.objects import ObjectDict, matches_selector
+from tpu_operator.nodepool import get_node_pools
+from tpu_operator.state.skel import SyncStates
+from tpu_operator.states.tpuslice_state import TPUSliceLibtpuState
+
+log = logging.getLogger(__name__)
+
+
+class TPUSliceReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, req.name)
+        if obj is None:
+            return Result()  # GC via ownerReferences
+        ts = TPUSlice.from_unstructured(obj)
+
+        # a ClusterPolicy must exist (reference: nvidiadriver_controller.go:102-125)
+        cps = self.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        if not cps:
+            self._status(obj, "notReady", reason="NoClusterPolicy",
+                         message="no ClusterPolicy found; TPUSlice requires one")
+            return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
+        cps.sort(key=lambda o: (o["metadata"].get("creationTimestamp", ""), o["metadata"]["name"]))
+        cp = ClusterPolicy.from_unstructured(cps[0])
+
+        all_nodes = self.client.list("v1", "Node")
+        try:
+            validate_node_selectors(self.client, ts, all_nodes)
+        except ValidationError as e:
+            self._status(obj, "notReady", error=True, reason="NodeSelectorConflict", message=str(e))
+            return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
+
+        selector = ts.spec.get_node_selector()
+        nodes = [
+            n for n in all_nodes
+            if matches_selector(n["metadata"].get("labels"), selector)
+        ]
+        pools = get_node_pools(nodes)
+        catalog = InfoCatalog(
+            cluster_policy=cp,
+            namespace=self.namespace,
+            tpu_slice=ts,
+            node_pools=pools,
+            has_tpu_nodes=bool(pools),
+        )
+        state = TPUSliceLibtpuState(ts)
+        result = state.sync(self.client, catalog, owner=obj)
+        if result.state == SyncStates.ERROR:
+            self._status(obj, "notReady", error=True, reason="SyncError", message=result.error or "")
+            return Result(requeue=True)
+        if result.state == SyncStates.NOT_READY:
+            self._status(obj, "notReady", reason="DaemonSetsNotReady",
+                         message="libtpu DaemonSets are not ready on all pools")
+            return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
+        self._status(obj, "ready", reason="Ready",
+                     message=f"libtpu deployed on {len(pools)} node pool(s)")
+        return Result()
+
+    def _status(self, obj: ObjectDict, state: str, reason: str = "", message: str = "", error: bool = False):
+        publish_status(self.client, obj, state, reason, message, error)
+
+
+def setup_with_manager(mgr, reconciler: TPUSliceReconciler) -> Controller:
+    """reference: SetupWithManager nvidiadriver_controller.go:238+ — watch
+    TPUSlice (generation-gated), ClusterPolicy, Nodes, and owned
+    DaemonSets."""
+    ctrl = Controller("tpuslice", reconciler)
+
+    def map_to_all_slices(_obj) -> List[Request]:
+        try:
+            slices = reconciler.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=s["metadata"]["name"]) for s in slices]
+
+    def owned_daemonset(event_type, old, new) -> bool:
+        refs = new["metadata"].get("ownerReferences", [])
+        return any(r.get("kind") == TPU_SLICE_KIND for r in refs)
+
+    def node_changed(event_type, old: Optional[ObjectDict], new: ObjectDict) -> bool:
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return old["metadata"].get("labels") != new["metadata"].get("labels")
+
+    ctrl.watch(mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND), predicate=generation_changed)
+    ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND), mapper=map_to_all_slices)
+    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_all_slices, predicate=node_changed)
+    ctrl.watch(mgr.informer_for("apps/v1", "DaemonSet"), mapper=map_to_all_slices, predicate=owned_daemonset)
+    mgr.add_controller(ctrl)
+    return ctrl

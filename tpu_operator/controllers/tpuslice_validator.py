@@ -1,0 +1,53 @@
+"""TPUSlice node-selector conflict validation.
+
+Reference: ``internal/validator/validator.go:31-90`` — a node may be
+selected by at most one NVIDIADriver CR; overlapping CRs fail validation
+before any DaemonSet is rendered.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND, TPUSlice
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import matches_selector
+
+
+class ValidationError(Exception):
+    pass
+
+
+def selected_nodes(client: Client, tpu_slice: TPUSlice, nodes: Optional[List[dict]] = None) -> Set[str]:
+    """reference: getNVIDIADriverSelectedNodes validator.go:60-90. Pass
+    ``nodes`` to reuse one Node list across CRs (a reconcile would
+    otherwise pay O(CRs x nodes) API reads)."""
+    selector = tpu_slice.spec.get_node_selector()
+    if nodes is None:
+        nodes = client.list("v1", "Node")
+    return {
+        node["metadata"]["name"]
+        for node in nodes
+        if matches_selector(node["metadata"].get("labels"), selector)
+    }
+
+
+def validate_node_selectors(client: Client, tpu_slice: TPUSlice, nodes: Optional[List[dict]] = None) -> None:
+    """Raise when this CR's selected nodes overlap another TPUSlice CR's
+    (reference: Validate validator.go:31-58)."""
+    if nodes is None:
+        nodes = client.list("v1", "Node")
+    mine = selected_nodes(client, tpu_slice, nodes)
+    conflicts: Dict[str, List[str]] = {}
+    for other_obj in client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND):
+        other = TPUSlice.from_unstructured(other_obj)
+        if other.name == tpu_slice.name:
+            continue
+        overlap = mine & selected_nodes(client, other, nodes)
+        if overlap:
+            conflicts[other.name] = sorted(overlap)
+    if conflicts:
+        detail = "; ".join(f"{name}: {nodes}" for name, nodes in sorted(conflicts.items()))
+        raise ValidationError(
+            f"TPUSlice {tpu_slice.name} selects nodes already selected by other CRs: {detail}"
+        )

@@ -1,0 +1,78 @@
+"""Upgrade reconciler: drives rolling libtpu upgrades.
+
+Reference: ``controllers/upgrade_controller.go:80-197`` — gated on the
+ClusterPolicy's upgradePolicy.autoUpgrade flag (labels stripped when
+disabled, :102-120), builds the per-node state from pods + labels, exports
+progress metrics, applies the FSM, and re-plans every 2 minutes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+)
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.upgrade.fsm import (
+    IN_PROGRESS,
+    ClusterUpgradeStateManager,
+    UpgradeState,
+)
+
+log = logging.getLogger(__name__)
+
+
+class UpgradeReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.state_manager = ClusterUpgradeStateManager(client, namespace)
+        self.metrics = get_metrics()
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)
+        if obj is None:
+            return Result()
+        cp = ClusterPolicy.from_unstructured(obj)
+        policy = cp.spec.libtpu.upgrade_policy
+        if not policy.auto_upgrade:
+            self.state_manager.remove_upgrade_labels()
+            return Result()
+
+        state = self.state_manager.build_state()
+        self.metrics.upgrades_in_progress.set(state.count(*IN_PROGRESS))
+        self.metrics.upgrades_done.set(state.count(UpgradeState.DONE))
+        self.metrics.upgrades_failed.set(state.count(UpgradeState.FAILED))
+        self.state_manager.apply_state(state, policy)
+
+        # re-plan on a fixed cadence (reference: plannedRequeueInterval 2 min)
+        return Result(requeue_after=consts.UPGRADE_REPLAN_SECONDS)
+
+
+def setup_with_manager(mgr, reconciler: UpgradeReconciler) -> Controller:
+    ctrl = Controller("upgrade", reconciler)
+
+    def map_to_all_cps(_obj) -> List[Request]:
+        try:
+            cps = reconciler.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=cp["metadata"]["name"]) for cp in cps]
+
+    ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND))
+
+    def driver_pod(event_type, old, new) -> bool:
+        labels = new["metadata"].get("labels") or {}
+        return labels.get("app.kubernetes.io/component") == "libtpu-installer"
+
+    ctrl.watch(mgr.informer_for("v1", "Pod", reconciler.namespace), mapper=map_to_all_cps, predicate=driver_pod)
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -64,10 +64,12 @@ class ClusterSim:
     # -- one simulation step -------------------------------------------------
 
     def step(self) -> None:
+        # DaemonSet pods tolerate the unschedulable taint, so cordoned nodes
+        # still run them (matches the real DS controller — this is what lets
+        # a cordoned node's driver pod restart during an upgrade)
         nodes = self.client.list("v1", "Node")
-        schedulable = [n for n in nodes if not n.get("spec", {}).get("unschedulable")]
         for ds in self.client.list("apps/v1", "DaemonSet", self.namespace):
-            self._sync_daemonset(ds, schedulable)
+            self._sync_daemonset(ds, nodes)
 
     def _sync_daemonset(self, ds: dict, nodes: list) -> None:
         md = ds["metadata"]
@@ -111,6 +113,7 @@ class ClusterSim:
         ns = md.get("namespace", "default")
         labels = dict(ds.get("spec", {}).get("template", {}).get("metadata", {}).get("labels", {}))
         labels["sim.tpu.google.com/daemonset"] = md["name"]
+        labels["pod-template-generation"] = str(md.get("generation", 1))
         want_nodes = {n["metadata"]["name"] for n in matching_nodes}
         have = {}
         for pod in self.client.list("v1", "Pod", ns, label_selector={"sim.tpu.google.com/daemonset": md["name"]}):

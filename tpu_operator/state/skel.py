@@ -148,13 +148,19 @@ class StateSkel:
 
     # -- sync ----------------------------------------------------------------
 
+    def render_all(self, catalog) -> List[ObjectDict]:
+        """All desired objects for this state. Default: one render pass over
+        the manifest dir; fan-out states (per-node-pool DaemonSets, the
+        reference's stateDriver pattern driver.go:222-278) override this to
+        render once per pool."""
+        return self.renderer.render_objects(self.get_render_data(catalog))
+
     def sync(self, client: Client, catalog, owner: Optional[ObjectDict] = None) -> SyncResult:
         if not self.is_enabled(catalog):
             self.delete_owned(client, catalog)
             return SyncResult(state=SyncStates.IGNORE)
         try:
-            data = self.get_render_data(catalog)
-            objects = self.renderer.render_objects(data)
+            objects = self.render_all(catalog)
         except Exception as e:  # noqa: BLE001 — render failure is a state error
             log.exception("state %s: render failed", self.name)
             return SyncResult(state=SyncStates.ERROR, error=str(e))

@@ -1,0 +1,90 @@
+"""TPUSlice libtpu state: per-node-pool DaemonSet fan-out.
+
+Reference: ``internal/state/driver.go`` — ``stateDriver`` renders the
+driver DaemonSet once per node pool (driver.go:222-278) with unique names
+(getDriverName driver.go:406-460), cleans stale DaemonSets for vanished
+pools (:173-201), and is owned by one NVIDIADriver CR. Here: one libtpu
+DaemonSet per (accelerator type, topology, GKE pool), owned by one
+TPUSlice CR, with OnDelete update strategy so version bumps are rolled by
+the upgrade controller, not the DS controller.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from tpu_operator.utils import object_hash
+
+from tpu_operator import consts, images
+from tpu_operator.api.tpuslice import TPUSlice
+from tpu_operator.catalog import InfoCatalog
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodepool import NodePool
+from tpu_operator.render import Renderer
+from tpu_operator.state.skel import StateSkel
+from tpu_operator.states.clusterpolicy_states import MANIFEST_ROOT
+
+
+def _dns_safe(name: str) -> str:
+    """DNS-1123 truncation with a content-hash suffix: long slice+pool
+    combinations must never collide to one DaemonSet name (the reference
+    hashes into getDriverName for the same reason)."""
+    clean = re.sub(r"[^a-z0-9-]", "-", name.lower()).strip("-")
+    if len(clean) <= 63:
+        return clean
+    return f"{clean[:54].rstrip('-')}-{object_hash(name)[:8]}"
+
+
+def ds_name_for(slice_name: str, pool: NodePool) -> str:
+    """reference: getDriverName/getDriverAppName driver.go:406-460."""
+    return _dns_safe(f"libtpu-{slice_name}-{pool.name}")
+
+
+class TPUSliceLibtpuState(StateSkel):
+    """State label value is per-CR so two TPUSlice CRs never collect each
+    other's objects during stale cleanup."""
+
+    def __init__(self, tpu_slice: TPUSlice):
+        super().__init__(
+            f"tpuslice-{tpu_slice.name}",
+            [os.path.join(MANIFEST_ROOT, "tpuslice-libtpu-common")],
+        )
+        self.tpu_slice = tpu_slice
+        self.pool_renderer = Renderer([os.path.join(MANIFEST_ROOT, "tpuslice-libtpu-pool")])
+
+    def _common_data(self, catalog: InfoCatalog) -> dict:
+        spec = self.tpu_slice.spec
+        return {
+            "namespace": catalog.namespace,
+            "slice_name": self.tpu_slice.name,
+            "slice_labels": spec.labels,
+            "slice_annotations": spec.annotations,
+            "tpu_resource": consts.TPU_RESOURCE_NAME,
+            "validation_dir": consts.VALIDATION_DIR,
+            "install_dir": spec.install_dir,
+            "image": images.resolve("libtpu", spec),
+            "image_pull_policy": spec.image_pull_policy,
+            "env": spec.env,
+            "args": spec.args,
+            "resources": spec.resources,
+            "priority_class_name": spec.priority_class_name,
+            "tolerations": spec.tolerations,
+            "node_affinity": spec.node_affinity,
+        }
+
+    def render_all(self, catalog: InfoCatalog) -> List[ObjectDict]:
+        data = self._common_data(catalog)
+        objects = self.renderer.render_objects(data)
+        for pool in catalog.node_pools or []:
+            pool_selector = dict(pool.selector)
+            pool_selector.update(self.tpu_slice.spec.node_selector)
+            pool_data = dict(
+                data,
+                pool=pool,
+                ds_name=ds_name_for(self.tpu_slice.name, pool),
+                pool_selector=pool_selector,
+            )
+            objects.extend(self.pool_renderer.render_objects(pool_data))
+        return objects

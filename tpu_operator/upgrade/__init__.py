@@ -1,0 +1,4 @@
+from tpu_operator.upgrade.fsm import (  # noqa: F401
+    ClusterUpgradeStateManager,
+    UpgradeState,
+)

@@ -1,0 +1,369 @@
+"""Rolling libtpu upgrade: the node-label state machine.
+
+Reference: the vendored upgrade library
+(vendor/github.com/NVIDIA/k8s-operator-libs/pkg/upgrade) — per-node FSM
+driven by the ``upgrade-state`` node label:
+
+    upgrade-required → cordon-required → wait-for-jobs-required →
+    pod-deletion-required → drain-required → pod-restart-required →
+    validation-required → uncordon-required → upgrade-done
+    (consts.go:44-67)
+
+The design is re-implemented, not ported: states are pure functions over
+the cluster, the whole machine is stateless and idempotent
+(upgrade_state.go:68-74 — every decision is recomputed from pods + labels
+each pass), and concurrency limits (maxParallelUpgrades / maxUnavailable)
+bound how many nodes may be in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import UpgradePolicySpec
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import ObjectDict, matches_selector
+
+log = logging.getLogger(__name__)
+
+DRIVER_POD_COMPONENT_LABEL = "app.kubernetes.io/component"
+DRIVER_POD_COMPONENT = "libtpu-installer"
+VALIDATOR_POD_APP = "tpu-operator-validator"
+POD_TEMPLATE_GENERATION_LABEL = "pod-template-generation"
+
+
+class UpgradeState:
+    UNKNOWN = ""
+    UPGRADE_REQUIRED = "upgrade-required"
+    CORDON_REQUIRED = "cordon-required"
+    WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+    POD_DELETION_REQUIRED = "pod-deletion-required"
+    DRAIN_REQUIRED = "drain-required"
+    POD_RESTART_REQUIRED = "pod-restart-required"
+    VALIDATION_REQUIRED = "validation-required"
+    UNCORDON_REQUIRED = "uncordon-required"
+    DONE = "upgrade-done"
+    FAILED = "upgrade-failed"
+
+
+# states counting as "in progress" for the maxParallel budget
+IN_PROGRESS = {
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.VALIDATION_REQUIRED,
+    UpgradeState.UNCORDON_REQUIRED,
+}
+
+
+@dataclasses.dataclass
+class NodeUpgradeState:
+    node: ObjectDict
+    driver_pods: List[ObjectDict]
+    daemonset: Optional[ObjectDict]
+    state: str
+
+    @property
+    def name(self) -> str:
+        return self.node["metadata"]["name"]
+
+
+@dataclasses.dataclass
+class ClusterUpgradeState:
+    nodes: Dict[str, NodeUpgradeState]
+
+    def in_state(self, *states: str) -> List[NodeUpgradeState]:
+        return sorted(
+            (n for n in self.nodes.values() if n.state in states), key=lambda n: n.name
+        )
+
+    def count(self, *states: str) -> int:
+        return len(self.in_state(*states))
+
+
+class ClusterUpgradeStateManager:
+    """reference: ClusterUpgradeStateManager upgrade_state.go:67-101
+    (BuildState + ApplyState)."""
+
+    def __init__(self, client: Client, namespace: str):
+        self.client = client
+        self.namespace = namespace
+
+    # -- BuildState ----------------------------------------------------------
+
+    def build_state(self) -> ClusterUpgradeState:
+        """Recompute every node's upgrade state from driver pods + labels."""
+        daemonsets = {
+            ds["metadata"]["name"]: ds
+            for ds in self.client.list("apps/v1", "DaemonSet", self.namespace)
+        }
+        pods_by_node: Dict[str, List[ObjectDict]] = {}
+        for pod in self.client.list(
+            "v1", "Pod", self.namespace,
+            label_selector={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+        ):
+            node_name = pod.get("spec", {}).get("nodeName")
+            if node_name:
+                pods_by_node.setdefault(node_name, []).append(pod)
+
+        nodes: Dict[str, NodeUpgradeState] = {}
+        for node in self.client.list("v1", "Node"):
+            name = node["metadata"]["name"]
+            pods = pods_by_node.get(name, [])
+            if not pods and consts.UPGRADE_STATE_LABEL not in (node["metadata"].get("labels") or {}):
+                continue  # not a driver node
+            ds = self._owning_daemonset(pods, daemonsets)
+            label_state = (node["metadata"].get("labels") or {}).get(consts.UPGRADE_STATE_LABEL, "")
+            state = label_state
+            if not label_state and self._pod_outdated(pods, ds):
+                state = UpgradeState.UPGRADE_REQUIRED
+            if label_state == UpgradeState.DONE and self._pod_outdated(pods, ds):
+                # a new upgrade round begins
+                state = UpgradeState.UPGRADE_REQUIRED
+            nodes[name] = NodeUpgradeState(node=node, driver_pods=pods, daemonset=ds, state=state)
+        return ClusterUpgradeState(nodes=nodes)
+
+    @staticmethod
+    def _owning_daemonset(pods: List[ObjectDict], daemonsets: Dict[str, ObjectDict]):
+        for pod in pods:
+            for ref in pod["metadata"].get("ownerReferences", []):
+                if ref.get("kind") == "DaemonSet" and ref.get("name") in daemonsets:
+                    return daemonsets[ref["name"]]
+        return None
+
+    @staticmethod
+    def _pod_outdated(pods: List[ObjectDict], ds: Optional[ObjectDict]) -> bool:
+        """A driver pod is outdated when its template generation no longer
+        matches its DaemonSet's (the reference compares pod template
+        hashes; kube stamps pod-template-generation on DS pods)."""
+        if ds is None or not pods:
+            return False
+        want = str(ds["metadata"].get("generation", 1))
+        for pod in pods:
+            have = (pod["metadata"].get("labels") or {}).get(POD_TEMPLATE_GENERATION_LABEL)
+            if have is not None and have != want:
+                return True
+        return False
+
+    # -- ApplyState ----------------------------------------------------------
+
+    def apply_state(self, state: ClusterUpgradeState, policy: UpgradePolicySpec) -> None:
+        """One idempotent pass: advance each node by at most one step.
+        Buckets are snapshotted up front so a node moved this pass isn't
+        reprocessed by the next bucket (the reference processes the buckets
+        BuildState computed, never intra-pass transitions)."""
+        buckets = {
+            s: state.in_state(s)
+            for s in (
+                UpgradeState.UPGRADE_REQUIRED,
+                UpgradeState.CORDON_REQUIRED,
+                UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+                UpgradeState.POD_DELETION_REQUIRED,
+                UpgradeState.DRAIN_REQUIRED,
+                UpgradeState.POD_RESTART_REQUIRED,
+                UpgradeState.VALIDATION_REQUIRED,
+                UpgradeState.UNCORDON_REQUIRED,
+            )
+        }
+        max_parallel = policy.max_parallel_upgrades or len(state.nodes) or 1
+        in_progress = state.count(*IN_PROGRESS)
+        budget = max(0, max_parallel - in_progress)
+        budget = min(budget, self._unavailable_budget(state, policy))
+
+        for node_state in buckets[UpgradeState.UPGRADE_REQUIRED]:
+            if budget > 0:
+                self._set_state(node_state, UpgradeState.CORDON_REQUIRED)
+                budget -= 1
+            else:
+                # persist the computed upgrade-required label so progress is
+                # visible and survives operator restarts
+                self._set_state(node_state, UpgradeState.UPGRADE_REQUIRED)
+
+        for node_state in buckets[UpgradeState.CORDON_REQUIRED]:
+            self._cordon(node_state.node, True)
+            if policy.wait_for_completion.pod_selector:
+                self._set_state(node_state, UpgradeState.WAIT_FOR_JOBS_REQUIRED)
+            else:
+                self._set_state(node_state, UpgradeState.POD_DELETION_REQUIRED)
+
+        for node_state in buckets[UpgradeState.WAIT_FOR_JOBS_REQUIRED]:
+            if not self._pods_on_node(node_state.name, policy.wait_for_completion.pod_selector):
+                self._set_state(node_state, UpgradeState.POD_DELETION_REQUIRED)
+
+        for node_state in buckets[UpgradeState.POD_DELETION_REQUIRED]:
+            self._delete_tpu_pods(node_state.name)
+            if policy.drain.enable:
+                self._set_state(node_state, UpgradeState.DRAIN_REQUIRED)
+            else:
+                self._set_state(node_state, UpgradeState.POD_RESTART_REQUIRED)
+
+        for node_state in buckets[UpgradeState.DRAIN_REQUIRED]:
+            self._drain(node_state.name, policy)
+            self._set_state(node_state, UpgradeState.POD_RESTART_REQUIRED)
+
+        for node_state in buckets[UpgradeState.POD_RESTART_REQUIRED]:
+            for pod in node_state.driver_pods:
+                md = pod["metadata"]
+                try:
+                    self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+                except errors.NotFound:
+                    pass
+            self._set_state(node_state, UpgradeState.VALIDATION_REQUIRED)
+
+        for node_state in buckets[UpgradeState.VALIDATION_REQUIRED]:
+            if self._node_validated(node_state):
+                self._set_state(node_state, UpgradeState.UNCORDON_REQUIRED)
+
+        for node_state in buckets[UpgradeState.UNCORDON_REQUIRED]:
+            self._cordon(node_state.node, False)
+            self._set_state(node_state, UpgradeState.DONE)
+
+    def _unavailable_budget(self, state: ClusterUpgradeState, policy: UpgradePolicySpec) -> int:
+        """maxUnavailable bounds total unavailable nodes (absolute or
+        percentage of driver nodes), like the vendored lib."""
+        total = len(state.nodes) or 1
+        raw = str(policy.max_unavailable or "25%").strip()
+        try:
+            if raw.endswith("%"):
+                limit = max(1, int(total * int(raw[:-1].strip()) / 100))
+            else:
+                limit = max(1, int(raw))
+        except ValueError:
+            # malformed user value must degrade, not crash the upgrade loop
+            log.warning("invalid maxUnavailable %r, falling back to 25%%", raw)
+            limit = max(1, total // 4)
+        unavailable = sum(
+            1 for n in state.nodes.values() if n.node.get("spec", {}).get("unschedulable")
+        )
+        return max(0, limit - unavailable)
+
+    # -- node/pod operations -------------------------------------------------
+
+    def _set_state(self, node_state: NodeUpgradeState, new_state: str) -> None:
+        node = self.client.get_or_none("v1", "Node", node_state.name)
+        if node is None:
+            return
+        labels = node["metadata"].setdefault("labels", {})
+        if labels.get(consts.UPGRADE_STATE_LABEL) == new_state:
+            node_state.state = new_state
+            return
+        labels[consts.UPGRADE_STATE_LABEL] = new_state
+        try:
+            self.client.update(node)
+            node_state.state = new_state
+            node_state.node = node
+            log.info("upgrade: node %s -> %s", node_state.name, new_state)
+        except errors.Conflict:
+            pass  # re-planned next pass
+
+    def _cordon(self, node: ObjectDict, cordon: bool) -> None:
+        live = self.client.get_or_none("v1", "Node", node["metadata"]["name"])
+        if live is None:
+            return
+        if bool(live.get("spec", {}).get("unschedulable")) == cordon:
+            return
+        live.setdefault("spec", {})["unschedulable"] = cordon
+        try:
+            self.client.update(live)
+        except errors.Conflict:
+            pass
+
+    def _pods_on_node(self, node_name: str, selector) -> List[ObjectDict]:
+        return [
+            p
+            for p in self.client.list("v1", "Pod", label_selector=selector or None)
+            if p.get("spec", {}).get("nodeName") == node_name
+            and p.get("status", {}).get("phase") not in ("Succeeded", "Failed")
+        ]
+
+    def _delete_tpu_pods(self, node_name: str) -> None:
+        """Delete pods consuming google.com/tpu on the node (reference:
+        pod-deletion deletes pods consuming GPU resources)."""
+        for pod in self._pods_on_node(node_name, None):
+            if self._is_daemonset_pod(pod):
+                continue
+            if self._consumes_tpu(pod):
+                md = pod["metadata"]
+                try:
+                    self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+                except errors.NotFound:
+                    pass
+
+    def _drain(self, node_name: str, policy: UpgradePolicySpec) -> None:
+        """Evict all non-DaemonSet pods (reference: drain manager with the
+        DrainSpec's podSelector filter)."""
+        selector = policy.drain.pod_selector or None
+        for pod in self._pods_on_node(node_name, selector):
+            if self._is_daemonset_pod(pod):
+                continue
+            md = pod["metadata"]
+            try:
+                self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+            except errors.NotFound:
+                pass
+
+    @staticmethod
+    def _is_daemonset_pod(pod: ObjectDict) -> bool:
+        return any(
+            ref.get("kind") == "DaemonSet" for ref in pod["metadata"].get("ownerReferences", [])
+        )
+
+    @staticmethod
+    def _consumes_tpu(pod: ObjectDict) -> bool:
+        for ctr in pod.get("spec", {}).get("containers", []):
+            limits = ctr.get("resources", {}).get("limits", {}) or {}
+            requests = ctr.get("resources", {}).get("requests", {}) or {}
+            if consts.TPU_RESOURCE_NAME in limits or consts.TPU_RESOURCE_NAME in requests:
+                return True
+        return False
+
+    def _node_validated(self, node_state: NodeUpgradeState) -> bool:
+        """Fresh driver pod running with the current template generation,
+        and — when the validator operand is deployed — its pod Running on
+        the node (reference waits on app=nvidia-operator-validator pods,
+        cmd/gpu-operator/main.go:151)."""
+        pods = [
+            p
+            for p in self.client.list(
+                "v1", "Pod", self.namespace,
+                label_selector={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+            )
+            if p.get("spec", {}).get("nodeName") == node_state.name
+        ]
+        if not pods:
+            return False
+        ds = node_state.daemonset
+        want = str(ds["metadata"].get("generation", 1)) if ds else None
+        for pod in pods:
+            if pod.get("status", {}).get("phase") != "Running":
+                return False
+            have = (pod["metadata"].get("labels") or {}).get(POD_TEMPLATE_GENERATION_LABEL)
+            if want is not None and have is not None and have != want:
+                return False
+        validators = [
+            p
+            for p in self.client.list("v1", "Pod", self.namespace, label_selector={"app": VALIDATOR_POD_APP})
+            if p.get("spec", {}).get("nodeName") == node_state.name
+        ]
+        if validators and any(p.get("status", {}).get("phase") != "Running" for p in validators):
+            return False
+        return True
+
+    # -- label cleanup -------------------------------------------------------
+
+    def remove_upgrade_labels(self) -> None:
+        """reference: removeNodeUpgradeStateLabels upgrade_controller.go:201-227."""
+        for node in self.client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if consts.UPGRADE_STATE_LABEL in labels:
+                del labels[consts.UPGRADE_STATE_LABEL]
+                try:
+                    self.client.update(node)
+                except errors.Conflict:
+                    pass

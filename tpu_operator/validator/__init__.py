@@ -1,0 +1,11 @@
+"""Validator operand (reference: validator/ — the nvidia-validator image).
+
+One binary, component selected by ``COMPONENT`` env; each component checks
+its piece of the TPU stack and writes a status file under
+``/run/tpu/validations``. The status files are the cross-DaemonSet
+synchronization barrier: other operands' init containers poll for them
+(reference: validator/main.go:131-166, the ``*-ready`` files under
+/run/nvidia/validations).
+"""
+
+from tpu_operator.validator.main import COMPONENTS, Context, run_component  # noqa: F401

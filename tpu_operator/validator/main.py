@@ -1,0 +1,230 @@
+"""tpu-validator: component dispatch + retry loop.
+
+Reference: ``validator/main.go`` — ``Component`` interface (:51-57),
+component dispatch on the COMPONENT env (:450-565), 5s retry-forever loop
+(:133-134), status files as the cross-operand barrier. Components:
+
+    libtpu    driver-validation analog (:617-635): libtpu.so installed on
+              the host path + installer container ready marker
+    plugin    plugin-validation analog (:813, :1096-1174): google.com/tpu
+              allocatable on this node
+    workload  cuda-validation analog (:1189-1308): schedule a JAX smoke
+              pod, wait for Succeeded
+    slice     multi-host check (BASELINE config 4): jax.distributed
+              bring-up + psum allreduce over ICI, records GB/s/chip
+    metrics   node-status-exporter payload (validator/metrics.go)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Callable, Dict, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import new_object
+from tpu_operator.validator import status as status_files
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Context:
+    client: Optional[Client] = None
+    node_name: str = ""
+    namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE
+    validation_dir: str = consts.VALIDATION_DIR
+    install_dir: str = consts.LIBTPU_INSTALL_DIR
+    validator_image: str = ""
+    retry_interval: float = 5.0  # reference: sleepIntervalSeconds main.go:133
+    resource_poll_retries: int = 30  # reference: gpuResourceDiscoveryWaitRetries
+    pod_wait_retries: int = 60  # reference: podCreationWaitRetries
+    expected_chips: Optional[int] = None
+
+    @classmethod
+    def from_env(cls, client: Optional[Client] = None) -> "Context":
+        return cls(
+            client=client,
+            node_name=os.environ.get("NODE_NAME", ""),
+            namespace=os.environ.get(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE),
+            validation_dir=os.environ.get("VALIDATION_DIR", consts.VALIDATION_DIR),
+            install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
+            validator_image=os.environ.get("VALIDATOR_IMAGE", ""),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Components. Each returns a payload dict on success, raises on failure.
+# ---------------------------------------------------------------------------
+
+
+def validate_libtpu(ctx: Context) -> dict:
+    """reference: Driver.runValidation main.go:617-635 — the driver is
+    ready when the host install dir carries libtpu.so and the installer
+    container's ready marker."""
+    lib = os.path.join(ctx.install_dir, "libtpu.so")
+    marker = os.path.join(ctx.install_dir, consts.LIBTPU_CTR_READY_FILE)
+    if not os.path.exists(lib):
+        raise RuntimeError(f"libtpu.so not found at {lib}")
+    if not os.path.exists(marker):
+        raise RuntimeError(f"installer ready marker missing: {marker}")
+    return {"libtpu": lib, "size": os.path.getsize(lib)}
+
+
+def validate_plugin(ctx: Context) -> dict:
+    """reference: Plugin.validateGPUResource main.go:1115-1174 — poll this
+    node's allocatable for the extended resource the device plugin
+    advertises."""
+    if ctx.client is None or not ctx.node_name:
+        raise RuntimeError("plugin validation requires a kube client and NODE_NAME")
+    for _ in range(ctx.resource_poll_retries):
+        node = ctx.client.get_or_none("v1", "Node", ctx.node_name)
+        if node is not None:
+            allocatable = node.get("status", {}).get("allocatable", {}) or {}
+            chips = int(allocatable.get(consts.TPU_RESOURCE_NAME, "0") or "0")
+            if chips > 0:
+                return {"resource": consts.TPU_RESOURCE_NAME, "chips": chips}
+        time.sleep(ctx.retry_interval)
+    raise RuntimeError(
+        f"{consts.TPU_RESOURCE_NAME} never became allocatable on {ctx.node_name}"
+    )
+
+
+def workload_pod(ctx: Context) -> dict:
+    """The JAX smoke pod spec (reference: cuda-workload-validation.yaml —
+    the vectorAdd pod, GPU limit, restartPolicy OnFailure)."""
+    return new_object(
+        "v1",
+        "Pod",
+        f"tpu-workload-validation-{ctx.node_name or 'node'}",
+        ctx.namespace,
+        labels={"app": "tpu-workload-validation"},
+        spec={
+            "restartPolicy": "Never",
+            "nodeName": ctx.node_name or None,
+            "tolerations": [
+                {"key": consts.TPU_RESOURCE_NAME, "operator": "Exists", "effect": "NoSchedule"}
+            ],
+            "containers": [
+                {
+                    "name": "tpu-smoke",
+                    "image": ctx.validator_image or "tpu-operator-validator",
+                    "command": ["python", "-m", "tpu_operator.validator.workload_entry"],
+                    "env": [{"name": "COMPONENT", "value": "smoke"}],
+                    "resources": {
+                        "limits": {consts.TPU_RESOURCE_NAME: str(ctx.expected_chips or 1)}
+                    },
+                }
+            ],
+        },
+    )
+
+
+def validate_workload(ctx: Context) -> dict:
+    """reference: CUDA.runWorkload main.go:1232-1308 + waitForPod
+    :1055-1072 — schedule the smoke pod, wait Succeeded, clean up."""
+    if ctx.client is None:
+        raise RuntimeError("workload validation requires a kube client")
+    pod = workload_pod(ctx)
+    name, ns = pod["metadata"]["name"], ctx.namespace
+    existing = ctx.client.get_or_none("v1", "Pod", name, ns)
+    if existing is not None:  # stale from a previous attempt
+        ctx.client.delete("v1", "Pod", name, ns)
+    ctx.client.create(pod)
+    try:
+        for _ in range(ctx.pod_wait_retries):
+            live = ctx.client.get_or_none("v1", "Pod", name, ns)
+            phase = (live or {}).get("status", {}).get("phase")
+            if phase == "Succeeded":
+                return {"pod": name, "phase": phase}
+            if phase == "Failed":
+                raise RuntimeError(f"workload pod {name} failed")
+            time.sleep(ctx.retry_interval)
+        raise RuntimeError(f"workload pod {name} did not succeed in time")
+    finally:
+        try:
+            ctx.client.delete("v1", "Pod", name, ns)
+        except errors.ApiError:
+            pass
+
+
+def validate_slice(ctx: Context) -> dict:
+    """Multi-host ICI check: bring up jax.distributed from the gang env and
+    run the psum allreduce, reporting GB/s/chip (BASELINE config 4)."""
+    from tpu_operator.workloads import allreduce, distributed
+
+    dist = distributed.initialize()
+    report = allreduce.run_allreduce()
+    report["hosts"] = dist.num_processes
+    report["process_id"] = dist.process_id
+    return report
+
+
+def validate_smoke(ctx: Context) -> dict:
+    """In-pod payload of the workload pod (the vectorAdd itself)."""
+    from tpu_operator.workloads import smoke
+
+    return smoke.run_smoke(expected_devices=ctx.expected_chips)
+
+
+ComponentFn = Callable[[Context], dict]
+
+COMPONENTS: Dict[str, tuple] = {
+    # name -> (fn, status file)
+    "libtpu": (validate_libtpu, consts.LIBTPU_READY_FILE),
+    "plugin": (validate_plugin, consts.PLUGIN_READY_FILE),
+    "workload": (validate_workload, consts.WORKLOAD_READY_FILE),
+    "slice": (validate_slice, "slice-ready"),
+    "smoke": (validate_smoke, None),
+}
+
+
+def run_component(
+    name: str,
+    ctx: Context,
+    max_attempts: Optional[int] = None,
+) -> dict:
+    """Retry-forever loop (reference: main.go:133-139): clear the stale
+    status file, run the check every retry_interval until it passes, then
+    write the status file other operands are blocked on."""
+    fn, ready_file = COMPONENTS[name]
+    if ready_file:
+        status_files.clear_status(ready_file, ctx.validation_dir)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            payload = fn(ctx)
+            break
+        except Exception as e:  # noqa: BLE001 — every failure retries, like the reference
+            log.warning("validation %s attempt %d failed: %s", name, attempt, e)
+            if max_attempts is not None and attempt >= max_attempts:
+                raise
+            time.sleep(ctx.retry_interval)
+    if ready_file:
+        status_files.write_status(ready_file, ctx.validation_dir, payload)
+    return payload
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    component = os.environ.get("COMPONENT", "")
+    if component == "metrics":
+        from tpu_operator.validator.metrics import NodeMetrics
+
+        NodeMetrics.from_env().run_forever()
+        return 0
+    if component not in COMPONENTS:
+        log.error("unknown COMPONENT %r (valid: %s)", component, ", ".join(COMPONENTS))
+        return 1
+    ctx = Context.from_env()
+    run_component(component, ctx)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,127 @@
+"""Node metrics exporter (the node-status-exporter payload).
+
+Reference: ``validator/metrics.go`` — a per-node Prometheus server that
+(1) watches the validation status files (:157-188, 30s cadence),
+(2) re-runs the libtpu validation every 60s (:235-248), and
+(3) counts this node's TPU devices (:190-299). Metric names mirror
+``gpu_operator_node_*`` with the tpu swap.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import prometheus_client
+
+from tpu_operator import consts
+from tpu_operator.validator import status as status_files
+from tpu_operator.validator.main import Context, validate_libtpu
+
+log = logging.getLogger(__name__)
+
+WATCHED_COMPONENTS = (
+    consts.LIBTPU_READY_FILE,
+    consts.PLUGIN_READY_FILE,
+    consts.WORKLOAD_READY_FILE,
+    "slice-ready",
+)
+
+
+class NodeMetrics:
+    def __init__(
+        self,
+        ctx: Context,
+        port: int = 8000,
+        status_interval: float = 30.0,  # reference: metrics.go:39-46
+        revalidate_interval: float = 60.0,
+        registry: Optional[prometheus_client.CollectorRegistry] = None,
+    ):
+        self.ctx = ctx
+        self.port = port
+        self.status_interval = status_interval
+        self.revalidate_interval = revalidate_interval
+        self.registry = registry or prometheus_client.CollectorRegistry()
+        node = ctx.node_name or "unknown"
+        self.component_ready = prometheus_client.Gauge(
+            "tpu_operator_node_component_ready",
+            "1 when the component's validation status file is present",
+            ["node", "component"],
+            registry=self.registry,
+        )
+        self.tpu_chips = prometheus_client.Gauge(
+            "tpu_operator_node_tpu_chips",
+            "TPU chips advertised by the device plugin on this node",
+            ["node"],
+            registry=self.registry,
+        )
+        self.libtpu_validations = prometheus_client.Counter(
+            "tpu_operator_node_libtpu_revalidations_total",
+            "Periodic libtpu re-validation attempts",
+            ["node", "result"],
+            registry=self.registry,
+        )
+        self.slice_busbw = prometheus_client.Gauge(
+            "tpu_operator_node_slice_allreduce_busbw_gbps",
+            "Last slice-validation allreduce bus bandwidth (GB/s/chip)",
+            ["node"],
+            registry=self.registry,
+        )
+        self._node = node
+        self._stop = threading.Event()
+
+    @classmethod
+    def from_env(cls) -> "NodeMetrics":
+        return cls(Context.from_env(), port=int(os.environ.get("METRICS_PORT", "8000")))
+
+    # -- collection passes ---------------------------------------------------
+
+    def collect_status_files(self) -> None:
+        for component in WATCHED_COMPONENTS:
+            payload = status_files.read_status(component, self.ctx.validation_dir)
+            self.component_ready.labels(self._node, component).set(0 if payload is None else 1)
+            if component == "slice-ready" and payload:
+                busbw = payload.get("peak_busbw_gbps_per_chip")
+                if busbw is not None:
+                    self.slice_busbw.labels(self._node).set(busbw)
+
+    def collect_device_count(self) -> None:
+        if self.ctx.client is None or not self.ctx.node_name:
+            return
+        node = self.ctx.client.get_or_none("v1", "Node", self.ctx.node_name)
+        if node is None:
+            return
+        allocatable = node.get("status", {}).get("allocatable", {}) or {}
+        self.tpu_chips.labels(self._node).set(int(allocatable.get(consts.TPU_RESOURCE_NAME, "0") or "0"))
+
+    def revalidate_libtpu(self) -> None:
+        """reference: metrics.go:235-248 — keep the driver check honest
+        after node reboots / driver swaps."""
+        try:
+            payload = validate_libtpu(self.ctx)
+            status_files.write_status(consts.LIBTPU_READY_FILE, self.ctx.validation_dir, payload)
+            self.libtpu_validations.labels(self._node, "success").inc()
+        except Exception as e:  # noqa: BLE001
+            log.warning("libtpu revalidation failed: %s", e)
+            status_files.clear_status(consts.LIBTPU_READY_FILE, self.ctx.validation_dir)
+            self.libtpu_validations.labels(self._node, "failure").inc()
+
+    # -- server --------------------------------------------------------------
+
+    def run_forever(self) -> None:
+        prometheus_client.start_http_server(self.port, registry=self.registry)
+        last_revalidate = 0.0
+        while not self._stop.is_set():
+            self.collect_status_files()
+            self.collect_device_count()
+            now = time.monotonic()
+            if now - last_revalidate >= self.revalidate_interval:
+                self.revalidate_libtpu()
+                last_revalidate = now
+            self._stop.wait(self.status_interval)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,23 @@
+"""In-pod entry for the workload-validation pod: the vectorAdd analog.
+
+The workload pod (validator/main.py:workload_pod) runs this module with a
+google.com/tpu limit; success (exit 0) marks the node's TPU stack usable
+end to end (reference: the vectorAdd container in
+cuda-workload-validation.yaml).
+"""
+
+import json
+import os
+
+from tpu_operator.workloads.smoke import run_smoke
+
+
+def main() -> int:
+    expected = os.environ.get("EXPECTED_CHIPS")
+    report = run_smoke(expected_devices=int(expected) if expected else None)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

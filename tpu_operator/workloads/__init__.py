@@ -1,0 +1,16 @@
+"""TPU workload payloads run by the validator operand.
+
+These replace the reference's only accelerator-executing code — the CUDA
+``vectorAdd`` sample the validator schedules (validator/Dockerfile:55-57,
+CUDA.runWorkload validator/main.go:1232-1308) — with JAX/XLA programs:
+
+    smoke      device-count + on-device matmul (the vectorAdd analog)
+    allreduce  jax.lax.psum over the ICI mesh, reporting GB/s/chip
+               (the BASELINE north-star metric)
+    burnin     a sharded transformer train step exercising MXU + ICI +
+               HBM simultaneously (gang burn-in for multi-host slices)
+    distributed multi-host / multi-slice jax.distributed bring-up
+
+Everything here runs identically on a virtual CPU mesh
+(``--xla_force_host_platform_device_count``) and on real TPU slices.
+"""

@@ -1,0 +1,88 @@
+"""ICI allreduce bandwidth validation — the BASELINE north-star metric.
+
+Reference analog: none (NCCL perf lives outside the GPU operator); the
+BASELINE.json north star replaces the CUDA workload check with a
+``jax.lax.psum`` allreduce over ICI reporting GB/s/chip. The collective is
+expressed with ``shard_map`` over a 1-D device mesh so XLA lowers it to a
+native ICI all-reduce; on a virtual CPU mesh the same code validates the
+collective's correctness.
+
+Bus bandwidth convention follows nccl-tests: an n-way ring all-reduce
+moves 2*(n-1)/n bytes per byte of payload per chip, so
+busbw = algbw * 2*(n-1)/n.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _build_allreduce(mesh: Mesh):
+    @partial(shard_map, mesh=mesh, in_specs=P("x"), out_specs=P())
+    def allreduce(x):
+        return jax.lax.psum(x, "x")
+
+    return jax.jit(allreduce)
+
+
+def run_allreduce(
+    sizes_mb: tuple = (1, 4, 16, 64),
+    devices: Optional[List] = None,
+    iters: int = 10,
+    warmup: int = 3,
+) -> dict:
+    """All-reduce across every visible device; returns per-size timings and
+    the peak bus bandwidth in GB/s/chip. Verifies numerics (sum of
+    per-device shards) before timing."""
+    devices = devices or jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("x",))
+    allreduce = _build_allreduce(mesh)
+
+    # correctness first (the validation part)
+    k = 1024
+    x = jnp.arange(n * k, dtype=jnp.float32).reshape(n, k)
+    with mesh:
+        got = np.asarray(allreduce(x.reshape(-1)))
+    want = np.asarray(x).reshape(n, k).sum(axis=0)
+    if not np.allclose(got, want, rtol=1e-5):
+        raise RuntimeError("allreduce numerics mismatch")
+
+    results = []
+    best_busbw = 0.0
+    for size_mb in sizes_mb:
+        per_chip = int(size_mb * 1024 * 1024 / 4)  # f32 elements per chip
+        x = jnp.ones((n * per_chip,), dtype=jnp.float32)
+        with mesh:
+            for _ in range(warmup):
+                allreduce(x).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                allreduce(x).block_until_ready()
+            dt = (time.perf_counter() - t0) / iters
+        bytes_per_chip = per_chip * 4
+        algbw = bytes_per_chip / dt / 1e9
+        busbw = algbw * (2 * (n - 1) / n) if n > 1 else algbw
+        best_busbw = max(best_busbw, busbw)
+        results.append(
+            {"size_mb": size_mb, "time_ms": dt * 1e3, "algbw_gbps": algbw, "busbw_gbps": busbw}
+        )
+    return {
+        "devices": n,
+        "platform": devices[0].platform,
+        "results": results,
+        "peak_busbw_gbps_per_chip": best_busbw,
+        "ok": True,
+    }

@@ -1,0 +1,67 @@
+"""Multi-host / multi-slice distributed bring-up.
+
+The operator's slice manager renders gang placement with GKE-style worker
+identity env (``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``) and, for
+multi-slice (BASELINE config 5), a DCN coordinator address
+(``MEGASCALE_COORDINATOR_ADDRESS``) — this module turns those env vars
+into a ``jax.distributed.initialize`` call inside the validator workload
+pods. Reference analog: none — NCCL bootstrap lives inside user workload
+images; here the operator owns the bring-up contract end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+
+    @property
+    def needed(self) -> bool:
+        return self.num_processes > 1
+
+
+def config_from_env(env: Optional[Mapping[str, str]] = None, coordinator_port: int = 8476) -> DistributedConfig:
+    """Derive the distributed topology from GKE TPU env vars.
+
+    - ``TPU_WORKER_ID``: this host's index within the slice (0-based)
+    - ``TPU_WORKER_HOSTNAMES``: comma-separated host list; worker 0 is the
+      coordinator
+    - ``MEGASCALE_COORDINATOR_ADDRESS`` (multi-slice): overrides the
+      coordinator for cross-slice DCN bring-up
+    """
+    env = env if env is not None else os.environ
+    hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    worker_id = int(env.get("TPU_WORKER_ID", "0") or "0")
+    num = len(hostnames) if hostnames else 1
+    coordinator = env.get("MEGASCALE_COORDINATOR_ADDRESS") or (
+        f"{hostnames[0]}:{coordinator_port}" if hostnames else None
+    )
+    if coordinator and ":" not in coordinator.rsplit("]", 1)[-1]:
+        coordinator = f"{coordinator}:{coordinator_port}"
+    return DistributedConfig(
+        coordinator_address=coordinator,
+        num_processes=num,
+        process_id=worker_id,
+    )
+
+
+def initialize(env: Optional[Mapping[str, str]] = None, coordinator_port: int = 8476) -> DistributedConfig:
+    """Call jax.distributed.initialize when the env describes a multi-host
+    gang; single-host is a no-op (jax works locally)."""
+    cfg = config_from_env(env, coordinator_port)
+    if cfg.needed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    return cfg
