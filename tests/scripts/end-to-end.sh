@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# End-to-end harness (reference: tests/scripts/end-to-end.sh + cases/defaults.sh):
+# install -> all operands Ready -> run TPU workload -> live ClusterPolicy
+# update -> disable/enable operand -> operator restart -> uninstall.
+# Runs against the in-memory apiserver + cluster sim (the CPU-only kind
+# cluster configuration) so it needs no cluster and no TPUs.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python3 - <<'PY'
+import time
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+from tpu_operator.api.clusterpolicy import new_cluster_policy, CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND
+from tpu_operator.controllers.clusterpolicy_controller import ClusterPolicyReconciler, setup_with_manager
+from tpu_operator.chart import render_chart
+import yaml
+
+def wait(fn, t=30, what=""):
+    dl = time.monotonic() + t
+    while time.monotonic() < dl:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"TIMEOUT waiting for {what}")
+
+NS = "tpu-operator"
+client = FakeClient()
+sim = ClusterSim(client, ready_delay=0.3).start()
+for i in range(4):
+    client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+
+# 1. "helm install": render the chart and apply the CR it contains
+values = yaml.safe_load(open("deploy/values.yaml"))
+objs = render_chart(values)
+cp = [o for o in objs if o["kind"] == "ClusterPolicy"][0]
+mgr = Manager(client, namespace=NS)
+setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+mgr.start()
+client.create(cp)
+
+def ready():
+    o = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+    return o.get("status", {}).get("state") == "ready" and len(client.list("apps/v1", "DaemonSet", NS)) == 7
+wait(ready, what="install -> Ready")
+print("STEP 1 OK: install -> ClusterPolicy Ready, 7 operand DaemonSets")
+
+# 2. TPU workload (the smoke payload the validator schedules)
+from tpu_operator.workloads.smoke import run_smoke
+report = run_smoke()
+print(f"STEP 2 OK: TPU workload pass ({report['device_count']} {report['platform']} device(s))")
+
+# 3. live update: bump libtpu version, expect DS re-render
+obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+obj["spec"].setdefault("libtpu", {}).update({"repository": "gcr.io/new", "image": "libtpu", "version": "9.9"})
+client.update(obj)
+wait(lambda: client.get("apps/v1", "DaemonSet", "libtpu-installer", NS)["spec"]["template"]["spec"]["containers"][0]["image"] == "gcr.io/new/libtpu:9.9",
+     what="live image update")
+print("STEP 3 OK: live ClusterPolicy update re-rendered libtpu DaemonSet")
+
+# 4. disable -> DS deleted; enable -> DS back (reference: update-clusterpolicy.sh)
+obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+obj["spec"]["metricsExporter"] = {"enabled": False}
+client.update(obj)
+wait(lambda: client.get_or_none("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS) is None, what="operand disable")
+obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+obj["spec"]["metricsExporter"] = {"enabled": True}
+client.update(obj)
+wait(lambda: client.get_or_none("apps/v1", "DaemonSet", "tpu-metrics-exporter", NS) is not None, what="operand enable")
+print("STEP 4 OK: operand disable/enable cycle")
+
+# 5. operator restart: stop manager, start a fresh one, still converges
+mgr.stop()
+mgr2 = Manager(client, namespace=NS)
+setup_with_manager(mgr2, ClusterPolicyReconciler(client, NS))
+mgr2.start()
+wait(ready, what="post-restart Ready")
+print("STEP 5 OK: operator restart -> Ready (stateless resume)")
+
+# 6. uninstall: delete CR -> operands GC'd via ownerReferences
+client.delete(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+wait(lambda: client.list("apps/v1", "DaemonSet", NS) == [], what="uninstall GC")
+print("STEP 6 OK: uninstall -> operands garbage-collected")
+mgr2.stop(); sim.stop()
+print("END-TO-END: PASS")
+PY

@@ -1,0 +1,200 @@
+"""Operand agent + entrypoint + chart tests."""
+
+import io
+import sys
+
+import pytest
+import yaml
+
+from tpu_operator import consts
+from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+from tpu_operator.agents.slice_manager_agent import SliceManagerAgent, WORKER_ID_LABEL
+from tpu_operator.agents.tfd_agent import TFDAgent
+from tpu_operator.chart import render_chart
+from tpu_operator.cmd import tpuop_cfg
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.native import tpuinfo
+
+NS = "tpu-operator"
+
+
+class TestTFDAgent:
+    def test_publishes_labels(self):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", "tpu-v5-lite-podslice", "4x4"))
+        agent = TFDAgent(client, "tpu-0")
+        assert agent.apply_once() is True
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v5-lite-podslice"
+        assert labels[consts.TFD_TOPOLOGY_LABEL] == "4x4"
+        assert labels[consts.TFD_SLICE_HOSTS_LABEL] == "4"
+        assert labels[consts.TFD_TPU_GENERATION_LABEL] == "v5e"
+        # second pass: no change
+        assert agent.apply_once() is False
+
+    def test_removes_labels_when_tpu_gone(self):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        agent = TFDAgent(client, "tpu-0")
+        agent.apply_once()
+        node = client.get("v1", "Node", "tpu-0")
+        del node["metadata"]["labels"][consts.GKE_TPU_ACCELERATOR_LABEL]
+        client.update(node)
+        assert agent.apply_once() is True
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert not any(k in labels for k in consts.TFD_LABELS)
+
+
+class TestSliceManagerAgent:
+    def seed(self, client, multihost=True):
+        topo = "4x4" if multihost else "2x2"
+        for i in range(4 if multihost else 1):
+            node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", topo, nodepool="pool-a")
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+
+    def test_creates_gang_plumbing(self):
+        client = FakeClient()
+        self.seed(client)
+        agent = SliceManagerAgent(client, NS)
+        names = agent.reconcile_once()
+        assert len(names) == 1
+        svc = client.get("v1", "Service", names[0], NS)
+        assert svc["spec"]["clusterIP"] == "None"
+        cm = client.get("v1", "ConfigMap", f"{names[0]}-gang", NS)
+        hosts = cm["data"]["TPU_WORKER_HOSTNAMES"].split(",")
+        assert len(hosts) == 4 and hosts[0].startswith(names[0] + "-0.")
+        assert cm["data"]["TPU_TOPOLOGY"] == "4x4"
+        for i in range(4):
+            assert client.get("v1", "Node", f"v5e-{i}")["metadata"]["labels"][WORKER_ID_LABEL] == str(i)
+
+    def test_single_host_pool_skipped(self):
+        client = FakeClient()
+        self.seed(client, multihost=False)
+        agent = SliceManagerAgent(client, NS)
+        assert agent.reconcile_once() == []
+
+    def test_multislice_coordinator_env(self):
+        client = FakeClient()
+        self.seed(client)
+        agent = SliceManagerAgent(client, NS, multi_slice=True, coordinator_port=9000)
+        names = agent.reconcile_once()
+        cm = client.get("v1", "ConfigMap", f"{names[0]}-gang", NS)
+        assert cm["data"]["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":9000")
+        assert cm["data"]["MEGASCALE_NUM_SLICES"] == "1"
+
+    def test_stale_cleanup(self):
+        client = FakeClient()
+        self.seed(client)
+        agent = SliceManagerAgent(client, NS)
+        names = agent.reconcile_once()
+        for i in range(4):
+            client.delete("v1", "Node", f"v5e-{i}")
+        agent.reconcile_once()
+        assert client.get_or_none("v1", "Service", names[0], NS) is None
+        assert client.get_or_none("v1", "ConfigMap", f"{names[0]}-gang", NS) is None
+
+
+class TestMetricsExporterAgent:
+    def test_collects_chips_and_hbm(self):
+        agent = MetricsExporterAgent(node_name="tpu-0")
+        agent.collect_device_stats()
+        values = {m.name: {tuple(sorted(s.labels.items())): s.value for s in m.samples}
+                  for m in agent.registry.collect()}
+        assert values["tpu_exporter_chips"][(("node", "tpu-0"),)] == 8  # cpu test mesh
+
+
+class TestNative:
+    def test_probe_shape(self):
+        report = tpuinfo.probe()
+        assert set(report) >= {"chip_count", "devices"}
+        assert isinstance(report["chip_count"], int)
+
+    def test_fnv_parity(self):
+        from tpu_operator.utils import fnv64a
+
+        for payload in (b"", b"a", b"cluster-policy" * 100):
+            assert tpuinfo.fnv64(payload) == fnv64a(payload)
+
+
+class TestChart:
+    def test_render_defaults(self):
+        with open("deploy/values.yaml") as f:
+            values = yaml.safe_load(f)
+        objs = render_chart(values)
+        kinds = [o["kind"] for o in objs]
+        assert kinds.count("CustomResourceDefinition") == 2
+        for kind in ("Namespace", "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                     "Deployment", "ClusterPolicy"):
+            assert kind in kinds, kind
+        deploy = [o for o in objs if o["kind"] == "Deployment"][0]
+        ctr = deploy["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["image"] == "gcr.io/tpu-operator/tpu-operator:1.0.0"
+        assert "--leader-elect" in ctr["args"]
+        cp = [o for o in objs if o["kind"] == "ClusterPolicy"][0]
+        assert cp["spec"]["devicePlugin"]["enabled"] is True
+
+    def test_values_flow_into_cr(self):
+        values = {"namespace": "custom-ns",
+                  "clusterPolicy": {"metricsExporter": {"enabled": False}}}
+        objs = render_chart(values)
+        cp = [o for o in objs if o["kind"] == "ClusterPolicy"][0]
+        assert cp["spec"]["metricsExporter"]["enabled"] is False
+        ns = [o for o in objs if o["kind"] == "Namespace"][0]
+        assert ns["metadata"]["name"] == "custom-ns"
+
+
+class TestTpuopCfg:
+    def test_validate_good_clusterpolicy(self, tmp_path, capsys):
+        p = tmp_path / "cp.yaml"
+        p.write_text(yaml.safe_dump({
+            "apiVersion": "tpu.google.com/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "cluster-policy"},
+            "spec": {"libtpu": {"repository": "gcr.io/x", "image": "libtpu", "version": "1"}},
+        }))
+        assert tpuop_cfg.main(["validate", "clusterpolicy", "--input", str(p)]) == 0
+
+    def test_validate_bad_enabled_type(self, tmp_path, capsys):
+        p = tmp_path / "cp.yaml"
+        p.write_text(yaml.safe_dump({
+            "apiVersion": "tpu.google.com/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "x"},
+            "spec": {"devicePlugin": {"enabled": "yes"}},
+        }))
+        assert tpuop_cfg.main(["validate", "clusterpolicy", "--input", str(p)]) == 1
+        assert "enabled must be a boolean" in capsys.readouterr().err
+
+    def test_validate_wrong_kind(self, tmp_path, capsys):
+        p = tmp_path / "x.yaml"
+        p.write_text(yaml.safe_dump({"kind": "Deployment"}))
+        assert tpuop_cfg.main(["validate", "clusterpolicy", "--input", str(p)]) == 1
+
+    def test_generate_crds(self, capsys):
+        assert tpuop_cfg.main(["generate", "crds"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert {d["metadata"]["name"] for d in docs} == {
+            "clusterpolicies.tpu.google.com", "tpuslices.tpu.google.com"}
+
+    def test_render(self, capsys):
+        assert tpuop_cfg.main(["render", "--values", "deploy/values.yaml"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert any(d["kind"] == "ClusterPolicy" for d in docs)
+
+
+class TestOperatorMain:
+    def test_fake_cluster_boot(self):
+        from tpu_operator.cmd.main import build_parser, make_client
+
+        args = build_parser().parse_args(["--fake-cluster", "2"])
+        client = make_client(args)
+        assert len(client.list("v1", "Node")) == 2
+
+    def test_in_cluster_required_without_fake(self, monkeypatch):
+        from tpu_operator.cmd.main import build_parser, make_client
+        from tpu_operator.kube import errors
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        args = build_parser().parse_args([])
+        with pytest.raises(errors.ApiError, match="not running in a cluster"):
+            make_client(args)

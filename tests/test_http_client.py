@@ -1,0 +1,161 @@
+"""HTTP apiserver client tests against an in-process stub apiserver
+(cross-process loopback is blocked in this environment, so the stub serves
+from a thread — same pattern as the manager endpoint tests)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.http_client import HttpClient, plural_of
+
+
+class StubApiserver:
+    """Just enough of the kube REST API: CRUD on any path + one watch
+    stream fed from a queue."""
+
+    def __init__(self):
+        self.store = {}
+        self.watch_events = []
+        self.watch_ready = threading.Event()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if "watch=true" in self.path:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    stub.watch_ready.set()
+                    sent = 0
+                    deadline = time.monotonic() + 5
+                    while time.monotonic() < deadline:
+                        while sent < len(stub.watch_events):
+                            self.wfile.write(json.dumps(stub.watch_events[sent]).encode() + b"\n")
+                            self.wfile.flush()
+                            sent += 1
+                        time.sleep(0.01)
+                    return
+                if path in stub.store:
+                    self._send(200, stub.store[path])
+                elif any(k.startswith(path + "/") for k in stub.store):
+                    items = [v for k, v in stub.store.items() if k.startswith(path + "/")]
+                    self._send(200, {"kind": "List", "metadata": {"resourceVersion": "1"}, "items": items})
+                else:
+                    self._send(200, {"items": [], "metadata": {}}) if path.endswith("s") and "/" not in path.rsplit("/", 1)[-1] else self._send(404, {"reason": "NotFound"})
+
+            def do_POST(self):  # noqa: N802
+                body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+                name = body["metadata"]["name"]
+                key = self.path.split("?")[0] + "/" + name
+                if key in stub.store:
+                    self._send(409, {"reason": "AlreadyExists"})
+                    return
+                stub.store[key] = body
+                self._send(201, body)
+
+            def do_PUT(self):  # noqa: N802
+                body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+                key = self.path.split("?")[0].removesuffix("/status")
+                if key not in stub.store:
+                    self._send(404, {"reason": "NotFound"})
+                    return
+                stub.store[key] = body
+                self._send(200, body)
+
+            def do_DELETE(self):  # noqa: N802
+                key = self.path.split("?")[0]
+                if stub.store.pop(key, None) is None:
+                    self._send(404, {"reason": "NotFound"})
+                    return
+                self._send(200, {})
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def stub():
+    s = StubApiserver()
+    yield s
+    s.stop()
+
+
+def test_plural_rules():
+    assert plural_of("ClusterPolicy") == "clusterpolicies"
+    assert plural_of("DaemonSet") == "daemonsets"
+    assert plural_of("Ingress") == "ingresses"
+    assert plural_of("PriorityClass") == "priorityclasses"
+
+
+def test_paths():
+    c = HttpClient("http://x")
+    assert c._path("v1", "Node", None, "n1") == "/api/v1/nodes/n1"
+    assert c._path("v1", "Pod", "ns", "p") == "/api/v1/namespaces/ns/pods/p"
+    assert c._path("apps/v1", "DaemonSet", "ns") == "/apis/apps/v1/namespaces/ns/daemonsets"
+    assert c._path("tpu.google.com/v1", "ClusterPolicy", None, "cp") == "/apis/tpu.google.com/v1/clusterpolicies/cp"
+    # cluster-scoped kinds ignore the namespace arg
+    assert c._path("v1", "Node", "ignored", "n1") == "/api/v1/nodes/n1"
+
+
+def test_crud_round_trip(stub):
+    client = HttpClient(stub.url)
+    obj = {"apiVersion": "v1", "kind": "ConfigMap",
+           "metadata": {"name": "cm", "namespace": "ns"}, "data": {"k": "1"}}
+    created = client.create(obj)
+    assert created["data"]["k"] == "1"
+    got = client.get("v1", "ConfigMap", "cm", "ns")
+    assert got["data"]["k"] == "1"
+    got["data"]["k"] = "2"
+    client.update(got)
+    assert client.get("v1", "ConfigMap", "cm", "ns")["data"]["k"] == "2"
+    listed = client.list("v1", "ConfigMap", "ns")
+    assert len(listed) == 1
+    client.delete("v1", "ConfigMap", "cm", "ns")
+    with pytest.raises(errors.NotFound):
+        client.get("v1", "ConfigMap", "cm", "ns")
+
+
+def test_conflict_and_exists_mapping(stub):
+    client = HttpClient(stub.url)
+    obj = {"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": "cm", "namespace": "ns"}}
+    client.create(obj)
+    with pytest.raises(errors.AlreadyExists):
+        client.create(obj)
+
+
+def test_watch_streams_events(stub):
+    client = HttpClient(stub.url)
+    received = []
+    sub = client.watch("v1", "Node", lambda et, obj: received.append((et, obj["metadata"]["name"])))
+    assert stub.watch_ready.wait(5)
+    stub.watch_events.append(
+        {"type": "ADDED", "object": {"metadata": {"name": "n1", "resourceVersion": "2"}}}
+    )
+    deadline = time.monotonic() + 5
+    while not received and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sub.stop()
+    assert ("ADDED", "n1") in received

@@ -16,7 +16,7 @@ from tpu_operator.kube.controller import Request
 from tpu_operator.kube.fake import FakeClient
 from tpu_operator.kube.objects import new_object
 from tpu_operator.kube.sim import ClusterSim, make_tpu_node
-from tpu_operator.upgrade.fsm import ClusterUpgradeStateManager, UpgradeState
+from tpu_operator.upgrade.fsm import IN_PROGRESS, ClusterUpgradeStateManager, UpgradeState
 
 NS = "tpu-operator"
 
@@ -185,3 +185,35 @@ class TestUpgradeReconciler:
             r.reconcile(Request(name="cluster-policy"))
             sim.step()
         assert all(node_state(client, f"tpu-{i}") == UpgradeState.DONE for i in range(2))
+
+
+class TestUpgradeTimeout:
+    def test_hung_job_parks_node_in_failed(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=1)
+        client.create(new_object(
+            "v1", "Pod", "hung-job", "default",
+            labels={"job": "training"},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%",
+             "waitForCompletion": {"podSelector": "job=training", "timeoutSeconds": 1},
+             "drain": {"enable": False}}
+        )
+        mgr.apply_state(mgr.build_state(), policy)
+        mgr.apply_state(mgr.build_state(), policy)
+        assert node_state(client, "tpu-0") == UpgradeState.WAIT_FOR_JOBS_REQUIRED
+        # backdate the transition past the timeout
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["annotations"][consts.UPGRADE_STATE_SINCE_ANNOTATION] = "0"
+        client.update(node)
+        mgr.apply_state(mgr.build_state(), policy)
+        assert node_state(client, "tpu-0") == UpgradeState.FAILED
+        # failed nodes no longer consume the parallel budget
+        state = mgr.build_state()
+        assert state.count(*IN_PROGRESS) == 0
+

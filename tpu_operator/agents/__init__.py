@@ -1,0 +1,14 @@
+"""Operand agents: the payloads of the operand container images.
+
+The reference operator only *templates* its operands (device plugin, GFD,
+DCGM exporter live in sibling repos — SURVEY.md §2.3). This framework
+ships the TPU equivalents in-repo so the whole stack is one codebase:
+
+    tfd_agent              tpu-feature-discovery container payload
+    slice_manager_agent    tpu-slice-manager container payload
+    metrics_exporter_agent tpu-metrics-exporter container payload
+    (validator/            the tpu-operator-validator payload)
+
+The Cloud TPU device plugin (kubelet gRPC registration) is the remaining
+external operand; its DaemonSet templates the upstream image.
+"""

@@ -1,0 +1,119 @@
+"""tpu-metrics-exporter agent (the dcgm + dcgm-exporter analog).
+
+One operand where NVIDIA needs two (DCGM daemon + exporter): libtpu's
+runtime stats are reachable in-process, so the exporter collects and
+serves in one loop. Exported series (tpu swap of dcgm-exporter's
+DCGM_FI_DEV_*):
+
+    tpu_exporter_chips                visible TPU chips
+    tpu_exporter_hbm_used_bytes       per-chip HBM in use (libtpu
+                                      memory_stats via the jax runtime)
+    tpu_exporter_hbm_limit_bytes      per-chip HBM capacity
+    tpu_exporter_hbm_bandwidth_gbps   measured pallas-triad HBM bandwidth
+    tpu_exporter_duty_cycle           per-chip busy fraction when the
+                                      runtime exposes it
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import prometheus_client
+
+log = logging.getLogger(__name__)
+
+
+class MetricsExporterAgent:
+    def __init__(
+        self,
+        node_name: str = "",
+        port: int = 8431,
+        interval: float = 30.0,
+        bandwidth_probe_interval: float = 600.0,
+        registry: Optional[prometheus_client.CollectorRegistry] = None,
+    ):
+        self.node_name = node_name or "unknown"
+        self.port = port
+        self.interval = interval
+        self.bandwidth_probe_interval = bandwidth_probe_interval
+        self.registry = registry or prometheus_client.CollectorRegistry()
+        self.chips = prometheus_client.Gauge(
+            "tpu_exporter_chips", "Visible TPU chips", ["node"], registry=self.registry
+        )
+        self.hbm_used = prometheus_client.Gauge(
+            "tpu_exporter_hbm_used_bytes", "HBM bytes in use", ["node", "chip"], registry=self.registry
+        )
+        self.hbm_limit = prometheus_client.Gauge(
+            "tpu_exporter_hbm_limit_bytes", "HBM bytes capacity", ["node", "chip"], registry=self.registry
+        )
+        self.hbm_bandwidth = prometheus_client.Gauge(
+            "tpu_exporter_hbm_bandwidth_gbps",
+            "Measured triad HBM bandwidth",
+            ["node"],
+            registry=self.registry,
+        )
+        self.duty_cycle = prometheus_client.Gauge(
+            "tpu_exporter_duty_cycle", "TensorCore busy fraction", ["node", "chip"], registry=self.registry
+        )
+        self.collect_errors = prometheus_client.Counter(
+            "tpu_exporter_collect_errors_total", "Collection failures", ["node"], registry=self.registry
+        )
+        self._stop = threading.Event()
+
+    # -- collection -----------------------------------------------------------
+
+    def collect_device_stats(self) -> None:
+        """Chip inventory + HBM occupancy from the libtpu-backed runtime."""
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception as e:  # noqa: BLE001 — no runtime -> no chips
+            log.warning("metrics: jax runtime unavailable: %s", e)
+            self.collect_errors.labels(self.node_name).inc()
+            self.chips.labels(self.node_name).set(0)
+            return
+        self.chips.labels(self.node_name).set(len(devices))
+        for dev in devices:
+            chip = str(getattr(dev, "id", dev))
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — some platforms expose none
+                stats = {}
+            if "bytes_in_use" in stats:
+                self.hbm_used.labels(self.node_name, chip).set(stats["bytes_in_use"])
+            if "bytes_limit" in stats:
+                self.hbm_limit.labels(self.node_name, chip).set(stats["bytes_limit"])
+            if "duty_cycle" in stats:
+                self.duty_cycle.labels(self.node_name, chip).set(stats["duty_cycle"])
+
+    def probe_bandwidth(self) -> None:
+        """Occasional active probe — the pallas triad — for achievable HBM
+        bandwidth (the ICI-bandwidth analog lives in the slice validator)."""
+        try:
+            from tpu_operator.workloads.kernels import hbm_bandwidth_probe
+
+            report = hbm_bandwidth_probe(size_mb=64, iters=3, warmup=1)
+            self.hbm_bandwidth.labels(self.node_name).set(report["bandwidth_gbps"])
+        except Exception as e:  # noqa: BLE001
+            log.warning("metrics: bandwidth probe failed: %s", e)
+            self.collect_errors.labels(self.node_name).inc()
+
+    # -- server ---------------------------------------------------------------
+
+    def run_forever(self) -> None:
+        prometheus_client.start_http_server(self.port, registry=self.registry)
+        last_probe = 0.0
+        while not self._stop.is_set():
+            self.collect_device_stats()
+            now = time.monotonic()
+            if now - last_probe >= self.bandwidth_probe_interval:
+                self.probe_bandwidth()
+                last_probe = now
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,159 @@
+"""tpu-slice-manager agent (the mig-manager analog, re-imagined for TPUs).
+
+MIG partitions one GPU into sub-devices; a TPU slice composes many hosts
+into one accelerator. So where mig-manager applies mig-parted profiles per
+node, the slice manager materializes the *gang plumbing* each multi-host
+slice needs (reference concept: state-mig-manager + the per-node
+``nvidia.com/mig.config`` label loop):
+
+  - a headless Service per slice (stable DNS for worker discovery)
+  - a ConfigMap carrying the gang env contract: TPU_WORKER_HOSTNAMES,
+    chips/topology, and — when multiSlice is on — the DCN coordinator
+    address (MEGASCALE_COORDINATOR_ADDRESS, BASELINE config 5)
+  - per-node worker identity labels (tpu.google.com/worker-id) mirroring
+    the reference's per-node config label reconciliation
+
+Workload pods join a slice gang by mounting the ConfigMap and using the
+headless Service DNS — which is exactly what the validator's slice
+component consumes (workloads/distributed.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import new_object
+from tpu_operator.nodepool import NodePool, get_node_pools
+
+log = logging.getLogger(__name__)
+
+WORKER_ID_LABEL = "tpu.google.com/worker-id"
+SLICE_SERVICE_PREFIX = "tpu-slice"
+
+
+class SliceManagerAgent:
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        multi_slice: bool = False,
+        coordinator_port: int = 8476,
+        interval: float = 30.0,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.multi_slice = multi_slice
+        self.coordinator_port = coordinator_port
+        self.interval = interval
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile_once(self) -> List[str]:
+        """Converge gang plumbing for every multi-host pool; returns the
+        slice names reconciled. Idempotent — every host of the slice runs
+        this and the create-or-update converges."""
+        nodes = [
+            n for n in self.client.list("v1", "Node")
+            if (n["metadata"].get("labels") or {}).get(consts.TPU_PRESENT_LABEL) == "true"
+        ]
+        pools = get_node_pools(nodes)
+        reconciled = []
+        slice_names = []
+        for index, pool in enumerate(pools):
+            if not pool.info.multi_host:
+                continue
+            name = self._slice_name(pool)
+            slice_names.append(name)
+            self._apply_service(name)
+            self._apply_gang_configmap(name, pool, slice_index=index, total_slices=len(pools))
+            self._apply_worker_ids(pool)
+            reconciled.append(name)
+        self._cleanup_stale(slice_names)
+        return reconciled
+
+    @staticmethod
+    def _slice_name(pool: NodePool) -> str:
+        return f"{SLICE_SERVICE_PREFIX}-{pool.name}"[:63].rstrip("-")
+
+    def _apply_service(self, name: str) -> None:
+        svc = new_object(
+            "v1",
+            "Service",
+            name,
+            self.namespace,
+            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            spec={
+                "clusterIP": "None",  # headless: per-worker DNS
+                "selector": {"tpu.google.com/slice": name},
+                "ports": [{"name": "coordinator", "port": self.coordinator_port}],
+            },
+        )
+        self.client.apply(svc)
+
+    def _apply_gang_configmap(self, name: str, pool: NodePool, slice_index: int, total_slices: int) -> None:
+        hostnames = ",".join(
+            f"{name}-{i}.{name}.{self.namespace}.svc" for i in range(len(pool.node_names))
+        )
+        data = {
+            "TPU_WORKER_HOSTNAMES": hostnames,
+            "TPU_ACCELERATOR_TYPE": pool.accelerator_type,
+            "TPU_TOPOLOGY": pool.topology,
+            "TPU_SLICE_HOSTS": str(pool.info.slice_hosts),
+            "TPU_CHIPS_PER_HOST": str(pool.info.chips_per_node),
+        }
+        if self.multi_slice:
+            # slice 0's worker 0 coordinates the DCN mesh
+            first = f"{SLICE_SERVICE_PREFIX}-slice0-coordinator"
+            data["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                f"{first}.{self.namespace}.svc:{self.coordinator_port}"
+            )
+            data["MEGASCALE_NUM_SLICES"] = str(total_slices)
+            data["MEGASCALE_SLICE_ID"] = str(slice_index)
+        cm = new_object(
+            "v1",
+            "ConfigMap",
+            f"{name}-gang",
+            self.namespace,
+            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            data=data,
+        )
+        self.client.apply(cm)
+
+    def _apply_worker_ids(self, pool: NodePool) -> None:
+        """Stable worker ids: sorted node order within the pool (reference
+        concept: per-node mig.config label loop)."""
+        for worker_id, node_name in enumerate(pool.node_names):
+            try:
+                node = self.client.get("v1", "Node", node_name)
+            except errors.NotFound:
+                continue
+            labels = node["metadata"].setdefault("labels", {})
+            if labels.get(WORKER_ID_LABEL) != str(worker_id):
+                labels[WORKER_ID_LABEL] = str(worker_id)
+                try:
+                    self.client.update(node)
+                except errors.Conflict:
+                    pass
+
+    def _cleanup_stale(self, live_names: List[str]) -> None:
+        selector = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+        for svc in self.client.list("v1", "Service", self.namespace, label_selector=selector):
+            if svc["metadata"]["name"] not in live_names:
+                self.client.delete("v1", "Service", svc["metadata"]["name"], self.namespace)
+        live_cms = {f"{n}-gang" for n in live_names}
+        for cm in self.client.list("v1", "ConfigMap", self.namespace, label_selector=selector):
+            if cm["metadata"]["name"] not in live_cms:
+                self.client.delete("v1", "ConfigMap", cm["metadata"]["name"], self.namespace)
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.reconcile_once()
+            except errors.ApiError as e:
+                log.warning("slice-manager: %s", e)
+            time.sleep(self.interval)

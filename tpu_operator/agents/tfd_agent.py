@@ -1,0 +1,101 @@
+"""tpu-feature-discovery agent (the GFD analog).
+
+Reference: gpu-feature-discovery (templated by assets/gpu-feature-discovery)
+publishes per-node GPU attribute labels. This agent derives TPU attributes
+for its node — from the GKE-provided labels plus, when available, the
+native ``tpuinfo`` device probe — and patches them onto the Node as
+``tpu.google.com/*`` labels (BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.nodeinfo import tfd_labels, tpu_info
+
+log = logging.getLogger(__name__)
+
+
+class TFDAgent:
+    def __init__(self, client: Client, node_name: str, interval: float = 60.0):
+        self.client = client
+        self.node_name = node_name
+        self.interval = interval
+
+    def discover(self) -> dict:
+        """Labels to publish for this node. The GKE labels are the source
+        of truth for slice identity; the native probe (native/tpuinfo)
+        contributes the locally-visible chip count when present."""
+        node = self.client.get("v1", "Node", self.node_name)
+        info = tpu_info(node)
+        if info is None:
+            return {}
+        labels = tfd_labels(info)
+        chips = self._probe_local_chips()
+        if chips is not None:
+            labels[consts.TFD_CHIPS_PER_NODE_LABEL] = str(chips)
+        return labels
+
+    @staticmethod
+    def _probe_local_chips() -> Optional[int]:
+        try:
+            from tpu_operator.native import tpuinfo
+
+            report = tpuinfo.probe()
+            return report["chip_count"] if report.get("chip_count") else None
+        except Exception:  # noqa: BLE001 — native probe is best-effort
+            return None
+
+    def apply_once(self) -> bool:
+        """Patch the node when discovery differs from current labels."""
+        want = self.discover()
+        try:
+            node = self.client.get("v1", "Node", self.node_name)
+        except errors.NotFound:
+            return False
+        labels = node["metadata"].setdefault("labels", {})
+        changed = False
+        for key, value in want.items():
+            if labels.get(key) != value:
+                labels[key] = value
+                changed = True
+        if not want:
+            for key in consts.TFD_LABELS:
+                if key in labels:
+                    del labels[key]
+                    changed = True
+        if changed:
+            try:
+                self.client.update(node)
+            except errors.Conflict:
+                return False
+        return changed
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.apply_once()
+            except errors.ApiError as e:
+                log.warning("tfd: %s", e)
+            time.sleep(self.interval)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("NODE_NAME required")
+        return 1
+    raise NotImplementedError(
+        "in-cluster transport pending; run TFDAgent with an injected client"
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""Deployment chart rendering: values -> install manifests.
+
+Reference: the Helm chart ``deployments/gpu-operator`` — values.yaml feeds
+templates/operator.yaml (the operator Deployment) and
+templates/clusterpolicy.yaml (the CR), with CRDs shipped alongside
+(crds/). Rendering uses the same jinja2 engine as the operand states, so
+``tpuop-cfg render --values deploy/values.yaml | kubectl apply -f -`` is
+the helm-install analog.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from tpu_operator.api.common import ImageSpec
+from tpu_operator.api.crds import all_crds
+from tpu_operator.render import Renderer
+
+CHART_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "deploy")
+
+
+def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
+    """CRDs first (like helm's crds/ handling), then templated objects."""
+    operator = dict(
+        {
+            "repository": "gcr.io/tpu-operator",
+            "image": "tpu-operator",
+            "version": "1.0.0",
+            "imagePullPolicy": "IfNotPresent",
+            "replicas": 1,
+            "leaderElect": True,
+            "resources": None,
+        },
+        **(values.get("operator") or {}),
+    )
+    cp_spec = values.get("clusterPolicy") or {}
+    data = {
+        "namespace": values.get("namespace", "tpu-operator"),
+        "operator": operator,
+        "operator_image": ImageSpec.from_dict(operator).image_path("OPERATOR_IMAGE"),
+        "cluster_policy_spec": cp_spec,
+        "psa_enabled": bool((cp_spec.get("psa") or {}).get("enabled")),
+    }
+    renderer = Renderer([os.path.join(chart_dir, "templates")])
+    return all_crds() + renderer.render_objects(data)

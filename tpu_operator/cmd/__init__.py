@@ -1,0 +1,1 @@
+"""Entrypoints (reference: cmd/ — controller-manager, gpuop-cfg CLI)."""

@@ -1,0 +1,111 @@
+"""tpu-operator controller-manager entrypoint.
+
+Reference: ``cmd/gpu-operator/main.go:72-196`` — flags, zap-style logging,
+leader election, health probe on :8081, metrics on :8080, the three
+controllers, run until signalled. A ``--fake-cluster`` mode runs against
+the in-memory apiserver + sim (the CPU-only kind-cluster configuration)
+for local development and e2e scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from tpu_operator import consts
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_with_manager as setup_clusterpolicy,
+)
+from tpu_operator.controllers.tpuslice_controller import (
+    TPUSliceReconciler,
+    setup_with_manager as setup_tpuslice,
+)
+from tpu_operator.controllers.upgrade_controller import (
+    UpgradeReconciler,
+    setup_with_manager as setup_upgrade,
+)
+from tpu_operator.kube.manager import Manager
+
+log = logging.getLogger("tpu-operator")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-operator", description="TPU operator controller-manager")
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true", default=False)
+    p.add_argument("--zap-log-level", default="info", help="debug|info|warning|error")
+    p.add_argument(
+        "--fake-cluster",
+        type=int,
+        metavar="N",
+        default=None,
+        help="run against an in-memory apiserver seeded with N simulated TPU nodes",
+    )
+    return p
+
+
+def _addr(spec: str, default_host: str = "0.0.0.0"):
+    host, _, port = spec.rpartition(":")
+    return (host or default_host, int(port))
+
+
+def make_client(args):
+    if args.fake_cluster is not None:
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+        client = FakeClient()
+        for i in range(args.fake_cluster):
+            client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+        ClusterSim(client, ready_delay=0.5).start()
+        return client
+    from tpu_operator.kube.http_client import HttpClient
+
+    return HttpClient.in_cluster()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.zap_log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    namespace = os.environ.get(consts.OPERATOR_NAMESPACE_ENV)
+    if not namespace:
+        # reference: OPERATOR_NAMESPACE is mandatory (state_manager.go:762-770)
+        log.warning("%s not set; defaulting to %s", consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE)
+        namespace = consts.DEFAULT_OPERATOR_NAMESPACE
+
+    client = make_client(args)
+    mgr = Manager(
+        client,
+        namespace=namespace,
+        leader_election=args.leader_elect,
+        health_addr=_addr(args.health_probe_bind_address),
+        metrics_addr=_addr(args.metrics_bind_address),
+    )
+    setup_clusterpolicy(mgr, ClusterPolicyReconciler(client, namespace))
+    setup_tpuslice(mgr, TPUSliceReconciler(client, namespace))
+    setup_upgrade(mgr, UpgradeReconciler(client, namespace))
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    mgr.start()
+    log.info("tpu-operator running (namespace=%s)", namespace)
+    try:
+        while not stop.is_set() and not mgr.stopped():
+            stop.wait(1.0)
+    finally:
+        mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

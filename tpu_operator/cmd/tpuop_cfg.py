@@ -1,0 +1,130 @@
+"""tpuop-cfg: config lint + generation CLI.
+
+Reference: ``cmd/gpuop-cfg`` (main.go:35-74) — validate ClusterPolicy YAML
+(image fields, env consistency) and the CSV analog; extended here with CRD
+and chart generation so everything the operator serves can be produced and
+checked offline.
+
+    tpuop-cfg validate clusterpolicy --input cr.yaml
+    tpuop-cfg validate tpuslice --input ts.yaml
+    tpuop-cfg generate crds
+    tpuop-cfg render --values deploy/values.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+import yaml
+
+from tpu_operator.api.clusterpolicy import ClusterPolicy
+from tpu_operator.api.crds import all_crds
+from tpu_operator.api.tpuslice import TPUSlice
+
+IMAGE_COMPONENTS = (
+    "libtpu",
+    "device_plugin",
+    "tpu_feature_discovery",
+    "slice_manager",
+    "metrics_exporter",
+    "node_status_exporter",
+    "validator",
+)
+
+
+def validate_clusterpolicy(doc: dict) -> List[str]:
+    """Image/env lint (reference: validate/clusterpolicy/images.go) — every
+    enabled component must resolve to a pullable image path, env entries
+    must be {name, value} shaped, enablement flags must be booleans."""
+    problems: List[str] = []
+    if doc.get("kind") != "ClusterPolicy":
+        problems.append(f"kind must be ClusterPolicy, got {doc.get('kind')!r}")
+        return problems
+    cp = ClusterPolicy.from_unstructured(doc)
+    from tpu_operator import images as images_mod
+
+    for name in IMAGE_COMPONENTS:
+        spec = getattr(cp.spec, name)
+        if hasattr(spec, "is_enabled") and not spec.is_enabled():
+            continue
+        key = {"tpu_feature_discovery": "tfd"}.get(name, name)
+        path = images_mod.resolve(key, spec)
+        if not path:
+            problems.append(f"{name}: no image resolvable (CR fields, env, defaults all empty)")
+        if spec.version and spec.version.startswith("sha256:") and not spec.image:
+            problems.append(f"{name}: digest version without image")
+        for e in spec.env:
+            if not isinstance(e, dict) or "name" not in e:
+                problems.append(f"{name}: malformed env entry {e!r}")
+    raw_spec = doc.get("spec", {}) or {}
+    for comp, sub in raw_spec.items():
+        if isinstance(sub, dict) and "enabled" in sub and not isinstance(sub["enabled"], bool):
+            problems.append(f"{comp}.enabled must be a boolean, got {sub['enabled']!r}")
+    return problems
+
+
+def validate_tpuslice(doc: dict) -> List[str]:
+    problems: List[str] = []
+    if doc.get("kind") != "TPUSlice":
+        problems.append(f"kind must be TPUSlice, got {doc.get('kind')!r}")
+        return problems
+    ts = TPUSlice.from_unstructured(doc)
+    for key, value in ts.spec.get_node_selector().items():
+        if not isinstance(value, str):
+            problems.append(f"nodeSelector[{key!r}] must be a string")
+    return problems
+
+
+def cmd_validate(args) -> int:
+    with open(args.input) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    problems: List[str] = []
+    for doc in docs:
+        if args.what == "clusterpolicy":
+            problems += validate_clusterpolicy(doc)
+        else:
+            problems += validate_tpuslice(doc)
+    for p in problems:
+        print(f"INVALID: {p}", file=sys.stderr)
+    if not problems:
+        print(f"{args.input}: OK ({len(docs)} document(s))")
+    return 1 if problems else 0
+
+
+def cmd_generate_crds(args) -> int:
+    yaml.safe_dump_all(all_crds(), sys.stdout, default_flow_style=False, sort_keys=False)
+    return 0
+
+
+def cmd_render(args) -> int:
+    from tpu_operator.chart import render_chart
+
+    with open(args.values) as f:
+        values = yaml.safe_load(f) or {}
+    objs = render_chart(values)
+    yaml.safe_dump_all(objs, sys.stdout, default_flow_style=False, sort_keys=False)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpuop-cfg")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser("validate", help="lint a CR YAML file")
+    v.add_argument("what", choices=["clusterpolicy", "tpuslice"])
+    v.add_argument("--input", required=True)
+    v.set_defaults(fn=cmd_validate)
+    g = sub.add_parser("generate", help="generate artifacts")
+    gsub = g.add_subparsers(dest="what", required=True)
+    gc = gsub.add_parser("crds")
+    gc.set_defaults(fn=cmd_generate_crds)
+    r = sub.add_parser("render", help="render the deployment chart from values")
+    r.add_argument("--values", required=True)
+    r.set_defaults(fn=cmd_render)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ TFD_LABELS = (
 # Upgrade-state node label (reference: nvidia.com/gpu-driver-upgrade-state,
 # vendor k8s-operator-libs/pkg/upgrade/consts.go).
 UPGRADE_STATE_LABEL = "tpu.google.com/libtpu-upgrade-state"
+UPGRADE_STATE_SINCE_ANNOTATION = "tpu.google.com/libtpu-upgrade-state-since"
 UPGRADE_SKIP_DRAIN_POD_LABEL = "tpu.google.com/libtpu-upgrade-drain.skip"
 
 # ---------------------------------------------------------------------------

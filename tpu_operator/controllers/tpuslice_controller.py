@@ -55,6 +55,16 @@ class TPUSliceReconciler:
             return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
         cps.sort(key=lambda o: (o["metadata"].get("creationTimestamp", ""), o["metadata"]["name"]))
         cp = ClusterPolicy.from_unstructured(cps[0])
+        if not cp.spec.libtpu.use_slice_crd():
+            # without this gate the ClusterPolicy's own libtpu state and the
+            # per-CR DaemonSets would both install libtpu on the same nodes
+            # (reference: the UseNvidiaDriverCRD gate)
+            self._status(
+                obj, "notReady", error=True, reason="TPUSliceCRDDisabled",
+                message="ClusterPolicy spec.libtpu.useTPUSliceCRD is not true; "
+                        "TPUSlice CRs are inactive",
+            )
+            return Result(requeue_after=consts.REQUEUE_NOT_READY_SECONDS)
 
         all_nodes = self.client.list("v1", "Node")
         try:

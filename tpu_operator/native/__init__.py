@@ -1,0 +1,1 @@
+"""Native components (C++), loaded over ctypes."""

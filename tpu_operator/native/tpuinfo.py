@@ -1,0 +1,93 @@
+"""ctypes wrapper for the native tpuinfo probe (native/tpuinfo.cc).
+
+Self-builds with g++ on first use when the shared library is missing
+(image builds run ``make -C native`` instead); falls back to a pure-Python
+scan of the same device paths when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import json
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libtpuinfo.so")
+_SRC_PATH = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native", "tpuinfo.cc")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-fPIC", "-Wall", "-std=c++17", "-shared",
+                     "-o", _SO_PATH, _SRC_PATH],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError) as e:
+                log.warning("tpuinfo native build failed (%s); using python fallback", e)
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.tpuinfo_probe.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.tpuinfo_probe.restype = ctypes.c_int
+            lib.tpuinfo_fnv64.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong]
+            lib.tpuinfo_fnv64.restype = ctypes.c_ulonglong
+            _lib = lib
+            return lib
+        except OSError as e:
+            log.warning("tpuinfo load failed (%s); using python fallback", e)
+            _build_failed = True
+            return None
+
+
+def _python_probe() -> dict:
+    devices = sorted(glob.glob("/dev/accel*"))
+    sys_devices = sorted(glob.glob("/sys/class/accel/accel*"))
+    vfio = [p for p in glob.glob("/dev/vfio/*") if not p.endswith("/vfio")]
+    return {
+        "chip_count": max(len(devices), len(sys_devices)),
+        "devices": devices,
+        "vfio_groups": len(vfio),
+    }
+
+
+def probe() -> dict:
+    """Device inventory: {"chip_count": N, "devices": [...], "vfio_groups": N}."""
+    lib = _load()
+    if lib is None:
+        return _python_probe()
+    buf = ctypes.create_string_buffer(64 * 1024)
+    n = lib.tpuinfo_probe(buf, len(buf))
+    if n < 0:
+        return _python_probe()
+    return json.loads(buf.value.decode())
+
+
+def fnv64(data: bytes) -> int:
+    """Native FNV-1a (same constants as tpu_operator.utils.fnv64a)."""
+    lib = _load()
+    if lib is None:
+        from tpu_operator.utils import fnv64a
+
+        return fnv64a(data)
+    return int(lib.tpuinfo_fnv64(data, len(data)))

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Dict, List, Optional
 
 from tpu_operator import consts
@@ -195,6 +196,13 @@ class ClusterUpgradeStateManager:
         for node_state in buckets[UpgradeState.WAIT_FOR_JOBS_REQUIRED]:
             if not self._pods_on_node(node_state.name, policy.wait_for_completion.pod_selector):
                 self._set_state(node_state, UpgradeState.POD_DELETION_REQUIRED)
+            elif self._state_expired(node_state, policy.wait_for_completion.timeout_seconds):
+                # a hung job must not stall the whole rolling upgrade:
+                # after the policy timeout the node is parked in
+                # upgrade-failed (operator intervention required, like the
+                # reference lib) and stops consuming the parallel budget
+                log.error("upgrade: node %s wait-for-jobs timed out", node_state.name)
+                self._set_state(node_state, UpgradeState.FAILED)
 
         for node_state in buckets[UpgradeState.POD_DELETION_REQUIRED]:
             self._delete_tpu_pods(node_state.name)
@@ -223,6 +231,20 @@ class ClusterUpgradeStateManager:
         for node_state in buckets[UpgradeState.UNCORDON_REQUIRED]:
             self._cordon(node_state.node, False)
             self._set_state(node_state, UpgradeState.DONE)
+
+    @staticmethod
+    def _state_expired(node_state: NodeUpgradeState, timeout_seconds: int) -> bool:
+        if not timeout_seconds:
+            return False
+        since = (node_state.node["metadata"].get("annotations") or {}).get(
+            consts.UPGRADE_STATE_SINCE_ANNOTATION
+        )
+        if not since:
+            return False
+        try:
+            return time.time() - float(since) > timeout_seconds
+        except ValueError:
+            return False
 
     def _unavailable_budget(self, state: ClusterUpgradeState, policy: UpgradePolicySpec) -> int:
         """maxUnavailable bounds total unavailable nodes (absolute or
@@ -254,6 +276,11 @@ class ClusterUpgradeStateManager:
             node_state.state = new_state
             return
         labels[consts.UPGRADE_STATE_LABEL] = new_state
+        # timestamp the transition so per-state timeouts survive operator
+        # restarts (all FSM state lives in the cluster)
+        node["metadata"].setdefault("annotations", {})[
+            consts.UPGRADE_STATE_SINCE_ANNOTATION
+        ] = str(int(time.time()))
         try:
             self.client.update(node)
             node_state.state = new_state

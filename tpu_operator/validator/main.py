@@ -54,6 +54,7 @@ class Context:
             validation_dir=os.environ.get("VALIDATION_DIR", consts.VALIDATION_DIR),
             install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
             validator_image=os.environ.get("VALIDATOR_IMAGE", ""),
+            expected_chips=int(os.environ["EXPECTED_CHIPS"]) if os.environ.get("EXPECTED_CHIPS") else None,
         )
 
 
@@ -114,7 +115,14 @@ def workload_pod(ctx: Context) -> dict:
                     "name": "tpu-smoke",
                     "image": ctx.validator_image or "tpu-operator-validator",
                     "command": ["python", "-m", "tpu_operator.validator.workload_entry"],
-                    "env": [{"name": "COMPONENT", "value": "smoke"}],
+                    "env": [
+                        {"name": "COMPONENT", "value": "smoke"},
+                        *(
+                            [{"name": "EXPECTED_CHIPS", "value": str(ctx.expected_chips)}]
+                            if ctx.expected_chips
+                            else []
+                        ),
+                    ],
                     "resources": {
                         "limits": {consts.TPU_RESOURCE_NAME: str(ctx.expected_chips or 1)}
                     },
@@ -210,18 +218,30 @@ def run_component(
     return payload
 
 
+def _in_cluster_client() -> Optional[Client]:
+    """The plugin/workload/metrics components talk to the apiserver; inside
+    a pod the in-cluster config is always present."""
+    if not os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return None
+    from tpu_operator.kube.http_client import HttpClient
+
+    return HttpClient.in_cluster()
+
+
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
     component = os.environ.get("COMPONENT", "")
     if component == "metrics":
         from tpu_operator.validator.metrics import NodeMetrics
 
-        NodeMetrics.from_env().run_forever()
+        metrics = NodeMetrics(Context.from_env(client=_in_cluster_client()),
+                              port=int(os.environ.get("METRICS_PORT", "8000")))
+        metrics.run_forever()
         return 0
     if component not in COMPONENTS:
         log.error("unknown COMPONENT %r (valid: %s)", component, ", ".join(COMPONENTS))
         return 1
-    ctx = Context.from_env()
+    ctx = Context.from_env(client=_in_cluster_client())
     run_component(component, ctx)
     return 0
 

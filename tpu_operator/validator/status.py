@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 from typing import Optional
 
 from tpu_operator import consts
@@ -18,9 +19,20 @@ def write_status(name: str, validation_dir: Optional[str] = None, payload: Optio
     downstream consumers (node metrics exporter) can read results."""
     path = status_path(name, validation_dir)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        if payload is not None:
-            json.dump(payload, f)
+    # atomic: the files are barrier flags on a hostPath shared across
+    # containers — a torn read must be impossible
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), prefix=f".{name}.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            if payload is not None:
+                json.dump(payload, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+        raise
     return path
 
 
