@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Support-bundle dump (reference: hack/must-gather.sh): collect everything
+# needed to debug a tpu-operator install into ARTIFACT_DIR.
+set -uo pipefail
+ARTIFACT_DIR="${ARTIFACT_DIR:-/tmp/tpu-operator-must-gather}"
+NS="${OPERATOR_NAMESPACE:-tpu-operator}"
+K="${KUBECTL:-kubectl}"
+mkdir -p "$ARTIFACT_DIR"
+echo "collecting into $ARTIFACT_DIR (namespace $NS)"
+
+$K version > "$ARTIFACT_DIR/version.txt" 2>&1
+$K get nodes -o yaml > "$ARTIFACT_DIR/nodes.yaml" 2>&1
+$K get nodes --show-labels > "$ARTIFACT_DIR/node-labels.txt" 2>&1
+$K get clusterpolicies.tpu.google.com -o yaml > "$ARTIFACT_DIR/clusterpolicies.yaml" 2>&1
+$K get tpuslices.tpu.google.com -o yaml > "$ARTIFACT_DIR/tpuslices.yaml" 2>&1
+$K -n "$NS" get all -o wide > "$ARTIFACT_DIR/all.txt" 2>&1
+$K -n "$NS" get daemonsets -o yaml > "$ARTIFACT_DIR/daemonsets.yaml" 2>&1
+$K -n "$NS" get events --sort-by=.lastTimestamp > "$ARTIFACT_DIR/events.txt" 2>&1
+mkdir -p "$ARTIFACT_DIR/pod-logs"
+for pod in $($K -n "$NS" get pods -o name 2>/dev/null); do
+  name="${pod##*/}"
+  $K -n "$NS" logs "$pod" --all-containers --tail=2000 > "$ARTIFACT_DIR/pod-logs/$name.log" 2>&1
+  $K -n "$NS" describe "$pod" > "$ARTIFACT_DIR/pod-logs/$name.describe.txt" 2>&1
+done
+echo "done: $(du -sh "$ARTIFACT_DIR" | cut -f1)"

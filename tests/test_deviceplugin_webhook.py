@@ -1,0 +1,238 @@
+"""Device plugin gRPC round-trip (stub kubelet), webhook reviews, typed
+clientset tests."""
+
+import json
+import threading
+import urllib.request
+
+import grpc
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.device_plugin_agent import (
+    API_VERSION,
+    PLUGIN_SOCKET_NAME,
+    TPUDevicePlugin,
+)
+from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
+from tpu_operator.api.clusterpolicy import new_cluster_policy
+from tpu_operator.api.tpuslice import new_tpu_slice
+from tpu_operator.api.versioned import Clientset
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.webhook import WebhookServer, handle_review
+
+
+class StubKubelet:
+    """In-process Registration service capturing Register calls."""
+
+    def __init__(self, socket_path: str):
+        self.requests = []
+        self.event = threading.Event()
+        outer = self
+
+        def register(request, context):
+            outer.requests.append(request)
+            outer.event.set()
+            return pb.Empty()
+
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration",
+            {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    register,
+                    request_deserializer=pb.RegisterRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                )
+            },
+        )
+        from concurrent import futures
+
+        self.server = grpc.server(thread_pool=futures.ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"unix://{socket_path}")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=0)
+
+
+class TestDevicePlugin:
+    def test_full_round_trip(self, tmp_path):
+        socket_dir = str(tmp_path)
+        kubelet_sock = str(tmp_path / "kubelet.sock")
+        kubelet = StubKubelet(kubelet_sock)
+        plugin = TPUDevicePlugin(
+            socket_dir=socket_dir,
+            devices=["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"],
+        )
+        try:
+            plugin.serve()
+            plugin.register(kubelet_sock)
+            assert kubelet.event.wait(5)
+            req = kubelet.requests[0]
+            assert req.version == API_VERSION
+            assert req.resource_name == consts.TPU_RESOURCE_NAME
+            assert req.endpoint == PLUGIN_SOCKET_NAME
+
+            # kubelet-side: dial the plugin like the kubelet would
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            law = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ListAndWatchResponse.FromString,
+            )
+            stream = law(pb.Empty())
+            first = next(stream)
+            assert [d.ID for d in first.devices] == ["accel0", "accel1", "accel2", "accel3"]
+            assert all(d.health == "Healthy" for d in first.devices)
+
+            allocate = channel.unary_unary(
+                "/v1beta1.DevicePlugin/Allocate",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.AllocateResponse.FromString,
+            )
+            resp = allocate(
+                pb.AllocateRequest(
+                    container_requests=[pb.ContainerAllocateRequest(devicesIDs=["accel0", "accel2"])]
+                )
+            )
+            ctr = resp.container_responses[0]
+            assert [d.host_path for d in ctr.devices] == ["/dev/accel0", "/dev/accel2"]
+            assert ctr.envs["TPU_VISIBLE_CHIPS"] == "0,2"
+            assert ctr.mounts[0].host_path == consts.LIBTPU_INSTALL_DIR
+            channel.close()
+        finally:
+            plugin.stop()
+            kubelet.stop()
+
+    def test_inventory_change_republished(self, tmp_path):
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=["/dev/accel0"])
+        try:
+            plugin.serve()
+            channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            law = channel.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ListAndWatchResponse.FromString,
+            )
+            stream = law(pb.Empty())
+            assert len(next(stream).devices) == 1
+            plugin._devices_override = ["/dev/accel0", "/dev/accel1"]
+            plugin._updates.put(plugin.discover())
+            assert len(next(stream).devices) == 2
+            channel.close()
+        finally:
+            plugin.stop()
+
+
+class TestWebhook:
+    def review(self, kind, obj, operation="CREATE"):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "u1", "operation": operation, "object": obj},
+        }
+
+    def test_valid_clusterpolicy_allowed(self):
+        cs = Clientset.fake()
+        result = handle_review(cs.raw, "/validate-clusterpolicy", self.review("cp", new_cluster_policy()))
+        assert result["response"]["allowed"] is True
+        assert result["response"]["uid"] == "u1"
+
+    def test_second_clusterpolicy_denied(self):
+        cs = Clientset.fake(seed=[new_cluster_policy("first")])
+        result = handle_review(
+            cs.raw, "/validate-clusterpolicy", self.review("cp", new_cluster_policy("second"))
+        )
+        assert result["response"]["allowed"] is False
+        assert "singleton" in result["response"]["status"]["message"]
+
+    def test_bad_enabled_type_denied(self):
+        obj = new_cluster_policy(spec={"devicePlugin": {"enabled": "yes"}})
+        result = handle_review(None, "/validate-clusterpolicy", self.review("cp", obj))
+        assert result["response"]["allowed"] is False
+
+    def test_overlapping_tpuslice_denied(self):
+        node = make_tpu_node("n0")
+        node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        cs = Clientset.fake(seed=[node, new_tpu_slice("a")])
+        result = handle_review(cs.raw, "/validate-tpuslice", self.review("ts", new_tpu_slice("b")))
+        assert result["response"]["allowed"] is False
+        assert "already selected" in result["response"]["status"]["message"]
+
+    def test_http_server_round_trip(self):
+        server = WebhookServer(None, addr=("127.0.0.1", 0)).start()
+        try:
+            host, port = server.address
+            body = json.dumps(self.review("cp", new_cluster_policy())).encode()
+            req = urllib.request.Request(
+                f"http://{host}:{port}/validate-clusterpolicy", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                result = json.loads(resp.read())
+            assert result["response"]["allowed"] is True
+        finally:
+            server.stop()
+
+
+class TestTypedClientset:
+    def test_round_trip(self):
+        cs = Clientset.fake()
+        from tpu_operator.api.clusterpolicy import ClusterPolicy
+
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy())
+        created = cs.cluster_policies.create(cp)
+        assert created.name == "cluster-policy"
+        assert cs.cluster_policies.get("cluster-policy").spec.libtpu.is_enabled()
+        created.status.state = "ready"
+        cs.cluster_policies.update_status(created)
+        assert cs.cluster_policies.get("cluster-policy").status.state == "ready"
+        assert len(cs.cluster_policies.list()) == 1
+        cs.cluster_policies.delete("cluster-policy")
+        assert cs.cluster_policies.get_or_none("cluster-policy") is None
+
+    def test_tpu_slices(self):
+        cs = Clientset.fake(seed=[new_tpu_slice("a")])
+        slices = cs.tpu_slices.list()
+        assert len(slices) == 1 and slices[0].name == "a"
+
+
+class TestWebhookTLS:
+    def test_https_round_trip_with_self_signed_cert(self, tmp_path):
+        import ssl as ssl_mod
+
+        from tpu_operator.webhook import generate_self_signed_cert
+
+        cert, key, ca_b64 = generate_self_signed_cert(str(tmp_path))
+        assert ca_b64
+        server = WebhookServer(None, addr=("127.0.0.1", 0), cert_file=cert, key_file=key).start()
+        try:
+            host, port = server.address
+            ctx = ssl_mod.create_default_context(cafile=cert)
+            ctx.check_hostname = False
+            body = json.dumps({"request": {"uid": "u1", "operation": "CREATE",
+                                            "object": new_cluster_policy()}}).encode()
+            req = urllib.request.Request(
+                f"https://{host}:{port}/validate-clusterpolicy", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, context=ctx) as resp:
+                result = json.loads(resp.read())
+            assert result["response"]["allowed"] is True
+        finally:
+            server.stop()
+
+
+class TestChartWebhook:
+    def test_webhook_objects_rendered_when_enabled(self):
+        from tpu_operator.chart import render_chart
+
+        objs = render_chart({"webhook": {"enabled": True, "caBundle": "QUJD"}})
+        vwc = [o for o in objs if o["kind"] == "ValidatingWebhookConfiguration"]
+        assert len(vwc) == 1
+        hooks = vwc[0]["webhooks"]
+        assert {h["name"] for h in hooks} == {"clusterpolicy.tpu.google.com", "tpuslice.tpu.google.com"}
+        assert all(h["clientConfig"]["caBundle"] == "QUJD" for h in hooks)
+        # disabled by default
+        assert not [o for o in render_chart({}) if o["kind"] == "ValidatingWebhookConfiguration"]

@@ -109,3 +109,35 @@ class TestDistributed:
              "MEGASCALE_COORDINATOR_ADDRESS": "slice0-coord:9000"}
         )
         assert cfg.coordinator_address == "slice0-coord:9000"
+
+
+class TestRingAttention:
+    def test_causal_matches_dense(self):
+        from tpu_operator.workloads.ringattention import run_ring_attention_check
+
+        report = run_ring_attention_check(causal=True)
+        assert report["ok"] and report["devices"] == 8
+        assert report["max_abs_err"] < 2e-4
+
+    def test_non_causal_matches_dense(self):
+        from tpu_operator.workloads.ringattention import run_ring_attention_check
+
+        report = run_ring_attention_check(causal=False, seq_len=128)
+        assert report["ok"]
+
+    def test_subset_mesh(self):
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        from tpu_operator.workloads.ringattention import run_ring_attention_check
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        report = run_ring_attention_check(mesh=mesh, seq_len=64)
+        assert report["devices"] == 4
+
+    def test_indivisible_seq_rejected(self):
+        import pytest as _pytest
+        from tpu_operator.workloads.ringattention import run_ring_attention_check
+
+        with _pytest.raises(ValueError, match="not divisible"):
+            run_ring_attention_check(seq_len=100)

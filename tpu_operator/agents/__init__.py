@@ -7,8 +7,7 @@ ships the TPU equivalents in-repo so the whole stack is one codebase:
     tfd_agent              tpu-feature-discovery container payload
     slice_manager_agent    tpu-slice-manager container payload
     metrics_exporter_agent tpu-metrics-exporter container payload
+    device_plugin_agent    tpu-device-plugin container payload (kubelet
+                           gRPC device plugin, v1beta1)
     (validator/            the tpu-operator-validator payload)
-
-The Cloud TPU device plugin (kubelet gRPC registration) is the remaining
-external operand; its DaemonSet templates the upstream image.
 """

@@ -1,0 +1,100 @@
+"""Typed clientset for the operator's CRDs.
+
+Reference: the generated clientset under ``api/versioned``
+(clientset.go:133 + per-type typed clients + fakes) consumed by external
+automation and tests. Here: thin typed wrappers over any ``Client``
+(HTTP or fake), so consumers read/write ClusterPolicy/TPUSlice as typed
+objects instead of raw dicts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+)
+from tpu_operator.api.tpuslice import (
+    TPU_SLICE_API_VERSION,
+    TPU_SLICE_KIND,
+    TPUSlice,
+)
+from tpu_operator.kube.client import Client
+
+
+class _TypedClient:
+    api_version: str
+    kind: str
+    typed_cls: type
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def get(self, name: str):
+        return self.typed_cls.from_unstructured(self.client.get(self.api_version, self.kind, name))
+
+    def get_or_none(self, name: str):
+        obj = self.client.get_or_none(self.api_version, self.kind, name)
+        return self.typed_cls.from_unstructured(obj) if obj is not None else None
+
+    def list(self, label_selector=None) -> List:
+        return [
+            self.typed_cls.from_unstructured(obj)
+            for obj in self.client.list(self.api_version, self.kind, label_selector=label_selector)
+        ]
+
+    def create(self, typed):
+        return self.typed_cls.from_unstructured(self.client.create(typed.to_unstructured()))
+
+    def update(self, typed):
+        return self.typed_cls.from_unstructured(self.client.update(typed.to_unstructured()))
+
+    def update_status(self, typed):
+        return self.typed_cls.from_unstructured(self.client.update_status(typed.to_unstructured()))
+
+    def delete(self, name: str) -> None:
+        self.client.delete(self.api_version, self.kind, name)
+
+
+class ClusterPolicies(_TypedClient):
+    api_version = CLUSTER_POLICY_API_VERSION
+    kind = CLUSTER_POLICY_KIND
+    typed_cls = ClusterPolicy
+
+
+class TPUSlices(_TypedClient):
+    api_version = TPU_SLICE_API_VERSION
+    kind = TPU_SLICE_KIND
+    typed_cls = TPUSlice
+
+
+class Clientset:
+    """reference: versioned.Clientset — one handle, per-type accessors."""
+
+    def __init__(self, client: Client):
+        self._client = client
+        self.cluster_policies = ClusterPolicies(client)
+        self.tpu_slices = TPUSlices(client)
+
+    @classmethod
+    def in_cluster(cls) -> "Clientset":
+        from tpu_operator.kube.http_client import HttpClient
+
+        return cls(HttpClient.in_cluster())
+
+    @classmethod
+    def fake(cls, seed: Optional[List[dict]] = None) -> "Clientset":
+        """reference: api/versioned/fake — a clientset over the in-memory
+        apiserver, optionally pre-seeded."""
+        from tpu_operator.kube.fake import FakeClient
+
+        client = FakeClient()
+        for obj in seed or []:
+            client.create(obj)
+        return cls(client)
+
+    @property
+    def raw(self) -> Client:
+        return self._client

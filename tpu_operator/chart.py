@@ -35,12 +35,15 @@ def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
         **(values.get("operator") or {}),
     )
     cp_spec = values.get("clusterPolicy") or {}
+    webhook = dict({"enabled": False, "failurePolicy": "Fail", "caBundle": ""},
+                   **(values.get("webhook") or {}))
     data = {
         "namespace": values.get("namespace", "tpu-operator"),
         "operator": operator,
         "operator_image": ImageSpec.from_dict(operator).image_path("OPERATOR_IMAGE"),
         "cluster_policy_spec": cp_spec,
         "psa_enabled": bool((cp_spec.get("psa") or {}).get("enabled")),
+        "webhook": webhook,
     }
     renderer = Renderer([os.path.join(chart_dir, "templates")])
     return all_crds() + renderer.render_objects(data)

@@ -81,6 +81,7 @@ class ClusterPolicyReconciler:
         )
         try:
             self._label_tpu_nodes(cp)
+            self._apply_psa_labels(cp)
         except errors.ApiError as e:
             log.warning("node labelling failed: %s", e)
             self.metrics.record_failure()
@@ -142,6 +143,28 @@ class ClusterPolicyReconciler:
             self.client, obj, state, reason, message, error,
             extra={"namespace": self.namespace},
         )
+
+    def _apply_psa_labels(self, cp: ClusterPolicy) -> None:
+        """Pod Security Admission labels on the operand namespace when
+        psa.enabled (reference: setPodSecurityLabelsForNamespace
+        state_manager.go:600-648 — operands run privileged)."""
+        if not cp.spec.psa.is_enabled():
+            return
+        ns = self.client.get_or_none("v1", "Namespace", self.namespace)
+        if ns is None:
+            return
+        labels = ns["metadata"].setdefault("labels", {})
+        want = {
+            "pod-security.kubernetes.io/enforce": "privileged",
+            "pod-security.kubernetes.io/audit": "privileged",
+            "pod-security.kubernetes.io/warn": "privileged",
+        }
+        if any(labels.get(k) != v for k, v in want.items()):
+            labels.update(want)
+            try:
+                self.client.update(ns)
+            except errors.Conflict:
+                pass
 
     def _enabled_operand_keys(self, cp: ClusterPolicy) -> List[str]:
         catalog = InfoCatalog(cluster_policy=cp, namespace=self.namespace)

@@ -161,14 +161,21 @@ def validate_workload(ctx: Context) -> dict:
 
 
 def validate_slice(ctx: Context) -> dict:
-    """Multi-host ICI check: bring up jax.distributed from the gang env and
-    run the psum allreduce, reporting GB/s/chip (BASELINE config 4)."""
-    from tpu_operator.workloads import allreduce, distributed
+    """Multi-host ICI check (BASELINE config 4): bring up jax.distributed
+    from the gang env, run the psum allreduce (GB/s/chip), and the
+    long-context ring-attention exactness check over the same ring."""
+    from tpu_operator.workloads import allreduce, distributed, ringattention
 
     dist = distributed.initialize()
     report = allreduce.run_allreduce()
     report["hosts"] = dist.num_processes
     report["process_id"] = dist.process_id
+    import jax
+
+    n = len(jax.devices())
+    report["ring_attention"] = ringattention.run_ring_attention_check(
+        seq_len=max(128, 32 * n)
+    )
     return report
 
 

@@ -1,0 +1,169 @@
+"""Validating admission webhook.
+
+Reference: the manager's webhook server on :9443
+(cmd/gpu-operator/main.go). Serves AdmissionReview v1 at:
+
+    /validate-clusterpolicy   lint (tpuop-cfg rules) + singleton guard
+    /validate-tpuslice        lint + node-selector disjointness
+
+Rejecting bad CRs at admission gives users immediate feedback instead of
+an Error condition minutes later.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from tpu_operator.api.clusterpolicy import CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND
+from tpu_operator.api.tpuslice import TPUSlice
+from tpu_operator.controllers.tpuslice_validator import ValidationError, validate_node_selectors
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger(__name__)
+
+
+def review_clusterpolicy(client: Optional[Client], obj: dict, operation: str) -> List[str]:
+    from tpu_operator.cmd.tpuop_cfg import validate_clusterpolicy
+
+    problems = validate_clusterpolicy(obj)
+    if client is not None and operation == "CREATE":
+        existing = client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        others = [o for o in existing if o["metadata"]["name"] != obj.get("metadata", {}).get("name")]
+        if others:
+            problems.append(
+                "a ClusterPolicy already exists "
+                f"({others[0]['metadata']['name']}); the CRD is a cluster singleton"
+            )
+    return problems
+
+
+def review_tpuslice(client: Optional[Client], obj: dict, operation: str) -> List[str]:
+    from tpu_operator.cmd.tpuop_cfg import validate_tpuslice
+
+    problems = validate_tpuslice(obj)
+    if client is not None and not problems:
+        try:
+            validate_node_selectors(client, TPUSlice.from_unstructured(obj))
+        except ValidationError as e:
+            problems.append(str(e))
+    return problems
+
+
+def handle_review(client: Optional[Client], path: str, review: dict) -> dict:
+    """AdmissionReview in -> AdmissionReview out."""
+    request = review.get("request", {}) or {}
+    obj = request.get("object", {}) or {}
+    operation = request.get("operation", "CREATE")
+    if path.endswith("clusterpolicy"):
+        problems = review_clusterpolicy(client, obj, operation)
+    elif path.endswith("tpuslice"):
+        problems = review_tpuslice(client, obj, operation)
+    else:
+        problems = [f"unknown webhook path {path}"]
+    response = {"uid": request.get("uid", ""), "allowed": not problems}
+    if problems:
+        response["status"] = {"code": 422, "message": "; ".join(problems)}
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview", "response": response}
+
+
+class WebhookServer:
+    """The apiserver only calls webhooks over HTTPS: pass cert/key paths
+    (mounted from the webhook Secret) to serve TLS like the reference's
+    :9443 server; plain HTTP is for tests only."""
+
+    def __init__(
+        self,
+        client: Optional[Client],
+        addr: Tuple[str, int] = ("0.0.0.0", 9443),
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
+    ):
+        self.client = client
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    review = json.loads(self.rfile.read(length))
+                    result = handle_review(outer.client, self.path, review)
+                    code = 200
+                except Exception as e:  # noqa: BLE001 — malformed review
+                    result = {"error": str(e)}
+                    code = 400
+                body = json.dumps(result).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer(addr, Handler)
+        if cert_file and key_file:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+            self.server.socket = ctx.wrap_socket(self.server.socket, server_side=True)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.server_address
+
+    def start(self) -> "WebhookServer":
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+
+
+def generate_self_signed_cert(directory: str, hostname: str = "tpu-operator-webhook") -> Tuple[str, str, str]:
+    """Dev/bootstrap helper: self-signed serving cert. Returns
+    (cert_path, key_path, ca_bundle_b64) — the bundle goes into the
+    ValidatingWebhookConfiguration's clientConfig.caBundle."""
+    import base64
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName(hostname), x509.DNSName(f"{hostname}.tpu-operator.svc")]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+    os.makedirs(directory, exist_ok=True)
+    cert_path = os.path.join(directory, "tls.crt")
+    key_path = os.path.join(directory, "tls.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert_pem)
+    with open(key_path, "wb") as f:
+        f.write(key_pem)
+    return cert_path, key_path, base64.b64encode(cert_pem).decode()
