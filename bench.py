@@ -91,11 +91,18 @@ def tpu_details() -> dict:
         details["smoke_s"] = round(time.perf_counter() - t0, 3)
         from tpu_operator.workloads.kernels import hbm_bandwidth_probe
 
-        probe = hbm_bandwidth_probe(size_mb=64 if platform != "cpu" else 16, iters=5, warmup=2)
+        probe = hbm_bandwidth_probe(size_mb=256 if platform != "cpu" else 16, iters=30)
         details["triad_gbps"] = round(probe["bandwidth_gbps"], 2)
+        from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
+
+        mm = matmul_tflops(size=4096 if platform != "cpu" else 512, iters=32)
+        details["matmul_bf16_tflops"] = round(mm["tflops"], 2)
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+        if gen in PEAK_TFLOPS:
+            details["mxu_utilization_pct"] = round(100 * mm["tflops"] / PEAK_TFLOPS[gen], 1)
         from tpu_operator.workloads.allreduce import run_allreduce
 
-        ar = run_allreduce(sizes_mb=(4, 16), iters=5, warmup=2)
+        ar = run_allreduce(sizes_mb=(16,), iters=10)
         details["allreduce_busbw_gbps_per_chip"] = round(ar["peak_busbw_gbps_per_chip"], 2)
     except Exception as e:  # noqa: BLE001 — details are best-effort
         details["device_error"] = str(e)

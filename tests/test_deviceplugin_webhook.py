@@ -118,7 +118,7 @@ class TestDevicePlugin:
             stream = law(pb.Empty())
             assert len(next(stream).devices) == 1
             plugin._devices_override = ["/dev/accel0", "/dev/accel1"]
-            plugin._updates.put(plugin.discover())
+            plugin._publish(plugin.discover())
             assert len(next(stream).devices) == 2
             channel.close()
         finally:
@@ -236,3 +236,25 @@ class TestChartWebhook:
         assert all(h["clientConfig"]["caBundle"] == "QUJD" for h in hooks)
         # disabled by default
         assert not [o for o in render_chart({}) if o["kind"] == "ValidatingWebhookConfiguration"]
+
+
+class TestChartWebhookServing:
+    def test_deployment_wired_when_webhook_enabled(self):
+        from tpu_operator.chart import render_chart
+
+        objs = render_chart({"webhook": {"enabled": True, "caBundle": "QUJD",
+                                          "tlsCrt": "Y3J0", "tlsKey": "a2V5"}})
+        deploy = [o for o in objs if o["kind"] == "Deployment"][0]
+        ctr = deploy["spec"]["template"]["spec"]["containers"][0]
+        assert "--webhook-cert-dir=/etc/tpu-operator/webhook-certs" in ctr["args"]
+        assert {"name": "webhook", "containerPort": 9443} in ctr["ports"]
+        assert ctr["volumeMounts"][0]["name"] == "webhook-certs"
+        secret = [o for o in objs if o["kind"] == "Secret"][0]
+        assert secret["type"] == "kubernetes.io/tls"
+        assert secret["data"]["tls.crt"] == "Y3J0"
+        # disabled: no webhook plumbing in the deployment
+        objs_off = render_chart({})
+        deploy_off = [o for o in objs_off if o["kind"] == "Deployment"][0]
+        ctr_off = deploy_off["spec"]["template"]["spec"]["containers"][0]
+        assert not any("webhook" in a for a in ctr_off["args"])
+        assert not [o for o in objs_off if o["kind"] == "Secret"]

@@ -1,0 +1,153 @@
+"""Stress + fault-injection tests.
+
+The reference runs no race detector and no fault injection (SURVEY.md §5);
+this suite goes further: concurrent controllers under node churn, CR
+update storms, and injected operand crashes must all converge to Ready
+with no stuck states — the level-triggered design's whole claim.
+"""
+
+import threading
+import time
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_with_manager,
+)
+from tpu_operator.kube import errors
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+NS = "tpu-operator"
+
+
+def wait_for(fn, timeout=30.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def cp_state(client):
+    obj = client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+    return (obj or {}).get("status", {}).get("state")
+
+
+def test_node_churn_converges():
+    """Nodes joining/leaving while the operator reconciles: the final
+    steady state must be Ready with labels exactly on surviving nodes."""
+    client = FakeClient()
+    sim = ClusterSim(client, ready_delay=0.05).start()
+    mgr = Manager(client, namespace=NS)
+    setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            name = f"churn-{i % 6}"
+            try:
+                client.create(make_tpu_node(name))
+            except errors.AlreadyExists:
+                try:
+                    client.delete("v1", "Node", name)
+                except errors.NotFound:
+                    pass
+            i += 1
+            time.sleep(0.01)
+
+    try:
+        mgr.start()
+        client.create(new_cluster_policy())
+        churners = [threading.Thread(target=churn, daemon=True) for _ in range(3)]
+        for t in churners:
+            t.start()
+        time.sleep(1.5)
+        stop.set()
+        for t in churners:
+            t.join(timeout=5)
+        # after the storm: must converge — Ready AND every surviving node
+        # labelled ("ready" can predate the reconcile for the last joiner)
+        def settled():
+            if cp_state(client) != "ready":
+                return False
+            return all(
+                node["metadata"].get("labels", {}).get(consts.TPU_PRESENT_LABEL) == "true"
+                for node in client.list("v1", "Node")
+            )
+
+        assert wait_for(settled, timeout=20), (
+            cp_state(client),
+            [(n["metadata"]["name"], n["metadata"].get("labels", {}).get(consts.TPU_PRESENT_LABEL))
+             for n in client.list("v1", "Node")],
+        )
+    finally:
+        stop.set()
+        mgr.stop()
+        sim.stop()
+
+
+def test_cr_update_storm_no_thrash():
+    """Rapid spec flips must settle; the hash discipline must leave the
+    final DaemonSet matching the last spec."""
+    client = FakeClient()
+    sim = ClusterSim(client, ready_delay=0.0).start()
+    mgr = Manager(client, namespace=NS)
+    setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+    try:
+        mgr.start()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        assert wait_for(lambda: cp_state(client) == "ready")
+        for i in range(20):
+            # mid-storm conflicts may be dropped, but the LAST update must
+            # land for the final-state assertion to be meaningful
+            while True:
+                obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+                obj["spec"].setdefault("libtpu", {}).update(
+                    {"repository": "gcr.io/storm", "image": "libtpu", "version": f"v{i}"}
+                )
+                try:
+                    client.update(obj)
+                    break
+                except errors.Conflict:
+                    if i < 19:
+                        break  # non-final update: dropping it is fine
+        assert wait_for(
+            lambda: (client.get("apps/v1", "DaemonSet", "libtpu-installer", NS)["spec"]["template"]
+                     ["spec"]["containers"][0]["image"]).endswith("v19"),
+            timeout=20,
+        )
+        assert wait_for(lambda: cp_state(client) == "ready", timeout=20)
+    finally:
+        mgr.stop()
+        sim.stop()
+
+
+def test_operand_crashes_recovered():
+    """Injected operand crashes (flaking DaemonSets) flip the CR NotReady
+    and it must return to Ready once the faults stop."""
+    client = FakeClient()
+    sim = ClusterSim(client, ready_delay=0.05, flake_rate=0.3).start()
+    mgr = Manager(client, namespace=NS)
+    setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+    try:
+        mgr.start()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        time.sleep(1.0)  # let faults fire
+        sim.flake_rate = 0.0  # outage ends
+        assert wait_for(lambda: cp_state(client) == "ready", timeout=20), cp_state(client)
+        for ds in client.list("apps/v1", "DaemonSet", NS):
+            assert ds["status"]["numberAvailable"] == ds["status"]["desiredNumberScheduled"]
+    finally:
+        mgr.stop()
+        sim.stop()

@@ -34,7 +34,7 @@ class TestSmoke:
 
 class TestAllreduce:
     def test_correct_and_reports_bandwidth(self):
-        report = run_allreduce(sizes_mb=(1,), iters=2, warmup=1)
+        report = run_allreduce(sizes_mb=(1,), iters=2)
         assert report["devices"] == 8
         assert report["peak_busbw_gbps_per_chip"] > 0
         assert report["results"][0]["busbw_gbps"] == pytest.approx(
@@ -42,7 +42,7 @@ class TestAllreduce:
         )
 
     def test_subset_of_devices(self):
-        report = run_allreduce(sizes_mb=(1,), devices=jax.devices()[:4], iters=1, warmup=1)
+        report = run_allreduce(sizes_mb=(1,), devices=jax.devices()[:4], iters=1)
         assert report["devices"] == 4
 
 
@@ -88,7 +88,7 @@ class TestKernels:
         assert out.shape == (1024, 128)
 
     def test_bandwidth_probe(self):
-        report = hbm_bandwidth_probe(size_mb=8, iters=2, warmup=1)
+        report = hbm_bandwidth_probe(size_mb=8, iters=2)
         assert report["bandwidth_gbps"] > 0
 
 
@@ -141,3 +141,42 @@ class TestRingAttention:
 
         with _pytest.raises(ValueError, match="not divisible"):
             run_ring_attention_check(seq_len=100)
+
+
+class TestSequenceParallelBurnin:
+    def test_sp_step_runs_and_converges(self):
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh_3d, run_burnin
+
+        mesh = make_mesh_3d(data=2, sp=2, model=2)
+        cfg = BurninConfig(sequence_parallel=True, n_layers=1, seq_len=64, batch=8)
+        report = run_burnin(mesh=mesh, steps=3, cfg=cfg)
+        assert report["ok"] and report["mesh"] == {"data": 2, "sp": 2, "model": 2}
+
+    def test_sp_matches_dense_numerics(self):
+        from tpu_operator.workloads.burnin import (
+            BurninConfig,
+            build_train_step,
+            make_mesh,
+            make_mesh_3d,
+        )
+
+        dense_cfg = BurninConfig(sequence_parallel=False, n_layers=1, seq_len=64, batch=8)
+        sp_cfg = BurninConfig(sequence_parallel=True, n_layers=1, seq_len=64, batch=8)
+        _, p1, b1 = None, None, None
+        step_d, params_d, batch_d = build_train_step(make_mesh(data=2, model=4), dense_cfg)
+        _, loss_d = step_d(params_d, batch_d)
+        step_s, params_s, batch_s = build_train_step(make_mesh_3d(data=2, sp=2, model=2), sp_cfg)
+        _, loss_s = step_s(params_s, batch_s)
+        assert abs(float(loss_d) - float(loss_s)) < 1e-2
+
+    def test_sp_requires_sp_axis(self):
+        from tpu_operator.workloads.burnin import BurninConfig, build_train_step, make_mesh
+
+        with pytest.raises(ValueError, match="sp"):
+            build_train_step(make_mesh(), BurninConfig(sequence_parallel=True))
+
+
+def test_graft_entry_dryrun_3d():
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)

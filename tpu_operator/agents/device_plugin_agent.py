@@ -72,7 +72,10 @@ class TPUDevicePlugin:
         self._devices_override = devices
         self.health_check_interval = health_check_interval
         self._server: Optional[grpc.Server] = None
-        self._updates: "queue.Queue[List[str]]" = queue.Queue()
+        # per-stream subscriber queues: a re-dialled ListAndWatch must not
+        # have its updates stolen by a zombie predecessor stream
+        self._subscribers: List["queue.Queue[List[str]]"] = []
+        self._sub_lock = threading.Lock()
         self._stop = threading.Event()
         self._last_devices: List[str] = []
 
@@ -97,15 +100,23 @@ class TPUDevicePlugin:
 
     def ListAndWatch(self, request, context):
         """Stream the inventory; re-send whenever it changes."""
-        current = self.discover()
-        self._last_devices = current
-        yield self._device_list(current)
-        while not self._stop.is_set():
-            try:
-                current = self._updates.get(timeout=0.2)
-            except queue.Empty:
-                continue
+        my_queue: "queue.Queue[List[str]]" = queue.Queue()
+        with self._sub_lock:
+            self._subscribers.append(my_queue)
+        try:
+            current = self.discover()
+            self._last_devices = current
             yield self._device_list(current)
+            while not self._stop.is_set():
+                try:
+                    current = my_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                yield self._device_list(current)
+        finally:
+            with self._sub_lock:
+                if my_queue in self._subscribers:
+                    self._subscribers.remove(my_queue)
 
     def GetPreferredAllocation(self, request, context):
         responses = [
@@ -205,7 +216,7 @@ class TPUDevicePlugin:
             current = self.discover()
             if current != self._last_devices:
                 self._last_devices = current
-                self._updates.put(current)
+                self._publish(current)
             if not os.path.exists(self.socket_path):
                 log.warning("plugin socket vanished (kubelet restart?); re-registering")
                 try:
@@ -216,6 +227,11 @@ class TPUDevicePlugin:
                 except Exception as e:  # noqa: BLE001 — retry next tick
                     log.warning("re-registration failed: %s", e)
             self._stop.wait(self.health_check_interval)
+
+    def _publish(self, devices: List[str]) -> None:
+        with self._sub_lock:
+            for sub in self._subscribers:
+                sub.put(devices)
 
     def run_forever(self, kubelet_socket: Optional[str] = None) -> None:
         self.serve()

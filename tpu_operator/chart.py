@@ -35,8 +35,10 @@ def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
         **(values.get("operator") or {}),
     )
     cp_spec = values.get("clusterPolicy") or {}
-    webhook = dict({"enabled": False, "failurePolicy": "Fail", "caBundle": ""},
-                   **(values.get("webhook") or {}))
+    webhook = dict(
+        {"enabled": False, "failurePolicy": "Fail", "caBundle": "", "tlsCrt": "", "tlsKey": ""},
+        **(values.get("webhook") or {}),
+    )
     data = {
         "namespace": values.get("namespace", "tpu-operator"),
         "operator": operator,

@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect", action="store_true", default=False)
     p.add_argument("--zap-log-level", default="info", help="debug|info|warning|error")
     p.add_argument(
+        "--webhook-cert-dir",
+        default="",
+        help="serve the validating admission webhook on :9443 using tls.crt/tls.key from this dir",
+    )
+    p.add_argument("--webhook-bind-address", default=":9443")
+    p.add_argument(
         "--fake-cluster",
         type=int,
         metavar="N",
@@ -94,6 +100,17 @@ def main(argv=None) -> int:
     setup_tpuslice(mgr, TPUSliceReconciler(client, namespace))
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
 
+    webhook_server = None
+    if args.webhook_cert_dir:
+        from tpu_operator.webhook import WebhookServer
+
+        cert = os.path.join(args.webhook_cert_dir, "tls.crt")
+        key = os.path.join(args.webhook_cert_dir, "tls.key")
+        webhook_server = WebhookServer(
+            client, addr=_addr(args.webhook_bind_address), cert_file=cert, key_file=key
+        ).start()
+        log.info("admission webhook serving on %s", args.webhook_bind_address)
+
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
@@ -103,6 +120,8 @@ def main(argv=None) -> int:
         while not stop.is_set() and not mgr.stopped():
             stop.wait(1.0)
     finally:
+        if webhook_server is not None:
+            webhook_server.stop()
         mgr.stop()
     return 0
 

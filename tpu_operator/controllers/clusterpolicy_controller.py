@@ -148,19 +148,28 @@ class ClusterPolicyReconciler:
         """Pod Security Admission labels on the operand namespace when
         psa.enabled (reference: setPodSecurityLabelsForNamespace
         state_manager.go:600-648 — operands run privileged)."""
-        if not cp.spec.psa.is_enabled():
-            return
         ns = self.client.get_or_none("v1", "Namespace", self.namespace)
         if ns is None:
             return
         labels = ns["metadata"].setdefault("labels", {})
-        want = {
-            "pod-security.kubernetes.io/enforce": "privileged",
-            "pod-security.kubernetes.io/audit": "privileged",
-            "pod-security.kubernetes.io/warn": "privileged",
-        }
-        if any(labels.get(k) != v for k, v in want.items()):
-            labels.update(want)
+        keys = (
+            "pod-security.kubernetes.io/enforce",
+            "pod-security.kubernetes.io/audit",
+            "pod-security.kubernetes.io/warn",
+        )
+        changed = False
+        if cp.spec.psa.is_enabled():
+            for k in keys:
+                if labels.get(k) != "privileged":
+                    labels[k] = "privileged"
+                    changed = True
+        else:
+            # disabling psa must also revert the privileged posture
+            for k in keys:
+                if k in labels:
+                    del labels[k]
+                    changed = True
+        if changed:
             try:
                 self.client.update(ns)
             except errors.Conflict:

@@ -12,6 +12,7 @@ node churn, rolling updates, and upgrade drains.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, Optional
@@ -33,12 +34,18 @@ class ClusterSim:
         ready_delay: float = 0.0,
         tick: float = 0.02,
         create_pods: bool = True,
+        flake_rate: float = 0.0,
+        seed: int = 0,
     ):
         self.client = client
         self.namespace = namespace
         self.ready_delay = ready_delay
         self.tick = tick
         self.create_pods = create_pods
+        # fault injection: per-step probability that a DaemonSet's pods all
+        # go unavailable (container crash) and restart the readiness clock
+        self.flake_rate = flake_rate
+        self._rng = random.Random(seed)
         self._scheduled_at: Dict[tuple, float] = {}  # (ds key, rv) -> time scheduled
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -86,6 +93,9 @@ class ClusterSim:
         gen_key = (key, md.get("generation", 1))
         if gen_key not in self._scheduled_at:
             self._scheduled_at = {k: v for k, v in self._scheduled_at.items() if k[0] != key}
+            self._scheduled_at[gen_key] = time.monotonic()
+        elif self.flake_rate and self._rng.random() < self.flake_rate:
+            # injected failure: pods crash, availability clock restarts
             self._scheduled_at[gen_key] = time.monotonic()
         available = desired if (time.monotonic() - self._scheduled_at[gen_key]) >= self.ready_delay else 0
 

@@ -38,6 +38,9 @@ class BurninConfig:
     n_layers: int = 2
     dtype: str = "bfloat16"
     learning_rate: float = 0.05
+    # shard the sequence axis over an 'sp' mesh axis and use ring attention
+    # (workloads/ringattention.py) inside the block — the long-context mode
+    sequence_parallel: bool = False
 
     @property
     def jdtype(self):
@@ -57,6 +60,14 @@ def make_mesh(devices=None, data: Optional[int] = None, model: Optional[int] = N
     if data * model != n:
         raise ValueError(f"mesh {data}x{model} != {n} devices")
     return Mesh(np.array(devices).reshape(data, model), ("data", "model"))
+
+
+def make_mesh_3d(devices=None, data: int = 2, sp: int = 2, model: int = 2) -> Mesh:
+    """3-D (data, sp, model) mesh: dp x sequence-parallel x tp."""
+    devices = devices if devices is not None else jax.devices()
+    if data * sp * model != len(devices):
+        raise ValueError(f"mesh {data}x{sp}x{model} != {len(devices)} devices")
+    return Mesh(np.array(devices).reshape(data, sp, model), ("data", "sp", "model"))
 
 
 def param_shardings(cfg: BurninConfig) -> Dict[str, P]:
@@ -93,36 +104,69 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _block(params, layer: int, x, cfg: BurninConfig):
+def _dense_ctx(q, k, v, d_head):
+    """(b, s, h, dh) causal attention, dense O(S^2) path."""
+    s = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+
+def _ring_ctx(q, k, v, mesh: Mesh):
+    """Sequence-parallel attention: ring over 'sp', heads stay sharded over
+    'model', batch over 'data' — each mesh axis keeps its role and the
+    ring's ppermute rides the sp axis of the ICI mesh."""
+    from functools import partial as _partial
+
+    from tpu_operator.workloads.ringattention import _ring_attention_local
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    spec = P("data", "sp", "model", None)
+    fn = shard_map(
+        _partial(_ring_attention_local, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None):
     b, s, d = x.shape
     h = cfg.n_heads
     w = {k: params[k].astype(cfg.jdtype) for k in params if k.startswith(f"l{layer}/")}
     y = _rmsnorm(x, params[f"l{layer}/ln_scale"])
     qkv = y @ w[f"l{layer}/qkv"]  # (b, s, 3d) — column-parallel
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
-    k = k.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
-    v = v.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d // h)
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    q = q.reshape(b, s, h, d // h)
+    k = k.reshape(b, s, h, d // h)
+    v = v.reshape(b, s, h, d // h)
+    if cfg.sequence_parallel:
+        ctx = _ring_ctx(q, k, v, mesh)
+    else:
+        ctx = _dense_ctx(q, k, v, d // h)
+    ctx = ctx.reshape(b, s, d)
     x = x + ctx @ w[f"l{layer}/proj"]  # row-parallel -> psum by XLA
     y = _rmsnorm(x, params[f"l{layer}/ln_scale"])
     x = x + jax.nn.gelu(y @ w[f"l{layer}/w1"]) @ w[f"l{layer}/w2"]
     return x
 
 
-def forward(params, x, cfg: BurninConfig):
+def forward(params, x, cfg: BurninConfig, mesh: Optional[Mesh] = None):
     for layer in range(cfg.n_layers):
-        x = _block(params, layer, x, cfg)
+        x = _block(params, layer, x, cfg, mesh)
     return _rmsnorm(x, params["out_norm"])
 
 
-def loss_fn(params, batch, cfg: BurninConfig):
+def loss_fn(params, batch, cfg: BurninConfig, mesh: Optional[Mesh] = None):
     x, target = batch
-    out = forward(params, x, cfg)
+    out = forward(params, x, cfg, mesh)
     return jnp.mean(jnp.square(out.astype(jnp.float32) - target.astype(jnp.float32)))
 
 
@@ -130,12 +174,14 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     """Returns (step, params, batch): a jitted SGD train step with explicit
     in/out shardings over the mesh, ready-to-run inputs included."""
     cfg = cfg or BurninConfig()
+    if cfg.sequence_parallel and "sp" not in mesh.axis_names:
+        raise ValueError("sequence_parallel needs an 'sp' mesh axis (make_mesh_3d)")
     specs = param_shardings(cfg)
     params = init_params(jax.random.PRNGKey(0), cfg)
     params = {
         k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
     }
-    batch_spec = P("data", None, None)
+    batch_spec = P("data", "sp", None) if cfg.sequence_parallel else P("data", None, None)
     key = jax.random.PRNGKey(1)
     x = jax.random.normal(key, (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
     target = jax.random.normal(jax.random.PRNGKey(2), (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
@@ -145,7 +191,7 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     batch_sh = (NamedSharding(mesh, batch_spec),) * 2
 
     def step(params, batch) -> Tuple[dict, jax.Array]:
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - cfg.learning_rate * g.astype(p.dtype), params, grads
         )

@@ -15,6 +15,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 
@@ -42,7 +43,7 @@ def triad(x: jax.Array, y: jax.Array, alpha: float = 2.0, block_rows: int = 1024
     )(x, y)
 
 
-def hbm_bandwidth_probe(size_mb: int = 256, iters: int = 10, warmup: int = 3) -> dict:
+def hbm_bandwidth_probe(size_mb: int = 256, iters: int = 10) -> dict:
     """Measured triad bandwidth in GB/s (3 streams: 2 reads + 1 write)."""
     n_elems = size_mb * 1024 * 1024 // 4
     cols = 512
@@ -56,11 +57,21 @@ def hbm_bandwidth_probe(size_mb: int = 256, iters: int = 10, warmup: int = 3) ->
     # correctness
     if float(out[0, 0]) != 4.0:
         raise RuntimeError("triad numerics mismatch")
-    for _ in range(warmup):
-        fn(x, y).block_until_ready()
+
+    # the whole timed region is ONE device program (fori_loop over the
+    # kernel) ending in a scalar: fetching the scalar forces execution
+    # (relayed dev backends can ack block_until_ready early), and fresh
+    # input data defeats any result caching
+    @partial(jax.jit, static_argnames="n")
+    def chain(z, y, n):
+        out = lax.fori_loop(0, n, lambda i, acc: triad(acc, y), z)
+        return out[0, 0] + out[-1, -1]
+
+    x2 = x * 1.5  # fresh data, materialized before the timed region
+    float(chain(x, y, iters))  # compile + warm the exact program
+    float(x2[0, 0])
     t0 = time.perf_counter()
-    for _ in range(iters):
-        fn(x, y).block_until_ready()
+    float(chain(x2, y, iters))
     dt = (time.perf_counter() - t0) / iters
     moved = 3 * rows * cols * 4  # bytes
     return {

@@ -61,18 +61,15 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
-    acc = jnp.zeros((b, s_local, h, d), dtype=jnp.float32)
-    row_sum = jnp.zeros((b, h, s_local), dtype=jnp.float32)
-    row_max = jnp.full((b, h, s_local), -jnp.inf, dtype=jnp.float32)
-    # the accumulators become device-varying inside the loop; mark the
-    # (constant) initial values as varying over the ring axis so the scan
-    # carry types match
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        acc, row_sum, row_max = (pcast(x, (axis_name,), to="varying") for x in (acc, row_sum, row_max))
-    elif hasattr(lax, "pvary"):
-        acc, row_sum, row_max = (lax.pvary(x, (axis_name,)) for x in (acc, row_sum, row_max))
     qf = q.astype(jnp.float32)
+    # Derive the accumulators from q so they inherit q's varying-manual-axes
+    # type: the scan carry then matches whatever enclosing mesh axes this
+    # body runs under (a bare 'sp' ring or a (data, sp, model) train step),
+    # without naming them.
+    acc = jnp.zeros_like(qf)
+    row_base = jnp.sum(qf, axis=3).transpose(0, 2, 1) * 0.0  # (b, h, s_local)
+    row_sum = row_base
+    row_max = row_base - jnp.inf
 
     def step(t, carry):
         k_blk, v_blk, state = carry
