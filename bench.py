@@ -95,7 +95,7 @@ def tpu_details() -> dict:
         details["triad_gbps"] = round(probe["bandwidth_gbps"], 2)
         from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
 
-        mm = matmul_tflops(size=4096 if platform != "cpu" else 512, iters=32)
+        mm = matmul_tflops(size=8192 if platform != "cpu" else 512, iters=64 if platform != "cpu" else 8)
         details["matmul_bf16_tflops"] = round(mm["tflops"], 2)
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
         if gen in PEAK_TFLOPS:

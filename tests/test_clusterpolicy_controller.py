@@ -177,3 +177,34 @@ class TestEndToEnd:
         finally:
             mgr.stop()
             sim.stop()
+
+
+class TestPSALabels:
+    def test_enable_then_disable_reverts_only_our_labels(self):
+        client = FakeClient()
+        client.create(new_object("v1", "Namespace", NS))
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy(spec={"psa": {"enabled": True}}))
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        ns = client.get("v1", "Namespace", NS)
+        assert ns["metadata"]["labels"]["pod-security.kubernetes.io/enforce"] == "privileged"
+        # disable -> our labels removed
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        cp["spec"]["psa"] = {"enabled": False}
+        client.update(cp)
+        r.reconcile(Request(name="cluster-policy"))
+        ns = client.get("v1", "Namespace", NS)
+        assert "pod-security.kubernetes.io/enforce" not in ns["metadata"].get("labels", {})
+
+    def test_admin_set_labels_never_touched(self):
+        client = FakeClient()
+        ns_obj = new_object("v1", "Namespace", NS,
+                            labels={"pod-security.kubernetes.io/enforce": "baseline"})
+        client.create(ns_obj)
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())  # psa disabled by default
+        r = ClusterPolicyReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        ns = client.get("v1", "Namespace", NS)
+        assert ns["metadata"]["labels"]["pod-security.kubernetes.io/enforce"] == "baseline"

@@ -258,3 +258,95 @@ class TestChartWebhookServing:
         ctr_off = deploy_off["spec"]["template"]["spec"]["containers"][0]
         assert not any("webhook" in a for a in ctr_off["args"])
         assert not [o for o in objs_off if o["kind"] == "Secret"]
+
+
+class TestPluginConfig:
+    def seed_configmap(self, client):
+        from tpu_operator.kube.objects import new_object
+
+        client.create(new_object(
+            "v1", "ConfigMap", "plugin-config", "tpu-operator",
+            data={
+                "default": "replicas: 1\n",
+                "time-shared": "replicas: 4\n",
+                "broken": "{not yaml",
+            },
+        ))
+
+    def test_default_config_selected(self):
+        from tpu_operator.agents.device_plugin_agent import select_plugin_config
+
+        cs = Clientset.fake()
+        self.seed_configmap(cs.raw)
+        cs.raw.create(make_tpu_node("n0"))
+        cfg = select_plugin_config(cs.raw, "n0", "plugin-config", "tpu-operator", default="default")
+        assert cfg == {"replicas": 1}
+
+    def test_node_label_overrides(self):
+        from tpu_operator.agents.device_plugin_agent import (
+            PLUGIN_CONFIG_LABEL,
+            select_plugin_config,
+        )
+
+        cs = Clientset.fake()
+        self.seed_configmap(cs.raw)
+        node = make_tpu_node("n0", extra_labels={PLUGIN_CONFIG_LABEL: "time-shared"})
+        cs.raw.create(node)
+        cfg = select_plugin_config(cs.raw, "n0", "plugin-config", "tpu-operator", default="default")
+        assert cfg == {"replicas": 4}
+
+    def test_invalid_yaml_is_empty(self):
+        from tpu_operator.agents.device_plugin_agent import (
+            PLUGIN_CONFIG_LABEL,
+            select_plugin_config,
+        )
+
+        cs = Clientset.fake()
+        self.seed_configmap(cs.raw)
+        cs.raw.create(make_tpu_node("n0", extra_labels={PLUGIN_CONFIG_LABEL: "broken"}))
+        assert select_plugin_config(cs.raw, "n0", "plugin-config", "tpu-operator") == {}
+
+    def test_replicas_advertise_shared_chips(self, tmp_path):
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path),
+            devices=["/dev/accel0", "/dev/accel1"],
+            config={"replicas": 2},
+        )
+        resp = plugin._device_list(plugin.discover())
+        assert [d.ID for d in resp.devices] == [
+            "accel0-rep0", "accel0-rep1", "accel1-rep0", "accel1-rep1"]
+        # allocation of two replicas of the same chip injects ONE device node
+        alloc = plugin.Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=["accel0-rep0", "accel0-rep1"])]),
+            None,
+        )
+        ctr = alloc.container_responses[0]
+        assert [d.host_path for d in ctr.devices] == ["/dev/accel0"]
+        assert ctr.envs["TPU_VISIBLE_CHIPS"] == "0"
+
+
+class TestGangEnvIntegration:
+    def test_slice_manager_configmap_feeds_distributed_config(self):
+        """slice manager gang ConfigMap -> the env contract ->
+        workloads.distributed bring-up: the full multi-host wiring story."""
+        from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+        from tpu_operator.workloads.distributed import config_from_env
+
+        cs = Clientset.fake()
+        for i in range(4):
+            node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a")
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            cs.raw.create(node)
+        agent = SliceManagerAgent(cs.raw, "tpu-operator")
+        (name,) = agent.reconcile_once()
+        cm = cs.raw.get("v1", "ConfigMap", f"{name}-gang", "tpu-operator")
+        # a worker pod gets the ConfigMap as env + its node's worker id
+        node = cs.raw.get("v1", "Node", "v5e-2")
+        env = dict(cm["data"])
+        env["TPU_WORKER_ID"] = node["metadata"]["labels"]["tpu.google.com/worker-id"]
+        dist = config_from_env(env)
+        assert dist.needed
+        assert dist.num_processes == 4
+        assert dist.process_id == 2
+        assert dist.coordinator_address.startswith(f"{name}-0.{name}.tpu-operator.svc")

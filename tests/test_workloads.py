@@ -162,7 +162,6 @@ class TestSequenceParallelBurnin:
 
         dense_cfg = BurninConfig(sequence_parallel=False, n_layers=1, seq_len=64, batch=8)
         sp_cfg = BurninConfig(sequence_parallel=True, n_layers=1, seq_len=64, batch=8)
-        _, p1, b1 = None, None, None
         step_d, params_d, batch_d = build_train_step(make_mesh(data=2, model=4), dense_cfg)
         _, loss_d = step_d(params_d, batch_d)
         step_s, params_s, batch_s = build_train_step(make_mesh_3d(data=2, sp=2, model=2), sp_cfg)

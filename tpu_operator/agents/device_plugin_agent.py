@@ -34,6 +34,9 @@ from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
 log = logging.getLogger(__name__)
 
 API_VERSION = "v1beta1"
+# per-node plugin config selection label (reference: the device-plugin
+# config label driving the config-manager sidecar)
+PLUGIN_CONFIG_LABEL = "tpu.google.com/device-plugin.config"
 KUBELET_SOCKET_DIR = "/var/lib/kubelet/device-plugins"
 PLUGIN_SOCKET_NAME = "tpu-device-plugin.sock"
 
@@ -64,7 +67,11 @@ class TPUDevicePlugin:
         install_dir: str = consts.LIBTPU_INSTALL_DIR,
         devices: Optional[List[str]] = None,  # override for tests
         health_check_interval: float = 30.0,
+        config: Optional[dict] = None,  # selected named config
     ):
+        # supported config keys (the time-slicing analog): ``replicas``
+        # advertises each physical chip N times so N pods can share it
+        self.config = config or {}
         self.socket_dir = socket_dir
         self.socket_path = os.path.join(socket_dir, PLUGIN_SOCKET_NAME)
         self.resource_name = resource_name
@@ -89,9 +96,17 @@ class TPUDevicePlugin:
         return tpuinfo.probe().get("devices", [])
 
     def _device_list(self, paths: List[str]) -> pb.ListAndWatchResponse:
-        return pb.ListAndWatchResponse(
-            devices=[pb.Device(ID=os.path.basename(p), health="Healthy") for p in paths]
-        )
+        replicas = int(self.config.get("replicas", 1) or 1)
+        devices = []
+        for p in paths:
+            base = os.path.basename(p)
+            if replicas <= 1:
+                devices.append(pb.Device(ID=base, health="Healthy"))
+            else:
+                devices.extend(
+                    pb.Device(ID=f"{base}-rep{r}", health="Healthy") for r in range(replicas)
+                )
+        return pb.ListAndWatchResponse(devices=devices)
 
     # -- DevicePlugin service -------------------------------------------------
 
@@ -104,9 +119,9 @@ class TPUDevicePlugin:
         with self._sub_lock:
             self._subscribers.append(my_queue)
         try:
-            current = self.discover()
-            self._last_devices = current
-            yield self._device_list(current)
+            # note: _last_devices is owned by health_loop — writing it here
+            # would suppress the publish other subscribers rely on
+            yield self._device_list(self.discover())
             while not self._stop.is_set():
                 try:
                     current = my_queue.get(timeout=0.2)
@@ -133,20 +148,27 @@ class TPUDevicePlugin:
         responses = []
         for creq in request.container_requests:
             ids = list(creq.devicesIDs)
+            # replicated ids (chip sharing) collapse back onto their
+            # physical device node
+            physical = []
+            for dev_id in ids:
+                phys = dev_id.split("-rep")[0]
+                if phys not in physical:
+                    physical.append(phys)
             devices = [
                 pb.DeviceSpec(
                     container_path=f"/dev/{dev_id}",
                     host_path=f"/dev/{dev_id}",
                     permissions="rw",
                 )
-                for dev_id in ids
+                for dev_id in physical
             ]
             mounts = [
                 pb.Mount(container_path=self.install_dir, host_path=self.install_dir, read_only=True)
             ]
             # chip indices come from the device ids themselves (accel2 ->
             # chip 2): the env must match the /dev nodes actually injected
-            chip_ids = [re.sub(r"\D", "", dev_id) or dev_id for dev_id in ids]
+            chip_ids = [re.sub(r"\D", "", dev_id) or dev_id for dev_id in physical]
             envs = {
                 "TPU_VISIBLE_CHIPS": ",".join(chip_ids),
                 "TPU_LIBRARY_PATH": os.path.join(self.install_dir, "libtpu.so"),
@@ -234,6 +256,7 @@ class TPUDevicePlugin:
                 sub.put(devices)
 
     def run_forever(self, kubelet_socket: Optional[str] = None) -> None:
+        self._last_devices = self.discover()
         self.serve()
         self.register(kubelet_socket)
         self.health_loop(kubelet_socket)
@@ -244,6 +267,34 @@ class TPUDevicePlugin:
             self._server.stop(grace=1)
 
 
+def select_plugin_config(client, node_name: str, configmap_name: str, namespace: str, default: str = "") -> dict:
+    """Named-config selection (reference: handleDevicePluginConfig
+    object_controls.go:2355-2466): the ConfigMap holds one entry per named
+    config (YAML); a node opts into one via the PLUGIN_CONFIG_LABEL label,
+    else ``default`` applies. Returns {} when nothing is configured."""
+    import yaml
+
+    if not configmap_name or client is None:
+        return {}
+    cm = client.get_or_none("v1", "ConfigMap", configmap_name, namespace)
+    if cm is None:
+        return {}
+    data = cm.get("data", {}) or {}
+    wanted = default
+    if node_name:
+        node = client.get_or_none("v1", "Node", node_name)
+        if node is not None:
+            wanted = (node["metadata"].get("labels") or {}).get(PLUGIN_CONFIG_LABEL, default)
+    raw = data.get(wanted, "")
+    if not raw:
+        return {}
+    try:
+        return yaml.safe_load(raw) or {}
+    except yaml.YAMLError:
+        log.warning("plugin config %r in %s is invalid YAML", wanted, configmap_name)
+        return {}
+
+
 def _pool():
     from concurrent import futures
 
@@ -252,8 +303,22 @@ def _pool():
 
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
+    config = {}
+    configmap = os.environ.get("PLUGIN_CONFIG_MAP", "")
+    if configmap and os.environ.get("KUBERNETES_SERVICE_HOST"):
+        from tpu_operator.kube.http_client import HttpClient
+
+        config = select_plugin_config(
+            HttpClient.in_cluster(),
+            os.environ.get("NODE_NAME", ""),
+            configmap,
+            os.environ.get("OPERATOR_NAMESPACE", consts.DEFAULT_OPERATOR_NAMESPACE),
+            default=os.environ.get("PLUGIN_CONFIG_DEFAULT", ""),
+        )
+        log.info("plugin config: %s", config or "(none)")
     plugin = TPUDevicePlugin(
-        install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR)
+        install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
+        config=config,
     )
     plugin.run_forever()
     return 0

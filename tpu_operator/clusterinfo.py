@@ -24,13 +24,15 @@ class ClusterInfo:
     tpu_node_count: int = 0
 
 
-def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD) -> ClusterInfo:
+def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD, nodes=None) -> ClusterInfo:
     """Oneshot detection from Node objects (reference: getRuntime
     state_manager.go:714-751 inspects node.status.nodeInfo
-    .containerRuntimeVersion of schedulable nodes)."""
+    .containerRuntimeVersion of schedulable nodes). Pass ``nodes`` (e.g.
+    an informer-cache snapshot) to avoid an apiserver list."""
     from tpu_operator.nodeinfo import is_tpu_node
 
-    nodes = client.list("v1", "Node")
+    if nodes is None:
+        nodes = client.list("v1", "Node")
     runtime = ""
     k8s_version = ""
     is_gke = False

@@ -26,7 +26,7 @@ from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
-from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.kube.objects import ObjectDict, deep_copy
 from tpu_operator.nodeinfo import is_tpu_node
 from tpu_operator.state import StateManager, SyncStates
 from tpu_operator.states import new_cluster_policy_states
@@ -52,6 +52,14 @@ class ClusterPolicyReconciler:
         self.namespace = namespace
         self.state_manager = StateManager(new_cluster_policy_states())
         self.metrics = get_metrics()
+        # wired by setup_with_manager: cache-backed node reads (read-only
+        # snapshots, no apiserver round-trip per reconcile)
+        self.node_informer = None
+
+    def _nodes(self):
+        if self.node_informer is not None and self.node_informer.has_synced():
+            return self.node_informer.cached(copy=False)
+        return self.client.list("v1", "Node")
 
     # -- reconcile -----------------------------------------------------------
 
@@ -71,7 +79,8 @@ class ClusterPolicyReconciler:
 
         # init: re-detect cluster facts + label nodes every reconcile
         # (reference: init() state_manager.go:753-895)
-        info = clusterinfo.detect(self.client, cp.spec.operator.default_runtime)
+        nodes = self._nodes()
+        info = clusterinfo.detect(self.client, cp.spec.operator.default_runtime, nodes=nodes)
         catalog = InfoCatalog(
             cluster_policy=cp,
             namespace=self.namespace,
@@ -152,6 +161,8 @@ class ClusterPolicyReconciler:
         if ns is None:
             return
         labels = ns["metadata"].setdefault("labels", {})
+        annotations = ns["metadata"].setdefault("annotations", {})
+        marker = "tpu.google.com/psa-labels-managed"
         keys = (
             "pod-security.kubernetes.io/enforce",
             "pod-security.kubernetes.io/audit",
@@ -163,12 +174,18 @@ class ClusterPolicyReconciler:
                 if labels.get(k) != "privileged":
                     labels[k] = "privileged"
                     changed = True
-        else:
-            # disabling psa must also revert the privileged posture
+            if annotations.get(marker) != "true":
+                annotations[marker] = "true"
+                changed = True
+        elif annotations.get(marker) == "true":
+            # revert ONLY what the operator wrote (the marker proves it);
+            # admin-set PSA labels are never touched
             for k in keys:
-                if k in labels:
+                if labels.get(k) == "privileged":
                     del labels[k]
                     changed = True
+            del annotations[marker]
+            changed = True
         if changed:
             try:
                 self.client.update(ns)
@@ -189,7 +206,9 @@ class ClusterPolicyReconciler:
         labels from nodes that no longer have TPUs. Existing explicit values
         (e.g. a hand-set \"false\" opt-out) are left alone."""
         enabled_keys = set(self._enabled_operand_keys(cp))
-        for node in self.client.list("v1", "Node"):
+        for cached_node in self._nodes():
+            # cache snapshots are read-only; take a private copy to mutate
+            node = deep_copy(cached_node)
             labels = node["metadata"].setdefault("labels", {})
             changed = False
             if is_tpu_node(node):
@@ -245,7 +264,9 @@ def setup_with_manager(mgr, reconciler: ClusterPolicyReconciler) -> Controller:
         return [Request(name=cp["metadata"]["name"]) for cp in cps]
 
     ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND), predicate=generation_changed)
-    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_all_cps, predicate=node_labels_changed)
+    node_informer = mgr.informer_for("v1", "Node")
+    ctrl.watch(node_informer, mapper=map_to_all_cps, predicate=node_labels_changed)
+    reconciler.node_informer = node_informer
 
     def owned_daemonset(event_type, old, new) -> bool:
         refs = new["metadata"].get("ownerReferences", [])

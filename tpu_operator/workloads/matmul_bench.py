@@ -20,7 +20,7 @@ from math import isfinite as np_isfinite
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def matmul_tflops(size: int = 4096, iters: int = 64) -> dict:
+def matmul_tflops(size: int = 8192, iters: int = 64, unroll: int = 8) -> dict:
     """z = z @ y chained ``iters`` times INSIDE one jitted fori_loop: the
     whole timed region is a single device program, so host dispatch
     latency (large under the remote-relay dev setup) never pollutes the
@@ -33,7 +33,7 @@ def matmul_tflops(size: int = 4096, iters: int = 64) -> dict:
 
     @partial(jax.jit, static_argnames="n")
     def chain(z, y, n):
-        out = lax.fori_loop(0, n, lambda i, acc: acc @ y, z, unroll=4)
+        out = lax.fori_loop(0, n, lambda i, acc: acc @ y, z, unroll=unroll)
         # reduce to a scalar INSIDE the program: fetching it is what forces
         # execution (on relayed dev backends block_until_ready can return
         # before the work actually runs)
