@@ -1,0 +1,44 @@
+# Build/test entrypoints (reference: Makefile + versions.mk targets).
+PYTHON ?= python3
+
+.PHONY: all test unit-test e2e bench golden validate-generated-assets crds render native images clean
+
+all: native test
+
+test: unit-test
+
+unit-test:
+	$(PYTHON) -m pytest tests/ -q
+
+e2e:
+	bash tests/scripts/end-to-end.sh
+
+bench:
+	$(PYTHON) bench.py
+
+golden:
+	$(PYTHON) scripts/update_golden.py
+
+# reference: validate-generated-assets (Makefile:242-245) — golden drift check
+validate-generated-assets:
+	$(PYTHON) -m pytest tests/test_render_states.py -q -k golden
+
+crds:
+	$(PYTHON) -m tpu_operator.cmd.tpuop_cfg generate crds
+
+render:
+	$(PYTHON) -m tpu_operator.cmd.tpuop_cfg render --values deploy/values.yaml
+
+validate:
+	$(PYTHON) scripts/validate_rendered.py
+
+native:
+	$(MAKE) -C native
+
+images:
+	docker build -f docker/Dockerfile -t tpu-operator:dev .
+	docker build -f docker/Dockerfile.validator -t tpu-operator-validator:dev .
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache tests/__pycache__ tpu_operator/__pycache__

@@ -112,6 +112,7 @@ def tpu_details() -> dict:
 def main() -> None:
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
+    scale_64 = bench_install_to_ready(nodes=64)  # 16 slices of v5e-16
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -120,6 +121,7 @@ def main() -> None:
         "runs": [round(r, 3) for r in runs],
         "baseline_s": REFERENCE_READY_BOUND_S,
         "sim_container_start_s": SIM_CONTAINER_START_S,
+        "scale_64node_s": round(scale_64, 3),
         "details": tpu_details(),
     }
     print(json.dumps(out))

@@ -1,0 +1,31 @@
+#!/usr/bin/env python3
+"""Render the chart and lint the ClusterPolicy it produces (the
+gpuop-cfg-in-CI analog, Makefile `validate` target)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml
+
+from tpu_operator.chart import render_chart
+from tpu_operator.cmd.tpuop_cfg import validate_clusterpolicy
+
+
+def main() -> int:
+    with open(os.path.join(os.path.dirname(__file__), "..", "deploy", "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    objs = render_chart(values)
+    cps = [o for o in objs if o.get("kind") == "ClusterPolicy"]
+    problems = [p for cp in cps for p in validate_clusterpolicy(cp)]
+    for p in problems:
+        print(f"INVALID: {p}", file=sys.stderr)
+    if not cps:
+        print("no ClusterPolicy rendered", file=sys.stderr)
+        return 1
+    print(f"rendered chart OK: {len(objs)} objects, {len(cps)} ClusterPolicy")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

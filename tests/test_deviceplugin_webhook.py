@@ -350,3 +350,54 @@ class TestGangEnvIntegration:
         assert dist.num_processes == 4
         assert dist.process_id == 2
         assert dist.coordinator_address.startswith(f"{name}-0.{name}.tpu-operator.svc")
+
+
+class TestPreferredAllocation:
+    def test_contiguous_window_preferred(self, tmp_path):
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["accel0", "accel3", "accel4", "accel5", "accel7"],
+                    allocation_size=3,
+                )
+            ]),
+            None,
+        )
+        assert list(resp.container_responses[0].deviceIDs) == ["accel3", "accel4", "accel5"]
+
+    def test_must_include_respected(self, tmp_path):
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["accel0", "accel1", "accel2", "accel6", "accel7"],
+                    must_include_deviceIDs=["accel7"],
+                    allocation_size=2,
+                )
+            ]),
+            None,
+        )
+        assert "accel7" in list(resp.container_responses[0].deviceIDs)
+
+
+class TestPreferredAllocationContract:
+    def test_fallback_still_includes_musts(self, tmp_path):
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["accel0", "accel1", "accel6", "accel7"],
+                    must_include_deviceIDs=["accel0", "accel7"],
+                    allocation_size=2,
+                )
+            ]),
+            None,
+        )
+        got = list(resp.container_responses[0].deviceIDs)
+        assert set(got) >= {"accel0", "accel7"}
+
+    def test_options_advertise_preferred_allocation(self, tmp_path):
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        opts = plugin.GetDevicePluginOptions(pb.Empty(), None)
+        assert opts.get_preferred_allocation_available is True

@@ -111,7 +111,7 @@ class TPUDevicePlugin:
     # -- DevicePlugin service -------------------------------------------------
 
     def GetDevicePluginOptions(self, request, context):
-        return pb.DevicePluginOptions(pre_start_required=False, get_preferred_allocation_available=False)
+        return pb.DevicePluginOptions(pre_start_required=False, get_preferred_allocation_available=True)
 
     def ListAndWatch(self, request, context):
         """Stream the inventory; re-send whenever it changes."""
@@ -134,12 +134,33 @@ class TPUDevicePlugin:
                     self._subscribers.remove(my_queue)
 
     def GetPreferredAllocation(self, request, context):
-        responses = [
-            pb.ContainerPreferredAllocationResponse(
-                deviceIDs=list(req.available_deviceIDs)[: req.allocation_size]
-            )
-            for req in request.container_requests
-        ]
+        """Prefer ICI-adjacent chips: pick the contiguous window of chip
+        indices with the smallest spread (adjacent indices share ICI links
+        on TPU topologies, so a contiguous gang minimizes hop count)."""
+        responses = []
+        for req in request.container_requests:
+            available = list(req.available_deviceIDs)
+            size = req.allocation_size or len(available)
+            must = list(req.must_include_deviceIDs)
+
+            def chip_index(dev_id: str) -> int:
+                digits = re.sub(r"\D", "", dev_id.split("-rep")[0])
+                return int(digits) if digits else 0
+
+            ordered = sorted(available, key=chip_index)
+            # fallback always satisfies must_include (the contract): musts
+            # first, then nearest remaining chips
+            rest = [d for d in ordered if d not in must]
+            best = (must + rest)[:size]
+            best_spread = None
+            for start in range(0, max(1, len(ordered) - size + 1)):
+                window = ordered[start : start + size]
+                if len(window) < size or not all(m in window for m in must):
+                    continue
+                spread = chip_index(window[-1]) - chip_index(window[0])
+                if best_spread is None or spread < best_spread:
+                    best, best_spread = window, spread
+            responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=best))
         return pb.PreferredAllocationResponse(container_responses=responses)
 
     def Allocate(self, request, context):
@@ -306,15 +327,20 @@ def main() -> int:
     config = {}
     configmap = os.environ.get("PLUGIN_CONFIG_MAP", "")
     if configmap and os.environ.get("KUBERNETES_SERVICE_HOST"):
-        from tpu_operator.kube.http_client import HttpClient
+        try:
+            from tpu_operator.kube.http_client import HttpClient
 
-        config = select_plugin_config(
-            HttpClient.in_cluster(),
-            os.environ.get("NODE_NAME", ""),
-            configmap,
-            os.environ.get("OPERATOR_NAMESPACE", consts.DEFAULT_OPERATOR_NAMESPACE),
-            default=os.environ.get("PLUGIN_CONFIG_DEFAULT", ""),
-        )
+            config = select_plugin_config(
+                HttpClient.in_cluster(),
+                os.environ.get("NODE_NAME", ""),
+                configmap,
+                os.environ.get("OPERATOR_NAMESPACE", consts.DEFAULT_OPERATOR_NAMESPACE),
+                default=os.environ.get("PLUGIN_CONFIG_DEFAULT", ""),
+            )
+        except Exception as e:  # noqa: BLE001 — config is optional: a 403/
+            # network error must degrade to defaults, never crash-loop the
+            # plugin (that would take down TPU scheduling on the node)
+            log.warning("plugin config unavailable (%s); using defaults", e)
         log.info("plugin config: %s", config or "(none)")
     plugin = TPUDevicePlugin(
         install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
