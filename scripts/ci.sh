@@ -3,21 +3,10 @@
 # golden-asset drift targets in one pass).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-echo "== unit tests =="
+echo "== unit tests (includes golden render drift) =="
 python3 -m pytest tests/ -q
-echo "== golden render drift =="
-python3 -m pytest tests/test_render_states.py -q -k golden
 echo "== rendered chart lints clean =="
-python3 -m tpu_operator.cmd.tpuop_cfg render --values deploy/values.yaml > /tmp/ci-render.yaml
-python3 - <<'PY'
-import yaml
-from tpu_operator.cmd.tpuop_cfg import validate_clusterpolicy
-docs = list(yaml.safe_load_all(open("/tmp/ci-render.yaml")))
-cps = [d for d in docs if d and d.get("kind") == "ClusterPolicy"]
-problems = [p for cp in cps for p in validate_clusterpolicy(cp)]
-assert cps and not problems, problems
-print(f"OK ({len(docs)} objects, {len(cps)} ClusterPolicy)")
-PY
+python3 scripts/validate_rendered.py
 echo "== e2e =="
 bash tests/scripts/end-to-end.sh
 echo "CI: PASS"

@@ -198,3 +198,24 @@ class TestOperatorMain:
         args = build_parser().parse_args([])
         with pytest.raises(errors.ApiError, match="not running in a cluster"):
             make_client(args)
+
+
+class TestMultiSliceGang:
+    def test_two_pools_get_distinct_slice_ids(self):
+        client = FakeClient()
+        for i in range(4):
+            node = make_tpu_node(f"a-{i}", "tpu-v5p-slice", "2x2x2",
+                                 nodepool="pool-a" if i < 2 else "pool-b")
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+        agent = SliceManagerAgent(client, NS, multi_slice=True, coordinator_port=8476)
+        names = agent.reconcile_once()
+        assert len(names) == 2  # two v5p 2x2x2 pools (2 hosts each)
+        ids, nums = set(), set()
+        for name in names:
+            cm = client.get("v1", "ConfigMap", f"{name}-gang", NS)
+            ids.add(cm["data"]["MEGASCALE_SLICE_ID"])
+            nums.add(cm["data"]["MEGASCALE_NUM_SLICES"])
+            assert cm["data"]["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8476")
+        assert ids == {"0", "1"}
+        assert nums == {"2"}

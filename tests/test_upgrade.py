@@ -217,3 +217,37 @@ class TestUpgradeTimeout:
         state = mgr.build_state()
         assert state.count(*IN_PROGRESS) == 0
 
+
+
+class TestEvents:
+    def test_cp_state_transition_emits_event(self):
+        client = FakeClient()
+        seed(client, nodes=1)
+        events = client.list("v1", "Event", "default")
+        assert any(e.get("involvedObject", {}).get("kind") == "ClusterPolicy" for e in events), events
+
+    def test_upgrade_transitions_emit_node_events(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=1)
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%", "drain": {"enable": False}}
+        )
+        mgr.apply_state(mgr.build_state(), policy)
+        node_events = [e for e in client.list("v1", "Event", "default")
+                       if e.get("involvedObject", {}).get("kind") == "Node"]
+        assert node_events
+        assert any("cordon-required" in e.get("message", "") for e in node_events)
+
+    def test_repeat_events_aggregate(self):
+        from tpu_operator.kube.events import EventRecorder
+        from tpu_operator.kube.objects import new_object
+
+        client = FakeClient()
+        rec = EventRecorder(client, NS)
+        node = client.create(new_object("v1", "Node", "n0"))
+        rec.normal(node, "Test", "same message")
+        rec.normal(node, "Test", "same message")
+        events = client.list("v1", "Event", "default")
+        assert len(events) == 1 and events[0]["count"] == 2

@@ -160,3 +160,57 @@ class TestNodeMetrics:
         nm = NodeMetrics(ctx)
         nm.revalidate_libtpu()  # libtpu.so absent -> must clear the file
         assert status_files.read_status(consts.LIBTPU_READY_FILE, ctx.validation_dir) is None
+
+
+class TestLibtpuInstaller:
+    def test_install_and_validate_round_trip(self, tmp_path):
+        from tpu_operator.agents import libtpu_installer
+
+        src = tmp_path / "src" / "libtpu.so"
+        src.parent.mkdir()
+        src.write_bytes(b"\x7fELF fake libtpu " + b"x" * 100)
+        install_dir = str(tmp_path / "install")
+        report = libtpu_installer.install(str(src), install_dir, version="1.2.3")
+        assert report["changed"] is True
+        import os
+
+        link = os.path.join(install_dir, "libtpu.so")
+        assert os.path.islink(link)
+        assert os.readlink(link) == "libtpu-1.2.3.so"
+        # the validator's libtpu component now passes against this dir
+        ctx = Context(install_dir=install_dir, validation_dir=str(tmp_path / "val"), retry_interval=0.01)
+        payload = validate_libtpu(ctx)
+        assert payload["size"] > 0
+        # idempotent second run
+        assert libtpu_installer.install(str(src), install_dir, version="1.2.3")["changed"] is False
+
+    def test_version_upgrade_repoints_symlink(self, tmp_path):
+        from tpu_operator.agents import libtpu_installer
+        import os
+
+        src1 = tmp_path / "a.so"; src1.write_bytes(b"v1" * 50)
+        src2 = tmp_path / "b.so"; src2.write_bytes(b"v2" * 50)
+        install_dir = str(tmp_path / "install")
+        libtpu_installer.install(str(src1), install_dir, version="1")
+        libtpu_installer.install(str(src2), install_dir, version="2")
+        assert os.readlink(os.path.join(install_dir, "libtpu.so")) == "libtpu-2.so"
+        with open(os.path.join(install_dir, "version")) as f:
+            assert f.read().strip() == "2"
+
+    def test_explicit_source_takes_priority(self, tmp_path):
+        from tpu_operator.agents import libtpu_installer
+
+        src = tmp_path / "custom-libtpu.so"
+        src.write_bytes(b"custom")
+        # an explicit existing source wins over any bundled library
+        assert libtpu_installer.find_libtpu(str(src)) == str(src)
+        # a missing explicit source falls back to the bundled library (this
+        # image ships one) or raises when nothing exists — both are valid
+        # find_libtpu contracts; just assert it never returns a missing path
+        import os
+
+        try:
+            found = libtpu_installer.find_libtpu("/nonexistent/libtpu.so")
+            assert os.path.exists(found)
+        except FileNotFoundError:
+            pass

@@ -142,6 +142,9 @@ class TPUDevicePlugin:
             available = list(req.available_deviceIDs)
             size = req.allocation_size or len(available)
             must = list(req.must_include_deviceIDs)
+            if not available or size <= 0:
+                responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=must))
+                continue
 
             def chip_index(dev_id: str) -> int:
                 digits = re.sub(r"\D", "", dev_id.split("-rep")[0])

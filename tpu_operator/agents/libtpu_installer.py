@@ -1,0 +1,114 @@
+"""libtpu-installer: the driver-container payload.
+
+Reference: the nvidia driver container (assets/state-driver
+0500_daemonset.yaml `command: ["nvidia-driver"]`) compiles + loads kernel
+modules; libtpu is a userspace library, so the TPU equivalent is an
+atomic versioned install onto the host path that the device plugin mounts
+into workload containers:
+
+  1. locate libtpu.so (LIBTPU_PATH env, the bundled pip package, or an
+     explicit --source)
+  2. copy to <install-dir>/libtpu-<version>.so, atomically repoint the
+     libtpu.so symlink (no torn reads for running pods)
+  3. write the version file + the installer ready marker the validator's
+     libtpu component checks (consts.LIBTPU_CTR_READY_FILE)
+  4. keep running (DaemonSet semantics); the startupProbe checks the
+     marker
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from tpu_operator import consts
+
+log = logging.getLogger(__name__)
+
+
+def find_libtpu(source: Optional[str] = None) -> str:
+    """Resolve the libtpu.so shipped in this image."""
+    candidates = [source, os.environ.get("LIBTPU_PATH")]
+    try:
+        import libtpu  # the pip package bundles the .so
+
+        candidates.append(os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so"))
+    except ImportError:
+        pass
+    candidates.append("/usr/lib/libtpu.so")
+    for path in candidates:
+        if path and os.path.exists(path):
+            return path
+    raise FileNotFoundError(f"no libtpu.so found (checked {[c for c in candidates if c]})")
+
+
+def file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def install(source: str, install_dir: str, version: str = "") -> dict:
+    """Idempotent atomic install; returns a report."""
+    os.makedirs(install_dir, exist_ok=True)
+    digest = file_digest(source)
+    version = version or digest[:12]
+    versioned = os.path.join(install_dir, f"libtpu-{version}.so")
+    link = os.path.join(install_dir, "libtpu.so")
+    changed = False
+    if not os.path.exists(versioned) or file_digest(versioned) != digest:
+        fd, tmp = tempfile.mkstemp(dir=install_dir, prefix=".libtpu-")
+        os.close(fd)
+        shutil.copyfile(source, tmp)
+        os.replace(tmp, versioned)
+        changed = True
+    # atomically repoint the symlink (or replace a plain file from older
+    # installs)
+    tmp_link = os.path.join(install_dir, ".libtpu.so.tmp")
+    try:
+        os.remove(tmp_link)
+    except FileNotFoundError:
+        pass
+    os.symlink(os.path.basename(versioned), tmp_link)
+    os.replace(tmp_link, link)
+    with open(os.path.join(install_dir, "version"), "w") as f:
+        f.write(version + "\n")
+    with open(os.path.join(install_dir, consts.LIBTPU_CTR_READY_FILE), "w") as f:
+        f.write(digest + "\n")
+    log.info("libtpu %s installed at %s (changed=%s)", version, link, changed)
+    return {"version": version, "digest": digest, "path": link, "changed": changed}
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("libtpu-installer")
+    p.add_argument("--install-dir", default=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR))
+    p.add_argument("--source", default=None)
+    p.add_argument("--version", default=os.environ.get("LIBTPU_VERSION", ""))
+    p.add_argument("--oneshot", action="store_true", help="install and exit (tests/manual)")
+    args = p.parse_args(argv)
+    report = install(find_libtpu(args.source), args.install_dir, args.version)
+    log.info("install report: %s", report)
+    if args.oneshot:
+        return 0
+    # DaemonSet long-run: periodically re-verify (self-heal if the host
+    # path is wiped, e.g. node image upgrade)
+    while True:
+        time.sleep(60)
+        try:
+            install(find_libtpu(args.source), args.install_dir, args.version)
+        except (OSError, FileNotFoundError) as e:
+            log.warning("re-verify failed: %s", e)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

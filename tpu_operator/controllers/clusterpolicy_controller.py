@@ -26,6 +26,7 @@ from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.events import EventRecorder
 from tpu_operator.kube.objects import ObjectDict, deep_copy
 from tpu_operator.nodeinfo import is_tpu_node
 from tpu_operator.state import StateManager, SyncStates
@@ -52,6 +53,7 @@ class ClusterPolicyReconciler:
         self.namespace = namespace
         self.state_manager = StateManager(new_cluster_policy_states())
         self.metrics = get_metrics()
+        self.recorder = EventRecorder(client, namespace)
         # wired by setup_with_manager: cache-backed node reads (read-only
         # snapshots, no apiserver round-trip per reconcile)
         self.node_informer = None
@@ -148,10 +150,15 @@ class ClusterPolicyReconciler:
         error: bool = False,
     ) -> None:
         """reference: updateCRState clusterpolicy_controller.go:237."""
+        previous = obj.get("status", {}).get("state")
         publish_status(
             self.client, obj, state, reason, message, error,
             extra={"namespace": self.namespace},
         )
+        if previous != state:
+            # kubectl-describe visibility for every state transition
+            event_type = "Warning" if error else "Normal"
+            self.recorder.event(obj, event_type, reason or state, message or f"state: {state}")
 
     def _apply_psa_labels(self, cp: ClusterPolicy) -> None:
         """Pod Security Admission labels on the operand namespace when

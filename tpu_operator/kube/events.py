@@ -1,0 +1,85 @@
+"""Kubernetes Event recorder.
+
+Reference: the vendored upgrade library and controller-runtime record
+Events against the CR / Nodes (eventRecorder in upgrade_state.go) so
+``kubectl describe`` explains what the operator did and why. Minimal
+recorder: creates/aggregates v1 Events in the operator namespace.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import ObjectDict, new_object
+from tpu_operator.utils import object_hash
+
+log = logging.getLogger(__name__)
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+class EventRecorder:
+    def __init__(self, client: Client, namespace: str, component: str = "tpu-operator"):
+        self.client = client
+        self.namespace = namespace
+        self.component = component
+
+    def event(
+        self,
+        involved: ObjectDict,
+        event_type: str,
+        reason: str,
+        message: str,
+    ) -> Optional[ObjectDict]:
+        """Record one event; repeats of the same (object, reason, message)
+        bump the count instead of piling up objects (apiserver event
+        aggregation semantics)."""
+        ref = {
+            "apiVersion": involved.get("apiVersion", ""),
+            "kind": involved.get("kind", ""),
+            "name": involved.get("metadata", {}).get("name", ""),
+            "namespace": involved.get("metadata", {}).get("namespace", ""),
+            "uid": involved.get("metadata", {}).get("uid", ""),
+        }
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        key = object_hash([ref["kind"], ref["name"], reason, message])
+        name = f"{ref['name'] or 'cluster'}.{key}"[:253]
+        # the apiserver requires event.namespace == involvedObject.namespace
+        # ("default" for cluster-scoped objects whose ref namespace is "")
+        event_ns = ref["namespace"] or "default"
+        existing = self.client.get_or_none("v1", "Event", name, event_ns)
+        try:
+            if existing is not None:
+                existing["count"] = existing.get("count", 1) + 1
+                existing["lastTimestamp"] = now
+                return self.client.update(existing)
+            return self.client.create(
+                new_object(
+                    "v1",
+                    "Event",
+                    name,
+                    event_ns,
+                    involvedObject=ref,
+                    reason=reason,
+                    message=message,
+                    type=event_type,
+                    count=1,
+                    firstTimestamp=now,
+                    lastTimestamp=now,
+                    source={"component": self.component},
+                )
+            )
+        except errors.ApiError as e:  # events are best-effort
+            log.debug("event %s/%s not recorded: %s", reason, name, e)
+            return None
+
+    def normal(self, involved: ObjectDict, reason: str, message: str):
+        return self.event(involved, NORMAL, reason, message)
+
+    def warning(self, involved: ObjectDict, reason: str, message: str):
+        return self.event(involved, WARNING, reason, message)

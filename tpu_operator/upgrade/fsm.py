@@ -92,9 +92,14 @@ class ClusterUpgradeStateManager:
     """reference: ClusterUpgradeStateManager upgrade_state.go:67-101
     (BuildState + ApplyState)."""
 
-    def __init__(self, client: Client, namespace: str):
+    def __init__(self, client: Client, namespace: str, recorder=None):
         self.client = client
         self.namespace = namespace
+        if recorder is None:
+            from tpu_operator.kube.events import EventRecorder
+
+            recorder = EventRecorder(client, namespace)
+        self.recorder = recorder
 
     # -- BuildState ----------------------------------------------------------
 
@@ -286,6 +291,9 @@ class ClusterUpgradeStateManager:
             node_state.state = new_state
             node_state.node = node
             log.info("upgrade: node %s -> %s", node_state.name, new_state)
+            event_type = "Warning" if new_state == UpgradeState.FAILED else "Normal"
+            self.recorder.event(node, event_type, f"LibtpuUpgrade",
+                                f"node {node_state.name}: {new_state}")
         except errors.Conflict:
             pass  # re-planned next pass
 
