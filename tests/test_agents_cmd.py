@@ -219,3 +219,29 @@ class TestMultiSliceGang:
             assert cm["data"]["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8476")
         assert ids == {"0", "1"}
         assert nums == {"2"}
+
+
+class TestSliceProfiles:
+    def test_disabled_profile_skips_family(self):
+        from tpu_operator.kube.objects import new_object
+
+        client = FakeClient()
+        for i in range(4):
+            node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a")
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+        client.create(new_object(
+            "v1", "ConfigMap", "tpu-slice-config", NS,
+            data={"config.yaml": (
+                "version: v1\n"
+                "slice-configs:\n"
+                "  default:\n"
+                "    - accelerator-type: tpu-v5-lite-podslice\n"
+                "      gang: disabled\n"
+            )},
+        ))
+        agent = SliceManagerAgent(client, NS, config_map="tpu-slice-config")
+        assert agent.reconcile_once() == []
+        # and with no profile entry matching, gangs default on
+        client.delete("v1", "ConfigMap", "tpu-slice-config", NS)
+        assert len(agent.reconcile_once()) == 1

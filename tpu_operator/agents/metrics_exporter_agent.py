@@ -17,6 +17,7 @@ DCGM_FI_DEV_*):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -117,3 +118,30 @@ class MetricsExporterAgent:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-metrics-exporter")
+    p.add_argument("--port", type=int, default=None)
+    args = p.parse_args()
+    port = args.port
+    if port is None:
+        # env fallback resolved AFTER flag parsing, tolerantly: a malformed
+        # METRICS_PORT must not crash-loop the exporter
+        try:
+            port = int(os.environ.get("METRICS_PORT", "8431").strip())
+        except ValueError:
+            log.warning("invalid METRICS_PORT %r; using 8431", os.environ.get("METRICS_PORT"))
+            port = 8431
+    MetricsExporterAgent(
+        node_name=os.environ.get("NODE_NAME", ""),
+        port=port,
+    ).run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

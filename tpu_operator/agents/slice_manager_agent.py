@@ -21,6 +21,7 @@ component consumes (workloads/distributed.py).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import List, Optional
 
@@ -44,12 +45,36 @@ class SliceManagerAgent:
         multi_slice: bool = False,
         coordinator_port: int = 8476,
         interval: float = 30.0,
+        config_map: str = "",
     ):
         self.client = client
         self.namespace = namespace
         self.multi_slice = multi_slice
         self.coordinator_port = coordinator_port
         self.interval = interval
+        # named slice profiles (the mig-parted-config analog rendered by
+        # state-slice-manager/0400_configmap.yaml)
+        self.config_map = config_map
+
+    def _load_profile(self) -> dict:
+        """The selected slice profile: {accelerator-type -> gang mode}.
+        Empty dict -> everything defaults to per-slice gangs."""
+        if not self.config_map:
+            return {}
+        cm = self.client.get_or_none("v1", "ConfigMap", self.config_map, self.namespace)
+        if cm is None:
+            return {}
+        import yaml
+
+        try:
+            config = yaml.safe_load((cm.get("data", {}) or {}).get("config.yaml", "")) or {}
+        except yaml.YAMLError:
+            log.warning("slice config %s has invalid YAML", self.config_map)
+            return {}
+        profiles = config.get("slice-configs", {}) or {}
+        selected = (cm.get("data", {}) or {}).get("default", "") or "default"
+        entries = profiles.get(selected, []) or []
+        return {e.get("accelerator-type", "all"): e.get("gang", "per-slice") for e in entries}
 
     # -- reconcile ------------------------------------------------------------
 
@@ -62,11 +87,15 @@ class SliceManagerAgent:
             if (n["metadata"].get("labels") or {}).get(consts.TPU_PRESENT_LABEL) == "true"
         ]
         pools = get_node_pools(nodes)
+        profile = self._load_profile()
         reconciled = []
         slice_names = []
         for index, pool in enumerate(pools):
             if not pool.info.multi_host:
                 continue
+            gang = profile.get(pool.accelerator_type, profile.get("all", "per-slice"))
+            if gang == "disabled":
+                continue  # profile opts this accelerator family out
             name = self._slice_name(pool)
             slice_names.append(name)
             self._apply_service(name)
@@ -157,3 +186,22 @@ class SliceManagerAgent:
             except errors.ApiError as e:
                 log.warning("slice-manager: %s", e)
             time.sleep(self.interval)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    from tpu_operator.kube.http_client import HttpClient
+
+    agent = SliceManagerAgent(
+        HttpClient.in_cluster(),
+        namespace=os.environ.get(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE),
+        multi_slice=os.environ.get("MULTI_SLICE_ENABLED", "").lower() == "true",
+        coordinator_port=int(os.environ.get("COORDINATOR_PORT", "8476")),
+        config_map=os.environ.get("SLICE_CONFIG_MAP", ""),
+    )
+    agent.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

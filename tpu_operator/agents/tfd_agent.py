@@ -92,9 +92,11 @@ def main() -> int:
     if not node_name:
         log.error("NODE_NAME required")
         return 1
-    raise NotImplementedError(
-        "in-cluster transport pending; run TFDAgent with an injected client"
-    )
+    from tpu_operator.kube.http_client import HttpClient
+
+    interval = float(os.environ.get("TFD_SLEEP_INTERVAL", "60"))
+    TFDAgent(HttpClient.in_cluster(), node_name, interval=interval).run_forever()
+    return 0
 
 
 if __name__ == "__main__":
