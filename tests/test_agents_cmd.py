@@ -245,3 +245,49 @@ class TestSliceProfiles:
         # and with no profile entry matching, gangs default on
         client.delete("v1", "ConfigMap", "tpu-slice-config", NS)
         assert len(agent.reconcile_once()) == 1
+
+
+class TestSliceProfileRobustness:
+    def seed_nodes(self, client, pools=("pool-a", "pool-b")):
+        from tpu_operator.kube.objects import new_object
+
+        for pool_i, pool in enumerate(pools):
+            acc = "tpu-v5-lite-podslice" if pool_i == 0 else "tpu-v5p-slice"
+            topo = "4x4" if pool_i == 0 else "2x2x2"
+            for i in range(4 if pool_i == 0 else 2):
+                node = make_tpu_node(f"{pool}-{i}", acc, topo, nodepool=pool)
+                node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+                client.create(node)
+
+    def test_malformed_profile_degrades_to_defaults(self):
+        from tpu_operator.kube.objects import new_object
+
+        client = FakeClient()
+        self.seed_nodes(client, pools=("pool-a",))
+        client.create(new_object(
+            "v1", "ConfigMap", "cfg", NS,
+            data={"config.yaml": "slice-configs:\n  default:\n    gang: disabled\n"},  # mapping, not list
+        ))
+        agent = SliceManagerAgent(client, NS, config_map="cfg")
+        assert len(agent.reconcile_once()) == 1  # degraded to default, no crash
+
+    def test_disabled_family_excluded_from_megascale_count(self):
+        from tpu_operator.kube.objects import new_object
+
+        client = FakeClient()
+        self.seed_nodes(client)
+        client.create(new_object(
+            "v1", "ConfigMap", "cfg", NS,
+            data={"config.yaml": (
+                "slice-configs:\n"
+                "  default:\n"
+                "    - accelerator-type: tpu-v5-lite-podslice\n"
+                "      gang: disabled\n"
+            )},
+        ))
+        agent = SliceManagerAgent(client, NS, multi_slice=True, config_map="cfg")
+        names = agent.reconcile_once()
+        assert len(names) == 1
+        cm = client.get("v1", "ConfigMap", f"{names[0]}-gang", NS)
+        assert cm["data"]["MEGASCALE_NUM_SLICES"] == "1"
+        assert cm["data"]["MEGASCALE_SLICE_ID"] == "0"

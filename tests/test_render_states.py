@@ -215,3 +215,20 @@ class TestStateEngine:
         ds["status"] = {"desiredNumberScheduled": 1, "numberAvailable": 0, "updatedNumberScheduled": 0}
         client.update_status(ds)
         assert mgr.sync_state(client, catalog).status == SyncStates.NOT_READY
+
+
+class TestRenderCache:
+    def test_memoized_and_isolated(self):
+        catalog = make_catalog()
+        state = {s.name: s for s in new_cluster_policy_states()}["state-libtpu"]
+        a = state.render_all(catalog)
+        b = state.render_all(catalog)
+        assert a == b and a is not b
+        # mutating a returned object must not poison the cache
+        b[0]["metadata"]["name"] = "tampered"
+        assert state.render_all(catalog)[0]["metadata"]["name"] != "tampered"
+        # spec change invalidates the cache
+        catalog2 = make_catalog(spec={"libtpu": {"repository": "gcr.io/z", "image": "l", "version": "2"}})
+        c = state.render_all(catalog2)
+        (ds,) = [o for o in c if o["kind"] == "DaemonSet"]
+        assert ds["spec"]["template"]["spec"]["containers"][0]["image"] == "gcr.io/z/l:2"

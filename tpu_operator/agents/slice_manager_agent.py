@@ -71,10 +71,25 @@ class SliceManagerAgent:
         except yaml.YAMLError:
             log.warning("slice config %s has invalid YAML", self.config_map)
             return {}
-        profiles = config.get("slice-configs", {}) or {}
+        # a user-malformed (but parseable) config must degrade to defaults,
+        # never crash-loop the DaemonSet
+        if not isinstance(config, dict):
+            log.warning("slice config %s: config.yaml is not a mapping", self.config_map)
+            return {}
+        profiles = config.get("slice-configs", {})
+        if not isinstance(profiles, dict):
+            log.warning("slice config %s: slice-configs is not a mapping", self.config_map)
+            return {}
         selected = (cm.get("data", {}) or {}).get("default", "") or "default"
-        entries = profiles.get(selected, []) or []
-        return {e.get("accelerator-type", "all"): e.get("gang", "per-slice") for e in entries}
+        entries = profiles.get(selected, [])
+        if not isinstance(entries, list):
+            log.warning("slice config %s: profile %r is not a list", self.config_map, selected)
+            return {}
+        return {
+            e.get("accelerator-type", "all"): e.get("gang", "per-slice")
+            for e in entries
+            if isinstance(e, dict)
+        }
 
     # -- reconcile ------------------------------------------------------------
 
@@ -88,21 +103,23 @@ class SliceManagerAgent:
         ]
         pools = get_node_pools(nodes)
         profile = self._load_profile()
-        reconciled = []
-        slice_names = []
-        for index, pool in enumerate(pools):
-            if not pool.info.multi_host:
-                continue
+
+        def participates(pool) -> bool:
             gang = profile.get(pool.accelerator_type, profile.get("all", "per-slice"))
-            if gang == "disabled":
-                continue  # profile opts this accelerator family out
+            return pool.info.multi_host and gang != "disabled"
+
+        # slice ids/count must enumerate only PARTICIPATING slices: a DCN
+        # mesh sized over disabled pools would wait forever for slices
+        # that never join
+        active = [p for p in pools if participates(p)]
+        reconciled = []
+        for index, pool in enumerate(active):
             name = self._slice_name(pool)
-            slice_names.append(name)
             self._apply_service(name)
-            self._apply_gang_configmap(name, pool, slice_index=index, total_slices=len(pools))
+            self._apply_gang_configmap(name, pool, slice_index=index, total_slices=len(active))
             self._apply_worker_ids(pool)
             reconciled.append(name)
-        self._cleanup_stale(slice_names)
+        self._cleanup_stale(reconciled)
         return reconciled
 
     @staticmethod
@@ -188,6 +205,14 @@ class SliceManagerAgent:
             time.sleep(self.interval)
 
 
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)).strip())
+    except ValueError:
+        log.warning("invalid %s %r; using %d", name, os.environ.get(name), default)
+        return default
+
+
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
     from tpu_operator.kube.http_client import HttpClient
@@ -196,7 +221,7 @@ def main() -> int:
         HttpClient.in_cluster(),
         namespace=os.environ.get(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE),
         multi_slice=os.environ.get("MULTI_SLICE_ENABLED", "").lower() == "true",
-        coordinator_port=int(os.environ.get("COORDINATOR_PORT", "8476")),
+        coordinator_port=_int_env("COORDINATOR_PORT", 8476),
         config_map=os.environ.get("SLICE_CONFIG_MAP", ""),
     )
     agent.run_forever()

@@ -94,7 +94,11 @@ def main() -> int:
         return 1
     from tpu_operator.kube.http_client import HttpClient
 
-    interval = float(os.environ.get("TFD_SLEEP_INTERVAL", "60"))
+    try:
+        interval = float(os.environ.get("TFD_SLEEP_INTERVAL", "60").strip())
+    except ValueError:
+        log.warning("invalid TFD_SLEEP_INTERVAL %r; using 60s", os.environ.get("TFD_SLEEP_INTERVAL"))
+        interval = 60.0
     TFDAgent(HttpClient.in_cluster(), node_name, interval=interval).run_forever()
     return 0
 
