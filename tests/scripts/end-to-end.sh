@@ -51,6 +51,28 @@ from tpu_operator.workloads.smoke import run_smoke
 report = run_smoke()
 print(f"STEP 2 OK: TPU workload pass ({report['device_count']} {report['platform']} device(s))")
 
+# 2b. gang placement: the slice manager materializes the full multi-host
+# contract — worker pods resolvable at every TPU_WORKER_HOSTNAMES entry,
+# and a coordinator Service behind MEGASCALE_COORDINATOR_ADDRESS
+from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+sm = SliceManagerAgent(client, NS, multi_slice=True, validator_image="tpu-operator-validator:e2e")
+slice_names = sm.reconcile_once()
+assert slice_names, "no multi-host slices reconciled"
+gang_cm = client.get("v1", "ConfigMap", f"{slice_names[0]}-gang", NS)
+hostnames = gang_cm["data"]["TPU_WORKER_HOSTNAMES"].split(",")
+pods = {p["metadata"]["name"]: p for p in client.list("v1", "Pod", NS)
+        if (p["metadata"].get("labels") or {}).get("app") == "tpu-slice-worker"}
+assert len(pods) == len(hostnames) == 4, (len(pods), len(hostnames))
+for entry in hostnames:
+    host, svc = entry.split(".")[:2]
+    pod = pods[host]
+    assert pod["spec"]["hostname"] == host and pod["spec"]["subdomain"] == svc
+    service = client.get("v1", "Service", svc, NS)
+    assert all(pod["metadata"]["labels"].get(k) == v for k, v in service["spec"]["selector"].items())
+coord_host = gang_cm["data"]["MEGASCALE_COORDINATOR_ADDRESS"].rsplit(":", 1)[0]
+assert client.get("v1", "Service", coord_host.split(".")[0], NS) is not None
+print(f"STEP 2b OK: gang placement ({len(pods)} worker pods, coordinator Service resolvable)")
+
 # 3. live update: bump libtpu version, expect DS re-render
 obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
 obj["spec"].setdefault("libtpu", {}).update({"repository": "gcr.io/new", "image": "libtpu", "version": "9.9"})

@@ -12,6 +12,13 @@ slice needs (reference concept: state-mig-manager + the per-node
     address (MEGASCALE_COORDINATOR_ADDRESS, BASELINE config 5)
   - per-node worker identity labels (tpu.google.com/worker-id) mirroring
     the reference's per-node config label reconciliation
+  - the gang itself: one COMPONENT=slice validator worker pod per host
+    (manifests/slice-gang/0100_worker_pod.yaml), hostname ``<slice>-<i>``
+    + subdomain ``<slice>`` so every TPU_WORKER_HOSTNAMES entry resolves
+    through the headless Service (reference analog: Plugin.runWorkload
+    validator/main.go:941-1028, gang-sized)
+  - for multi-slice, the DCN coordinator Service the gang env advertises,
+    selecting worker 0 of the first active slice
 
 Workload pods join a slice gang by mounting the ConfigMap and using the
 headless Service DNS — which is exactly what the validator's slice
@@ -30,11 +37,20 @@ from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.objects import new_object
 from tpu_operator.nodepool import NodePool, get_node_pools
+from tpu_operator.render import Renderer
+from tpu_operator.utils import object_hash
 
 log = logging.getLogger(__name__)
 
 WORKER_ID_LABEL = "tpu.google.com/worker-id"
+SLICE_LABEL = "tpu.google.com/slice"
 SLICE_SERVICE_PREFIX = "tpu-slice"
+GANG_HASH_ANNOTATION = "tpu.google.com/gang-hash"
+MANAGED_BY = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+
+GANG_MANIFEST_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "manifests", "slice-gang"
+)
 
 
 class SliceManagerAgent:
@@ -46,6 +62,9 @@ class SliceManagerAgent:
         coordinator_port: int = 8476,
         interval: float = 30.0,
         config_map: str = "",
+        validator_image: str = "tpu-operator-validator",
+        image_pull_policy: str = "IfNotPresent",
+        validation_dir: str = consts.VALIDATION_DIR,
     ):
         self.client = client
         self.namespace = namespace
@@ -55,6 +74,10 @@ class SliceManagerAgent:
         # named slice profiles (the mig-parted-config analog rendered by
         # state-slice-manager/0400_configmap.yaml)
         self.config_map = config_map
+        self.validator_image = validator_image
+        self.image_pull_policy = image_pull_policy
+        self.validation_dir = validation_dir
+        self._renderer = Renderer([GANG_MANIFEST_DIR])
 
     def _load_profile(self) -> dict:
         """The selected slice profile: {accelerator-type -> gang mode}.
@@ -112,19 +135,43 @@ class SliceManagerAgent:
         # mesh sized over disabled pools would wait forever for slices
         # that never join
         active = [p for p in pools if participates(p)]
+        coordinator = self._coordinator_name(active) if self.multi_slice else ""
         reconciled = []
+        gang_pods: List[str] = []
         for index, pool in enumerate(active):
             name = self._slice_name(pool)
             self._apply_service(name)
-            self._apply_gang_configmap(name, pool, slice_index=index, total_slices=len(active))
+            self._apply_gang_configmap(
+                name, pool, slice_index=index, total_slices=len(active), coordinator=coordinator
+            )
             self._apply_worker_ids(pool)
+            gang_pods.extend(self._apply_gang_pods(name, pool))
             reconciled.append(name)
-        self._cleanup_stale(reconciled)
+        if coordinator and active:
+            self._apply_coordinator_service(coordinator, self._slice_name(active[0]))
+        self._cleanup_stale(reconciled, gang_pods, coordinator)
         return reconciled
 
     @staticmethod
     def _slice_name(pool: NodePool) -> str:
-        return f"{SLICE_SERVICE_PREFIX}-{pool.name}"[:63].rstrip("-")
+        # leave room for "-<worker id>" pod/hostname suffixes within the
+        # 63-char DNS label limit; long names get a content-hash suffix so
+        # two pools differing only past the cut never collide (same scheme
+        # as states/tpuslice_state._dns_safe)
+        name = f"{SLICE_SERVICE_PREFIX}-{pool.name}"
+        if len(name) <= 58:
+            return name.rstrip("-")
+        return f"{name[:49].rstrip('-')}-{object_hash(pool.name)[:8]}"
+
+    @staticmethod
+    def _coordinator_name(active: List[NodePool]) -> str:
+        """DCN coordinator Service name, derived from the first ACTIVE
+        slice (slice 0 of the megascale mesh) so the advertised address
+        always matches a Service this agent creates."""
+        if not active:
+            return ""
+        first = SliceManagerAgent._slice_name(active[0])
+        return f"{first}-coord"[:63].rstrip("-")
 
     def _apply_service(self, name: str) -> None:
         svc = new_object(
@@ -132,16 +179,74 @@ class SliceManagerAgent:
             "Service",
             name,
             self.namespace,
-            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            labels=dict(MANAGED_BY),
             spec={
                 "clusterIP": "None",  # headless: per-worker DNS
-                "selector": {"tpu.google.com/slice": name},
+                "selector": {SLICE_LABEL: name},
                 "ports": [{"name": "coordinator", "port": self.coordinator_port}],
             },
         )
         self.client.apply(svc)
 
-    def _apply_gang_configmap(self, name: str, pool: NodePool, slice_index: int, total_slices: int) -> None:
+    def _apply_coordinator_service(self, name: str, slice0: str) -> None:
+        """The multi-slice DCN coordinator: a stable ClusterIP in front of
+        slice 0's worker 0 (the megascale coordinator process)."""
+        svc = new_object(
+            "v1",
+            "Service",
+            name,
+            self.namespace,
+            labels=dict(MANAGED_BY),
+            spec={
+                "selector": {SLICE_LABEL: slice0, WORKER_ID_LABEL: "0"},
+                "ports": [{"name": "coordinator", "port": self.coordinator_port}],
+            },
+        )
+        self.client.apply(svc)
+
+    def _apply_gang_pods(self, name: str, pool: NodePool) -> List[str]:
+        """One COMPONENT=slice worker pod per host of the slice, scheduled
+        through the scheduler (hostname nodeSelector + TPU resource limit)
+        and resolvable as ``<name>-<i>.<name>.<ns>.svc`` via the headless
+        Service. Pods are effectively immutable, so spec changes are
+        rolled by delete+create, gated on a rendered-spec hash."""
+        objs = self._renderer.render_objects(
+            {
+                "slice_name": name,
+                "workers": [
+                    {"worker_id": i, "node_name": n} for i, n in enumerate(pool.node_names)
+                ],
+                "namespace": self.namespace,
+                "validator_image": self.validator_image,
+                "image_pull_policy": self.image_pull_policy,
+                "tpu_resource": consts.TPU_RESOURCE_NAME,
+                "chips_per_host": pool.info.chips_per_node,
+                "coordinator_port": self.coordinator_port,
+                "validation_dir": self.validation_dir,
+            }
+        )
+        created = []
+        for pod in objs:
+            spec_hash = object_hash(pod)
+            pod["metadata"].setdefault("annotations", {})[GANG_HASH_ANNOTATION] = spec_hash
+            pod_name = pod["metadata"]["name"]
+            existing = self.client.get_or_none("v1", "Pod", pod_name, self.namespace)
+            if existing is not None:
+                old = (existing["metadata"].get("annotations") or {}).get(GANG_HASH_ANNOTATION)
+                if old == spec_hash:
+                    created.append(pod_name)
+                    continue
+                self.client.delete("v1", "Pod", pod_name, self.namespace)
+            try:
+                self.client.create(pod)
+            except (errors.Conflict, errors.AlreadyExists):
+                pass  # another host's agent won the race; converged either way
+            created.append(pod_name)
+        return created
+
+    def _apply_gang_configmap(
+        self, name: str, pool: NodePool, slice_index: int, total_slices: int, coordinator: str = ""
+    ) -> None:
         hostnames = ",".join(
             f"{name}-{i}.{name}.{self.namespace}.svc" for i in range(len(pool.node_names))
         )
@@ -152,11 +257,11 @@ class SliceManagerAgent:
             "TPU_SLICE_HOSTS": str(pool.info.slice_hosts),
             "TPU_CHIPS_PER_HOST": str(pool.info.chips_per_node),
         }
-        if self.multi_slice:
-            # slice 0's worker 0 coordinates the DCN mesh
-            first = f"{SLICE_SERVICE_PREFIX}-slice0-coordinator"
+        if self.multi_slice and coordinator:
+            # slice 0's worker 0 coordinates the DCN mesh, fronted by the
+            # coordinator Service this same reconcile creates
             data["MEGASCALE_COORDINATOR_ADDRESS"] = (
-                f"{first}.{self.namespace}.svc:{self.coordinator_port}"
+                f"{coordinator}.{self.namespace}.svc:{self.coordinator_port}"
             )
             data["MEGASCALE_NUM_SLICES"] = str(total_slices)
             data["MEGASCALE_SLICE_ID"] = str(slice_index)
@@ -165,7 +270,7 @@ class SliceManagerAgent:
             "ConfigMap",
             f"{name}-gang",
             self.namespace,
-            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            labels=dict(MANAGED_BY),
             data=data,
         )
         self.client.apply(cm)
@@ -186,15 +291,21 @@ class SliceManagerAgent:
                 except errors.Conflict:
                     pass
 
-    def _cleanup_stale(self, live_names: List[str]) -> None:
-        selector = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
-        for svc in self.client.list("v1", "Service", self.namespace, label_selector=selector):
-            if svc["metadata"]["name"] not in live_names:
+    def _cleanup_stale(
+        self, live_names: List[str], live_pods: Optional[List[str]] = None, coordinator: str = ""
+    ) -> None:
+        live_services = set(live_names) | ({coordinator} if coordinator else set())
+        for svc in self.client.list("v1", "Service", self.namespace, label_selector=MANAGED_BY):
+            if svc["metadata"]["name"] not in live_services:
                 self.client.delete("v1", "Service", svc["metadata"]["name"], self.namespace)
         live_cms = {f"{n}-gang" for n in live_names}
-        for cm in self.client.list("v1", "ConfigMap", self.namespace, label_selector=selector):
+        for cm in self.client.list("v1", "ConfigMap", self.namespace, label_selector=MANAGED_BY):
             if cm["metadata"]["name"] not in live_cms:
                 self.client.delete("v1", "ConfigMap", cm["metadata"]["name"], self.namespace)
+        live_pod_set = set(live_pods or [])
+        for pod in self.client.list("v1", "Pod", self.namespace, label_selector=MANAGED_BY):
+            if pod["metadata"]["name"] not in live_pod_set:
+                self.client.delete("v1", "Pod", pod["metadata"]["name"], self.namespace)
 
     def run_forever(self) -> None:
         while True:
@@ -223,6 +334,9 @@ def main() -> int:
         multi_slice=os.environ.get("MULTI_SLICE_ENABLED", "").lower() == "true",
         coordinator_port=_int_env("COORDINATOR_PORT", 8476),
         config_map=os.environ.get("SLICE_CONFIG_MAP", ""),
+        validator_image=os.environ.get("VALIDATOR_IMAGE", "tpu-operator-validator"),
+        image_pull_policy=os.environ.get("VALIDATOR_IMAGE_PULL_POLICY", "IfNotPresent"),
+        validation_dir=os.environ.get("VALIDATION_DIR", consts.VALIDATION_DIR),
     )
     agent.run_forever()
     return 0
