@@ -27,6 +27,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# ensure the 8-device virtual CPU mesh is available for the multichip
+# details block (must happen before any backend initialization; same
+# recipe as tests/conftest.py)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
 REFERENCE_READY_BOUND_S = 900.0  # tests/e2e/gpu_operator_test.go:137
 SIM_CONTAINER_START_S = 0.25  # simulated image-pull/container-start latency
 
@@ -100,13 +107,50 @@ def tpu_details() -> dict:
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
         if gen in PEAK_TFLOPS:
             details["mxu_utilization_pct"] = round(100 * mm["tflops"] / PEAK_TFLOPS[gen], 1)
-        from tpu_operator.workloads.allreduce import run_allreduce
+        if platform != "cpu":
+            from tpu_operator.workloads.allreduce import run_allreduce
 
-        ar = run_allreduce(sizes_mb=(16,), iters=10)
-        details["allreduce_busbw_gbps_per_chip"] = round(ar["peak_busbw_gbps_per_chip"], 2)
+            ar = run_allreduce(sizes_mb=(16,), iters=10)
+            if ar["devices"] > 1:
+                details["allreduce_busbw_gbps_per_chip"] = round(
+                    ar["peak_busbw_gbps_per_chip"], 2
+                )
+            else:
+                # a single-chip psum proves the collective lowers and runs,
+                # but measures dispatch latency, not an interconnect — never
+                # report it beside real bandwidth numbers
+                details["allreduce"] = {k: ar[k] for k in ("devices", "correctness_only")}
+        # on CPU-only hosts the virtual mesh below owns the (fake-device)
+        # collective measurement
+        details["multichip_virtual_mesh"] = _virtual_mesh_details()
     except Exception as e:  # noqa: BLE001 — details are best-effort
         details["device_error"] = str(e)
     return details
+
+
+def _virtual_mesh_details() -> dict:
+    """The multi-chip sharding path exercised on the 8-device virtual CPU
+    mesh (xla_force_host_platform_device_count): psum allreduce + ring
+    attention exactness. Bandwidth here is host-memory movement on fake
+    devices — reported to show the path runs, never as an ICI number."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    cpu = jax.devices("cpu")
+    if len(cpu) < 8:
+        return {"skipped": f"only {len(cpu)} cpu devices"}
+    from tpu_operator.workloads.allreduce import run_allreduce
+    from tpu_operator.workloads.ringattention import run_ring_attention_check
+
+    ar = run_allreduce(sizes_mb=(4,), devices=cpu[:8], iters=5)
+    ring = run_ring_attention_check(mesh=Mesh(np.array(cpu[:8]), ("sp",)))
+    return {
+        "note": "8 virtual CPU devices; validates sharding/collectives, not ICI",
+        "devices": 8,
+        "psum_busbw_gbps_per_chip": round(ar["peak_busbw_gbps_per_chip"], 2),
+        "ring_attention_max_abs_err": float(ring["max_abs_err"]),
+    }
 
 
 def main() -> None:
@@ -118,6 +162,11 @@ def main() -> None:
         "value": round(value, 3),
         "unit": "s",
         "vs_baseline": round(REFERENCE_READY_BOUND_S / value, 1),
+        # the baseline is the reference's CI bound on real hardware; this
+        # run isolates operator overhead on a sim apiserver with a 0.25 s
+        # container start, so the ratio is an overhead isolate, not a
+        # hardware-for-hardware comparison
+        "vs_baseline_kind": "operator_overhead_isolate",
         "runs": [round(r, 3) for r in runs],
         "baseline_s": REFERENCE_READY_BOUND_S,
         "sim_container_start_s": SIM_CONTAINER_START_S,

@@ -103,5 +103,8 @@ def run_allreduce(
         "platform": devices[0].platform,
         "results": results,
         "peak_busbw_gbps_per_chip": best_busbw,
+        # a 1-device "allreduce" is a self-psum: it validates the collective
+        # lowering and measures dispatch latency, never an interconnect
+        "correctness_only": n == 1,
         "ok": True,
     }
