@@ -1,7 +1,7 @@
 # Build/test entrypoints (reference: Makefile + versions.mk targets).
 PYTHON ?= python3
 
-.PHONY: all test unit-test e2e bench golden validate-generated-assets crds render native images clean
+.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render native images clean
 
 all: native test
 
@@ -18,6 +18,14 @@ bench:
 
 golden:
 	$(PYTHON) scripts/update_golden.py
+
+# regenerate the Helm chart's crds/ from the API definitions
+chart-crds:
+	$(PYTHON) scripts/update_chart_crds.py
+
+# verify the Helm chart renders identically to the tpuop-cfg render path
+chart-verify:
+	$(PYTHON) -m pytest tests/test_helm_chart.py -q
 
 # reference: validate-generated-assets (Makefile:242-245) — golden drift check
 validate-generated-assets:

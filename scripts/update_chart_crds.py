@@ -1,0 +1,36 @@
+#!/usr/bin/env python3
+"""Regenerate the Helm chart's crds/ from the API definitions (the chart
+ships CRDs alongside templates like the reference's
+deployments/gpu-operator/crds/). tests/test_helm_chart.py asserts drift."""
+
+import os
+import sys
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tpu_operator.api.crds import all_crds  # noqa: E402
+
+CRD_DIR = os.path.join(ROOT, "deploy", "helm", "tpu-operator", "crds")
+
+
+def main() -> None:
+    os.makedirs(CRD_DIR, exist_ok=True)
+    expected = set()
+    for crd in all_crds():
+        name = crd["metadata"]["name"].split(".")[0] + ".yaml"
+        expected.add(name)
+        path = os.path.join(CRD_DIR, name)
+        with open(path, "w") as f:
+            yaml.safe_dump(crd, f, default_flow_style=False, sort_keys=False)
+        print(f"wrote {path}")
+    on_disk = {n for n in os.listdir(CRD_DIR) if n.endswith((".yaml", ".yml"))}
+    for stale in on_disk - expected:
+        os.unlink(os.path.join(CRD_DIR, stale))
+        print(f"removed stale {stale}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,15 +13,26 @@ from __future__ import annotations
 import os
 from typing import List
 
+import yaml
+
 from tpu_operator.api.common import ImageSpec
 from tpu_operator.api.crds import all_crds
 from tpu_operator.render import Renderer
+from tpu_operator.utils import deep_merge
 
 CHART_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "deploy")
 
 
 def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
-    """CRDs first (like helm's crds/ handling), then templated objects."""
+    """CRDs first (like helm's crds/ handling), then templated objects.
+
+    User values deep-merge over the chart's default values.yaml — helm
+    semantics — so a partial overrides file produces the same install
+    through this path and through ``helm install -f``."""
+    defaults_file = os.path.join(chart_dir, "values.yaml")
+    if os.path.exists(defaults_file):
+        with open(defaults_file) as f:
+            values = deep_merge(yaml.safe_load(f) or {}, values or {})
     operator = dict(
         {
             "repository": "gcr.io/tpu-operator",

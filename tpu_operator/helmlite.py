@@ -1,0 +1,316 @@
+"""helmlite: a minimal Go-template (Helm) renderer for chart verification.
+
+The shipped Helm chart (``deploy/helm/tpu-operator``) is the user-facing
+install path (reference: ``deployments/gpu-operator`` — Chart.yaml,
+templates/operator.yaml, crds/). This environment carries no ``helm``
+binary, so CI proves the chart correct by rendering it with this engine
+and asserting object-for-object parity with ``chart.render_chart()``
+(see tests/test_helm_chart.py).
+
+The engine implements exactly the text/template + sprig subset the chart
+uses — actions with trim markers, ``.Values``/``.Release`` paths,
+``if``/``else``/``end``, pipelines, and the functions listed in
+``_FUNCTIONS`` — and *raises* on anything else, so a chart edit that
+outgrows the verifier fails loudly instead of silently diverging from
+what real helm would render. Semantics follow Go:
+
+  - ``{{-``/``-}}`` trim all adjacent whitespace including newlines
+  - missing map keys evaluate to None (render as empty, falsey in ``if``)
+  - truthiness: nil/false/0/""/empty collection are false
+  - ``toYaml`` marshals with sorted keys (sigs.k8s.io/yaml behavior)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.utils import deep_merge
+
+
+class HelmliteError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# functions (sprig subset)
+# ---------------------------------------------------------------------------
+
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v != 0
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    return True
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=True).rstrip("\n")
+
+
+def _gostr(v: Any) -> str:
+    """Stringify the way Go's text/template prints values: booleans are
+    lowercase, nil is empty."""
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+def _indent(n: Any, s: Any) -> str:
+    pad = " " * int(n)
+    return "\n".join(pad + line for line in _gostr(s).splitlines())
+
+
+_FUNCTIONS = {
+    "toYaml": _to_yaml,
+    "indent": _indent,
+    "nindent": lambda n, s: "\n" + _indent(n, s),
+    "quote": lambda v: '"%s"' % _gostr(v).replace("\\", "\\\\").replace('"', '\\"'),
+    "default": lambda d, v=None: v if _truthy(v) else d,
+    "hasPrefix": lambda prefix, s: str(s).startswith(str(prefix)),
+    "not": lambda v: not _truthy(v),
+    "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1]),
+    "or": lambda *a: next((x for x in a if _truthy(x)), a[-1]),
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+}
+
+
+# ---------------------------------------------------------------------------
+# lexer / parser
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+def _lex(source: str) -> List[Tuple[str, str]]:
+    """Split into ('text', s) and ('action', body) tokens with Go trim
+    semantics applied to the surrounding text."""
+    tokens: List[Tuple[str, str]] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(source):
+        text = source[pos : m.start()]
+        if m.group(1) == "-":
+            text = text.rstrip()
+        tokens.append(("text", text))
+        tokens.append(("action", m.group(2)))
+        pos = m.end()
+        if m.group(3) == "-":
+            # trim leading whitespace of the following text
+            rest = source[pos:]
+            stripped = rest.lstrip()
+            pos += len(rest) - len(stripped)
+    tokens.append(("text", source[pos:]))
+    return [t for t in tokens if t[0] == "action" or t[1]]
+
+
+class _Node:
+    pass
+
+
+class _Text(_Node):
+    def __init__(self, s: str):
+        self.s = s
+
+
+class _Expr(_Node):
+    def __init__(self, pipeline: str):
+        self.pipeline = pipeline
+
+
+class _If(_Node):
+    def __init__(self):
+        # list of (condition-pipeline or None for else, body nodes)
+        self.branches: List[Tuple[Optional[str], List[_Node]]] = []
+
+
+def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False):
+    nodes: List[_Node] = []
+    while i < len(tokens):
+        kind, body = tokens[i]
+        if kind == "text":
+            nodes.append(_Text(body))
+            i += 1
+            continue
+        if body.startswith("/*"):
+            i += 1
+            continue
+        word = body.split(None, 1)[0] if body else ""
+        if word == "if":
+            node = _If()
+            cond = body[2:].strip()
+            while True:
+                sub, i, term = _parse(tokens, i + 1, in_block=True)
+                node.branches.append((cond, sub))
+                if term == "end":
+                    break
+                if term == "else":
+                    # bare else: final branch with condition None
+                    sub, i, term2 = _parse(tokens, i + 1, in_block=True)
+                    node.branches.append((None, sub))
+                    if term2 != "end":
+                        raise HelmliteError(f"expected end after else, got {term2}")
+                    break
+                if term.startswith("else if"):
+                    cond = term[len("else if") :].strip()
+                    continue
+                raise HelmliteError(f"unexpected block terminator {term!r}")
+            nodes.append(node)
+            i += 1
+            continue
+        if word in ("end", "else") or body.startswith("else if"):
+            if not in_block:
+                raise HelmliteError(f"unexpected {body!r} outside a block")
+            return nodes, i, body
+        if word in ("range", "with", "define", "template", "include", "block"):
+            raise HelmliteError(
+                f"helmlite does not implement {word!r} — extend _FUNCTIONS/_parse "
+                "(and re-check against real helm) before using it in the chart"
+            )
+        nodes.append(_Expr(body))
+        i += 1
+    if in_block:
+        raise HelmliteError("unterminated block (missing {{ end }})")
+    return nodes, i, ""
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r'"(?:[^"\\]|\\.)*"|\S+')
+
+
+def _eval_atom(tok: str, ctx: Dict[str, Any]) -> Any:
+    if tok.startswith('"') and tok.endswith('"'):
+        return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if tok in ("true", "false"):
+        return tok == "true"
+    if tok in ("nil", "null"):
+        return None
+    if re.fullmatch(r"-?\d+", tok):
+        return int(tok)
+    if re.fullmatch(r"-?\d+\.\d+", tok):
+        return float(tok)
+    if tok == ".":
+        return ctx
+    if tok.startswith("."):
+        cur: Any = ctx
+        for part in tok[1:].split("."):
+            if not part:
+                raise HelmliteError(f"bad path {tok!r}")
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = None
+            if cur is None:
+                return None
+        return cur
+    raise HelmliteError(f"cannot evaluate {tok!r}")
+
+
+def _eval_segment(tokens: List[str], ctx: Dict[str, Any], piped: Any = ...) -> Any:
+    head = tokens[0]
+    if head in _FUNCTIONS:
+        args = [_eval_atom(t, ctx) for t in tokens[1:]]
+        if piped is not ...:
+            args.append(piped)
+        return _FUNCTIONS[head](*args)
+    if len(tokens) != 1 or piped is not ...:
+        raise HelmliteError(f"unknown function {head!r}")
+    return _eval_atom(head, ctx)
+
+
+def _eval_pipeline(pipeline: str, ctx: Dict[str, Any]) -> Any:
+    value: Any = ...
+    for segment in pipeline.split("|"):
+        tokens = _TOKEN_RE.findall(segment.strip())
+        if not tokens:
+            raise HelmliteError(f"empty pipeline segment in {pipeline!r}")
+        value = _eval_segment(tokens, ctx, value)
+    return value
+
+
+def _render_nodes(nodes: List[_Node], ctx: Dict[str, Any]) -> str:
+    out: List[str] = []
+    for node in nodes:
+        if isinstance(node, _Text):
+            out.append(node.s)
+        elif isinstance(node, _Expr):
+            out.append(_gostr(_eval_pipeline(node.pipeline, ctx)))
+        elif isinstance(node, _If):
+            for cond, body in node.branches:
+                if cond is None or _truthy(_eval_pipeline(cond, ctx)):
+                    out.append(_render_nodes(body, ctx))
+                    break
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# chart rendering
+# ---------------------------------------------------------------------------
+
+
+def render_string(source: str, ctx: Dict[str, Any]) -> str:
+    nodes, _, _ = _parse(_lex(source))
+    return _render_nodes(nodes, ctx)
+
+
+def template(
+    chart_dir: str,
+    values: Optional[dict] = None,
+    release_name: str = "tpu-operator",
+    namespace: str = "default",
+) -> List[ObjectDict]:
+    """``helm template`` equivalent: chart default values deep-merged with
+    overrides, crds/ emitted first (helm installs them before templates),
+    then every templates/*.yaml in lexical order."""
+    values_file = os.path.join(chart_dir, "values.yaml")
+    with open(values_file) as f:
+        defaults = yaml.safe_load(f) or {}
+    merged = deep_merge(defaults, values or {})
+    chart_meta = {}
+    chart_yaml = os.path.join(chart_dir, "Chart.yaml")
+    if os.path.exists(chart_yaml):
+        with open(chart_yaml) as f:
+            chart_meta = yaml.safe_load(f) or {}
+    ctx = {
+        "Values": merged,
+        "Release": {"Name": release_name, "Namespace": namespace, "Service": "Helm"},
+        "Chart": {"Name": chart_meta.get("name", ""), "Version": chart_meta.get("version", "")},
+    }
+    objects: List[ObjectDict] = []
+    crd_dir = os.path.join(chart_dir, "crds")
+    if os.path.isdir(crd_dir):
+        for name in sorted(os.listdir(crd_dir)):
+            if not name.endswith((".yaml", ".yml")):
+                continue
+            with open(os.path.join(crd_dir, name)) as f:
+                objects.extend(d for d in yaml.safe_load_all(f) if d)
+    tmpl_dir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(tmpl_dir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tmpl_dir, name)) as f:
+            source = f.read()
+        try:
+            text = render_string(source, ctx)
+        except HelmliteError as e:
+            raise HelmliteError(f"{name}: {e}") from e
+        try:
+            docs = list(yaml.safe_load_all(text))
+        except yaml.YAMLError as e:
+            raise HelmliteError(f"{name}: rendered YAML invalid: {e}\n{text}") from e
+        objects.extend(d for d in docs if d)
+    return objects

@@ -17,6 +17,18 @@ def fnv64a(data: bytes) -> int:
     return h
 
 
+def deep_merge(base: dict, override: dict) -> dict:
+    """Helm-style values merge: nested dicts merge key-wise, everything
+    else (lists included) is replaced by the override."""
+    merged = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(merged.get(k), dict):
+            merged[k] = deep_merge(merged[k], v)
+        else:
+            merged[k] = v
+    return merged
+
+
 def object_hash(obj: Any) -> str:
     """Deterministic content hash of an object (reference: GetObjectHash
     internal/utils/utils.go:66-77, FNV over the marshalled object). Used
