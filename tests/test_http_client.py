@@ -21,6 +21,7 @@ class StubApiserver:
         self.store = {}
         self.watch_events = []
         self.watch_ready = threading.Event()
+        self.evictions_blocked = False  # simulate a PDB rejecting evictions
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -57,6 +58,18 @@ class StubApiserver:
 
             def do_POST(self):  # noqa: N802
                 body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+                path = self.path.split("?")[0]
+                if path.endswith("/eviction"):
+                    if stub.evictions_blocked:
+                        self._send(429, {"reason": "TooManyRequests",
+                                         "message": "disruption budget violated"})
+                        return
+                    pod_key = path.removesuffix("/eviction")
+                    if stub.store.pop(pod_key, None) is None:
+                        self._send(404, {"reason": "NotFound"})
+                        return
+                    self._send(201, {"kind": "Status", "status": "Success"})
+                    return
                 name = body["metadata"]["name"]
                 key = self.path.split("?")[0] + "/" + name
                 if key in stub.store:
@@ -144,6 +157,19 @@ def test_conflict_and_exists_mapping(stub):
     client.create(obj)
     with pytest.raises(errors.AlreadyExists):
         client.create(obj)
+
+
+def test_eviction_subresource_and_429_mapping(stub):
+    client = HttpClient(stub.url)
+    pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p1", "namespace": "ns"}}
+    client.create(pod)
+    stub.evictions_blocked = True
+    with pytest.raises(errors.TooManyRequests):
+        client.evict("p1", "ns")
+    assert client.get_or_none("v1", "Pod", "p1", "ns") is not None
+    stub.evictions_blocked = False
+    client.evict("p1", "ns")
+    assert client.get_or_none("v1", "Pod", "p1", "ns") is None
 
 
 def test_watch_streams_events(stub):

@@ -133,6 +133,102 @@ class TestApplyState:
         # daemonset-owned operand pods survive the drain
         assert client.list("v1", "Pod", NS, label_selector={"app.kubernetes.io/component": "libtpu-installer"})
 
+    def test_pdb_blocked_drain_parks_node(self):
+        """A PodDisruptionBudget protecting a workload pod must park the
+        node in drain-required (eviction API, 429) instead of the pod
+        being hard-deleted; when the PDB frees up, the drain proceeds."""
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        client.create(new_object(
+            "v1", "Pod", "protected", "default",
+            labels={"app": "critical"},
+            spec={"nodeName": "tpu-0",
+                  "containers": [{"name": "t", "resources": {"limits": {"google.com/tpu": "4"}}}]},
+            status={"phase": "Running"},
+        ))
+        client.create(new_object(
+            "policy/v1", "PodDisruptionBudget", "critical-pdb", "default",
+            spec={"minAvailable": 1, "selector": {"matchLabels": {"app": "critical"}}},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 2, "maxUnavailable": "100%",
+             "drain": {"enable": True, "timeoutSeconds": 3600}}
+        )
+        for _ in range(5):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        # the protected pod survives; its node parks mid-upgrade
+        assert client.get_or_none("v1", "Pod", "protected", "default") is not None
+        assert node_state(client, "tpu-0") in (
+            UpgradeState.POD_DELETION_REQUIRED, UpgradeState.DRAIN_REQUIRED
+        )
+        # drop the PDB -> upgrade completes
+        client.delete("policy/v1", "PodDisruptionBudget", "critical-pdb", "default")
+        for _ in range(6):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        assert client.get_or_none("v1", "Pod", "protected", "default") is None
+        assert node_state(client, "tpu-0") == UpgradeState.DONE
+
+    def test_pdb_blocked_drain_times_out_to_failed(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=1)
+        client.create(new_object(
+            "v1", "Pod", "protected", "default",
+            labels={"app": "critical"},
+            spec={"nodeName": "tpu-0",
+                  "containers": [{"name": "t", "resources": {"limits": {"google.com/tpu": "4"}}}]},
+            status={"phase": "Running"},
+        ))
+        client.create(new_object(
+            "policy/v1", "PodDisruptionBudget", "critical-pdb", "default",
+            spec={"minAvailable": 1, "selector": {"matchLabels": {"app": "critical"}}},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%",
+             "podDeletion": {"timeoutSeconds": 1},
+             "drain": {"enable": True, "timeoutSeconds": 1}}
+        )
+        for _ in range(3):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        # let the since-annotation age past the 1s timeout
+        time.sleep(1.1)
+        for _ in range(3):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        assert node_state(client, "tpu-0") == UpgradeState.FAILED
+        assert client.get_or_none("v1", "Pod", "protected", "default") is not None
+
+    def test_drain_force_overrides_pdb(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client, nodes=1)
+        client.create(new_object(
+            "v1", "Pod", "protected", "default",
+            labels={"app": "critical"},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        ))
+        client.create(new_object(
+            "policy/v1", "PodDisruptionBudget", "critical-pdb", "default",
+            spec={"minAvailable": 1, "selector": {"matchLabels": {"app": "critical"}}},
+        ))
+        bump_libtpu_version(client, cp_rec)
+        mgr = ClusterUpgradeStateManager(client, NS)
+        policy = UpgradePolicySpec.from_dict(
+            {"autoUpgrade": True, "maxParallelUpgrades": 1, "maxUnavailable": "100%",
+             "drain": {"enable": True, "force": True}}
+        )
+        for _ in range(8):
+            mgr.apply_state(mgr.build_state(), policy)
+            sim.step()
+        assert client.get_or_none("v1", "Pod", "protected", "default") is None
+        assert node_state(client, "tpu-0") == UpgradeState.DONE
+
     def test_wait_for_jobs_blocks_until_jobs_finish(self):
         client = FakeClient()
         cp_rec, sim = seed(client, nodes=1)

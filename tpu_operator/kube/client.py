@@ -53,6 +53,12 @@ class Client(abc.ABC):
     def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None: ...
 
     @abc.abstractmethod
+    def evict(self, name: str, namespace: str) -> None:
+        """Graceful pod removal via the pods/eviction subresource; raises
+        errors.TooManyRequests when a PodDisruptionBudget blocks it."""
+        ...
+
+    @abc.abstractmethod
     def watch(
         self,
         api_version: str,

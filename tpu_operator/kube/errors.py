@@ -29,6 +29,12 @@ class Invalid(ApiError):
     code = 422
 
 
+class TooManyRequests(ApiError):
+    """Eviction blocked (typically by a PodDisruptionBudget) — retryable."""
+
+    code = 429
+
+
 def is_not_found(err: BaseException) -> bool:
     return isinstance(err, NotFound)
 

@@ -199,6 +199,48 @@ class FakeClient(Client):
             self._pending.extend(self._collect_garbage(obj["metadata"].get("uid")))
         self._notify()
 
+    def evict(self, name, namespace):
+        """pods/eviction with PodDisruptionBudget accounting: an eviction
+        that would leave a matching PDB below its budget returns 429
+        (errors.TooManyRequests), mirroring the real apiserver's
+        disruption controller."""
+        pod = self.get("v1", "Pod", name, namespace)
+        labels = pod["metadata"].get("labels") or {}
+        for pdb in self.list("policy/v1", "PodDisruptionBudget", namespace):
+            selector = (pdb.get("spec", {}).get("selector") or {}).get("matchLabels") or {}
+            if not selector or not all(labels.get(k) == v for k, v in selector.items()):
+                continue
+            if self._pdb_disruptions_allowed(pdb, selector, namespace) <= 0:
+                raise errors.TooManyRequests(
+                    f"Cannot evict pod {namespace}/{name}: it would violate "
+                    f"PodDisruptionBudget {pdb['metadata']['name']}"
+                )
+        self.delete("v1", "Pod", name, namespace)
+
+    def _pdb_disruptions_allowed(self, pdb, selector, namespace) -> int:
+        spec = pdb.get("spec", {})
+        matching = [
+            p
+            for p in self.list("v1", "Pod", namespace, label_selector=selector)
+            if p.get("status", {}).get("phase") not in ("Succeeded", "Failed")
+        ]
+        total = len(matching)
+        # pods without a phase (sim objects) count as healthy
+        healthy = sum(
+            1 for p in matching if p.get("status", {}).get("phase") in (None, "Running")
+        )
+
+        def resolve(value) -> int:
+            if isinstance(value, str) and value.endswith("%"):
+                return (total * int(value[:-1]) + 99) // 100  # ceil, like k8s
+            return int(value)
+
+        if spec.get("minAvailable") is not None:
+            return healthy - resolve(spec["minAvailable"])
+        if spec.get("maxUnavailable") is not None:
+            return resolve(spec["maxUnavailable"]) - (total - healthy)
+        return 1
+
     def _collect_garbage(self, owner_uid):
         """Cascade-delete dependents (background GC semantics)."""
         events = []

@@ -134,6 +134,8 @@ class HttpClient(Client):
                 raise errors.Conflict(detail) from e
             if e.code in (400, 422):
                 raise errors.Invalid(detail) from e
+            if e.code == 429:
+                raise errors.TooManyRequests(detail) from e
             raise errors.ApiError(f"{method} {path}: HTTP {e.code}: {detail}") from e
         except urllib.error.URLError as e:
             raise errors.ApiError(f"{method} {path}: {e}") from e
@@ -178,6 +180,20 @@ class HttpClient(Client):
 
     def delete(self, api_version, kind, name, namespace=None):
         self._request("DELETE", self._path(api_version, kind, namespace, name))
+
+    def evict(self, name, namespace):
+        """POST pods/eviction (the drain path the reference's upgrade lib
+        uses); the apiserver answers 429 when a PDB blocks the eviction,
+        surfaced as errors.TooManyRequests by _request."""
+        self._request(
+            "POST",
+            self._path("v1", "Pod", namespace, name) + "/eviction",
+            body={
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            },
+        )
 
     # -- watch ---------------------------------------------------------------
 

@@ -164,6 +164,13 @@ class ClusterUpgradeStateManager:
         Buckets are snapshotted up front so a node moved this pass isn't
         reprocessed by the next bucket (the reference processes the buckets
         BuildState computed, never intra-pass transitions)."""
+        # one cluster-wide pod list per pass; every bucket filters this
+        # snapshot in memory instead of re-listing per node
+        pods_by_node: Dict[str, List[ObjectDict]] = {}
+        for pod in self.client.list("v1", "Pod"):
+            node_name = pod.get("spec", {}).get("nodeName")
+            if node_name and pod.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
+                pods_by_node.setdefault(node_name, []).append(pod)
         buckets = {
             s: state.in_state(s)
             for s in (
@@ -199,7 +206,10 @@ class ClusterUpgradeStateManager:
                 self._set_state(node_state, UpgradeState.POD_DELETION_REQUIRED)
 
         for node_state in buckets[UpgradeState.WAIT_FOR_JOBS_REQUIRED]:
-            if not self._pods_on_node(node_state.name, policy.wait_for_completion.pod_selector):
+            pods = self._filter_pods(
+                pods_by_node.get(node_state.name, ()), policy.wait_for_completion.pod_selector
+            )
+            if not pods:
                 self._set_state(node_state, UpgradeState.POD_DELETION_REQUIRED)
             elif self._state_expired(node_state, policy.wait_for_completion.timeout_seconds):
                 # a hung job must not stall the whole rolling upgrade:
@@ -210,27 +220,66 @@ class ClusterUpgradeStateManager:
                 self._set_state(node_state, UpgradeState.FAILED)
 
         for node_state in buckets[UpgradeState.POD_DELETION_REQUIRED]:
-            self._delete_tpu_pods(node_state.name)
-            if policy.drain.enable:
-                self._set_state(node_state, UpgradeState.DRAIN_REQUIRED)
-            else:
-                self._set_state(node_state, UpgradeState.POD_RESTART_REQUIRED)
+            targets = [
+                p
+                for p in pods_by_node.get(node_state.name, ())
+                if not self._is_daemonset_pod(p) and self._consumes_tpu(p)
+            ]
+            self._evict_phase(
+                node_state,
+                targets,
+                force=policy.pod_deletion.force,
+                timeout_seconds=policy.pod_deletion.timeout_seconds,
+                next_state=(
+                    UpgradeState.DRAIN_REQUIRED
+                    if policy.drain.enable
+                    else UpgradeState.POD_RESTART_REQUIRED
+                ),
+            )
 
         for node_state in buckets[UpgradeState.DRAIN_REQUIRED]:
-            self._drain(node_state.name, policy)
-            self._set_state(node_state, UpgradeState.POD_RESTART_REQUIRED)
+            targets = [
+                p
+                for p in self._filter_pods(
+                    pods_by_node.get(node_state.name, ()), policy.drain.pod_selector
+                )
+                if not self._is_daemonset_pod(p)
+            ]
+            self._evict_phase(
+                node_state,
+                targets,
+                force=policy.drain.force,
+                timeout_seconds=policy.drain.timeout_seconds,
+                next_state=UpgradeState.POD_RESTART_REQUIRED,
+            )
 
         for node_state in buckets[UpgradeState.POD_RESTART_REQUIRED]:
-            for pod in node_state.driver_pods:
+            want = (
+                str(node_state.daemonset["metadata"].get("generation", 1))
+                if node_state.daemonset
+                else None
+            )
+            outdated = [
+                p
+                for p in node_state.driver_pods
+                if want is not None
+                and (p["metadata"].get("labels") or {}).get(POD_TEMPLATE_GENERATION_LABEL)
+                not in (None, want)
+            ]
+            for pod in outdated:
                 md = pod["metadata"]
                 try:
                     self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
                 except errors.NotFound:
                     pass
-            self._set_state(node_state, UpgradeState.VALIDATION_REQUIRED)
+            if not outdated:
+                # only advance once the stale pods are gone — moving to
+                # VALIDATION in the deletion pass just burns a replan on a
+                # node with no driver pod yet
+                self._set_state(node_state, UpgradeState.VALIDATION_REQUIRED)
 
         for node_state in buckets[UpgradeState.VALIDATION_REQUIRED]:
-            if self._node_validated(node_state):
+            if self._node_validated(node_state, pods_by_node.get(node_state.name, ())):
                 self._set_state(node_state, UpgradeState.UNCORDON_REQUIRED)
 
         for node_state in buckets[UpgradeState.UNCORDON_REQUIRED]:
@@ -309,39 +358,60 @@ class ClusterUpgradeStateManager:
         except errors.Conflict:
             pass
 
-    def _pods_on_node(self, node_name: str, selector) -> List[ObjectDict]:
-        return [
-            p
-            for p in self.client.list("v1", "Pod", label_selector=selector or None)
-            if p.get("spec", {}).get("nodeName") == node_name
-            and p.get("status", {}).get("phase") not in ("Succeeded", "Failed")
-        ]
+    def _evict_phase(
+        self,
+        node_state: NodeUpgradeState,
+        targets: List[ObjectDict],
+        force: bool,
+        timeout_seconds: int,
+        next_state: str,
+    ) -> None:
+        """Shared pod-deletion/drain step: evict the targets, advance when
+        none remain blocked, or park until the phase's own timeout sends
+        the node to upgrade-failed (reference: drain manager + DrainSpec —
+        a PDB-blocked eviction feeds the same timeout->failed path as hung
+        jobs, visible via the state label meanwhile)."""
+        blocked = self._evict_pods(targets, force=force)
+        if not blocked:
+            self._set_state(node_state, next_state)
+        elif self._state_expired(node_state, timeout_seconds):
+            log.error(
+                "upgrade: node %s %s blocked past timeout", node_state.name, node_state.state
+            )
+            self._set_state(node_state, UpgradeState.FAILED)
 
-    def _delete_tpu_pods(self, node_name: str) -> None:
-        """Delete pods consuming google.com/tpu on the node (reference:
-        pod-deletion deletes pods consuming GPU resources)."""
-        for pod in self._pods_on_node(node_name, None):
-            if self._is_daemonset_pod(pod):
-                continue
-            if self._consumes_tpu(pod):
-                md = pod["metadata"]
-                try:
-                    self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
-                except errors.NotFound:
-                    pass
+    @staticmethod
+    def _filter_pods(pods, selector) -> List[ObjectDict]:
+        if not selector:
+            return list(pods)
+        return [p for p in pods if matches_selector(p["metadata"].get("labels"), selector)]
 
-    def _drain(self, node_name: str, policy: UpgradePolicySpec) -> None:
-        """Evict all non-DaemonSet pods (reference: drain manager with the
-        DrainSpec's podSelector filter)."""
-        selector = policy.drain.pod_selector or None
-        for pod in self._pods_on_node(node_name, selector):
-            if self._is_daemonset_pod(pod):
-                continue
+    def _evict_pods(self, pods: List[ObjectDict], force: bool = False) -> List[ObjectDict]:
+        """Evict via the pods/eviction subresource so PodDisruptionBudgets
+        are honored (reference: the vendored drain manager); returns the
+        pods a PDB blocked. ``force`` falls back to plain DELETE for
+        blocked pods (DrainSpec.force, kubectl drain --disable-eviction
+        semantics)."""
+        blocked: List[ObjectDict] = []
+        for pod in pods:
             md = pod["metadata"]
             try:
-                self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+                self.client.evict(md["name"], md.get("namespace"))
             except errors.NotFound:
                 pass
+            except errors.TooManyRequests:
+                if force:
+                    try:
+                        self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+                    except errors.NotFound:
+                        pass
+                else:
+                    log.info(
+                        "upgrade: eviction of %s/%s blocked by disruption budget",
+                        md.get("namespace"), md["name"],
+                    )
+                    blocked.append(pod)
+        return blocked
 
     @staticmethod
     def _is_daemonset_pod(pod: ObjectDict) -> bool:
@@ -358,18 +428,18 @@ class ClusterUpgradeStateManager:
                 return True
         return False
 
-    def _node_validated(self, node_state: NodeUpgradeState) -> bool:
+    def _node_validated(self, node_state: NodeUpgradeState, node_pods) -> bool:
         """Fresh driver pod running with the current template generation,
         and — when the validator operand is deployed — its pod Running on
         the node (reference waits on app=nvidia-operator-validator pods,
-        cmd/gpu-operator/main.go:151)."""
+        cmd/gpu-operator/main.go:151). ``node_pods`` is this node's slice
+        of the pass-wide pod snapshot."""
+        in_ns = [p for p in node_pods if p["metadata"].get("namespace") == self.namespace]
         pods = [
             p
-            for p in self.client.list(
-                "v1", "Pod", self.namespace,
-                label_selector={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
-            )
-            if p.get("spec", {}).get("nodeName") == node_state.name
+            for p in in_ns
+            if (p["metadata"].get("labels") or {}).get(DRIVER_POD_COMPONENT_LABEL)
+            == DRIVER_POD_COMPONENT
         ]
         if not pods:
             return False
@@ -382,9 +452,7 @@ class ClusterUpgradeStateManager:
             if want is not None and have is not None and have != want:
                 return False
         validators = [
-            p
-            for p in self.client.list("v1", "Pod", self.namespace, label_selector={"app": VALIDATOR_POD_APP})
-            if p.get("spec", {}).get("nodeName") == node_state.name
+            p for p in in_ns if (p["metadata"].get("labels") or {}).get("app") == VALIDATOR_POD_APP
         ]
         if validators and any(p.get("status", {}).get("phase") != "Running" for p in validators):
             return False
