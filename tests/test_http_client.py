@@ -22,6 +22,7 @@ class StubApiserver:
         self.watch_events = []
         self.watch_ready = threading.Event()
         self.evictions_blocked = False  # simulate a PDB rejecting evictions
+        self.reject_tokens = set()  # bearer tokens to answer with 401
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -32,7 +33,16 @@ class StubApiserver:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _auth_rejected(self):
+                auth = self.headers.get("Authorization", "")
+                if auth.removeprefix("Bearer ") in stub.reject_tokens:
+                    self._send(401, {"reason": "Unauthorized"})
+                    return True
+                return False
+
             def do_GET(self):  # noqa: N802
+                if self._auth_rejected():
+                    return
                 path = self.path.split("?")[0]
                 if "watch=true" in self.path:
                     self.send_response(200)
@@ -114,6 +124,32 @@ def stub():
     s = StubApiserver()
     yield s
     s.stop()
+
+
+def test_token_refresh_on_ttl_and_401(stub, tmp_path):
+    token_file = tmp_path / "token"
+    token_file.write_text("tok-1")
+    client = HttpClient(stub.url, token_path=str(token_file))
+    client.create({"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": "a", "namespace": "ns"}})
+    assert client.token == "tok-1"
+    # rotate the bound token on disk; TTL expiry forces a re-read
+    token_file.write_text("tok-2")
+    client._token_read_at = 0.0
+    client.get("v1", "ConfigMap", "a", "ns")
+    assert client.token == "tok-2"
+    # a 401 (expired bound token) re-reads immediately and retries once
+    token_file.write_text("tok-3")
+    stub.reject_tokens = {"tok-2"}
+    client.get("v1", "ConfigMap", "a", "ns")
+    assert client.token == "tok-3"
+
+
+def test_crd_plurals_from_definitions():
+    from tpu_operator.kube import http_client as hc
+
+    assert hc.plural_of("ClusterPolicy") == "clusterpolicies"
+    # the CRD definitions, not the naive fallback, must be the source
+    assert "TPUSlice" in hc.PLURALS and "ClusterPolicy" in hc.PLURALS
 
 
 def test_plural_rules():

@@ -96,6 +96,19 @@ class TestPluginComponent:
 
 
 class TestWorkloadComponent:
+    def test_pod_schedules_via_selector_not_nodename(self, ctx):
+        """The smoke pod must go through the scheduler (hostname selector
+        + TPU limit) so it exercises google.com/tpu accounting — nodeName
+        pinning would bypass the very allocation plugin validation just
+        proved (reference: plugin-workload-validation.yaml)."""
+        from tpu_operator.validator.main import workload_pod
+
+        pod = workload_pod(ctx)
+        assert "nodeName" not in pod["spec"] or pod["spec"]["nodeName"] is None
+        assert pod["spec"]["nodeSelector"] == {"kubernetes.io/hostname": ctx.node_name}
+        limits = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert consts.TPU_RESOURCE_NAME in limits
+
     def test_waits_for_pod_success(self, ctx):
         def kubelet():
             # fake kubelet: run the scheduled validation pod to completion

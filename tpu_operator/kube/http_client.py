@@ -15,6 +15,7 @@ import json
 import logging
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -30,19 +31,43 @@ TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
 CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 NAMESPACE_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
 
-# kind -> plural for the kinds this operator touches; anything else falls
-# back to naive lowercase+s pluralization.
+# kind -> plural for the kinds this operator touches; custom kinds load
+# from the CRD definitions (the authoritative spec.names.plural), anything
+# else falls back to naive lowercase+s pluralization.
 PLURALS = {
-    "ClusterPolicy": "clusterpolicies",
-    "TPUSlice": "tpuslices",
     "Endpoints": "endpoints",
     "NetworkPolicy": "networkpolicies",
     "PriorityClass": "priorityclasses",
     "Ingress": "ingresses",
 }
 
+_crd_plurals_loaded = False
+
+
+def _load_crd_plurals() -> None:
+    """Fill PLURALS from the CRD definitions so every custom kind the
+    operator serves pluralizes exactly as the API registers it (naive
+    '+s'/'ies' fallback rules mis-pluralize irregular kinds)."""
+    global _crd_plurals_loaded
+    if _crd_plurals_loaded:
+        return
+    try:
+        from tpu_operator.api.crds import all_crds  # deferred: avoids an import cycle
+
+        for crd in all_crds():
+            names = crd.get("spec", {}).get("names", {})
+            if names.get("kind") and names.get("plural"):
+                PLURALS.setdefault(names["kind"], names["plural"])
+    except ImportError:
+        # mid-initialization (circular import window): fall back to naive
+        # pluralization this call, retry the load next time
+        return
+    _crd_plurals_loaded = True
+
 
 def plural_of(kind: str) -> str:
+    if kind not in PLURALS:
+        _load_crd_plurals()
     if kind in PLURALS:
         return PLURALS[kind]
     lower = kind.lower()
@@ -72,9 +97,16 @@ class HttpClient(Client):
         token: Optional[str] = None,
         ca_path: Optional[str] = None,
         timeout: float = 30.0,
+        token_path: Optional[str] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # bound SA tokens expire (~1h): with token_path set, the token
+        # re-reads on a TTL and once more on any 401 (client-go refresh
+        # behavior), so long-running agents never wedge on a stale token
+        self.token_path = token_path
+        self._token_read_at = 0.0
+        self.token_ttl = 300.0
         self.timeout = timeout
         if ca_path:
             self._ssl = ssl.create_default_context(cafile=ca_path)
@@ -91,9 +123,7 @@ class HttpClient(Client):
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         if not host:
             raise errors.ApiError("not running in a cluster (KUBERNETES_SERVICE_HOST unset)")
-        with open(TOKEN_PATH) as f:
-            token = f.read().strip()
-        return cls(f"https://{host}:{port}", token=token, ca_path=CA_PATH)
+        return cls(f"https://{host}:{port}", ca_path=CA_PATH, token_path=TOKEN_PATH)
 
     # -- request plumbing ----------------------------------------------------
 
@@ -109,7 +139,26 @@ class HttpClient(Client):
             parts.append(name)
         return "/".join(parts)
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None, query: Optional[dict] = None) -> dict:
+    def _bearer(self, force_refresh: bool = False) -> Optional[str]:
+        if self.token_path and (
+            force_refresh or not self.token or time.time() - self._token_read_at > self.token_ttl
+        ):
+            try:
+                with open(self.token_path) as f:
+                    self.token = f.read().strip()
+                self._token_read_at = time.time()
+            except OSError as e:
+                log.warning("could not refresh SA token from %s: %s", self.token_path, e)
+        return self.token
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        query: Optional[dict] = None,
+        _retry_auth: bool = True,
+    ) -> dict:
         url = self.base_url + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -118,13 +167,18 @@ class HttpClient(Client):
         req.add_header("Accept", "application/json")
         if body is not None:
             req.add_header("Content-Type", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._bearer()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
+            if e.code == 401 and _retry_auth and self.token_path:
+                # expired bound token: re-read once and retry the request
+                self._bearer(force_refresh=True)
+                return self._request(method, path, body, query, _retry_auth=False)
             detail = e.read().decode(errors="replace")[:500]
             if e.code == 404:
                 raise errors.NotFound(detail) from e
@@ -238,8 +292,9 @@ class HttpClient(Client):
             query["resourceVersion"] = resource_version
         url = self.base_url + self._path(api_version, kind, namespace) + "?" + urllib.parse.urlencode(query)
         req = urllib.request.Request(url)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._bearer()  # watch streams reconnect, picking up fresh tokens
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         with urllib.request.urlopen(req, timeout=300, context=self._ssl) as resp:
             buffer = b""
             while sub.active:

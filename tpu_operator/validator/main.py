@@ -106,9 +106,24 @@ def workload_pod(ctx: Context) -> dict:
         labels={"app": "tpu-workload-validation"},
         spec={
             "restartPolicy": "Never",
-            "nodeName": ctx.node_name or None,
+            # schedule through the scheduler (hostname selector + the TPU
+            # limit below) so the pod exercises the same google.com/tpu
+            # accounting plugin validation just proved — nodeName pinning
+            # would bypass both (reference: plugin-workload-validation.yaml
+            # schedules with a GPU limit)
+            "nodeSelector": (
+                {"kubernetes.io/hostname": ctx.node_name} if ctx.node_name else None
+            ),
             "tolerations": [
-                {"key": consts.TPU_RESOURCE_NAME, "operator": "Exists", "effect": "NoSchedule"}
+                {"key": consts.TPU_RESOURCE_NAME, "operator": "Exists", "effect": "NoSchedule"},
+                # validation runs while the upgrade FSM still has the node
+                # cordoned (VALIDATION before UNCORDON), so the pod must
+                # tolerate the cordon taint to schedule at all
+                {
+                    "key": "node.kubernetes.io/unschedulable",
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                },
             ],
             "containers": [
                 {
