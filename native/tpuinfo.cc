@@ -13,6 +13,7 @@
 //   /sys/class/accel/accel*  sysfs accel class (newer kernels)
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <dirent.h>
 #include <string>
@@ -61,6 +62,63 @@ int tpuinfo_probe(char* buf, int len) {
   json += "],\"vfio_groups\":" +
           std::to_string(vfio.empty() ? 0 : vfio.size() - 1) +  // minus /dev/vfio/vfio
           "}";
+  if (static_cast<int>(json.size()) + 1 > len) return -1;
+  std::memcpy(buf, json.c_str(), json.size() + 1);
+  return static_cast<int>(json.size());
+}
+
+// Per-chip (x,y,z) coordinates within this host's block of the torus.
+//
+// Source of truth is the libtpu/GKE host-bounds contract: the runtime
+// publishes TPU_CHIPS_PER_HOST_BOUNDS="x,y,z" on TPU VMs (2,2,1 on
+// v4/v5p hosts, 2,4,1 on single-host v5e-8). Without the env var, bounds
+// fall back by enumerated chip count. Chip index walks x fastest, then
+// y, then z — the same linearization libtpu uses for local devices.
+// Consumed by the device plugin's GetPreferredAllocation so gang
+// neighborhoods follow real torus adjacency instead of index windows.
+//
+// Writes {"bounds":[x,y,z],"coords":[[x,y,z],...]} JSON. Returns bytes
+// written, or -1 if the buffer is too small.
+int tpuinfo_chip_coords(int chip_count, char* buf, int len) {
+  int bx = 0, by = 0, bz = 0;
+  const char* env = std::getenv("TPU_CHIPS_PER_HOST_BOUNDS");
+  if (env != nullptr) {
+    char trailing = 0;
+    // strict x,y,z — trailing tokens invalidate the value (keeps parity
+    // with the Python fallback parser)
+    if (std::sscanf(env, "%d,%d,%d%c", &bx, &by, &bz, &trailing) != 3) {
+      bx = by = bz = 0;
+    }
+  }
+  // sanity cap: host blocks are a handful of chips; a bogus env value
+  // must not overflow bx*by*bz or build megabytes of JSON
+  if (bx <= 0 || by <= 0 || bz <= 0 || bx > 64 || by > 64 || bz > 64 ||
+      bx * by * bz > 4096) {
+    bx = by = bz = 0;
+  }
+  if (bx <= 0 || by <= 0 || bz <= 0) {
+    if (chip_count <= 0) {
+      std::vector<std::string> devices = list_dir("/dev", "accel");
+      std::vector<std::string> sys_devices = list_dir("/sys/class/accel", "accel");
+      chip_count = static_cast<int>(
+          devices.size() > sys_devices.size() ? devices.size() : sys_devices.size());
+    }
+    switch (chip_count) {
+      case 8: bx = 2; by = 4; bz = 1; break;
+      case 4: bx = 2; by = 2; bz = 1; break;
+      case 2: bx = 2; by = 1; bz = 1; break;
+      default: bx = chip_count > 0 ? chip_count : 1; by = 1; bz = 1; break;
+    }
+  }
+  std::string json = "{\"bounds\":[" + std::to_string(bx) + "," + std::to_string(by) +
+                     "," + std::to_string(bz) + "],\"coords\":[";
+  int n = bx * by * bz;
+  for (int i = 0; i < n; ++i) {
+    if (i) json += ",";
+    json += "[" + std::to_string(i % bx) + "," + std::to_string((i / bx) % by) + "," +
+            std::to_string(i / (bx * by)) + "]";
+  }
+  json += "]}";
   if (static_cast<int>(json.size()) + 1 > len) return -1;
   std::memcpy(buf, json.c_str(), json.size() + 1);
   return static_cast<int>(json.size());

@@ -381,6 +381,69 @@ class TestPreferredAllocation:
         assert "accel7" in list(resp.container_responses[0].deviceIDs)
 
 
+class TestTorusPreferredAllocation:
+    def test_2x2_face_beats_index_line(self, tmp_path, monkeypatch):
+        """On a 4x4 block, chips 0,1,4,5 form a 2x2 face (pairwise torus
+        distance 8) while the index-contiguous 0,1,2,3 is a line (10) —
+        coordinates must win over the window heuristic."""
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "4,4,1")
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=[f"accel{i}" for i in range(6)],
+                    allocation_size=4,
+                )
+            ]),
+            None,
+        )
+        assert sorted(resp.container_responses[0].deviceIDs) == [
+            "accel0", "accel1", "accel4", "accel5"
+        ]
+
+    def test_vertical_adjacency_beats_index_window(self, tmp_path, monkeypatch):
+        """chips 0 (0,0) and 4 (0,1) are y-neighbors (dist 1) while the
+        index-window pick {3,4} sits at opposite block corners (dist 4) —
+        coordinates must win."""
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "4,2,1")
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["accel0", "accel3", "accel4"],
+                    allocation_size=2,
+                )
+            ]),
+            None,
+        )
+        assert sorted(resp.container_responses[0].deviceIDs) == ["accel0", "accel4"]
+
+    def test_must_include_and_replicas(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+        plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])
+        resp = plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["accel0-rep0", "accel1-rep0", "accel3-rep1"],
+                    must_include_deviceIDs=["accel3-rep1"],
+                    allocation_size=2,
+                )
+            ]),
+            None,
+        )
+        got = list(resp.container_responses[0].deviceIDs)
+        # accel1 (1,0) is adjacent to accel3 (1,1); accel0 (0,0) is diagonal
+        assert sorted(got) == ["accel1-rep0", "accel3-rep1"]
+
+    def test_chip_coords_native_and_python_agree(self, monkeypatch):
+        from tpu_operator.native import tpuinfo
+
+        monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,2")
+        assert tpuinfo.chip_coords() == tpuinfo._python_chip_coords(0)
+        monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS")
+        assert tpuinfo.chip_coords(4) == tpuinfo._python_chip_coords(4)
+
+
 class TestPreferredAllocationContract:
     def test_fallback_still_includes_musts(self, tmp_path):
         plugin = TPUDevicePlugin(socket_dir=str(tmp_path), devices=[])

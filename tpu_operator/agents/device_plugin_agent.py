@@ -20,6 +20,7 @@ grpc_tools codegen needed.
 from __future__ import annotations
 
 import logging
+import math
 import os
 import queue
 import re
@@ -85,6 +86,7 @@ class TPUDevicePlugin:
         self._sub_lock = threading.Lock()
         self._stop = threading.Event()
         self._last_devices: List[str] = []
+        self._coords_cache: Optional[dict] = None
 
     # -- inventory -----------------------------------------------------------
 
@@ -133,10 +135,18 @@ class TPUDevicePlugin:
                 if my_queue in self._subscribers:
                     self._subscribers.remove(my_queue)
 
+    # combination cap for torus-aware search; beyond it the index-window
+    # heuristic answers (C(16,8)=12870 is the realistic worst case)
+    _MAX_COMBINATIONS = 20000
+
     def GetPreferredAllocation(self, request, context):
-        """Prefer ICI-adjacent chips: pick the contiguous window of chip
-        indices with the smallest spread (adjacent indices share ICI links
-        on TPU topologies, so a contiguous gang minimizes hop count)."""
+        """Prefer ICI-adjacent chips using real chip coordinates: choose
+        the candidate set minimizing total pairwise Manhattan distance in
+        the host's block (tie-break: bounding-box volume), so a 2x2 face
+        beats an equal-index-spread line. Coordinates come from the native
+        probe's host-bounds contract (native/tpuinfo.cc:tpuinfo_chip_coords);
+        falls back to the contiguous index-window heuristic when the
+        search space is too large."""
         responses = []
         for req in request.container_requests:
             available = list(req.available_deviceIDs)
@@ -145,26 +155,80 @@ class TPUDevicePlugin:
             if not available or size <= 0:
                 responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=must))
                 continue
-
-            def chip_index(dev_id: str) -> int:
-                digits = re.sub(r"\D", "", dev_id.split("-rep")[0])
-                return int(digits) if digits else 0
-
-            ordered = sorted(available, key=chip_index)
-            # fallback always satisfies must_include (the contract): musts
-            # first, then nearest remaining chips
-            rest = [d for d in ordered if d not in must]
-            best = (must + rest)[:size]
-            best_spread = None
-            for start in range(0, max(1, len(ordered) - size + 1)):
-                window = ordered[start : start + size]
-                if len(window) < size or not all(m in window for m in must):
-                    continue
-                spread = chip_index(window[-1]) - chip_index(window[0])
-                if best_spread is None or spread < best_spread:
-                    best, best_spread = window, spread
+            best = self._torus_preferred(available, size, must)
+            if best is None:
+                best = self._window_preferred(available, size, must)
             responses.append(pb.ContainerPreferredAllocationResponse(deviceIDs=best))
         return pb.PreferredAllocationResponse(container_responses=responses)
+
+    @staticmethod
+    def _chip_index(dev_id: str) -> int:
+        digits = re.sub(r"\D", "", dev_id.split("-rep")[0])
+        return int(digits) if digits else 0
+
+    def _torus_preferred(self, available, size, must):
+        """Exhaustive search over candidate sets by block-local Manhattan
+        distance; None when infeasible or the combination count exceeds
+        the cap. Distances do NOT wrap: TPU_CHIPS_PER_HOST_BOUNDS is one
+        host's sub-block of the slice — opposite block edges link onward
+        to other hosts, never to each other (torus closure exists only at
+        full-pod scale)."""
+        import itertools
+
+        if self._coords_cache is None:
+            from tpu_operator.native import tpuinfo
+
+            # host bounds are immutable for the plugin's lifetime
+            self._coords_cache = tpuinfo.chip_coords()
+        coords = self._coords_cache["coords"]
+        free = [d for d in available if d not in must]
+        needed = size - len(must)
+        if needed < 0 or needed > len(free):
+            return None
+        if math.comb(len(free), needed) > self._MAX_COMBINATIONS:
+            return None
+
+        def coord(dev_id):
+            idx = self._chip_index(dev_id)
+            return coords[idx] if idx < len(coords) else [idx, 0, 0]
+
+        def dist(a, b):
+            return sum(abs(a[axis] - b[axis]) for axis in range(3))
+
+        def score(devs):
+            pts = [coord(d) for d in devs]
+            pairwise = sum(
+                dist(pts[i], pts[j]) for i in range(len(pts)) for j in range(i + 1, len(pts))
+            )
+            volume = 1
+            for axis in range(3):
+                vals = [p[axis] for p in pts]
+                volume *= max(vals) - min(vals) + 1
+            return (pairwise, volume)
+
+        best, best_score = None, None
+        for combo in itertools.combinations(free, needed):
+            devs = must + list(combo)
+            s = score(devs)
+            if best_score is None or s < best_score:
+                best, best_score = devs, s
+        return best
+
+    def _window_preferred(self, available, size, must):
+        """Contiguous index-window fallback: smallest index spread that
+        still satisfies must_include."""
+        ordered = sorted(available, key=self._chip_index)
+        rest = [d for d in ordered if d not in must]
+        best = (must + rest)[:size]
+        best_spread = None
+        for start in range(0, max(1, len(ordered) - size + 1)):
+            window = ordered[start : start + size]
+            if len(window) < size or not all(m in window for m in must):
+                continue
+            spread = self._chip_index(window[-1]) - self._chip_index(window[0])
+            if best_spread is None or spread < best_spread:
+                best, best_spread = window, spread
+        return best
 
     def Allocate(self, request, context):
         """Per-container device nodes + libtpu mount + TPU env (the

@@ -52,6 +52,9 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.tpuinfo_probe.restype = ctypes.c_int
             lib.tpuinfo_fnv64.argtypes = [ctypes.c_char_p, ctypes.c_ulonglong]
             lib.tpuinfo_fnv64.restype = ctypes.c_ulonglong
+            if hasattr(lib, "tpuinfo_chip_coords"):  # older prebuilt .so lacks it
+                lib.tpuinfo_chip_coords.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+                lib.tpuinfo_chip_coords.restype = ctypes.c_int
             _lib = lib
             return lib
         except OSError as e:
@@ -80,6 +83,45 @@ def probe() -> dict:
     n = lib.tpuinfo_probe(buf, len(buf))
     if n < 0:
         return _python_probe()
+    return json.loads(buf.value.decode())
+
+
+def _python_chip_coords(chip_count: int) -> dict:
+    bounds = None
+    env = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+    if env:
+        try:
+            bx, by, bz = (int(p) for p in env.split(","))
+            # same sanity cap as the native parser (tpuinfo.cc)
+            if 0 < bx <= 64 and 0 < by <= 64 and 0 < bz <= 64 and bx * by * bz <= 4096:
+                bounds = (bx, by, bz)
+        except ValueError:
+            pass
+    if bounds is None:
+        if chip_count <= 0:
+            chip_count = _python_probe()["chip_count"]
+        bounds = {8: (2, 4, 1), 4: (2, 2, 1), 2: (2, 1, 1)}.get(
+            chip_count, (max(chip_count, 1), 1, 1)
+        )
+    bx, by, bz = bounds
+    return {
+        "bounds": [bx, by, bz],
+        "coords": [[i % bx, (i // bx) % by, i // (bx * by)] for i in range(bx * by * bz)],
+    }
+
+
+def chip_coords(chip_count: int = 0) -> dict:
+    """Per-chip (x,y,z) within this host's torus block, from the
+    TPU_CHIPS_PER_HOST_BOUNDS contract (libtpu/GKE) or chip-count
+    defaults: {"bounds": [x,y,z], "coords": [[x,y,z], ...]} indexed by
+    local chip number (x fastest, libtpu's linearization)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tpuinfo_chip_coords"):
+        return _python_chip_coords(chip_count)
+    buf = ctypes.create_string_buffer(64 * 1024)
+    n = lib.tpuinfo_chip_coords(chip_count, buf, len(buf))
+    if n < 0:
+        return _python_chip_coords(chip_count)
     return json.loads(buf.value.decode())
 
 
