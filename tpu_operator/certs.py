@@ -1,0 +1,316 @@
+"""Webhook serving-certificate management with rotation.
+
+Reference: the GPU operator ships webhook certs via helm/OLM conventions
+and leaves renewal to cert-manager. This operator owns the loop itself
+(cert-manager is not a given on GKE): a CA + serving cert pair is
+generated on first start, republished to the TLS Secret the Deployment
+mounts, and the ValidatingWebhookConfiguration's per-webhook caBundle is
+patched so the apiserver trusts the new chain. A background loop
+re-checks expiry and rotates before the not-after date; the serving
+socket reloads the chain in place so admissions keep flowing through a
+rotation (WebhookServer.reload_certs).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import new_object
+
+log = logging.getLogger(__name__)
+
+DAY = 24 * 3600
+
+_PEM_CERT_END = b"-----END CERTIFICATE-----"
+
+
+def _split_pem_certs(bundle: bytes):
+    """Split a PEM bundle into individual certificate blocks."""
+    certs = []
+    rest = bundle
+    while _PEM_CERT_END in rest:
+        head, _, rest = rest.partition(_PEM_CERT_END)
+        certs.append(head + _PEM_CERT_END + b"\n")
+    return certs
+
+
+def _new_key():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _key_pem(key) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+
+
+def make_ca(common_name: str, validity_seconds: int):
+    """Self-signed CA. Returns (ca_cert, ca_key)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.x509.oid import NameOID
+
+    key = _new_key()
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(seconds=60))
+        .not_valid_after(now + datetime.timedelta(seconds=validity_seconds))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def issue_serving_cert(ca_cert, ca_key, hostname: str, sans, validity_seconds: int):
+    """CA-signed serving cert for the webhook Service DNS names.
+    Returns (cert_pem, key_pem) with the CA appended to the chain."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.x509.oid import NameOID
+
+    key = _new_key()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(seconds=60))
+        .not_valid_after(now + datetime.timedelta(seconds=validity_seconds))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName(n) for n in sans]), critical=False
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    chain = cert.public_bytes(serialization.Encoding.PEM) + ca_cert.public_bytes(
+        serialization.Encoding.PEM
+    )
+    return chain, _key_pem(key)
+
+
+class WebhookCertManager:
+    """Generate, publish, and rotate the webhook's serving certificate.
+
+    All state lives on disk (cert_dir) and in the cluster (Secret +
+    VWC caBundle), so restarts resume cleanly — the same statelessness
+    contract the rest of the operator follows.
+    """
+
+    def __init__(
+        self,
+        client: Optional[Client],
+        namespace: str,
+        cert_dir: str,
+        service: str = "tpu-operator-webhook",
+        secret_name: str = "tpu-operator-webhook-tls",
+        vwc_name: str = "tpu-operator",
+        validity_seconds: int = 365 * DAY,
+        rotate_before_seconds: int = 30 * DAY,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.cert_dir = cert_dir
+        self.service = service
+        self.secret_name = secret_name
+        self.vwc_name = vwc_name
+        self.validity_seconds = validity_seconds
+        self.rotate_before_seconds = rotate_before_seconds
+        self.cert_path = os.path.join(cert_dir, "tls.crt")
+        self.key_path = os.path.join(cert_dir, "tls.key")
+        self._server = None  # attached WebhookServer, reloaded on rotation
+        self._stop = threading.Event()
+
+    # -- inspection ----------------------------------------------------------
+
+    def expires_at(self) -> Optional[datetime.datetime]:
+        from cryptography import x509
+
+        try:
+            with open(self.cert_path, "rb") as f:
+                cert = x509.load_pem_x509_certificate(f.read())
+        except (OSError, ValueError):
+            return None
+        return cert.not_valid_after_utc
+
+    def needs_rotation(self) -> bool:
+        expires = self.expires_at()
+        if expires is None:
+            return True
+        remaining = (expires - datetime.datetime.now(datetime.timezone.utc)).total_seconds()
+        return remaining <= self.rotate_before_seconds
+
+    # -- rotation ------------------------------------------------------------
+
+    def ensure(self) -> bool:
+        """Converge the serving cert; returns True when it changed.
+
+        Order is trust-first so admissions never break mid-sequence:
+        (1) adopt a still-fresh cert from the published Secret (restart /
+        second replica: converge on the shared cert instead of minting a
+        competing CA); else (2) append the new CA to every VWC caBundle
+        (old CAs kept, so apiservers with a cached bundle still verify),
+        (3) publish the Secret, (4) write disk, (5) hot-reload the server.
+        Any publish failure aborts before the serving cert changes and
+        retries on the next loop pass."""
+        if not self.needs_rotation():
+            return False
+        if self._adopt_from_secret():
+            if self._server is not None:
+                self._server.reload_certs()
+            log.info("webhook cert adopted from Secret %s", self.secret_name)
+            return True
+        sans = [
+            self.service,
+            f"{self.service}.{self.namespace}",
+            f"{self.service}.{self.namespace}.svc",
+        ]
+        ca_cert, ca_key = make_ca(f"{self.service}-ca", self.validity_seconds)
+        cert_pem, key_pem = issue_serving_cert(
+            ca_cert, ca_key, sans[-1], sans, self.validity_seconds
+        )
+        from cryptography.hazmat.primitives import serialization
+
+        ca_pem = ca_cert.public_bytes(serialization.Encoding.PEM)
+        if not self._patch_vwc_bundle(ca_pem):
+            return False
+        if not self._publish_secret(cert_pem, key_pem):
+            return False
+        self._write_atomic(self.cert_path, cert_pem)
+        self._write_atomic(self.key_path, key_pem, mode=0o600)
+        if self._server is not None:
+            self._server.reload_certs()
+        log.info(
+            "webhook cert rotated (expires %s)", self.expires_at().isoformat(timespec="seconds")
+        )
+        return True
+
+    def _adopt_from_secret(self) -> bool:
+        """Use the cluster Secret's cert when it is fresher than ours —
+        the shared source of truth across restarts and replicas."""
+        if self.client is None:
+            return False
+        from cryptography import x509
+
+        try:
+            secret = self.client.get_or_none("v1", "Secret", self.secret_name, self.namespace)
+        except errors.ApiError:
+            return False
+        data = (secret or {}).get("data") or {}
+        if "tls.crt" not in data or "tls.key" not in data:
+            return False
+        try:
+            cert_pem = base64.b64decode(data["tls.crt"])
+            key_pem = base64.b64decode(data["tls.key"])
+            cert = x509.load_pem_x509_certificate(cert_pem)
+        except Exception:  # noqa: BLE001 — malformed secret: mint fresh
+            return False
+        remaining = (
+            cert.not_valid_after_utc - datetime.datetime.now(datetime.timezone.utc)
+        ).total_seconds()
+        if remaining <= self.rotate_before_seconds:
+            return False
+        self._write_atomic(self.cert_path, cert_pem)
+        self._write_atomic(self.key_path, key_pem, mode=0o600)
+        return True
+
+    @staticmethod
+    def _write_atomic(path: str, data: bytes, mode: int = 0o644) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    # how many predecessor CAs stay in the caBundle through rotations
+    _KEEP_OLD_CAS = 2
+
+    def _patch_vwc_bundle(self, new_ca_pem: bytes) -> bool:
+        """Prepend the new CA to every webhook's caBundle, keeping recent
+        predecessors so apiservers holding a cached bundle (or pods still
+        serving the previous cert) stay verifiable through the rollover."""
+        if self.client is None:
+            return True
+        try:
+            vwc = self.client.get_or_none(
+                "admissionregistration.k8s.io/v1",
+                "ValidatingWebhookConfiguration",
+                self.vwc_name,
+            )
+        except errors.ApiError as e:
+            log.warning("could not read VWC %s: %s", self.vwc_name, e)
+            return False
+        if vwc is None:
+            return True  # no VWC installed (e.g. chart webhook disabled): nothing to trust-sync
+        for hook in vwc.get("webhooks", []):
+            cfg = hook.setdefault("clientConfig", {})
+            old = base64.b64decode(cfg.get("caBundle", "") or "")
+            keep = _split_pem_certs(old)[: self._KEEP_OLD_CAS]
+            cfg["caBundle"] = base64.b64encode(new_ca_pem + b"".join(keep)).decode()
+        try:
+            self.client.update(vwc)
+            return True
+        except errors.ApiError as e:
+            log.warning("could not patch VWC caBundle: %s", e)
+            return False
+
+    def _publish_secret(self, cert_pem: bytes, key_pem: bytes) -> bool:
+        if self.client is None:
+            return True
+        secret = new_object(
+            "v1",
+            "Secret",
+            self.secret_name,
+            self.namespace,
+            type="kubernetes.io/tls",
+            data={
+                "tls.crt": base64.b64encode(cert_pem).decode(),
+                "tls.key": base64.b64encode(key_pem).decode(),
+            },
+        )
+        try:
+            self.client.apply(secret)
+            return True
+        except errors.ApiError as e:
+            log.warning("could not publish webhook Secret: %s", e)
+            return False
+
+    # -- serving integration -------------------------------------------------
+
+    def attach(self, server) -> None:
+        self._server = server
+
+    def run_forever(self, interval: float = 3600.0) -> None:
+        while not self._stop.is_set():
+            try:
+                self.ensure()
+            except Exception as e:  # noqa: BLE001 — rotation must retry, never die
+                log.warning("cert rotation check failed: %s", e)
+            self._stop.wait(interval)
+
+    def start(self, interval: float = 3600.0) -> "WebhookCertManager":
+        threading.Thread(target=self.run_forever, args=(interval,), daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--webhook-bind-address", default=":9443")
     p.add_argument(
+        "--webhook-manage-certs",
+        action="store_true",
+        help="generate + rotate the webhook serving cert in-process "
+        "(publishes the TLS Secret and patches the VWC caBundle)",
+    )
+    p.add_argument(
         "--fake-cluster",
         type=int,
         metavar="N",
@@ -101,14 +107,26 @@ def main(argv=None) -> int:
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
 
     webhook_server = None
+    cert_manager = None
     if args.webhook_cert_dir:
         from tpu_operator.webhook import WebhookServer
 
         cert = os.path.join(args.webhook_cert_dir, "tls.crt")
         key = os.path.join(args.webhook_cert_dir, "tls.key")
+        if args.webhook_manage_certs:
+            from tpu_operator.certs import WebhookCertManager
+
+            cert_manager = WebhookCertManager(client, namespace, args.webhook_cert_dir)
+            try:
+                cert_manager.ensure()  # bootstrap before the first TLS bind
+            except Exception as e:  # noqa: BLE001 — the loop retries; don't crash startup
+                log.warning("webhook cert bootstrap failed (will retry): %s", e)
         webhook_server = WebhookServer(
             client, addr=_addr(args.webhook_bind_address), cert_file=cert, key_file=key
         ).start()
+        if cert_manager is not None:
+            cert_manager.attach(webhook_server)
+            cert_manager.start()
         log.info("admission webhook serving on %s", args.webhook_bind_address)
 
     stop = threading.Event()
@@ -120,6 +138,8 @@ def main(argv=None) -> int:
         while not stop.is_set() and not mgr.stopped():
             stop.wait(1.0)
     finally:
+        if cert_manager is not None:
+            cert_manager.stop()
         if webhook_server is not None:
             webhook_server.stop()
         mgr.stop()

@@ -108,10 +108,19 @@ class WebhookServer:
                 pass
 
         self.server = ThreadingHTTPServer(addr, Handler)
+        self._cert_file, self._key_file = cert_file, key_file
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
         if cert_file and key_file:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(cert_file, key_file)
-            self.server.socket = ctx.wrap_socket(self.server.socket, server_side=True)
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(cert_file, key_file)
+            self.server.socket = self._ssl_ctx.wrap_socket(self.server.socket, server_side=True)
+
+    def reload_certs(self) -> None:
+        """Re-read the serving chain from disk into the live SSL context:
+        new handshakes pick up a rotated cert with zero downtime (existing
+        connections finish on the old one)."""
+        if self._ssl_ctx is not None and self._cert_file and self._key_file:
+            self._ssl_ctx.load_cert_chain(self._cert_file, self._key_file)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -126,38 +135,24 @@ class WebhookServer:
 
 
 def generate_self_signed_cert(directory: str, hostname: str = "tpu-operator-webhook") -> Tuple[str, str, str]:
-    """Dev/bootstrap helper: self-signed serving cert. Returns
+    """Dev/bootstrap helper: CA-signed serving cert pair on disk. Returns
     (cert_path, key_path, ca_bundle_b64) — the bundle goes into the
-    ValidatingWebhookConfiguration's clientConfig.caBundle."""
+    ValidatingWebhookConfiguration's clientConfig.caBundle. Thin wrapper
+    over the certs module (WebhookCertManager owns the production
+    rotation loop)."""
     import base64
 
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
-    from cryptography.x509.oid import NameOID
+    from cryptography.hazmat.primitives import serialization
 
-    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostname)])
-    now = datetime.datetime.now(datetime.timezone.utc)
-    cert = (
-        x509.CertificateBuilder()
-        .subject_name(name)
-        .issuer_name(name)
-        .public_key(key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now)
-        .not_valid_after(now + datetime.timedelta(days=365))
-        .add_extension(
-            x509.SubjectAlternativeName([x509.DNSName(hostname), x509.DNSName(f"{hostname}.tpu-operator.svc")]),
-            critical=False,
-        )
-        .sign(key, hashes.SHA256())
-    )
-    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
-    key_pem = key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.TraditionalOpenSSL,
-        serialization.NoEncryption(),
+    from tpu_operator import certs
+
+    ca_cert, ca_key = certs.make_ca(f"{hostname}-ca", 365 * certs.DAY)
+    cert_pem, key_pem = certs.issue_serving_cert(
+        ca_cert,
+        ca_key,
+        hostname,
+        [hostname, f"{hostname}.tpu-operator.svc"],
+        365 * certs.DAY,
     )
     os.makedirs(directory, exist_ok=True)
     cert_path = os.path.join(directory, "tls.crt")
@@ -166,4 +161,5 @@ def generate_self_signed_cert(directory: str, hostname: str = "tpu-operator-webh
         f.write(cert_pem)
     with open(key_path, "wb") as f:
         f.write(key_pem)
-    return cert_path, key_path, base64.b64encode(cert_pem).decode()
+    ca_b64 = base64.b64encode(ca_cert.public_bytes(serialization.Encoding.PEM)).decode()
+    return cert_path, key_path, ca_b64
