@@ -91,6 +91,52 @@ class TestWebhookCertManager:
         bundle = base64.b64decode(vwc["webhooks"][0]["clientConfig"]["caBundle"])
         assert bundle.count(b"-----END CERTIFICATE-----") == 2
 
+    def test_published_state_resynced_while_cert_fresh(self, tmp_path):
+        """A wiped caBundle (helm upgrade) or deleted Secret must be
+        repaired on the next loop pass, not at the expiry window."""
+        client = FakeClient()
+        make_vwc(client)
+        mgr = WebhookCertManager(client, NS, str(tmp_path))
+        mgr.ensure()
+        vwc = client.get(
+            "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
+        )
+        for hook in vwc["webhooks"]:
+            hook["clientConfig"]["caBundle"] = ""
+        client.update(vwc)
+        client.delete("v1", "Secret", "tpu-operator-webhook-tls", NS)
+        assert mgr.ensure() is False  # cert fresh: no rotation...
+        # ...but published state was reconciled from disk
+        assert client.get("v1", "Secret", "tpu-operator-webhook-tls", NS)
+        vwc = client.get(
+            "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
+        )
+        assert all(h["clientConfig"]["caBundle"] for h in vwc["webhooks"])
+        # resync is idempotent: no churn when everything matches
+        rv = vwc["metadata"]["resourceVersion"]
+        mgr.ensure()
+        assert client.get(
+            "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
+        )["metadata"]["resourceVersion"] == rv
+
+    def test_adopt_rejects_mismatched_key(self, tmp_path):
+        client = FakeClient()
+        make_vwc(client)
+        mgr1 = WebhookCertManager(client, NS, str(tmp_path / "a"))
+        mgr1.ensure()
+        # corrupt the Secret: fresh cert, key from a different pair
+        from tpu_operator import certs as certs_mod
+
+        _, other_key = certs_mod.make_ca("other", certs_mod.DAY)
+        secret = client.get("v1", "Secret", "tpu-operator-webhook-tls", NS)
+        secret["data"]["tls.key"] = base64.b64encode(
+            certs_mod._key_pem(other_key)
+        ).decode()
+        client.update(secret)
+        mgr2 = WebhookCertManager(client, NS, str(tmp_path / "b"))
+        assert mgr2._adopt_from_secret() is False  # mints fresh instead
+        assert mgr2.ensure() is True
+
     def test_private_key_not_world_readable(self, tmp_path):
         import os
         import stat

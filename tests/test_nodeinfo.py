@@ -2,7 +2,7 @@
 internal/nodeinfo tests, internal/state/nodepool.go cases)."""
 
 from tpu_operator import consts
-from tpu_operator.clusterinfo import detect
+from tpu_operator.clusterinfo import LiveClusterInfo, detect
 from tpu_operator.kube.fake import FakeClient
 from tpu_operator.kube.objects import new_object
 from tpu_operator.kube.sim import make_tpu_node
@@ -58,6 +58,91 @@ def test_clusterinfo_detect():
     assert info.is_gke
     assert info.tpu_node_count == 1
     assert info.kubernetes_version.startswith("v1.29")
+
+
+def test_clusterinfo_kubelet_versions():
+    client = FakeClient()
+    client.create(make_tpu_node("tpu-0"))
+    client.create(make_tpu_node("tpu-1"))
+    info = detect(client)
+    assert sum(info.kubelet_versions.values()) == 2
+
+
+class CountingClient(FakeClient):
+    def __init__(self):
+        super().__init__()
+        self.node_lists = 0
+
+    def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
+        if kind == "Node":
+            self.node_lists += 1
+        return super().list(api_version, kind, namespace, label_selector, field_selector)
+
+
+class FakeInformer:
+    def __init__(self):
+        self.handlers = []
+
+    def add_handler(self, h):
+        self.handlers.append(h)
+
+    def fire(self):
+        for h in self.handlers:
+            h("MODIFIED", {})
+
+
+class TestLiveClusterInfo:
+    def test_unattached_stays_oneshot(self):
+        client = CountingClient()
+        client.create(make_tpu_node("tpu-0"))
+        live = LiveClusterInfo(client)
+        live.get()
+        live.get()
+        assert client.node_lists == 2  # no events feeding invalidate -> no caching
+
+    def test_attached_caches_until_node_event(self):
+        client = CountingClient()
+        client.create(make_tpu_node("tpu-0"))
+        live = LiveClusterInfo(client)
+        informer = FakeInformer()
+        live.attach(informer)
+        first = live.get()
+        assert live.get() is first  # zero node re-parsing while clean
+        assert client.node_lists == 1
+        client.create(make_tpu_node("tpu-1"))
+        informer.fire()
+        assert live.get().tpu_node_count == 2
+        assert client.node_lists == 2
+
+    def test_runtime_default_change_busts_cache(self):
+        client = CountingClient()
+        client.create(new_object("v1", "Node", "bare"))  # no runtime reported
+        live = LiveClusterInfo(client)
+        live.attach(FakeInformer())
+        assert live.get(default_runtime="containerd").container_runtime == "containerd"
+        assert live.get(default_runtime="docker").container_runtime == "docker"
+
+    def test_invalidation_during_recompute_keeps_cache_dirty(self):
+        client = CountingClient()
+        client.create(make_tpu_node("tpu-0"))
+        live = LiveClusterInfo(client)
+        live.attach(FakeInformer())
+        real_detect = detect
+
+        def racing_detect(*a, **kw):
+            live.invalidate()  # event lands mid-recompute
+            return real_detect(*a, **kw)
+
+        import tpu_operator.clusterinfo as ci
+
+        orig = ci.detect
+        ci.detect = racing_detect
+        try:
+            live.get()
+        finally:
+            ci.detect = orig
+        live.get()
+        assert client.node_lists == 2  # second get recomputed (cache stayed dirty)
 
 
 def test_node_pools_partition_by_type_topology_pool():

@@ -37,7 +37,7 @@ def _split_pem_certs(bundle: bytes):
     rest = bundle
     while _PEM_CERT_END in rest:
         head, _, rest = rest.partition(_PEM_CERT_END)
-        certs.append(head + _PEM_CERT_END + b"\n")
+        certs.append(head.lstrip() + _PEM_CERT_END + b"\n")
     return certs
 
 
@@ -173,6 +173,10 @@ class WebhookCertManager:
         Any publish failure aborts before the serving cert changes and
         retries on the next loop pass."""
         if not self.needs_rotation():
+            # disk cert is fine, but the published Secret/caBundle may have
+            # drifted (helm upgrade reapplying an empty caBundle, deleted
+            # Secret) — reconcile them from disk every pass
+            self._sync_published()
             return False
         if self._adopt_from_secret():
             if self._server is not None:
@@ -204,12 +208,55 @@ class WebhookCertManager:
         )
         return True
 
+    def _sync_published(self) -> None:
+        """Re-assert the cluster-published state from the disk cert: the
+        Secret must carry the same chain and every VWC bundle must contain
+        our CA (drift here breaks admissions long before expiry)."""
+        if self.client is None:
+            return
+        try:
+            with open(self.cert_path, "rb") as f:
+                cert_pem = f.read()
+            with open(self.key_path, "rb") as f:
+                key_pem = f.read()
+        except OSError:
+            return
+        chain = _split_pem_certs(cert_pem)
+        ca_pem = chain[-1] if len(chain) > 1 else chain[0] if chain else b""
+        if not ca_pem:
+            return
+        try:
+            secret = self.client.get_or_none("v1", "Secret", self.secret_name, self.namespace)
+        except errors.ApiError:
+            return
+        data = (secret or {}).get("data") or {}
+        if base64.b64decode(data.get("tls.crt", "") or "") != cert_pem:
+            self._publish_secret(cert_pem, key_pem)
+        try:
+            vwc = self.client.get_or_none(
+                "admissionregistration.k8s.io/v1",
+                "ValidatingWebhookConfiguration",
+                self.vwc_name,
+            )
+        except errors.ApiError:
+            return
+        if vwc is None:
+            return
+        missing = any(
+            ca_pem.strip()
+            not in base64.b64decode(h.get("clientConfig", {}).get("caBundle", "") or "")
+            for h in vwc.get("webhooks", [])
+        )
+        if missing:
+            self._patch_vwc_bundle(ca_pem)
+
     def _adopt_from_secret(self) -> bool:
         """Use the cluster Secret's cert when it is fresher than ours —
         the shared source of truth across restarts and replicas."""
         if self.client is None:
             return False
         from cryptography import x509
+        from cryptography.hazmat.primitives import serialization
 
         try:
             secret = self.client.get_or_none("v1", "Secret", self.secret_name, self.namespace)
@@ -222,6 +269,11 @@ class WebhookCertManager:
             cert_pem = base64.b64decode(data["tls.crt"])
             key_pem = base64.b64decode(data["tls.key"])
             cert = x509.load_pem_x509_certificate(cert_pem)
+            key = serialization.load_pem_private_key(key_pem, password=None)
+            # a mismatched pair must never land on disk: load_cert_chain
+            # would fail and needs_rotation() would still report fresh
+            if key.public_key().public_numbers() != cert.public_key().public_numbers():
+                return False
         except Exception:  # noqa: BLE001 — malformed secret: mint fresh
             return False
         remaining = (
@@ -306,7 +358,13 @@ class WebhookCertManager:
                 self.ensure()
             except Exception as e:  # noqa: BLE001 — rotation must retry, never die
                 log.warning("cert rotation check failed: %s", e)
-            self._stop.wait(interval)
+            # while the cert is missing/expiring (e.g. bootstrap against an
+            # unreachable apiserver), retry fast instead of hourly
+            try:
+                wait = interval if not self.needs_rotation() else min(interval, 15.0)
+            except Exception:  # noqa: BLE001
+                wait = interval
+            self._stop.wait(wait)
 
     def start(self, interval: float = 3600.0) -> "WebhookCertManager":
         threading.Thread(target=self.run_forever, args=(interval,), daemon=True).start()

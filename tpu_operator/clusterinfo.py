@@ -11,6 +11,8 @@ labels at all.
 from __future__ import annotations
 
 import dataclasses
+import threading
+from typing import Dict, Optional
 
 from tpu_operator import consts
 from tpu_operator.kube.client import Client
@@ -22,6 +24,8 @@ class ClusterInfo:
     container_runtime: str = consts.RUNTIME_CONTAINERD
     is_gke: bool = False
     tpu_node_count: int = 0
+    # kubelet version -> node count, for version-skew-driven gating
+    kubelet_versions: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD, nodes=None) -> ClusterInfo:
@@ -37,6 +41,7 @@ def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD, nod
     k8s_version = ""
     is_gke = False
     tpu_nodes = 0
+    kubelet_versions: Dict[str, int] = {}
     for node in nodes:
         labels = node.get("metadata", {}).get("labels", {}) or {}
         if consts.GKE_NODEPOOL_LABEL in labels:
@@ -44,8 +49,11 @@ def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD, nod
         if is_tpu_node(node):
             tpu_nodes += 1
         info = node.get("status", {}).get("nodeInfo", {})
-        if not k8s_version and info.get("kubeletVersion"):
-            k8s_version = info["kubeletVersion"]
+        kubelet = info.get("kubeletVersion", "")
+        if kubelet:
+            kubelet_versions[kubelet] = kubelet_versions.get(kubelet, 0) + 1
+            if not k8s_version:
+                k8s_version = kubelet
         crv = info.get("containerRuntimeVersion", "")
         if crv and not runtime:
             runtime = crv.split(":")[0].replace("://", "")
@@ -54,4 +62,57 @@ def detect(client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD, nod
         container_runtime=runtime or default_runtime,
         is_gke=is_gke,
         tpu_node_count=tpu_nodes,
+        kubelet_versions=kubelet_versions,
     )
+
+
+class LiveClusterInfo:
+    """Live mode (reference: clusterinfo.go:83-125 — oneshot vs live):
+    facts cached across reconciles and invalidated by node watch events,
+    so the reconcile hot path does zero node re-parsing while nothing
+    changes. ``detect`` remains the oneshot mode."""
+
+    def __init__(self, client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD):
+        self.client = client
+        self.default_runtime = default_runtime
+        self._lock = threading.Lock()
+        self._cache: Optional[ClusterInfo] = None
+        self._cached_runtime_default = ""
+        self._generation = 0  # bumped by invalidate; guards the recompute race
+        self._clean_generation = -1
+        # caching is only sound once node events feed invalidate(); until
+        # attach() every get() recomputes (oneshot behavior)
+        self._attached = False
+
+    def attach(self, informer) -> None:
+        """Subscribe to a Node informer: any add/update/delete busts the
+        cache (facts only change when a node object changes). Enables
+        caching — unattached, get() stays oneshot."""
+        informer.add_handler(lambda *_args: self.invalidate())
+        self._attached = True
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._generation += 1
+
+    def get(self, nodes=None, default_runtime: Optional[str] = None) -> ClusterInfo:
+        """Cached facts; recomputes only after an invalidation (or when
+        the caller's runtime default changed, which alters the fallback)."""
+        runtime_default = default_runtime or self.default_runtime
+        with self._lock:
+            if (
+                self._attached
+                and self._cache is not None
+                and self._clean_generation == self._generation
+                and self._cached_runtime_default == runtime_default
+            ):
+                return self._cache
+            generation = self._generation
+        info = detect(self.client, runtime_default, nodes=nodes)
+        with self._lock:
+            self._cache = info
+            self._cached_runtime_default = runtime_default
+            # an invalidation racing the recompute keeps the cache dirty
+            if self._generation == generation:
+                self._clean_generation = generation
+        return info

@@ -106,13 +106,23 @@ def main(argv=None) -> int:
     setup_tpuslice(mgr, TPUSliceReconciler(client, namespace))
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
 
-    webhook_server = None
+    stop = threading.Event()
+    webhook_holder: dict = {}
     cert_manager = None
     if args.webhook_cert_dir:
         from tpu_operator.webhook import WebhookServer
 
         cert = os.path.join(args.webhook_cert_dir, "tls.crt")
         key = os.path.join(args.webhook_cert_dir, "tls.key")
+
+        def start_webhook() -> None:
+            webhook_holder["server"] = WebhookServer(
+                client, addr=_addr(args.webhook_bind_address), cert_file=cert, key_file=key
+            ).start()
+            if cert_manager is not None:
+                cert_manager.attach(webhook_holder["server"])
+            log.info("admission webhook serving on %s", args.webhook_bind_address)
+
         if args.webhook_manage_certs:
             from tpu_operator.certs import WebhookCertManager
 
@@ -121,15 +131,24 @@ def main(argv=None) -> int:
                 cert_manager.ensure()  # bootstrap before the first TLS bind
             except Exception as e:  # noqa: BLE001 — the loop retries; don't crash startup
                 log.warning("webhook cert bootstrap failed (will retry): %s", e)
-        webhook_server = WebhookServer(
-            client, addr=_addr(args.webhook_bind_address), cert_file=cert, key_file=key
-        ).start()
-        if cert_manager is not None:
-            cert_manager.attach(webhook_server)
             cert_manager.start()
-        log.info("admission webhook serving on %s", args.webhook_bind_address)
+            if os.path.exists(cert) and os.path.exists(key):
+                start_webhook()
+            else:
+                # bootstrap could not publish yet (e.g. apiserver down):
+                # serve as soon as the rotation loop lands the cert files
+                # instead of crashing on a missing chain
+                def start_when_ready() -> None:
+                    while not stop.is_set():
+                        if os.path.exists(cert) and os.path.exists(key):
+                            start_webhook()
+                            return
+                        stop.wait(2.0)
 
-    stop = threading.Event()
+                threading.Thread(target=start_when_ready, daemon=True).start()
+        else:
+            start_webhook()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     mgr.start()
@@ -140,8 +159,8 @@ def main(argv=None) -> int:
     finally:
         if cert_manager is not None:
             cert_manager.stop()
-        if webhook_server is not None:
-            webhook_server.stop()
+        if webhook_holder.get("server") is not None:
+            webhook_holder["server"].stop()
         mgr.stop()
     return 0
 

@@ -57,6 +57,9 @@ class ClusterPolicyReconciler:
         # wired by setup_with_manager: cache-backed node reads (read-only
         # snapshots, no apiserver round-trip per reconcile)
         self.node_informer = None
+        # live cluster facts: recomputed only when a node event lands
+        # (reference: clusterinfo live mode, clusterinfo.go:83-125)
+        self.cluster_info = clusterinfo.LiveClusterInfo(client)
 
     def _nodes(self):
         if self.node_informer is not None and self.node_informer.has_synced():
@@ -79,10 +82,14 @@ class ClusterPolicyReconciler:
 
         cp = ClusterPolicy.from_unstructured(obj)
 
-        # init: re-detect cluster facts + label nodes every reconcile
-        # (reference: init() state_manager.go:753-895)
+        # init: cluster facts from the live cache (recomputed only after a
+        # node event) + label nodes every reconcile (reference: init()
+        # state_manager.go:753-895 recomputes each pass; live mode is the
+        # v2 improvement clusterinfo.go:83-125 offers)
         nodes = self._nodes()
-        info = clusterinfo.detect(self.client, cp.spec.operator.default_runtime, nodes=nodes)
+        info = self.cluster_info.get(
+            nodes=nodes, default_runtime=cp.spec.operator.default_runtime
+        )
         catalog = InfoCatalog(
             cluster_policy=cp,
             namespace=self.namespace,
@@ -274,6 +281,7 @@ def setup_with_manager(mgr, reconciler: ClusterPolicyReconciler) -> Controller:
     node_informer = mgr.informer_for("v1", "Node")
     ctrl.watch(node_informer, mapper=map_to_all_cps, predicate=node_labels_changed)
     reconciler.node_informer = node_informer
+    reconciler.cluster_info.attach(node_informer)
 
     def owned_daemonset(event_type, old, new) -> bool:
         refs = new["metadata"].get("ownerReferences", [])

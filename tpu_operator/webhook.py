@@ -12,7 +12,6 @@ an Error condition minutes later.
 
 from __future__ import annotations
 
-import datetime
 import json
 import logging
 import os
