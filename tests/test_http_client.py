@@ -144,6 +144,37 @@ def test_token_refresh_on_ttl_and_401(stub, tmp_path):
     assert client.token == "tok-3"
 
 
+def test_from_kubeconfig_parses_client_cert_auth(tmp_path):
+    """kind/k3s kubeconfigs use inline client-cert auth; the client must
+    materialize the CA and load the cert chain without a cluster."""
+    import base64
+
+    import yaml
+
+    from tpu_operator.webhook import generate_self_signed_cert
+
+    cert, key, ca_b64 = generate_self_signed_cert(str(tmp_path))
+    kubeconfig = {
+        "current-context": "kind",
+        "contexts": [{"name": "kind", "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1", "cluster": {
+            "server": "https://127.0.0.1:6443",
+            "certificate-authority-data": ca_b64}}],
+        "users": [{"name": "u1", "user": {
+            "client-certificate-data": base64.b64encode(open(cert, "rb").read()).decode(),
+            "client-key-data": base64.b64encode(open(key, "rb").read()).decode()}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(kubeconfig))
+    client = HttpClient.from_kubeconfig(str(path))
+    assert client.base_url == "https://127.0.0.1:6443"
+    assert client._ssl is not None
+    # token-auth variant
+    kubeconfig["users"] = [{"name": "u1", "user": {"token": "tok"}}]
+    path.write_text(yaml.safe_dump(kubeconfig))
+    assert HttpClient.from_kubeconfig(str(path)).token == "tok"
+
+
 def test_crd_plurals_from_definitions():
     from tpu_operator.kube import http_client as hc
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import ssl
 import threading
 import time
@@ -116,9 +117,80 @@ class HttpClient(Client):
             self._ssl = None
 
     @classmethod
-    def in_cluster(cls) -> "HttpClient":
-        import os
+    def from_kubeconfig(cls, path: Optional[str] = None, context: Optional[str] = None) -> "HttpClient":
+        """Build a client from a kubeconfig (the reference e2e talks to a
+        real cluster the same way): supports token and client-certificate
+        auth, inline (base64 *-data) or file-referenced credentials."""
+        import base64
+        import tempfile
 
+        import yaml
+
+        path = path or os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f) or {}
+        base_dir = os.path.dirname(os.path.abspath(path))
+
+        def by_name(section, name):
+            for entry in cfg.get(section, []) or []:
+                if entry.get("name") == name:
+                    return entry
+            raise errors.ApiError(f"kubeconfig: no {section} entry named {name!r}")
+
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = by_name("contexts", ctx_name)["context"]
+        cluster = by_name("clusters", ctx["cluster"])["cluster"]
+        user = by_name("users", ctx["user"])["user"]
+
+        def resolve(entry: dict, file_key: str) -> Optional[str]:
+            # kubectl resolves relative credential paths against the
+            # kubeconfig's own directory
+            p = entry.get(file_key)
+            if p and not os.path.isabs(p):
+                p = os.path.join(base_dir, p)
+            return p
+
+        def decoded(entry: dict, inline_key: str, file_key: str) -> Optional[bytes]:
+            if entry.get(inline_key):
+                return base64.b64decode(entry[inline_key])
+            p = resolve(entry, file_key)
+            if p:
+                with open(p, "rb") as f:
+                    return f.read()
+            return None
+
+        client = cls(cluster["server"], token=user.get("token"))
+        if client._ssl is not None:
+            ca_pem = decoded(cluster, "certificate-authority-data", "certificate-authority")
+            if ca_pem:
+                client._ssl.load_verify_locations(cadata=ca_pem.decode())
+            cert_pem = decoded(user, "client-certificate-data", "client-certificate")
+            key_pem = decoded(user, "client-key-data", "client-key")
+            if cert_pem and key_pem:
+                # stdlib ssl only loads cert chains from files: stage them
+                # 0600 and unlink immediately after the (synchronous) load
+                paths = []
+                try:
+                    for data in (cert_pem, key_pem):
+                        fd, tmp = tempfile.mkstemp(suffix=".pem")
+                        os.fchmod(fd, 0o600)
+                        with os.fdopen(fd, "wb") as f:
+                            f.write(data)
+                        paths.append(tmp)
+                    client._ssl.load_cert_chain(paths[0], paths[1])
+                finally:
+                    for tmp in paths:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+            if cluster.get("insecure-skip-tls-verify"):
+                client._ssl.check_hostname = False
+                client._ssl.verify_mode = ssl.CERT_NONE
+        return client
+
+    @classmethod
+    def in_cluster(cls) -> "HttpClient":
         host = os.environ.get("KUBERNETES_SERVICE_HOST")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         if not host:
