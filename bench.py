@@ -122,10 +122,11 @@ def tpu_details() -> dict:
             details["triad_detail"] = detail
         from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
 
-        mm = matmul_tflops(size=8192 if platform != "cpu" else 512, iters=64 if platform != "cpu" else 8)
-        details["matmul_bf16_tflops"] = round(mm["tflops"], 2)
+        mm = matmul_tflops(size=8192 if platform != "cpu" else 512, iters=16 if platform != "cpu" else 2)
+        key = "matmul_bf16_tflops_lower_bound" if mm.get("unstable_timing") else "matmul_bf16_tflops"
+        details[key] = round(mm["tflops"], 2)
         gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
-        if gen in PEAK_TFLOPS:
+        if gen in PEAK_TFLOPS and not mm.get("unstable_timing"):
             details["mxu_utilization_pct"] = round(100 * mm["tflops"] / PEAK_TFLOPS[gen], 1)
         if platform != "cpu":
             from tpu_operator.workloads.allreduce import run_allreduce

@@ -86,10 +86,18 @@ class TestRealApiserver:
 
             assert wait_for(ready, timeout=120), "ClusterPolicy never became Ready"
 
-            # live update flows through the watch -> reconcile path
-            cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
-            cp["spec"].setdefault("libtpu", {})["version"] = "e2e-bump"
-            client.update(cp)
+            # live update flows through the watch -> reconcile path; retry
+            # on conflict — the controller's status writes race this PUT
+            for _ in range(10):
+                cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+                cp["spec"].setdefault("libtpu", {})["version"] = "e2e-bump"
+                try:
+                    client.update(cp)
+                    break
+                except errors.Conflict:
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("spec update kept conflicting")
             assert wait_for(ready, timeout=60), "not Ready after live update"
         finally:
             mgr.stop()

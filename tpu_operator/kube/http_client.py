@@ -126,7 +126,19 @@ class HttpClient(Client):
 
         import yaml
 
-        path = path or os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        if path is None:
+            # KUBECONFIG may be a colon-separated list (kubectl merges
+            # them); use the first existing file
+            candidates = [
+                p
+                for p in os.environ.get("KUBECONFIG", "").split(os.pathsep)
+                if p and os.path.exists(os.path.expanduser(p))
+            ]
+            path = (
+                os.path.expanduser(candidates[0])
+                if candidates
+                else os.path.expanduser("~/.kube/config")
+            )
         with open(path) as f:
             cfg = yaml.safe_load(f) or {}
         base_dir = os.path.dirname(os.path.abspath(path))
@@ -135,7 +147,10 @@ class HttpClient(Client):
             for entry in cfg.get(section, []) or []:
                 if entry.get("name") == name:
                     return entry
-            raise errors.ApiError(f"kubeconfig: no {section} entry named {name!r}")
+            # a local config problem, not an apiserver error — fail loudly
+            # instead of letting ApiError handlers misread it as the
+            # cluster being unreachable
+            raise ValueError(f"kubeconfig {path}: no {section} entry named {name!r}")
 
         ctx_name = context or cfg.get("current-context", "")
         ctx = by_name("contexts", ctx_name)["context"]

@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from tpu_operator.workloads.timing import two_point_min_timing
+
 try:
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -114,52 +116,19 @@ def hbm_bandwidth_probe(size_mb: int = 128, iters: int = 50, reps: int = 3) -> d
         "platform": platform,
         "kernel": "triad_inplace" if inplace else "triad",
     }
-    seeds = iter(1.0 + 0.001 * k for k in range(1000))
     if platform != "tpu":
         # interpret mode: one cheap timing, the number is not a hardware
         # bandwidth anyway
-        float(chain(x, y, next(seeds), iters))
+        float(chain(x, y, 1.0, iters))
         t0 = time.perf_counter()
-        float(chain(x, y, next(seeds), iters))
+        float(chain(x, y, 1.001, iters))
         dt = (time.perf_counter() - t0) / iters
         report.update({"time_ms": dt * 1e3, "bandwidth_gbps": moved / dt / 1e9})
         return report
 
-    lo, hi = iters, 6 * iters
-    for n in (lo, hi):
-        float(chain(x, y, next(seeds), n))  # compile + warm both programs
-    mins = {lo: float("inf"), hi: float("inf")}
-    # interleave the two counts so ambient load drifts (relay contention)
-    # hit both equally instead of biasing the slope
-    for _ in range(reps):
-        for n in (lo, hi):
-            t0 = time.perf_counter()
-            float(chain(x, y, next(seeds), n))
-            mins[n] = min(mins[n], time.perf_counter() - t0)
-    dt = (mins[hi] - mins[lo]) / (hi - lo)
-    report.update(
-        {
-            "inclusive_gbps": moved * hi / mins[hi] / 1e9,
-            "iters": [lo, hi],
-            "min_times_ms": [round(mins[lo] * 1e3, 2), round(mins[hi] * 1e3, 2)],
-        }
-    )
-    if dt <= 0:
-        # noise swamped the slope: report only the (overhead-inclusive)
-        # lower bound rather than a fabricated number
-        report.update(
-            {
-                "time_ms": mins[hi] / hi * 1e3,
-                "bandwidth_gbps": moved * hi / mins[hi] / 1e9,
-                "unstable_timing": True,
-            }
-        )
-        return report
-    report.update(
-        {
-            "time_ms": dt * 1e3,
-            "bandwidth_gbps": moved / dt / 1e9,
-            "dispatch_overhead_ms_est": (mins[lo] - dt * lo) * 1e3,
-        }
-    )
+    timing = two_point_min_timing(lambda s, n: float(chain(x, y, s, n)), iters, 6 * iters, reps)
+    report["inclusive_gbps"] = moved / timing.inclusive_per_iter_s / 1e9
+    report.update(timing.report_fields())
+    per_iter = timing.per_iter_s or timing.inclusive_per_iter_s
+    report.update({"time_ms": per_iter * 1e3, "bandwidth_gbps": moved / per_iter / 1e9})
     return report

@@ -8,7 +8,6 @@ chip generation's published peak.
 
 from __future__ import annotations
 
-import time
 from functools import partial
 
 import jax
@@ -16,15 +15,22 @@ import jax.numpy as jnp
 from jax import lax
 from math import isfinite as np_isfinite
 
+from tpu_operator.workloads.timing import two_point_min_timing
+
 # published dense bf16 peak TFLOP/s per chip, for utilization reporting
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def matmul_tflops(size: int = 8192, iters: int = 64, unroll: int = 8) -> dict:
-    """z = z @ y chained ``iters`` times INSIDE one jitted fori_loop: the
-    whole timed region is a single device program, so host dispatch
-    latency (large under the remote-relay dev setup) never pollutes the
-    measurement. 2*N^3 FLOPs per step."""
+def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 3) -> dict:
+    """z = z @ y chained INSIDE one jitted fori_loop: the whole timed
+    region is a single device program, so host dispatch latency (large
+    AND noisy under the remote-relay dev setup) never sits between
+    matmuls. The per-iteration time comes from chains of two lengths
+    (``iters`` and ``6*iters``), interleaved min-over-``reps`` sampling —
+    the fixed dispatch overhead cancels in the difference (same scheme as
+    kernels.hbm_bandwidth_probe). 2*N^3 FLOPs per step; a per-call seed
+    scalar keeps every timed call's inputs distinct so a relay can never
+    serve a cached result."""
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
     # scale so the chain neither explodes nor vanishes
@@ -32,25 +38,28 @@ def matmul_tflops(size: int = 8192, iters: int = 64, unroll: int = 8) -> dict:
          / jnp.bfloat16(size ** 0.5))
 
     @partial(jax.jit, static_argnames="n")
-    def chain(z, y, n):
-        out = lax.fori_loop(0, n, lambda i, acc: acc @ y, z, unroll=unroll)
+    def chain(z, y, s, n):
+        out = lax.fori_loop(0, n, lambda i, acc: acc @ y, z * s, unroll=unroll)
         # reduce to a scalar INSIDE the program: fetching it is what forces
         # execution (on relayed dev backends block_until_ready can return
         # before the work actually runs)
         return jnp.float32(out.sum())
 
-    warm = float(chain(x, y, iters))  # compile + warm the exact program
-    x2 = jax.random.normal(jax.random.PRNGKey(2), (size, size), dtype=jnp.bfloat16)
-    t0 = time.perf_counter()
-    fetched = float(chain(x2, y, iters))  # fresh data defeats result caching
-    dt = (time.perf_counter() - t0) / iters
+    fetched = []
+
+    def run(seed, n):
+        fetched.append(float(chain(x, y, seed, n)))
+
+    timing = two_point_min_timing(run, iters, 6 * iters, reps)
+    if not all(np_isfinite(v) for v in fetched):
+        raise RuntimeError(f"matmul chain produced non-finite values: {fetched}")
     flops = 2 * size**3
-    tflops = flops / dt / 1e12
-    if not (np_isfinite(warm) and np_isfinite(fetched)):
-        raise RuntimeError(f"matmul chain produced non-finite values: {warm}, {fetched}")
-    return {
+    report = {
         "size": size,
-        "time_ms": dt * 1e3,
-        "tflops": tflops,
         "platform": jax.devices()[0].platform,
+        "inclusive_tflops": flops / timing.inclusive_per_iter_s / 1e12,
     }
+    report.update(timing.report_fields())
+    per_iter = timing.per_iter_s or timing.inclusive_per_iter_s
+    report.update({"time_ms": per_iter * 1e3, "tflops": flops / per_iter / 1e12})
+    return report

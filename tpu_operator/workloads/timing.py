@@ -1,0 +1,81 @@
+"""Two-point chain timing for relayed/remote device backends.
+
+Per-program dispatch overhead on a relayed backend is both large
+(~100 ms here) and noisy (±40 ms), so a single inclusive timing of a
+chained kernel under-reports throughput severalfold. The scheme used by
+every device probe in this package: time the same chained program at two
+iteration counts, interleave the repetitions of both counts (so ambient
+load drifts hit both equally instead of biasing the slope), take the min
+per count (minimum filters the long-tailed dispatch noise), and derive
+the per-iteration time from the difference — the fixed overhead cancels
+exactly. Each timed call gets a distinct seed scalar so a relay can
+never serve a cached result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass
+class TwoPointTiming:
+    lo: int
+    hi: int
+    min_lo_s: float
+    min_hi_s: float
+    # per-iteration seconds from the slope; None when noise swamped it
+    # (mins[hi] <= mins[lo]) and only the inclusive bound is usable
+    per_iter_s: Optional[float]
+
+    @property
+    def overhead_s(self) -> Optional[float]:
+        if self.per_iter_s is None:
+            return None
+        return self.min_lo_s - self.per_iter_s * self.lo
+
+    @property
+    def inclusive_per_iter_s(self) -> float:
+        """Overhead-inclusive lower-bound rate from the long chain."""
+        return self.min_hi_s / self.hi
+
+    def report_fields(self) -> dict:
+        fields = {
+            "iters": [self.lo, self.hi],
+            "min_times_ms": [round(self.min_lo_s * 1e3, 2), round(self.min_hi_s * 1e3, 2)],
+        }
+        if self.per_iter_s is None:
+            fields["unstable_timing"] = True
+        else:
+            fields["dispatch_overhead_ms_est"] = self.overhead_s * 1e3
+        return fields
+
+
+def two_point_min_timing(
+    run: Callable[[float, int], None], lo: int, hi: int, reps: int = 3
+) -> TwoPointTiming:
+    """``run(seed, n)`` must execute (and force) one chained program of
+    ``n`` iterations with the seed folded into its inputs. Warms both
+    programs, then interleaves ``reps`` timed calls per count."""
+    if reps < 1:
+        raise ValueError(f"reps must be >= 1, got {reps}")
+    if not 0 < lo < hi:
+        raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+    seeds = iter(1.0 + 0.001 * k for k in range(2 * reps + 2))
+    for n in (lo, hi):
+        run(next(seeds), n)  # compile + warm the exact programs
+    mins = {lo: float("inf"), hi: float("inf")}
+    for _ in range(reps):
+        for n in (lo, hi):
+            t0 = time.perf_counter()
+            run(next(seeds), n)
+            mins[n] = min(mins[n], time.perf_counter() - t0)
+    dt = (mins[hi] - mins[lo]) / (hi - lo)
+    return TwoPointTiming(
+        lo=lo,
+        hi=hi,
+        min_lo_s=mins[lo],
+        min_hi_s=mins[hi],
+        per_iter_s=dt if dt > 0 else None,
+    )
