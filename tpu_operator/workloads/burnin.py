@@ -41,6 +41,12 @@ class BurninConfig:
     # shard the sequence axis over an 'sp' mesh axis and use ring attention
     # (workloads/ringattention.py) inside the block — the long-context mode
     sequence_parallel: bool = False
+    # >0 replaces the dense FFN with a top-1 routed mixture of experts
+    # sharded over an 'ep' mesh axis (GShard-style one-hot dispatch — the
+    # canonical TPU MoE formulation: XLA lowers the dispatch/combine
+    # einsums against 'ep'-sharded expert weights to all-to-alls over ICI)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @property
     def jdtype(self):
@@ -62,12 +68,30 @@ def make_mesh(devices=None, data: Optional[int] = None, model: Optional[int] = N
     return Mesh(np.array(devices).reshape(data, model), ("data", "model"))
 
 
+def _named_mesh(devices, **axes: int) -> Mesh:
+    """Mesh over named axes (in keyword order); validates the factoring."""
+    devices = devices if devices is not None else jax.devices()
+    total = 1
+    for size in axes.values():
+        total *= size
+    if total != len(devices):
+        shape = "x".join(str(s) for s in axes.values())
+        raise ValueError(f"mesh {shape} != {len(devices)} devices")
+    return Mesh(np.array(devices).reshape(*axes.values()), tuple(axes))
+
+
 def make_mesh_3d(devices=None, data: int = 2, sp: int = 2, model: int = 2) -> Mesh:
     """3-D (data, sp, model) mesh: dp x sequence-parallel x tp."""
-    devices = devices if devices is not None else jax.devices()
-    if data * sp * model != len(devices):
-        raise ValueError(f"mesh {data}x{sp}x{model} != {len(devices)} devices")
-    return Mesh(np.array(devices).reshape(data, sp, model), ("data", "sp", "model"))
+    return _named_mesh(devices, data=data, sp=sp, model=model)
+
+
+def make_mesh_4d(
+    devices=None, data: int = 1, sp: int = 2, model: int = 2, ep: int = 2
+) -> Mesh:
+    """4-D (data, sp, model, ep) mesh: dp x sequence-parallel x tp x
+    expert-parallel — the full parallelism cross-product the burn-in
+    exercises."""
+    return _named_mesh(devices, data=data, sp=sp, model=model, ep=ep)
 
 
 def param_shardings(cfg: BurninConfig) -> Dict[str, P]:
@@ -77,8 +101,14 @@ def param_shardings(cfg: BurninConfig) -> Dict[str, P]:
     for layer in range(cfg.n_layers):
         specs[f"l{layer}/qkv"] = P(None, "model")
         specs[f"l{layer}/proj"] = P("model", None)
-        specs[f"l{layer}/w1"] = P(None, "model")
-        specs[f"l{layer}/w2"] = P("model", None)
+        if cfg.moe_experts:
+            # experts over 'ep', tensor-parallel inside each expert
+            specs[f"l{layer}/router"] = P(None, None)
+            specs[f"l{layer}/moe_w1"] = P("ep", None, "model")
+            specs[f"l{layer}/moe_w2"] = P("ep", "model", None)
+        else:
+            specs[f"l{layer}/w1"] = P(None, "model")
+            specs[f"l{layer}/w2"] = P("model", None)
         specs[f"l{layer}/ln_scale"] = P(None)
     specs["out_norm"] = P(None)
     return specs
@@ -88,12 +118,20 @@ def init_params(key, cfg: BurninConfig) -> Dict[str, jax.Array]:
     params = {}
     d, f = cfg.d_model, cfg.d_ff
     for layer in range(cfg.n_layers):
-        key, k1, k2, k3, k4 = jax.random.split(key, 5)
+        key, k1, k2, k3, k4, k5 = jax.random.split(key, 6)
         s = 1.0 / np.sqrt(d)
         params[f"l{layer}/qkv"] = jax.random.normal(k1, (d, 3 * d)) * s
         params[f"l{layer}/proj"] = jax.random.normal(k2, (d, d)) * s
-        params[f"l{layer}/w1"] = jax.random.normal(k3, (d, f)) * s
-        params[f"l{layer}/w2"] = jax.random.normal(k4, (f, d)) * (1.0 / np.sqrt(f))
+        if cfg.moe_experts:
+            e = cfg.moe_experts
+            params[f"l{layer}/router"] = jax.random.normal(k5, (d, e)) * s
+            params[f"l{layer}/moe_w1"] = jax.random.normal(k3, (e, d, f)) * s
+            params[f"l{layer}/moe_w2"] = jax.random.normal(k4, (e, f, d)) * (
+                1.0 / np.sqrt(f)
+            )
+        else:
+            params[f"l{layer}/w1"] = jax.random.normal(k3, (d, f)) * s
+            params[f"l{layer}/w2"] = jax.random.normal(k4, (f, d)) * (1.0 / np.sqrt(f))
         params[f"l{layer}/ln_scale"] = jnp.ones((d,), dtype=jnp.float32)
     params["out_norm"] = jnp.ones((cfg.d_model,), dtype=jnp.float32)
     return params
@@ -137,6 +175,49 @@ def _ring_ctx(q, k, v, mesh: Mesh):
     return fn(q, k, v)
 
 
+def _moe_ffn(params, layer: int, y, cfg: BurninConfig, mesh: Optional[Mesh] = None):
+    """Top-1 routed mixture of experts, GShard-style one-hot dispatch
+    (static shapes throughout, XLA/SPMD-native):
+
+      dispatch (tokens, E, cap) one-hot -> all-to-all to 'ep'-sharded
+      expert buffers -> per-expert FFN (batched matmuls on the MXU) ->
+      combine back weighted by the router gate.
+
+    Capacity-dropped tokens pass through on the residual path, standard
+    MoE semantics. The router gradient flows through the gate value."""
+    b, s, d = y.shape
+    t = b * s
+    e = cfg.moe_experts
+    cap = max(1, int(cfg.moe_capacity_factor * t / e))
+    w1 = params[f"l{layer}/moe_w1"].astype(cfg.jdtype)
+    w2 = params[f"l{layer}/moe_w2"].astype(cfg.jdtype)
+    tokens = y.reshape(t, d)
+
+    logits = tokens.astype(jnp.float32) @ params[f"l{layer}/router"].astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)  # (t, e)
+    expert_idx = jnp.argmax(gates, axis=-1)  # (t,)
+    gate_val = jnp.max(gates, axis=-1)  # (t,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # (t, e)
+    # each token's slot within its expert's capacity buffer
+    position = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=-1)  # (t,)
+    keep = position < cap
+    dispatch = (onehot.astype(cfg.jdtype) * keep[:, None].astype(cfg.jdtype))[
+        :, :, None
+    ] * jax.nn.one_hot(position, cap, dtype=cfg.jdtype)[:, None, :]
+    # (t, e, cap)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)  # (e, cap, d)
+    if mesh is not None and "ep" in mesh.axis_names:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("ep", None, None))
+        )
+    hidden = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden, w2)  # (e, cap, d)
+    combine = dispatch * gate_val[:, None, None].astype(cfg.jdtype)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.reshape(b, s, d)
+
+
 def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None):
     b, s, d = x.shape
     h = cfg.n_heads
@@ -154,7 +235,10 @@ def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None
     ctx = ctx.reshape(b, s, d)
     x = x + ctx @ w[f"l{layer}/proj"]  # row-parallel -> psum by XLA
     y = _rmsnorm(x, params[f"l{layer}/ln_scale"])
-    x = x + jax.nn.gelu(y @ w[f"l{layer}/w1"]) @ w[f"l{layer}/w2"]
+    if cfg.moe_experts:
+        x = x + _moe_ffn(params, layer, y, cfg, mesh)
+    else:
+        x = x + jax.nn.gelu(y @ w[f"l{layer}/w1"]) @ w[f"l{layer}/w2"]
     return x
 
 
@@ -176,6 +260,13 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     cfg = cfg or BurninConfig()
     if cfg.sequence_parallel and "sp" not in mesh.axis_names:
         raise ValueError("sequence_parallel needs an 'sp' mesh axis (make_mesh_3d)")
+    if cfg.moe_experts and "ep" not in mesh.axis_names:
+        raise ValueError("moe_experts needs an 'ep' mesh axis (make_mesh_4d)")
+    if cfg.moe_experts and cfg.moe_experts % mesh.shape.get("ep", 1):
+        raise ValueError(
+            f"moe_experts ({cfg.moe_experts}) must divide evenly over the "
+            f"'ep' axis ({mesh.shape.get('ep')})"
+        )
     specs = param_shardings(cfg)
     params = init_params(jax.random.PRNGKey(0), cfg)
     params = {
