@@ -216,6 +216,7 @@ class TestSliceManagerAgent:
             report = vmain.run_component("slice", ctx, max_attempts=1)
         assert report["hosts"] == 1
         assert report["ring_attention"]["max_abs_err"] < 2e-2
+        assert report["pipeline"]["ok"] and report["pipeline"]["stages"] == 8
 
 
 class TestMetricsExporterAgent:

@@ -177,9 +177,10 @@ def validate_workload(ctx: Context) -> dict:
 
 def validate_slice(ctx: Context) -> dict:
     """Multi-host ICI check (BASELINE config 4): bring up jax.distributed
-    from the gang env, run the psum allreduce (GB/s/chip), and the
-    long-context ring-attention exactness check over the same ring."""
-    from tpu_operator.workloads import allreduce, distributed, ringattention
+    from the gang env, run the psum allreduce (GB/s/chip), the
+    long-context ring-attention exactness check over the same ring, and
+    the pipeline-parallel schedule over the device chain."""
+    from tpu_operator.workloads import allreduce, distributed, pipeline, ringattention
 
     dist = distributed.initialize()
     report = allreduce.run_allreduce()
@@ -191,6 +192,7 @@ def validate_slice(ctx: Context) -> dict:
     report["ring_attention"] = ringattention.run_ring_attention_check(
         seq_len=max(128, 32 * n)
     )
+    report["pipeline"] = pipeline.run_pipeline_check()
     return report
 
 

@@ -180,7 +180,11 @@ def run_ring_attention_check(
     )
     with mesh:
         err = float(jax.jit(fn)(jax.random.PRNGKey(0)))
-    if err > 2e-4:
+    # TPU matmuls default to bf16 mantissas (~8 bits) even on f32 inputs,
+    # so the ring-vs-dense difference sits in the 1e-3 range there; CPU
+    # computes both paths in full f32
+    tolerance = 2e-2 if mesh.devices.flat[0].platform == "tpu" else 2e-4
+    if err > tolerance:
         raise RuntimeError(f"ring attention mismatch vs dense: max abs err {err}")
     return {
         "devices": n,
