@@ -108,6 +108,21 @@ class TestChartContents:
         generated = {c["metadata"]["name"]: c for c in all_crds()}
         assert on_disk == generated, "chart crds/ drifted (scripts/update_chart_crds.py)"
 
+    def test_default_values_satisfy_schema(self):
+        """helm validates values against values.schema.json at install;
+        the chart's own defaults (and the render path's) must pass."""
+        import jsonschema
+
+        with open(os.path.join(HELM_CHART, "values.schema.json")) as f:
+            schema = yaml.safe_load(f)
+        with open(os.path.join(HELM_CHART, "values.yaml")) as f:
+            jsonschema.validate(yaml.safe_load(f), schema)
+        render_vals = load_default_values()
+        render_vals.pop("namespace")
+        jsonschema.validate(render_vals, schema)
+        with pytest.raises(jsonschema.ValidationError):
+            jsonschema.validate({"operator": {"imagePullPolicy": "Sometimes"}}, schema)
+
     def test_chart_yaml(self):
         with open(os.path.join(HELM_CHART, "Chart.yaml")) as f:
             meta = yaml.safe_load(f)

@@ -283,6 +283,30 @@ class TestUpgradeReconciler:
         assert all(node_state(client, f"tpu-{i}") == UpgradeState.DONE for i in range(2))
 
 
+    def test_upgrade_progress_published_in_cr_status(self):
+        client = FakeClient()
+        cp_rec, sim = seed(client)
+        bump_libtpu_version(client, cp_rec)
+        r = UpgradeReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        upgrade = cp["status"]["upgrade"]
+        assert upgrade["inProgress"] + upgrade["pending"] >= 1
+        assert set(upgrade["nodes"]) <= {"tpu-0", "tpu-1"}
+        # run to completion: every node reports done in status
+        for _ in range(15):
+            r.reconcile(Request(name="cluster-policy"))
+            sim.step()
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        upgrade = cp["status"]["upgrade"]
+        assert upgrade["done"] == 2 and upgrade["inProgress"] == 0
+        assert set(upgrade["nodes"].values()) == {UpgradeState.DONE}
+        # the ClusterPolicy reconciler's own status writes preserve it
+        cp_rec.reconcile(Request(name="cluster-policy"))
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        assert cp["status"]["upgrade"]["done"] == 2
+
+
 class TestUpgradeTimeout:
     def test_hung_job_parks_node_in_failed(self):
         client = FakeClient()

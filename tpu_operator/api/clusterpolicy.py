@@ -275,6 +275,10 @@ class ClusterPolicyStatus(SpecBase):
     state: str = field(default="")
     namespace: str = field(default="")
     conditions: List[dict] = field(default_factory=list)
+    # rolling-upgrade progress published by the upgrade reconciler
+    # (inProgress/done/failed/pending counts + per-node FSM state); must
+    # be declared or a real apiserver's structural pruning drops it
+    upgrade: dict = field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -45,6 +45,8 @@ class UpgradeReconciler:
         policy = cp.spec.libtpu.upgrade_policy
         if not policy.auto_upgrade:
             self.state_manager.remove_upgrade_labels()
+            # labels are gone: clear any stale progress block too
+            self._publish_upgrade_status(req.name, self.state_manager.build_state())
             return Result()
 
         state = self.state_manager.build_state()
@@ -52,9 +54,41 @@ class UpgradeReconciler:
         self.metrics.upgrades_done.set(state.count(UpgradeState.DONE))
         self.metrics.upgrades_failed.set(state.count(UpgradeState.FAILED))
         self.state_manager.apply_state(state, policy)
+        # apply_state keeps the in-memory state current (every successful
+        # transition writes node_state.state), so no re-list is needed
+        self._publish_upgrade_status(req.name, state)
 
         # re-plan on a fixed cadence (reference: plannedRequeueInterval 2 min)
         return Result(requeue_after=consts.UPGRADE_REPLAN_SECONDS)
+
+    def _publish_upgrade_status(self, cp_name: str, state) -> None:
+        """Per-node upgrade progress in ClusterPolicy status (the
+        reference exposes this via metrics only; kubectl-visible state is
+        the natural home)."""
+        upgrade = {
+            "inProgress": state.count(*IN_PROGRESS),
+            "done": state.count(UpgradeState.DONE),
+            "failed": state.count(UpgradeState.FAILED),
+            "pending": state.count(UpgradeState.UPGRADE_REQUIRED),
+            "nodes": {n.name: n.state for n in state.nodes.values() if n.state},
+        }
+        obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name)
+        if obj is None:
+            return
+        status = obj.setdefault("status", {})
+        if not upgrade["nodes"]:
+            if "upgrade" not in status:
+                return
+            del status["upgrade"]
+        elif status.get("upgrade") == upgrade:
+            return
+        else:
+            status["upgrade"] = upgrade
+        try:
+            self.client.update_status(obj)
+        except errors.ApiError as e:
+            # the ClusterPolicy reconciler races this write; next replan wins
+            log.debug("upgrade status publish skipped: %s", e)
 
 
 def setup_with_manager(mgr, reconciler: UpgradeReconciler) -> Controller:
