@@ -100,10 +100,14 @@ mgr2.start()
 wait(ready, what="post-restart Ready")
 print("STEP 5 OK: operator restart -> Ready (stateless resume)")
 
-# 6. uninstall: delete CR -> operands GC'd via ownerReferences
+# 6. uninstall: delete CR -> operands GC'd via ownerReferences, and the
+# gang objects (owned by the slice-manager DaemonSet) cascade with them
 client.delete(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
 wait(lambda: client.list("apps/v1", "DaemonSet", NS) == [], what="uninstall GC")
-print("STEP 6 OK: uninstall -> operands garbage-collected")
+wait(lambda: client.list("v1", "Pod", NS, label_selector={"app": "tpu-slice-worker"}) == [],
+     what="gang pod GC")
+assert client.get_or_none("v1", "Service", slice_names[0], NS) is None, "gang Service leaked"
+print("STEP 6 OK: uninstall -> operands + gang objects garbage-collected")
 mgr2.stop(); sim.stop()
 print("END-TO-END: PASS")
 PY

@@ -181,6 +181,30 @@ class TestSliceManagerAgent:
         agent.reconcile_once()
         assert client.get_or_none("v1", "Service", svc_name, NS) is None
 
+    def test_gang_objects_owned_by_manager_daemonset(self):
+        """Gang Services/ConfigMaps/pods carry an ownerReference to the
+        slice-manager DaemonSet so operator uninstall cascades instead of
+        leaking them."""
+        from tpu_operator.kube.objects import new_object
+
+        client = FakeClient()
+        self.seed(client)
+        ds = client.create(
+            new_object("apps/v1", "DaemonSet", "tpu-slice-manager", NS, spec={})
+        )
+        agent = SliceManagerAgent(client, NS)
+        names = agent.reconcile_once()
+        for kind, name in (
+            ("Service", names[0]),
+            ("ConfigMap", f"{names[0]}-gang"),
+            ("Pod", f"{names[0]}-0"),
+        ):
+            refs = client.get("v1", kind, name, NS)["metadata"]["ownerReferences"]
+            assert refs[0]["uid"] == ds["metadata"]["uid"], (kind, name)
+        client.delete("apps/v1", "DaemonSet", "tpu-slice-manager", NS)
+        assert client.list("v1", "Pod", NS) == []
+        assert client.get_or_none("v1", "Service", names[0], NS) is None
+
     def test_long_pool_names_never_collide(self):
         from tpu_operator.nodeinfo import TPUNodeInfo
         from tpu_operator.nodepool import NodePool

@@ -136,6 +136,7 @@ class SliceManagerAgent:
         # that never join
         active = [p for p in pools if participates(p)]
         coordinator = self._coordinator_name(active) if self.multi_slice else ""
+        self._owner_ref = self._managing_daemonset_ref()
         reconciled = []
         gang_pods: List[str] = []
         for index, pool in enumerate(active):
@@ -151,6 +152,34 @@ class SliceManagerAgent:
             self._apply_coordinator_service(coordinator, self._slice_name(active[0]))
         self._cleanup_stale(reconciled, gang_pods, coordinator)
         return reconciled
+
+    def _managing_daemonset_ref(self) -> Optional[dict]:
+        """ownerReference to the slice-manager DaemonSet: gang objects are
+        runtime state, so uninstalling the operator (CR delete -> operand
+        DS GC) must cascade to them instead of leaking Services/Pods.
+        Falls back to the last known ref — a lookup failure (restrictive
+        RBAC, DS mid-delete) must never strip ownership or kill the
+        reconcile."""
+        try:
+            ds = self.client.get_or_none(
+                "apps/v1", "DaemonSet", "tpu-slice-manager", self.namespace
+            )
+        except errors.ApiError as e:
+            log.debug("owner DaemonSet lookup failed (%s); keeping previous ref", e)
+            return getattr(self, "_owner_ref", None)
+        if ds is None or not ds["metadata"].get("uid"):
+            return getattr(self, "_owner_ref", None)
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "DaemonSet",
+            "name": ds["metadata"]["name"],
+            "uid": ds["metadata"]["uid"],
+        }
+
+    def _own(self, obj: dict) -> dict:
+        if getattr(self, "_owner_ref", None):
+            obj["metadata"]["ownerReferences"] = [dict(self._owner_ref)]
+        return obj
 
     @staticmethod
     def _slice_name(pool: NodePool) -> str:
@@ -186,7 +215,7 @@ class SliceManagerAgent:
                 "ports": [{"name": "coordinator", "port": self.coordinator_port}],
             },
         )
-        self.client.apply(svc)
+        self.client.apply(self._own(svc))
 
     def _apply_coordinator_service(self, name: str, slice0: str) -> None:
         """The multi-slice DCN coordinator: a stable ClusterIP in front of
@@ -202,7 +231,7 @@ class SliceManagerAgent:
                 "ports": [{"name": "coordinator", "port": self.coordinator_port}],
             },
         )
-        self.client.apply(svc)
+        self.client.apply(self._own(svc))
 
     def _apply_gang_pods(self, name: str, pool: NodePool) -> List[str]:
         """One COMPONENT=slice worker pod per host of the slice, scheduled
@@ -227,7 +256,11 @@ class SliceManagerAgent:
         )
         created = []
         for pod in objs:
+            # hash BEFORE attaching the ownerReference: the DS uid is
+            # metadata, and folding it into the hash would delete+recreate
+            # every running gang worker on any operator reinstall
             spec_hash = object_hash(pod)
+            self._own(pod)
             pod["metadata"].setdefault("annotations", {})[GANG_HASH_ANNOTATION] = spec_hash
             pod_name = pod["metadata"]["name"]
             existing = self.client.get_or_none("v1", "Pod", pod_name, self.namespace)
@@ -273,7 +306,7 @@ class SliceManagerAgent:
             labels=dict(MANAGED_BY),
             data=data,
         )
-        self.client.apply(cm)
+        self.client.apply(self._own(cm))
 
     def _apply_worker_ids(self, pool: NodePool) -> None:
         """Stable worker ids: sorted node order within the pool (reference
