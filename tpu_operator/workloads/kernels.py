@@ -70,16 +70,15 @@ def triad(
     )(x, y)
 
 
-def hbm_bandwidth_probe(size_mb: int = 128, iters: int = 50, reps: int = 3) -> dict:
+def hbm_bandwidth_probe(size_mb: int = 128, iters: int = 50, reps: int = 6) -> dict:
     """Measured triad bandwidth in GB/s (3 streams: 2 reads + 1 write).
 
     On TPU the per-program dispatch overhead through a relayed backend is
-    both large (~100 ms here) and noisy (±40 ms), so a single inclusive
-    timing under-reports bandwidth by 2-5x. The probe times the chained
-    kernel at two iteration counts (``iters`` and ``6*iters``), takes the
-    min over ``reps`` repetitions of each (minimum filters the
-    long-tailed dispatch noise), and derives the per-iteration time from
-    the difference — fixed overhead cancels exactly."""
+    large, noisy, and bimodal, so a single inclusive timing under-reports
+    bandwidth by 2-5x. The probe times the chained kernel at two
+    iteration counts (``iters`` and ``6*iters``) as back-to-back pairs
+    and reports the median of per-pair slopes (workloads/timing.py) —
+    fixed overhead cancels within each pair."""
     platform = jax.devices()[0].platform
     n_elems = size_mb * 1024 * 1024 // 4
     cols = 1024 if platform == "tpu" else 512

@@ -21,16 +21,16 @@ from tpu_operator.workloads.timing import two_point_min_timing
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 3) -> dict:
+def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
     """z = z @ y chained INSIDE one jitted fori_loop: the whole timed
     region is a single device program, so host dispatch latency (large
     AND noisy under the remote-relay dev setup) never sits between
-    matmuls. The per-iteration time comes from chains of two lengths
-    (``iters`` and ``6*iters``), interleaved min-over-``reps`` sampling —
-    the fixed dispatch overhead cancels in the difference (same scheme as
-    kernels.hbm_bandwidth_probe). 2*N^3 FLOPs per step; a per-call seed
-    scalar keeps every timed call's inputs distinct so a relay can never
-    serve a cached result."""
+    matmuls. The per-iteration time is the median of per-pair slopes
+    over chains of two lengths (``iters`` and ``6*iters``) — the fixed
+    dispatch overhead cancels within each back-to-back pair
+    (workloads/timing.py). 2*N^3 FLOPs per step; a per-call seed scalar
+    keeps every timed call's inputs distinct so a relay can never serve
+    a cached result."""
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
     # scale so the chain neither explodes nor vanishes

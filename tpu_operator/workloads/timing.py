@@ -1,39 +1,53 @@
 """Two-point chain timing for relayed/remote device backends.
 
 Per-program dispatch overhead on a relayed backend is both large
-(~100 ms here) and noisy (±40 ms), so a single inclusive timing of a
-chained kernel under-reports throughput severalfold. The scheme used by
-every device probe in this package: time the same chained program at two
-iteration counts, interleave the repetitions of both counts (so ambient
-load drifts hit both equally instead of biasing the slope), take the min
-per count (minimum filters the long-tailed dispatch noise), and derive
-the per-iteration time from the difference — the fixed overhead cancels
-exactly. Each timed call gets a distinct seed scalar so a relay can
-never serve a cached result.
+(~100 ms here) and noisy — and not merely noisy but BIMODAL (observed
+~105 vs ~145 ms regimes), so a single inclusive timing of a chained
+kernel under-reports throughput severalfold, and even subtracting the
+min of one iteration count from the min of another mixes regimes and
+can report impossible rates (a min-based run once exceeded HBM peak).
+
+The estimator: time the same chained program at two iteration counts as
+back-to-back (lo, hi) pairs — one pair shares an ambient regime — take
+each pair's slope (t_hi - t_lo)/(hi - lo), and report the MEDIAN of the
+per-pair slopes: the fixed overhead cancels within a pair, and
+cross-regime pairs land in the tails where the median rejects them.
+Each timed call gets a distinct seed scalar so a relay can never serve
+a cached result.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import statistics
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 
 @dataclasses.dataclass
 class TwoPointTiming:
     lo: int
     hi: int
-    min_lo_s: float
-    min_hi_s: float
-    # per-iteration seconds from the slope; None when noise swamped it
-    # (mins[hi] <= mins[lo]) and only the inclusive bound is usable
+    # per-pair (t_lo, t_hi) samples, in measurement order
+    pairs: List[tuple]
+    # per-iteration seconds: median of per-pair slopes; None when the
+    # median slope was non-positive (noise swamped the signal) and only
+    # the inclusive bound is usable
     per_iter_s: Optional[float]
+
+    @property
+    def min_lo_s(self) -> float:
+        return min(t for t, _ in self.pairs)
+
+    @property
+    def min_hi_s(self) -> float:
+        return min(t for _, t in self.pairs)
 
     @property
     def overhead_s(self) -> Optional[float]:
         if self.per_iter_s is None:
             return None
-        return self.min_lo_s - self.per_iter_s * self.lo
+        return statistics.median(t_lo for t_lo, _ in self.pairs) - self.per_iter_s * self.lo
 
     @property
     def inclusive_per_iter_s(self) -> float:
@@ -53,11 +67,11 @@ class TwoPointTiming:
 
 
 def two_point_min_timing(
-    run: Callable[[float, int], None], lo: int, hi: int, reps: int = 3
+    run: Callable[[float, int], None], lo: int, hi: int, reps: int = 5
 ) -> TwoPointTiming:
     """``run(seed, n)`` must execute (and force) one chained program of
     ``n`` iterations with the seed folded into its inputs. Warms both
-    programs, then interleaves ``reps`` timed calls per count."""
+    programs, then times ``reps`` back-to-back (lo, hi) pairs."""
     if reps < 1:
         raise ValueError(f"reps must be >= 1, got {reps}")
     if not 0 < lo < hi:
@@ -65,17 +79,21 @@ def two_point_min_timing(
     seeds = iter(1.0 + 0.001 * k for k in range(2 * reps + 2))
     for n in (lo, hi):
         run(next(seeds), n)  # compile + warm the exact programs
-    mins = {lo: float("inf"), hi: float("inf")}
+    pairs: List[tuple] = []
     for _ in range(reps):
+        times = []
         for n in (lo, hi):
             t0 = time.perf_counter()
             run(next(seeds), n)
-            mins[n] = min(mins[n], time.perf_counter() - t0)
-    dt = (mins[hi] - mins[lo]) / (hi - lo)
+            times.append(time.perf_counter() - t0)
+        pairs.append(tuple(times))
+    # median over ALL slopes, negatives included: dropping only one tail
+    # would bias the estimate upward and could leave a single
+    # cross-regime outlier masquerading as a clean measurement
+    slope = statistics.median((t_hi - t_lo) / (hi - lo) for t_lo, t_hi in pairs)
     return TwoPointTiming(
         lo=lo,
         hi=hi,
-        min_lo_s=mins[lo],
-        min_hi_s=mins[hi],
-        per_iter_s=dt if dt > 0 else None,
+        pairs=pairs,
+        per_iter_s=slope if slope > 0 else None,
     )
