@@ -151,7 +151,15 @@ class TestNodeMetrics:
     def test_collects_status_and_devices(self, ctx):
         install_libtpu(ctx)
         status_files.write_status(consts.LIBTPU_READY_FILE, ctx.validation_dir, {"ok": True})
-        status_files.write_status("slice-ready", ctx.validation_dir, {"peak_busbw_gbps_per_chip": 42.5})
+        status_files.write_status(
+            "slice-ready",
+            ctx.validation_dir,
+            {
+                "peak_busbw_gbps_per_chip": 42.5,
+                "ring_attention": {"max_abs_err": 3.5e-7},
+                "pipeline": {"ok": True, "stages": 4, "max_abs_err_vs_sequential": 9e-8},
+            },
+        )
         nm = NodeMetrics(ctx)
         nm.collect_status_files()
         nm.collect_device_count()
@@ -167,6 +175,12 @@ class TestNodeMetrics:
         assert ready[(("component", consts.PLUGIN_READY_FILE), ("node", "tpu-0"))] == 0
         assert values["tpu_operator_node_tpu_chips"][(("node", "tpu-0"),)] == 4
         assert values["tpu_operator_node_slice_allreduce_busbw_gbps"][(("node", "tpu-0"),)] == 42.5
+        assert values["tpu_operator_node_slice_ring_attention_max_abs_err"][
+            (("node", "tpu-0"),)
+        ] == 3.5e-7
+        assert values["tpu_operator_node_slice_pipeline_max_abs_err"][
+            (("node", "tpu-0"),)
+        ] == 9e-8
 
     def test_revalidation_failure_clears_barrier(self, ctx):
         status_files.write_status(consts.LIBTPU_READY_FILE, ctx.validation_dir, {"ok": True})

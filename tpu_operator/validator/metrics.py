@@ -70,6 +70,19 @@ class NodeMetrics:
             ["node"],
             registry=self.registry,
         )
+        self.slice_ring_attention_err = prometheus_client.Gauge(
+            "tpu_operator_node_slice_ring_attention_max_abs_err",
+            "Ring-vs-dense attention exactness from the last slice validation",
+            ["node"],
+            registry=self.registry,
+        )
+        self.slice_pipeline_err = prometheus_client.Gauge(
+            "tpu_operator_node_slice_pipeline_max_abs_err",
+            "Pipelined-vs-sequential exactness from the last slice validation "
+            "(failed checks never write the file — alert on component_ready)",
+            ["node"],
+            registry=self.registry,
+        )
         self._node = node
         self._stop = threading.Event()
 
@@ -87,6 +100,14 @@ class NodeMetrics:
                 busbw = payload.get("peak_busbw_gbps_per_chip")
                 if busbw is not None:
                     self.slice_busbw.labels(self._node).set(busbw)
+                ring = payload.get("ring_attention") or {}
+                if ring.get("max_abs_err") is not None:
+                    self.slice_ring_attention_err.labels(self._node).set(ring["max_abs_err"])
+                pipeline = payload.get("pipeline") or {}
+                if pipeline.get("max_abs_err_vs_sequential") is not None:
+                    self.slice_pipeline_err.labels(self._node).set(
+                        pipeline["max_abs_err_vs_sequential"]
+                    )
 
     def collect_device_count(self) -> None:
         if self.ctx.client is None or not self.ctx.node_name:
