@@ -69,6 +69,19 @@ class TestHelmParity:
         }
         assert_parity(values)
 
+    def test_webhook_managed_certs_mode(self):
+        """webhook enabled without user cert material: the Deployment runs
+        --webhook-manage-certs with a writable emptyDir instead of the
+        read-only Secret mount."""
+        values = load_default_values()
+        values["webhook"] = {"enabled": True, "failurePolicy": "Fail", "caBundle": ""}
+        assert_parity(values)
+        dep = [o for o in helm_render(values) if o["kind"] == "Deployment"][0]
+        spec = dep["spec"]["template"]["spec"]
+        args = spec["containers"][0]["args"]
+        assert "--webhook-manage-certs" in args
+        assert spec["volumes"][0] == {"name": "webhook-certs", "emptyDir": {}}
+
     def test_psa_and_no_resources_and_digest_image(self):
         values = load_default_values()
         values["clusterPolicy"]["psa"] = {"enabled": True}
