@@ -273,3 +273,40 @@ def test_graft_entry_dryrun_3d():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_graft_entry_dryrun_driver_invocation():
+    """Reproduce the driver's exact invocation context: a fresh process with
+    the ambient env (axon TPU platform registered, JAX_PLATFORMS=axon, no
+    conftest CPU forcing, no pre-set host-device-count flag).
+
+    r02 regression: the dryrun died on a transient libtpu fault because
+    array creation touched the default (TPU) backend. The hermetic dryrun
+    must pass regardless of TPU state and must initialize ONLY the cpu
+    backend."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+    )
+    code = (
+        "import __graft_entry__ as g; g.dryrun_multichip(8)\n"
+        "import jax._src.xla_bridge as xb\n"
+        "assert sorted(xb._backends) == ['cpu'], sorted(xb._backends)\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=repo_root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
+    assert "dryrun_multichip: mesh=" in proc.stdout
+    assert "pp=8 stages" in proc.stdout

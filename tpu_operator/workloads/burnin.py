@@ -268,14 +268,18 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
             f"'ep' axis ({mesh.shape.get('ep')})"
         )
     specs = param_shardings(cfg)
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    batch_spec = P("data", "sp", None) if cfg.sequence_parallel else P("data", None, None)
+    # Pin PRNG/array creation to the mesh's own platform: without this the
+    # arrays materialize on the *default* backend before device_put, so a
+    # CPU-mesh dryrun could die on an unrelated TPU fault (MULTICHIP_r02).
+    with jax.default_device(mesh.devices.flat[0]):
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        key = jax.random.PRNGKey(1)
+        x = jax.random.normal(key, (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
+        target = jax.random.normal(jax.random.PRNGKey(2), (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
     params = {
         k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
     }
-    batch_spec = P("data", "sp", None) if cfg.sequence_parallel else P("data", None, None)
-    key = jax.random.PRNGKey(1)
-    x = jax.random.normal(key, (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
-    target = jax.random.normal(jax.random.PRNGKey(2), (cfg.batch, cfg.seq_len, cfg.d_model), dtype=cfg.jdtype)
     batch = tuple(jax.device_put(a, NamedSharding(mesh, batch_spec)) for a in (x, target))
 
     param_sh = {k: NamedSharding(mesh, specs[k]) for k in params}

@@ -126,19 +126,21 @@ def run_pipeline_check(
     stages sequentially, (b) a pipelined SGD step trains (loss falls)."""
     mesh = mesh or make_pp_mesh()
     n_stages = mesh.shape["pp"]
-    key = jax.random.PRNGKey(0)
-    k_w, k_b, k_x, k_t = jax.random.split(key, 4)
-    # one linear + gelu layer per stage
-    stacked = {
-        "w": jax.random.normal(k_w, (n_stages, d_model, d_model)) / np.sqrt(d_model),
-        "b": jax.random.normal(k_b, (n_stages, d_model)) * 0.01,
-    }
+    # Pin creation to the mesh's platform so a CPU-mesh check never
+    # touches the default backend (hermeticity, see burnin.build_train_step).
+    with jax.default_device(mesh.devices.flat[0]):
+        key = jax.random.PRNGKey(0)
+        k_w, k_b, k_x, k_t = jax.random.split(key, 4)
+        # one linear + gelu layer per stage
+        stacked = {
+            "w": jax.random.normal(k_w, (n_stages, d_model, d_model)) / np.sqrt(d_model),
+            "b": jax.random.normal(k_b, (n_stages, d_model)) * 0.01,
+        }
+        x = jax.random.normal(k_x, (n_micro, batch, d_model))
+        target = jax.random.normal(k_t, (n_micro, batch, d_model))
 
     def stage_fn(p, x):
         return jax.nn.gelu(x @ p["w"] + p["b"])
-
-    x = jax.random.normal(k_x, (n_micro, batch, d_model))
-    target = jax.random.normal(k_t, (n_micro, batch, d_model))
 
     pipelined = jax.jit(
         partial(pipeline_apply, stage_fn=stage_fn, mesh=mesh)
