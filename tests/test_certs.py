@@ -119,6 +119,54 @@ class TestWebhookCertManager:
             "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
         )["metadata"]["resourceVersion"] == rv
 
+    def test_independently_minted_replicas_converge_without_thrash(self, tmp_path):
+        """Two replicas that minted independently (both run the cert
+        manager; there is no leader gate) must converge on the published
+        Secret instead of rewriting it back and forth every pass."""
+        client = FakeClient()
+        make_vwc(client)
+        mgr1 = WebhookCertManager(client, NS, str(tmp_path / "a"))
+        mgr1.ensure()
+        secret_rv = client.get("v1", "Secret", "tpu-operator-webhook-tls", NS)[
+            "metadata"
+        ]["resourceVersion"]
+        # replica 2 minted while partitioned from the apiserver
+        mgr2 = WebhookCertManager(None, NS, str(tmp_path / "b"))
+        mgr2.ensure()
+        mgr2.client = client
+        # next pass: cert is fresh, sync must ADOPT the Secret, not publish
+        assert mgr2.ensure() is False
+        secret = client.get("v1", "Secret", "tpu-operator-webhook-tls", NS)
+        assert secret["metadata"]["resourceVersion"] == secret_rv
+        with open(mgr2.cert_path, "rb") as f:
+            assert f.read() == base64.b64decode(secret["data"]["tls.crt"])
+        # and replica 1 sees no drift on its next pass either
+        assert mgr1.ensure() is False
+        assert client.get("v1", "Secret", "tpu-operator-webhook-tls", NS)[
+            "metadata"
+        ]["resourceVersion"] == secret_rv
+
+    def test_adoption_repairs_wiped_cabundle(self, tmp_path):
+        """A replica adopting the Secret's cert must still re-assert the
+        VWC caBundle: with failurePolicy=Fail, returning before that check
+        leaves admissions broken until the next (hourly) pass."""
+        client = FakeClient()
+        make_vwc(client)
+        mgr1 = WebhookCertManager(client, NS, str(tmp_path / "a"))
+        mgr1.ensure()
+        vwc = client.get(
+            "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
+        )
+        for hook in vwc["webhooks"]:
+            hook["clientConfig"]["caBundle"] = ""  # helm upgrade reapplied it empty
+        client.update(vwc)
+        mgr2 = WebhookCertManager(client, NS, str(tmp_path / "b"))
+        assert mgr2.ensure() is True  # adopted
+        vwc = client.get(
+            "admissionregistration.k8s.io/v1", "ValidatingWebhookConfiguration", "tpu-operator"
+        )
+        assert all(h["clientConfig"]["caBundle"] for h in vwc["webhooks"])
+
     def test_adopt_rejects_mismatched_key(self, tmp_path):
         client = FakeClient()
         make_vwc(client)

@@ -269,7 +269,10 @@ class SliceManagerAgent:
                 if old == spec_hash:
                     created.append(pod_name)
                     continue
-                self.client.delete("v1", "Pod", pod_name, self.namespace)
+                try:
+                    self.client.delete("v1", "Pod", pod_name, self.namespace)
+                except errors.NotFound:
+                    pass  # another host's agent deleted it first
             try:
                 self.client.create(pod)
             except (errors.Conflict, errors.AlreadyExists):
@@ -327,18 +330,26 @@ class SliceManagerAgent:
     def _cleanup_stale(
         self, live_names: List[str], live_pods: Optional[List[str]] = None, coordinator: str = ""
     ) -> None:
+        # every node's agent runs this concurrently: a racing agent deleting
+        # the same stale object first must not abort the rest of the pass
+        def delete_quietly(api_version: str, kind: str, name: str) -> None:
+            try:
+                self.client.delete(api_version, kind, name, self.namespace)
+            except errors.NotFound:
+                pass
+
         live_services = set(live_names) | ({coordinator} if coordinator else set())
         for svc in self.client.list("v1", "Service", self.namespace, label_selector=MANAGED_BY):
             if svc["metadata"]["name"] not in live_services:
-                self.client.delete("v1", "Service", svc["metadata"]["name"], self.namespace)
+                delete_quietly("v1", "Service", svc["metadata"]["name"])
         live_cms = {f"{n}-gang" for n in live_names}
         for cm in self.client.list("v1", "ConfigMap", self.namespace, label_selector=MANAGED_BY):
             if cm["metadata"]["name"] not in live_cms:
-                self.client.delete("v1", "ConfigMap", cm["metadata"]["name"], self.namespace)
+                delete_quietly("v1", "ConfigMap", cm["metadata"]["name"])
         live_pod_set = set(live_pods or [])
         for pod in self.client.list("v1", "Pod", self.namespace, label_selector=MANAGED_BY):
             if pod["metadata"]["name"] not in live_pod_set:
-                self.client.delete("v1", "Pod", pod["metadata"]["name"], self.namespace)
+                delete_quietly("v1", "Pod", pod["metadata"]["name"])
 
     def run_forever(self) -> None:
         while True:

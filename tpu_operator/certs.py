@@ -181,6 +181,11 @@ class WebhookCertManager:
         if self._adopt_from_secret():
             if self._server is not None:
                 self._server.reload_certs()
+            # the VWC caBundle may not carry the adopted chain's CA (e.g. a
+            # helm upgrade reapplied an empty bundle while we were down);
+            # with failurePolicy=Fail that blocks every CR write until the
+            # next pass, so re-assert trust before declaring success
+            self._sync_published()
             log.info("webhook cert adopted from Secret %s", self.secret_name)
             return True
         sans = [
@@ -208,30 +213,54 @@ class WebhookCertManager:
         )
         return True
 
-    def _sync_published(self) -> None:
-        """Re-assert the cluster-published state from the disk cert: the
-        Secret must carry the same chain and every VWC bundle must contain
-        our CA (drift here breaks admissions long before expiry)."""
-        if self.client is None:
-            return
+    def _read_disk_chain(self) -> Optional[Tuple[bytes, bytes, bytes]]:
+        """(cert_pem, key_pem, ca_pem) from disk, or None when absent.
+        The CA is the chain's last cert; a single-cert file is its own CA
+        (self-signed bootstrap)."""
         try:
             with open(self.cert_path, "rb") as f:
                 cert_pem = f.read()
             with open(self.key_path, "rb") as f:
                 key_pem = f.read()
         except OSError:
-            return
+            return None
         chain = _split_pem_certs(cert_pem)
         ca_pem = chain[-1] if len(chain) > 1 else chain[0] if chain else b""
         if not ca_pem:
+            return None
+        return cert_pem, key_pem, ca_pem
+
+    def _sync_published(self) -> None:
+        """Re-assert the cluster-published state from the disk cert: the
+        Secret must carry the same chain and every VWC bundle must contain
+        our CA (drift here breaks admissions long before expiry)."""
+        if self.client is None:
             return
+        disk = self._read_disk_chain()
+        if disk is None:
+            return
+        cert_pem, key_pem, ca_pem = disk
         try:
             secret = self.client.get_or_none("v1", "Secret", self.secret_name, self.namespace)
         except errors.ApiError:
             return
         data = (secret or {}).get("data") or {}
         if base64.b64decode(data.get("tls.crt", "") or "") != cert_pem:
-            self._publish_secret(cert_pem, key_pem)
+            # the cert manager runs on every replica, not just the leader:
+            # when the Secret differs, prefer adopting it (it is the shared
+            # source of truth) — republishing unconditionally would have two
+            # replicas that minted independently rewrite the Secret back and
+            # forth every pass. Republish only when the Secret's cert is
+            # stale or malformed.
+            if self._adopt_from_secret():
+                if self._server is not None:
+                    self._server.reload_certs()
+                disk = self._read_disk_chain()
+                if disk is None:
+                    return
+                cert_pem, key_pem, ca_pem = disk
+            else:
+                self._publish_secret(cert_pem, key_pem)
         try:
             vwc = self.client.get_or_none(
                 "admissionregistration.k8s.io/v1",

@@ -1,0 +1,259 @@
+"""HTTP facade over the in-memory fake apiserver.
+
+Serves the kube REST API surface the operator speaks
+(``kube/http_client.py``: CRUD + /status + pods/eviction + chunked JSON
+watch streams) over real TCP, delegating storage and semantics to a
+``FakeClient``. Purpose: drive and measure the operator over the wire —
+JSON serialization, watch-stream delivery, connection churn — instead of
+in-process dict calls. Reference counterpart: the e2e suite running the
+operator against a real apiserver (tests/e2e/gpu_operator_test.go:104-170).
+
+Scope notes:
+- watch streams start "now" (no resourceVersion replay); the client's
+  informers list-then-watch, and the controllers' periodic requeues cover
+  the list→watch gap exactly as they do against a real apiserver.
+- HTTP/1.0, one connection per request (urllib on the client side); the
+  measured overhead therefore includes connection setup, which leans
+  conservative vs client-go's pooled transport.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.http_client import plural_of
+
+log = logging.getLogger(__name__)
+
+# kinds the operator and its operands touch; the reverse plural map is
+# built from these + the CRDs (anything else 404s loudly, which is what a
+# real apiserver does for unregistered kinds)
+KNOWN_KINDS = [
+    "Pod",
+    "Node",
+    "Namespace",
+    "Service",
+    "ServiceAccount",
+    "ConfigMap",
+    "Secret",
+    "Event",
+    "Endpoints",
+    "DaemonSet",
+    "Deployment",
+    "Role",
+    "RoleBinding",
+    "ClusterRole",
+    "ClusterRoleBinding",
+    "PodDisruptionBudget",
+    "PriorityClass",
+    "Lease",
+    "ValidatingWebhookConfiguration",
+    "MutatingWebhookConfiguration",
+    "CustomResourceDefinition",
+    "ServiceMonitor",
+    "PrometheusRule",
+    "NetworkPolicy",
+    "RuntimeClass",
+]
+
+
+def _kind_map() -> Dict[str, str]:
+    kinds = list(KNOWN_KINDS)
+    try:
+        from tpu_operator.api.crds import all_crds
+
+        for crd in all_crds():
+            k = crd.get("spec", {}).get("names", {}).get("kind")
+            if k:
+                kinds.append(k)
+    except ImportError:  # pragma: no cover — import cycle window
+        pass
+    return {plural_of(k): k for k in kinds}
+
+
+class FakeApiServer:
+    """ThreadingHTTPServer translating kube REST calls onto a Client."""
+
+    def __init__(self, client: Client, host: str = "127.0.0.1", port: int = 0):
+        self.client = client
+        self._plural_to_kind = _kind_map()
+        self._stopped = threading.Event()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.0: no Content-Length bookkeeping, connection closes
+            # at end of response — watch streams read until EOF
+
+            def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> Optional[dict]:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return None
+                return json.loads(self.rfile.read(length))
+
+            def _dispatch(self, method: str) -> None:
+                try:
+                    server._handle(self, method)
+                except errors.NotFound as e:
+                    self._send(404, {"reason": "NotFound", "message": str(e)})
+                except errors.AlreadyExists as e:
+                    self._send(409, {"reason": "AlreadyExists", "message": str(e)})
+                except errors.Conflict as e:
+                    self._send(409, {"reason": "Conflict", "message": str(e)})
+                except errors.TooManyRequests as e:
+                    self._send(429, {"reason": "TooManyRequests", "message": str(e)})
+                except errors.Invalid as e:
+                    self._send(422, {"reason": "Invalid", "message": str(e)})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream
+                except Exception as e:  # noqa: BLE001 — surface as a 500
+                    log.exception("apiserver shim: %s %s", method, self.path)
+                    self._send(500, {"reason": "InternalError", "message": str(e)})
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_PUT(self):  # noqa: N802
+                self._dispatch("PUT")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fake-apiserver", daemon=True
+        )
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- request handling ----------------------------------------------------
+
+    def _parse(
+        self, path: str
+    ) -> Tuple[str, str, Optional[str], Optional[str], Optional[str]]:
+        """path -> (api_version, kind, namespace, name, subresource)."""
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] == ["api", "v1"]:
+            api_version, rest = "v1", parts[2:]
+        elif parts and parts[0] == "apis" and len(parts) >= 3:
+            api_version, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+        else:
+            raise errors.NotFound(f"unrecognized path {path}")
+        namespace = None
+        # /namespaces/<ns>/<plural>... is a namespaced collection;
+        # /namespaces or /namespaces/<name> address Namespace objects
+        if rest and rest[0] == "namespaces" and len(rest) >= 3:
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            raise errors.NotFound(f"no resource in path {path}")
+        plural, rest = rest[0], rest[1:]
+        kind = self._plural_to_kind.get(plural)
+        if kind is None:
+            raise errors.NotFound(f"unknown resource {plural!r}")
+        name = rest[0] if rest else None
+        sub = rest[1] if len(rest) > 1 else None
+        return api_version, kind, namespace, name, sub
+
+    def _handle(self, handler, method: str) -> None:
+        raw_path, _, raw_query = handler.path.partition("?")
+        query = urllib.parse.parse_qs(raw_query)
+        api_version, kind, namespace, name, sub = self._parse(raw_path)
+
+        if method == "GET" and name is None:
+            if query.get("watch") == ["true"]:
+                return self._serve_watch(handler, api_version, kind, namespace)
+            selector = None
+            if query.get("labelSelector"):
+                selector = dict(
+                    pair.split("=", 1)
+                    for pair in query["labelSelector"][0].split(",")
+                    if "=" in pair
+                )
+            items = self.client.list(api_version, kind, namespace, label_selector=selector)
+            return handler._send(
+                200,
+                {
+                    "apiVersion": api_version,
+                    "kind": f"{kind}List",
+                    "metadata": {"resourceVersion": "0"},
+                    "items": items,
+                },
+            )
+        if method == "GET":
+            return handler._send(200, self.client.get(api_version, kind, name, namespace))
+        if method == "POST" and sub == "eviction":
+            self.client.evict(name, namespace)
+            return handler._send(201, {"status": "Success"})
+        if method == "POST":
+            obj = handler._body()
+            created = self.client.create(obj)
+            return handler._send(201, created or obj)
+        if method == "PUT" and sub == "status":
+            obj = handler._body()
+            updated = self.client.update_status(obj)
+            return handler._send(200, updated or obj)
+        if method == "PUT":
+            obj = handler._body()
+            updated = self.client.update(obj)
+            return handler._send(200, updated or obj)
+        if method == "DELETE":
+            self.client.delete(api_version, kind, name, namespace)
+            return handler._send(200, {"status": "Success"})
+        raise errors.Invalid(f"unsupported {method} on {handler.path}")
+
+    def _serve_watch(self, handler, api_version: str, kind: str, namespace) -> None:
+        """Chunked JSON watch stream fed from a live FakeClient watcher.
+        Streams from 'now' — the client re-lists first (informer contract)."""
+        events: "queue.Queue" = queue.Queue()
+        sub = self.client.watch(
+            api_version, kind, lambda etype, obj: events.put((etype, obj)), namespace
+        )
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.end_headers()
+        handler.wfile.flush()
+        try:
+            while not self._stopped.is_set():
+                try:
+                    etype, obj = events.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                handler.wfile.write(
+                    json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+                )
+                handler.wfile.flush()
+        finally:
+            sub.stop()
