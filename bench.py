@@ -158,6 +158,22 @@ def tpu_details() -> dict:
                 # but measures dispatch latency, not an interconnect — never
                 # report it beside real bandwidth numbers
                 details["allreduce"] = {k: ar[k] for k in ("devices", "correctness_only")}
+        # the metrics exporter's own active probes (the DCGM-analog
+        # series), collected from this chip — proves the exported
+        # utilization gauges populate on real hardware
+        from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+
+        exporter = MetricsExporterAgent(node_name="bench")
+        exporter.collect_device_stats()
+        exporter.probe_utilization()
+        series = {
+            "chips": int(exporter.chips.labels("bench")._value.get()),
+            "matmul_tflops": round(exporter.matmul_tflops.labels("bench")._value.get(), 2),
+        }
+        util = exporter.mxu_utilization.labels("bench")._value.get()
+        if util:
+            series["mxu_utilization_pct"] = round(util, 1)
+        details["exporter_series"] = series
         # on CPU-only hosts the virtual mesh below owns the (fake-device)
         # collective measurement
         details["multichip_virtual_mesh"] = _virtual_mesh_details()

@@ -1,0 +1,11 @@
+{{/*
+Common labels for operator-owned install objects (reference:
+deployments/gpu-operator/templates/_helpers.tpl). Verified against
+helmlite's define/include support — keep in sync with the
+tpuop-cfg render path (deploy/templates/0500_deployment.yaml).
+*/}}
+{{- define "tpu-operator.labels" -}}
+app: tpu-operator
+app.kubernetes.io/name: tpu-operator
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end }}

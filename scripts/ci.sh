@@ -4,9 +4,13 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 echo "== unit tests (includes golden render drift) =="
-python3 -m pytest tests/ -q
+# the explicit image-smoke step below covers tests/test_image_smoke.py;
+# skip the in-suite copy so CI boots each entrypoint once, not twice
+TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST=1 python3 -m pytest tests/ -q
 echo "== rendered chart lints clean =="
 python3 scripts/validate_rendered.py
+echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) =="
+python3 scripts/image_smoke.py
 echo "== e2e =="
 bash tests/scripts/end-to-end.sh
 echo "CI: PASS"

@@ -251,6 +251,18 @@ class TestMetricsExporterAgent:
                   for m in agent.registry.collect()}
         assert values["tpu_exporter_chips"][(("node", "tpu-0"),)] == 8  # cpu test mesh
 
+    def test_utilization_probe_populates(self):
+        """The active compute probe (DCGM-utilization analog) must set the
+        measured-TFLOPs gauge on any platform; the %-of-peak gauge only
+        where the generation peak applies (real TPU)."""
+        agent = MetricsExporterAgent(node_name="tpu-0")
+        agent.probe_utilization()
+        values = {m.name: {tuple(sorted(s.labels.items())): s.value for s in m.samples}
+                  for m in agent.registry.collect()}
+        assert values["tpu_exporter_matmul_tflops"][(("node", "tpu-0"),)] > 0
+        # no passive duty-cycle gauge survives: it had no source anywhere
+        assert "tpu_exporter_duty_cycle" not in values
+
 
 class TestNative:
     def test_probe_shape(self):

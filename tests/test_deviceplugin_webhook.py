@@ -2,7 +2,6 @@
 clientset tests."""
 
 import json
-import threading
 import urllib.request
 
 import grpc
@@ -18,42 +17,8 @@ from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
 from tpu_operator.api.clusterpolicy import new_cluster_policy
 from tpu_operator.api.tpuslice import new_tpu_slice
 from tpu_operator.api.versioned import Clientset
-from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.kube.sim import StubKubelet, make_tpu_node
 from tpu_operator.webhook import WebhookServer, handle_review
-
-
-class StubKubelet:
-    """In-process Registration service capturing Register calls."""
-
-    def __init__(self, socket_path: str):
-        self.requests = []
-        self.event = threading.Event()
-        outer = self
-
-        def register(request, context):
-            outer.requests.append(request)
-            outer.event.set()
-            return pb.Empty()
-
-        handler = grpc.method_handlers_generic_handler(
-            "v1beta1.Registration",
-            {
-                "Register": grpc.unary_unary_rpc_method_handler(
-                    register,
-                    request_deserializer=pb.RegisterRequest.FromString,
-                    response_serializer=lambda m: m.SerializeToString(),
-                )
-            },
-        )
-        from concurrent import futures
-
-        self.server = grpc.server(thread_pool=futures.ThreadPoolExecutor(max_workers=2))
-        self.server.add_generic_rpc_handlers((handler,))
-        self.server.add_insecure_port(f"unix://{socket_path}")
-        self.server.start()
-
-    def stop(self):
-        self.server.stop(grace=0)
 
 
 class TestDevicePlugin:

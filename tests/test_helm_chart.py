@@ -121,6 +121,20 @@ class TestChartContents:
         generated = {c["metadata"]["name"]: c for c in all_crds()}
         assert on_disk == generated, "chart crds/ drifted (scripts/update_chart_crds.py)"
 
+    def test_image_pull_secrets_parity(self):
+        """imagePullSecrets renders through helm's range/with path and the
+        jinja render path identically (the range/include ceiling lift)."""
+        values = load_default_values()
+        values["operator"] = dict(
+            values.get("operator") or {}, imagePullSecrets=[{"name": "regcred"}, {"name": "gcr"}]
+        )
+        assert_parity(values)
+        dep = [o for o in helm_render(values) if o["kind"] == "Deployment"][0]
+        secrets = dep["spec"]["template"]["spec"]["imagePullSecrets"]
+        assert secrets == [{"name": "regcred"}, {"name": "gcr"}]
+        labels = dep["metadata"]["labels"]
+        assert labels["app.kubernetes.io/instance"] == "tpu-operator"
+
     def test_default_values_satisfy_schema(self):
         """helm validates values against values.schema.json at install;
         the chart's own defaults (and the render path's) must pass."""
@@ -160,8 +174,80 @@ class TestChartContents:
 
 class TestHelmliteEngine:
     def test_unsupported_construct_raises(self):
-        with pytest.raises(helmlite.HelmliteError, match="range"):
-            helmlite.render_string("{{ range .Values.items }}x{{ end }}", {"Values": {}})
+        with pytest.raises(helmlite.HelmliteError, match="block"):
+            helmlite.render_string('{{ block "x" . }}y{{ end }}', {"Values": {}})
+
+    def test_range_list_with_vars(self):
+        t = "{{ range $i, $v := .Values.items }}{{ $i }}={{ $v }};{{ end }}"
+        assert helmlite.render_string(t, {"Values": {"items": ["a", "b"]}}) == "0=a;1=b;"
+
+    def test_range_rebinds_dot_and_else(self):
+        t = "{{ range .Values.items }}[{{ .name }}]{{ else }}none{{ end }}"
+        ctx = {"Values": {"items": [{"name": "x"}, {"name": "y"}]}}
+        assert helmlite.render_string(t, ctx) == "[x][y]"
+        assert helmlite.render_string(t, {"Values": {}}) == "none"
+
+    def test_range_map_sorted(self):
+        t = "{{ range $k, $v := .Values.m }}{{ $k }}:{{ $v }},{{ end }}"
+        assert (
+            helmlite.render_string(t, {"Values": {"m": {"b": 2, "a": 1}}}) == "a:1,b:2,"
+        )
+
+    def test_with_rebinds_dot_root_stays(self):
+        t = "{{ with .Values.sub }}{{ .x }}/{{ $.Values.top }}{{ end }}"
+        ctx = {"Values": {"sub": {"x": 1}, "top": 2}}
+        assert helmlite.render_string(t, ctx) == "1/2"
+        assert helmlite.render_string("{{ with .Values.nope }}y{{ else }}n{{ end }}", {"Values": {}}) == "n"
+
+    def test_variable_assignment(self):
+        t = '{{ $name := .Values.n }}{{ $name }}-{{ $name }}'
+        assert helmlite.render_string(t, {"Values": {"n": "ab"}}) == "ab-ab"
+
+    def test_assignment_propagates_out_of_range(self):
+        """Go semantics: = assigns the enclosing declaration (the standard
+        helm found-flag idiom); := inside a block stays block-local."""
+        t = (
+            "{{ $found := false }}{{ range .Values.items }}{{ $found = true }}"
+            "{{ end }}{{ if $found }}yes{{ else }}no{{ end }}"
+        )
+        assert helmlite.render_string(t, {"Values": {"items": [1]}}) == "yes"
+        assert helmlite.render_string(t, {"Values": {"items": []}}) == "no"
+        shadow = "{{ $x := 1 }}{{ if true }}{{ $x := 2 }}{{ end }}{{ $x }}"
+        assert helmlite.render_string(shadow, {}) == "1"
+
+    def test_block_scoped_variables_do_not_leak(self):
+        with pytest.raises(helmlite.HelmliteError, match="undefined"):
+            helmlite.render_string("{{ if true }}{{ $x := 1 }}{{ end }}{{ $x }}", {})
+        with pytest.raises(helmlite.HelmliteError, match="undeclared"):
+            helmlite.render_string("{{ $x = 1 }}", {})
+
+    def test_define_include_nindent(self):
+        defines = {}
+        helmlite.load_defines(
+            '{{- define "t.labels" -}}\napp: {{ .app }}\ntier: web\n{{- end }}', defines
+        )
+        out = helmlite.render_string(
+            'meta:\n  labels:{{ include "t.labels" .Values | nindent 4 }}',
+            {"Values": {"app": "z"}},
+            defines,
+        )
+        assert yaml.safe_load(out) == {"meta": {"labels": {"app": "z", "tier": "web"}}}
+
+    def test_template_action(self):
+        defines = {}
+        helmlite.load_defines('{{ define "t.x" }}<{{ . }}>{{ end }}', defines)
+        assert (
+            helmlite.render_string('{{ template "t.x" .Values.v }}', {"Values": {"v": 7}}, defines)
+            == "<7>"
+        )
+
+    def test_helper_files_must_not_emit_text(self):
+        with pytest.raises(helmlite.HelmliteError, match="only define"):
+            helmlite.load_defines('{{ define "t" }}x{{ end }}\nstray', {})
+
+    def test_include_unknown_template_raises(self):
+        with pytest.raises(helmlite.HelmliteError, match="no template"):
+            helmlite.render_string('{{ include "missing" . }}', {})
 
     def test_trim_markers(self):
         out = helmlite.render_string("a\n{{- if true }}\nb\n{{- end }}\n", {})

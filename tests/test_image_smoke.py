@@ -1,0 +1,30 @@
+"""Image-entrypoint smoke as a suite test (scripts/image_smoke.py is the
+CI gate; this keeps Dockerfile drift inside `pytest tests/`).
+
+Runs the harness in a subprocess because the smoke boots real entrypoint
+processes with their own env (in-cluster TLS, CPU jax) that must not
+inherit this process's initialized backends."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(
+    bool(os.environ.get("TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST")),
+    reason="ci.sh runs scripts/image_smoke.py as its own explicit gate",
+)
+def test_image_entrypoints_boot():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "image_smoke.py")],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"stdout={proc.stdout[-4000:]}\nstderr={proc.stderr[-2000:]}"
+    assert "IMAGE SMOKE: PASS" in proc.stdout

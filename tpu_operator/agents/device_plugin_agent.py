@@ -410,6 +410,10 @@ def main() -> int:
             log.warning("plugin config unavailable (%s); using defaults", e)
         log.info("plugin config: %s", config or "(none)")
     plugin = TPUDevicePlugin(
+        # KUBELET_SOCKET_DIR: the kubelet's device-plugin dir is a fixed
+        # host path in production; overridable so the image smoke can run
+        # the real entrypoint against a stub kubelet socket
+        socket_dir=os.environ.get("KUBELET_SOCKET_DIR", KUBELET_SOCKET_DIR),
         install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
         config=config,
     )

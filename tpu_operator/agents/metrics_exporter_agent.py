@@ -10,8 +10,16 @@ DCGM_FI_DEV_*):
                                       memory_stats via the jax runtime)
     tpu_exporter_hbm_limit_bytes      per-chip HBM capacity
     tpu_exporter_hbm_bandwidth_gbps   measured pallas-triad HBM bandwidth
-    tpu_exporter_duty_cycle           per-chip busy fraction when the
-                                      runtime exposes it
+    tpu_exporter_matmul_tflops        measured bf16 matmul throughput
+    tpu_exporter_mxu_utilization_pct  matmul_tflops / generation peak
+
+Utilization is an ACTIVE probe (calibrated matmul burst), not a passive
+busy-fraction counter: no passive source exists on every deployment —
+PJRT memory_stats carries no duty-cycle key, and relay-attached chips
+expose neither /dev/accel nor libtpu's runtime-metrics gRPC (probed
+round 3; native/tpuinfo.cc reads the device nodes where they do exist).
+The probe measures what the DCGM-utilization analog actually promises:
+the fraction of the chip's compute the node can currently deliver.
 """
 
 from __future__ import annotations
@@ -56,8 +64,17 @@ class MetricsExporterAgent:
             ["node"],
             registry=self.registry,
         )
-        self.duty_cycle = prometheus_client.Gauge(
-            "tpu_exporter_duty_cycle", "TensorCore busy fraction", ["node", "chip"], registry=self.registry
+        self.matmul_tflops = prometheus_client.Gauge(
+            "tpu_exporter_matmul_tflops",
+            "Measured bf16 matmul throughput",
+            ["node"],
+            registry=self.registry,
+        )
+        self.mxu_utilization = prometheus_client.Gauge(
+            "tpu_exporter_mxu_utilization_pct",
+            "Measured matmul throughput as % of the generation's MXU peak",
+            ["node"],
+            registry=self.registry,
         )
         self.collect_errors = prometheus_client.Counter(
             "tpu_exporter_collect_errors_total", "Collection failures", ["node"], registry=self.registry
@@ -88,8 +105,6 @@ class MetricsExporterAgent:
                 self.hbm_used.labels(self.node_name, chip).set(stats["bytes_in_use"])
             if "bytes_limit" in stats:
                 self.hbm_limit.labels(self.node_name, chip).set(stats["bytes_limit"])
-            if "duty_cycle" in stats:
-                self.duty_cycle.labels(self.node_name, chip).set(stats["duty_cycle"])
 
     def probe_bandwidth(self) -> None:
         """Occasional active probe — the pallas triad — for achievable HBM
@@ -103,6 +118,30 @@ class MetricsExporterAgent:
             log.warning("metrics: bandwidth probe failed: %s", e)
             self.collect_errors.labels(self.node_name).inc()
 
+    def probe_utilization(self) -> None:
+        """Active compute probe: achieved bf16 matmul TFLOP/s (and % of the
+        generation's MXU peak when known) — the DCGM-utilization analog."""
+        try:
+            import jax
+
+            from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
+
+            on_tpu = jax.local_devices()[0].platform == "tpu"
+            report = matmul_tflops(
+                size=4096 if on_tpu else 256, iters=8 if on_tpu else 2
+            )
+            self.matmul_tflops.labels(self.node_name).set(report["tflops"])
+            gen = os.environ.get("PALLAS_AXON_TPU_GEN", "") or os.environ.get(
+                "TPU_GENERATION", ""
+            )
+            if on_tpu and gen in PEAK_TFLOPS and not report.get("unstable_timing"):
+                self.mxu_utilization.labels(self.node_name).set(
+                    100.0 * report["tflops"] / PEAK_TFLOPS[gen]
+                )
+        except Exception as e:  # noqa: BLE001
+            log.warning("metrics: utilization probe failed: %s", e)
+            self.collect_errors.labels(self.node_name).inc()
+
     # -- server ---------------------------------------------------------------
 
     def run_forever(self) -> None:
@@ -113,6 +152,7 @@ class MetricsExporterAgent:
             now = time.monotonic()
             if now - last_probe >= self.bandwidth_probe_interval:
                 self.probe_bandwidth()
+                self.probe_utilization()
                 last_probe = now
             self._stop.wait(self.interval)
 

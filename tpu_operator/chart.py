@@ -39,6 +39,7 @@ def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
             "image": "tpu-operator",
             "version": "1.0.0",
             "imagePullPolicy": "IfNotPresent",
+            "imagePullSecrets": [],
             "replicas": 1,
             "leaderElect": True,
             "resources": None,

@@ -7,10 +7,13 @@ binary, so CI proves the chart correct by rendering it with this engine
 and asserting object-for-object parity with ``chart.render_chart()``
 (see tests/test_helm_chart.py).
 
-The engine implements exactly the text/template + sprig subset the chart
-uses — actions with trim markers, ``.Values``/``.Release`` paths,
-``if``/``else``/``end``, pipelines, and the functions listed in
-``_FUNCTIONS`` — and *raises* on anything else, so a chart edit that
+The engine implements the text/template + sprig subset charts use —
+actions with trim markers, ``.Values``/``.Release`` paths,
+``if``/``else``/``end``, ``range`` (with ``$i, $v :=`` declarations and
+``else``), ``with``, variables (``$x := ...``, ``$`` as the root
+context), named templates (``define`` in ``*.tpl`` files, the ``include``
+function and ``template`` action), pipelines, and the functions listed
+in ``_FUNCTIONS`` — and *raises* on anything else, so a chart edit that
 outgrows the verifier fails loudly instead of silently diverging from
 what real helm would render. Semantics follow Go:
 
@@ -18,6 +21,8 @@ what real helm would render. Semantics follow Go:
   - missing map keys evaluate to None (render as empty, falsey in ``if``)
   - truthiness: nil/false/0/""/empty collection are false
   - ``toYaml`` marshals with sorted keys (sigs.k8s.io/yaml behavior)
+  - ``range`` over maps iterates keys in sorted order (text/template)
+  - inside ``define`` bodies, ``$`` and ``.`` are the invocation argument
 """
 
 from __future__ import annotations
@@ -135,7 +140,62 @@ class _If(_Node):
         self.branches: List[Tuple[Optional[str], List[_Node]]] = []
 
 
-def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False):
+class _Range(_Node):
+    def __init__(self, var_names: List[str], pipeline: str, body, else_body):
+        self.var_names = var_names  # [] | [$v] | [$i, $v]
+        self.pipeline = pipeline
+        self.body = body
+        self.else_body = else_body
+
+
+class _With(_Node):
+    def __init__(self, pipeline: str, body, else_body):
+        self.pipeline = pipeline
+        self.body = body
+        self.else_body = else_body
+
+
+class _Assign(_Node):
+    def __init__(self, name: str, pipeline: str, declare: bool):
+        self.name = name  # without the $
+        self.pipeline = pipeline
+        self.declare = declare  # := (new block-local) vs = (existing var)
+
+
+class _TemplateCall(_Node):
+    def __init__(self, name: str, pipeline: Optional[str]):
+        self.name = name
+        self.pipeline = pipeline
+
+
+def _parse_block_with_else(tokens, i, defines):
+    """Parse a body that may carry one {{ else }}; returns
+    (body, else_body, next_i)."""
+    body, i, term = _parse(tokens, i + 1, in_block=True, defines=defines)
+    else_body: List[_Node] = []
+    if term == "else":
+        else_body, i, term = _parse(tokens, i + 1, in_block=True, defines=defines)
+    if term != "end":
+        raise HelmliteError(f"expected end, got {term!r}")
+    return body, else_body, i
+
+
+def _split_range_decl(decl: str) -> Tuple[List[str], str]:
+    if ":=" in decl:
+        left, _, pipeline = decl.partition(":=")
+        names = []
+        for raw in left.split(","):
+            raw = raw.strip()
+            if not raw.startswith("$"):
+                raise HelmliteError(f"range variable {raw!r} must start with $")
+            names.append(raw[1:])
+        if len(names) > 2:
+            raise HelmliteError(f"range declares at most 2 variables: {decl!r}")
+        return names, pipeline.strip()
+    return [], decl.strip()
+
+
+def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False, defines=None):
     nodes: List[_Node] = []
     while i < len(tokens):
         kind, body = tokens[i]
@@ -151,13 +211,13 @@ def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False):
             node = _If()
             cond = body[2:].strip()
             while True:
-                sub, i, term = _parse(tokens, i + 1, in_block=True)
+                sub, i, term = _parse(tokens, i + 1, in_block=True, defines=defines)
                 node.branches.append((cond, sub))
                 if term == "end":
                     break
                 if term == "else":
                     # bare else: final branch with condition None
-                    sub, i, term2 = _parse(tokens, i + 1, in_block=True)
+                    sub, i, term2 = _parse(tokens, i + 1, in_block=True, defines=defines)
                     node.branches.append((None, sub))
                     if term2 != "end":
                         raise HelmliteError(f"expected end after else, got {term2}")
@@ -169,15 +229,51 @@ def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False):
             nodes.append(node)
             i += 1
             continue
+        if word == "range":
+            names, pipeline = _split_range_decl(body[len("range") :].strip())
+            rng_body, else_body, i = _parse_block_with_else(tokens, i, defines)
+            nodes.append(_Range(names, pipeline, rng_body, else_body))
+            i += 1
+            continue
+        if word == "with":
+            with_body, else_body, i = _parse_block_with_else(tokens, i, defines)
+            nodes.append(_With(body[len("with") :].strip(), with_body, else_body))
+            i += 1
+            continue
+        if word == "define":
+            name = body[len("define") :].strip()
+            if not (name.startswith('"') and name.endswith('"')):
+                raise HelmliteError(f"define name must be quoted: {body!r}")
+            sub, i, term = _parse(tokens, i + 1, in_block=True, defines=defines)
+            if term != "end":
+                raise HelmliteError(f"expected end after define, got {term!r}")
+            if defines is None:
+                raise HelmliteError("define outside a template file context")
+            defines[name[1:-1]] = sub
+            i += 1
+            continue
+        if word == "template":
+            rest = body[len("template") :].strip()
+            m = re.match(r'^"((?:[^"\\]|\\.)*)"\s*(.*)$', rest)
+            if not m:
+                raise HelmliteError(f"template name must be quoted: {body!r}")
+            nodes.append(_TemplateCall(m.group(1), m.group(2).strip() or None))
+            i += 1
+            continue
         if word in ("end", "else") or body.startswith("else if"):
             if not in_block:
                 raise HelmliteError(f"unexpected {body!r} outside a block")
             return nodes, i, body
-        if word in ("range", "with", "define", "template", "include", "block"):
+        if word == "block":
             raise HelmliteError(
-                f"helmlite does not implement {word!r} — extend _FUNCTIONS/_parse "
+                "helmlite does not implement 'block' — extend _parse "
                 "(and re-check against real helm) before using it in the chart"
             )
+        m = re.match(r"^\$([\w]+)\s*(:=|=)\s*(.+)$", body)
+        if m:
+            nodes.append(_Assign(m.group(1), m.group(3).strip(), m.group(2) == ":="))
+            i += 1
+            continue
         nodes.append(_Expr(body))
         i += 1
     if in_block:
@@ -192,7 +288,68 @@ def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False):
 _TOKEN_RE = re.compile(r'"(?:[^"\\]|\\.)*"|\S+')
 
 
-def _eval_atom(tok: str, ctx: Dict[str, Any]) -> Any:
+class _VarFrame:
+    """One block's variable bindings, chained to the enclosing block —
+    Go semantics: ``:=`` declares in the current block, ``=`` assigns to
+    the nearest enclosing declaration (and errors if none exists)."""
+
+    def __init__(self, parent: Optional["_VarFrame"] = None):
+        self.map: Dict[str, Any] = {}
+        self.parent = parent
+
+    def lookup(self, name: str) -> Any:
+        frame = self
+        while frame is not None:
+            if name in frame.map:
+                return frame.map[name]
+            frame = frame.parent
+        raise HelmliteError(f"undefined variable ${name}")
+
+    def declare(self, name: str, value: Any) -> None:
+        self.map[name] = value
+
+    def assign(self, name: str, value: Any) -> None:
+        frame = self
+        while frame is not None:
+            if name in frame.map:
+                frame.map[name] = value
+                return
+            frame = frame.parent
+        raise HelmliteError(f"cannot assign to undeclared variable ${name} (use :=)")
+
+
+class _Scope:
+    """Evaluation scope: the current dot, ``$`` (set at template start),
+    the variable frame chain, and the chart's named templates."""
+
+    def __init__(self, dot: Any, root: Any, variables: Optional[_VarFrame] = None,
+                 defines: Optional[Dict[str, list]] = None):
+        self.dot = dot
+        self.root = root
+        self.vars = variables if variables is not None else _VarFrame()
+        self.defines = defines if defines is not None else {}
+
+    def child(self, dot: Any) -> "_Scope":
+        # block bodies see the outer variables through the frame chain;
+        # their own declarations stay block-local (Go scoping)
+        return _Scope(dot, self.root, _VarFrame(self.vars), self.defines)
+
+
+def _walk(base: Any, path: str, full: str) -> Any:
+    cur = base
+    for part in path.split("."):
+        if not part:
+            raise HelmliteError(f"bad path {full!r}")
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = None
+        if cur is None:
+            return None
+    return cur
+
+
+def _eval_atom(tok: str, scope: _Scope) -> Any:
     if tok.startswith('"') and tok.endswith('"'):
         return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
     if tok in ("true", "false"):
@@ -204,56 +361,107 @@ def _eval_atom(tok: str, ctx: Dict[str, Any]) -> Any:
     if re.fullmatch(r"-?\d+\.\d+", tok):
         return float(tok)
     if tok == ".":
-        return ctx
+        return scope.dot
+    if tok == "$":
+        return scope.root
+    if tok.startswith("$."):
+        return _walk(scope.root, tok[2:], tok)
+    if tok.startswith("$"):
+        name, _, path = tok[1:].partition(".")
+        base = scope.vars.lookup(name)
+        return _walk(base, path, tok) if path else base
     if tok.startswith("."):
-        cur: Any = ctx
-        for part in tok[1:].split("."):
-            if not part:
-                raise HelmliteError(f"bad path {tok!r}")
-            if isinstance(cur, dict):
-                cur = cur.get(part)
-            else:
-                cur = None
-            if cur is None:
-                return None
-        return cur
+        return _walk(scope.dot, tok[1:], tok)
     raise HelmliteError(f"cannot evaluate {tok!r}")
 
 
-def _eval_segment(tokens: List[str], ctx: Dict[str, Any], piped: Any = ...) -> Any:
+def _eval_segment(tokens: List[str], scope: _Scope, piped: Any = ...) -> Any:
     head = tokens[0]
+    if head == "include":
+        args = [_eval_atom(t, scope) for t in tokens[1:]]
+        if piped is not ...:
+            args.append(piped)
+        if len(args) != 2:
+            raise HelmliteError(f"include wants (name, context), got {len(args)} args")
+        return _render_define(args[0], args[1], scope)
     if head in _FUNCTIONS:
-        args = [_eval_atom(t, ctx) for t in tokens[1:]]
+        args = [_eval_atom(t, scope) for t in tokens[1:]]
         if piped is not ...:
             args.append(piped)
         return _FUNCTIONS[head](*args)
     if len(tokens) != 1 or piped is not ...:
         raise HelmliteError(f"unknown function {head!r}")
-    return _eval_atom(head, ctx)
+    return _eval_atom(head, scope)
 
 
-def _eval_pipeline(pipeline: str, ctx: Dict[str, Any]) -> Any:
+def _eval_pipeline(pipeline: str, scope: _Scope) -> Any:
     value: Any = ...
     for segment in pipeline.split("|"):
         tokens = _TOKEN_RE.findall(segment.strip())
         if not tokens:
             raise HelmliteError(f"empty pipeline segment in {pipeline!r}")
-        value = _eval_segment(tokens, ctx, value)
+        value = _eval_segment(tokens, scope, value)
     return value
 
 
-def _render_nodes(nodes: List[_Node], ctx: Dict[str, Any]) -> str:
+def _render_define(name: str, arg: Any, scope: _Scope) -> str:
+    if name not in scope.defines:
+        raise HelmliteError(f"no template defined with name {name!r}")
+    # Go: inside a template invocation, both . and $ are the argument,
+    # and the variable scope starts fresh
+    return _render_nodes(scope.defines[name], _Scope(arg, arg, None, scope.defines))
+
+
+def _render_nodes(nodes: List[_Node], scope: _Scope) -> str:
     out: List[str] = []
     for node in nodes:
         if isinstance(node, _Text):
             out.append(node.s)
         elif isinstance(node, _Expr):
-            out.append(_gostr(_eval_pipeline(node.pipeline, ctx)))
+            out.append(_gostr(_eval_pipeline(node.pipeline, scope)))
+        elif isinstance(node, _Assign):
+            value = _eval_pipeline(node.pipeline, scope)
+            if node.declare:
+                scope.vars.declare(node.name, value)
+            else:
+                scope.vars.assign(node.name, value)
+        elif isinstance(node, _TemplateCall):
+            arg = _eval_pipeline(node.pipeline, scope) if node.pipeline else None
+            out.append(_render_define(node.name, arg, scope))
         elif isinstance(node, _If):
             for cond, body in node.branches:
-                if cond is None or _truthy(_eval_pipeline(cond, ctx)):
-                    out.append(_render_nodes(body, ctx))
+                if cond is None or _truthy(_eval_pipeline(cond, scope)):
+                    # if-bodies are blocks too: declarations stay local
+                    out.append(_render_nodes(body, scope.child(scope.dot)))
                     break
+        elif isinstance(node, _With):
+            val = _eval_pipeline(node.pipeline, scope)
+            if _truthy(val):
+                out.append(_render_nodes(node.body, scope.child(val)))
+            elif node.else_body:
+                out.append(_render_nodes(node.else_body, scope))
+        elif isinstance(node, _Range):
+            val = _eval_pipeline(node.pipeline, scope)
+            if isinstance(val, dict):
+                items = [(k, val[k]) for k in sorted(val)]  # text/template order
+            elif isinstance(val, (list, tuple)):
+                items = list(enumerate(val))
+            elif val is None:
+                items = []
+            else:
+                raise HelmliteError(f"range over non-iterable {type(val).__name__}")
+            if not items:
+                if node.else_body:
+                    out.append(_render_nodes(node.else_body, scope))
+                continue
+            for key, elem in items:
+                body_scope = scope.child(elem)
+                if len(node.var_names) == 1:
+                    body_scope.vars.declare(node.var_names[0], elem)
+                elif len(node.var_names) == 2:
+                    body_scope.vars.declare(node.var_names[0], key)
+                    body_scope.vars.declare(node.var_names[1], elem)
+                out.append(_render_nodes(node.body, body_scope))
     return "".join(out)
 
 
@@ -262,9 +470,30 @@ def _render_nodes(nodes: List[_Node], ctx: Dict[str, Any]) -> str:
 # ---------------------------------------------------------------------------
 
 
-def render_string(source: str, ctx: Dict[str, Any]) -> str:
-    nodes, _, _ = _parse(_lex(source))
-    return _render_nodes(nodes, ctx)
+def render_string(
+    source: str, ctx: Dict[str, Any], defines: Optional[Dict[str, list]] = None
+) -> str:
+    defines = defines if defines is not None else {}
+    nodes, _, _ = _parse(_lex(source), defines=defines)
+    return _render_nodes(nodes, _Scope(ctx, ctx, None, defines))
+
+
+def load_defines(source: str, defines: Dict[str, list]) -> None:
+    """Collect {{ define }} blocks from a helper file (_helpers.tpl) into
+    the shared chart-wide template namespace (helm semantics)."""
+    nodes, _, _ = _parse(_lex(source), defines=defines)
+    for node in nodes:
+        if isinstance(node, _Text):
+            if node.s.strip():
+                raise HelmliteError(
+                    f"helper files must only define templates; found output text {node.s.strip()[:40]!r}"
+                )
+        else:
+            # an expression/if/range at the top level of a .tpl would be
+            # rendered by real helm but silently lost here — fail loudly
+            raise HelmliteError(
+                f"helper files must only define templates; found {type(node).__name__} action"
+            )
 
 
 def template(
@@ -299,13 +528,21 @@ def template(
             with open(os.path.join(crd_dir, name)) as f:
                 objects.extend(d for d in yaml.safe_load_all(f) if d)
     tmpl_dir = os.path.join(chart_dir, "templates")
+    defines: Dict[str, list] = {}
+    for name in sorted(os.listdir(tmpl_dir)):
+        if name.endswith(".tpl"):
+            with open(os.path.join(tmpl_dir, name)) as f:
+                try:
+                    load_defines(f.read(), defines)
+                except HelmliteError as e:
+                    raise HelmliteError(f"{name}: {e}") from e
     for name in sorted(os.listdir(tmpl_dir)):
         if not name.endswith((".yaml", ".yml")):
             continue
         with open(os.path.join(tmpl_dir, name)) as f:
             source = f.read()
         try:
-            text = render_string(source, ctx)
+            text = render_string(source, ctx, defines)
         except HelmliteError as e:
             raise HelmliteError(f"{name}: {e}") from e
         try:

@@ -28,9 +28,14 @@ from tpu_operator.kube.objects import ObjectDict, api_group, is_cluster_scoped, 
 
 log = logging.getLogger(__name__)
 
-TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
-CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
-NAMESPACE_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+# the standard in-cluster mount; KUBE_SERVICEACCOUNT_DIR relocates it so
+# entrypoints can run against a served fake apiserver (image smoke / e2e)
+_SA_DIR = os.environ.get(
+    "KUBE_SERVICEACCOUNT_DIR", "/var/run/secrets/kubernetes.io/serviceaccount"
+)
+TOKEN_PATH = os.path.join(_SA_DIR, "token")
+CA_PATH = os.path.join(_SA_DIR, "ca.crt")
+NAMESPACE_PATH = os.path.join(_SA_DIR, "namespace")
 
 # kind -> plural for the kinds this operator touches; custom kinds load
 # from the CRD definitions (the authoritative spec.names.plural), anything
@@ -319,8 +324,13 @@ class HttpClient(Client):
         path = self._path(obj["apiVersion"], obj["kind"], md.get("namespace"), md["name"]) + "/status"
         return self._request("PUT", path, body=obj)
 
-    def delete(self, api_version, kind, name, namespace=None):
-        self._request("DELETE", self._path(api_version, kind, namespace, name))
+    def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
+        query = (
+            {"gracePeriodSeconds": str(grace_period_seconds)}
+            if grace_period_seconds is not None
+            else None
+        )
+        self._request("DELETE", self._path(api_version, kind, namespace, name), query=query)
 
     def evict(self, name, namespace):
         """POST pods/eviction (the drain path the reference's upgrade lib

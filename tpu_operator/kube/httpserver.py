@@ -80,12 +80,20 @@ def _kind_map() -> Dict[str, str]:
 
 
 class FakeApiServer:
-    """ThreadingHTTPServer translating kube REST calls onto a Client."""
+    """ThreadingHTTPServer translating kube REST calls onto a Client.
 
-    def __init__(self, client: Client, host: str = "127.0.0.1", port: int = 0):
+    ``tls=True`` mints a self-signed CA + serving cert for ``localhost``
+    (certs.py machinery) and serves HTTPS — what ``HttpClient.in_cluster``
+    expects, so real entrypoint processes can run against this server with
+    the standard in-cluster env (see scripts/image_smoke.py)."""
+
+    def __init__(
+        self, client: Client, host: str = "127.0.0.1", port: int = 0, tls: bool = False
+    ):
         self.client = client
         self._plural_to_kind = _kind_map()
         self._stopped = threading.Event()
+        self.ca_pem: bytes = b""
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -141,14 +149,40 @@ class FakeApiServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
+        self._scheme = "http"
+        if tls:
+            import ssl
+            import tempfile
+
+            from tpu_operator.certs import DAY, issue_serving_cert, make_ca
+
+            ca_cert, ca_key = make_ca("fake-apiserver-ca", DAY)
+            cert_pem, key_pem = issue_serving_cert(
+                ca_cert, ca_key, "localhost", ["localhost"], DAY
+            )
+            from cryptography.hazmat.primitives import serialization
+
+            self.ca_pem = ca_cert.public_bytes(serialization.Encoding.PEM)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            # stdlib ssl loads chains from files only: stage + unlink
+            with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
+                suffix=".pem"
+            ) as kf:
+                cf.write(cert_pem), cf.flush()
+                kf.write(key_pem), kf.flush()
+                ctx.load_cert_chain(cf.name, kf.name)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+            self._scheme = "https"
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="fake-apiserver", daemon=True
         )
 
     @property
     def base_url(self) -> str:
-        host, port = self.httpd.server_address[:2]
-        return f"http://{host}:{port}"
+        port = self.httpd.server_address[1]
+        # TLS certs name "localhost"; plain http keeps the bind address
+        host = "localhost" if self._scheme == "https" else self.httpd.server_address[0]
+        return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "FakeApiServer":
         self._thread.start()

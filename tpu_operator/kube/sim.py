@@ -195,3 +195,43 @@ def make_tpu_node(
             },
         },
     )
+
+
+class StubKubelet:
+    """In-process kubelet device-plugin Registration service (v1beta1) on a
+    unix socket, capturing Register calls — the kubelet half of the device
+    plugin contract, for tests and the image-entrypoint smoke."""
+
+    def __init__(self, socket_path: str):
+        import grpc
+
+        from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
+
+        self.requests = []
+        self.event = threading.Event()
+        outer = self
+
+        def register(request, context):
+            outer.requests.append(request)
+            outer.event.set()
+            return pb.Empty()
+
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration",
+            {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    register,
+                    request_deserializer=pb.RegisterRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                )
+            },
+        )
+        from concurrent import futures
+
+        self.server = grpc.server(thread_pool=futures.ThreadPoolExecutor(max_workers=2))
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"unix://{socket_path}")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=0)
