@@ -1,0 +1,291 @@
+"""Rolling libtpu-upgrade drill against any conformant apiserver.
+
+Transport-agnostic: the same drill runs against the HTTP-served fake
+apiserver in the regular suite (tests/test_httpserver.py) and against a
+real cluster when KUBECONFIG is supplied (tests/test_e2e_real.py) —
+proving the upgrade FSM against real eviction/PDB semantics
+(reference: the vendored upgrade lib's drain path,
+vendor/.../upgrade/upgrade_state.go:67-101).
+
+The drill provisions a synthetic tainted Node plus a driver DaemonSet/
+pod pair and plays the parts the synthetic node lacks (kubelet: pod
+status + termination finalizing; DS controller: driver-pod recreation at
+the current generation). Everything it creates is namespaced except the
+Node, and all of it is cleaned up.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import UpgradePolicySpec
+from tpu_operator.kube import errors
+from tpu_operator.kube.objects import new_object
+from tpu_operator.upgrade.fsm import (
+    DRIVER_POD_COMPONENT,
+    DRIVER_POD_COMPONENT_LABEL,
+    POD_TEMPLATE_GENERATION_LABEL,
+    ClusterUpgradeStateManager,
+    UpgradeState,
+)
+
+PAUSE_IMAGE = "registry.k8s.io/pause:3.9"
+DRILL_TAINT = {"key": "tpu.google.com/upgrade-drill", "effect": "NoSchedule"}
+
+
+def _mark_running(client, name: str, ns: str) -> None:
+    """Play the kubelet: pod Running + Ready (the disruption controller
+    counts Ready pods when computing PDB budgets)."""
+    pod = client.get_or_none("v1", "Pod", name, ns)
+    if pod is None:
+        return
+    pod["status"] = {
+        "phase": "Running",
+        "conditions": [{"type": "Ready", "status": "True"}],
+    }
+    try:
+        client.update_status(pod)
+    except errors.Conflict:
+        pass
+
+
+def _finalize_terminating(client, ns: str, node_name: str) -> None:
+    """Play the kubelet: force-finalize pods the eviction API put into
+    Terminating (a synthetic node has no kubelet to confirm)."""
+    for pod in client.list("v1", "Pod", ns):
+        md = pod["metadata"]
+        if md.get("deletionTimestamp") and pod.get("spec", {}).get("nodeName") == node_name:
+            try:
+                client.delete("v1", "Pod", md["name"], ns, grace_period_seconds=0)
+            except errors.ApiError:
+                pass
+
+
+class UpgradeDrill:
+    def __init__(self, client, ns: str):
+        self.client = client
+        self.ns = ns
+        suffix = uuid.uuid4().hex[:8]
+        self.node_name = f"tpu-drill-{suffix}"
+        self.ds_name = f"libtpu-drill-{suffix}"
+        self.driver_pod = f"{self.ds_name}-0"
+        self.workload_pod = f"drill-workload-{suffix}"
+        self.pdb_name = f"drill-pdb-{suffix}"
+        self.workload_app = f"drill-critical-{suffix}"
+
+    # -- setup / teardown ----------------------------------------------------
+
+    def setup(self) -> None:
+        c = self.client
+        c.create(
+            new_object(
+                "v1",
+                "Node",
+                self.node_name,
+                labels={consts.TPU_PRESENT_LABEL: "true"},
+                spec={"taints": [dict(DRILL_TAINT)]},
+            )
+        )
+        # nodeSelector matches nothing, so a real DS controller schedules
+        # no pods; the drill creates (and recreates) the driver pod itself
+        c.create(
+            new_object(
+                "apps/v1",
+                "DaemonSet",
+                self.ds_name,
+                self.ns,
+                spec={
+                    "selector": {"matchLabels": {DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT}},
+                    "template": {
+                        "metadata": {
+                            "labels": {DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT}
+                        },
+                        "spec": {
+                            "nodeSelector": {"tpu.google.com/upgrade-drill-never": "true"},
+                            "containers": [
+                                {"name": "drill", "image": PAUSE_IMAGE, "env": [{"name": "ROUND", "value": "1"}]}
+                            ],
+                        },
+                    },
+                },
+            )
+        )
+        self._create_driver_pod()
+        c.create(
+            new_object(
+                "v1",
+                "Pod",
+                self.workload_pod,
+                self.ns,
+                labels={"app": self.workload_app},
+                spec={
+                    "nodeName": self.node_name,
+                    "tolerations": [{"key": DRILL_TAINT["key"], "operator": "Exists"}],
+                    "containers": [
+                        {
+                            "name": "w",
+                            "image": PAUSE_IMAGE,
+                            "resources": {"limits": {consts.TPU_RESOURCE_NAME: "1"}},
+                        }
+                    ],
+                },
+            )
+        )
+        _mark_running(c, self.workload_pod, self.ns)
+        c.create(
+            new_object(
+                "policy/v1",
+                "PodDisruptionBudget",
+                self.pdb_name,
+                self.ns,
+                spec={"minAvailable": 1, "selector": {"matchLabels": {"app": self.workload_app}}},
+            )
+        )
+
+    def teardown(self) -> None:
+        c = self.client
+        for kind, name, ns in (
+            ("PodDisruptionBudget", self.pdb_name, self.ns),
+            ("Pod", self.workload_pod, self.ns),
+            ("Pod", self.driver_pod, self.ns),
+            ("DaemonSet", self.ds_name, self.ns),
+            ("Node", self.node_name, None),
+        ):
+            api = {"DaemonSet": "apps/v1", "PodDisruptionBudget": "policy/v1"}.get(kind, "v1")
+            try:
+                c.delete(api, kind, name, ns, grace_period_seconds=0 if kind == "Pod" else None)
+            except errors.ApiError:
+                pass
+
+    def _create_driver_pod(self) -> None:
+        ds = self.client.get("apps/v1", "DaemonSet", self.ds_name, self.ns)
+        gen = str(ds["metadata"].get("generation", 1))
+        pod = new_object(
+            "v1",
+            "Pod",
+            self.driver_pod,
+            self.ns,
+            labels={
+                DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT,
+                POD_TEMPLATE_GENERATION_LABEL: gen,
+            },
+            spec={
+                "nodeName": self.node_name,
+                "tolerations": [{"key": DRILL_TAINT["key"], "operator": "Exists"}],
+                "containers": [{"name": "drill", "image": PAUSE_IMAGE}],
+            },
+        )
+        pod["metadata"]["ownerReferences"] = [
+            {
+                "apiVersion": "apps/v1",
+                "kind": "DaemonSet",
+                "name": self.ds_name,
+                "uid": ds["metadata"].get("uid", ""),
+                "controller": True,
+            }
+        ]
+        self.client.create(pod)
+        _mark_running(self.client, self.driver_pod, self.ns)
+
+    # -- the drill -----------------------------------------------------------
+
+    def bump_generation(self) -> None:
+        """Spec change -> metadata.generation increments (a real apiserver
+        does this itself; the fake mirrors it), making the driver pod
+        outdated."""
+        ds = self.client.get("apps/v1", "DaemonSet", self.ds_name, self.ns)
+        ds["spec"]["template"]["spec"]["containers"][0]["env"] = [
+            {"name": "ROUND", "value": "2"}
+        ]
+        self.client.update(ds)
+
+    @staticmethod
+    def _state_of(node) -> str:
+        return (node["metadata"].get("labels") or {}).get(consts.UPGRADE_STATE_LABEL, "")
+
+    def node_state(self) -> str:
+        return self._state_of(self.client.get("v1", "Node", self.node_name))
+
+    def run(self, max_passes: int = 40, pass_interval: float = 0.3) -> dict:
+        """Drive FSM passes to completion; returns observations for asserts.
+
+        While the PDB blocks, the node must park in pod-deletion-required
+        (the real eviction API answering 429); the drill then relaxes the
+        PDB and plays kubelet/DS-controller until the node is DONE.
+        """
+        mgr = ClusterUpgradeStateManager(self.client, self.ns)
+        policy = UpgradePolicySpec.from_dict(
+            {
+                "autoUpgrade": True,
+                "maxParallelUpgrades": 1,
+                "maxUnavailable": "100%",
+                "drain": {"enable": False},
+            }
+        )
+        self.bump_generation()
+        obs = {"cordoned": False, "parked_passes": 0, "pdb_relaxed": False}
+        for _ in range(max_passes):
+            mgr.apply_state(mgr.build_state(), policy)
+            node = self.client.get("v1", "Node", self.node_name)
+            if node.get("spec", {}).get("unschedulable"):
+                obs["cordoned"] = True
+            state = self._state_of(node)
+            if state == UpgradeState.POD_DELETION_REQUIRED and not obs["pdb_relaxed"]:
+                # the eviction must be blocked while the PDB stands
+                obs["parked_passes"] += 1
+                assert (
+                    self.client.get_or_none("v1", "Pod", self.workload_pod, self.ns)
+                    is not None
+                ), "PDB-protected workload was removed while eviction should be blocked"
+                if obs["parked_passes"] >= 2:
+                    pdb = self.client.get(
+                        "policy/v1", "PodDisruptionBudget", self.pdb_name, self.ns
+                    )
+                    pdb["spec"]["minAvailable"] = 0
+                    self.client.update(pdb)
+                    obs["pdb_relaxed"] = True
+            # kubelet/DS-controller duties for the synthetic node
+            _finalize_terminating(self.client, self.ns, self.node_name)
+            if (
+                obs["pdb_relaxed"]
+                and self.client.get_or_none("v1", "Pod", self.driver_pod, self.ns) is None
+            ):
+                self._create_driver_pod()
+            if state == UpgradeState.DONE:
+                break
+            time.sleep(pass_interval)
+        node = self.client.get("v1", "Node", self.node_name)
+        obs["final_state"] = self._state_of(node)
+        obs["uncordoned"] = not node.get("spec", {}).get("unschedulable")
+        pod = self.client.get_or_none("v1", "Pod", self.driver_pod, self.ns)
+        ds = self.client.get("apps/v1", "DaemonSet", self.ds_name, self.ns)
+        obs["driver_generation_current"] = bool(pod) and (
+            pod["metadata"]["labels"].get(POD_TEMPLATE_GENERATION_LABEL)
+            == str(ds["metadata"].get("generation", 1))
+        )
+        obs["workload_evicted"] = (
+            self.client.get_or_none("v1", "Pod", self.workload_pod, self.ns) is None
+        )
+        return obs
+
+
+def run_upgrade_drill(client, ns: str, **run_kwargs) -> dict:
+    drill = UpgradeDrill(client, ns)
+    try:
+        # setup inside the try: a partial setup (e.g. the cluster-scoped
+        # Node created but the DaemonSet rejected) must still tear down,
+        # or the synthetic TPU-labelled Node leaks into a real cluster
+        drill.setup()
+        return drill.run(**run_kwargs)
+    finally:
+        drill.teardown()
+
+
+def assert_drill_passed(obs: dict) -> None:
+    assert obs["final_state"] == UpgradeState.DONE, obs
+    assert obs["cordoned"] and obs["uncordoned"], obs
+    assert obs["parked_passes"] >= 2, f"PDB never parked the node: {obs}"
+    assert obs["pdb_relaxed"] and obs["workload_evicted"], obs
+    assert obs["driver_generation_current"], obs

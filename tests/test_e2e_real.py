@@ -111,6 +111,32 @@ class TestRealApiserver:
                 pass
 
 
+@pytest.mark.e2e
+class TestRealUpgradeDrill:
+    def test_rolling_libtpu_upgrade_drill(self):
+        """VERDICT r02 item 7: the upgrade FSM against real eviction/PDB
+        semantics — cordon, eviction parked by the cluster's disruption
+        controller (429), PDB relax, pod restart at the new DaemonSet
+        generation, validation, uncordon. Uses a synthetic tainted Node so
+        nothing real is disturbed; the drill plays kubelet for it."""
+        client = _real_cluster_client()
+        ns = f"tpu-op-drill-{uuid.uuid4().hex[:8]}"
+        from drill import assert_drill_passed, run_upgrade_drill
+        from tpu_operator.kube.objects import new_object
+
+        client.create(new_object("v1", "Namespace", ns))
+        try:
+            # slower cadence: the real disruption controller needs a beat
+            # to observe PDB spec changes before evictions pass
+            obs = run_upgrade_drill(client, ns, max_passes=60, pass_interval=1.0)
+            assert_drill_passed(obs)
+        finally:
+            try:
+                client.delete("v1", "Namespace", ns)
+            except errors.ApiError:
+                pass
+
+
 def _crds_served(client) -> bool:
     try:
         client.list("tpu.google.com/v1", "ClusterPolicy")

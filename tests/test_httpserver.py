@@ -124,6 +124,19 @@ class TestWatchOverHttp:
         assert ("DELETED", "w1") in seen
 
 
+class TestUpgradeDrillOverHttp:
+    def test_rolling_upgrade_drill(self, served):
+        """The full rolling-upgrade FSM walk (cordon → eviction parked by
+        a PDB → relax → pod restart → validate → uncordon → done) against
+        the apiserver over the wire — the same drill test_e2e_real.py runs
+        against a real cluster when KUBECONFIG is supplied."""
+        from drill import assert_drill_passed, run_upgrade_drill
+
+        _, client = served
+        obs = run_upgrade_drill(client, NS)
+        assert_drill_passed(obs)
+
+
 class TestOperatorOverHttp:
     def test_install_to_ready_over_http(self):
         """The bench.py http-transport flow: operator on HttpClient, fake

@@ -50,7 +50,19 @@ class Client(abc.ABC):
     def update_status(self, obj: ObjectDict) -> ObjectDict: ...
 
     @abc.abstractmethod
-    def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None: ...
+    def delete(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        namespace: Optional[str] = None,
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """Delete an object. ``grace_period_seconds=0`` force-finalizes a
+        pod immediately — what a kubelet-less harness needs to confirm
+        termination for pods on synthetic nodes (the in-memory fake always
+        deletes immediately and ignores the parameter)."""
+        ...
 
     @abc.abstractmethod
     def evict(self, name: str, namespace: str) -> None:

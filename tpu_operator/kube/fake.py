@@ -189,7 +189,9 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(stored)
 
-    def delete(self, api_version, kind, name, namespace=None):
+    def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
+        # grace_period_seconds is accepted for Client-interface parity; the
+        # in-memory store always deletes immediately (no kubelet to wait on)
         with self._lock:
             key = self._key(api_version, kind, name, namespace)
             obj = self._store.pop(key, None)
