@@ -146,6 +146,18 @@ def tpu_details() -> dict:
         if gen in PEAK_TFLOPS and not mm.get("unstable_timing"):
             details["mxu_utilization_pct"] = round(100 * mm["tflops"] / PEAK_TFLOPS[gen], 1)
         if platform != "cpu":
+            # quantized-inference rate: int8 x int8 -> int32 on the MXU's
+            # double-rate path (v5e: 394 TOP/s peak)
+            from tpu_operator.workloads.matmul_bench import PEAK_INT8_TOPS, int8_matmul_tops
+
+            i8 = int8_matmul_tops(size=8192, iters=16)
+            key = "matmul_int8_tops_lower_bound" if i8.get("unstable_timing") else "matmul_int8_tops"
+            details[key] = round(i8["tops"], 2)
+            if gen in PEAK_INT8_TOPS and not i8.get("unstable_timing"):
+                details["int8_mxu_utilization_pct"] = round(
+                    100 * i8["tops"] / PEAK_INT8_TOPS[gen], 1
+                )
+
             from tpu_operator.workloads.allreduce import run_allreduce
 
             ar = run_allreduce(sizes_mb=(16,), iters=10)

@@ -249,6 +249,50 @@ class TestHelmliteEngine:
         with pytest.raises(helmlite.HelmliteError, match="no template"):
             helmlite.render_string('{{ include "missing" . }}', {})
 
+    def test_sprig_string_functions(self):
+        ctx = {"Values": {"name": "TPU-Op", "tag": "v1.2.3-rc"}}
+        cases = [
+            ('{{ printf "%s:%d" .Values.name 8080 }}', "TPU-Op:8080"),
+            ("{{ .Values.name | lower }}", "tpu-op"),
+            ("{{ .Values.name | upper }}", "TPU-OP"),
+            ('{{ .Values.tag | trimPrefix "v" }}', "1.2.3-rc"),
+            ('{{ .Values.tag | trimSuffix "-rc" }}', "v1.2.3"),
+            ('{{ .Values.name | trunc 3 }}', "TPU"),
+            ('{{ .Values.name | replace "-" "_" }}', "TPU_Op"),
+            ('{{ if contains "rc" .Values.tag }}pre{{ else }}ga{{ end }}', "pre"),
+            ('{{ "a" | ternary "yes" "no" }}', "yes"),
+            ("{{ .Values.name | len }}", "6"),
+        ]
+        for template, want in cases:
+            assert helmlite.render_string(template, ctx) == want, template
+
+    def test_required_raises_on_missing(self):
+        assert (
+            helmlite.render_string('{{ required "need it" .Values.x }}', {"Values": {"x": 1}})
+            == "1"
+        )
+        with pytest.raises(helmlite.HelmliteError, match="need it"):
+            helmlite.render_string('{{ required "need it" .Values.x }}', {"Values": {}})
+
+    def test_printf_errors(self):
+        with pytest.raises(helmlite.HelmliteError, match="not enough args"):
+            helmlite.render_string('{{ printf "%s-%s" "a" }}', {})
+        with pytest.raises(helmlite.HelmliteError, match="unsupported verb"):
+            helmlite.render_string('{{ printf "%x" 5 }}', {})
+        with pytest.raises(helmlite.HelmliteError, match="wants an integer"):
+            helmlite.render_string('{{ printf "%d" "v1.2" }}', {})
+
+    def test_len_of_nil_raises_and_missing_key_is_empty_string(self):
+        # Go errors on len of untyped nil; answering 0 would silently
+        # diverge from real helm
+        with pytest.raises(helmlite.HelmliteError, match="len of"):
+            helmlite.render_string("{{ .Values.nope | len }}", {"Values": {}})
+        # a missing key must stringify as "", never "None"
+        assert (
+            helmlite.render_string('{{ if hasSuffix "e" .Values.nope }}y{{ else }}n{{ end }}', {"Values": {}})
+            == "n"
+        )
+
     def test_trim_markers(self):
         out = helmlite.render_string("a\n{{- if true }}\nb\n{{- end }}\n", {})
         assert out == "a\nb\n"

@@ -269,6 +269,15 @@ class TestSequenceParallelBurnin:
             build_train_step(make_mesh(), BurninConfig(sequence_parallel=True))
 
 
+class TestMatmulBench:
+    def test_int8_probe_reports_rate(self):
+        from tpu_operator.workloads.matmul_bench import int8_matmul_tops
+
+        report = int8_matmul_tops(size=128, iters=2, reps=2)
+        assert report["tops"] > 0
+        assert report["size"] == 128
+
+
 class TestMultiprocessDistributed:
     """Live multi-process jax.distributed over localhost TCP — the env the
     slice manager renders, executed for real (VERDICT r02 item 2; reference

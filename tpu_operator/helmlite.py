@@ -77,18 +77,89 @@ def _indent(n: Any, s: Any) -> str:
     return "\n".join(pad + line for line in _gostr(s).splitlines())
 
 
+def _quote(v: Any) -> str:
+    return '"%s"' % _gostr(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _printf(fmt: Any, *args: Any) -> str:
+    """Go fmt verbs → python %-formatting for the subset charts use."""
+    out = []
+    arg_iter = iter(args)
+    i, s = 0, str(fmt)
+    while i < len(s):
+        ch = s[i]
+        if ch != "%":
+            out.append(ch)
+            i += 1
+            continue
+        if i + 1 >= len(s):
+            raise HelmliteError("printf: trailing % in " + repr(fmt))
+        verb = s[i + 1]
+        i += 2
+        if verb == "%":
+            out.append("%")
+            continue
+        try:
+            arg = next(arg_iter)
+        except StopIteration:
+            raise HelmliteError(f"printf: not enough args for {fmt!r}") from None
+        if verb in ("s", "v"):
+            out.append(_gostr(arg))
+        elif verb == "d":
+            if isinstance(arg, bool) or not isinstance(arg, int):
+                raise HelmliteError(f"printf: %d wants an integer, got {arg!r}")
+            out.append(str(arg))
+        elif verb == "q":
+            out.append(_quote(arg))
+        else:
+            raise HelmliteError(f"printf: unsupported verb %{verb} in {fmt!r}")
+    return "".join(out)
+
+
+def _golen(v: Any) -> int:
+    if not isinstance(v, (str, list, dict, tuple)):
+        # Go errors on len of untyped nil / non-collections; silently
+        # answering 0 would let the chart diverge from real helm
+        raise HelmliteError(f"len of non-collection {type(v).__name__}")
+    return len(v)
+
+
+def _required(msg: Any, v: Any = None) -> Any:
+    if v is None or v == "":
+        raise HelmliteError(f"required value missing: {_gostr(msg)}")
+    return v
+
+
 _FUNCTIONS = {
     "toYaml": _to_yaml,
     "indent": _indent,
     "nindent": lambda n, s: "\n" + _indent(n, s),
-    "quote": lambda v: '"%s"' % _gostr(v).replace("\\", "\\\\").replace('"', '\\"'),
+    "quote": _quote,
     "default": lambda d, v=None: v if _truthy(v) else d,
-    "hasPrefix": lambda prefix, s: str(s).startswith(str(prefix)),
+    # _gostr: a missing key (None) must compare as "", not "None"
+    "hasPrefix": lambda prefix, s: _gostr(s).startswith(str(prefix)),
+    "hasSuffix": lambda suffix, s: _gostr(s).endswith(str(suffix)),
     "not": lambda v: not _truthy(v),
     "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1]),
     "or": lambda *a: next((x for x in a if _truthy(x)), a[-1]),
     "eq": lambda a, b: a == b,
     "ne": lambda a, b: a != b,
+    # sprig string/flow helpers charts lean on
+    "printf": _printf,
+    "required": _required,
+    "lower": lambda s: _gostr(s).lower(),
+    "upper": lambda s: _gostr(s).upper(),
+    "title": lambda s: _gostr(s).title(),
+    "trim": lambda s: _gostr(s).strip(),
+    "trunc": lambda n, s: _gostr(s)[: int(n)] if int(n) >= 0 else _gostr(s)[int(n):],
+    "trimPrefix": lambda prefix, s: _gostr(s).removeprefix(str(prefix)),
+    "trimSuffix": lambda suffix, s: _gostr(s).removesuffix(str(suffix)),
+    "replace": lambda old, new, s: _gostr(s).replace(str(old), str(new)),
+    "contains": lambda needle, s: str(needle) in _gostr(s),
+    "toString": _gostr,
+    "len": _golen,
+    # sprig: ternary trueVal falseVal cond (cond usually piped in)
+    "ternary": lambda t, f, cond: t if _truthy(cond) else f,
 }
 
 

@@ -19,6 +19,9 @@ from tpu_operator.workloads.timing import two_point_min_timing
 
 # published dense bf16 peak TFLOP/s per chip, for utilization reporting
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+# published dense int8 peak TOP/s per chip (2x bf16 on v5e+; v4 has no
+# int8 fast path)
+PEAK_INT8_TOPS = {"v4": 275.0, "v5e": 394.0, "v5p": 918.0, "v6e": 1836.0}
 
 
 def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
@@ -62,4 +65,47 @@ def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int 
     report.update(timing.report_fields())
     per_iter = timing.per_iter_s or timing.inclusive_per_iter_s
     report.update({"time_ms": per_iter * 1e3, "tflops": flops / per_iter / 1e12})
+    return report
+
+
+def int8_matmul_tops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
+    """Quantized-inference throughput probe: chained int8 x int8 -> int32
+    matmuls (``preferred_element_type``), the MXU's double-rate path on
+    v5e+. Same chain/two-point-timing structure as ``matmul_tflops``;
+    each step requantizes the int32 accumulator back to int8 with an
+    arithmetic shift (VPU work, O(N^2), negligible beside the 2N^3 MACs).
+    Reference analog: none — the GPU operator runs no compute benchmarks;
+    this extends the validator's perf surface the TPU-native way."""
+    x = jax.random.randint(jax.random.PRNGKey(0), (size, size), -4, 5, dtype=jnp.int8)
+    y = jax.random.randint(jax.random.PRNGKey(1), (size, size), -4, 5, dtype=jnp.int8)
+
+    dot = partial(
+        lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+    @partial(jax.jit, static_argnames="n")
+    def chain(z, y, s, n):
+        def step(i, acc):
+            # requantize: shift keeps magnitudes in int8 range for the
+            # next MXU pass; wraparound is irrelevant to a rate probe
+            return lax.shift_right_arithmetic(dot(acc, y), 7).astype(jnp.int8)
+
+        out = lax.fori_loop(0, n, step, (z + jnp.int8(s)), unroll=unroll)
+        return jnp.int32(out.astype(jnp.int32).sum())
+
+    def run(seed, n):
+        float(chain(x, y, seed, n))  # the fetch forces execution
+
+    timing = two_point_min_timing(run, iters, 6 * iters, reps)
+    ops = 2 * size**3
+    report = {
+        "size": size,
+        "platform": jax.devices()[0].platform,
+        "inclusive_tops": ops / timing.inclusive_per_iter_s / 1e12,
+    }
+    report.update(timing.report_fields())
+    per_iter = timing.per_iter_s or timing.inclusive_per_iter_s
+    report.update({"time_ms": per_iter * 1e3, "tops": ops / per_iter / 1e12})
     return report
