@@ -102,6 +102,47 @@ class TestCrudOverHttp:
 
 
 class TestWatchOverHttp:
+    def test_stream_replays_existing_state(self, served):
+        """resourceVersion=0 semantics, pinned at the raw endpoint (no
+        prior LIST, so HttpClient's own list-replay can't mask a broken
+        server): an object created BEFORE the stream connects must arrive
+        as a synthetic ADDED. Losing it is unrecoverable — no resync
+        timer exists; this exact race wedged the install flow once
+        keep-alive made request setup fast enough to hit the gap."""
+        import json as _json
+        import urllib.request
+
+        store, client = served
+        store.create(new_object("v1", "ConfigMap", "pre-existing", NS))
+        url = (
+            client.base_url
+            + f"/api/v1/namespaces/{NS}/configmaps?watch=true&resourceVersion=0"
+        )
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            event = _json.loads(resp.readline())
+        assert event["type"] == "ADDED"
+        assert event["object"]["metadata"]["name"] == "pre-existing"
+
+    def test_stream_with_nonzero_rv_does_not_replay(self, served):
+        """A nonzero resourceVersion asks for live events only; replaying
+        the world there would double every object on each reconnect."""
+        import json as _json
+        import urllib.request
+
+        store, client = served
+        store.create(new_object("v1", "ConfigMap", "old", NS))
+        url = (
+            client.base_url
+            + f"/api/v1/namespaces/{NS}/configmaps?watch=true&resourceVersion=99"
+        )
+        resp = urllib.request.urlopen(url, timeout=10)
+        # only a LIVE event may arrive; create one after the stream opens
+        time.sleep(0.3)
+        store.create(new_object("v1", "ConfigMap", "fresh", NS))
+        event = _json.loads(resp.readline())
+        resp.close()
+        assert event["object"]["metadata"]["name"] == "fresh"
+
     def test_watch_streams_events(self, served):
         store, client = served
         seen = []

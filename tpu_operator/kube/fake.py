@@ -259,10 +259,23 @@ class FakeClient(Client):
             events.extend(self._collect_garbage(obj["metadata"].get("uid")))
         return events
 
-    def watch(self, api_version, kind, handler, namespace=None):
+    def watch(self, api_version, kind, handler, namespace=None, replay=False):
+        """``replay=True`` is kube's resourceVersion=0 watch semantics:
+        synthetic ADDED events for the current state, delivered atomically
+        with registration — so a consumer whose LIST ran on a separate
+        request (the HTTP facade's stream) can never lose an object
+        created in the list→watch gap. The handler runs under the store
+        lock during replay and must not call back into the client."""
         key = (api_group(api_version), kind)
         sub = _Sub(self, key, handler, namespace)
         with self._lock:
+            if replay:
+                for (g, k, ns, _), obj in self._store.items():
+                    if g != key[0] or k != kind:
+                        continue
+                    if namespace and ns != namespace:
+                        continue
+                    handler(ADDED, deep_copy(obj))
             self._watchers.setdefault(key, []).append(sub)
         return sub
 

@@ -120,6 +120,10 @@ class HttpClient(Client):
             self._ssl = ssl.create_default_context()
         else:
             self._ssl = None
+        # keep-alive pool, initialized eagerly: lazy init from two racing
+        # first requests would create two different locks guarding it
+        self._idle_conns: list = []
+        self._pool_lock = threading.Lock()
 
     @classmethod
     def from_kubeconfig(cls, path: Optional[str] = None, context: Optional[str] = None) -> "HttpClient":
@@ -243,6 +247,54 @@ class HttpClient(Client):
                 log.warning("could not refresh SA token from %s: %s", self.token_path, e)
         return self.token
 
+    # -- pooled keep-alive transport ----------------------------------------
+    #
+    # client-go rides a pooled HTTP/2 (or keep-alive HTTP/1.1) transport;
+    # opening a TCP (+TLS) connection per request triples small-request
+    # latency. Unary requests here reuse persistent http.client
+    # connections from a small pool; watch streams intentionally hold
+    # their own dedicated connection (see _stream_watch).
+
+    _POOL_MAX_IDLE = 4
+
+    def _new_conn(self):
+        import http.client
+        import socket
+
+        parsed = urllib.parse.urlsplit(self.base_url)
+        if parsed.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                parsed.hostname, parsed.port or 443, timeout=self.timeout, context=self._ssl
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port or 80, timeout=self.timeout
+            )
+        # request headers and (JSON) bodies go out as separate small
+        # writes; without TCP_NODELAY, Nagle holds the second segment for
+        # the peer's delayed ACK (~40 ms) on every kept-alive request
+        conn.connect()
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def _checkout_conn(self):
+        """Returns (conn, pooled): pooled=True means the connection was
+        reused — the only case where a connection-level failure is safely
+        retryable (the server may have closed it while idle; the request
+        can't have been processed)."""
+        with self._pool_lock:
+            if self._idle_conns:
+                return self._idle_conns.pop(), True
+        return self._new_conn(), False
+
+    def _checkin_conn(self, conn, reusable: bool) -> None:
+        if reusable:
+            with self._pool_lock:
+                if len(self._idle_conns) < self._POOL_MAX_IDLE:
+                    self._idle_conns.append(conn)
+                    return
+        conn.close()
+
     def _request(
         self,
         method: str,
@@ -251,40 +303,68 @@ class HttpClient(Client):
         query: Optional[dict] = None,
         _retry_auth: bool = True,
     ) -> dict:
-        url = self.base_url + path
+        import http.client
+
+        target = path
         if query:
-            url += "?" + urllib.parse.urlencode(query)
+            target += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
+        headers = {"Accept": "application/json"}
         if body is not None:
-            req.add_header("Content-Type", "application/json")
+            headers["Content-Type"] = "application/json"
         token = self._bearer()
         if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout, context=self._ssl) as resp:
-                payload = resp.read()
+            headers["Authorization"] = f"Bearer {token}"
+
+        # Retry policy: ONLY a request that failed on a reused (pooled)
+        # connection retries, on a fresh connection — the server closing
+        # an idle keep-alive connection is a normal race and the request
+        # was provably never processed. A failure on a fresh connection
+        # is ambiguous (a POST/PUT may have landed) and must surface, not
+        # silently duplicate a mutation (client-go draws the same line).
+        for attempt in range(2):
+            if attempt == 0:
+                conn, pooled = self._checkout_conn()
+            else:
+                conn, pooled = self._new_conn(), False
+            try:
+                conn.request(method, target, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()  # drain fully so the conn can be reused
+                status = resp.status
+            except (
+                http.client.RemoteDisconnected,
+                http.client.BadStatusLine,
+                BrokenPipeError,
+                ConnectionResetError,
+            ) as e:
+                conn.close()
+                if pooled:
+                    continue  # stale keep-alive: retry on a fresh connection
+                raise errors.ApiError(f"{method} {path}: {e}") from e
+            except OSError as e:
+                conn.close()
+                raise errors.ApiError(f"{method} {path}: {e}") from e
+            self._checkin_conn(conn, reusable=not resp.will_close)
+            if status < 400:
                 return json.loads(payload) if payload else {}
-        except urllib.error.HTTPError as e:
-            if e.code == 401 and _retry_auth and self.token_path:
+            if status == 401 and _retry_auth and self.token_path:
                 # expired bound token: re-read once and retry the request
                 self._bearer(force_refresh=True)
                 return self._request(method, path, body, query, _retry_auth=False)
-            detail = e.read().decode(errors="replace")[:500]
-            if e.code == 404:
-                raise errors.NotFound(detail) from e
-            if e.code == 409:
+            detail = payload.decode(errors="replace")[:500]
+            if status == 404:
+                raise errors.NotFound(detail)
+            if status == 409:
                 if "AlreadyExists" in detail:
-                    raise errors.AlreadyExists(detail) from e
-                raise errors.Conflict(detail) from e
-            if e.code in (400, 422):
-                raise errors.Invalid(detail) from e
-            if e.code == 429:
-                raise errors.TooManyRequests(detail) from e
-            raise errors.ApiError(f"{method} {path}: HTTP {e.code}: {detail}") from e
-        except urllib.error.URLError as e:
-            raise errors.ApiError(f"{method} {path}: {e}") from e
+                    raise errors.AlreadyExists(detail)
+                raise errors.Conflict(detail)
+            if status in (400, 422):
+                raise errors.Invalid(detail)
+            if status == 429:
+                raise errors.TooManyRequests(detail)
+            raise errors.ApiError(f"{method} {path}: HTTP {status}: {detail}")
+        raise errors.ApiError(f"{method} {path}: retry on fresh connection failed")
 
     # -- Client API ----------------------------------------------------------
 
@@ -364,14 +444,20 @@ class HttpClient(Client):
         while sub.active:
             try:
                 if not resource_version:
-                    # (re-)list to establish a consistent start point; replay
-                    # as ADDED like the informer expects
+                    # (re-)list to establish a consistent start point
                     listed = self._request("GET", self._path(api_version, kind, namespace))
                     resource_version = listed.get("metadata", {}).get("resourceVersion", "")
-                    for item in listed.get("items", []):
-                        item.setdefault("apiVersion", api_version)
-                        item.setdefault("kind", kind)
-                        handler("ADDED", item)
+                    if resource_version != "0":
+                        # real apiserver: replay the list as ADDED and
+                        # stream from its resourceVersion (gap-free)
+                        for item in listed.get("items", []):
+                            item.setdefault("apiVersion", api_version)
+                            item.setdefault("kind", kind)
+                            handler("ADDED", item)
+                    # rv "0": the server streams its own synthetic ADDED
+                    # replay atomically with watch registration (kube's
+                    # resourceVersion=0 semantics) — replaying the list
+                    # here too would double every object on each connect
                 self._stream_watch(api_version, kind, handler, namespace, sub, resource_version)
                 resource_version = ""  # stream ended: full re-list
             except errors.ApiError as e:

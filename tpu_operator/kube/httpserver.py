@@ -9,12 +9,13 @@ in-process dict calls. Reference counterpart: the e2e suite running the
 operator against a real apiserver (tests/e2e/gpu_operator_test.go:104-170).
 
 Scope notes:
-- watch streams start "now" (no resourceVersion replay); the client's
-  informers list-then-watch, and the controllers' periodic requeues cover
-  the list→watch gap exactly as they do against a real apiserver.
-- HTTP/1.0, one connection per request (urllib on the client side); the
-  measured overhead therefore includes connection setup, which leans
-  conservative vs client-go's pooled transport.
+- list responses advertise resourceVersion "0"; a watch opened with rv
+  absent or "0" replays the current state as synthetic ADDED events
+  atomically with registration (kube's rv=0 semantics), so nothing can
+  be lost in the list→watch gap. A nonzero rv streams live events only.
+- HTTP/1.1 keep-alive: unary requests reuse connections (the client
+  pools them, like client-go's transport); watch streams mark
+  Connection: close and hold a dedicated connection for their lifetime.
 """
 
 from __future__ import annotations
@@ -97,8 +98,11 @@ class FakeApiServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.0: no Content-Length bookkeeping, connection closes
-            # at end of response — watch streams read until EOF
+            protocol_version = "HTTP/1.1"  # keep-alive for unary requests
+            # headers leave as many small writes; with keep-alive (no FIN
+            # to flush them) Nagle + delayed ACK would add ~40 ms per
+            # response. StreamRequestHandler.setup applies this per socket.
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
                 pass
@@ -107,10 +111,14 @@ class FakeApiServer:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def _body(self) -> Optional[dict]:
+                return self._parsed_body
+
+            def _read_body(self) -> Optional[dict]:
                 length = int(self.headers.get("Content-Length") or 0)
                 if not length:
                     return None
@@ -118,6 +126,10 @@ class FakeApiServer:
 
             def _dispatch(self, method: str) -> None:
                 try:
+                    # drain the body up front, whatever the outcome: on a
+                    # keep-alive connection, unread body bytes would be
+                    # parsed as the next request's start line
+                    self._parsed_body = self._read_body()
                     server._handle(self, method)
                 except errors.NotFound as e:
                     self._send(404, {"reason": "NotFound", "message": str(e)})
@@ -228,7 +240,8 @@ class FakeApiServer:
 
         if method == "GET" and name is None:
             if query.get("watch") == ["true"]:
-                return self._serve_watch(handler, api_version, kind, namespace)
+                rv = (query.get("resourceVersion") or [""])[0]
+                return self._serve_watch(handler, api_version, kind, namespace, rv)
             selector = None
             if query.get("labelSelector"):
                 selector = dict(
@@ -268,15 +281,30 @@ class FakeApiServer:
             return handler._send(200, {"status": "Success"})
         raise errors.Invalid(f"unsupported {method} on {handler.path}")
 
-    def _serve_watch(self, handler, api_version: str, kind: str, namespace) -> None:
+    def _serve_watch(
+        self, handler, api_version: str, kind: str, namespace, resource_version: str = ""
+    ) -> None:
         """Chunked JSON watch stream fed from a live FakeClient watcher.
-        Streams from 'now' — the client re-lists first (informer contract)."""
+
+        resourceVersion absent or "0" opens with a replay of the current
+        state as synthetic ADDED events, atomic with registration
+        (FakeClient.watch(replay=True)) — kube's rv=0 semantics. This is
+        what closes the list→watch gap: the client's LIST runs on a
+        separate request, and a lost creation in that gap would otherwise
+        never be seen (no informer resync timer exists to recover it).
+        List responses advertise rv "0" so clients take this path."""
         events: "queue.Queue" = queue.Queue()
         sub = self.client.watch(
-            api_version, kind, lambda etype, obj: events.put((etype, obj)), namespace
+            api_version,
+            kind,
+            lambda etype, obj: events.put((etype, obj)),
+            namespace,
+            replay=resource_version in ("", "0"),
         )
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")
+        # no Content-Length: the stream ends when this connection closes
+        handler.send_header("Connection", "close")
         handler.end_headers()
         handler.wfile.flush()
         try:
