@@ -158,6 +158,16 @@ def tpu_details() -> dict:
                     100 * i8["tops"] / PEAK_INT8_TOPS[gen], 1
                 )
 
+            # long-context hot op: pallas flash attention vs XLA dense
+            from tpu_operator.workloads.flashattention import flash_attention_bench
+
+            fa = flash_attention_bench(seq_len=8192, heads=8)
+            details["flash_attention_8k"] = {
+                "time_ms": round(fa["flash_time_ms"], 2),
+                "tflops": round(fa["flash_tflops"], 1),
+                "speedup_vs_dense": round(fa.get("speedup_vs_dense", 0.0), 2),
+            }
+
             from tpu_operator.workloads.allreduce import run_allreduce
 
             ar = run_allreduce(sizes_mb=(16,), iters=10)

@@ -269,6 +269,34 @@ class TestSequenceParallelBurnin:
             build_train_step(make_mesh(), BurninConfig(sequence_parallel=True))
 
 
+class TestFlashAttention:
+    def test_matches_dense_causal_and_full(self):
+        from tpu_operator.workloads.flashattention import run_flash_attention_check
+
+        for causal in (True, False):
+            report = run_flash_attention_check(
+                seq_len=256, block_q=64, block_k=64, causal=causal
+            )
+            assert report["ok"] and report["max_abs_err"] < 2e-2
+
+    def test_uneven_blocks(self):
+        """block_q > block_k puts fully-masked rows on diagonal blocks —
+        the -inf guards must keep them finite."""
+        from tpu_operator.workloads.flashattention import run_flash_attention_check
+
+        report = run_flash_attention_check(seq_len=256, block_q=128, block_k=64)
+        assert report["ok"]
+
+    def test_rejects_misaligned_seq(self):
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+
+        q = jnp.zeros((1, 96, 2, 32), dtype=jnp.bfloat16)
+        with pytest.raises(ValueError, match="divide"):
+            flash_attention(q, q, q, block_q=64, block_k=64)
+
+
 class TestMatmulBench:
     def test_int8_probe_reports_rate(self):
         from tpu_operator.workloads.matmul_bench import int8_matmul_tops

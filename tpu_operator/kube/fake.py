@@ -268,14 +268,10 @@ class FakeClient(Client):
         lock during replay and must not call back into the client."""
         key = (api_group(api_version), kind)
         sub = _Sub(self, key, handler, namespace)
-        with self._lock:
+        with self._lock:  # RLock: list() below re-enters safely
             if replay:
-                for (g, k, ns, _), obj in self._store.items():
-                    if g != key[0] or k != kind:
-                        continue
-                    if namespace and ns != namespace:
-                        continue
-                    handler(ADDED, deep_copy(obj))
+                for obj in self.list(api_version, kind, namespace):
+                    handler(ADDED, obj)
             self._watchers.setdefault(key, []).append(sub)
         return sub
 

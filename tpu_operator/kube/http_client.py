@@ -305,7 +305,10 @@ class HttpClient(Client):
     ) -> dict:
         import http.client
 
-        target = path
+        # kubeconfig servers may carry a path prefix (proxied apiservers,
+        # e.g. https://host/k8s/clusters/c-x): preserve it like the
+        # urllib-based watch path does
+        target = urllib.parse.urlsplit(self.base_url).path.rstrip("/") + path
         if query:
             target += "?" + urllib.parse.urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
@@ -317,21 +320,23 @@ class HttpClient(Client):
             headers["Authorization"] = f"Bearer {token}"
 
         # Retry policy: ONLY a request that failed on a reused (pooled)
-        # connection retries, on a fresh connection — the server closing
-        # an idle keep-alive connection is a normal race and the request
-        # was provably never processed. A failure on a fresh connection
-        # is ambiguous (a POST/PUT may have landed) and must surface, not
+        # connection BEFORE any response bytes arrived retries, on a fresh
+        # connection — the server closing an idle keep-alive connection is
+        # a normal race and such a request was provably never processed.
+        # Once a status line exists (or on a fresh connection), failure is
+        # ambiguous (a POST/PUT may have landed) and must surface, not
         # silently duplicate a mutation (client-go draws the same line).
         for attempt in range(2):
-            if attempt == 0:
-                conn, pooled = self._checkout_conn()
-            else:
-                conn, pooled = self._new_conn(), False
+            try:
+                if attempt == 0:
+                    conn, pooled = self._checkout_conn()
+                else:
+                    conn, pooled = self._new_conn(), False
+            except OSError as e:
+                raise errors.ApiError(f"{method} {path}: {e}") from e
             try:
                 conn.request(method, target, body=data, headers=headers)
                 resp = conn.getresponse()
-                payload = resp.read()  # drain fully so the conn can be reused
-                status = resp.status
             except (
                 http.client.RemoteDisconnected,
                 http.client.BadStatusLine,
@@ -345,6 +350,14 @@ class HttpClient(Client):
             except OSError as e:
                 conn.close()
                 raise errors.ApiError(f"{method} {path}: {e}") from e
+            try:
+                payload = resp.read()  # drain fully so the conn can be reused
+            except OSError as e:
+                # the response started: never re-send, the mutation may
+                # have been applied
+                conn.close()
+                raise errors.ApiError(f"{method} {path}: {e} (mid-response)") from e
+            status = resp.status
             self._checkin_conn(conn, reusable=not resp.will_close)
             if status < 400:
                 return json.loads(payload) if payload else {}

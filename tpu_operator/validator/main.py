@@ -193,6 +193,13 @@ def validate_slice(ctx: Context) -> dict:
         seq_len=max(128, 32 * n)
     )
     report["pipeline"] = pipeline.run_pipeline_check()
+    # the within-chip half of the long-context story: the pallas flash
+    # kernel must agree with dense attention on this node's accelerator
+    from tpu_operator.workloads import flashattention
+
+    report["flash_attention"] = flashattention.run_flash_attention_check(
+        seq_len=256, block_q=128, block_k=128
+    )
     return report
 
 

@@ -1,0 +1,223 @@
+"""Pallas TPU flash attention — the hot-op kernel for the long-context
+validation payloads.
+
+Causal (or full) attention computed with the online-softmax recurrence
+over a (batch·head, q-block, k-block) grid: the k dimension is the
+innermost (sequential) grid axis, the running (acc, m, l) state lives in
+VMEM scratch across its steps, and only one (block_q, block_k) score
+tile ever exists — O(S) memory against XLA's dense O(S²) path, VMEM
+bounded by the block sizes rather than the sequence, so 100k+ contexts
+stream through the same kernel.
+
+Same recurrence as ``ringattention._block_attend`` — the ring decomposes
+the sequence ACROSS chips (ppermute over ICI) while this kernel blocks
+it WITHIN a chip; together they form the two-level long-context story.
+
+Reference analog: none (the GPU operator runs no attention); this
+extends the validator's compute payload family the TPU-native way.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q: int, block_k: int, causal: bool
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    relevant = True if not causal else kj * block_k < (qi + 1) * block_q
+
+    @pl.when(relevant)
+    def _attend():
+        q = q_ref[0]  # (BQ, D)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        k = k_ref[0]  # (BK, D)
+        v = v_ref[0]
+        s = (
+            lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (BQ, BK)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
+        l = l_ref[:, :1]
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        # fully-masked rows (block_q > block_k diagonals) keep m at -inf:
+        # exp(-inf - -inf) must yield 0, not nan
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(
+            l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        # rows with no valid key (defensive): l == 0 -> emit 0, not inf
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 1024,
+) -> jax.Array:
+    """q/k/v: (B, S, H, D) — the burn-in/ring layout. VMEM holds one
+    q/k/v/out block plus the (block_q, D) accumulator, independent of S."""
+    if pltpu is None:  # pragma: no cover — jax build without pallas TPU
+        raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq_len {s} must divide by blocks ({block_q}, {block_k})")
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qb, kb, vb = bh(q), bh(k), bh(v)
+    grid = (b * h, s // block_q, s // block_k)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
+    out_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
+    kwargs = {}
+    if not interpret:
+        # bh and q blocks parallelize (megacore); the k axis is the
+        # sequential accumulation dimension
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    out = pl.pallas_call(
+        partial(_flash_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        grid=grid,
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0)
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qb, kb, vb)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def run_flash_attention_check(
+    seq_len: int = 512,
+    batch: int = 1,
+    heads: int = 2,
+    head_dim: int = 128,
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = True,
+) -> dict:
+    """Validator payload: the kernel must match dense attention to bf16
+    accumulation noise on both the causal and full paths."""
+    from tpu_operator.workloads.ringattention import dense_attention
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (batch, seq_len, heads, head_dim)
+    q, k, v = (jax.random.normal(key, shape, dtype=jnp.bfloat16) for key in keys)
+    got = flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+    want = dense_attention(q, k, v, causal=causal)
+    err = float(
+        jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32)))
+    )
+    if not np.isfinite(err) or err > 2e-2:
+        raise RuntimeError(f"flash attention diverges from dense: max_abs_err={err}")
+    return {
+        "seq_len": seq_len,
+        "block_q": block_q,
+        "block_k": block_k,
+        "causal": causal,
+        "max_abs_err": err,
+        "ok": True,
+    }
+
+
+def flash_attention_bench(
+    seq_len: int = 4096,
+    heads: int = 8,
+    head_dim: int = 128,
+    iters: int = 8,
+    reps: int = 4,
+) -> dict:
+    """Flash kernel vs XLA dense attention at long context: per-call time
+    for each (two-point relay-safe timing) and achieved attention
+    FLOP/s. Dense is skipped above 8k — its O(S²) scores stop fitting."""
+    from tpu_operator.workloads.ringattention import dense_attention
+    from tpu_operator.workloads.timing import two_point_min_timing
+
+    shape = (1, seq_len, heads, head_dim)
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(key, shape, dtype=jnp.bfloat16) for key in keys)
+
+    def timed(fn):
+        @partial(jax.jit, static_argnames="n")
+        def chain(q, k, v, s, n):
+            def step(i, acc):
+                return fn(acc, k, v).astype(q.dtype)
+
+            out = lax.fori_loop(0, n, step, q * s)
+            return jnp.float32(out.sum())
+
+        timing = two_point_min_timing(
+            lambda s, n: float(chain(q, k, v, s, n)), iters, 4 * iters, reps
+        )
+        return timing.per_iter_s or timing.inclusive_per_iter_s
+
+    flash_s = timed(lambda a, kk, vv: flash_attention(a, kk, vv, causal=True))
+    report = {
+        "seq_len": seq_len,
+        "heads": heads,
+        # causal attention: 2 matmuls x 2·S²/2·D MACs per head
+        "flash_time_ms": flash_s * 1e3,
+        "flash_tflops": 2 * 2 * heads * seq_len**2 * head_dim / 2 / flash_s / 1e12,
+    }
+    if seq_len <= 8192:
+        dense_s = timed(lambda a, kk, vv: dense_attention(a, kk, vv, causal=True))
+        report["dense_time_ms"] = dense_s * 1e3
+        report["speedup_vs_dense"] = dense_s / flash_s
+    return report
