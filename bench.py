@@ -166,6 +166,10 @@ def tpu_details() -> dict:
                 "time_ms": round(fa["flash_time_ms"], 2),
                 "tflops": round(fa["flash_tflops"], 1),
                 "speedup_vs_dense": round(fa.get("speedup_vs_dense", 0.0), 2),
+                "fwd_bwd_ms": round(fa["flash_fwd_bwd_ms"], 2),
+                "train_step_speedup_vs_dense": round(
+                    fa.get("train_step_speedup_vs_dense", 0.0), 2
+                ),
             }
 
             from tpu_operator.workloads.allreduce import run_allreduce

@@ -352,9 +352,9 @@ class HttpClient(Client):
                 raise errors.ApiError(f"{method} {path}: {e}") from e
             try:
                 payload = resp.read()  # drain fully so the conn can be reused
-            except OSError as e:
-                # the response started: never re-send, the mutation may
-                # have been applied
+            except (OSError, http.client.HTTPException) as e:
+                # the response started (IncompleteRead/reset mid-body):
+                # never re-send, the mutation may have been applied
                 conn.close()
                 raise errors.ApiError(f"{method} {path}: {e} (mid-response)") from e
             status = resp.status

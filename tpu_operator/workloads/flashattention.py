@@ -13,6 +13,12 @@ Same recurrence as ``ringattention._block_attend`` — the ring decomposes
 the sequence ACROSS chips (ppermute over ICI) while this kernel blocks
 it WITHIN a chip; together they form the two-level long-context story.
 
+Differentiable: a custom VJP implements the FlashAttention-2 backward —
+the forward saves only (out, logsumexp), the backward recomputes the
+probability tiles and runs two kernels, one gridded over q blocks
+accumulating dQ, one over k blocks accumulating dK/dV — so training
+long-context models pays O(S) memory in both directions.
+
 Reference analog: none (the GPU operator runs no attention); this
 extends the validator's compute payload family the TPU-native way.
 """
@@ -34,8 +40,24 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, block_q: int, block_k: int, causal: bool
+def _masked_scores(q, k, qi, kj, block_q, block_k, causal):
+    """scale·QKᵀ with the causal mask applied — shared by fwd and bwd
+    (the backward recomputes scores instead of saving O(S²) tiles)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = (
+        lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        * scale
+    )  # (BQ, BK)
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    return s, scale
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int, causal: bool,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -53,19 +75,9 @@ def _flash_kernel(
     @pl.when(relevant)
     def _attend():
         q = q_ref[0]  # (BQ, D)
-        scale = 1.0 / math.sqrt(q.shape[-1])
         k = k_ref[0]  # (BK, D)
         v = v_ref[0]
-        s = (
-            lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            * scale
-        )  # (BQ, BK)
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        s, _ = _masked_scores(q, k, qi, kj, block_q, block_k, causal)
         m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
         l = l_ref[:, :1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
@@ -91,6 +103,191 @@ def _flash_kernel(
         l = l_ref[:, :1]
         # rows with no valid key (defensive): l == 0 -> emit 0, not inf
         o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        lse = jnp.where(
+            (l > 0.0) & jnp.isfinite(m), m + jnp.log(jnp.where(l > 0.0, l, 1.0)), -jnp.inf
+        )
+        lse_ref[0] = lse  # (BQ, 1) slice of the (BH, S, 1) stat array
+
+
+def _row_stat(ref, qi, block_q):
+    """(BQ, 1) slice of a (1, S, 1) row-stat block (lse / delta)."""
+    return ref[0, pl.ds(qi * block_q, block_q), :]
+
+
+def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal):
+    s, scale = _masked_scores(q, k, qi, kj, block_q, block_k, causal)
+    p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
+    # rows with lse=-inf (no valid keys) and masked entries contribute 0
+    p = jnp.where(jnp.isneginf(s) | ~jnp.isfinite(lse), 0.0, p)
+    return p, scale
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q: int, block_k: int, causal: bool,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    relevant = True if not causal else kj * block_k < (qi + 1) * block_q
+
+    @pl.when(relevant)
+    def _accumulate():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = _row_stat(lse_ref, qi, block_q)
+        delta = _row_stat(delta_ref, qi, block_q)
+        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] = dq_acc[:] + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, block_q: int, block_k: int, causal: bool,
+):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks entirely above this k block see none of it
+    relevant = True if not causal else (qi + 1) * block_q > kj * block_k
+
+    @pl.when(relevant)
+    def _accumulate():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = _row_stat(lse_ref, qi, block_q)
+        delta = _row_stat(delta_ref, qi, block_q)
+        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal)
+        # dV += Pᵀ dO
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale  # (BQ, BK)
+        # dK += dSᵀ Q
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pallas_kwargs(interpret: bool, semantics) -> dict:
+    if interpret:
+        return {"interpret": True}
+    # bh plus the leading block axis parallelize (megacore); the last
+    # grid axis is the sequential accumulation dimension
+    return {"compiler_params": pltpu.CompilerParams(dimension_semantics=semantics)}
+
+
+def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int):
+    bh_count, s, d = qb.shape
+    interpret = jax.devices()[0].platform != "tpu"
+    grid = (bh_count, s // block_q, s // block_k)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
+    # each qi program owns its own (1, BQ, 1) slice of the stat array —
+    # rank-3 with a trailing singleton because the TPU lowering wants the
+    # block's last two dims (8, 128)-divisible or equal to the array's
+    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj: (i, j, 0))
+    return pl.pallas_call(
+        partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+            jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=(q_spec, lse_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0)
+        ],
+        **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+    )(qb, kb, vb)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(qb, kb, vb, causal: bool, block_q: int, block_k: int):
+    out, _ = _flash_forward(qb, kb, vb, causal, block_q, block_k)
+    return out
+
+
+def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k):
+    out, lse = _flash_forward(qb, kb, vb, causal, block_q, block_k)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, residuals, g):
+    qb, kb, vb, out, lse = residuals
+    bh_count, s, d = qb.shape
+    interpret = jax.devices()[0].platform != "tpu"
+    # D_i = rowsum(dO ∘ O): cheap elementwise, XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
+    row_spec = pl.BlockSpec((1, s, 1), lambda i, j, kj: (i, 0, 0))
+    dq = pl.pallas_call(
+        partial(_flash_dq_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+        grid=(bh_count, s // block_q, s // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+    )(qb, kb, vb, g, lse, delta)
+    # dK/dV: k blocks own the grid, q is the sequential axis
+    kq_q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, j: (i, j, 0))
+    kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, j: (i, kj, 0))
+    kq_row_spec = pl.BlockSpec((1, s, 1), lambda i, kj, j: (i, 0, 0))
+    dk, dv = pl.pallas_call(
+        partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct(kb.shape, kb.dtype),
+            jax.ShapeDtypeStruct(vb.shape, vb.dtype),
+        ),
+        grid=(bh_count, s // block_k, s // block_q),
+        in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec],
+        out_specs=(kq_k_spec, kq_k_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),  # dk acc
+            pltpu.VMEM((block_k, d), jnp.float32),  # dv acc
+        ],
+        **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+    )(qb, kb, vb, g, lse, delta)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -102,7 +299,8 @@ def flash_attention(
     block_k: int = 1024,
 ) -> jax.Array:
     """q/k/v: (B, S, H, D) — the burn-in/ring layout. VMEM holds one
-    q/k/v/out block plus the (block_q, D) accumulator, independent of S."""
+    q/k/v/out block plus the (block_q, D) accumulator, independent of S.
+    Differentiable (custom VJP, FlashAttention-2 backward)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
@@ -110,37 +308,11 @@ def flash_attention(
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"seq_len {s} must divide by blocks ({block_q}, {block_k})")
-    interpret = jax.devices()[0].platform != "tpu"
 
     def bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    qb, kb, vb = bh(q), bh(k), bh(v)
-    grid = (b * h, s // block_q, s // block_k)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
-    out_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
-    kwargs = {}
-    if not interpret:
-        # bh and q blocks parallelize (megacore); the k axis is the
-        # sequential accumulation dimension
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
-    out = pl.pallas_call(
-        partial(_flash_kernel, block_q=block_q, block_k=block_k, causal=causal),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
-        grid=grid,
-        in_specs=[q_spec, k_spec, k_spec],
-        out_specs=out_spec,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), jnp.float32),  # acc
-            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0)
-            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0)
-        ],
-        interpret=interpret,
-        **kwargs,
-    )(qb, kb, vb)
+    out = _flash_core(bh(q), bh(k), bh(v), causal, block_q, block_k)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -208,16 +380,41 @@ def flash_attention_bench(
         )
         return timing.per_iter_s or timing.inclusive_per_iter_s
 
+    def timed_grad(fn):
+        def loss(a, kk, vv):
+            return jnp.sum(fn(a, kk, vv).astype(jnp.float32))
+
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+
+        @partial(jax.jit, static_argnames="n")
+        def chain(q, k, v, s, n):
+            def step(i, acc):
+                dq, _, _ = grad(acc, k, v)
+                return acc + dq.astype(q.dtype) * jnp.bfloat16(0.001)
+
+            out = lax.fori_loop(0, n, step, q * s)
+            return jnp.float32(out.sum())
+
+        timing = two_point_min_timing(
+            lambda s, n: float(chain(q, k, v, s, n)), iters, 4 * iters, reps
+        )
+        return timing.per_iter_s or timing.inclusive_per_iter_s
+
     flash_s = timed(lambda a, kk, vv: flash_attention(a, kk, vv, causal=True))
+    flash_train_s = timed_grad(lambda a, kk, vv: flash_attention(a, kk, vv, causal=True))
     report = {
         "seq_len": seq_len,
         "heads": heads,
         # causal attention: 2 matmuls x 2·S²/2·D MACs per head
         "flash_time_ms": flash_s * 1e3,
         "flash_tflops": 2 * 2 * heads * seq_len**2 * head_dim / 2 / flash_s / 1e12,
+        "flash_fwd_bwd_ms": flash_train_s * 1e3,
     }
     if seq_len <= 8192:
         dense_s = timed(lambda a, kk, vv: dense_attention(a, kk, vv, causal=True))
         report["dense_time_ms"] = dense_s * 1e3
         report["speedup_vs_dense"] = dense_s / flash_s
+        dense_train_s = timed_grad(lambda a, kk, vv: dense_attention(a, kk, vv, causal=True))
+        report["dense_fwd_bwd_ms"] = dense_train_s * 1e3
+        report["train_step_speedup_vs_dense"] = dense_train_s / flash_train_s
     return report
