@@ -315,6 +315,40 @@ class TestFlashAttention:
         report = run_flash_attention_check(seq_len=256, block_q=128, block_k=64)
         assert report["ok"]
 
+    def test_burnin_trains_through_flash_kernel(self):
+        """The burn-in transformer with use_flash_attention trains on the
+        sharded mesh (pallas kernel under shard_map, custom VJP through
+        jax.grad) and agrees with the dense path's loss."""
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh, run_burnin
+
+        kwargs = dict(d_model=128, n_heads=2, d_ff=256, seq_len=128, batch=8, n_layers=1)
+        mesh = make_mesh(data=4, model=2)
+        flash = run_burnin(mesh=mesh, cfg=BurninConfig(use_flash_attention=True, **kwargs))
+        dense = run_burnin(mesh=mesh, cfg=BurninConfig(**kwargs))
+        assert flash["ok"] and dense["ok"]
+        assert abs(flash["losses"][0] - dense["losses"][0]) < 2e-2
+
+    def test_burnin_flash_config_validation(self):
+        from tpu_operator.workloads.burnin import (
+            BurninConfig,
+            build_train_step,
+            make_mesh,
+            make_mesh_3d,
+        )
+
+        # heads must divide the model axis (dense path would accept this)
+        with pytest.raises(ValueError, match="n_heads"):
+            build_train_step(
+                make_mesh(data=2, model=4),
+                BurninConfig(n_heads=2, seq_len=128, use_flash_attention=True),
+            )
+        # flash and ring are mutually exclusive attention paths
+        with pytest.raises(ValueError, match="separate attention"):
+            build_train_step(
+                make_mesh_3d(data=2, sp=2, model=2),
+                BurninConfig(sequence_parallel=True, use_flash_attention=True),
+            )
+
     def test_rejects_misaligned_seq(self):
         import jax.numpy as jnp
 

@@ -27,6 +27,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.35
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
 
 @dataclasses.dataclass(frozen=True)
 class BurninConfig:
@@ -41,6 +46,10 @@ class BurninConfig:
     # shard the sequence axis over an 'sp' mesh axis and use ring attention
     # (workloads/ringattention.py) inside the block — the long-context mode
     sequence_parallel: bool = False
+    # use the pallas flash kernel (workloads/flashattention.py) for the
+    # local attention instead of the dense einsum path — requires
+    # 128-aligned seq_len; differentiable via its custom VJP
+    use_flash_attention: bool = False
     # >0 replaces the dense FFN with a top-1 routed mixture of experts
     # sharded over an 'ep' mesh axis (GShard-style one-hot dispatch — the
     # canonical TPU MoE formulation: XLA lowers the dispatch/combine
@@ -160,11 +169,6 @@ def _ring_ctx(q, k, v, mesh: Mesh):
 
     from tpu_operator.workloads.ringattention import _ring_attention_local
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
     spec = P("data", "sp", "model", None)
     fn = shard_map(
         _partial(_ring_attention_local, axis_name="sp", causal=True),
@@ -173,6 +177,30 @@ def _ring_ctx(q, k, v, mesh: Mesh):
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def _flash_ctx(q, k, v, mesh: Optional[Mesh]):
+    """Local attention via the pallas flash kernel. A pallas_call does not
+    partition under pjit by itself, so on a mesh it runs under shard_map —
+    batch stays on 'data', heads on 'model', each shard running the kernel
+    on its local slice (the custom VJP differentiates through shard_map)."""
+    from tpu_operator.workloads.flashattention import flash_attention
+
+    s = q.shape[1]
+    block = min(s, 256 if s % 256 == 0 else 128)
+
+    def local(a, b, c):
+        return flash_attention(a, b, c, causal=True, block_q=block, block_k=block)
+
+    if mesh is None:
+        return local(q, k, v)
+    model = "model" if "model" in mesh.axis_names else None
+    spec = P("data", None, model, None)
+    # check_vma off: pallas_call's ShapeDtypeStruct outputs carry no vma
+    # annotation, which the shard_map varying-axis checker insists on
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
+    )(q, k, v)
 
 
 def _moe_ffn(params, layer: int, y, cfg: BurninConfig, mesh: Optional[Mesh] = None):
@@ -230,6 +258,8 @@ def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None
     v = v.reshape(b, s, h, d // h)
     if cfg.sequence_parallel:
         ctx = _ring_ctx(q, k, v, mesh)
+    elif cfg.use_flash_attention:
+        ctx = _flash_ctx(q, k, v, mesh)
     else:
         ctx = _dense_ctx(q, k, v, d // h)
     ctx = ctx.reshape(b, s, d)
@@ -260,6 +290,26 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     cfg = cfg or BurninConfig()
     if cfg.sequence_parallel and "sp" not in mesh.axis_names:
         raise ValueError("sequence_parallel needs an 'sp' mesh axis (make_mesh_3d)")
+    if cfg.sequence_parallel and cfg.use_flash_attention:
+        raise ValueError(
+            "sequence_parallel and use_flash_attention are separate attention "
+            "paths — enable one (ring spans chips, flash blocks within one)"
+        )
+    if cfg.use_flash_attention:
+        # the flash shard_map splits batch over 'data' and heads over
+        # 'model'; reject configs the dense path would accept but this
+        # path cannot shard, instead of a raw trace-time shape error
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if cfg.batch % axes.get("data", 1):
+            raise ValueError(
+                f"use_flash_attention: batch ({cfg.batch}) must divide over "
+                f"the 'data' axis ({axes.get('data', 1)})"
+            )
+        if cfg.n_heads % axes.get("model", 1):
+            raise ValueError(
+                f"use_flash_attention: n_heads ({cfg.n_heads}) must divide "
+                f"over the 'model' axis ({axes.get('model', 1)})"
+            )
     if cfg.moe_experts and "ep" not in mesh.axis_names:
         raise ValueError("moe_experts needs an 'ep' mesh axis (make_mesh_4d)")
     if cfg.moe_experts and cfg.moe_experts % mesh.shape.get("ep", 1):
