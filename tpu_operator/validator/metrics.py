@@ -76,6 +76,12 @@ class NodeMetrics:
             ["node"],
             registry=self.registry,
         )
+        self.slice_flash_attention_err = prometheus_client.Gauge(
+            "tpu_operator_node_slice_flash_attention_max_abs_err",
+            "Pallas-flash-vs-dense attention exactness from the last slice validation",
+            ["node"],
+            registry=self.registry,
+        )
         self.slice_pipeline_err = prometheus_client.Gauge(
             "tpu_operator_node_slice_pipeline_max_abs_err",
             "Pipelined-vs-sequential exactness from the last slice validation "
@@ -103,6 +109,11 @@ class NodeMetrics:
                 ring = payload.get("ring_attention") or {}
                 if ring.get("max_abs_err") is not None:
                     self.slice_ring_attention_err.labels(self._node).set(ring["max_abs_err"])
+                flash = payload.get("flash_attention") or {}
+                if flash.get("max_abs_err") is not None:
+                    self.slice_flash_attention_err.labels(self._node).set(
+                        flash["max_abs_err"]
+                    )
                 pipeline = payload.get("pipeline") or {}
                 if pipeline.get("max_abs_err_vs_sequential") is not None:
                     self.slice_pipeline_err.labels(self._node).set(
