@@ -234,18 +234,29 @@ def _virtual_mesh_details() -> dict:
 
 
 def _multiprocess_distributed_details() -> dict:
-    """Live 2-process jax.distributed over localhost TCP (gang contract
-    end to end; closest this 1-chip environment gets to BASELINE 4/5)."""
+    """Live multi-process jax.distributed over localhost TCP (gang
+    contract end to end; closest this 1-chip environment gets to
+    BASELINE 4/5): a 2-host single-slice gang, and a 2-slice world over
+    the DCN coordinator (MEGASCALE_* env path)."""
     try:
-        from tpu_operator.workloads.multiproc import run_multiprocess_check
+        from tpu_operator.workloads.multiproc import (
+            run_multiprocess_check,
+            run_multislice_check,
+        )
 
         report = run_multiprocess_check(num_workers=2, devices_per_worker=4)
+        multislice = run_multislice_check(num_slices=2, hosts_per_slice=1, devices_per_worker=4)
         return {
             "note": "2 local processes x 4 virtual CPU devices, real jax.distributed/TCP",
             "global_devices": report["global_devices"],
             "psum_ok": report["psum_ok"],
             "psum_chain_ms": round(report["psum_chain_ms"], 2),
             "ring_attention_max_err": report["ring_attention_max_err"],
+            "two_slice_dcn": {
+                "slices": multislice["num_slices"],
+                "global_devices": multislice["global_devices"],
+                "psum_ok": multislice["psum_ok"],
+            },
         }
     except Exception as e:  # noqa: BLE001 — details are best-effort
         return {"error": str(e)[-500:]}

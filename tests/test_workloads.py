@@ -110,6 +110,72 @@ class TestDistributed:
         )
         assert cfg.coordinator_address == "slice0-coord:9000"
 
+    def test_multislice_world_spans_slices(self):
+        """MEGASCALE_NUM_SLICES multiplies the process world; a worker's
+        global id offsets by its slice's block (slice 1 host 1 of a
+        2-slice x 2-host job is process 3 of 4)."""
+        cfg = config_from_env(
+            {
+                "TPU_WORKER_ID": "1",
+                "TPU_WORKER_HOSTNAMES": "a,b",
+                "MEGASCALE_COORDINATOR_ADDRESS": "slice0-coord:9000",
+                "MEGASCALE_NUM_SLICES": "2",
+                "MEGASCALE_SLICE_ID": "1",
+            }
+        )
+        assert (cfg.num_processes, cfg.process_id) == (4, 3)
+        assert cfg.needed
+        # a 1-host slice still needs distributed init when slices > 1
+        solo = config_from_env(
+            {
+                "TPU_WORKER_ID": "0",
+                "TPU_WORKER_HOSTNAMES": "a",
+                "MEGASCALE_COORDINATOR_ADDRESS": "c:9",
+                "MEGASCALE_NUM_SLICES": "2",
+                "MEGASCALE_SLICE_ID": "0",
+            }
+        )
+        assert solo.needed and solo.num_processes == 2 and solo.process_id == 0
+
+    def test_multislice_requires_coordinator(self):
+        """NUM_SLICES>1 without the DCN coordinator would have every slice
+        elect its own coordinator while claiming the cross-slice world —
+        a silent deadlock; it must fail fast instead."""
+        with pytest.raises(ValueError, match="COORDINATOR_ADDRESS"):
+            config_from_env(
+                {"TPU_WORKER_HOSTNAMES": "a,b", "MEGASCALE_NUM_SLICES": "2"}
+            )
+
+    def test_launchers_reject_mismatched_worlds(self):
+        from tpu_operator.workloads.multiproc import (
+            run_multiprocess_check,
+            run_multislice_check,
+        )
+
+        # a multi-slice env derives a bigger world than the single-slice
+        # launcher spawns
+        with pytest.raises(ValueError, match="run_multislice_check"):
+            run_multiprocess_check(
+                num_workers=2,
+                gang_env={
+                    "TPU_WORKER_HOSTNAMES": "a,b",
+                    "MEGASCALE_COORDINATOR_ADDRESS": "c",
+                    "MEGASCALE_NUM_SLICES": "2",
+                    "MEGASCALE_SLICE_ID": "0",
+                },
+            )
+        # heterogeneous slices deadlock at initialize; reject up front
+        with pytest.raises(ValueError, match="uniform"):
+            run_multislice_check(
+                num_slices=2,
+                gang_envs=[
+                    {"TPU_WORKER_HOSTNAMES": "a", "MEGASCALE_NUM_SLICES": "2",
+                     "MEGASCALE_COORDINATOR_ADDRESS": "c", "MEGASCALE_SLICE_ID": "0"},
+                    {"TPU_WORKER_HOSTNAMES": "a,b", "MEGASCALE_NUM_SLICES": "2",
+                     "MEGASCALE_COORDINATOR_ADDRESS": "c", "MEGASCALE_SLICE_ID": "1"},
+                ],
+            )
+
 
 class TestRingAttention:
     def test_causal_matches_dense(self):
@@ -404,6 +470,44 @@ class TestMultiprocessDistributed:
         assert report["ring_attention_max_err"] < 1e-4
         # every worker observed the same global topology
         assert {w["num_processes"] for w in report["workers"]} == {2}
+
+    def test_two_slice_world_from_rendered_gang_envs(self):
+        """BASELINE config 5 shape, executed live: two slices (two pools)
+        rendered by the multi-slice manager, one jax.distributed world
+        spanning both over the DCN coordinator — psum and ring attention
+        cross the slice boundary for real."""
+        from tpu_operator import consts
+        from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.sim import make_tpu_node
+        from tpu_operator.workloads.multiproc import run_multislice_check
+
+        client = FakeClient()
+        for pool in ("pool-a", "pool-b"):
+            for i in range(2):
+                node = make_tpu_node(
+                    f"{pool}-{i}", "tpu-v5-lite-podslice", "2x4", nodepool=pool
+                )
+                node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+                client.create(node)
+        agent = SliceManagerAgent(
+            client, "tpu-operator", multi_slice=True, coordinator_port=8476
+        )
+        names = agent.reconcile_once()
+        assert len(names) == 2
+        gang_envs = [
+            client.get("v1", "ConfigMap", f"{name}-gang", "tpu-operator")["data"]
+            for name in names
+        ]
+        assert {env["MEGASCALE_SLICE_ID"] for env in gang_envs} == {"0", "1"}
+        report = run_multislice_check(
+            num_slices=2, devices_per_worker=2, gang_envs=gang_envs, timeout=120
+        )
+        assert report["ok"] and report["psum_ok"]
+        # 2 slices x 2 hosts x 2 devices: the world spans every slice
+        assert report["global_devices"] == 8
+        assert {w["num_processes"] for w in report["workers"]} == {4}
+        assert {w["process_id"] for w in report["workers"]} == {0, 1, 2, 3}
 
     def test_multislice_env_coordinator_rewritten_to_loopback(self):
         """A multi-slice gang env carries MEGASCALE_COORDINATOR_ADDRESS

@@ -35,11 +35,33 @@ def config_from_env(env: Optional[Mapping[str, str]] = None, coordinator_port: i
       coordinator
     - ``MEGASCALE_COORDINATOR_ADDRESS`` (multi-slice): overrides the
       coordinator for cross-slice DCN bring-up
+    - ``MEGASCALE_NUM_SLICES`` / ``MEGASCALE_SLICE_ID`` (multi-slice): the
+      process world spans every slice — num_processes multiplies by the
+      slice count and this host's process id offsets by its slice's block
+      (slice 0 worker 0 is the global coordinator). Slices must be
+      uniform: every slice's env lists the same number of hostnames (the
+      slice manager renders pools of one accelerator/topology shape per
+      slice set, so this holds in-cluster; ``multiproc.run_multislice_check``
+      validates it for hand-built envs).
     """
     env = env if env is not None else os.environ
     hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     worker_id = int(env.get("TPU_WORKER_ID", "0") or "0")
-    num = len(hostnames) if hostnames else 1
+    per_slice = len(hostnames) if hostnames else 1
+    num = per_slice
+    process_id = worker_id
+    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1") or "1")
+    if num_slices > 1:
+        if not env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            # without the shared DCN coordinator every slice would elect
+            # its own slice-local coordinator while claiming the
+            # cross-slice world size — a silent deadlock at initialize.
+            # Fail fast instead.
+            raise ValueError(
+                "MEGASCALE_NUM_SLICES > 1 requires MEGASCALE_COORDINATOR_ADDRESS"
+            )
+        num = per_slice * num_slices
+        process_id = int(env.get("MEGASCALE_SLICE_ID", "0") or "0") * per_slice + worker_id
     coordinator = env.get("MEGASCALE_COORDINATOR_ADDRESS") or (
         f"{hostnames[0]}:{coordinator_port}" if hostnames else None
     )
@@ -48,7 +70,7 @@ def config_from_env(env: Optional[Mapping[str, str]] = None, coordinator_port: i
     return DistributedConfig(
         coordinator_address=coordinator,
         num_processes=num,
-        process_id=worker_id,
+        process_id=process_id,
     )
 
 

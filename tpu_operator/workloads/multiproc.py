@@ -157,56 +157,37 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_multiprocess_check(
-    num_workers: int = 2,
-    devices_per_worker: int = 4,
-    gang_env: Optional[Mapping[str, str]] = None,
-    timeout: float = 300.0,
-) -> dict:
-    """Spawn ``num_workers`` gang worker processes and collect their reports.
+def _localize_gang_env(gang_env: Mapping[str, str], port: int) -> dict:
+    """Rewrite a rendered gang env for loopback execution: hostnames and
+    the DCN coordinator point at 127.0.0.1 (the launcher plays the
+    resolver the headless Service plays in-cluster)."""
+    env = dict(gang_env)
+    hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    env["TPU_WORKER_HOSTNAMES"] = ",".join("127.0.0.1" for _ in hostnames)
+    env["TPU_COORDINATOR_PORT"] = str(port)
+    if "MEGASCALE_COORDINATOR_ADDRESS" in env:
+        # the DCN coordinator override wins in config_from_env, so it
+        # too must point at loopback
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    return env
 
-    ``gang_env``: the gang ConfigMap data as the slice manager rendered it
-    (``slice_manager_agent._apply_gang_configmap``); hostnames are rewritten
-    to loopback since the headless Service's DNS does not exist here. When
-    omitted, a minimal contract-shaped env is synthesized.
-    """
-    if gang_env is None:
-        gang_env = {
-            "TPU_WORKER_HOSTNAMES": ",".join("127.0.0.1" for _ in range(num_workers)),
-        }
-    hostnames = [h for h in gang_env["TPU_WORKER_HOSTNAMES"].split(",") if h]
-    if len(hostnames) != num_workers:
-        raise ValueError(
-            f"gang env lists {len(hostnames)} workers, launcher asked for {num_workers}"
-        )
-    port = _free_port()
-    env_common = dict(os.environ)
-    env_common.update(gang_env)
-    env_common.update(
-        {
-            # loopback stands in for the headless-Service DNS entries
-            "TPU_WORKER_HOSTNAMES": ",".join("127.0.0.1" for _ in hostnames),
-            "TPU_COORDINATOR_PORT": str(port),
-        }
-    )
-    if "MEGASCALE_COORDINATOR_ADDRESS" in env_common:
-        # multi-slice env: the DCN coordinator override wins in
-        # config_from_env, so it too must point at loopback
-        env_common["MEGASCALE_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-    env_common.update(
-        {
-            # CPU platform with K virtual devices per worker; env is set
-            # before the child interpreter starts, so it beats the
-            # sitecustomize jax pre-import
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_worker}",
-        }
-    )
+
+def _launch_workers(worker_envs, devices_per_worker: int, timeout: float):
+    """Spawn one worker process per env, collect and validate reports."""
     procs = []
-    for i in range(num_workers):
-        env = dict(env_common)
-        env["TPU_WORKER_ID"] = str(i)
+    for worker_env in worker_envs:
+        env = dict(os.environ)
+        env.update(worker_env)
+        env.update(
+            {
+                # CPU platform with K virtual devices per worker; env is
+                # set before the child interpreter starts, so it beats
+                # the sitecustomize jax pre-import
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_worker}",
+            }
+        )
         procs.append(
             subprocess.Popen(
                 [sys.executable, "-m", "tpu_operator.workloads.multiproc"],
@@ -242,8 +223,12 @@ def run_multiprocess_check(
         workers.append(report)
     if failures:
         raise RuntimeError("multiprocess check failed:\n" + "\n".join(failures))
+    return workers
+
+
+def _summarize(workers, devices_per_worker: int) -> dict:
     return {
-        "num_workers": num_workers,
+        "num_workers": len(workers),
         "devices_per_worker": devices_per_worker,
         "global_devices": workers[0]["global_devices"],
         "psum_ok": all(w["psum_ok"] for w in workers),
@@ -252,6 +237,103 @@ def run_multiprocess_check(
         "workers": workers,
         "ok": True,
     }
+
+
+def run_multiprocess_check(
+    num_workers: int = 2,
+    devices_per_worker: int = 4,
+    gang_env: Optional[Mapping[str, str]] = None,
+    timeout: float = 300.0,
+) -> dict:
+    """Spawn ``num_workers`` gang worker processes and collect their reports.
+
+    ``gang_env``: the gang ConfigMap data as the slice manager rendered it
+    (``slice_manager_agent._apply_gang_configmap``); hostnames are rewritten
+    to loopback since the headless Service's DNS does not exist here. When
+    omitted, a minimal contract-shaped env is synthesized.
+    """
+    if gang_env is None:
+        gang_env = {
+            "TPU_WORKER_HOSTNAMES": ",".join("127.0.0.1" for _ in range(num_workers)),
+        }
+    hostnames = [h for h in gang_env["TPU_WORKER_HOSTNAMES"].split(",") if h]
+    if len(hostnames) != num_workers:
+        raise ValueError(
+            f"gang env lists {len(hostnames)} workers, launcher asked for {num_workers}"
+        )
+    base = _localize_gang_env(gang_env, _free_port())
+    # a multi-slice env derives a world larger than this launcher spawns
+    # (config_from_env multiplies by MEGASCALE_NUM_SLICES): the gang
+    # would wait for processes that never start — fail fast
+    from tpu_operator.workloads.distributed import config_from_env
+
+    derived = config_from_env(dict(base, TPU_WORKER_ID="0"))
+    if derived.num_processes != num_workers:
+        raise ValueError(
+            f"gang env derives a {derived.num_processes}-process world but the "
+            f"launcher spawns {num_workers} — multi-slice envs need "
+            "run_multislice_check"
+        )
+    worker_envs = [dict(base, TPU_WORKER_ID=str(i)) for i in range(num_workers)]
+    workers = _launch_workers(worker_envs, devices_per_worker, timeout)
+    return _summarize(workers, devices_per_worker)
+
+
+def run_multislice_check(
+    num_slices: int = 2,
+    hosts_per_slice: int = 1,
+    devices_per_worker: int = 4,
+    gang_envs: Optional[list] = None,
+    timeout: float = 300.0,
+) -> dict:
+    """BASELINE config 5 analog: ONE distributed job spanning slices over
+    the DCN coordinator. Each worker process receives its own slice's
+    gang env (MEGASCALE_COORDINATOR_ADDRESS / NUM_SLICES / SLICE_ID plus
+    the per-slice hostname list) and derives the global process world
+    from it (``distributed.config_from_env``); slice 0's worker 0
+    coordinates, exactly as the slice manager wires it in-cluster.
+
+    ``gang_envs``: one rendered gang ConfigMap per slice (the slice
+    manager's multi_slice output); synthesized when omitted.
+    """
+    if gang_envs is None:
+        hostnames = ",".join("127.0.0.1" for _ in range(hosts_per_slice))
+        gang_envs = [
+            {
+                "TPU_WORKER_HOSTNAMES": hostnames,
+                "MEGASCALE_COORDINATOR_ADDRESS": "127.0.0.1",
+                "MEGASCALE_NUM_SLICES": str(num_slices),
+                "MEGASCALE_SLICE_ID": str(i),
+            }
+            for i in range(num_slices)
+        ]
+    if len(gang_envs) != num_slices:
+        raise ValueError(f"{len(gang_envs)} gang envs for {num_slices} slices")
+    host_counts = {
+        len([h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h])
+        for env in gang_envs
+    }
+    if len(host_counts) != 1:
+        # heterogeneous slices compute inconsistent world sizes and
+        # colliding process ids (config_from_env derives the world from
+        # the LOCAL slice's host count) — deadlock at initialize
+        raise ValueError(f"slices must be uniform; host counts differ: {host_counts}")
+    declared = {env.get("MEGASCALE_NUM_SLICES") for env in gang_envs}
+    if declared != {str(num_slices)}:
+        raise ValueError(
+            f"gang envs declare MEGASCALE_NUM_SLICES={declared}, launcher runs {num_slices}"
+        )
+    port = _free_port()
+    worker_envs = []
+    for slice_env in gang_envs:
+        localized = _localize_gang_env(slice_env, port)
+        n_hosts = len([h for h in localized["TPU_WORKER_HOSTNAMES"].split(",") if h])
+        for worker_id in range(n_hosts):
+            worker_envs.append(dict(localized, TPU_WORKER_ID=str(worker_id)))
+    workers = _launch_workers(worker_envs, devices_per_worker, timeout)
+    report = _summarize(workers, devices_per_worker)
+    report["num_slices"] = num_slices
+    return report
 
 
 if __name__ == "__main__":
