@@ -46,9 +46,32 @@ def ready():
 wait(ready, what="install -> Ready")
 print("STEP 1 OK: install -> ClusterPolicy Ready, 7 operand DaemonSets")
 
-# 2. TPU workload (the smoke payload the validator schedules)
+# 2. TPU workload (the smoke payload the validator schedules) on whatever
+# accelerator is attached (the one real-device step; everything else is
+# hermetic). The relayed dev backend occasionally throws transient
+# FAILED_PRECONDITION faults (libtpu client/terminal skew) unrelated to
+# the operator under test: retry ONCE, only for that fault class, and in
+# a fresh subprocess — jax caches a failed backend init for the process
+# lifetime, so an in-process retry would just re-raise it.
 from tpu_operator.workloads.smoke import run_smoke
-report = run_smoke()
+try:
+    report = run_smoke()
+except Exception as first:  # noqa: BLE001 — inspect the fault class below
+    if "FAILED_PRECONDITION" not in str(first):
+        raise  # a real workload failure must fail the e2e
+    print(f"STEP 2 retry (fresh process) after transient device fault: {first}")
+    time.sleep(5)
+    import json as _json, subprocess, sys as _sys
+    proc = subprocess.run(
+        [_sys.executable, "-c",
+         "import json; from tpu_operator.workloads.smoke import run_smoke; "
+         "print('SMOKE:' + json.dumps(run_smoke()))"],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"smoke retry failed: {proc.stderr[-2000:]}") from first
+    report = next(_json.loads(l[len("SMOKE:"):]) for l in proc.stdout.splitlines()
+                  if l.startswith("SMOKE:"))
 print(f"STEP 2 OK: TPU workload pass ({report['device_count']} {report['platform']} device(s))")
 
 # 2b. gang placement: the slice manager materializes the full multi-host
