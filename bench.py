@@ -142,7 +142,9 @@ def tpu_details() -> dict:
         mm = matmul_tflops(size=8192 if platform != "cpu" else 512, iters=16 if platform != "cpu" else 2)
         key = "matmul_bf16_tflops_lower_bound" if mm.get("unstable_timing") else "matmul_bf16_tflops"
         details[key] = round(mm["tflops"], 2)
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+        from tpu_operator.workloads.matmul_bench import chip_generation
+
+        gen = chip_generation()
         if gen in PEAK_TFLOPS and not mm.get("unstable_timing"):
             details["mxu_utilization_pct"] = round(100 * mm["tflops"] / PEAK_TFLOPS[gen], 1)
         if platform != "cpu":

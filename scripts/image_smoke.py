@@ -131,7 +131,7 @@ class Harness:
 
 
 def spawn(module: str, args, env) -> subprocess.Popen:
-    return subprocess.Popen(
+    proc = subprocess.Popen(
         [sys.executable, "-m", module, *args],
         env=env,
         cwd=REPO,
@@ -139,6 +139,19 @@ def spawn(module: str, args, env) -> subprocess.Popen:
         stderr=subprocess.STDOUT,
         text=True,
     )
+    # drain the pipe continuously: a chatty child would otherwise block on
+    # a full pipe buffer before reaching its observable startup effect,
+    # and the smoke would misreport a startup timeout
+    proc.out_lines = []
+
+    def _drain():
+        for line in proc.stdout:
+            proc.out_lines.append(line)
+
+    import threading
+
+    threading.Thread(target=_drain, daemon=True).start()
+    return proc
 
 
 def wait_for(desc: str, predicate, proc=None, timeout: float = START_TIMEOUT):
@@ -149,7 +162,7 @@ def wait_for(desc: str, predicate, proc=None, timeout: float = START_TIMEOUT):
         if proc is not None and proc.poll() is not None:
             raise SystemExit(
                 f"FAIL {desc}: process exited rc={proc.returncode}\n"
-                f"{proc.stdout.read()[-3000:]}"
+                f"{''.join(proc.out_lines)[-3000:]}"
             )
         time.sleep(0.25)
     out = ""
@@ -158,9 +171,9 @@ def wait_for(desc: str, predicate, proc=None, timeout: float = START_TIMEOUT):
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            proc.kill()  # SIGTERM ignored: make the pipe EOF before read
+            proc.kill()  # SIGTERM ignored: force exit so the drain sees EOF
             proc.wait(timeout=10)
-        out = proc.stdout.read()[-3000:]
+        out = "".join(proc.out_lines)[-3000:]
     raise SystemExit(f"FAIL {desc}: condition not met in {timeout}s\n{out}")
 
 

@@ -88,8 +88,12 @@ class UpgradeDrill:
                 spec={"taints": [dict(DRILL_TAINT)]},
             )
         )
-        # nodeSelector matches nothing, so a real DS controller schedules
-        # no pods; the drill creates (and recreates) the driver pod itself
+        # Keep a REAL DaemonSet controller's hands off the drill pod: the
+        # DS selector matches a label the pod does not carry (so the
+        # controller neither adopts nor deletes it), its nodeSelector
+        # matches no node (so it schedules nothing), and the pod's
+        # ownerReference below is controller: False. The FSM only needs
+        # the ownerReference kind/name to resolve the owning DS.
         c.create(
             new_object(
                 "apps/v1",
@@ -97,11 +101,9 @@ class UpgradeDrill:
                 self.ds_name,
                 self.ns,
                 spec={
-                    "selector": {"matchLabels": {DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT}},
+                    "selector": {"matchLabels": {"app": f"{self.ds_name}-template"}},
                     "template": {
-                        "metadata": {
-                            "labels": {DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT}
-                        },
+                        "metadata": {"labels": {"app": f"{self.ds_name}-template"}},
                         "spec": {
                             "nodeSelector": {"tpu.google.com/upgrade-drill-never": "true"},
                             "containers": [
@@ -183,7 +185,10 @@ class UpgradeDrill:
                 "kind": "DaemonSet",
                 "name": self.ds_name,
                 "uid": ds["metadata"].get("uid", ""),
-                "controller": True,
+                # controller False: a real DS controller must not treat the
+                # drill's hand-made pod as its own (it would delete it —
+                # shouldRunDaemonPod is false for the synthetic node)
+                "controller": False,
             }
         ]
         self.client.create(pod)

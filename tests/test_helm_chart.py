@@ -220,6 +220,24 @@ class TestHelmliteEngine:
             helmlite.render_string("{{ if true }}{{ $x := 1 }}{{ end }}{{ $x }}", {})
         with pytest.raises(helmlite.HelmliteError, match="undeclared"):
             helmlite.render_string("{{ $x = 1 }}", {})
+        # else bodies are blocks too (range/with)
+        with pytest.raises(helmlite.HelmliteError, match="undefined"):
+            helmlite.render_string(
+                "{{ range .Values.items }}x{{ else }}{{ $v := 1 }}{{ end }}{{ $v }}",
+                {"Values": {}},
+            )
+
+    def test_pipe_inside_string_literal(self):
+        assert (
+            helmlite.render_string('{{ eq .Values.sep "|" }}', {"Values": {"sep": "|"}})
+            == "true"
+        )
+        assert (
+            helmlite.render_string('{{ replace "|" "," .Values.s }}', {"Values": {"s": "a|b"}})
+            == "a,b"
+        )
+        with pytest.raises(helmlite.HelmliteError, match="unterminated"):
+            helmlite.render_string('{{ eq .Values.x "| }}', {"Values": {}})
 
     def test_define_include_nindent(self):
         defines = {}

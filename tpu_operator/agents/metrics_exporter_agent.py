@@ -124,7 +124,11 @@ class MetricsExporterAgent:
         try:
             import jax
 
-            from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS, matmul_tflops
+            from tpu_operator.workloads.matmul_bench import (
+                PEAK_TFLOPS,
+                chip_generation,
+                matmul_tflops,
+            )
 
             on_tpu = jax.local_devices()[0].platform == "tpu"
             # the 8192/16 configuration matches the headline probe: shorter
@@ -133,9 +137,10 @@ class MetricsExporterAgent:
                 size=8192 if on_tpu else 256, iters=16 if on_tpu else 2
             )
             self.matmul_tflops.labels(self.node_name).set(report["tflops"])
-            gen = os.environ.get("PALLAS_AXON_TPU_GEN", "") or os.environ.get(
-                "TPU_GENERATION", ""
-            )
+            # generation from the runtime's device_kind: rendered pods set
+            # no generation env var, so an env-only lookup would leave the
+            # utilization gauge silently absent in-cluster
+            gen = chip_generation()
             if on_tpu and gen in PEAK_TFLOPS and not report.get("unstable_timing"):
                 self.mxu_utilization.labels(self.node_name).set(
                     100.0 * report["tflops"] / PEAK_TFLOPS[gen]

@@ -421,7 +421,7 @@ def _walk(base: Any, path: str, full: str) -> Any:
 
 
 def _eval_atom(tok: str, scope: _Scope) -> Any:
-    if tok.startswith('"') and tok.endswith('"'):
+    if len(tok) >= 2 and tok.startswith('"') and tok.endswith('"'):
         return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
     if tok in ("true", "false"):
         return tok == "true"
@@ -465,9 +465,40 @@ def _eval_segment(tokens: List[str], scope: _Scope, piped: Any = ...) -> Any:
     return _eval_atom(head, scope)
 
 
+def _split_pipeline(pipeline: str) -> List[str]:
+    """Split on '|' outside string literals ('{{ eq .x "|" }}' must not
+    split inside the quoted argument)."""
+    segments: List[str] = []
+    current: List[str] = []
+    in_string = False
+    i = 0
+    while i < len(pipeline):
+        ch = pipeline[i]
+        if in_string:
+            current.append(ch)
+            if ch == "\\" and i + 1 < len(pipeline):
+                current.append(pipeline[i + 1])
+                i += 1
+            elif ch == '"':
+                in_string = False
+        elif ch == '"':
+            in_string = True
+            current.append(ch)
+        elif ch == "|":
+            segments.append("".join(current))
+            current = []
+        else:
+            current.append(ch)
+        i += 1
+    if in_string:
+        raise HelmliteError(f"unterminated string literal in {pipeline!r}")
+    segments.append("".join(current))
+    return segments
+
+
 def _eval_pipeline(pipeline: str, scope: _Scope) -> Any:
     value: Any = ...
-    for segment in pipeline.split("|"):
+    for segment in _split_pipeline(pipeline):
         tokens = _TOKEN_RE.findall(segment.strip())
         if not tokens:
             raise HelmliteError(f"empty pipeline segment in {pipeline!r}")
@@ -510,7 +541,8 @@ def _render_nodes(nodes: List[_Node], scope: _Scope) -> str:
             if _truthy(val):
                 out.append(_render_nodes(node.body, scope.child(val)))
             elif node.else_body:
-                out.append(_render_nodes(node.else_body, scope))
+                # else bodies are blocks too: declarations stay local
+                out.append(_render_nodes(node.else_body, scope.child(scope.dot)))
         elif isinstance(node, _Range):
             val = _eval_pipeline(node.pipeline, scope)
             if isinstance(val, dict):
@@ -523,7 +555,8 @@ def _render_nodes(nodes: List[_Node], scope: _Scope) -> str:
                 raise HelmliteError(f"range over non-iterable {type(val).__name__}")
             if not items:
                 if node.else_body:
-                    out.append(_render_nodes(node.else_body, scope))
+                    # else bodies are blocks too: declarations stay local
+                    out.append(_render_nodes(node.else_body, scope.child(scope.dot)))
                 continue
             for key, elem in items:
                 body_scope = scope.child(elem)

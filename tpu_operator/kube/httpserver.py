@@ -308,10 +308,23 @@ class FakeApiServer:
         handler.end_headers()
         handler.wfile.flush()
         try:
+            idle_ticks = 0
             while not self._stopped.is_set():
                 try:
                     etype, obj = events.get(timeout=0.5)
+                    idle_ticks = 0
                 except queue.Empty:
+                    # a client that vanished is only detectable by writing:
+                    # heartbeat an (informer-ignored) BOOKMARK on idle so a
+                    # dead stream raises BrokenPipe here instead of leaking
+                    # this thread + subscription + queue until server stop
+                    idle_ticks += 1
+                    if idle_ticks >= 10:  # ~5s idle
+                        idle_ticks = 0
+                        handler.wfile.write(
+                            json.dumps({"type": "BOOKMARK", "object": {}}).encode() + b"\n"
+                        )
+                        handler.wfile.flush()
                     continue
                 handler.wfile.write(
                     json.dumps({"type": etype, "object": obj}).encode() + b"\n"

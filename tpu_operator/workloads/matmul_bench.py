@@ -23,6 +23,36 @@ PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 # int8 fast path)
 PEAK_INT8_TOPS = {"v4": 275.0, "v5e": 394.0, "v5p": 918.0, "v6e": 1836.0}
 
+# PJRT device_kind strings per generation — the LOCAL source of truth for
+# peak lookups (env vars only exist in dev shells; in-cluster pods carry
+# neither, but the runtime always knows what chip it is on)
+_DEVICE_KIND_GENERATIONS = (
+    ("v6e", ("v6e", "trillium")),
+    ("v5p", ("v5p",)),
+    ("v5e", ("v5 lite", "v5e", "v5litepod")),
+    ("v4", ("v4",)),
+)
+
+
+def chip_generation() -> str:
+    """TPU generation ('v4'/'v5e'/'v5p'/'v6e') from the local runtime's
+    device_kind, falling back to the dev-shell env vars; '' off-TPU or
+    when unrecognized."""
+    import os
+
+    try:
+        device = jax.local_devices()[0]
+    except Exception:  # noqa: BLE001 — no runtime
+        device = None
+    if device is not None and device.platform == "tpu":
+        kind = (getattr(device, "device_kind", "") or "").lower()
+        for gen, needles in _DEVICE_KIND_GENERATIONS:
+            if any(needle in kind for needle in needles):
+                return gen
+    return os.environ.get("PALLAS_AXON_TPU_GEN", "") or os.environ.get(
+        "TPU_GENERATION", ""
+    )
+
 
 def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
     """z = z @ y chained INSIDE one jitted fori_loop: the whole timed

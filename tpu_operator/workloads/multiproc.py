@@ -323,6 +323,11 @@ def run_multislice_check(
         raise ValueError(
             f"gang envs declare MEGASCALE_NUM_SLICES={declared}, launcher runs {num_slices}"
         )
+    slice_ids = [env.get("MEGASCALE_SLICE_ID") for env in gang_envs]
+    if len(set(slice_ids)) != num_slices:
+        # duplicate ids derive colliding process ids: two workers claim
+        # the same slot and initialize hangs waiting for the missing one
+        raise ValueError(f"MEGASCALE_SLICE_ID values must be distinct: {slice_ids}")
     port = _free_port()
     worker_envs = []
     for slice_env in gang_envs:
