@@ -21,6 +21,15 @@ from tpu_operator.kube.objects import new_object
 NS = "tpu-operator"
 
 
+def wait_for(fn, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
 @pytest.fixture()
 def served():
     store = FakeClient()
@@ -163,6 +172,77 @@ class TestWatchOverHttp:
         sub.stop()
         assert ("ADDED", "w1") in seen
         assert ("DELETED", "w1") in seen
+
+
+class TestApiserverRestart:
+    def test_operator_survives_apiserver_restart(self):
+        """Kill the apiserver mid-run and bring it back on the same port:
+        pooled connections go stale (retried), watch streams drop and
+        re-list, and the operator converges on state created while it was
+        blind — the level-triggered recovery a real apiserver rollout
+        exercises."""
+        from tpu_operator.api.clusterpolicy import (
+            CLUSTER_POLICY_API_VERSION,
+            CLUSTER_POLICY_KIND,
+            new_cluster_policy,
+        )
+        from tpu_operator.controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+            setup_with_manager,
+        )
+        from tpu_operator.kube.manager import Manager
+        from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+        store = FakeClient()
+        for i in range(2):
+            store.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+        server = FakeApiServer(store).start()
+        port = server.httpd.server_address[1]
+        client = HttpClient(server.base_url, timeout=5.0)
+        sim = ClusterSim(store, ready_delay=0.05, tick=0.01).start()
+        mgr = Manager(client, namespace=NS)
+        setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+        mgr.start()
+        try:
+            client.create(new_cluster_policy())
+
+            def ready():
+                cp = store.get_or_none(
+                    CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy"
+                )
+                return (cp or {}).get("status", {}).get("state") == "ready"
+
+            assert wait_for(ready), "never Ready before the restart"
+
+            server.stop()
+            # mutate while the operator is blind: bump the libtpu version
+            cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            cp["spec"].setdefault("libtpu", {}).update(
+                {"repository": "gcr.io/x", "image": "libtpu", "version": "post-outage"}
+            )
+            store.update(cp)
+            time.sleep(1.0)
+            server2 = FakeApiServer(store, port=port).start()
+            try:
+                def converged():
+                    for ds in store.list("apps/v1", "DaemonSet", NS):
+                        image = ds["spec"]["template"]["spec"]["containers"][0].get("image", "")
+                        if "post-outage" in image:
+                            return True
+                    return False
+
+                assert wait_for(
+                    converged, timeout=40, interval=0.1
+                ), "operator never reconciled the blind-window update"
+            finally:
+                server2.stop()
+        finally:
+            mgr.stop()
+            sim.stop()
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 — already stopped
+                pass
 
 
 class TestUpgradeDrillOverHttp:
