@@ -158,6 +158,7 @@ class TestNodeMetrics:
                 "peak_busbw_gbps_per_chip": 42.5,
                 "ring_attention": {"max_abs_err": 3.5e-7},
                 "flash_attention": {"max_abs_err": 7.8e-3},
+                "ring_flash_attention": {"max_abs_err": 5.4e-7},
                 "pipeline": {"ok": True, "stages": 4, "max_abs_err_vs_sequential": 9e-8},
             },
         )
@@ -185,6 +186,9 @@ class TestNodeMetrics:
         assert values["tpu_operator_node_slice_flash_attention_max_abs_err"][
             (("node", "tpu-0"),)
         ] == 7.8e-3
+        assert values["tpu_operator_node_slice_ring_flash_attention_max_abs_err"][
+            (("node", "tpu-0"),)
+        ] == 5.4e-7
 
     def test_revalidation_failure_clears_barrier(self, ctx):
         status_files.write_status(consts.LIBTPU_READY_FILE, ctx.validation_dir, {"ok": True})

@@ -200,6 +200,10 @@ def validate_slice(ctx: Context) -> dict:
     report["flash_attention"] = flashattention.run_flash_attention_check(
         seq_len=256, block_q=128, block_k=128
     )
+    # and the two levels composed: flash as the ring's local attention
+    report["ring_flash_attention"] = ringattention.run_ring_attention_check(
+        seq_len=max(128, 32 * n), local_impl="flash"
+    )
     return report
 
 

@@ -82,6 +82,12 @@ class NodeMetrics:
             ["node"],
             registry=self.registry,
         )
+        self.slice_ring_flash_err = prometheus_client.Gauge(
+            "tpu_operator_node_slice_ring_flash_attention_max_abs_err",
+            "Composed flash-in-ring attention exactness from the last slice validation",
+            ["node"],
+            registry=self.registry,
+        )
         self.slice_pipeline_err = prometheus_client.Gauge(
             "tpu_operator_node_slice_pipeline_max_abs_err",
             "Pipelined-vs-sequential exactness from the last slice validation "
@@ -113,6 +119,11 @@ class NodeMetrics:
                 if flash.get("max_abs_err") is not None:
                     self.slice_flash_attention_err.labels(self._node).set(
                         flash["max_abs_err"]
+                    )
+                ring_flash = payload.get("ring_flash_attention") or {}
+                if ring_flash.get("max_abs_err") is not None:
+                    self.slice_ring_flash_err.labels(self._node).set(
+                        ring_flash["max_abs_err"]
                     )
                 pipeline = payload.get("pipeline") or {}
                 if pipeline.get("max_abs_err_vs_sequential") is not None:

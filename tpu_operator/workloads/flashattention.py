@@ -40,28 +40,38 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _masked_scores(q, k, qi, kj, block_q, block_k, causal):
+def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0):
     """scale·QKᵀ with the causal mask applied — shared by fwd and bwd
-    (the backward recomputes scores instead of saving O(S²) tiles)."""
+    (the backward recomputes scores instead of saving O(S²) tiles).
+    ``q_start``/``k_start`` are GLOBAL sequence offsets (ring attention
+    passes the circulating block's origin so causality holds across
+    chips; 0 for plain within-array attention)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = (
         lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         * scale
     )  # (BQ, BK)
     if causal:
-        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        q_pos = q_start + qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_start + kj * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
     return s, scale
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    q_start_ref, k_start_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
     *, block_q: int, block_k: int, causal: bool,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
+    q_start = q_start_ref[0]
+    k_start = k_start_ref[0]
 
     @pl.when(kj == 0)
     def _init():
@@ -69,15 +79,22 @@ def _flash_fwd_kernel(
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: blocks strictly above the diagonal contribute nothing
-    relevant = True if not causal else kj * block_k < (qi + 1) * block_q
+    # causal: blocks whose every key is in this q block's future
+    # contribute nothing (offsets make this global-position aware)
+    relevant = (
+        True
+        if not causal
+        else k_start + kj * block_k < q_start + (qi + 1) * block_q
+    )
 
     @pl.when(relevant)
     def _attend():
         q = q_ref[0]  # (BQ, D)
         k = k_ref[0]  # (BK, D)
         v = v_ref[0]
-        s, _ = _masked_scores(q, k, qi, kj, block_q, block_k, causal)
+        s, _ = _masked_scores(
+            q, k, qi, kj, block_q, block_k, causal, q_start, k_start
+        )
         m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
         l = l_ref[:, :1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
@@ -208,22 +225,24 @@ def _pallas_kwargs(interpret: bool, semantics) -> dict:
     return {"compiler_params": pltpu.CompilerParams(dimension_semantics=semantics)}
 
 
-def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int):
+def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
+                   q_start=0, k_start=0):
     bh_count, s, d = qb.shape
+    sk = kb.shape[1]  # ring passes same-sized shards; unequal also works
     interpret = jax.devices()[0].platform != "tpu"
-    grid = (bh_count, s // block_q, s // block_k)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
+    grid = (bh_count, s // block_q, sk // block_k)
+    # index maps receive the scalar-prefetch refs appended to the grid
+    # indices — hence *_
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj, *_: (i, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj, *_: (i, kj, 0))
     # each qi program owns its own (1, BQ, 1) slice of the stat array —
     # rank-3 with a trailing singleton because the TPU lowering wants the
     # block's last two dims (8, 128)-divisible or equal to the array's
-    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj: (i, j, 0))
-    return pl.pallas_call(
-        partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal),
-        out_shape=(
-            jax.ShapeDtypeStruct(qb.shape, qb.dtype),
-            jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
-        ),
+    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj, *_: (i, j, 0))
+    # global sequence offsets ride scalar prefetch (SMEM) so the ring can
+    # pass traced per-step origins; zeros for plain within-array attention
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=grid,
         in_specs=[q_spec, k_spec, k_spec],
         out_specs=(q_spec, lse_spec),
@@ -232,8 +251,22 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int):
             pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0)
             pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0)
         ],
+    )
+    return pl.pallas_call(
+        partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+            jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
+        ),
+        grid_spec=grid_spec,
         **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
-    )(qb, kb, vb)
+    )(
+        jnp.reshape(jnp.asarray(q_start, jnp.int32), (1,)),
+        jnp.reshape(jnp.asarray(k_start, jnp.int32), (1,)),
+        qb,
+        kb,
+        vb,
+    )
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -314,6 +347,46 @@ def flash_attention(
 
     out = _flash_core(bh(q), bh(k), bh(v), causal, block_q, block_k)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    q_start=0,
+    k_start=0,
+):
+    """Forward-only variant returning ``(out, lse)`` with GLOBAL sequence
+    offsets for the causal mask: the building block ring attention uses —
+    each ring step attends the local q block (origin ``q_start``) against
+    the circulating K/V block (origin ``k_start``) and merges per-step
+    results with a logsumexp combine. q may be shorter than k/v (the ring
+    holds one local q block while K/V rotate). Not differentiable; the
+    custom-VJP path is ``flash_attention``."""
+    if pltpu is None:  # pragma: no cover — jax build without pallas TPU
+        raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lens ({sq}, {sk}) must divide by blocks ({block_q}, {block_k})"
+        )
+
+    def bh(x):
+        s = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out, lse = _flash_forward(
+        bh(q), bh(k), bh(v), causal, block_q, block_k, q_start, k_start
+    )
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)  # (B, S, H)
+    return out, lse
 
 
 def run_flash_attention_check(

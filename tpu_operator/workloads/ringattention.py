@@ -90,15 +90,71 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True):
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, causal: bool,
+                                block_q: int = 256, block_k: int = 256):
+    """Per-device ring body with the pallas flash kernel as the local
+    attention: each ring step runs flash over the local q block against
+    the circulating K/V block (global sequence offsets keep the causal
+    mask correct across chips) and merges the per-step normalized
+    (out, lse) pairs with a logsumexp combine — the two-level long-context
+    composition executed end to end. Forward-only (the validator's
+    exactness payload); training paths use the jnp ring body."""
+    from tpu_operator.workloads.flashattention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    # combined output + its logsumexp, both normalized. (No vma-typing
+    # zero needed: this body only runs under check_vma=False, which the
+    # pallas_call outputs require anyway.)
+    out = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse = jnp.full((b, s_local, h), -jnp.inf, jnp.float32)
+
+    def step(t, carry):
+        k_blk, v_blk, out, lse = carry
+        kv_idx = (my_idx - t) % n
+        o_j, lse_j = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=causal, block_q=block_q, block_k=block_k,
+            q_start=my_idx * s_local, k_start=kv_idx * s_local,
+        )
+        # merge two normalized partial softmax results:
+        #   o = (o_a·e^(lse_a−m) + o_b·e^(lse_b−m)) / (e^(lse_a−m)+e^(lse_b−m))
+        m = jnp.maximum(lse, lse_j)
+        safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+        w_old = jnp.exp(jnp.where(jnp.isneginf(lse), -jnp.inf, lse - safe_m))
+        w_new = jnp.exp(jnp.where(jnp.isneginf(lse_j), -jnp.inf, lse_j - safe_m))
+        denom = w_old + w_new
+        safe_denom = jnp.where(denom == 0.0, 1.0, denom)
+        out = (
+            out * w_old[..., None] + o_j.astype(jnp.float32) * w_new[..., None]
+        ) / safe_denom[..., None]
+        lse = jnp.where(denom > 0.0, safe_m + jnp.log(safe_denom), -jnp.inf)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, out, lse
+
+    _, _, out, _ = lax.fori_loop(0, n, step, (k, v, out, lse))
+    return out.astype(q.dtype)
+
+
+_LOCAL_IMPLS = {"dense": _ring_attention_local, "flash": _ring_attention_local_flash}
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True,
+                   local_impl: str = "dense"):
     """Sequence-parallel attention. Inputs (B, S, H, D) with S sharded over
-    ``axis_name``; output same sharding."""
+    ``axis_name``; output same sharding. ``local_impl="flash"`` runs the
+    pallas flash kernel for each local block (forward-only)."""
     spec = P(None, axis_name, None, None)
     fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        partial(_LOCAL_IMPLS[local_impl], axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # only the flash body needs the vma check off (pallas outputs
+        # carry no vma); keep the dense path fully type-checked
+        check_vma=(local_impl == "dense"),
     )
     return jax.jit(fn)(q, k, v)
 
@@ -115,7 +171,8 @@ def dense_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _check_local(key, *, axis_name, causal, s_local, batch, heads, head_dim):
+def _check_local(key, *, axis_name, causal, s_local, batch, heads, head_dim,
+                 local_impl="dense"):
     """Per-device check body: generate this device's Q/K/V blocks from the
     (replicated) key + axis index, run the ring, compare against a dense
     reference computed from an all-gathered K/V, and pmax the error. The
@@ -126,7 +183,7 @@ def _check_local(key, *, axis_name, causal, s_local, batch, heads, head_dim):
     q = jax.random.normal(jax.random.fold_in(key, 3 * idx), shape, dtype=jnp.float32)
     k = jax.random.normal(jax.random.fold_in(key, 3 * idx + 1), shape, dtype=jnp.float32)
     v = jax.random.normal(jax.random.fold_in(key, 3 * idx + 2), shape, dtype=jnp.float32)
-    ring = _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal)
+    ring = _LOCAL_IMPLS[local_impl](q, k, v, axis_name=axis_name, causal=causal)
     # dense reference: local q against the full gathered sequence
     kg = lax.all_gather(k, axis_name, axis=1, tiled=True)  # (B, S, H, D)
     vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
@@ -149,6 +206,7 @@ def run_ring_attention_check(
     heads: int = 2,
     head_dim: int = 32,
     causal: bool = True,
+    local_impl: str = "dense",
 ) -> dict:
     """Validator payload: exactness of the ring against dense attention.
     Everything — data generation, both attention computations, and the
@@ -172,6 +230,7 @@ def run_ring_attention_check(
             batch=batch,
             heads=heads,
             head_dim=head_dim,
+            local_impl=local_impl,
         ),
         mesh=mesh,
         in_specs=P(),
