@@ -383,6 +383,49 @@ class TestFlashAttention:
             err = float(jnp.max(jnp.abs(got - want)))
             assert err < 1e-4, f"d{name} diverges: {err}"
 
+    def test_grouped_query_attention(self):
+        """GQA: 4 query heads sharing 2 KV heads must match dense over
+        repeated KV, forward and gradients (the dK/dV kernel accumulates
+        over every (group member, q block) pair)."""
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+        from tpu_operator.workloads.ringattention import dense_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(7), 4)
+        b, s, h, hkv, d = 1, 256, 4, 2, 64
+        q = jax.random.normal(keys[0], (b, s, h, d), dtype=jnp.float32)
+        k = jax.random.normal(keys[1], (b, s, hkv, d), dtype=jnp.float32)
+        v = jax.random.normal(keys[2], (b, s, hkv, d), dtype=jnp.float32)
+        w = jax.random.normal(keys[3], (b, s, h, d), dtype=jnp.float32)
+
+        def rep(x):
+            return jnp.repeat(x, h // hkv, axis=2)
+
+        got = flash_attention(q, k, v, block_q=64, block_k=64)
+        want = dense_attention(q, rep(k), rep(v), causal=True)
+        assert float(jnp.max(jnp.abs(got - want))) < 1e-4
+
+        flash_grads = jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(q, k, v, block_q=64, block_k=64) * w),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        dense_grads = jax.grad(
+            lambda q, k, v: jnp.sum(dense_attention(q, rep(k), rep(v), causal=True) * w),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", flash_grads, dense_grads):
+            assert a.shape == b_.shape
+            assert float(jnp.max(jnp.abs(a - b_))) < 1e-4, f"d{name} diverges"
+
+        # 3 kv heads do not divide 4 q heads
+        k3 = jax.random.normal(keys[1], (b, s, 3, d), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            flash_attention(q, k3, k3, block_q=64, block_k=64)
+        # a v whose heads differ from k's would silently read wrong rows
+        with pytest.raises(ValueError, match="must match"):
+            flash_attention(q, k, rep(v), block_q=64, block_k=64)
+
     def test_uneven_blocks(self):
         """block_q > block_k puts fully-masked rows on diagonal blocks —
         the -inf guards must keep them finite."""

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -176,13 +177,16 @@ def _flash_dq_kernel(
 
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-    *, block_q: int, block_k: int, causal: bool,
+    *, block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
 ):
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)
+    n_seq = pl.num_programs(2)
+    # GQA: the sequential axis enumerates (group member, q block); the q
+    # block index (which sets sequence positions) is t % q_blocks
+    qi = t if q_blocks is None else t % q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -211,7 +215,7 @@ def _flash_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == n_seq - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -225,16 +229,48 @@ def _pallas_kwargs(interpret: bool, semantics) -> dict:
     return {"compiler_params": pltpu.CompilerParams(dimension_semantics=semantics)}
 
 
+def _collapse_heads(q, k, v):
+    """Validate the GQA head layout and collapse (B, S, H, D) arrays to
+    (B·H, S, D) rows; returns (qb, kb, vb, h, h_kv). Shared by both entry
+    points so the checks cannot drift."""
+    b, _, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads ({h_kv})")
+    if v.shape != k.shape:
+        # the kernel's index maps are built from k's head count alone; a
+        # mismatched v would silently read the wrong rows
+        raise ValueError(f"k and v shapes must match: {k.shape} vs {v.shape}")
+
+    def bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * x.shape[2], x.shape[1], d)
+
+    return bh(q), bh(k), bh(v), h, h_kv
+
+
+def _kv_row(i, heads: int, kv_heads: int):
+    """Collapsed-row mapping for grouped-query attention: q row i (of
+    B·heads) reads kv row (of B·kv_heads) — query heads share KV heads in
+    groups of heads//kv_heads. Identity when heads == kv_heads."""
+    group = heads // kv_heads
+    return (i // heads) * kv_heads + (i % heads) // group
+
+
 def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
-                   q_start=0, k_start=0):
+                   q_start=0, k_start=0, heads: Optional[int] = None,
+                   kv_heads: Optional[int] = None):
     bh_count, s, d = qb.shape
     sk = kb.shape[1]  # ring passes same-sized shards; unequal also works
+    heads = heads or 1
+    kv_heads = kv_heads or heads
     interpret = jax.devices()[0].platform != "tpu"
     grid = (bh_count, s // block_q, sk // block_k)
     # index maps receive the scalar-prefetch refs appended to the grid
     # indices — hence *_
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj, *_: (i, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj, *_: (i, kj, 0))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d), lambda i, j, kj, *_: (_kv_row(i, heads, kv_heads), kj, 0)
+    )
     # each qi program owns its own (1, BQ, 1) slice of the stat array —
     # rank-3 with a trailing singleton because the TPU lowering wants the
     # block's last two dims (8, 128)-divisible or equal to the array's
@@ -269,25 +305,33 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     )
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(qb, kb, vb, causal: bool, block_q: int, block_k: int):
-    out, _ = _flash_forward(qb, kb, vb, causal, block_q, block_k)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(qb, kb, vb, causal: bool, block_q: int, block_k: int,
+                heads: int, kv_heads: int):
+    out, _ = _flash_forward(
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads
+    )
     return out
 
 
-def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k):
-    out, lse = _flash_forward(qb, kb, vb, causal, block_q, block_k)
+def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k, heads, kv_heads):
+    out, lse = _flash_forward(
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads
+    )
     return out, (qb, kb, vb, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, residuals, g):
+def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, residuals, g):
     qb, kb, vb, out, lse = residuals
     bh_count, s, d = qb.shape
+    group = heads // kv_heads
     interpret = jax.devices()[0].platform != "tpu"
     # D_i = rowsum(dO ∘ O): cheap elementwise, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda i, j, kj: (i, kj, 0))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d), lambda i, j, kj: (_kv_row(i, heads, kv_heads), kj, 0)
+    )
     row_spec = pl.BlockSpec((1, s, 1), lambda i, j, kj: (i, 0, 0))
     dq = pl.pallas_call(
         partial(_flash_dq_kernel, block_q=block_q, block_k=block_k, causal=causal),
@@ -298,17 +342,30 @@ def _flash_core_bwd(causal, block_q, block_k, residuals, g):
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
     )(qb, kb, vb, g, lse, delta)
-    # dK/dV: k blocks own the grid, q is the sequential axis
-    kq_q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, j: (i, j, 0))
-    kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, j: (i, kj, 0))
-    kq_row_spec = pl.BlockSpec((1, s, 1), lambda i, kj, j: (i, 0, 0))
+    # dK/dV: kv rows own the grid; the sequential axis enumerates every
+    # (group member, q block) pair that attends this KV head
+    nq = s // block_q
+    kvbh = kb.shape[0]
+
+    def q_row(i, t):
+        return (i // kv_heads) * heads + (i % kv_heads) * group + t // nq
+
+    kq_q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, t: (q_row(i, t), t % nq, 0))
+    kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, t: (i, kj, 0))
+    kq_row_spec = pl.BlockSpec((1, s, 1), lambda i, kj, t: (q_row(i, t), 0, 0))
     dk, dv = pl.pallas_call(
-        partial(_flash_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        partial(
+            _flash_dkv_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            causal=causal,
+            q_blocks=nq,
+        ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, kb.dtype),
             jax.ShapeDtypeStruct(vb.shape, vb.dtype),
         ),
-        grid=(bh_count, s // block_k, s // block_q),
+        grid=(kvbh, s // block_k, nq * group),
         in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec],
         out_specs=(kq_k_spec, kq_k_spec),
         scratch_shapes=[
@@ -331,9 +388,13 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 1024,
 ) -> jax.Array:
-    """q/k/v: (B, S, H, D) — the burn-in/ring layout. VMEM holds one
+    """q: (B, S, H, D); k/v: (B, S, H_kv, D) with H_kv dividing H — the
+    burn-in/ring layout, grouped-query attention when H_kv < H (query
+    heads share KV heads in groups, the modern LLM shape). VMEM holds one
     q/k/v/out block plus the (block_q, D) accumulator, independent of S.
-    Differentiable (custom VJP, FlashAttention-2 backward)."""
+    Differentiable (custom VJP, FlashAttention-2 backward; for GQA the
+    dK/dV kernel's sequential axis enumerates every (group member,
+    q block) pair attending the KV head)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
@@ -341,11 +402,8 @@ def flash_attention(
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"seq_len {s} must divide by blocks ({block_q}, {block_k})")
-
-    def bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
-    out = _flash_core(bh(q), bh(k), bh(v), causal, block_q, block_k)
+    qb, kb, vb, h, h_kv = _collapse_heads(q, k, v)
+    out = _flash_core(qb, kb, vb, causal, block_q, block_k, h, h_kv)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -376,13 +434,10 @@ def flash_attention_with_lse(
         raise ValueError(
             f"seq lens ({sq}, {sk}) must divide by blocks ({block_q}, {block_k})"
         )
-
-    def bh(x):
-        s = x.shape[1]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-
+    qb, kb, vb, h, h_kv = _collapse_heads(q, k, v)
     out, lse = _flash_forward(
-        bh(q), bh(k), bh(v), causal, block_q, block_k, q_start, k_start
+        qb, kb, vb, causal, block_q, block_k, q_start, k_start,
+        heads=h, kv_heads=h_kv,
     )
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     lse = lse.reshape(b, h, sq).transpose(0, 2, 1)  # (B, S, H)
