@@ -345,6 +345,25 @@ class TestSequenceParallelBurnin:
             build_train_step(make_mesh(), BurninConfig(sequence_parallel=True))
 
 
+def _dense_window_reference(q, k, v, window):
+    """Banded causal softmax over repeated-KV — the reference for the
+    sliding-window (and windowed-GQA) tests."""
+    import jax.numpy as jnp
+
+    s, d = q.shape[1], q.shape[-1]
+    if k.shape[2] != q.shape[2]:
+        reps = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    q_pos = jnp.arange(s)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    keep = (q_pos >= k_pos) & (q_pos - k_pos < window)
+    probs = jax.nn.softmax(jnp.where(keep, scores, -jnp.inf), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 class TestFlashAttention:
     def test_matches_dense_causal_and_full(self):
         from tpu_operator.workloads.flashattention import run_flash_attention_check
@@ -382,6 +401,103 @@ class TestFlashAttention:
         for name, got, want in zip("qkv", flash_grads, dense_grads):
             err = float(jnp.max(jnp.abs(got - want)))
             assert err < 1e-4, f"d{name} diverges: {err}"
+
+    def test_sliding_window(self):
+        """window=W must match dense attention with a banded causal mask
+        (0 <= q-k < W), forward and gradients, and reject non-causal use."""
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(5), 4)
+        b, s, h, d, W = 1, 256, 2, 64, 96
+        q, k, v = (jax.random.normal(kk, (b, s, h, d), dtype=jnp.float32) for kk in keys[:3])
+        w = jax.random.normal(keys[3], (b, s, h, d), dtype=jnp.float32)
+
+        def dense_window(q, k, v):
+            return _dense_window_reference(q, k, v, W)
+
+        got = flash_attention(q, k, v, block_q=64, block_k=64, window=W)
+        want = dense_window(q, k, v)
+        assert float(jnp.max(jnp.abs(got - want))) < 1e-4
+
+        flash_grads = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, block_q=64, block_k=64, window=W) * w
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        dense_grads = jax.grad(
+            lambda q, k, v: jnp.sum(dense_window(q, k, v) * w), argnums=(0, 1, 2)
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", flash_grads, dense_grads):
+            assert float(jnp.max(jnp.abs(a - b_))) < 1e-4, f"d{name} diverges"
+
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=W, block_q=64, block_k=64)
+
+    def test_sliding_window_edges(self):
+        """A window >= seq_len equals plain causal attention; a
+        block-aligned window is exact too (band-grid edge cases: negative
+        band starts, clamped loads, top-of-range skips)."""
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+        from tpu_operator.workloads.ringattention import dense_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(6), 3)
+        s = 256
+        q, k, v = (
+            jax.random.normal(kk, (1, s, 2, 64), dtype=jnp.float32) for kk in keys
+        )
+        full = dense_attention(q, k, v, causal=True)
+        for W in (1000, 256, 64):
+            got = flash_attention(q, k, v, block_q=64, block_k=64, window=W)
+            want = _dense_window_reference(q, k, v, W)
+            assert float(jnp.max(jnp.abs(got - want))) < 1e-4, W
+            if W >= s:  # window covering the sequence equals plain causal
+                assert float(jnp.max(jnp.abs(got - full))) < 1e-4, W
+
+    def test_window_with_gqa(self):
+        """Window and GQA interact through the banded k_spec index map and
+        the dK/dV (group, q block) decomposition — exactness of the
+        combined path, forward and gradients."""
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(8), 4)
+        b, s, h, hkv, d, W = 1, 256, 4, 2, 64, 96
+        q = jax.random.normal(keys[0], (b, s, h, d), dtype=jnp.float32)
+        k = jax.random.normal(keys[1], (b, s, hkv, d), dtype=jnp.float32)
+        v = jax.random.normal(keys[2], (b, s, hkv, d), dtype=jnp.float32)
+        w = jax.random.normal(keys[3], (b, s, h, d), dtype=jnp.float32)
+        got = flash_attention(q, k, v, block_q=64, block_k=64, window=W)
+        want = _dense_window_reference(q, k, v, W)
+        assert float(jnp.max(jnp.abs(got - want))) < 1e-4
+
+        flash_grads = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, block_q=64, block_k=64, window=W) * w
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        dense_grads = jax.grad(
+            lambda q, k, v: jnp.sum(_dense_window_reference(q, k, v, W) * w),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", flash_grads, dense_grads):
+            assert float(jnp.max(jnp.abs(a - b_))) < 1e-4, f"d{name} diverges"
+
+    def test_rejects_mismatched_kv_seq(self):
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+
+        q = jnp.zeros((1, 512, 2, 64), dtype=jnp.float32)
+        kv = jnp.zeros((1, 256, 2, 64), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="must equal q's"):
+            flash_attention(q, kv, kv, block_q=64, block_k=64)
 
     def test_grouped_query_attention(self):
         """GQA: 4 query heads sharing 2 KV heads must match dense over

@@ -41,12 +41,15 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0):
-    """scale·QKᵀ with the causal mask applied — shared by fwd and bwd
-    (the backward recomputes scores instead of saving O(S²) tiles).
-    ``q_start``/``k_start`` are GLOBAL sequence offsets (ring attention
-    passes the circulating block's origin so causality holds across
-    chips; 0 for plain within-array attention)."""
+def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
+                   window: Optional[int] = None):
+    """scale·QKᵀ with the causal (and optional sliding-window) mask —
+    shared by fwd and bwd (the backward recomputes scores instead of
+    saving O(S²) tiles). ``q_start``/``k_start`` are GLOBAL sequence
+    offsets (ring attention passes the circulating block's origin so
+    causality holds across chips; 0 for plain within-array attention);
+    ``window`` keeps only the last ``window`` positions (0 ≤ q−k <
+    window)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = (
         lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -59,33 +62,71 @@ def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0)
         k_pos = k_start + kj * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        keep = q_pos >= k_pos
+        if window is not None:
+            keep &= q_pos - k_pos < window
+        s = jnp.where(keep, s, -jnp.inf)
     return s, scale
+
+
+def _block_relevant(qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
+                    window: Optional[int] = None):
+    """Whether any (q, k) pair in this block pair survives the mask —
+    blocks strictly above the diagonal (causal) or entirely older than
+    the window are skipped without touching the MXU."""
+    if not causal:
+        return True
+    relevant = k_start + kj * block_k < q_start + (qi + 1) * block_q
+    if window is not None:
+        # the newest key in the block must still be inside some q row's
+        # window: k_max >= q_min - window + 1
+        relevant &= (
+            k_start + (kj + 1) * block_k - 1
+            >= q_start + qi * block_q - window + 1
+        )
+    return relevant
+
+
+def _window_base(qi, block_q: int, block_k: int, window: int):
+    """First k block of q block ``qi``'s window band (may be negative —
+    callers clamp for loads and skip the out-of-range steps)."""
+    return (qi * block_q - window + 1) // block_k
 
 
 def _flash_fwd_kernel(
     q_start_ref, k_start_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc_ref, m_ref, l_ref,
-    *, block_q: int, block_k: int, causal: bool,
+    *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
+    nk_total: Optional[int] = None,
 ):
     qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    t = pl.program_id(2)
     nk = pl.num_programs(2)
     q_start = q_start_ref[0]
     k_start = k_start_ref[0]
+    if window is None:
+        kj = t
+    else:
+        # banded grid: the sequential axis walks only the window band, so
+        # only its blocks are ever LOADED. The base clamps into
+        # [0, nk_total - nk] so the walked range always lies in the valid
+        # block range (W >= S degenerates to the full causal scan);
+        # _block_relevant still masks out-of-band steps.
+        base = jnp.clip(
+            _window_base(qi, block_q, block_k, window), 0, nk_total - nk
+        )
+        kj = base + t
 
-    @pl.when(kj == 0)
+    @pl.when(t == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: blocks whose every key is in this q block's future
-    # contribute nothing (offsets make this global-position aware)
-    relevant = (
-        True
-        if not causal
-        else k_start + kj * block_k < q_start + (qi + 1) * block_q
+    # blocks fully outside the causal/window band contribute nothing
+    # (offsets make this global-position aware)
+    relevant = _block_relevant(
+        qi, kj, block_q, block_k, causal, q_start, k_start, window
     )
 
     @pl.when(relevant)
@@ -94,7 +135,7 @@ def _flash_fwd_kernel(
         k = k_ref[0]  # (BK, D)
         v = v_ref[0]
         s, _ = _masked_scores(
-            q, k, qi, kj, block_q, block_k, causal, q_start, k_start
+            q, k, qi, kj, block_q, block_k, causal, q_start, k_start, window
         )
         m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
         l = l_ref[:, :1]
@@ -116,7 +157,7 @@ def _flash_fwd_kernel(
             l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
         )
 
-    @pl.when(kj == nk - 1)
+    @pl.when(t == nk - 1)
     def _finalize():
         l = l_ref[:, :1]
         # rows with no valid key (defensive): l == 0 -> emit 0, not inf
@@ -128,13 +169,18 @@ def _flash_fwd_kernel(
         lse_ref[0] = lse  # (BQ, 1) slice of the (BH, S, 1) stat array
 
 
-def _row_stat(ref, qi, block_q):
-    """(BQ, 1) slice of a (1, S, 1) row-stat block (lse / delta)."""
-    return ref[0, pl.ds(qi * block_q, block_q), :]
+def _row_stat(ref):
+    """(BQ, 1) view of a (1, BQ, 1) row-stat block (lse / delta). The
+    stats are BLOCKED per q block: a full (1, S, 1) block would pad its
+    singleton lane to 128 in VMEM — 16 MB per buffer at 32k, busting the
+    scoped-VMEM budget before double buffering."""
+    return ref[0]
 
 
-def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal):
-    s, scale = _masked_scores(q, k, qi, kj, block_q, block_k, causal)
+def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
+                  window: Optional[int] = None):
+    s, scale = _masked_scores(q, k, qi, kj, block_q, block_k, causal,
+                              window=window)
     p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
     # rows with lse=-inf (no valid keys) and masked entries contribute 0
     p = jnp.where(jnp.isneginf(s) | ~jnp.isfinite(lse), 0.0, p)
@@ -143,7 +189,7 @@ def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal):
 
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, block_q: int, block_k: int, causal: bool,
+    *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -153,14 +199,14 @@ def _flash_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    relevant = True if not causal else kj * block_k < (qi + 1) * block_q
+    relevant = _block_relevant(qi, kj, block_q, block_k, causal, window=window)
 
     @pl.when(relevant)
     def _accumulate():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = _row_stat(lse_ref, qi, block_q)
-        delta = _row_stat(delta_ref, qi, block_q)
-        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal)
+        lse = _row_stat(lse_ref)
+        delta = _row_stat(delta_ref)
+        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal, window)
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
@@ -178,6 +224,7 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
+    window: Optional[int] = None,
 ):
     kj = pl.program_id(1)
     t = pl.program_id(2)
@@ -191,15 +238,16 @@ def _flash_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # causal: q blocks entirely above this k block see none of it
-    relevant = True if not causal else (qi + 1) * block_q > kj * block_k
+    # q blocks fully outside the causal/window band see none of this
+    # k block
+    relevant = _block_relevant(qi, kj, block_q, block_k, causal, window=window)
 
     @pl.when(relevant)
     def _accumulate():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse = _row_stat(lse_ref, qi, block_q)
-        delta = _row_stat(delta_ref, qi, block_q)
-        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal)
+        lse = _row_stat(lse_ref)
+        delta = _row_stat(delta_ref)
+        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal, window)
         # dV += Pᵀ dO
         dv_acc[:] = dv_acc[:] + lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -258,18 +306,48 @@ def _kv_row(i, heads: int, kv_heads: int):
 
 def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
                    q_start=0, k_start=0, heads: Optional[int] = None,
-                   kv_heads: Optional[int] = None):
+                   kv_heads: Optional[int] = None,
+                   window: Optional[int] = None):
     bh_count, s, d = qb.shape
     sk = kb.shape[1]  # ring passes same-sized shards; unequal also works
+    if window is not None and not (
+        isinstance(q_start, int) and q_start == 0
+        and isinstance(k_start, int) and k_start == 0
+    ):
+        # the band walk uses LOCAL block indices; global offsets would
+        # silently drop in-window keys outside the walked band
+        raise ValueError("window does not compose with q_start/k_start offsets")
     heads = heads or 1
     kv_heads = kv_heads or heads
     interpret = jax.devices()[0].platform != "tpu"
-    grid = (bh_count, s // block_q, sk // block_k)
+    nk_total = sk // block_k
+    if window is None:
+        nk_grid = nk_total
+
+        def k_block(j, t):
+            return t
+    else:
+        # banded grid: q block j needs keys in [j·BQ−W+1, (j+1)·BQ−1] —
+        # a fixed number of k blocks regardless of S, so a 32k sequence
+        # with a 4k window LOADS O(W) keys per q block, not O(S)
+        nk_grid = min(nk_total, (window + block_q - 2) // block_k + 2)
+
+        def k_block(j, t):
+            # base clamped into [0, nk_total - nk_grid]: the walked range
+            # stays valid even when the band pokes past either end (the
+            # kernel mirrors this arithmetic and masks out-of-band steps)
+            base = jnp.clip(
+                _window_base(j, block_q, block_k, window), 0, nk_total - nk_grid
+            )
+            return base + t
+
+    grid = (bh_count, s // block_q, nk_grid)
     # index maps receive the scalar-prefetch refs appended to the grid
     # indices — hence *_
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj, *_: (i, j, 0))
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t, *_: (i, j, 0))
     k_spec = pl.BlockSpec(
-        (1, block_k, d), lambda i, j, kj, *_: (_kv_row(i, heads, kv_heads), kj, 0)
+        (1, block_k, d),
+        lambda i, j, t, *_: (_kv_row(i, heads, kv_heads), k_block(j, t), 0),
     )
     # each qi program owns its own (1, BQ, 1) slice of the stat array —
     # rank-3 with a trailing singleton because the TPU lowering wants the
@@ -289,7 +367,8 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
         ],
     )
     return pl.pallas_call(
-        partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k,
+                causal=causal, window=window, nk_total=nk_total),
         out_shape=(
             jax.ShapeDtypeStruct(qb.shape, qb.dtype),
             jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
@@ -305,23 +384,25 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     )
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_core(qb, kb, vb, causal: bool, block_q: int, block_k: int,
-                heads: int, kv_heads: int):
+                heads: int, kv_heads: int, window: Optional[int] = None):
     out, _ = _flash_forward(
-        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads,
+        window=window,
     )
     return out
 
 
-def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k, heads, kv_heads):
+def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k, heads, kv_heads, window):
     out, lse = _flash_forward(
-        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads,
+        window=window,
     )
     return out, (qb, kb, vb, out, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, residuals, g):
+def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals, g):
     qb, kb, vb, out, lse = residuals
     bh_count, s, d = qb.shape
     group = heads // kv_heads
@@ -332,9 +413,10 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, residuals, g):
     k_spec = pl.BlockSpec(
         (1, block_k, d), lambda i, j, kj: (_kv_row(i, heads, kv_heads), kj, 0)
     )
-    row_spec = pl.BlockSpec((1, s, 1), lambda i, j, kj: (i, 0, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj: (i, j, 0))
     dq = pl.pallas_call(
-        partial(_flash_dq_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
+                causal=causal, window=window),
         out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
         grid=(bh_count, s // block_q, s // block_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -352,7 +434,7 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, residuals, g):
 
     kq_q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, t: (q_row(i, t), t % nq, 0))
     kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, t: (i, kj, 0))
-    kq_row_spec = pl.BlockSpec((1, s, 1), lambda i, kj, t: (q_row(i, t), 0, 0))
+    kq_row_spec = pl.BlockSpec((1, block_q, 1), lambda i, kj, t: (q_row(i, t), t % nq, 0))
     dk, dv = pl.pallas_call(
         partial(
             _flash_dkv_kernel,
@@ -360,6 +442,7 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, residuals, g):
             block_k=block_k,
             causal=causal,
             q_blocks=nq,
+            window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, kb.dtype),
@@ -387,6 +470,7 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 256,
     block_k: int = 1024,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """q: (B, S, H, D); k/v: (B, S, H_kv, D) with H_kv dividing H — the
     burn-in/ring layout, grouped-query attention when H_kv < H (query
@@ -394,7 +478,12 @@ def flash_attention(
     q/k/v/out block plus the (block_q, D) accumulator, independent of S.
     Differentiable (custom VJP, FlashAttention-2 backward; for GQA the
     dK/dV kernel's sequential axis enumerates every (group member,
-    q block) pair attending the KV head)."""
+    q block) pair attending the KV head). ``window`` keeps only the last
+    ``window`` positions (sliding-window/local attention, causal only).
+    The FORWARD walks a banded k grid — only the window's blocks are
+    ever loaded, O(S·window) — while the backward keeps full grids and
+    skips only the out-of-band compute (tiles still stream; band the
+    backward grids before relying on O(S·window) training steps)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
@@ -402,8 +491,15 @@ def flash_attention(
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"seq_len {s} must divide by blocks ({block_q}, {block_k})")
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal attention and window >= 1")
+    if k.shape[1] != s:
+        # only the forward-only ring entry point supports unequal seq
+        # lens; here the backward grids are sized from q's length, so a
+        # shorter k/v would silently read clamped (wrong) tiles
+        raise ValueError(f"k/v seq_len {k.shape[1]} must equal q's ({s})")
     qb, kb, vb, h, h_kv = _collapse_heads(q, k, v)
-    out = _flash_core(qb, kb, vb, causal, block_q, block_k, h, h_kv)
+    out = _flash_core(qb, kb, vb, causal, block_q, block_k, h, h_kv, window)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -483,10 +579,13 @@ def flash_attention_bench(
     head_dim: int = 128,
     iters: int = 8,
     reps: int = 4,
+    window: Optional[int] = None,
 ) -> dict:
     """Flash kernel vs XLA dense attention at long context: per-call time
     for each (two-point relay-safe timing) and achieved attention
-    FLOP/s. Dense is skipped above 8k — its O(S²) scores stop fitting."""
+    FLOP/s. Dense is skipped above 8k — its O(S²) scores stop fitting.
+    ``window`` additionally times the banded sliding-window forward
+    (reproduces the numbers cited in docs/design.md)."""
     from tpu_operator.workloads.ringattention import dense_attention
     from tpu_operator.workloads.timing import two_point_min_timing
 
@@ -538,6 +637,12 @@ def flash_attention_bench(
         "flash_tflops": 2 * 2 * heads * seq_len**2 * head_dim / 2 / flash_s / 1e12,
         "flash_fwd_bwd_ms": flash_train_s * 1e3,
     }
+    if window is not None:
+        window_s = timed(
+            lambda a, kk, vv: flash_attention(a, kk, vv, causal=True, window=window)
+        )
+        report["window"] = window
+        report["flash_window_time_ms"] = window_s * 1e3
     if seq_len <= 8192:
         dense_s = timed(lambda a, kk, vv: dense_attention(a, kk, vv, causal=True))
         report["dense_time_ms"] = dense_s * 1e3
