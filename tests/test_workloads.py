@@ -458,6 +458,36 @@ class TestFlashAttention:
             if W >= s:  # window covering the sequence equals plain causal
                 assert float(jnp.max(jnp.abs(got - full))) < 1e-4, W
 
+    def test_sliding_window_mixed_block_sizes(self):
+        """The production default uses block_q != block_k; the band-width
+        formulas and the dkv base phase ((kj·BK) % BQ != 0) are
+        asymmetric, so both orientations must be exact — forward and
+        gradients."""
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention
+
+        keys = jax.random.split(jax.random.PRNGKey(9), 4)
+        b, s, h, d, W = 1, 512, 2, 64, 160
+        q, k, v = (jax.random.normal(kk, (b, s, h, d), dtype=jnp.float32) for kk in keys[:3])
+        w = jax.random.normal(keys[3], (b, s, h, d), dtype=jnp.float32)
+        want = _dense_window_reference(q, k, v, W)
+        want_grads = jax.grad(
+            lambda q, k, v: jnp.sum(_dense_window_reference(q, k, v, W) * w),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for bq, bk in ((64, 128), (128, 64)):
+            got = flash_attention(q, k, v, block_q=bq, block_k=bk, window=W)
+            assert float(jnp.max(jnp.abs(got - want))) < 1e-4, (bq, bk)
+            got_grads = jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                    flash_attention(q, k, v, block_q=bq, block_k=bk, window=W) * w
+                ),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for name, a, b_ in zip("qkv", got_grads, want_grads):
+                assert float(jnp.max(jnp.abs(a - b_))) < 1e-4, (bq, bk, name)
+
     def test_window_with_gqa(self):
         """Window and GQA interact through the banded k_spec index map and
         the dK/dV (group, q block) decomposition — exactness of the

@@ -93,6 +93,24 @@ def _window_base(qi, block_q: int, block_k: int, window: int):
     return (qi * block_q - window + 1) // block_k
 
 
+def _k_band(nk_total: int, block_q: int, block_k: int, window: Optional[int]):
+    """(band width, walked-block fn) for the banded k walk over q block
+    ``j`` — shared by the forward and dQ passes so the two can't drift.
+    Without a window the walk is the full k range."""
+    if window is None:
+        return nk_total, lambda j, t: t
+    n_band = min(nk_total, (window + block_q - 2) // block_k + 2)
+
+    def k_block(j, t):
+        # base clamped into [0, nk_total - n_band]: the walked range stays
+        # valid even when the band pokes past either end (the kernels
+        # mirror this arithmetic and mask out-of-band steps)
+        base = jnp.clip(_window_base(j, block_q, block_k, window), 0, nk_total - n_band)
+        return base + t
+
+    return n_band, k_block
+
+
 def _flash_fwd_kernel(
     q_start_ref, k_start_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc_ref, m_ref, l_ref,
@@ -190,12 +208,21 @@ def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
+    nk_total: Optional[int] = None,
 ):
     qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    t = pl.program_id(2)
     nk = pl.num_programs(2)
+    if window is None:
+        kj = t
+    else:
+        # banded k walk, mirroring the forward: only window blocks load
+        kj = (
+            jnp.clip(_window_base(qi, block_q, block_k, window), 0, nk_total - nk)
+            + t
+        )
 
-    @pl.when(kj == 0)
+    @pl.when(t == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
@@ -216,7 +243,7 @@ def _flash_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(kj == nk - 1)
+    @pl.when(t == nk - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
@@ -224,14 +251,18 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
-    window: Optional[int] = None,
+    window: Optional[int] = None, nq_total: Optional[int] = None,
 ):
     kj = pl.program_id(1)
     t = pl.program_id(2)
     n_seq = pl.num_programs(2)
     # GQA: the sequential axis enumerates (group member, q block); the q
-    # block index (which sets sequence positions) is t % q_blocks
+    # block index (which sets sequence positions) is t % q_blocks. With a
+    # window, q_blocks is the BAND width and the base is k block kj's
+    # first causally-reachable q block (clamped like the forward's walk).
     qi = t if q_blocks is None else t % q_blocks
+    if window is not None:
+        qi = jnp.clip((kj * block_k) // block_q, 0, nq_total - q_blocks) + qi
 
     @pl.when(t == 0)
     def _init():
@@ -321,26 +352,10 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     kv_heads = kv_heads or heads
     interpret = jax.devices()[0].platform != "tpu"
     nk_total = sk // block_k
-    if window is None:
-        nk_grid = nk_total
-
-        def k_block(j, t):
-            return t
-    else:
-        # banded grid: q block j needs keys in [j·BQ−W+1, (j+1)·BQ−1] —
-        # a fixed number of k blocks regardless of S, so a 32k sequence
-        # with a 4k window LOADS O(W) keys per q block, not O(S)
-        nk_grid = min(nk_total, (window + block_q - 2) // block_k + 2)
-
-        def k_block(j, t):
-            # base clamped into [0, nk_total - nk_grid]: the walked range
-            # stays valid even when the band pokes past either end (the
-            # kernel mirrors this arithmetic and masks out-of-band steps)
-            base = jnp.clip(
-                _window_base(j, block_q, block_k, window), 0, nk_total - nk_grid
-            )
-            return base + t
-
+    # banded grid: q block j needs keys in [j·BQ−W+1, (j+1)·BQ−1] — a
+    # fixed number of k blocks regardless of S, so a 32k sequence with a
+    # 4k window LOADS O(W) keys per q block, not O(S)
+    nk_grid, k_block = _k_band(nk_total, block_q, block_k, window)
     grid = (bh_count, s // block_q, nk_grid)
     # index maps receive the scalar-prefetch refs appended to the grid
     # indices — hence *_
@@ -409,46 +424,66 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
     interpret = jax.devices()[0].platform != "tpu"
     # D_i = rowsum(dO ∘ O): cheap elementwise, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, kj: (i, j, 0))
+    nq = s // block_q
+    nk_total = s // block_k
+    # band the k walk like the forward: only window blocks are loaded
+    nk_band, dq_k_block = _k_band(nk_total, block_q, block_k, window)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))
     k_spec = pl.BlockSpec(
-        (1, block_k, d), lambda i, j, kj: (_kv_row(i, heads, kv_heads), kj, 0)
+        (1, block_k, d),
+        lambda i, j, t: (_kv_row(i, heads, kv_heads), dq_k_block(j, t), 0),
     )
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj: (i, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0))
     dq = pl.pallas_call(
         partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
-                causal=causal, window=window),
+                causal=causal, window=window, nk_total=nk_total),
         out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
-        grid=(bh_count, s // block_q, s // block_k),
+        grid=(bh_count, nq, nk_band),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
     )(qb, kb, vb, g, lse, delta)
     # dK/dV: kv rows own the grid; the sequential axis enumerates every
-    # (group member, q block) pair that attends this KV head
-    nq = s // block_q
+    # (group member, banded q block) pair that attends this KV head
     kvbh = kb.shape[0]
+    if window is None:
+        nq_band = nq
+
+        def dkv_q_block(kj, t):
+            return t % nq
+    else:
+        nq_band = min(nq, (window + block_k - 2) // block_q + 2)
+
+        def dkv_q_block(kj, t):
+            base = jnp.clip((kj * block_k) // block_q, 0, nq - nq_band)
+            return base + t % nq_band
 
     def q_row(i, t):
-        return (i // kv_heads) * heads + (i % kv_heads) * group + t // nq
+        return (i // kv_heads) * heads + (i % kv_heads) * group + t // nq_band
 
-    kq_q_spec = pl.BlockSpec((1, block_q, d), lambda i, kj, t: (q_row(i, t), t % nq, 0))
+    kq_q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda i, kj, t: (q_row(i, t), dkv_q_block(kj, t), 0)
+    )
     kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, t: (i, kj, 0))
-    kq_row_spec = pl.BlockSpec((1, block_q, 1), lambda i, kj, t: (q_row(i, t), t % nq, 0))
+    kq_row_spec = pl.BlockSpec(
+        (1, block_q, 1), lambda i, kj, t: (q_row(i, t), dkv_q_block(kj, t), 0)
+    )
     dk, dv = pl.pallas_call(
         partial(
             _flash_dkv_kernel,
             block_q=block_q,
             block_k=block_k,
             causal=causal,
-            q_blocks=nq,
+            q_blocks=nq_band,
             window=window,
+            nq_total=nq,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, kb.dtype),
             jax.ShapeDtypeStruct(vb.shape, vb.dtype),
         ),
-        grid=(kvbh, s // block_k, nq * group),
+        grid=(kvbh, nk_total, nq_band * group),
         in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec],
         out_specs=(kq_k_spec, kq_k_spec),
         scratch_shapes=[
@@ -479,11 +514,10 @@ def flash_attention(
     Differentiable (custom VJP, FlashAttention-2 backward; for GQA the
     dK/dV kernel's sequential axis enumerates every (group member,
     q block) pair attending the KV head). ``window`` keeps only the last
-    ``window`` positions (sliding-window/local attention, causal only).
-    The FORWARD walks a banded k grid — only the window's blocks are
-    ever loaded, O(S·window) — while the backward keeps full grids and
-    skips only the out-of-band compute (tiles still stream; band the
-    backward grids before relying on O(S·window) training steps)."""
+    ``window`` positions (sliding-window/local attention, causal only):
+    forward and backward all walk banded grids — only the window's
+    blocks are ever loaded, so fwd and fwd+bwd both cost O(S·window),
+    not O(S²)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
