@@ -177,6 +177,26 @@ class TestDistributed:
             )
 
 
+class TestCollectives:
+    def test_all_primitives_exact(self):
+        """psum / all_gather / reduce_scatter / all_to_all / ppermute must
+        each be numerically exact on the mesh."""
+        from tpu_operator.workloads.collectives import run_collectives_check
+
+        report = run_collectives_check()
+        assert report["ok"] and report["devices"] == 8
+        assert set(report["errors"]) == {
+            "psum", "all_gather", "reduce_scatter", "all_to_all", "ppermute",
+        }
+        assert max(report["errors"].values()) < 1e-5
+
+    def test_rejects_indivisible_payload(self):
+        from tpu_operator.workloads.collectives import run_collectives_check
+
+        with pytest.raises(ValueError, match="divide"):
+            run_collectives_check(per_device=2049)
+
+
 class TestRingAttention:
     def test_flash_local_impl_matches_dense(self):
         """The two-level composition: pallas flash as each ring step's

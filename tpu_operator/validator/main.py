@@ -204,6 +204,14 @@ def validate_slice(ctx: Context) -> dict:
     report["ring_flash_attention"] = ringattention.run_ring_attention_check(
         seq_len=max(128, 32 * n), local_impl="flash"
     )
+    # the full collective-primitive set (all-gather / reduce-scatter /
+    # all-to-all / ppermute beside the headline psum)
+    from tpu_operator.workloads import collectives
+
+    # max(n, ...) keeps the payload nonzero on slices wider than 2048
+    report["collectives"] = collectives.run_collectives_check(
+        per_device=max(n, (2048 // n) * n)
+    )
     return report
 
 
