@@ -1,0 +1,106 @@
+"""Sweep flash-attention block sizes on the real chip.
+
+Times the 8k causal forward (and optionally fwd+bwd) for a grid of
+(block_q, block_k) configs using the relay-safe two-point estimator and
+prints one JSON line per config. Run on the axon TPU backend (default
+platform); pass --fwd-bwd to add the training path for each config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_operator.workloads.flashattention import flash_attention
+from tpu_operator.workloads.timing import two_point_min_timing
+
+
+def time_config(seq_len, heads, head_dim, block_q, block_k, iters, reps,
+                fwd_bwd=False):
+    shape = (1, seq_len, heads, head_dim)
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(key, shape, dtype=jnp.bfloat16) for key in keys)
+    fn = lambda a, kk, vv: flash_attention(
+        a, kk, vv, causal=True, block_q=block_q, block_k=block_k
+    )
+
+    @partial(jax.jit, static_argnames="n")
+    def chain(q, k, v, s, n):
+        def step(i, acc):
+            return fn(acc, k, v).astype(q.dtype)
+
+        out = lax.fori_loop(0, n, step, q * s)
+        return jnp.float32(out.sum())
+
+    timing = two_point_min_timing(
+        lambda s, n: float(chain(q, k, v, s, n)), iters, 4 * iters, reps
+    )
+    t = timing.per_iter_s or timing.inclusive_per_iter_s
+    flops = 2 * 2 * heads * seq_len**2 * head_dim / 2
+    out = {
+        "seq_len": seq_len,
+        "block_q": block_q,
+        "block_k": block_k,
+        "fwd_ms": round(t * 1e3, 3),
+        "fwd_tflops": round(flops / t / 1e12, 1),
+        "stable": timing.per_iter_s is not None,
+    }
+    if fwd_bwd:
+        def loss(a, kk, vv):
+            return jnp.sum(fn(a, kk, vv).astype(jnp.float32))
+
+        grad = jax.grad(loss, argnums=(0, 1, 2))
+
+        @partial(jax.jit, static_argnames="n")
+        def gchain(q, k, v, s, n):
+            def step(i, acc):
+                dq, _, _ = grad(acc, k, v)
+                return acc + dq.astype(q.dtype) * jnp.bfloat16(0.001)
+
+            out = lax.fori_loop(0, n, step, q * s)
+            return jnp.float32(out.sum())
+
+        gt = two_point_min_timing(
+            lambda s, n: float(gchain(q, k, v, s, n)), iters, 4 * iters, reps
+        )
+        ts = gt.per_iter_s or gt.inclusive_per_iter_s
+        out["fwd_bwd_ms"] = round(ts * 1e3, 3)
+        out["fwd_bwd_stable"] = gt.per_iter_s is not None
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=4)
+    ap.add_argument("--fwd-bwd", action="store_true")
+    ap.add_argument(
+        "--configs",
+        default="256x1024,256x512,512x512,512x1024,128x1024,256x2048,512x2048,1024x1024",
+        help="comma-separated BQxBK pairs",
+    )
+    args = ap.parse_args()
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+    for cfg in args.configs.split(","):
+        bq, bk = (int(x) for x in cfg.split("x"))
+        try:
+            r = time_config(
+                args.seq, args.heads, args.head_dim, bq, bk,
+                args.iters, args.reps, fwd_bwd=args.fwd_bwd,
+            )
+        except Exception as e:  # keep sweeping past an invalid config
+            r = {"block_q": bq, "block_k": bk, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

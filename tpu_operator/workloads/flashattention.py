@@ -87,6 +87,57 @@ def _block_relevant(qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
     return relevant
 
 
+# Kernel tuning switches (measured on a v5e chip; scripts/flash_sweep.py
+# A/Bs them). The balance permutation pays off where the parallel axis
+# carries triangular work — the forward and dQ grids; the dK/dV grid
+# measured slightly WORSE permuted (its sequential q walk already evens
+# out cross-kj variation), so it stays in natural order.
+_PERMUTE_FWD = True
+_PERMUTE_DQ = True
+_PERMUTE_DKV = False
+
+
+def _balance_perm(j, n: int):
+    """Permutation interleaving light and heavy rows of a causal triangle:
+    physical program j works logical block (j//2) for even j and
+    (n-1-j//2) for odd j. Megacore splits a parallel grid axis into
+    contiguous halves — unpermuted, the half owning the early q blocks
+    does ~1/3 of the triangle's work while the other does ~2/3 and sets
+    the makespan; interleaved, both halves carry (almost) equal work.
+    Self-inverse in effect for any split into contiguous chunks."""
+    return jnp.where(j % 2 == 0, j // 2, n - 1 - j // 2)
+
+
+def _causal_last_k(qi, block_q: int, block_k: int, nk_total: int, q_start=0, k_start=0):
+    """Last k block with any unmasked pair for q block ``qi`` (clipped to
+    the valid range). Used to CLAMP the k/v load index maps at the
+    diagonal: grid steps past it re-request the same block, which the
+    pallas pipeline recognises (unchanged block index -> no copy), so
+    above-diagonal steps cost neither HBM traffic nor a DMA slot — they
+    are pure bubbles. Without this, a causal walk fetched the full k
+    range and wasted ~half the bandwidth the kernel moved."""
+    return jnp.clip(
+        (q_start - k_start + (qi + 1) * block_q - 1) // block_k, 0, nk_total - 1
+    )
+
+
+def _block_unmasked(qi, kj, block_q, block_k, q_start=0, k_start=0,
+                    window: Optional[int] = None):
+    """Whether EVERY (q, k) pair in this causal block pair is unmasked —
+    the fast path: interior blocks skip mask construction (two iotas, a
+    compare, two selects) and the -inf fixups, leaving only
+    max/exp/sum on the VPU. Only diagonal-straddling (and window-edge)
+    blocks pay for masking."""
+    q_min = q_start + qi * block_q
+    k_max = k_start + (kj + 1) * block_k - 1
+    unmasked = q_min >= k_max
+    if window is not None:
+        q_max = q_start + (qi + 1) * block_q - 1
+        k_min = k_start + kj * block_k
+        unmasked &= q_max - k_min < window
+    return unmasked
+
+
 def _window_base(qi, block_q: int, block_k: int, window: int):
     """First k block of q block ``qi``'s window band (may be negative —
     callers clamp for loads and skip the out-of-range steps)."""
@@ -115,9 +166,11 @@ def _flash_fwd_kernel(
     q_start_ref, k_start_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     acc_ref, m_ref, l_ref,
     *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
-    nk_total: Optional[int] = None,
+    nk_total: Optional[int] = None, permute_q: bool = False,
 ):
     qi = pl.program_id(1)
+    if permute_q:
+        qi = _balance_perm(qi, pl.num_programs(1))
     t = pl.program_id(2)
     nk = pl.num_programs(2)
     q_start = q_start_ref[0]
@@ -147,24 +200,30 @@ def _flash_fwd_kernel(
         qi, kj, block_q, block_k, causal, q_start, k_start, window
     )
 
-    @pl.when(relevant)
-    def _attend():
+    def _attend(masked: bool):
         q = q_ref[0]  # (BQ, D)
         k = k_ref[0]  # (BK, D)
         v = v_ref[0]
         s, _ = _masked_scores(
-            q, k, qi, kj, block_q, block_k, causal, q_start, k_start, window
+            q, k, qi, kj, block_q, block_k, causal and masked, q_start, k_start,
+            window,
         )
         m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
         l = l_ref[:, :1]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
-        # fully-masked rows (block_q > block_k diagonals) keep m at -inf:
-        # exp(-inf - -inf) must yield 0, not nan
-        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
-        p = jnp.exp(s - safe_m)
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        if masked:
+            # fully-masked rows (block_q > block_k diagonals) keep m at
+            # -inf: exp(-inf - -inf) must yield 0, not nan
+            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+            correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+            p = jnp.exp(s - safe_m)
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        else:
+            # every pair live: blk_max (and so new_m) is finite, and
+            # exp(-inf - new_m) = 0 covers a still-empty m on its own
+            correction = jnp.exp(m - new_m)
+            p = jnp.exp(s - new_m)
         pv = lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -174,6 +233,21 @@ def _flash_fwd_kernel(
         l_ref[:] = jnp.broadcast_to(
             l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
         )
+
+    if causal:
+        unmasked = _block_unmasked(
+            qi, kj, block_q, block_k, q_start, k_start, window
+        )
+
+        @pl.when(relevant & unmasked)
+        def _fast():
+            _attend(masked=False)
+
+        @pl.when(relevant & jnp.logical_not(unmasked))
+        def _masked():
+            _attend(masked=True)
+    else:
+        _attend(masked=False)
 
     @pl.when(t == nk - 1)
     def _finalize():
@@ -196,9 +270,15 @@ def _row_stat(ref):
 
 
 def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
-                  window: Optional[int] = None):
-    s, scale = _masked_scores(q, k, qi, kj, block_q, block_k, causal,
-                              window=window)
+                  window: Optional[int] = None, masked: bool = True):
+    """``masked=False`` is the interior-block fast path: no mask
+    construction and no lse guards — valid because a causal row always
+    contains its diagonal key, so lse is finite wherever an unmasked
+    block exists."""
+    s, scale = _masked_scores(q, k, qi, kj, block_q, block_k,
+                              causal and masked, window=window)
+    if not masked:
+        return jnp.exp(s - lse), scale
     p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
     # rows with lse=-inf (no valid keys) and masked entries contribute 0
     p = jnp.where(jnp.isneginf(s) | ~jnp.isfinite(lse), 0.0, p)
@@ -208,9 +288,11 @@ def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
 def _flash_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
-    nk_total: Optional[int] = None,
+    nk_total: Optional[int] = None, permute_q: bool = False,
 ):
     qi = pl.program_id(1)
+    if permute_q:
+        qi = _balance_perm(qi, pl.num_programs(1))
     t = pl.program_id(2)
     nk = pl.num_programs(2)
     if window is None:
@@ -228,12 +310,13 @@ def _flash_dq_kernel(
 
     relevant = _block_relevant(qi, kj, block_q, block_k, causal, window=window)
 
-    @pl.when(relevant)
-    def _accumulate():
+    def _accumulate(masked: bool):
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = _row_stat(lse_ref)
         delta = _row_stat(delta_ref)
-        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal, window)
+        p, scale = _recomputed_p(
+            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked
+        )
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
@@ -242,6 +325,19 @@ def _flash_dq_kernel(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if causal:
+        unmasked = _block_unmasked(qi, kj, block_q, block_k, window=window)
+
+        @pl.when(relevant & unmasked)
+        def _fast():
+            _accumulate(masked=False)
+
+        @pl.when(relevant & jnp.logical_not(unmasked))
+        def _masked():
+            _accumulate(masked=True)
+    else:
+        _accumulate(masked=False)
 
     @pl.when(t == nk - 1)
     def _finalize():
@@ -252,8 +348,11 @@ def _flash_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
     *, block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
     window: Optional[int] = None, nq_total: Optional[int] = None,
+    permute_kv: bool = False,
 ):
     kj = pl.program_id(1)
+    if permute_kv:
+        kj = _balance_perm(kj, pl.num_programs(1))
     t = pl.program_id(2)
     n_seq = pl.num_programs(2)
     # GQA: the sequential axis enumerates (group member, q block); the q
@@ -273,12 +372,13 @@ def _flash_dkv_kernel(
     # k block
     relevant = _block_relevant(qi, kj, block_q, block_k, causal, window=window)
 
-    @pl.when(relevant)
-    def _accumulate():
+    def _accumulate(masked: bool):
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = _row_stat(lse_ref)
         delta = _row_stat(delta_ref)
-        p, scale = _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal, window)
+        p, scale = _recomputed_p(
+            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked
+        )
         # dV += Pᵀ dO
         dv_acc[:] = dv_acc[:] + lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -293,6 +393,19 @@ def _flash_dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if causal:
+        unmasked = _block_unmasked(qi, kj, block_q, block_k, window=window)
+
+        @pl.when(relevant & unmasked)
+        def _fast():
+            _accumulate(masked=False)
+
+        @pl.when(relevant & jnp.logical_not(unmasked))
+        def _masked():
+            _accumulate(masked=True)
+    else:
+        _accumulate(masked=False)
 
     @pl.when(t == n_seq - 1)
     def _finalize():
@@ -357,17 +470,38 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     # 4k window LOADS O(W) keys per q block, not O(S)
     nk_grid, k_block = _k_band(nk_total, block_q, block_k, window)
     grid = (bh_count, s // block_q, nk_grid)
+    # megacore balance: permute the parallel q axis so each contiguous
+    # half of the causal triangle carries equal work (identity for
+    # non-causal and windowed grids — a window band is already uniform)
+    permute_q = causal and window is None and _PERMUTE_FWD
+    nq = s // block_q
+
+    def q_block(j):
+        return _balance_perm(j, nq) if permute_q else j
+
     # index maps receive the scalar-prefetch refs appended to the grid
     # indices — hence *_
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t, *_: (i, j, 0))
-    k_spec = pl.BlockSpec(
-        (1, block_k, d),
-        lambda i, j, t, *_: (_kv_row(i, heads, kv_heads), k_block(j, t), 0),
-    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t, *_: (i, q_block(j), 0))
+
+    def k_index(i, j, t, qs_ref, ks_ref):
+        kj = k_block(j, t)
+        if causal:
+            # clamp loads at the diagonal: above-diagonal steps repeat
+            # the previous block index, so the pipeline skips their DMA
+            # entirely (they were ~half of all causal fetches)
+            kj = jnp.minimum(
+                kj,
+                _causal_last_k(
+                    q_block(j), block_q, block_k, nk_total, qs_ref[0], ks_ref[0]
+                ),
+            )
+        return (_kv_row(i, heads, kv_heads), kj, 0)
+
+    k_spec = pl.BlockSpec((1, block_k, d), k_index)
     # each qi program owns its own (1, BQ, 1) slice of the stat array —
     # rank-3 with a trailing singleton because the TPU lowering wants the
     # block's last two dims (8, 128)-divisible or equal to the array's
-    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj, *_: (i, j, 0))
+    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj, *_: (i, q_block(j), 0))
     # global sequence offsets ride scalar prefetch (SMEM) so the ring can
     # pass traced per-step origins; zeros for plain within-array attention
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -383,7 +517,8 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     )
     return pl.pallas_call(
         partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k,
-                causal=causal, window=window, nk_total=nk_total),
+                causal=causal, window=window, nk_total=nk_total,
+                permute_q=permute_q),
         out_shape=(
             jax.ShapeDtypeStruct(qb.shape, qb.dtype),
             jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
@@ -428,15 +563,28 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
     nk_total = s // block_k
     # band the k walk like the forward: only window blocks are loaded
     nk_band, dq_k_block = _k_band(nk_total, block_q, block_k, window)
-    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, j, 0))
-    k_spec = pl.BlockSpec(
-        (1, block_k, d),
-        lambda i, j, t: (_kv_row(i, heads, kv_heads), dq_k_block(j, t), 0),
-    )
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, j, 0))
+    # megacore balance, mirroring the forward (identity when windowed)
+    permute_q = causal and window is None and _PERMUTE_DQ
+
+    def q_block(j):
+        return _balance_perm(j, nq) if permute_q else j
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, t: (i, q_block(j), 0))
+
+    def dq_k_index(i, j, t):
+        kj = dq_k_block(j, t)
+        if causal:
+            # same diagonal load clamp as the forward: above-diagonal
+            # steps repeat a block index -> no DMA
+            kj = jnp.minimum(kj, _causal_last_k(q_block(j), block_q, block_k, nk_total))
+        return (_kv_row(i, heads, kv_heads), kj, 0)
+
+    k_spec = pl.BlockSpec((1, block_k, d), dq_k_index)
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, q_block(j), 0))
     dq = pl.pallas_call(
         partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
-                causal=causal, window=window, nk_total=nk_total),
+                causal=causal, window=window, nk_total=nk_total,
+                permute_q=permute_q),
         out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
         grid=(bh_count, nq, nk_band),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -462,12 +610,28 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
     def q_row(i, t):
         return (i // kv_heads) * heads + (i % kv_heads) * group + t // nq_band
 
+    # the dK/dV triangle leans the other way (early k blocks see every q
+    # block): permute the parallel kv axis for the same megacore balance
+    permute_kv = causal and window is None and _PERMUTE_DKV
+
+    def kv_block(kj):
+        return _balance_perm(kj, nk_total) if permute_kv else kj
+
+    def dkv_q_index(kj, t):
+        qi = dkv_q_block(kv_block(kj), t)
+        if causal:
+            # mirror of the forward's diagonal clamp: q blocks entirely
+            # BEFORE this k block are masked everywhere, so clamp their
+            # loads up to the first causally-relevant q block
+            qi = jnp.maximum(qi, (kv_block(kj) * block_k) // block_q)
+        return qi
+
     kq_q_spec = pl.BlockSpec(
-        (1, block_q, d), lambda i, kj, t: (q_row(i, t), dkv_q_block(kj, t), 0)
+        (1, block_q, d), lambda i, kj, t: (q_row(i, t), dkv_q_index(kj, t), 0)
     )
-    kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, t: (i, kj, 0))
+    kq_k_spec = pl.BlockSpec((1, block_k, d), lambda i, kj, t: (i, kv_block(kj), 0))
     kq_row_spec = pl.BlockSpec(
-        (1, block_q, 1), lambda i, kj, t: (q_row(i, t), dkv_q_block(kj, t), 0)
+        (1, block_q, 1), lambda i, kj, t: (q_row(i, t), dkv_q_index(kj, t), 0)
     )
     dk, dv = pl.pallas_call(
         partial(
@@ -478,6 +642,7 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
             q_blocks=nq_band,
             window=window,
             nq_total=nq,
+            permute_kv=permute_kv,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, kb.dtype),
@@ -503,7 +668,7 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 256,
+    block_q: int = 1024,
     block_k: int = 1024,
     window: Optional[int] = None,
 ) -> jax.Array:
