@@ -60,8 +60,20 @@ def config_from_env(env: Optional[Mapping[str, str]] = None, coordinator_port: i
             raise ValueError(
                 "MEGASCALE_NUM_SLICES > 1 requires MEGASCALE_COORDINATOR_ADDRESS"
             )
+        slice_id_raw = (env.get("MEGASCALE_SLICE_ID") or "").strip()
+        if not slice_id_raw:
+            # a dropped MEGASCALE_SLICE_ID would silently default every
+            # slice to block 0 — colliding process ids and a hang at
+            # initialize, the same silent-deadlock class as a missing
+            # coordinator. Fail fast instead.
+            raise ValueError("MEGASCALE_NUM_SLICES > 1 requires MEGASCALE_SLICE_ID")
+        slice_id = int(slice_id_raw)
+        if not 0 <= slice_id < num_slices:
+            raise ValueError(
+                f"MEGASCALE_SLICE_ID {slice_id} outside [0, {num_slices})"
+            )
         num = per_slice * num_slices
-        process_id = int(env.get("MEGASCALE_SLICE_ID", "0") or "0") * per_slice + worker_id
+        process_id = slice_id * per_slice + worker_id
     coordinator = env.get("MEGASCALE_COORDINATOR_ADDRESS") or (
         f"{hostnames[0]}:{coordinator_port}" if hostnames else None
     )

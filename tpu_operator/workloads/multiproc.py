@@ -222,6 +222,17 @@ def _launch_workers(worker_envs, devices_per_worker: int, timeout: float):
             )
         workers.append(report)
     if failures:
+        if any("timeout" in f for f in failures):
+            # the overwhelmingly common cause: initialize() blocks until
+            # EVERY process in the derived world connects, so one missing
+            # worker wedges the whole gang with no error anywhere — name
+            # the failure mode instead of leaving a bare timeout
+            failures.append(
+                "hint: a timed-out gang usually means a worker in the derived "
+                "world never started (missing pod, wrong TPU_WORKER_HOSTNAMES, "
+                "or a MEGASCALE_* mismatch) — jax.distributed.initialize waits "
+                "for all of them"
+            )
         raise RuntimeError("multiprocess check failed:\n" + "\n".join(failures))
     return workers
 
