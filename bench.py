@@ -38,13 +38,27 @@ REFERENCE_READY_BOUND_S = 900.0  # tests/e2e/gpu_operator_test.go:137
 SIM_CONTAINER_START_S = 0.25  # simulated image-pull/container-start latency
 
 
-def bench_install_to_ready(nodes: int = 4, transport: str = "inproc") -> float:
+def bench_install_to_ready(
+    nodes: int = 4,
+    transport: str = "inproc",
+    cached_reads: bool = True,
+    collect_stats: bool = False,
+    deadline_s: float = 120.0,
+    settle_s: float = 0.0,
+):
     """transport="inproc": operator calls the fake apiserver as dict ops.
     transport="http": the same fake apiserver is served over real TCP
     (kube/httpserver.py) and the operator runs on HttpClient — the number
     then includes JSON serialization, watch-stream delivery, and
     per-request connection setup. The cluster sim (standing in for
-    kubelets + the DaemonSet controller) stays in-process either way."""
+    kubelets + the DaemonSet controller) stays in-process either way.
+
+    ``cached_reads=False`` bypasses the informer-cache read path (the
+    round-3 behavior) so the apiserver-traffic saving is measurable.
+    ``collect_stats=True`` returns ``(elapsed, stats)`` with wire-request
+    counts per verb and the requests-per-reconcile rate; ``settle_s``
+    keeps the operator running that long after Ready so steady-state
+    reconciles dominate the rate instead of install-time churn."""
     from tpu_operator.api.clusterpolicy import (
         CLUSTER_POLICY_API_VERSION,
         CLUSTER_POLICY_KIND,
@@ -73,12 +87,30 @@ def bench_install_to_ready(nodes: int = 4, transport: str = "inproc") -> float:
         client = store
     sim = ClusterSim(store, ready_delay=SIM_CONTAINER_START_S, tick=0.01).start()
     mgr = Manager(client, namespace=ns)
-    setup_with_manager(mgr, ClusterPolicyReconciler(client, ns))
+    setup_with_manager(mgr, ClusterPolicyReconciler(client, ns), cached_reads=cached_reads)
+    import prometheus_client
+
+    from tpu_operator.controllers.operator_metrics import get_metrics
+
+    get_metrics()  # ensure the counter exists before sampling it
+
+    def reconcile_count() -> float:
+        # public sample API (the _value attribute is private and has moved
+        # across prometheus_client versions)
+        return (
+            prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_reconciliation_total"
+            )
+            or 0.0
+        )
+
+    reconciles_before = reconcile_count()
     mgr.start()
     try:
         t0 = time.perf_counter()
         client.create(new_cluster_policy())
-        deadline = t0 + 120
+        deadline = t0 + deadline_s
+        elapsed = None
         while time.perf_counter() < deadline:
             cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
             if cp.get("status", {}).get("state") == "ready":
@@ -86,9 +118,26 @@ def bench_install_to_ready(nodes: int = 4, transport: str = "inproc") -> float:
                 if len(dses) == 7 and all(
                     ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
                 ):
-                    return time.perf_counter() - t0
+                    elapsed = time.perf_counter() - t0
+                    break
             time.sleep(0.005)
-        raise RuntimeError("ClusterPolicy never became Ready")
+        if elapsed is None:
+            raise RuntimeError("ClusterPolicy never became Ready")
+        if not collect_stats:
+            return elapsed
+        if settle_s:
+            time.sleep(settle_s)
+        reconciles = reconcile_count() - reconciles_before
+        counts = dict(getattr(client, "request_counts", {}) or {})
+        total = sum(counts.values())
+        stats = {
+            "cached_reads": cached_reads,
+            "reconciles": int(reconciles),
+            "wire_requests": counts,
+            "wire_requests_total": total,
+            "requests_per_reconcile": round(total / reconciles, 1) if reconciles else None,
+        }
+        return elapsed, stats
     finally:
         mgr.stop()
         sim.stop()
@@ -248,6 +297,7 @@ def _multiprocess_distributed_details() -> dict:
 
         report = run_multiprocess_check(num_workers=2, devices_per_worker=4)
         multislice = run_multislice_check(num_slices=2, hosts_per_slice=1, devices_per_worker=4)
+        four_slice = run_multislice_check(num_slices=4, hosts_per_slice=2, devices_per_worker=1)
         return {
             "note": "2 local processes x 4 virtual CPU devices, real jax.distributed/TCP",
             "global_devices": report["global_devices"],
@@ -258,6 +308,14 @@ def _multiprocess_distributed_details() -> dict:
                 "slices": multislice["num_slices"],
                 "global_devices": multislice["global_devices"],
                 "psum_ok": multislice["psum_ok"],
+            },
+            # 8 processes in 4 slice blocks: the process-id derivation at a
+            # non-trivial (slice, host) layout
+            "four_slice_dcn": {
+                "slices": four_slice["num_slices"],
+                "processes": four_slice["num_workers"],
+                "global_devices": four_slice["global_devices"],
+                "psum_ok": four_slice["psum_ok"],
             },
         }
     except Exception as e:  # noqa: BLE001 — details are best-effort
@@ -270,6 +328,26 @@ def main() -> None:
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
     http_value = statistics.median(http_runs)
     scale_64 = bench_install_to_ready(nodes=64)  # 16 slices of v5e-16
+    # apiserver traffic at scale over the wire, cached (informer-served
+    # reads, the controller-runtime model) vs uncached (round-3's direct
+    # reads): the requests-per-reconcile drop is what keeps a real
+    # apiserver alive on large clusters. 3 s of steady state after Ready
+    # so the rate reflects level-triggered reconciles, not just install.
+    scale_http = {}
+    for label, nodes, cached in (
+        ("64node_cached", 64, True),
+        ("64node_direct", 64, False),
+        ("256node_cached", 256, True),
+        ("256node_direct", 256, False),
+    ):
+        try:
+            elapsed, stats = bench_install_to_ready(
+                nodes=nodes, transport="http", cached_reads=cached,
+                collect_stats=True, deadline_s=300.0, settle_s=3.0,
+            )
+            scale_http[label] = {"install_to_ready_s": round(elapsed, 3), **stats}
+        except RuntimeError as e:
+            scale_http[label] = {"error": str(e)}
     details = tpu_details()
     details["multiprocess_distributed"] = _multiprocess_distributed_details()
     out = {
@@ -291,6 +369,8 @@ def main() -> None:
         "baseline_s": REFERENCE_READY_BOUND_S,
         "sim_container_start_s": SIM_CONTAINER_START_S,
         "scale_64node_s": round(scale_64, 3),
+        "scale_256node_s": scale_http.get("256node_cached", {}).get("install_to_ready_s"),
+        "scale_http_transport": scale_http,
         "details": details,
     }
     print(json.dumps(out))

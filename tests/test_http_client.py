@@ -252,3 +252,57 @@ def test_watch_streams_events(stub):
         time.sleep(0.01)
     sub.stop()
     assert ("ADDED", "n1") in received
+
+
+class TestPooledRetryIdempotency:
+    """A reused keep-alive connection dying before the status line is an
+    ambiguous failure — the server may have processed the request before
+    closing. Idempotent methods (GET/DELETE/rv-guarded PUT) silently
+    retry on a fresh connection; a POST must surface the error instead
+    of risking a double-create (client-go draws the same line)."""
+
+    class _DeadConn:
+        def request(self, *a, **kw):
+            import http.client
+
+            raise http.client.RemoteDisconnected("server closed idle conn")
+
+        def close(self):
+            pass
+
+    class _GoodConn:
+        class _Resp:
+            status = 200
+            will_close = True
+
+            def read(self):
+                return b"{}"
+
+        def request(self, *a, **kw):
+            pass
+
+        def getresponse(self):
+            return self._Resp()
+
+        def close(self):
+            pass
+
+    def _client(self, monkeypatch):
+        client = HttpClient("http://unused")
+        monkeypatch.setattr(client, "_checkout_conn", lambda: (self._DeadConn(), True))
+        monkeypatch.setattr(client, "_new_conn", lambda: self._GoodConn())
+        return client
+
+    def test_get_retries_on_fresh_connection(self, monkeypatch):
+        client = self._client(monkeypatch)
+        assert client._request("GET", "/api/v1/nodes") == {}
+
+    def test_put_and_delete_retry(self, monkeypatch):
+        client = self._client(monkeypatch)
+        assert client._request("PUT", "/api/v1/nodes/n1", body={}) == {}
+        assert client._request("DELETE", "/api/v1/nodes/n1") == {}
+
+    def test_post_surfaces_the_ambiguous_failure(self, monkeypatch):
+        client = self._client(monkeypatch)
+        with pytest.raises(errors.ApiError, match="server closed idle conn"):
+            client._request("POST", "/api/v1/nodes", body={})

@@ -233,3 +233,45 @@ def test_leader_loss_invokes_on_stopped_leading():
         time.sleep(0.02)
     assert lost
     a.stop()
+
+
+class TestCachedReadClient:
+    def test_namespaced_read_reuses_namespaced_informer(self):
+        """A cached read scoped to a namespace must reuse the namespaced
+        informer the manager already runs — not shadow it with a new
+        cluster-wide LIST+watch (the apiserver traffic cached reads exist
+        to eliminate)."""
+        from tpu_operator.kube.cached import CachedReadClient
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.manager import Manager
+        from tpu_operator.kube.objects import new_object
+
+        store = FakeClient()
+        store.create(new_object("v1", "Pod", "p1", "ns-a"))
+        store.create(new_object("v1", "Pod", "p2", "ns-b"))
+        mgr = Manager(store)
+        mgr.informer_for("v1", "Pod", "ns-a")
+        mgr.start()
+        try:
+            cached = CachedReadClient(store, mgr)
+            assert [o["metadata"]["name"] for o in cached.list("v1", "Pod", "ns-a")] == ["p1"]
+            assert set(mgr._informers) == {("v1", "Pod", "ns-a")}
+            # a cluster-wide read cannot be served from the namespaced
+            # cache; it cold-starts its own informer once
+            assert len(cached.list("v1", "Pod")) == 2
+            assert ("v1", "Pod", "") in mgr._informers
+            # keyed get through the cluster-wide informer
+            assert cached.get("v1", "Pod", "p2", "ns-b")["metadata"]["name"] == "p2"
+        finally:
+            mgr.stop()
+
+    def test_read_before_manager_start_falls_through_live(self):
+        from tpu_operator.kube.cached import CachedReadClient
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.manager import Manager
+        from tpu_operator.kube.objects import new_object
+
+        store = FakeClient()
+        store.create(new_object("v1", "ConfigMap", "c", "ns"))
+        cached = CachedReadClient(store, Manager(store))
+        assert cached.get("v1", "ConfigMap", "c", "ns")["metadata"]["name"] == "c"

@@ -20,6 +20,21 @@ expose neither /dev/accel nor libtpu's runtime-metrics gRPC (probed
 round 3; native/tpuinfo.cc reads the device nodes where they do exist).
 The probe measures what the DCGM-utilization analog actually promises:
 the fraction of the chip's compute the node can currently deliver.
+
+Unlike DCGM's passive counters, the active probes BORROW the chip: a
+burst steals MXU/HBM time from any co-resident tenant. Deployments
+control this via ``TPU_EXPORTER_ACTIVE_PROBES``:
+
+    auto (default)  probe, but treat an unacquirable runtime/chip as
+                    "allocated to a tenant" and skip quietly (on
+                    single-client runtimes, successfully acquiring the
+                    chip implies nobody else holds it — that is the
+                    allocation gate)
+    on              probe and count every failure as a collect error
+    off             never run active probes (passive stats only)
+
+and ``TPU_EXPORTER_PROBE_INTERVAL`` (seconds between probe bursts,
+default 600).
 """
 
 from __future__ import annotations
@@ -42,12 +57,16 @@ class MetricsExporterAgent:
         port: int = 8431,
         interval: float = 30.0,
         bandwidth_probe_interval: float = 600.0,
+        active_probes: str = "auto",
         registry: Optional[prometheus_client.CollectorRegistry] = None,
     ):
+        if active_probes not in ("auto", "on", "off"):
+            raise ValueError(f"active_probes must be auto/on/off, got {active_probes!r}")
         self.node_name = node_name or "unknown"
         self.port = port
         self.interval = interval
         self.bandwidth_probe_interval = bandwidth_probe_interval
+        self.active_probes = active_probes
         self.registry = registry or prometheus_client.CollectorRegistry()
         self.chips = prometheus_client.Gauge(
             "tpu_exporter_chips", "Visible TPU chips", ["node"], registry=self.registry
@@ -115,8 +134,7 @@ class MetricsExporterAgent:
             report = hbm_bandwidth_probe(size_mb=64, iters=25)
             self.hbm_bandwidth.labels(self.node_name).set(report["bandwidth_gbps"])
         except Exception as e:  # noqa: BLE001
-            log.warning("metrics: bandwidth probe failed: %s", e)
-            self.collect_errors.labels(self.node_name).inc()
+            self._probe_failed("bandwidth", e)
 
     def probe_utilization(self) -> None:
         """Active compute probe: achieved bf16 matmul TFLOP/s (and % of the
@@ -146,8 +164,18 @@ class MetricsExporterAgent:
                     100.0 * report["tflops"] / PEAK_TFLOPS[gen]
                 )
         except Exception as e:  # noqa: BLE001
-            log.warning("metrics: utilization probe failed: %s", e)
-            self.collect_errors.labels(self.node_name).inc()
+            self._probe_failed("utilization", e)
+
+    def _probe_failed(self, what: str, exc: Exception) -> None:
+        """In auto mode an unacquirable chip means a tenant owns it (the
+        single-client runtime rejects a second client): skip quietly
+        rather than spam collect_errors every cycle. ``on`` means the
+        operator asked for unconditional probing — count the failure."""
+        if self.active_probes == "auto":
+            log.info("metrics: %s probe skipped (chip busy or unavailable): %s", what, exc)
+            return
+        log.warning("metrics: %s probe failed: %s", what, exc)
+        self.collect_errors.labels(self.node_name).inc()
 
     # -- server ---------------------------------------------------------------
 
@@ -157,7 +185,10 @@ class MetricsExporterAgent:
         while not self._stop.is_set():
             self.collect_device_stats()
             now = time.monotonic()
-            if now - last_probe >= self.bandwidth_probe_interval:
+            if (
+                self.active_probes != "off"
+                and now - last_probe >= self.bandwidth_probe_interval
+            ):
                 self.probe_bandwidth()
                 self.probe_utilization()
                 last_probe = now
@@ -183,9 +214,23 @@ def main() -> int:
         except ValueError:
             log.warning("invalid METRICS_PORT %r; using 8431", os.environ.get("METRICS_PORT"))
             port = 8431
+    active = os.environ.get("TPU_EXPORTER_ACTIVE_PROBES", "auto").strip().lower()
+    if active not in ("auto", "on", "off"):
+        log.warning("invalid TPU_EXPORTER_ACTIVE_PROBES %r; using auto", active)
+        active = "auto"
+    try:
+        probe_interval = float(os.environ.get("TPU_EXPORTER_PROBE_INTERVAL", "600").strip())
+    except ValueError:
+        log.warning(
+            "invalid TPU_EXPORTER_PROBE_INTERVAL %r; using 600",
+            os.environ.get("TPU_EXPORTER_PROBE_INTERVAL"),
+        )
+        probe_interval = 600.0
     MetricsExporterAgent(
         node_name=os.environ.get("NODE_NAME", ""),
         port=port,
+        bandwidth_probe_interval=probe_interval,
+        active_probes=active,
     ).run_forever()
     return 0
 

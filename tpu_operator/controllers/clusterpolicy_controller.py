@@ -24,6 +24,7 @@ from tpu_operator.catalog import InfoCatalog
 from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
 from tpu_operator.kube.events import EventRecorder
@@ -264,11 +265,21 @@ def node_labels_changed(event_type: str, old: Optional[ObjectDict], new: ObjectD
     return old["metadata"].get("labels") != new["metadata"].get("labels")
 
 
-def setup_with_manager(mgr, reconciler: ClusterPolicyReconciler) -> Controller:
+def setup_with_manager(
+    mgr, reconciler: ClusterPolicyReconciler, cached_reads: bool = True
+) -> Controller:
     """reference: SetupWithManager clusterpolicy_controller.go:352-407 —
     watch the CR (generation-gated), Node label events, and owned
-    DaemonSets, all funnelled into requests for every ClusterPolicy."""
+    DaemonSets, all funnelled into requests for every ClusterPolicy.
+    ``cached_reads=False`` keeps reads on the wire client (bench uses it
+    to measure what the informer caches save)."""
     ctrl = Controller("clusterpolicy", reconciler)
+    if cached_reads:
+        # reads via the manager's informer caches, writes direct — the
+        # reference reconciler reads exclusively through controller-runtime's
+        # cache (clusterpolicy_controller.go:352-407); without this every
+        # sync pass re-LISTs all owned kinds per state
+        reconciler.client = CachedReadClient(reconciler.client, mgr)
 
     def map_to_all_cps(_obj) -> List[Request]:
         try:

@@ -26,6 +26,7 @@ from tpu_operator.catalog import InfoCatalog
 from tpu_operator.controllers.status import publish_status
 from tpu_operator.controllers.tpuslice_validator import ValidationError, validate_node_selectors
 from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
 from tpu_operator.kube.objects import ObjectDict, matches_selector
@@ -108,6 +109,7 @@ def setup_with_manager(mgr, reconciler: TPUSliceReconciler) -> Controller:
     TPUSlice (generation-gated), ClusterPolicy, Nodes, and owned
     DaemonSets."""
     ctrl = Controller("tpuslice", reconciler)
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
 
     def map_to_all_slices(_obj) -> List[Request]:
         try:

@@ -19,6 +19,7 @@ from tpu_operator.api.clusterpolicy import (
 )
 from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
 from tpu_operator.upgrade.fsm import (
@@ -93,6 +94,7 @@ class UpgradeReconciler:
 
 def setup_with_manager(mgr, reconciler: UpgradeReconciler) -> Controller:
     ctrl = Controller("upgrade", reconciler)
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
 
     def map_to_all_cps(_obj) -> List[Request]:
         try:

@@ -11,6 +11,7 @@ automatic re-list + re-watch on disconnect/410.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
@@ -27,6 +28,29 @@ from tpu_operator.kube.client import Client, WatchHandler, WatchSubscription
 from tpu_operator.kube.objects import ObjectDict, api_group, is_cluster_scoped, nested_get
 
 log = logging.getLogger(__name__)
+
+
+def _requests_counter():
+    """Process-wide ``tpu_operator_apiserver_requests_total{verb}`` on the
+    default registry — with ``tpu_operator_reconciliation_total`` this
+    yields the requests-per-reconcile rate the reference gets for free
+    from controller-runtime's rest_client_requests_total."""
+    global _REQUESTS_TOTAL
+    if _REQUESTS_TOTAL is None:
+        import prometheus_client
+
+        _REQUESTS_TOTAL = prometheus_client.Counter(
+            "tpu_operator_apiserver_requests_total",
+            "Wire requests this process has sent to the apiserver",
+            ["verb"],
+        )
+    return _REQUESTS_TOTAL
+
+
+_REQUESTS_TOTAL = None
+
+# client-go's pager chunks LISTs at 500 by default; same here
+LIST_PAGE_SIZE = 500
 
 # the standard in-cluster mount; KUBE_SERVICEACCOUNT_DIR relocates it so
 # entrypoints can run against a served fake apiserver (image smoke / e2e)
@@ -124,6 +148,18 @@ class HttpClient(Client):
         # first requests would create two different locks guarding it
         self._idle_conns: list = []
         self._pool_lock = threading.Lock()
+        # per-client wire-request counts by verb (benchable without
+        # scraping the process-wide prometheus counter)
+        self.request_counts: collections.Counter = collections.Counter()
+        self._stats_lock = threading.Lock()
+
+    def _count_request(self, verb: str) -> None:
+        with self._stats_lock:
+            self.request_counts[verb] += 1
+        try:
+            _requests_counter().labels(verb).inc()
+        except Exception:  # noqa: BLE001 — metrics must never break IO
+            pass
 
     @classmethod
     def from_kubeconfig(cls, path: Optional[str] = None, context: Optional[str] = None) -> "HttpClient":
@@ -319,13 +355,16 @@ class HttpClient(Client):
         if token:
             headers["Authorization"] = f"Bearer {token}"
 
-        # Retry policy: ONLY a request that failed on a reused (pooled)
-        # connection BEFORE any response bytes arrived retries, on a fresh
-        # connection — the server closing an idle keep-alive connection is
-        # a normal race and such a request was provably never processed.
-        # Once a status line exists (or on a fresh connection), failure is
-        # ambiguous (a POST/PUT may have landed) and must surface, not
-        # silently duplicate a mutation (client-go draws the same line).
+        # Retry policy: ONLY an IDEMPOTENT request that failed on a reused
+        # (pooled) connection before any response bytes arrived retries, on
+        # a fresh connection — the server closing an idle keep-alive
+        # connection is the common race, but "no status line" does NOT
+        # prove the request went unprocessed (the server may have read and
+        # applied it, then died before responding). GET/DELETE/PUT are safe
+        # to re-send (kube PUTs are rv-guarded: a duplicate hits Conflict);
+        # a POST could double-create, so it surfaces the error instead and
+        # callers tolerate AlreadyExists on their own retry (Go's transport
+        # draws the same idempotency line when request bytes were written).
         for attempt in range(2):
             try:
                 if attempt == 0:
@@ -334,6 +373,7 @@ class HttpClient(Client):
                     conn, pooled = self._new_conn(), False
             except OSError as e:
                 raise errors.ApiError(f"{method} {path}: {e}") from e
+            self._count_request(method)
             try:
                 conn.request(method, target, body=data, headers=headers)
                 resp = conn.getresponse()
@@ -344,7 +384,7 @@ class HttpClient(Client):
                 ConnectionResetError,
             ) as e:
                 conn.close()
-                if pooled:
+                if pooled and method != "POST":
                     continue  # stale keep-alive: retry on a fresh connection
                 raise errors.ApiError(f"{method} {path}: {e}") from e
             except OSError as e:
@@ -385,22 +425,50 @@ class HttpClient(Client):
         return self._request("GET", self._path(api_version, kind, namespace, name))
 
     def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
+        """Chunked LIST (kube pagination): pages of ``LIST_PAGE_SIZE`` via
+        ``limit``/``continue`` so a large cluster never materializes one
+        giant response (client-go pager semantics). Selectors go in the
+        query so a conformant server filters server-side; the local
+        filter stays as a backstop for servers that ignore fieldSelector
+        on a kind (filtering twice is a no-op)."""
         query = {}
         if isinstance(label_selector, dict):
             query["labelSelector"] = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
         elif label_selector:
             query["labelSelector"] = label_selector
-        result = self._request("GET", self._path(api_version, kind, namespace), query=query or None)
+        if field_selector:
+            query["fieldSelector"] = ",".join(
+                f"{path}={want}" for path, want in sorted(field_selector.items())
+            )
+        raw, _ = self._list_paged(api_version, kind, namespace, query)
         items: List[ObjectDict] = []
-        for item in result.get("items", []):
-            item.setdefault("apiVersion", api_version)
-            item.setdefault("kind", kind)
+        for item in raw:
             if field_selector and not all(
                 nested_get(item, *path.split(".")) == want for path, want in field_selector.items()
             ):
                 continue
             items.append(item)
         return items
+
+    def _list_paged(self, api_version, kind, namespace, query: Optional[dict] = None):
+        """Chunked LIST shared by ``list`` and the watch re-list: returns
+        ``(items, resourceVersion)`` with the rv of the final chunk (kube
+        serves every chunk of one paged list from the same snapshot, so
+        that rv is the consistent point to watch from)."""
+        query = dict(query or {})
+        query["limit"] = str(LIST_PAGE_SIZE)
+        items: List[ObjectDict] = []
+        while True:
+            result = self._request("GET", self._path(api_version, kind, namespace), query=query)
+            for item in result.get("items", []):
+                item.setdefault("apiVersion", api_version)
+                item.setdefault("kind", kind)
+                items.append(item)
+            md = result.get("metadata", {})
+            cont = md.get("continue")
+            if not cont:
+                return items, md.get("resourceVersion", "")
+            query["continue"] = cont
 
     def create(self, obj):
         md = obj.get("metadata", {})
@@ -457,15 +525,15 @@ class HttpClient(Client):
         while sub.active:
             try:
                 if not resource_version:
-                    # (re-)list to establish a consistent start point
-                    listed = self._request("GET", self._path(api_version, kind, namespace))
-                    resource_version = listed.get("metadata", {}).get("resourceVersion", "")
+                    # (re-)list to establish a consistent start point —
+                    # paged like every other LIST (informer reconnects on
+                    # large clusters are exactly where one giant response
+                    # would hurt most)
+                    items, resource_version = self._list_paged(api_version, kind, namespace)
                     if resource_version != "0":
                         # real apiserver: replay the list as ADDED and
                         # stream from its resourceVersion (gap-free)
-                        for item in listed.get("items", []):
-                            item.setdefault("apiVersion", api_version)
-                            item.setdefault("kind", kind)
+                        for item in items:
                             handler("ADDED", item)
                     # rv "0": the server streams its own synthetic ADDED
                     # replay atomically with watch registration (kube's
@@ -488,6 +556,7 @@ class HttpClient(Client):
             query["resourceVersion"] = resource_version
         url = self.base_url + self._path(api_version, kind, namespace) + "?" + urllib.parse.urlencode(query)
         req = urllib.request.Request(url)
+        self._count_request("WATCH")
         token = self._bearer()  # watch streams reconnect, picking up fresh tokens
         if token:
             req.add_header("Authorization", f"Bearer {token}")

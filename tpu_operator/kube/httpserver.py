@@ -249,13 +249,41 @@ class FakeApiServer:
                     for pair in query["labelSelector"][0].split(",")
                     if "=" in pair
                 )
-            items = self.client.list(api_version, kind, namespace, label_selector=selector)
+            field_selector = None
+            if query.get("fieldSelector"):
+                field_selector = dict(
+                    pair.split("=", 1)
+                    for pair in query["fieldSelector"][0].split(",")
+                    if "=" in pair
+                )
+            items = self.client.list(
+                api_version, kind, namespace,
+                label_selector=selector, field_selector=field_selector,
+            )
+            # pagination (limit/continue): name-keyed continuation over a
+            # sorted view, so chunks stay stable under concurrent writes
+            # (an insert before the cursor is missed, matching kube's
+            # consistency contract for paged lists). The token is the last
+            # key served, not an index — indexes shift.
+            items.sort(key=lambda o: (o["metadata"].get("namespace") or "", o["metadata"]["name"]))
+            metadata = {"resourceVersion": "0"}
+            limit = int(query["limit"][0]) if query.get("limit") else 0
+            if query.get("continue"):
+                after = tuple(query["continue"][0].split("\x00", 1))
+                items = [
+                    o for o in items
+                    if (o["metadata"].get("namespace") or "", o["metadata"]["name"]) > after
+                ]
+            if limit and len(items) > limit:
+                items = items[:limit]
+                last = items[-1]["metadata"]
+                metadata["continue"] = f"{last.get('namespace') or ''}\x00{last['name']}"
             return handler._send(
                 200,
                 {
                     "apiVersion": api_version,
                     "kind": f"{kind}List",
-                    "metadata": {"resourceVersion": "0"},
+                    "metadata": metadata,
                     "items": items,
                 },
             )
@@ -292,7 +320,39 @@ class FakeApiServer:
         what closes the list→watch gap: the client's LIST runs on a
         separate request, and a lost creation in that gap would otherwise
         never be seen (no informer resync timer exists to recover it).
-        List responses advertise rv "0" so clients take this path."""
+        List responses advertise rv "0" so clients take this path.
+
+        Any OTHER resourceVersion gets a 410-style ERROR event: this
+        store keeps no event history, so it cannot replay from an
+        arbitrary rv — and silently streaming only LIVE events would lose
+        everything in the gap. A real apiserver answers a too-old rv the
+        same way (Status 410 Gone inside the stream), forcing the client
+        to re-list; raw consumers get the same contract here."""
+        if resource_version not in ("", "0"):
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            handler.wfile.write(
+                json.dumps(
+                    {
+                        "type": "ERROR",
+                        "object": {
+                            "apiVersion": "v1",
+                            "kind": "Status",
+                            "status": "Failure",
+                            "reason": "Expired",
+                            "code": 410,
+                            "message": (
+                                f"too old resource version: {resource_version}"
+                            ),
+                        },
+                    }
+                ).encode()
+                + b"\n"
+            )
+            handler.wfile.flush()
+            return
         events: "queue.Queue" = queue.Queue()
         sub = self.client.watch(
             api_version,

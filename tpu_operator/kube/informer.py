@@ -7,7 +7,7 @@ import threading
 from typing import Callable, List, Optional
 
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client
-from tpu_operator.kube.objects import ObjectDict, deep_copy, object_key
+from tpu_operator.kube.objects import ObjectDict, api_group, deep_copy, object_key
 
 
 def _newer(rv_new, rv_old) -> bool:
@@ -36,20 +36,29 @@ class Informer:
         self._lock = threading.RLock()
         self._sub = None
         self._synced = False
+        self._stopped = False
+        # serializes start/stop so a late lazy start (a cached read of a
+        # new kind on a running manager) can never leak a watch past stop
+        self._lifecycle = threading.Lock()
 
     def add_handler(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
 
     def start(self) -> None:
-        # Subscribe first so no events are lost between list and watch.
-        self._sub = self.client.watch(self.api_version, self.kind, self._on_event, self.namespace)
-        for obj in self.client.list(self.api_version, self.kind, self.namespace):
-            self._on_event(ADDED, obj)
-        self._synced = True
+        with self._lifecycle:
+            if self._stopped or self._sub is not None:
+                return  # stopped or already started — idempotent
+            # Subscribe first so no events are lost between list and watch.
+            self._sub = self.client.watch(self.api_version, self.kind, self._on_event, self.namespace)
+            for obj in self.client.list(self.api_version, self.kind, self.namespace):
+                self._on_event(ADDED, obj)
+            self._synced = True
 
     def stop(self) -> None:
-        if self._sub is not None:
-            self._sub.stop()
+        with self._lifecycle:
+            self._stopped = True
+            if self._sub is not None:
+                self._sub.stop()
 
     def has_synced(self) -> bool:
         return self._synced
@@ -92,9 +101,11 @@ class Informer:
             return [deep_copy(obj) for obj in self._cache.values()]
 
     def get(self, name: str, namespace: str = "") -> Optional[ObjectDict]:
-        """Keyed cache read (deep copy of one object, not the whole cache)."""
+        """Keyed cache read (deep copy of one object, not the whole
+        cache). O(1): the cache is keyed by object_key, and this informer
+        serves exactly one (group, kind) — the hot cached-read path calls
+        this once per desired object per sync."""
+        key = (api_group(self.api_version), self.kind, namespace or "", name)
         with self._lock:
-            for key, obj in self._cache.items():
-                if key[3] == name and key[2] == (namespace or ""):
-                    return deep_copy(obj)
-        return None
+            obj = self._cache.get(key)
+        return deep_copy(obj) if obj is not None else None

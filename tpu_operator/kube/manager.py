@@ -51,15 +51,40 @@ class Manager:
     def informer_for(self, api_version: str, kind: str, namespace: Optional[str] = None) -> Informer:
         """Shared informer per (api_version, kind, namespace). If the manager
         is already running, the informer is started (list+watch) immediately
-        so late wiring never yields a silent dead watch."""
+        so late wiring never yields a silent dead watch.
+
+        The steady-state path is LOCK-FREE (a dict read): cached reads go
+        through here on every get/list, and taking the manager lifecycle
+        lock per read would let one slow cold start block stop() — and
+        with it the leader-loss teardown — plus every other controller's
+        reads. Only creation registers under the lock; the synchronous
+        cold LIST runs OUTSIDE it (the informer's own lifecycle guard
+        keeps a concurrent manager stop from leaking the watch)."""
+        key = (api_version, kind, namespace or "")
+        informer = self._informers.get(key)
+        if informer is not None:
+            return informer
+        return self._informer_create(key, api_version, kind, namespace)
+
+    def informer_peek(self, api_version: str, kind: str, namespace: Optional[str] = None) -> Optional[Informer]:
+        """Existing informer for exactly this scope, or None — never
+        creates. Cache-backed readers use it to reuse whatever watch scope
+        is already wired (a namespaced Pod informer must not be shadowed
+        by a brand-new cluster-wide one, nor vice versa)."""
+        return self._informers.get((api_version, kind, namespace or ""))
+
+    def _informer_create(self, key, api_version: str, kind: str, namespace: Optional[str]) -> Informer:
         with self._lifecycle:
-            key = (api_version, kind, namespace or "")
-            if key not in self._informers:
+            informer = self._informers.get(key)
+            if informer is None:
                 informer = Informer(self.client, api_version, kind, namespace)
                 self._informers[key] = informer
-                if self._started.is_set():
-                    informer.start()
-            return self._informers[key]
+                start_now = self._started.is_set() and not self._stopping
+            else:
+                start_now = False
+        if start_now:
+            informer.start()
+        return informer
 
     def add_controller(self, controller: Controller) -> Controller:
         self._controllers.append(controller)

@@ -196,13 +196,22 @@ class StateSkel:
     def apply_object(self, client: Client, obj: ObjectDict) -> None:
         """Create-or-update gated on the hash annotation
         (reference: state_skel.go:223-285 + DaemonSet hash discipline
-        object_controls.go:4177-4212)."""
+        object_controls.go:4177-4212).
+
+        Reads may be served from an informer cache (CachedReadClient), so
+        a just-created object can look absent for one watch delivery; the
+        AlreadyExists fallback re-reads LIVE and updates, instead of
+        failing the whole state sync until the cache catches up."""
         md = obj["metadata"]
         try:
             existing = client.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
         except errors.NotFound:
-            client.create(obj)
-            return
+            try:
+                client.create(obj)
+                return
+            except errors.AlreadyExists:
+                live = getattr(client, "live", client)
+                existing = live.get(obj["apiVersion"], obj["kind"], md["name"], md.get("namespace"))
         if get_annotation(existing, consts.LAST_APPLIED_HASH_ANNOTATION) == get_annotation(
             obj, consts.LAST_APPLIED_HASH_ANNOTATION
         ):
