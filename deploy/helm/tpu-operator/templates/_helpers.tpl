@@ -5,7 +5,13 @@ helmlite's define/include support — keep in sync with the
 tpuop-cfg render path (deploy/templates/0500_deployment.yaml).
 */}}
 {{- define "tpu-operator.labels" -}}
-app: tpu-operator
-app.kubernetes.io/name: tpu-operator
-app.kubernetes.io/instance: {{ .Release.Name }}
+{{- /* user labels merge UNDER the chart's own (merge: leftmost wins),
+      so extraLabels can never clobber the app selector labels; hasKey
+      distinguishes an absent key from an explicitly empty map (both
+      valid, neither may break merge) */ -}}
+{{- $extra := ternary (.Values.operator.extraLabels | default (dict)) (dict) (hasKey .Values.operator "extraLabels") -}}
+{{- toYaml (merge (dict
+      "app" "tpu-operator"
+      "app.kubernetes.io/name" "tpu-operator"
+      "app.kubernetes.io/instance" .Release.Name) $extra) -}}
 {{- end }}

@@ -96,6 +96,26 @@ class TestHelmParity:
         values["clusterPolicy"]["multiSlice"] = {"enabled": True, "coordinatorPort": 9000}
         assert_parity(values)
 
+    def test_extra_labels(self):
+        """operator.extraLabels land on the Deployment through both
+        render paths, and can never clobber the chart's own app labels
+        (helm: merge gives the chart's dict precedence; jinja: the base
+        labels win YAML duplicate-key resolution)."""
+        values = load_default_values()
+        values["operator"]["extraLabels"] = {
+            "team": "ml-infra",
+            "app": "evil-override",
+            # scalar-looking strings must stay strings through BOTH
+            # renderers (raw jinja interpolation once yielded bool true)
+            "stage": "true",
+        }
+        assert_parity(values)
+        deploy = [o for o in render_chart(values) if o["kind"] == "Deployment"][0]
+        labels = deploy["metadata"]["labels"]
+        assert labels["team"] == "ml-infra"
+        assert labels["app"] == "tpu-operator"
+        assert labels["stage"] == "true"
+
     def test_partial_values_merge_like_helm(self):
         """A partial overrides file must produce the same install through
         both paths: helm deep-merges over chart defaults, and render_chart
@@ -174,8 +194,58 @@ class TestChartContents:
 
 class TestHelmliteEngine:
     def test_unsupported_construct_raises(self):
-        with pytest.raises(helmlite.HelmliteError, match="block"):
-            helmlite.render_string('{{ block "x" . }}y{{ end }}', {"Values": {}})
+        with pytest.raises(helmlite.HelmliteError, match="unknown function"):
+            helmlite.render_string("{{ urlquery .Values.x }}", {"Values": {}})
+
+    def test_block_renders_default_body(self):
+        out = helmlite.render_string(
+            '{{ block "greet" .Values }}hi {{ .who }}{{ end }}',
+            {"Values": {"who": "tpu"}},
+        )
+        assert out == "hi tpu"
+
+    def test_block_overridden_by_define(self):
+        """Go/helm semantics: block's body is only the DEFAULT — a
+        template defined under the same name wins, regardless of where
+        it appears."""
+        out = helmlite.render_string(
+            '{{ define "greet" }}hello {{ .who }}{{ end }}'
+            '{{ block "greet" .Values }}hi {{ .who }}{{ end }}',
+            {"Values": {"who": "tpu"}},
+        )
+        assert out == "hello tpu"
+
+    def test_parenthesized_pipelines(self):
+        ctx = {"Values": {"a": "x", "b": "", "n": 3}}
+        cases = [
+            ('{{ if and (eq .Values.a "x") (not .Values.b) }}y{{ else }}n{{ end }}', "y"),
+            ('{{ ternary "@" ":" (hasPrefix "sha256:" "sha256:abc") }}', "@"),
+            # nested parens + a pipe INSIDE the parens must not split outside
+            ('{{ or (and (.Values.b | not) "inner") "outer" }}', "inner"),
+            ('{{ (printf "%s-%d" .Values.a .Values.n) | upper }}', "X-3"),
+        ]
+        for template, want in cases:
+            assert helmlite.render_string(template, ctx) == want, template
+
+    def test_unbalanced_parens_raise(self):
+        for template in ("{{ and (eq .x 1 }}", "{{ and eq .x 1) }}"):
+            with pytest.raises(helmlite.HelmliteError, match="parenthes"):
+                helmlite.render_string(template, {"Values": {}})
+
+    def test_dict_merge_haskey(self):
+        ctx = {"Values": {"m": {"a": 1}, "extra": {"b": 2, "nested": {"x": 1}}}}
+        cases = [
+            ('{{ if hasKey .Values.m "a" }}y{{ end }}', "y"),
+            ('{{ if hasKey .Values.m "z" }}y{{ else }}n{{ end }}', "n"),
+            ('{{ toYaml (dict "k" "v") }}', "k: v"),
+            # merge: leftmost (dst) precedence, deep
+            (
+                '{{ toYaml (merge (dict "b" 9) .Values.extra (dict "nested" (dict "y" 2))) }}',
+                "b: 9\nnested:\n  x: 1\n  y: 2",
+            ),
+        ]
+        for template, want in cases:
+            assert helmlite.render_string(template, ctx) == want, template
 
     def test_range_list_with_vars(self):
         t = "{{ range $i, $v := .Values.items }}{{ $i }}={{ $v }};{{ end }}"

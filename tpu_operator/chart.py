@@ -43,6 +43,7 @@ def render_chart(values: dict, chart_dir: str = CHART_DIR) -> List[dict]:
             "replicas": 1,
             "leaderElect": True,
             "resources": None,
+            "extraLabels": {},
         },
         **(values.get("operator") or {}),
     )

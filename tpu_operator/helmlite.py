@@ -12,8 +12,10 @@ actions with trim markers, ``.Values``/``.Release`` paths,
 ``if``/``else``/``end``, ``range`` (with ``$i, $v :=`` declarations and
 ``else``), ``with``, variables (``$x := ...``, ``$`` as the root
 context), named templates (``define`` in ``*.tpl`` files, the ``include``
-function and ``template`` action), pipelines, and the functions listed
-in ``_FUNCTIONS`` — and *raises* on anything else, so a chart edit that
+function and ``template`` action, ``block`` as define-with-default +
+execute-in-place), pipelines with parenthesized sub-expressions, and the
+functions listed in ``_FUNCTIONS`` — and *raises* on anything else, so a
+chart edit that
 outgrows the verifier fails loudly instead of silently diverging from
 what real helm would render. Semantics follow Go:
 
@@ -130,6 +132,24 @@ def _required(msg: Any, v: Any = None) -> Any:
     return v
 
 
+def _sprig_dict(*kv: Any) -> dict:
+    if len(kv) % 2:
+        raise HelmliteError(f"dict wants key/value pairs, got {len(kv)} args")
+    return {str(kv[i]): kv[i + 1] for i in range(0, len(kv), 2)}
+
+
+def _sprig_merge(dst: Any, *srcs: Any) -> dict:
+    """sprig merge: deep-merge sources into dst with dst taking
+    precedence (leftmost wins). Returns a new dict; arguments are not
+    mutated (sprig mutates dst — charts here never rely on that)."""
+    out: dict = {}
+    for m in reversed((dst,) + srcs):
+        if not isinstance(m, dict):
+            raise HelmliteError(f"merge wants dicts, got {type(m).__name__}")
+        out = deep_merge(out, m)
+    return out
+
+
 _FUNCTIONS = {
     "toYaml": _to_yaml,
     "indent": _indent,
@@ -160,6 +180,10 @@ _FUNCTIONS = {
     "len": _golen,
     # sprig: ternary trueVal falseVal cond (cond usually piped in)
     "ternary": lambda t, f, cond: t if _truthy(cond) else f,
+    # sprig dict helpers
+    "hasKey": lambda d, k: isinstance(d, dict) and str(k) in d,
+    "dict": _sprig_dict,
+    "merge": _sprig_merge,
 }
 
 
@@ -336,10 +360,22 @@ def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False, de
                 raise HelmliteError(f"unexpected {body!r} outside a block")
             return nodes, i, body
         if word == "block":
-            raise HelmliteError(
-                "helmlite does not implement 'block' — extend _parse "
-                "(and re-check against real helm) before using it in the chart"
-            )
+            # Go: {{ block "name" pipeline }}body{{ end }} is shorthand for
+            # define + execute-in-place, with the body as the DEFAULT: a
+            # template defined elsewhere under the same name overrides it
+            # (helm's override idiom), hence setdefault, not assignment
+            m = re.match(r'^block\s+"((?:[^"\\]|\\.)*)"\s+(.+)$', body, re.DOTALL)
+            if not m:
+                raise HelmliteError(f"malformed block action: {body!r}")
+            sub, i, term = _parse(tokens, i + 1, in_block=True, defines=defines)
+            if term != "end":
+                raise HelmliteError(f"expected end after block, got {term!r}")
+            if defines is None:
+                raise HelmliteError("block outside a template file context")
+            defines.setdefault(m.group(1), sub)
+            nodes.append(_TemplateCall(m.group(1), m.group(2).strip()))
+            i += 1
+            continue
         m = re.match(r"^\$([\w]+)\s*(:=|=)\s*(.+)$", body)
         if m:
             nodes.append(_Assign(m.group(1), m.group(3).strip(), m.group(2) == ":="))
@@ -355,8 +391,6 @@ def _parse(tokens: List[Tuple[str, str]], i: int = 0, in_block: bool = False, de
 # ---------------------------------------------------------------------------
 # evaluation
 # ---------------------------------------------------------------------------
-
-_TOKEN_RE = re.compile(r'"(?:[^"\\]|\\.)*"|\S+')
 
 
 class _VarFrame:
@@ -421,6 +455,9 @@ def _walk(base: Any, path: str, full: str) -> Any:
 
 
 def _eval_atom(tok: str, scope: _Scope) -> Any:
+    if len(tok) >= 2 and tok.startswith("(") and tok.endswith(")"):
+        # parenthesized sub-pipeline: a full pipeline in argument position
+        return _eval_pipeline(tok[1:-1], scope)
     if len(tok) >= 2 and tok.startswith('"') and tok.endswith('"'):
         return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
     if tok in ("true", "false"):
@@ -466,11 +503,13 @@ def _eval_segment(tokens: List[str], scope: _Scope, piped: Any = ...) -> Any:
 
 
 def _split_pipeline(pipeline: str) -> List[str]:
-    """Split on '|' outside string literals ('{{ eq .x "|" }}' must not
-    split inside the quoted argument)."""
+    """Split on '|' outside string literals and parentheses
+    ('{{ eq .x "|" }}' and '{{ and (eq .a 1 | not) .b }}' must not split
+    inside the quoted argument / the parenthesized sub-pipeline)."""
     segments: List[str] = []
     current: List[str] = []
     in_string = False
+    depth = 0
     i = 0
     while i < len(pipeline):
         ch = pipeline[i]
@@ -484,7 +523,13 @@ def _split_pipeline(pipeline: str) -> List[str]:
         elif ch == '"':
             in_string = True
             current.append(ch)
-        elif ch == "|":
+        elif ch == "(":
+            depth += 1
+            current.append(ch)
+        elif ch == ")":
+            depth -= 1
+            current.append(ch)
+        elif ch == "|" and depth == 0:
             segments.append("".join(current))
             current = []
         else:
@@ -492,14 +537,68 @@ def _split_pipeline(pipeline: str) -> List[str]:
         i += 1
     if in_string:
         raise HelmliteError(f"unterminated string literal in {pipeline!r}")
+    if depth:
+        raise HelmliteError(f"unbalanced parentheses in {pipeline!r}")
     segments.append("".join(current))
     return segments
+
+
+def _segment_tokens(segment: str) -> List[str]:
+    """Tokenize one pipeline segment: string literals and parenthesized
+    sub-pipelines each form ONE token (the latter evaluated recursively
+    by ``_eval_atom``)."""
+    tokens: List[str] = []
+    s = segment.strip()
+    i, n = 0, len(s)
+    while i < n:
+        ch = s[i]
+        if ch.isspace():
+            i += 1
+            continue
+        if ch == '"':
+            j = i + 1
+            while j < n and s[j] != '"':
+                j += 2 if s[j] == "\\" else 1
+            if j >= n:
+                raise HelmliteError(f"unterminated string literal in {segment!r}")
+            tokens.append(s[i : j + 1])
+            i = j + 1
+            continue
+        if ch == "(":
+            depth, j, in_str = 1, i + 1, False
+            while j < n and depth:
+                c = s[j]
+                if in_str:
+                    if c == "\\":
+                        j += 1
+                    elif c == '"':
+                        in_str = False
+                elif c == '"':
+                    in_str = True
+                elif c == "(":
+                    depth += 1
+                elif c == ")":
+                    depth -= 1
+                j += 1
+            if depth:
+                raise HelmliteError(f"unbalanced parentheses in {segment!r}")
+            tokens.append(s[i:j])
+            i = j
+            continue
+        if ch == ")":
+            raise HelmliteError(f"unbalanced parentheses in {segment!r}")
+        j = i
+        while j < n and not s[j].isspace() and s[j] not in '()"':
+            j += 1
+        tokens.append(s[i:j])
+        i = j
+    return tokens
 
 
 def _eval_pipeline(pipeline: str, scope: _Scope) -> Any:
     value: Any = ...
     for segment in _split_pipeline(pipeline):
-        tokens = _TOKEN_RE.findall(segment.strip())
+        tokens = _segment_tokens(segment)
         if not tokens:
             raise HelmliteError(f"empty pipeline segment in {pipeline!r}")
         value = _eval_segment(tokens, scope, value)
