@@ -13,4 +13,13 @@ echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) ==
 python3 scripts/image_smoke.py
 echo "== e2e =="
 bash tests/scripts/end-to-end.sh
+echo "== real-apiserver e2e (optional: needs docker + kind) =="
+# 42 is kind-e2e.sh's skip sentinel, chosen outside pytest's 0-5 range
+# so a crashed suite can never read as "kind not installed"
+rc=0
+bash tests/scripts/kind-e2e.sh || rc=$?
+if [ "$rc" -ne 0 ] && [ "$rc" -ne 42 ]; then
+  echo "kind e2e FAILED (rc=$rc)"
+  exit "$rc"
+fi
 echo "CI: PASS"
