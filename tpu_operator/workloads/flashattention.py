@@ -42,19 +42,22 @@ except ImportError:  # pragma: no cover
 
 
 def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
-                   window: Optional[int] = None):
-    """scale·QKᵀ with the causal (and optional sliding-window) mask —
-    shared by fwd and bwd (the backward recomputes scores instead of
-    saving O(S²) tiles). ``q_start``/``k_start`` are GLOBAL sequence
-    offsets (ring attention passes the circulating block's origin so
-    causality holds across chips; 0 for plain within-array attention);
-    ``window`` keeps only the last ``window`` positions (0 ≤ q−k <
-    window)."""
+                   window: Optional[int] = None, q_seg=None, k_seg=None):
+    """scale·QKᵀ with the causal (and optional sliding-window /
+    segment) mask — shared by fwd and bwd (the backward recomputes
+    scores instead of saving O(S²) tiles). ``q_start``/``k_start`` are
+    GLOBAL sequence offsets (ring attention passes the circulating
+    block's origin so causality holds across chips; 0 for plain
+    within-array attention); ``window`` keeps only the last ``window``
+    positions (0 ≤ q−k < window); ``q_seg``/``k_seg`` are (BQ, 1)/(BK, 1)
+    segment-id columns — packed-sequence attention keeps only same-
+    segment pairs."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = (
         lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         * scale
     )  # (BQ, BK)
+    keep = None
     if causal:
         q_pos = q_start + qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -65,6 +68,10 @@ def _masked_scores(q, k, qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
         keep = q_pos >= k_pos
         if window is not None:
             keep &= q_pos - k_pos < window
+    if q_seg is not None:
+        same = q_seg == jnp.swapaxes(k_seg, 0, 1)  # (BQ, BK)
+        keep = same if keep is None else keep & same
+    if keep is not None:
         s = jnp.where(keep, s, -jnp.inf)
     return s, scale
 
@@ -138,6 +145,42 @@ def _block_unmasked(qi, kj, block_q, block_k, q_start=0, k_start=0,
     return unmasked
 
 
+def _dispatch_block(attend, relevant, causal, unmasked, qseg_ref, kseg_ref):
+    """Emit the fast/masked branches for one block: ``attend(masked)``
+    is the kernel body, ``relevant`` gates blocks with any live pair
+    (python True when statically relevant), ``unmasked`` is the causal/
+    window interior condition (None when not causal). With segment ids,
+    a block stays on the fast path only when BOTH tiles are uniform in
+    the same segment (min==max reduces on the (B*, 1) id columns — far
+    cheaper than the (BQ, BK) mask they replace), so long packed
+    documents keep the interior-block win."""
+    if qseg_ref is not None:
+        q_seg, k_seg = qseg_ref[0], kseg_ref[0]
+        uniform = (
+            (jnp.min(q_seg) == jnp.max(q_seg))
+            & (jnp.min(k_seg) == jnp.max(k_seg))
+            & (jnp.min(q_seg) == jnp.min(k_seg))
+        )
+        unmasked = uniform if unmasked is None else unmasked & uniform
+    elif unmasked is None:
+        attend(masked=False)  # full attention, no segments: nothing masks
+        return
+    fast = unmasked if relevant is True else relevant & unmasked
+    slow = (
+        jnp.logical_not(unmasked)
+        if relevant is True
+        else relevant & jnp.logical_not(unmasked)
+    )
+
+    @pl.when(fast)
+    def _fast():
+        attend(masked=False)
+
+    @pl.when(slow)
+    def _masked():
+        attend(masked=True)
+
+
 def _window_base(qi, block_q: int, block_k: int, window: int):
     """First k block of q block ``qi``'s window band (may be negative —
     callers clamp for loads and skip the out-of-range steps)."""
@@ -163,11 +206,16 @@ def _k_band(nk_total: int, block_q: int, block_k: int, window: Optional[int]):
 
 
 def _flash_fwd_kernel(
-    q_start_ref, k_start_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-    acc_ref, m_ref, l_ref,
-    *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
+    q_start_ref, k_start_ref, q_ref, k_ref, v_ref, *rest,
+    block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
     nk_total: Optional[int] = None, permute_q: bool = False,
+    segments: bool = False,
 ):
+    if segments:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     if permute_q:
         qi = _balance_perm(qi, pl.num_programs(1))
@@ -207,6 +255,8 @@ def _flash_fwd_kernel(
         s, _ = _masked_scores(
             q, k, qi, kj, block_q, block_k, causal and masked, q_start, k_start,
             window,
+            q_seg=qseg_ref[0] if (segments and masked) else None,
+            k_seg=kseg_ref[0] if (segments and masked) else None,
         )
         m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
         l = l_ref[:, :1]
@@ -234,20 +284,16 @@ def _flash_fwd_kernel(
             l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
         )
 
-    if causal:
-        unmasked = _block_unmasked(
-            qi, kj, block_q, block_k, q_start, k_start, window
-        )
-
-        @pl.when(relevant & unmasked)
-        def _fast():
-            _attend(masked=False)
-
-        @pl.when(relevant & jnp.logical_not(unmasked))
-        def _masked():
-            _attend(masked=True)
-    else:
-        _attend(masked=False)
+    _dispatch_block(
+        _attend,
+        relevant,
+        causal,
+        _block_unmasked(qi, kj, block_q, block_k, q_start, k_start, window)
+        if causal
+        else None,
+        qseg_ref,
+        kseg_ref,
+    )
 
     @pl.when(t == nk - 1)
     def _finalize():
@@ -270,13 +316,15 @@ def _row_stat(ref):
 
 
 def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
-                  window: Optional[int] = None, masked: bool = True):
+                  window: Optional[int] = None, masked: bool = True,
+                  q_seg=None, k_seg=None):
     """``masked=False`` is the interior-block fast path: no mask
     construction and no lse guards — valid because a causal row always
     contains its diagonal key, so lse is finite wherever an unmasked
     block exists."""
     s, scale = _masked_scores(q, k, qi, kj, block_q, block_k,
-                              causal and masked, window=window)
+                              causal and masked, window=window,
+                              q_seg=q_seg, k_seg=k_seg)
     if not masked:
         return jnp.exp(s - lse), scale
     p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0))
@@ -286,10 +334,16 @@ def _recomputed_p(q, k, qi, kj, lse, block_q, block_k, causal,
 
 
 def _flash_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
     nk_total: Optional[int] = None, permute_q: bool = False,
+    segments: bool = False,
 ):
+    if segments:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     if permute_q:
         qi = _balance_perm(qi, pl.num_programs(1))
@@ -315,7 +369,9 @@ def _flash_dq_kernel(
         lse = _row_stat(lse_ref)
         delta = _row_stat(delta_ref)
         p, scale = _recomputed_p(
-            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked
+            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked,
+            q_seg=qseg_ref[0] if (segments and masked) else None,
+            k_seg=kseg_ref[0] if (segments and masked) else None,
         )
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -326,18 +382,14 @@ def _flash_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        unmasked = _block_unmasked(qi, kj, block_q, block_k, window=window)
-
-        @pl.when(relevant & unmasked)
-        def _fast():
-            _accumulate(masked=False)
-
-        @pl.when(relevant & jnp.logical_not(unmasked))
-        def _masked():
-            _accumulate(masked=True)
-    else:
-        _accumulate(masked=False)
+    _dispatch_block(
+        _accumulate,
+        relevant,
+        causal,
+        _block_unmasked(qi, kj, block_q, block_k, window=window) if causal else None,
+        qseg_ref,
+        kseg_ref,
+    )
 
     @pl.when(t == nk - 1)
     def _finalize():
@@ -345,11 +397,16 @@ def _flash_dq_kernel(
 
 
 def _flash_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-    *, block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q: int, block_k: int, causal: bool, q_blocks: Optional[int] = None,
     window: Optional[int] = None, nq_total: Optional[int] = None,
-    permute_kv: bool = False,
+    permute_kv: bool = False, segments: bool = False,
 ):
+    if segments:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        qseg_ref = kseg_ref = None
     kj = pl.program_id(1)
     if permute_kv:
         kj = _balance_perm(kj, pl.num_programs(1))
@@ -377,7 +434,9 @@ def _flash_dkv_kernel(
         lse = _row_stat(lse_ref)
         delta = _row_stat(delta_ref)
         p, scale = _recomputed_p(
-            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked
+            q, k, qi, kj, lse, block_q, block_k, causal, window, masked=masked,
+            q_seg=qseg_ref[0] if (segments and masked) else None,
+            k_seg=kseg_ref[0] if (segments and masked) else None,
         )
         # dV += Pᵀ dO
         dv_acc[:] = dv_acc[:] + lax.dot_general(
@@ -394,18 +453,14 @@ def _flash_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        unmasked = _block_unmasked(qi, kj, block_q, block_k, window=window)
-
-        @pl.when(relevant & unmasked)
-        def _fast():
-            _accumulate(masked=False)
-
-        @pl.when(relevant & jnp.logical_not(unmasked))
-        def _masked():
-            _accumulate(masked=True)
-    else:
-        _accumulate(masked=False)
+    _dispatch_block(
+        _accumulate,
+        relevant,
+        causal,
+        _block_unmasked(qi, kj, block_q, block_k, window=window) if causal else None,
+        qseg_ref,
+        kseg_ref,
+    )
 
     @pl.when(t == n_seq - 1)
     def _finalize():
@@ -451,7 +506,7 @@ def _kv_row(i, heads: int, kv_heads: int):
 def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
                    q_start=0, k_start=0, heads: Optional[int] = None,
                    kv_heads: Optional[int] = None,
-                   window: Optional[int] = None):
+                   window: Optional[int] = None, seg=None):
     bh_count, s, d = qb.shape
     sk = kb.shape[1]  # ring passes same-sized shards; unequal also works
     if window is not None and not (
@@ -502,12 +557,29 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     # rank-3 with a trailing singleton because the TPU lowering wants the
     # block's last two dims (8, 128)-divisible or equal to the array's
     lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, kj, *_: (i, q_block(j), 0))
+    in_specs = [q_spec, k_spec, k_spec]
+    inputs = [qb, kb, vb]
+    if seg is not None:
+        # segment-id columns (B, S, 1): per-batch, shared by every head
+        # of that batch; the k-side column rides the same diagonal clamp
+        # as the k/v loads
+        qseg_spec = pl.BlockSpec(
+            (1, block_q, 1), lambda i, j, t, *_: (i // heads, q_block(j), 0)
+        )
+
+        def kseg_index(i, j, t, qs_ref, ks_ref):
+            # same block walk (and diagonal clamp) as the k/v tiles —
+            # composed on k_index so the two can never drift
+            return (i // heads,) + k_index(i, j, t, qs_ref, ks_ref)[1:]
+
+        in_specs += [qseg_spec, pl.BlockSpec((1, block_k, 1), kseg_index)]
+        inputs += [seg, seg]
     # global sequence offsets ride scalar prefetch (SMEM) so the ring can
     # pass traced per-step origins; zeros for plain within-array attention
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[q_spec, k_spec, k_spec],
+        in_specs=in_specs,
         out_specs=(q_spec, lse_spec),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # acc
@@ -518,7 +590,7 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     return pl.pallas_call(
         partial(_flash_fwd_kernel, block_q=block_q, block_k=block_k,
                 causal=causal, window=window, nk_total=nk_total,
-                permute_q=permute_q),
+                permute_q=permute_q, segments=seg is not None),
         out_shape=(
             jax.ShapeDtypeStruct(qb.shape, qb.dtype),
             jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
@@ -528,9 +600,7 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     )(
         jnp.reshape(jnp.asarray(q_start, jnp.int32), (1,)),
         jnp.reshape(jnp.asarray(k_start, jnp.int32), (1,)),
-        qb,
-        kb,
-        vb,
+        *inputs,
     )
 
 
@@ -554,6 +624,44 @@ def _flash_core_fwd(qb, kb, vb, causal, block_q, block_k, heads, kv_heads, windo
 
 def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals, g):
     qb, kb, vb, out, lse = residuals
+    return _flash_bwd_impl(
+        qb, kb, vb, out, lse, g, causal, block_q, block_k, heads, kv_heads, window
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core_seg(qb, kb, vb, seg, causal: bool, block_q: int, block_k: int,
+                    heads: int, kv_heads: int, window: Optional[int] = None):
+    """Segment-id (packed-sequence) sibling of ``_flash_core``: ``seg``
+    is a traced (B, S, 1) int32 array, so it rides the VJP as a regular
+    argument and gets a float0 cotangent (integers carry no gradient)."""
+    out, _ = _flash_forward(
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads,
+        window=window, seg=seg,
+    )
+    return out
+
+
+def _flash_core_seg_fwd(qb, kb, vb, seg, causal, block_q, block_k, heads, kv_heads, window):
+    out, lse = _flash_forward(
+        qb, kb, vb, causal, block_q, block_k, heads=heads, kv_heads=kv_heads,
+        window=window, seg=seg,
+    )
+    return out, (qb, kb, vb, seg, out, lse)
+
+
+def _flash_core_seg_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals, g):
+    qb, kb, vb, seg, out, lse = residuals
+    dq, dk, dv = _flash_bwd_impl(
+        qb, kb, vb, out, lse, g, causal, block_q, block_k, heads, kv_heads,
+        window, seg=seg,
+    )
+    dseg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
+                    heads, kv_heads, window, seg=None):
     bh_count, s, d = qb.shape
     group = heads // kv_heads
     interpret = jax.devices()[0].platform != "tpu"
@@ -581,17 +689,28 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
 
     k_spec = pl.BlockSpec((1, block_k, d), dq_k_index)
     row_spec = pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i, q_block(j), 0))
+    dq_in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    dq_inputs = [qb, kb, vb, g, lse, delta]
+    if seg is not None:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda i, j, t: (i // heads, q_block(j), 0)),
+            pl.BlockSpec(
+                (1, block_k, 1),
+                lambda i, j, t: (i // heads,) + dq_k_index(i, j, t)[1:],
+            ),
+        ]
+        dq_inputs += [seg, seg]
     dq = pl.pallas_call(
         partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
                 causal=causal, window=window, nk_total=nk_total,
-                permute_q=permute_q),
+                permute_q=permute_q, segments=seg is not None),
         out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
         grid=(bh_count, nq, nk_band),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
-    )(qb, kb, vb, g, lse, delta)
+    )(*dq_inputs)
     # dK/dV: kv rows own the grid; the sequential axis enumerates every
     # (group member, banded q block) pair that attends this KV head
     kvbh = kb.shape[0]
@@ -633,6 +752,19 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
     kq_row_spec = pl.BlockSpec(
         (1, block_q, 1), lambda i, kj, t: (q_row(i, t), dkv_q_index(kj, t), 0)
     )
+    dkv_in_specs = [kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec]
+    dkv_inputs = [qb, kb, vb, g, lse, delta]
+    if seg is not None:
+        dkv_in_specs += [
+            pl.BlockSpec(
+                (1, block_q, 1),
+                lambda i, kj, t: (i // kv_heads, dkv_q_index(kj, t), 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, 1), lambda i, kj, t: (i // kv_heads, kv_block(kj), 0)
+            ),
+        ]
+        dkv_inputs += [seg, seg]
     dk, dv = pl.pallas_call(
         partial(
             _flash_dkv_kernel,
@@ -643,24 +775,26 @@ def _flash_core_bwd(causal, block_q, block_k, heads, kv_heads, window, residuals
             window=window,
             nq_total=nq,
             permute_kv=permute_kv,
+            segments=seg is not None,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(kb.shape, kb.dtype),
             jax.ShapeDtypeStruct(vb.shape, vb.dtype),
         ),
         grid=(kvbh, nk_total, nq_band * group),
-        in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec],
+        in_specs=dkv_in_specs,
         out_specs=(kq_k_spec, kq_k_spec),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),  # dk acc
             pltpu.VMEM((block_k, d), jnp.float32),  # dv acc
         ],
         **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
-    )(qb, kb, vb, g, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+_flash_core_seg.defvjp(_flash_core_seg_fwd, _flash_core_seg_bwd)
 
 
 def flash_attention(
@@ -671,6 +805,7 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     window: Optional[int] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q: (B, S, H, D); k/v: (B, S, H_kv, D) with H_kv dividing H — the
     burn-in/ring layout, grouped-query attention when H_kv < H (query
@@ -682,7 +817,11 @@ def flash_attention(
     ``window`` positions (sliding-window/local attention, causal only):
     forward and backward all walk banded grids — only the window's
     blocks are ever loaded, so fwd and fwd+bwd both cost O(S·window),
-    not O(S²)."""
+    not O(S²). ``segment_ids`` (B, S) int restricts attention to
+    same-segment pairs — packed-sequence training, the standard way to
+    batch variable-length documents; composes with causal, GQA, and
+    window, and every block takes the masked path (a segment boundary
+    can fall anywhere)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
@@ -698,7 +837,19 @@ def flash_attention(
         # shorter k/v would silently read clamped (wrong) tiles
         raise ValueError(f"k/v seq_len {k.shape[1]} must equal q's ({s})")
     qb, kb, vb, h, h_kv = _collapse_heads(q, k, v)
-    out = _flash_core(qb, kb, vb, causal, block_q, block_k, h, h_kv, window)
+    if segment_ids is not None:
+        if segment_ids.shape != (b, s):
+            raise ValueError(
+                f"segment_ids must be (batch, seq) = {(b, s)}, got {segment_ids.shape}"
+            )
+        if not jnp.issubdtype(segment_ids.dtype, jnp.integer):
+            raise ValueError(f"segment_ids must be integral, got {segment_ids.dtype}")
+        seg = segment_ids.astype(jnp.int32)[:, :, None]  # (B, S, 1)
+        out = _flash_core_seg(
+            qb, kb, vb, seg, causal, block_q, block_k, h, h_kv, window
+        )
+    else:
+        out = _flash_core(qb, kb, vb, causal, block_q, block_k, h, h_kv, window)
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
@@ -762,12 +913,30 @@ def run_flash_attention_check(
     )
     if not np.isfinite(err) or err > 2e-2:
         raise RuntimeError(f"flash attention diverges from dense: max_abs_err={err}")
+    # packed sequences: two segments with the boundary mid-block — the
+    # masked path must hold exactness through the segment compare too
+    cut = seq_len // 2 + seq_len // 8
+    seg = jnp.broadcast_to(
+        (jnp.arange(seq_len) >= cut).astype(jnp.int32), (batch, seq_len)
+    )
+    got_seg = flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k, segment_ids=seg
+    )
+    want_seg = dense_attention(q, k, v, causal=causal, segment_ids=seg)
+    seg_err = float(
+        jnp.max(jnp.abs(got_seg.astype(jnp.float32) - want_seg.astype(jnp.float32)))
+    )
+    if not np.isfinite(seg_err) or seg_err > 2e-2:
+        raise RuntimeError(
+            f"packed-sequence flash diverges from dense: max_abs_err={seg_err}"
+        )
     return {
         "seq_len": seq_len,
         "block_q": block_q,
         "block_k": block_k,
         "causal": causal,
         "max_abs_err": err,
+        "segment_max_abs_err": seg_err,
         "ok": True,
     }
 

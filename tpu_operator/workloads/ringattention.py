@@ -159,14 +159,20 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = Tr
     return jax.jit(fn)(q, k, v)
 
 
-def dense_attention(q, k, v, causal: bool = True):
-    """Reference O(S^2) attention for correctness checks."""
+def dense_attention(q, k, v, causal: bool = True, segment_ids=None):
+    """Reference O(S^2) attention for correctness checks.
+    ``segment_ids`` (B, S) restricts attention to same-segment pairs
+    (packed sequences); a position always attends itself, so no row is
+    ever fully masked."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         s = q.shape[1]
         mask = jnp.tril(jnp.ones((s, s), dtype=bool))
         scores = jnp.where(mask, scores, -jnp.inf)
+    if segment_ids is not None:
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B, Q, K)
+        scores = jnp.where(same[:, None, :, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)
 
