@@ -697,6 +697,30 @@ class TestFlashAttention:
         want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), rep(v))
         assert float(jnp.max(jnp.abs(got - want))) < 1e-4
 
+    def test_unequal_length_causal_lse(self):
+        """The ring's forward-only entry point allows q longer than k/v.
+        That shape must NEVER take the flattened-triangle walk (whose
+        finalize condition is unreachable for q rows past the k range —
+        their output blocks would stay unwritten garbage); the guard
+        keeps it on the rectangular path."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from tpu_operator.workloads.flashattention import flash_attention_with_lse
+
+        keys = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = jax.random.normal(keys[0], (1, 512, 2, 64), dtype=jnp.float32)
+        k = jax.random.normal(keys[1], (1, 256, 2, 64), dtype=jnp.float32)
+        v = jax.random.normal(keys[2], (1, 256, 2, 64), dtype=jnp.float32)
+        out, _ = flash_attention_with_lse(q, k, v, causal=True, block_q=256, block_k=256)
+        scale = 1 / np.sqrt(64.0)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.arange(512)[:, None] >= jnp.arange(256)[None, :]
+        sc = jnp.where(mask[None, None], sc, -jnp.inf)
+        want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(sc, axis=-1), v)
+        assert float(jnp.max(jnp.abs(out - want))) < 1e-4
+
     def test_segment_ids_validation(self):
         import jax.numpy as jnp
 
@@ -722,6 +746,32 @@ class TestFlashAttention:
         dense = run_burnin(mesh=mesh, cfg=BurninConfig(**kwargs))
         assert flash["ok"] and dense["ok"]
         assert abs(flash["losses"][0] - dense["losses"][0]) < 2e-2
+
+    def test_burnin_trains_packed_sequences(self):
+        """Packed-sequence training end to end: the burn-in transformer
+        with packed_segments runs the kernel's segment_ids path under
+        shard_map and trains to a finite, decreasing-ish loss; its first
+        loss DIFFERS from unpacked flash (the mask really changed)."""
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh, run_burnin
+
+        kwargs = dict(d_model=128, n_heads=2, d_ff=256, seq_len=128, batch=8, n_layers=1)
+        mesh = make_mesh(data=4, model=2)
+        packed = run_burnin(
+            mesh=mesh,
+            cfg=BurninConfig(use_flash_attention=True, packed_segments=4, **kwargs),
+        )
+        plain = run_burnin(mesh=mesh, cfg=BurninConfig(use_flash_attention=True, **kwargs))
+        assert packed["ok"]
+        assert abs(packed["losses"][0] - plain["losses"][0]) > 1e-5
+
+    def test_burnin_packed_requires_flash(self):
+        from tpu_operator.workloads.burnin import BurninConfig, build_train_step, make_mesh
+
+        with pytest.raises(ValueError, match="packed_segments"):
+            build_train_step(
+                make_mesh(data=4, model=2),
+                BurninConfig(seq_len=128, packed_segments=4),
+            )
 
     def test_burnin_flash_config_validation(self):
         from tpu_operator.workloads.burnin import (

@@ -50,6 +50,11 @@ class BurninConfig:
     # local attention instead of the dense einsum path — requires
     # 128-aligned seq_len; differentiable via its custom VJP
     use_flash_attention: bool = False
+    # >0 trains on synthetic PACKED sequences: the seq axis is split into
+    # this many documents and attention stays within each (the kernel's
+    # segment_ids path — how production pretraining batches variable-
+    # length data). Requires use_flash_attention.
+    packed_segments: int = 0
     # >0 replaces the dense FFN with a top-1 routed mixture of experts
     # sharded over an 'ep' mesh axis (GShard-style one-hot dispatch — the
     # canonical TPU MoE formulation: XLA lowers the dispatch/combine
@@ -179,28 +184,42 @@ def _ring_ctx(q, k, v, mesh: Mesh):
     return fn(q, k, v)
 
 
-def _flash_ctx(q, k, v, mesh: Optional[Mesh]):
+def _flash_ctx(q, k, v, mesh: Optional[Mesh], packed: int = 0):
     """Local attention via the pallas flash kernel. A pallas_call does not
     partition under pjit by itself, so on a mesh it runs under shard_map —
     batch stays on 'data', heads on 'model', each shard running the kernel
-    on its local slice (the custom VJP differentiates through shard_map)."""
+    on its local slice (the custom VJP differentiates through shard_map).
+    ``packed`` > 0 splits the sequence into that many equal documents via
+    the kernel's segment_ids path (packed-sequence training)."""
     from tpu_operator.workloads.flashattention import flash_attention
 
     s = q.shape[1]
     block = min(s, 256 if s % 256 == 0 else 128)
+    seg = None
+    if packed:
+        seg = jnp.broadcast_to(
+            (jnp.arange(s) * packed // s).astype(jnp.int32), (q.shape[0], s)
+        )
 
-    def local(a, b, c):
-        return flash_attention(a, b, c, causal=True, block_q=block, block_k=block)
+    def local(a, b, c, sg=None):
+        return flash_attention(
+            a, b, c, causal=True, block_q=block, block_k=block, segment_ids=sg
+        )
 
     if mesh is None:
-        return local(q, k, v)
+        return local(q, k, v, seg)
     model = "model" if "model" in mesh.axis_names else None
     spec = P("data", None, model, None)
+    in_specs = (spec,) * 3
+    args = (q, k, v)
+    if seg is not None:
+        in_specs += (P("data", None),)  # ids replicate over 'model'
+        args += (seg,)
     # check_vma off: pallas_call's ShapeDtypeStruct outputs carry no vma
     # annotation, which the shard_map varying-axis checker insists on
     return shard_map(
-        local, mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False
-    )(q, k, v)
+        local, mesh=mesh, in_specs=in_specs, out_specs=spec, check_vma=False
+    )(*args)
 
 
 def _moe_ffn(params, layer: int, y, cfg: BurninConfig, mesh: Optional[Mesh] = None):
@@ -259,7 +278,7 @@ def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None
     if cfg.sequence_parallel:
         ctx = _ring_ctx(q, k, v, mesh)
     elif cfg.use_flash_attention:
-        ctx = _flash_ctx(q, k, v, mesh)
+        ctx = _flash_ctx(q, k, v, mesh, packed=cfg.packed_segments)
     else:
         ctx = _dense_ctx(q, k, v, d // h)
     ctx = ctx.reshape(b, s, d)
@@ -310,6 +329,15 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
                 f"use_flash_attention: n_heads ({cfg.n_heads}) must divide "
                 f"over the 'model' axis ({axes.get('model', 1)})"
             )
+    if cfg.packed_segments and not cfg.use_flash_attention:
+        raise ValueError(
+            "packed_segments rides the flash kernel's segment_ids path — "
+            "set use_flash_attention"
+        )
+    if cfg.packed_segments and cfg.packed_segments > cfg.seq_len:
+        raise ValueError(
+            f"packed_segments ({cfg.packed_segments}) exceeds seq_len ({cfg.seq_len})"
+        )
     if cfg.moe_experts and "ep" not in mesh.axis_names:
         raise ValueError("moe_experts needs an 'ep' mesh axis (make_mesh_4d)")
     if cfg.moe_experts and cfg.moe_experts % mesh.shape.get("ep", 1):

@@ -98,10 +98,14 @@ def _block_relevant(qi, kj, block_q, block_k, causal, q_start=0, k_start=0,
 # A/Bs them). The balance permutation pays off where the parallel axis
 # carries triangular work — the forward and dQ grids; the dK/dV grid
 # measured slightly WORSE permuted (its sequential q walk already evens
-# out cross-kj variation), so it stays in natural order.
+# out cross-kj variation), so it stays in natural order. _TRIANGLE_FWD
+# flattens the plain-causal forward's (q block, k block) rectangle into
+# a 1-D walk of ONLY the lower-triangle pairs (walk tables ride scalar
+# prefetch): zero bubble steps, megacore split on the uniform bh axis.
 _PERMUTE_FWD = True
 _PERMUTE_DQ = True
 _PERMUTE_DKV = False
+_TRIANGLE_FWD = True
 
 
 def _balance_perm(j, n: int):
@@ -145,7 +149,7 @@ def _block_unmasked(qi, kj, block_q, block_k, q_start=0, k_start=0,
     return unmasked
 
 
-def _dispatch_block(attend, relevant, causal, unmasked, qseg_ref, kseg_ref):
+def _dispatch_block(attend, relevant, unmasked, qseg_ref, kseg_ref):
     """Emit the fast/masked branches for one block: ``attend(masked)``
     is the kernel body, ``relevant`` gates blocks with any live pair
     (python True when statically relevant), ``unmasked`` is the causal/
@@ -205,6 +209,93 @@ def _k_band(nk_total: int, block_q: int, block_k: int, window: Optional[int]):
     return n_band, k_block
 
 
+def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool):
+    """One online-softmax accumulation step over a score tile — shared by
+    the rectangular and flattened-triangle forward kernels. ``masked``
+    keeps the -inf guards; the fast path drops them (every pair live:
+    blk_max and so new_m are finite, and exp(-inf - new_m) = 0 covers a
+    still-empty m on its own)."""
+    m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
+    l = l_ref[:, :1]
+    blk_max = jnp.max(s, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    if masked:
+        # fully-masked rows (block_q > block_k diagonals) keep m at
+        # -inf: exp(-inf - -inf) must yield 0, not nan
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+    else:
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+    pv = lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[:] = acc_ref[:] * correction + pv
+    m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(
+        l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+
+
+def _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    """Write the normalized output block + logsumexp from the running
+    (acc, m, l) state — shared by both forward kernels."""
+    l = l_ref[:, :1]
+    # rows with no valid key (defensive): l == 0 -> emit 0, not inf
+    o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+    m = m_ref[:, :1]
+    lse = jnp.where(
+        (l > 0.0) & jnp.isfinite(m), m + jnp.log(jnp.where(l > 0.0, l, 1.0)), -jnp.inf
+    )
+    lse_ref[0] = lse  # (BQ, 1) slice of the (BH, S, 1) stat array
+
+
+def _flash_fwd_tri_kernel(
+    qi_tab_ref, kj_tab_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int,
+):
+    """Flattened-triangle causal forward: the 1-D sequential axis walks
+    ONLY the lower-triangle (q block, k block) pairs via prefetched
+    tables, so every grid step moves data and computes — no bubbles, and
+    the megacore split falls on the uniform bh axis. Plain causal only
+    (no window/segments/ring offsets — those keep the rectangular
+    kernel)."""
+    t = pl.program_id(1)
+    qi = qi_tab_ref[t]
+    kj = kj_tab_ref[t]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # only the diagonal-straddling block of each row needs the mask
+    unmasked = (qi * block_q) >= ((kj + 1) * block_k - 1)
+
+    @pl.when(unmasked)
+    def _fast():
+        s, _ = _masked_scores(
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, causal=False
+        )
+        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=False)
+
+    @pl.when(jnp.logical_not(unmasked))
+    def _masked():
+        s, _ = _masked_scores(
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, causal=True
+        )
+        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=True)
+
+    @pl.when(kj == ((qi + 1) * block_q - 1) // block_k)
+    def _done():
+        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref)
+
+
 def _flash_fwd_kernel(
     q_start_ref, k_start_ref, q_ref, k_ref, v_ref, *rest,
     block_q: int, block_k: int, causal: bool, window: Optional[int] = None,
@@ -249,45 +340,17 @@ def _flash_fwd_kernel(
     )
 
     def _attend(masked: bool):
-        q = q_ref[0]  # (BQ, D)
-        k = k_ref[0]  # (BK, D)
-        v = v_ref[0]
         s, _ = _masked_scores(
-            q, k, qi, kj, block_q, block_k, causal and masked, q_start, k_start,
-            window,
+            q_ref[0], k_ref[0], qi, kj, block_q, block_k, causal and masked,
+            q_start, k_start, window,
             q_seg=qseg_ref[0] if (segments and masked) else None,
             k_seg=kseg_ref[0] if (segments and masked) else None,
         )
-        m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
-        l = l_ref[:, :1]
-        blk_max = jnp.max(s, axis=-1, keepdims=True)
-        new_m = jnp.maximum(m, blk_max)
-        if masked:
-            # fully-masked rows (block_q > block_k diagonals) keep m at
-            # -inf: exp(-inf - -inf) must yield 0, not nan
-            safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-            correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
-            p = jnp.exp(s - safe_m)
-            p = jnp.where(jnp.isneginf(s), 0.0, p)
-        else:
-            # every pair live: blk_max (and so new_m) is finite, and
-            # exp(-inf - new_m) = 0 covers a still-empty m on its own
-            correction = jnp.exp(m - new_m)
-            p = jnp.exp(s - new_m)
-        pv = lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] = acc_ref[:] * correction + pv
-        m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(
-            l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-        )
+        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked)
 
     _dispatch_block(
         _attend,
         relevant,
-        causal,
         _block_unmasked(qi, kj, block_q, block_k, q_start, k_start, window)
         if causal
         else None,
@@ -297,14 +360,7 @@ def _flash_fwd_kernel(
 
     @pl.when(t == nk - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        # rows with no valid key (defensive): l == 0 -> emit 0, not inf
-        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
-        m = m_ref[:, :1]
-        lse = jnp.where(
-            (l > 0.0) & jnp.isfinite(m), m + jnp.log(jnp.where(l > 0.0, l, 1.0)), -jnp.inf
-        )
-        lse_ref[0] = lse  # (BQ, 1) slice of the (BH, S, 1) stat array
+        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref)
 
 
 def _row_stat(ref):
@@ -385,7 +441,6 @@ def _flash_dq_kernel(
     _dispatch_block(
         _accumulate,
         relevant,
-        causal,
         _block_unmasked(qi, kj, block_q, block_k, window=window) if causal else None,
         qseg_ref,
         kseg_ref,
@@ -456,7 +511,6 @@ def _flash_dkv_kernel(
     _dispatch_block(
         _accumulate,
         relevant,
-        causal,
         _block_unmasked(qi, kj, block_q, block_k, window=window) if causal else None,
         qseg_ref,
         kseg_ref,
@@ -503,6 +557,52 @@ def _kv_row(i, heads: int, kv_heads: int):
     return (i // heads) * kv_heads + (i % heads) // group
 
 
+def _flash_forward_triangle(qb, kb, vb, block_q: int, block_k: int,
+                            heads: int, kv_heads: int, interpret: bool):
+    """Plain-causal forward over a flattened lower-triangle walk: grid
+    (bh, T) where T enumerates exactly the causally-relevant (q block,
+    k block) pairs in row-major order via prefetched walk tables —
+    every step loads and computes, the rectangle's above-diagonal
+    bubbles never exist, and the megacore parallel split lands on the
+    uniform bh axis."""
+    bh_count, s, d = qb.shape
+    nq = s // block_q
+    nk_total = kb.shape[1] // block_k
+    tab_qi, tab_kj = [], []
+    for qi in range(nq):
+        for kj in range(min(nk_total - 1, ((qi + 1) * block_q - 1) // block_k) + 1):
+            tab_qi.append(qi)
+            tab_kj.append(kj)
+    qi_tab = jnp.asarray(tab_qi, jnp.int32)
+    kj_tab = jnp.asarray(tab_kj, jnp.int32)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, t, qit, kjt: (i, qit[t], 0))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d),
+        lambda i, t, qit, kjt: (_kv_row(i, heads, kv_heads), kjt[t], 0),
+    )
+    lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, t, qit, kjt: (i, qit[t], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh_count, len(tab_qi)),
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=(q_spec, lse_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (col 0)
+        ],
+    )
+    return pl.pallas_call(
+        partial(_flash_fwd_tri_kernel, block_q=block_q, block_k=block_k),
+        out_shape=(
+            jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+            jax.ShapeDtypeStruct((bh_count, s, 1), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        **_pallas_kwargs(interpret, ("parallel", "arbitrary")),
+    )(qi_tab, kj_tab, qb, kb, vb)
+
+
 def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
                    q_start=0, k_start=0, heads: Optional[int] = None,
                    kv_heads: Optional[int] = None,
@@ -520,6 +620,21 @@ def _flash_forward(qb, kb, vb, causal: bool, block_q: int, block_k: int,
     kv_heads = kv_heads or heads
     interpret = jax.devices()[0].platform != "tpu"
     nk_total = sk // block_k
+    plain_causal = (
+        causal
+        and window is None
+        and seg is None
+        and sk == s  # triangle tables assume one square diagonal: a q
+        # row past the k range would never hit the kernel's finalize and
+        # its output block would stay unwritten (the ring's unequal-length
+        # calls keep the rectangular walk)
+        and isinstance(q_start, int) and q_start == 0
+        and isinstance(k_start, int) and k_start == 0
+    )
+    if plain_causal and _TRIANGLE_FWD:
+        return _flash_forward_triangle(
+            qb, kb, vb, block_q, block_k, heads, kv_heads, interpret
+        )
     # banded grid: q block j needs keys in [j·BQ−W+1, (j+1)·BQ−1] — a
     # fixed number of k blocks regardless of S, so a 32k sequence with a
     # 4k window LOADS O(W) keys per q block, not O(S)
