@@ -228,6 +228,18 @@ def tpu_details() -> dict:
                     fa.get("train_step_speedup_vs_remat_dense", 0.0), 2
                 ),
             }
+            # long-context scaling: the kernel's achieved rate RISES with
+            # sequence length (diagonal over-compute amortizes; the
+            # triangle walk has no bubbles to grow)
+            scaling = {}
+            for s_len in (16384, 32768):
+                fs = flash_attention_bench(seq_len=s_len, heads=8, iters=4, reps=3)
+                scaling[f"{s_len // 1024}k"] = {
+                    "time_ms": round(fs["flash_time_ms"], 2),
+                    "tflops": round(fs["flash_tflops"], 1),
+                    "fwd_bwd_ms": round(fs["flash_fwd_bwd_ms"], 2),
+                }
+            details["flash_attention_scaling"] = scaling
 
             from tpu_operator.workloads.allreduce import run_allreduce
 
