@@ -263,6 +263,17 @@ class TestMetricsExporterAgent:
         # no passive duty-cycle gauge survives: it had no source anywhere
         assert "tpu_exporter_duty_cycle" not in values
 
+    def test_ici_probe_populates_on_multichip(self):
+        """The ICI bus-bandwidth gauge (NVLink-counter analog) must
+        populate whenever the node has >1 chip — here the 8-device CPU
+        test mesh proves the plumbing; the value only means ICI on real
+        hardware."""
+        agent = MetricsExporterAgent(node_name="tpu-0")
+        agent.probe_ici()
+        values = {m.name: {tuple(sorted(s.labels.items())): s.value for s in m.samples}
+                  for m in agent.registry.collect()}
+        assert values["tpu_exporter_ici_bandwidth_gbps"][(("node", "tpu-0"),)] > 0
+
 
 class TestNative:
     def test_probe_shape(self):

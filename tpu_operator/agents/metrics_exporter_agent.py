@@ -10,6 +10,10 @@ DCGM_FI_DEV_*):
                                       memory_stats via the jax runtime)
     tpu_exporter_hbm_limit_bytes      per-chip HBM capacity
     tpu_exporter_hbm_bandwidth_gbps   measured pallas-triad HBM bandwidth
+    tpu_exporter_ici_bandwidth_gbps   measured psum all-reduce bus
+                                      bandwidth per chip (multi-chip
+                                      hosts only — the NVLink/DCGM
+                                      counter analog; absent on 1 chip)
     tpu_exporter_matmul_tflops        measured bf16 matmul throughput
     tpu_exporter_mxu_utilization_pct  matmul_tflops / generation peak
 
@@ -83,6 +87,12 @@ class MetricsExporterAgent:
             ["node"],
             registry=self.registry,
         )
+        self.ici_bandwidth = prometheus_client.Gauge(
+            "tpu_exporter_ici_bandwidth_gbps",
+            "Measured psum all-reduce bus bandwidth per chip (multi-chip hosts)",
+            ["node"],
+            registry=self.registry,
+        )
         self.matmul_tflops = prometheus_client.Gauge(
             "tpu_exporter_matmul_tflops",
             "Measured bf16 matmul throughput",
@@ -135,6 +145,27 @@ class MetricsExporterAgent:
             self.hbm_bandwidth.labels(self.node_name).set(report["bandwidth_gbps"])
         except Exception as e:  # noqa: BLE001
             self._probe_failed("bandwidth", e)
+
+    def probe_ici(self) -> None:
+        """Active inter-chip probe — chained psum all-reduce over every
+        local chip — for achieved ICI bus bandwidth per chip (the
+        NVLink-counter analog; DCGM reads passive counters, TPUs expose
+        none here). Single-chip nodes have no ICI: the gauge stays
+        absent rather than reporting a loopback artifact."""
+        try:
+            import jax
+
+            devices = jax.local_devices()
+            if len(devices) < 2:
+                return
+            from tpu_operator.workloads.allreduce import run_allreduce
+
+            ar = run_allreduce(sizes_mb=(16,), iters=10, devices=devices)
+            self.ici_bandwidth.labels(self.node_name).set(
+                ar["peak_busbw_gbps_per_chip"]
+            )
+        except Exception as e:  # noqa: BLE001
+            self._probe_failed("ici", e)
 
     def probe_utilization(self) -> None:
         """Active compute probe: achieved bf16 matmul TFLOP/s (and % of the
@@ -191,6 +222,7 @@ class MetricsExporterAgent:
             ):
                 self.probe_bandwidth()
                 self.probe_utilization()
+                self.probe_ici()
                 last_probe = now
             self._stop.wait(self.interval)
 
