@@ -51,9 +51,11 @@ class BurninConfig:
     # 128-aligned seq_len; differentiable via its custom VJP
     use_flash_attention: bool = False
     # >0 trains on synthetic PACKED sequences: the seq axis is split into
-    # this many documents and attention stays within each (the kernel's
-    # segment_ids path — how production pretraining batches variable-
-    # length data). Requires use_flash_attention.
+    # this many documents and attention stays within each — how
+    # production pretraining batches variable-length data. Rides the
+    # flash kernel's segment_ids path (use_flash_attention) or the
+    # ring's circulating ids (sequence_parallel; documents may span
+    # sp shards).
     packed_segments: int = 0
     # >0 replaces the dense FFN with a top-1 routed mixture of experts
     # sharded over an 'ep' mesh axis (GShard-style one-hot dispatch — the
@@ -166,22 +168,39 @@ def _dense_ctx(q, k, v, d_head):
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
 
-def _ring_ctx(q, k, v, mesh: Mesh):
+def _packed_ids(batch: int, s: int, packed: int):
+    """Synthetic packed-document segment ids: the sequence split into
+    ``packed`` equal documents — ONE definition shared by the ring and
+    flash paths so the two can never train on different layouts."""
+    return jnp.broadcast_to(
+        (jnp.arange(s) * packed // s).astype(jnp.int32), (batch, s)
+    )
+
+
+def _ring_ctx(q, k, v, mesh: Mesh, packed: int = 0):
     """Sequence-parallel attention: ring over 'sp', heads stay sharded over
     'model', batch over 'data' — each mesh axis keeps its role and the
-    ring's ppermute rides the sp axis of the ICI mesh."""
+    ring's ppermute rides the sp axis of the ICI mesh. ``packed`` > 0
+    splits the sequence into that many documents via circulating segment
+    ids (packed-sequence training ACROSS chips: documents may span sp
+    shards)."""
     from functools import partial as _partial
 
     from tpu_operator.workloads.ringattention import _ring_attention_local
 
     spec = P("data", "sp", "model", None)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    if packed:
+        in_specs += (P("data", "sp"),)  # ids shard with the sequence
+        args += (_packed_ids(q.shape[0], q.shape[1], packed),)
     fn = shard_map(
         _partial(_ring_attention_local, axis_name="sp", causal=True),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(*args)
 
 
 def _flash_ctx(q, k, v, mesh: Optional[Mesh], packed: int = 0):
@@ -195,11 +214,7 @@ def _flash_ctx(q, k, v, mesh: Optional[Mesh], packed: int = 0):
 
     s = q.shape[1]
     block = min(s, 256 if s % 256 == 0 else 128)
-    seg = None
-    if packed:
-        seg = jnp.broadcast_to(
-            (jnp.arange(s) * packed // s).astype(jnp.int32), (q.shape[0], s)
-        )
+    seg = _packed_ids(q.shape[0], s, packed) if packed else None
 
     def local(a, b, c, sg=None):
         return flash_attention(
@@ -276,7 +291,7 @@ def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None
     k = k.reshape(b, s, h, d // h)
     v = v.reshape(b, s, h, d // h)
     if cfg.sequence_parallel:
-        ctx = _ring_ctx(q, k, v, mesh)
+        ctx = _ring_ctx(q, k, v, mesh, packed=cfg.packed_segments)
     elif cfg.use_flash_attention:
         ctx = _flash_ctx(q, k, v, mesh, packed=cfg.packed_segments)
     else:
@@ -329,10 +344,11 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
                 f"use_flash_attention: n_heads ({cfg.n_heads}) must divide "
                 f"over the 'model' axis ({axes.get('model', 1)})"
             )
-    if cfg.packed_segments and not cfg.use_flash_attention:
+    if cfg.packed_segments and not (cfg.use_flash_attention or cfg.sequence_parallel):
         raise ValueError(
-            "packed_segments rides the flash kernel's segment_ids path — "
-            "set use_flash_attention"
+            "packed_segments needs a segment-aware attention path — set "
+            "use_flash_attention (within-chip kernel) or sequence_parallel "
+            "(ids circulate the ring)"
         )
     if cfg.packed_segments and cfg.packed_segments > cfg.seq_len:
         raise ValueError(

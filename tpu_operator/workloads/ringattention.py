@@ -32,17 +32,27 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 
-def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state):
+def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
+                  q_seg=None, k_seg=None):
     """Accumulate attention of local q against one K/V block using the
-    online-softmax recurrence. state = (acc, row_sum, row_max)."""
+    online-softmax recurrence. state = (acc, row_sum, row_max).
+    ``q_seg``/``k_seg`` (B, Sq)/(B, Sk) restrict attention to same-
+    segment pairs — the k-side ids circulate the ring with their K/V
+    block, so packed documents can span shard boundaries."""
     acc, row_sum, row_max = state
     scale = 1.0 / np.sqrt(q.shape[-1])
     # (B, H, Sq, Sk)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    keep = None
     if causal:
         q_pos = q_block_idx * s_local + jnp.arange(s_local)[:, None]
         k_pos = kv_block_idx * s_local + jnp.arange(s_local)[None, :]
-        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+        keep = (q_pos >= k_pos)[None, None]  # (1, 1, Sq, Sk)
+    if q_seg is not None:
+        same = (q_seg[:, :, None] == k_seg[:, None, :])[:, None]  # (B, 1, Sq, Sk)
+        keep = same if keep is None else keep & same
+    if keep is not None:
+        scores = jnp.where(keep, scores, -jnp.inf)
     blk_max = jnp.max(scores, axis=-1)  # (B, H, Sq)
     new_max = jnp.maximum(row_max, blk_max)
     # guard fully-masked rows: exp(-inf - -inf) paths must yield 0, not nan
@@ -56,8 +66,12 @@ def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state):
     return new_acc, new_sum, new_max
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
-    """Per-device body under shard_map. q/k/v: (B, S_local, H, D)."""
+def _ring_attention_local(q, k, v, seg=None, *, axis_name: str, causal: bool):
+    """Per-device body under shard_map. q/k/v: (B, S_local, H, D);
+    ``seg`` (B, S_local) packed-sequence ids — the local shard's ids
+    serve the q side while a COPY circulates the ring with its K/V
+    block, so cross-shard same-document attention still connects and
+    cross-document attention is masked even across chips."""
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -70,20 +84,24 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     row_base = jnp.sum(qf, axis=3).transpose(0, 2, 1) * 0.0  # (b, h, s_local)
     row_sum = row_base
     row_max = row_base - jnp.inf
+    k_seg0 = seg if seg is not None else jnp.zeros((b, 0), jnp.int32)
 
     def step(t, carry):
-        k_blk, v_blk, state = carry
+        k_blk, v_blk, k_seg, state = carry
         kv_idx = (my_idx - t) % n
         state = _block_attend(qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
-                              my_idx, kv_idx, s_local, causal, state)
+                              my_idx, kv_idx, s_local, causal, state,
+                              q_seg=seg, k_seg=k_seg if seg is not None else None)
         # rotate K/V one hop: device i -> i+1 (neighbor ICI link)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, state
+        if seg is not None:
+            k_seg = lax.ppermute(k_seg, axis_name, perm)
+        return k_blk, v_blk, k_seg, state
 
-    _, _, (acc, row_sum, row_max) = lax.fori_loop(
-        0, n, step, (k, v, (acc, row_sum, row_max))
+    _, _, _, (acc, row_sum, row_max) = lax.fori_loop(
+        0, n, step, (k, v, k_seg0, (acc, row_sum, row_max))
     )
     denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = acc / denom.transpose(0, 2, 1)[..., None]
@@ -142,21 +160,40 @@ _LOCAL_IMPLS = {"dense": _ring_attention_local, "flash": _ring_attention_local_f
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True,
-                   local_impl: str = "dense"):
+                   local_impl: str = "dense", segment_ids=None):
     """Sequence-parallel attention. Inputs (B, S, H, D) with S sharded over
     ``axis_name``; output same sharding. ``local_impl="flash"`` runs the
-    pallas flash kernel for each local block (forward-only)."""
+    pallas flash kernel for each local block (forward-only).
+    ``segment_ids`` (B, S) restricts attention to same-segment pairs
+    ACROSS the ring — packed documents may span shard boundaries (ids
+    circulate with their K/V block); dense body only (the differentiable
+    path packed training uses)."""
     spec = P(None, axis_name, None, None)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    if segment_ids is not None:
+        if local_impl != "dense":
+            raise ValueError(
+                "segment_ids requires local_impl='dense' (the flash lse entry "
+                "point carries no segment path)"
+            )
+        if segment_ids.shape != q.shape[:2]:
+            raise ValueError(
+                f"segment_ids must be (batch, seq) = {q.shape[:2]}, "
+                f"got {segment_ids.shape}"
+            )
+        in_specs += (P(None, axis_name),)  # ids shard with the sequence
+        args += (segment_ids.astype(jnp.int32),)
     fn = shard_map(
         partial(_LOCAL_IMPLS[local_impl], axis_name=axis_name, causal=causal),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         # only the flash body needs the vma check off (pallas outputs
         # carry no vma); keep the dense path fully type-checked
         check_vma=(local_impl == "dense"),
     )
-    return jax.jit(fn)(q, k, v)
+    return jax.jit(fn)(*args)
 
 
 def dense_attention(q, k, v, causal: bool = True, segment_ids=None):
