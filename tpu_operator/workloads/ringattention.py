@@ -33,12 +33,13 @@ except ImportError:  # pragma: no cover — older jax
 
 
 def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
-                  q_seg=None, k_seg=None):
+                  q_seg=None, k_seg=None, window=None):
     """Accumulate attention of local q against one K/V block using the
     online-softmax recurrence. state = (acc, row_sum, row_max).
     ``q_seg``/``k_seg`` (B, Sq)/(B, Sk) restrict attention to same-
     segment pairs — the k-side ids circulate the ring with their K/V
-    block, so packed documents can span shard boundaries."""
+    block, so packed documents can span shard boundaries. ``window``
+    keeps only the last ``window`` positions (0 <= q-k < window)."""
     acc, row_sum, row_max = state
     scale = 1.0 / np.sqrt(q.shape[-1])
     # (B, H, Sq, Sk)
@@ -47,7 +48,10 @@ def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
     if causal:
         q_pos = q_block_idx * s_local + jnp.arange(s_local)[:, None]
         k_pos = kv_block_idx * s_local + jnp.arange(s_local)[None, :]
-        keep = (q_pos >= k_pos)[None, None]  # (1, 1, Sq, Sk)
+        keep = q_pos >= k_pos
+        if window is not None:
+            keep &= q_pos - k_pos < window
+        keep = keep[None, None]  # (1, 1, Sq, Sk)
     if q_seg is not None:
         same = (q_seg[:, :, None] == k_seg[:, None, :])[:, None]  # (B, 1, Sq, Sk)
         keep = same if keep is None else keep & same
@@ -66,12 +70,27 @@ def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
     return new_acc, new_sum, new_max
 
 
-def _ring_attention_local(q, k, v, seg=None, *, axis_name: str, causal: bool):
+def _ring_hops(n: int, s_local: int, window: Optional[int]) -> int:
+    """Ring steps a causal window actually needs: q attends only the
+    last ``window`` positions, so K/V blocks older than
+    ceil((window + s_local - 1) / s_local) hops behind never contribute
+    — rotating further would spend ICI moving fully-masked blocks.
+    The full ring when unwindowed."""
+    if window is None:
+        return n
+    return min(n, (window + s_local - 2) // s_local + 1)
+
+
+def _ring_attention_local(q, k, v, seg=None, *, axis_name: str, causal: bool,
+                          window: Optional[int] = None):
     """Per-device body under shard_map. q/k/v: (B, S_local, H, D);
     ``seg`` (B, S_local) packed-sequence ids — the local shard's ids
     serve the q side while a COPY circulates the ring with its K/V
     block, so cross-shard same-document attention still connects and
-    cross-document attention is masked even across chips."""
+    cross-document attention is masked even across chips. ``window``
+    (causal only) BANDS the ring: rotation stops once the circulating
+    block is older than any local row's window — O(window) ICI traffic
+    per device instead of O(S)."""
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -91,7 +110,8 @@ def _ring_attention_local(q, k, v, seg=None, *, axis_name: str, causal: bool):
         kv_idx = (my_idx - t) % n
         state = _block_attend(qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
                               my_idx, kv_idx, s_local, causal, state,
-                              q_seg=seg, k_seg=k_seg if seg is not None else None)
+                              q_seg=seg, k_seg=k_seg if seg is not None else None,
+                              window=window)
         # rotate K/V one hop: device i -> i+1 (neighbor ICI link)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
@@ -101,7 +121,7 @@ def _ring_attention_local(q, k, v, seg=None, *, axis_name: str, causal: bool):
         return k_blk, v_blk, k_seg, state
 
     _, _, _, (acc, row_sum, row_max) = lax.fori_loop(
-        0, n, step, (k, v, k_seg0, (acc, row_sum, row_max))
+        0, _ring_hops(n, s_local, window), step, (k, v, k_seg0, (acc, row_sum, row_max))
     )
     denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out = acc / denom.transpose(0, 2, 1)[..., None]
@@ -160,23 +180,32 @@ _LOCAL_IMPLS = {"dense": _ring_attention_local, "flash": _ring_attention_local_f
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = True,
-                   local_impl: str = "dense", segment_ids=None):
+                   local_impl: str = "dense", segment_ids=None,
+                   window: Optional[int] = None):
     """Sequence-parallel attention. Inputs (B, S, H, D) with S sharded over
     ``axis_name``; output same sharding. ``local_impl="flash"`` runs the
     pallas flash kernel for each local block (forward-only).
     ``segment_ids`` (B, S) restricts attention to same-segment pairs
     ACROSS the ring — packed documents may span shard boundaries (ids
-    circulate with their K/V block); dense body only (the differentiable
-    path packed training uses)."""
+    circulate with their K/V block). ``window`` (causal) BANDS the ring:
+    K/V rotate only as many hops as the window reaches, so per-device
+    ICI traffic is O(window), not O(S). Both are dense-body only (the
+    differentiable path training uses)."""
+    local_kwargs = {}
+    if segment_ids is not None or window is not None:
+        if local_impl != "dense":
+            raise ValueError(
+                "segment_ids/window require local_impl='dense' (the flash lse "
+                "entry point carries neither path)"
+            )
+    if window is not None:
+        if not causal or window < 1:
+            raise ValueError("window requires causal attention and window >= 1")
+        local_kwargs["window"] = window
     spec = P(None, axis_name, None, None)
     in_specs = (spec, spec, spec)
     args = (q, k, v)
     if segment_ids is not None:
-        if local_impl != "dense":
-            raise ValueError(
-                "segment_ids requires local_impl='dense' (the flash lse entry "
-                "point carries no segment path)"
-            )
         if segment_ids.shape != q.shape[:2]:
             raise ValueError(
                 f"segment_ids must be (batch, seq) = {q.shape[:2]}, "
@@ -185,7 +214,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = Tr
         in_specs += (P(None, axis_name),)  # ids shard with the sequence
         args += (segment_ids.astype(jnp.int32),)
     fn = shard_map(
-        partial(_LOCAL_IMPLS[local_impl], axis_name=axis_name, causal=causal),
+        partial(
+            _LOCAL_IMPLS[local_impl], axis_name=axis_name, causal=causal,
+            **local_kwargs,
+        ),
         mesh=mesh,
         in_specs=in_specs,
         out_specs=spec,
