@@ -42,8 +42,17 @@ def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
     keeps only the last ``window`` positions (0 <= q-k < window)."""
     acc, row_sum, row_max = state
     scale = 1.0 / np.sqrt(q.shape[-1])
+    b, sq, h, d = q.shape
+    h_kv = k.shape[2]
+    # grouped-query attention: q heads share KV heads in groups — the
+    # ring circulates only the H_kv heads (group-factor less ICI
+    # traffic), and the einsum pairs each q group with its KV head
+    # without materializing repeated K/V. Plain MHA is the g == 1 case
+    # (the reshapes are free metadata ops), so ONE math path serves both.
+    g = h // h_kv
+    qg = q.reshape(b, sq, h_kv, g, d)
     # (B, H, Sq, Sk)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).reshape(b, h, sq, -1) * scale
     keep = None
     if causal:
         q_pos = q_block_idx * s_local + jnp.arange(s_local)[:, None]
@@ -65,7 +74,8 @@ def _block_attend(q, k, v, q_block_idx, kv_block_idx, s_local, causal, state,
     probs = jnp.exp(scores - safe_max[..., None])
     probs = jnp.where(jnp.isneginf(scores), 0.0, probs)
     new_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-    blk_out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    pg = probs.reshape(b, h_kv, g, sq, -1)
+    blk_out = jnp.einsum("bhgqk,bkhd->bqhgd", pg, v).reshape(b, sq, h, d)
     new_acc = acc * correction.transpose(0, 2, 1)[..., None] + blk_out
     return new_acc, new_sum, new_max
 
@@ -190,7 +200,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal: bool = Tr
     circulate with their K/V block). ``window`` (causal) BANDS the ring:
     K/V rotate only as many hops as the window reaches, so per-device
     ICI traffic is O(window), not O(S). Both are dense-body only (the
-    differentiable path training uses)."""
+    differentiable path training uses). Grouped-query attention
+    (k/v with H_kv dividing H) circulates only the H_kv heads —
+    group-factor less ICI traffic per rotation."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads ({k.shape[2]})"
+        )
+    if v.shape != k.shape:
+        raise ValueError(f"k and v shapes must match: {k.shape} vs {v.shape}")
     local_kwargs = {}
     if segment_ids is not None or window is not None:
         if local_impl != "dense":
