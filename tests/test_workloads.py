@@ -975,6 +975,56 @@ class TestFlashAttention:
         )
         assert report["ok"]
 
+    def test_burnin_trains_gqa(self):
+        """Grouped-query attention in the training payload: the fused
+        projection shrinks to q + 2*kv_heads*head_dim, and all three
+        attention paths (dense, flash kernel, ring) train the GQA shape
+        on their meshes."""
+        from tpu_operator.workloads.burnin import (
+            BurninConfig,
+            build_train_step,
+            make_mesh,
+            make_mesh_3d,
+            run_burnin,
+        )
+
+        kwargs = dict(d_model=128, n_heads=4, d_ff=256, seq_len=128, batch=8, n_layers=1)
+        mesh = make_mesh(data=4, model=2)
+        for path in ({}, {"use_flash_attention": True}):
+            report = run_burnin(mesh=mesh, cfg=BurninConfig(kv_heads=2, **kwargs, **path))
+            assert report["ok"], path
+        ring = run_burnin(
+            mesh=make_mesh_3d(data=2, sp=2, model=2),
+            cfg=BurninConfig(
+                d_model=64, n_heads=4, d_ff=128, seq_len=64, batch=4,
+                n_layers=1, sequence_parallel=True, kv_heads=2,
+            ),
+        )
+        assert ring["ok"]
+        # 3 kv heads do not divide 4 q heads
+        with pytest.raises(ValueError, match="multiple of kv_heads"):
+            build_train_step(mesh, BurninConfig(kv_heads=3, **kwargs))
+        # kv heads must shard over 'model' like q heads — replicating
+        # them would silently mispair GQA groups across shards
+        with pytest.raises(ValueError, match="kv_heads"):
+            build_train_step(
+                make_mesh_3d(data=2, sp=2, model=2),
+                BurninConfig(
+                    d_model=64, n_heads=2, d_ff=128, seq_len=64, batch=4,
+                    n_layers=1, sequence_parallel=True, kv_heads=1,
+                ),
+            )
+        # an indivisible sequence gets the same clean rejection instead
+        # of a raw shard_map trace error
+        with pytest.raises(ValueError, match="seq_len"):
+            build_train_step(
+                make_mesh_3d(data=2, sp=2, model=2),
+                BurninConfig(
+                    d_model=64, n_heads=2, d_ff=128, seq_len=33, batch=4,
+                    n_layers=1, sequence_parallel=True,
+                ),
+            )
+
     def test_burnin_packed_requires_flash(self):
         from tpu_operator.workloads.burnin import BurninConfig, build_train_step, make_mesh
 

@@ -43,6 +43,11 @@ class BurninConfig:
     n_layers: int = 2
     dtype: str = "bfloat16"
     learning_rate: float = 0.05
+    # >0 uses grouped-query attention: this many KV heads shared by the
+    # n_heads query heads in groups (the modern LLM shape — smaller KV
+    # projections, and the ring circulates group-factor less ICI
+    # traffic). 0 = multi-head (KV heads == n_heads).
+    kv_heads: int = 0
     # shard the sequence axis over an 'sp' mesh axis and use ring attention
     # (workloads/ringattention.py) inside the block — the long-context mode
     sequence_parallel: bool = False
@@ -67,6 +72,17 @@ class BurninConfig:
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def resolved_kv_heads(self) -> int:
+        return self.kv_heads or self.n_heads
+
+    @property
+    def qkv_width(self) -> int:
+        """Fused projection width: q (d_model) + k + v (kv_heads*head_dim
+        each) — shrinks under grouped-query attention."""
+        head_dim = self.d_model // self.n_heads
+        return self.d_model + 2 * self.resolved_kv_heads * head_dim
 
 
 def make_mesh(devices=None, data: Optional[int] = None, model: Optional[int] = None) -> Mesh:
@@ -136,7 +152,7 @@ def init_params(key, cfg: BurninConfig) -> Dict[str, jax.Array]:
     for layer in range(cfg.n_layers):
         key, k1, k2, k3, k4, k5 = jax.random.split(key, 6)
         s = 1.0 / np.sqrt(d)
-        params[f"l{layer}/qkv"] = jax.random.normal(k1, (d, 3 * d)) * s
+        params[f"l{layer}/qkv"] = jax.random.normal(k1, (d, cfg.qkv_width)) * s
         params[f"l{layer}/proj"] = jax.random.normal(k2, (d, d)) * s
         if cfg.moe_experts:
             e = cfg.moe_experts
@@ -285,17 +301,22 @@ def _block(params, layer: int, x, cfg: BurninConfig, mesh: Optional[Mesh] = None
     h = cfg.n_heads
     w = {k: params[k].astype(cfg.jdtype) for k in params if k.startswith(f"l{layer}/")}
     y = _rmsnorm(x, params[f"l{layer}/ln_scale"])
-    qkv = y @ w[f"l{layer}/qkv"]  # (b, s, 3d) — column-parallel
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, s, h, d // h)
-    k = k.reshape(b, s, h, d // h)
-    v = v.reshape(b, s, h, d // h)
+    h_kv = cfg.resolved_kv_heads
+    dh = d // h
+    qkv = y @ w[f"l{layer}/qkv"]  # (b, s, qkv_width) — column-parallel
+    q, k, v = jnp.split(qkv, [d, d + h_kv * dh], axis=-1)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, h_kv, dh)
+    v = v.reshape(b, s, h_kv, dh)
     if cfg.sequence_parallel:
         ctx = _ring_ctx(q, k, v, mesh, packed=cfg.packed_segments)
     elif cfg.use_flash_attention:
         ctx = _flash_ctx(q, k, v, mesh, packed=cfg.packed_segments)
     else:
-        ctx = _dense_ctx(q, k, v, d // h)
+        if h_kv != h:  # the O(S^2) baseline just repeats KV
+            k = jnp.repeat(k, h // h_kv, axis=2)
+            v = jnp.repeat(v, h // h_kv, axis=2)
+        ctx = _dense_ctx(q, k, v, dh)
     ctx = ctx.reshape(b, s, d)
     x = x + ctx @ w[f"l{layer}/proj"]  # row-parallel -> psum by XLA
     y = _rmsnorm(x, params[f"l{layer}/ln_scale"])
@@ -322,6 +343,10 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     """Returns (step, params, batch): a jitted SGD train step with explicit
     in/out shardings over the mesh, ready-to-run inputs included."""
     cfg = cfg or BurninConfig()
+    if cfg.kv_heads and cfg.n_heads % cfg.kv_heads:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must be a multiple of kv_heads ({cfg.kv_heads})"
+        )
     if cfg.sequence_parallel and "sp" not in mesh.axis_names:
         raise ValueError("sequence_parallel needs an 'sp' mesh axis (make_mesh_3d)")
     if cfg.sequence_parallel and cfg.use_flash_attention:
@@ -329,20 +354,32 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
             "sequence_parallel and use_flash_attention are separate attention "
             "paths — enable one (ring spans chips, flash blocks within one)"
         )
-    if cfg.use_flash_attention:
-        # the flash shard_map splits batch over 'data' and heads over
-        # 'model'; reject configs the dense path would accept but this
-        # path cannot shard, instead of a raw trace-time shape error
+    if cfg.use_flash_attention or cfg.sequence_parallel:
+        # both sharded attention paths split batch over 'data' and heads
+        # (q AND kv — replicating kv would silently mispair GQA groups
+        # across shards) over 'model'; reject configs the dense path
+        # would accept, instead of a raw trace-time shape error
+        path = "use_flash_attention" if cfg.use_flash_attention else "sequence_parallel"
         axes = dict(zip(mesh.axis_names, mesh.devices.shape))
         if cfg.batch % axes.get("data", 1):
             raise ValueError(
-                f"use_flash_attention: batch ({cfg.batch}) must divide over "
+                f"{path}: batch ({cfg.batch}) must divide over "
                 f"the 'data' axis ({axes.get('data', 1)})"
             )
         if cfg.n_heads % axes.get("model", 1):
             raise ValueError(
-                f"use_flash_attention: n_heads ({cfg.n_heads}) must divide "
+                f"{path}: n_heads ({cfg.n_heads}) must divide "
                 f"over the 'model' axis ({axes.get('model', 1)})"
+            )
+        if cfg.resolved_kv_heads % axes.get("model", 1):
+            raise ValueError(
+                f"{path}: kv_heads ({cfg.resolved_kv_heads}) must "
+                f"divide over the 'model' axis ({axes.get('model', 1)})"
+            )
+        if cfg.sequence_parallel and cfg.seq_len % axes.get("sp", 1):
+            raise ValueError(
+                f"sequence_parallel: seq_len ({cfg.seq_len}) must divide "
+                f"over the 'sp' axis ({axes.get('sp', 1)})"
             )
     if cfg.packed_segments and not (cfg.use_flash_attention or cfg.sequence_parallel):
         raise ValueError(
