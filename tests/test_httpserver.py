@@ -336,3 +336,48 @@ class TestListPagination:
         # and through the client API
         items = client.list("v1", "Pod", NS, field_selector={"status.phase": "Pending"})
         assert [o["metadata"]["name"] for o in items] == ["p-pending"]
+
+
+class TestWatch410Recovery:
+    def test_raw_stale_stream_surfaces_410_as_apierror(self, served):
+        """A watch stream answered with the 410 ERROR event must raise
+        ApiError inside _stream_watch — the signal the watch loop's
+        recovery branch keys on."""
+
+        class _Sub:
+            active = True
+
+        _, client = served
+        with pytest.raises(errors.ApiError, match="410"):
+            client._stream_watch(
+                "v1", "ConfigMap", lambda et, obj: None, NS, _Sub(),
+                resource_version="99",
+            )
+
+    def test_watch_loop_relists_after_410(self, served, monkeypatch):
+        """The recovery loop itself: when the stream dies with the 410
+        ApiError, _watch_loop must re-list and re-watch rather than
+        wedge — the informer keeps observing objects created after the
+        expiry. The first stream attempt is forced to fail exactly the
+        way a real apiserver's Gone answer does."""
+        store, client = served
+        calls = {"n": 0}
+        orig = client._stream_watch
+
+        def flaky(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise errors.ApiError(
+                    "watch error event: {'code': 410, 'reason': 'Expired'}"
+                )
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(client, "_stream_watch", flaky)
+        seen = []
+        sub = client.watch(
+            "v1", "ConfigMap", lambda et, o: seen.append((et, o["metadata"]["name"]))
+        )
+        assert wait_for(lambda: calls["n"] >= 2, timeout=10), "no re-watch after 410"
+        store.create(new_object("v1", "ConfigMap", "after", NS))
+        assert wait_for(lambda: ("ADDED", "after") in seen, timeout=10)
+        sub.stop()
