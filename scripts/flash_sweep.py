@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpu_operator.workloads.flashattention import flash_attention
-from tpu_operator.workloads.timing import two_point_min_timing
+from tpu_operator.workloads.timing import attention_grad_chain, two_point_min_timing
 
 
 def time_config(seq_len, heads, head_dim, block_q, block_k, iters, reps,
@@ -52,20 +52,7 @@ def time_config(seq_len, heads, head_dim, block_q, block_k, iters, reps,
         "stable": timing.per_iter_s is not None,
     }
     if fwd_bwd:
-        def loss(a, kk, vv):
-            return jnp.sum(fn(a, kk, vv).astype(jnp.float32))
-
-        grad = jax.grad(loss, argnums=(0, 1, 2))
-
-        @partial(jax.jit, static_argnames="n")
-        def gchain(q, k, v, s, n):
-            def step(i, acc):
-                dq, _, _ = grad(acc, k, v)
-                return acc + dq.astype(q.dtype) * jnp.bfloat16(0.001)
-
-            out = lax.fori_loop(0, n, step, q * s)
-            return jnp.float32(out.sum())
-
+        gchain = attention_grad_chain(fn, q, k, v)
         gt = two_point_min_timing(
             lambda s, n: float(gchain(q, k, v, s, n)), iters, 4 * iters, reps
         )

@@ -66,6 +66,42 @@ class TwoPointTiming:
         return fields
 
 
+def attention_grad_chain(fn, q, k, v):
+    """Jitted fwd+bwd timing chain for an attention ``fn(q, k, v)``:
+    each step folds ALL THREE cotangents back into the next step's
+    inputs. The single definition matters — a dq-only chain once let
+    jax's DCE delete the dK/dV kernel from the compiled program, so two
+    copies of this harness mis-reported "fwd+bwd" until both were
+    found. Returns ``chain(q, k, v, seed_scalar, n)``."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def loss(a, kk, vv):
+        return jnp.sum(fn(a, kk, vv).astype(jnp.float32))
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @partial(jax.jit, static_argnames="n")
+    def chain(q, k, v, s, n):
+        def step(i, carry):
+            a, kc, vc = carry
+            dq, dk, dv = grad(a, kc, vc)
+            eps = jnp.asarray(0.001, q.dtype)
+            return (
+                a + dq.astype(q.dtype) * eps,
+                kc + dk.astype(k.dtype) * eps,
+                vc + dv.astype(v.dtype) * eps,
+            )
+
+        out = lax.fori_loop(0, n, step, (q * s, k, v))
+        return jnp.float32(out[0].sum())
+
+    return chain
+
+
 def two_point_min_timing(
     run: Callable[[float, int], None], lo: int, hi: int, reps: int = 5
 ) -> TwoPointTiming:
