@@ -221,7 +221,7 @@ def tpu_details() -> dict:
                 # two training baselines, naive and remat'd dense, timed
                 # by the same all-cotangents chain as the flash path (a
                 # dq-only chain once let DCE delete work asymmetrically
-                # and inflate this ratio to ~90x; honest value ~6.5x)
+                # and inflate this ratio to ~90x; honest value ~6-6.5x)
                 "train_step_speedup_vs_dense": round(
                     fa.get("train_step_speedup_vs_dense", 0.0), 2
                 ),
