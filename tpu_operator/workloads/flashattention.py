@@ -106,6 +106,15 @@ _PERMUTE_FWD = True
 _PERMUTE_DQ = True
 _PERMUTE_DKV = False
 _TRIANGLE_FWD = True
+# Backward block sizes, independent of the forward's (the two passes
+# have different working sets: the backward holds q/k/v/do plus two
+# accumulators). None = inherit the forward blocks; used only when they
+# divide the sequence. Swept on hardware at 8k with the forward at
+# 1024x1024: inheriting (4.50 ms fwd+bwd) beat every override tried
+# (512x512 5.16, 512x1024 5.54, 256x1024 4.84, 1024x512 4.66), so the
+# defaults stay None.
+_BWD_BLOCK_Q = None
+_BWD_BLOCK_K = None
 
 
 def _balance_perm(j, n: int):
@@ -778,6 +787,13 @@ def _flash_core_seg_bwd(causal, block_q, block_k, heads, kv_heads, window, resid
 def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
                     heads, kv_heads, window, seg=None):
     bh_count, s, d = qb.shape
+    # the backward may run its own block sizes (lse/delta are stored at
+    # full resolution, so re-blocking is free); fall back to the
+    # forward's when an override doesn't divide the sequence
+    if _BWD_BLOCK_Q and s % _BWD_BLOCK_Q == 0:
+        block_q = _BWD_BLOCK_Q
+    if _BWD_BLOCK_K and s % _BWD_BLOCK_K == 0:
+        block_k = _BWD_BLOCK_K
     group = heads // kv_heads
     interpret = jax.devices()[0].platform != "tpu"
     # D_i = rowsum(dO ∘ O): cheap elementwise, XLA fuses it
