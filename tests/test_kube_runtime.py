@@ -312,3 +312,37 @@ class TestCachedReadClient:
             assert got["data"]["v"] == "desired"
         finally:
             mgr.stop()
+
+
+def test_lazy_informer_start_racing_stop_leaks_no_watch(monkeypatch):
+    """The lock-free informer_for starts a lazily-created informer
+    OUTSIDE the manager lifecycle lock (so a slow cold LIST cannot
+    block stop()). The cost is a race: manager stop landing between
+    registration and start. The informer's own lifecycle guard must
+    win that race — no watch subscription may survive the stop."""
+    from tpu_operator.kube import manager as manager_mod
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class SlowStartInformer(Informer):
+        def start(self):
+            entered.set()
+            release.wait(10)  # hold exactly the race window open
+            super().start()
+
+    monkeypatch.setattr(manager_mod, "Informer", SlowStartInformer)
+    store = FakeClient()
+    mgr = Manager(store)
+    mgr.start()
+    t = threading.Thread(target=lambda: mgr.informer_for("v1", "Pod"), daemon=True)
+    t.start()
+    assert entered.wait(10)
+    mgr.stop()  # lands while the lazy start is parked pre-subscription
+    release.set()
+    t.join(10)
+    assert not t.is_alive(), "lazy start deadlocked against manager stop"
+    informer = mgr.informer_peek("v1", "Pod")
+    assert informer is not None and informer._stopped
+    live = [sub for subs in store._watchers.values() for sub in subs]
+    assert not live, f"watch subscriptions leaked past stop: {live}"
