@@ -11,10 +11,13 @@ apiserver + cluster sim (the "CPU-only kind cluster" configuration,
 BASELINE config 1/4 shape), so the number isolates operator overhead:
 reconcile latency, render cost, state-machine passes, watch fan-out.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-vs_baseline is the reference bound (900 s) over our measured time.
-When TPU hardware is visible, a details block adds the on-chip validation
-payloads (smoke matmul, pallas triad HBM bandwidth, psum allreduce).
+Prints ONE compact JSON line: {"metric", "value", "unit", "vs_baseline",
+...headline numbers}. vs_baseline is the reference bound (900 s) over our
+measured time. The full structure (per-run timings, scale/stat blocks,
+on-chip validation payloads — smoke matmul, pallas triad HBM bandwidth,
+flash attention, psum allreduce) is written to BENCH_DETAIL.json; pass
+--full to print it instead. The compact line exists because the driver
+records only a ~2,000-char tail of stdout (BENCH_r04 truncated mid-object).
 """
 
 from __future__ import annotations
@@ -341,6 +344,44 @@ def _multiprocess_distributed_details() -> dict:
         return {"error": str(e)[-500:]}
 
 
+def _compact_summary(out: dict) -> dict:
+    """The driver records only the tail of stdout (~2,000 chars observed:
+    BENCH_r04 truncated mid-object and parsed as null). The final printed
+    line must therefore be a compact selection of headline numbers; the
+    full structure goes to BENCH_DETAIL.json next to this script."""
+    details = out.get("details", {})
+    fa = details.get("flash_attention_8k", {})
+    scaling = details.get("flash_attention_scaling", {})
+    scale_http = out.get("scale_http_transport", {})
+    compact = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "vs_baseline_kind": out["vs_baseline_kind"],
+        "http_transport_s": out.get("http_transport_s"),
+        "scale_64node_s": out.get("scale_64node_s"),
+        "scale_256node_s": out.get("scale_256node_s"),
+        "scale_1024node_s": out.get("scale_1024node_s"),
+        "requests_per_reconcile": {
+            label.replace("node_cached", ""): blk.get("requests_per_reconcile")
+            for label, blk in scale_http.items()
+            if label.endswith("_cached") and isinstance(blk, dict)
+        },
+        "platform": details.get("platform"),
+        "matmul_bf16_tflops": details.get("matmul_bf16_tflops")
+        or details.get("matmul_bf16_tflops_lower_bound"),
+        "matmul_int8_tops": details.get("matmul_int8_tops")
+        or details.get("matmul_int8_tops_lower_bound"),
+        "triad_gbps": details.get("triad_gbps") or details.get("triad_gbps_lower_bound"),
+        "flash_8k_tflops": fa.get("tflops"),
+        "flash_8k_fwd_bwd_ms": fa.get("fwd_bwd_ms"),
+        "flash_32k_tflops": scaling.get("32k", {}).get("tflops"),
+        "detail_file": "BENCH_DETAIL.json",
+    }
+    return {k: v for k, v in compact.items() if v not in (None, {})}
+
+
 def main() -> None:
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
@@ -358,6 +399,10 @@ def main() -> None:
         ("64node_direct", 64, False),
         ("256node_cached", 256, True),
         ("256node_direct", 256, False),
+        # one order of magnitude above the 256-node point; cached only
+        # (the direct path's point is made at 64/256 — repeating it at
+        # 1024 would just burn minutes re-measuring a known O(nodes) cost)
+        ("1024node_cached", 1024, True),
     ):
         try:
             elapsed, stats = bench_install_to_ready(
@@ -389,10 +434,24 @@ def main() -> None:
         "sim_container_start_s": SIM_CONTAINER_START_S,
         "scale_64node_s": round(scale_64, 3),
         "scale_256node_s": scale_http.get("256node_cached", {}).get("install_to_ready_s"),
+        "scale_1024node_s": scale_http.get("1024node_cached", {}).get("install_to_ready_s"),
         "scale_http_transport": scale_http,
         "details": details,
     }
-    print(json.dumps(out))
+    detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    with open(detail_path, "w") as f:
+        json.dump(out, f, indent=1)
+    if "--full" in sys.argv[1:]:
+        print(json.dumps(out))
+        return
+    line = json.dumps(_compact_summary(out), separators=(",", ":"))
+    if len(line) >= 1800:
+        # never fail (or truncate mid-object) after a multi-minute run:
+        # drop to the bare driver contract and flag the overflow
+        print(f"summary line too long ({len(line)} chars); printing core fields", file=sys.stderr)
+        core = {k: out[k] for k in ("metric", "value", "unit", "vs_baseline")}
+        line = json.dumps(core, separators=(",", ":"))
+    print(line)
 
 
 if __name__ == "__main__":

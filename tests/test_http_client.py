@@ -242,7 +242,11 @@ def test_eviction_subresource_and_429_mapping(stub):
 def test_watch_streams_events(stub):
     client = HttpClient(stub.url)
     received = []
-    sub = client.watch("v1", "Node", lambda et, obj: received.append((et, obj["metadata"]["name"])))
+    sub = client.watch(
+        "v1",
+        "Node",
+        lambda et, obj: et != "SYNC" and received.append((et, obj["metadata"]["name"])),
+    )
     assert stub.watch_ready.wait(5)
     stub.watch_events.append(
         {"type": "ADDED", "object": {"metadata": {"name": "n1", "resourceVersion": "2"}}}
@@ -306,3 +310,34 @@ class TestPooledRetryIdempotency:
         client = self._client(monkeypatch)
         with pytest.raises(errors.ApiError, match="server closed idle conn"):
             client._request("POST", "/api/v1/nodes", body={})
+
+    class _NotFoundConn(_GoodConn):
+        class _Resp:
+            status = 404
+            will_close = True
+
+            def read(self):
+                return b'{"reason":"NotFound"}'
+
+        def getresponse(self):
+            return self._Resp()
+
+    def _notfound_retry_client(self, monkeypatch):
+        client = HttpClient("http://unused")
+        monkeypatch.setattr(client, "_checkout_conn", lambda: (self._DeadConn(), True))
+        monkeypatch.setattr(client, "_new_conn", lambda: self._NotFoundConn())
+        return client
+
+    def test_retried_delete_normalizes_404_to_success(self, monkeypatch):
+        """The first DELETE may have been processed before the pooled
+        connection died; a 404 on the retry then IS the successful
+        outcome — surfacing NotFound would invert the result for callers
+        that don't tolerate NotFound-on-delete (advisor r4)."""
+        client = self._notfound_retry_client(monkeypatch)
+        assert client._request("DELETE", "/api/v1/nodes/n1") == {}
+
+    def test_retried_get_still_raises_notfound(self, monkeypatch):
+        # the normalization is DELETE-specific: a GET 404 is a real answer
+        client = self._notfound_retry_client(monkeypatch)
+        with pytest.raises(errors.NotFound):
+            client._request("GET", "/api/v1/nodes/n1")

@@ -115,9 +115,9 @@ class TestWatchOverHttp:
         """resourceVersion=0 semantics, pinned at the raw endpoint (no
         prior LIST, so HttpClient's own list-replay can't mask a broken
         server): an object created BEFORE the stream connects must arrive
-        as a synthetic ADDED. Losing it is unrecoverable — no resync
-        timer exists; this exact race wedged the install flow once
-        keep-alive made request setup fast enough to hit the gap."""
+        in the opening SYNC snapshot event. Losing it is unrecoverable —
+        no resync timer exists; this exact race wedged the install flow
+        once keep-alive made request setup fast enough to hit the gap."""
         import json as _json
         import urllib.request
 
@@ -129,8 +129,9 @@ class TestWatchOverHttp:
         )
         with urllib.request.urlopen(url, timeout=10) as resp:
             event = _json.loads(resp.readline())
-        assert event["type"] == "ADDED"
-        assert event["object"]["metadata"]["name"] == "pre-existing"
+        assert event["type"] == "SYNC"
+        names = [o["metadata"]["name"] for o in event["object"]["items"]]
+        assert names == ["pre-existing"]
 
     def test_stream_with_stale_rv_gets_410_error_event(self, served):
         """The store keeps no event history, so a watch from an arbitrary
@@ -160,13 +161,15 @@ class TestWatchOverHttp:
         got_two = threading.Event()
 
         def handler(etype, obj):
+            if etype == "SYNC":  # opening snapshot (empty here) — not an object
+                return
             seen.append((etype, obj["metadata"]["name"]))
             if len(seen) >= 2:
                 got_two.set()
 
         sub = client.watch("v1", "ConfigMap", handler, NS)
-        # watch starts with a re-list (empty) then streams live events;
-        # give the stream a beat to connect before mutating
+        # watch starts with a SYNC snapshot (empty) then streams live
+        # events; give the stream a beat to connect before mutating
         time.sleep(0.3)
         store.create(new_object("v1", "ConfigMap", "w1", NS))
         store.delete("v1", "ConfigMap", "w1", NS)
@@ -245,6 +248,55 @@ class TestApiserverRestart:
                 server.stop()
             except Exception:  # noqa: BLE001 — already stopped
                 pass
+
+
+class TestInformerPhantomHeal:
+    def test_reconnect_sync_drops_object_deleted_during_gap(self):
+        """The advisor-r4 phantom scenario, end to end over the wire: an
+        object deleted while the watch stream is down must leave the
+        informer cache when the stream reconnects — the reconnect SYNC
+        snapshot replaces the store (client-go Replace semantics). Before
+        that fix the replay was ADDED-only and the deleted object stayed
+        cached forever, feeding cached-read reconcilers a phantom."""
+        from tpu_operator.kube.informer import Informer
+
+        store = FakeClient()
+        server = FakeApiServer(store).start()
+        port = server.httpd.server_address[1]
+        client = HttpClient(server.base_url, timeout=5.0)
+        store.create(new_object("v1", "ConfigMap", "phantom", NS))
+        store.create(new_object("v1", "ConfigMap", "survivor", NS))
+        inf = Informer(client, "v1", "ConfigMap", NS)
+        deleted = []
+
+        def on_event(etype, old, new):
+            if etype == "DELETED":
+                deleted.append(new["metadata"]["name"])
+
+        inf.add_handler(on_event)
+        inf.start()
+        server2 = None
+        try:
+            assert wait_for(lambda: len(inf.cached()) == 2), "informer never synced"
+            server.stop()
+            # delete while the operator is blind: no stream is connected,
+            # so the DELETED event is lost for good
+            store.delete("v1", "ConfigMap", "phantom", NS)
+            time.sleep(1.0)
+            server2 = FakeApiServer(store, port=port).start()
+            assert wait_for(
+                lambda: {o["metadata"]["name"] for o in inf.cached()} == {"survivor"},
+                timeout=20,
+            ), "phantom survived the reconnect SYNC"
+            assert "phantom" in deleted
+        finally:
+            inf.stop()
+            for s in (server, server2):
+                try:
+                    if s is not None:
+                        s.stop()
+                except Exception:  # noqa: BLE001 — already stopped
+                    pass
 
 
 class TestUpgradeDrillOverHttp:
@@ -375,7 +427,9 @@ class TestWatch410Recovery:
         monkeypatch.setattr(client, "_stream_watch", flaky)
         seen = []
         sub = client.watch(
-            "v1", "ConfigMap", lambda et, o: seen.append((et, o["metadata"]["name"]))
+            "v1",
+            "ConfigMap",
+            lambda et, o: et != "SYNC" and seen.append((et, o["metadata"]["name"])),
         )
         assert wait_for(lambda: calls["n"] >= 2, timeout=10), "no re-watch after 410"
         store.create(new_object("v1", "ConfigMap", "after", NS))

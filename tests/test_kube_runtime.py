@@ -166,6 +166,64 @@ def test_informer_rejects_stale_resource_version():
     inf.stop()
 
 
+def test_informer_sync_replaces_store_and_synthesizes_deletes():
+    """client-go Reflector Replace semantics: a SYNC snapshot is
+    authoritative — objects absent from it were deleted during a watch
+    gap and must leave the cache (with a DELETED notification) or they
+    linger as phantoms that cached-read reconcilers trust forever."""
+    client = FakeClient()
+    inf = Informer(client, "v1", "Node")
+    seen = []
+    inf.add_handler(lambda t, old, new: seen.append((t, new["metadata"]["name"])))
+    inf.start()
+    client.create(new_object("v1", "Node", "gone"))
+    client.create(new_object("v1", "Node", "kept"))
+    assert {o["metadata"]["name"] for o in inf.cached()} == {"gone", "kept"}
+    seen.clear()
+    kept = client.get("v1", "Node", "kept")
+    fresh = new_object("v1", "Node", "fresh")
+    fresh["metadata"]["resourceVersion"] = "99"
+    inf._on_event("SYNC", {"apiVersion": "v1", "kind": "NodeList", "items": [kept, fresh]})
+    assert {o["metadata"]["name"] for o in inf.cached()} == {"kept", "fresh"}
+    assert ("DELETED", "gone") in seen
+    assert ("ADDED", "fresh") in seen
+    # the unchanged object must NOT renotify (same rv → dropped)
+    assert not any(name == "kept" for _, name in seen)
+    inf.stop()
+
+
+def test_informer_start_unwinds_watch_on_list_failure():
+    """If the snapshot replay inside watch() raises (its LIST fails),
+    start() must leave no watch registered and stay startable — with
+    _sub left set, every later start() would no-op, the informer would
+    leak a live watch and never report synced (advisor r4). A second
+    start() after the fault must succeed."""
+    client = FakeClient()
+    client.create(new_object("v1", "Node", "n1"))
+    fail = {"on": True}
+    real_list = client.list
+
+    def flaky_list(*a, **kw):
+        if fail["on"]:
+            raise RuntimeError("apiserver hiccup")
+        return real_list(*a, **kw)
+
+    client.list = flaky_list
+    inf = Informer(client, "v1", "Node")
+    try:
+        inf.start()
+    except RuntimeError:
+        pass
+    assert inf._sub is None
+    assert not inf.has_synced()
+    assert client._watchers.get(("", "Node"), []) == []  # no leaked watch
+    fail["on"] = False
+    inf.start()
+    assert inf.has_synced()
+    assert {o["metadata"]["name"] for o in inf.cached()} == {"n1"}
+    inf.stop()
+
+
 def test_update_status_conflict_on_stale_resource_version():
     client = FakeClient()
     created = client.create(new_object("v1", "Node", "n1"))

@@ -15,9 +15,11 @@ the apiserver by a watch delivery. Writers that need read-your-writes
 (create-after-cache-miss, rv-guarded updates) handle the resulting
 AlreadyExists/Conflict and requeue — see ``StateSkel.apply_object``,
 which falls back to ``.live`` for exactly that. A kind's first cached
-read starts its informer (synchronous list + watch registration), so a
-cold read is never served from an empty cache; reads before the manager
-starts fall through to the live client.
+read starts its informer (a snapshot-bearing watch: registration plus a
+SYNC replay of current state, awaited by ``Informer.start``), so a cold
+read is never served from an empty cache; reads before the manager
+starts, or while an informer has not yet received its snapshot, fall
+through to the live client.
 """
 
 from __future__ import annotations
@@ -110,5 +112,5 @@ class CachedReadClient(Client):
     def evict(self, name: str, namespace: str) -> None:
         return self.live.evict(name, namespace)
 
-    def watch(self, api_version, kind, handler, namespace=None) -> WatchSubscription:
-        return self.live.watch(api_version, kind, handler, namespace)
+    def watch(self, api_version, kind, handler, namespace=None, replay=False) -> WatchSubscription:
+        return self.live.watch(api_version, kind, handler, namespace, replay=replay)

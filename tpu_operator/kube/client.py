@@ -11,6 +11,13 @@ from tpu_operator.kube.objects import ObjectDict
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# Synthetic full-snapshot event, delivered at watch (re)connect instead of a
+# per-object ADDED replay: handler(SYNC, {"items": [...]}). Cache consumers
+# must REPLACE their store from it — upsert every item and drop keys absent
+# from the snapshot (client-go Reflector/DeltaFIFO Replace semantics); a
+# plain ADDED replay can never communicate deletions that happened during a
+# watch gap, leaving phantom objects cached forever.
+SYNC = "SYNC"
 
 WatchHandler = Callable[[str, ObjectDict], None]
 
@@ -77,8 +84,15 @@ class Client(abc.ABC):
         kind: str,
         handler: WatchHandler,
         namespace: Optional[str] = None,
+        replay: bool = False,
     ) -> WatchSubscription:
-        """Register a watch; handler is called with (event_type, object)."""
+        """Register a watch; handler is called with (event_type, object).
+
+        ``replay=True`` asks for an initial SYNC snapshot of current state
+        before live events (kube's resourceVersion=0 semantics). There must
+        be exactly ONE snapshot source per subscription — a consumer that
+        runs its own competing LIST alongside a snapshot-bearing watch can
+        interleave two differently-aged snapshots and corrupt its cache."""
 
     # -- conveniences -------------------------------------------------------
 

@@ -14,7 +14,15 @@ import time
 from typing import List, Optional
 
 from tpu_operator.kube import errors
-from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client, WatchHandler, WatchSubscription
+from tpu_operator.kube.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    SYNC,
+    Client,
+    WatchHandler,
+    WatchSubscription,
+)
 from tpu_operator.kube.objects import (
     ObjectDict,
     api_group,
@@ -261,17 +269,26 @@ class FakeClient(Client):
 
     def watch(self, api_version, kind, handler, namespace=None, replay=False):
         """``replay=True`` is kube's resourceVersion=0 watch semantics:
-        synthetic ADDED events for the current state, delivered atomically
-        with registration — so a consumer whose LIST ran on a separate
-        request (the HTTP facade's stream) can never lose an object
-        created in the list→watch gap. The handler runs under the store
-        lock during replay and must not call back into the client."""
+        the current state delivered atomically with registration — so a
+        consumer whose LIST ran on a separate request (the HTTP facade's
+        stream) can never lose an object created in the list→watch gap.
+        The replay is one SYNC snapshot event rather than per-object ADDED:
+        a reconnecting cache consumer must also learn about objects deleted
+        during its gap, which only a full-snapshot replace can convey. The
+        handler runs under the store lock during replay and must not call
+        back into the client."""
         key = (api_group(api_version), kind)
         sub = _Sub(self, key, handler, namespace)
         with self._lock:  # RLock: list() below re-enters safely
             if replay:
-                for obj in self.list(api_version, kind, namespace):
-                    handler(ADDED, obj)
+                handler(
+                    SYNC,
+                    {
+                        "apiVersion": api_version,
+                        "kind": f"{kind}List",
+                        "items": self.list(api_version, kind, namespace),
+                    },
+                )
             self._watchers.setdefault(key, []).append(sub)
         return sub
 

@@ -24,7 +24,7 @@ import urllib.request
 from typing import List, Optional
 
 from tpu_operator.kube import errors
-from tpu_operator.kube.client import Client, WatchHandler, WatchSubscription
+from tpu_operator.kube.client import SYNC, Client, WatchHandler, WatchSubscription
 from tpu_operator.kube.objects import ObjectDict, api_group, is_cluster_scoped, nested_get
 
 log = logging.getLogger(__name__)
@@ -338,6 +338,7 @@ class HttpClient(Client):
         body: Optional[dict] = None,
         query: Optional[dict] = None,
         _retry_auth: bool = True,
+        _resent: bool = False,
     ) -> dict:
         import http.client
 
@@ -366,6 +367,10 @@ class HttpClient(Client):
         # callers tolerate AlreadyExists on their own retry (Go's transport
         # draws the same idempotency line when request bytes were written).
         for attempt in range(2):
+            # "this exact request was already sent at least once" — carried
+            # through the 401 token-refresh recursion below, which restarts
+            # the attempt counter but not the request's send history
+            resent = _resent or attempt == 1
             try:
                 if attempt == 0:
                     conn, pooled = self._checkout_conn()
@@ -404,9 +409,19 @@ class HttpClient(Client):
             if status == 401 and _retry_auth and self.token_path:
                 # expired bound token: re-read once and retry the request
                 self._bearer(force_refresh=True)
-                return self._request(method, path, body, query, _retry_auth=False)
+                return self._request(
+                    method, path, body, query, _retry_auth=False, _resent=resent
+                )
             detail = payload.decode(errors="replace")[:500]
             if status == 404:
+                if method == "DELETE" and resent:
+                    # this is the RETRY of a DELETE whose first send died on
+                    # a stale pooled connection — the server may well have
+                    # processed that first attempt, making this NotFound the
+                    # successful outcome. Normalize to success (idempotent
+                    # delete) instead of inverting the result for callers
+                    # that don't tolerate NotFound-on-delete.
+                    return {}
                 raise errors.NotFound(detail)
             if status == 409:
                 if "AlreadyExists" in detail:
@@ -509,7 +524,14 @@ class HttpClient(Client):
 
     # -- watch ---------------------------------------------------------------
 
-    def watch(self, api_version, kind, handler: WatchHandler, namespace=None) -> WatchSubscription:
+    def watch(
+        self, api_version, kind, handler: WatchHandler, namespace=None, replay=False
+    ) -> WatchSubscription:
+        # ``replay`` is accepted for Client-interface parity but has no
+        # effect: an HTTP watch ALWAYS begins with a SYNC snapshot (the
+        # loop's own paged LIST, or the server's rv=0 replay) because the
+        # stream must re-establish a consistent start point on every
+        # (re)connect anyway. Raw consumers just skip SYNC events.
         sub = _WatchSub()
         thread = threading.Thread(
             target=self._watch_loop,
@@ -531,14 +553,22 @@ class HttpClient(Client):
                     # would hurt most)
                     items, resource_version = self._list_paged(api_version, kind, namespace)
                     if resource_version != "0":
-                        # real apiserver: replay the list as ADDED and
-                        # stream from its resourceVersion (gap-free)
-                        for item in items:
-                            handler("ADDED", item)
-                    # rv "0": the server streams its own synthetic ADDED
-                    # replay atomically with watch registration (kube's
+                        # real apiserver: deliver the list as ONE SYNC
+                        # snapshot (cache consumers replace their store,
+                        # learning about objects deleted during the gap)
+                        # and stream from its resourceVersion (gap-free)
+                        handler(
+                            SYNC,
+                            {
+                                "apiVersion": api_version,
+                                "kind": f"{kind}List",
+                                "items": items,
+                            },
+                        )
+                    # rv "0": the server streams its own SYNC snapshot
+                    # atomically with watch registration (kube's
                     # resourceVersion=0 semantics) — replaying the list
-                    # here too would double every object on each connect
+                    # here too would be a stale second snapshot
                 self._stream_watch(api_version, kind, handler, namespace, sub, resource_version)
                 resource_version = ""  # stream ended: full re-list
             except errors.ApiError as e:

@@ -10,9 +10,11 @@ operator against a real apiserver (tests/e2e/gpu_operator_test.go:104-170).
 
 Scope notes:
 - list responses advertise resourceVersion "0"; a watch opened with rv
-  absent or "0" replays the current state as synthetic ADDED events
-  atomically with registration (kube's rv=0 semantics), so nothing can
-  be lost in the list→watch gap. A nonzero rv streams live events only.
+  absent or "0" replays the current state as one synthetic SYNC snapshot
+  event atomically with registration (kube's rv=0 semantics, upgraded to
+  a replace so reconnecting caches also learn about deletions), so
+  nothing can be lost in the list→watch gap. A nonzero rv streams live
+  events only.
 - HTTP/1.1 keep-alive: unary requests reuse connections (the client
   pools them, like client-go's transport); watch streams mark
   Connection: close and hold a dedicated connection for their lifetime.
@@ -315,8 +317,9 @@ class FakeApiServer:
         """Chunked JSON watch stream fed from a live FakeClient watcher.
 
         resourceVersion absent or "0" opens with a replay of the current
-        state as synthetic ADDED events, atomic with registration
-        (FakeClient.watch(replay=True)) — kube's rv=0 semantics. This is
+        state as one synthetic SYNC snapshot event, atomic with
+        registration (FakeClient.watch(replay=True)) — kube's rv=0
+        semantics upgraded to a cache replace. This is
         what closes the list→watch gap: the client's LIST runs on a
         separate request, and a lost creation in that gap would otherwise
         never be seen (no informer resync timer exists to recover it).
@@ -359,7 +362,7 @@ class FakeApiServer:
             kind,
             lambda etype, obj: events.put((etype, obj)),
             namespace,
-            replay=resource_version in ("", "0"),
+            replay=True,  # any other rv already left via the 410 branch
         )
         handler.send_response(200)
         handler.send_header("Content-Type", "application/json")

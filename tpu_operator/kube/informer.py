@@ -6,7 +6,7 @@ import logging
 import threading
 from typing import Callable, List, Optional
 
-from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, Client
+from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, SYNC, Client
 from tpu_operator.kube.objects import ObjectDict, api_group, deep_copy, object_key
 
 
@@ -35,7 +35,7 @@ class Informer:
         self._cache: dict = {}
         self._lock = threading.RLock()
         self._sub = None
-        self._synced = False
+        self._synced = threading.Event()
         self._stopped = False
         # serializes start/stop so a late lazy start (a cached read of a
         # new kind on a running manager) can never leak a watch past stop
@@ -44,15 +44,27 @@ class Informer:
     def add_handler(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
 
-    def start(self) -> None:
+    def start(self, sync_timeout: float = 5.0) -> None:
         with self._lifecycle:
             if self._stopped or self._sub is not None:
                 return  # stopped or already started — idempotent
-            # Subscribe first so no events are lost between list and watch.
-            self._sub = self.client.watch(self.api_version, self.kind, self._on_event, self.namespace)
-            for obj in self.client.list(self.api_version, self.kind, self.namespace):
-                self._on_event(ADDED, obj)
-            self._synced = True
+            # The watch subscription is the SINGLE snapshot source: it
+            # delivers current state as one SYNC event (atomically with
+            # registration for the in-memory client; on stream connect for
+            # the HTTP client) and live events after. The informer must NOT
+            # run its own competing LIST — two listers produce two
+            # differently-aged snapshots whose reordering can resurrect a
+            # deleted object or fabricate a deletion. If watch() itself
+            # raises, _sub stays None so a later start() retries cleanly.
+            self._sub = self.client.watch(
+                self.api_version, self.kind, self._on_event, self.namespace, replay=True
+            )
+        # Outside the lifecycle lock (stop() must never wait on this):
+        # immediate for the in-memory client; stream-connect latency over
+        # HTTP. On timeout (apiserver down) the informer stays unsynced —
+        # cached readers fall back to live — and heals when the watch
+        # loop's retry eventually connects and delivers its SYNC.
+        self._synced.wait(sync_timeout)
 
     def stop(self) -> None:
         with self._lifecycle:
@@ -61,9 +73,12 @@ class Informer:
                 self._sub.stop()
 
     def has_synced(self) -> bool:
-        return self._synced
+        return self._synced.is_set()
 
     def _on_event(self, event_type: str, obj: ObjectDict) -> None:
+        if event_type == SYNC:
+            self._replace(obj.get("items") or [])
+            return
         key = object_key(obj)
         with self._lock:
             old = self._cache.get(key)
@@ -88,6 +103,25 @@ class Informer:
                 )
             except Exception:  # noqa: BLE001 — informer must survive handler bugs
                 log.exception("informer handler failed for %s %s", self.kind, key)
+
+    def _replace(self, items: List[ObjectDict]) -> None:
+        """client-go Reflector/DeltaFIFO Replace semantics for a SYNC
+        snapshot (watch (re)connect): the snapshot is authoritative — every
+        item upserts through the normal rv-staleness-checked path, and
+        cached keys absent from it get a synthesized DELETED, so an object
+        deleted during a watch gap can never linger as a phantom (with
+        cached reads, a phantom would make reconcilers skip recreation or
+        loop on NotFound forever — there is no resync timer to heal it)."""
+        with self._lock:
+            snapshot_keys = {object_key(o) for o in items}
+            # no copy needed: _on_event(DELETED) pops the entry and deep-
+            # copies before notifying handlers; nothing mutates it between
+            stale = [o for k, o in self._cache.items() if k not in snapshot_keys]
+        for obj in items:
+            self._on_event(ADDED, obj)
+        for old in stale:
+            self._on_event(DELETED, old)
+        self._synced.set()
 
     # -- cache reads --------------------------------------------------------
 

@@ -109,11 +109,12 @@ class Manager:
             self._leader.start()
             if wait_for_leader:
                 self._leader.wait_for_leadership()
-        # Informers first: each Informer.start() lists synchronously, so by
-        # the time workers start every cache has synced — the equivalent of
-        # controller-runtime blocking workers on WaitForCacheSync. _started
-        # is set only after this loop; informer_for holds the lifecycle lock,
-        # so an informer is started exactly once.
+        # Informers first: each Informer.start() awaits its watch's initial
+        # SYNC snapshot, so by the time workers start every cache has synced
+        # — the equivalent of controller-runtime blocking workers on
+        # WaitForCacheSync. _started is set only after this loop;
+        # informer_for holds the lifecycle lock, so an informer is started
+        # exactly once.
         for informer in list(self._informers.values()):
             informer.start()
         for controller in self._controllers:

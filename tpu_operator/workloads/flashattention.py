@@ -951,8 +951,11 @@ def flash_attention(
     not O(S²). ``segment_ids`` (B, S) int restricts attention to
     same-segment pairs — packed-sequence training, the standard way to
     batch variable-length documents; composes with causal, GQA, and
-    window, and every block takes the masked path (a segment boundary
-    can fall anywhere)."""
+    window. Interior blocks whose q- and k-columns are seg-uniform and
+    matching keep the unmasked fast path (a min/max reduce on the id
+    columns proves uniformity); only blocks straddling a segment
+    boundary pay for mask construction (see _dispatch_block and
+    docs/design.md)."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
