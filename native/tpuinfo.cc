@@ -25,6 +25,18 @@ bool starts_with(const char* s, const char* prefix) {
   return std::strncmp(s, prefix, std::strlen(prefix)) == 0;
 }
 
+// TPUINFO_SCAN_ROOT prefixes every probed path (default ""): containers
+// that mount the host filesystem somewhere other than / (e.g. /host) and
+// tests that simulate a device inventory in a scratch directory both
+// point the probe at their root.
+std::string scan_root() {
+  const char* env = std::getenv("TPUINFO_SCAN_ROOT");
+  if (env == nullptr || env[0] == '\0') return "";
+  std::string root(env);
+  while (!root.empty() && root.back() == '/') root.pop_back();
+  return root;
+}
+
 std::vector<std::string> list_dir(const char* path, const char* prefix) {
   std::vector<std::string> out;
   DIR* dir = ::opendir(path);
@@ -47,9 +59,11 @@ extern "C" {
 // Writes a JSON report into buf (NUL-terminated). Returns the number of
 // bytes written (excluding NUL), or -1 if the buffer is too small.
 int tpuinfo_probe(char* buf, int len) {
-  std::vector<std::string> devices = list_dir("/dev", "accel");
-  std::vector<std::string> sys_devices = list_dir("/sys/class/accel", "accel");
-  std::vector<std::string> vfio = list_dir("/dev/vfio", "");
+  const std::string root = scan_root();
+  std::vector<std::string> devices = list_dir((root + "/dev").c_str(), "accel");
+  std::vector<std::string> sys_devices =
+      list_dir((root + "/sys/class/accel").c_str(), "accel");
+  std::vector<std::string> vfio = list_dir((root + "/dev/vfio").c_str(), "");
   // /dev/accel and sysfs describe the same chips; take the larger view.
   int chip_count = static_cast<int>(
       devices.size() > sys_devices.size() ? devices.size() : sys_devices.size());
@@ -98,8 +112,10 @@ int tpuinfo_chip_coords(int chip_count, char* buf, int len) {
   }
   if (bx <= 0 || by <= 0 || bz <= 0) {
     if (chip_count <= 0) {
-      std::vector<std::string> devices = list_dir("/dev", "accel");
-      std::vector<std::string> sys_devices = list_dir("/sys/class/accel", "accel");
+      const std::string root = scan_root();
+      std::vector<std::string> devices = list_dir((root + "/dev").c_str(), "accel");
+      std::vector<std::string> sys_devices =
+          list_dir((root + "/sys/class/accel").c_str(), "accel");
       chip_count = static_cast<int>(
           devices.size() > sys_devices.size() ? devices.size() : sys_devices.size());
     }

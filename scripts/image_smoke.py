@@ -280,6 +280,36 @@ def smoke_entrypoints(wrappers: dict, harness: Harness) -> None:
     finish(proc)
     print("ok: tpu-feature-discovery published node labels over TLS")
 
+    # tpu-node-discovery: the NFD-analog bootstrap — a bare (non-GKE) node
+    # plus a simulated /dev/accel* inventory must come out labelled
+    from tpu_operator.kube.sim import make_bare_node
+
+    harness.store.create(make_bare_node("bare-0"))
+    scan_root = os.path.join(harness.tmp, "scanroot")
+    os.makedirs(os.path.join(scan_root, "dev"))
+    for i in range(4):
+        open(os.path.join(scan_root, "dev", f"accel{i}"), "w").close()
+    proc = spawn(
+        check("tpu-node-discovery"),
+        [],
+        harness.env(
+            NODE_NAME="bare-0",
+            TPUINFO_SCAN_ROOT=scan_root,
+            TPU_ACCELERATOR_TYPE="v5litepod-4",
+            TPU_TOPOLOGY="",  # override anything the axon runtime injected
+        ),
+    )
+    wait_for(
+        "tpu-node-discovery labels",
+        lambda: (harness.store.get("v1", "Node", "bare-0")["metadata"].get("labels") or {}).get(
+            consts.TFD_ACCELERATOR_TYPE_LABEL
+        )
+        == "tpu-v5-lite-podslice",
+        proc,
+    )
+    finish(proc)
+    print("ok: tpu-node-discovery labelled a bare node from the device probe")
+
     # tpu-slice-manager: renders gang Service/ConfigMap for the 2-host pool
     proc = spawn(check("tpu-slice-manager"), [], harness.env())
     wait_for(

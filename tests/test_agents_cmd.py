@@ -33,7 +33,13 @@ class TestTFDAgent:
         # second pass: no change
         assert agent.apply_once() is False
 
-    def test_removes_labels_when_tpu_gone(self):
+    def test_removes_labels_when_tpu_gone(self, tmp_path, monkeypatch):
+        # pin the device probe to an empty inventory: "TPU gone" must mean
+        # no GKE label AND no local hardware, or tfd's own published
+        # labels would keep the node looking like a TPU forever (the
+        # tpu_info bootstrap fallback reads them)
+        (tmp_path / "dev").mkdir()
+        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
         client = FakeClient()
         client.create(make_tpu_node("tpu-0"))
         agent = TFDAgent(client, "tpu-0")
@@ -44,6 +50,33 @@ class TestTFDAgent:
         assert agent.apply_once() is True
         labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
         assert not any(k in labels for k in consts.TFD_LABELS)
+
+    def test_keeps_discovery_labels_on_selfmanaged_node(self, tmp_path, monkeypatch):
+        """Self-managed regime: no GKE labels, but hardware is present and
+        the node-discovery bootstrap published the base labels. tfd must
+        enrich (slice-hosts, generation), never strip."""
+        (tmp_path / "dev").mkdir()
+        for i in range(4):
+            (tmp_path / "dev" / f"accel{i}").touch()
+        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+        from tpu_operator.kube.sim import make_bare_node
+
+        client = FakeClient()
+        client.create(
+            make_bare_node(
+                "bare-0",
+                extra_labels={
+                    consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                    consts.TFD_TOPOLOGY_LABEL: "4x4",
+                },
+            )
+        )
+        assert TFDAgent(client, "bare-0").apply_once() is True
+        labels = client.get("v1", "Node", "bare-0")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v5-lite-podslice"
+        assert labels[consts.TFD_SLICE_HOSTS_LABEL] == "4"
+        assert labels[consts.TFD_TPU_GENERATION_LABEL] == "v5e"
+        assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
 
 
 class TestSliceManagerAgent:

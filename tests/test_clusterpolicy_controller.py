@@ -50,8 +50,10 @@ class TestReconcileDirect:
         assert cp["status"]["state"] == "ready"
         reasons = {c["type"]: c["reason"] for c in cp["status"]["conditions"]}
         assert reasons["Ready"] == "NoTPUNodes"
-        # no operand daemonsets created
-        assert client.list("apps/v1", "DaemonSet", NS) == []
+        # no gated operand daemonsets created — only the discovery
+        # bootstrap, which by design deploys before any node is recognized
+        dses = client.list("apps/v1", "DaemonSet", NS)
+        assert [d["metadata"]["name"] for d in dses] == ["tpu-node-discovery"]
 
     def test_tpu_nodes_get_labelled(self):
         client = FakeClient()
@@ -141,7 +143,7 @@ class TestEndToEnd:
                 if get_cp(client).get("status", {}).get("state") != "ready":
                     return False
                 dses = client.list("apps/v1", "DaemonSet", NS)
-                return len(dses) == 7 and all(
+                return len(dses) == 8 and all(
                     ds.get("status", {}).get("desiredNumberScheduled") == 4
                     and ds["status"].get("numberAvailable") == 4
                     for ds in dses
@@ -150,7 +152,7 @@ class TestEndToEnd:
             assert wait_for(settled, timeout=15), get_cp(client).get("status")
             # sim created operand pods on every node
             pods = client.list("v1", "Pod", NS)
-            assert len(pods) == 28
+            assert len(pods) == 32  # 8 DaemonSets x 4 nodes
         finally:
             mgr.stop()
             sim.stop()
@@ -165,15 +167,18 @@ class TestEndToEnd:
             mgr.start()
             client.create(new_cluster_policy())
             assert wait_for(lambda: get_cp(client).get("status", {}).get("state") == "ready", timeout=10)
-            # no TPU nodes yet -> no DSes
-            assert client.list("apps/v1", "DaemonSet", NS) == []
+            # no TPU nodes yet -> only the discovery bootstrap deploys
+            # (it exists precisely to find TPU nodes; every gated operand
+            # waits for recognition)
+            dses = client.list("apps/v1", "DaemonSet", NS)
+            assert [d["metadata"]["name"] for d in dses] == ["tpu-node-discovery"]
             client.create(make_tpu_node("tpu-late"))
             assert wait_for(
                 lambda: client.get("v1", "Node", "tpu-late")["metadata"]["labels"].get(consts.TPU_PRESENT_LABEL)
                 == "true",
                 timeout=10,
             )
-            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 7, timeout=10)
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 8, timeout=10)
         finally:
             mgr.stop()
             sim.stop()

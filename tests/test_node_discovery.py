@@ -1,0 +1,299 @@
+"""Node-discovery bootstrap: the NFD analog for non-GKE clusters.
+
+Reference: NFD's PCI scan labels GPU nodes on ANY cluster
+(state_manager.go:113-117); the gpu-operator then stamps its own state
+labels from those (state_manager.go:481-581). These tests prove the TPU
+equivalent: a node with NO cloud.google.com/* labels but real (simulated)
+/dev/accel* hardware ends up fully labelled and the gated operands
+deploy.
+"""
+
+import os
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.node_discovery_agent import (
+    NodeDiscoveryAgent,
+    parse_vm_accelerator_type,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import make_bare_node, make_tpu_node
+from tpu_operator.nodeinfo import is_tpu_node, tpu_info
+
+NS = "tpu-operator"
+
+
+def _clear_ambient_tpu_env(monkeypatch):
+    # the axon jax plugin injects TPU_TOPOLOGY etc. into this process at
+    # interpreter startup (sitecustomize) — invisible to the shell, but
+    # discover() would read them as the VM contract
+    for var in ("TPU_TOPOLOGY", "TPU_ACCELERATOR_TYPE", "TPU_CHIPS_PER_HOST_BOUNDS"):
+        monkeypatch.delenv(var, raising=False)
+
+
+@pytest.fixture()
+def dev_root(tmp_path, monkeypatch):
+    """A simulated TPU-VM device inventory: 4 chips under a scratch root
+    the native/python probe scans via TPUINFO_SCAN_ROOT."""
+    _clear_ambient_tpu_env(monkeypatch)
+    (tmp_path / "dev").mkdir()
+    for i in range(4):
+        (tmp_path / "dev" / f"accel{i}").touch()
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+    return tmp_path
+
+
+@pytest.fixture()
+def empty_root(tmp_path, monkeypatch):
+    _clear_ambient_tpu_env(monkeypatch)
+    (tmp_path / "dev").mkdir()
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+    return tmp_path
+
+
+class TestVMTypeParsing:
+    def test_known_generations(self):
+        assert parse_vm_accelerator_type("v5litepod-16") == ("tpu-v5-lite-podslice", 16)
+        assert parse_vm_accelerator_type("v4-32") == ("tpu-v4-podslice", 16)
+        assert parse_vm_accelerator_type("v5p-8") == ("tpu-v5p-slice", 4)
+        assert parse_vm_accelerator_type("v6e-4") == ("tpu-v6e-slice", 4)
+
+    def test_unknown_strings(self):
+        assert parse_vm_accelerator_type("") is None
+        assert parse_vm_accelerator_type("a100-80gb") is None
+        assert parse_vm_accelerator_type("v5litepod") is None
+
+
+class TestDiscoveryAgent:
+    def test_probe_and_stamp_with_vm_type(self, dev_root, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+        client = FakeClient()
+        client.create(make_bare_node("bare-0"))
+        agent = NodeDiscoveryAgent(client, "bare-0")
+        assert agent.apply_once()
+        labels = client.get("v1", "Node", "bare-0")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v5-lite-podslice"
+        assert labels[consts.TFD_TOPOLOGY_LABEL] == "4x4"  # 16 chips, 2D
+        assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
+        # idempotent: second pass sees no diff
+        assert not agent.apply_once()
+
+    def test_stamp_without_vm_type_still_recognizable(self, dev_root):
+        """No TPU_ACCELERATOR_TYPE env: the node is still recognized as a
+        TPU node from the probed inventory alone (degraded, not blocked)."""
+        client = FakeClient()
+        client.create(make_bare_node("bare-1"))
+        NodeDiscoveryAgent(client, "bare-1").apply_once()
+        node = client.get("v1", "Node", "bare-1")
+        assert is_tpu_node(node)
+        info = tpu_info(node)
+        # catalog miss: the probed local chip count stands in
+        assert info.chips_per_node == 4
+        assert info.slice_hosts == 1
+
+    def test_topology_env_override(self, dev_root, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")
+        client = FakeClient()
+        client.create(make_bare_node("bare-2"))
+        NodeDiscoveryAgent(client, "bare-2").apply_once()
+        labels = client.get("v1", "Node", "bare-2")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v4-podslice"
+        assert labels[consts.TFD_TOPOLOGY_LABEL] == "2x2x4"
+
+    def test_no_hardware_publishes_nothing(self, empty_root):
+        client = FakeClient()
+        client.create(make_bare_node("cpu-0"))
+        assert not NodeDiscoveryAgent(client, "cpu-0").apply_once()
+        labels = client.get("v1", "Node", "cpu-0")["metadata"]["labels"]
+        assert not any(k in labels for k in consts.TFD_LABELS)
+
+    def test_hardware_gone_strips_labels(self, empty_root):
+        client = FakeClient()
+        client.create(
+            make_bare_node(
+                "bare-3",
+                extra_labels={
+                    consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                    consts.TFD_CHIPS_PER_NODE_LABEL: "4",
+                },
+            )
+        )
+        assert NodeDiscoveryAgent(client, "bare-3").apply_once()
+        labels = client.get("v1", "Node", "bare-3")["metadata"]["labels"]
+        assert not any(k in labels for k in consts.TFD_LABELS)
+
+    def test_probe_failure_never_strips(self, empty_root, monkeypatch):
+        """One bad probe tick must not tear down a labelled node: stripping
+        requires a SUCCESSFUL probe that saw no hardware."""
+        client = FakeClient()
+        client.create(
+            make_bare_node(
+                "bare-4",
+                extra_labels={
+                    consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                    consts.TFD_CHIPS_PER_NODE_LABEL: "4",
+                },
+            )
+        )
+        agent = NodeDiscoveryAgent(client, "bare-4")
+        monkeypatch.setattr(NodeDiscoveryAgent, "probe_chips", staticmethod(lambda: None))
+        assert not agent.apply_once()
+        labels = client.get("v1", "Node", "bare-4")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v5-lite-podslice"
+
+    def test_gke_node_never_gets_identity_guesses(self, dev_root, monkeypatch):
+        """On a GKE-labelled node the probe publishes only directly
+        measured facts (chip count) — never the guessed accelerator-type,
+        which would persist wrongly whenever tfd is disabled."""
+        monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+        client = FakeClient()
+        client.create(make_tpu_node("gke-1", "tpu-v5p-slice", "2x2x1"))
+        NodeDiscoveryAgent(client, "gke-1").apply_once()
+        labels = client.get("v1", "Node", "gke-1")["metadata"]["labels"]
+        assert consts.TFD_ACCELERATOR_TYPE_LABEL not in labels
+        assert consts.TFD_TOPOLOGY_LABEL not in labels
+        assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
+
+    def test_gke_labels_are_authoritative(self, dev_root, monkeypatch):
+        """On GKE the platform labels (and the tfd operand's richer
+        publication) own tpu.google.com/*; the probe must not overwrite
+        an existing value with its guess."""
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+        client = FakeClient()
+        node = make_tpu_node("gke-0", "tpu-v6e-slice", "2x2")
+        node["metadata"]["labels"][consts.TFD_ACCELERATOR_TYPE_LABEL] = "tpu-v6e-slice"
+        client.create(node)
+        NodeDiscoveryAgent(client, "gke-0").apply_once()
+        labels = client.get("v1", "Node", "gke-0")["metadata"]["labels"]
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v6e-slice"
+        # additive facts (chips-per-node was absent) may still land
+        assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
+
+
+class TestNodeinfoFallback:
+    def test_tpu_info_from_discovery_labels(self):
+        node = make_bare_node(
+            "n0",
+            extra_labels={
+                consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                consts.TFD_TOPOLOGY_LABEL: "4x4",
+            },
+        )
+        info = tpu_info(node)
+        assert info is not None
+        assert info.generation == "v5e"
+        assert info.chips_in_slice == 16
+        assert info.slice_hosts == 4
+
+    def test_gke_labels_win_over_discovery(self):
+        node = make_tpu_node(
+            "n1",
+            "tpu-v5p-slice",
+            "2x2x1",
+            extra_labels={
+                consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                consts.TFD_TOPOLOGY_LABEL: "4x4",
+            },
+        )
+        assert tpu_info(node).generation == "v5p"
+
+    def test_bare_node_is_not_tpu(self):
+        assert not is_tpu_node(make_bare_node("n2"))
+
+    def test_nodepool_selector_uses_discovery_labels(self):
+        """Self-managed pools must select on the labels their nodes
+        actually carry — a GKE-label selector would match zero nodes and
+        hang every per-pool TPUSlice DaemonSet."""
+        from tpu_operator.nodepool import get_node_pools
+
+        nodes = [
+            make_bare_node(
+                f"n{i}",
+                extra_labels={
+                    consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+                    consts.TFD_TOPOLOGY_LABEL: "4x4",
+                },
+            )
+            for i in range(2)
+        ]
+        (pool,) = get_node_pools(nodes)
+        assert pool.selector == {
+            consts.TFD_ACCELERATOR_TYPE_LABEL: "tpu-v5-lite-podslice",
+            consts.TFD_TOPOLOGY_LABEL: "4x4",
+        }
+        # every pool node actually matches its own selector
+        for node in nodes:
+            labels = node["metadata"]["labels"]
+            assert all(labels.get(k) == v for k, v in pool.selector.items())
+
+    def test_nodepool_selector_keeps_gke_labels_on_gke(self):
+        from tpu_operator.nodepool import get_node_pools
+
+        (pool,) = get_node_pools([make_tpu_node("g0", "tpu-v5-lite-podslice", "4x4")])
+        assert consts.GKE_TPU_ACCELERATOR_LABEL in pool.selector
+
+
+class TestBootstrapEndToEnd:
+    def test_unlabelled_node_with_hardware_gets_operands(self, dev_root, monkeypatch):
+        """The verdict-r4 'done' criterion: a node with NO cloud.google.com
+        labels but a simulated /dev/accel* inventory ends up fully labelled
+        and the gated operand DaemonSets deploy. Flow: operator installs →
+        only the discovery bootstrap deploys (no recognized TPU nodes) →
+        the discovery agent (standing in for its DaemonSet pod) probes and
+        stamps tpu.google.com labels → the node watch re-reconciles →
+        deploy gates stamp → all operands deploy."""
+        import time
+
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+        from tpu_operator.controllers.clusterpolicy_controller import (
+            ClusterPolicyReconciler,
+            setup_with_manager,
+        )
+        from tpu_operator.kube.manager import Manager
+        from tpu_operator.kube.sim import ClusterSim
+
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+        client = FakeClient()
+        client.create(make_bare_node("selfmanaged-0"))
+        sim = ClusterSim(client, ready_delay=0.0).start()
+        mgr = Manager(client, namespace=NS)
+        setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+
+        def wait_for(fn, timeout=15.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if fn():
+                    return True
+                time.sleep(0.05)
+            return False
+
+        try:
+            mgr.start()
+            client.create(new_cluster_policy())
+            # phase 1: nothing recognized — only the bootstrap DS exists
+            assert wait_for(
+                lambda: [d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)]
+                == ["tpu-node-discovery"]
+            ), client.list("apps/v1", "DaemonSet", NS)
+            # phase 2: the discovery pod the sim scheduled runs its probe
+            assert NodeDiscoveryAgent(client, "selfmanaged-0").apply_once()
+            # phase 3: recognition cascades — present + deploy gates stamp,
+            # every gated operand DaemonSet deploys
+            assert wait_for(
+                lambda: client.get("v1", "Node", "selfmanaged-0")["metadata"]["labels"].get(
+                    consts.TPU_PRESENT_LABEL
+                )
+                == "true"
+            )
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 8), [
+                d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)
+            ]
+            labels = client.get("v1", "Node", "selfmanaged-0")["metadata"]["labels"]
+            assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v5-lite-podslice"
+            assert labels[consts.TFD_TOPOLOGY_LABEL] == "2x2"
+            assert not any(k.startswith("cloud.google.com/") for k in labels)
+        finally:
+            mgr.stop()
+            sim.stop()

@@ -72,8 +72,16 @@ class TestStateRendering:
                 found += 1
                 sel = obj["spec"]["template"]["spec"]["nodeSelector"]
                 deploy_keys = [k for k in sel if k.startswith(consts.COMMON_DEPLOY_LABEL_PREFIX)]
-                assert deploy_keys, (name, sel)
-        assert found == 7  # libtpu, plugin, validation, tfd, slice-mgr, metrics, node-status
+                if name == "state-node-discovery":
+                    # the bootstrap's contract is the inverse: it must reach
+                    # nodes the operator has NOT recognized yet, so a
+                    # tpu.deploy.* gate would defeat it (NFD-worker model)
+                    assert not deploy_keys, (name, sel)
+                else:
+                    assert deploy_keys, (name, sel)
+        # discovery, libtpu, plugin, validation, tfd, slice-mgr, metrics,
+        # node-status
+        assert found == 8
 
     def test_custom_images_and_env_flow_into_daemonset(self):
         catalog = make_catalog(

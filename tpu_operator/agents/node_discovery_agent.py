@@ -1,0 +1,204 @@
+"""tpu-node-discovery agent: the NFD-analog bootstrap.
+
+The reference recognizes GPU nodes anywhere because NFD's PCI scan
+(pci-10de → ``nvidia.com/gpu.present``) runs on every node of any
+cluster (state_manager.go:113-117). This operator's GKE path instead
+consumes the ``cloud.google.com/gke-tpu-*`` labels — which nothing
+stamps on a self-managed TPU-VM cluster, and the tfd DaemonSet that
+could probe hardware only schedules on nodes already recognized as TPU
+nodes (a circular dependency).
+
+This agent breaks the circle. Its DaemonSet (state-node-discovery)
+schedules on EVERY Linux node with no TPU gate and no validation
+barriers, probes the kernel's accelerator inventory with the native
+``tpuinfo`` probe (/dev/accel*, /sys/class/accel), and — when chips are
+present — publishes the vendor-neutral ``tpu.google.com/*`` labels that
+``nodeinfo.tpu_info`` accepts as an alternative to GKE's. From there the
+normal flow takes over: the ClusterPolicy reconciler stamps
+``tpu.present`` + per-operand deploy gates and the operand DaemonSets
+schedule, exactly as on GKE.
+
+Accelerator identity: the Cloud TPU VM runtime contract publishes
+``TPU_ACCELERATOR_TYPE`` (e.g. "v5litepod-16") and optionally
+``TPU_TOPOLOGY`` in the VM environment; when present they are mapped to
+the catalog types. Without them the node is still recognized (type
+``tpu-unknown-device``) and the probed local chip count stands in for
+catalog attributes — discovery degrades, it never blocks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import time
+from typing import Dict, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger(__name__)
+
+# Accelerator type published when hardware is present but the VM
+# environment does not identify the generation. nodeinfo treats catalog
+# misses gracefully (probed chip count stands in for chips_per_host).
+UNKNOWN_ACCELERATOR = "tpu-unknown-device"
+
+# Cloud TPU VM accelerator-type strings → (catalog type, chips per
+# TensorCore-count divisor). v4/v5p type strings count TensorCores
+# (2 per chip); v5e/v6e strings count chips directly.
+_VM_TYPE_PATTERNS: Tuple[Tuple[str, str, int], ...] = (
+    (r"^v4-(\d+)$", "tpu-v4-podslice", 2),
+    (r"^v5litepod-(\d+)$", "tpu-v5-lite-podslice", 1),
+    (r"^v5p-(\d+)$", "tpu-v5p-slice", 2),
+    (r"^v6e-(\d+)$", "tpu-v6e-slice", 1),
+)
+
+# 2D slice topologies by chip count (v5e/v6e podslice shapes). 3D
+# generations (v4/v5p) are ambiguous by count alone and require
+# TPU_TOPOLOGY.
+_2D_TOPOLOGY_BY_CHIPS = {
+    1: "1x1",
+    4: "2x2",
+    8: "2x4",
+    16: "4x4",
+    32: "4x8",
+    64: "8x8",
+    128: "8x16",
+    256: "16x16",
+}
+
+
+def parse_vm_accelerator_type(vm_type: str) -> Optional[Tuple[str, int]]:
+    """"v5litepod-16" → ("tpu-v5-lite-podslice", 16 chips); None when the
+    string matches no known generation."""
+    for pattern, catalog_type, divisor in _VM_TYPE_PATTERNS:
+        m = re.match(pattern, vm_type.strip())
+        if m:
+            return catalog_type, max(1, int(m.group(1)) // divisor)
+    return None
+
+
+class NodeDiscoveryAgent:
+    """Probe local TPU hardware and publish discovery labels on the Node."""
+
+    def __init__(self, client: Client, node_name: str, interval: float = 60.0):
+        self.client = client
+        self.node_name = node_name
+        self.interval = interval
+
+    # -- discovery -----------------------------------------------------------
+
+    @staticmethod
+    def probe_chips() -> Optional[int]:
+        """Locally visible chip count; None when the probe itself failed.
+        The distinction matters: a successful probe of an empty inventory
+        justifies stripping labels, a transient failure must not (it would
+        tear down every gated operand on the node for one bad tick)."""
+        try:
+            from tpu_operator.native import tpuinfo
+
+            return int(tpuinfo.probe().get("chip_count") or 0)
+        except Exception:  # noqa: BLE001 — probe machinery failed
+            return None
+
+    def discover(self) -> Optional[Dict[str, str]]:
+        """Labels to publish: empty when a successful probe saw no TPU
+        hardware, None when the probe failed (indeterminate — change
+        nothing this tick)."""
+        chips = self.probe_chips()
+        if chips is None:
+            return None
+        if chips <= 0:
+            return {}
+        labels = {consts.TFD_CHIPS_PER_NODE_LABEL: str(chips)}
+        acc_type = UNKNOWN_ACCELERATOR
+        topology = os.environ.get("TPU_TOPOLOGY", "").strip()
+        slice_chips = 0
+        vm_type = os.environ.get("TPU_ACCELERATOR_TYPE", "").strip()
+        parsed = parse_vm_accelerator_type(vm_type) if vm_type else None
+        if parsed:
+            acc_type, slice_chips = parsed
+            if not topology and acc_type in ("tpu-v5-lite-podslice", "tpu-v6e-slice"):
+                topology = _2D_TOPOLOGY_BY_CHIPS.get(slice_chips, "")
+        labels[consts.TFD_ACCELERATOR_TYPE_LABEL] = acc_type
+        if topology:
+            labels[consts.TFD_TOPOLOGY_LABEL] = topology
+        return labels
+
+    # -- publication ---------------------------------------------------------
+
+    def apply_once(self) -> bool:
+        """Stamp discovery labels when they differ; strip them when a
+        successful probe found no hardware AND the node has no GKE
+        accelerator label (on GKE the tfd operand owns the tpu.google.com
+        labels — never fight it). A failed probe changes nothing."""
+        want = self.discover()
+        if want is None:
+            return False  # indeterminate probe: keep current state
+        try:
+            node = self.client.get("v1", "Node", self.node_name)
+        except errors.NotFound:
+            return False
+        labels = node["metadata"].setdefault("labels", {})
+        changed = False
+        if want:
+            # On a GKE-labelled node the platform (and the tfd operand's
+            # richer publication) own TPU identity: publish only directly
+            # probed facts (chip count), never the env/count-derived
+            # identity guesses — a guessed accelerator-type could persist
+            # wrongly whenever tfd is disabled or hasn't run yet.
+            if labels.get(consts.GKE_TPU_ACCELERATOR_LABEL):
+                want = {
+                    k: v
+                    for k, v in want.items()
+                    if k == consts.TFD_CHIPS_PER_NODE_LABEL
+                }
+            for key, value in want.items():
+                if labels.get(key) != value:
+                    labels[key] = value
+                    changed = True
+        elif not labels.get(consts.GKE_TPU_ACCELERATOR_LABEL):
+            for key in consts.TFD_LABELS:
+                if key in labels:
+                    del labels[key]
+                    changed = True
+        if changed:
+            try:
+                self.client.update(node)
+            except errors.Conflict:
+                return False  # node moved under us; next tick retries
+        return changed
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.apply_once()
+            except errors.ApiError as e:
+                log.warning("node-discovery: %s", e)
+            time.sleep(self.interval)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("NODE_NAME required")
+        return 1
+    from tpu_operator.kube.http_client import HttpClient
+
+    try:
+        interval = float(os.environ.get("DISCOVERY_SLEEP_INTERVAL", "60").strip())
+    except ValueError:
+        log.warning(
+            "invalid DISCOVERY_SLEEP_INTERVAL %r; using 60s",
+            os.environ.get("DISCOVERY_SLEEP_INTERVAL"),
+        )
+        interval = 60.0
+    NodeDiscoveryAgent(HttpClient.in_cluster(), node_name, interval=interval).run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,7 @@ from typing import Optional
 from tpu_operator import consts
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import deep_copy
 from tpu_operator.nodeinfo import tfd_labels, tpu_info
 
 log = logging.getLogger(__name__)
@@ -28,33 +29,56 @@ class TFDAgent:
         self.node_name = node_name
         self.interval = interval
 
-    def discover(self) -> dict:
-        """Labels to publish for this node. The GKE labels are the source
-        of truth for slice identity; the native probe (native/tpuinfo)
-        contributes the locally-visible chip count when present."""
+    def discover(self) -> Optional[dict]:
+        """Labels to publish for this node ({} = strip ours, None =
+        indeterminate, change nothing). The GKE labels are the source of
+        truth for slice identity; the native probe (native/tpuinfo)
+        contributes the locally-visible chip count when present.
+
+        tpu_info's bootstrap fallback reads the tpu.google.com labels this
+        very agent publishes — so discovery here must start from the
+        GKE-only view, or a node whose GKE label disappeared would keep
+        looking like a TPU node off our own stale publication forever. The
+        fallback view is consulted only when local hardware actually
+        exists (the self-managed regime, where the node-discovery
+        bootstrap owns the base labels and this agent enriches them)."""
         node = self.client.get("v1", "Node", self.node_name)
-        info = tpu_info(node)
+        gke_view = deep_copy(node)
+        gke_labels = gke_view["metadata"].get("labels") or {}
+        for key in consts.TFD_LABELS:
+            gke_labels.pop(key, None)
+        info = tpu_info(gke_view)
+        chips = self._probe_local_chips()  # probe ONCE; reused below
+        if info is None and chips:
+            info = tpu_info(node)  # discovery-published base labels
         if info is None:
+            if chips is None and tpu_info(node) is not None:
+                # no GKE identity, probe failed, but discovery labels
+                # exist: indeterminate — never strip on a bad probe tick
+                return None
             return {}
         labels = tfd_labels(info)
-        chips = self._probe_local_chips()
-        if chips is not None:
+        if chips:  # successful probe that saw chips; 0 keeps catalog value
             labels[consts.TFD_CHIPS_PER_NODE_LABEL] = str(chips)
         return labels
 
     @staticmethod
     def _probe_local_chips() -> Optional[int]:
+        """Locally visible chip count; None when the probe machinery
+        failed (distinct from a successful probe seeing 0 chips — only
+        the latter may justify treating hardware as absent)."""
         try:
             from tpu_operator.native import tpuinfo
 
-            report = tpuinfo.probe()
-            return report["chip_count"] if report.get("chip_count") else None
+            return int(tpuinfo.probe().get("chip_count") or 0)
         except Exception:  # noqa: BLE001 — native probe is best-effort
             return None
 
     def apply_once(self) -> bool:
         """Patch the node when discovery differs from current labels."""
         want = self.discover()
+        if want is None:
+            return False  # indeterminate probe tick: keep current state
         try:
             node = self.client.get("v1", "Node", self.node_name)
         except errors.NotFound:

@@ -160,6 +160,16 @@ class TPUFeatureDiscoverySpec(ComponentCommon):
 
 
 @dataclasses.dataclass
+class NodeDiscoverySpec(ComponentCommon):
+    """NFD-analog bootstrap: a gate-free DaemonSet on every Linux node
+    that probes /dev/accel* (native tpuinfo) and publishes the
+    tpu.google.com accelerator labels, so self-managed (non-GKE) TPU-VM
+    clusters are recognized without anyone stamping the
+    cloud.google.com/gke-tpu-* labels (reference: the NFD worker the
+    gpu-operator chart deploys, feeding state_manager.go:113-117)."""
+
+
+@dataclasses.dataclass
 class SliceManagerConfigSpec(SpecBase):
     name: str = field(default="")
     default: str = field(default="")
@@ -260,6 +270,7 @@ class ClusterPolicySpec(SpecBase):
     libtpu: LibtpuSpec = sub(LibtpuSpec)
     device_plugin: DevicePluginSpec = sub(DevicePluginSpec, json="devicePlugin")
     tpu_feature_discovery: TPUFeatureDiscoverySpec = sub(TPUFeatureDiscoverySpec, json="tfd")
+    node_discovery: NodeDiscoverySpec = sub(NodeDiscoverySpec, json="nodeDiscovery")
     slice_manager: SliceManagerSpec = sub(SliceManagerSpec, json="sliceManager")
     metrics_exporter: MetricsExporterSpec = sub(MetricsExporterSpec, json="metricsExporter")
     node_status_exporter: NodeStatusExporterSpec = sub(NodeStatusExporterSpec, json="nodeStatusExporter")

@@ -15,6 +15,9 @@ DEFAULTS = {
     "libtpu": ("LIBTPU_INSTALLER_IMAGE", "gcr.io/tpu-operator/libtpu-installer:1.0.0"),
     "device_plugin": ("TPU_DEVICE_PLUGIN_IMAGE", "gcr.io/tpu-operator/tpu-device-plugin:1.0.0"),
     "tfd": ("TPU_FEATURE_DISCOVERY_IMAGE", "gcr.io/tpu-operator/tpu-feature-discovery:1.0.0"),
+    # the discovery bootstrap ships in the validator/agents image (same
+    # codebase as the other agents; shim: tpu-node-discovery)
+    "node_discovery": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
     "slice_manager": ("TPU_SLICE_MANAGER_IMAGE", "gcr.io/tpu-operator/tpu-slice-manager:1.0.0"),
     "metrics_exporter": ("TPU_METRICS_EXPORTER_IMAGE", "gcr.io/tpu-operator/tpu-metrics-exporter:1.0.0"),
     "node_status_exporter": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),

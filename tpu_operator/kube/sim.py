@@ -178,6 +178,7 @@ def make_tpu_node(
         "cloud.google.com/gke-tpu-topology": topology,
         "cloud.google.com/gke-nodepool": nodepool,
         "kubernetes.io/hostname": name,
+        "kubernetes.io/os": "linux",  # kubelets always set this
     }
     labels.update(extra_labels or {})
     return new_object(
@@ -192,6 +193,27 @@ def make_tpu_node(
             "nodeInfo": {
                 "containerRuntimeVersion": "containerd://1.7.10",
                 "kubeletVersion": "v1.29.1-gke.100",
+            },
+        },
+    )
+
+
+def make_bare_node(name: str, extra_labels: Optional[dict] = None) -> dict:
+    """A node with NO cloud labels — what a self-managed TPU-VM cluster
+    presents before the node-discovery bootstrap runs. Carries only what
+    every kubelet stamps (hostname, os)."""
+    labels = {"kubernetes.io/hostname": name, "kubernetes.io/os": "linux"}
+    labels.update(extra_labels or {})
+    return new_object(
+        "v1",
+        "Node",
+        name,
+        labels=labels,
+        spec={},
+        status={
+            "nodeInfo": {
+                "containerRuntimeVersion": "containerd://1.7.10",
+                "kubeletVersion": "v1.29.1",
             },
         },
     )

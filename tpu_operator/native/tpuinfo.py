@@ -63,10 +63,18 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
 
 
+def _scan_root() -> str:
+    """TPUINFO_SCAN_ROOT prefixes every probed path (same contract as the
+    native probe): host-mounted-at-/host containers and simulated-device
+    tests both point the scan at their root."""
+    return os.environ.get("TPUINFO_SCAN_ROOT", "").rstrip("/")
+
+
 def _python_probe() -> dict:
-    devices = sorted(glob.glob("/dev/accel*"))
-    sys_devices = sorted(glob.glob("/sys/class/accel/accel*"))
-    vfio = [p for p in glob.glob("/dev/vfio/*") if not p.endswith("/vfio")]
+    root = _scan_root()
+    devices = sorted(glob.glob(f"{root}/dev/accel*"))
+    sys_devices = sorted(glob.glob(f"{root}/sys/class/accel/accel*"))
+    vfio = [p for p in glob.glob(f"{root}/dev/vfio/*") if not p.endswith("/vfio")]
     return {
         "chip_count": max(len(devices), len(sys_devices)),
         "devices": devices,

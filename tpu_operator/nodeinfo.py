@@ -3,9 +3,20 @@
 Analog of ``internal/nodeinfo`` (node_info.go:34-57, attributes.go:43) —
 but where the reference derives attributes from NFD's PCI scan
 (pci-10de 0x10de = NVIDIA vendor id, state_manager.go:113-117), TPU nodes
-are recognized by the labels GKE stamps on TPU node pools
-(``cloud.google.com/gke-tpu-accelerator``, ``-topology``) and attributes
-come from a built-in accelerator catalog.
+are recognized by EITHER of two label sources, checked in order:
+
+1. the labels GKE stamps on TPU node pools
+   (``cloud.google.com/gke-tpu-accelerator``, ``-topology``), or
+2. the vendor-neutral ``tpu.google.com/{accelerator-type,topology}``
+   labels published by this operator's own node-discovery DaemonSet
+   (agents/node_discovery_agent.py) from the native device probe —
+   the NFD-analog bootstrap that makes self-managed (non-GKE) TPU-VM
+   clusters work: nothing on such clusters stamps the GKE labels, so
+   recognizing only source 1 would leave the operator cloud-locked
+   (the reference's NFD-based labelling works on any cluster,
+   state_manager.go:481-581).
+
+Attributes come from a built-in accelerator catalog either way.
 """
 
 from __future__ import annotations
@@ -62,6 +73,11 @@ class TPUNodeInfo:
     chips_per_node: int
     slice_hosts: int  # hosts forming the slice
     nodepool: str
+    # which label set identified the node: "gke" (cloud.google.com/*) or
+    # "discovery" (tpu.google.com/* from the node-discovery bootstrap).
+    # Selectors built from this info MUST use the same set — the other
+    # one does not exist on the node (nodepool.NodePool.selector).
+    label_source: str = "gke"
 
     @property
     def multi_host(self) -> bool:
@@ -69,16 +85,26 @@ class TPUNodeInfo:
 
 
 def tpu_info(node: ObjectDict) -> Optional[TPUNodeInfo]:
-    """None when the node carries no GKE TPU accelerator label."""
+    """None when the node carries neither the GKE accelerator label nor
+    the operator-published discovery label (see module docstring)."""
     labels = node.get("metadata", {}).get("labels", {}) or {}
+    source = "gke"
     acc_type = labels.get(consts.GKE_TPU_ACCELERATOR_LABEL, "")
+    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
+    if not acc_type:
+        # bootstrap path: labels the node-discovery DaemonSet published
+        # from the native device probe on a non-GKE cluster
+        source = "discovery"
+        acc_type = labels.get(consts.TFD_ACCELERATOR_TYPE_LABEL, "")
+        topology = labels.get(consts.TFD_TOPOLOGY_LABEL, "")
     if not acc_type:
         return None
     acc = ACCELERATORS.get(acc_type)
-    topology = labels.get(consts.GKE_TPU_TOPOLOGY_LABEL, "")
     dims = parse_topology(topology)
     chips_in_slice = math.prod(dims) if dims else 0
-    chips_per_host = acc.chips_per_host if acc else 4
+    # the probe-published local chip count beats catalog defaults when the
+    # accelerator type is unknown to the catalog (self-managed bootstrap)
+    chips_per_host = acc.chips_per_host if acc else _probed_chips(labels) or 4
     chips_per_node = min(chips_in_slice, chips_per_host) if chips_in_slice else chips_per_host
     slice_hosts = max(1, math.ceil(chips_in_slice / chips_per_host)) if chips_in_slice else 1
     return TPUNodeInfo(
@@ -90,7 +116,16 @@ def tpu_info(node: ObjectDict) -> Optional[TPUNodeInfo]:
         chips_per_node=chips_per_node,
         slice_hosts=slice_hosts,
         nodepool=labels.get(consts.GKE_NODEPOOL_LABEL, ""),
+        label_source=source,
     )
+
+
+def _probed_chips(labels: Dict[str, str]) -> int:
+    """The local chip count the discovery agent published, or 0."""
+    try:
+        return max(0, int(labels.get(consts.TFD_CHIPS_PER_NODE_LABEL, "0")))
+    except ValueError:
+        return 0
 
 
 def is_tpu_node(node: ObjectDict) -> bool:

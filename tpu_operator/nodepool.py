@@ -28,7 +28,17 @@ class NodePool:
 
     @property
     def selector(self) -> Dict[str, str]:
-        """nodeSelector matching exactly this pool's nodes."""
+        """nodeSelector matching exactly this pool's nodes — built from
+        the label set that actually identified them: GKE labels on GKE,
+        the discovery-published tpu.google.com labels on self-managed
+        clusters (where no cloud.google.com/* label exists, so a GKE
+        selector would match zero nodes and every per-pool TPUSlice
+        DaemonSet would hang unscheduled)."""
+        if self.info.label_source == "discovery":
+            sel = {consts.TFD_ACCELERATOR_TYPE_LABEL: self.accelerator_type}
+            if self.topology:
+                sel[consts.TFD_TOPOLOGY_LABEL] = self.topology
+            return sel
         sel = {consts.GKE_TPU_ACCELERATOR_LABEL: self.accelerator_type}
         if self.topology:
             sel[consts.GKE_TPU_TOPOLOGY_LABEL] = self.topology

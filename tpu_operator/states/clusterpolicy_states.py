@@ -6,6 +6,10 @@ controllers/state_manager.go:791-810. The TPU mapping (SURVEY.md §2.5):
     pre-requisites              -> pre-requisites (operand PriorityClass;
                                    no RuntimeClasses — TPUs need no
                                    container-runtime hook)
+    (NFD worker, chart subchart) -> state-node-discovery (the bootstrap
+                                   that recognizes TPU hardware on
+                                   non-GKE clusters; deploys with no
+                                   TPU gate, like NFD runs everywhere)
     state-operator-metrics      -> state-operator-metrics
     state-driver                -> state-libtpu
     state-container-toolkit     -> (none: device plugin mounts /dev/accel*
@@ -38,6 +42,7 @@ MANIFEST_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__f
 
 STATE_ORDER = [
     "pre-requisites",
+    "state-node-discovery",
     "state-operator-metrics",
     "state-libtpu",
     "state-device-plugin",
@@ -98,6 +103,7 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             config_default=spec.device_plugin.config.default,
         ),
         "tfd": _component_data(spec.tpu_feature_discovery, "tfd"),
+        "node_discovery": _component_data(spec.node_discovery, "node_discovery"),
         "slice_manager": _component_data(
             spec.slice_manager,
             "slice_manager",
@@ -155,6 +161,20 @@ class PreRequisitesState(ClusterPolicyState):
 
     def __init__(self):
         super().__init__("pre-requisites")
+
+
+class NodeDiscoveryState(ClusterPolicyState):
+    """NFD-analog bootstrap (see manifests/state-node-discovery). MUST
+    deploy while the cluster has no recognized TPU nodes — finding them
+    is its purpose — so the has-TPU-nodes skip does not apply."""
+
+    requires_tpu_nodes = False
+
+    def __init__(self):
+        super().__init__("state-node-discovery")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.node_discovery.is_enabled()
 
 
 class OperatorMetricsState(ClusterPolicyState):
@@ -227,6 +247,7 @@ def new_cluster_policy_states() -> List[StateSkel]:
     """reference: addState x19, state_manager.go:791-810."""
     states = [
         PreRequisitesState(),
+        NodeDiscoveryState(),
         OperatorMetricsState(),
         LibtpuState(),
         DevicePluginState(),
