@@ -341,31 +341,84 @@ class TestListPagination:
         # 7 objects at page size 3 = 3 GET requests (3 + 3 + 1)
         assert client.request_counts["GET"] - before.get("GET", 0) == 3
 
-    def test_continue_token_is_stable_under_inserts(self, served, monkeypatch):
-        """Name-keyed continuation: an object created BEFORE the cursor
-        while paging is missed (kube's documented contract), but nothing
-        after the cursor is skipped or duplicated."""
-        from tpu_operator.kube import http_client as hc
+    def test_continue_serves_first_page_snapshot_under_concurrent_writes(self, served):
+        """kube's paged-list consistency contract: every page of one LIST
+        is served from the FIRST page's snapshot — a concurrent create and
+        delete mid-pagination are invisible until a fresh list (a real
+        apiserver pins the pagination to page 1's resourceVersion; the
+        old name-keyed live-view continuation diverged exactly here)."""
+        import json as _json
+        import urllib.parse as up
+        import urllib.request
 
         store, client = served
         for i in (0, 2, 4, 6):
             store.create(new_object("v1", "ConfigMap", f"cm-{i}", NS))
-        monkeypatch.setattr(hc, "LIST_PAGE_SIZE", 2)
-        import json as _json
-        import urllib.request
-
         base = client.base_url + f"/api/v1/namespaces/{NS}/configmaps?limit=2"
         with urllib.request.urlopen(base, timeout=10) as resp:
             page1 = _json.loads(resp.read())
         cont = page1["metadata"]["continue"]
         assert [o["metadata"]["name"] for o in page1["items"]] == ["cm-0", "cm-2"]
-        # a concurrent insert after the cursor must appear in page 2
+        # mutate mid-pagination: neither write may affect later pages
         store.create(new_object("v1", "ConfigMap", "cm-3", NS))
-        import urllib.parse as up
-
+        store.delete("v1", "ConfigMap", "cm-6", NS)
         with urllib.request.urlopen(base + "&continue=" + up.quote(cont), timeout=10) as resp:
             page2 = _json.loads(resp.read())
-        assert [o["metadata"]["name"] for o in page2["items"]] == ["cm-3", "cm-4"]
+        assert [o["metadata"]["name"] for o in page2["items"]] == ["cm-4", "cm-6"]
+        # a FRESH list sees the post-write world
+        assert [o["metadata"]["name"] for o in store.list("v1", "ConfigMap", NS)] == [
+            "cm-0", "cm-2", "cm-3", "cm-4",
+        ]
+
+    def test_unknown_continue_token_answers_410_and_pager_recovers(self, monkeypatch):
+        """A stale/unknown continue token gets 410 Expired (kube answers a
+        compacted snapshot the same way) and HttpClient's pager restarts
+        the list from scratch rather than failing the caller."""
+        import urllib.error
+        import urllib.request
+
+        from tpu_operator.kube import http_client as hc
+
+        store = FakeClient()
+        server = FakeApiServer(store).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            for i in range(3):
+                store.create(new_object("v1", "ConfigMap", f"cm-{i}", NS))
+            url = (
+                server.base_url
+                + f"/api/v1/namespaces/{NS}/configmaps?limit=2&continue=bogus"
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(url, timeout=10)
+            assert exc_info.value.code == 410
+            # the typed error surfaces through the client request layer
+            with pytest.raises(errors.Expired):
+                client._request(
+                    "GET",
+                    f"/api/v1/namespaces/{NS}/configmaps",
+                    query={"limit": "2", "continue": "bogus"},
+                )
+            # and the pager recovers: evict the parked snapshot between
+            # page 1 and page 2, then list through the public API
+            monkeypatch.setattr(hc, "LIST_PAGE_SIZE", 2)
+            real_request = client._request
+            calls = {"continues": 0}
+
+            def request_with_eviction(method, path, body=None, query=None, **kw):
+                if query and query.get("continue"):
+                    calls["continues"] += 1
+                    if calls["continues"] == 1:
+                        with server._snapshots_lock:
+                            server._list_snapshots.clear()
+                return real_request(method, path, body=body, query=query, **kw)
+
+            monkeypatch.setattr(client, "_request", request_with_eviction)
+            items = client.list("v1", "ConfigMap", NS)
+            assert [o["metadata"]["name"] for o in items] == ["cm-0", "cm-1", "cm-2"]
+            assert calls["continues"] >= 2  # the expired token then the retry's
+        finally:
+            server.stop()
 
     def test_field_selector_filters_server_side(self, served):
         import json as _json

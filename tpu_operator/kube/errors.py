@@ -35,6 +35,15 @@ class TooManyRequests(ApiError):
     code = 429
 
 
+class Expired(ApiError):
+    """410 Gone: a resourceVersion or LIST continue token too old to
+    serve. client-go's pager reacts by restarting the list from scratch
+    (pkg/api/errors.IsResourceExpired); HttpClient._list_paged does the
+    same."""
+
+    code = 410
+
+
 def is_not_found(err: BaseException) -> bool:
     return isinstance(err, NotFound)
 

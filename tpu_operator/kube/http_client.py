@@ -429,6 +429,8 @@ class HttpClient(Client):
                 raise errors.Conflict(detail)
             if status in (400, 422):
                 raise errors.Invalid(detail)
+            if status == 410:
+                raise errors.Expired(detail)
             if status == 429:
                 raise errors.TooManyRequests(detail)
             raise errors.ApiError(f"{method} {path}: HTTP {status}: {detail}")
@@ -472,18 +474,33 @@ class HttpClient(Client):
         that rv is the consistent point to watch from)."""
         query = dict(query or {})
         query["limit"] = str(LIST_PAGE_SIZE)
-        items: List[ObjectDict] = []
-        while True:
-            result = self._request("GET", self._path(api_version, kind, namespace), query=query)
-            for item in result.get("items", []):
-                item.setdefault("apiVersion", api_version)
-                item.setdefault("kind", kind)
-                items.append(item)
-            md = result.get("metadata", {})
-            cont = md.get("continue")
-            if not cont:
-                return items, md.get("resourceVersion", "")
-            query["continue"] = cont
+        for attempt in range(3):
+            items: List[ObjectDict] = []
+            query.pop("continue", None)
+            try:
+                while True:
+                    result = self._request(
+                        "GET", self._path(api_version, kind, namespace), query=query
+                    )
+                    for item in result.get("items", []):
+                        item.setdefault("apiVersion", api_version)
+                        item.setdefault("kind", kind)
+                        items.append(item)
+                    md = result.get("metadata", {})
+                    cont = md.get("continue")
+                    if not cont:
+                        return items, md.get("resourceVersion", "")
+                    query["continue"] = cont
+            except errors.Expired:
+                # the continue token's snapshot aged out mid-pagination
+                # (410 Gone): restart the whole list from a fresh snapshot,
+                # the same recovery client-go's pager performs
+                if attempt == 2:
+                    raise
+                log.warning(
+                    "%s list: continue token expired; restarting pagination", kind
+                )
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def create(self, obj):
         md = obj.get("metadata", {})

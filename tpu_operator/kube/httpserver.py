@@ -22,11 +22,13 @@ Scope notes:
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import queue
 import threading
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -90,12 +92,22 @@ class FakeApiServer:
     expects, so real entrypoint processes can run against this server with
     the standard in-cluster env (see scripts/image_smoke.py)."""
 
+    # bound on concurrently parked pagination snapshots (kube bounds them
+    # by etcd compaction; beyond the cap the oldest token answers 410)
+    _MAX_LIST_SNAPSHOTS = 64
+
     def __init__(
         self, client: Client, host: str = "127.0.0.1", port: int = 0, tls: bool = False
     ):
         self.client = client
         self._plural_to_kind = _kind_map()
         self._stopped = threading.Event()
+        # continue token -> remaining items of a paged LIST, captured as a
+        # snapshot when page 1 was served (kube pins paged lists to the
+        # first page's resourceVersion; serving later pages from the live
+        # view would show a different, possibly inconsistent world)
+        self._list_snapshots: "collections.OrderedDict[str, list]" = collections.OrderedDict()
+        self._snapshots_lock = threading.Lock()
         self.ca_pem: bytes = b""
         server = self
 
@@ -141,6 +153,8 @@ class FakeApiServer:
                     self._send(409, {"reason": "Conflict", "message": str(e)})
                 except errors.TooManyRequests as e:
                     self._send(429, {"reason": "TooManyRequests", "message": str(e)})
+                except errors.Expired as e:
+                    self._send(410, {"reason": "Expired", "message": str(e)})
                 except errors.Invalid as e:
                     self._send(422, {"reason": "Invalid", "message": str(e)})
                 except (BrokenPipeError, ConnectionResetError):
@@ -258,28 +272,61 @@ class FakeApiServer:
                     for pair in query["fieldSelector"][0].split(",")
                     if "=" in pair
                 )
-            items = self.client.list(
-                api_version, kind, namespace,
-                label_selector=selector, field_selector=field_selector,
-            )
-            # pagination (limit/continue): name-keyed continuation over a
-            # sorted view, so chunks stay stable under concurrent writes
-            # (an insert before the cursor is missed, matching kube's
-            # consistency contract for paged lists). The token is the last
-            # key served, not an index — indexes shift.
-            items.sort(key=lambda o: (o["metadata"].get("namespace") or "", o["metadata"]["name"]))
+            # pagination (limit/continue): rv-snapshot semantics. Page 1
+            # captures the full (filtered, sorted) result as a snapshot;
+            # continue tokens serve the remainder of THAT snapshot, so a
+            # concurrent create/delete mid-pagination is invisible until a
+            # fresh list — exactly kube's consistency contract (a paged
+            # list is served from the first page's resourceVersion). An
+            # unknown/expired token answers 410 Expired, which the client
+            # pager handles by restarting the list (client-go behavior).
             metadata = {"resourceVersion": "0"}
             limit = int(query["limit"][0]) if query.get("limit") else 0
             if query.get("continue"):
-                after = tuple(query["continue"][0].split("\x00", 1))
-                items = [
-                    o for o in items
-                    if (o["metadata"].get("namespace") or "", o["metadata"]["name"]) > after
-                ]
+                token = query["continue"][0]
+                with self._snapshots_lock:
+                    # read WITHOUT popping: kube continue tokens are
+                    # replayable (a client whose keep-alive connection died
+                    # after the server processed the GET re-sends the same
+                    # token); single-use tokens would answer that retry
+                    # with a spurious 410. Eviction is the LRU cap's job.
+                    items = self._list_snapshots.get(token)
+                    if items is not None:
+                        self._list_snapshots.move_to_end(token)
+                if items is None:
+                    return handler._send(
+                        410,
+                        {
+                            "reason": "Expired",
+                            "message": "The provided continue parameter is too old",
+                        },
+                    )
+            else:
+                items = self.client.list(
+                    api_version, kind, namespace,
+                    label_selector=selector, field_selector=field_selector,
+                )
+                items.sort(
+                    key=lambda o: (o["metadata"].get("namespace") or "", o["metadata"]["name"])
+                )
             if limit and len(items) > limit:
+                rest = items[limit:]
                 items = items[:limit]
-                last = items[-1]["metadata"]
-                metadata["continue"] = f"{last.get('namespace') or ''}\x00{last['name']}"
+                token = uuid.uuid4().hex
+                with self._snapshots_lock:
+                    self._list_snapshots[token] = rest
+                    while len(self._list_snapshots) > self._MAX_LIST_SNAPSHOTS:
+                        evicted, _ = self._list_snapshots.popitem(last=False)
+                        # a pagination still in flight just lost its
+                        # snapshot; its next continue draws 410 and the
+                        # client pager restarts — correct but worth a
+                        # trace under heavy list concurrency
+                        log.warning(
+                            "list-snapshot cap (%d) evicted token %s…",
+                            self._MAX_LIST_SNAPSHOTS,
+                            evicted[:8],
+                        )
+                metadata["continue"] = token
             return handler._send(
                 200,
                 {
