@@ -13,6 +13,13 @@ echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) ==
 python3 scripts/image_smoke.py
 echo "== e2e =="
 bash tests/scripts/end-to-end.sh
+echo "== real-helm render golden (optional: needs helm) =="
+rc=0
+bash tests/scripts/helm-golden.sh || rc=$?
+if [ "$rc" -ne 0 ] && [ "$rc" -ne 42 ]; then
+  echo "helm golden FAILED (rc=$rc)"
+  exit "$rc"
+fi
 echo "== real-apiserver e2e (optional: needs docker + kind) =="
 # 42 is kind-e2e.sh's skip sentinel, chosen outside pytest's 0-5 range
 # so a crashed suite can never read as "kind not installed"

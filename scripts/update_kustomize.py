@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Regenerate deploy/kustomize/ from the chart render path.
+
+Reference: config/default/kustomization.yaml (+ crd/rbac/manager bases)
+gives non-helm installs a kubectl-apply path. Here the bases are
+GENERATED from the same renderer `tpuop-cfg render` uses, so the three
+install paths (helm chart, tpuop-cfg render, kustomize) can never drift:
+tests/test_kustomize.py re-renders and fails on any difference.
+
+Layout (mirrors kubebuilder's config/ convention):
+    deploy/kustomize/crd/       both CRDs
+    deploy/kustomize/rbac/      ServiceAccount, ClusterRole(+Binding)
+    deploy/kustomize/manager/   Namespace + operator Deployment
+    deploy/kustomize/samples/   a default ClusterPolicy CR (not in
+                                default/ — installing the CR is the
+                                user's opt-in, like config/samples)
+    deploy/kustomize/default/   aggregates crd + rbac + manager
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+KUSTOMIZE_DIR = os.path.join(REPO, "deploy", "kustomize")
+
+# kind -> (base dir, file name)
+PLACEMENT = {
+    "CustomResourceDefinition": ("crd", None),  # per-object file by name
+    "ServiceAccount": ("rbac", "serviceaccount.yaml"),
+    "ClusterRole": ("rbac", "clusterrole.yaml"),
+    "ClusterRoleBinding": ("rbac", "clusterrolebinding.yaml"),
+    "Namespace": ("manager", "namespace.yaml"),
+    "Deployment": ("manager", "deployment.yaml"),
+    "ClusterPolicy": ("samples", "clusterpolicy.yaml"),
+    "Secret": ("manager", "webhook-secret.yaml"),
+    "ValidatingWebhookConfiguration": ("manager", "webhook.yaml"),
+}
+
+
+def generate() -> dict:
+    """Returns {relative path: yaml text} for every file to write."""
+    from tpu_operator.chart import render_chart
+
+    with open(os.path.join(REPO, "deploy", "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    objs = render_chart(values)
+    files: dict = {}
+    resources: dict = {"crd": [], "rbac": [], "manager": [], "samples": []}
+    for obj in objs:
+        kind = obj["kind"]
+        if kind not in PLACEMENT:
+            raise SystemExit(f"no kustomize placement for rendered kind {kind!r}")
+        base, fname = PLACEMENT[kind]
+        if fname is None:
+            fname = obj["metadata"]["name"].split(".")[0] + ".yaml"
+        rel = os.path.join(base, fname)
+        text = yaml.safe_dump(obj, sort_keys=False)
+        if rel in files:
+            files[rel] += "---\n" + text
+        else:
+            files[rel] = text
+            resources[base].append(fname)
+    for base, names in resources.items():
+        if not names:
+            continue
+        files[os.path.join(base, "kustomization.yaml")] = yaml.safe_dump(
+            {
+                "apiVersion": "kustomize.config.k8s.io/v1beta1",
+                "kind": "Kustomization",
+                "resources": sorted(names),
+            },
+            sort_keys=False,
+        )
+    files[os.path.join("default", "kustomization.yaml")] = yaml.safe_dump(
+        {
+            "apiVersion": "kustomize.config.k8s.io/v1beta1",
+            "kind": "Kustomization",
+            # samples/ (the ClusterPolicy CR) is deliberately excluded:
+            # creating the CR is the user's opt-in, mirroring
+            # config/samples in the reference layout
+            "resources": ["../crd", "../rbac", "../manager"],
+        },
+        sort_keys=False,
+    )
+    return files
+
+
+def main() -> int:
+    files = generate()
+    for rel, text in sorted(files.items()):
+        path = os.path.join(KUSTOMIZE_DIR, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+    # prune stale files (an object removed from the chart must take its
+    # base file with it, or the drift test fails unrecoverably by
+    # regeneration alone)
+    for root, _, names in os.walk(KUSTOMIZE_DIR):
+        for name in names:
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, KUSTOMIZE_DIR)
+            if rel not in files:
+                os.unlink(path)
+                print(f"pruned {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Real-Helm render gate (reference: the chart is consumed by actual helm,
+# deployments/gpu-operator/). The in-repo parity tests pit helmlite
+# against tpuop-cfg render — both in-repo, so a helmlite bug and a chart
+# bug could cancel out. This gate runs the REAL `helm template` when a
+# helm binary exists and diffs its objects against the helmlite render;
+# exit 42 = helm not installed (skip sentinel, same contract as
+# kind-e2e.sh). On first success it also writes a golden snapshot to
+# tests/golden/helm-template.yaml for the repo to commit.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+if ! command -v helm >/dev/null 2>&1; then
+  echo "helm-golden: no helm binary; skipping (exit 42)"
+  exit 42
+fi
+
+python3 - <<'EOF'
+import copy
+import os
+import subprocess
+import sys
+
+import yaml
+
+sys.path.insert(0, os.getcwd())
+from tpu_operator import helmlite
+
+CHART = "deploy/helm/tpu-operator"
+GOLDEN = "tests/golden/helm-template.yaml"
+
+with open("deploy/helm/tpu-operator/values.yaml") as f:
+    values = yaml.safe_load(f)
+
+proc = subprocess.run(
+    [
+        "helm", "template", "tpu-operator", CHART,
+        "-n", "tpu-operator", "--include-crds",
+        "--set", "createNamespace=true",
+    ],
+    capture_output=True, text=True, timeout=300,
+)
+if proc.returncode != 0:
+    sys.exit(f"helm template failed:\n{proc.stderr[-3000:]}")
+
+def by_key(objs):
+    return {(o["kind"], o["metadata"]["name"]): o for o in objs if o}
+
+helm_objs = by_key(yaml.safe_load_all(proc.stdout))
+vals = copy.deepcopy(values)
+vals["createNamespace"] = True
+lite_objs = by_key(helmlite.template(CHART, vals, namespace="tpu-operator"))
+
+if set(helm_objs) != set(lite_objs):
+    sys.exit(
+        "object sets differ:\n"
+        f" helm-only: {sorted(set(helm_objs) - set(lite_objs))}\n"
+        f" helmlite-only: {sorted(set(lite_objs) - set(helm_objs))}"
+    )
+diffs = [k for k in helm_objs if helm_objs[k] != lite_objs[k]]
+if diffs:
+    for k in diffs[:5]:
+        print(f"DIFF {k}:\n helm: {helm_objs[k]}\n lite: {lite_objs[k]}")
+    sys.exit(f"{len(diffs)} objects differ between helm and helmlite")
+
+if os.path.exists(GOLDEN):
+    # the committed snapshot is the gate: today's helm output must match
+    # it exactly (catches a regression that helmlite happens to mirror)
+    with open(GOLDEN) as f:
+        golden = by_key(yaml.safe_load_all(f))
+    if golden != helm_objs:
+        changed = sorted(
+            set(golden) ^ set(helm_objs)
+            | {k for k in set(golden) & set(helm_objs) if golden[k] != helm_objs[k]}
+        )
+        sys.exit(
+            f"helm output drifted from committed {GOLDEN}: {changed}\n"
+            "(delete the golden and re-run to regenerate intentionally)"
+        )
+    print(f"helm-golden: {len(helm_objs)} objects agree with helmlite AND {GOLDEN}")
+else:
+    with open(GOLDEN, "w") as f:
+        yaml.safe_dump_all(
+            [helm_objs[k] for k in sorted(helm_objs)], f, sort_keys=False
+        )
+    print(
+        f"helm-golden: {len(helm_objs)} objects agree; snapshot bootstrapped -> "
+        f"{GOLDEN} — COMMIT IT to arm the gate"
+    )
+EOF
+echo "HELM GOLDEN: PASS"

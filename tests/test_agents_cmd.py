@@ -87,6 +87,33 @@ class TestSliceManagerAgent:
             node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
             client.create(node)
 
+    def test_psum_floor_env_reaches_gang_workers(self, monkeypatch):
+        """The agent-side hop of the ICI-floor chain: MIN_PSUM_GBPS_PER_CHIP
+        read from the environment must land in every COMPONENT=slice gang
+        worker pod (spec.validator.minPsumGbpsPerChip → slice-manager DS
+        env → agent → worker pods)."""
+        from tpu_operator.agents.slice_manager_agent import agent_from_env
+
+        client = FakeClient()
+        self.seed(client)
+        monkeypatch.setenv("MIN_PSUM_GBPS_PER_CHIP", "37.0")
+        monkeypatch.setenv(consts.OPERATOR_NAMESPACE_ENV, NS)
+        agent = agent_from_env(client)
+        names = agent.reconcile_once()
+        pods = client.list("v1", "Pod", NS, label_selector={"app": "tpu-slice-worker"})
+        assert len(pods) == 4
+        for pod in pods:
+            env = {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+            assert env["MIN_PSUM_GBPS_PER_CHIP"] == "37.0", pod["metadata"]["name"]
+        # and without the env, the floor is absent — not an empty string
+        monkeypatch.delenv("MIN_PSUM_GBPS_PER_CHIP")
+        client2 = FakeClient()
+        self.seed(client2)
+        agent_from_env(client2).reconcile_once()
+        for pod in client2.list("v1", "Pod", NS, label_selector={"app": "tpu-slice-worker"}):
+            names_set = {e["name"] for e in pod["spec"]["containers"][0]["env"]}
+            assert "MIN_PSUM_GBPS_PER_CHIP" not in names_set
+
     def test_creates_gang_plumbing(self):
         client = FakeClient()
         self.seed(client)

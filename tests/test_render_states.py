@@ -83,6 +83,45 @@ class TestStateRendering:
         # node-status
         assert found == 8
 
+    def test_perf_floor_envs_render_into_operand_daemonsets(self):
+        """spec.validator.minTflops reaches the workload-validation init
+        container; minPsumGbpsPerChip reaches the slice-manager agent
+        (which forwards it into every gang worker pod)."""
+        catalog = make_catalog(
+            spec={"validator": {"minTflops": 120.5, "minPsumGbpsPerChip": 37.0}}
+        )
+        (ds,) = [
+            o
+            for o in render_state("state-operator-validation", catalog)
+            if o["kind"] == "DaemonSet"
+        ]
+        workload = [
+            c
+            for c in ds["spec"]["template"]["spec"]["initContainers"]
+            if c["name"] == "workload-validation"
+        ][0]
+        env = {e["name"]: e.get("value") for e in workload["env"]}
+        assert env["MIN_TFLOPS"] == "120.5"
+        (sm_ds,) = [
+            o
+            for o in render_state("state-slice-manager", catalog)
+            if o["kind"] == "DaemonSet"
+        ]
+        sm_env = {
+            e["name"]: e.get("value")
+            for e in sm_ds["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert sm_env["MIN_PSUM_GBPS_PER_CHIP"] == "37.0"
+        # no floors configured -> no envs rendered
+        plain = make_catalog()
+        (ds2,) = [
+            o
+            for o in render_state("state-operator-validation", plain)
+            if o["kind"] == "DaemonSet"
+        ]
+        for c in ds2["spec"]["template"]["spec"]["initContainers"]:
+            assert "MIN_TFLOPS" not in {e["name"] for e in c.get("env", [])}
+
     def test_custom_images_and_env_flow_into_daemonset(self):
         catalog = make_catalog(
             spec={

@@ -12,9 +12,12 @@ from tpu_operator.kube.sim import make_tpu_node
 from tpu_operator.validator import status as status_files
 from tpu_operator.validator.main import (
     Context,
+    enforce_floor,
     run_component,
     validate_libtpu,
     validate_plugin,
+    validate_smoke,
+    validate_slice,
     validate_workload,
 )
 from tpu_operator.validator.metrics import NodeMetrics
@@ -145,6 +148,103 @@ class TestWorkloadComponent:
         with pytest.raises(RuntimeError, match="failed"):
             validate_workload(ctx)
         t.join()
+
+
+class TestPerfFloors:
+    """spec.validator.minTflops / minPsumGbpsPerChip: below-floor nodes
+    must fail validation (NotReady, status file withheld) — the reference
+    gates only on resource presence (main.go:1096-1174), letting degraded
+    hardware sail to Ready."""
+
+    def test_enforce_floor(self):
+        enforce_floor("x", measured=100.0, floor=None)  # no floor: no-op
+        enforce_floor("x", measured=100.0, floor=99.0)
+        with pytest.raises(RuntimeError, match="below configured floor"):
+            enforce_floor("x", measured=98.9, floor=99.0)
+
+    def test_smoke_fails_below_tflops_floor(self, ctx, monkeypatch):
+        import tpu_operator.workloads.matmul_bench as mb
+        import tpu_operator.workloads.smoke as smoke_mod
+
+        monkeypatch.setattr(smoke_mod, "run_smoke", lambda **kw: {"ok": True})
+        monkeypatch.setattr(mb, "matmul_tflops", lambda **kw: {"tflops": 50.0})
+        ctx.min_tflops = 120.0
+        with pytest.raises(RuntimeError, match="below configured floor"):
+            validate_smoke(ctx)
+
+    def test_smoke_passes_at_or_above_floor(self, ctx, monkeypatch):
+        import tpu_operator.workloads.matmul_bench as mb
+        import tpu_operator.workloads.smoke as smoke_mod
+
+        monkeypatch.setattr(smoke_mod, "run_smoke", lambda **kw: {"ok": True})
+        monkeypatch.setattr(mb, "matmul_tflops", lambda **kw: {"tflops": 150.0})
+        ctx.min_tflops = 120.0
+        report = validate_smoke(ctx)
+        assert report["matmul_bf16_tflops"] == 150.0
+
+    def test_smoke_without_floor_skips_bench(self, ctx, monkeypatch):
+        import tpu_operator.workloads.smoke as smoke_mod
+
+        monkeypatch.setattr(smoke_mod, "run_smoke", lambda **kw: {"ok": True})
+        # matmul_tflops NOT patched: calling it would hit real hardware —
+        # the no-floor path must not
+        report = validate_smoke(ctx)
+        assert "matmul_bf16_tflops" not in report
+
+    def test_below_floor_withholds_status_file(self, ctx, monkeypatch):
+        import tpu_operator.workloads.matmul_bench as mb
+        import tpu_operator.workloads.smoke as smoke_mod
+
+        monkeypatch.setattr(smoke_mod, "run_smoke", lambda **kw: {"ok": True})
+        monkeypatch.setattr(mb, "matmul_tflops", lambda **kw: {"tflops": 1.0})
+        ctx.min_tflops = 120.0
+        import tpu_operator.validator.main as vmain
+
+        monkeypatch.setitem(
+            vmain.COMPONENTS, "smoke", (validate_smoke, "smoke-perf-ready")
+        )
+        with pytest.raises(RuntimeError):
+            run_component("smoke", ctx, max_attempts=2)
+        assert status_files.read_status("smoke-perf-ready", ctx.validation_dir) is None
+
+    def test_slice_fails_below_psum_floor(self, ctx, monkeypatch):
+        """The real validate_slice, with the collective measurement
+        stubbed to a degraded multi-chip report: the floor check fires
+        right after the allreduce, before the heavyweight checks."""
+        import types
+
+        from tpu_operator.workloads import allreduce, distributed
+
+        monkeypatch.setattr(
+            distributed,
+            "initialize",
+            lambda: types.SimpleNamespace(num_processes=2, process_id=0),
+        )
+        monkeypatch.setattr(
+            allreduce,
+            "run_allreduce",
+            lambda **kw: {"devices": 8, "peak_busbw_gbps_per_chip": 12.5},
+        )
+        ctx.min_psum_gbps_per_chip = 40.0
+        with pytest.raises(RuntimeError, match="psum bus GB/s/chip.*below"):
+            validate_slice(ctx)
+
+    def test_floor_envs_parse(self, monkeypatch):
+        monkeypatch.setenv("MIN_TFLOPS", "120.5")
+        monkeypatch.setenv("MIN_PSUM_GBPS_PER_CHIP", "37")
+        c = Context.from_env()
+        assert c.min_tflops == 120.5
+        assert c.min_psum_gbps_per_chip == 37.0
+        monkeypatch.setenv("MIN_TFLOPS", "garbage")
+        assert Context.from_env().min_tflops is None
+
+    def test_workload_pod_carries_floor_env(self, ctx):
+        from tpu_operator.validator.main import workload_pod
+
+        ctx.min_tflops = 100.0
+        pod = workload_pod(ctx)
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        assert env["MIN_TFLOPS"] == "100.0"
 
 
 class TestNodeMetrics:

@@ -65,6 +65,7 @@ class SliceManagerAgent:
         validator_image: str = "tpu-operator-validator",
         image_pull_policy: str = "IfNotPresent",
         validation_dir: str = consts.VALIDATION_DIR,
+        min_psum_gbps_per_chip: str = "",
     ):
         self.client = client
         self.namespace = namespace
@@ -77,6 +78,9 @@ class SliceManagerAgent:
         self.validator_image = validator_image
         self.image_pull_policy = image_pull_policy
         self.validation_dir = validation_dir
+        # forwarded into every gang worker so COMPONENT=slice enforces the
+        # ICI bandwidth floor (spec.validator.minPsumGbpsPerChip)
+        self.min_psum_gbps_per_chip = min_psum_gbps_per_chip
         self._renderer = Renderer([GANG_MANIFEST_DIR])
 
     def _load_profile(self) -> dict:
@@ -252,6 +256,7 @@ class SliceManagerAgent:
                 "chips_per_host": pool.info.chips_per_node,
                 "coordinator_port": self.coordinator_port,
                 "validation_dir": self.validation_dir,
+                "min_psum_gbps_per_chip": self.min_psum_gbps_per_chip,
             }
         )
         created = []
@@ -368,12 +373,11 @@ def _int_env(name: str, default: int) -> int:
         return default
 
 
-def main() -> int:
-    logging.basicConfig(level=logging.INFO)
-    from tpu_operator.kube.http_client import HttpClient
-
-    agent = SliceManagerAgent(
-        HttpClient.in_cluster(),
+def agent_from_env(client: Client) -> "SliceManagerAgent":
+    """Construct the agent from the DaemonSet's env contract (split from
+    main() so tests pin the env→constructor hop of e.g. the psum floor)."""
+    return SliceManagerAgent(
+        client,
         namespace=os.environ.get(consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE),
         multi_slice=os.environ.get("MULTI_SLICE_ENABLED", "").lower() == "true",
         coordinator_port=_int_env("COORDINATOR_PORT", 8476),
@@ -381,8 +385,15 @@ def main() -> int:
         validator_image=os.environ.get("VALIDATOR_IMAGE", "tpu-operator-validator"),
         image_pull_policy=os.environ.get("VALIDATOR_IMAGE_PULL_POLICY", "IfNotPresent"),
         validation_dir=os.environ.get("VALIDATION_DIR", consts.VALIDATION_DIR),
+        min_psum_gbps_per_chip=os.environ.get("MIN_PSUM_GBPS_PER_CHIP", ""),
     )
-    agent.run_forever()
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    from tpu_operator.kube.http_client import HttpClient
+
+    agent_from_env(HttpClient.in_cluster()).run_forever()
     return 0
 
 

@@ -231,6 +231,16 @@ class ValidatorSpec(ComponentCommon):
     plugin: ComponentValidatorSpec = sub(ComponentValidatorSpec)
     workload: ComponentValidatorSpec = sub(ComponentValidatorSpec)
     slice: ComponentValidatorSpec = sub(ComponentValidatorSpec)
+    # Optional performance floors (no reference analog — their validator
+    # gates only on resource presence, main.go:1096-1174, so a degraded
+    # node sails to Ready). When set, the workload component fails below
+    # minTflops (bf16 matmul on this node's chips) and the slice component
+    # fails below minPsumGbpsPerChip (allreduce bus bandwidth over ICI) —
+    # NotReady, status file withheld, operands stay gated.
+    min_tflops: Optional[float] = field(json="minTflops", default=None)
+    min_psum_gbps_per_chip: Optional[float] = field(
+        json="minPsumGbpsPerChip", default=None
+    )
 
 
 @dataclasses.dataclass

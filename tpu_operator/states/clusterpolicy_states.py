@@ -129,6 +129,8 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             plugin_env=spec.validator.plugin.env,
             workload_env=spec.validator.workload.env,
             slice_env=spec.validator.slice.env,
+            min_tflops=spec.validator.min_tflops,
+            min_psum_gbps_per_chip=spec.validator.min_psum_gbps_per_chip,
         ),
         "multi_slice": {
             "enabled": spec.multi_slice.is_enabled(),

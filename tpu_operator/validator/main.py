@@ -44,6 +44,13 @@ class Context:
     resource_poll_retries: int = 30  # reference: gpuResourceDiscoveryWaitRetries
     pod_wait_retries: int = 60  # reference: podCreationWaitRetries
     expected_chips: Optional[int] = None
+    # performance floors (spec.validator.minTflops / minPsumGbpsPerChip).
+    # The reference's validator gates only on resource presence
+    # (main.go:1096-1174); a floor makes a thermally-throttled chip or a
+    # degraded ICI link fail validation (NotReady, status file withheld)
+    # instead of sailing to Ready.
+    min_tflops: Optional[float] = None
+    min_psum_gbps_per_chip: Optional[float] = None
 
     @classmethod
     def from_env(cls, client: Optional[Client] = None) -> "Context":
@@ -55,6 +62,28 @@ class Context:
             install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
             validator_image=os.environ.get("VALIDATOR_IMAGE", ""),
             expected_chips=int(os.environ["EXPECTED_CHIPS"]) if os.environ.get("EXPECTED_CHIPS") else None,
+            min_tflops=_float_env("MIN_TFLOPS"),
+            min_psum_gbps_per_chip=_float_env("MIN_PSUM_GBPS_PER_CHIP"),
+        )
+
+
+def _float_env(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("invalid %s %r; floor disabled", name, raw)
+        return None
+
+
+def enforce_floor(what: str, measured: float, floor: Optional[float]) -> None:
+    """Raise (→ retry loop → NotReady) when a measured rate is below its
+    configured floor; no-op when no floor is set."""
+    if floor is not None and measured < floor:
+        raise RuntimeError(
+            f"{what} {measured:.2f} below configured floor {floor:.2f}"
         )
 
 
@@ -137,6 +166,11 @@ def workload_pod(ctx: Context) -> dict:
                             if ctx.expected_chips
                             else []
                         ),
+                        *(
+                            [{"name": "MIN_TFLOPS", "value": str(ctx.min_tflops)}]
+                            if ctx.min_tflops is not None
+                            else []
+                        ),
                     ],
                     "resources": {
                         "limits": {consts.TPU_RESOURCE_NAME: str(ctx.expected_chips or 1)}
@@ -186,6 +220,14 @@ def validate_slice(ctx: Context) -> dict:
     report = allreduce.run_allreduce()
     report["hosts"] = dist.num_processes
     report["process_id"] = dist.process_id
+    if report.get("devices", 0) > 1:
+        # the ICI bandwidth floor only means something on a real
+        # multi-chip ring; a single chip measures dispatch, not fabric
+        enforce_floor(
+            "psum bus GB/s/chip",
+            report.get("peak_busbw_gbps_per_chip", 0.0),
+            ctx.min_psum_gbps_per_chip,
+        )
     import jax
 
     n = len(jax.devices())
@@ -216,10 +258,20 @@ def validate_slice(ctx: Context) -> dict:
 
 
 def validate_smoke(ctx: Context) -> dict:
-    """In-pod payload of the workload pod (the vectorAdd itself)."""
+    """In-pod payload of the workload pod (the vectorAdd itself). With a
+    minTflops floor configured, also measures the bf16 matmul rate on
+    this node's chips and fails below the floor — catching a throttled or
+    degraded chip the correctness check would pass."""
     from tpu_operator.workloads import smoke
 
-    return smoke.run_smoke(expected_devices=ctx.expected_chips)
+    report = smoke.run_smoke(expected_devices=ctx.expected_chips)
+    if ctx.min_tflops is not None:
+        from tpu_operator.workloads.matmul_bench import matmul_tflops
+
+        mm = matmul_tflops(size=4096, iters=8)
+        report["matmul_bf16_tflops"] = round(mm["tflops"], 2)
+        enforce_floor("bf16 matmul TFLOP/s", mm["tflops"], ctx.min_tflops)
+    return report
 
 
 ComponentFn = Callable[[Context], dict]
