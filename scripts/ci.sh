@@ -14,9 +14,14 @@ python3 scripts/image_smoke.py
 echo "== e2e =="
 bash tests/scripts/end-to-end.sh
 echo "== real-helm render golden (optional: needs helm) =="
+# 42 = no helm binary (skip); 43 = helm agreed with helmlite but the
+# golden snapshot was only just bootstrapped (gate unarmed until the
+# snapshot is committed) — both are non-failures, but 43 is surfaced
 rc=0
 bash tests/scripts/helm-golden.sh || rc=$?
-if [ "$rc" -ne 0 ] && [ "$rc" -ne 42 ]; then
+if [ "$rc" -eq 43 ]; then
+  echo "NOTE: helm golden bootstrapped, commit tests/golden/helm-template.yaml"
+elif [ "$rc" -ne 0 ] && [ "$rc" -ne 42 ]; then
   echo "helm golden FAILED (rc=$rc)"
   exit "$rc"
 fi

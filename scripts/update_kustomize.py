@@ -101,14 +101,19 @@ def main() -> int:
         print(f"wrote {path}")
     # prune stale files (an object removed from the chart must take its
     # base file with it, or the drift test fails unrecoverably by
-    # regeneration alone)
-    for root, _, names in os.walk(KUSTOMIZE_DIR):
-        for name in names:
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, KUSTOMIZE_DIR)
-            if rel not in files:
-                os.unlink(path)
-                print(f"pruned {path}")
+    # regeneration alone) — but ONLY inside the generated bases: users
+    # may keep hand-written overlays (deploy/kustomize/overlays/...)
+    # next to them, and those are not ours to delete
+    generated_bases = {rel.split(os.sep)[0] for rel in files}
+    for base in sorted(generated_bases):
+        base_dir = os.path.join(KUSTOMIZE_DIR, base)
+        for root, _, names in os.walk(base_dir):
+            for name in names:
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, KUSTOMIZE_DIR)
+                if rel not in files:
+                    os.unlink(path)
+                    print(f"pruned {path}")
     return 0
 
 

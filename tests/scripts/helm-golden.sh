@@ -15,7 +15,8 @@ if ! command -v helm >/dev/null 2>&1; then
   exit 42
 fi
 
-python3 - <<'EOF'
+rc=0
+python3 - <<'EOF' || rc=$?
 import copy
 import os
 import subprocess
@@ -87,5 +88,12 @@ else:
         f"helm-golden: {len(helm_objs)} objects agree; snapshot bootstrapped -> "
         f"{GOLDEN} — COMMIT IT to arm the gate"
     )
+    sys.exit(43)  # bootstrap sentinel: agreement checked, golden gate UNARMED
 EOF
+if [ "$rc" -eq 43 ]; then
+  echo "HELM GOLDEN: PASS (unarmed — snapshot bootstrapped, commit it)"
+  exit 43
+elif [ "$rc" -ne 0 ]; then
+  exit "$rc"
+fi
 echo "HELM GOLDEN: PASS"

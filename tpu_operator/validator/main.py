@@ -266,11 +266,22 @@ def validate_smoke(ctx: Context) -> dict:
 
     report = smoke.run_smoke(expected_devices=ctx.expected_chips)
     if ctx.min_tflops is not None:
+        import jax
+
         from tpu_operator.workloads.matmul_bench import matmul_tflops
 
-        mm = matmul_tflops(size=4096, iters=8)
-        report["matmul_bf16_tflops"] = round(mm["tflops"], 2)
-        enforce_floor("bf16 matmul TFLOP/s", mm["tflops"], ctx.min_tflops)
+        # measure EVERY local chip and gate on the slowest: one throttled
+        # chip must not hide behind a healthy default device
+        rates = {}
+        for dev in jax.local_devices():
+            mm = matmul_tflops(size=4096, iters=8, device=dev)
+            rates[str(dev)] = round(mm["tflops"], 2)
+        report["matmul_bf16_tflops_per_chip"] = rates
+        slowest = min(rates, key=rates.get)
+        report["matmul_bf16_tflops"] = rates[slowest]
+        enforce_floor(
+            f"bf16 matmul TFLOP/s ({slowest})", rates[slowest], ctx.min_tflops
+        )
     return report
 
 

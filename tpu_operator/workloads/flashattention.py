@@ -106,6 +106,14 @@ _PERMUTE_FWD = True
 _PERMUTE_DQ = True
 _PERMUTE_DKV = False
 _TRIANGLE_FWD = True
+# Triangle-flattened BACKWARD walks (same idea as _TRIANGLE_FWD): the dQ
+# grid walks only each q row's causally-relevant k blocks, the dK/dV grid
+# only each k column's relevant (group member, q block) pairs — the
+# rectangle's above/below-diagonal bubble steps never exist and megacore
+# splits on the uniform bh axis. Plain causal only (window/segments/ring
+# offsets keep the rectangular kernels).
+_TRIANGLE_DQ = True
+_TRIANGLE_DKV = True
 # Backward block sizes, independent of the forward's (the two passes
 # have different working sets: the backward holds q/k/v/do plus two
 # accumulators). None = inherit the forward blocks; used only when they
@@ -218,12 +226,23 @@ def _k_band(nk_total: int, block_q: int, block_k: int, window: Optional[int]):
     return n_band, k_block
 
 
-def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool):
+# base-2 softmax constants: exp(x) lowers to exp2(x·log2e) on the VPU, so
+# a kernel whose scores are already in base-2 units (the 1/√d softmax
+# scale and log2(e) folded into a pre-scaled operand of the QKᵀ matmul)
+# saves one full-(BQ,BK)-tile multiply per exp AND the separate scale
+# multiply — the triangle kernels run this way; lse converts back to
+# natural units at finalize so the backward contract never changes.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
+
+def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool, exp_fn=jnp.exp):
     """One online-softmax accumulation step over a score tile — shared by
     the rectangular and flattened-triangle forward kernels. ``masked``
     keeps the -inf guards; the fast path drops them (every pair live:
     blk_max and so new_m are finite, and exp(-inf - new_m) = 0 covers a
-    still-empty m on its own)."""
+    still-empty m on its own). ``exp_fn=jnp.exp2`` is the base-2 path
+    (scores pre-scaled by log2e — see _LOG2E note)."""
     m = m_ref[:, :1]  # (BQ, 1) — column 0 carries the row stat
     l = l_ref[:, :1]
     blk_max = jnp.max(s, axis=-1, keepdims=True)
@@ -232,12 +251,12 @@ def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool):
         # fully-masked rows (block_q > block_k diagonals) keep m at
         # -inf: exp(-inf - -inf) must yield 0, not nan
         safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
-        p = jnp.exp(s - safe_m)
+        correction = exp_fn(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe_m))
+        p = exp_fn(s - safe_m)
         p = jnp.where(jnp.isneginf(s), 0.0, p)
     else:
-        correction = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m)
+        correction = exp_fn(m - new_m)
+        p = exp_fn(s - new_m)
     pv = lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -249,17 +268,36 @@ def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool):
     )
 
 
-def _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref):
+def _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref, m_scale: float = 1.0):
     """Write the normalized output block + logsumexp from the running
-    (acc, m, l) state — shared by both forward kernels."""
+    (acc, m, l) state — shared by both forward kernels. ``m_scale``
+    converts a base-2 running max back to natural units (ln 2 for the
+    base-2 triangle kernel; note ln(l) stays natural either way — l is a
+    sum of probabilities, base-free), so the stored lse ALWAYS means
+    natural-log-sum-exp whichever kernel produced it."""
     l = l_ref[:, :1]
     # rows with no valid key (defensive): l == 0 -> emit 0, not inf
     o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
-    m = m_ref[:, :1]
+    m = m_ref[:, :1] * m_scale
     lse = jnp.where(
         (l > 0.0) & jnp.isfinite(m), m + jnp.log(jnp.where(l > 0.0, l, 1.0)), -jnp.inf
     )
     lse_ref[0] = lse  # (BQ, 1) slice of the (BH, S, 1) stat array
+
+
+def _tri_scores(q2, k, qi, kj, block_q: int, block_k: int, masked: bool):
+    """Raw QKᵀ for the base-2 triangle kernels: NO scale multiply — the
+    softmax scale and log2e ride a pre-scaled operand, so the score tile
+    comes out of the MXU already in base-2 units. ``masked`` applies the
+    causal where (the only mask the triangle paths support)."""
+    s = lax.dot_general(
+        q2, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if masked:
+        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    return s
 
 
 def _flash_fwd_tri_kernel(
@@ -270,9 +308,10 @@ def _flash_fwd_tri_kernel(
     """Flattened-triangle causal forward: the 1-D sequential axis walks
     ONLY the lower-triangle (q block, k block) pairs via prefetched
     tables, so every grid step moves data and computes — no bubbles, and
-    the megacore split falls on the uniform bh axis. Plain causal only
-    (no window/segments/ring offsets — those keep the rectangular
-    kernel)."""
+    the megacore split falls on the uniform bh axis. Runs the base-2
+    softmax on pre-scaled q (see _LOG2E note); finalize converts lse
+    back to natural units. Plain causal only (no window/segments/ring
+    offsets — those keep the rectangular kernel)."""
     t = pl.program_id(1)
     qi = qi_tab_ref[t]
     kj = kj_tab_ref[t]
@@ -288,21 +327,17 @@ def _flash_fwd_tri_kernel(
 
     @pl.when(unmasked)
     def _fast():
-        s, _ = _masked_scores(
-            q_ref[0], k_ref[0], qi, kj, block_q, block_k, causal=False
-        )
-        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=False)
+        s = _tri_scores(q_ref[0], k_ref[0], qi, kj, block_q, block_k, masked=False)
+        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=False, exp_fn=jnp.exp2)
 
     @pl.when(jnp.logical_not(unmasked))
     def _masked():
-        s, _ = _masked_scores(
-            q_ref[0], k_ref[0], qi, kj, block_q, block_k, causal=True
-        )
-        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=True)
+        s = _tri_scores(q_ref[0], k_ref[0], qi, kj, block_q, block_k, masked=True)
+        _online_update(s, v_ref[0], acc_ref, m_ref, l_ref, masked=True, exp_fn=jnp.exp2)
 
     @pl.when(kj == ((qi + 1) * block_q - 1) // block_k)
     def _done():
-        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref)
+        _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref, m_scale=_LN2)
 
 
 def _flash_fwd_kernel(
@@ -531,6 +566,139 @@ def _flash_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _tri_recomputed_p(q2, kx, qi, kj, lse2, block_q, block_k, masked: bool):
+    """Base-2 probability recompute for the triangle backward kernels:
+    ``q2``/``kx`` carry the folded scale+log2e split (see the wrappers),
+    ``lse2`` is the stored natural lse pre-multiplied by log2e. Same
+    guard structure as _recomputed_p's fast/masked paths."""
+    s = _tri_scores(q2, kx, qi, kj, block_q, block_k, masked)
+    if not masked:
+        return jnp.exp2(s - lse2)
+    p = jnp.exp2(s - jnp.where(jnp.isfinite(lse2), lse2, 0.0))
+    return jnp.where(jnp.isneginf(s) | ~jnp.isfinite(lse2), 0.0, p)
+
+
+def _dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc,
+             qi, kj, block_q, block_k, masked: bool):
+    """One dQ accumulation for the triangle walk. Contract: q arrives
+    pre-scaled by log2e, k by 1/√d (their product puts QKᵀ in base-2
+    units), lse by log2e — so dS·scale folds into the already-scaled k
+    (dq = P∘(dP−Δ) @ (k/√d)) and no full-tile scale multiply remains."""
+    q2, ks, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse2 = _row_stat(lse_ref)
+    delta = _row_stat(delta_ref)
+    p = _tri_recomputed_p(q2, ks, qi, kj, lse2, block_q, block_k, masked)
+    dp = lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    dq_acc[:] = dq_acc[:] + lax.dot_general(
+        ds.astype(ks.dtype), ks, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _flash_dq_tri_kernel(
+    qi_tab_ref, kj_tab_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc, *, block_q: int, block_k: int,
+):
+    """Flattened-triangle dQ: grid (bh, T) walking exactly the causal
+    (q block, k block) pairs via prefetched tables — the rectangle's
+    above-diagonal bubbles never exist. Each q row's walk starts at
+    kj=0 and ends at its diagonal block, so init/finalize key off kj
+    alone (same structure as _flash_fwd_tri_kernel)."""
+    t = pl.program_id(1)
+    qi = qi_tab_ref[t]
+    kj = kj_tab_ref[t]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    unmasked = (qi * block_q) >= ((kj + 1) * block_k - 1)
+
+    @pl.when(unmasked)
+    def _fast():
+        _dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc,
+                 qi, kj, block_q, block_k, masked=False)
+
+    @pl.when(jnp.logical_not(unmasked))
+    def _masked():
+        _dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc,
+                 qi, kj, block_q, block_k, masked=True)
+
+    @pl.when(kj == ((qi + 1) * block_q - 1) // block_k)
+    def _done():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_acc, dv_acc,
+              qi, kj, block_q, block_k, masked: bool):
+    """One dK/dV accumulation for the triangle walk. Contract mirrors
+    _dq_step with the fold swapped: q arrives pre-scaled by 1/√d, k by
+    log2e — QKᵀ is base-2 and dK = P∘(dP−Δ) @ (q/√d) needs no further
+    scale. dV = Pᵀ dO is scale-free either way."""
+    qs, k2, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse2 = _row_stat(lse_ref)
+    delta = _row_stat(delta_ref)
+    p = _tri_recomputed_p(qs, k2, qi, kj, lse2, block_q, block_k, masked)
+    dv_acc[:] = dv_acc[:] + lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta)
+    dk_acc[:] = dk_acc[:] + lax.dot_general(
+        ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _flash_dkv_tri_kernel(
+    kj_tab_ref, qi_tab_ref, memb_tab_ref, q_ref, k_ref, v_ref, do_ref,
+    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    *, block_q: int, block_k: int,
+):
+    """Flattened-triangle dK/dV: grid (kvbh, T) where T enumerates, for
+    each k block, exactly its causally-reachable (group member, q block)
+    pairs via prefetched tables — the below-diagonal bubble steps of the
+    rectangular walk never exist. A k column's walk has no fixed first/
+    last index, so boundaries come from comparing adjacent kj table
+    entries (clamped lookups keep t-1/t+1 in range; the member table is
+    consumed by the index maps alone — a member change never crosses a
+    kj boundary, so the accumulators carry straight through)."""
+    t = pl.program_id(1)
+    n_t = pl.num_programs(1)
+    kj = kj_tab_ref[t]
+    qi = qi_tab_ref[t]
+    first = (t == 0) | (kj_tab_ref[jnp.maximum(t - 1, 0)] != kj)
+    last = (t == n_t - 1) | (kj_tab_ref[jnp.minimum(t + 1, n_t - 1)] != kj)
+
+    @pl.when(first)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    unmasked = (qi * block_q) >= ((kj + 1) * block_k - 1)
+
+    @pl.when(unmasked)
+    def _fast():
+        _dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dk_acc, dv_acc, qi, kj, block_q, block_k, masked=False)
+
+    @pl.when(jnp.logical_not(unmasked))
+    def _masked():
+        _dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dk_acc, dv_acc, qi, kj, block_q, block_k, masked=True)
+
+    @pl.when(last)
+    def _done():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _pallas_kwargs(interpret: bool, semantics) -> dict:
     if interpret:
         return {"interpret": True}
@@ -577,13 +745,11 @@ def _flash_forward_triangle(qb, kb, vb, block_q: int, block_k: int,
     bh_count, s, d = qb.shape
     nq = s // block_q
     nk_total = kb.shape[1] // block_k
-    tab_qi, tab_kj = [], []
-    for qi in range(nq):
-        for kj in range(min(nk_total - 1, ((qi + 1) * block_q - 1) // block_k) + 1):
-            tab_qi.append(qi)
-            tab_kj.append(kj)
-    qi_tab = jnp.asarray(tab_qi, jnp.int32)
-    kj_tab = jnp.asarray(tab_kj, jnp.int32)
+    qi_tab, kj_tab = _causal_triangle_tables(nq, nk_total, block_q, block_k)
+    # base-2 softmax: fold the 1/√d scale AND log2e into q ONCE (an
+    # O(S·D) scan; the per-step full-(BQ,BK)-tile scale multiply and the
+    # exp-lowering's log2e multiply both disappear from the hot loop)
+    qb = (qb.astype(jnp.float32) * (_LOG2E / math.sqrt(d))).astype(qb.dtype)
     q_spec = pl.BlockSpec((1, block_q, d), lambda i, t, qit, kjt: (i, qit[t], 0))
     k_spec = pl.BlockSpec(
         (1, block_k, d),
@@ -592,7 +758,7 @@ def _flash_forward_triangle(qb, kb, vb, block_q: int, block_k: int,
     lse_spec = pl.BlockSpec((1, block_q, 1), lambda i, t, qit, kjt: (i, qit[t], 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(bh_count, len(tab_qi)),
+        grid=(bh_count, qi_tab.shape[0]),
         in_specs=[q_spec, k_spec, k_spec],
         out_specs=(q_spec, lse_spec),
         scratch_shapes=[
@@ -784,6 +950,108 @@ def _flash_core_seg_bwd(causal, block_q, block_k, heads, kv_heads, window, resid
     return dq, dk, dv, dseg
 
 
+def _causal_triangle_tables(nq: int, nk_total: int, block_q: int, block_k: int):
+    """Row-major (q block, k block) walk tables of the causal lower
+    triangle — shared by the forward and dQ triangle kernels."""
+    tab_qi, tab_kj = [], []
+    for qi in range(nq):
+        for kj in range(min(nk_total - 1, ((qi + 1) * block_q - 1) // block_k) + 1):
+            tab_qi.append(qi)
+            tab_kj.append(kj)
+    return jnp.asarray(tab_qi, jnp.int32), jnp.asarray(tab_kj, jnp.int32)
+
+
+def _flash_dq_triangle(qb, kb, vb, g, lse, delta, block_q, block_k,
+                       heads, kv_heads, interpret):
+    """dQ over the flattened causal triangle (see _flash_dq_tri_kernel).
+    Folds the softmax scale split across the operands once, outside the
+    hot loop: q·log2e and k/√d make QKᵀ base-2, and the pre-scaled k
+    doubles as dS's missing ·scale in the final dot."""
+    bh_count, s, d = qb.shape
+    nq = s // block_q
+    nk_total = kb.shape[1] // block_k
+    qi_tab, kj_tab = _causal_triangle_tables(nq, nk_total, block_q, block_k)
+    qb = (qb.astype(jnp.float32) * _LOG2E).astype(qb.dtype)
+    kb = (kb.astype(jnp.float32) * (1.0 / math.sqrt(d))).astype(kb.dtype)
+    lse = lse * _LOG2E
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, t, qit, kjt: (i, qit[t], 0))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d),
+        lambda i, t, qit, kjt: (_kv_row(i, heads, kv_heads), kjt[t], 0),
+    )
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda i, t, qit, kjt: (i, qit[t], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh_count, qi_tab.shape[0]),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        partial(_flash_dq_tri_kernel, block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+        grid_spec=grid_spec,
+        **_pallas_kwargs(interpret, ("parallel", "arbitrary")),
+    )(qi_tab, kj_tab, qb, kb, vb, g, lse, delta)
+
+
+def _flash_dkv_triangle(qb, kb, vb, g, lse, delta, block_q, block_k,
+                        heads, kv_heads, interpret):
+    """dK/dV over the flattened causal triangle: for each k block, walk
+    its causally-reachable (group member, q block) pairs only (see
+    _flash_dkv_tri_kernel). Scale fold mirrors _flash_dq_triangle with
+    the split swapped: q/√d and k·log2e, so dK's dot reuses the
+    pre-scaled q."""
+    bh_count, s, d = qb.shape
+    qb = (qb.astype(jnp.float32) * (1.0 / math.sqrt(d))).astype(qb.dtype)
+    kb = (kb.astype(jnp.float32) * _LOG2E).astype(kb.dtype)
+    lse = lse * _LOG2E
+    kvbh = kb.shape[0]
+    group = heads // kv_heads
+    nq = s // block_q
+    nk_total = kb.shape[1] // block_k
+    tab_kj, tab_qi, tab_memb = [], [], []
+    for kj in range(nk_total):
+        qi0 = (kj * block_k) // block_q
+        for memb in range(group):
+            for qi in range(qi0, nq):
+                tab_kj.append(kj)
+                tab_qi.append(qi)
+                tab_memb.append(memb)
+    kj_tab = jnp.asarray(tab_kj, jnp.int32)
+    qi_tab = jnp.asarray(tab_qi, jnp.int32)
+    memb_tab = jnp.asarray(tab_memb, jnp.int32)
+
+    def q_index(i, t, kjt, qit, mt):
+        row = (i // kv_heads) * heads + (i % kv_heads) * group + mt[t]
+        return (row, qit[t], 0)
+
+    q_spec = pl.BlockSpec((1, block_q, d), q_index)
+    row_spec = pl.BlockSpec(
+        (1, block_q, 1), lambda i, t, kjt, qit, mt: q_index(i, t, kjt, qit, mt)
+    )
+    k_spec = pl.BlockSpec((1, block_k, d), lambda i, t, kjt, qit, mt: (i, kjt[t], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(kvbh, kj_tab.shape[0]),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=(k_spec, k_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),  # dk acc
+            pltpu.VMEM((block_k, d), jnp.float32),  # dv acc
+        ],
+    )
+    return pl.pallas_call(
+        partial(_flash_dkv_tri_kernel, block_q=block_q, block_k=block_k),
+        out_shape=(
+            jax.ShapeDtypeStruct(kb.shape, kb.dtype),
+            jax.ShapeDtypeStruct(vb.shape, vb.dtype),
+        ),
+        grid_spec=grid_spec,
+        **_pallas_kwargs(interpret, ("parallel", "arbitrary")),
+    )(kj_tab, qi_tab, memb_tab, qb, kb, vb, g, lse, delta)
+
+
 def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
                     heads, kv_heads, window, seg=None):
     bh_count, s, d = qb.shape
@@ -798,6 +1066,23 @@ def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
     interpret = jax.devices()[0].platform != "tpu"
     # D_i = rowsum(dO ∘ O): cheap elementwise, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True)
+    # flattened-triangle walks (same constraints as the forward's:
+    # plain causal, square diagonal, no window/segments)
+    plain_causal = causal and window is None and seg is None and kb.shape[1] == s
+    use_tri_dq = plain_causal and _TRIANGLE_DQ
+    use_tri_dkv = plain_causal and _TRIANGLE_DKV
+    if use_tri_dq and use_tri_dkv:
+        # the default path returns before any rectangular spec/banding
+        # construction (mirrors _flash_forward's early triangle return);
+        # mixed flag settings (sweep experiments) fall through and pick
+        # per-kernel below
+        dq = _flash_dq_triangle(
+            qb, kb, vb, g, lse, delta, block_q, block_k, heads, kv_heads, interpret
+        )
+        dk, dv = _flash_dkv_triangle(
+            qb, kb, vb, g, lse, delta, block_q, block_k, heads, kv_heads, interpret
+        )
+        return dq, dk, dv
     nq = s // block_q
     nk_total = s // block_k
     # band the k walk like the forward: only window blocks are loaded
@@ -831,17 +1116,22 @@ def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
             ),
         ]
         dq_inputs += [seg, seg]
-    dq = pl.pallas_call(
-        partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
-                causal=causal, window=window, nk_total=nk_total,
-                permute_q=permute_q, segments=seg is not None),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
-        grid=(bh_count, nq, nk_band),
-        in_specs=dq_in_specs,
-        out_specs=q_spec,
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
-    )(*dq_inputs)
+    if use_tri_dq:
+        dq = _flash_dq_triangle(
+            qb, kb, vb, g, lse, delta, block_q, block_k, heads, kv_heads, interpret
+        )
+    else:
+        dq = pl.pallas_call(
+            partial(_flash_dq_kernel, block_q=block_q, block_k=block_k,
+                    causal=causal, window=window, nk_total=nk_total,
+                    permute_q=permute_q, segments=seg is not None),
+            out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+            grid=(bh_count, nq, nk_band),
+            in_specs=dq_in_specs,
+            out_specs=q_spec,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+        )(*dq_inputs)
     # dK/dV: kv rows own the grid; the sequential axis enumerates every
     # (group member, banded q block) pair that attends this KV head
     kvbh = kb.shape[0]
@@ -896,31 +1186,36 @@ def _flash_bwd_impl(qb, kb, vb, out, lse, g, causal, block_q, block_k,
             ),
         ]
         dkv_inputs += [seg, seg]
-    dk, dv = pl.pallas_call(
-        partial(
-            _flash_dkv_kernel,
-            block_q=block_q,
-            block_k=block_k,
-            causal=causal,
-            q_blocks=nq_band,
-            window=window,
-            nq_total=nq,
-            permute_kv=permute_kv,
-            segments=seg is not None,
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct(kb.shape, kb.dtype),
-            jax.ShapeDtypeStruct(vb.shape, vb.dtype),
-        ),
-        grid=(kvbh, nk_total, nq_band * group),
-        in_specs=dkv_in_specs,
-        out_specs=(kq_k_spec, kq_k_spec),
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),  # dk acc
-            pltpu.VMEM((block_k, d), jnp.float32),  # dv acc
-        ],
-        **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
-    )(*dkv_inputs)
+    if use_tri_dkv:
+        dk, dv = _flash_dkv_triangle(
+            qb, kb, vb, g, lse, delta, block_q, block_k, heads, kv_heads, interpret
+        )
+    else:
+        dk, dv = pl.pallas_call(
+            partial(
+                _flash_dkv_kernel,
+                block_q=block_q,
+                block_k=block_k,
+                causal=causal,
+                q_blocks=nq_band,
+                window=window,
+                nq_total=nq,
+                permute_kv=permute_kv,
+                segments=seg is not None,
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct(kb.shape, kb.dtype),
+                jax.ShapeDtypeStruct(vb.shape, vb.dtype),
+            ),
+            grid=(kvbh, nk_total, nq_band * group),
+            in_specs=dkv_in_specs,
+            out_specs=(kq_k_spec, kq_k_spec),
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),  # dk acc
+                pltpu.VMEM((block_k, d), jnp.float32),  # dv acc
+            ],
+            **_pallas_kwargs(interpret, ("parallel", "parallel", "arbitrary")),
+        )(*dkv_inputs)
     return dq, dk, dv
 
 

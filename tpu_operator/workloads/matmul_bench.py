@@ -54,7 +54,9 @@ def chip_generation() -> str:
     )
 
 
-def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
+def matmul_tflops(
+    size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5, device=None
+) -> dict:
     """z = z @ y chained INSIDE one jitted fori_loop: the whole timed
     region is a single device program, so host dispatch latency (large
     AND noisy under the remote-relay dev setup) never sits between
@@ -69,6 +71,11 @@ def matmul_tflops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int 
     # scale so the chain neither explodes nor vanishes
     y = (jax.random.normal(jax.random.PRNGKey(1), (size, size), dtype=jnp.bfloat16)
          / jnp.bfloat16(size ** 0.5))
+    if device is not None:
+        # per-chip measurement (the validator's minTflops floor checks
+        # EVERY local chip — a throttled chip 2 must not hide behind a
+        # healthy chip 0)
+        x, y = jax.device_put(x, device), jax.device_put(y, device)
 
     @partial(jax.jit, static_argnames="n")
     def chain(z, y, s, n):
