@@ -479,11 +479,17 @@ class TestWatch410Recovery:
 
         monkeypatch.setattr(client, "_stream_watch", flaky)
         seen = []
-        sub = client.watch(
-            "v1",
-            "ConfigMap",
-            lambda et, o: et != "SYNC" and seen.append((et, o["metadata"]["name"])),
-        )
+
+        def handler(et, o):
+            # consume snapshots like a cache consumer would: an object
+            # created in the re-registration window arrives in the SYNC
+            # replay, not as a live ADDED (racing which one is flaky)
+            if et == "SYNC":
+                seen.extend(("ADDED", i["metadata"]["name"]) for i in o.get("items", []))
+                return
+            seen.append((et, o["metadata"]["name"]))
+
+        sub = client.watch("v1", "ConfigMap", handler)
         assert wait_for(lambda: calls["n"] >= 2, timeout=10), "no re-watch after 410"
         store.create(new_object("v1", "ConfigMap", "after", NS))
         assert wait_for(lambda: ("ADDED", "after") in seen, timeout=10)

@@ -1,0 +1,175 @@
+"""RBAC completeness gate: the SHIPPED ClusterRole must cover every
+request the operator actually makes.
+
+A real apiserver enforces RBAC, so a missing verb surfaces as 403s in
+production — a failure mode the permissive in-memory fake could never
+show. The reference catches this implicitly by running e2e on a live
+cluster (tests/e2e/gpu_operator_test.go:104-170); here the fake
+apiserver's enforcing mode (FakeApiServer(authorize=...)) replays the
+same check against the chart's rendered ClusterRole while the full
+install→Ready flow runs over the wire.
+"""
+
+import os
+import time
+
+import pytest
+import yaml
+
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_with_manager,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.http_client import HttpClient
+from tpu_operator.kube.httpserver import FakeApiServer, RbacAuthorizer
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+NS = "tpu-operator"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def shipped_rules() -> list:
+    """The ClusterRole rules every install path ships (chart, tpuop-cfg
+    render, kustomize — parity-tested elsewhere, so any one source is
+    authoritative)."""
+    from tpu_operator.chart import render_chart
+
+    with open(os.path.join(REPO, "deploy", "values.yaml")) as f:
+        objs = render_chart(yaml.safe_load(f))
+    (role,) = [o for o in objs if o["kind"] == "ClusterRole"]
+    return role["rules"]
+
+
+def wait_for(fn, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestRbacAuthorizer:
+    def test_rule_matching(self):
+        auth = RbacAuthorizer(
+            [
+                {"apiGroups": [""], "resources": ["pods"], "verbs": ["get", "list"]},
+                {"apiGroups": ["apps"], "resources": ["*"], "verbs": ["*"]},
+                {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
+            ]
+        )
+        assert auth.allows("", "pods", "get")
+        assert not auth.allows("", "pods", "delete")
+        assert auth.allows("apps", "daemonsets", "patch")
+        assert auth.allows("", "pods/eviction", "create")
+        assert not auth.allows("", "pods/eviction", "delete")
+        assert not auth.allows("", "secrets", "get")
+
+    def test_subresource_wildcard(self):
+        """kube's ResourceMatches accepts '*/subresource' — and does NOT
+        support 'resource/*' (a rule written that way covers nothing)."""
+        auth = RbacAuthorizer(
+            [{"apiGroups": [""], "resources": ["*/eviction"], "verbs": ["create"]}]
+        )
+        assert auth.allows("", "pods/eviction", "create")
+        assert not auth.allows("", "pods", "create")
+        bogus = RbacAuthorizer(
+            [{"apiGroups": [""], "resources": ["pods/*"], "verbs": ["create"]}]
+        )
+        assert not bogus.allows("", "pods/eviction", "create")
+
+
+class TestOperatorUnderEnforcement:
+    def _run_install(self, rules):
+        store = FakeClient()
+        for i in range(2):
+            store.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "2x4"))
+        authorizer = RbacAuthorizer(rules)
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        sim = ClusterSim(store, ready_delay=0.02, tick=0.01).start()
+        mgr = Manager(client, namespace=NS)
+        setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
+        try:
+            mgr.start()
+            client.create(new_cluster_policy())
+
+            def ready():
+                cp = store.get_or_none(
+                    CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy"
+                )
+                return (cp or {}).get("status", {}).get("state") == "ready"
+
+            became_ready = wait_for(ready, timeout=30)
+            return became_ready, authorizer.denials
+        finally:
+            mgr.stop()
+            sim.stop()
+            server.stop()
+
+    def test_shipped_clusterrole_covers_the_whole_install(self):
+        """Install→Ready under full RBAC enforcement with exactly the
+        rules every install path ships: zero denials allowed. A failure
+        here means a production operator would be throwing 403s."""
+        became_ready, denials = self._run_install(shipped_rules())
+        assert became_ready, f"never Ready under enforcement; denials={sorted(set(denials))}"
+        assert not denials, f"ClusterRole gaps: {sorted(set(denials))}"
+
+    # The drill drives the operator FSM AND its own harness (fake kubelet
+    # marking pods Running, test-admin managing the PDB fixture) through
+    # one client. On a real cluster those harness ops run under kubelet/
+    # admin credentials, never the operator's — so the enforcement run
+    # supplements the shipped rules with exactly that actor's slice. The
+    # operator's own upgrade verbs (node cordon/label updates, pod
+    # deletes, pods/eviction create) must still come from shipped_rules.
+    HARNESS_RULES = [
+        {"apiGroups": [""], "resources": ["pods/status"], "verbs": ["update"]},
+        {
+            "apiGroups": ["policy"],
+            "resources": ["poddisruptionbudgets"],
+            "verbs": ["get", "list", "create", "update", "delete"],
+        },
+    ]
+
+    def test_upgrade_drill_runs_under_enforcement(self):
+        """The rolling-upgrade FSM (cordon → PDB-parked eviction → drain
+        → validate → uncordon) exercises verbs the install alone never
+        does — pods/eviction create, node updates mid-walk, grace-period
+        pod deletes. All operator-side traffic must be covered by the
+        shipped rules (harness-side kubelet/admin ops get their own
+        slice, as on a real cluster)."""
+        from drill import assert_drill_passed, run_upgrade_drill
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(shipped_rules() + self.HARNESS_RULES)
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            obs = run_upgrade_drill(client, NS)
+            assert_drill_passed(obs)
+            assert not authorizer.denials, (
+                f"ClusterRole gaps in the upgrade path: {sorted(set(authorizer.denials))}"
+            )
+        finally:
+            server.stop()
+
+    def test_enforcement_actually_bites(self):
+        """Negative control: strip daemonsets from the rules and the same
+        flow must record denials (proves the gate can fail — without
+        this, a broken authorizer that allows everything would make the
+        positive test meaningless)."""
+        rules = [
+            r
+            for r in shipped_rules()
+            if "daemonsets" not in (r.get("resources") or [])
+        ]
+        became_ready, denials = self._run_install(rules)
+        assert any(res == "daemonsets" for _, _, res in denials), denials
+        assert not became_ready, "Ready despite the operator being unable to manage DaemonSets"

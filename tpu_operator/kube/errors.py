@@ -25,6 +25,14 @@ class Conflict(ApiError):
     code = 409
 
 
+class Forbidden(ApiError):
+    """403: the authenticated subject's RBAC rules do not cover this
+    verb/resource (the fake apiserver raises it in enforcing mode — see
+    FakeApiServer(authorize=...))."""
+
+    code = 403
+
+
 class Invalid(ApiError):
     code = 422
 

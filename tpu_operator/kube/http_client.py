@@ -429,6 +429,8 @@ class HttpClient(Client):
                 raise errors.Conflict(detail)
             if status in (400, 422):
                 raise errors.Invalid(detail)
+            if status == 403:
+                raise errors.Forbidden(detail)
             if status == 410:
                 raise errors.Expired(detail)
             if status == 429:

@@ -35,6 +35,7 @@ from typing import Dict, Optional, Tuple
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.http_client import plural_of
+from tpu_operator.kube.objects import api_group
 
 log = logging.getLogger(__name__)
 
@@ -84,22 +85,72 @@ def _kind_map() -> Dict[str, str]:
     return {plural_of(k): k for k in kinds}
 
 
+class RbacAuthorizer:
+    """Kube PolicyRule evaluation (the RBAC authorizer's allow logic for
+    one subject): ``rules`` is a ClusterRole's ``rules`` list. Used by
+    FakeApiServer's enforcing mode so the suite can prove the operator's
+    SHIPPED ClusterRole covers every request the operator actually makes
+    — real clusters enforce this and fail with 403s the in-memory fake
+    otherwise never surfaces (the reference gets the check implicitly
+    from its live-cluster e2e)."""
+
+    def __init__(self, rules):
+        self.rules = rules or []
+        self.denials: list = []  # (verb, group, resource) of every 403
+
+    def allows(self, group: str, resource: str, verb: str) -> bool:
+        for rule in self.rules:
+            groups = rule.get("apiGroups") or []
+            if group not in groups and "*" not in groups:
+                continue
+            resources = rule.get("resources") or []
+            if (
+                resource not in resources
+                and "*" not in resources
+                # kube's ResourceMatches accepts "*/subresource" (any
+                # resource, that subresource) — NOT "resource/*"
+                and not ("/" in resource and "*/" + resource.split("/", 1)[1] in resources)
+            ):
+                continue
+            verbs = rule.get("verbs") or []
+            if verb in verbs or "*" in verbs:
+                return True
+        return False
+
+    def check(self, group: str, resource: str, verb: str) -> None:
+        if not self.allows(group, resource, verb):
+            self.denials.append((verb, group, resource))
+            raise errors.Forbidden(
+                f"RBAC: cannot {verb!r} resource {resource!r} in API group {group!r}"
+            )
+
+
 class FakeApiServer:
     """ThreadingHTTPServer translating kube REST calls onto a Client.
 
     ``tls=True`` mints a self-signed CA + serving cert for ``localhost``
     (certs.py machinery) and serves HTTPS — what ``HttpClient.in_cluster``
     expects, so real entrypoint processes can run against this server with
-    the standard in-cluster env (see scripts/image_smoke.py)."""
+    the standard in-cluster env (see scripts/image_smoke.py).
+
+    ``authorize=RbacAuthorizer(rules)`` turns on RBAC enforcement: every
+    request is checked against the rules and denied with 403 when
+    uncovered."""
 
     # bound on concurrently parked pagination snapshots (kube bounds them
     # by etcd compaction; beyond the cap the oldest token answers 410)
     _MAX_LIST_SNAPSHOTS = 64
 
     def __init__(
-        self, client: Client, host: str = "127.0.0.1", port: int = 0, tls: bool = False
+        self,
+        client: Client,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls: bool = False,
+        authorize: Optional[RbacAuthorizer] = None,
     ):
         self.client = client
+        self.authorizer = authorize
         self._plural_to_kind = _kind_map()
         self._stopped = threading.Event()
         # continue token -> remaining items of a paged LIST, captured as a
@@ -155,6 +206,8 @@ class FakeApiServer:
                     self._send(429, {"reason": "TooManyRequests", "message": str(e)})
                 except errors.Expired as e:
                     self._send(410, {"reason": "Expired", "message": str(e)})
+                except errors.Forbidden as e:
+                    self._send(403, {"reason": "Forbidden", "message": str(e)})
                 except errors.Invalid as e:
                     self._send(422, {"reason": "Invalid", "message": str(e)})
                 except (BrokenPipeError, ConnectionResetError):
@@ -253,6 +306,20 @@ class FakeApiServer:
         raw_path, _, raw_query = handler.path.partition("?")
         query = urllib.parse.parse_qs(raw_query)
         api_version, kind, namespace, name, sub = self._parse(raw_path)
+
+        if self.authorizer is not None:
+            resource = plural_of(kind) + (f"/{sub}" if sub else "")
+            if method == "GET" and name is None and query.get("watch") == ["true"]:
+                verb = "watch"
+            elif method == "GET":
+                verb = "get" if name else "list"
+            elif method == "POST":
+                verb = "create"
+            elif method == "PUT":
+                verb = "update"
+            else:
+                verb = "delete"
+            self.authorizer.check(api_group(api_version), resource, verb)
 
         if method == "GET" and name is None:
             if query.get("watch") == ["true"]:
