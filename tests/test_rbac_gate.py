@@ -86,6 +86,130 @@ class TestRbacAuthorizer:
         assert not bogus.allows("", "pods/eviction", "create")
 
 
+def state_rules(state_name: str) -> list:
+    """Combined Role + ClusterRole rules one operand state ships for its
+    agent's ServiceAccount (namespace scoping collapses — the operator
+    is single-namespace, so the union is the agent's effective rules).
+    Two same-named Role/ClusterRole objects in one state are rejected
+    outright: on a real cluster only the last-applied one exists, so a
+    permissive union here could pass a gate production would fail."""
+    from tpu_operator.api import ClusterPolicy
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.catalog import InfoCatalog
+    from tpu_operator.states import new_cluster_policy_states
+
+    cp = ClusterPolicy.from_unstructured(new_cluster_policy())
+    catalog = InfoCatalog(cluster_policy=cp)
+    states = {s.name: s for s in new_cluster_policy_states()}
+    state = states[state_name]
+    by_name: dict = {}
+    for obj in state.renderer.render_objects(state.get_render_data(catalog)):
+        if obj["kind"] in ("Role", "ClusterRole"):
+            key = (obj["kind"], obj["metadata"]["name"])
+            assert key not in by_name, (
+                f"{state_name} renders duplicate {key} — same-named RBAC "
+                "objects overwrite each other on a live cluster"
+            )
+            by_name[key] = obj["rules"]
+    rules = []
+    for obj_rules in by_name.values():
+        rules.extend(obj_rules)
+    return rules
+
+
+class TestAgentsUnderEnforcement:
+    """Each operand agent that talks to the apiserver runs its core loop
+    under enforcement with exactly the Role/ClusterRole its own state
+    ships — the same 403s a real cluster would produce for a missing
+    grant."""
+
+    def _enforced(self, state_name):
+        store = FakeClient()
+        authorizer = RbacAuthorizer(state_rules(state_name))
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        return store, server, client, authorizer
+
+    def test_tfd_agent(self, tmp_path, monkeypatch):
+        from tpu_operator.agents.tfd_agent import TFDAgent
+
+        (tmp_path / "dev").mkdir()
+        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+        store, server, client, auth = self._enforced("state-tpu-feature-discovery")
+        try:
+            store.create(make_tpu_node("tpu-0"))
+            assert TFDAgent(client, "tpu-0").apply_once()
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+    def test_node_discovery_agent(self, tmp_path, monkeypatch):
+        from tpu_operator.agents.node_discovery_agent import NodeDiscoveryAgent
+        from tpu_operator.kube.sim import make_bare_node
+
+        (tmp_path / "dev").mkdir()
+        for i in range(4):
+            (tmp_path / "dev" / f"accel{i}").touch()
+        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+        for var in ("TPU_TOPOLOGY", "TPU_ACCELERATOR_TYPE"):
+            monkeypatch.delenv(var, raising=False)
+        store, server, client, auth = self._enforced("state-node-discovery")
+        try:
+            store.create(make_bare_node("bare-0"))
+            assert NodeDiscoveryAgent(client, "bare-0").apply_once()
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+    def test_slice_manager_agent(self):
+        from tpu_operator import consts
+        from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+
+        store, server, client, auth = self._enforced("state-slice-manager")
+        try:
+            for i in range(4):
+                node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4")
+                node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+                store.create(node)
+            names = SliceManagerAgent(client, NS).reconcile_once()
+            assert names, "no slice reconciled"
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+    def test_device_plugin_config_selection(self):
+        from tpu_operator.agents.device_plugin_agent import select_plugin_config
+        from tpu_operator.kube.objects import new_object
+
+        store, server, client, auth = self._enforced("state-device-plugin")
+        try:
+            store.create(make_tpu_node("tpu-0"))
+            store.create(
+                new_object(
+                    "v1", "ConfigMap", "plugin-config", NS,
+                    data={"default": "sharing:\n  chips_per_container: 1\n"},
+                )
+            )
+            cfg = select_plugin_config(client, "tpu-0", "plugin-config", NS, default="default")
+            assert cfg == {"sharing": {"chips_per_container": 1}}
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+    def test_validator_plugin_component(self):
+        from tpu_operator.validator.main import Context, validate_plugin
+
+        store, server, client, auth = self._enforced("state-operator-validation")
+        try:
+            store.create(make_tpu_node("tpu-0", chips=4))
+            ctx = Context(client=client, node_name="tpu-0", retry_interval=0.01)
+            report = validate_plugin(ctx)
+            assert report["chips"] == 4
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+
 class TestOperatorUnderEnforcement:
     def _run_install(self, rules):
         store = FakeClient()
