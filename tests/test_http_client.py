@@ -258,6 +258,87 @@ def test_watch_streams_events(stub):
     assert ("ADDED", "n1") in received
 
 
+def test_watch_resumes_from_last_rv_without_relist():
+    """client-go Reflector parity: when a stream ends cleanly (apiserver
+    watch timeout), the loop must resume the watch from the last seen
+    resourceVersion — NOT pay a full re-list — provided the server's
+    lists advertise real (nonzero) rvs. The mini-server below acts like
+    real kube: rv'd LIST, a first watch session that delivers one event
+    then ends, and subsequent sessions that record their start rv."""
+    import json as _json
+    import threading as _threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    lists = []
+    watch_rvs = []
+    second_session = _threading.Event()
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # noqa: A003
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if "watch=true" in self.path:
+                import urllib.parse as up
+
+                q = up.parse_qs(up.urlsplit(self.path).query)
+                rv = (q.get("resourceVersion") or [""])[0]
+                watch_rvs.append(rv)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                if len(watch_rvs) == 1:
+                    # first session: one event past the list rv, then a
+                    # bookmark advancing progress, then clean stream end
+                    for ev in (
+                        {"type": "ADDED", "object": {"metadata": {"name": "n1", "resourceVersion": "11"}}},
+                        {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "12"}}},
+                    ):
+                        self.wfile.write(_json.dumps(ev).encode() + b"\n")
+                        self.wfile.flush()
+                    return  # connection closes: clean end
+                second_session.set()
+                # hold the second session open briefly so the loop doesn't
+                # spin through more reconnects while the test asserts
+                import time as _time
+
+                _time.sleep(2)
+                return
+            # LIST: real-kube style nonzero resourceVersion
+            lists.append(self.path)
+            body = _json.dumps(
+                {"apiVersion": "v1", "kind": "NodeList",
+                 "metadata": {"resourceVersion": "10"}, "items": []}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = HttpClient(f"http://127.0.0.1:{httpd.server_address[1]}", timeout=5.0)
+    seen = []
+    sub = client.watch("v1", "Node", lambda et, o: seen.append(et))
+    try:
+        assert second_session.wait(10), "watch never reconnected"
+        assert watch_rvs[0] == "10"  # first session starts at the list rv
+        # the reconnect resumed from the bookmark's progress rv — and did
+        # NOT re-list (one LIST total, no second SYNC delivered)
+        assert watch_rvs[1] == "12", watch_rvs
+        assert len(lists) == 1, lists
+        assert seen.count("SYNC") == 1
+    finally:
+        sub.stop()
+        httpd.shutdown()
+        httpd.server_close()
+
+
 class TestPooledRetryIdempotency:
     """A reused keep-alive connection dying before the status line is an
     ambiguous failure — the server may have processed the request before

@@ -563,6 +563,7 @@ class HttpClient(Client):
 
     def _watch_loop(self, api_version, kind, handler, namespace, sub: _WatchSub) -> None:
         resource_version = ""
+        can_resume = False  # server serves arbitrary-rv watches (real kube)
         while sub.active:
             try:
                 if not resource_version:
@@ -571,7 +572,8 @@ class HttpClient(Client):
                     # large clusters are exactly where one giant response
                     # would hurt most)
                     items, resource_version = self._list_paged(api_version, kind, namespace)
-                    if resource_version != "0":
+                    can_resume = resource_version != "0"
+                    if can_resume:
                         # real apiserver: deliver the list as ONE SYNC
                         # snapshot (cache consumers replace their store,
                         # learning about objects deleted during the gap)
@@ -588,8 +590,17 @@ class HttpClient(Client):
                     # atomically with watch registration (kube's
                     # resourceVersion=0 semantics) — replaying the list
                     # here too would be a stale second snapshot
-                self._stream_watch(api_version, kind, handler, namespace, sub, resource_version)
-                resource_version = ""  # stream ended: full re-list
+                last_rv = self._stream_watch(
+                    api_version, kind, handler, namespace, sub, resource_version
+                )
+                # clean stream end (apiserver watch timeout): resume from
+                # the last delivered resourceVersion instead of a full
+                # re-list — client-go's Reflector behavior; gap-free
+                # because rv continuity is preserved, and a too-old rv
+                # answers 410 which lands in the re-list branch below.
+                # Servers whose lists advertise rv "0" (the in-repo fake)
+                # keep no history to resume from — always re-list there.
+                resource_version = last_rv if (can_resume and last_rv) else ""
             except errors.ApiError as e:
                 log.warning("watch %s: %s; re-listing", kind, e)
                 resource_version = ""
@@ -599,7 +610,11 @@ class HttpClient(Client):
             if sub.active:
                 sub._stopped.wait(1.0)
 
-    def _stream_watch(self, api_version, kind, handler, namespace, sub, resource_version) -> None:
+    def _stream_watch(
+        self, api_version, kind, handler, namespace, sub, resource_version
+    ) -> Optional[str]:
+        """Run one watch stream; returns the last resourceVersion seen
+        (events and bookmarks) so the loop can resume without re-listing."""
         query = {"watch": "true", "allowWatchBookmarks": "true"}
         if resource_version:
             query["resourceVersion"] = resource_version
@@ -609,12 +624,17 @@ class HttpClient(Client):
         token = self._bearer()  # watch streams reconnect, picking up fresh tokens
         if token:
             req.add_header("Authorization", f"Bearer {token}")
+        # the START rv is itself a valid resume point: an idle stream the
+        # server closes without delivering anything (bookmarks are
+        # best-effort) must not force a full re-list on every watch
+        # timeout (client-go resumes from lastSyncResourceVersion)
+        last_rv: Optional[str] = resource_version or None
         with urllib.request.urlopen(req, timeout=300, context=self._ssl) as resp:
             buffer = b""
             while sub.active:
                 chunk = resp.read1(65536)
                 if not chunk:
-                    return
+                    return last_rv
                 buffer += chunk
                 while b"\n" in buffer:
                     line, buffer = buffer.split(b"\n", 1)
@@ -622,6 +642,9 @@ class HttpClient(Client):
                         continue
                     event = json.loads(line)
                     etype, obj = event.get("type"), event.get("object", {})
+                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if rv:  # bookmarks carry the server's progress rv too
+                        last_rv = rv
                     if etype == "BOOKMARK":
                         continue
                     if etype == "ERROR":
@@ -629,3 +652,4 @@ class HttpClient(Client):
                     obj.setdefault("apiVersion", api_version)
                     obj.setdefault("kind", kind)
                     handler(etype, obj)
+        return last_rv
