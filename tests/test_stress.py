@@ -138,7 +138,7 @@ def test_informer_converges_through_apiserver_restarts_with_churn():
     apiserver outages while a mutator concurrently creates and deletes
     objects — deletions lost in the blind windows heal via the reconnect
     SYNC Replace (no phantoms), creations are never lost. 3 restart
-    cycles, ~40 mutations."""
+    cycles, one mutation every 20 ms throughout (~150+ total)."""
     import random
 
     from tpu_operator.kube.http_client import HttpClient
