@@ -284,6 +284,43 @@ class TestOperatorUnderEnforcement:
         finally:
             server.stop()
 
+    def test_cert_lifecycle_under_enforcement(self, tmp_path):
+        """The webhook cert manager's full converge path (Secret adopt/
+        publish, VWC caBundle patch) runs under the shipped rules — the
+        install flow never exercises secrets/VWC verbs (webhook defaults
+        off), so without this the role's secrets/admissionregistration
+        slices were untested claims."""
+        from tpu_operator.certs import WebhookCertManager
+        from tpu_operator.kube.objects import new_object
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(shipped_rules())
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            store.create(
+                new_object(
+                    "admissionregistration.k8s.io/v1",
+                    "ValidatingWebhookConfiguration",
+                    "tpu-operator",
+                    webhooks=[{"name": "clusterpolicy.tpu.google.com", "clientConfig": {}}],
+                )
+            )
+            mgr = WebhookCertManager(client, NS, str(tmp_path))
+            assert mgr.ensure()  # mint + publish Secret + patch caBundle
+            assert not mgr.ensure()  # converged: second pass is a no-op
+            secret = store.get("v1", "Secret", "tpu-operator-webhook-tls", NS)
+            assert secret["data"]["tls.crt"]
+            vwc = store.get(
+                "admissionregistration.k8s.io/v1",
+                "ValidatingWebhookConfiguration",
+                "tpu-operator",
+            )
+            assert vwc["webhooks"][0]["clientConfig"]["caBundle"]
+            assert not authorizer.denials, sorted(set(authorizer.denials))
+        finally:
+            server.stop()
+
     def test_enforcement_actually_bites(self):
         """Negative control: strip daemonsets from the rules and the same
         flow must record denials (proves the gate can fail — without
