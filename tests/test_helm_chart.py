@@ -341,6 +341,11 @@ class TestHelmliteEngine:
         ctx = {"Values": {"name": "TPU-Op", "tag": "v1.2.3-rc"}}
         cases = [
             ('{{ printf "%s:%d" .Values.name 8080 }}', "TPU-Op:8080"),
+            # Go fmt width/precision specs and %f (default 6 decimals)
+            ('{{ printf "%.1f" 1.25 }}', "1.2"),
+            ('{{ printf "%f" 1.5 }}', "1.500000"),
+            ('{{ printf "%5d|%-4s|" 42 "ab" }}', "   42|ab  |"),
+            ('{{ printf "100%%" }}', "100%"),
             ("{{ .Values.name | lower }}", "tpu-op"),
             ("{{ .Values.name | upper }}", "TPU-OP"),
             ('{{ .Values.tag | trimPrefix "v" }}', "1.2.3-rc"),
@@ -369,6 +374,11 @@ class TestHelmliteEngine:
             helmlite.render_string('{{ printf "%x" 5 }}', {})
         with pytest.raises(helmlite.HelmliteError, match="wants an integer"):
             helmlite.render_string('{{ printf "%d" "v1.2" }}', {})
+        # malformed specs fail the engine's error contract, not ValueError
+        with pytest.raises(helmlite.HelmliteError, match="malformed spec"):
+            helmlite.render_string('{{ printf "%5-d" 3 }}', {})
+        with pytest.raises(helmlite.HelmliteError, match="malformed spec"):
+            helmlite.render_string('{{ printf "%1.2.3f" 1.0 }}', {})
 
     def test_len_of_nil_raises_and_missing_key_is_empty_string(self):
         # Go errors on len of untyped nil; answering 0 would silently

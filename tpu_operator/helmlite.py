@@ -96,9 +96,22 @@ def _printf(fmt: Any, *args: Any) -> str:
             continue
         if i + 1 >= len(s):
             raise HelmliteError("printf: trailing % in " + repr(fmt))
-        verb = s[i + 1]
-        i += 2
+        # optional width[.precision] between % and the verb (Go fmt):
+        # %5d, %.2f, %8.3f, %-10s
+        j = i + 1
+        while j < len(s) and (s[j].isdigit() or s[j] in ".-"):
+            j += 1
+        if j >= len(s):
+            raise HelmliteError("printf: trailing format spec in " + repr(fmt))
+        spec, verb = s[i + 1 : j], s[j]
+        if spec and not re.fullmatch(r"-?\d*(\.\d+)?", spec):
+            # a malformed spec must fail the engine's error contract
+            # (HelmliteError), not escape as ValueError from %-formatting
+            raise HelmliteError(f"printf: malformed spec %{spec}{verb} in {fmt!r}")
+        i = j + 1
         if verb == "%":
+            if spec:
+                raise HelmliteError(f"printf: malformed %% spec in {fmt!r}")
             out.append("%")
             continue
         try:
@@ -106,12 +119,19 @@ def _printf(fmt: Any, *args: Any) -> str:
         except StopIteration:
             raise HelmliteError(f"printf: not enough args for {fmt!r}") from None
         if verb in ("s", "v"):
-            out.append(_gostr(arg))
+            out.append(("%" + spec + "s") % _gostr(arg))
         elif verb == "d":
             if isinstance(arg, bool) or not isinstance(arg, int):
                 raise HelmliteError(f"printf: %d wants an integer, got {arg!r}")
-            out.append(str(arg))
+            out.append(("%" + spec + "d") % arg)
+        elif verb == "f":
+            if isinstance(arg, bool) or not isinstance(arg, (int, float)):
+                raise HelmliteError(f"printf: %f wants a number, got {arg!r}")
+            # Go's %f defaults to 6 decimals, same as python's
+            out.append(("%" + spec + "f") % float(arg))
         elif verb == "q":
+            if spec:
+                raise HelmliteError(f"printf: %q takes no spec in {fmt!r}")
             out.append(_quote(arg))
         else:
             raise HelmliteError(f"printf: unsupported verb %{verb} in {fmt!r}")
