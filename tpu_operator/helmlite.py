@@ -104,7 +104,7 @@ def _printf(fmt: Any, *args: Any) -> str:
         if j >= len(s):
             raise HelmliteError("printf: trailing format spec in " + repr(fmt))
         spec, verb = s[i + 1 : j], s[j]
-        if spec and not re.fullmatch(r"-?\d*(\.\d+)?", spec):
+        if spec and not re.fullmatch(r"-?\d*(\.\d*)?", spec):  # Go: "%.f" = precision 0
             # a malformed spec must fail the engine's error contract
             # (HelmliteError), not escape as ValueError from %-formatting
             raise HelmliteError(f"printf: malformed spec %{spec}{verb} in {fmt!r}")

@@ -262,10 +262,8 @@ def _online_update(s, v, acc_ref, m_ref, l_ref, masked: bool, exp_fn=jnp.exp):
         preferred_element_type=jnp.float32,
     )
     acc_ref[:] = acc_ref[:] * correction + pv
-    m_ref[:] = jnp.broadcast_to(new_m, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(
-        l * correction + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-    )
+    m_ref[:, :1] = new_m
+    l_ref[:, :1] = l * correction + jnp.sum(p, axis=-1, keepdims=True)
 
 
 def _finalize_out(o_ref, lse_ref, acc_ref, m_ref, l_ref, m_scale: float = 1.0):
