@@ -108,6 +108,27 @@ def cmd_render(args) -> int:
     return 0
 
 
+def cmd_must_gather(args) -> int:
+    """kubectl-free support bundle (reference: hack/must-gather.sh shells
+    out to kubectl; this rides the in-repo client — kubeconfig or
+    in-cluster — and is tested against the served fake apiserver)."""
+    import os
+
+    from tpu_operator import consts, mustgather
+    from tpu_operator.kube.http_client import HttpClient
+
+    if os.environ.get("KUBERNETES_SERVICE_HOST") and not args.kubeconfig:
+        client = HttpClient.in_cluster()
+    else:
+        client = HttpClient.from_kubeconfig(args.kubeconfig or None)
+    ns = args.namespace or os.environ.get(
+        consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE
+    )
+    written = mustgather.collect(client, ns, args.output)
+    print(f"collected {len(written)} artifacts into {args.output}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -122,6 +143,11 @@ def main(argv=None) -> int:
     r = sub.add_parser("render", help="render the deployment chart from values")
     r.add_argument("--values", required=True)
     r.set_defaults(fn=cmd_render)
+    mg = sub.add_parser("must-gather", help="collect a kubectl-free support bundle")
+    mg.add_argument("--output", default="/tmp/tpu-operator-must-gather")
+    mg.add_argument("--namespace", default="")
+    mg.add_argument("--kubeconfig", default="")
+    mg.set_defaults(fn=cmd_must_gather)
     args = p.parse_args(argv)
     return args.fn(args)
 

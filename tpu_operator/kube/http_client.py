@@ -339,7 +339,8 @@ class HttpClient(Client):
         query: Optional[dict] = None,
         _retry_auth: bool = True,
         _resent: bool = False,
-    ) -> dict:
+        _raw: bool = False,
+    ):
         import http.client
 
         # kubeconfig servers may carry a path prefix (proxied apiservers,
@@ -405,12 +406,15 @@ class HttpClient(Client):
             status = resp.status
             self._checkin_conn(conn, reusable=not resp.will_close)
             if status < 400:
+                if _raw:  # plain-text endpoints (pods/log)
+                    return payload.decode(errors="replace")
                 return json.loads(payload) if payload else {}
             if status == 401 and _retry_auth and self.token_path:
                 # expired bound token: re-read once and retry the request
                 self._bearer(force_refresh=True)
                 return self._request(
-                    method, path, body, query, _retry_auth=False, _resent=resent
+                    method, path, body, query,
+                    _retry_auth=False, _resent=resent, _raw=_raw,
                 )
             detail = payload.decode(errors="replace")[:500]
             if status == 404:
@@ -526,6 +530,26 @@ class HttpClient(Client):
             else None
         )
         self._request("DELETE", self._path(api_version, kind, namespace, name), query=query)
+
+    def pod_logs(self, name, namespace, container=None, tail_lines=None) -> str:
+        """GET pods/<name>/log (plain text, not JSON) — the support-bundle
+        collector's kubectl-logs analog. Rides ``_request``'s raw mode so
+        the pooled-connection retry and 401 token refresh apply here too."""
+        query = {}
+        if container:
+            query["container"] = container
+        if tail_lines is not None:
+            query["tailLines"] = str(tail_lines)
+        return self._request(
+            "GET",
+            self._path("v1", "Pod", namespace, name) + "/log",
+            query=query or None,
+            _raw=True,
+        )
+
+    def server_version(self) -> dict:
+        """GET /version (kubectl version's server half)."""
+        return self._request("GET", "/version")
 
     def evict(self, name, namespace):
         """POST pods/eviction (the drain path the reference's upgrade lib

@@ -305,6 +305,11 @@ class FakeApiServer:
     def _handle(self, handler, method: str) -> None:
         raw_path, _, raw_query = handler.path.partition("?")
         query = urllib.parse.parse_qs(raw_query)
+        if method == "GET" and raw_path == "/version":
+            return handler._send(
+                200,
+                {"major": "1", "minor": "29", "gitVersion": "v1.29.0-fake"},
+            )
         api_version, kind, namespace, name, sub = self._parse(raw_path)
 
         if self.authorizer is not None:
@@ -403,6 +408,21 @@ class FakeApiServer:
                     "items": items,
                 },
             )
+        if method == "GET" and sub == "log" and kind == "Pod":
+            # kubelet-proxied pod logs, plain text. The fake has no
+            # containers: serve the tpu.google.com/fake-logs annotation
+            # (tests seed it) or empty — a missing pod still 404s.
+            pod = self.client.get(api_version, kind, name, namespace)
+            text = (pod["metadata"].get("annotations") or {}).get(
+                "tpu.google.com/fake-logs", ""
+            )
+            body = text.encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/plain")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return
         if method == "GET":
             return handler._send(200, self.client.get(api_version, kind, name, namespace))
         if method == "POST" and sub == "eviction":

@@ -1,0 +1,148 @@
+"""kubectl-free support-bundle collector.
+
+Reference: ``hack/must-gather.sh`` shells out to kubectl for every
+artifact, which ties support bundles to a workstation with kubectl
+configured. This collector rides the in-repo ``HttpClient`` instead
+(kubeconfig or in-cluster), so `tpuop-cfg must-gather` works anywhere
+the operator itself can run — and, unlike a bash script, it is testable
+end to end against the served fake apiserver.
+
+Artifact layout mirrors the script's: nodes.yaml, node-labels.txt,
+clusterpolicies.yaml, tpuslices.yaml, daemonsets.yaml, pods.yaml,
+services.yaml, configmaps.yaml, events.txt, pod-logs/<pod>.log.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Tuple
+
+import yaml
+
+from tpu_operator.api.clusterpolicy import CLUSTER_POLICY_API_VERSION
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger(__name__)
+
+# (file stem, api_version, kind, namespaced)
+_COLLECTIONS: List[Tuple[str, str, str, bool]] = [
+    ("nodes", "v1", "Node", False),
+    ("clusterpolicies", CLUSTER_POLICY_API_VERSION, "ClusterPolicy", False),
+    ("tpuslices", TPU_SLICE_API_VERSION, "TPUSlice", False),
+    ("daemonsets", "apps/v1", "DaemonSet", True),
+    ("pods", "v1", "Pod", True),
+    ("services", "v1", "Service", True),
+    ("configmaps", "v1", "ConfigMap", True),
+]
+
+
+def _write(path: str, text: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -> List[str]:
+    """Collect the support bundle into ``outdir``; returns the relative
+    paths written. Every artifact is best-effort — a failing collection
+    records the error in the file instead of aborting the bundle (a
+    half-broken cluster is exactly when bundles matter)."""
+    written: List[str] = []
+
+    def emit(rel: str, text: str) -> None:
+        _write(os.path.join(outdir, rel), text)
+        written.append(rel)
+
+    version_fn = getattr(client, "server_version", None)
+    if version_fn is not None:
+        try:
+            emit("version.txt", yaml.safe_dump(version_fn(), sort_keys=False))
+        except errors.ApiError as e:
+            emit("version.txt", f"# collection failed: {e}\n")
+
+    all_lines: List[str] = []
+    for stem, api_version, kind, namespaced in _COLLECTIONS:
+        try:
+            items = client.list(api_version, kind, namespace if namespaced else None)
+            emit(
+                f"{stem}.yaml",
+                yaml.safe_dump_all(items, sort_keys=False) if items else "# none\n",
+            )
+            if namespaced:
+                for o in items:  # the `get all -o wide` analog
+                    status = o.get("status") or {}
+                    brief = status.get("phase") or (
+                        f"{status.get('numberAvailable', '?')}/"
+                        f"{status.get('desiredNumberScheduled', '?')}"
+                        if kind == "DaemonSet"
+                        else ""
+                    )
+                    all_lines.append(f"{kind}  {o['metadata']['name']}  {brief}".rstrip())
+        except errors.ApiError as e:
+            emit(f"{stem}.yaml", f"# collection failed: {e}\n")
+            all_lines.append(f"{kind}  # collection failed: {e}")
+    emit("all.txt", "\n".join(all_lines) + "\n" if all_lines else "# none\n")
+
+    try:
+        lines = []
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            rendered = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            lines.append(f"{node['metadata']['name']}  {rendered}")
+        emit("node-labels.txt", "\n".join(lines) + "\n" if lines else "# none\n")
+    except errors.ApiError as e:
+        emit("node-labels.txt", f"# collection failed: {e}\n")
+
+    try:
+        # cluster-wide: events for cluster-scoped objects (the CRs) land
+        # in "default" per apiserver rules, not the operator namespace
+        events = client.list("v1", "Event")
+        events.sort(key=lambda e: e.get("lastTimestamp") or "")
+        lines = [
+            f"{e.get('lastTimestamp', '?')}  {e.get('type', '?')}  "
+            f"{e.get('reason', '?')}  "
+            f"{(e.get('involvedObject') or {}).get('kind', '?')}/"
+            f"{(e.get('involvedObject') or {}).get('name', '?')}  "
+            f"{e.get('message', '')}"
+            for e in events
+        ]
+        emit("events.txt", "\n".join(lines) + "\n" if lines else "# none\n")
+    except errors.ApiError as e:
+        emit("events.txt", f"# collection failed: {e}\n")
+
+    pod_logs = getattr(client, "pod_logs", None)
+    if pod_logs is not None:
+        try:
+            pods = client.list("v1", "Pod", namespace)
+        except errors.ApiError:
+            pods = []
+        for pod in pods:
+            name = pod["metadata"]["name"]
+            spec = pod.get("spec") or {}
+            containers = [
+                c.get("name", "")
+                for c in (spec.get("initContainers") or []) + (spec.get("containers") or [])
+            ]
+
+            def fetch(container=None) -> str:
+                try:
+                    return pod_logs(
+                        name, namespace, container=container, tail_lines=log_tail
+                    )
+                except errors.ApiError as e:
+                    return f"# logs unavailable: {e}\n"
+
+            if len(containers) > 1:
+                # a real apiserver 400s a log request on a multi-container
+                # pod without ?container= — gather each (kubectl's
+                # --all-containers) into one artifact
+                text = "\n".join(
+                    f"==== container {c} ====\n{fetch(c)}" for c in containers
+                )
+            else:
+                text = fetch()
+            emit(os.path.join("pod-logs", f"{name}.log"), text)
+    return written
