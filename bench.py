@@ -118,7 +118,7 @@ def bench_install_to_ready(
             cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
             if cp.get("status", {}).get("state") == "ready":
                 dses = store.list("apps/v1", "DaemonSet", ns)
-                if len(dses) == 8 and all(
+                if len(dses) == 9 and all(
                     ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
                 ):
                     elapsed = time.perf_counter() - t0

@@ -11,6 +11,7 @@ echo "collecting into $ARTIFACT_DIR (namespace $NS)"
 $K version > "$ARTIFACT_DIR/version.txt" 2>&1
 $K get nodes -o yaml > "$ARTIFACT_DIR/nodes.yaml" 2>&1
 $K get nodes --show-labels > "$ARTIFACT_DIR/node-labels.txt" 2>&1
+$K get nodes -o custom-columns='NODE:.metadata.name,HEALTH:.metadata.labels.tpu\.google\.com/tpu\.health,REPAIR:.metadata.labels.tpu\.google\.com/tpu\.repair-state,RETRIES:.metadata.annotations.tpu\.google\.com/tpu\.repair-retries,SLICE:.metadata.labels.tpu\.google\.com/slice\.health' > "$ARTIFACT_DIR/node-health.txt" 2>&1
 $K get clusterpolicies.tpu.google.com -o yaml > "$ARTIFACT_DIR/clusterpolicies.yaml" 2>&1
 $K get tpuslices.tpu.google.com -o yaml > "$ARTIFACT_DIR/tpuslices.yaml" 2>&1
 $K -n "$NS" get all -o wide > "$ARTIFACT_DIR/all.txt" 2>&1

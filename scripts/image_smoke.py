@@ -338,6 +338,47 @@ def smoke_entrypoints(wrappers: dict, harness: Harness) -> None:
         kubelet.stop()
     print("ok: tpu-device-plugin registered", consts.TPU_RESOURCE_NAME, "with stub kubelet")
 
+    # tpu-health-monitor: probes the sandboxed host surfaces and publishes
+    # the node health label + per-chip annotation over the TLS apiserver.
+    # The sandbox is made healthy deterministically: 4 fake /dev/accel*
+    # nodes matching the node's 4 allocatable chips, the libtpu ready
+    # marker from the installer check above, and a stub plugin socket.
+    health_scan = os.path.join(harness.tmp, "health-scanroot")
+    os.makedirs(os.path.join(health_scan, "dev"))
+    for i in range(4):
+        open(os.path.join(health_scan, "dev", f"accel{i}"), "w").close()
+    # own socket-dir sandbox: the real plugin check above may have left a
+    # socket inode in harness.kubelet_dir that open() cannot truncate
+    health_kubelet = os.path.join(harness.tmp, "health-kubelet")
+    os.makedirs(health_kubelet)
+    open(os.path.join(health_kubelet, "tpu-device-plugin.sock"), "w").close()
+    health_dir = os.path.join(harness.tmp, "health")
+    proc = spawn(
+        check("tpu-health-monitor"),
+        [],
+        harness.env(
+            TPUINFO_SCAN_ROOT=health_scan,
+            KUBELET_SOCKET_DIR=health_kubelet,
+            HEALTH_DIR=health_dir,
+            HEALTH_CHECK_INTERVAL="1",
+            TPU_HEALTH_ACTIVE_PROBES="off",
+        ),
+    )
+    wait_for(
+        "tpu-health-monitor verdict",
+        lambda: (harness.store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}).get(
+            consts.TPU_HEALTH_LABEL
+        )
+        == consts.HEALTH_HEALTHY,
+        proc,
+    )
+    finish(proc)
+    with open(os.path.join(health_dir, consts.HEALTH_VERDICTS_FILE)) as f:
+        verdicts = json.load(f)
+    if verdicts.get("verdict") != consts.HEALTH_HEALTHY or len(verdicts.get("chips", {})) != 4:
+        raise SystemExit(f"FAIL tpu-health-monitor: bad verdicts file {verdicts}")
+    print("ok: tpu-health-monitor published node health over TLS + verdicts file")
+
     # tpu-metrics-exporter: serves prometheus metrics
     port = free_port()
     proc = spawn(check("tpu-metrics-exporter"), ["--port", str(port)], harness.env())

@@ -294,3 +294,185 @@ def assert_drill_passed(obs: dict) -> None:
     assert obs["parked_passes"] >= 2, f"PDB never parked the node: {obs}"
     assert obs["pdb_relaxed"] and obs["workload_evicted"], obs
     assert obs["driver_generation_current"], obs
+
+
+# ---------------------------------------------------------------------------
+# Health-remediation drill: inject unhealth -> cordon/evict (PDB-honoring)
+# -> libtpu reinstall -> revalidate -> uncordon; and separately, exhaust
+# the retry budget -> quarantined. Same synthetic-node pattern as the
+# upgrade drill (the drill plays the health agent and the kubelet/DS
+# controller; the repair FSM under test plays the operator).
+# ---------------------------------------------------------------------------
+
+
+class HealthRepairDrill(UpgradeDrill):
+    """Reuses the upgrade drill's fixture (tainted Node + driver DS/pod +
+    PDB-protected TPU workload); drives the repair FSM instead."""
+
+    def _set_health(self, verdict: str) -> None:
+        """Play the health agent: publish the node verdict label."""
+        node = self.client.get("v1", "Node", self.node_name)
+        node["metadata"].setdefault("labels", {})[consts.TPU_HEALTH_LABEL] = verdict
+        self.client.update(node)
+
+    def _repair_state(self) -> str:
+        node = self.client.get("v1", "Node", self.node_name)
+        return (node["metadata"].get("labels") or {}).get(consts.REPAIR_STATE_LABEL, "")
+
+    def run_repair(self, max_passes: int = 60, pass_interval: float = 0.2) -> dict:
+        """Full heal loop: degraded -> cordon -> PDB-parked eviction ->
+        relax -> driver reinstall -> agent re-probe heals -> uncordon."""
+        from tpu_operator.api.clusterpolicy import HealthMonitorSpec
+        from tpu_operator.controllers.health_controller import NodeRepairManager, RepairState
+
+        mgr = NodeRepairManager(self.client, self.ns)
+        spec = HealthMonitorSpec.from_dict(
+            {"remediation": {"enable": True, "retryLimit": 3, "timeoutSeconds": 300,
+              "gracePeriodSeconds": 0}}
+        )
+        self._set_health(consts.HEALTH_DEGRADED)
+        obs = {
+            "cordoned": False,
+            "parked_passes": 0,
+            "pdb_relaxed": False,
+            "driver_pod_recreated": False,
+            "states_seen": [],
+        }
+        for _ in range(max_passes):
+            mgr.apply_state(spec)
+            node = self.client.get("v1", "Node", self.node_name)
+            if node.get("spec", {}).get("unschedulable"):
+                obs["cordoned"] = True
+            state = self._repair_state()
+            if state and (not obs["states_seen"] or obs["states_seen"][-1] != state):
+                obs["states_seen"].append(state)
+            if state == RepairState.EVICTION_REQUIRED and not obs["pdb_relaxed"]:
+                # the eviction must be blocked while the PDB stands
+                obs["parked_passes"] += 1
+                assert (
+                    self.client.get_or_none("v1", "Pod", self.workload_pod, self.ns)
+                    is not None
+                ), "PDB-protected workload was removed while eviction should be blocked"
+                if obs["parked_passes"] >= 2:
+                    pdb = self.client.get(
+                        "policy/v1", "PodDisruptionBudget", self.pdb_name, self.ns
+                    )
+                    pdb["spec"]["minAvailable"] = 0
+                    self.client.update(pdb)
+                    obs["pdb_relaxed"] = True
+            # kubelet/DS-controller duties for the synthetic node
+            _finalize_terminating(self.client, self.ns, self.node_name)
+            if (
+                obs["pdb_relaxed"]
+                and self.client.get_or_none("v1", "Pod", self.driver_pod, self.ns) is None
+            ):
+                self._create_driver_pod()
+                obs["driver_pod_recreated"] = True
+            if state == RepairState.REVALIDATE_REQUIRED and obs["driver_pod_recreated"]:
+                # the reinstall landed: the agent's next probe passes
+                self._set_health(consts.HEALTH_HEALTHY)
+            if not state and obs["cordoned"]:
+                break  # repair complete (label cleared)
+            time.sleep(pass_interval)
+        node = self.client.get("v1", "Node", self.node_name)
+        labels = node["metadata"].get("labels") or {}
+        obs["final_repair_state"] = labels.get(consts.REPAIR_STATE_LABEL, "")
+        obs["final_health"] = labels.get(consts.TPU_HEALTH_LABEL, "")
+        obs["uncordoned"] = not node.get("spec", {}).get("unschedulable")
+        obs["retries"] = (node["metadata"].get("annotations") or {}).get(
+            consts.REPAIR_RETRIES_ANNOTATION
+        )
+        obs["workload_evicted"] = (
+            self.client.get_or_none("v1", "Pod", self.workload_pod, self.ns) is None
+        )
+        return obs
+
+    def run_quarantine(self, retry_limit: int = 1, max_passes: int = 40,
+                       pass_interval: float = 0.2) -> dict:
+        """Budget-exhaustion loop: the node never heals (the drill
+        withholds the agent's healthy verdict), every attempt times out
+        at revalidation, and the retry budget lands quarantined."""
+        from tpu_operator.api.clusterpolicy import HealthMonitorSpec
+        from tpu_operator.controllers.health_controller import NodeRepairManager, RepairState
+
+        mgr = NodeRepairManager(self.client, self.ns)
+        # PDB out of the way: this scenario exercises the budget, not
+        # eviction parking
+        pdb = self.client.get("policy/v1", "PodDisruptionBudget", self.pdb_name, self.ns)
+        pdb["spec"]["minAvailable"] = 0
+        self.client.update(pdb)
+        spec = HealthMonitorSpec.from_dict(
+            {"remediation": {"enable": True, "retryLimit": retry_limit, "timeoutSeconds": 1,
+              "gracePeriodSeconds": 0}}
+        )
+        self._set_health(consts.HEALTH_DEGRADED)
+        obs = {"attempts_observed": 0, "states_seen": []}
+        prev_state = ""
+        for _ in range(max_passes):
+            mgr.apply_state(spec)
+            _finalize_terminating(self.client, self.ns, self.node_name)
+            if self.client.get_or_none("v1", "Pod", self.driver_pod, self.ns) is None:
+                self._create_driver_pod()
+            state = self._repair_state()
+            if state and state != prev_state:
+                obs["states_seen"].append(state)
+                if state == RepairState.CORDON_REQUIRED:
+                    obs["attempts_observed"] += 1
+            prev_state = state
+            if state == RepairState.QUARANTINED:
+                break
+            time.sleep(pass_interval)
+        node = self.client.get("v1", "Node", self.node_name)
+        obs["final_repair_state"] = self._repair_state()
+        obs["still_cordoned"] = bool(node.get("spec", {}).get("unschedulable"))
+        obs["retries"] = (node["metadata"].get("annotations") or {}).get(
+            consts.REPAIR_RETRIES_ANNOTATION
+        )
+        return obs
+
+
+def run_health_drill(client, ns: str, **run_kwargs) -> dict:
+    drill = HealthRepairDrill(client, ns)
+    try:
+        drill.setup()
+        return drill.run_repair(**run_kwargs)
+    finally:
+        drill.teardown()
+
+
+def run_quarantine_drill(client, ns: str, **run_kwargs) -> dict:
+    drill = HealthRepairDrill(client, ns)
+    try:
+        drill.setup()
+        return drill.run_quarantine(**run_kwargs)
+    finally:
+        drill.teardown()
+
+
+def assert_health_drill_passed(obs: dict) -> None:
+    from tpu_operator.controllers.health_controller import RepairState
+
+    assert obs["final_repair_state"] == "", obs
+    assert obs["final_health"] == consts.HEALTH_HEALTHY, obs
+    assert obs["cordoned"] and obs["uncordoned"], obs
+    assert obs["parked_passes"] >= 2, f"PDB never parked the node: {obs}"
+    assert obs["pdb_relaxed"] and obs["workload_evicted"], obs
+    assert obs["driver_pod_recreated"], obs
+    assert obs["retries"] == "1", obs
+    walked = obs["states_seen"]
+    for expected in (
+        RepairState.EVICTION_REQUIRED,
+        RepairState.REINSTALL_REQUIRED,
+        RepairState.REVALIDATE_REQUIRED,
+        RepairState.UNCORDON_REQUIRED,
+    ):
+        assert expected in walked, (expected, walked)
+
+
+def assert_quarantine_drill_passed(obs: dict, retry_limit: int = 1) -> None:
+    from tpu_operator.controllers.health_controller import RepairState
+
+    assert obs["final_repair_state"] == RepairState.QUARANTINED, obs
+    assert obs["still_cordoned"], obs
+    assert obs["attempts_observed"] == retry_limit, obs
+    assert obs["retries"] == str(retry_limit), obs

@@ -66,7 +66,8 @@ class TestReconcileDirect:
         assert labels[consts.TPU_PRESENT_LABEL] == "true"
         assert labels[consts.TPU_WORKLOAD_CONFIG_LABEL] == "container"
         for op in ("libtpu", "device-plugin", "tfd", "slice-manager",
-                   "metrics-exporter", "node-status-exporter", "operator-validation"):
+                   "metrics-exporter", "node-status-exporter", "operator-validation",
+                   "health-monitor"):
             assert labels[consts.COMMON_DEPLOY_LABEL_PREFIX + op] == "true", op
         cpu_labels = client.get("v1", "Node", "cpu-0")["metadata"].get("labels", {})
         assert consts.TPU_PRESENT_LABEL not in cpu_labels
@@ -143,7 +144,7 @@ class TestEndToEnd:
                 if get_cp(client).get("status", {}).get("state") != "ready":
                     return False
                 dses = client.list("apps/v1", "DaemonSet", NS)
-                return len(dses) == 8 and all(
+                return len(dses) == 9 and all(
                     ds.get("status", {}).get("desiredNumberScheduled") == 4
                     and ds["status"].get("numberAvailable") == 4
                     for ds in dses
@@ -152,7 +153,7 @@ class TestEndToEnd:
             assert wait_for(settled, timeout=15), get_cp(client).get("status")
             # sim created operand pods on every node
             pods = client.list("v1", "Pod", NS)
-            assert len(pods) == 32  # 8 DaemonSets x 4 nodes
+            assert len(pods) == 36  # 9 DaemonSets x 4 nodes
         finally:
             mgr.stop()
             sim.stop()
@@ -178,7 +179,7 @@ class TestEndToEnd:
                 == "true",
                 timeout=10,
             )
-            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 8, timeout=10)
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 9, timeout=10)
         finally:
             mgr.stop()
             sim.stop()

@@ -1,0 +1,616 @@
+"""Node health monitoring & auto-remediation subsystem tests.
+
+Three layers under test (ISSUE 1 tentpole):
+  1. the health agent's probes + verdict publication (label, annotation,
+     TPUHealthy condition, Events, verdicts file),
+  2. the device plugin consuming verdicts: unhealthy chips flip to
+     Unhealthy in ListAndWatch over the real gRPC socket,
+  3. the remediation controller's bounded repair FSM — driven end to end
+     over the wire (fault-injection drill on the served fake apiserver):
+     cordon → PDB-honoring eviction → libtpu reinstall → revalidate →
+     uncordon, and retry-budget exhaustion → quarantined — with Events
+     and both new operator metrics observable.
+"""
+
+import json
+import os
+import time
+
+import grpc
+import prometheus_client
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
+from tpu_operator.agents.device_plugin_agent import TPUDevicePlugin
+from tpu_operator.agents.health_monitor_agent import HealthMonitorAgent
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    HealthMonitorSpec,
+    new_cluster_policy,
+)
+from tpu_operator.controllers.health_controller import (
+    HealthReconciler,
+    NodeRepairManager,
+    RepairState,
+)
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.http_client import HttpClient
+from tpu_operator.kube.httpserver import FakeApiServer
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_tpu_node
+
+NS = "tpu-operator"
+
+
+def make_agent(client, tmp_path, monkeypatch, chips=4, node="tpu-0", **kw):
+    """An agent whose probe surfaces are all sandboxed under tmp_path and
+    initially HEALTHY: chips device nodes, the libtpu ready marker, the
+    plugin socket file. Tests degrade individual surfaces from there."""
+    scan = tmp_path / "scan"
+    (scan / "dev").mkdir(parents=True, exist_ok=True)
+    for i in range(chips):
+        (scan / "dev" / f"accel{i}").touch()
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(scan))
+    install = tmp_path / "install"
+    install.mkdir(exist_ok=True)
+    (install / consts.LIBTPU_CTR_READY_FILE).touch()
+    sockets = tmp_path / "sockets"
+    sockets.mkdir(exist_ok=True)
+    (sockets / "tpu-device-plugin.sock").touch()
+    kw.setdefault("active_probes", "off")
+    return HealthMonitorAgent(
+        client,
+        node,
+        install_dir=str(install),
+        socket_dir=str(sockets),
+        health_dir=str(tmp_path / "health"),
+        **kw,
+    )
+
+
+def node_labels(client, name="tpu-0"):
+    return client.get("v1", "Node", name)["metadata"].get("labels") or {}
+
+
+def events_by_reason(client):
+    return {e.get("reason") for e in client.list("v1", "Event")}
+
+
+def metric(name: str):
+    return prometheus_client.REGISTRY.get_sample_value(name)
+
+
+class TestHealthMonitorAgent:
+    def test_healthy_node_publishes_everything(self, tmp_path, monkeypatch):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=4))
+        agent = make_agent(client, tmp_path, monkeypatch)
+        assert agent.apply_once() is True
+        labels = node_labels(client)
+        assert labels[consts.TPU_HEALTH_LABEL] == consts.HEALTH_HEALTHY
+        node = client.get("v1", "Node", "tpu-0")
+        chips = json.loads(
+            node["metadata"]["annotations"][consts.TPU_HEALTH_CHIPS_ANNOTATION]
+        )
+        assert chips == {f"accel{i}": "Healthy" for i in range(4)}
+        (cond,) = [
+            c
+            for c in node["status"]["conditions"]
+            if c["type"] == consts.TPU_HEALTH_CONDITION
+        ]
+        assert cond["status"] == "True"
+        with open(tmp_path / "health" / consts.HEALTH_VERDICTS_FILE) as f:
+            verdicts = json.load(f)
+        assert verdicts["verdict"] == consts.HEALTH_HEALTHY
+        # a first-ever healthy verdict is not a transition: no Event noise
+        assert "TPUHealthRestored" not in events_by_reason(client)
+        # steady state: second pass changes nothing
+        assert agent.apply_once() is False
+
+    def test_yanked_chip_degrades_with_per_chip_verdict(self, tmp_path, monkeypatch):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=4))
+        agent = make_agent(client, tmp_path, monkeypatch)
+        agent.apply_once()
+        os.unlink(tmp_path / "scan" / "dev" / "accel2")  # chip disappears
+        assert agent.apply_once() is True
+        node = client.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == consts.HEALTH_DEGRADED
+        chips = json.loads(
+            node["metadata"]["annotations"][consts.TPU_HEALTH_CHIPS_ANNOTATION]
+        )
+        assert chips["accel2"] == "Unhealthy"
+        assert chips["accel0"] == "Healthy"
+        (cond,) = [
+            c
+            for c in node["status"]["conditions"]
+            if c["type"] == consts.TPU_HEALTH_CONDITION
+        ]
+        assert cond["status"] == "False" and "accel2" in cond["message"]
+        assert "TPUHealthDegraded" in events_by_reason(client)
+        # the shared verdicts file carries the per-chip map for the plugin
+        with open(tmp_path / "health" / consts.HEALTH_VERDICTS_FILE) as f:
+            assert json.load(f)["chips"]["accel2"] == "Unhealthy"
+
+    def test_recovery_restores_health_with_event(self, tmp_path, monkeypatch):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=2))
+        agent = make_agent(client, tmp_path, monkeypatch, chips=2, expected_chips=2)
+        agent.apply_once()
+        os.unlink(tmp_path / "scan" / "dev" / "accel1")
+        agent.apply_once()
+        assert node_labels(client)[consts.TPU_HEALTH_LABEL] == consts.HEALTH_DEGRADED
+        (tmp_path / "scan" / "dev" / "accel1").touch()
+        assert agent.apply_once() is True
+        assert node_labels(client)[consts.TPU_HEALTH_LABEL] == consts.HEALTH_HEALTHY
+        assert "TPUHealthRestored" in events_by_reason(client)
+
+    def test_missing_libtpu_marker_and_socket_degrade(self, tmp_path, monkeypatch):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=2))
+        agent = make_agent(client, tmp_path, monkeypatch, chips=2, expected_chips=2)
+        os.unlink(tmp_path / "install" / consts.LIBTPU_CTR_READY_FILE)
+        os.unlink(tmp_path / "sockets" / "tpu-device-plugin.sock")
+        agent.apply_once()
+        node = client.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == consts.HEALTH_DEGRADED
+        (cond,) = [
+            c
+            for c in node["status"]["conditions"]
+            if c["type"] == consts.TPU_HEALTH_CONDITION
+        ]
+        assert "libtpu" in cond["message"] and "socket" in cond["message"]
+
+    def test_indeterminate_probe_changes_nothing(self, tmp_path, monkeypatch):
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=2))
+        agent = make_agent(client, tmp_path, monkeypatch, chips=2, expected_chips=2)
+        agent.apply_once()
+        before = node_labels(client)[consts.TPU_HEALTH_LABEL]
+
+        def boom():
+            raise RuntimeError("probe machinery down")
+
+        monkeypatch.setattr("tpu_operator.native.tpuinfo.probe", boom)
+        assert agent.apply_once() is False
+        assert node_labels(client)[consts.TPU_HEALTH_LABEL] == before
+
+    def test_timeslicing_replicas_do_not_inflate_expected_chips(self, tmp_path, monkeypatch):
+        """Expected chips come from the TFD label / accelerator catalog,
+        never the google.com/tpu allocatable: device-plugin time-slicing
+        (replicas=N) inflates allocatable, and counting it would brand a
+        healthy shared node degraded and auto-repair it."""
+        client = FakeClient()
+        node = make_tpu_node("tpu-0", chips=4)
+        node["status"]["allocatable"]["google.com/tpu"] = "8"  # replicas: 2
+        client.create(node)
+        agent = make_agent(client, tmp_path, monkeypatch, chips=4)
+        agent.apply_once()
+        assert node_labels(client)[consts.TPU_HEALTH_LABEL] == consts.HEALTH_HEALTHY
+
+    def test_expected_chips_from_allocatable(self, tmp_path, monkeypatch):
+        """A node advertising 4 chips whose probe only sees 2 is degraded
+        even though both present chips look fine."""
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0", chips=4))
+        agent = make_agent(client, tmp_path, monkeypatch, chips=2)
+        agent.apply_once()
+        chips = json.loads(
+            client.get("v1", "Node", "tpu-0")["metadata"]["annotations"][
+                consts.TPU_HEALTH_CHIPS_ANNOTATION
+            ]
+        )
+        assert chips == {
+            "accel0": "Healthy",
+            "accel1": "Healthy",
+            "accel2": "Unhealthy",
+            "accel3": "Unhealthy",
+        }
+
+
+class TestDevicePluginHealthIntegration:
+    """Layer 2: the plugin's health loop consumes the agent's verdicts
+    and its own re-probe, flipping devices in ListAndWatch."""
+
+    def dial_stream(self, plugin):
+        channel = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+        law = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        return channel, law(pb.Empty())
+
+    def test_yanked_device_reported_unhealthy_not_dropped(self, tmp_path):
+        """Satellite bugfix: a device that vanishes must be re-reported
+        as Unhealthy (kubelet keeps it in capacity, stops allocating),
+        not silently left Healthy — and only CHANGES are published."""
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path),
+            devices=["/dev/accel0", "/dev/accel1"],
+            health_dir=str(tmp_path / "nohealth"),
+        )
+        plugin._last_health = plugin.current_health()
+        assert plugin.health_tick() is False  # steady state: no publish
+        plugin._devices_override = ["/dev/accel0"]  # accel1 yanked
+        assert plugin.health_tick() is True
+        assert plugin._last_health == {"accel0": "Healthy", "accel1": "Unhealthy"}
+        assert plugin.health_tick() is False  # change published exactly once
+        plugin._devices_override = ["/dev/accel0", "/dev/accel1"]  # restored
+        assert plugin.health_tick() is True
+        assert plugin._last_health == {"accel0": "Healthy", "accel1": "Healthy"}
+
+    def test_unhealthy_chip_flips_in_listandwatch_over_the_wire(self, tmp_path):
+        """Acceptance: agent marks a chip unhealthy (verdicts file) → the
+        plugin's next health tick re-publishes → the kubelet-side stream
+        sees the device flip to Unhealthy, then recover."""
+        health_dir = tmp_path / "health"
+        health_dir.mkdir()
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path),
+            devices=["/dev/accel0", "/dev/accel1"],
+            health_dir=str(health_dir),
+        )
+        try:
+            plugin._last_health = plugin.current_health()
+            plugin.serve()
+            channel, stream = self.dial_stream(plugin)
+            first = next(stream)
+            assert [(d.ID, d.health) for d in first.devices] == [
+                ("accel0", "Healthy"),
+                ("accel1", "Healthy"),
+            ]
+            # the health agent's verdict lands in the shared file
+            with open(health_dir / consts.HEALTH_VERDICTS_FILE, "w") as f:
+                json.dump({"verdict": "degraded",
+                           "chips": {"accel0": "Healthy", "accel1": "Unhealthy"}}, f)
+            assert plugin.health_tick() is True
+            update = next(stream)
+            assert [(d.ID, d.health) for d in update.devices] == [
+                ("accel0", "Healthy"),
+                ("accel1", "Unhealthy"),
+            ]
+            # heal: verdicts go back to healthy
+            with open(health_dir / consts.HEALTH_VERDICTS_FILE, "w") as f:
+                json.dump({"verdict": "healthy",
+                           "chips": {"accel0": "Healthy", "accel1": "Healthy"}}, f)
+            assert plugin.health_tick() is True
+            healed = next(stream)
+            assert all(d.health == "Healthy" for d in healed.devices)
+            channel.close()
+        finally:
+            plugin.stop()
+
+    def test_torn_or_missing_verdicts_file_is_ignored(self, tmp_path):
+        health_dir = tmp_path / "health"
+        health_dir.mkdir()
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path), devices=["/dev/accel0"], health_dir=str(health_dir)
+        )
+        assert plugin.current_health() == {"accel0": "Healthy"}
+        (health_dir / consts.HEALTH_VERDICTS_FILE).write_text("{not json")
+        assert plugin.current_health() == {"accel0": "Healthy"}
+
+    def test_stale_verdicts_file_is_ignored(self, tmp_path):
+        """A dead/disabled health agent must not pin chips Unhealthy
+        forever: verdicts older than the TTL are dropped and the plugin's
+        own device probe stands."""
+        health_dir = tmp_path / "health"
+        health_dir.mkdir()
+        path = health_dir / consts.HEALTH_VERDICTS_FILE
+        path.write_text(json.dumps({"chips": {"accel0": "Unhealthy"}}))
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path), devices=["/dev/accel0"], health_dir=str(health_dir)
+        )
+        assert plugin.current_health() == {"accel0": "Unhealthy"}  # fresh: honored
+        old = time.time() - 2 * plugin.VERDICTS_TTL_SECONDS
+        os.utime(path, (old, old))  # the agent stopped rewriting it
+        assert plugin.current_health() == {"accel0": "Healthy"}
+
+    def test_replicated_devices_inherit_chip_health(self, tmp_path):
+        plugin = TPUDevicePlugin(
+            socket_dir=str(tmp_path),
+            devices=["/dev/accel0"],
+            config={"replicas": 2},
+            health_dir=str(tmp_path / "nohealth"),
+        )
+        plugin.current_health()
+        plugin._devices_override = []
+        resp = plugin._device_list(plugin.current_health())
+        assert [(d.ID, d.health) for d in resp.devices] == [
+            ("accel0-rep0", "Unhealthy"),
+            ("accel0-rep1", "Unhealthy"),
+        ]
+
+
+class TestRemediationFSM:
+    """Layer 3 unit coverage on the fake client (the over-the-wire drill
+    lives in TestHealthEndToEnd)."""
+
+    def seed(self, client, health=consts.HEALTH_DEGRADED, name="tpu-0", pool=None):
+        node = make_tpu_node(name, nodepool=pool or "tpu-pool")
+        node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        if health:
+            node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = health
+        client.create(node)
+        return node
+
+    def spec(self, **remediation):
+        remediation.setdefault("enable", True)
+        remediation.setdefault("gracePeriodSeconds", 0)
+        return HealthMonitorSpec.from_dict({"remediation": remediation})
+
+    def test_degraded_node_enters_repair_and_cordons(self):
+        client = FakeClient()
+        self.seed(client)
+        mgr = NodeRepairManager(client, NS)
+        mgr.apply_state(self.spec())
+        assert node_labels(client)[consts.REPAIR_STATE_LABEL] == RepairState.CORDON_REQUIRED
+        mgr.apply_state(self.spec())
+        node = client.get("v1", "Node", "tpu-0")
+        assert node["spec"]["unschedulable"] is True
+        assert node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] == RepairState.EVICTION_REQUIRED
+        assert node["metadata"]["annotations"][consts.REPAIR_RETRIES_ANNOTATION] == "1"
+
+    def test_grace_period_spares_provisioning_nodes(self):
+        """A freshly degraded node (e.g. joining: libtpu still installing,
+        plugin not registered) is left alone until the degradation
+        outlives the grace period — no mid-install cordon, no budget
+        burn. An old degradation repairs immediately."""
+        client = FakeClient()
+        self.seed(client)
+        mgr = NodeRepairManager(client, NS)
+        spec = self.spec(gracePeriodSeconds=3600)
+        mgr.apply_state(spec)
+        node = client.get("v1", "Node", "tpu-0")
+        # no repair started; the controller stamped health.since and waits
+        assert consts.REPAIR_STATE_LABEL not in node["metadata"]["labels"]
+        assert not node["spec"].get("unschedulable")
+        assert consts.TPU_HEALTH_SINCE_ANNOTATION in node["metadata"]["annotations"]
+        assert consts.REPAIR_RETRIES_ANNOTATION not in node["metadata"]["annotations"]
+        mgr.apply_state(spec)  # still inside grace
+        assert consts.REPAIR_STATE_LABEL not in node_labels(client)
+        # age the degradation past the grace window: repair begins
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["annotations"][consts.TPU_HEALTH_SINCE_ANNOTATION] = str(
+            int(time.time()) - 7200
+        )
+        client.update(node)
+        mgr.apply_state(spec)
+        assert node_labels(client)[consts.REPAIR_STATE_LABEL] == RepairState.CORDON_REQUIRED
+
+    def test_healthy_node_untouched(self):
+        client = FakeClient()
+        self.seed(client, health=consts.HEALTH_HEALTHY)
+        NodeRepairManager(client, NS).apply_state(self.spec())
+        assert consts.REPAIR_STATE_LABEL not in node_labels(client)
+
+    def test_revalidate_timeout_reenters_without_orphaning_cordon(self):
+        """A revalidation timeout must keep the node under FSM ownership
+        (straight back to cordon-required): dropping to no-state while
+        cordoned would orphan the cordon if the heal lands in the gap."""
+        client = FakeClient()
+        self.seed(client)
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = RepairState.REVALIDATE_REQUIRED
+        node["metadata"].setdefault("annotations", {})[
+            consts.REPAIR_STATE_SINCE_ANNOTATION
+        ] = str(int(time.time()) - 100)
+        node["metadata"]["annotations"][consts.REPAIR_RETRIES_ANNOTATION] = "1"
+        node["spec"]["unschedulable"] = True
+        client.update(node)
+        # a Running driver pod (the libtpu DaemonSet's) so the reinstall
+        # step of the re-entered attempt can advance
+        from tpu_operator.upgrade.fsm import (
+            DRIVER_POD_COMPONENT,
+            DRIVER_POD_COMPONENT_LABEL,
+        )
+
+        client.create(new_object(
+            "v1", "Pod", "libtpu-tpu-0", NS,
+            labels={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        ))
+        mgr = NodeRepairManager(client, NS)
+        mgr.apply_state(self.spec(retryLimit=3, timeoutSeconds=1))
+        labels = node_labels(client)
+        assert labels[consts.REPAIR_STATE_LABEL] == RepairState.CORDON_REQUIRED
+        # budget burned atomically with the state write
+        node = client.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["annotations"][consts.REPAIR_RETRIES_ANNOTATION] == "2"
+        # now the heal lands: the FSM walks the node out and uncordons it
+        # (the test plays the DS controller, recreating the driver pod
+        # the reinstall entry-action deletes)
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_HEALTHY
+        client.update(node)
+        for _ in range(6):
+            mgr.apply_state(self.spec(retryLimit=3, timeoutSeconds=1))
+            if client.get_or_none("v1", "Pod", "libtpu-tpu-0", NS) is None:
+                client.create(new_object(
+                    "v1", "Pod", "libtpu-tpu-0", NS,
+                    labels={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+                    spec={"nodeName": "tpu-0", "containers": []},
+                    status={"phase": "Running"},
+                ))
+        node = client.get("v1", "Node", "tpu-0")
+        assert consts.REPAIR_STATE_LABEL not in node["metadata"]["labels"]
+        assert not node["spec"].get("unschedulable")
+
+    def test_retry_budget_exhaustion_quarantines(self):
+        client = FakeClient()
+        node = self.seed(client)
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"].setdefault("annotations", {})[
+            consts.REPAIR_RETRIES_ANNOTATION
+        ] = "3"
+        client.update(node)
+        NodeRepairManager(client, NS).apply_state(self.spec(retryLimit=3))
+        node = client.get("v1", "Node", "tpu-0")
+        assert node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] == RepairState.QUARANTINED
+        assert node["spec"]["unschedulable"] is True
+        # quarantine is terminal: further passes leave it parked
+        NodeRepairManager(client, NS).apply_state(self.spec(retryLimit=3))
+        assert node_labels(client)[consts.REPAIR_STATE_LABEL] == RepairState.QUARANTINED
+
+    def test_slice_gang_marked_degraded_and_cleared(self):
+        """One sick host poisons its whole multi-host gang (fail fast for
+        gang-scheduled workloads); healing clears every member."""
+        client = FakeClient()
+        self.seed(client, name="v5e-0", pool="pool-a")
+        self.seed(client, health=consts.HEALTH_HEALTHY, name="v5e-1", pool="pool-a")
+        self.seed(client, health=consts.HEALTH_HEALTHY, name="other-0", pool="pool-b")
+        self.seed(client, health=consts.HEALTH_HEALTHY, name="other-1", pool="pool-b")
+        mgr = NodeRepairManager(client, NS)
+        mgr.apply_state(self.spec())
+        assert (
+            node_labels(client, "v5e-1")[consts.TPU_SLICE_HEALTH_LABEL]
+            == consts.HEALTH_DEGRADED
+        )
+        assert consts.TPU_SLICE_HEALTH_LABEL not in node_labels(client, "other-0")
+        # heal the sick host: the gang label clears everywhere
+        node = client.get("v1", "Node", "v5e-0")
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_HEALTHY
+        del node["metadata"]["labels"][consts.REPAIR_STATE_LABEL]
+        client.update(node)
+        mgr.apply_state(self.spec())
+        for name in ("v5e-0", "v5e-1"):
+            assert consts.TPU_SLICE_HEALTH_LABEL not in node_labels(client, name)
+
+    def test_remediation_disabled_strips_and_uncordons(self):
+        client = FakeClient()
+        self.seed(client)
+        client.create(new_cluster_policy(spec={
+            "healthMonitor": {"remediation": {"enable": True, "gracePeriodSeconds": 0}}}))
+        r = HealthReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        r.reconcile(Request(name="cluster-policy"))
+        assert client.get("v1", "Node", "tpu-0")["spec"]["unschedulable"] is True
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        cp["spec"]["healthMonitor"] = {"remediation": {"enable": False}}
+        client.update(cp)
+        r.reconcile(Request(name="cluster-policy"))
+        node = client.get("v1", "Node", "tpu-0")
+        assert consts.REPAIR_STATE_LABEL not in node["metadata"]["labels"]
+        assert not node["spec"].get("unschedulable")
+        # "re-enabling starts clean": the retry budget is wiped too
+        assert consts.REPAIR_RETRIES_ANNOTATION not in (
+            node["metadata"].get("annotations") or {}
+        )
+
+    def test_quarantined_node_keeps_cordon_when_disabled(self):
+        client = FakeClient()
+        node = self.seed(client)
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = RepairState.QUARANTINED
+        node["spec"]["unschedulable"] = True
+        client.update(node)
+        NodeRepairManager(client, NS).remove_repair_labels()
+        node = client.get("v1", "Node", "tpu-0")
+        assert consts.REPAIR_STATE_LABEL not in node["metadata"]["labels"]
+        assert node["spec"]["unschedulable"] is True  # human opted it out
+
+
+class TestHealthReconciler:
+    def test_publishes_status_and_metrics(self):
+        client = FakeClient()
+        node = make_tpu_node("tpu-0")
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_DEGRADED
+        client.create(node)
+        client.create(new_cluster_policy(spec={"healthMonitor": {
+            "interval": 7, "remediation": {"gracePeriodSeconds": 0}}}))
+        r = HealthReconciler(client, NS)
+        result = r.reconcile(Request(name="cluster-policy"))
+        assert result.requeue_after == 7.0
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        assert cp["status"]["health"]["remediating"] == 1
+        assert cp["status"]["health"]["nodes"]["tpu-0"] == RepairState.CORDON_REQUIRED
+        assert metric("tpu_operator_unhealthy_nodes") == 1
+        assert metric("tpu_operator_remediations_total") >= 1
+
+    def test_monitoring_only_mode_keeps_observability(self):
+        """remediation.enable=false with monitoring on: no repair runs,
+        but the gauge, status.health, and the slice fail-fast labels all
+        stay live — disabling auto-repair must not blind the operator."""
+        client = FakeClient()
+        for i in range(2):
+            node = make_tpu_node(f"v5e-{i}", nodepool="pool-a")
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = (
+                consts.HEALTH_DEGRADED if i == 0 else consts.HEALTH_HEALTHY
+            )
+            client.create(node)
+        client.create(new_cluster_policy(spec={
+            "healthMonitor": {"remediation": {"enable": False}}}))
+        r = HealthReconciler(client, NS)
+        result = r.reconcile(Request(name="cluster-policy"))
+        assert result.requeue_after > 0
+        node = client.get("v1", "Node", "v5e-0")
+        assert consts.REPAIR_STATE_LABEL not in node["metadata"]["labels"]
+        assert not node["spec"].get("unschedulable")  # no repair ran
+        assert metric("tpu_operator_unhealthy_nodes") == 1
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        assert cp["status"]["health"]["degraded"] == 1
+        # the gang fail-fast label still flows to the sick host's peer
+        assert (
+            client.get("v1", "Node", "v5e-1")["metadata"]["labels"][
+                consts.TPU_SLICE_HEALTH_LABEL
+            ]
+            == consts.HEALTH_DEGRADED
+        )
+
+    def test_healthy_cluster_clears_status_block(self):
+        client = FakeClient()
+        node = make_tpu_node("tpu-0")
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_HEALTHY
+        client.create(node)
+        client.create(new_cluster_policy())
+        r = HealthReconciler(client, NS)
+        r.reconcile(Request(name="cluster-policy"))
+        cp = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        assert "health" not in cp.get("status", {})
+        assert metric("tpu_operator_unhealthy_nodes") == 0
+
+
+class TestHealthEndToEnd:
+    """The acceptance fault-injection drill, over the wire (HTTP-served
+    fake apiserver with real eviction/PDB semantics)."""
+
+    def run_over_wire(self, fn, **kwargs):
+        from drill import run_health_drill, run_quarantine_drill  # noqa: F401
+
+        store = FakeClient()
+        server = FakeApiServer(store).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            return fn(client, NS, **kwargs), store
+        finally:
+            server.stop()
+
+    def test_full_remediation_loop(self):
+        from drill import assert_health_drill_passed, run_health_drill
+
+        before = metric("tpu_operator_remediations_total") or 0
+        obs, store = self.run_over_wire(run_health_drill)
+        assert_health_drill_passed(obs)
+        # Events at each step: repair transitions + the final remediated
+        reasons = {e.get("reason") for e in store.list("v1", "Event")}
+        assert "TPUNodeRepair" in reasons and "TPUNodeRemediated" in reasons
+        # the remediation counter observed the attempt
+        assert metric("tpu_operator_remediations_total") == before + 1
+
+    def test_retry_budget_exhaustion_lands_quarantined(self):
+        from drill import assert_quarantine_drill_passed, run_quarantine_drill
+
+        obs, store = self.run_over_wire(run_quarantine_drill, retry_limit=1)
+        assert_quarantine_drill_passed(obs, retry_limit=1)
+        quarantine_events = [
+            e
+            for e in store.list("v1", "Event")
+            if e.get("reason") == "TPUNodeRepair"
+            and RepairState.QUARANTINED in e.get("message", "")
+        ]
+        assert quarantine_events and quarantine_events[0]["type"] == "Warning"

@@ -127,6 +127,22 @@ class TestHelmParity:
         assert cp["spec"]["libtpu"] == {"enabled": True}
         assert cp["spec"]["multiSlice"] == {"enabled": True}
 
+    def test_health_monitor_knobs_flow_through_both_paths(self):
+        """The nested healthMonitor knobs: a partial override of one knob
+        keeps the chart defaults on the rest (deep merge) and renders
+        identically through helm and tpuop-cfg render."""
+        partial = {"clusterPolicy": {"healthMonitor": {"interval": 60}}}
+        assert_parity(partial)
+        cp = [o for o in render_chart(partial) if o["kind"] == "ClusterPolicy"][0]
+        hm = cp["spec"]["healthMonitor"]
+        assert hm["interval"] == 60
+        assert hm["remediation"] == {"enable": True, "retryLimit": 3, "timeoutSeconds": 300,
+                                     "gracePeriodSeconds": 300}
+        # full disable flows too
+        off = {"clusterPolicy": {"healthMonitor": {"enabled": False,
+                                                   "remediation": {"enable": False}}}}
+        assert_parity(off)
+
 
 class TestChartContents:
     def test_crds_dir_matches_api(self):
@@ -231,6 +247,24 @@ class TestHelmliteEngine:
         for template in ("{{ and (eq .x 1 }}", "{{ and eq .x 1) }}"):
             with pytest.raises(helmlite.HelmliteError, match="parenthes"):
                 helmlite.render_string(template, {"Values": {}})
+
+    def test_default_and_coalesce(self):
+        """sprig default/coalesce (TODO gap 4): the guards the chart uses
+        for nested health knobs a partial values file may omit."""
+        ctx = {"Values": {"clusterPolicy": {"healthMonitor": {"interval": 60}}}}
+        cases = [
+            # coalesce: first non-empty argument wins
+            ("{{ coalesce .Values.clusterPolicy.healthMonitor.interval 30 }}", "60"),
+            ("{{ coalesce .Values.clusterPolicy.healthMonitor.retryLimit 3 }}", "3"),
+            ("{{ coalesce .Values.nope .Values.alsoNope }}", ""),  # all empty -> nil
+            ('{{ coalesce "" 0 "x" "y" }}', "x"),
+            # default: piped form, empty/zero falls back
+            ('{{ .Values.clusterPolicy.healthMonitor.interval | default 30 }}', "60"),
+            ('{{ .Values.clusterPolicy.healthMonitor.missing | default 30 }}', "30"),
+            ('{{ toYaml (default (dict) .Values.noSpec) }}', "{}"),
+        ]
+        for template, want in cases:
+            assert helmlite.render_string(template, ctx) == want, template
 
     def test_dict_merge_haskey(self):
         ctx = {"Values": {"m": {"a": 1}, "extra": {"b": 2, "nested": {"x": 1}}}}

@@ -75,9 +75,12 @@ def test_bundle_from_live_install(tmp_path):
         cps = list(yaml.safe_load_all((tmp_path / "clusterpolicies.yaml").read_text()))
         assert cps[0]["status"]["state"] == "ready"
         dses = list(yaml.safe_load_all((tmp_path / "daemonsets.yaml").read_text()))
-        assert len(dses) == 8
+        assert len(dses) == 9
         labels_txt = (tmp_path / "node-labels.txt").read_text()
         assert "tpu.google.com/tpu.present=true" in labels_txt
+        # the health subsystem's per-node view rides in the bundle
+        health_txt = (tmp_path / "node-health.txt").read_text()
+        assert "tpu-0" in health_txt and "health=" in health_txt and "repair=" in health_txt
         events_txt = (tmp_path / "events.txt").read_text()
         assert "ClusterPolicy" in events_txt  # CR transition events landed
         pod_name = pod["metadata"]["name"]
@@ -91,7 +94,8 @@ def test_bundle_from_live_install(tmp_path):
         stems = {w.split("/")[0] for w in written}
         assert {
             "version.txt", "all.txt",
-            "nodes.yaml", "node-labels.txt", "clusterpolicies.yaml", "tpuslices.yaml",
+            "nodes.yaml", "node-labels.txt", "node-health.txt",
+            "clusterpolicies.yaml", "tpuslices.yaml",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs",
         } <= stems

@@ -209,6 +209,68 @@ class TestAgentsUnderEnforcement:
         finally:
             server.stop()
 
+    def _run_health_agent(self, client, tmp_path, monkeypatch):
+        """The agent's full publish surface: node get/update, nodes/status
+        update (TPUHealthy condition), events create — a DEGRADED pass so
+        the event path definitely fires."""
+        from tpu_operator.agents.health_monitor_agent import HealthMonitorAgent
+
+        (tmp_path / "dev").mkdir(exist_ok=True)
+        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+        agent = HealthMonitorAgent(
+            client,
+            "tpu-0",
+            install_dir=str(tmp_path),
+            socket_dir=str(tmp_path),
+            health_dir=str(tmp_path / "health"),
+            active_probes="off",
+        )
+        return agent.apply_once()
+
+    def test_health_monitor_agent(self, tmp_path, monkeypatch):
+        store, server, client, auth = self._enforced("state-health-monitor")
+        try:
+            store.create(make_tpu_node("tpu-0", chips=4))
+            assert self._run_health_agent(client, tmp_path, monkeypatch)
+            node = store.get("v1", "Node", "tpu-0")
+            from tpu_operator import consts
+
+            assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == "degraded"
+            assert any(
+                c["type"] == consts.TPU_HEALTH_CONDITION
+                for c in node["status"]["conditions"]
+            )
+            assert not auth.denials, auth.denials
+        finally:
+            server.stop()
+
+    def test_health_monitor_grants_actually_needed(self, tmp_path, monkeypatch):
+        """Negative control for the new ClusterRole: strip nodes/status
+        and the condition write must 403 — proving the grant is load-
+        bearing, not cargo cult."""
+        from tpu_operator.kube import errors
+
+        rules = [
+            r
+            for r in state_rules("state-health-monitor")
+            if "nodes/status" not in (r.get("resources") or [])
+        ]
+        store = FakeClient()
+        authorizer = RbacAuthorizer(rules)
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            store.create(make_tpu_node("tpu-0", chips=4))
+            try:
+                self._run_health_agent(client, tmp_path, monkeypatch)
+            except errors.ApiError:
+                pass  # a surfaced 403 is equally acceptable
+            assert any(res == "nodes/status" for _, _, res in authorizer.denials), (
+                authorizer.denials
+            )
+        finally:
+            server.stop()
+
 
 class TestOperatorUnderEnforcement:
     def _run_install(self, rules):
@@ -280,6 +342,26 @@ class TestOperatorUnderEnforcement:
             assert_drill_passed(obs)
             assert not authorizer.denials, (
                 f"ClusterRole gaps in the upgrade path: {sorted(set(authorizer.denials))}"
+            )
+        finally:
+            server.stop()
+
+    def test_health_drill_runs_under_enforcement(self):
+        """The repair FSM (cordon → PDB-parked eviction → driver-pod
+        delete → revalidate → uncordon) under the shipped operator rules:
+        all operator-side traffic must be covered (harness-side kubelet/
+        admin ops get their own slice, as in the upgrade drill)."""
+        from drill import assert_health_drill_passed, run_health_drill
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(shipped_rules() + self.HARNESS_RULES)
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            obs = run_health_drill(client, NS)
+            assert_health_drill_passed(obs)
+            assert not authorizer.denials, (
+                f"ClusterRole gaps in the remediation path: {sorted(set(authorizer.denials))}"
             )
         finally:
             server.stop()

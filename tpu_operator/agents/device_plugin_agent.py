@@ -19,13 +19,15 @@ grpc_tools codegen needed.
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import os
 import queue
 import re
 import threading
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
 import grpc
 
@@ -69,6 +71,7 @@ class TPUDevicePlugin:
         devices: Optional[List[str]] = None,  # override for tests
         health_check_interval: float = 30.0,
         config: Optional[dict] = None,  # selected named config
+        health_dir: Optional[str] = None,  # health agent's verdicts dir
     ):
         # supported config keys (the time-slicing analog): ``replicas``
         # advertises each physical chip N times so N pods can share it
@@ -77,15 +80,22 @@ class TPUDevicePlugin:
         self.socket_path = os.path.join(socket_dir, PLUGIN_SOCKET_NAME)
         self.resource_name = resource_name
         self.install_dir = install_dir
+        self.health_dir = health_dir if health_dir is not None else os.environ.get(
+            "HEALTH_DIR", consts.HEALTH_DIR
+        )
         self._devices_override = devices
         self.health_check_interval = health_check_interval
         self._server: Optional[grpc.Server] = None
         # per-stream subscriber queues: a re-dialled ListAndWatch must not
         # have its updates stolen by a zombie predecessor stream
-        self._subscribers: List["queue.Queue[List[str]]"] = []
+        self._subscribers: List["queue.Queue"] = []
         self._sub_lock = threading.Lock()
         self._stop = threading.Event()
-        self._last_devices: List[str] = []
+        # every device ever advertised: a yanked chip must be re-reported
+        # as Unhealthy (kubelet keeps it in capacity, stops allocating),
+        # not silently dropped from the inventory
+        self._known_devices: set = set()
+        self._last_health: Dict[str, str] = {}
         self._coords_cache: Optional[dict] = None
 
     # -- inventory -----------------------------------------------------------
@@ -97,16 +107,65 @@ class TPUDevicePlugin:
 
         return tpuinfo.probe().get("devices", [])
 
-    def _device_list(self, paths: List[str]) -> pb.ListAndWatchResponse:
+    # verdicts older than this are ignored: the agent rewrites the file
+    # every probe tick, so a stale mtime means it is dead or disabled —
+    # its last word must not pin chips Unhealthy forever
+    VERDICTS_TTL_SECONDS = 600.0
+
+    def read_external_verdicts(self) -> Dict[str, str]:
+        """Per-chip verdicts published by the health monitor agent
+        (hostPath JSON, written atomically). Missing/torn/stale file
+        degrades to no verdicts — the plugin's own device probe still
+        stands."""
+        path = os.path.join(self.health_dir, consts.HEALTH_VERDICTS_FILE)
+        try:
+            ttl = float(os.environ.get("HEALTH_VERDICTS_TTL", "") or self.VERDICTS_TTL_SECONDS)
+        except ValueError:
+            ttl = self.VERDICTS_TTL_SECONDS
+        try:
+            if ttl > 0 and time.time() - os.stat(path).st_mtime > ttl:
+                return {}
+            with open(path) as f:
+                data = json.load(f)
+            chips = data.get("chips") if isinstance(data, dict) else None
+            if not isinstance(chips, dict):
+                return {}  # any non-conforming shape degrades, never raises
+            return {str(k): str(v) for k, v in chips.items()}
+        except (OSError, ValueError):
+            return {}
+
+    def current_health(self) -> Dict[str, str]:
+        """The authoritative per-chip health map: a probe of /dev/accel*
+        (present → Healthy, previously-seen-but-gone → Unhealthy) merged
+        with the health agent's verdicts (its Unhealthy overrides ours —
+        it sees degradation a bare device-node check cannot, e.g. a
+        failing matmul)."""
+        present = {os.path.basename(p) for p in self.discover()}
+        self._known_devices |= present
+        health = {
+            dev: "Healthy" if dev in present else "Unhealthy"
+            for dev in sorted(self._known_devices)
+        }
+        for dev, verdict in self.read_external_verdicts().items():
+            if dev in health and verdict != "Healthy":
+                health[dev] = "Unhealthy"
+        return health
+
+    def _device_list(self, inventory) -> pb.ListAndWatchResponse:
+        """Build the ListAndWatch response from a health map ({device:
+        verdict}); a plain path list is accepted for compatibility and
+        reads as all-Healthy."""
+        if not isinstance(inventory, dict):
+            inventory = {os.path.basename(p): "Healthy" for p in inventory}
         replicas = int(self.config.get("replicas", 1) or 1)
         devices = []
-        for p in paths:
-            base = os.path.basename(p)
+        for base in sorted(inventory, key=self._chip_index):
+            health = inventory[base]
             if replicas <= 1:
-                devices.append(pb.Device(ID=base, health="Healthy"))
+                devices.append(pb.Device(ID=base, health=health))
             else:
                 devices.extend(
-                    pb.Device(ID=f"{base}-rep{r}", health="Healthy") for r in range(replicas)
+                    pb.Device(ID=f"{base}-rep{r}", health=health) for r in range(replicas)
                 )
         return pb.ListAndWatchResponse(devices=devices)
 
@@ -117,13 +176,13 @@ class TPUDevicePlugin:
 
     def ListAndWatch(self, request, context):
         """Stream the inventory; re-send whenever it changes."""
-        my_queue: "queue.Queue[List[str]]" = queue.Queue()
+        my_queue: "queue.Queue" = queue.Queue()
         with self._sub_lock:
             self._subscribers.append(my_queue)
         try:
-            # note: _last_devices is owned by health_loop — writing it here
+            # note: _last_health is owned by health_loop — writing it here
             # would suppress the publish other subscribers rely on
-            yield self._device_list(self.discover())
+            yield self._device_list(self.current_health())
             while not self._stop.is_set():
                 try:
                     current = my_queue.get(timeout=0.2)
@@ -316,17 +375,26 @@ class TPUDevicePlugin:
         channel.close()
         log.info("registered %s with kubelet (%d device(s))", self.resource_name, len(self.discover()))
 
+    def health_tick(self) -> bool:
+        """One health pass: re-probe /dev/accel*, merge the health
+        agent's verdicts, and publish a ListAndWatch update ONLY on
+        change (a yanked device transitions to Unhealthy, a restored one
+        back to Healthy). Returns True when an update was published."""
+        current = self.current_health()
+        if current == self._last_health:
+            return False
+        self._last_health = current
+        self._publish(current)
+        return True
+
     def health_loop(self, kubelet_socket: Optional[str] = None) -> None:
-        """Re-publish the inventory when it changes (chip hotplug, driver
-        restart), and re-serve + re-register when the kubelet restarts —
-        a kubelet restart wipes /var/lib/kubelet/device-plugins/ including
-        our socket, and the v1beta1 contract requires plugins to register
-        again."""
+        """Re-probe and re-publish the per-device health each tick (chip
+        hotplug, driver restart, health-agent verdicts), and re-serve +
+        re-register when the kubelet restarts — a kubelet restart wipes
+        /var/lib/kubelet/device-plugins/ including our socket, and the
+        v1beta1 contract requires plugins to register again."""
         while not self._stop.is_set():
-            current = self.discover()
-            if current != self._last_devices:
-                self._last_devices = current
-                self._publish(current)
+            self.health_tick()
             if not os.path.exists(self.socket_path):
                 log.warning("plugin socket vanished (kubelet restart?); re-registering")
                 try:
@@ -338,13 +406,13 @@ class TPUDevicePlugin:
                     log.warning("re-registration failed: %s", e)
             self._stop.wait(self.health_check_interval)
 
-    def _publish(self, devices: List[str]) -> None:
+    def _publish(self, inventory) -> None:
         with self._sub_lock:
             for sub in self._subscribers:
-                sub.put(devices)
+                sub.put(inventory)
 
     def run_forever(self, kubelet_socket: Optional[str] = None) -> None:
-        self._last_devices = self.discover()
+        self._last_health = self.current_health()
         self.serve()
         self.register(kubelet_socket)
         self.health_loop(kubelet_socket)

@@ -1,0 +1,322 @@
+"""tpu-health-monitor agent: continuous per-node TPU health probing.
+
+The NVIDIA reference pairs provisioning with continuous DCGM health
+checks; TPUs expose no passive health counters, so this agent probes the
+observable surfaces directly every tick:
+
+    chips    /dev/accel* presence vs the chip count the node advertises
+             (a yanked chip or dead driver shows up as a missing device
+             node) — per-chip verdicts
+    libtpu   the installer's ready marker on the host install path
+             (consts.LIBTPU_CTR_READY_FILE; a wiped node image or broken
+             install loses it)
+    plugin   device-plugin socket liveness under the kubelet's
+             device-plugins dir (a crashed plugin leaves TPUs
+             unschedulable silently)
+    matmul   optional cheap matmul sanity burst (reusing the metrics
+             exporter's active-probe gating: ``auto`` skips quietly when
+             a tenant owns the chip, ``on`` counts failures, ``off``
+             never runs it)
+
+Verdicts are published three ways, each feeding a different consumer:
+
+    1. an atomically-written JSON file in ``consts.HEALTH_DIR`` (hostPath
+       shared with the device plugin, which flips devices Unhealthy in
+       ListAndWatch so the kubelet stops allocating them),
+    2. the ``tpu.google.com/tpu.health`` node label + per-chip verdict
+       annotation (consumed by the remediation controller),
+    3. a ``TPUHealthy`` node status condition + Kubernetes Events on
+       transitions (kubectl-describe visibility).
+
+A probe that *fails to run* is indeterminate and changes nothing — only
+a successful probe that *observes* degradation flips the verdict (same
+contract as the node-discovery agent's probe).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+
+log = logging.getLogger(__name__)
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+class HealthMonitorAgent:
+    def __init__(
+        self,
+        client: Optional[Client],
+        node_name: str,
+        install_dir: str = consts.LIBTPU_INSTALL_DIR,
+        socket_dir: str = "/var/lib/kubelet/device-plugins",
+        health_dir: str = consts.HEALTH_DIR,
+        interval: float = 30.0,
+        active_probes: str = "auto",
+        expected_chips: Optional[int] = None,
+        recorder=None,
+    ):
+        if active_probes not in ("auto", "on", "off"):
+            raise ValueError(f"active_probes must be auto/on/off, got {active_probes!r}")
+        self.client = client
+        self.node_name = node_name
+        self.install_dir = install_dir
+        self.socket_dir = socket_dir
+        self.health_dir = health_dir
+        self.interval = interval
+        self.active_probes = active_probes
+        self._expected_chips = expected_chips
+        if recorder is None and client is not None:
+            from tpu_operator.kube.events import EventRecorder
+
+            recorder = EventRecorder(client, "", component="tpu-health-monitor")
+        self.recorder = recorder
+        self._last_verdict: Optional[str] = None
+
+    # -- probes ---------------------------------------------------------------
+
+    def expected_chips(self, node: Optional[dict] = None) -> Optional[int]:
+        """How many chips this node should have: the TFD chips-per-node
+        label, else the accelerator catalog (both count PHYSICAL chips).
+        Deliberately NOT the google.com/tpu allocatable — device-plugin
+        time-slicing replicas inflate it, which would brand every shared
+        chip's phantom replicas Unhealthy and auto-repair a healthy node.
+        Recomputed each pass (a late-arriving TFD label must win); None
+        when the node is unreadable/unrecognized (presence-only then)."""
+        if self._expected_chips is not None:
+            return self._expected_chips
+        if node is None:
+            if self.client is None:
+                return None
+            node = self.client.get_or_none("v1", "Node", self.node_name)
+            if node is None:
+                return None
+        raw = (node["metadata"].get("labels") or {}).get(consts.TFD_CHIPS_PER_NODE_LABEL)
+        try:
+            if raw is not None:
+                return int(raw)
+        except ValueError:
+            pass
+        from tpu_operator.nodeinfo import tpu_info
+
+        info = tpu_info(node)
+        return info.chips_per_node if info is not None else None
+
+    def probe_chips(self, node: Optional[dict] = None) -> Optional[Dict[str, str]]:
+        """Per-chip verdicts from the device inventory: present devices
+        are Healthy, expected-but-absent indices are Unhealthy. None when
+        the probe machinery itself failed (indeterminate)."""
+        try:
+            from tpu_operator.native import tpuinfo
+
+            devices = tpuinfo.probe().get("devices", [])
+        except Exception:  # noqa: BLE001 — probe failure is indeterminate
+            return None
+        verdicts = {os.path.basename(d): HEALTHY for d in devices}
+        expected = self.expected_chips(node)
+        if expected:
+            for i in range(expected):
+                verdicts.setdefault(f"accel{i}", UNHEALTHY)
+        return verdicts
+
+    def probe_libtpu(self) -> bool:
+        """The installer ready-marker the validator's libtpu component
+        also gates on — losing it means workloads would load a stale or
+        missing libtpu.so."""
+        return os.path.exists(os.path.join(self.install_dir, consts.LIBTPU_CTR_READY_FILE))
+
+    def probe_plugin_socket(self) -> bool:
+        from tpu_operator.agents.device_plugin_agent import PLUGIN_SOCKET_NAME
+
+        return os.path.exists(os.path.join(self.socket_dir, PLUGIN_SOCKET_NAME))
+
+    def probe_matmul(self) -> Optional[bool]:
+        """Cheap matmul sanity burst: does the runtime still deliver
+        compute on this node's chips? Returns None (indeterminate) when
+        the probe is off, or fails in ``auto`` mode — an unacquirable
+        chip usually means a tenant owns it (the single-client runtime
+        rejects a second client), which is not unhealth."""
+        if self.active_probes == "off":
+            return None
+        try:
+            from tpu_operator.workloads.matmul_bench import matmul_tflops
+
+            report = matmul_tflops(size=256, iters=2)
+            return report["tflops"] > 0
+        except Exception as e:  # noqa: BLE001
+            if self.active_probes == "auto":
+                log.info("health: matmul probe skipped (chip busy or unavailable): %s", e)
+                return None
+            log.warning("health: matmul probe failed: %s", e)
+            return False
+
+    def probe(self, node: Optional[dict] = None) -> Optional[dict]:
+        """One full probe pass -> report, or None when the chip inventory
+        itself was indeterminate (change nothing this tick)."""
+        chips = self.probe_chips(node)
+        if chips is None:
+            return None
+        reasons: List[str] = []
+        missing = sorted(c for c, v in chips.items() if v != HEALTHY)
+        if missing:
+            reasons.append(f"missing devices: {','.join(missing)}")
+        if not chips:
+            reasons.append("no TPU devices visible")
+        if not self.probe_libtpu():
+            reasons.append("libtpu install marker missing")
+        if not self.probe_plugin_socket():
+            reasons.append("device-plugin socket absent")
+        matmul = self.probe_matmul()
+        if matmul is False:
+            reasons.append("matmul sanity probe failed")
+        verdict = consts.HEALTH_DEGRADED if reasons else consts.HEALTH_HEALTHY
+        return {"verdict": verdict, "chips": chips, "reasons": reasons}
+
+    # -- publication ----------------------------------------------------------
+
+    def write_verdicts_file(self, report: dict) -> None:
+        """Atomic write so the device plugin never reads a torn file."""
+        os.makedirs(self.health_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.health_dir, prefix=".verdicts-")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"verdict": report["verdict"], "chips": report["chips"],
+                       "reasons": report["reasons"]}, f)
+        os.replace(tmp, os.path.join(self.health_dir, consts.HEALTH_VERDICTS_FILE))
+
+    def _set_condition(self, node: dict, report: dict) -> None:
+        """TPUHealthy node condition via the status subresource (the node
+        problem-detector convention; a failed write is best-effort — the
+        label is the load-bearing signal). Operates on the node object
+        the caller already holds — no extra GET per tick."""
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        healthy = report["verdict"] == consts.HEALTH_HEALTHY
+        cond = {
+            "type": consts.TPU_HEALTH_CONDITION,
+            "status": "True" if healthy else "False",
+            "reason": "ProbesPassed" if healthy else "ProbeFailed",
+            "message": "; ".join(report["reasons"]) or "all health probes passed",
+            "lastTransitionTime": now,
+        }
+        conds = node.setdefault("status", {}).setdefault("conditions", [])
+        existing = next((c for c in conds if c.get("type") == cond["type"]), None)
+        if existing is not None:
+            if existing.get("status") == cond["status"] and existing.get("message") == cond["message"]:
+                return
+            cond["lastTransitionTime"] = (
+                existing.get("lastTransitionTime", now)
+                if existing.get("status") == cond["status"]
+                else now
+            )
+            conds[conds.index(existing)] = cond
+        else:
+            conds.append(cond)
+        try:
+            self.client.update_status(node)
+        except errors.ApiError as e:
+            log.debug("health: condition publish skipped: %s", e)
+
+    def apply_once(self) -> bool:
+        """One probe + publish pass; returns True when anything changed.
+        The node is fetched ONCE and threaded through the probe (expected
+        chips), the label/annotation write, and the condition write."""
+        node = (
+            self.client.get_or_none("v1", "Node", self.node_name)
+            if self.client is not None
+            else None
+        )
+        report = self.probe(node)
+        if report is None:
+            return False  # indeterminate: keep current state
+        self.write_verdicts_file(report)
+        if self.client is None or node is None:
+            return False
+        labels = node["metadata"].setdefault("labels", {})
+        annotations = node["metadata"].setdefault("annotations", {})
+        chips_json = json.dumps(report["chips"], sort_keys=True)
+        previous = labels.get(consts.TPU_HEALTH_LABEL)
+        changed = (
+            previous != report["verdict"]
+            or annotations.get(consts.TPU_HEALTH_CHIPS_ANNOTATION) != chips_json
+        )
+        # a first-ever healthy verdict is not a transition — only flips
+        # (and a node BORN degraded) warrant an Event
+        transitioned = previous != report["verdict"] and (
+            previous is not None or report["verdict"] == consts.HEALTH_DEGRADED
+        )
+        if changed:
+            labels[consts.TPU_HEALTH_LABEL] = report["verdict"]
+            annotations[consts.TPU_HEALTH_CHIPS_ANNOTATION] = chips_json
+            if previous != report["verdict"]:
+                # the remediation grace period is measured from this stamp
+                annotations[consts.TPU_HEALTH_SINCE_ANNOTATION] = str(int(time.time()))
+            try:
+                # use the server's response (fresh resourceVersion) for
+                # the follow-up condition write
+                node = self.client.update(node) or node
+            except errors.Conflict:
+                return False  # node moved under us; next tick retries
+        self._set_condition(node, report)
+        if transitioned and self.recorder is not None:
+            degraded = report["verdict"] == consts.HEALTH_DEGRADED
+            self.recorder.event(
+                node,
+                "Warning" if degraded else "Normal",
+                "TPUHealthDegraded" if degraded else "TPUHealthRestored",
+                f"node {self.node_name}: {report['verdict']}"
+                + (f" ({'; '.join(report['reasons'])})" if report["reasons"] else ""),
+            )
+        self._last_verdict = report["verdict"]
+        return changed
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.apply_once()
+            except errors.ApiError as e:
+                log.warning("health-monitor: %s", e)
+            time.sleep(self.interval)
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("NODE_NAME required")
+        return 1
+    from tpu_operator.kube.http_client import HttpClient
+
+    try:
+        interval = float(os.environ.get("HEALTH_CHECK_INTERVAL", "30").strip())
+    except ValueError:
+        log.warning(
+            "invalid HEALTH_CHECK_INTERVAL %r; using 30s",
+            os.environ.get("HEALTH_CHECK_INTERVAL"),
+        )
+        interval = 30.0
+    active = os.environ.get("TPU_HEALTH_ACTIVE_PROBES", "auto").strip().lower()
+    if active not in ("auto", "on", "off"):
+        log.warning("invalid TPU_HEALTH_ACTIVE_PROBES %r; using auto", active)
+        active = "auto"
+    HealthMonitorAgent(
+        HttpClient.in_cluster(),
+        node_name,
+        install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
+        socket_dir=os.environ.get("KUBELET_SOCKET_DIR", "/var/lib/kubelet/device-plugins"),
+        health_dir=os.environ.get("HEALTH_DIR", consts.HEALTH_DIR),
+        interval=interval,
+        active_probes=active,
+    ).run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -244,6 +244,44 @@ class ValidatorSpec(ComponentCommon):
 
 
 @dataclasses.dataclass
+class RemediationSpec(SpecBase):
+    """Auto-remediation knobs for degraded TPU nodes. No reference analog
+    (the gpu-operator stops at DCGM health metrics); the model is GKE
+    node auto-repair, bounded by a retry budget so a persistently sick
+    node lands in the ``quarantined`` terminal label instead of cycling
+    forever."""
+
+    enable: bool = field(default=True)
+    retry_limit: int = field(json="retryLimit", default=3)
+    # force falls back to plain DELETE for PDB-blocked evictions
+    # (kubectl drain --disable-eviction semantics)
+    force: bool = field(default=False)
+    # per-repair-state budget; an eviction blocked past it quarantines the
+    # node, a revalidation stuck past it burns one retry and restarts
+    timeout_seconds: int = field(json="timeoutSeconds", default=300)
+    # degradation must persist this long before repair starts: a freshly
+    # joined node legitimately looks degraded while libtpu installs and
+    # the plugin comes up — cordoning it mid-provision would kill the
+    # install and burn retry budget on every node join
+    grace_period_seconds: int = field(json="gracePeriodSeconds", default=300)
+
+
+@dataclasses.dataclass
+class HealthMonitorSpec(ComponentCommon):
+    """The closed-loop health subsystem: a per-node agent (DaemonSet)
+    probing /dev/accel* presence, the libtpu install marker, the device
+    plugin socket, and an optional matmul sanity check; plus the operator
+    remediation controller consuming its verdicts (DCGM health check →
+    node auto-repair analog)."""
+
+    interval: int = field(default=30)  # seconds between agent probe ticks
+    # matmul sanity probe gating, same contract as the metrics exporter's
+    # active probes: auto skips quietly when a tenant owns the chip
+    active_probes: str = field(json="activeProbes", default="auto")
+    remediation: RemediationSpec = sub(RemediationSpec)
+
+
+@dataclasses.dataclass
 class MultiSliceSpec(SpecBase):
     """Multi-slice (DCN-connected slices) support: the validator and the
     slice manager wire JAX distributed-coordinator addresses across slices
@@ -285,6 +323,7 @@ class ClusterPolicySpec(SpecBase):
     metrics_exporter: MetricsExporterSpec = sub(MetricsExporterSpec, json="metricsExporter")
     node_status_exporter: NodeStatusExporterSpec = sub(NodeStatusExporterSpec, json="nodeStatusExporter")
     validator: ValidatorSpec = sub(ValidatorSpec)
+    health_monitor: HealthMonitorSpec = sub(HealthMonitorSpec, json="healthMonitor")
     multi_slice: MultiSliceSpec = sub(MultiSliceSpec, json="multiSlice")
     psa: PSASpec = sub(PSASpec)
 
@@ -300,6 +339,10 @@ class ClusterPolicyStatus(SpecBase):
     # (inProgress/done/failed/pending counts + per-node FSM state); must
     # be declared or a real apiserver's structural pruning drops it
     upgrade: dict = field(default_factory=dict)
+    # node-health / remediation progress published by the health
+    # reconciler (degraded/remediating/quarantined counts + per-node
+    # repair state); declared for the same structural-pruning reason
+    health: dict = field(default_factory=dict)
 
 
 @dataclasses.dataclass

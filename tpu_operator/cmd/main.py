@@ -1,7 +1,7 @@
 """tpu-operator controller-manager entrypoint.
 
 Reference: ``cmd/gpu-operator/main.go:72-196`` — flags, zap-style logging,
-leader election, health probe on :8081, metrics on :8080, the three
+leader election, health probe on :8081, metrics on :8080, the four
 controllers, run until signalled. A ``--fake-cluster`` mode runs against
 the in-memory apiserver + sim (the CPU-only kind-cluster configuration)
 for local development and e2e scripts.
@@ -20,6 +20,10 @@ from tpu_operator import consts
 from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     setup_with_manager as setup_clusterpolicy,
+)
+from tpu_operator.controllers.health_controller import (
+    HealthReconciler,
+    setup_with_manager as setup_health,
 )
 from tpu_operator.controllers.tpuslice_controller import (
     TPUSliceReconciler,
@@ -105,6 +109,7 @@ def main(argv=None) -> int:
     setup_clusterpolicy(mgr, ClusterPolicyReconciler(client, namespace))
     setup_tpuslice(mgr, TPUSliceReconciler(client, namespace))
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
+    setup_health(mgr, HealthReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

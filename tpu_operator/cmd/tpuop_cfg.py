@@ -30,6 +30,7 @@ IMAGE_COMPONENTS = (
     "slice_manager",
     "metrics_exporter",
     "node_status_exporter",
+    "health_monitor",
     "validator",
 )
 

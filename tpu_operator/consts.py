@@ -51,6 +51,38 @@ UPGRADE_STATE_SINCE_ANNOTATION = "tpu.google.com/libtpu-upgrade-state-since"
 UPGRADE_SKIP_DRAIN_POD_LABEL = "tpu.google.com/libtpu-upgrade-drain.skip"
 
 # ---------------------------------------------------------------------------
+# Node health (the DCGM-health → node-auto-repair analog). The health agent
+# owns the health label/annotation/condition; the remediation controller
+# owns the repair labels.
+# ---------------------------------------------------------------------------
+TPU_HEALTH_LABEL = "tpu.google.com/tpu.health"  # healthy | degraded
+HEALTH_HEALTHY = "healthy"
+HEALTH_DEGRADED = "degraded"
+# JSON map of per-chip verdicts ({"accel0": "Healthy", ...}) published by
+# the health agent alongside the summary label
+TPU_HEALTH_CHIPS_ANNOTATION = "tpu.google.com/tpu.health.chips"
+# when the current verdict was first observed (epoch seconds) — the
+# remediation grace period is measured against it, so a node that is
+# merely still PROVISIONING (libtpu installing, plugin not up yet) is
+# not cordoned mid-install
+TPU_HEALTH_SINCE_ANNOTATION = "tpu.google.com/tpu.health.since"
+TPU_HEALTH_CONDITION = "TPUHealthy"  # node status condition type
+# slice gang health: one degraded host marks every peer of its gang so
+# multi-host workloads fail fast instead of hanging on a sick member
+TPU_SLICE_HEALTH_LABEL = "tpu.google.com/slice.health"
+
+# Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
+# terminal: quarantined), persisted on the node like the upgrade FSM's.
+REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"
+REPAIR_STATE_SINCE_ANNOTATION = "tpu.google.com/tpu.repair-state-since"
+REPAIR_RETRIES_ANNOTATION = "tpu.google.com/tpu.repair-retries"
+
+# Host path shared between the health agent (writer) and the device plugin
+# (reader): per-chip verdict file consumed by ListAndWatch.
+HEALTH_DIR = "/run/tpu/health"
+HEALTH_VERDICTS_FILE = "verdicts.json"
+
+# ---------------------------------------------------------------------------
 # Annotations.
 # ---------------------------------------------------------------------------
 LAST_APPLIED_HASH_ANNOTATION = "tpu.google.com/last-applied-hash"
@@ -90,6 +122,7 @@ OPERATOR_NAME = "tpu-operator"
 REQUEUE_NOT_READY_SECONDS = 5.0
 REQUEUE_NO_TPU_NODES_SECONDS = 45.0
 UPGRADE_REPLAN_SECONDS = 120.0
+HEALTH_REPLAN_SECONDS = 30.0
 
 # Container runtimes (reference: getRuntime state_manager.go:714-751).
 RUNTIME_CONTAINERD = "containerd"

@@ -45,6 +45,7 @@ OPERAND_DEPLOY_KEYS = {
     "state-slice-manager": consts.COMMON_DEPLOY_LABEL_PREFIX + "slice-manager",
     "state-metrics-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "metrics-exporter",
     "state-node-status-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "node-status-exporter",
+    "state-health-monitor": consts.COMMON_DEPLOY_LABEL_PREFIX + "health-monitor",
 }
 
 

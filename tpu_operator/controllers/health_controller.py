@@ -1,0 +1,525 @@
+"""Health remediation controller: bounded node auto-repair.
+
+Consumes the health agent's ``tpu.google.com/tpu.health`` verdicts and
+drives a per-node repair FSM — the GKE node-auto-repair analog the NVIDIA
+reference stops short of (DCGM feeds metrics, nothing acts on them):
+
+    (degraded) → cordon-required → eviction-required →
+    reinstall-required → revalidate-required → uncordon-required → (healed)
+                                        └─ retry budget exhausted → quarantined
+
+Like the upgrade FSM (``tpu_operator/upgrade/fsm.py``, whose cordon/
+eviction machinery this reuses by subclassing), every decision is
+recomputed from cluster state each pass: the FSM lives entirely in node
+labels/annotations and survives operator restarts. Evictions go through
+pods/eviction so PodDisruptionBudgets are honored; a blocked eviction
+parks the node until the remediation timeout quarantines it. Each repair
+attempt burns one unit of the retry budget — a node that keeps flapping
+lands in the ``quarantined`` terminal label (cordoned, operator hands
+off to a human) instead of cycling forever.
+
+Slice awareness: a degraded or in-repair host stamps
+``tpu.google.com/slice.health=degraded`` on every peer of its
+slice-manager gang (same accelerator node pool), so multi-host workloads
+fail fast at scheduling instead of hanging on a sick gang member.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+    HealthMonitorSpec,
+)
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.upgrade.fsm import (
+    DRIVER_POD_COMPONENT,
+    DRIVER_POD_COMPONENT_LABEL,
+    ClusterUpgradeStateManager,
+)
+
+log = logging.getLogger(__name__)
+
+
+class RepairState:
+    CORDON_REQUIRED = "cordon-required"
+    EVICTION_REQUIRED = "eviction-required"
+    REINSTALL_REQUIRED = "reinstall-required"
+    REVALIDATE_REQUIRED = "revalidate-required"
+    UNCORDON_REQUIRED = "uncordon-required"
+    QUARANTINED = "quarantined"
+
+
+IN_REPAIR = {
+    RepairState.CORDON_REQUIRED,
+    RepairState.EVICTION_REQUIRED,
+    RepairState.REINSTALL_REQUIRED,
+    RepairState.REVALIDATE_REQUIRED,
+    RepairState.UNCORDON_REQUIRED,
+}
+
+
+def _labels(node: ObjectDict) -> dict:
+    return node["metadata"].get("labels") or {}
+
+
+def _annotations(node: ObjectDict) -> dict:
+    return node["metadata"].get("annotations") or {}
+
+
+class NodeRepairManager(ClusterUpgradeStateManager):
+    """The repair FSM. Subclasses the upgrade state manager for its
+    cordon/eviction/pod machinery (the reference pattern: one drain
+    implementation shared by every node-walking controller); the state
+    space and labels are its own."""
+
+    # -- state bookkeeping ---------------------------------------------------
+
+    def repair_nodes(self) -> List[ObjectDict]:
+        """Nodes the FSM cares about: carrying a health verdict or a
+        repair label (a node whose agent died mid-repair must still
+        finish its walk)."""
+        out = []
+        for node in self.client.list("v1", "Node"):
+            labels = _labels(node)
+            if consts.TPU_HEALTH_LABEL in labels or consts.REPAIR_STATE_LABEL in labels:
+                out.append(node)
+        return sorted(out, key=lambda n: n["metadata"]["name"])
+
+    def _set_repair_state(
+        self, node: ObjectDict, new_state: str, retries: Optional[int] = None
+    ) -> bool:
+        """One atomic node write: state label + transition timestamp (+
+        the retry counter when an attempt begins — bundling it here means
+        a Conflict burns neither the budget nor the state)."""
+        live = self.client.get_or_none("v1", "Node", node["metadata"]["name"])
+        if live is None:
+            return False
+        labels = live["metadata"].setdefault("labels", {})
+        annotations = live["metadata"].setdefault("annotations", {})
+        if retries is not None:
+            annotations[consts.REPAIR_RETRIES_ANNOTATION] = str(retries)
+        if new_state:
+            if labels.get(consts.REPAIR_STATE_LABEL) == new_state and retries is None:
+                return True
+            labels[consts.REPAIR_STATE_LABEL] = new_state
+            # timestamp the transition so per-state timeouts survive
+            # operator restarts (all FSM state lives in the cluster)
+            annotations[consts.REPAIR_STATE_SINCE_ANNOTATION] = str(int(time.time()))
+        else:
+            if consts.REPAIR_STATE_LABEL not in labels:
+                return True
+            del labels[consts.REPAIR_STATE_LABEL]
+            annotations.pop(consts.REPAIR_STATE_SINCE_ANNOTATION, None)
+        try:
+            self.client.update(live)
+        except errors.Conflict:
+            return False  # re-planned next pass
+        node["metadata"] = live["metadata"]
+        log.info("repair: node %s -> %s", node["metadata"]["name"], new_state or "(cleared)")
+        event_type = "Warning" if new_state == RepairState.QUARANTINED else "Normal"
+        self.recorder.event(
+            live, event_type, "TPUNodeRepair",
+            f"node {node['metadata']['name']}: {new_state or 'repair complete'}",
+        )
+        return True
+
+    def _repair_expired(self, node: ObjectDict, timeout_seconds: int) -> bool:
+        if not timeout_seconds:
+            return False
+        since = _annotations(node).get(consts.REPAIR_STATE_SINCE_ANNOTATION)
+        if not since:
+            return False
+        try:
+            return time.time() - float(since) > timeout_seconds
+        except ValueError:
+            return False
+
+    def _retries(self, node: ObjectDict) -> int:
+        try:
+            return int(_annotations(node).get(consts.REPAIR_RETRIES_ANNOTATION, "0"))
+        except ValueError:
+            return 0
+
+    def _in_grace_period(self, node: ObjectDict, remediation) -> bool:
+        """A node is left alone until its degradation has persisted past
+        the grace period: a freshly joined node looks degraded while
+        libtpu installs and the plugin registers, and cordoning it
+        mid-provision would kill the install (and burn retry budget on
+        every node join). The agent stamps health.since on transitions;
+        when the label was set by something that did not (e.g. a manual
+        kubectl label), the controller stamps it itself and waits."""
+        grace = max(0, remediation.grace_period_seconds)
+        if not grace:
+            return False
+        since = _annotations(node).get(consts.TPU_HEALTH_SINCE_ANNOTATION)
+        if since is None:
+            live = self.client.get_or_none("v1", "Node", node["metadata"]["name"])
+            if live is not None:
+                live["metadata"].setdefault("annotations", {})[
+                    consts.TPU_HEALTH_SINCE_ANNOTATION
+                ] = str(int(time.time()))
+                try:
+                    self.client.update(live)
+                    node["metadata"] = live["metadata"]
+                except errors.Conflict:
+                    pass
+            return True
+        try:
+            return time.time() - float(since) < grace
+        except ValueError:
+            return False
+
+    def _begin_or_quarantine(self, node: ObjectDict, remediation) -> str:
+        """Start one repair attempt against the retry budget, or park the
+        node in the quarantined terminal state when the budget is spent.
+        Used both on fresh degradation and when a revalidation times out
+        (re-entering directly keeps the node under FSM ownership — the
+        cordon is never orphaned on a node with no repair state)."""
+        retries = self._retries(node)
+        if retries >= max(0, remediation.retry_limit):
+            self._set_repair_state(node, RepairState.QUARANTINED)
+            self._cordon(node, True)
+            return RepairState.QUARANTINED
+        if self._set_repair_state(node, RepairState.CORDON_REQUIRED, retries=retries + 1):
+            get_metrics().remediations_total.inc()
+        return RepairState.CORDON_REQUIRED
+
+    # -- one idempotent pass -------------------------------------------------
+
+    def apply_state(self, spec: HealthMonitorSpec) -> Dict[str, str]:  # type: ignore[override]
+        """Advance every node by at most one repair step; returns the
+        post-pass {node: repair state} map (health verdicts included for
+        degraded nodes not yet in repair)."""
+        remediation = spec.remediation
+        pods_by_node: Dict[str, List[ObjectDict]] = {}
+        for pod in self.client.list("v1", "Pod"):
+            node_name = pod.get("spec", {}).get("nodeName")
+            if node_name and pod.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
+                pods_by_node.setdefault(node_name, []).append(pod)
+
+        states: Dict[str, str] = {}
+        nodes = self.repair_nodes()
+        for node in nodes:
+            name = node["metadata"]["name"]
+            state = _labels(node).get(consts.REPAIR_STATE_LABEL, "")
+            health = _labels(node).get(consts.TPU_HEALTH_LABEL, "")
+
+            if state == RepairState.QUARANTINED:
+                # terminal: stays cordoned until a human intervenes
+                self._cordon(node, True)
+                states[name] = state
+                continue
+
+            if not state:
+                if health == consts.HEALTH_DEGRADED:
+                    if self._in_grace_period(node, remediation):
+                        states[name] = health  # provisioning/flap grace
+                    else:
+                        states[name] = self._begin_or_quarantine(node, remediation)
+                elif health:
+                    states[name] = health
+                continue
+
+            if state == RepairState.CORDON_REQUIRED:
+                self._cordon(node, True)
+                self._set_repair_state(node, RepairState.EVICTION_REQUIRED)
+                states[name] = RepairState.EVICTION_REQUIRED
+
+            elif state == RepairState.EVICTION_REQUIRED:
+                targets = [
+                    p
+                    for p in pods_by_node.get(name, ())
+                    if not self._is_daemonset_pod(p) and self._consumes_tpu(p)
+                ]
+                blocked = self._evict_pods(targets, force=remediation.force)
+                if not blocked:
+                    # entry action for reinstall: kill the node's driver
+                    # pods NOW so any Running driver pod seen later is the
+                    # DaemonSet's fresh replacement (fresh libtpu install)
+                    self._delete_driver_pods(pods_by_node.get(name, ()))
+                    self._set_repair_state(node, RepairState.REINSTALL_REQUIRED)
+                    states[name] = RepairState.REINSTALL_REQUIRED
+                elif self._repair_expired(node, remediation.timeout_seconds):
+                    log.error("repair: node %s eviction blocked past timeout", name)
+                    self._set_repair_state(node, RepairState.QUARANTINED)
+                    states[name] = RepairState.QUARANTINED
+                else:
+                    states[name] = state
+
+            elif state == RepairState.REINSTALL_REQUIRED:
+                if self._fresh_driver_pod_running(pods_by_node.get(name, ())):
+                    self._set_repair_state(node, RepairState.REVALIDATE_REQUIRED)
+                    states[name] = RepairState.REVALIDATE_REQUIRED
+                elif self._repair_expired(node, remediation.timeout_seconds):
+                    # the DaemonSet never brought a driver pod back (e.g.
+                    # libtpu operand broken/disabled): burn a retry rather
+                    # than parking here unbounded
+                    log.warning("repair: node %s driver pod never returned", name)
+                    states[name] = self._begin_or_quarantine(node, remediation)
+                else:
+                    states[name] = state
+
+            elif state == RepairState.REVALIDATE_REQUIRED:
+                if health == consts.HEALTH_HEALTHY:
+                    self._set_repair_state(node, RepairState.UNCORDON_REQUIRED)
+                    states[name] = RepairState.UNCORDON_REQUIRED
+                elif self._repair_expired(node, remediation.timeout_seconds):
+                    # the attempt failed to heal: re-enter directly
+                    # against the retry budget (never drop to no-state
+                    # while cordoned — a heal landing in that gap would
+                    # leave the cordon orphaned forever)
+                    log.warning("repair: node %s did not revalidate in time", name)
+                    states[name] = self._begin_or_quarantine(node, remediation)
+                else:
+                    states[name] = state
+
+            elif state == RepairState.UNCORDON_REQUIRED:
+                self._cordon(node, False)
+                self._set_repair_state(node, "")
+                self.recorder.event(
+                    node, "Normal", "TPUNodeRemediated",
+                    f"node {name}: repair complete, uncordoned",
+                )
+                states[name] = ""
+
+            else:
+                log.warning("repair: node %s carries unknown state %r", name, state)
+                states[name] = state
+
+        self._sync_slice_health(nodes)
+        return states
+
+    def _delete_driver_pods(self, node_pods) -> None:
+        for pod in node_pods:
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(DRIVER_POD_COMPONENT_LABEL) != DRIVER_POD_COMPONENT:
+                continue
+            md = pod["metadata"]
+            try:
+                self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+            except errors.NotFound:
+                pass
+
+    def _fresh_driver_pod_running(self, node_pods) -> bool:
+        """A Running, non-terminating driver pod — the DaemonSet's
+        replacement after the entry-action delete, i.e. a fresh libtpu
+        install pass."""
+        for pod in node_pods:
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(DRIVER_POD_COMPONENT_LABEL) != DRIVER_POD_COMPONENT:
+                continue
+            if pod["metadata"].get("deletionTimestamp"):
+                continue
+            if pod.get("status", {}).get("phase") == "Running":
+                return True
+        return False
+
+    # -- slice gang awareness ------------------------------------------------
+
+    def _sync_slice_health(self, nodes: List[ObjectDict]) -> None:
+        """Mark every member of a gang whose host is degraded/in-repair
+        with the slice-health label; clear it when the gang is whole
+        again. Gangs are keyed the way the slice manager pools nodes:
+        the GKE node pool."""
+        pools: Dict[str, List[ObjectDict]] = {}
+        for node in self.client.list("v1", "Node"):
+            pool = _labels(node).get(consts.GKE_NODEPOOL_LABEL)
+            if pool and _labels(node).get(consts.TPU_PRESENT_LABEL) == "true":
+                pools.setdefault(pool, []).append(node)
+        sick = set()
+        for node in nodes:
+            labels = _labels(node)
+            if (
+                labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_DEGRADED
+                or labels.get(consts.REPAIR_STATE_LABEL)
+            ):
+                pool = labels.get(consts.GKE_NODEPOOL_LABEL)
+                if pool:
+                    sick.add(pool)
+        for pool, members in pools.items():
+            # single-host pools have no gang to poison — but a pool that
+            # SHRANK to one member must still clear a stale label
+            want = (
+                consts.HEALTH_DEGRADED if pool in sick and len(members) >= 2 else None
+            )
+            for member in members:
+                labels = member["metadata"].setdefault("labels", {})
+                if want is None:
+                    if consts.TPU_SLICE_HEALTH_LABEL not in labels:
+                        continue
+                    del labels[consts.TPU_SLICE_HEALTH_LABEL]
+                else:
+                    if labels.get(consts.TPU_SLICE_HEALTH_LABEL) == want:
+                        continue
+                    labels[consts.TPU_SLICE_HEALTH_LABEL] = want
+                try:
+                    self.client.update(member)
+                except errors.Conflict:
+                    pass
+
+    # -- monitoring-only mode ------------------------------------------------
+
+    def observe_state(self) -> Dict[str, str]:
+        """Remediation off, monitoring on: report health verdicts and
+        keep the slice-gang labels honest WITHOUT driving any repair —
+        observability (gauges, status.health, fail-fast gang labels)
+        must not die with auto-repair."""
+        states: Dict[str, str] = {}
+        nodes = self.repair_nodes()
+        for node in nodes:
+            health = _labels(node).get(consts.TPU_HEALTH_LABEL, "")
+            if health:
+                states[node["metadata"]["name"]] = health
+        self._sync_slice_health(nodes)
+        return states
+
+    # -- cleanup -------------------------------------------------------------
+
+    def remove_repair_labels(self, keep_slice_labels: bool = False) -> bool:
+        """Remediation disabled: strip repair state and uncordon nodes we
+        were mid-walk on. Quarantined nodes keep their cordon (a human
+        opted them out of scheduling; disabling auto-repair must not
+        silently re-admit a sick node) but lose the label so re-enabling
+        starts clean. Returns True when cleanup fully converged (a
+        Conflict leaves work behind and the caller should requeue —
+        nothing else retriggers a reconcile for a node whose labels no
+        longer change)."""
+        clean = True
+        for node in self.client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            annotations = node["metadata"].get("annotations") or {}
+            state = labels.get(consts.REPAIR_STATE_LABEL)
+            slice_label = not keep_slice_labels and consts.TPU_SLICE_HEALTH_LABEL in labels
+            retries = consts.REPAIR_RETRIES_ANNOTATION in annotations
+            if not state and not slice_label and not retries:
+                continue
+            if state:
+                del labels[consts.REPAIR_STATE_LABEL]
+            if not keep_slice_labels:
+                labels.pop(consts.TPU_SLICE_HEALTH_LABEL, None)
+            annotations.pop(consts.REPAIR_STATE_SINCE_ANNOTATION, None)
+            # the retry budget goes too: "re-enabling starts clean" — a
+            # stale count would quarantine the node's first new fault
+            annotations.pop(consts.REPAIR_RETRIES_ANNOTATION, None)
+            try:
+                self.client.update(node)
+            except errors.Conflict:
+                clean = False
+                continue
+            if state in IN_REPAIR:
+                self._cordon(node, False)
+        return clean
+
+
+class HealthReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.repair_manager = NodeRepairManager(client, namespace)
+        self.metrics = get_metrics()
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)
+        if obj is None:
+            return Result()
+        cp = ClusterPolicy.from_unstructured(obj)
+        spec = cp.spec.health_monitor
+        interval = float(spec.interval or consts.HEALTH_REPLAN_SECONDS)
+        if not spec.is_enabled():
+            clean = self.repair_manager.remove_repair_labels()
+            self._publish_health_status(req.name, {})
+            # nothing is tracked while disabled: stale gauge values would
+            # keep alerts firing forever
+            self.metrics.unhealthy_nodes.set(0)
+            self.metrics.quarantined_nodes.set(0)
+            # a conflicted cleanup must retry: nothing else retriggers a
+            # reconcile for a node whose labels stop changing
+            return Result() if clean else Result(requeue_after=interval)
+
+        if not spec.remediation.enable:
+            # monitoring-only: repair unwinds, but observability (gauges,
+            # status.health, slice fail-fast labels) stays live
+            clean = self.repair_manager.remove_repair_labels(keep_slice_labels=True)
+            states = self.repair_manager.observe_state()
+            degraded = [n for n, s in states.items() if s == consts.HEALTH_DEGRADED]
+            self.metrics.unhealthy_nodes.set(len(degraded))
+            self.metrics.quarantined_nodes.set(0)
+            self._publish_health_status(req.name, states)
+            return Result(requeue_after=interval)
+
+        states = self.repair_manager.apply_state(spec)
+        degraded = [n for n, s in states.items() if s == consts.HEALTH_DEGRADED]
+        quarantined = [n for n, s in states.items() if s == RepairState.QUARANTINED]
+        in_repair = [n for n, s in states.items() if s in IN_REPAIR]
+        self.metrics.unhealthy_nodes.set(len(degraded) + len(in_repair) + len(quarantined))
+        self.metrics.quarantined_nodes.set(len(quarantined))
+        self._publish_health_status(req.name, states)
+        # replan on the agent's own cadence: repair progress depends on
+        # re-probes landing, not just cluster events
+        return Result(requeue_after=interval)
+
+    def _publish_health_status(self, cp_name: str, states: Dict[str, str]) -> None:
+        """Per-node repair progress in ClusterPolicy status (same shape
+        as the upgrade reconciler's block)."""
+        interesting = {n: s for n, s in states.items() if s and s != consts.HEALTH_HEALTHY}
+        health = {
+            "degraded": sum(1 for s in states.values() if s == consts.HEALTH_DEGRADED),
+            "remediating": sum(1 for s in states.values() if s in IN_REPAIR),
+            "quarantined": sum(1 for s in states.values() if s == RepairState.QUARANTINED),
+            "nodes": interesting,
+        }
+        obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name)
+        if obj is None:
+            return
+        status = obj.setdefault("status", {})
+        if not interesting:
+            if "health" not in status:
+                return
+            del status["health"]
+        elif status.get("health") == health:
+            return
+        else:
+            status["health"] = health
+        try:
+            self.client.update_status(obj)
+        except errors.ApiError as e:
+            # the ClusterPolicy reconciler races this write; next replan wins
+            log.debug("health status publish skipped: %s", e)
+
+
+def setup_with_manager(mgr, reconciler: HealthReconciler) -> Controller:
+    ctrl = Controller("health", reconciler)
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_all_cps(_obj) -> List[Request]:
+        try:
+            cps = reconciler.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=cp["metadata"]["name"]) for cp in cps]
+
+    ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND))
+
+    def health_labels_changed(event_type, old, new) -> bool:
+        keys = (consts.TPU_HEALTH_LABEL, consts.REPAIR_STATE_LABEL)
+        if event_type != "MODIFIED" or old is None:
+            return any(k in (new["metadata"].get("labels") or {}) for k in keys)
+        old_labels = old["metadata"].get("labels") or {}
+        new_labels = new["metadata"].get("labels") or {}
+        return any(old_labels.get(k) != new_labels.get(k) for k in keys)
+
+    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_all_cps, predicate=health_labels_changed)
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -62,6 +62,21 @@ class OperatorMetrics:
             "Nodes in libtpu upgrade-failed state",
             registry=reg,
         )
+        self.unhealthy_nodes = prometheus_client.Gauge(
+            "tpu_operator_unhealthy_nodes",
+            "Nodes whose TPU health is degraded, in repair, or quarantined",
+            registry=reg,
+        )
+        self.quarantined_nodes = prometheus_client.Gauge(
+            "tpu_operator_quarantined_nodes",
+            "Nodes parked in the quarantined terminal repair state",
+            registry=reg,
+        )
+        self.remediations_total = prometheus_client.Counter(
+            "tpu_operator_remediations_total",
+            "Health remediation attempts started",
+            registry=reg,
+        )
 
     def record_success(self):
         self.reconciliation_total.inc()

@@ -176,6 +176,10 @@ _FUNCTIONS = {
     "nindent": lambda n, s: "\n" + _indent(n, s),
     "quote": _quote,
     "default": lambda d, v=None: v if _truthy(v) else d,
+    # sprig coalesce: first non-empty argument, nil when all are empty
+    # (empty per Go truthiness — the chart's guard for nested knobs a
+    # partial values file may omit, e.g. clusterPolicy.healthMonitor.*)
+    "coalesce": lambda *a: next((x for x in a if _truthy(x)), None),
     # _gostr: a missing key (None) must compare as "", not "None"
     "hasPrefix": lambda prefix, s: _gostr(s).startswith(str(prefix)),
     "hasSuffix": lambda suffix, s: _gostr(s).endswith(str(suffix)),

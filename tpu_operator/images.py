@@ -22,6 +22,9 @@ DEFAULTS = {
     "metrics_exporter": ("TPU_METRICS_EXPORTER_IMAGE", "gcr.io/tpu-operator/tpu-metrics-exporter:1.0.0"),
     "node_status_exporter": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
     "validator": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
+    # the health agent ships in the validator/agents image (shim:
+    # tpu-health-monitor), like the discovery bootstrap
+    "health_monitor": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
 }
 
 

@@ -8,6 +8,7 @@ the operator itself can run — and, unlike a bash script, it is testable
 end to end against the served fake apiserver.
 
 Artifact layout mirrors the script's: nodes.yaml, node-labels.txt,
+node-health.txt (health/repair labels + TPUHealthy conditions),
 clusterpolicies.yaml, tpuslices.yaml, daemonsets.yaml, pods.yaml,
 services.yaml, configmaps.yaml, events.txt, pod-logs/<pod>.log.
 """
@@ -95,6 +96,39 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("node-labels.txt", "\n".join(lines) + "\n" if lines else "# none\n")
     except errors.ApiError as e:
         emit("node-labels.txt", f"# collection failed: {e}\n")
+
+    try:
+        # the health subsystem's per-node view: verdict label, per-chip
+        # annotation, repair FSM state/retries, and the TPUHealthy
+        # condition — the first things support asks for on a sick slice
+        from tpu_operator import consts as _consts
+
+        lines = []
+        for node in client.list("v1", "Node"):
+            md = node["metadata"]
+            labels = md.get("labels") or {}
+            annotations = md.get("annotations") or {}
+            cond = next(
+                (
+                    c
+                    for c in (node.get("status", {}).get("conditions") or [])
+                    if c.get("type") == _consts.TPU_HEALTH_CONDITION
+                ),
+                None,
+            )
+            lines.append(
+                f"{md['name']}  "
+                f"health={labels.get(_consts.TPU_HEALTH_LABEL, '-')}  "
+                f"repair={labels.get(_consts.REPAIR_STATE_LABEL, '-')}  "
+                f"retries={annotations.get(_consts.REPAIR_RETRIES_ANNOTATION, '0')}  "
+                f"slice={labels.get(_consts.TPU_SLICE_HEALTH_LABEL, '-')}  "
+                f"condition={(cond or {}).get('status', '-')}"
+                + (f" ({cond['message']})" if cond and cond.get("message") else "")
+                + f"  chips={annotations.get(_consts.TPU_HEALTH_CHIPS_ANNOTATION, '-')}"
+            )
+        emit("node-health.txt", "\n".join(lines) + "\n" if lines else "# none\n")
+    except errors.ApiError as e:
+        emit("node-health.txt", f"# collection failed: {e}\n")
 
     try:
         # cluster-wide: events for cluster-scoped objects (the CRs) land

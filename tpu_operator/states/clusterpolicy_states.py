@@ -51,6 +51,7 @@ STATE_ORDER = [
     "state-slice-manager",
     "state-metrics-exporter",
     "state-node-status-exporter",
+    "state-health-monitor",
 ]
 
 
@@ -101,6 +102,10 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             "device_plugin",
             config_name=spec.device_plugin.config.name,
             config_default=spec.device_plugin.config.default,
+            # staleness horizon for the health agent's verdicts file,
+            # derived from the agent's own probe cadence: a long interval
+            # must not make fresh verdicts look stale mid-tick
+            health_verdicts_ttl=max(600, 4 * int(spec.health_monitor.interval or 30)),
         ),
         "tfd": _component_data(spec.tpu_feature_discovery, "tfd"),
         "node_discovery": _component_data(spec.node_discovery, "node_discovery"),
@@ -122,6 +127,13 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             },
         ),
         "node_status_exporter": _component_data(spec.node_status_exporter, "node_status_exporter", port=8000),
+        "health_monitor": _component_data(
+            spec.health_monitor,
+            "health_monitor",
+            interval=spec.health_monitor.interval or 30,
+            active_probes=spec.health_monitor.active_probes or "auto",
+        ),
+        "health_dir": consts.HEALTH_DIR,
         "validator": _component_data(
             spec.validator,
             "validator",
@@ -245,6 +257,18 @@ class NodeStatusExporterState(ClusterPolicyState):
         return catalog.cluster_policy.spec.node_status_exporter.is_enabled()
 
 
+class HealthMonitorState(ClusterPolicyState):
+    """The node health agent (DCGM-health → node-auto-repair analog):
+    probes chips/libtpu/plugin-socket per node and publishes verdicts the
+    device plugin and the remediation controller consume."""
+
+    def __init__(self):
+        super().__init__("state-health-monitor")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.health_monitor.is_enabled()
+
+
 def new_cluster_policy_states() -> List[StateSkel]:
     """reference: addState x19, state_manager.go:791-810."""
     states = [
@@ -258,6 +282,7 @@ def new_cluster_policy_states() -> List[StateSkel]:
         SliceManagerState(),
         MetricsExporterState(),
         NodeStatusExporterState(),
+        HealthMonitorState(),
     ]
     assert [s.name for s in states] == STATE_ORDER
     return states
