@@ -1,7 +1,7 @@
 # Build/test entrypoints (reference: Makefile + versions.mk targets).
 PYTHON ?= python3
 
-.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render native images clean
+.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render lint native images clean
 
 all: native test
 
@@ -39,6 +39,10 @@ render:
 
 validate:
 	$(PYTHON) scripts/validate_rendered.py
+
+# static analysis: manifest rules, RBAC least-privilege proof, drift
+lint:
+	$(PYTHON) -m tpu_operator.cmd.tpuop_lint
 
 native:
 	$(MAKE) -C native

@@ -9,6 +9,18 @@ echo "== unit tests (includes golden render drift) =="
 TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST=1 python3 -m pytest tests/ -q
 echo "== rendered chart lints clean =="
 python3 scripts/validate_rendered.py
+echo "== tpuop-lint static analysis (error severity fails the build) =="
+# JSON to a file for artifact upload AND a human-readable echo on failure
+if ! python3 -m tpu_operator.cmd.tpuop_lint --format json > /tmp/lint-report.json; then
+  python3 -m tpu_operator.cmd.tpuop_lint --format text || true
+  echo "tpuop-lint FAILED (see /tmp/lint-report.json)"
+  exit 1
+fi
+python3 - <<'EOF'
+import json
+summary = json.load(open("/tmp/lint-report.json"))["summary"]
+print(f"tpuop-lint: {summary}")
+EOF
 echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) =="
 python3 scripts/image_smoke.py
 echo "== e2e =="

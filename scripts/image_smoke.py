@@ -225,6 +225,21 @@ def smoke_entrypoints(wrappers: dict, harness: Harness) -> None:
         raise SystemExit(f"FAIL tpuop-cfg: rc={proc.returncode}\n{proc.stderr[-2000:]}")
     print("ok: tpuop-cfg generate crds")
 
+    # tpuop-lint: static analysis over the shipped artifacts, exits 0
+    # (a seeded defect failing the build is covered by tests/test_lint.py;
+    # here the check is that the in-image entrypoint boots and runs clean)
+    proc = subprocess.run(
+        [sys.executable, "-m", check("tpuop-lint"), "--format", "json"],
+        env=harness.env(),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=START_TIMEOUT * 4,  # renders every state + walks the AST
+    )
+    if proc.returncode != 0 or '"summary"' not in proc.stdout:
+        raise SystemExit(f"FAIL tpuop-lint: rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    print("ok: tpuop-lint --format json")
+
     # libtpu-installer: oneshot install of a fake .so into the sandbox
     fake_so = os.path.join(harness.tmp, "libtpu-src.so")
     with open(fake_so, "wb") as f:

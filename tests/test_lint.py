@@ -1,0 +1,334 @@
+"""tpuop-lint test suite.
+
+Three layers:
+  * known-bad fixtures — one minimal manifest per lint rule asserting
+    exactly that rule fires, and that a baseline entry suppresses it
+  * seeded defects — a dropped ClusterRole verb, an unpinned image, a
+    renamed CRD field: each must be caught by its analyzer
+  * the acceptance gate — the shipped repo lints clean (zero
+    unsuppressed error findings)
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from tpu_operator.lint import drift, manifest_rules, rbac_static, runner
+from tpu_operator.lint.findings import Baseline, dedupe, failing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Minimal fixture objects.
+# ---------------------------------------------------------------------------
+
+
+def make_daemonset(**overrides):
+    """A DaemonSet that passes every manifest rule; tests break exactly
+    one aspect each."""
+    ds = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": "fix", "namespace": "ns"},
+        "spec": {
+            "selector": {"matchLabels": {"app": "fix"}},
+            "template": {
+                "metadata": {"labels": {"app": "fix"}},
+                "spec": {
+                    "serviceAccountName": "fix-sa",
+                    "nodeSelector": {"tpu.google.com/tpu.deploy.fix": "true"},
+                    "tolerations": [
+                        {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}
+                    ],
+                    "containers": [
+                        {
+                            "name": "main",
+                            "image": "gcr.io/x/img:1.0.0",
+                            "resources": {"requests": {"cpu": "10m"}},
+                            "readinessProbe": {"exec": {"command": ["true"]}},
+                        }
+                    ],
+                    "volumes": [],
+                },
+            },
+        },
+    }
+    for path, value in overrides.items():
+        node = ds
+        keys = path.split(".")
+        for k in keys[:-1]:
+            node = node[k]
+        node[keys[-1]] = value
+    return ds
+
+
+SA = {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": {"name": "fix-sa"}}
+
+
+def rules_fired(objects):
+    return {f.rule for f in manifest_rules.lint_group("fixture", objects)}
+
+
+class TestManifestRuleFixtures:
+    def test_clean_fixture_fires_nothing(self):
+        assert rules_fired([SA, make_daemonset()]) == set()
+
+    def test_m001_privileged(self):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["containers"][0]["securityContext"] = {
+            "privileged": True
+        }
+        assert rules_fired([SA, ds]) == {"TPUOP-M001"}
+
+    def test_m002_hostpath(self):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["volumes"] = [
+            {"name": "dev", "hostPath": {"path": "/dev"}}
+        ]
+        assert rules_fired([SA, ds]) == {"TPUOP-M002"}
+
+    @pytest.mark.parametrize(
+        "image", ["gcr.io/x/img:latest", "gcr.io/x/img", "localhost:5000/img"]
+    )
+    def test_m003_unpinned_image(self, image):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["containers"][0]["image"] = image
+        assert rules_fired([SA, ds]) == {"TPUOP-M003"}
+
+    @pytest.mark.parametrize(
+        "image", ["gcr.io/x/img:1.2.3", "gcr.io/x/img@sha256:abc", "localhost:5000/img:1.0"]
+    )
+    def test_m003_pinned_images_pass(self, image):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["containers"][0]["image"] = image
+        assert rules_fired([SA, ds]) == set()
+
+    def test_m004_selector_mismatch(self):
+        ds = make_daemonset()
+        ds["spec"]["selector"]["matchLabels"] = {"app": "other"}
+        assert rules_fired([SA, ds]) == {"TPUOP-M004"}
+
+    def test_m005_dangling_serviceaccount(self):
+        assert rules_fired([make_daemonset()]) == {"TPUOP-M005"}
+
+    def test_m006_dangling_configmap(self):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["volumes"] = [
+            {"name": "cfg", "configMap": {"name": "nope"}}
+        ]
+        assert rules_fired([SA, ds]) == {"TPUOP-M006"}
+
+    def test_m007_no_probe(self):
+        ds = make_daemonset()
+        del ds["spec"]["template"]["spec"]["containers"][0]["readinessProbe"]
+        assert rules_fired([SA, ds]) == {"TPUOP-M007"}
+
+    def test_m008_no_requests(self):
+        ds = make_daemonset()
+        del ds["spec"]["template"]["spec"]["containers"][0]["resources"]
+        assert rules_fired([SA, ds]) == {"TPUOP-M008"}
+
+    def test_m009_missing_tpu_toleration(self):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["tolerations"] = []
+        assert rules_fired([SA, ds]) == {"TPUOP-M009"}
+
+    def test_r003_unknown_verb(self):
+        role = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "r"},
+            "rules": [{"apiGroups": [""], "resources": ["nodes"], "verbs": ["label"]}],
+        }
+        assert rules_fired([role]) == {"TPUOP-R003"}
+
+    def test_r004_cluster_scoped_in_role(self):
+        role = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": "r", "namespace": "ns"},
+            "rules": [{"apiGroups": [""], "resources": ["nodes"], "verbs": ["get"]}],
+        }
+        assert rules_fired([role]) == {"TPUOP-R004"}
+
+    def test_baseline_suppresses_exactly_its_target(self):
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["containers"][0]["securityContext"] = {
+            "privileged": True
+        }
+        findings = manifest_rules.lint_group("fixture", [SA, ds])
+        baseline = Baseline.from_text(
+            "TPUOP-M001 DaemonSet/fix/ctr:main  # fixture justification\n"
+        )
+        applied = baseline.apply(findings)
+        assert all(f.suppressed for f in applied if f.rule == "TPUOP-M001")
+        assert not failing(applied)
+        assert not baseline.unused_entries()
+
+    def test_baseline_prefix_respects_boundaries(self):
+        """'vol:dev' must not swallow 'vol:device-plugins'."""
+        ds = make_daemonset()
+        ds["spec"]["template"]["spec"]["volumes"] = [
+            {"name": "dev", "hostPath": {"path": "/dev"}},
+            {"name": "device-plugins", "hostPath": {"path": "/var/lib/kubelet"}},
+        ]
+        findings = manifest_rules.lint_group("fixture", [SA, ds])
+        baseline = Baseline.from_text("TPUOP-M002 DaemonSet/fix/vol:dev  # just dev\n")
+        applied = baseline.apply(findings)
+        suppressed = {f.location for f in applied if f.suppressed}
+        assert suppressed == {"DaemonSet/fix/vol:dev"}
+
+
+# ---------------------------------------------------------------------------
+# Seeded RBAC defects.
+# ---------------------------------------------------------------------------
+
+
+class TestRbacSeededDefects:
+    @pytest.fixture(scope="class")
+    def shipped(self):
+        return rbac_static.shipped_subject_rules()
+
+    def test_dropped_clusterrole_verb_is_caught(self, shipped):
+        """Remove nodes/status update from the health monitor's rules:
+        the analyzer must report the missing grant."""
+        rules = copy.deepcopy(shipped)
+        rules["state-health-monitor"] = [
+            r
+            for r in rules["state-health-monitor"]
+            if "nodes/status" not in (r.get("resources") or [])
+        ]
+        findings = rbac_static.analyze(rules_by_subject=rules)
+        assert any(
+            f.rule == "TPUOP-R001"
+            and f.location == "rbac:state-health-monitor/nodes/status/update"
+            for f in findings
+        ), [f.location for f in findings]
+
+    def test_extra_verb_is_caught_as_excess(self, shipped):
+        rules = copy.deepcopy(shipped)
+        rules["state-tpu-feature-discovery"] = rules["state-tpu-feature-discovery"] + [
+            {"apiGroups": [""], "resources": ["secrets"], "verbs": ["get"]}
+        ]
+        findings = rbac_static.analyze(rules_by_subject=rules)
+        assert any(
+            f.rule == "TPUOP-R002"
+            and f.location == "rbac:state-tpu-feature-discovery/secrets/get"
+            for f in findings
+        ), [f.location for f in findings]
+
+    def test_shipped_rules_diff_clean(self, shipped):
+        """The committed Roles/ClusterRoles match the static derivation
+        exactly — no missing grants, no excess."""
+        findings = rbac_static.analyze(rules_by_subject=shipped)
+        problems = [f for f in findings if f.severity == "error"]
+        assert not problems, [f"{f.location}: {f.message}" for f in problems]
+
+    def test_every_call_site_resolves(self):
+        """No TPUOP-R005: every client call site in the package either
+        resolves statically or carries a pragma."""
+        _, findings = rbac_static.required_grants()
+        assert not findings, [f.location for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Seeded drift defects.
+# ---------------------------------------------------------------------------
+
+
+class TestDriftSeededDefects:
+    def test_renamed_crd_field_is_caught(self):
+        from tpu_operator.api.crds import all_crds
+
+        shipped = {c["metadata"]["name"]: c for c in all_crds()}
+        crd = shipped["clusterpolicies.tpu.google.com"]
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        props = schema["properties"]["spec"]["properties"]
+        props["libtpuu"] = props.pop("libtpu")  # the rename
+        findings = drift.crd_schema_drift(shipped_crds=shipped)
+        locs = [f.location for f in findings]
+        assert any("libtpu" in loc for loc in locs), locs
+        assert all(f.rule == "TPUOP-D001" for f in findings)
+
+    def test_type_change_is_caught(self):
+        from tpu_operator.api.crds import all_crds
+
+        shipped = {c["metadata"]["name"]: c for c in all_crds()}
+        crd = shipped["tpuslices.tpu.google.com"]
+        schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        spec_props = schema["properties"]["spec"]["properties"]
+        key = next(iter(spec_props))
+        spec_props[key] = {"type": "string"} if spec_props[key] != {"type": "string"} else {"type": "integer"}
+        findings = drift.crd_schema_drift(shipped_crds=shipped)
+        assert findings and all(f.rule == "TPUOP-D001" for f in findings)
+
+    def test_shipped_crds_clean(self):
+        assert drift.crd_schema_drift() == []
+        assert drift.helm_kustomize_crd_drift() == []
+
+    def test_goldens_fresh(self):
+        assert drift.golden_drift() == []
+
+    def test_kustomize_fresh(self):
+        assert drift.kustomize_drift() == []
+
+
+# ---------------------------------------------------------------------------
+# The acceptance gate + CLI.
+# ---------------------------------------------------------------------------
+
+
+class TestShippedRepoLintsClean:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return runner.run_lint()
+
+    def test_zero_unsuppressed_errors(self, findings):
+        bad = failing(findings)
+        assert not bad, [f"{f.rule} {f.location}: {f.message}" for f in bad]
+
+    def test_no_dead_baseline_entries(self, findings):
+        dead = [f for f in findings if f.rule == "TPUOP-B001"]
+        assert not dead, [f.message for f in dead]
+
+    def test_privileged_surface_is_fully_documented(self, findings):
+        """Every privileged/hostPath finding is suppressed by a baseline
+        entry — none unsuppressed, and none vanished (the suppression
+        count proves the rules still see the surface)."""
+        m = [f for f in findings if f.rule in ("TPUOP-M001", "TPUOP-M002")]
+        assert m, "the privileged/hostPath surface disappeared entirely?"
+        assert all(f.suppressed for f in m)
+
+    def test_cli_json_exit_zero(self, capsys):
+        from tpu_operator.cmd.tpuop_lint import main
+
+        assert main(["--format", "json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["summary"]["error"] == 0
+        assert {f["rule"] for f in report["findings"] if not f.get("suppressed")} <= {
+            "TPUOP-M007"
+        }
+
+    def test_cli_fails_on_seeded_error(self, tmp_path, capsys):
+        """End to end: an empty baseline un-suppresses the privileged
+        findings and the CLI exits nonzero."""
+        from tpu_operator.cmd.tpuop_lint import main
+
+        empty = tmp_path / "baseline"
+        empty.write_text("")
+        assert main(["--baseline", str(empty), "--format", "json"]) == 1
+
+    def test_dedupe_collapses_render_paths(self):
+        """The same DaemonSet reaches the linter via state render AND
+        golden snapshot; identical findings must collapse to one."""
+        groups = runner.manifest_groups()
+        all_findings = []
+        for group, objects in groups:
+            all_findings.extend(manifest_rules.lint_group(group, objects))
+        deduped = dedupe(all_findings)
+        keys = [(f.rule, f.location, f.message) for f in deduped]
+        assert len(keys) == len(set(keys))
+        assert len(deduped) < len(all_findings)

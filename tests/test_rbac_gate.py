@@ -117,124 +117,148 @@ def state_rules(state_name: str) -> list:
     return rules
 
 
+# -- agent exercises --------------------------------------------------------
+#
+# Each function drives one operand agent's core loop over the wire and
+# returns normally only on success. They are module-level (not test
+# methods) because TWO gates replay them: the per-agent enforcement
+# tests below, and TestStaticRuntimeConsistency, which re-runs them to
+# prove the static RBAC analyzer's per-operand verb set covers
+# everything the runtime actually sends.
+
+
+def exercise_tfd(store, client, tmp_path, monkeypatch):
+    from tpu_operator.agents.tfd_agent import TFDAgent
+
+    (tmp_path / "dev").mkdir(exist_ok=True)
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+    store.create(make_tpu_node("tpu-0"))
+    assert TFDAgent(client, "tpu-0").apply_once()
+
+
+def exercise_node_discovery(store, client, tmp_path, monkeypatch):
+    from tpu_operator.agents.node_discovery_agent import NodeDiscoveryAgent
+    from tpu_operator.kube.sim import make_bare_node
+
+    (tmp_path / "dev").mkdir(exist_ok=True)
+    for i in range(4):
+        (tmp_path / "dev" / f"accel{i}").touch()
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+    for var in ("TPU_TOPOLOGY", "TPU_ACCELERATOR_TYPE"):
+        monkeypatch.delenv(var, raising=False)
+    store.create(make_bare_node("bare-0"))
+    assert NodeDiscoveryAgent(client, "bare-0").apply_once()
+
+
+def exercise_slice_manager(store, client, tmp_path=None, monkeypatch=None):
+    from tpu_operator import consts
+    from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+
+    for i in range(4):
+        node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4")
+        node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    names = SliceManagerAgent(client, NS).reconcile_once()
+    assert names, "no slice reconciled"
+
+
+def exercise_device_plugin(store, client, tmp_path=None, monkeypatch=None):
+    from tpu_operator.agents.device_plugin_agent import select_plugin_config
+    from tpu_operator.kube.objects import new_object
+
+    store.create(make_tpu_node("tpu-0"))
+    store.create(
+        new_object(
+            "v1", "ConfigMap", "plugin-config", NS,
+            data={"default": "sharing:\n  chips_per_container: 1\n"},
+        )
+    )
+    cfg = select_plugin_config(client, "tpu-0", "plugin-config", NS, default="default")
+    assert cfg == {"sharing": {"chips_per_container": 1}}
+
+
+def exercise_validator_plugin(store, client, tmp_path=None, monkeypatch=None):
+    from tpu_operator.validator.main import Context, validate_plugin
+
+    store.create(make_tpu_node("tpu-0", chips=4))
+    ctx = Context(client=client, node_name="tpu-0", retry_interval=0.01)
+    report = validate_plugin(ctx)
+    assert report["chips"] == 4
+
+
+def exercise_node_status_exporter(store, client, tmp_path=None, monkeypatch=None):
+    """The metrics payload's apiserver surface: the per-node context
+    read that used to 403 under the (formerly empty) shipped rules."""
+    store.create(make_tpu_node("tpu-0", chips=4))
+    node = client.get("v1", "Node", "tpu-0")
+    assert node["metadata"]["name"] == "tpu-0"
+
+
+def run_health_agent(client, tmp_path, monkeypatch):
+    """The agent's full publish surface: node get/update, nodes/status
+    update (TPUHealthy condition), events create — a DEGRADED pass so
+    the event path definitely fires."""
+    from tpu_operator.agents.health_monitor_agent import HealthMonitorAgent
+
+    (tmp_path / "dev").mkdir(exist_ok=True)
+    monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
+    agent = HealthMonitorAgent(
+        client,
+        "tpu-0",
+        install_dir=str(tmp_path),
+        socket_dir=str(tmp_path),
+        health_dir=str(tmp_path / "health"),
+        active_probes="off",
+    )
+    return agent.apply_once()
+
+
+def exercise_health_monitor(store, client, tmp_path, monkeypatch):
+    store.create(make_tpu_node("tpu-0", chips=4))
+    assert run_health_agent(client, tmp_path, monkeypatch)
+
+
+AGENT_EXERCISES = {
+    "state-tpu-feature-discovery": exercise_tfd,
+    "state-node-discovery": exercise_node_discovery,
+    "state-slice-manager": exercise_slice_manager,
+    "state-device-plugin": exercise_device_plugin,
+    "state-operator-validation": exercise_validator_plugin,
+    "state-node-status-exporter": exercise_node_status_exporter,
+    "state-health-monitor": exercise_health_monitor,
+}
+
+
+def enforced_server(state_name):
+    store = FakeClient()
+    authorizer = RbacAuthorizer(state_rules(state_name))
+    server = FakeApiServer(store, authorize=authorizer).start()
+    client = HttpClient(server.base_url, timeout=10.0)
+    return store, server, client, authorizer
+
+
 class TestAgentsUnderEnforcement:
     """Each operand agent that talks to the apiserver runs its core loop
     under enforcement with exactly the Role/ClusterRole its own state
     ships — the same 403s a real cluster would produce for a missing
     grant."""
 
-    def _enforced(self, state_name):
-        store = FakeClient()
-        authorizer = RbacAuthorizer(state_rules(state_name))
-        server = FakeApiServer(store, authorize=authorizer).start()
-        client = HttpClient(server.base_url, timeout=10.0)
-        return store, server, client, authorizer
-
-    def test_tfd_agent(self, tmp_path, monkeypatch):
-        from tpu_operator.agents.tfd_agent import TFDAgent
-
-        (tmp_path / "dev").mkdir()
-        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
-        store, server, client, auth = self._enforced("state-tpu-feature-discovery")
+    @pytest.mark.parametrize("state_name", sorted(AGENT_EXERCISES))
+    def test_agent_under_shipped_rules(self, state_name, tmp_path, monkeypatch):
+        store, server, client, auth = enforced_server(state_name)
         try:
-            store.create(make_tpu_node("tpu-0"))
-            assert TFDAgent(client, "tpu-0").apply_once()
+            AGENT_EXERCISES[state_name](store, client, tmp_path, monkeypatch)
             assert not auth.denials, auth.denials
         finally:
             server.stop()
 
-    def test_node_discovery_agent(self, tmp_path, monkeypatch):
-        from tpu_operator.agents.node_discovery_agent import NodeDiscoveryAgent
-        from tpu_operator.kube.sim import make_bare_node
-
-        (tmp_path / "dev").mkdir()
-        for i in range(4):
-            (tmp_path / "dev" / f"accel{i}").touch()
-        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
-        for var in ("TPU_TOPOLOGY", "TPU_ACCELERATOR_TYPE"):
-            monkeypatch.delenv(var, raising=False)
-        store, server, client, auth = self._enforced("state-node-discovery")
-        try:
-            store.create(make_bare_node("bare-0"))
-            assert NodeDiscoveryAgent(client, "bare-0").apply_once()
-            assert not auth.denials, auth.denials
-        finally:
-            server.stop()
-
-    def test_slice_manager_agent(self):
+    def test_health_monitor_publishes_verdict(self, tmp_path, monkeypatch):
         from tpu_operator import consts
-        from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
 
-        store, server, client, auth = self._enforced("state-slice-manager")
+        store, server, client, auth = enforced_server("state-health-monitor")
         try:
-            for i in range(4):
-                node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "4x4")
-                node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
-                store.create(node)
-            names = SliceManagerAgent(client, NS).reconcile_once()
-            assert names, "no slice reconciled"
-            assert not auth.denials, auth.denials
-        finally:
-            server.stop()
-
-    def test_device_plugin_config_selection(self):
-        from tpu_operator.agents.device_plugin_agent import select_plugin_config
-        from tpu_operator.kube.objects import new_object
-
-        store, server, client, auth = self._enforced("state-device-plugin")
-        try:
-            store.create(make_tpu_node("tpu-0"))
-            store.create(
-                new_object(
-                    "v1", "ConfigMap", "plugin-config", NS,
-                    data={"default": "sharing:\n  chips_per_container: 1\n"},
-                )
-            )
-            cfg = select_plugin_config(client, "tpu-0", "plugin-config", NS, default="default")
-            assert cfg == {"sharing": {"chips_per_container": 1}}
-            assert not auth.denials, auth.denials
-        finally:
-            server.stop()
-
-    def test_validator_plugin_component(self):
-        from tpu_operator.validator.main import Context, validate_plugin
-
-        store, server, client, auth = self._enforced("state-operator-validation")
-        try:
-            store.create(make_tpu_node("tpu-0", chips=4))
-            ctx = Context(client=client, node_name="tpu-0", retry_interval=0.01)
-            report = validate_plugin(ctx)
-            assert report["chips"] == 4
-            assert not auth.denials, auth.denials
-        finally:
-            server.stop()
-
-    def _run_health_agent(self, client, tmp_path, monkeypatch):
-        """The agent's full publish surface: node get/update, nodes/status
-        update (TPUHealthy condition), events create — a DEGRADED pass so
-        the event path definitely fires."""
-        from tpu_operator.agents.health_monitor_agent import HealthMonitorAgent
-
-        (tmp_path / "dev").mkdir(exist_ok=True)
-        monkeypatch.setenv("TPUINFO_SCAN_ROOT", str(tmp_path))
-        agent = HealthMonitorAgent(
-            client,
-            "tpu-0",
-            install_dir=str(tmp_path),
-            socket_dir=str(tmp_path),
-            health_dir=str(tmp_path / "health"),
-            active_probes="off",
-        )
-        return agent.apply_once()
-
-    def test_health_monitor_agent(self, tmp_path, monkeypatch):
-        store, server, client, auth = self._enforced("state-health-monitor")
-        try:
-            store.create(make_tpu_node("tpu-0", chips=4))
-            assert self._run_health_agent(client, tmp_path, monkeypatch)
+            exercise_health_monitor(store, client, tmp_path, monkeypatch)
             node = store.get("v1", "Node", "tpu-0")
-            from tpu_operator import consts
-
             assert node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] == "degraded"
             assert any(
                 c["type"] == consts.TPU_HEALTH_CONDITION
@@ -262,7 +286,7 @@ class TestAgentsUnderEnforcement:
         try:
             store.create(make_tpu_node("tpu-0", chips=4))
             try:
-                self._run_health_agent(client, tmp_path, monkeypatch)
+                run_health_agent(client, tmp_path, monkeypatch)
             except errors.ApiError:
                 pass  # a surfaced 403 is equally acceptable
             assert any(res == "nodes/status" for _, _, res in authorizer.denials), (
@@ -285,7 +309,10 @@ class TestOperatorUnderEnforcement:
         setup_with_manager(mgr, ClusterPolicyReconciler(client, NS))
         try:
             mgr.start()
-            client.create(new_cluster_policy())
+            # the CR install is an ADMIN action (kubectl apply), not the
+            # operator's: it goes straight into the store so the shipped
+            # ClusterRole doesn't need (and doesn't hold) CR create
+            store.create(new_cluster_policy())
 
             def ready():
                 cp = store.get_or_none(
@@ -322,6 +349,10 @@ class TestOperatorUnderEnforcement:
             "resources": ["poddisruptionbudgets"],
             "verbs": ["get", "list", "create", "update", "delete"],
         },
+        # the drill provisions/tears down its synthetic tainted Node —
+        # cloud-controller territory; the operator itself only reads and
+        # updates nodes, never creates or deletes them
+        {"apiGroups": [""], "resources": ["nodes"], "verbs": ["create", "delete"]},
     ]
 
     def test_upgrade_drill_runs_under_enforcement(self):
@@ -416,3 +447,62 @@ class TestOperatorUnderEnforcement:
         became_ready, denials = self._run_install(rules)
         assert any(res == "daemonsets" for _, _, res in denials), denials
         assert not became_ready, "Ready despite the operator being unable to manage DaemonSets"
+
+
+class TestStaticRuntimeConsistency:
+    """Wire the two RBAC gates together (neither can rot alone): the
+    static analyzer's per-operand verb derivation must be a SUPERSET of
+    whatever the runtime gate observes over the wire for the same agent
+    flows. A static set that misses an observed verb means tpuop-lint
+    would bless a Role the runtime needs more from; the excess direction
+    is covered by tpuop-lint's own TPUOP-R002 pass."""
+
+    @pytest.fixture(scope="class")
+    def static_required(self):
+        from tpu_operator.lint.rbac_static import required_grants
+
+        required, _ = required_grants()
+        return required
+
+    @pytest.mark.parametrize("state_name", sorted(AGENT_EXERCISES))
+    def test_static_covers_observed(self, state_name, static_required, tmp_path, monkeypatch):
+        store, server, client, auth = enforced_server(state_name)
+        try:
+            AGENT_EXERCISES[state_name](store, client, tmp_path, monkeypatch)
+        finally:
+            server.stop()
+        assert auth.checks, "flow sent no requests — the gate observed nothing"
+        missing = auth.checks - static_required[state_name]
+        assert not missing, (
+            f"runtime sent verbs the static analyzer does not attribute to "
+            f"{state_name}: {sorted(missing)} — update tpu_operator/lint/"
+            "rbac_static.py (SUBJECT_ROOTS or a call-site pragma)"
+        )
+
+
+class TestClientVerbSurface:
+    def test_verbs_table_covers_every_client_method(self):
+        """HttpClient.VERBS is the one table both gates derive verb
+        semantics from; every public Client-interface method that can
+        reach the apiserver must be declared there, so adding a client
+        method without classifying it fails here instead of silently
+        dodging both the static and runtime RBAC gates."""
+        import inspect
+
+        from tpu_operator.kube.client import Client
+
+        public = {
+            name
+            for name, member in inspect.getmembers(Client, predicate=inspect.isfunction)
+            if not name.startswith("_")
+        }
+        undeclared = public - set(HttpClient.VERBS)
+        assert not undeclared, (
+            f"client methods missing from HttpClient.VERBS: {sorted(undeclared)}"
+        )
+
+    def test_verbs_table_has_no_stale_entries(self):
+        """Every VERBS key must exist on HttpClient (a renamed method
+        must take its table entry along)."""
+        for name in HttpClient.VERBS:
+            assert callable(getattr(HttpClient, name, None)), name

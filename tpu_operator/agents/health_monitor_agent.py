@@ -220,7 +220,7 @@ class HealthMonitorAgent:
         else:
             conds.append(cond)
         try:
-            self.client.update_status(node)
+            self.client.update_status(node)  # tpuop-lint: kinds=v1/Node
         except errors.ApiError as e:
             log.debug("health: condition publish skipped: %s", e)
 
@@ -261,7 +261,7 @@ class HealthMonitorAgent:
             try:
                 # use the server's response (fresh resourceVersion) for
                 # the follow-up condition write
-                node = self.client.update(node) or node
+                node = self.client.update(node) or node  # tpuop-lint: kinds=v1/Node
             except errors.Conflict:
                 return False  # node moved under us; next tick retries
         self._set_condition(node, report)

@@ -279,7 +279,7 @@ class SliceManagerAgent:
                 except errors.NotFound:
                     pass  # another host's agent deleted it first
             try:
-                self.client.create(pod)
+                self.client.create(pod)  # tpuop-lint: kinds=v1/Pod
             except (errors.Conflict, errors.AlreadyExists):
                 pass  # another host's agent won the race; converged either way
             created.append(pod_name)
@@ -339,7 +339,7 @@ class SliceManagerAgent:
         # the same stale object first must not abort the rest of the pass
         def delete_quietly(api_version: str, kind: str, name: str) -> None:
             try:
-                self.client.delete(api_version, kind, name, self.namespace)
+                self.client.delete(api_version, kind, name, self.namespace)  # tpuop-lint: kinds=v1/Service,v1/ConfigMap,v1/Pod
             except errors.NotFound:
                 pass
 

@@ -78,7 +78,7 @@ def make_client(args):
 
         client = FakeClient()
         for i in range(args.fake_cluster):
-            client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+            client.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))  # tpuop-lint: ignore
         ClusterSim(client, ready_delay=0.5).start()
         return client
     from tpu_operator.kube.http_client import HttpClient

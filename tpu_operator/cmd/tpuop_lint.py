@@ -1,0 +1,104 @@
+"""tpuop-lint: static analysis CLI.
+
+    tpuop-lint                         # text report, exit 1 on errors
+    tpuop-lint --format json           # machine-readable (CI, must-gather)
+    tpuop-lint --only rbac,drift       # subset of analyzers
+    tpuop-lint --rules                 # print the rule catalog
+    tpuop-lint --update-baseline       # rewrite the baseline from current
+                                       # error findings (review the diff!)
+
+Exit status: 0 clean (warnings/info allowed), 1 when any unsuppressed
+error-severity finding remains, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from tpu_operator.lint import runner
+from tpu_operator.lint.findings import (
+    RULES,
+    Finding,
+    failing,
+    render_json,
+    render_text,
+    sort_findings,
+)
+
+
+def _write_baseline(path: str, findings: List[Finding]) -> int:
+    lines = [
+        "# tpuop-lint baseline: intentional exceptions, one per line:",
+        "#   RULE-ID  location-prefix  # one-line justification",
+        "# Regenerate with `tpuop-lint --update-baseline`, then EDIT the",
+        "# justifications — an unexplained suppression fails review.",
+    ]
+    for f in sort_findings(findings):
+        if f.severity != "error":
+            continue
+        lines.append(f"{f.rule} {f.location}  # TODO: justify")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print(f"wrote {path} ({sum(1 for l in lines if not l.startswith('#'))} entries)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "tpuop-lint", description="static analysis over shipped operator artifacts"
+    )
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"suppression file (default: {runner.DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--only",
+        default=None,
+        help=f"comma-separated analyzers to run (default: all of {','.join(runner.ANALYZERS)})",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="include baseline-suppressed findings in text output",
+    )
+    p.add_argument("--rules", action="store_true", help="print the rule catalog and exit")
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from current error findings",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.rules:
+        for rule, (severity, desc) in sorted(RULES.items()):
+            print(f"{rule}  {severity:8s} {desc}")
+        return 0
+    only = None
+    if args.only:
+        only = [a.strip() for a in args.only.split(",") if a.strip()]
+        unknown = [a for a in only if a not in runner.ANALYZERS]
+        if unknown:
+            print(f"unknown analyzer(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+    if args.update_baseline:
+        # run WITHOUT the existing baseline so every current error lands
+        findings = runner.run_lint(baseline_path=os.devnull, only=only)
+        return _write_baseline(args.baseline or runner.DEFAULT_BASELINE, findings)
+    findings = runner.run_lint(baseline_path=args.baseline, only=only)
+    if args.format == "json":
+        sys.stdout.write(render_json(findings))
+    else:
+        sys.stdout.write(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if failing(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

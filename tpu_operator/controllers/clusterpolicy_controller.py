@@ -251,7 +251,7 @@ class ClusterPolicyReconciler:
                         changed = True
             if changed:
                 try:
-                    self.client.update(node)
+                    self.client.update(node)  # tpuop-lint: kinds=v1/Node
                 except errors.Conflict:
                     # node moved under us; the node watch re-triggers reconcile
                     log.debug("node %s label update conflicted", node["metadata"]["name"])

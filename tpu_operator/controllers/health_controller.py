@@ -365,7 +365,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                         continue
                     labels[consts.TPU_SLICE_HEALTH_LABEL] = want
                 try:
-                    self.client.update(member)
+                    self.client.update(member)  # tpuop-lint: kinds=v1/Node
                 except errors.Conflict:
                     pass
 

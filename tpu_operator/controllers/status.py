@@ -46,7 +46,7 @@ def publish_status(
     if status == before:
         return
     try:
-        client.update_status(obj)
+        client.update_status(obj)  # tpuop-lint: kinds=tpu.google.com/v1/ClusterPolicy,tpu.google.com/v1alpha1/TPUSlice
     except errors.Conflict:
         # next reconcile re-reads and re-publishes
         log.debug("status update conflicted for %s", obj["metadata"].get("name"))

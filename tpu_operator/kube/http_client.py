@@ -121,6 +121,31 @@ class _WatchSub(WatchSubscription):
 
 
 class HttpClient(Client):
+    # Canonical RBAC surface of the client: every public method that can
+    # reach the apiserver, mapped to the (verb, subresource) pairs it
+    # exercises on its target resource — subresource None is the resource
+    # itself, "status" appends /status, a value containing "/" pins the
+    # whole resource (pods/eviction). The static RBAC analyzer
+    # (tpu_operator.lint.rbac_static) and the runtime RBAC gate
+    # (tests/test_rbac_gate.py) BOTH consume this mapping and both assert
+    # it covers the whole Client interface, so a new client method that
+    # skips this table fails both gates instead of dodging them.
+    VERBS = {
+        "get": (("get", None),),
+        "get_or_none": (("get", None),),
+        "list": (("list", None),),
+        # an HTTP watch always (re-)LISTs to establish its snapshot
+        "watch": (("list", None), ("watch", None)),
+        "create": (("create", None),),
+        "update": (("update", None),),
+        "apply": (("get", None), ("create", None), ("update", None)),
+        "update_status": (("update", "status"),),
+        "delete": (("delete", None),),
+        "evict": (("create", "pods/eviction"),),
+        "pod_logs": (("get", "pods/log"),),
+        "server_version": (),  # /version is not a resource request
+    }
+
     def __init__(
         self,
         base_url: str,

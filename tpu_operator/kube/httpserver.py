@@ -97,6 +97,12 @@ class RbacAuthorizer:
     def __init__(self, rules):
         self.rules = rules or []
         self.denials: list = []  # (verb, group, resource) of every 403
+        # every authorization check seen, allowed or not, as
+        # (group, resource, verb): the observed over-the-wire verb set a
+        # flow actually exercised. tests/test_rbac_gate.py diffs this
+        # against the static analyzer's per-operand derivation so the
+        # runtime gate and tpuop-lint's RBAC pass can't rot apart.
+        self.checks: set = set()
 
     def allows(self, group: str, resource: str, verb: str) -> bool:
         for rule in self.rules:
@@ -118,6 +124,7 @@ class RbacAuthorizer:
         return False
 
     def check(self, group: str, resource: str, verb: str) -> None:
+        self.checks.add((group, resource, verb))
         if not self.allows(group, resource, verb):
             self.denials.append((verb, group, resource))
             raise errors.Forbidden(

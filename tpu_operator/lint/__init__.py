@@ -1,0 +1,31 @@
+"""tpuop-lint: commit-time static analysis over everything the operator
+ships.
+
+Three analyzers (see COMPONENTS.md §"lint subsystem" for the rule
+catalog):
+
+    manifest  every rendered operand state, the goldens, the chart
+              output, and the kustomize bases — security posture,
+              image pinning, label/reference integrity, scheduling
+              hygiene (lint/manifest_rules.py)
+    rbac      AST-extracted apiserver call sites per agent/controller
+              diffed against the shipped Roles/ClusterRoles — missing
+              grants fail at runtime as 403s, excess grants are
+              over-privilege (lint/rbac_static.py)
+    drift     shipped CRD YAML vs the dataclass-derived schemas, helm
+              crds/ vs kustomize crd/, goldens vs regeneration
+              (lint/drift.py)
+
+The motivating incident: a missing ``events`` grant that only surfaced
+at runtime via the RBAC-enforcing fake apiserver (TODO.md round 5) — a
+class of bug this suite catches at commit time instead.
+"""
+
+from tpu_operator.lint.findings import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    Baseline,
+    Finding,
+)
+from tpu_operator.lint.runner import run_lint  # noqa: F401

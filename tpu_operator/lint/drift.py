@@ -1,0 +1,226 @@
+"""Generated-artifact drift detection (TPUOP-D*).
+
+The repo ships the same truth through four materializations: the
+dataclass API model (the generator), the helm chart's ``crds/``, the
+kustomize ``crd/`` base, and the golden render snapshots. Every pair
+that can disagree silently is a production-skew risk, so each has a
+rule:
+
+    TPUOP-D001  shipped CRD schema vs the dataclass-derived schema,
+                diffed field-by-field (name/type/nesting) so a renamed
+                CRD field reports its exact JSONPath
+    TPUOP-D002  helm crds/ vs kustomize crd/ byte equality
+    TPUOP-D003  goldens vs a fresh render
+    TPUOP-D004  committed kustomize tree vs its generator
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import yaml
+
+from tpu_operator.lint.findings import ERROR, Finding, make
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+HELM_CRD_DIR = os.path.join(REPO_ROOT, "deploy", "helm", "tpu-operator", "crds")
+KUSTOMIZE_CRD_DIR = os.path.join(REPO_ROOT, "deploy", "kustomize", "crd")
+GOLDEN_DIR = os.path.join(REPO_ROOT, "tests", "golden")
+
+
+def _diff_tree(expected, shipped, path: str, out: List[str], depth: int = 0) -> None:
+    """Structural diff with JSONPath-style locations; recursion bounded
+    by schema nesting (CRD schemas are finite trees)."""
+    if isinstance(expected, dict) and isinstance(shipped, dict):
+        for key in expected:
+            if key not in shipped:
+                out.append(f"{path}.{key}: missing from shipped CRD")
+            else:
+                _diff_tree(expected[key], shipped[key], f"{path}.{key}", out, depth + 1)
+        for key in shipped:
+            if key not in expected:
+                out.append(f"{path}.{key}: present in shipped CRD but not in the model")
+        return
+    if isinstance(expected, list) and isinstance(shipped, list):
+        if len(expected) != len(shipped):
+            out.append(f"{path}: length {len(shipped)} != expected {len(expected)}")
+            return
+        for i, (e, s) in enumerate(zip(expected, shipped)):
+            _diff_tree(e, s, f"{path}[{i}]", out, depth + 1)
+        return
+    if expected != shipped:
+        out.append(f"{path}: shipped {shipped!r} != expected {expected!r}")
+
+
+def _load_crd_files(directory: str) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        if name == "kustomization.yaml":
+            continue
+        with open(os.path.join(directory, name)) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc and doc.get("kind") == "CustomResourceDefinition":
+                    out[doc["metadata"]["name"]] = doc
+    return out
+
+
+def crd_schema_drift(shipped_crds: Optional[Dict[str, dict]] = None) -> List[Finding]:
+    """D001: every shipped CRD (helm copy is the comparison source; D002
+    pins kustomize to it) against the dataclass-derived CRD, whole
+    object — names/scope/printer columns AND the openAPI schema, so a
+    renamed dataclass field or a hand-edited YAML property both report
+    the precise path."""
+    from tpu_operator.api.crds import all_crds
+
+    findings: List[Finding] = []
+    if shipped_crds is None:
+        shipped_crds = _load_crd_files(HELM_CRD_DIR)
+        if not shipped_crds:  # not in a full checkout (e.g. in-image)
+            return findings
+    expected = {crd["metadata"]["name"]: crd for crd in all_crds()}
+    for name, crd in expected.items():
+        if name not in shipped_crds:
+            findings.append(make(
+                "TPUOP-D001", ERROR, f"crd:{name}",
+                "CRD missing from shipped crds/ — run scripts/update_chart_crds.py",
+            ))
+            continue
+        diffs: List[str] = []
+        _diff_tree(crd, shipped_crds[name], "$", diffs)
+        for d in diffs[:20]:  # cap: one rename can cascade; keep it readable
+            findings.append(make(
+                "TPUOP-D001", ERROR, f"crd:{name}/{d.split(':', 1)[0]}",
+                f"schema drift vs the dataclass model: {d} "
+                "(run scripts/update_chart_crds.py)",
+            ))
+    for name in shipped_crds:
+        if name not in expected:
+            findings.append(make(
+                "TPUOP-D001", ERROR, f"crd:{name}",
+                "shipped CRD has no dataclass model — stale file?",
+            ))
+    return findings
+
+
+def helm_kustomize_crd_drift() -> List[Finding]:
+    """D002: the two shipped CRD copies must be byte-identical (both are
+    generated from the same model; any skew means one regeneration
+    script ran without the other)."""
+    findings: List[Finding] = []
+    if not (os.path.isdir(HELM_CRD_DIR) and os.path.isdir(KUSTOMIZE_CRD_DIR)):
+        return findings
+    helm = _load_crd_files(HELM_CRD_DIR)
+    kust = _load_crd_files(KUSTOMIZE_CRD_DIR)
+    for name in sorted(set(helm) | set(kust)):
+        if name not in helm or name not in kust:
+            findings.append(make(
+                "TPUOP-D002", ERROR, f"crd:{name}",
+                f"present in {'kustomize' if name not in helm else 'helm'} "
+                "crds only — regenerate both",
+            ))
+            continue
+        diffs: List[str] = []
+        _diff_tree(helm[name], kust[name], "$", diffs)
+        for d in diffs[:10]:
+            findings.append(make(
+                "TPUOP-D002", ERROR, f"crd:{name}/{d.split(':', 1)[0]}",
+                f"helm crds/ and kustomize crd/ disagree: {d}",
+            ))
+    return findings
+
+
+def golden_spec_catalog():
+    """The one InfoCatalog spec the golden snapshots are generated from
+    (scripts/update_golden.py): serviceMonitor enabled so the monitoring
+    objects render. Shared by golden_drift (what counts as 'fresh') and
+    the manifest-lint render (runner.manifest_groups) — two copies of
+    this spec drifting apart would make the two passes disagree."""
+    from tpu_operator.api import ClusterPolicy
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.catalog import InfoCatalog
+
+    cp = ClusterPolicy.from_unstructured(
+        new_cluster_policy(spec={"metricsExporter": {"serviceMonitor": {"enabled": True}}})
+    )
+    return InfoCatalog(cluster_policy=cp)
+
+
+def golden_drift() -> List[Finding]:
+    """D003: regenerate every state's golden render in-memory (the exact
+    spec scripts/update_golden.py uses) and compare to the committed
+    snapshots."""
+    from tpu_operator.states import new_cluster_policy_states
+
+    findings: List[Finding] = []
+    if not os.path.isdir(GOLDEN_DIR):
+        return findings
+    catalog = golden_spec_catalog()
+    for state in new_cluster_policy_states():
+        path = os.path.join(GOLDEN_DIR, f"{state.name}.yaml")
+        objs = state.renderer.render_objects(state.get_render_data(catalog))
+        fresh = yaml.safe_dump_all(objs, default_flow_style=False, sort_keys=False)
+        if not os.path.exists(path):
+            findings.append(make(
+                "TPUOP-D003", ERROR, f"golden:{state.name}",
+                "no golden snapshot — run scripts/update_golden.py",
+            ))
+            continue
+        with open(path) as f:
+            committed = f.read()
+        if committed != fresh:
+            committed_objs = list(yaml.safe_load_all(committed))
+            diffs: List[str] = []
+            _diff_tree(objs, committed_objs, "$", diffs)
+            detail = f" (first drift: {diffs[0]})" if diffs else ""
+            findings.append(make(
+                "TPUOP-D003", ERROR, f"golden:{state.name}",
+                f"golden snapshot stale{detail} — run scripts/update_golden.py",
+            ))
+    return findings
+
+
+def kustomize_drift() -> List[Finding]:
+    """D004: the committed kustomize tree must reproduce byte-for-byte
+    from its generator (same contract tests/test_kustomize.py enforces,
+    surfaced at commit time)."""
+    import importlib.util
+
+    findings: List[Finding] = []
+    gen_path = os.path.join(REPO_ROOT, "scripts", "update_kustomize.py")
+    kdir = os.path.join(REPO_ROOT, "deploy", "kustomize")
+    if not (os.path.exists(gen_path) and os.path.isdir(kdir)):
+        return findings
+    spec = importlib.util.spec_from_file_location("_tpuop_update_kustomize", gen_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for rel, text in sorted(mod.generate().items()):
+        path = os.path.join(kdir, rel)
+        if not os.path.exists(path):
+            findings.append(make(
+                "TPUOP-D004", ERROR, f"kustomize:{rel}",
+                "file missing — run scripts/update_kustomize.py",
+            ))
+            continue
+        with open(path) as f:
+            if f.read() != text:
+                findings.append(make(
+                    "TPUOP-D004", ERROR, f"kustomize:{rel}",
+                    "stale vs generator — run scripts/update_kustomize.py",
+                ))
+    return findings
+
+
+def analyze() -> List[Finding]:
+    return (
+        crd_schema_drift()
+        + helm_kustomize_crd_drift()
+        + golden_drift()
+        + kustomize_drift()
+    )

@@ -1,0 +1,221 @@
+"""Finding model + baseline (suppression) file handling.
+
+A finding is (rule id, severity, location, message). Locations are
+stable, source-independent keys — ``DaemonSet/tpu-device-plugin/ctr:x``
+rather than a file path — so the same logical defect seen through
+several render paths (state render, golden snapshot, chart output)
+deduplicates to one finding, and a baseline entry written against one
+path keeps suppressing it through all of them.
+
+Baseline format (``.tpuop-lint-baseline`` at the repo root), one entry
+per line:
+
+    RULE-ID  location-prefix  # one-line justification
+
+An entry suppresses every finding whose rule matches exactly and whose
+location starts with the given prefix. Unused entries are themselves
+reported (info) so the baseline can't accrete dead exceptions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    location: str
+    message: str
+    suppressed: bool = False
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "location": self.location,
+            "message": self.message,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    location_prefix: str
+    justification: str
+    lineno: int
+
+    def matches(self, finding: Finding) -> bool:
+        """Prefix match on a path boundary: 'vol:dev' must not swallow
+        'vol:device-plugins'."""
+        if finding.rule != self.rule:
+            return False
+        loc, prefix = finding.location, self.location_prefix
+        if loc == prefix:
+            return True
+        if not loc.startswith(prefix):
+            return False
+        return prefix.endswith(("/", ":")) or loc[len(prefix)] in "/:["
+
+
+class Baseline:
+    """Parsed suppression file."""
+
+    def __init__(self, entries: List[BaselineEntry], path: str = ""):
+        self.entries = entries
+        self.path = path
+        self._hits: Dict[BaselineEntry, int] = {e: 0 for e in entries}
+
+    @classmethod
+    def from_text(cls, text: str, path: str = "") -> "Baseline":
+        entries: List[BaselineEntry] = []
+        for lineno, raw in enumerate(text.splitlines(), start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, justification = line.partition("#")
+            parts = body.split()
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{path or 'baseline'}:{lineno}: expected "
+                    f"'RULE location-prefix  # justification', got {raw!r}"
+                )
+            entries.append(
+                BaselineEntry(
+                    rule=parts[0],
+                    location_prefix=parts[1],
+                    justification=justification.strip(),
+                    lineno=lineno,
+                )
+            )
+        return cls(entries, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path) as f:
+                return cls.from_text(f.read(), path)
+        except FileNotFoundError:
+            return cls([], path)
+
+    def apply(self, findings: List[Finding]) -> List[Finding]:
+        """Mark suppressed findings; suppression is recorded (not
+        dropped) so reports can show what the baseline is absorbing."""
+        out: List[Finding] = []
+        for f in findings:
+            entry = next((e for e in self.entries if e.matches(f)), None)
+            if entry is not None:
+                self._hits[entry] += 1
+                f = dataclasses.replace(f, suppressed=True)
+            out.append(f)
+        return out
+
+    def unused_entries(self) -> List[BaselineEntry]:
+        return [e for e, hits in self._hits.items() if hits == 0]
+
+
+def dedupe(findings: List[Finding]) -> List[Finding]:
+    """Collapse identical findings reported through multiple render
+    paths (state render vs golden vs chart), keeping first occurrence
+    order within severity rank."""
+    seen: set = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.location, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    return out
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(
+        findings,
+        key=lambda f: (_SEVERITY_ORDER.get(f.severity, 99), f.rule, f.location),
+    )
+
+
+def summarize(findings: List[Finding]) -> Dict[str, int]:
+    counts = {ERROR: 0, WARNING: 0, INFO: 0, "suppressed": 0}
+    for f in findings:
+        if f.suppressed:
+            counts["suppressed"] += 1
+        else:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def failing(findings: List[Finding]) -> List[Finding]:
+    """The findings that make the lint gate exit nonzero."""
+    return [f for f in findings if f.severity == ERROR and not f.suppressed]
+
+
+def render_text(findings: List[Finding], show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for f in sort_findings(findings):
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = "suppressed" if f.suppressed else f.severity
+        lines.append(f"{tag:10s} {f.rule}  {f.location}: {f.message}")
+    counts = summarize(findings)
+    lines.append(
+        f"tpuop-lint: {counts[ERROR]} error(s), {counts[WARNING]} warning(s), "
+        f"{counts[INFO]} info, {counts['suppressed']} suppressed"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: List[Finding]) -> str:
+    return (
+        json.dumps(
+            {
+                "findings": [f.to_dict() for f in sort_findings(findings)],
+                "summary": summarize(findings),
+            },
+            indent=2,
+            sort_keys=False,
+        )
+        + "\n"
+    )
+
+
+def make(rule: str, severity: str, location: str, message: str) -> Finding:
+    return Finding(rule=rule, severity=severity, location=location, message=message)
+
+
+# Rule catalog: id -> (default severity, one-line description). The CLI's
+# --rules output and COMPONENTS.md both derive from this table.
+RULES: Dict[str, Tuple[str, str]] = {
+    "TPUOP-M001": (ERROR, "privileged container (baseline must document why)"),
+    "TPUOP-M002": (ERROR, "hostPath volume (baseline must document why)"),
+    "TPUOP-M003": (ERROR, "image tag unpinned (:latest or missing tag)"),
+    "TPUOP-M004": (ERROR, "DaemonSet selector does not match template labels"),
+    "TPUOP-M005": (ERROR, "referenced ServiceAccount not defined in the same state"),
+    "TPUOP-M006": (ERROR, "referenced ConfigMap not defined in the same state"),
+    "TPUOP-M007": (WARNING, "long-running container defines no liveness/readiness probe"),
+    "TPUOP-M008": (ERROR, "long-running container requests no resources"),
+    "TPUOP-M009": (ERROR, "TPU node agent missing the TPU-resource taint toleration"),
+    "TPUOP-R001": (ERROR, "RBAC missing grant: the code needs a verb no shipped rule covers"),
+    "TPUOP-R002": (ERROR, "RBAC excess grant: shipped verb no code path needs"),
+    "TPUOP-R003": (ERROR, "unknown RBAC verb (not a Kubernetes authorization verb)"),
+    "TPUOP-R004": (ERROR, "cluster-scoped resource granted by a namespaced Role (grants nothing)"),
+    "TPUOP-R005": (WARNING, "client call site with unresolvable kind (add a tpuop-lint pragma)"),
+    "TPUOP-D001": (ERROR, "shipped CRD schema drifted from the dataclass model"),
+    "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
+    "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),
+    "TPUOP-D004": (ERROR, "kustomize tree stale (run scripts/update_kustomize.py)"),
+    "TPUOP-B001": (INFO, "baseline entry matched nothing — delete it"),
+}

@@ -1,0 +1,218 @@
+"""Manifest lint rules (TPUOP-M*/R003/R004).
+
+Input is a *group* of already-rendered objects — one operand state, the
+whole chart output, or one kustomize base. Cross-reference rules (the
+ServiceAccount/ConfigMap checks) are scoped to the group, mirroring how
+the objects land on a cluster: a state's DaemonSet referencing a
+ServiceAccount some *other* state ships works only by accident of
+install order.
+
+Locations are source-independent (``Kind/name[/detail]``) so a defect
+seen through several render paths deduplicates — see findings.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.lint.findings import ERROR, WARNING, Finding, make
+
+# Kubernetes authorization verbs (kubectl api-resources -o wide + RBAC
+# special verbs). Anything else in a PolicyRule silently grants nothing.
+KNOWN_RBAC_VERBS = {
+    "get", "list", "watch", "create", "update", "patch", "delete",
+    "deletecollection", "bind", "escalate", "impersonate", "use",
+    "approve", "sign", "*",
+}
+
+# Cluster-scoped resources this operator's manifests could plausibly
+# name. A namespaced Role granting one of these is dead weight: RBAC
+# only matches namespaced requests against Roles, so the grant can never
+# authorize anything (kube's authorizer semantics).
+CLUSTER_SCOPED_RESOURCES = {
+    "nodes", "namespaces", "persistentvolumes", "clusterroles",
+    "clusterrolebindings", "priorityclasses", "storageclasses",
+    "validatingwebhookconfigurations", "mutatingwebhookconfigurations",
+    "customresourcedefinitions", "clusterpolicies", "tpuslices",
+    "apiservices", "certificatesigningrequests",
+}
+
+_POD_TEMPLATE_KINDS = ("DaemonSet", "Deployment", "StatefulSet", "Job")
+
+
+def _obj_loc(obj: dict) -> str:
+    return f"{obj.get('kind', '?')}/{(obj.get('metadata') or {}).get('name', '?')}"
+
+
+def _pod_spec(obj: dict) -> Optional[dict]:
+    kind = obj.get("kind")
+    if kind in _POD_TEMPLATE_KINDS:
+        return ((obj.get("spec") or {}).get("template") or {}).get("spec")
+    if kind == "Pod":
+        return obj.get("spec")
+    return None
+
+
+def _containers(pod_spec: dict, include_init: bool = True) -> Iterable[Tuple[str, dict]]:
+    for ctr in pod_spec.get("containers") or []:
+        yield ("ctr", ctr)
+    if include_init:
+        for ctr in pod_spec.get("initContainers") or []:
+            yield ("init", ctr)
+
+
+def _image_pinned(image: str) -> bool:
+    """Pinned means an explicit non-latest tag or a digest. The tag
+    separator must come after the last '/', or a registry port
+    (host:5000/img) would read as a tag."""
+    if "@sha256:" in image:
+        return True
+    tail = image.rsplit("/", 1)[-1]
+    _, sep, tag = tail.partition(":")
+    return bool(sep) and tag not in ("", "latest")
+
+
+def lint_group(group: str, objects: List[dict]) -> List[Finding]:
+    """All manifest rules over one group of rendered objects."""
+    findings: List[Finding] = []
+    sa_names = {
+        (o.get("metadata") or {}).get("name")
+        for o in objects
+        if o.get("kind") == "ServiceAccount"
+    }
+    cm_names = {
+        (o.get("metadata") or {}).get("name")
+        for o in objects
+        if o.get("kind") == "ConfigMap"
+    }
+
+    for obj in objects:
+        loc = _obj_loc(obj)
+        kind = obj.get("kind")
+
+        # -- RBAC shape rules (R003/R004) -----------------------------------
+        if kind in ("Role", "ClusterRole"):
+            for i, rule in enumerate(obj.get("rules") or []):
+                for verb in rule.get("verbs") or []:
+                    if verb not in KNOWN_RBAC_VERBS:
+                        findings.append(make(
+                            "TPUOP-R003", ERROR, f"{loc}/rules[{i}]",
+                            f"verb {verb!r} is not a Kubernetes authorization "
+                            "verb — this grant is silently dead",
+                        ))
+                if kind == "Role":
+                    for res in rule.get("resources") or []:
+                        base = res.split("/", 1)[0]
+                        if base in CLUSTER_SCOPED_RESOURCES:
+                            findings.append(make(
+                                "TPUOP-R004", ERROR, f"{loc}/rules[{i}]",
+                                f"cluster-scoped resource {res!r} in a namespaced "
+                                "Role grants nothing — move it to a ClusterRole "
+                                "or drop it",
+                            ))
+
+        # -- DaemonSet selector/template consistency (M004) ----------------
+        if kind in ("DaemonSet", "Deployment", "StatefulSet"):
+            spec = obj.get("spec") or {}
+            match = ((spec.get("selector") or {}).get("matchLabels")) or {}
+            tmpl_labels = (
+                ((spec.get("template") or {}).get("metadata") or {}).get("labels")
+            ) or {}
+            for k, v in match.items():
+                if tmpl_labels.get(k) != v:
+                    findings.append(make(
+                        "TPUOP-M004", ERROR, loc,
+                        f"selector {k}={v} not satisfied by template labels "
+                        f"{tmpl_labels} — the controller would orphan its pods",
+                    ))
+
+        pod_spec = _pod_spec(obj)
+        if pod_spec is None:
+            continue
+        long_running = kind in ("DaemonSet", "Deployment", "StatefulSet")
+
+        # -- ServiceAccount reference (M005) -------------------------------
+        sa = pod_spec.get("serviceAccountName")
+        if sa and sa not in sa_names:
+            findings.append(make(
+                "TPUOP-M005", ERROR, loc,
+                f"serviceAccountName {sa!r} is not defined in group "
+                f"{group!r} — pods fail to schedule on a fresh install",
+            ))
+
+        # -- ConfigMap references (M006) -----------------------------------
+        for vol in pod_spec.get("volumes") or []:
+            cm_ref = (vol.get("configMap") or {}).get("name")
+            if cm_ref and cm_ref not in cm_names:
+                findings.append(make(
+                    "TPUOP-M006", ERROR, f"{loc}/vol:{vol.get('name', '?')}",
+                    f"configMap volume references {cm_ref!r}, not defined in "
+                    f"group {group!r}",
+                ))
+
+        # -- hostPath volumes (M002) ---------------------------------------
+        for vol in pod_spec.get("volumes") or []:
+            if "hostPath" in vol:
+                findings.append(make(
+                    "TPUOP-M002", ERROR, f"{loc}/vol:{vol.get('name', '?')}",
+                    f"hostPath mount of {vol['hostPath'].get('path', '?')!r} — "
+                    "node filesystem access must be individually justified",
+                ))
+
+        # -- TPU-taint toleration on node agents (M009) --------------------
+        node_selector = pod_spec.get("nodeSelector") or {}
+        targets_tpu_nodes = any(
+            k.startswith(consts.COMMON_DEPLOY_LABEL_PREFIX)
+            or k == consts.TPU_PRESENT_LABEL
+            for k in node_selector
+        )
+        if kind == "DaemonSet" and targets_tpu_nodes:
+            tolerations = pod_spec.get("tolerations") or []
+            tolerated = any(
+                t.get("key") == consts.TPU_RESOURCE_NAME
+                or (t.get("operator") == "Exists" and not t.get("key"))
+                for t in tolerations
+            )
+            if not tolerated:
+                findings.append(make(
+                    "TPUOP-M009", ERROR, loc,
+                    f"targets TPU nodes but does not tolerate the "
+                    f"{consts.TPU_RESOURCE_NAME} taint — the agent never "
+                    "schedules on the nodes it exists to manage",
+                ))
+
+        # -- per-container rules -------------------------------------------
+        for role, ctr in _containers(pod_spec):
+            cname = ctr.get("name", "?")
+            cloc = f"{loc}/{role}:{cname}"
+            image = ctr.get("image", "")
+            if image and not _image_pinned(image):
+                findings.append(make(
+                    "TPUOP-M003", ERROR, cloc,
+                    f"image {image!r} is not pinned to a tag or digest — "
+                    "deploys become unreproducible",
+                ))
+            if (ctr.get("securityContext") or {}).get("privileged"):
+                findings.append(make(
+                    "TPUOP-M001", ERROR, cloc,
+                    "privileged container — device access must be "
+                    "individually justified",
+                ))
+            if role == "ctr" and long_running:
+                if not ctr.get("resources", {}).get("requests"):
+                    findings.append(make(
+                        "TPUOP-M008", ERROR, cloc,
+                        "no resource requests — the scheduler treats this "
+                        "system-critical pod as weightless",
+                    ))
+                if not any(
+                    ctr.get(p)
+                    for p in ("livenessProbe", "readinessProbe", "startupProbe")
+                ):
+                    findings.append(make(
+                        "TPUOP-M007", WARNING, cloc,
+                        "no liveness/readiness/startup probe — a wedged "
+                        "process keeps reading Ready forever",
+                    ))
+    return findings

@@ -1,0 +1,656 @@
+"""Static RBAC least-privilege analysis (TPUOP-R001/R002/R005).
+
+Walks the package's AST for every Kubernetes API call site (the
+``HttpClient.VERBS`` surface plus ``informer_for``), attributes each
+site to the subject that executes it at runtime — one of the operand
+agents (each runs under its own state's ServiceAccount) or the operator
+controller-manager — and diffs the derived per-subject verb sets
+against the shipped Roles/ClusterRoles:
+
+    missing grant  code needs a verb no shipped rule covers → 403 in
+                   production (TPUOP-R001, error)
+    excess grant   shipped verb no reachable code path needs →
+                   over-privilege (TPUOP-R002, error; intentional
+                   exceptions go in .tpuop-lint-baseline)
+
+Attribution is a reachable-module closure: a subject owns its root
+modules plus everything they (transitively) import inside the package,
+minus transport/infra modules and modules rooted by another subject.
+That is what makes shared helpers come out right — e.g.
+``kube/events.py`` is imported by both the health agent and the
+operator's condition manager, so its Event verbs land in both subjects'
+required sets.
+
+Call sites whose kind isn't statically resolvable (object-valued
+``create(obj)`` where ``obj`` flows in from elsewhere, loops over kind
+tables) carry a pragma comment on the call line:
+
+    # tpuop-lint: kinds=v1/Service,v1/ConfigMap
+    # tpuop-lint: kinds=state-owned     (every kind the state engine manages)
+    # tpuop-lint: ignore                (not a live call site)
+
+Unpragma'd unresolvable sites surface as TPUOP-R005 findings so new
+dynamic call sites can't silently widen the blind spot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpu_operator.kube.http_client import HttpClient, plural_of
+from tpu_operator.kube.objects import api_group
+from tpu_operator.lint.findings import ERROR, WARNING, Finding, make
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_NAME = "tpu_operator"
+
+# A Grant is (apiGroup, resource, verb) with subresources spelled out
+# ("" group for core, resource like "nodes/status").
+Grant = Tuple[str, str, str]
+
+# informer_for(api_version, kind) is the manager-side watch entrypoint;
+# the dynamic client.watch it drives lives in kube/informer.py (excluded
+# as infra), so the literal informer_for sites are where list+watch
+# attribution belongs.
+EXTRA_METHODS = {"informer_for": (("list", None), ("watch", None))}
+
+# Subject -> root modules (paths relative to the package root; a
+# trailing "/" roots a whole directory). Operand agents run under their
+# state's ServiceAccount; everything controller-side runs under the
+# operator's ClusterRole. validator/metrics.py is rooted separately
+# because COMPONENT=metrics is the node-status-exporter DaemonSet's
+# entrypoint — it executes under that state's ServiceAccount, not the
+# validator's.
+SUBJECT_ROOTS: Dict[str, Sequence[str]] = {
+    "state-node-discovery": ("agents/node_discovery_agent.py",),
+    "state-tpu-feature-discovery": ("agents/tfd_agent.py",),
+    "state-device-plugin": ("agents/device_plugin_agent.py",),
+    "state-slice-manager": ("agents/slice_manager_agent.py",),
+    "state-health-monitor": ("agents/health_monitor_agent.py",),
+    "state-metrics-exporter": ("agents/metrics_exporter_agent.py",),
+    "state-libtpu": ("agents/libtpu_installer.py",),
+    "state-node-status-exporter": ("validator/metrics.py",),
+    "state-operator-validation": (
+        "validator/main.py",
+        "validator/status.py",
+        "validator/workload_entry.py",
+    ),
+    "operator": (
+        "cmd/main.py",
+        "controllers/",
+        "state/",
+        "states/",
+        "upgrade/",
+        "kube/manager.py",
+        "kube/leader.py",
+        "kube/controller.py",
+        "certs.py",
+        "webhook.py",
+        "catalog.py",
+        "clusterinfo.py",
+        "nodepool.py",
+    ),
+}
+
+# Transport, test doubles, and delegating wrappers: their internal
+# dynamic calls are accounted at the *caller* via HttpClient.VERBS
+# (e.g. Client.apply -> get+create+update), or they never run in a pod.
+EXCLUDED_MODULES = (
+    "kube/http_client.py",
+    "kube/client.py",
+    "kube/objects.py",
+    "kube/errors.py",
+    "kube/queue.py",
+    "kube/fake.py",
+    "kube/httpserver.py",
+    "kube/sim.py",
+    "kube/cached.py",
+    "kube/informer.py",
+    "cmd/tpuop_cfg.py",
+    "cmd/tpuop_lint.py",
+    "mustgather.py",
+    "lint/",
+    "workloads/",
+    "native/",
+    "agents/dpapi/",
+)
+
+
+def state_owned_kinds() -> List[Tuple[str, str]]:
+    """Every (apiVersion, kind) the state engine may create/update/
+    delete: the skeleton's own delete list plus the pod-bearing renders
+    (the TPUSlice gang worker Pods ride the same apply path)."""
+    from tpu_operator.state.skel import StateSkel
+
+    kinds = list(StateSkel("_probe", [PKG_ROOT]).owned_kinds())
+    if ("v1", "Pod") not in kinds:
+        kinds.append(("v1", "Pod"))
+    return kinds
+
+
+@dataclasses.dataclass
+class CallSite:
+    module: str  # package-relative path
+    lineno: int
+    method: str
+    grants: Optional[Set[Grant]]  # None = unresolvable
+
+
+# ---------------------------------------------------------------------------
+# Module discovery + import graph.
+# ---------------------------------------------------------------------------
+
+
+_MODULE_CACHE: Optional[List[str]] = None
+
+
+def _iter_modules() -> List[str]:
+    global _MODULE_CACHE
+    if _MODULE_CACHE is None:
+        out = []
+        for root, _, names in os.walk(PKG_ROOT):
+            for name in names:
+                if not name.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(root, name), PKG_ROOT)
+                out.append(rel.replace(os.sep, "/"))
+        _MODULE_CACHE = sorted(out)
+    return _MODULE_CACHE
+
+
+def _excluded(rel: str) -> bool:
+    return any(
+        rel == pat or (pat.endswith("/") and rel.startswith(pat))
+        for pat in EXCLUDED_MODULES
+    )
+
+
+def _module_name_to_rel(dotted: str) -> Optional[str]:
+    """tpu_operator.kube.events -> kube/events.py (or kube/__init__.py
+    for package imports); None for out-of-package modules."""
+    if not dotted.startswith(PKG_NAME):
+        return None
+    tail = dotted[len(PKG_NAME):].lstrip(".")
+    rel = tail.replace(".", "/")
+    for candidate in (f"{rel}.py", f"{rel}/__init__.py", "__init__.py" if not rel else None):
+        if candidate and os.path.exists(os.path.join(PKG_ROOT, candidate)):
+            return candidate
+    return None
+
+
+def _imports_of(tree: ast.AST) -> List[str]:
+    """Package-internal imports (any nesting level — agents import
+    helpers lazily inside functions)."""
+    found: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                rel = _module_name_to_rel(alias.name)
+                if rel:
+                    found.append(rel)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            rel = _module_name_to_rel(node.module)
+            if rel:
+                found.append(rel)
+            # "from tpu_operator.api import clusterpolicy" imports a module
+            for alias in node.names:
+                sub = _module_name_to_rel(f"{node.module}.{alias.name}")
+                if sub:
+                    found.append(sub)
+    return found
+
+
+# ---------------------------------------------------------------------------
+# Constant + kind resolution.
+# ---------------------------------------------------------------------------
+
+
+class _ModuleScope:
+    """Resolves Name/Attribute nodes to string constants: module-level
+    literal assignments, plus imported names looked up by importing the
+    source module (safe here — every package module is importable)."""
+
+    def __init__(self, tree: ast.Module):
+        self.literals: Dict[str, str] = {}
+        self.imported: Dict[str, Tuple[str, str]] = {}  # local -> (module, attr)
+        self.modules: Dict[str, str] = {}  # local alias -> dotted module
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                if isinstance(node.value.value, str):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.literals[tgt.id] = node.value.value
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imported[local] = (node.module, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.modules[local] = alias.name
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in self.literals:
+                return self.literals[node.id]
+            if node.id in self.imported:
+                mod, attr = self.imported[node.id]
+                return self._getattr_str(mod, attr)
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base in self.modules:
+                return self._getattr_str(self.modules[base], node.attr)
+            if base in self.imported:
+                mod, attr = self.imported[base]
+                return self._getattr_str(f"{mod}.{attr}", node.attr)
+        return None
+
+    @staticmethod
+    def _getattr_str(module: str, attr: str) -> Optional[str]:
+        try:
+            value = getattr(importlib.import_module(module), attr, None)
+        except ImportError:
+            return None
+        return value if isinstance(value, str) else None
+
+
+def _kind_from_obj_expr(node: ast.AST, scope: _ModuleScope, assigns: Dict[str, Tuple[str, str]]):
+    """Best-effort (api_version, kind) of an object-valued expression:
+    a variable previously bound to a typed fetch, a new_object(...)
+    call, a dict literal with apiVersion/kind, or `x or y` fallbacks."""
+    if isinstance(node, ast.Name):
+        return assigns.get(node.id)
+    if isinstance(node, ast.BoolOp):
+        for v in node.values:
+            got = _kind_from_obj_expr(v, scope, assigns)
+            if got:
+                return got
+        return None
+    if isinstance(node, ast.Call):
+        func = node.func
+        fname = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else ""
+        )
+        if fname == "new_object" and len(node.args) >= 2:
+            av = scope.resolve_str(node.args[0])
+            kd = scope.resolve_str(node.args[1])
+            if av and kd:
+                return (av, kd)
+        if fname in ("get", "get_or_none", "list") and len(node.args) >= 2:
+            av = scope.resolve_str(node.args[0])
+            kd = scope.resolve_str(node.args[1])
+            if av and kd:
+                return (av, kd)
+        # unwrap single-arg decorators like self._own(svc)
+        if len(node.args) == 1:
+            return _kind_from_obj_expr(node.args[0], scope, assigns)
+    if isinstance(node, ast.Dict):
+        av = kd = None
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant):
+                if k.value == "apiVersion":
+                    av = scope.resolve_str(v)
+                elif k.value == "kind":
+                    kd = scope.resolve_str(v)
+        if av and kd:
+            return (av, kd)
+    return None
+
+
+def _function_assigns(fn: ast.AST, scope: _ModuleScope) -> Dict[str, Tuple[str, str]]:
+    assigns: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                got = _kind_from_obj_expr(node.value, scope, assigns)
+                if got:
+                    assigns[tgt.id] = got
+        elif isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            # `for pod in client.list("v1", "Pod", ...)` binds the kind
+            got = _kind_from_obj_expr(node.iter, scope, assigns)
+            if got:
+                assigns[node.target.id] = got
+    return assigns
+
+
+# ---------------------------------------------------------------------------
+# Call-site extraction.
+# ---------------------------------------------------------------------------
+
+
+def _pragma(source_lines: List[str], lineno: int) -> Optional[str]:
+    if 1 <= lineno <= len(source_lines):
+        line = source_lines[lineno - 1]
+        if "# tpuop-lint:" in line:
+            return line.split("# tpuop-lint:", 1)[1].strip()
+    return None
+
+
+def _grants_for(api_version: str, kind: str, verb_pairs) -> Set[Grant]:
+    group = api_group(api_version)
+    resource = plural_of(kind)
+    grants: Set[Grant] = set()
+    for verb, sub in verb_pairs:
+        if sub is None:
+            grants.add((group, resource, verb))
+        elif "/" in sub:  # fixed resource like pods/eviction
+            grants.add(("", sub, verb))
+        else:  # subresource of the target, e.g. status
+            grants.add((group, f"{resource}/{sub}", verb))
+    return grants
+
+
+def _receiver(func: ast.Attribute) -> str:
+    try:
+        return ast.unparse(func.value)
+    except Exception:  # pragma: no cover - unparse is total on valid ASTs
+        return ""
+
+
+_OBJ_METHODS = {"create", "update", "apply", "update_status"}
+_TYPED_METHODS = {"get", "get_or_none", "list", "delete", "watch", "informer_for"}
+# "v1", "apps/v1", "rbac.authorization.k8s.io/v1", "tpu.google.com/v1alpha1"
+_API_VERSION_RE = re.compile(r"^(v\d+[a-z0-9]*|[a-z0-9.\-]+/v\d+[a-z0-9]*)$")
+_ANY_RECEIVER = {
+    "get_or_none", "update_status", "evict", "pod_logs",
+    "server_version", "apply", "informer_for",
+}
+
+
+def extract_module_sites(rel: str) -> List[CallSite]:
+    path = os.path.join(PKG_ROOT, rel)
+    with open(path) as f:
+        source = f.read()
+    tree = ast.parse(source)
+    scope = _ModuleScope(tree)
+    lines = source.splitlines()
+    verb_table = dict(HttpClient.VERBS)
+    verb_table.update(EXTRA_METHODS)
+
+    sites: List[CallSite] = []
+    # enclosing-function assignment maps, computed lazily per function
+    functions = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+    def enclosing_assigns(call: ast.Call) -> Dict[str, Tuple[str, str]]:
+        best = None
+        for fn in functions:
+            if fn.lineno <= call.lineno <= (fn.end_lineno or fn.lineno):
+                if best is None or fn.lineno >= best.lineno:
+                    best = fn
+        return _function_assigns(best, scope) if best is not None else {}
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+            continue
+        method = node.func.attr
+        if method not in verb_table:
+            continue
+        pragma = _pragma(lines, node.lineno)
+        if pragma == "ignore":
+            continue
+        recv = _receiver(node.func)
+        if pragma is None and method not in _ANY_RECEIVER and not recv.endswith("client"):
+            # The receiver doesn't look like a client. For the typed
+            # methods, a first argument resolving to an apiVersion-shaped
+            # string is decisive evidence anyway (`c = self.client;
+            # c.list("v1", "Pod")` must not slip through just because the
+            # variable was renamed) — dict.get/list callers never pass
+            # one. update/create on a renamed receiver remains out of
+            # reach for pure AST analysis; the runtime cross-check
+            # (TestStaticRuntimeConsistency) is the backstop there.
+            if method in _TYPED_METHODS and len(node.args) >= 2:
+                first = scope.resolve_str(node.args[0])
+                if first is None or not _API_VERSION_RE.match(first):
+                    continue
+            else:
+                continue  # dict.get / dict.update / unrelated receivers
+        verb_pairs = verb_table[method]
+        if not verb_pairs:
+            continue  # server_version
+
+        grants: Optional[Set[Grant]] = None
+        if pragma and pragma.startswith("kinds="):
+            spec = pragma[len("kinds="):]
+            grants = set()
+            if spec == "state-owned":
+                for av, kd in state_owned_kinds():
+                    grants |= _grants_for(av, kd, verb_pairs)
+            else:
+                for pair in spec.split(","):
+                    av, _, kd = pair.strip().rpartition("/")
+                    grants |= _grants_for(av, kd, verb_pairs)
+        elif method in ("evict", "pod_logs"):
+            grants = _grants_for("v1", "Pod", verb_pairs)
+        elif method in _TYPED_METHODS:
+            if len(node.args) >= 2:
+                av = scope.resolve_str(node.args[0])
+                kd = scope.resolve_str(node.args[1])
+                if av and kd:
+                    grants = _grants_for(av, kd, verb_pairs)
+        elif method in _OBJ_METHODS and node.args:
+            got = _kind_from_obj_expr(node.args[0], scope, enclosing_assigns(node))
+            if got:
+                grants = _grants_for(got[0], got[1], verb_pairs)
+        sites.append(CallSite(module=rel, lineno=node.lineno, method=method, grants=grants))
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# Subject attribution.
+# ---------------------------------------------------------------------------
+
+
+def _roots_for(subject: str) -> List[str]:
+    out: List[str] = []
+    for root in SUBJECT_ROOTS[subject]:
+        if root.endswith("/"):
+            out.extend(
+                rel for rel in _iter_modules()
+                if rel.startswith(root) and not _excluded(rel)
+            )
+        else:
+            out.append(root)
+    return out
+
+
+def _foreign_roots(subject: str) -> Set[str]:
+    taken: Set[str] = set()
+    for other, _ in SUBJECT_ROOTS.items():
+        if other == subject:
+            continue
+        taken.update(_roots_for(other))
+    return taken
+
+
+def subject_modules(subject: str) -> List[str]:
+    """Reachable-module closure for one subject (see module docstring)."""
+    own = set(_roots_for(subject))
+    foreign = _foreign_roots(subject) - own
+    seen: Set[str] = set()
+    queue = [r for r in own if not _excluded(r)]
+    while queue:
+        rel = queue.pop()
+        if rel in seen or _excluded(rel) or rel in foreign:
+            continue
+        seen.add(rel)
+        path = os.path.join(PKG_ROOT, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        queue.extend(_imports_of(tree))
+    return sorted(seen)
+
+
+def _cached_read_kinds() -> Set[Tuple[str, str]]:
+    """Resources whose operator-side reads ride CachedReadClient (the
+    reconcilers wrap their client in setup_with_manager): a cached read
+    cold-starts an informer, so a plain get/list becomes list+watch on
+    the wire. Reads outside the reconcilers (cert manager Secrets,
+    leader-election Leases, event-recorder Events, webhook CR lists) use
+    the raw client and stay as written."""
+    kinds = set(state_owned_kinds())
+    kinds.update({("v1", "Node"), ("v1", "Namespace"), ("apps/v1", "DaemonSet")})
+    return {(api_group(av), plural_of(kd)) for av, kd in kinds}
+
+
+def _expand_cached_reads(grants: Set[Grant]) -> Set[Grant]:
+    cached = _cached_read_kinds()
+    out = set(grants)
+    for group, resource, verb in grants:
+        if verb in ("get", "list") and "/" not in resource and (group, resource) in cached:
+            out.add((group, resource, "list"))
+            out.add((group, resource, "watch"))
+    return out
+
+
+def required_grants() -> Tuple[Dict[str, Set[Grant]], List[Finding]]:
+    """Per-subject statically-required grants + R005 findings for
+    unresolvable call sites."""
+    findings: List[Finding] = []
+    site_cache: Dict[str, List[CallSite]] = {}
+    required: Dict[str, Set[Grant]] = {}
+    unresolved_reported: Set[Tuple[str, int]] = set()
+    for subject in SUBJECT_ROOTS:
+        grants: Set[Grant] = set()
+        for rel in subject_modules(subject):
+            if rel not in site_cache:
+                site_cache[rel] = extract_module_sites(rel)
+            for site in site_cache[rel]:
+                if site.grants is None:
+                    key = (site.module, site.lineno)
+                    if key not in unresolved_reported:
+                        unresolved_reported.add(key)
+                        findings.append(make(
+                            "TPUOP-R005", WARNING,
+                            f"{site.module}:{site.lineno}",
+                            f"cannot resolve the kind of client.{site.method}() "
+                            "— add '# tpuop-lint: kinds=...' on the call line",
+                        ))
+                    continue
+                grants |= site.grants
+        if subject == "operator":
+            grants = _expand_cached_reads(grants)
+        required[subject] = grants
+    return required, findings
+
+
+# ---------------------------------------------------------------------------
+# Shipped-rules diff.
+# ---------------------------------------------------------------------------
+
+
+def _fmt_resource(group: str, resource: str) -> str:
+    return resource if not group else f"{resource}.{group}"
+
+
+def diff_subject(subject: str, required: Set[Grant], rules: List[dict]) -> List[Finding]:
+    """Missing/excess grants for one subject against its shipped rules."""
+    from tpu_operator.kube.httpserver import RbacAuthorizer
+
+    findings: List[Finding] = []
+    auth = RbacAuthorizer(rules)
+    for group, resource, verb in sorted(required):
+        if not auth.allows(group, resource, verb):
+            findings.append(make(
+                "TPUOP-R001", ERROR,
+                f"rbac:{subject}/{_fmt_resource(group, resource)}/{verb}",
+                f"{subject} needs {verb!r} on {_fmt_resource(group, resource)} "
+                "but no shipped rule grants it — this 403s in production",
+            ))
+    for i, rule in enumerate(rules):
+        groups = rule.get("apiGroups") or []
+        resources = rule.get("resources") or []
+        verbs = rule.get("verbs") or []
+        if "*" in groups or "*" in resources or "*" in verbs:
+            # wildcards are un-enumerable; the manifest rules forbid the
+            # bogus ones, and a wildcard this operator ships would itself
+            # be a review flag
+            continue
+        for group in groups:
+            for resource in resources:
+                sub = resource.split("/", 1)[1] if "/" in resource else None
+                for verb in verbs:
+                    grant = (group, resource, verb)
+                    covered = grant in required
+                    if not covered and sub and "/" in resource:
+                        # "*/sub"-style shipped rules match any parent
+                        covered = any(
+                            r.endswith(f"/{sub}") and v == verb and g == group
+                            for g, r, v in required
+                        )
+                    if not covered:
+                        findings.append(make(
+                            "TPUOP-R002", ERROR,
+                            f"rbac:{subject}/{_fmt_resource(group, resource)}/{verb}",
+                            f"shipped rules grant {subject} {verb!r} on "
+                            f"{_fmt_resource(group, resource)} but no reachable "
+                            "code path needs it — trim or baseline",
+                        ))
+    return findings
+
+
+def shipped_subject_rules() -> Dict[str, List[dict]]:
+    """Shipped rules per subject: the chart's operator ClusterRole, and
+    each state's Role+ClusterRole union (the single-namespace collapse
+    the runtime gate also applies)."""
+    import yaml
+
+    from tpu_operator.api import ClusterPolicy
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.catalog import InfoCatalog
+    from tpu_operator.chart import render_chart
+    from tpu_operator.states import new_cluster_policy_states
+
+    repo = os.path.dirname(PKG_ROOT)
+    with open(os.path.join(repo, "deploy", "values.yaml")) as f:
+        chart_objs = render_chart(yaml.safe_load(f))
+    out: Dict[str, List[dict]] = {}
+    (operator_role,) = [o for o in chart_objs if o["kind"] == "ClusterRole"]
+    out["operator"] = operator_role["rules"]
+
+    cp = ClusterPolicy.from_unstructured(new_cluster_policy())
+    catalog = InfoCatalog(cluster_policy=cp)
+    for state in new_cluster_policy_states():
+        rules: List[dict] = []
+        for obj in state.renderer.render_objects(state.get_render_data(catalog)):
+            if obj["kind"] in ("Role", "ClusterRole"):
+                rules.extend(obj.get("rules") or [])
+        out[state.name] = rules
+    return out
+
+
+def analyze(rules_by_subject: Optional[Dict[str, List[dict]]] = None) -> List[Finding]:
+    """Full static RBAC pass: extraction + per-subject diff.
+    ``rules_by_subject`` overrides the shipped rules (fixture tests seed
+    defects this way)."""
+    required, findings = required_grants()
+    shipped = rules_by_subject if rules_by_subject is not None else shipped_subject_rules()
+    for subject, grants in required.items():
+        rules = shipped.get(subject)
+        if rules is None:
+            continue
+        findings.extend(diff_subject(subject, grants, rules))
+    # a subject with shipped rules but no mapped code is itself suspect
+    for subject in shipped or {}:
+        if subject not in required and shipped[subject]:
+            findings.append(make(
+                "TPUOP-R002", ERROR, f"rbac:{subject}",
+                "shipped rules exist but no code is attributed to this "
+                "subject — update SUBJECT_ROOTS or drop the rules",
+            ))
+    return findings

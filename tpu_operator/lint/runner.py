@@ -1,0 +1,117 @@
+"""Lint orchestration: gather object groups, run analyzers, apply the
+baseline.
+
+Manifest groups mirror how objects reach a cluster:
+
+    state:<name>    each ClusterPolicy operand state, freshly rendered
+                    (serviceMonitor enabled, the goldens' spec, so the
+                    monitoring objects are linted too)
+    golden:<name>   the committed golden snapshots (identical findings
+                    deduplicate against the fresh render; a *divergent*
+                    golden yields both its own findings and a D003)
+    chart           the full chart render from deploy/values.yaml
+    kustomize       the generated kustomize bases, as one group (the
+                    default overlay applies them together)
+
+Every group collector is best-effort on layout: inside the shipped
+image only the package manifests exist, so goldens/kustomize simply
+contribute nothing there (must-gather runs the same code path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+from tpu_operator.lint import drift, manifest_rules, rbac_static
+from tpu_operator.lint.findings import (
+    INFO,
+    Baseline,
+    Finding,
+    dedupe,
+    make,
+    sort_findings,
+)
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".tpuop-lint-baseline")
+
+ANALYZERS = ("manifest", "rbac", "drift")
+
+
+def manifest_groups() -> List[Tuple[str, List[dict]]]:
+    from tpu_operator.chart import render_chart
+    from tpu_operator.states import new_cluster_policy_states
+
+    groups: List[Tuple[str, List[dict]]] = []
+    catalog = drift.golden_spec_catalog()
+    for state in new_cluster_policy_states():
+        groups.append(
+            (f"state:{state.name}",
+             state.renderer.render_objects(state.get_render_data(catalog)))
+        )
+
+    golden_dir = os.path.join(REPO_ROOT, "tests", "golden")
+    if os.path.isdir(golden_dir):
+        for name in sorted(os.listdir(golden_dir)):
+            if not name.endswith(".yaml") or name == "helm-template.yaml":
+                continue
+            with open(os.path.join(golden_dir, name)) as f:
+                objs = [d for d in yaml.safe_load_all(f) if d]
+            groups.append((f"golden:{name[:-len('.yaml')]}", objs))
+
+    values_path = os.path.join(REPO_ROOT, "deploy", "values.yaml")
+    if os.path.exists(values_path):
+        with open(values_path) as f:
+            groups.append(("chart", render_chart(yaml.safe_load(f))))
+
+    kustomize_dir = os.path.join(REPO_ROOT, "deploy", "kustomize")
+    if os.path.isdir(kustomize_dir):
+        objs = []
+        for base in ("crd", "rbac", "manager", "samples"):
+            base_dir = os.path.join(kustomize_dir, base)
+            if not os.path.isdir(base_dir):
+                continue
+            for name in sorted(os.listdir(base_dir)):
+                if name == "kustomization.yaml" or not name.endswith((".yaml", ".yml")):
+                    continue
+                with open(os.path.join(base_dir, name)) as f:
+                    objs.extend(d for d in yaml.safe_load_all(f) if d)
+        groups.append(("kustomize", objs))
+    return groups
+
+
+def run_lint(
+    baseline_path: Optional[str] = None,
+    only: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run the selected analyzers, dedupe, and apply the baseline.
+    Returns every finding (suppressed ones marked, not dropped)."""
+    selected = set(only or ANALYZERS)
+    findings: List[Finding] = []
+    if "manifest" in selected:
+        for group, objects in manifest_groups():
+            findings.extend(manifest_rules.lint_group(group, objects))
+    if "rbac" in selected:
+        findings.extend(rbac_static.analyze())
+    if "drift" in selected:
+        findings.extend(drift.analyze())
+    findings = dedupe(findings)
+
+    baseline = Baseline.load(
+        DEFAULT_BASELINE if baseline_path is None else baseline_path
+    )
+    findings = baseline.apply(findings)
+    if selected != set(ANALYZERS):
+        return sort_findings(findings)  # partial run: can't judge dead entries
+    for entry in baseline.unused_entries():
+        findings.append(make(
+            "TPUOP-B001", INFO,
+            f"baseline:{os.path.basename(baseline.path)}:{entry.lineno}",
+            f"baseline entry '{entry.rule} {entry.location_prefix}' matched "
+            "nothing — delete it (dead exceptions hide real regressions)",
+        ))
+    return sort_findings(findings)

@@ -147,6 +147,19 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
     except errors.ApiError as e:
         emit("events.txt", f"# collection failed: {e}\n")
 
+    try:
+        # static-analysis snapshot of the *running build*: support reads
+        # it to rule out config drift before chasing cluster state. Every
+        # source the repo checkout would add (goldens, kustomize) is
+        # simply absent in-image, so the in-image report covers the
+        # rendered states + chart the operator actually serves.
+        from tpu_operator.lint.findings import render_json
+        from tpu_operator.lint.runner import run_lint
+
+        emit("lint-report.json", render_json(run_lint()))
+    except Exception as e:  # noqa: BLE001 — the bundle must never fail on lint
+        emit("lint-report.json", f"# collection failed: {e}\n")
+
     pod_logs = getattr(client, "pod_logs", None)
     if pod_logs is not None:
         try:

@@ -191,7 +191,7 @@ def validate_workload(ctx: Context) -> dict:
     existing = ctx.client.get_or_none("v1", "Pod", name, ns)
     if existing is not None:  # stale from a previous attempt
         ctx.client.delete("v1", "Pod", name, ns)
-    ctx.client.create(pod)
+    ctx.client.create(pod)  # tpuop-lint: kinds=v1/Pod
     try:
         for _ in range(ctx.pod_wait_retries):
             live = ctx.client.get_or_none("v1", "Pod", name, ns)
