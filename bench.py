@@ -48,6 +48,7 @@ def bench_install_to_ready(
     collect_stats: bool = False,
     deadline_s: float = 120.0,
     settle_s: float = 0.0,
+    perturb_flips: int = 8,
 ):
     """transport="inproc": operator calls the fake apiserver as dict ops.
     transport="http": the same fake apiserver is served over real TCP
@@ -59,9 +60,19 @@ def bench_install_to_ready(
     ``cached_reads=False`` bypasses the informer-cache read path (the
     round-3 behavior) so the apiserver-traffic saving is measurable.
     ``collect_stats=True`` returns ``(elapsed, stats)`` with wire-request
-    counts per verb and the requests-per-reconcile rate; ``settle_s``
-    keeps the operator running that long after Ready so steady-state
-    reconciles dominate the rate instead of install-time churn."""
+    counts per verb and two requests-per-reconcile rates:
+
+    - ``requests_per_reconcile`` (headline): measured over the POST-Ready
+      window — ``settle_s`` of quiet steady state plus ``perturb_flips``
+      admin label flips the operator must repair (one deploy-gate label
+      removed per flip, written straight into the store the way kubectl
+      would). This is the steady-state control-plane cost per unit of
+      actual change, the number that must stay flat as the cluster grows
+      (O(changes), not O(nodes)).
+    - ``install.requests_per_reconcile``: the old whole-run rate. Install
+      necessarily writes every node once (the initial label stamp), so
+      this one scales with node count by construction and is kept only
+      for continuity with earlier BENCH rounds."""
     from tpu_operator.api.clusterpolicy import (
         CLUSTER_POLICY_API_VERSION,
         CLUSTER_POLICY_KIND,
@@ -128,17 +139,61 @@ def bench_install_to_ready(
             raise RuntimeError("ClusterPolicy never became Ready")
         if not collect_stats:
             return elapsed
+
+        def requests_total() -> int:
+            return sum((getattr(client, "request_counts", {}) or {}).values())
+
+        ready_reconciles = reconcile_count()
+        ready_requests = requests_total()
         if settle_s:
             time.sleep(settle_s)
+        # controlled perturbation: an admin (store-direct, uncounted) strips
+        # one deploy-gate label; the operator must notice and restore it.
+        # Each flip is exactly one unit of real change, so the post-Ready
+        # requests/reconciles ratio measures the marginal cost of a change.
+        from tpu_operator import consts as _consts
+
+        gate = _consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd"
+        for i in range(perturb_flips):
+            node_name = f"tpu-{i % nodes}"
+            store.patch("v1", "Node", node_name, {"metadata": {"labels": {gate: None}}})
+            flip_deadline = time.monotonic() + 15.0
+            while time.monotonic() < flip_deadline:
+                labels = store.get("v1", "Node", node_name)["metadata"].get("labels") or {}
+                if labels.get(gate) == "true":
+                    break
+                time.sleep(0.002)
+            else:
+                raise RuntimeError(f"operator never restored {gate} on {node_name}")
+        time.sleep(0.2)  # let the last repair's echo/status bookkeeping land
+
         reconciles = reconcile_count() - reconciles_before
         counts = dict(getattr(client, "request_counts", {}) or {})
         total = sum(counts.values())
+        steady_reconciles = int(reconcile_count() - ready_reconciles)
+        steady_requests = requests_total() - ready_requests
         stats = {
             "cached_reads": cached_reads,
             "reconciles": int(reconciles),
             "wire_requests": counts,
             "wire_requests_total": total,
-            "requests_per_reconcile": round(total / reconciles, 1) if reconciles else None,
+            "install": {
+                "reconciles": int(ready_reconciles - reconciles_before),
+                "wire_requests_total": ready_requests,
+                "requests_per_reconcile": (
+                    round(ready_requests / (ready_reconciles - reconciles_before), 1)
+                    if ready_reconciles > reconciles_before
+                    else None
+                ),
+            },
+            "steady": {
+                "label_flips": perturb_flips,
+                "reconciles": steady_reconciles,
+                "wire_requests_total": steady_requests,
+            },
+            "requests_per_reconcile": (
+                round(steady_requests / steady_reconciles, 1) if steady_reconciles else 0.0
+            ),
         }
         return elapsed, stats
     finally:
@@ -366,6 +421,7 @@ def _compact_summary(out: dict) -> dict:
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
+        "scale_4096node_s": out.get("scale_4096node_s"),
         "requests_per_reconcile": {
             label.replace("node_cached", ""): blk.get("requests_per_reconcile")
             for label, blk in scale_http.items()
@@ -385,7 +441,40 @@ def _compact_summary(out: dict) -> dict:
     return {k: v for k, v in compact.items() if v not in (None, {})}
 
 
+def scale_smoke() -> int:
+    """Fast CI gate (scripts/ci.sh): the steady-state requests-per-
+    reconcile rate must stay flat between 64 and 256 nodes — the O(changes)
+    property. Fails (exit 1) when rpr[256] > 1.5 x rpr[64], the regression
+    shape a reintroduced full-scan or full-object write produces."""
+    results = {}
+    for nodes in (64, 256):
+        elapsed, stats = bench_install_to_ready(
+            nodes=nodes, transport="http", cached_reads=True,
+            collect_stats=True, deadline_s=180.0, settle_s=1.0,
+        )
+        results[nodes] = {
+            "install_to_ready_s": round(elapsed, 3),
+            "requests_per_reconcile": stats["requests_per_reconcile"],
+            "steady": stats["steady"],
+        }
+    r64 = results[64]["requests_per_reconcile"]
+    r256 = results[256]["requests_per_reconcile"]
+    # max(r64, 1.0) keeps a near-zero 64-node rate from flagging noise
+    ok = r256 <= 1.5 * max(r64, 1.0)
+    print(json.dumps({
+        "metric": "scale_smoke_requests_per_reconcile",
+        "rpr_64": r64,
+        "rpr_256": r256,
+        "threshold": round(1.5 * max(r64, 1.0), 2),
+        "ok": ok,
+        "detail": results,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def main() -> None:
+    if "--scale-smoke" in sys.argv[1:]:
+        raise SystemExit(scale_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -402,10 +491,11 @@ def main() -> None:
         ("64node_direct", 64, False),
         ("256node_cached", 256, True),
         ("256node_direct", 256, False),
-        # one order of magnitude above the 256-node point; cached only
+        # two orders of magnitude above the 64-node point; cached only
         # (the direct path's point is made at 64/256 — repeating it at
-        # 1024 would just burn minutes re-measuring a known O(nodes) cost)
+        # 1024+ would just burn minutes re-measuring a known O(nodes) cost)
         ("1024node_cached", 1024, True),
+        ("4096node_cached", 4096, True),
     ):
         try:
             elapsed, stats = bench_install_to_ready(
@@ -438,6 +528,7 @@ def main() -> None:
         "scale_64node_s": round(scale_64, 3),
         "scale_256node_s": scale_http.get("256node_cached", {}).get("install_to_ready_s"),
         "scale_1024node_s": scale_http.get("1024node_cached", {}).get("install_to_ready_s"),
+        "scale_4096node_s": scale_http.get("4096node_cached", {}).get("install_to_ready_s"),
         "scale_http_transport": scale_http,
         "details": details,
     }

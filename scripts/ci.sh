@@ -21,6 +21,10 @@ import json
 summary = json.load(open("/tmp/lint-report.json"))["summary"]
 print(f"tpuop-lint: {summary}")
 EOF
+echo "== bench smoke: requests-per-reconcile stays flat 64 -> 256 nodes =="
+# O(changes) gate: fails when rpr[256] > 1.5 x rpr[64] — the regression
+# shape a reintroduced full-scan or full-object write produces
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --scale-smoke
 echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) =="
 python3 scripts/image_smoke.py
 echo "== e2e =="

@@ -124,10 +124,14 @@ class SliceManagerAgent:
         """Converge gang plumbing for every multi-host pool; returns the
         slice names reconciled. Idempotent — every host of the slice runs
         this and the create-or-update converges."""
-        nodes = [
-            n for n in self.client.list("v1", "Node")
-            if (n["metadata"].get("labels") or {}).get(consts.TPU_PRESENT_LABEL) == "true"
-        ]
+        # server-side selector: only TPU nodes come over the wire (and a
+        # cached read serves it from the informer's label index)
+        nodes = self.client.list(
+            "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+        )
+        node_labels = {
+            n["metadata"]["name"]: n["metadata"].get("labels") or {} for n in nodes
+        }
         pools = get_node_pools(nodes)
         profile = self._load_profile()
 
@@ -149,7 +153,7 @@ class SliceManagerAgent:
             self._apply_gang_configmap(
                 name, pool, slice_index=index, total_slices=len(active), coordinator=coordinator
             )
-            self._apply_worker_ids(pool)
+            self._apply_worker_ids(pool, node_labels)
             gang_pods.extend(self._apply_gang_pods(name, pool))
             reconciled.append(name)
         if coordinator and active:
@@ -316,20 +320,21 @@ class SliceManagerAgent:
         )
         self.client.apply(self._own(cm))
 
-    def _apply_worker_ids(self, pool: NodePool) -> None:
+    def _apply_worker_ids(self, pool: NodePool, node_labels: dict) -> None:
         """Stable worker ids: sorted node order within the pool (reference
-        concept: per-node mig.config label loop)."""
+        concept: per-node mig.config label loop). A label-only merge patch
+        per changed node — the current labels come from the reconcile's own
+        node list (no per-node GET), and rv-free patches let every host's
+        concurrent agent converge instead of Conflict-bouncing."""
         for worker_id, node_name in enumerate(pool.node_names):
-            try:
-                node = self.client.get("v1", "Node", node_name)
-            except errors.NotFound:
-                continue
-            labels = node["metadata"].setdefault("labels", {})
+            labels = node_labels.get(node_name, {})
             if labels.get(WORKER_ID_LABEL) != str(worker_id):
-                labels[WORKER_ID_LABEL] = str(worker_id)
                 try:
-                    self.client.update(node)
-                except errors.Conflict:
+                    self.client.patch(
+                        "v1", "Node", node_name,
+                        {"metadata": {"labels": {WORKER_ID_LABEL: str(worker_id)}}},
+                    )
+                except errors.NotFound:
                     pass
 
     def _cleanup_stale(

@@ -123,6 +123,9 @@ REQUEUE_NOT_READY_SECONDS = 5.0
 REQUEUE_NO_TPU_NODES_SECONDS = 45.0
 UPGRADE_REPLAN_SECONDS = 120.0
 HEALTH_REPLAN_SECONDS = 30.0
+# Node-event burst coalescing: watch events landing within this window
+# collapse into one reconcile (a label sweep fans out one event per node)
+NODE_EVENT_COALESCE_SECONDS = 0.05
 
 # Container runtimes (reference: getRuntime state_manager.go:714-751).
 RUNTIME_CONTAINERD = "containerd"

@@ -27,8 +27,9 @@ from tpu_operator.kube import errors
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.echo import WriteEchoFilter
 from tpu_operator.kube.events import EventRecorder
-from tpu_operator.kube.objects import ObjectDict, deep_copy
+from tpu_operator.kube.objects import ObjectDict, metadata_patch
 from tpu_operator.nodeinfo import is_tpu_node
 from tpu_operator.state import StateManager, SyncStates
 from tpu_operator.states import new_cluster_policy_states
@@ -59,6 +60,10 @@ class ClusterPolicyReconciler:
         # wired by setup_with_manager: cache-backed node reads (read-only
         # snapshots, no apiserver round-trip per reconcile)
         self.node_informer = None
+        # post-write label state per node, consulted by the node-watch
+        # predicate so our own label sweep's echo events don't re-enqueue
+        # the reconcile that produced them
+        self.echo_filter = WriteEchoFilter()
         # live cluster facts: recomputed only when a node event lands
         # (reference: clusterinfo live mode, clusterinfo.go:83-125)
         self.cluster_info = clusterinfo.LiveClusterInfo(client)
@@ -172,41 +177,38 @@ class ClusterPolicyReconciler:
     def _apply_psa_labels(self, cp: ClusterPolicy) -> None:
         """Pod Security Admission labels on the operand namespace when
         psa.enabled (reference: setPodSecurityLabelsForNamespace
-        state_manager.go:600-648 — operands run privileged)."""
+        state_manager.go:600-648 — operands run privileged). Written as a
+        metadata-only merge patch: the old full-object update re-sent the
+        whole Namespace and could Conflict with unrelated writers."""
         ns = self.client.get_or_none("v1", "Namespace", self.namespace)
         if ns is None:
             return
-        labels = ns["metadata"].setdefault("labels", {})
-        annotations = ns["metadata"].setdefault("annotations", {})
+        labels = ns["metadata"].get("labels") or {}
+        annotations = ns["metadata"].get("annotations") or {}
         marker = "tpu.google.com/psa-labels-managed"
         keys = (
             "pod-security.kubernetes.io/enforce",
             "pod-security.kubernetes.io/audit",
             "pod-security.kubernetes.io/warn",
         )
-        changed = False
+        label_delta: dict = {}
+        annotation_delta: dict = {}
         if cp.spec.psa.is_enabled():
             for k in keys:
                 if labels.get(k) != "privileged":
-                    labels[k] = "privileged"
-                    changed = True
+                    label_delta[k] = "privileged"
             if annotations.get(marker) != "true":
-                annotations[marker] = "true"
-                changed = True
+                annotation_delta[marker] = "true"
         elif annotations.get(marker) == "true":
             # revert ONLY what the operator wrote (the marker proves it);
             # admin-set PSA labels are never touched
             for k in keys:
                 if labels.get(k) == "privileged":
-                    del labels[k]
-                    changed = True
-            del annotations[marker]
-            changed = True
-        if changed:
-            try:
-                self.client.update(ns)
-            except errors.Conflict:
-                pass
+                    label_delta[k] = None
+            annotation_delta[marker] = None
+        body = metadata_patch(labels=label_delta, annotations=annotation_delta)
+        if body:
+            self.client.patch("v1", "Namespace", self.namespace, body)
 
     def _enabled_operand_keys(self, cp: ClusterPolicy) -> List[str]:
         catalog = InfoCatalog(cluster_policy=cp, namespace=self.namespace)
@@ -220,41 +222,71 @@ class ClusterPolicyReconciler:
         """reference: labelGPUNodes state_manager.go:481-581 — stamp
         tpu.present + per-operand deploy labels on TPU nodes, strip all our
         labels from nodes that no longer have TPUs. Existing explicit values
-        (e.g. a hand-set \"false\" opt-out) are left alone."""
+        (e.g. a hand-set \"false\" opt-out) are left alone.
+
+        Each changed node gets a labels-only JSON merge patch (additions as
+        values, removals as nulls): no deep copy of the Node, a ~100-byte
+        write instead of the whole object, and — because no resourceVersion
+        travels — no Conflict against concurrent kubelet/agent writers of
+        unrelated fields."""
         enabled_keys = set(self._enabled_operand_keys(cp))
-        for cached_node in self._nodes():
-            # cache snapshots are read-only; take a private copy to mutate
-            node = deep_copy(cached_node)
-            labels = node["metadata"].setdefault("labels", {})
-            changed = False
+        work: List[tuple] = []
+        for node in self._nodes():
+            # cache snapshots are read-only: compute the delta, never mutate
+            labels = node["metadata"].get("labels") or {}
+            delta: dict = {}
             if is_tpu_node(node):
                 if labels.get(consts.TPU_PRESENT_LABEL) != "true":
-                    labels[consts.TPU_PRESENT_LABEL] = "true"
-                    changed = True
+                    delta[consts.TPU_PRESENT_LABEL] = "true"
                 if consts.TPU_WORKLOAD_CONFIG_LABEL not in labels:
-                    labels[consts.TPU_WORKLOAD_CONFIG_LABEL] = consts.DEFAULT_WORKLOAD_CONFIG
-                    changed = True
-                workload = labels[consts.TPU_WORKLOAD_CONFIG_LABEL]
+                    delta[consts.TPU_WORKLOAD_CONFIG_LABEL] = consts.DEFAULT_WORKLOAD_CONFIG
+                workload = labels.get(
+                    consts.TPU_WORKLOAD_CONFIG_LABEL, consts.DEFAULT_WORKLOAD_CONFIG
+                )
                 for key in OPERAND_DEPLOY_KEYS.values():
                     want = key in enabled_keys and workload == consts.WORKLOAD_CONFIG_CONTAINER
                     if want and key not in labels:
-                        labels[key] = "true"
-                        changed = True
+                        delta[key] = "true"
                     elif not want and key in labels:
-                        del labels[key]
-                        changed = True
+                        delta[key] = None
             else:
                 ours = [consts.TPU_PRESENT_LABEL, consts.TPU_WORKLOAD_CONFIG_LABEL, *OPERAND_DEPLOY_KEYS.values()]
                 for key in ours:
                     if key in labels:
-                        del labels[key]
-                        changed = True
-            if changed:
-                try:
-                    self.client.update(node)  # tpuop-lint: kinds=v1/Node
-                except errors.Conflict:
-                    # node moved under us; the node watch re-triggers reconcile
-                    log.debug("node %s label update conflicted", node["metadata"]["name"])
+                        delta[key] = None
+            if delta:
+                after = {k: v for k, v in labels.items() if delta.get(k, v) is not None}
+                after.update({k: v for k, v in delta.items() if v is not None})
+                work.append((node["metadata"]["name"], delta, after))
+        for item in work:
+            self._patch_node_labels(*item)
+
+    def _patch_node_labels(self, name: str, delta: dict, labels_after: dict) -> None:
+        """One labels-only merge patch, retried once in place on Conflict
+        (rare for a patch — no rv travels with it — but a real apiserver
+        can still 409 under storage races). The old full-object update
+        dropped the node silently on Conflict and waited for the watch; a
+        second Conflict now propagates so the reconcile requeues instead
+        of losing the write."""
+        body = {"metadata": {"labels": delta}}
+        # record BEFORE the write: the in-memory client delivers the watch
+        # event synchronously inside patch(), so a record made after the
+        # call would miss its own echo. A failed write leaves a record for
+        # a label state that never materializes — harmless by the filter's
+        # advisory design (a foreign event with different labels passes).
+        self.echo_filter.record(name, labels_after)
+        for attempt in (0, 1):
+            try:
+                self.client.patch("v1", "Node", name, body)
+                return
+            except errors.NotFound:
+                # node deleted while the sweep ran (cache trails the watch):
+                # skip it, the rest of the sweep must still land
+                return
+            except errors.Conflict:
+                if attempt:
+                    raise
+                log.debug("node %s label patch conflicted; retrying once", name)
 
 
 def node_labels_changed(event_type: str, old: Optional[ObjectDict], new: ObjectDict) -> bool:
@@ -274,7 +306,12 @@ def setup_with_manager(
     DaemonSets, all funnelled into requests for every ClusterPolicy.
     ``cached_reads=False`` keeps reads on the wire client (bench uses it
     to measure what the informer caches save)."""
-    ctrl = Controller("clusterpolicy", reconciler)
+    # node-event bursts (every node in a sweep delivers one event, all
+    # mapping to the same CP request) coalesce into one reconcile
+    ctrl = Controller(
+        "clusterpolicy", reconciler,
+        coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS,
+    )
     if cached_reads:
         # reads via the manager's informer caches, writes direct — the
         # reference reconciler reads exclusively through controller-runtime's
@@ -289,9 +326,19 @@ def setup_with_manager(
             return []
         return [Request(name=cp["metadata"]["name"]) for cp in cps]
 
+    def node_event(event_type, old, new) -> bool:
+        if not node_labels_changed(event_type, old, new):
+            return False
+        # drop the echo of our own label writes: at N nodes one sweep
+        # otherwise re-delivers N MODIFIED events that re-enqueue the very
+        # reconcile that produced them
+        if event_type == "MODIFIED" and reconciler.echo_filter.is_echo(new):
+            return False
+        return True
+
     ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND), predicate=generation_changed)
     node_informer = mgr.informer_for("v1", "Node")
-    ctrl.watch(node_informer, mapper=map_to_all_cps, predicate=node_labels_changed)
+    ctrl.watch(node_informer, mapper=map_to_all_cps, predicate=node_event)
     reconciler.node_informer = node_informer
     reconciler.cluster_info.attach(node_informer)
 

@@ -42,7 +42,7 @@ from tpu_operator.kube import errors
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
-from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.kube.objects import ObjectDict, metadata_patch
 from tpu_operator.upgrade.fsm import (
     DRIVER_POD_COMPONENT,
     DRIVER_POD_COMPONENT_LABEL,
@@ -89,43 +89,46 @@ class NodeRepairManager(ClusterUpgradeStateManager):
     def repair_nodes(self) -> List[ObjectDict]:
         """Nodes the FSM cares about: carrying a health verdict or a
         repair label (a node whose agent died mid-repair must still
-        finish its walk)."""
-        out = []
-        for node in self.client.list("v1", "Node"):
-            labels = _labels(node)
-            if consts.TPU_HEALTH_LABEL in labels or consts.REPAIR_STATE_LABEL in labels:
-                out.append(node)
-        return sorted(out, key=lambda n: n["metadata"]["name"])
+        finish its walk). Two existence-selector lists instead of a full
+        node scan: cached reads ride the informer's label-key index, so
+        the cost is O(nodes with a verdict), not O(cluster)."""
+        seen: Dict[str, ObjectDict] = {}
+        for selector in (consts.TPU_HEALTH_LABEL, consts.REPAIR_STATE_LABEL):
+            for node in self.client.list("v1", "Node", label_selector=selector):
+                seen[node["metadata"]["name"]] = node
+        return sorted(seen.values(), key=lambda n: n["metadata"]["name"])
 
     def _set_repair_state(
         self, node: ObjectDict, new_state: str, retries: Optional[int] = None
     ) -> bool:
         """One atomic node write: state label + transition timestamp (+
-        the retry counter when an attempt begins — bundling it here means
-        a Conflict burns neither the budget nor the state)."""
-        live = self.client.get_or_none("v1", "Node", node["metadata"]["name"])
-        if live is None:
-            return False
-        labels = live["metadata"].setdefault("labels", {})
-        annotations = live["metadata"].setdefault("annotations", {})
+        the retry counter when an attempt begins). Sent as a labels/
+        annotations merge patch — no read-modify-write cycle, and no rv to
+        Conflict on, so concurrent kubelet/agent writers of other fields
+        can never bounce a repair transition."""
+        name = node["metadata"]["name"]
+        labels = _labels(node)
+        annotation_delta: dict = {}
+        label_delta: dict = {}
         if retries is not None:
-            annotations[consts.REPAIR_RETRIES_ANNOTATION] = str(retries)
+            annotation_delta[consts.REPAIR_RETRIES_ANNOTATION] = str(retries)
         if new_state:
             if labels.get(consts.REPAIR_STATE_LABEL) == new_state and retries is None:
                 return True
-            labels[consts.REPAIR_STATE_LABEL] = new_state
+            label_delta[consts.REPAIR_STATE_LABEL] = new_state
             # timestamp the transition so per-state timeouts survive
             # operator restarts (all FSM state lives in the cluster)
-            annotations[consts.REPAIR_STATE_SINCE_ANNOTATION] = str(int(time.time()))
+            annotation_delta[consts.REPAIR_STATE_SINCE_ANNOTATION] = str(int(time.time()))
         else:
             if consts.REPAIR_STATE_LABEL not in labels:
                 return True
-            del labels[consts.REPAIR_STATE_LABEL]
-            annotations.pop(consts.REPAIR_STATE_SINCE_ANNOTATION, None)
+            label_delta[consts.REPAIR_STATE_LABEL] = None
+            annotation_delta[consts.REPAIR_STATE_SINCE_ANNOTATION] = None
+        body = metadata_patch(labels=label_delta, annotations=annotation_delta)
         try:
-            self.client.update(live)
-        except errors.Conflict:
-            return False  # re-planned next pass
+            live = self.client.patch("v1", "Node", name, body)
+        except errors.NotFound:
+            return False  # node gone; re-planned next pass
         node["metadata"] = live["metadata"]
         log.info("repair: node %s -> %s", node["metadata"]["name"], new_state or "(cleared)")
         event_type = "Warning" if new_state == RepairState.QUARANTINED else "Normal"
@@ -165,16 +168,15 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             return False
         since = _annotations(node).get(consts.TPU_HEALTH_SINCE_ANNOTATION)
         if since is None:
-            live = self.client.get_or_none("v1", "Node", node["metadata"]["name"])
-            if live is not None:
-                live["metadata"].setdefault("annotations", {})[
-                    consts.TPU_HEALTH_SINCE_ANNOTATION
-                ] = str(int(time.time()))
-                try:
-                    self.client.update(live)
-                    node["metadata"] = live["metadata"]
-                except errors.Conflict:
-                    pass
+            stamp = str(int(time.time()))
+            try:
+                live = self.client.patch(
+                    "v1", "Node", node["metadata"]["name"],
+                    {"metadata": {"annotations": {consts.TPU_HEALTH_SINCE_ANNOTATION: stamp}}},
+                )
+                node["metadata"] = live["metadata"]
+            except errors.NotFound:
+                pass
             return True
         try:
             return time.time() - float(since) < grace
@@ -334,9 +336,13 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         again. Gangs are keyed the way the slice manager pools nodes:
         the GKE node pool."""
         pools: Dict[str, List[ObjectDict]] = {}
-        for node in self.client.list("v1", "Node"):
+        # selector list instead of a full node scan: the cached read rides
+        # the informer's (tpu.present=true) label-pair index
+        for node in self.client.list(
+            "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+        ):
             pool = _labels(node).get(consts.GKE_NODEPOOL_LABEL)
-            if pool and _labels(node).get(consts.TPU_PRESENT_LABEL) == "true":
+            if pool:
                 pools.setdefault(pool, []).append(node)
         sick = set()
         for node in nodes:
@@ -355,19 +361,20 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                 consts.HEALTH_DEGRADED if pool in sick and len(members) >= 2 else None
             )
             for member in members:
-                labels = member["metadata"].setdefault("labels", {})
+                labels = _labels(member)
                 if want is None:
                     if consts.TPU_SLICE_HEALTH_LABEL not in labels:
                         continue
-                    del labels[consts.TPU_SLICE_HEALTH_LABEL]
                 else:
                     if labels.get(consts.TPU_SLICE_HEALTH_LABEL) == want:
                         continue
-                    labels[consts.TPU_SLICE_HEALTH_LABEL] = want
                 try:
-                    self.client.update(member)  # tpuop-lint: kinds=v1/Node
-                except errors.Conflict:
-                    pass
+                    self.client.patch(
+                        "v1", "Node", member["metadata"]["name"],
+                        {"metadata": {"labels": {consts.TPU_SLICE_HEALTH_LABEL: want}}},
+                    )
+                except errors.NotFound:
+                    pass  # member deleted mid-pass; next pass re-pools
 
     # -- monitoring-only mode ------------------------------------------------
 
@@ -405,17 +412,26 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             retries = consts.REPAIR_RETRIES_ANNOTATION in annotations
             if not state and not slice_label and not retries:
                 continue
+            label_delta: dict = {}
             if state:
-                del labels[consts.REPAIR_STATE_LABEL]
-            if not keep_slice_labels:
-                labels.pop(consts.TPU_SLICE_HEALTH_LABEL, None)
-            annotations.pop(consts.REPAIR_STATE_SINCE_ANNOTATION, None)
+                label_delta[consts.REPAIR_STATE_LABEL] = None
+            if not keep_slice_labels and consts.TPU_SLICE_HEALTH_LABEL in labels:
+                label_delta[consts.TPU_SLICE_HEALTH_LABEL] = None
+            annotation_delta: dict = {}
+            if consts.REPAIR_STATE_SINCE_ANNOTATION in annotations:
+                annotation_delta[consts.REPAIR_STATE_SINCE_ANNOTATION] = None
             # the retry budget goes too: "re-enabling starts clean" — a
             # stale count would quarantine the node's first new fault
-            annotations.pop(consts.REPAIR_RETRIES_ANNOTATION, None)
+            if retries:
+                annotation_delta[consts.REPAIR_RETRIES_ANNOTATION] = None
             try:
-                self.client.update(node)
-            except errors.Conflict:
+                self.client.patch(
+                    "v1", "Node", node["metadata"]["name"],
+                    metadata_patch(labels=label_delta, annotations=annotation_delta),
+                )
+            except errors.NotFound:
+                continue
+            except errors.ApiError:
                 clean = False
                 continue
             if state in IN_REPAIR:
@@ -483,24 +499,31 @@ class HealthReconciler:
         obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name)
         if obj is None:
             return
-        status = obj.setdefault("status", {})
+        status = obj.get("status") or {}
         if not interesting:
             if "health" not in status:
                 return
-            del status["health"]
+            want = None  # merge-patch null removes the block
         elif status.get("health") == health:
             return
         else:
-            status["health"] = health
+            want = health
         try:
-            self.client.update_status(obj)
+            # a health-key-only status patch: the ClusterPolicy reconciler's
+            # concurrent conditions/state patch can neither conflict with
+            # this write nor be clobbered by it
+            self.client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1/ClusterPolicy
+                CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name,
+                {"status": {"health": want}},
+            )
         except errors.ApiError as e:
-            # the ClusterPolicy reconciler races this write; next replan wins
             log.debug("health status publish skipped: %s", e)
 
 
 def setup_with_manager(mgr, reconciler: HealthReconciler) -> Controller:
-    ctrl = Controller("health", reconciler)
+    ctrl = Controller(
+        "health", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
+    )
     reconciler.client = CachedReadClient(reconciler.client, mgr)
 
     def map_to_all_cps(_obj) -> List[Request]:

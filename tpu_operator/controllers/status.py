@@ -31,7 +31,13 @@ def publish_status(
     """Set status.state + Ready/Error conditions, writing only on change.
     The before-image is snapshotted up front — the condition helpers mutate
     in place, so comparing against a live alias would always say
-    'unchanged' and swallow reason/message transitions."""
+    'unchanged' and swallow reason/message transitions.
+
+    The write is a merge patch against the status subresource carrying
+    only the keys this publisher owns (state/conditions/extra): no
+    resourceVersion travels, so it can never Conflict with the other
+    status writers (health block, upgrade block) and never clobbers their
+    keys — the full-object update_status it replaces did both."""
     status = obj.setdefault("status", {})
     before = copy.deepcopy(status)
     conds = status.setdefault("conditions", [])
@@ -44,9 +50,17 @@ def publish_status(
     status["state"] = state
     status.update(extra or {})
     if status == before:
+        # byte-identical to what is already on the CR: no write, and the
+        # caller (see ClusterPolicyReconciler._update_status) emits no
+        # Event either — a quiet steady state costs zero status traffic
         return
+    delta = {"conditions": status["conditions"], "state": state}
+    delta.update(extra or {})
+    md = obj["metadata"]
     try:
-        client.update_status(obj)  # tpuop-lint: kinds=tpu.google.com/v1/ClusterPolicy,tpu.google.com/v1alpha1/TPUSlice
-    except errors.Conflict:
-        # next reconcile re-reads and re-publishes
-        log.debug("status update conflicted for %s", obj["metadata"].get("name"))
+        client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1/ClusterPolicy,tpu.google.com/v1alpha1/TPUSlice
+            obj["apiVersion"], obj["kind"], md["name"], {"status": delta}, md.get("namespace")
+        )
+    except errors.NotFound:
+        # CR deleted between read and publish; its reconcile is moot
+        log.debug("status publish skipped for deleted %s", md.get("name"))

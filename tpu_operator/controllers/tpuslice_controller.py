@@ -108,7 +108,9 @@ def setup_with_manager(mgr, reconciler: TPUSliceReconciler) -> Controller:
     """reference: SetupWithManager nvidiadriver_controller.go:238+ — watch
     TPUSlice (generation-gated), ClusterPolicy, Nodes, and owned
     DaemonSets."""
-    ctrl = Controller("tpuslice", reconciler)
+    ctrl = Controller(
+        "tpuslice", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
+    )
     reconciler.client = CachedReadClient(reconciler.client, mgr)
 
     def map_to_all_slices(_obj) -> List[Request]:

@@ -76,19 +76,23 @@ class UpgradeReconciler:
         obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name)
         if obj is None:
             return
-        status = obj.setdefault("status", {})
+        status = obj.get("status") or {}
         if not upgrade["nodes"]:
             if "upgrade" not in status:
                 return
-            del status["upgrade"]
+            want = None  # merge-patch null removes the block
         elif status.get("upgrade") == upgrade:
             return
         else:
-            status["upgrade"] = upgrade
+            want = upgrade
         try:
-            self.client.update_status(obj)
+            # upgrade-key-only status patch: can't conflict with (or
+            # clobber) the ClusterPolicy reconciler's conditions writes
+            self.client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1/ClusterPolicy
+                CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, cp_name,
+                {"status": {"upgrade": want}},
+            )
         except errors.ApiError as e:
-            # the ClusterPolicy reconciler races this write; next replan wins
             log.debug("upgrade status publish skipped: %s", e)
 
 

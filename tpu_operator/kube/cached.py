@@ -31,7 +31,7 @@ from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client, WatchSubscription
 from tpu_operator.kube.objects import (
     ObjectDict,
-    matches_selector,
+    deep_copy,
     nested_get,
 )
 
@@ -78,20 +78,20 @@ class CachedReadClient(Client):
                 api_version, kind, namespace,
                 label_selector=label_selector, field_selector=field_selector,
             )
-        out = []
-        for obj in informer.cached():
-            md = obj.get("metadata", {})
-            if namespace and md.get("namespace") != namespace:
-                continue
-            if not matches_selector(md.get("labels"), label_selector):
-                continue
-            if field_selector and not all(
-                nested_get(obj, *path.split(".")) == want
-                for path, want in field_selector.items()
-            ):
-                continue
-            out.append(obj)
-        return out
+        # selector reads ride the informer's label indexes (O(matches)
+        # candidates, only matches deep-copied) — a steady-state state-
+        # engine pass runs ~100 selector lists and used to copy every
+        # cached object of every owned kind per list
+        if field_selector:
+            out = []
+            for obj in informer.select(label_selector, namespace, copy=False):
+                if all(
+                    nested_get(obj, *path.split(".")) == want
+                    for path, want in field_selector.items()
+                ):
+                    out.append(deep_copy(obj))
+            return out
+        return informer.select(label_selector, namespace)
 
     # -- writes pass through -------------------------------------------------
 
@@ -103,6 +103,12 @@ class CachedReadClient(Client):
 
     def update_status(self, obj: ObjectDict) -> ObjectDict:
         return self.live.update_status(obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None) -> ObjectDict:
+        return self.live.patch(api_version, kind, name, patch, namespace)
+
+    def patch_status(self, api_version, kind, name, patch, namespace=None) -> ObjectDict:
+        return self.live.patch_status(api_version, kind, name, patch, namespace)
 
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None) -> None:
         return self.live.delete(

@@ -57,6 +57,29 @@ class Client(abc.ABC):
     def update_status(self, obj: ObjectDict) -> ObjectDict: ...
 
     @abc.abstractmethod
+    def patch(
+        self, api_version: str, kind: str, name: str, patch: ObjectDict,
+        namespace: Optional[str] = None,
+    ) -> ObjectDict:
+        """JSON merge patch (RFC 7386, ``application/merge-patch+json``):
+        dicts merge recursively, any other value replaces, ``None`` deletes
+        the key. Carries no resourceVersion, so a minimal patch (e.g. a
+        labels-only delta) can never Conflict with concurrent writers of
+        *other* fields — the O(changes) write primitive for hot paths that
+        previously re-PUT whole objects."""
+        ...
+
+    @abc.abstractmethod
+    def patch_status(
+        self, api_version: str, kind: str, name: str, patch: ObjectDict,
+        namespace: Optional[str] = None,
+    ) -> ObjectDict:
+        """Merge patch against the status subresource; ``patch`` is the
+        full body whose ``status`` key carries the delta (only status is
+        touched, like update_status)."""
+        ...
+
+    @abc.abstractmethod
     def delete(
         self,
         api_version: str,

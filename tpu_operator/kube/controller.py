@@ -52,10 +52,19 @@ def to_self_request(obj: ObjectDict) -> List[Request]:
 
 
 class Controller:
-    def __init__(self, name: str, reconciler, max_concurrent: int = 1):
+    def __init__(
+        self,
+        name: str,
+        reconciler,
+        max_concurrent: int = 1,
+        coalesce_window: float = 0.0,
+    ):
         self.name = name
         self.reconciler = reconciler  # object with .reconcile(Request) -> Result
-        self.queue = RateLimitingQueue()
+        # coalesce_window > 0 folds event bursts (a node label sweep fans
+        # out one watch event per node, all mapping to the same Request)
+        # into one reconcile per window — see RateLimitingQueue
+        self.queue = RateLimitingQueue(coalesce_window=coalesce_window)
         self.max_concurrent = max_concurrent
         self._watches: List[tuple] = []  # (informer, mapper, predicate)
         self._threads: List[threading.Thread] = []

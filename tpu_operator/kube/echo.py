@@ -1,0 +1,60 @@
+"""Self-write echo suppression for watch predicates.
+
+Every write the operator sends comes straight back as a watch MODIFIED
+event. For per-node label writes that echo is pure churn: at 1024 nodes
+one label sweep re-delivers ~1024 events whose only content is what the
+operator itself just wrote, each re-enqueueing the reconcile that
+produced them. The filter records the exact post-write label state per
+object; the watch predicate drops a MODIFIED event whose labels equal a
+recorded write (the operator already knows that state — it authored it).
+
+Safety: suppression is advisory-only and level-triggered-safe. The
+informer cache still applies every event (only the enqueue is skipped),
+and a CONCURRENT foreign change makes the delivered labels differ from
+the recorded ones, so the event passes through and the next reconcile
+reads current state. Entries expire on a TTL and the map is size-bounded,
+so a lost or re-ordered echo can only cost one redundant reconcile,
+never a missed one.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+from tpu_operator.kube.objects import ObjectDict
+
+
+class WriteEchoFilter:
+    def __init__(self, max_entries: int = 8192, ttl_seconds: float = 30.0):
+        self._lock = threading.Lock()
+        self._ttl = ttl_seconds
+        self._max = max_entries
+        # name -> (expected labels dict, expiry deadline)
+        self._expected: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+
+    def record(self, name: str, labels: Optional[dict]) -> None:
+        """Remember the label state a write just produced for ``name``."""
+        with self._lock:
+            self._expected[name] = (dict(labels or {}), time.monotonic() + self._ttl)
+            self._expected.move_to_end(name)
+            while len(self._expected) > self._max:
+                self._expected.popitem(last=False)
+
+    def is_echo(self, obj: ObjectDict) -> bool:
+        """True when the event's labels are exactly what we last wrote for
+        this object (and the record hasn't expired). Non-consuming: several
+        controllers watch the same informer, and the same echo reaches each
+        of their predicates."""
+        name = obj.get("metadata", {}).get("name", "")
+        with self._lock:
+            entry = self._expected.get(name)
+            if entry is None:
+                return False
+            want, deadline = entry
+            if time.monotonic() > deadline:
+                del self._expected[name]
+                return False
+            return (obj.get("metadata", {}).get("labels") or {}) == want

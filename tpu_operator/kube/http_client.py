@@ -140,6 +140,8 @@ class HttpClient(Client):
         "update": (("update", None),),
         "apply": (("get", None), ("create", None), ("update", None)),
         "update_status": (("update", "status"),),
+        "patch": (("patch", None),),
+        "patch_status": (("patch", "status"),),
         "delete": (("delete", None),),
         "evict": (("create", "pods/eviction"),),
         "pod_logs": (("get", "pods/log"),),
@@ -365,6 +367,7 @@ class HttpClient(Client):
         _retry_auth: bool = True,
         _resent: bool = False,
         _raw: bool = False,
+        content_type: str = "application/json",
     ):
         import http.client
 
@@ -377,7 +380,7 @@ class HttpClient(Client):
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Accept": "application/json"}
         if body is not None:
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = content_type
         token = self._bearer()
         if token:
             headers["Authorization"] = f"Bearer {token}"
@@ -388,7 +391,9 @@ class HttpClient(Client):
         # connection is the common race, but "no status line" does NOT
         # prove the request went unprocessed (the server may have read and
         # applied it, then died before responding). GET/DELETE/PUT are safe
-        # to re-send (kube PUTs are rv-guarded: a duplicate hits Conflict);
+        # to re-send (kube PUTs are rv-guarded: a duplicate hits Conflict),
+        # and so is PATCH (a merge patch re-applied converges to the same
+        # object — it carries no rv to conflict on);
         # a POST could double-create, so it surfaces the error instead and
         # callers tolerate AlreadyExists on their own retry (Go's transport
         # draws the same idempotency line when request bytes were written).
@@ -440,6 +445,7 @@ class HttpClient(Client):
                 return self._request(
                     method, path, body, query,
                     _retry_auth=False, _resent=resent, _raw=_raw,
+                    content_type=content_type,
                 )
             detail = payload.decode(errors="replace")[:500]
             if status == 404:
@@ -547,6 +553,23 @@ class HttpClient(Client):
         md = obj.get("metadata", {})
         path = self._path(obj["apiVersion"], obj["kind"], md.get("namespace"), md["name"]) + "/status"
         return self._request("PUT", path, body=obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        """JSON merge patch (RFC 7386). The O(changes) write: a labels-only
+        delta rides a ~100-byte request instead of re-PUTting the whole
+        object, and carries no resourceVersion to conflict on."""
+        return self._request(
+            "PATCH",
+            self._path(api_version, kind, namespace, name),
+            body=patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def patch_status(self, api_version, kind, name, patch, namespace=None):
+        path = self._path(api_version, kind, namespace, name) + "/status"
+        return self._request(
+            "PATCH", path, body=patch, content_type="application/merge-patch+json"
+        )
 
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
         query = (

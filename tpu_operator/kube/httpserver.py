@@ -27,6 +27,7 @@ import json
 import logging
 import queue
 import threading
+import time
 import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -232,6 +233,9 @@ class FakeApiServer:
             def do_PUT(self):  # noqa: N802
                 self._dispatch("PUT")
 
+            def do_PATCH(self):  # noqa: N802
+                self._dispatch("PATCH")
+
             def do_DELETE(self):  # noqa: N802
                 self._dispatch("DELETE")
 
@@ -329,6 +333,8 @@ class FakeApiServer:
                 verb = "create"
             elif method == "PUT":
                 verb = "update"
+            elif method == "PATCH":
+                verb = "patch"
             else:
                 verb = "delete"
             self.authorizer.check(api_group(api_version), resource, verb)
@@ -337,13 +343,12 @@ class FakeApiServer:
             if query.get("watch") == ["true"]:
                 rv = (query.get("resourceVersion") or [""])[0]
                 return self._serve_watch(handler, api_version, kind, namespace, rv)
-            selector = None
-            if query.get("labelSelector"):
-                selector = dict(
-                    pair.split("=", 1)
-                    for pair in query["labelSelector"][0].split(",")
-                    if "=" in pair
-                )
+            # pass the selector through as the raw kubectl-style string:
+            # matches_selector handles the full grammar (k=v, bare-key
+            # existence, !k, in/notin) — the old k=v-only dict parse
+            # silently dropped existence requirements and returned the
+            # whole collection
+            selector = (query.get("labelSelector") or [None])[0]
             field_selector = None
             if query.get("fieldSelector"):
                 field_selector = dict(
@@ -447,6 +452,20 @@ class FakeApiServer:
             obj = handler._body()
             updated = self.client.update(obj)
             return handler._send(200, updated or obj)
+        if method == "PATCH":
+            # only JSON merge patch is served (what HttpClient sends); the
+            # real apiserver answers other patch types with 415
+            ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+            if ctype != "application/merge-patch+json":
+                raise errors.Invalid(f"unsupported patch content type {ctype!r}")
+            body = handler._body() or {}
+            if sub == "status":
+                patched = self.client.patch_status(api_version, kind, name, body, namespace)
+            elif sub:
+                raise errors.Invalid(f"cannot patch subresource {sub!r}")
+            else:
+                patched = self.client.patch(api_version, kind, name, body, namespace)
+            return handler._send(200, patched)
         if method == "DELETE":
             self.client.delete(api_version, kind, name, namespace)
             return handler._send(200, {"status": "Success"})
@@ -515,7 +534,7 @@ class FakeApiServer:
             idle_ticks = 0
             while not self._stopped.is_set():
                 try:
-                    etype, obj = events.get(timeout=0.5)
+                    batch = [events.get(timeout=0.5)]
                     idle_ticks = 0
                 except queue.Empty:
                     # a client that vanished is only detectable by writing:
@@ -530,9 +549,31 @@ class FakeApiServer:
                         )
                         handler.wfile.flush()
                     continue
-                handler.wfile.write(
+                # drain the queue and ship the burst as ONE write+flush: a
+                # label sweep produces thousands of events, and waking the
+                # stream thread + a socket flush per event made the event
+                # path cost more than the writes that caused it. The 2 ms
+                # collect window lets a serial writer's back-to-back events
+                # actually form a batch (real apiservers buffer watch
+                # responses the same way); informer consumers only ever
+                # see it as watch latency, well under any reconcile window
+                deadline = time.monotonic() + 0.002
+                while len(batch) < 500:
+                    try:
+                        batch.append(events.get_nowait())
+                    except queue.Empty:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            batch.append(events.get(timeout=remaining))
+                        except queue.Empty:
+                            break
+                payload = b"".join(
                     json.dumps({"type": etype, "object": obj}).encode() + b"\n"
+                    for etype, obj in batch
                 )
+                handler.wfile.write(payload)
                 handler.wfile.flush()
         finally:
             sub.stop()

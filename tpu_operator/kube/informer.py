@@ -1,13 +1,29 @@
-"""Shared informer: list+watch a kind, keep a cache, fan out to handlers."""
+"""Shared informer: list+watch a kind, keep an indexed cache, fan out.
+
+The cache maintains label indexes (client-go Indexer equivalent): every
+``key=value`` pair and every bare label key map to the set of cached
+objects carrying them, so selector reads (``select``) touch O(matches)
+objects instead of scanning — and deep-copying — the whole store. At
+4096 nodes that is the difference between a reconcile that copies a few
+changed objects and one that copies the cluster. Custom indexes
+(``add_index``) cover non-label lookups the same way.
+"""
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, SYNC, Client
-from tpu_operator.kube.objects import ObjectDict, api_group, deep_copy, object_key
+from tpu_operator.kube.objects import (
+    ObjectDict,
+    api_group,
+    deep_copy,
+    matches_selector,
+    object_key,
+    parse_selector,
+)
 
 
 def _newer(rv_new, rv_old) -> bool:
@@ -21,8 +37,14 @@ def _newer(rv_new, rv_old) -> bool:
 
 log = logging.getLogger(__name__)
 
-# handler(event_type, old_obj_or_None, new_obj)
+# handler(event_type, old_obj_or_None, new_obj). Handlers receive the
+# CACHED objects themselves (no per-handler deep copy — at scale that
+# copied every node once per handler per event) and MUST treat them as
+# read-only, the client-go cache convention.
 EventHandler = Callable[[str, Optional[ObjectDict], ObjectDict], None]
+
+# index fn: obj -> list of index values the object files under
+IndexFunc = Callable[[ObjectDict], List[str]]
 
 
 class Informer:
@@ -33,6 +55,13 @@ class Informer:
         self.namespace = namespace
         self._handlers: List[EventHandler] = []
         self._cache: dict = {}
+        # label indexes, maintained on every upsert/delete:
+        #   (label key, value) -> {cache keys}, and label key -> {cache keys}
+        # (the latter serves bare-existence selector requirements)
+        self._label_pairs: Dict[Tuple[str, str], Set[tuple]] = {}
+        self._label_keys: Dict[str, Set[tuple]] = {}
+        self._index_fns: Dict[str, IndexFunc] = {}
+        self._indexes: Dict[str, Dict[str, Set[tuple]]] = {}
         self._lock = threading.RLock()
         self._sub = None
         self._synced = threading.Event()
@@ -43,6 +72,19 @@ class Informer:
 
     def add_handler(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
+
+    def add_index(self, name: str, fn: IndexFunc) -> None:
+        """Register a custom index (client-go AddIndexers): ``fn`` maps an
+        object to the values it files under; ``by_index`` reads them back
+        O(matches). Existing cache entries are indexed immediately."""
+        with self._lock:
+            if name in self._index_fns:
+                return
+            self._index_fns[name] = fn
+            index = self._indexes.setdefault(name, {})
+            for key, obj in self._cache.items():
+                for value in fn(obj) or ():
+                    index.setdefault(value, set()).add(key)
 
     def start(self, sync_timeout: float = 5.0) -> None:
         with self._lifecycle:
@@ -75,6 +117,40 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    # -- index maintenance (call with self._lock held) -----------------------
+
+    def _index_add(self, key, obj: ObjectDict) -> None:
+        for k, v in (obj["metadata"].get("labels") or {}).items():
+            self._label_pairs.setdefault((k, v), set()).add(key)
+            self._label_keys.setdefault(k, set()).add(key)
+        for name, fn in self._index_fns.items():
+            index = self._indexes[name]
+            for value in fn(obj) or ():
+                index.setdefault(value, set()).add(key)
+
+    def _index_remove(self, key, obj: ObjectDict) -> None:
+        for k, v in (obj["metadata"].get("labels") or {}).items():
+            bucket = self._label_pairs.get((k, v))
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_pairs[(k, v)]
+            bucket = self._label_keys.get(k)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._label_keys[k]
+        for name, fn in self._index_fns.items():
+            index = self._indexes[name]
+            for value in fn(obj) or ():
+                bucket = index.get(value)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del index[value]
+
+    # -- event path ----------------------------------------------------------
+
     def _on_event(self, event_type: str, obj: ObjectDict) -> None:
         if event_type == SYNC:
             self._replace(obj.get("items") or [])
@@ -83,6 +159,8 @@ class Informer:
         with self._lock:
             old = self._cache.get(key)
             if event_type == DELETED:
+                if old is not None:
+                    self._index_remove(key, old)
                 self._cache.pop(key, None)
             else:
                 if old is not None and not _newer(
@@ -91,15 +169,23 @@ class Informer:
                     # duplicate or stale delivery (list replay after watch,
                     # or reordered concurrent notifications) — drop
                     return
-                self._cache[key] = deep_copy(obj)
+                # the delivered object is stored as-is: both clients hand
+                # each subscriber a private object (FakeClient deep-copies
+                # per delivery, the HTTP watch parses fresh JSON), so no
+                # defensive copy is needed here
+                if old is not None:
+                    self._index_remove(key, old)
+                self._cache[key] = obj
+                self._index_add(key, obj)
         for handler in self._handlers:
             try:
-                # each handler gets its own copies so one handler mutating an
-                # object can't corrupt the cache or its peers
+                # handlers get the cached objects (read-only convention) —
+                # per-handler deep copies made every node event cost
+                # O(object size x handlers)
                 handler(
                     event_type if old is None or event_type == DELETED else MODIFIED,
-                    deep_copy(old) if old is not None else None,
-                    deep_copy(obj),
+                    old,
+                    obj,
                 )
             except Exception:  # noqa: BLE001 — informer must survive handler bugs
                 log.exception("informer handler failed for %s %s", self.kind, key)
@@ -114,8 +200,8 @@ class Informer:
         loop on NotFound forever — there is no resync timer to heal it)."""
         with self._lock:
             snapshot_keys = {object_key(o) for o in items}
-            # no copy needed: _on_event(DELETED) pops the entry and deep-
-            # copies before notifying handlers; nothing mutates it between
+            # no copy needed: _on_event(DELETED) pops the entry and hands
+            # the read-only cached object to handlers; nothing mutates it
             stale = [o for k, o in self._cache.items() if k not in snapshot_keys]
         for obj in items:
             self._on_event(ADDED, obj)
@@ -143,3 +229,54 @@ class Informer:
         with self._lock:
             obj = self._cache.get(key)
         return deep_copy(obj) if obj is not None else None
+
+    def by_index(self, name: str, value: str, copy: bool = True) -> List[ObjectDict]:
+        """Objects a custom index files under ``value`` — O(matches)."""
+        with self._lock:
+            keys = self._indexes.get(name, {}).get(value, ())
+            objs = [self._cache[k] for k in keys if k in self._cache]
+            return [deep_copy(o) for o in objs] if copy else objs
+
+    def select(
+        self, label_selector=None, namespace: Optional[str] = None, copy: bool = True
+    ) -> List[ObjectDict]:
+        """Selector read through the label indexes: equality and existence
+        requirements narrow to candidate sets first, the full selector
+        then filters the (small) candidate list, and only matches are
+        deep-copied. Falls back to a full scan when no requirement is
+        indexable (e.g. a pure ``!key`` selector)."""
+        with self._lock:
+            candidates = self._candidate_keys(label_selector)
+            if candidates is None:
+                objs = list(self._cache.values())
+            else:
+                objs = [self._cache[k] for k in candidates if k in self._cache]
+            out = []
+            for obj in objs:
+                md = obj.get("metadata", {})
+                if namespace and md.get("namespace") != namespace:
+                    continue
+                if not matches_selector(md.get("labels"), label_selector):
+                    continue
+                out.append(deep_copy(obj) if copy else obj)
+        return out
+
+    def _candidate_keys(self, label_selector) -> Optional[set]:
+        """Smallest indexed candidate set for a selector, or None when the
+        selector has no indexable requirement. Call with the lock held."""
+        if label_selector is None:
+            return None
+        if isinstance(label_selector, dict):
+            reqs = [(k, "=", [v]) for k, v in label_selector.items()]
+        else:
+            reqs = parse_selector(label_selector)
+        best: Optional[set] = None
+        for key, op, values in reqs:
+            bucket: Optional[set] = None
+            if op == "=":
+                bucket = self._label_pairs.get((key, values[0]), set())
+            elif op in ("exists", "in"):
+                bucket = self._label_keys.get(key, set())
+            if bucket is not None and (best is None or len(bucket) < len(best)):
+                best = bucket
+        return set(best) if best is not None else None

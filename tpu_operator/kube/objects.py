@@ -77,7 +77,47 @@ def new_object(
 
 
 def deep_copy(obj: ObjectDict) -> ObjectDict:
+    """Deep copy specialized for JSON trees (what every kube object is):
+    ~4x faster than copy.deepcopy, which dominates fake-apiserver and
+    cache-read cost at thousands of objects. Non-JSON values fall back to
+    copy.deepcopy for correctness."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    if isinstance(obj, dict):
+        return {k: deep_copy(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [deep_copy(v) for v in obj]
     return copy.deepcopy(obj)
+
+
+def metadata_patch(labels: Optional[dict] = None, annotations: Optional[dict] = None) -> Optional[dict]:
+    """Merge-patch body for a labels/annotations delta (values set,
+    ``None`` entries delete), or None when there is nothing to write —
+    the shared shape every label-FSM writer sends."""
+    metadata: dict = {}
+    if labels:
+        metadata["labels"] = labels
+    if annotations:
+        metadata["annotations"] = annotations
+    return {"metadata": metadata} if metadata else None
+
+
+def merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch, returning the patched value (inputs are
+    not mutated): dicts merge recursively, ``None`` deletes a key, any
+    other value replaces wholesale (lists included — merge patch has no
+    per-element list semantics)."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    out = dict(target) if isinstance(target, dict) else {}
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        elif isinstance(value, dict):
+            out[key] = merge_patch(out.get(key), value)
+        else:
+            out[key] = copy.deepcopy(value)
+    return out
 
 
 def set_owner_reference(obj: ObjectDict, owner: ObjectDict, controller: bool = True) -> None:

@@ -3,6 +3,14 @@
 Deduplicates items, supports delayed adds, and applies per-item exponential
 backoff on failure — base/max mirror the reference's controller rate limiter
 (100 ms – 3 s, clusterpolicy_controller.go:51-52).
+
+``coalesce_window`` adds event-burst coalescing: an ``add`` parks the item
+for the window instead of making it ready immediately, and every further
+add of the same item inside the window is a no-op — so a label sweep that
+fans out N watch events (one per node, each mapping to the same Request)
+costs ONE reconcile per window instead of re-waking the worker per event.
+Level-triggered correctness is preserved: the reconcile that eventually
+runs reads current state, so nothing coalesced away is lost.
 """
 
 from __future__ import annotations
@@ -14,14 +22,18 @@ from typing import Any, Optional
 
 
 class RateLimitingQueue:
-    def __init__(self, base_delay: float = 0.1, max_delay: float = 3.0):
+    def __init__(
+        self, base_delay: float = 0.1, max_delay: float = 3.0, coalesce_window: float = 0.0
+    ):
         self._base = base_delay
         self._max = max_delay
+        self._coalesce = coalesce_window
         self._lock = threading.Condition()
         self._queue: list = []  # FIFO of ready items
         self._dirty: set = set()  # items added while being processed
         self._processing: set = set()
         self._in_queue: set = set()
+        self._coalescing: set = set()  # parked in _delayed by add()'s window
         self._delayed: list = []  # heap of (ready_time, seq, item)
         self._failures: dict = {}
         self._seq = 0
@@ -36,7 +48,15 @@ class RateLimitingQueue:
             if item in self._processing:
                 self._dirty.add(item)
                 return
-            if item in self._in_queue:
+            if item in self._in_queue or item in self._coalescing:
+                return
+            if self._coalesce > 0:
+                self._coalescing.add(item)
+                self._seq += 1
+                heapq.heappush(
+                    self._delayed, (time.monotonic() + self._coalesce, self._seq, item)
+                )
+                self._lock.notify()
                 return
             self._queue.append(item)
             self._in_queue.add(item)
@@ -73,6 +93,7 @@ class RateLimitingQueue:
                 now = time.monotonic()
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, item = heapq.heappop(self._delayed)
+                    self._coalescing.discard(item)
                     if item not in self._in_queue and item not in self._processing:
                         self._queue.append(item)
                         self._in_queue.add(item)

@@ -352,11 +352,15 @@ class ClusterUpgradeStateManager:
             return
         if bool(live.get("spec", {}).get("unschedulable")) == cordon:
             return
-        live.setdefault("spec", {})["unschedulable"] = cordon
+        # one-field merge patch: no rv, so concurrent label writers (the
+        # health agent, kubelet heartbeats) can never Conflict a cordon
         try:
-            self.client.update(live)
-        except errors.Conflict:
-            pass
+            self.client.patch(
+                "v1", "Node", node["metadata"]["name"],
+                {"spec": {"unschedulable": True if cordon else None}},
+            )
+        except errors.NotFound:
+            pass  # node deleted mid-walk; next pass re-plans
 
     def _evict_phase(
         self,
