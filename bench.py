@@ -49,6 +49,7 @@ def bench_install_to_ready(
     deadline_s: float = 120.0,
     settle_s: float = 0.0,
     perturb_flips: int = 8,
+    chaos=None,
 ):
     """transport="inproc": operator calls the fake apiserver as dict ops.
     transport="http": the same fake apiserver is served over real TCP
@@ -95,8 +96,11 @@ def bench_install_to_ready(
         from tpu_operator.kube.http_client import HttpClient
         from tpu_operator.kube.httpserver import FakeApiServer
 
-        apiserver = FakeApiServer(store).start()
-        client = HttpClient(apiserver.base_url)
+        # chaos: a seeded ChaosDirector (kube/chaos.py) injected at the
+        # HTTP layer — chaos_converge_s measures install→Ready through
+        # the standard fault schedule with the real retry/breaker path
+        apiserver = FakeApiServer(store, chaos=chaos).start()
+        client = HttpClient(apiserver.base_url, watch_stall_seconds=10.0)
     else:
         client = store
     sim = ClusterSim(store, ready_delay=SIM_CONTAINER_START_S, tick=0.01).start()
@@ -122,7 +126,11 @@ def bench_install_to_ready(
     mgr.start()
     try:
         t0 = time.perf_counter()
-        client.create(new_cluster_policy())
+        # admin-side, like kubectl (and like the soak/RBAC-gate tests):
+        # the CR install is not the operator's own traffic — and under a
+        # chaos schedule a store-create can't eat an injected fault on a
+        # POST the client (correctly) never retries
+        store.create(new_cluster_policy())
         deadline = t0 + deadline_s
         elapsed = None
         while time.perf_counter() < deadline:
@@ -418,6 +426,7 @@ def _compact_summary(out: dict) -> dict:
         "vs_baseline": out["vs_baseline"],
         "vs_baseline_kind": out["vs_baseline_kind"],
         "http_transport_s": out.get("http_transport_s"),
+        "chaos_converge_s": out.get("chaos_converge_s"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -472,9 +481,88 @@ def scale_smoke() -> int:
     return 0 if ok else 1
 
 
+def bench_chaos_converge(
+    nodes: int = 16,
+    seed: int = 20260803,
+    outage_at: float = 3.0,
+    outage_duration: float = 30.0,
+    watch_drop_every: float = 10.0,
+    deadline_s: float = 240.0,
+    rate_scale: float = 1.0,
+    director=None,
+):
+    """Install→Ready under the STANDARD seeded fault schedule (5% 5xx,
+    429+Retry-After bursts, 410s, connection resets, periodic watch
+    drops, one full-outage window) — the chaos twin of the clean-install
+    headline. Returns (elapsed_s, director) so callers can assert the
+    fault classes that actually fired."""
+    from tpu_operator.kube.chaos import ChaosDirector
+
+    if director is None:
+        director = ChaosDirector.standard(
+            seed, outage_at=outage_at, outage_duration=outage_duration,
+            watch_drop_every=watch_drop_every, rate_scale=rate_scale,
+        )
+    elapsed = bench_install_to_ready(
+        nodes=nodes, transport="http", deadline_s=deadline_s, chaos=director
+    )
+    return elapsed, director
+
+
+def chaos_smoke() -> int:
+    """Bounded CI gate (scripts/ci.sh): the operator must converge to
+    Ready through the standard fault schedule with a short outage, and
+    every configured fault class must actually have fired (a schedule
+    that silently injects nothing would make the gate vacuous)."""
+    from tpu_operator.kube.chaos import (
+        FAULT_410,
+        FAULT_RESET,
+        FAULT_RESET_BODY,
+        ChaosDirector,
+        FaultRule,
+    )
+
+    # the outage opens almost immediately so the install is FORCED to
+    # ride through it (a fast clean install would otherwise finish
+    # before the window). The RARE classes (410, resets) are prepended
+    # as scripted fire-exactly-N rules so the gate is deterministic —
+    # purely probabilistic low rates left the class coverage to luck
+    # (post-PR3 installs read through informer watches, so unary GET
+    # traffic is sparse) and the gate flaked red.
+    director = ChaosDirector.standard(
+        20260803, outage_at=0.5, outage_duration=3.0, watch_drop_every=1.0,
+        rate_scale=3.0,
+    )
+    # GET-scoped: a scripted reset landing on the one CR-create POST
+    # would fail the install's first write instead of testing recovery
+    director.rules = [
+        FaultRule(FAULT_410, rate=1.0, times=2, verbs=("GET",)),
+        FaultRule(FAULT_RESET, rate=1.0, times=2, verbs=("GET",)),
+        FaultRule(FAULT_RESET_BODY, rate=1.0, times=2, verbs=("GET",)),
+        *director.rules,
+    ]
+    elapsed, director = bench_chaos_converge(
+        nodes=32, deadline_s=120.0, director=director,
+    )
+    missed = director.configured_classes() - director.fired_classes()
+    out = {
+        "metric": "chaos_smoke_converge",
+        "chaos_converge_s": round(elapsed, 3),
+        "faults_injected": len(director.fault_log),
+        "fault_classes": sorted(director.fired_classes()),
+        "fault_classes_missed": sorted(missed),
+        "seed": director.seed,
+        "ok": not missed,
+    }
+    print(json.dumps(out, separators=(",", ":")))
+    return 0 if not missed else 1
+
+
 def main() -> None:
     if "--scale-smoke" in sys.argv[1:]:
         raise SystemExit(scale_smoke())
+    if "--chaos-smoke" in sys.argv[1:]:
+        raise SystemExit(chaos_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -505,6 +593,20 @@ def main() -> None:
             scale_http[label] = {"install_to_ready_s": round(elapsed, 3), **stats}
         except RuntimeError as e:
             scale_http[label] = {"error": str(e)}
+    # install→Ready under the standard fault schedule (30 s outage, 5%
+    # 5xx, 429 bursts, watch drops) — the robustness twin of the clean
+    # number: how much failure costs, not just how fast success is
+    try:
+        chaos_s, chaos_director = bench_chaos_converge()
+        chaos_block = {
+            "chaos_converge_s": round(chaos_s, 3),
+            "seed": chaos_director.seed,
+            "faults_injected": len(chaos_director.fault_log),
+            "fault_classes": sorted(chaos_director.fired_classes()),
+        }
+    except Exception as e:  # noqa: BLE001 — a chaos failure must not
+        # crash the whole nightly bench; record it as the chaos result
+        chaos_block = {"error": f"{type(e).__name__}: {e}"}
     details = tpu_details()
     details["multiprocess_distributed"] = _multiprocess_distributed_details()
     out = {
@@ -530,6 +632,8 @@ def main() -> None:
         "scale_1024node_s": scale_http.get("1024node_cached", {}).get("install_to_ready_s"),
         "scale_4096node_s": scale_http.get("4096node_cached", {}).get("install_to_ready_s"),
         "scale_http_transport": scale_http,
+        "chaos_converge_s": chaos_block.get("chaos_converge_s"),
+        "chaos": chaos_block,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

@@ -5,8 +5,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 echo "== unit tests (includes golden render drift) =="
 # the explicit image-smoke step below covers tests/test_image_smoke.py;
-# skip the in-suite copy so CI boots each entrypoint once, not twice
-TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST=1 python3 -m pytest tests/ -q
+# skip the in-suite copy so CI boots each entrypoint once, not twice.
+# slow-marked drills (the full-length 256-node/30s-outage chaos soak)
+# stay out of the gate — the bounded chaos smoke below covers the path
+TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST=1 python3 -m pytest tests/ -q -m "not slow"
 echo "== rendered chart lints clean =="
 python3 scripts/validate_rendered.py
 echo "== tpuop-lint static analysis (error severity fails the build) =="
@@ -25,6 +27,11 @@ echo "== bench smoke: requests-per-reconcile stays flat 64 -> 256 nodes =="
 # O(changes) gate: fails when rpr[256] > 1.5 x rpr[64] — the regression
 # shape a reintroduced full-scan or full-object write produces
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --scale-smoke
+echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
+# bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
+# watch drops, and a full-outage window; fails if any configured fault
+# class never fired (a vacuous schedule) or convergence never happens
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --chaos-smoke
 echo "== image entrypoints boot (no docker daemon: resolved from Dockerfiles) =="
 python3 scripts/image_smoke.py
 echo "== e2e =="

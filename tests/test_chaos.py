@@ -1,0 +1,931 @@
+"""Chaos-hardened control plane: fault injection, client resilience,
+and the crash-recovery / leader-failover drills.
+
+Layers under test, bottom-up:
+- ChaosDirector determinism + scheduling (same seed → same fault log).
+- HttpClient retry/backoff: Retry-After honored on 429/503, full-jitter
+  retries for idempotent verbs, POSTs never retried, budget + deadline
+  bounds.
+- Circuit breaker: opens after consecutive transport failures,
+  fail-fasts while open, half-open probe closes it on recovery.
+- Watch-stream staleness: a silently hung stream (no events, no
+  heartbeats) is abandoned at watch_stall_seconds and re-listed.
+- Leader elector resilience: transient apiserver errors neither kill
+  the elector thread nor depose a leader inside its renew deadline.
+- Drills: chaos soak (install→Ready through the standard fault
+  schedule, Degraded set and cleared, no stuck queue items, every fault
+  class fired), operator crash mid-rollout → restart → idempotent
+  convergence with no duplicate/orphaned operands, and two-replica
+  leader failover under the SHIPPED operator ClusterRole with the
+  exactly-one-active-reconciler invariant held throughout.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    new_cluster_policy,
+)
+from tpu_operator.controllers import conditions
+from tpu_operator.controllers.clusterpolicy_controller import (
+    ClusterPolicyReconciler,
+    setup_with_manager,
+)
+from tpu_operator.kube import errors
+from tpu_operator.kube.chaos import (
+    FAULT_410,
+    FAULT_429,
+    FAULT_500,
+    FAULT_503,
+    FAULT_RESET,
+    ChaosClient,
+    ChaosDirector,
+    FaultRule,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.http_client import HttpClient
+from tpu_operator.kube.httpserver import FakeApiServer
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.leader import LeaderElector
+from tpu_operator.kube.manager import Manager
+from tpu_operator.kube.retry import ApiResilience, CircuitBreaker
+from tpu_operator.kube.sim import ClusterSim, make_tpu_node
+
+NS = "tpu-operator"
+
+
+def wait_for(fn, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# ChaosDirector
+# ---------------------------------------------------------------------------
+
+
+class TestChaosDirector:
+    SCHEDULE = dict(
+        rules=[
+            FaultRule(FAULT_500, rate=0.2),
+            FaultRule(FAULT_429, rate=0.1, retry_after=0.5),
+            FaultRule(FAULT_410, rate=0.05, verbs=("GET",)),
+        ],
+    )
+
+    def _drive(self, director):
+        for i in range(300):
+            director.decide(("GET", "PATCH", "POST")[i % 3], ("Node", "Pod")[i % 2])
+        return [(r.seq, r.verb, r.kind, r.fault) for r in director.fault_log]
+
+    def test_same_seed_same_fault_log(self):
+        log_a = self._drive(ChaosDirector(seed=42, **self.SCHEDULE))
+        log_b = self._drive(ChaosDirector(seed=42, **self.SCHEDULE))
+        assert log_a and log_a == log_b
+
+    def test_different_seed_different_fault_log(self):
+        log_a = self._drive(ChaosDirector(seed=42, **self.SCHEDULE))
+        log_b = self._drive(ChaosDirector(seed=43, **self.SCHEDULE))
+        assert log_a != log_b
+
+    def test_scripted_schedule_fires_exactly_n_times(self):
+        d = ChaosDirector(
+            seed=0,
+            rules=[FaultRule(FAULT_500, rate=1.0, times=3, verbs=("PATCH",), kinds=("Node",))],
+        )
+        for _ in range(10):
+            d.decide("PATCH", "Node")
+        assert len(d.fault_log) == 3
+        assert d.decide("PATCH", "Pod") is None  # kind filter holds
+
+    def test_outage_window_dominates(self):
+        d = ChaosDirector(seed=0, outages=((0.0, 60.0),)).start()
+        injection = d.decide("GET", "Node")
+        assert injection is not None and injection.fault == FAULT_RESET
+        assert d.outage_seen()
+
+    def test_chaos_client_raises_mapped_errors(self):
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        client = ChaosClient(
+            store,
+            ChaosDirector(seed=0, rules=[FaultRule(FAULT_429, rate=1.0, times=1, retry_after=2.0)]),
+        )
+        with pytest.raises(errors.TooManyRequests) as exc:
+            client.get("v1", "Node", "n1")
+        assert exc.value.retry_after == 2.0
+        # the scripted fault is spent; the wrapped store serves normally
+        assert client.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Retry / Retry-After / budget
+# ---------------------------------------------------------------------------
+
+
+def _served(store, chaos=None, **client_kw):
+    server = FakeApiServer(store, chaos=chaos).start()
+    client = HttpClient(server.base_url, timeout=5.0, **client_kw)
+    return server, client
+
+
+class TestClientRetry:
+    def test_5xx_retried_transparently_for_reads(self):
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        chaos = ChaosDirector(seed=1, rules=[FaultRule(FAULT_500, rate=1.0, times=2)])
+        server, client = _served(store, chaos)
+        try:
+            assert client.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+            assert client.resilience.retries["GET"] == 2
+            assert client.resilience.failures["http_500"] == 2
+        finally:
+            server.stop()
+
+    def test_retry_after_header_is_honored(self):
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        chaos = ChaosDirector(
+            seed=1, rules=[FaultRule(FAULT_429, rate=1.0, times=1, retry_after=0.4)]
+        )
+        server, client = _served(store, chaos)
+        try:
+            t0 = time.monotonic()
+            assert client.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+            # the server said "come back in 0.4s" and the client obeyed
+            assert time.monotonic() - t0 >= 0.4
+        finally:
+            server.stop()
+
+    def test_post_is_never_retried(self):
+        store = FakeClient()
+        chaos = ChaosDirector(seed=1, rules=[FaultRule(FAULT_503, rate=1.0, times=1)])
+        server, client = _served(store, chaos)
+        try:
+            with pytest.raises(errors.ServerError):
+                client.create(make_tpu_node("n1"))
+            assert client.resilience.retries.get("POST", 0) == 0
+            # the fault was consumed by the one attempt; a caller-level
+            # retry (what controllers do) succeeds
+            client.create(make_tpu_node("n1"))
+        finally:
+            server.stop()
+
+    def test_retry_budget_bounds_attempts(self):
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        chaos = ChaosDirector(seed=1, rules=[FaultRule(FAULT_500, rate=1.0)])  # fails forever
+        server, client = _served(store, chaos, retry_budget=2, request_deadline=5.0)
+        try:
+            with pytest.raises(errors.ServerError):
+                client.get("v1", "Node", "n1")
+            assert client.resilience.retries["GET"] == 2  # budget, not infinity
+        finally:
+            server.stop()
+
+    def test_eviction_429_surfaces_immediately(self):
+        """PDB-blocked evictions answer 429 — that is an APPLICATION
+        answer the upgrade/repair FSMs park on, and it must never be
+        spun on by the retry layer (eviction is a POST)."""
+        store = FakeClient()
+        chaos = ChaosDirector(seed=1, rules=[FaultRule(FAULT_429, rate=1.0, times=1)])
+        server, client = _served(store, chaos)
+        try:
+            store.create(make_tpu_node("n1"))
+            t0 = time.monotonic()
+            with pytest.raises(errors.TooManyRequests):
+                client.evict("ghost", NS)
+            assert time.monotonic() - t0 < 0.5  # no Retry-After sleep
+            # and it is an APPLICATION answer, not apiserver degradation:
+            # a PDB-protected drain must never stamp Degraded=True
+            assert client.resilience.failures.get("http_429", 0) == 0
+            assert not client.resilience.degraded()
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_transport_failures_and_recovers(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=3, reset_seconds=5.0, clock=lambda: clock[0])
+        for _ in range(3):
+            b.before_request()
+            b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        with pytest.raises(errors.BreakerOpen):
+            b.before_request()  # fail fast, no wire attempt
+        clock[0] = 6.0
+        b.before_request()  # half-open probe admitted
+        assert b.state == CircuitBreaker.HALF_OPEN
+        with pytest.raises(errors.BreakerOpen):
+            b.before_request()  # second caller NOT admitted during the probe
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED
+
+    def test_failed_probe_reopens(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=1, reset_seconds=1.0, clock=lambda: clock[0])
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        clock[0] = 2.0
+        b.before_request()
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN
+        assert b.open_count == 2
+
+    def test_answered_5xx_does_not_open_the_breaker(self):
+        """An apiserver that ANSWERS with 500s has a working transport:
+        the breaker is for unreachability, not for server errors."""
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        chaos = ChaosDirector(seed=1, rules=[FaultRule(FAULT_500, rate=1.0)])
+        server, client = _served(store, chaos, retry_budget=1, request_deadline=2.0)
+        try:
+            for _ in range(4):
+                with pytest.raises(errors.ServerError):
+                    client.get("v1", "Node", "n1")
+            assert client.resilience.breaker.state == CircuitBreaker.CLOSED
+        finally:
+            server.stop()
+
+    def test_outage_opens_breaker_then_recovery_closes_it(self):
+        store = FakeClient()
+        store.create(make_tpu_node("n1"))
+        # outage from t=0 for 1.5s, healthy after
+        chaos = ChaosDirector(seed=1, outages=((0.0, 1.5),))
+        server, client = _served(store, chaos, retry_budget=1, request_deadline=1.0)
+        client.resilience = ApiResilience(
+            breaker=CircuitBreaker(failure_threshold=2, reset_seconds=0.3)
+        )
+        try:
+            for _ in range(3):
+                with pytest.raises(errors.ApiError):
+                    client.get("v1", "Node", "n1")
+            assert client.resilience.breaker.state == CircuitBreaker.OPEN
+            assert client.resilience.degraded()
+            # while open: fail-fast without a wire attempt
+            sent_before = client.request_counts["GET"]
+            with pytest.raises(errors.BreakerOpen):
+                client.get("v1", "Node", "n1")
+            assert client.request_counts["GET"] == sent_before
+            # after the outage the half-open probe closes the breaker
+
+            def recovered():
+                try:
+                    return client.get("v1", "Node", "n1")["metadata"]["name"] == "n1"
+                except errors.ApiError:
+                    return False
+
+            assert wait_for(recovered, timeout=10.0, interval=0.2)
+            assert client.resilience.breaker.state == CircuitBreaker.CLOSED
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Watch staleness
+# ---------------------------------------------------------------------------
+
+
+class TestWatchStaleness:
+    def test_hung_stream_is_abandoned_and_relisted(self):
+        """The server wedges every watch stream 0.3s after connect (no
+        events, no heartbeats — indistinguishable from a quiet cluster
+        without the stall detector). The client must abandon the stream
+        at watch_stall_seconds and re-list, so an object created during
+        the hang still becomes visible."""
+        store = FakeClient()
+        chaos = ChaosDirector(seed=1, watch_hang_after=0.3, watch_hang_duration=3600.0)
+        server = FakeApiServer(store, chaos=chaos).start()
+        client = HttpClient(server.base_url, timeout=5.0, watch_stall_seconds=1.0)
+        informer = Informer(client, "v1", "Node")
+        try:
+            informer.start()
+            time.sleep(0.6)  # the live stream is hung by now
+            store.create(make_tpu_node("late"))
+            assert wait_for(lambda: informer.get("late") is not None, timeout=15.0), (
+                "stalled watch was never abandoned; informer is blind"
+            )
+            assert informer.last_event_at is not None
+        finally:
+            informer.stop()
+            server.stop()
+
+    def test_informer_stale_and_resync(self):
+        client = FakeClient()
+        client.create(make_tpu_node("n1"))
+        informer = Informer(client, "v1", "Node")
+        informer.start()
+        assert informer.has_synced()
+        assert not informer.stale(10.0)
+        time.sleep(0.05)
+        assert informer.stale(0.01)  # nothing delivered since the SYNC
+        before = informer.last_sync_at
+        informer.resync()
+        assert wait_for(lambda: informer.last_sync_at != before, timeout=2.0)
+        assert informer.get("n1") is not None
+        informer.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leader elector resilience (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyClient(FakeClient):
+    """Raises a transient 500 on every Lease op while .broken is set."""
+
+    def __init__(self):
+        super().__init__()
+        self.broken = False
+
+    def _maybe_break(self, kind):
+        if self.broken and kind == "Lease":
+            raise errors.ServerError("injected 500", status=500)
+
+    def get(self, api_version, kind, name, namespace=None):
+        self._maybe_break(kind)
+        return super().get(api_version, kind, name, namespace)
+
+    def update(self, obj):
+        self._maybe_break(obj["kind"])
+        return super().update(obj)
+
+    def create(self, obj):
+        self._maybe_break(obj["kind"])
+        return super().create(obj)
+
+
+class TestLeaderElectorResilience:
+    def test_transient_error_does_not_kill_elector_thread(self):
+        """The old code let any unexpected ApiError propagate out of
+        _try_acquire_or_renew and silently kill the elector thread —
+        leadership wedged until process restart. A blip must read as
+        'not acquired this round' and the loop must keep running."""
+        client = _FlakyClient()
+        client.broken = True
+        elector = LeaderElector(client, namespace="ns", lease_duration=0.6, renew_interval=0.05)
+        elector.start()
+        time.sleep(0.3)
+        assert elector._thread.is_alive(), "transient 500 killed the elector thread"
+        assert not elector.is_leader()
+        client.broken = False  # apiserver heals
+        assert elector.wait_for_leadership(3.0), "elector never recovered from the blip"
+        elector.stop()
+
+    def test_leader_rides_out_blip_within_renew_deadline(self):
+        """A LEADER seeing transient renew errors keeps the lease until
+        renew_deadline (client-go RetryPeriod-until-RenewDeadline);
+        losing leadership on the first 500 would bounce the whole
+        manager on every apiserver hiccup."""
+        client = _FlakyClient()
+        lost = []
+        elector = LeaderElector(
+            client, namespace="ns",
+            lease_duration=2.0, renew_interval=0.05, renew_deadline=1.0,
+        )
+        elector.on_stopped_leading = lambda: lost.append(True)
+        elector.start()
+        assert elector.wait_for_leadership(3.0)
+        client.broken = True
+        time.sleep(0.4)  # several failed renews, all inside the deadline
+        assert elector.is_leader() and not lost
+        client.broken = False
+        time.sleep(0.3)
+        assert elector.is_leader() and not lost  # renewed again, still leading
+        elector.stop()
+
+    def test_leader_deposes_after_renew_deadline(self):
+        client = _FlakyClient()
+        lost = []
+        elector = LeaderElector(
+            client, namespace="ns",
+            lease_duration=1.0, renew_interval=0.05, renew_deadline=0.3,
+        )
+        elector.on_stopped_leading = lambda: lost.append(True)
+        elector.start()
+        assert elector.wait_for_leadership(3.0)
+        client.broken = True
+        assert wait_for(lambda: bool(lost), timeout=3.0), (
+            "leader outlived its renew deadline with the apiserver down"
+        )
+        elector.stop()
+
+    def test_renew_conflict_on_own_applied_write_keeps_leadership(self):
+        """The transport retry layer can re-send a renew PUT whose first
+        send was APPLIED before the response died — the retry then 409s
+        against the elector's own successful write. That Conflict must
+        not read as 'lease lost' (it would depose the leader and bounce
+        the manager): the elector re-reads the lease and believes it."""
+        class _AppliedThenConflict(FakeClient):
+            def __init__(self):
+                super().__init__()
+                self.arm = False
+
+            def update(self, obj):
+                if obj["kind"] == "Lease" and self.arm:
+                    self.arm = False
+                    super().update(obj)  # the write LANDS…
+                    raise errors.Conflict("retried PUT hit its own write")
+                return super().update(obj)
+
+        client = _AppliedThenConflict()
+        lost = []
+        elector = LeaderElector(client, namespace="ns", lease_duration=5.0, renew_interval=0.05)
+        elector.on_stopped_leading = lambda: lost.append(True)
+        elector.start()
+        assert elector.wait_for_leadership(3.0)
+        client.arm = True
+        time.sleep(0.4)  # several renew cycles, one of them conflicted
+        assert elector.is_leader() and not lost, (
+            "a Conflict against the elector's own applied renew deposed the leader"
+        )
+        elector.stop()
+
+    def test_blocked_renew_deposes_at_wall_clock_deadline(self):
+        """renew_deadline is a WALL-CLOCK bound: a renew call that HANGS
+        (blackholed apiserver — connects block instead of failing fast)
+        must not extend leadership past the deadline while the lease
+        expires under a standby. The watchdog deposes on time even with
+        the renew loop stuck inside the call."""
+        hang = threading.Event()
+
+        class _HangingClient(FakeClient):
+            def get(self, api_version, kind, name, namespace=None):
+                if kind == "Lease" and hang.is_set():
+                    time.sleep(5.0)  # far past the 0.4s renew_deadline
+                    raise errors.TransportError("blackholed")
+                return super().get(api_version, kind, name, namespace)
+
+        client = _HangingClient()
+        lost = []
+        elector = LeaderElector(
+            client, namespace="ns",
+            lease_duration=1.0, renew_interval=0.05, renew_deadline=0.4,
+        )
+        elector.on_stopped_leading = lambda: lost.append(time.monotonic())
+        elector.start()
+        assert elector.wait_for_leadership(3.0)
+        t0 = time.monotonic()
+        hang.set()
+        assert wait_for(lambda: not elector.is_leader(), timeout=2.0), (
+            "hung renew extended leadership past renew_deadline"
+        )
+        deposed_after = time.monotonic() - t0
+        assert deposed_after < 1.0, f"deposed only after {deposed_after:.2f}s (lease already expired)"
+        assert wait_for(lambda: bool(lost), timeout=2.0)
+        elector._stop.set()  # skip stop()'s release (the client still hangs)
+
+    def test_release_retries_once_on_conflict(self):
+        class _ConflictOnce(FakeClient):
+            def __init__(self):
+                super().__init__()
+                self.conflicts_left = 1
+
+            def update(self, obj):
+                if obj["kind"] == "Lease" and self.conflicts_left > 0:
+                    self.conflicts_left -= 1
+                    raise errors.Conflict("race")
+                return super().update(obj)
+
+        client = _ConflictOnce()
+        elector = LeaderElector(client, namespace="ns", lease_duration=5.0, renew_interval=0.05)
+        elector.start()
+        assert elector.wait_for_leadership(3.0)
+        elector.stop()  # release must survive the injected Conflict
+        lease = client.get("coordination.k8s.io/v1", "Lease", elector.lease_name, "ns")
+        assert lease["spec"]["holderIdentity"] == "", "conflicted release left the lease held"
+
+
+# ---------------------------------------------------------------------------
+# Drills: chaos soak, crash-restart, leader failover
+# ---------------------------------------------------------------------------
+
+
+def shipped_rules():
+    import os
+
+    import yaml
+
+    from tpu_operator.chart import render_chart
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "deploy", "values.yaml")) as f:
+        objs = render_chart(yaml.safe_load(f))
+    (role,) = [o for o in objs if o["kind"] == "ClusterRole"]
+    return role["rules"]
+
+
+def _expected_operand_daemonsets(store):
+    dses = store.list("apps/v1", "DaemonSet", NS)
+    return sorted(ds["metadata"]["name"] for ds in dses)
+
+
+def _assert_no_orphans(store, cp_uid):
+    """Every operator-owned object must be owned by the LIVE ClusterPolicy:
+    a crash that left objects owned by nothing (or re-created duplicates
+    beside the originals) fails here."""
+    dses = store.list("apps/v1", "DaemonSet", NS)
+    names = [ds["metadata"]["name"] for ds in dses]
+    assert len(names) == len(set(names)) == 9, names
+    for ds in dses:
+        refs = ds["metadata"].get("ownerReferences") or []
+        assert any(r.get("uid") == cp_uid for r in refs), (
+            f"orphaned DaemonSet {ds['metadata']['name']}: ownerReferences={refs}"
+        )
+
+
+def _run_soak(nodes, director, ready_timeout, client_kw=None):
+    """Shared soak body: full operator over the wire through ``director``'s
+    schedule; returns observations for asserts."""
+    store = FakeClient()
+    for i in range(nodes):
+        store.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "4x4"))
+    server = FakeApiServer(store, chaos=director).start()
+    client = HttpClient(
+        server.base_url, timeout=5.0, watch_stall_seconds=8.0,
+        **(client_kw or {}),
+    )
+    sim = ClusterSim(store, ready_delay=0.05, tick=0.01).start()
+    mgr = Manager(client, namespace=NS)
+    reconciler = ClusterPolicyReconciler(client, NS)
+    ctrl = setup_with_manager(mgr, reconciler)
+    obs = {"degraded_seen": False}
+    stop_sampler = threading.Event()
+
+    def sample_degraded():
+        while not stop_sampler.wait(0.05):
+            cp = store.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            cond = conditions.get_condition(
+                (cp or {}).get("status", {}).get("conditions", []), conditions.DEGRADED
+            )
+            if cond and cond.get("status") == "True":
+                obs["degraded_seen"] = True
+
+    sampler = threading.Thread(target=sample_degraded, daemon=True)
+    try:
+        mgr.start()
+        store.create(new_cluster_policy())  # admin-side, like kubectl
+        sampler.start()
+
+        def ready():
+            cp = store.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            if (cp or {}).get("status", {}).get("state") != "ready":
+                return False
+            dses = store.list("apps/v1", "DaemonSet", NS)
+            return len(dses) == 9 and all(
+                ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
+            )
+
+        obs["became_ready"] = wait_for(ready, timeout=ready_timeout, interval=0.1)
+
+        # a fast install can converge before the rare probabilistic
+        # classes (reset-body at ~0.6%) or the time-scheduled ones
+        # (outage window, watch drops) ever fire: keep cheap reads
+        # flowing until every configured class has landed, then end the
+        # chaos run and require the cluster to heal
+        probe = HttpClient(server.base_url, timeout=3.0, retry_budget=0, request_deadline=1.0)
+
+        def all_classes_fired():
+            try:
+                probe.get("v1", "Node", "tpu-0")
+            except errors.ApiError:
+                pass
+            return director.configured_classes() <= director.fired_classes()
+
+        obs["all_classes_fired"] = wait_for(all_classes_fired, timeout=45.0, interval=0.02)
+        director.quiesce()  # the chaos run ends; the cluster must heal
+
+        # recovery: once faults stop landing, the Degraded condition must
+        # CLEAR (the degraded-requeue path keeps reconciling until then)
+        def degraded_cleared():
+            cp = store.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            cond = conditions.get_condition(
+                (cp or {}).get("status", {}).get("conditions", []), conditions.DEGRADED
+            )
+            return cond is not None and cond.get("status") == "False"
+
+        obs["degraded_cleared"] = wait_for(
+            degraded_cleared,
+            timeout=consts.API_DEGRADED_WINDOW_SECONDS + 3 * consts.REQUEUE_DEGRADED_SECONDS,
+            interval=0.2,
+        ) if obs["became_ready"] else False
+        # zero STUCK queue items once converged and quiet: nothing
+        # ready-but-unprocessed and nothing in a failure-backoff spiral.
+        # (len(queue)==0 is the wrong check: the Ready heartbeat
+        # legitimately parks one delayed requeue at all times.)
+        def drained():
+            q = ctrl.queue
+            with q._lock:
+                return not q._queue and not q._failures
+
+        obs["queue_drained"] = wait_for(drained, timeout=15.0)
+        cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        obs["cp_uid"] = cp["metadata"]["uid"]
+        obs["store"] = store
+        return obs
+    finally:
+        stop_sampler.set()
+        mgr.stop()
+        sim.stop()
+        server.stop()
+
+
+class TestChaosSoak:
+    def test_install_converges_through_fault_schedule(self):
+        """Tier-1 soak: the standard schedule compressed (same classes,
+        shorter outage) so the whole drill stays CI-sized. 5% 5xx, 429
+        bursts, 410s, resets, a watch drop every 2s, one 3s full outage
+        — and the install must come out Ready with the Degraded
+        condition having been set and then cleared, no stuck queue
+        items, and every configured fault class actually fired."""
+        director = ChaosDirector.standard(
+            seed=7, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
+            rate_scale=2.0,
+        )
+        obs = _run_soak(nodes=24, director=director, ready_timeout=90.0)
+        assert obs["became_ready"], "never Ready under the fault schedule"
+        assert obs["degraded_seen"], "Degraded condition never observed during chaos"
+        assert obs["degraded_cleared"], "Degraded condition never cleared after recovery"
+        assert obs["queue_drained"], "stuck queue items after convergence"
+        missed = director.configured_classes() - director.fired_classes()
+        assert not missed, f"configured fault classes never fired: {missed}"
+        _assert_no_orphans(obs["store"], obs["cp_uid"])
+
+    @pytest.mark.slow
+    def test_full_soak_256_nodes_30s_outage(self):
+        """The acceptance-criteria drill at full strength: 256 nodes,
+        the standard schedule verbatim (5% 5xx, watch drop every ~10s,
+        429+Retry-After bursts, one 30s full outage), reproducible from
+        the seed."""
+        director = ChaosDirector.standard(seed=20260803, outage_at=8.0, outage_duration=30.0)
+        obs = _run_soak(nodes=256, director=director, ready_timeout=240.0)
+        assert obs["became_ready"], "256-node install never Ready under chaos"
+        assert obs["degraded_seen"] and obs["degraded_cleared"]
+        assert obs["queue_drained"]
+        missed = director.configured_classes() - director.fired_classes()
+        assert not missed, f"configured fault classes never fired: {missed}"
+        _assert_no_orphans(obs["store"], obs["cp_uid"])
+
+
+class TestCrashRestartDrill:
+    def test_crash_mid_rollout_then_restart_converges_idempotently(self):
+        """SIGKILL-equivalent drill: mid-install the apiserver goes away
+        under the operator (in-flight writes die on the wire, nothing
+        graceful runs — from the cluster's view this is
+        indistinguishable from the operator process being killed, since
+        a dead process also just stops talking). The store (etcd)
+        survives. A FRESH operator process (new manager, new client,
+        new server port) against the same store must converge with no
+        duplicate or orphaned operands — both drills run under the
+        shipped operator ClusterRole."""
+        from tpu_operator.kube.httpserver import RbacAuthorizer
+
+        rules = shipped_rules()
+        store = FakeClient()
+        for i in range(8):
+            store.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "2x4"))
+        sim = ClusterSim(store, ready_delay=0.1, tick=0.01).start()
+
+        server1 = FakeApiServer(store, authorize=RbacAuthorizer(rules)).start()
+        client1 = HttpClient(server1.base_url, timeout=3.0, request_deadline=3.0)
+        mgr1 = Manager(client1, namespace=NS)
+        setup_with_manager(mgr1, ClusterPolicyReconciler(client1, NS))
+        mgr2 = None
+        server2 = None
+        try:
+            mgr1.start()
+            store.create(new_cluster_policy())
+            # crash point: rollout demonstrably in flight, not yet Ready
+            assert wait_for(
+                lambda: len(store.list("apps/v1", "DaemonSet", NS)) >= 3, timeout=30.0
+            ), "rollout never started"
+            cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            assert cp.get("status", {}).get("state") != "ready", "crashed too late"
+            server1.stop()  # the lights go out mid-write
+            mgr1.stop()  # reap threads; nothing can reach the cluster anyway
+
+            auth2 = RbacAuthorizer(rules)
+            server2 = FakeApiServer(store, authorize=auth2).start()
+            client2 = HttpClient(server2.base_url, timeout=5.0)
+            mgr2 = Manager(client2, namespace=NS)
+            setup_with_manager(mgr2, ClusterPolicyReconciler(client2, NS))
+            mgr2.start()
+
+            def ready():
+                cp = store.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+                if (cp or {}).get("status", {}).get("state") != "ready":
+                    return False
+                dses = store.list("apps/v1", "DaemonSet", NS)
+                return len(dses) == 9 and all(
+                    ds.get("status", {}).get("numberAvailable") == 8 for ds in dses
+                )
+
+            assert wait_for(ready, timeout=60.0), "restarted operator never converged"
+            assert not auth2.denials, f"RBAC gaps after restart: {sorted(set(auth2.denials))}"
+            cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+            _assert_no_orphans(store, cp["metadata"]["uid"])
+        finally:
+            if mgr2 is not None:
+                mgr2.stop()
+            if server2 is not None:
+                server2.stop()
+            sim.stop()
+
+
+class TestLeaderFailoverDrill:
+    def test_standby_takes_over_within_lease_window(self):
+        """Two Manager replicas under the SHIPPED operator ClusterRole,
+        leader election on. The leader's renewals start failing (wedged
+        replica); it must depose itself at renew_deadline and the
+        standby must acquire within the lease window — with the
+        exactly-one-active-reconciler invariant (no overlapping
+        reconcile intervals between replicas) held throughout."""
+        from tpu_operator.kube.httpserver import RbacAuthorizer
+
+        lease_duration, renew_deadline, renew_interval = 2.0, 1.2, 0.1
+        store = FakeClient()
+        for i in range(4):
+            store.create(make_tpu_node(f"tpu-{i}", "tpu-v5-lite-podslice", "2x4"))
+        authorizer = RbacAuthorizer(shipped_rules())
+        server = FakeApiServer(store, authorize=authorizer).start()
+        sim = ClusterSim(store, ready_delay=0.05, tick=0.01).start()
+
+        spans = []  # (replica, start, end) of every reconcile body
+        spans_lock = threading.Lock()
+
+        def instrument(reconciler, tag):
+            inner = reconciler.reconcile
+
+            def traced(req):
+                t0 = time.monotonic()
+                try:
+                    return inner(req)
+                finally:
+                    with spans_lock:
+                        spans.append((tag, t0, time.monotonic()))
+
+            reconciler.reconcile = traced
+
+        def replica(tag):
+            client = HttpClient(server.base_url, timeout=3.0)
+            mgr = Manager(
+                client, namespace=NS, leader_election=True,
+                lease_duration=lease_duration, renew_interval=renew_interval,
+                renew_deadline=renew_deadline,
+            )
+            reconciler = ClusterPolicyReconciler(client, NS)
+            setup_with_manager(mgr, reconciler)
+            instrument(reconciler, tag)
+            return mgr
+
+        mgr_a = replica("A")
+        mgr_b = replica("B")
+        b_thread = None
+        try:
+            mgr_a.start()  # blocks until A holds the lease
+            assert mgr_a._leader.is_leader()
+            store.create(new_cluster_policy())
+            assert wait_for(
+                lambda: (store.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy") or {})
+                .get("status", {}).get("state") == "ready",
+                timeout=60.0,
+            ), "leader A never drove the install Ready"
+
+            # standby: start() blocks on leadership, so run it in a thread
+            b_thread = threading.Thread(target=mgr_b.start, daemon=True)
+            b_thread.start()
+            time.sleep(3 * renew_interval)
+            assert not mgr_b._leader.is_leader(), "standby grabbed a held lease"
+
+            # the leader wedges: every renew now fails transiently
+            t_wedge = time.monotonic()
+            mgr_a._leader._acquire_or_renew = lambda: (_ for _ in ()).throw(
+                errors.ServerError("wedged replica", status=500)
+            )
+            # A must depose itself (renew_deadline) and self-stop…
+            assert wait_for(mgr_a.stopped, timeout=renew_deadline + 2.0), (
+                "deposed leader kept its manager running (split-brain)"
+            )
+            # …and B must acquire within the lease window
+            assert mgr_b._leader.wait_for_leadership(lease_duration + 2.0), (
+                "standby never acquired within the lease window"
+            )
+            takeover = time.monotonic() - t_wedge
+            assert takeover <= lease_duration + 2.0, f"takeover took {takeover:.1f}s"
+            b_thread.join(timeout=10.0)
+            assert not b_thread.is_alive(), "standby start() never returned"
+
+            # B now reconciles: flip a label and require B to repair it
+            gate = consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd"
+            store.patch("v1", "Node", "tpu-0", {"metadata": {"labels": {gate: None}}})
+            assert wait_for(
+                lambda: (store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}).get(gate) == "true",
+                timeout=15.0,
+            ), "new leader never reconciled"
+
+            # exactly-one-active-reconciler: no A span may overlap a B
+            # span. Spans are recorded when a reconcile RETURNS, so wait
+            # for B's repairing reconcile to finish before reading.
+            def b_recorded():
+                with spans_lock:
+                    return any(tag == "B" for tag, _, _ in spans)
+
+            assert wait_for(b_recorded, timeout=10.0)
+            with spans_lock:
+                a_spans = [(s, e) for tag, s, e in spans if tag == "A"]
+                b_spans = [(s, e) for tag, s, e in spans if tag == "B"]
+            assert a_spans and b_spans, (len(a_spans), len(b_spans))
+            overlap = [
+                (a, b)
+                for a in a_spans
+                for b in b_spans
+                if a[0] < b[1] and b[0] < a[1]
+            ]
+            assert not overlap, f"replicas reconciled concurrently: {overlap[:3]}"
+            assert not authorizer.denials, sorted(set(authorizer.denials))
+        finally:
+            mgr_b.stop()
+            mgr_a.stop()
+            sim.stop()
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Degraded condition plumbing (unit)
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedCondition:
+    def test_publish_sets_and_clears_degraded(self):
+        from tpu_operator.controllers.status import publish_status
+
+        client = FakeClient()
+        client.create(new_cluster_policy())
+        obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        publish_status(client, obj, "ready", degraded=True, degraded_detail="breaker=open")
+        conds = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")[
+            "status"
+        ]["conditions"]
+        cond = conditions.get_condition(conds, conditions.DEGRADED)
+        assert cond["status"] == "True" and cond["reason"] == "ApiserverDegraded"
+
+        obj = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
+        publish_status(client, obj, "ready", degraded=False)
+        conds = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")[
+            "status"
+        ]["conditions"]
+        cond = conditions.get_condition(conds, conditions.DEGRADED)
+        assert cond["status"] == "False" and cond["reason"] == "ApiserverHealthy"
+
+    def test_fake_client_reconcile_writes_no_degraded_condition(self):
+        """In-memory clients have no transport, hence no resilience
+        state: the condition must be absent, not 'False' (its presence
+        would churn every FakeClient-based golden/status test)."""
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        from tpu_operator.kube.controller import Request
+
+        rec = ClusterPolicyReconciler(client, NS)
+        rec.reconcile(Request(name="cluster-policy"))
+        conds = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")[
+            "status"
+        ]["conditions"]
+        assert conditions.get_condition(conds, conditions.DEGRADED) is None
+
+    def test_resilience_degraded_window_drains(self):
+        clock = [0.0]
+        res = ApiResilience(
+            breaker=CircuitBreaker(clock=lambda: clock[0]),
+            degraded_window=10.0, degraded_threshold=3, clock=lambda: clock[0],
+        )
+        for _ in range(3):
+            res.note_failure("http_500")
+        assert res.degraded()
+        clock[0] = 11.0
+        assert not res.degraded()  # the window drained
+
+    def test_mustgather_report_includes_breaker_and_retries(self):
+        res = ApiResilience()
+        res.note_retry("GET")
+        res.note_failure("transport")
+        report = res.report()
+        assert "breaker_state: closed" in report
+        assert "GET: 1" in report
+        assert "transport: 1" in report

@@ -41,17 +41,60 @@ def test_queue_add_after():
 
 
 def test_queue_rate_limit_backoff_grows():
-    q = RateLimitingQueue(base_delay=0.02, max_delay=1.0)
-    t0 = time.monotonic()
-    q.add_rate_limited("a")  # 0.02
+    """With full jitter the delay is uniform(0, base*2^n): the failure
+    count still grows the CAP exponentially, each delay stays under it,
+    and forget() drops the failure record."""
+    import random
+
+    q = RateLimitingQueue(base_delay=0.02, max_delay=1.0, rng=random.Random(7))
+
+    def scheduled_delay():
+        # assert on the queue's own schedule, not on wall-clock wakeup
+        # latency (a loaded CI runner adds tens of ms of scheduler slack)
+        with q._lock:
+            return q._delayed[0][0] - time.monotonic()
+
+    q.add_rate_limited("a")  # cap 0.02
+    assert scheduled_delay() <= 0.02
     assert q.get(1.0) == "a"
     q.done("a")
-    q.add_rate_limited("a")  # 0.04
+    q.add_rate_limited("a")  # cap 0.04
+    assert scheduled_delay() <= 0.04
     assert q.get(1.0) == "a"
     q.done("a")
-    assert time.monotonic() - t0 >= 0.06
+    assert q._failures.get("a") == 2  # the exponent kept growing
     q.forget("a")
     assert q._failures.get("a") is None
+
+
+def test_queue_rate_limit_jitter_desynchronizes():
+    """Thundering-herd protection: many items requeued at the same
+    failure count must NOT all become ready at the same instant —
+    asserted on the queue's OWN scheduled ready-times, so reverting
+    add_rate_limited to a deterministic schedule fails this test."""
+    import random
+
+    q = RateLimitingQueue(base_delay=0.5, max_delay=3.0, rng=random.Random(11))
+    for i in range(50):
+        q.add_rate_limited(f"item-{i}")  # all at failure count 0 -> cap 0.5
+    with q._lock:
+        ready_times = [t for t, _, _ in q._delayed]
+    assert len(ready_times) == 50
+    assert len({round(t, 3) for t in ready_times}) > 25  # spread, not a spike
+    assert max(ready_times) - min(ready_times) > 0.1  # genuinely desynchronized
+
+
+def test_queue_failures_map_is_bounded():
+    from tpu_operator.kube import queue as queue_mod
+
+    q = RateLimitingQueue(base_delay=0.001, max_delay=0.001)
+    for i in range(queue_mod._FAILURES_CAP + 100):
+        q.add_rate_limited(f"item-{i}")
+    assert len(q._failures) == queue_mod._FAILURES_CAP
+    # the OLDEST entries were evicted, the newest survive
+    assert "item-0" not in q._failures
+    q.shutdown()
+    assert not q._failures  # shutdown prunes everything
 
 
 def test_informer_cache_and_handlers():
@@ -238,7 +281,9 @@ def test_update_status_conflict_on_stale_resource_version():
 
 
 def test_requeue_true_backoff_grows():
-    q = RateLimitingQueue(base_delay=0.01, max_delay=1.0)
+    import random
+
+    q = RateLimitingQueue(base_delay=0.01, max_delay=1.0, rng=random.Random(3))
 
     class R:
         def __init__(self):
@@ -254,10 +299,13 @@ def test_requeue_true_backoff_grows():
     ctrl.start()
     q.add(Request(name="x"))
     time.sleep(0.3)
+    failures = q._failures.get(Request(name="x"), 0)  # before shutdown prunes
     ctrl.stop()
-    # with growing backoff the item cannot have run anywhere near 300ms/10ms times
-    assert 2 <= r.calls <= 12
-    assert q._failures.get(Request(name="x"), 0) >= 2
+    # with growing (jittered) backoff the item cannot have run anywhere
+    # near 300ms/10ms times — full jitter halves the expected delay, so
+    # the upper bound is looser than the old deterministic schedule's
+    assert 2 <= r.calls <= 20
+    assert failures >= 2
 
 
 def test_manager_informer_for_after_start_is_live():

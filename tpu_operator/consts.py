@@ -127,6 +127,38 @@ HEALTH_REPLAN_SECONDS = 30.0
 # collapse into one reconcile (a label sweep fans out one event per node)
 NODE_EVENT_COALESCE_SECONDS = 0.05
 
+# ---------------------------------------------------------------------------
+# Apiserver-client resilience (kube/retry.py + http_client.py): retry
+# budget and full-jitter backoff for idempotent verbs on 5xx/transport
+# errors, a per-request wall-clock deadline, and the circuit breaker
+# that fail-fasts while the apiserver is unreachable so controllers park
+# work via add_rate_limited instead of hot-looping on long timeouts.
+# ---------------------------------------------------------------------------
+API_RETRY_BUDGET = 4  # max re-sends of one logical request
+API_RETRY_BASE_DELAY_SECONDS = 0.1  # full-jitter backoff: uniform(0, base*2^n)
+API_RETRY_MAX_DELAY_SECONDS = 2.0
+API_REQUEST_DEADLINE_SECONDS = 20.0  # retries never push one request past this
+API_BREAKER_FAILURE_THRESHOLD = 5  # consecutive transport failures -> open
+API_BREAKER_RESET_SECONDS = 5.0  # open -> half-open probe interval
+# "apiserver degraded" window for the status condition: degraded while
+# the breaker is not closed, or this many request failures landed within
+# the window (retried-and-recovered attempts count — flakiness IS the
+# signal)
+API_DEGRADED_FAILURE_THRESHOLD = 3
+API_DEGRADED_WINDOW_SECONDS = 10.0
+REQUEUE_DEGRADED_SECONDS = 5.0  # re-check cadence while Degraded is set
+# slow heartbeat at the Ready terminal (controller-runtime SyncPeriod
+# analog): a quiet Ready cluster generates no events, so without it a
+# degradation that BEGINS while quiet (watch reconnects failing feed the
+# resilience state but enqueue nothing) would never surface as the
+# Degraded condition until some unrelated event landed. Costs one
+# cached-read reconcile (zero writes when nothing changed) per interval.
+READY_RESYNC_SECONDS = 60.0
+# watch-stream stall detection: no bytes (events, bookmarks, heartbeats)
+# for this long -> abandon the stream and re-list. Real apiservers
+# bookmark periodically; the in-repo fake heartbeats every ~5 s idle.
+WATCH_STALL_SECONDS = 300.0
+
 # Container runtimes (reference: getRuntime state_manager.go:714-751).
 RUNTIME_CONTAINERD = "containerd"
 RUNTIME_CRIO = "crio"

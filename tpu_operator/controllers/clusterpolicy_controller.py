@@ -24,6 +24,7 @@ from tpu_operator.catalog import InfoCatalog
 from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
+from tpu_operator.kube import retry as kube_retry
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
@@ -144,7 +145,15 @@ class ClusterPolicyReconciler:
             return Result(requeue_after=consts.REQUEUE_NO_TPU_NODES_SECONDS)
         self._update_status(obj, State.READY, reason="Ready",
                             message="all operand states are ready")
-        return Result()
+        if self._api_degraded():
+            # keep re-checking so the Degraded condition CLEARS once the
+            # apiserver recovers — a quiet Ready cluster generates no
+            # events to trigger the reconcile that would clear it
+            return Result(requeue_after=consts.REQUEUE_DEGRADED_SECONDS)
+        # slow heartbeat so a degradation that BEGINS while Ready and
+        # quiet (failing watch reconnects enqueue nothing) still gets a
+        # reconcile to surface it; a healthy pass costs zero writes
+        return Result(requeue_after=consts.READY_RESYNC_SECONDS)
 
     # -- helpers -------------------------------------------------------------
 
@@ -154,6 +163,13 @@ class ClusterPolicyReconciler:
             return True
         all_cps.sort(key=lambda o: (o["metadata"].get("creationTimestamp", ""), o["metadata"]["name"]))
         return all_cps[0]["metadata"]["name"] == obj["metadata"]["name"]
+
+    def _api_resilience(self):
+        return kube_retry.resilience_of(self.client)
+
+    def _api_degraded(self) -> bool:
+        res = self._api_resilience()
+        return bool(res) and res.degraded()
 
     def _update_status(
         self,
@@ -165,9 +181,24 @@ class ClusterPolicyReconciler:
     ) -> None:
         """reference: updateCRState clusterpolicy_controller.go:237."""
         previous = obj.get("status", {}).get("state")
+        res = self._api_resilience()
+        degraded = res.degraded() if res is not None else None
+        if degraded:
+            # the condition message must be BYTE-STABLE while degraded
+            # (live counters in it would defeat publish_status's
+            # write-on-change dedup and produce a status write per 5s
+            # requeue against the already-struggling apiserver); the
+            # volatile detail goes to the log + must-gather instead
+            broken = res.breaker.state != kube_retry.CircuitBreaker.CLOSED
+            detail = "apiserver requests failing; breaker " + ("open" if broken else "closed")
+            log.warning("apiserver degraded: %s", res.describe())
+        else:
+            detail = ""
         publish_status(
             self.client, obj, state, reason, message, error,
             extra={"namespace": self.namespace},
+            degraded=degraded,
+            degraded_detail=detail,
         )
         if previous != state:
             # kubectl-describe visibility for every state transition

@@ -13,6 +13,12 @@ from typing import List, Optional
 
 READY = "Ready"
 ERROR = "Error"
+# apiserver-connectivity degradation (chaos/resilience work): set while
+# the client's circuit breaker is not closed or request failures are
+# landing inside the degraded window, cleared on recovery. Orthogonal to
+# Ready — operands can be fully Ready while the control plane rides out
+# a 429 storm on cached reads.
+DEGRADED = "Degraded"
 
 
 def _now() -> str:
@@ -57,6 +63,17 @@ def set_error(conditions: Optional[List[dict]], reason: str, message: str) -> Li
     conditions = conditions if conditions is not None else []
     set_condition(conditions, READY, "False", reason, message)
     set_condition(conditions, ERROR, "True", reason, message)
+    return conditions
+
+
+def set_degraded(
+    conditions: Optional[List[dict]], degraded: bool, message: str = ""
+) -> List[dict]:
+    conditions = conditions if conditions is not None else []
+    if degraded:
+        set_condition(conditions, DEGRADED, "True", "ApiserverDegraded", message)
+    else:
+        set_condition(conditions, DEGRADED, "False", "ApiserverHealthy", message)
     return conditions
 
 

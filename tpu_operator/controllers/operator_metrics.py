@@ -77,6 +77,15 @@ class OperatorMetrics:
             "Health remediation attempts started",
             registry=reg,
         )
+        # apiserver-client resilience series, owned by the transport
+        # layer (kube/retry.py) the same way apiserver_requests_total is
+        # owned by http_client: process-wide on the default registry —
+        # re-exported here so the operator's metric surface is complete
+        # in one place and served from the manager's :8080 endpoint.
+        from tpu_operator.kube import retry as _retry
+
+        self.api_retries_total = _retry.retries_counter()
+        self.api_breaker_state = _retry.breaker_state_gauge()
 
     def record_success(self):
         self.reconciliation_total.inc()

@@ -27,6 +27,8 @@ def publish_status(
     message: str = "",
     error: bool = False,
     extra: Optional[dict] = None,
+    degraded: Optional[bool] = None,
+    degraded_detail: str = "",
 ) -> None:
     """Set status.state + Ready/Error conditions, writing only on change.
     The before-image is snapshotted up front — the condition helpers mutate
@@ -47,6 +49,12 @@ def publish_status(
         conditions.set_ready(conds, reason, message)
     else:
         conditions.set_not_ready(conds, reason or "NotReady", message)
+    if degraded is not None:
+        # apiserver-connectivity signal (kube/retry.ApiResilience): set
+        # while the client is riding out 429/5xx storms or an outage on
+        # retries + breaker + cached reads, cleared on recovery. None
+        # (in-memory clients, no resilience state) writes nothing.
+        conditions.set_degraded(conds, degraded, degraded_detail)
     status["state"] = state
     status.update(extra or {})
     if status == before:

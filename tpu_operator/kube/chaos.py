@@ -1,0 +1,354 @@
+"""Deterministic apiserver fault injection.
+
+The reference gpu-operator ships no fault injection at all (SURVEY.md
+§5); the closest it gets is a live-cluster e2e that happens to ride out
+real blips. This module makes failure a first-class, *reproducible* test
+input: a seeded ``ChaosDirector`` decides, per request, whether to
+inject a fault — 429 with Retry-After, 500/503, connection reset (clean
+or mid-body), 410 storms, added latency, watch-stream drops and silent
+hangs, and timed full-outage windows — from a scripted or probabilistic
+schedule, and records every injection in a fault log so tests can
+assert exactly what was survived.
+
+Plugging points:
+- ``FakeApiServer(chaos=director)`` injects at the HTTP layer — the
+  only place connection resets, watch hangs, and Retry-After headers
+  are physically expressible — so the real ``HttpClient`` retry/breaker
+  machinery is what gets exercised.
+- ``ChaosClient(inner, director)`` wraps any in-process ``Client`` and
+  raises the equivalent ``kube.errors`` for unit tests that don't want
+  a socket.
+
+Determinism: with a fixed seed and a fixed sequence of ``decide()``
+calls the fault log is bit-identical (the RNG is private and consulted
+in call order). Wall-clock-scheduled faults (outage windows, per-stream
+watch timers) depend on timing, so seeded-determinism assertions should
+drive the probabilistic/scripted rules directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+
+# fault classes a rule may inject (also the fault-log vocabulary;
+# "outage", "watch-drop", and "watch-hang" are scheduled, not ruled)
+FAULT_500 = "500"
+FAULT_503 = "503"
+FAULT_429 = "429"
+FAULT_410 = "410"
+FAULT_RESET = "reset"  # connection closed before any response bytes
+FAULT_RESET_BODY = "reset-body"  # response truncated mid-body
+FAULT_LATENCY = "latency"
+FAULT_OUTAGE = "outage"
+FAULT_WATCH_DROP = "watch-drop"
+FAULT_WATCH_HANG = "watch-hang"
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One line of the schedule. ``rate`` is the per-matching-request
+    probability; ``times`` > 0 caps total firings (``times`` with
+    ``rate=1.0`` is a scripted "fail the next N matching requests").
+    Empty ``verbs``/``kinds`` match everything."""
+
+    fault: str
+    rate: float = 1.0
+    times: int = 0  # 0 = unlimited
+    verbs: Tuple[str, ...] = ()  # HTTP methods: GET/POST/PUT/PATCH/DELETE
+    kinds: Tuple[str, ...] = ()
+    retry_after: float = 1.0  # 429/503 header value
+    latency: float = 0.0  # FAULT_LATENCY sleep
+    fired: int = dataclasses.field(default=0, compare=False)
+
+    def matches(self, verb: str, kind: str) -> bool:
+        if self.times and self.fired >= self.times:
+            return False
+        if self.verbs and verb not in self.verbs:
+            return False
+        if self.kinds and kind not in self.kinds:
+            return False
+        return True
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRecord:
+    seq: int
+    verb: str
+    kind: str
+    fault: str
+    detail: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    """What the transport should do to this request."""
+
+    fault: str
+    code: int = 0
+    retry_after: Optional[float] = None
+    latency: float = 0.0
+
+
+class _WatchChaos:
+    """Per-stream watch schedule: drop the stream after ``drop_after``
+    seconds of life, or go silent (no events, no heartbeats) after
+    ``hang_after`` for ``hang_duration`` — the fault the client's stall
+    detector exists for. During an outage every stream drops."""
+
+    def __init__(self, director: "ChaosDirector", kind: str):
+        self.director = director
+        self.kind = kind
+        self.born = time.monotonic()
+        self._hung_at: Optional[float] = None
+        self._hang_done = False
+
+    def check(self) -> Optional[str]:
+        d = self.director
+        now = time.monotonic()
+        if d._quiesced:
+            return None
+        if d.in_outage():
+            d._log(FAULT_OUTAGE, "WATCH", self.kind, "stream dropped by outage")
+            return "drop"
+        if d.watch_hang_after and not self._hang_done:
+            if self._hung_at is None and now - self.born >= d.watch_hang_after:
+                self._hung_at = now
+                d._log(FAULT_WATCH_HANG, "WATCH", self.kind,
+                       f"silent for {d.watch_hang_duration}s")
+            if self._hung_at is not None:
+                if now - self._hung_at < d.watch_hang_duration:
+                    return "hang"
+                self._hang_done = True
+        if d.watch_drop_every and now - self.born >= d.watch_drop_every:
+            d._log(FAULT_WATCH_DROP, "WATCH", self.kind,
+                   f"stream aged {now - self.born:.1f}s")
+            return "drop"
+        return None
+
+
+class ChaosDirector:
+    def __init__(
+        self,
+        seed: int = 0,
+        rules: Sequence[FaultRule] = (),
+        outages: Sequence[Tuple[float, float]] = (),  # (start_s, duration_s) after start()
+        watch_drop_every: float = 0.0,
+        watch_hang_after: float = 0.0,
+        watch_hang_duration: float = 0.0,
+    ):
+        self.seed = seed
+        self.rules = list(rules)
+        self.outages = tuple(outages)
+        self.watch_drop_every = watch_drop_every
+        self.watch_hang_after = watch_hang_after
+        self.watch_hang_duration = watch_hang_duration
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+        self._seq = 0
+        self._quiesced = False
+        self.fault_log: List[FaultRecord] = []
+
+    @classmethod
+    def standard(
+        cls,
+        seed: int,
+        outage_at: float = 8.0,
+        outage_duration: float = 30.0,
+        watch_drop_every: float = 10.0,
+        rate_scale: float = 1.0,
+    ) -> "ChaosDirector":
+        """The standard seeded fault schedule the chaos soak, the CI
+        gate, and bench's ``chaos_converge_s`` all run under: 5% 5xx
+        (half 500, half 503 with Retry-After), 2% 429+Retry-After
+        bursts, 1% 410s, 1% connection resets (a third mid-body),
+        periodic watch drops, and one full-outage window."""
+        r = rate_scale
+        return cls(
+            seed=seed,
+            rules=[
+                FaultRule(FAULT_500, rate=0.025 * r),
+                FaultRule(FAULT_503, rate=0.025 * r, retry_after=0.2),
+                FaultRule(FAULT_429, rate=0.02 * r, retry_after=0.1),
+                FaultRule(FAULT_410, rate=0.01 * r, verbs=("GET",)),
+                FaultRule(FAULT_RESET, rate=0.007 * r),
+                FaultRule(FAULT_RESET_BODY, rate=0.003 * r, verbs=("GET",)),
+            ],
+            outages=((outage_at, outage_duration),),
+            watch_drop_every=watch_drop_every,
+        )
+
+    # -- clock ---------------------------------------------------------------
+
+    def start(self) -> "ChaosDirector":
+        """Arm the wall-clock schedule (outage windows count from here);
+        called by the server on start, idempotent."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.monotonic()
+        return self
+
+    def quiesce(self) -> None:
+        """Stop injecting (the fault log is kept): the chaos run is
+        over and the cluster must now HEAL — soak tests quiesce after
+        convergence and assert the Degraded condition clears."""
+        with self._lock:
+            self._quiesced = True
+
+    def in_outage(self) -> bool:
+        with self._lock:
+            if self._t0 is None or self._quiesced:
+                return False
+            elapsed = time.monotonic() - self._t0
+        return any(start <= elapsed < start + dur for start, dur in self.outages)
+
+    def outage_seen(self) -> bool:
+        return any(rec.fault == FAULT_OUTAGE for rec in self.fault_log)
+
+    # -- decisions -----------------------------------------------------------
+
+    def _log(self, fault: str, verb: str, kind: str, detail: str = "") -> None:
+        with self._lock:
+            self._seq += 1
+            self.fault_log.append(FaultRecord(self._seq, verb, kind, fault, detail))
+
+    def decide(self, verb: str, kind: str) -> Optional[Injection]:
+        """Consulted once per unary request. Outage windows dominate
+        (everything is refused at the connection level); otherwise the
+        first matching rule that fires wins."""
+        if self.in_outage():
+            self._log(FAULT_OUTAGE, verb, kind, "connection refused")
+            return Injection(FAULT_RESET)
+        with self._lock:
+            if self._quiesced:
+                return None
+            rule = None
+            for candidate in self.rules:
+                if not candidate.matches(verb, kind):
+                    continue
+                if candidate.rate >= 1.0 or self._rng.random() < candidate.rate:
+                    rule = candidate
+                    rule.fired += 1
+                    break
+        if rule is None:
+            return None
+        self._log(rule.fault, verb, kind)
+        if rule.fault in (FAULT_500, FAULT_503):
+            return Injection(
+                rule.fault, code=int(rule.fault),
+                retry_after=rule.retry_after if rule.fault == FAULT_503 else None,
+            )
+        if rule.fault == FAULT_429:
+            return Injection(rule.fault, code=429, retry_after=rule.retry_after)
+        if rule.fault == FAULT_410:
+            return Injection(rule.fault, code=410)
+        if rule.fault == FAULT_LATENCY:
+            return Injection(rule.fault, latency=rule.latency)
+        return Injection(rule.fault)  # reset / reset-body
+
+    def watch_session(self, kind: str) -> _WatchChaos:
+        return _WatchChaos(self, kind)
+
+    # -- assertions ----------------------------------------------------------
+
+    def fired_classes(self) -> set:
+        return {rec.fault for rec in self.fault_log}
+
+    def configured_classes(self) -> set:
+        """Every fault class this schedule can produce — soak tests
+        assert fired == configured so no class silently never ran."""
+        classes = {rule.fault for rule in self.rules}
+        if self.outages:
+            classes.add(FAULT_OUTAGE)
+        if self.watch_drop_every:
+            classes.add(FAULT_WATCH_DROP)
+        if self.watch_hang_after:
+            classes.add(FAULT_WATCH_HANG)
+        return classes
+
+
+# HTTP method each Client verb rides (ChaosClient speaks Client, the
+# director's rule vocabulary is HTTP methods — same as the served path)
+_VERB_HTTP = {
+    "get": "GET", "list": "GET", "create": "POST", "update": "PUT",
+    "update_status": "PUT", "patch": "PATCH", "patch_status": "PATCH",
+    "delete": "DELETE", "evict": "POST",
+}
+
+
+class ChaosClient(Client):
+    """In-process chaos: wraps any ``Client`` and raises the error an
+    HTTP transport would surface for the injected fault. Watch-stream
+    faults (drop/hang) are transport artifacts and only exist on the
+    served path — ``watch`` here passes through untouched."""
+
+    def __init__(self, inner: Client, director: ChaosDirector):
+        self.inner = inner
+        self.director = director.start()
+
+    def _maybe_fault(self, verb: str, kind: str) -> None:
+        injection = self.director.decide(_VERB_HTTP[verb], kind)
+        if injection is None:
+            return
+        if injection.fault == FAULT_LATENCY:
+            time.sleep(injection.latency)
+            return
+        if injection.fault in (FAULT_RESET, FAULT_RESET_BODY):
+            raise errors.TransportError(
+                f"chaos: connection reset ({kind})",
+                retry_safe=injection.fault == FAULT_RESET,
+            )
+        if injection.code == 429:
+            raise errors.TooManyRequests("chaos: 429", retry_after=injection.retry_after)
+        if injection.code == 410:
+            raise errors.Expired("chaos: 410")
+        raise errors.ServerError(
+            f"chaos: HTTP {injection.code}", status=injection.code,
+            retry_after=injection.retry_after,
+        )
+
+    def get(self, api_version, kind, name, namespace=None):
+        self._maybe_fault("get", kind)
+        return self.inner.get(api_version, kind, name, namespace)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
+        self._maybe_fault("list", kind)
+        return self.inner.list(api_version, kind, namespace, label_selector, field_selector)
+
+    def create(self, obj):
+        self._maybe_fault("create", obj["kind"])
+        return self.inner.create(obj)
+
+    def update(self, obj):
+        self._maybe_fault("update", obj["kind"])
+        return self.inner.update(obj)
+
+    def update_status(self, obj):
+        self._maybe_fault("update_status", obj["kind"])
+        return self.inner.update_status(obj)
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        self._maybe_fault("patch", kind)
+        return self.inner.patch(api_version, kind, name, patch, namespace)
+
+    def patch_status(self, api_version, kind, name, patch, namespace=None):
+        self._maybe_fault("patch_status", kind)
+        return self.inner.patch_status(api_version, kind, name, patch, namespace)
+
+    def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
+        self._maybe_fault("delete", kind)
+        return self.inner.delete(api_version, kind, name, namespace, grace_period_seconds)
+
+    def evict(self, name, namespace):
+        self._maybe_fault("evict", "Pod")
+        return self.inner.evict(name, namespace)
+
+    def watch(self, api_version, kind, handler, namespace=None, replay=False):
+        return self.inner.watch(api_version, kind, handler, namespace, replay)

@@ -33,14 +33,62 @@ class Forbidden(ApiError):
     code = 403
 
 
+class TransportError(ApiError):
+    """Connection-level failure (refused, reset, timed out, closed
+    mid-exchange) — the request never produced an HTTP status. These are
+    what the client's circuit breaker counts: an apiserver that ANSWERS
+    (even with 5xx) has a working transport; one that doesn't is down.
+
+    ``retry_safe`` is False when response bytes had already started
+    arriving (reset mid-body): the mutation may have been applied, so
+    only the caller's idempotency reasoning — not the transport — can
+    justify a re-send."""
+
+    code = 0
+
+    def __init__(self, message: str = "", retry_safe: bool = True):
+        super().__init__(message)
+        self.retry_safe = retry_safe
+
+
+class ServerError(ApiError):
+    """5xx with an actual HTTP response (500/502/503/…): the server is
+    up but failing. Retryable for idempotent verbs; ``retry_after`` is
+    the parsed Retry-After header when the server sent one (503s from an
+    overloaded apiserver do)."""
+
+    code = 500
+
+    def __init__(self, message: str = "", status: int = 500, retry_after=None):
+        super().__init__(message)
+        self.code = status
+        self.retry_after = retry_after
+
+
+class BreakerOpen(ApiError):
+    """Fail-fast rejection from the client's own circuit breaker — the
+    request was never sent. Controllers treat it like any transient
+    ApiError (park the work via add_rate_limited); informer-cached reads
+    keep serving throughout."""
+
+    code = 0
+
+
 class Invalid(ApiError):
     code = 422
 
 
 class TooManyRequests(ApiError):
-    """Eviction blocked (typically by a PodDisruptionBudget) — retryable."""
+    """429: eviction blocked by a PodDisruptionBudget, or the apiserver
+    shedding load (priority & fairness). ``retry_after`` carries the
+    parsed Retry-After header when one was sent — the server's own
+    statement of when to come back, which the retry layer honors."""
 
     code = 429
+
+    def __init__(self, message: str = "", retry_after=None):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class Expired(ApiError):

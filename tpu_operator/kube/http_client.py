@@ -15,6 +15,7 @@ import collections
 import json
 import logging
 import os
+import random
 import ssl
 import threading
 import time
@@ -23,7 +24,8 @@ import urllib.parse
 import urllib.request
 from typing import List, Optional
 
-from tpu_operator.kube import errors
+from tpu_operator import consts
+from tpu_operator.kube import errors, retry
 from tpu_operator.kube.client import SYNC, Client, WatchHandler, WatchSubscription
 from tpu_operator.kube.objects import ObjectDict, api_group, is_cluster_scoped, nested_get
 
@@ -108,6 +110,17 @@ def plural_of(kind: str) -> str:
     return lower + "s"
 
 
+def _parse_retry_after(value) -> Optional[float]:
+    """Seconds form only (kube apiservers send integral seconds; the
+    HTTP-date form is not worth a date parser here)."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
+
+
 class _WatchSub(WatchSubscription):
     def __init__(self):
         self._stopped = threading.Event()
@@ -155,9 +168,22 @@ class HttpClient(Client):
         ca_path: Optional[str] = None,
         timeout: float = 30.0,
         token_path: Optional[str] = None,
+        retry_budget: int = consts.API_RETRY_BUDGET,
+        request_deadline: float = consts.API_REQUEST_DEADLINE_SECONDS,
+        watch_stall_seconds: float = consts.WATCH_STALL_SECONDS,
+        resilience: Optional[retry.ApiResilience] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # transport resilience: full-jitter retries for idempotent verbs
+        # on 5xx/transport errors (Retry-After honored on 429/503) under
+        # a per-request deadline, and a circuit breaker that fail-fasts
+        # while the apiserver is unreachable — see kube/retry.py
+        self.retry_budget = retry_budget
+        self.request_deadline = request_deadline
+        self.watch_stall_seconds = watch_stall_seconds
+        self.resilience = resilience or retry.ApiResilience()
+        self._retry_rng = random.Random()
         # bound SA tokens expire (~1h): with token_path set, the token
         # re-reads on a TTL and once more on any 401 (client-go refresh
         # behavior), so long-running agents never wedge on a stale token
@@ -358,7 +384,93 @@ class HttpClient(Client):
                     return
         conn.close()
 
+    # verbs a re-send cannot corrupt: GET reads, PUT is rv-guarded, a
+    # merge PATCH re-applied converges, DELETE tolerates NotFound (the
+    # retried-DELETE 404 normalization below). POST stays out — a
+    # double-create is real damage.
+    _IDEMPOTENT = frozenset({"GET", "PUT", "DELETE", "PATCH"})
+
     def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        query: Optional[dict] = None,
+        _raw: bool = False,
+        content_type: str = "application/json",
+    ):
+        """Resilient request: ``_request_once`` under the circuit breaker,
+        with bounded full-jitter retries for idempotent verbs on
+        transport errors and answered 5xx/429s (Retry-After honored),
+        all inside a per-request wall-clock deadline. Every failed
+        attempt — including ones a retry recovers — feeds the client's
+        degraded() signal; only transport failures feed the breaker."""
+        res = self.resilience
+        deadline = time.monotonic() + self.request_deadline
+        attempt = 0
+        while True:
+            res.breaker.before_request()  # raises BreakerOpen while open
+            try:
+                out = self._request_once(
+                    method, path, body, query,
+                    _resent=attempt > 0, _raw=_raw, content_type=content_type,
+                )
+            except errors.TransportError as e:
+                res.breaker.record_failure()
+                res.note_failure("transport")
+                # retry_safe=False (response started, mutation possibly
+                # applied) matters only for POST — which _IDEMPOTENT
+                # already excludes. For the verbs here a re-send is safe
+                # by the same reasoning as the answered-5xx branch: GET
+                # trivially, PUT is rv-guarded, PATCH converges, and a
+                # retried DELETE's 404 normalizes to success.
+                if method not in self._IDEMPOTENT:
+                    raise
+                last_err = e
+                delay = retry.full_jitter(
+                    attempt, consts.API_RETRY_BASE_DELAY_SECONDS,
+                    consts.API_RETRY_MAX_DELAY_SECONDS, self._retry_rng,
+                )
+            except (errors.ServerError, errors.TooManyRequests) as e:
+                res.breaker.record_success()  # the transport answered
+                # a 429 on a POST is (almost always) an APPLICATION
+                # answer — a PodDisruptionBudget blocking pods/eviction —
+                # not apiserver degradation: counting it would stamp
+                # Degraded=True on a healthy cluster mid-drain
+                if e.code != 429 or method in self._IDEMPOTENT:
+                    res.note_failure(f"http_{e.code}")
+                if method not in self._IDEMPOTENT:
+                    raise
+                last_err = e
+                # the server's own Retry-After beats our backoff guess
+                if getattr(e, "retry_after", None):
+                    delay = float(e.retry_after)
+                else:
+                    delay = retry.full_jitter(
+                        attempt, consts.API_RETRY_BASE_DELAY_SECONDS,
+                        consts.API_RETRY_MAX_DELAY_SECONDS, self._retry_rng,
+                    )
+            except errors.ApiError:
+                res.breaker.record_success()  # answered: 4xx/410/… are real answers
+                raise
+            except Exception:
+                # unanticipated failure mid-exchange (corrupt 2xx body in
+                # json.loads, token-file read error): count it as a
+                # failure so the breaker's half-open probe slot is always
+                # released — an escape with NEITHER record_* would wedge
+                # the breaker in HALF_OPEN/probe-in-flight forever
+                res.breaker.record_failure()
+                raise
+            else:
+                res.breaker.record_success()
+                return out
+            if attempt >= self.retry_budget or time.monotonic() + delay > deadline:
+                raise last_err
+            attempt += 1
+            res.note_retry(method)
+            time.sleep(delay)
+
+    def _request_once(
         self,
         method: str,
         path: str,
@@ -408,7 +520,8 @@ class HttpClient(Client):
                 else:
                     conn, pooled = self._new_conn(), False
             except OSError as e:
-                raise errors.ApiError(f"{method} {path}: {e}") from e
+                # connect-phase failure: nothing was sent, always retry-safe
+                raise errors.TransportError(f"{method} {path}: {e}") from e
             self._count_request(method)
             try:
                 conn.request(method, target, body=data, headers=headers)
@@ -422,18 +535,27 @@ class HttpClient(Client):
                 conn.close()
                 if pooled and method != "POST":
                     continue  # stale keep-alive: retry on a fresh connection
-                raise errors.ApiError(f"{method} {path}: {e}") from e
+                raise errors.TransportError(f"{method} {path}: {e}") from e
             except OSError as e:
                 conn.close()
-                raise errors.ApiError(f"{method} {path}: {e}") from e
+                raise errors.TransportError(f"{method} {path}: {e}") from e
             try:
                 payload = resp.read()  # drain fully so the conn can be reused
             except (OSError, http.client.HTTPException) as e:
                 # the response started (IncompleteRead/reset mid-body):
-                # never re-send, the mutation may have been applied
+                # the mutation may have been applied, so this single
+                # attempt never re-sends itself; retry_safe=False flags
+                # the ambiguity for callers whose verb is NOT idempotent
+                # (the retry layer re-sends idempotent verbs regardless
+                # — a duplicate GET/rv-guarded PUT/merge PATCH is safe)
                 conn.close()
-                raise errors.ApiError(f"{method} {path}: {e} (mid-response)") from e
+                raise errors.TransportError(
+                    f"{method} {path}: {e} (mid-response)", retry_safe=False
+                ) from e
             status = resp.status
+            retry_after = _parse_retry_after(
+                getattr(resp, "getheader", lambda *_: None)("Retry-After")
+            )
             self._checkin_conn(conn, reusable=not resp.will_close)
             if status < 400:
                 if _raw:  # plain-text endpoints (pods/log)
@@ -442,7 +564,7 @@ class HttpClient(Client):
             if status == 401 and _retry_auth and self.token_path:
                 # expired bound token: re-read once and retry the request
                 self._bearer(force_refresh=True)
-                return self._request(
+                return self._request_once(
                     method, path, body, query,
                     _retry_auth=False, _resent=resent, _raw=_raw,
                     content_type=content_type,
@@ -469,9 +591,14 @@ class HttpClient(Client):
             if status == 410:
                 raise errors.Expired(detail)
             if status == 429:
-                raise errors.TooManyRequests(detail)
+                raise errors.TooManyRequests(detail, retry_after=retry_after)
+            if status >= 500:
+                raise errors.ServerError(
+                    f"{method} {path}: HTTP {status}: {detail}",
+                    status=status, retry_after=retry_after,
+                )
             raise errors.ApiError(f"{method} {path}: HTTP {status}: {detail}")
-        raise errors.ApiError(f"{method} {path}: retry on fresh connection failed")
+        raise errors.TransportError(f"{method} {path}: retry on fresh connection failed")
 
     # -- Client API ----------------------------------------------------------
 
@@ -676,6 +803,17 @@ class HttpClient(Client):
             except errors.ApiError as e:
                 log.warning("watch %s: %s; re-listing", kind, e)
                 resource_version = ""
+            except TimeoutError as e:
+                # staleness detection: no bytes — no events, bookmarks,
+                # or heartbeats — for watch_stall_seconds. The server may
+                # have wedged the stream without closing it (a half-open
+                # TCP connection after an apiserver crash looks exactly
+                # like a quiet cluster); abandon it and re-list.
+                log.warning(
+                    "watch %s: stream stalled >%.0fs (%s); re-listing",
+                    kind, self.watch_stall_seconds, e,
+                )
+                resource_version = ""
             except Exception:  # noqa: BLE001 — watch loop must survive
                 log.exception("watch %s failed; re-listing", kind)
                 resource_version = ""
@@ -700,8 +838,14 @@ class HttpClient(Client):
         # server closes without delivering anything (bookmarks are
         # best-effort) must not force a full re-list on every watch
         # timeout (client-go resumes from lastSyncResourceVersion)
+        # the socket timeout doubles as the stall detector: a healthy
+        # stream always carries SOMETHING inside the window (events, or
+        # the server's idle bookmarks/heartbeats), so a read that times
+        # out means the stream silently wedged — the loop re-lists
         last_rv: Optional[str] = resource_version or None
-        with urllib.request.urlopen(req, timeout=300, context=self._ssl) as resp:
+        with urllib.request.urlopen(
+            req, timeout=self.watch_stall_seconds, context=self._ssl
+        ) as resp:
             buffer = b""
             while sub.active:
                 chunk = resp.read1(65536)

@@ -26,6 +26,7 @@ import collections
 import json
 import logging
 import queue
+import socket
 import threading
 import time
 import urllib.parse
@@ -33,12 +34,22 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from tpu_operator.kube import chaos as chaos_mod
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.http_client import plural_of
 from tpu_operator.kube.objects import api_group
 
 log = logging.getLogger(__name__)
+
+
+class _ChaosReset(Exception):
+    """Internal: abort this exchange at the connection level (chaos
+    'reset' / 'reset-body' faults and outage windows)."""
+
+    def __init__(self, mid_body: bool = False):
+        super().__init__("chaos reset")
+        self.mid_body = mid_body
 
 # kinds the operator and its operands touch; the reverse plural map is
 # built from these + the CRDs (anything else 404s loudly, which is what a
@@ -156,9 +167,14 @@ class FakeApiServer:
         port: int = 0,
         tls: bool = False,
         authorize: Optional[RbacAuthorizer] = None,
+        chaos: Optional["chaos_mod.ChaosDirector"] = None,
     ):
         self.client = client
         self.authorizer = authorize
+        # fault injection (kube/chaos.py): consulted per unary request
+        # and per watch-stream tick; sits in FRONT of authz and storage
+        # like a sick load balancer would
+        self.chaos = chaos
         self._plural_to_kind = _kind_map()
         self._stopped = threading.Event()
         # continue token -> remaining items of a paged LIST, captured as a
@@ -180,11 +196,13 @@ class FakeApiServer:
             def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
                 pass
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict, headers: Optional[dict] = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -218,6 +236,26 @@ class FakeApiServer:
                     self._send(403, {"reason": "Forbidden", "message": str(e)})
                 except errors.Invalid as e:
                     self._send(422, {"reason": "Invalid", "message": str(e)})
+                except _ChaosReset as fault:
+                    # kill the exchange at the connection level: the
+                    # mid-body flavor starts a response and truncates it
+                    # (the client must treat the mutation as possibly
+                    # applied); the plain flavor answers with nothing
+                    if fault.mid_body:
+                        try:
+                            self.send_response(200)
+                            self.send_header("Content-Type", "application/json")
+                            self.send_header("Content-Length", "1024")
+                            self.end_headers()
+                            self.wfile.write(b'{"partial":')
+                            self.wfile.flush()
+                        except OSError:
+                            pass
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away mid-stream
                 except Exception as e:  # noqa: BLE001 — surface as a 500
@@ -277,6 +315,8 @@ class FakeApiServer:
         return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "FakeApiServer":
+        if self.chaos is not None:
+            self.chaos.start()  # outage windows count from server start
         self._thread.start()
         return self
 
@@ -322,6 +362,43 @@ class FakeApiServer:
                 {"major": "1", "minor": "29", "gitVersion": "v1.29.0-fake"},
             )
         api_version, kind, namespace, name, sub = self._parse(raw_path)
+        is_watch = method == "GET" and name is None and query.get("watch") == ["true"]
+
+        if self.chaos is not None:
+            if is_watch:
+                # outage refuses the stream at connect; live streams get
+                # their drop/hang schedule from the session object below
+                if self.chaos.in_outage():
+                    self.chaos._log(
+                        chaos_mod.FAULT_OUTAGE, "WATCH", kind, "connect refused"
+                    )
+                    raise _ChaosReset()
+            else:
+                injection = self.chaos.decide(method, kind)
+                if injection is not None:
+                    if injection.fault == chaos_mod.FAULT_LATENCY:
+                        time.sleep(injection.latency)
+                    elif injection.fault == chaos_mod.FAULT_RESET:
+                        raise _ChaosReset()
+                    elif injection.fault == chaos_mod.FAULT_RESET_BODY:
+                        raise _ChaosReset(mid_body=True)
+                    else:
+                        reason = {
+                            429: "TooManyRequests",
+                            410: "Expired",
+                            500: "InternalError",
+                            503: "ServiceUnavailable",
+                        }.get(injection.code, "InternalError")
+                        extra = (
+                            {"Retry-After": f"{injection.retry_after:g}"}
+                            if injection.retry_after is not None
+                            else None
+                        )
+                        return handler._send(
+                            injection.code,
+                            {"reason": reason, "message": "chaos injection"},
+                            extra,
+                        )
 
         if self.authorizer is not None:
             resource = plural_of(kind) + (f"/{sub}" if sub else "")
@@ -530,9 +607,22 @@ class FakeApiServer:
         handler.send_header("Connection", "close")
         handler.end_headers()
         handler.wfile.flush()
+        session = self.chaos.watch_session(kind) if self.chaos is not None else None
         try:
             idle_ticks = 0
             while not self._stopped.is_set():
+                if session is not None:
+                    action = session.check()
+                    if action == "drop":
+                        return  # stream closes; the client must re-list
+                    if action == "hang":
+                        # go silent WITHOUT closing: no events, no
+                        # heartbeats — only the client's stall detector
+                        # can tell this from a quiet cluster. Queued
+                        # events stay queued (a real wedged stream
+                        # buffers too).
+                        time.sleep(0.1)
+                        continue
                 try:
                     batch = [events.get(timeout=0.5)]
                     idle_ticks = 0

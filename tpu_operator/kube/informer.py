@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, SYNC, Client
@@ -66,6 +67,13 @@ class Informer:
         self._sub = None
         self._synced = threading.Event()
         self._stopped = False
+        # staleness bookkeeping: when the last watch event (any type)
+        # and the last full SYNC snapshot landed — monotonic seconds.
+        # The transport's own stall detector (HttpClient
+        # watch_stall_seconds) is the primary recovery; these feed the
+        # manager's optional resync backstop and observability.
+        self.last_event_at: Optional[float] = None
+        self.last_sync_at: Optional[float] = None
         # serializes start/stop so a late lazy start (a cached read of a
         # new kind on a running manager) can never leak a watch past stop
         self._lifecycle = threading.Lock()
@@ -117,6 +125,35 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    def stale(self, threshold: float) -> bool:
+        """True when the watch has delivered NOTHING for ``threshold``
+        seconds after having synced once. Indistinguishable from a
+        genuinely quiet cluster by construction — callers use thresholds
+        comfortably above the server's heartbeat/bookmark cadence, and
+        the only action taken (``resync``) is correct either way."""
+        if not self._synced.is_set() or self.last_event_at is None:
+            return False
+        return time.monotonic() - self.last_event_at > threshold
+
+    def resync(self) -> None:
+        """Force a fresh snapshot: drop the current watch subscription
+        and re-subscribe (replay=True delivers a SYNC the cache applies
+        with Replace semantics). The recovery for a silently-stalled
+        watch the transport's own stall detector didn't catch."""
+        with self._lifecycle:
+            if self._stopped:
+                return
+            # the resync itself resets the staleness clock: without this
+            # a still-down apiserver would make the stall monitor churn a
+            # fresh watch subscription every tick instead of one recovery
+            # attempt per stall window
+            self.last_event_at = time.monotonic()
+            if self._sub is not None:
+                self._sub.stop()
+            self._sub = self.client.watch(
+                self.api_version, self.kind, self._on_event, self.namespace, replay=True
+            )
+
     # -- index maintenance (call with self._lock held) -----------------------
 
     def _index_add(self, key, obj: ObjectDict) -> None:
@@ -152,7 +189,9 @@ class Informer:
     # -- event path ----------------------------------------------------------
 
     def _on_event(self, event_type: str, obj: ObjectDict) -> None:
+        self.last_event_at = time.monotonic()
         if event_type == SYNC:
+            self.last_sync_at = self.last_event_at
             self._replace(obj.get("items") or [])
             return
         key = object_key(obj)

@@ -30,16 +30,29 @@ class LeaderElector:
         namespace: str = "tpu-operator",
         lease_duration: float = 15.0,
         renew_interval: float = 5.0,
+        renew_deadline: Optional[float] = None,
     ):
         self.client = client
         self.lease_name = lease_name
         self.namespace = namespace
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
+        # how long a LEADER rides out transient renew errors before
+        # deposing itself. Strictly less than lease_duration (client-go's
+        # RenewDeadline < LeaseDuration): the old leader gives up BEFORE
+        # any standby may acquire, so the exactly-one-active window has a
+        # gap, never an overlap.
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None else lease_duration * 2.0 / 3.0
+        )
         self.identity = f"{lease_name}-{uuid.uuid4().hex[:8]}"
         self._leading = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._last_renew = 0.0  # monotonic of the last SUCCESSFUL renew
+        self._depose_lock = threading.Lock()
+        self._deposed = False
         # Invoked (once) when leadership is LOST after having been held.
         # client-go treats this as fatal (OnStoppedLeading → exit); the
         # Manager wires this to a full shutdown.
@@ -48,6 +61,50 @@ class LeaderElector:
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name="leader-elector", daemon=True)
         self._thread.start()
+        # renew_deadline must be a WALL-CLOCK bound: the renew loop can
+        # sit blocked inside one apiserver call far longer than the
+        # deadline (a blackholed endpoint hangs the connect for the
+        # client's full timeout), during which the lease may expire and
+        # a standby acquire — the watchdog deposes on time regardless
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="leader-renew-watchdog", daemon=True
+        )
+        self._watchdog.start()
+
+    def _watchdog_loop(self) -> None:
+        interval = max(0.01, min(self.renew_interval, self.renew_deadline) / 4)
+        while not self._stop.wait(interval):
+            if (
+                self._leading.is_set()
+                and self._last_renew
+                and time.monotonic() - self._last_renew >= self.renew_deadline
+            ):
+                self._depose(only_if_deadline_exceeded=True)
+                if self._deposed:
+                    return
+
+    def _depose(self, only_if_deadline_exceeded: bool = False) -> None:
+        """Give up leadership exactly once (client-go OnStoppedLeading →
+        exit); callable from the renew loop and the watchdog. The
+        watchdog passes ``only_if_deadline_exceeded`` so the deadline is
+        RE-CHECKED under the lock: a renew that succeeded between the
+        watchdog's unlocked read and this call (updating _last_renew
+        under the same lock) must not be followed by a spurious depose
+        of a just-renewed leader."""
+        with self._depose_lock:
+            if self._deposed or not self._leading.is_set():
+                self._leading.clear()
+                return
+            if only_if_deadline_exceeded and (
+                not self._last_renew
+                or time.monotonic() - self._last_renew < self.renew_deadline
+            ):
+                return  # a renew landed concurrently; still leading
+            self._deposed = True
+            self._leading.clear()
+        log.error("leader election: lost lease %s", self.lease_name)
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
 
     def stop(self) -> None:
         self._stop.set()
@@ -65,19 +122,59 @@ class LeaderElector:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            if self._try_acquire_or_renew():
-                self._leading.set()
+            if self._deposed:
+                return
+            outcome = self._try_acquire_or_renew()  # True / False / None(transient)
+            now = time.monotonic()
+            if outcome:
+                # atomic with the watchdog's _depose: a renew that
+                # blocked past the deadline and then SUCCEEDED must not
+                # re-set _leading after on_stopped_leading already ran
+                # (the manager is tearing down)
+                with self._depose_lock:
+                    if self._deposed:
+                        return
+                    self._last_renew = now
+                    self._leading.set()
+            elif (
+                outcome is None
+                and self._leading.is_set()
+                and self._last_renew
+                and now - self._last_renew < self.renew_deadline
+            ):
+                # transient apiserver blip (5xx, transport error,
+                # breaker open) while we hold an unexpired lease: keep
+                # leading and retry — no standby can acquire before
+                # lease_duration passes, and we self-depose at
+                # renew_deadline, strictly earlier. client-go's
+                # RetryPeriod-until-RenewDeadline behavior.
+                log.warning(
+                    "leader election: renew failed transiently; retaining "
+                    "leadership (%.1fs since last renew, deadline %.1fs)",
+                    now - self._last_renew, self.renew_deadline,
+                )
             else:
                 was_leading = self._leading.is_set()
-                self._leading.clear()
                 if was_leading:
-                    log.error("leader election: lost lease %s", self.lease_name)
-                    if self.on_stopped_leading is not None:
-                        self.on_stopped_leading()
+                    self._depose()
                     return
+                self._leading.clear()
             self._stop.wait(self.renew_interval)
 
-    def _try_acquire_or_renew(self) -> bool:
+    def _try_acquire_or_renew(self) -> Optional[bool]:
+        """True: holding the lease. False: definitively not the holder
+        (someone else's unexpired lease, lost update race). None: the
+        apiserver couldn't answer — a transient error that must NOT read
+        as 'lease lost' (the old behavior let any unexpected ApiError
+        propagate and silently kill this thread, permanently wedging
+        leadership until process restart)."""
+        try:
+            return self._acquire_or_renew()
+        except errors.ApiError as e:
+            log.warning("leader election: transient apiserver error: %s", e)
+            return None
+
+    def _acquire_or_renew(self) -> bool:
         now = time.time()
         try:
             lease = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
@@ -114,14 +211,36 @@ class LeaderElector:
         try:
             self.client.update(lease)
             return True
-        except (errors.Conflict, errors.NotFound):
+        except errors.NotFound:
             return False
+        except errors.Conflict:
+            # A Conflict does NOT prove loss: the transport retry layer
+            # re-sends an rv-guarded PUT whose first send may have been
+            # APPLIED before the response was lost — the retry then 409s
+            # against our own successful write. Re-read and believe the
+            # lease itself (client-go re-gets before concluding loss):
+            # still our holderIdentity → we hold it; anything else →
+            # definitively lost. A transient error on the re-get
+            # propagates to _try_acquire_or_renew's None path.
+            try:
+                current = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
+            except errors.NotFound:
+                return False
+            return current.get("spec", {}).get("holderIdentity") == self.identity
 
     def _release(self) -> None:
-        try:
-            lease = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
-            if lease.get("spec", {}).get("holderIdentity") == self.identity:
-                lease["spec"]["holderIdentity"] = ""
-                self.client.update(lease)
-        except errors.ApiError:
-            pass
+        # one Conflict retry: a concurrent writer (renew racing stop, a
+        # standby probing) bumping the rv must not leave the lease held
+        # by a dead identity for a full lease_duration
+        for attempt in (0, 1):
+            try:
+                lease = self.client.get(LEASE_API, "Lease", self.lease_name, self.namespace)
+                if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                    lease["spec"]["holderIdentity"] = ""
+                    self.client.update(lease)
+                return
+            except errors.Conflict:
+                if attempt:
+                    return
+            except errors.ApiError:
+                return

@@ -29,13 +29,25 @@ class Manager:
         leader_election: bool = False,
         health_addr: Optional[Tuple[str, int]] = None,
         metrics_addr: Optional[Tuple[str, int]] = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+        renew_deadline: Optional[float] = None,
+        informer_stall_seconds: float = 0.0,
     ):
         self.client = client
         self.namespace = namespace
         self._informers: Dict[Tuple[str, str, str], Informer] = {}
         self._controllers: List[Controller] = []
         self._leader: Optional[LeaderElector] = (
-            LeaderElector(client, namespace=namespace) if leader_election else None
+            LeaderElector(
+                client,
+                namespace=namespace,
+                lease_duration=lease_duration,
+                renew_interval=renew_interval,
+                renew_deadline=renew_deadline,
+            )
+            if leader_election
+            else None
         )
         self._health_addr = health_addr
         self._metrics_addr = metrics_addr
@@ -45,6 +57,15 @@ class Manager:
         # never interleave with an in-progress start
         self._lifecycle = threading.RLock()
         self._stopping = False
+        # optional backstop for silently-stalled watches: a monitor
+        # thread resyncs any informer that delivered nothing for this
+        # long. Off by default — the transport's own stall detector
+        # (HttpClient watch_stall_seconds) is the primary recovery, and
+        # against the in-memory client a quiet cluster legitimately
+        # delivers nothing.
+        self._informer_stall_seconds = informer_stall_seconds
+        self._stall_stop = threading.Event()
+        self._stall_thread: Optional[threading.Thread] = None
 
     # -- building -----------------------------------------------------------
 
@@ -119,8 +140,28 @@ class Manager:
             informer.start()
         for controller in self._controllers:
             controller.start()
+        if self._informer_stall_seconds > 0:
+            self._stall_thread = threading.Thread(
+                target=self._stall_monitor, name="informer-stall-monitor", daemon=True
+            )
+            self._stall_thread.start()
         self._started.set()
         log.info("manager started: %d controllers, %d informers", len(self._controllers), len(self._informers))
+
+    def _stall_monitor(self) -> None:
+        interval = max(0.25, self._informer_stall_seconds / 4)
+        while not self._stall_stop.wait(interval):
+            for informer in list(self._informers.values()):
+                try:
+                    if informer.stale(self._informer_stall_seconds):
+                        log.warning(
+                            "informer %s/%s stalled >%.0fs; forcing re-list",
+                            informer.api_version, informer.kind,
+                            self._informer_stall_seconds,
+                        )
+                        informer.resync()
+                except Exception:  # noqa: BLE001 — the monitor must survive
+                    log.exception("informer stall check failed")
 
     def _on_stopped_leading(self) -> None:
         """Losing the lease while running is fatal, like client-go's
@@ -136,6 +177,7 @@ class Manager:
     def stop(self) -> None:
         with self._lifecycle:
             self._stopping = True
+            self._stall_stop.set()
             for controller in list(self._controllers):
                 controller.stop()
             for informer in list(self._informers.values()):

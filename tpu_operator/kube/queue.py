@@ -16,18 +16,32 @@ runs reads current state, so nothing coalesced away is lost.
 from __future__ import annotations
 
 import heapq
+import random
 import threading
 import time
 from typing import Any, Optional
 
+from tpu_operator.kube.retry import full_jitter
+
+# bound on the per-item failure map: items that error forever and are
+# never forget()-ed (deleted CRs, renamed nodes) must not accumulate
+# entries for the life of the process
+_FAILURES_CAP = 1024
+
 
 class RateLimitingQueue:
     def __init__(
-        self, base_delay: float = 0.1, max_delay: float = 3.0, coalesce_window: float = 0.0
+        self,
+        base_delay: float = 0.1,
+        max_delay: float = 3.0,
+        coalesce_window: float = 0.0,
+        rng: Optional[random.Random] = None,
     ):
         self._base = base_delay
         self._max = max_delay
         self._coalesce = coalesce_window
+        # full-jitter backoff needs a private RNG so tests can seed it
+        self._rng = rng or random.Random()
         self._lock = threading.Condition()
         self._queue: list = []  # FIFO of ready items
         self._dirty: set = set()  # items added while being processed
@@ -75,9 +89,17 @@ class RateLimitingQueue:
 
     def add_rate_limited(self, item: Any) -> None:
         with self._lock:
-            n = self._failures.get(item, 0)
+            # pop+reinsert keeps dict insertion order ≈ recency, so the
+            # cap below evicts the longest-untouched failure entries
+            n = self._failures.pop(item, 0)
             self._failures[item] = n + 1
-        self.add_after(item, min(self._base * (2**n), self._max))
+            while len(self._failures) > _FAILURES_CAP:
+                self._failures.pop(next(iter(self._failures)))
+        # FULL jitter (uniform over [0, cap]): after an outage ends,
+        # every parked item of every replica would otherwise requeue on
+        # the same exponential schedule and thundering-herd the
+        # recovering apiserver in lockstep
+        self.add_after(item, full_jitter(n, self._base, self._max, self._rng))
 
     def forget(self, item: Any) -> None:
         with self._lock:
@@ -90,6 +112,11 @@ class RateLimitingQueue:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
+                # shutdown preempts draining: a stopped controller (e.g.
+                # a deposed leader tearing down) must not keep handing
+                # parked items to workers — the new leader owns them now
+                if self._shutdown:
+                    return None
                 now = time.monotonic()
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, item = heapq.heappop(self._delayed)
@@ -104,8 +131,6 @@ class RateLimitingQueue:
                     self._in_queue.discard(item)
                     self._processing.add(item)
                     return item
-                if self._shutdown:
-                    return None
                 wait = None
                 if self._delayed:
                     wait = max(0.0, self._delayed[0][0] - now)
@@ -129,6 +154,7 @@ class RateLimitingQueue:
     def shutdown(self) -> None:
         with self._lock:
             self._shutdown = True
+            self._failures.clear()
             self._lock.notify_all()
 
     def __len__(self) -> int:

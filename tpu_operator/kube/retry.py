@@ -1,0 +1,248 @@
+"""Shared apiserver-client resilience: backoff, circuit breaker, health.
+
+client-go ships this in three layers (rest.Request retries + Retry-After
+honoring, the client-side rate limiter, and controller workqueue
+backoff); here the transport-level pieces live in one module so the HTTP
+client, the controllers, and must-gather all read the same state:
+
+- ``full_jitter``: AWS-style full-jitter exponential backoff — the delay
+  is uniform(0, min(cap, base*2^attempt)), so a fleet of clients retrying
+  the same brownout never synchronizes into a thundering herd.
+- ``CircuitBreaker``: closed → open after N CONSECUTIVE transport
+  failures (the apiserver not answering at all; an answered 5xx keeps
+  the transport "up") → half-open single probe after a cooldown →
+  closed on probe success. While open, requests fail fast with
+  ``errors.BreakerOpen`` instead of burning a full connect timeout per
+  attempt — controllers keep serving informer-cached reads and park
+  writes via ``RateLimitingQueue.add_rate_limited``.
+- ``ApiResilience``: per-client counters + the degraded() signal the
+  status publisher turns into the CR's ``Degraded`` condition.
+
+Metrics (process-wide, default registry — same pattern as
+``http_client._requests_counter``; surfaced via the manager's /metrics
+endpoint and re-exported by ``controllers.operator_metrics``):
+``tpu_operator_api_retries_total{verb}`` and
+``tpu_operator_api_breaker_state`` (0 closed, 1 half-open, 2 open).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+import threading
+import time
+from typing import Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+
+log = logging.getLogger(__name__)
+
+_RETRIES_TOTAL = None
+_BREAKER_STATE = None
+
+
+def retries_counter():
+    global _RETRIES_TOTAL
+    if _RETRIES_TOTAL is None:
+        import prometheus_client
+
+        _RETRIES_TOTAL = prometheus_client.Counter(
+            "tpu_operator_api_retries_total",
+            "Apiserver requests re-sent after a retryable failure",
+            ["verb"],
+        )
+    return _RETRIES_TOTAL
+
+
+def breaker_state_gauge():
+    global _BREAKER_STATE
+    if _BREAKER_STATE is None:
+        import prometheus_client
+
+        _BREAKER_STATE = prometheus_client.Gauge(
+            "tpu_operator_api_breaker_state",
+            "Apiserver-client circuit breaker state (0 closed, 1 half-open, 2 open)",
+        )
+    return _BREAKER_STATE
+
+
+def full_jitter(attempt: int, base: float, cap: float, rng: Optional[random.Random] = None) -> float:
+    """Full-jitter exponential backoff: uniform(0, min(cap, base*2^n))."""
+    upper = min(cap, base * (2 ** attempt))
+    return (rng or random).uniform(0.0, upper)
+
+
+class CircuitBreaker:
+    CLOSED = "closed"
+    HALF_OPEN = "half_open"
+    OPEN = "open"
+
+    _GAUGE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(
+        self,
+        failure_threshold: int = consts.API_BREAKER_FAILURE_THRESHOLD,
+        reset_seconds: float = consts.API_BREAKER_RESET_SECONDS,
+        clock=time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_seconds = reset_seconds
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.open_count = 0  # lifetime open transitions (must-gather)
+        self._probe_in_flight = False
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        try:
+            breaker_state_gauge().set(self._GAUGE_VALUE[state])
+        except Exception:  # noqa: BLE001 — metrics must never break IO
+            pass
+
+    def before_request(self) -> None:
+        """Admission check; raises ``errors.BreakerOpen`` to fail fast.
+        After the cooldown exactly ONE caller is admitted as the
+        half-open probe; its outcome decides closed vs re-open."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return
+            if self.state == self.OPEN and (
+                self._clock() - (self.opened_at or 0.0) >= self.reset_seconds
+            ):
+                self._set_state(self.HALF_OPEN)
+                self._probe_in_flight = False
+            if self.state == self.HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return
+            raise errors.BreakerOpen(
+                f"apiserver circuit breaker {self.state} "
+                f"({self.consecutive_failures} consecutive transport failures)"
+            )
+
+    def record_success(self) -> None:
+        """Any completed HTTP exchange — a 500 still proves the transport."""
+        with self._lock:
+            self.consecutive_failures = 0
+            self._probe_in_flight = False
+            if self.state != self.CLOSED:
+                log.info("apiserver breaker: probe succeeded, closing")
+                self._set_state(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            was_probe = self._probe_in_flight
+            self._probe_in_flight = False
+            if self.state == self.CLOSED and self.consecutive_failures < self.failure_threshold:
+                return
+            if self.state != self.OPEN:
+                log.warning(
+                    "apiserver breaker: OPEN after %d consecutive transport failures%s",
+                    self.consecutive_failures,
+                    " (half-open probe failed)" if was_probe else "",
+                )
+                self.open_count += 1
+                # stamped only on the TRANSITION into open: a straggler
+                # request that was already in flight when the breaker
+                # opened must not push the half-open probe (and with it
+                # recovery) out by another full cooldown when it fails
+                self.opened_at = self._clock()
+            self._set_state(self.OPEN)
+
+
+class ApiResilience:
+    """Per-client resilience state: the breaker plus failure/retry
+    accounting feeding the ``Degraded`` condition and must-gather."""
+
+    def __init__(
+        self,
+        breaker: Optional[CircuitBreaker] = None,
+        degraded_window: float = consts.API_DEGRADED_WINDOW_SECONDS,
+        degraded_threshold: int = consts.API_DEGRADED_FAILURE_THRESHOLD,
+        clock=time.monotonic,
+    ):
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.degraded_window = degraded_window
+        self.degraded_threshold = degraded_threshold
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.retries = collections.Counter()  # verb -> re-sends
+        self.failures = collections.Counter()  # error class -> attempts failed
+        self._recent: collections.deque = collections.deque()  # failure timestamps
+
+    def note_retry(self, verb: str) -> None:
+        with self._lock:
+            self.retries[verb] += 1
+        try:
+            retries_counter().labels(verb).inc()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def note_failure(self, kind: str) -> None:
+        """Record one failed request ATTEMPT (retried-and-recovered
+        attempts included: a flaky apiserver is degraded even when every
+        request eventually lands)."""
+        now = self._clock()
+        with self._lock:
+            self.failures[kind] += 1
+            self._recent.append(now)
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.degraded_window
+        while self._recent and self._recent[0] < cutoff:
+            self._recent.popleft()
+
+    def recent_failures(self) -> int:
+        with self._lock:
+            self._prune(self._clock())
+            return len(self._recent)
+
+    def degraded(self) -> bool:
+        if self.breaker.state != CircuitBreaker.CLOSED:
+            return True
+        return self.recent_failures() >= self.degraded_threshold
+
+    def describe(self) -> str:
+        """One-line summary for the Degraded condition message."""
+        return (
+            f"breaker={self.breaker.state} "
+            f"recent_failures={self.recent_failures()}/{self.degraded_window:.0f}s "
+            f"retries={sum(self.retries.values())}"
+        )
+
+    def report(self) -> str:
+        """Multi-line breaker/retry report (must-gather artifact)."""
+        lines = [
+            f"breaker_state: {self.breaker.state}",
+            f"breaker_consecutive_failures: {self.breaker.consecutive_failures}",
+            f"breaker_open_count: {self.breaker.open_count}",
+            f"degraded: {self.degraded()}",
+            f"recent_failures_{self.degraded_window:.0f}s: {self.recent_failures()}",
+            "retries_by_verb:",
+        ]
+        for verb, n in sorted(self.retries.items()):
+            lines.append(f"  {verb}: {n}")
+        lines.append("failed_attempts_by_class:")
+        for kind, n in sorted(self.failures.items()):
+            lines.append(f"  {kind}: {n}")
+        return "\n".join(lines) + "\n"
+
+
+def resilience_of(client) -> Optional[ApiResilience]:
+    """Find the transport-layer resilience state behind a (possibly
+    wrapped) client: CachedReadClient exposes ``.live``, the HTTP client
+    carries ``.resilience``. None for in-memory fakes."""
+    seen = set()
+    while client is not None and id(client) not in seen:
+        seen.add(id(client))
+        res = getattr(client, "resilience", None)
+        if res is not None:
+            return res
+        client = getattr(client, "live", None)
+    return None

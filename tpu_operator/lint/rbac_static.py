@@ -102,6 +102,8 @@ SUBJECT_ROOTS: Dict[str, Sequence[str]] = {
 # (e.g. Client.apply -> get+create+update), or they never run in a pod.
 EXCLUDED_MODULES = (
     "kube/http_client.py",
+    "kube/retry.py",
+    "kube/chaos.py",
     "kube/client.py",
     "kube/objects.py",
     "kube/errors.py",

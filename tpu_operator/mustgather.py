@@ -160,6 +160,19 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
     except Exception as e:  # noqa: BLE001 — the bundle must never fail on lint
         emit("lint-report.json", f"# collection failed: {e}\n")
 
+    # breaker/retry state of the collecting client itself: after a
+    # degraded-cluster collection this records what the transport rode
+    # out (retries by verb, breaker opens, failure classes) — the first
+    # artifact support reads when "the bundle took forever" IS the bug
+    from tpu_operator.kube.retry import resilience_of
+
+    res = resilience_of(client)
+    if res is not None:
+        try:
+            emit("api-resilience.txt", res.report())
+        except Exception as e:  # noqa: BLE001 — never fail the bundle
+            emit("api-resilience.txt", f"# collection failed: {e}\n")
+
     pod_logs = getattr(client, "pod_logs", None)
     if pod_logs is not None:
         try:
