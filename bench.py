@@ -427,6 +427,8 @@ def _compact_summary(out: dict) -> dict:
         "vs_baseline_kind": out["vs_baseline_kind"],
         "http_transport_s": out.get("http_transport_s"),
         "chaos_converge_s": out.get("chaos_converge_s"),
+        "placement_time_to_place_s": out.get("placement", {}).get("time_to_place_s"),
+        "placement_fragmentation": out.get("placement", {}).get("fragmentation"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -558,11 +560,166 @@ def chaos_smoke() -> int:
     return 0 if not missed else 1
 
 
+def bench_placement(
+    dims=(8, 8, 8),
+    seed: int = 20260803,
+    churn_cycles: int = 3,
+    churn_fraction: float = 0.33,
+):
+    """Topology-aware placement over a churned 512-host torus: fill the
+    pod with mixed-shape slices, then repeatedly evict a seeded random
+    subset and re-place fresh requests, timing every planning pass and
+    verifying the invariant that matters — zero double-booked hosts.
+
+    Runs the REAL planning path (PlacementEngine over labelled Node
+    objects, label deltas applied back like the controller would), not a
+    bare allocator loop, so gang re-validation cost at steady occupancy
+    is inside the measurement."""
+    import math
+    import random
+
+    from tpu_operator import consts as _consts
+    from tpu_operator.kube.sim import make_torus_nodes
+    from tpu_operator.placement.engine import PlacementEngine, PlacementPhase
+
+    shapes = ["4x4x4", "4x4x2", "2x2x2", "4x2x2", "2x2x1", "4x4x1"]
+    rng = random.Random(seed)
+    nodes = make_torus_nodes(dims)
+    nodes_by_name = {n["metadata"]["name"]: n for n in nodes}
+    slices: dict = {}
+    serial = 0
+
+    def new_slice(shape: str) -> str:
+        nonlocal serial
+        serial += 1
+        name = f"bench-{serial}"
+        slices[name] = {
+            "apiVersion": "tpu.google.com/v1alpha1",
+            "kind": "TPUSlice",
+            "metadata": {"name": name, "creationTimestamp": f"T{serial:06d}"},
+            "spec": {"placement": {"shape": shape, "priority": 0}},
+        }
+        return name
+
+    def apply_plan(plan) -> None:
+        for node_name, delta in plan.label_deltas.items():
+            labels = nodes_by_name[node_name]["metadata"].setdefault("labels", {})
+            for key, value in delta.items():
+                if value is None:
+                    labels.pop(key, None)
+                else:
+                    labels[key] = value
+        for name, status in plan.statuses.items():
+            if name in slices:
+                slices[name].setdefault("status", {})["placement"] = status
+
+    def overlap_violations() -> int:
+        violations = 0
+        claimed: dict = {}
+        for name, obj in slices.items():
+            st = (obj.get("status") or {}).get("placement") or {}
+            if st.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            shape = [int(d) for d in obj["spec"]["placement"]["shape"].split("x")]
+            assigned = st.get("nodes") or []
+            if len(assigned) != math.prod(shape):
+                violations += 1
+            for node_name in assigned:
+                if claimed.setdefault(node_name, name) != name:
+                    violations += 1
+                label_owner = (
+                    nodes_by_name[node_name]["metadata"].get("labels") or {}
+                ).get(_consts.PLACEMENT_LABEL)
+                if label_owner != name:
+                    violations += 1
+        return violations
+
+    def plan_once() -> tuple:
+        t0 = time.perf_counter()
+        plan = PlacementEngine(list(slices.values()), nodes).plan()
+        elapsed = time.perf_counter() - t0
+        apply_plan(plan)
+        return elapsed, plan
+
+    t_start = time.perf_counter()
+    times = []
+    # fill until two consecutive shapes bounce — steady high occupancy
+    misses = 0
+    while misses < 2:
+        name = new_slice(rng.choice(shapes))
+        elapsed, _ = plan_once()
+        times.append(elapsed)
+        st = (slices[name].get("status") or {}).get("placement") or {}
+        if st.get("phase") == PlacementPhase.SCHEDULED:
+            misses = 0
+        else:
+            misses += 1
+            del slices[name]  # keep the queue to real, placeable work
+            plan_once()
+    violations = overlap_violations()
+    # churn: evict a seeded third, re-place fresh mixed shapes
+    for _ in range(churn_cycles):
+        placed = sorted(
+            n for n, o in slices.items()
+            if ((o.get("status") or {}).get("placement") or {}).get("phase")
+            == PlacementPhase.SCHEDULED
+        )
+        evict = rng.sample(placed, max(1, int(len(placed) * churn_fraction)))
+        for name in evict:
+            del slices[name]
+        plan_once()  # the teardown pass (labels of deleted slices clear)
+        for _ in evict:
+            name = new_slice(rng.choice(shapes))
+            elapsed, _ = plan_once()
+            times.append(elapsed)
+            st = (slices[name].get("status") or {}).get("placement") or {}
+            if st.get("phase") != PlacementPhase.SCHEDULED:
+                del slices[name]
+                plan_once()
+        violations += overlap_violations()
+    scheduled = sum(
+        1 for o in slices.values()
+        if ((o.get("status") or {}).get("placement") or {}).get("phase")
+        == PlacementPhase.SCHEDULED
+    )
+    frag = PlacementEngine(list(slices.values()), nodes).plan().fragmentation
+    return {
+        "hosts": dims[0] * dims[1] * dims[2],
+        "slices_scheduled": scheduled,
+        "placements_attempted": len(times),
+        "time_to_place_s": round(statistics.median(times), 4),
+        "time_to_place_max_s": round(max(times), 4),
+        "fragmentation": max(frag.values()) if frag else 0.0,
+        "overlap_violations": violations,
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+    }
+
+
+def placement_smoke() -> int:
+    """CI gate (scripts/ci.sh): a full place/evict/re-place churn on the
+    simulated 512-host torus must finish inside the budget with zero
+    double-booked hosts — the regression shapes a broken allocator
+    produces (overlap) or an accidentally super-linear search (blown
+    budget)."""
+    budget_s = 120.0
+    result = bench_placement()
+    ok = result["overlap_violations"] == 0 and result["elapsed_s"] <= budget_s
+    print(json.dumps({
+        "metric": "placement_smoke",
+        "ok": ok,
+        "budget_s": budget_s,
+        **result,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def main() -> None:
     if "--scale-smoke" in sys.argv[1:]:
         raise SystemExit(scale_smoke())
     if "--chaos-smoke" in sys.argv[1:]:
         raise SystemExit(chaos_smoke())
+    if "--placement-smoke" in sys.argv[1:]:
+        raise SystemExit(placement_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -607,6 +764,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — a chaos failure must not
         # crash the whole nightly bench; record it as the chaos result
         chaos_block = {"error": f"{type(e).__name__}: {e}"}
+    # topology-aware placement over the churned 512-host torus:
+    # time-to-place + end-state fragmentation (gated by --placement-smoke)
+    try:
+        placement_block = bench_placement()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        placement_block = {"error": f"{type(e).__name__}: {e}"}
     details = tpu_details()
     details["multiprocess_distributed"] = _multiprocess_distributed_details()
     out = {
@@ -634,6 +797,7 @@ def main() -> None:
         "scale_http_transport": scale_http,
         "chaos_converge_s": chaos_block.get("chaos_converge_s"),
         "chaos": chaos_block,
+        "placement": placement_block,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

@@ -27,6 +27,12 @@ echo "== bench smoke: requests-per-reconcile stays flat 64 -> 256 nodes =="
 # O(changes) gate: fails when rpr[256] > 1.5 x rpr[64] — the regression
 # shape a reintroduced full-scan or full-object write produces
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --scale-smoke
+echo "== placement smoke: place/evict/re-place churn on a 512-host torus =="
+# topology gate: the full churn cycle must finish inside the budget with
+# ZERO double-booked hosts — the regression shapes a broken allocator
+# (overlap) or an accidentally super-linear block search (blown budget)
+# produce
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --placement-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

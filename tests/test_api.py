@@ -116,3 +116,13 @@ class TestCRDs:
             assert key in props, key
         assert props["libtpu"]["properties"]["installDir"] == {"type": "string"}
         assert crd["spec"]["scope"] == "Cluster"
+
+    def test_tpuslice_crd_placement_policy_is_enum(self):
+        from tpu_operator.api.crds import tpu_slice_crd
+
+        crd = tpu_slice_crd()
+        props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        policy = props["placement"]["properties"]["preemptionPolicy"]
+        # a typo'd policy must be rejected at admission, not silently
+        # degrade to Never and sit Unschedulable with no hint why
+        assert policy == {"type": "string", "enum": ["Never", "PreemptLower"]}

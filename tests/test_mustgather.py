@@ -83,6 +83,10 @@ def test_bundle_from_live_install(tmp_path):
         assert "tpu-0" in health_txt and "health=" in health_txt and "repair=" in health_txt
         events_txt = (tmp_path / "events.txt").read_text()
         assert "ClusterPolicy" in events_txt  # CR transition events landed
+        # the placement subsystem's queue + assignment dump rides too
+        placement_txt = (tmp_path / "placement.txt").read_text()
+        assert "# placement queue" in placement_txt
+        assert "# host assignments" in placement_txt
         pod_name = pod["metadata"]["name"]
         log_text = (tmp_path / "pod-logs" / f"{pod_name}.log").read_text()
         assert "line-1\nline-2\n" in log_text  # multi-container pods get headers
@@ -94,7 +98,7 @@ def test_bundle_from_live_install(tmp_path):
         stems = {w.split("/")[0] for w in written}
         assert {
             "version.txt", "all.txt",
-            "nodes.yaml", "node-labels.txt", "node-health.txt",
+            "nodes.yaml", "node-labels.txt", "node-health.txt", "placement.txt",
             "clusterpolicies.yaml", "tpuslices.yaml",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs",

@@ -297,3 +297,95 @@ class TestBootstrapEndToEnd:
         finally:
             mgr.stop()
             sim.stop()
+
+
+class TestTorusCoordsPublication:
+    """Placement-subsystem bootstrap: the host's ICI torus coordinate,
+    derived from the TPU VM contract's TPU_WORKER_ID + the slice
+    topology (row-major over the host grid)."""
+
+    def test_worker_id_maps_to_coords(self, dev_root, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")  # 16 chips, 4 hosts
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        client = FakeClient()
+        client.create(make_bare_node("bare-c0"))
+        NodeDiscoveryAgent(client, "bare-c0").apply_once()
+        labels = client.get("v1", "Node", "bare-c0")["metadata"]["labels"]
+        # host grid for 2x2x4 chips @ 4-chip (2x2x1) hosts = 1x1x4;
+        # worker 3 row-major = (0, 0, 3)
+        assert labels[consts.TORUS_COORDS_LABEL] == "0-0-3"
+
+    def test_missing_or_garbage_worker_id_degrades(self, dev_root, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")
+        for bad in (None, "nope", "99"):
+            if bad is None:
+                monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+            else:
+                monkeypatch.setenv("TPU_WORKER_ID", bad)
+            client = FakeClient()
+            client.create(make_bare_node("bare-c1"))
+            NodeDiscoveryAgent(client, "bare-c1").apply_once()
+            labels = client.get("v1", "Node", "bare-c1")["metadata"]["labels"]
+            assert consts.TORUS_COORDS_LABEL not in labels, bad
+            # identity labels still published — coords degrade, not block
+            assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v4-podslice"
+
+    def test_lost_worker_id_strips_stale_coords(self, dev_root, monkeypatch):
+        """Hardware still present but the id is no longer derivable: the
+        previously-published coordinate must NOT survive — the host may
+        have been re-provisioned into a different torus position."""
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        client = FakeClient()
+        client.create(make_bare_node("bare-c3"))
+        agent = NodeDiscoveryAgent(client, "bare-c3")
+        agent.apply_once()
+        assert client.get("v1", "Node", "bare-c3")["metadata"]["labels"][
+            consts.TORUS_COORDS_LABEL
+        ] == "0-0-3"
+        monkeypatch.delenv("TPU_WORKER_ID")
+        agent.apply_once()
+        labels = client.get("v1", "Node", "bare-c3")["metadata"]["labels"]
+        assert consts.TORUS_COORDS_LABEL not in labels
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v4-podslice"
+
+    def test_lost_topology_strips_stale_topology_and_coords(self, dev_root, monkeypatch):
+        """Re-provisioned host whose runtime no longer exposes
+        TPU_TOPOLOGY: the stale topology label must not survive — the
+        placement engine sizes the pool's host grid from it, and a grid
+        the host no longer belongs to corrupts every allocation there."""
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        client = FakeClient()
+        client.create(make_bare_node("bare-c4"))
+        agent = NodeDiscoveryAgent(client, "bare-c4")
+        agent.apply_once()
+        labels = client.get("v1", "Node", "bare-c4")["metadata"]["labels"]
+        assert labels[consts.TFD_TOPOLOGY_LABEL] == "2x2x4"
+        monkeypatch.delenv("TPU_TOPOLOGY")
+        agent.apply_once()
+        labels = client.get("v1", "Node", "bare-c4")["metadata"]["labels"]
+        assert consts.TFD_TOPOLOGY_LABEL not in labels
+        assert consts.TORUS_COORDS_LABEL not in labels
+        # directly probed facts survive: the hardware is still there
+        assert labels[consts.TFD_ACCELERATOR_TYPE_LABEL] == "tpu-v4-podslice"
+        assert labels[consts.TFD_CHIPS_PER_NODE_LABEL] == "4"
+
+    def test_hardware_gone_strips_coords_too(self, dev_root, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+        monkeypatch.setenv("TPU_TOPOLOGY", "2x2x4")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        client = FakeClient()
+        client.create(make_bare_node("bare-c2"))
+        agent = NodeDiscoveryAgent(client, "bare-c2")
+        agent.apply_once()
+        assert consts.TORUS_COORDS_LABEL in client.get("v1", "Node", "bare-c2")["metadata"]["labels"]
+        for i in range(4):
+            (dev_root / "dev" / f"accel{i}").unlink()
+        agent.apply_once()
+        labels = client.get("v1", "Node", "bare-c2")["metadata"]["labels"]
+        assert consts.TORUS_COORDS_LABEL not in labels

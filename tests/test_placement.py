@@ -1,0 +1,949 @@
+"""Topology-aware slice placement: torus allocator, planning engine,
+controller end-to-end, slice-manager consumption, nodepool determinism.
+
+The acceptance drill lives in tests/drill.py (priority preemption over
+the wire, run under the shipped RBAC gate in test_rbac_gate.py); the
+chaos rider lives in tests/test_chaos.py.
+"""
+
+import math
+import random
+
+from tpu_operator import consts
+from tpu_operator.api.tpuslice import (
+    TPU_SLICE_API_VERSION,
+    TPU_SLICE_KIND,
+    new_tpu_slice,
+)
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import make_torus_nodes, make_tpu_node
+from tpu_operator.nodepool import get_node_pools
+from tpu_operator.placement.engine import (
+    PlacementEngine,
+    PlacementPhase,
+    PreemptionPolicy,
+)
+from tpu_operator.placement.torus import (
+    Torus,
+    chip_topology_for,
+    host_grid_dims,
+    parse_shape,
+    worker_coords,
+)
+
+NS = "tpu-operator"
+
+
+def placement_slice(name, shape, priority=0, policy="Never", pool="", created=""):
+    obj = new_tpu_slice(
+        name,
+        {"placement": {
+            "shape": shape, "priority": priority,
+            "preemptionPolicy": policy, **({"pool": pool} if pool else {}),
+        }},
+    )
+    obj["metadata"]["creationTimestamp"] = created or "2026-01-01T00:00:00Z"
+    return obj
+
+
+def scheduled_nodes(status):
+    return ((status or {}).get("placement") or {}).get("nodes") or []
+
+
+def assert_no_double_booking(statuses, nodes):
+    """The acceptance invariant: no host serves two gangs — neither in
+    any status.placement nor in the node assignment labels."""
+    claimed = {}
+    for name, st in statuses.items():
+        if st.get("phase") != PlacementPhase.SCHEDULED:
+            continue
+        for node in st.get("nodes") or []:
+            assert claimed.setdefault(node, name) == name, (
+                f"host {node} booked by both {claimed[node]} and {name}"
+            )
+    by_label = {}
+    for node in nodes:
+        owner = (node["metadata"].get("labels") or {}).get(consts.PLACEMENT_LABEL)
+        if owner:
+            assert by_label.setdefault(node["metadata"]["name"], owner) == owner
+
+
+# ---------------------------------------------------------------------------
+# Torus geometry
+# ---------------------------------------------------------------------------
+
+
+class TestShapes:
+    def test_parse_shape(self):
+        assert parse_shape("4x4x4") == (4, 4, 4)
+        assert parse_shape("2x4") == (2, 4, 1)
+        assert parse_shape("8") == (8, 1, 1)
+        assert parse_shape("") is None
+        assert parse_shape("2x0x2") is None
+        assert parse_shape("axb") is None
+        assert parse_shape("1x2x3x4") is None
+
+    def test_host_grid_dims(self):
+        # v4/v5p: 4 chips per host as a 2x2x1 block
+        assert host_grid_dims("16x16x8", 4) == (8, 8, 8)
+        assert host_grid_dims("4x4x4", 4) == (2, 2, 4)
+        # v5e 2-D mesh, 4-chip hosts
+        assert host_grid_dims("4x4", 4) == (2, 2, 1)
+        # non-dividing axis: unknown wiring
+        assert host_grid_dims("3x4x4", 4) is None
+        assert host_grid_dims("garbage", 4) is None
+
+    def test_chip_topology_roundtrip(self):
+        assert chip_topology_for((8, 8, 8), 4) == "16x16x8"
+        # v4/v5p topology strings are 3-D by platform convention — a
+        # flat block keeps its trailing unit axis
+        assert chip_topology_for((2, 2, 1), 4) == "4x4x1"
+        # 2-D mesh generations (v5e/v6e) drop it
+        assert chip_topology_for((2, 2, 1), 4, topology_dims=2) == "4x4"
+        assert chip_topology_for((2, 2, 2), 4) == "4x4x2"
+
+    def test_worker_coords_row_major(self):
+        dims = (4, 2, 2)
+        seen = {worker_coords(i, dims) for i in range(16)}
+        assert len(seen) == 16
+        assert worker_coords(0, dims) == (0, 0, 0)
+        assert worker_coords(1, dims) == (1, 0, 0)
+        assert worker_coords(4, dims) == (0, 1, 0)
+        assert worker_coords(8, dims) == (0, 0, 1)
+
+
+class TestTorus:
+    def test_from_labelled_nodes(self):
+        nodes = make_torus_nodes((4, 2, 1))
+        torus = Torus.from_nodes(nodes)
+        assert torus.dims == (4, 2, 1)
+        assert torus.free_count() == 8
+        assert torus.node_at[(3, 1, 0)] == "tpu-7"
+
+    def test_unlabelled_pool_falls_back_deterministically(self):
+        nodes = [make_tpu_node(f"n{i}", "tpu-v4-podslice", "4x4x4") for i in range(8)]
+        a = Torus.from_nodes(list(nodes))
+        b = Torus.from_nodes(list(reversed(nodes)))
+        assert a.dims == b.dims == (2, 2, 2)
+        assert a.node_at == b.node_at
+
+    def test_fallback_layout_is_stable_under_membership_shrink(self):
+        """The fallback grid is anchored to the DECLARED host grid, not
+        the current member count: a pool losing its last-ranked member
+        must keep every other host's synthetic coordinate (a count-based
+        near-cubic grid would re-dimension (2,2,2)->(7,1,1) and tear down
+        every scheduled gang in the pool), and a scheduled gang on the
+        surviving hosts must stay intact through the engine."""
+        nodes = make_torus_nodes((2, 2, 2))
+        for node in nodes:
+            del node["metadata"]["labels"][consts.TORUS_COORDS_LABEL]
+        ts = placement_slice("gang", "2x2x1")
+        plan = PlacementEngine([ts], nodes).plan()
+        assert plan.statuses["gang"]["phase"] == PlacementPhase.SCHEDULED
+        self._apply_engine_plan(plan, nodes, [ts])
+        assert "tpu-7" not in plan.statuses["gang"]["nodes"]
+        survivors = [n for n in nodes if n["metadata"]["name"] != "tpu-7"]
+        plan2 = PlacementEngine([ts], survivors).plan()
+        assert "gang" not in plan2.teardowns, plan2.teardowns
+        assert plan2.statuses["gang"]["phase"] == PlacementPhase.SCHEDULED
+
+    @staticmethod
+    def _apply_engine_plan(plan, nodes, slices):
+        by_name = {n["metadata"]["name"]: n for n in nodes}
+        for node_name, delta in plan.label_deltas.items():
+            labels = by_name[node_name]["metadata"].setdefault("labels", {})
+            for key, value in delta.items():
+                if value is None:
+                    labels.pop(key, None)
+                else:
+                    labels[key] = value
+        for s in slices:
+            if s["metadata"]["name"] in plan.statuses:
+                s.setdefault("status", {})["placement"] = plan.statuses[s["metadata"]["name"]]
+
+    def test_half_labelled_pool_is_not_trusted(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        del nodes[0]["metadata"]["labels"][consts.TORUS_COORDS_LABEL]
+        torus = Torus.from_nodes(nodes)
+        # fallback layout, not a torus with a hole at (0,0,0)
+        assert len(torus.node_at) == 4 and torus.free_count() == 4
+
+    def test_exact_fit_packs_completely(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 2, 1)))
+        first, victims = torus.find_block(parse_shape("2x2x1"))
+        assert victims == frozenset()
+        torus.occupy("a", first.cells)
+        second, _ = torus.find_block(parse_shape("2x2x1"))
+        assert set(second.cells).isdisjoint(first.cells)
+        torus.occupy("b", second.cells)
+        assert torus.free_count() == 0
+        assert torus.find_block(parse_shape("1x1x1")) is None
+
+    def test_wraparound_block_is_found(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 1, 1)))
+        torus.occupy("mid", [(1, 0, 0), (2, 0, 0)])
+        found = torus.find_block(parse_shape("2x1x1"))
+        assert found is not None
+        block, _ = found
+        # only the wrapped pair (3,0,0)+(0,0,0) is free
+        assert set(block.cells) == {(3, 0, 0), (0, 0, 0)}
+
+    def test_mesh_pool_never_wraps(self):
+        """v5e/v6e are meshes without edge ICI links: a block folding
+        around the boundary would advertise a hop that doesn't exist."""
+        nodes = make_torus_nodes((4, 1, 1))
+        torus = Torus.from_nodes(nodes, wrap=False)
+        torus.occupy("mid", [(1, 0, 0), (2, 0, 0)])
+        # only the wrapped pair (3,0,0)+(0,0,0) would fit — rejected
+        assert torus.find_block(parse_shape("2x1x1")) is None
+        fresh = Torus.from_nodes(nodes, wrap=False)
+        found = fresh.find_block(parse_shape("4x1x1"))
+        assert found is not None  # non-wrapping blocks still place
+
+    def test_partial_pool_keeps_true_dims_no_fictional_wrap(self):
+        """A partially-registered pool must not shrink the torus to the
+        max labelled coordinate: that would invent wrap adjacency
+        between hosts that are really several hops apart. The declared
+        grid makes unregistered positions holes instead."""
+        nodes = [
+            n for n in make_torus_nodes((4, 1, 1))
+            if n["metadata"]["name"] != "tpu-3"
+        ]
+        torus = Torus.from_nodes(nodes, grid=(4, 1, 1))
+        assert torus.dims == (4, 1, 1)
+        torus.occupy("mid", [(1, 0, 0)])
+        # free cells are (0,0,0) and (2,0,0) — 2 hops apart on the true
+        # 4-wide ring; a max(coord)+1 torus would wrap them adjacent
+        assert torus.find_block(parse_shape("2x1x1")) is None
+
+    def test_rotation_fits_where_raw_shape_cannot(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 2, 1)))
+        found = torus.find_block(parse_shape("1x4x1"))  # must rotate onto x
+        assert found is not None
+        assert sorted(found[0].shape, reverse=True) == [4, 1, 1]
+
+    def test_impossible_shape(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 2, 1)))
+        assert torus.find_block(parse_shape("3x3x1")) is None
+        assert torus.find_block(parse_shape("8x1x1")) is None
+
+    def test_best_fit_prefers_snug_placement(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 4, 4)))
+        first, _ = torus.find_block(parse_shape("2x2x2"))
+        torus.occupy("a", first.cells)
+        second, _ = torus.find_block(parse_shape("2x2x2"))
+        # the next block must sit flush against the first (shares a face),
+        # not float in open space leaving slivers on both sides
+        adjacent = False
+        occupied = set(first.cells)
+        for (x, y, z) in second.cells:
+            for dx, dy, dz in ((1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0), (0, 0, 1), (0, 0, -1)):
+                if ((x + dx) % 4, (y + dy) % 4, (z + dz) % 4) in occupied:
+                    adjacent = True
+        assert adjacent, (first.cells, second.cells)
+
+    def test_unavailable_cells_are_neither_free_nor_victims(self):
+        torus = Torus.from_nodes(make_torus_nodes((2, 2, 1)))
+        torus.set_unavailable(["tpu-0"])
+        assert torus.free_count() == 3
+        assert torus.find_block(parse_shape("2x2x1")) is None
+        assert torus.find_block(parse_shape("2x2x1"), victim_ok=lambda o: True) is None
+
+    def test_fragmentation_metric(self):
+        torus = Torus.from_nodes(make_torus_nodes((4, 4, 1)))
+        assert torus.fragmentation() == 0.0  # empty = one free block
+        # checkerboard-ish scatter: plenty free, nothing contiguous
+        torus.occupy("x", [(x, y, 0) for x in range(4) for y in range(4) if (x + y) % 2])
+        assert torus.fragmentation() > 0.5
+        torus.release("x")
+        assert torus.fragmentation() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Node pool determinism (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestNodePoolDeterminism:
+    def test_pools_independent_of_informer_list_order(self):
+        """Placement decisions and gang worker ids both key off
+        get_node_pools output; a re-list returning the same nodes in a
+        different order must produce byte-identical pools — including
+        the representative info (it used to be first-seen input order)."""
+        nodes = make_torus_nodes((2, 2, 1), prefix="pool-a") + [
+            make_tpu_node(f"pool-b-{i}", "tpu-v5-lite-podslice", "4x4", nodepool="b")
+            for i in range(4)
+        ]
+        rng = random.Random(7)
+        baseline = get_node_pools(list(nodes))
+        for _ in range(5):
+            shuffled = list(nodes)
+            rng.shuffle(shuffled)
+            pools = get_node_pools(shuffled)
+            assert [p.name for p in pools] == [p.name for p in baseline]
+            for got, want in zip(pools, baseline):
+                assert got.node_names == want.node_names
+                assert got.info == want.info
+                assert got.info.node_name == want.node_names[0]
+
+
+# ---------------------------------------------------------------------------
+# Planning engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_mixed_shapes_never_double_book(self):
+        nodes = make_torus_nodes((4, 4, 2))
+        slices = [
+            placement_slice("a", "2x2x2", created="2026-01-01T00:00:01Z"),
+            placement_slice("b", "4x2x1", created="2026-01-01T00:00:02Z"),
+            placement_slice("c", "2x2x1", created="2026-01-01T00:00:03Z"),
+            placement_slice("d", "2x2x2", created="2026-01-01T00:00:04Z"),
+        ]
+        plan = PlacementEngine(slices, nodes).plan()
+        assert all(
+            st["phase"] == PlacementPhase.SCHEDULED for st in plan.statuses.values()
+        ), plan.statuses
+        assert_no_double_booking(plan.statuses, nodes)
+        assert plan.queue_depth == 0
+        for name, st in plan.statuses.items():
+            shape = parse_shape(st["shape"])
+            assert len(st["nodes"]) == math.prod(shape)
+
+    def test_priority_beats_fifo(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        slices = [
+            placement_slice("early-low", "2x2x1", priority=0, created="2026-01-01T00:00:01Z"),
+            placement_slice("late-high", "2x2x1", priority=10, created="2026-01-02T00:00:00Z"),
+        ]
+        plan = PlacementEngine(slices, nodes).plan()
+        assert plan.statuses["late-high"]["phase"] == PlacementPhase.SCHEDULED
+        assert plan.statuses["early-low"]["phase"] == PlacementPhase.UNSCHEDULABLE
+        assert plan.queue_depth == 1
+
+    def test_fifo_within_priority_band(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        slices = [
+            placement_slice("second", "2x2x1", created="2026-01-02T00:00:00Z"),
+            placement_slice("first", "2x2x1", created="2026-01-01T00:00:00Z"),
+        ]
+        plan = PlacementEngine(slices, nodes).plan()
+        assert plan.statuses["first"]["phase"] == PlacementPhase.SCHEDULED
+        assert plan.statuses["second"]["phase"] == PlacementPhase.UNSCHEDULABLE
+
+    def test_invalid_and_impossible_shapes_unschedulable(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        plan = PlacementEngine(
+            [placement_slice("bad", "axb"), placement_slice("big", "4x4x4")], nodes
+        ).plan()
+        assert plan.statuses["bad"]["phase"] == PlacementPhase.UNSCHEDULABLE
+        assert "invalid" in plan.statuses["bad"]["message"]
+        assert plan.statuses["big"]["phase"] == PlacementPhase.UNSCHEDULABLE
+
+    def test_preemption_evicts_minimal_victim_set(self):
+        """Two low-priority gangs fill the torus; a high-priority request
+        must displace EXACTLY one of them (the allocator ranks candidate
+        blocks by victim count), never both."""
+        nodes = make_torus_nodes((4, 2, 1))
+        low = [
+            placement_slice("low-a", "2x2x1", created="2026-01-01T00:00:01Z"),
+            placement_slice("low-b", "2x2x1", created="2026-01-01T00:00:02Z"),
+        ]
+        engine = PlacementEngine(low, nodes)
+        plan = engine.plan()
+        self._apply(plan, nodes, low)
+        high = placement_slice("high", "2x2x1", priority=5,
+                               policy=PreemptionPolicy.PREEMPT_LOWER,
+                               created="2026-01-03T00:00:00Z")
+        plan = PlacementEngine(low + [high], nodes).plan()
+        assert plan.statuses["high"]["phase"] == PlacementPhase.SCHEDULED
+        victims = [
+            n for n in ("low-a", "low-b")
+            if plan.statuses[n]["phase"] == PlacementPhase.QUEUED
+        ]
+        survivors = [
+            n for n in ("low-a", "low-b")
+            if plan.statuses[n]["phase"] == PlacementPhase.SCHEDULED
+        ]
+        assert len(victims) == 1 and len(survivors) == 1, plan.statuses
+        assert "preempted" in plan.statuses[victims[0]]["message"]
+        assert plan.teardowns == victims
+        assert_no_double_booking(plan.statuses, nodes)
+
+    def test_preemption_never_touches_equal_or_higher_priority(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        occupant = placement_slice("same-prio", "2x2x1", priority=5)
+        engine = PlacementEngine([occupant], nodes)
+        self._apply(engine.plan(), nodes, [occupant])
+        contender = placement_slice(
+            "contender", "2x2x1", priority=5,
+            policy=PreemptionPolicy.PREEMPT_LOWER, created="2026-01-02T00:00:00Z",
+        )
+        plan = PlacementEngine([occupant, contender], nodes).plan()
+        assert plan.statuses["contender"]["phase"] == PlacementPhase.UNSCHEDULABLE
+        assert plan.statuses["same-prio"]["phase"] == PlacementPhase.SCHEDULED
+
+    def test_never_policy_does_not_preempt(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        low = placement_slice("low", "2x2x1", priority=0)
+        engine = PlacementEngine([low], nodes)
+        self._apply(engine.plan(), nodes, [low])
+        high = placement_slice("high", "2x2x1", priority=10, created="2026-01-02T00:00:00Z")
+        plan = PlacementEngine([low, high], nodes).plan()
+        assert plan.statuses["high"]["phase"] == PlacementPhase.UNSCHEDULABLE
+        assert plan.statuses["low"]["phase"] == PlacementPhase.SCHEDULED
+
+    def test_quarantined_member_triggers_replacement(self):
+        """Health-integration satellite: a gang member entering repair
+        tears the gang down and the re-placement avoids the sick host."""
+        nodes = make_torus_nodes((4, 2, 1))
+        ts = placement_slice("gang", "2x2x1")
+        engine = PlacementEngine([ts], nodes)
+        plan = engine.plan()
+        self._apply(plan, nodes, [ts])
+        placed = set(plan.statuses["gang"]["nodes"])
+        sick = sorted(placed)[0]
+        for node in nodes:
+            if node["metadata"]["name"] == sick:
+                node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = "quarantined"
+        plan2 = PlacementEngine([ts], nodes).plan()
+        assert "gang" in plan2.teardowns
+        st = plan2.statuses["gang"]
+        assert st["phase"] == PlacementPhase.SCHEDULED  # re-placed same pass
+        assert sick not in st["nodes"]
+        # the sick host's assignment labels clear
+        assert plan2.label_deltas[sick][consts.PLACEMENT_LABEL] is None
+
+    def test_mesh_generation_never_wraps_through_engine(self):
+        """The engine derives wrap from the pool's accelerator family: a
+        v5e (mesh) pool must refuse the edge-spanning block a v4 torus
+        accepts."""
+        def chain_with_occupied_middle(accelerator):
+            nodes = make_torus_nodes((4, 1, 1), accelerator=accelerator)
+            mid = placement_slice("mid", "2x1x1", created="2026-01-01T00:00:01Z")
+            for name, index in (("tpu-1", "0"), ("tpu-2", "1")):
+                node = next(n for n in nodes if n["metadata"]["name"] == name)
+                node["metadata"]["labels"][consts.PLACEMENT_LABEL] = "mid"
+                node["metadata"]["labels"][consts.PLACEMENT_INDEX_LABEL] = index
+            new = placement_slice("new", "2x1x1", created="2026-01-01T00:00:02Z")
+            return PlacementEngine([mid, new], nodes).plan()
+
+        torus_plan = chain_with_occupied_middle("tpu-v4-podslice")
+        assert torus_plan.statuses["new"]["phase"] == PlacementPhase.SCHEDULED
+        assert set(torus_plan.statuses["new"]["nodes"]) == {"tpu-3", "tpu-0"}
+        mesh_plan = chain_with_occupied_middle("tpu-v5-lite-podslice")
+        assert mesh_plan.statuses["new"]["phase"] == PlacementPhase.UNSCHEDULABLE
+
+    def test_partially_registered_pool_through_engine(self):
+        """The engine sizes each pool's torus from its topology label,
+        so a scaling-up pool places only on really-contiguous hosts."""
+        nodes = [
+            n for n in make_torus_nodes((4, 1, 1))
+            if n["metadata"]["name"] != "tpu-3"
+        ]
+        mid = placement_slice("mid", "1x1x1", created="2026-01-01T00:00:01Z")
+        node1 = next(n for n in nodes if n["metadata"]["name"] == "tpu-1")
+        node1["metadata"]["labels"][consts.PLACEMENT_LABEL] = "mid"
+        node1["metadata"]["labels"][consts.PLACEMENT_INDEX_LABEL] = "0"
+        new = placement_slice("new", "2x1x1", created="2026-01-01T00:00:02Z")
+        plan = PlacementEngine([mid, new], nodes).plan()
+        # tpu-0 and tpu-2 are free but 2 hops apart on the true 4-ring
+        assert plan.statuses["new"]["phase"] == PlacementPhase.UNSCHEDULABLE
+
+    def test_equal_volume_shape_edit_triggers_replacement(self):
+        """An edited spec shape with the same host count must re-place
+        (the old block no longer matches the spec), while a pure
+        rotation of the placed shape must NOT (same block)."""
+        nodes = make_torus_nodes((4, 2, 1))
+        ts = placement_slice("gang", "4x1x1")
+        plan = PlacementEngine([ts], nodes).plan()
+        assert plan.statuses["gang"]["phase"] == PlacementPhase.SCHEDULED
+        self._apply(plan, nodes, [ts])
+        ts["spec"]["placement"]["shape"] = "1x4x1"  # rotation: same block
+        plan2 = PlacementEngine([ts], nodes).plan()
+        assert "gang" not in plan2.teardowns
+        ts["spec"]["placement"]["shape"] = "2x2x1"  # same volume, new geometry
+        plan3 = PlacementEngine([ts], nodes).plan()
+        assert "gang" in plan3.teardowns
+        st = plan3.statuses["gang"]
+        assert st["phase"] == PlacementPhase.SCHEDULED and st["shape"] == "2x2x1"
+
+    def test_stale_status_shape_does_not_tear_down_valid_gang(self):
+        """Gang validity is judged from node labels alone: after a
+        shape-edit re-place whose STATUS write failed (5xx), the next
+        pass sees labels forming a valid block of the spec shape but a
+        status still naming the old shape — it must converge the status,
+        not tear the healthy new block down again on every pass."""
+        nodes = make_torus_nodes((4, 2, 1))
+        ts = placement_slice("gang", "2x2x1")
+        plan = PlacementEngine([ts], nodes).plan()
+        assert plan.statuses["gang"]["phase"] == PlacementPhase.SCHEDULED
+        self._apply(plan, nodes, [ts])
+        # labels applied, but the status write never landed: status still
+        # records the pre-edit shape
+        ts["status"]["placement"]["shape"] = "4x1x1"
+        plan2 = PlacementEngine([ts], nodes).plan()
+        assert "gang" not in plan2.teardowns
+        st = plan2.statuses["gang"]
+        assert st["phase"] == PlacementPhase.SCHEDULED and st["shape"] == "2x2x1"
+        assert sorted(st["nodes"]) == sorted(scheduled_nodes(ts.get("status")))
+
+    def test_pool_repin_triggers_replacement(self):
+        nodes = (
+            make_torus_nodes((2, 2, 1), prefix="a", nodepool="pool-a")
+            + make_torus_nodes((2, 2, 1), prefix="b", nodepool="pool-b")
+        )
+        pool_names = [p.name for p in get_node_pools(nodes)]
+        ts = placement_slice("gang", "2x2x1")
+        plan = PlacementEngine([ts], nodes).plan()
+        self._apply(plan, nodes, [ts])
+        placed = plan.statuses["gang"]["pool"]
+        other = next(p for p in pool_names if p != placed)
+        ts["spec"]["placement"]["pool"] = other
+        plan2 = PlacementEngine([ts], nodes).plan()
+        assert "gang" in plan2.teardowns
+        st = plan2.statuses["gang"]
+        assert st["phase"] == PlacementPhase.SCHEDULED and st["pool"] == other
+
+    def test_split_gang_from_crash_mid_apply_is_replaced(self):
+        """Count/index/pool checks all pass on a SPLIT gang — a crash
+        between the label writes of a teardown + re-place leaves old and
+        new members sharing the owner label with unique indexes. The
+        geometry check must catch it and re-place."""
+        nodes = make_torus_nodes((4, 2, 1))
+        ts = placement_slice("gang", "2x2x1")
+        # members straddle two opposite edges with worker order that
+        # matches no row-major block anchored at index 0
+        members = {"tpu-0": "0", "tpu-3": "1", "tpu-4": "2", "tpu-7": "3"}
+        for node in nodes:
+            index = members.get(node["metadata"]["name"])
+            if index is not None:
+                node["metadata"]["labels"][consts.PLACEMENT_LABEL] = "gang"
+                node["metadata"]["labels"][consts.PLACEMENT_INDEX_LABEL] = index
+        plan = PlacementEngine([ts], nodes).plan()
+        assert "gang" in plan.teardowns, "split gang accepted as intact"
+        st = plan.statuses["gang"]
+        assert st["phase"] == PlacementPhase.SCHEDULED  # re-placed same pass
+        assert_no_double_booking(plan.statuses, nodes)
+
+    def test_intact_wrapped_gang_is_not_torn_down(self):
+        """The geometry check must accept a legitimately wrapped block
+        exactly as the engine writes it (cells anchored at the origin)."""
+        nodes = make_torus_nodes((4, 1, 1))
+        ts = placement_slice("gang", "2x1x1")
+        # the engine's own wrapped placement: origin (3,0,0), then (0,0,0)
+        for name, index in (("tpu-3", "0"), ("tpu-0", "1")):
+            node = next(n for n in nodes if n["metadata"]["name"] == name)
+            node["metadata"]["labels"][consts.PLACEMENT_LABEL] = "gang"
+            node["metadata"]["labels"][consts.PLACEMENT_INDEX_LABEL] = index
+        plan = PlacementEngine([ts], nodes).plan()
+        assert "gang" not in plan.teardowns
+        assert plan.statuses["gang"]["phase"] == PlacementPhase.SCHEDULED
+
+    def test_orphaned_assignments_cleared(self):
+        nodes = make_torus_nodes((2, 2, 1))
+        for node in nodes:
+            node["metadata"]["labels"][consts.PLACEMENT_LABEL] = "ghost"
+            node["metadata"]["labels"][consts.PLACEMENT_INDEX_LABEL] = "0"
+        plan = PlacementEngine([], nodes).plan()
+        for node in nodes:
+            delta = plan.label_deltas[node["metadata"]["name"]]
+            assert delta[consts.PLACEMENT_LABEL] is None
+
+    @staticmethod
+    def _apply(plan, nodes, slices):
+        """Apply a plan back onto the in-memory objects, the way the
+        controller would against the apiserver."""
+        by_name = {n["metadata"]["name"]: n for n in nodes}
+        for node_name, delta in plan.label_deltas.items():
+            labels = by_name[node_name]["metadata"].setdefault("labels", {})
+            for key, value in delta.items():
+                if value is None:
+                    labels.pop(key, None)
+                else:
+                    labels[key] = value
+        by_slice = {s["metadata"]["name"]: s for s in slices}
+        for name, status in plan.statuses.items():
+            if name in by_slice:
+                by_slice[name].setdefault("status", {})["placement"] = status
+
+
+# ---------------------------------------------------------------------------
+# Controller end-to-end on the fake apiserver
+# ---------------------------------------------------------------------------
+
+
+class TestPlacementController:
+    def _seed(self, client, dims=(4, 2, 1)):
+        for node in make_torus_nodes(dims):
+            client.create(node)
+
+    def test_reconcile_places_and_publishes(self):
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("train", "2x2x1"))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train")
+        st = ts["status"]["placement"]
+        assert st["phase"] == PlacementPhase.SCHEDULED
+        assert len(st["nodes"]) == 4 and st["pool"]
+        for index, node_name in enumerate(st["nodes"]):
+            labels = client.get("v1", "Node", node_name)["metadata"]["labels"]
+            assert labels[consts.PLACEMENT_LABEL] == "train"
+            assert labels[consts.PLACEMENT_INDEX_LABEL] == str(index)
+            assert labels[consts.PLACEMENT_TOPOLOGY_LABEL] == "4x4x1"  # v4: 3-D string
+
+    def test_reconcile_is_idempotent(self):
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("train", "2x2x1"))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        before = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train")
+        node_rvs = {
+            n["metadata"]["name"]: n["metadata"].get("resourceVersion")
+            for n in client.list("v1", "Node")
+        }
+        rec.reconcile(QUEUE_REQUEST)
+        after = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train")
+        assert after["status"]["placement"] == before["status"]["placement"]
+        for node in client.list("v1", "Node"):
+            assert node["metadata"].get("resourceVersion") == node_rvs[node["metadata"]["name"]], (
+                "idempotent pass re-wrote node labels"
+            )
+
+    def test_deleted_slice_releases_hosts(self):
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("gone", "2x2x1"))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        client.delete(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "gone")
+        rec.reconcile(QUEUE_REQUEST)
+        for node in client.list("v1", "Node"):
+            assert consts.PLACEMENT_LABEL not in (node["metadata"].get("labels") or {})
+
+    def test_queue_metrics_published(self):
+        import prometheus_client
+
+        client = FakeClient()
+        self._seed(client, dims=(2, 2, 1))
+        client.create(placement_slice("fits", "2x2x1", created="2026-01-01T00:00:00Z"))
+        client.create(placement_slice("waits", "2x2x1", created="2026-01-02T00:00:00Z"))
+        rec = PlacementReconciler(client, NS)
+        result = rec.reconcile(QUEUE_REQUEST)
+        depth = prometheus_client.REGISTRY.get_sample_value(
+            "tpu_operator_placement_queue_depth"
+        )
+        assert depth == 1.0
+        assert result.requeue_after == consts.PLACEMENT_REPLAN_SECONDS
+        (pool,) = get_node_pools(client.list("v1", "Node"))
+        frag = prometheus_client.REGISTRY.get_sample_value(
+            "tpu_operator_torus_fragmentation", {"pool": pool.name}
+        )
+        assert frag is not None
+
+    def test_failed_status_patch_requeues(self):
+        """Once labels converge nothing re-enqueues the queue, so a
+        swallowed status-write failure must force a requeue or the
+        status stays stale forever."""
+        from tpu_operator.kube import errors
+
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("train", "2x2x1"))
+        rec = PlacementReconciler(client, NS)
+        real_patch_status = client.patch_status
+
+        def failing_patch_status(*args, **kwargs):
+            raise errors.ApiError("injected status-write failure")
+
+        client.patch_status = failing_patch_status
+        result = rec.reconcile(QUEUE_REQUEST)
+        assert result.requeue, "failed status write did not requeue"
+        client.patch_status = real_patch_status
+        result = rec.reconcile(QUEUE_REQUEST)
+        assert not result.requeue
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train")
+        assert ts["status"]["placement"]["phase"] == PlacementPhase.SCHEDULED
+
+    def test_fragmentation_series_removed_with_pool(self):
+        import prometheus_client
+
+        client = FakeClient()
+        self._seed(client, dims=(2, 2, 1))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        (pool,) = get_node_pools(client.list("v1", "Node"))
+        sample = lambda: prometheus_client.REGISTRY.get_sample_value(
+            "tpu_operator_torus_fragmentation", {"pool": pool.name}
+        )
+        assert sample() is not None
+        for node in client.list("v1", "Node"):
+            client.delete("v1", "Node", node["metadata"]["name"])
+        rec.reconcile(QUEUE_REQUEST)
+        assert sample() is None, "drained pool kept exporting fragmentation"
+
+    def test_index_label_damage_heals_over_watch(self):
+        """Mangling an assignment index label must trigger a replan via
+        the watch predicate — nothing else re-enqueues a settled queue."""
+        import time
+
+        from tpu_operator.controllers.placement_controller import setup_with_manager
+        from tpu_operator.kube.manager import Manager
+
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("train", "2x2x1"))
+        mgr = Manager(client)
+        setup_with_manager(mgr, PlacementReconciler(client, NS))
+        mgr.start()
+        try:
+            def gang_indexes():
+                return sorted(
+                    labels[consts.PLACEMENT_INDEX_LABEL]
+                    for n in client.list("v1", "Node")
+                    if (labels := n["metadata"].get("labels") or {}).get(
+                        consts.PLACEMENT_LABEL
+                    ) == "train" and consts.PLACEMENT_INDEX_LABEL in labels
+                )
+
+            deadline = time.time() + 20
+            while time.time() < deadline and gang_indexes() != ["0", "1", "2", "3"]:
+                time.sleep(0.1)
+            assert gang_indexes() == ["0", "1", "2", "3"]
+            victim = next(
+                n["metadata"]["name"] for n in client.list("v1", "Node")
+                if (n["metadata"].get("labels") or {}).get(
+                    consts.PLACEMENT_INDEX_LABEL
+                ) == "3"
+            )
+            client.patch("v1", "Node", victim, {"metadata": {"labels": {
+                consts.PLACEMENT_INDEX_LABEL: "0",  # duplicate worker id
+            }}})
+            deadline = time.time() + 20
+            while time.time() < deadline and gang_indexes() != ["0", "1", "2", "3"]:
+                time.sleep(0.1)
+            assert gang_indexes() == ["0", "1", "2", "3"], (
+                "damaged index labels never healed"
+            )
+        finally:
+            mgr.stop()
+
+    def test_wiped_status_republished_over_watch(self):
+        """An externally wiped status.placement (CRD structural pruning,
+        manual status edit) must be re-published by the watch — a
+        settled queue has nothing else to re-enqueue it."""
+        import time
+
+        from tpu_operator.controllers.placement_controller import setup_with_manager
+        from tpu_operator.kube.manager import Manager
+
+        client = FakeClient()
+        self._seed(client)
+        client.create(placement_slice("train", "2x2x1"))
+        mgr = Manager(client)
+        setup_with_manager(mgr, PlacementReconciler(client, NS))
+        mgr.start()
+        try:
+            def phase():
+                ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train")
+                return ((ts.get("status") or {}).get("placement") or {}).get("phase")
+
+            deadline = time.time() + 20
+            while time.time() < deadline and phase() != PlacementPhase.SCHEDULED:
+                time.sleep(0.1)
+            assert phase() == PlacementPhase.SCHEDULED
+            client.patch_status(
+                TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "train",
+                {"status": {"placement": None}},
+            )
+            deadline = time.time() + 20
+            while time.time() < deadline and phase() != PlacementPhase.SCHEDULED:
+                time.sleep(0.1)
+            assert phase() == PlacementPhase.SCHEDULED, (
+                "wiped status.placement never re-published"
+            )
+        finally:
+            mgr.stop()
+
+    def test_preemption_over_fake_apiserver(self):
+        client = FakeClient()
+        self._seed(client, dims=(2, 2, 1))
+        client.create(placement_slice("low", "2x2x1", priority=0))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        client.create(placement_slice(
+            "high", "2x2x1", priority=9,
+            policy=PreemptionPolicy.PREEMPT_LOWER, created="2026-01-02T00:00:00Z",
+        ))
+        rec.reconcile(QUEUE_REQUEST)
+        high = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "high")
+        low = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "low")
+        assert high["status"]["placement"]["phase"] == PlacementPhase.SCHEDULED
+        assert low["status"]["placement"]["phase"] in (
+            PlacementPhase.QUEUED, PlacementPhase.UNSCHEDULABLE
+        )
+        for node_name in high["status"]["placement"]["nodes"]:
+            labels = client.get("v1", "Node", node_name)["metadata"]["labels"]
+            assert labels[consts.PLACEMENT_LABEL] == "high"
+        # a preemption event landed on the victim (cluster-scoped CR
+        # events land in "default" per apiserver rules)
+        events = client.list("v1", "Event")
+        assert any(e.get("reason") == "PlacementPreempted" for e in events), [
+            e.get("reason") for e in events
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Slice-manager consumption of assignments + health exclusion
+# ---------------------------------------------------------------------------
+
+
+class TestSliceManagerPlacement:
+    def _seed_assigned(self, client):
+        """A 4-host pool where the placement controller assigned 2 hosts
+        to gang 'train-a' — with index order deliberately OPPOSITE the
+        alphabetical node order, to prove worker ids follow the torus."""
+        for i, node in enumerate(make_torus_nodes((4, 1, 1), prefix="host")):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+        for name, index in (("host-1", "1"), ("host-0", "0")):
+            client.patch("v1", "Node", name, {"metadata": {"labels": {
+                consts.PLACEMENT_LABEL: "train-a",
+                consts.PLACEMENT_INDEX_LABEL: index,
+                consts.PLACEMENT_TOPOLOGY_LABEL: "4x2",
+            }}})
+        # make index order differ from name order on purpose
+        client.patch("v1", "Node", "host-0", {"metadata": {"labels": {
+            consts.PLACEMENT_INDEX_LABEL: "1",
+        }}})
+        client.patch("v1", "Node", "host-1", {"metadata": {"labels": {
+            consts.PLACEMENT_INDEX_LABEL: "0",
+        }}})
+
+    def test_assigned_gang_replaces_implicit_pool(self):
+        from tpu_operator.agents.slice_manager_agent import (
+            WORKER_ID_LABEL,
+            SliceManagerAgent,
+        )
+
+        client = FakeClient()
+        self._seed_assigned(client)
+        agent = SliceManagerAgent(client, NS)
+        names = agent.reconcile_once()
+        # ONE gang — the placement's — not the implicit whole-pool gang
+        assert names == ["tpu-slice-train-a"], names
+        cm = client.get("v1", "ConfigMap", "tpu-slice-train-a-gang", NS)
+        assert cm["data"]["TPU_SLICE_HOSTS"] == "2"
+        assert cm["data"]["TPU_TOPOLOGY"] == "4x2"  # the placed block, not the pool
+        # worker ids follow the placement index (torus order), not names
+        assert client.get("v1", "Node", "host-1")["metadata"]["labels"][WORKER_ID_LABEL] == "0"
+        assert client.get("v1", "Node", "host-0")["metadata"]["labels"][WORKER_ID_LABEL] == "1"
+        # unassigned pool members get no worker identity
+        for name in ("host-2", "host-3"):
+            assert WORKER_ID_LABEL not in client.get("v1", "Node", name)["metadata"]["labels"]
+
+    def test_quarantined_placement_member_defers_gang(self):
+        """A placed gang whose member the health subsystem excluded must
+        DEFER, not materialize short: the assignment labels are all still
+        present (cluster-wide completeness passes), but publishing the
+        survivors would pair the block's full TPU_TOPOLOGY with a
+        truncated hostlist (libtpu hang) and renumber worker ids off the
+        block's ICI order. The placement engine re-places the gang; until
+        then its plumbing stays down."""
+        from tpu_operator.agents.slice_manager_agent import (
+            WORKER_ID_LABEL,
+            SliceManagerAgent,
+        )
+
+        client = FakeClient()
+        self._seed_assigned(client)
+        agent = SliceManagerAgent(client, NS)
+        assert agent.reconcile_once() == ["tpu-slice-train-a"]
+        client.patch("v1", "Node", "host-0", {"metadata": {"labels": {
+            consts.REPAIR_STATE_LABEL: "quarantined",
+        }}})
+        assert agent.reconcile_once() == []
+        assert client.get_or_none("v1", "ConfigMap", "tpu-slice-train-a-gang", NS) is None
+        for name in ("host-0", "host-1"):
+            labels = client.get("v1", "Node", name)["metadata"]["labels"]
+            assert WORKER_ID_LABEL not in labels, name
+
+    def test_quarantined_member_leaves_gang_and_loses_worker_id(self):
+        """A quarantined member makes the implicit gang defer entirely:
+        a shrunk hostlist under the pool's full TPU_TOPOLOGY would hang
+        libtpu init on every surviving worker, and no placement engine
+        stands behind an implicit gang to re-place it. Teardown, then
+        re-materialize whole when the node heals."""
+        from tpu_operator.agents.slice_manager_agent import (
+            WORKER_ID_LABEL,
+            SliceManagerAgent,
+        )
+
+        client = FakeClient()
+        for node in make_torus_nodes((4, 1, 1), prefix="host"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+        agent = SliceManagerAgent(client, NS)
+        (gang,) = agent.reconcile_once()
+        assert client.get("v1", "Node", "host-2")["metadata"]["labels"][WORKER_ID_LABEL] == "2"
+        # the health subsystem quarantines a member
+        client.patch("v1", "Node", "host-2", {"metadata": {"labels": {
+            consts.REPAIR_STATE_LABEL: "quarantined",
+        }}})
+        assert agent.reconcile_once() == []
+        for name in ("host-0", "host-1", "host-2", "host-3"):
+            labels = client.get("v1", "Node", name)["metadata"]["labels"]
+            assert WORKER_ID_LABEL not in labels, (
+                f"{name} kept a worker identity in a torn-down gang"
+            )
+        assert client.get_or_none("v1", "ConfigMap", f"{gang}-gang", NS) is None
+        assert client.get_or_none("v1", "Service", gang, NS) is None
+        # repair completes: the gang comes back whole
+        client.patch("v1", "Node", "host-2", {"metadata": {"labels": {
+            consts.REPAIR_STATE_LABEL: None,
+        }}})
+        assert agent.reconcile_once() == [gang]
+        cm = client.get("v1", "ConfigMap", f"{gang}-gang", NS)
+        assert cm["data"]["TPU_SLICE_HOSTS"] == "4"
+        assert client.get("v1", "Node", "host-2")["metadata"]["labels"][WORKER_ID_LABEL] == "2"
+
+    def test_half_written_assignment_defers_gang(self):
+        """The controller patches assignment labels one node at a time;
+        a reconcile landing mid-write must not materialize a short gang
+        (full-block topology + truncated hostlist hangs libtpu on every
+        worker) NOR fall back to the implicit whole-pool gang."""
+        from tpu_operator.agents.slice_manager_agent import (
+            WORKER_ID_LABEL,
+            SliceManagerAgent,
+        )
+
+        client = FakeClient()
+        for node in make_torus_nodes((4, 1, 1), prefix="host"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            client.create(node)
+        # crashed after labelling 1 of the 2 hosts of a 4x2x1 block
+        client.patch("v1", "Node", "host-0", {"metadata": {"labels": {
+            consts.PLACEMENT_LABEL: "train-a",
+            consts.PLACEMENT_INDEX_LABEL: "0",
+            consts.PLACEMENT_TOPOLOGY_LABEL: "4x2x1",
+        }}})
+        agent = SliceManagerAgent(client, NS)
+        assert agent.reconcile_once() == []
+        assert WORKER_ID_LABEL not in client.get("v1", "Node", "host-0")["metadata"]["labels"]
+        # the remaining label lands: the complete gang materializes
+        client.patch("v1", "Node", "host-1", {"metadata": {"labels": {
+            consts.PLACEMENT_LABEL: "train-a",
+            consts.PLACEMENT_INDEX_LABEL: "1",
+            consts.PLACEMENT_TOPOLOGY_LABEL: "4x2x1",
+        }}})
+        assert agent.reconcile_once() == ["tpu-slice-train-a"]
+        cm = client.get("v1", "ConfigMap", "tpu-slice-train-a-gang", NS)
+        assert cm["data"]["TPU_SLICE_HOSTS"] == "2"

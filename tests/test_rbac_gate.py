@@ -397,6 +397,40 @@ class TestOperatorUnderEnforcement:
         finally:
             server.stop()
 
+    # the placement drill's admin half provisions TPUSlice CRs (kubectl
+    # territory on a real cluster); the operator side only reads them
+    # and patches their status
+    PLACEMENT_HARNESS_RULES = [
+        {
+            "apiGroups": ["tpu.google.com"],
+            "resources": ["tpuslices"],
+            "verbs": ["create", "delete"],
+        },
+    ]
+
+    def test_placement_drill_runs_under_enforcement(self):
+        """The placement controller's whole verb surface — TPUSlice
+        reads, tpuslices/status patches, node assignment-label patches,
+        Events — exercised by the priority-preemption drill over the
+        wire under the shipped operator rules (harness-side node/CR
+        provisioning gets its own slice, as in the other drills)."""
+        from drill import assert_placement_drill_passed, run_placement_drill
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(
+            shipped_rules() + self.HARNESS_RULES + self.PLACEMENT_HARNESS_RULES
+        )
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            obs = run_placement_drill(client, NS)
+            assert_placement_drill_passed(obs)
+            assert not authorizer.denials, (
+                f"ClusterRole gaps in the placement path: {sorted(set(authorizer.denials))}"
+            )
+        finally:
+            server.stop()
+
     def test_cert_lifecycle_under_enforcement(self, tmp_path):
         """The webhook cert manager's full converge path (Secret adopt/
         publish, VWC caBundle patch) runs under the shipped rules — the

@@ -58,6 +58,16 @@ _VM_TYPE_PATTERNS: Tuple[Tuple[str, str, int], ...] = (
 # 2D slice topologies by chip count (v5e/v6e podslice shapes). 3D
 # generations (v4/v5p) are ambiguous by count alone and require
 # TPU_TOPOLOGY.
+# every label discover() can emit — the strip-when-underivable set; the
+# other TFD_LABELS (slice-hosts, generation) are the tfd operand's richer
+# publication and are never this agent's to remove while hardware remains
+_SELF_PUBLISHED_LABELS = (
+    consts.TFD_ACCELERATOR_TYPE_LABEL,
+    consts.TFD_TOPOLOGY_LABEL,
+    consts.TFD_CHIPS_PER_NODE_LABEL,
+    consts.TORUS_COORDS_LABEL,
+)
+
 _2D_TOPOLOGY_BY_CHIPS = {
     1: "1x1",
     4: "2x2",
@@ -125,7 +135,31 @@ class NodeDiscoveryAgent:
         labels[consts.TFD_ACCELERATOR_TYPE_LABEL] = acc_type
         if topology:
             labels[consts.TFD_TOPOLOGY_LABEL] = topology
+            coords = self._torus_coords(topology, chips)
+            if coords:
+                labels[consts.TORUS_COORDS_LABEL] = coords
         return labels
+
+    @staticmethod
+    def _torus_coords(topology: str, chips_per_host: int) -> str:
+        """This host's coordinate on the slice's host grid, from the TPU
+        VM runtime contract's TPU_WORKER_ID (workers enumerate row-major
+        over the host grid). Empty when the id is absent/garbage or the
+        grid can't be derived — placement then degrades to the
+        deterministic row-major fallback layout, it never blocks."""
+        worker_env = os.environ.get("TPU_WORKER_ID", "").strip()
+        if not worker_env:
+            return ""
+        try:
+            worker_id = int(worker_env)
+        except ValueError:
+            return ""
+        from tpu_operator.placement.torus import host_grid_dims, worker_coords
+
+        dims = host_grid_dims(topology, chips_per_host)
+        if dims is None or worker_id < 0 or worker_id >= dims[0] * dims[1] * dims[2]:
+            return ""
+        return "-".join(str(c) for c in worker_coords(worker_id, dims))
 
     # -- publication ---------------------------------------------------------
 
@@ -149,7 +183,8 @@ class NodeDiscoveryAgent:
             # probed facts (chip count), never the env/count-derived
             # identity guesses — a guessed accelerator-type could persist
             # wrongly whenever tfd is disabled or hasn't run yet.
-            if labels.get(consts.GKE_TPU_ACCELERATOR_LABEL):
+            gke_owned = bool(labels.get(consts.GKE_TPU_ACCELERATOR_LABEL))
+            if gke_owned:
                 want = {
                     k: v
                     for k, v in want.items()
@@ -159,8 +194,22 @@ class NodeDiscoveryAgent:
                 if labels.get(key) != value:
                     labels[key] = value
                     changed = True
+            if not gke_owned:
+                # hardware still present but a fact this agent itself
+                # publishes is no longer derivable (worker id lost, the
+                # runtime's TPU_TOPOLOGY env gone after re-provisioning):
+                # a stale identity is worse than none — a stale topology
+                # would keep sizing the placement torus for a grid the
+                # host no longer belongs to, and a stale coordinate would
+                # claim a position the host may no longer hold. Strip
+                # only discovery's own keys: slice-hosts/generation
+                # belong to the richer tfd operand publication.
+                for key in _SELF_PUBLISHED_LABELS:
+                    if key not in want and key in labels:
+                        del labels[key]
+                        changed = True
         elif not labels.get(consts.GKE_TPU_ACCELERATOR_LABEL):
-            for key in consts.TFD_LABELS:
+            for key in consts.TFD_LABELS + (consts.TORUS_COORDS_LABEL,):
                 if key in labels:
                     del labels[key]
                     changed = True

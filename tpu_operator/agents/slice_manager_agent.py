@@ -132,17 +132,47 @@ class SliceManagerAgent:
         node_labels = {
             n["metadata"]["name"]: n["metadata"].get("labels") or {} for n in nodes
         }
-        pools = get_node_pools(nodes)
+        # hosts the health subsystem took out of service (quarantined or
+        # mid-repair, or flagged degraded) leave their gang NOW: keeping
+        # a sick member in the hostlist hangs every peer's collectives,
+        # and its stale worker-id label would survive quarantine forever
+        healthy = [
+            n for n in nodes
+            if not self._out_of_service(node_labels[n["metadata"]["name"]])
+        ]
+        pools = get_node_pools(healthy)
+        placement_pools = self._placement_pools(healthy, node_labels)
+        # ownership hands over on the FIRST assignment label, not on
+        # materialization: a half-written (or quarantine-degraded) gang
+        # defers above, and its hosts must not fall back into an
+        # implicit whole-pool gang while the labels converge
+        placed_nodes = {
+            name for name, labels in node_labels.items()
+            if labels.get(consts.PLACEMENT_LABEL)
+        }
         profile = self._load_profile()
 
         def participates(pool) -> bool:
             gang = profile.get(pool.accelerator_type, profile.get("all", "per-slice"))
             return pool.info.multi_host and gang != "disabled"
 
+        # a pool with any placement-assigned member hands gang ownership
+        # to the placement engine wholesale: an implicit whole-pool gang
+        # would double-book the placed hosts. A pool the health exclusion
+        # (or mid-registration) shrank below its declared topology defers
+        # the same way a half-written placement does — TPU_TOPOLOGY still
+        # names the full block, and a short hostlist under it hangs
+        # libtpu init on every surviving worker, with no placement engine
+        # behind an implicit gang to ever re-place it
+        implicit = [
+            p for p in pools
+            if not any(name in placed_nodes for name in p.node_names)
+            and self._pool_complete(p)
+        ]
         # slice ids/count must enumerate only PARTICIPATING slices: a DCN
         # mesh sized over disabled pools would wait forever for slices
         # that never join
-        active = [p for p in pools if participates(p)]
+        active = [p for p in implicit + placement_pools if participates(p)]
         coordinator = self._coordinator_name(active) if self.multi_slice else ""
         self._owner_ref = self._managing_daemonset_ref()
         reconciled = []
@@ -158,8 +188,135 @@ class SliceManagerAgent:
             reconciled.append(name)
         if coordinator and active:
             self._apply_coordinator_service(coordinator, self._slice_name(active[0]))
+        self._clear_stale_worker_ids(node_labels, active)
         self._cleanup_stale(reconciled, gang_pods, coordinator)
         return reconciled
+
+    def _clear_stale_worker_ids(self, node_labels: dict, active: List[NodePool]) -> None:
+        """A node that is no longer a member of any live gang — taken out
+        of service by the health subsystem, handed to the placement
+        engine without an assignment, or left by a shrunk pool — must not
+        keep a worker identity label: gang Services select on it, and a
+        quarantined node answering slice DNS is exactly the degraded-gang
+        hang the exclusion exists to prevent."""
+        members = {name for pool in active for name in pool.node_names}
+        for node_name, labels in node_labels.items():
+            if node_name in members or WORKER_ID_LABEL not in labels:
+                continue
+            try:
+                self.client.patch(
+                    "v1", "Node", node_name,
+                    {"metadata": {"labels": {WORKER_ID_LABEL: None}}},
+                )
+            except errors.NotFound:
+                pass
+
+    @staticmethod
+    def _out_of_service(labels: dict) -> bool:
+        """Health-subsystem exclusion, shared with the placement engine
+        so gang membership can never disagree between the two."""
+        from tpu_operator.placement.engine import labels_unavailable
+
+        return labels_unavailable(labels)
+
+    @staticmethod
+    def _pool_complete(pool: NodePool) -> bool:
+        """Whether an implicit pool's (healthy) membership fills its
+        declared topology's host grid. A shrunk torus cannot run — it
+        defers until the missing hosts heal or register. Unknown wiring
+        (unparseable topology) keeps the pre-placement behavior."""
+        from tpu_operator.placement.torus import host_grid_dims
+
+        grid = host_grid_dims(pool.topology, max(1, pool.info.chips_per_node))
+        if grid is None:
+            return True
+        return len(pool.node_names) == grid[0] * grid[1] * grid[2]
+
+    def _placement_pools(self, nodes: List[dict], node_labels: dict) -> List[NodePool]:
+        """Gangs the placement controller assigned: one pseudo-pool per
+        placement, members ordered by their placement index (worker ids
+        then follow the placed block's ICI wiring, not alphabetical node
+        names). The gang env gets the placed block's own size/topology,
+        not the whole pool's."""
+        import dataclasses
+
+        from tpu_operator.nodeinfo import tpu_info
+        from tpu_operator.placement.torus import host_grid_dims
+
+        groups: dict = {}
+        for node in nodes:
+            labels = node_labels[node["metadata"]["name"]]
+            owner = labels.get(consts.PLACEMENT_LABEL)
+            if not owner:
+                continue
+            try:
+                index = int(labels.get(consts.PLACEMENT_INDEX_LABEL, "0"))
+            except ValueError:
+                index = 0
+            groups.setdefault(owner, []).append((index, node))
+        # completeness is judged against the CLUSTER-WIDE label state (the
+        # controller patches one node at a time, so a reconcile can land
+        # mid-write): a gang only materializes once every index of its
+        # placed block is labelled SOMEWHERE. Ownership still hands over
+        # on the first label (see ``placed_nodes``), so a deferred gang's
+        # hosts never fall back into an implicit whole-pool gang.
+        cluster_indexes: dict = {}
+        for labels in node_labels.values():
+            owner = labels.get(consts.PLACEMENT_LABEL)
+            if not owner:
+                continue
+            try:
+                cluster_indexes.setdefault(owner, set()).add(
+                    int(labels.get(consts.PLACEMENT_INDEX_LABEL, "0"))
+                )
+            except ValueError:
+                pass
+        pools: List[NodePool] = []
+        for owner in sorted(groups):
+            members = sorted(
+                groups[owner], key=lambda t: (t[0], t[1]["metadata"]["name"])
+            )
+            info = tpu_info(members[0][1])
+            if info is None:
+                continue
+            names = [n["metadata"]["name"] for _, n in members]
+            topology = (
+                node_labels[names[0]].get(consts.PLACEMENT_TOPOLOGY_LABEL)
+                or info.topology
+            )
+            grid = host_grid_dims(topology, max(1, info.chips_per_node))
+            if grid is not None:
+                expected = grid[0] * grid[1] * grid[2]
+                if cluster_indexes.get(owner, set()) != set(range(expected)):
+                    # half-written assignment: advertising the block's
+                    # topology with a short hostlist hangs libtpu init
+                    # on every worker — wait for the labels to converge
+                    continue
+                if {i for i, _ in members} != set(range(expected)):
+                    # fully labelled but a member is health-excluded:
+                    # materializing the survivors would publish that same
+                    # libtpu-hanging short hostlist AND renumber worker
+                    # ids off the block's ICI order — defer (gang plumbing
+                    # tears down, every member's worker id clears) while
+                    # the engine re-places the gang away from the sick
+                    # host
+                    continue
+            pools.append(
+                NodePool(
+                    name=owner,
+                    accelerator_type=info.accelerator_type,
+                    topology=topology,
+                    gke_nodepool=info.nodepool,
+                    node_names=names,
+                    info=dataclasses.replace(
+                        info,
+                        topology=topology,
+                        slice_hosts=len(names),
+                        chips_in_slice=len(names) * info.chips_per_node,
+                    ),
+                )
+            )
+        return pools
 
     def _managing_daemonset_ref(self) -> Optional[dict]:
         """ownerReference to the slice-manager DaemonSet: gang objects are
@@ -299,7 +456,11 @@ class SliceManagerAgent:
             "TPU_WORKER_HOSTNAMES": hostnames,
             "TPU_ACCELERATOR_TYPE": pool.accelerator_type,
             "TPU_TOPOLOGY": pool.topology,
-            "TPU_SLICE_HOSTS": str(pool.info.slice_hosts),
+            # the ACTUAL gang size, not the topology-derived pool size:
+            # the two disagree whenever a sick host was excluded or a
+            # placement block is smaller than the pool, and every worker
+            # sizes its world from this env
+            "TPU_SLICE_HOSTS": str(len(pool.node_names)),
             "TPU_CHIPS_PER_HOST": str(pool.info.chips_per_node),
         }
         if self.multi_slice and coordinator:

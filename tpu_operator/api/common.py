@@ -62,11 +62,21 @@ class SpecBase:
         return out
 
 
-def field(json: Optional[str] = None, default: Any = None, default_factory: Any = None, loader: Any = None):
-    """Dataclass field with a JSON key and optional nested loader."""
+def field(
+    json: Optional[str] = None,
+    default: Any = None,
+    default_factory: Any = None,
+    loader: Any = None,
+    enum: Any = None,
+):
+    """Dataclass field with a JSON key, optional nested loader, and an
+    optional closed value set (rendered as an OpenAPI ``enum`` in the
+    generated CRD so the apiserver rejects typos at admission)."""
     metadata: Dict[str, Any] = {}
     if json:
         metadata["json"] = json
+    if enum is not None:
+        metadata["enum"] = list(enum)
     if loader is not None:
         metadata["loader"] = loader
     if default_factory is not None:

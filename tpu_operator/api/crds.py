@@ -55,7 +55,10 @@ def _schema_for_spec(cls: type) -> dict:
         if not f.init:
             continue
         key = f.metadata.get("json", f.name)
-        props[key] = _schema_for_type(hints.get(f.name, dict))
+        schema = _schema_for_type(hints.get(f.name, dict))
+        if "enum" in f.metadata:
+            schema = dict(schema, enum=f.metadata["enum"])
+        props[key] = schema
     return {"type": "object", "properties": props}
 
 

@@ -36,6 +36,30 @@ class SliceType:
 
 
 @dataclasses.dataclass
+class PlacementSpec(SpecBase):
+    """Topology-aware placement request (no reference analog — NVIDIA
+    has no ICI torus to pack). ``shape`` is the contiguous axis-aligned
+    HOST block requested on the pool's torus ("4x4x4", or "4x2" for 2-D
+    pools); empty shape = placement not requested (legacy implicit
+    per-pool gang pickup). The placement controller admits requests in
+    priority-then-FIFO order, writes per-node assignment labels the
+    slice manager consumes, and — under ``preemptionPolicy:
+    PreemptLower`` — tears down the minimal set of strictly-lower-
+    priority gangs when no free block exists."""
+
+    shape: str = field(default="")
+    priority: int = field(default=0)
+    preemption_policy: str = field(
+        json="preemptionPolicy", default="Never", enum=["Never", "PreemptLower"]
+    )
+    # optional node-pool pin (nodepool.NodePool.name); empty = any pool
+    pool: str = field(default="")
+
+    def requested(self) -> bool:
+        return bool(self.shape)
+
+
+@dataclasses.dataclass
 class TPUSliceSpec(ComponentCommon):
     """Per-instance libtpu deployment spec (reference:
     NVIDIADriverSpec nvidiadriver_types.go:40-185)."""
@@ -48,6 +72,7 @@ class TPUSliceSpec(ComponentCommon):
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     node_affinity: Optional[dict] = field(json="nodeAffinity", default=None)
+    placement: PlacementSpec = sub(PlacementSpec)
 
     def get_node_selector(self) -> Dict[str, str]:
         """Default to all TPU nodes when unset (reference:
@@ -63,6 +88,11 @@ class TPUSliceStatus(SpecBase):
 
     state: str = field(default="")
     conditions: List[dict] = field(default_factory=list)
+    # placement queue progress published by the placement controller
+    # (phase Queued|Scheduled|Unschedulable, pool, assigned nodes,
+    # block origin, message); declared or a real apiserver's structural
+    # pruning drops it
+    placement: dict = field(default_factory=dict)
 
 
 @dataclasses.dataclass

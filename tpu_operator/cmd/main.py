@@ -25,6 +25,10 @@ from tpu_operator.controllers.health_controller import (
     HealthReconciler,
     setup_with_manager as setup_health,
 )
+from tpu_operator.controllers.placement_controller import (
+    PlacementReconciler,
+    setup_with_manager as setup_placement,
+)
 from tpu_operator.controllers.tpuslice_controller import (
     TPUSliceReconciler,
     setup_with_manager as setup_tpuslice,
@@ -110,6 +114,7 @@ def main(argv=None) -> int:
     setup_tpuslice(mgr, TPUSliceReconciler(client, namespace))
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
     setup_health(mgr, HealthReconciler(client, namespace))
+    setup_placement(mgr, PlacementReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

@@ -71,6 +71,31 @@ TPU_HEALTH_CONDITION = "TPUHealthy"  # node status condition type
 # multi-host workloads fail fast instead of hanging on a sick member
 TPU_SLICE_HEALTH_LABEL = "tpu.google.com/slice.health"
 
+# ---------------------------------------------------------------------------
+# Topology-aware slice placement (tpu_operator/placement/). The placement
+# controller owns the assignment labels; node discovery (or the platform)
+# publishes the coordinate label; the slice manager consumes assignments.
+# ---------------------------------------------------------------------------
+# Host coordinate on the pool's ICI torus, "x-y-z" (e.g. "3-0-7"). On
+# self-managed clusters node discovery derives it from TPU_WORKER_ID +
+# the slice topology; absent coordinates degrade to a deterministic
+# row-major layout over the pool's sorted node names.
+TORUS_COORDS_LABEL = "tpu.google.com/torus-coords"
+# Which TPUSlice placement owns this host (the gang the slice manager
+# must materialize here) and the host's worker index within the placed
+# block (row-major over the block shape: torus neighbors get adjacent
+# worker ids, so gang hostlists follow the ICI wiring).
+PLACEMENT_LABEL = "tpu.google.com/placement"
+PLACEMENT_INDEX_LABEL = "tpu.google.com/placement-index"
+# The placed block's CHIP topology (oriented host shape x per-host chip
+# block, e.g. a 2x2x2-host block of 4-chip hosts -> "4x4x2"): what the
+# slice manager advertises as TPU_TOPOLOGY in the gang env — a sub-block
+# gang must not inherit the whole pool's topology
+PLACEMENT_TOPOLOGY_LABEL = "tpu.google.com/placement-topology"
+# re-plan cadence while placements are pending/unschedulable (capacity
+# frees up without any watch event the queue predicate maps)
+PLACEMENT_REPLAN_SECONDS = 15.0
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

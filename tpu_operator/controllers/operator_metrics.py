@@ -77,6 +77,19 @@ class OperatorMetrics:
             "Health remediation attempts started",
             registry=reg,
         )
+        self.placement_queue_depth = prometheus_client.Gauge(
+            "tpu_operator_placement_queue_depth",
+            "TPUSlice placement requests not currently Scheduled "
+            "(Queued + Unschedulable)",
+            registry=reg,
+        )
+        self.torus_fragmentation = prometheus_client.Gauge(
+            "tpu_operator_torus_fragmentation",
+            "External fragmentation of a node pool's host torus "
+            "(1 - largest free cube / free hosts)",
+            ["pool"],
+            registry=reg,
+        )
         # apiserver-client resilience series, owned by the transport
         # layer (kube/retry.py) the same way apiserver_requests_total is
         # owned by http_client: process-wide on the default registry —

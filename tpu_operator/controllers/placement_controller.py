@@ -1,0 +1,189 @@
+"""Placement reconciler: applies the engine's plan to the cluster.
+
+The queue is global (admission order is priority-then-FIFO across ALL
+TPUSlices), so every watch event maps to one synthetic request and each
+reconcile replans the whole queue from cluster state — the same
+level-triggered, recompute-everything shape as the health and upgrade
+walkers. Idempotent: the assignment labels on nodes are the source of
+truth, so a crash between label writes and status writes converges on
+the next pass instead of double-booking.
+
+Wire traffic per pass: one cached TPUSlice list, one cached Node list,
+one labels-only merge patch per node whose assignment changed, and one
+key-scoped status patch per slice whose placement block changed —
+O(changes), not O(cluster).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.placement.engine import PLACEMENT_MANAGER, Plan, PlacementEngine
+
+log = logging.getLogger(__name__)
+
+# the whole queue replans as one unit; every watch event maps here
+QUEUE_REQUEST = Request(name="placement-queue")
+
+
+class PlacementReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = EventRecorder(client, namespace, component=PLACEMENT_MANAGER)
+        self.metrics = get_metrics()
+        self._frag_pools: set = set()
+
+    def reconcile(self, req: Request) -> Result:
+        slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+        nodes = self.client.list("v1", "Node")
+        engine = PlacementEngine(slices, nodes)
+        plan = engine.plan()
+        self._apply_labels(plan)
+        statuses_ok = self._publish_statuses(plan, {s["metadata"]["name"]: s for s in slices})
+        self._record_events(plan, engine)
+        self.metrics.placement_queue_depth.set(plan.queue_depth)
+        for pool, frag in plan.fragmentation.items():
+            self.metrics.torus_fragmentation.labels(pool).set(frag)
+        for gone in self._frag_pools - set(plan.fragmentation):
+            # a drained/deleted pool must stop exporting its last value
+            try:
+                self.metrics.torus_fragmentation.remove(gone)
+            except KeyError:
+                pass
+        self._frag_pools = set(plan.fragmentation)
+        if plan.teardowns or not statuses_ok:
+            # a torn-down gang (preempted or degraded) re-places as soon
+            # as the world settles; a failed status write retries — once
+            # the labels have converged nothing else would re-enqueue it
+            return Result(requeue=True)
+        if plan.queue_depth:
+            # pending work but nothing actionable: capacity can free up
+            # without any event this controller watches mapping to it
+            return Result(requeue_after=consts.PLACEMENT_REPLAN_SECONDS)
+        return Result()
+
+    # -- plan application ----------------------------------------------------
+
+    def _apply_labels(self, plan: Plan) -> None:
+        # every delta is a real change by construction (assignments only
+        # land on previously-free hosts, clears only on labelled ones),
+        # so each is one labels-only merge patch with no read-back
+        for node_name in sorted(plan.label_deltas):
+            try:
+                self.client.patch(
+                    "v1", "Node", node_name,
+                    {"metadata": {"labels": plan.label_deltas[node_name]}},
+                )
+            except errors.NotFound:
+                pass  # node deleted mid-pass; next pass re-plans without it
+
+    def _publish_statuses(self, plan: Plan, slices: dict) -> bool:
+        ok = True
+        for name in sorted(plan.statuses):
+            desired = plan.statuses[name]
+            obj = slices.get(name)
+            if obj is None:
+                continue
+            current = (obj.get("status") or {}).get("placement") or {}
+            if current == desired:
+                continue
+            if not desired:
+                # the CR dropped its placement request: remove the block
+                body = None
+            else:
+                # merge patch merges nested objects: stale keys the new
+                # block no longer carries (message, origin, nodes) must be
+                # nulled explicitly or they'd survive the phase transition
+                body = dict(desired)
+                for stale in current:
+                    if stale not in body:
+                        body[stale] = None
+            try:
+                self.client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+                    TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name,
+                    {"status": {"placement": body}},
+                )
+            except errors.NotFound:
+                continue
+            except errors.ApiError as e:
+                ok = False  # caller requeues: status must converge too
+                log.debug("placement status publish for %s failed: %s", name, e)
+        return ok
+
+    def _record_events(self, plan: Plan, engine: PlacementEngine) -> None:
+        for slice_name, event_type, reason, message in plan.events:
+            involved = engine.slices.get(slice_name)
+            if involved is None:
+                continue
+            self.recorder.event(involved, event_type, reason, message)
+
+
+def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
+    ctrl = Controller(
+        "placement", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
+    )
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_queue(_obj) -> List[Request]:
+        return [QUEUE_REQUEST]
+
+    def placement_changed(event_type, old, new) -> bool:
+        """TPUSlice events matter when the placement request itself
+        changed (spec) or the CR appeared/went away — status echoes of
+        this controller's own writes must not re-enqueue the queue. A
+        WIPED status on a slice that still requests placement (CRD
+        structural pruning, manual status edit) does matter: a settled
+        queue would otherwise never re-publish it. No echo loop — this
+        controller's own writes always leave a non-empty block."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        if (old.get("spec") or {}).get("placement") != (new.get("spec") or {}).get("placement"):
+            return True
+        return bool(
+            (new.get("spec") or {}).get("placement")
+            and (old.get("status") or {}).get("placement")
+            and not (new.get("status") or {}).get("placement")
+        )
+
+    def node_changed(event_type, old: Optional[ObjectDict], new: ObjectDict) -> bool:
+        """Node events matter when placement inputs changed: health /
+        repair / coordinate / TPU identity / assignment labels. The echo
+        of this controller's own assignment writes is dropped by the
+        same-value check in _apply_labels, but filtering here saves the
+        reconcile entirely for unrelated label churn."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        keys = (
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TORUS_COORDS_LABEL,
+            consts.PLACEMENT_LABEL,
+            consts.PLACEMENT_INDEX_LABEL,
+            consts.PLACEMENT_TOPOLOGY_LABEL,
+            consts.GKE_TPU_ACCELERATOR_LABEL,
+            consts.GKE_TPU_TOPOLOGY_LABEL,
+            consts.TFD_ACCELERATOR_TYPE_LABEL,
+            consts.TFD_TOPOLOGY_LABEL,
+        )
+        old_labels = old["metadata"].get("labels") or {}
+        new_labels = new["metadata"].get("labels") or {}
+        return any(old_labels.get(k) != new_labels.get(k) for k in keys)
+
+    ctrl.watch(
+        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
+        mapper=map_to_queue, predicate=placement_changed,
+    )
+    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_queue, predicate=node_changed)
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -283,9 +283,14 @@ def make_tpu_node(
     nodepool: str = "tpu-pool",
     chips: int = 4,
     extra_labels: Optional[dict] = None,
+    coords: Optional[tuple] = None,
 ) -> dict:
     """A synthetic GKE TPU node (the fake analog of the reference's
-    NFD-labelled test nodes, object_controls_test.go:77-82)."""
+    NFD-labelled test nodes, object_controls_test.go:77-82). ``coords``
+    stamps the host's ICI torus coordinate label the placement engine
+    consumes (on real clusters: node discovery / the platform)."""
+    from tpu_operator import consts as _consts
+
     labels = {
         "cloud.google.com/gke-tpu-accelerator": accelerator,
         "cloud.google.com/gke-tpu-topology": topology,
@@ -293,6 +298,8 @@ def make_tpu_node(
         "kubernetes.io/hostname": name,
         "kubernetes.io/os": "linux",  # kubelets always set this
     }
+    if coords is not None:
+        labels[_consts.TORUS_COORDS_LABEL] = "-".join(str(c) for c in coords)
     labels.update(extra_labels or {})
     return new_object(
         "v1",
@@ -309,6 +316,44 @@ def make_tpu_node(
             },
         },
     )
+
+
+def make_torus_nodes(
+    dims: tuple = (8, 8, 8),
+    prefix: str = "tpu",
+    accelerator: str = "tpu-v4-podslice",
+    nodepool: str = "tpu-pool",
+    chips: int = 4,
+) -> list:
+    """A full host torus of synthetic TPU nodes: one node per (x, y, z)
+    host coordinate, all in one node pool, carrying the coordinate label
+    and a chip-level topology label consistent with the host grid
+    ((8,8,8) hosts @ 4 chips/host -> topology "16x16x8", 512 nodes).
+    This is the 512-host pod the placement bench and drills run on."""
+    from tpu_operator.nodeinfo import ACCELERATORS
+    from tpu_operator.placement.torus import chip_topology_for
+
+    info = ACCELERATORS.get(accelerator)
+    topology = chip_topology_for(
+        tuple(dims), chips, info.topology_dims if info is not None else 3
+    )
+    nodes = []
+    index = 0
+    for z in range(dims[2]):
+        for y in range(dims[1]):
+            for x in range(dims[0]):
+                nodes.append(
+                    make_tpu_node(
+                        f"{prefix}-{index}",
+                        accelerator,
+                        topology,
+                        nodepool=nodepool,
+                        chips=chips,
+                        coords=(x, y, z),
+                    )
+                )
+                index += 1
+    return nodes
 
 
 def make_bare_node(name: str, extra_labels: Optional[dict] = None) -> dict:

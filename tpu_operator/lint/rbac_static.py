@@ -83,6 +83,7 @@ SUBJECT_ROOTS: Dict[str, Sequence[str]] = {
     "operator": (
         "cmd/main.py",
         "controllers/",
+        "placement/",
         "state/",
         "states/",
         "upgrade/",

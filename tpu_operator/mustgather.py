@@ -131,6 +131,47 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("node-health.txt", f"# collection failed: {e}\n")
 
     try:
+        # the placement subsystem's view: the queue (every TPUSlice with
+        # a placement request + its phase) and the per-host assignment
+        # dump — what "why isn't my slice scheduled" starts from
+        from tpu_operator import consts as _consts
+
+        lines = ["# placement queue"]
+        queue = []
+        for ts in client.list(TPU_SLICE_API_VERSION, "TPUSlice"):
+            placement = (ts.get("spec") or {}).get("placement") or {}
+            if not placement.get("shape"):
+                continue
+            st = (ts.get("status") or {}).get("placement") or {}
+            queue.append(
+                f"{ts['metadata']['name']}  shape={placement.get('shape')}  "
+                f"priority={placement.get('priority', 0)}  "
+                f"policy={placement.get('preemptionPolicy', 'Never')}  "
+                f"phase={st.get('phase', '-')}  pool={st.get('pool', '-')}  "
+                f"origin={st.get('origin', '-')}  "
+                f"nodes={','.join(st.get('nodes') or []) or '-'}"
+                + (f"  message={st.get('message')}" if st.get("message") else "")
+            )
+        lines.extend(queue or ["# none"])
+        lines.append("")
+        lines.append("# host assignments")
+        assignments = []
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if _consts.PLACEMENT_LABEL not in labels and _consts.TORUS_COORDS_LABEL not in labels:
+                continue
+            assignments.append(
+                f"{node['metadata']['name']}  "
+                f"coords={labels.get(_consts.TORUS_COORDS_LABEL, '-')}  "
+                f"placement={labels.get(_consts.PLACEMENT_LABEL, '-')}  "
+                f"index={labels.get(_consts.PLACEMENT_INDEX_LABEL, '-')}"
+            )
+        lines.extend(assignments or ["# none"])
+        emit("placement.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("placement.txt", f"# collection failed: {e}\n")
+
+    try:
         # cluster-wide: events for cluster-scoped objects (the CRs) land
         # in "default" per apiserver rules, not the operator namespace
         events = client.list("v1", "Event")

@@ -57,25 +57,33 @@ def _pool_name(info: TPUNodeInfo) -> str:
 
 
 def get_node_pools(nodes: List[ObjectDict]) -> List[NodePool]:
-    """reference: getNodePools nodepool.go:55-132."""
-    pools: Dict[str, NodePool] = {}
+    """reference: getNodePools nodepool.go:55-132.
+
+    Fully deterministic in the node SET, independent of input order:
+    pools sort by name, members sort by name, and the representative
+    ``info`` is always the lexicographically-first member's (it used to
+    be whichever node the informer listed first, so gang worker ids and
+    placement decisions could differ across re-lists of the same
+    cluster)."""
+    infos: Dict[str, Dict[str, TPUNodeInfo]] = {}
     for node in nodes:
         info = tpu_info(node)
         if info is None:
             continue
-        key = _pool_name(info)
-        pool = pools.get(key)
-        if pool is None:
-            pools[key] = NodePool(
+        infos.setdefault(_pool_name(info), {})[info.node_name] = info
+    pools: List[NodePool] = []
+    for key in sorted(infos):
+        members = infos[key]
+        names = sorted(members)
+        representative = members[names[0]]
+        pools.append(
+            NodePool(
                 name=key,
-                accelerator_type=info.accelerator_type,
-                topology=info.topology,
-                gke_nodepool=info.nodepool,
-                node_names=[info.node_name],
-                info=info,
+                accelerator_type=representative.accelerator_type,
+                topology=representative.topology,
+                gke_nodepool=representative.nodepool,
+                node_names=names,
+                info=representative,
             )
-        else:
-            pool.node_names.append(info.node_name)
-    for pool in pools.values():
-        pool.node_names.sort()
-    return sorted(pools.values(), key=lambda p: p.name)
+        )
+    return pools

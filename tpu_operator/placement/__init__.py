@@ -1,0 +1,27 @@
+"""Topology-aware slice placement.
+
+Models each TPU node pool as a 3-D torus of hosts (the ICI wiring: v4/
+v5p pods are 3-D tori of chips, v5e/v6e 2-D meshes — a 2-D shape is a
+torus with a unit z axis) and allocates contiguous axis-aligned host
+blocks for TPUSlice ``spec.placement`` requests. Contiguity on the ICI
+is what keeps gang collectives at wire speed: a fragmented gang routes
+``psum`` over DCN hops and the whole slice degrades (PAPERS.md,
+"Exploration of TPUs for AI Applications" on torus topology).
+
+- ``torus.py`` — the torus model + block allocator + fragmentation
+  scoring (pure geometry, no apiserver).
+- ``engine.py`` — the planning core: admission in priority-then-FIFO
+  order, gang-integrity validation, minimal-victim preemption. Pure
+  (cluster state in, decisions out) so drills and chaos riders can
+  replay it deterministically.
+- ``controllers/placement_controller.py`` — the reconciler applying an
+  engine plan to the cluster (assignment labels, status.placement,
+  events, metrics).
+"""
+
+from tpu_operator.placement.engine import (  # noqa: F401
+    PlacementEngine,
+    PlacementPhase,
+    PreemptionPolicy,
+)
+from tpu_operator.placement.torus import Block, Torus, parse_shape  # noqa: F401

@@ -1,0 +1,422 @@
+"""Placement planning core: admission queue + gang integrity + preemption.
+
+Pure function of cluster state: feed it the current TPUSlices and Nodes,
+get back a :class:`Plan` — per-slice placement status, per-node label
+deltas, events to record, and per-pool fragmentation. The controller
+applies the plan; drills and chaos riders replay the engine directly.
+
+The assignment labels on nodes (``tpu.google.com/placement`` +
+``placement-index``) are the source of truth for what is currently
+placed — not ``status.placement`` — so a restarted operator (or one that
+crashed between the label writes and the status write) re-derives the
+same world and converges instead of double-booking.
+
+Queue semantics (``status.placement.phase``):
+
+- ``Queued``     — admitted, waiting for its first attempt this pass
+  (fresh request, re-placement after a lost gang member, or preempted).
+- ``Scheduled``  — a contiguous block is assigned; labels written.
+- ``Unschedulable`` — attempted and failed: no block free, and
+  preemption (if allowed) found no victim set.
+
+Admission is priority-then-FIFO. A higher-priority ``Unschedulable``
+slice with ``preemptionPolicy: PreemptLower`` preempts the MINIMAL
+victim set: the allocator ranks candidate blocks by (victim count,
+victim cells, free-surface exposure), so a block displacing one small
+low-priority gang always beats one displacing two. Victims are torn
+down (labels cleared, phase back to ``Queued``) and requeue behind the
+preemptor — cordon-free gang teardown, never node eviction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_operator import consts
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import ACCELERATORS
+from tpu_operator.nodepool import get_node_pools
+from tpu_operator.placement.torus import (
+    Torus,
+    chip_topology_for,
+    host_grid_dims,
+    parse_shape,
+)
+
+PLACEMENT_MANAGER = "tpu-placement"
+
+
+class PlacementPhase:
+    QUEUED = "Queued"
+    SCHEDULED = "Scheduled"
+    UNSCHEDULABLE = "Unschedulable"
+
+
+class PreemptionPolicy:
+    NEVER = "Never"
+    PREEMPT_LOWER = "PreemptLower"
+
+
+def _labels(node: ObjectDict) -> dict:
+    return node["metadata"].get("labels") or {}
+
+
+def labels_unavailable(labels: dict) -> bool:
+    """The health-subsystem exclusion predicate, shared with the slice
+    manager so the two can never disagree about who is in a gang: a node
+    mid-repair (any repair FSM state, incl. terminal quarantine) or
+    flagged degraded is out of service."""
+    return bool(labels.get(consts.REPAIR_STATE_LABEL)) or (
+        labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_DEGRADED
+    )
+
+
+def node_unavailable(node: ObjectDict) -> bool:
+    """A host the health subsystem has taken out of service: never a
+    placement candidate, and a gang holding it has lost a member."""
+    return labels_unavailable(_labels(node))
+
+
+def _pool_wraps(accelerator_type: str) -> bool:
+    """Whether a pool's ICI links wrap at the edges: 3-D torus
+    generations (v4/v5p) wrap, 2-D mesh generations (v5e/v6e) don't.
+    Unknown accelerators default to no wrap — a non-wrapping block is
+    contiguous on either family, the wrapped one only on a torus."""
+    info = ACCELERATORS.get(accelerator_type)
+    return info is not None and info.topology_dims >= 3
+
+
+def _topology_dims(accelerator_type: str) -> int:
+    """How many axes the generation's topology strings carry (v4/v5p
+    write '4x4x1', v5e/v6e write '4x4'); unknown families keep 3 — an
+    explicit unit axis is never wrong, a silently dropped one can be."""
+    info = ACCELERATORS.get(accelerator_type)
+    return info.topology_dims if info is not None else 3
+
+
+@dataclasses.dataclass
+class PlacementRequest:
+    """One TPUSlice's parsed spec.placement."""
+
+    name: str
+    shape: str
+    priority: int
+    policy: str
+    pool: str  # optional pool pin
+    created: str  # creationTimestamp for FIFO within a priority band
+
+    @classmethod
+    def from_slice(cls, obj: ObjectDict) -> Optional["PlacementRequest"]:
+        placement = (obj.get("spec") or {}).get("placement") or {}
+        shape = str(placement.get("shape") or "")
+        if not shape:
+            return None
+        try:
+            priority = int(placement.get("priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
+        return cls(
+            name=obj["metadata"]["name"],
+            shape=shape,
+            priority=priority,
+            policy=str(placement.get("preemptionPolicy") or PreemptionPolicy.NEVER),
+            pool=str(placement.get("pool") or ""),
+            created=obj["metadata"].get("creationTimestamp", ""),
+        )
+
+
+@dataclasses.dataclass
+class Plan:
+    # slice name -> the status.placement block to publish
+    statuses: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # node name -> label delta (None values clear)
+    label_deltas: Dict[str, Dict[str, Optional[str]]] = dataclasses.field(default_factory=dict)
+    # (slice name, event type, reason, message)
+    events: List[Tuple[str, str, str, str]] = dataclasses.field(default_factory=list)
+    fragmentation: Dict[str, float] = dataclasses.field(default_factory=dict)
+    queue_depth: int = 0
+    # slices whose gang was torn down this pass (preempted or lost a
+    # member): the controller requeues promptly so they re-place
+    teardowns: List[str] = dataclasses.field(default_factory=list)
+
+    def _delta(self, node: str) -> Dict[str, Optional[str]]:
+        return self.label_deltas.setdefault(node, {})
+
+    def assign(self, slice_name: str, ordered_nodes: Sequence[str], chip_topology: str) -> None:
+        for index, node in enumerate(ordered_nodes):
+            delta = self._delta(node)
+            delta[consts.PLACEMENT_LABEL] = slice_name
+            delta[consts.PLACEMENT_INDEX_LABEL] = str(index)
+            delta[consts.PLACEMENT_TOPOLOGY_LABEL] = chip_topology
+
+    def clear(self, nodes: Sequence[str]) -> None:
+        for node in nodes:
+            delta = self._delta(node)
+            # an assignment written later in the same pass wins over the
+            # teardown of the node's previous owner
+            if consts.PLACEMENT_LABEL not in delta or delta[consts.PLACEMENT_LABEL] is None:
+                delta[consts.PLACEMENT_LABEL] = None
+                delta[consts.PLACEMENT_INDEX_LABEL] = None
+                delta[consts.PLACEMENT_TOPOLOGY_LABEL] = None
+
+
+class PlacementEngine:
+    def __init__(self, slices: Sequence[ObjectDict], nodes: Sequence[ObjectDict]):
+        self.slices = {s["metadata"]["name"]: s for s in slices}
+        self.nodes = {n["metadata"]["name"]: n for n in nodes}
+        self.requests: Dict[str, PlacementRequest] = {}
+        for obj in slices:
+            req = PlacementRequest.from_slice(obj)
+            if req is not None:
+                self.requests[req.name] = req
+        # pool name -> (NodePool, Torus); unavailable hosts are cells the
+        # allocator can neither place on nor count as preemptable
+        self.pools: Dict[str, tuple] = {}
+        self.node_pool: Dict[str, str] = {}
+        for pool in get_node_pools(list(self.nodes.values())):
+            members = [self.nodes[n] for n in pool.node_names]
+            torus = Torus.from_nodes(
+                members,
+                wrap=_pool_wraps(pool.info.accelerator_type),
+                # the declared slice topology sizes the grid, so a
+                # partially-registered pool reads as a torus with holes
+                # rather than a smaller torus with fictional wrap links
+                grid=host_grid_dims(pool.info.topology, pool.info.chips_per_node),
+            )
+            torus.set_unavailable(
+                [n["metadata"]["name"] for n in members if node_unavailable(n)]
+            )
+            self.pools[pool.name] = (pool, torus)
+            for name in pool.node_names:
+                self.node_pool[name] = pool.name
+
+    # -- current assignments -------------------------------------------------
+
+    def _assigned_nodes(self) -> Dict[str, List[Tuple[int, str]]]:
+        """slice name -> [(index, node name)] read back from node labels."""
+        assigned: Dict[str, List[Tuple[int, str]]] = {}
+        for name, node in self.nodes.items():
+            labels = _labels(node)
+            owner = labels.get(consts.PLACEMENT_LABEL)
+            if not owner:
+                continue
+            try:
+                index = int(labels.get(consts.PLACEMENT_INDEX_LABEL, "0"))
+            except ValueError:
+                index = 0
+            assigned.setdefault(owner, []).append((index, name))
+        return assigned
+
+    def _gang_intact(self, req: PlacementRequest, members: List[Tuple[int, str]]) -> bool:
+        shape = parse_shape(req.shape)
+        if shape is None or len(members) != math.prod(shape):
+            return False
+        names = [n for _, n in members]
+        indexes = sorted(i for i, _ in members)
+        if indexes != list(range(len(members))):
+            return False  # duplicated/skipped worker ids: re-place
+        pool_names = {self.node_pool.get(n) for n in names}
+        if len(pool_names) != 1 or None in pool_names:
+            return False
+        if req.pool and next(iter(pool_names)) != req.pool:
+            return False  # spec re-pinned the slice to a different pool
+        # count/index/pool checks can all pass on a SPLIT gang (a crash
+        # between the label writes of a same-pass teardown + re-place
+        # leaves old and new members sharing the owner label with unique
+        # indexes) and on an equal-volume shape edit (4x2x1 -> 2x2x2):
+        # the members' coordinates must actually form one oriented
+        # contiguous block OF THE SPEC SHAPE, in worker order. Judged
+        # from labels alone — the status block may be stale (a failed
+        # status write after a successful re-place must not tear the
+        # healthy new block down again on every pass until it lands)
+        _, torus = self.pools[next(iter(pool_names))]
+        ordered = [torus.coords_of.get(n) for _, n in sorted(members)]
+        if None in ordered or not torus.is_contiguous_block(ordered, shape):
+            return False
+        return not any(node_unavailable(self.nodes[n]) for n in names)
+
+    # -- the pass ------------------------------------------------------------
+
+    def plan(self) -> Plan:
+        plan = Plan()
+        assigned = self._assigned_nodes()
+
+        # 1. orphaned assignment labels: owner gone, or no longer requests
+        #    placement — clear so hosts return to the free pool; a CR that
+        #    dropped its request also loses its stale status block ({} is
+        #    the clear-sentinel the controller patches as null)
+        for owner, members in sorted(assigned.items()):
+            if owner not in self.requests:
+                plan.clear([n for _, n in members])
+        for name, obj in self.slices.items():
+            if name not in self.requests and (obj.get("status") or {}).get("placement"):
+                plan.statuses[name] = {}
+
+        # 2. validate every currently-assigned gang; intact ones occupy
+        #    their torus cells, broken ones tear down and requeue
+        scheduled: Dict[str, str] = {}  # slice -> pool
+        pending: List[PlacementRequest] = []
+        for req in self.requests.values():
+            members = sorted(assigned.get(req.name, []))
+            if not members:
+                pending.append(req)
+                continue
+            if self._gang_intact(req, members):
+                pool_name = self.node_pool[members[0][1]]
+                _, torus = self.pools[pool_name]
+                torus.occupy(req.name, [torus.coords_of[n] for _, n in members])
+                scheduled[req.name] = pool_name
+                prior = (self.slices[req.name].get("status") or {}).get("placement") or {}
+                plan.statuses[req.name] = self._status(
+                    PlacementPhase.SCHEDULED, req, pool=pool_name,
+                    nodes=[n for _, n in members],
+                    # the original block origin isn't derivable from the
+                    # wrapped cell set; carry it through from the status
+                    # the original placement wrote
+                    origin=str(prior.get("origin") or ""),
+                )
+            else:
+                plan.clear([n for _, n in members])
+                plan.teardowns.append(req.name)
+                plan.events.append((
+                    req.name, "Warning", "PlacementDegraded",
+                    f"gang for {req.name} lost a member or its shape changed; re-placing",
+                ))
+                pending.append(req)
+
+        # 3. admit pending in priority-then-FIFO order
+        pending.sort(key=lambda r: (-r.priority, r.created, r.name))
+        for req in pending:
+            self._try_place(req, plan, scheduled)
+
+        plan.queue_depth = sum(
+            1 for name in self.requests if name not in scheduled
+        )
+        for pool_name, (_, torus) in sorted(self.pools.items()):
+            plan.fragmentation[pool_name] = torus.fragmentation()
+        return plan
+
+    def _candidate_pools(self, req: PlacementRequest) -> List[str]:
+        if req.pool:
+            return [req.pool] if req.pool in self.pools else []
+        return sorted(self.pools)
+
+    def _try_place(self, req: PlacementRequest, plan: Plan, scheduled: Dict[str, str]) -> None:
+        shape = parse_shape(req.shape)
+        if shape is None:
+            plan.statuses[req.name] = self._status(
+                PlacementPhase.UNSCHEDULABLE, req,
+                message=f"invalid placement shape {req.shape!r}",
+            )
+            return
+        pools = self._candidate_pools(req)
+        # clean fit first: ranked across pools by the allocator's own key
+        best = None
+        for pool_name in pools:
+            _, torus = self.pools[pool_name]
+            found = torus.find_block(shape)
+            if found is None:
+                continue
+            block, _ = found
+            key = (block.exposure, pool_name)
+            if best is None or key < best[0]:
+                best = (key, pool_name, block)
+        victims: frozenset = frozenset()
+        if best is None and req.policy == PreemptionPolicy.PREEMPT_LOWER:
+            best, victims = self._find_with_preemption(req, shape, pools)
+        if best is None:
+            plan.statuses[req.name] = self._status(
+                PlacementPhase.UNSCHEDULABLE, req,
+                message=(
+                    f"no free {req.shape} block"
+                    + (" and no preemptable lower-priority gang"
+                       if req.policy == PreemptionPolicy.PREEMPT_LOWER else "")
+                    + f" in pool(s) {', '.join(pools) or '(none)'}"
+                ),
+            )
+            return
+        _, pool_name, block = best
+        _, torus = self.pools[pool_name]
+        for victim in sorted(victims):
+            freed = torus.release(victim)
+            plan.clear([torus.node_at[c] for c in freed])
+            plan.teardowns.append(victim)
+            scheduled.pop(victim, None)
+            plan.statuses[victim] = self._status(
+                PlacementPhase.QUEUED, self.requests[victim],
+                message=f"preempted by higher-priority {req.name}; requeued",
+            )
+            plan.events.append((
+                victim, "Warning", "PlacementPreempted",
+                f"gang torn down: preempted by {req.name} "
+                f"(priority {req.priority} > {self.requests[victim].priority})",
+            ))
+        torus.occupy(req.name, block.cells)
+        ordered = [torus.node_at[c] for c in block.cells]
+        pool, _ = self.pools[pool_name]
+        plan.assign(
+            req.name, ordered,
+            chip_topology_for(
+                block.shape, pool.info.chips_per_node,
+                _topology_dims(pool.info.accelerator_type),
+            ),
+        )
+        scheduled[req.name] = pool_name
+        plan.statuses[req.name] = self._status(
+            PlacementPhase.SCHEDULED, req, pool=pool_name, nodes=ordered,
+            origin=block.origin_str,
+        )
+        plan.events.append((
+            req.name, "Normal", "PlacementScheduled",
+            f"placed {req.shape} block at {block.origin_str} in pool {pool_name}"
+            + (f" preempting {len(victims)} gang(s)" if victims else ""),
+        ))
+
+    def _find_with_preemption(self, req: PlacementRequest, shape, pools: List[str]):
+        """Minimal-victim search across pools: only strictly-lower-priority
+        scheduled placements are eligible victims."""
+
+        def victim_ok(owner: str) -> bool:
+            other = self.requests.get(owner)
+            return other is not None and other.priority < req.priority
+
+        best = None
+        best_victims: frozenset = frozenset()
+        for pool_name in pools:
+            _, torus = self.pools[pool_name]
+            found = torus.find_block(shape, victim_ok=victim_ok)
+            if found is None:
+                continue
+            block, victims = found
+            victim_cells = sum(len(torus.owner_cells(v)) for v in victims)
+            key = (len(victims), victim_cells, block.exposure, pool_name)
+            if best is None or key < best[0]:
+                best = (key, pool_name, block)
+                best_victims = victims
+        return best, best_victims
+
+    def _status(
+        self,
+        phase: str,
+        req: PlacementRequest,
+        pool: str = "",
+        nodes: Optional[List[str]] = None,
+        origin: str = "",
+        message: str = "",
+    ) -> dict:
+        block = {
+            "phase": phase,
+            "shape": req.shape,
+            "priority": req.priority,
+        }
+        if pool:
+            block["pool"] = pool
+        if nodes:
+            block["nodes"] = list(nodes)
+        if origin:
+            block["origin"] = origin
+        if message:
+            block["message"] = message
+        return block

@@ -1,0 +1,360 @@
+"""3-D torus model of one node pool's hosts + the block allocator.
+
+Coordinates come from the ``tpu.google.com/torus-coords`` node label
+("x-y-z", published by node discovery from the TPU VM runtime contract,
+or stamped by the platform). Pools whose nodes carry no coordinates
+degrade to a deterministic row-major layout over the sorted node names —
+placement still works, it just can't see the real wiring.
+
+Search is wraparound-aware where the hardware is: the ICI links wrap on
+every axis of a pod-scale 3-D torus (v4/v5p), so a block crossing the
+"edge" is exactly as contiguous as one in the middle — but v5e/v6e are
+2-D meshes with no wrap links, so ``wrap=False`` pools only place blocks
+that fit without folding (a wrapped block there would advertise an ICI
+hop that doesn't exist and silently degrade the gang onto DCN). The
+allocator prefers snug placements (least free surface exposed) so large
+blocks keep finding room — the best-fit fragmentation score the
+placement engine ranks candidates by.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import Counter
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tpu_operator import consts
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import parse_topology
+
+Coord = Tuple[int, int, int]
+
+# Per-host chip geometry by local chip count: how a host's chips sit in
+# the chip-level torus (v4/v5p attach 4 chips as a 2x2x1 block; 8-chip
+# v5e hosts span 2x4 of the 2-D mesh). Used both to derive the host grid
+# from a chip topology and to express a placed host block back in chips.
+_HOST_CHIP_BLOCKS: Dict[int, Tuple[int, int, int]] = {
+    1: (1, 1, 1),
+    4: (2, 2, 1),
+    8: (2, 4, 1),
+}
+
+_NEIGHBOR_STEPS: Tuple[Coord, ...] = (
+    (1, 0, 0), (-1, 0, 0), (0, 1, 0), (0, -1, 0), (0, 0, 1), (0, 0, -1),
+)
+
+
+def parse_shape(shape: str) -> Optional[Coord]:
+    """'4x4x4' -> (4, 4, 4); '2x4' -> (2, 4, 1); invalid/empty -> None."""
+    dims = parse_topology(shape)
+    if not dims or len(dims) > 3:
+        return None
+    while len(dims) < 3:
+        dims.append(1)
+    return (dims[0], dims[1], dims[2])
+
+
+def host_grid_dims(topology: str, chips_per_host: int) -> Optional[Coord]:
+    """The host-level grid implied by a chip topology: each axis divides
+    by the per-host chip block where it can ('16x16x8' @ 4 chips/host ->
+    (8, 8, 8) hosts). None when the topology doesn't parse or a block
+    axis doesn't divide its topology axis (unknown wiring — callers fall
+    back to a 1-D chain, which the allocator still handles)."""
+    dims = parse_shape(topology)
+    if dims is None:
+        return None
+    block = _HOST_CHIP_BLOCKS.get(max(1, chips_per_host))
+    if block is None:
+        return None
+    grid = []
+    for axis, per_host in zip(dims, block):
+        if axis % per_host:
+            return None
+        grid.append(axis // per_host)
+    return (grid[0], grid[1], grid[2])
+
+
+def chip_topology_for(shape: Coord, chips_per_host: int, topology_dims: int = 3) -> str:
+    """A placed host block expressed in chips — what gang workers expect
+    in TPU_TOPOLOGY ('2x2x2' hosts @ 4 chips/host -> '4x4x2'). The
+    string follows the generation's convention: 3-D torus generations
+    (v4/v5p) always write three axes ('4x4x1'), 2-D mesh generations
+    (v5e/v6e) drop the trailing unit axis ('4x4')."""
+    block = _HOST_CHIP_BLOCKS.get(max(1, chips_per_host), (1, 1, 1))
+    dims = [s * b for s, b in zip(shape, block)]
+    while len(dims) > max(2, topology_dims) and dims[-1] == 1:
+        dims.pop()
+    return "x".join(str(d) for d in dims)
+
+
+def worker_coords(worker_id: int, dims: Coord) -> Coord:
+    """Row-major (x fastest) coordinate of one worker in a host grid —
+    the Cloud TPU VM worker-id enumeration order."""
+    x_dim, y_dim, _ = dims
+    return (worker_id % x_dim, (worker_id // x_dim) % y_dim, worker_id // (x_dim * y_dim))
+
+
+def _near_cubic_dims(n: int) -> Coord:
+    """The most-cubic (a>=b>=c) factorization of n — the fallback grid
+    when nodes carry no coordinates. Deterministic in n alone."""
+    best = (n, 1, 1)
+    for c in range(1, int(round(n ** (1 / 3))) + 2):
+        if n % c:
+            continue
+        m = n // c
+        for b in range(c, int(m ** 0.5) + 1):
+            if m % b:
+                continue
+            cand = (m // b, b, c)
+            if cand[0] >= cand[1] >= cand[2] and max(cand) < max(best):
+                best = cand
+    return best
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    """One concrete candidate placement: an origin + oriented shape and
+    the wrapped cell set it covers, cells in row-major block order (so
+    worker ids follow the ICI wiring)."""
+
+    origin: Coord
+    shape: Coord  # the oriented (possibly rotated) shape actually placed
+    cells: Tuple[Coord, ...]
+    exposure: int = 0  # free-surface score at find time (lower = snugger)
+
+    @property
+    def origin_str(self) -> str:
+        return "-".join(str(c) for c in self.origin)
+
+
+class Torus:
+    """Occupancy model of one pool's host torus. Cells are host
+    coordinates; each holds at most one owner (a TPUSlice placement).
+    Unavailable cells (quarantined / in-repair / missing hosts) are
+    never free and never count as preemptable."""
+
+    def __init__(self, dims: Coord, node_at: Dict[Coord, str], wrap: bool = True):
+        self.dims = dims
+        self.wrap = wrap  # False on mesh generations: no edge links
+        self.node_at = dict(node_at)  # coord -> node name
+        self.coords_of = {n: c for c, n in self.node_at.items()}
+        self._owner: Dict[Coord, str] = {}
+        self._unavailable: Set[Coord] = set()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_nodes(
+        cls,
+        nodes: Sequence[ObjectDict],
+        wrap: bool = True,
+        grid: Optional[Coord] = None,
+    ) -> "Torus":
+        """Build from one pool's nodes. Every node must carry a distinct
+        torus-coords label for the labelled layout to be trusted; any
+        gap or duplicate drops the whole pool to the deterministic
+        row-major fallback (a half-labelled pool must not mix layouts).
+        ``wrap=False`` for mesh generations without edge ICI links.
+        ``grid`` is the pool's true host-grid size (from its topology
+        label): without it the dims are inferred as max(coord)+1, which
+        on a partially-registered pool understates the torus and invents
+        wrap adjacency between hosts that are really several hops apart
+        — unregistered positions become holes instead."""
+        named = sorted(nodes, key=lambda n: n["metadata"]["name"])
+        coords: Dict[Coord, str] = {}
+        ok = bool(named)
+        for node in named:
+            raw = (node["metadata"].get("labels") or {}).get(consts.TORUS_COORDS_LABEL, "")
+            parts = raw.split("-")
+            try:
+                at = tuple(int(p) for p in parts)
+            except ValueError:
+                ok = False
+                break
+            if len(at) != 3 or min(at) < 0 or at in coords:
+                ok = False
+                break
+            if grid is not None and any(c >= d for c, d in zip(at, grid)):
+                ok = False  # a coord outside the declared grid: distrust all
+                break
+            coords[at] = node["metadata"]["name"]
+        if ok and coords:
+            dims = grid or tuple(max(c[i] for c in coords) + 1 for i in range(3))
+            return cls((dims[0], dims[1], dims[2]), coords, wrap=wrap)
+        # fallback layout: anchored to the DECLARED grid whenever the
+        # members fit it, so the dims never depend on the current member
+        # count — _near_cubic_dims(n) would re-dimension the whole torus
+        # on any membership change (8 hosts (2,2,2) -> 9 hosts (3,3,1)),
+        # shifting every synthetic coordinate and tearing down every
+        # scheduled gang in the pool. Missing members are tail holes.
+        # (Name-rank assignment still shifts coords after a mid-rank
+        # member removal — unavoidable without real coordinates.)
+        if grid is not None and len(named) <= grid[0] * grid[1] * grid[2]:
+            dims = grid
+        else:
+            dims = _near_cubic_dims(max(1, len(named)))
+        return cls(
+            dims,
+            {worker_coords(i, dims): n["metadata"]["name"] for i, n in enumerate(named)},
+            wrap=wrap,
+        )
+
+    # -- occupancy -----------------------------------------------------------
+
+    def set_unavailable(self, node_names: Sequence[str]) -> None:
+        for name in node_names:
+            at = self.coords_of.get(name)
+            if at is not None:
+                self._unavailable.add(at)
+
+    def occupy(self, owner: str, cells: Sequence[Coord]) -> None:
+        for cell in cells:
+            self._owner[cell] = owner
+
+    def release(self, owner: str) -> List[Coord]:
+        freed = [c for c, o in self._owner.items() if o == owner]
+        for cell in freed:
+            del self._owner[cell]
+        return freed
+
+    def owner_cells(self, owner: str) -> List[Coord]:
+        return sorted(c for c, o in self._owner.items() if o == owner)
+
+    def owners(self) -> Set[str]:
+        return set(self._owner.values())
+
+    def _free(self, cell: Coord) -> bool:
+        return cell in self.node_at and cell not in self._unavailable and cell not in self._owner
+
+    def free_count(self) -> int:
+        return sum(1 for cell in self.node_at if self._free(cell))
+
+    # -- allocation ----------------------------------------------------------
+
+    def _wrap(self, cell: Coord) -> Coord:
+        if not self.wrap:
+            # mesh: no edge links — out-of-grid coords stay out-of-grid,
+            # so they're never free, never owned, never a neighbor
+            return cell
+        return (cell[0] % self.dims[0], cell[1] % self.dims[1], cell[2] % self.dims[2])
+
+    def _block_cells(self, origin: Coord, shape: Coord) -> Tuple[Coord, ...]:
+        # row-major over the block (x fastest): worker i's torus neighbor
+        # is worker i+1 along the fastest axis
+        return tuple(
+            self._wrap((origin[0] + i, origin[1] + j, origin[2] + k))
+            for k in range(shape[2])
+            for j in range(shape[1])
+            for i in range(shape[0])
+        )
+
+    def orientations(self, shape: Coord) -> List[Coord]:
+        """Distinct axis-aligned rotations of the shape that fit the
+        torus dims (a block axis longer than its torus axis would wrap
+        onto itself — never placeable)."""
+        seen = []
+        for perm in sorted(set(itertools.permutations(shape))):
+            if all(p <= d for p, d in zip(perm, self.dims)):
+                seen.append(perm)
+        return seen
+
+    def is_contiguous_block(self, cells: Sequence[Coord], shape: Coord) -> bool:
+        """Whether ``cells`` (in worker order) are exactly one oriented
+        row-major block of ``shape`` anchored at ``cells[0]`` — the
+        invariant a placed gang's coordinates must satisfy for its
+        worker ids to follow the ICI wiring."""
+        if not cells:
+            return False
+        return any(
+            tuple(cells) == self._block_cells(cells[0], oriented)
+            for oriented in self.orientations(shape)
+        )
+
+    def exposure(self, cells: Sequence[Coord]) -> int:
+        """Free cells adjacent (6-neighbor, wraparound) to the block but
+        outside it — the new free surface this placement would create.
+        Lower is snugger: flush against occupied/unavailable cells or
+        closing a pocket, which is what keeps big contiguous runs alive."""
+        block = set(cells)
+        touched: Set[Coord] = set()
+        for cell in block:
+            for step in _NEIGHBOR_STEPS:
+                at = self._wrap((cell[0] + step[0], cell[1] + step[1], cell[2] + step[2]))
+                if at not in block and self._free(at):
+                    touched.add(at)
+        return len(touched)
+
+    def find_block(
+        self,
+        shape: Coord,
+        victim_ok: Optional[Callable[[str], bool]] = None,
+    ) -> Optional[Tuple[Block, FrozenSet[str]]]:
+        """Best placement for ``shape``: tries every orientation at every
+        origin, requiring each covered cell to be free — or, when
+        ``victim_ok`` is given, occupied by an owner it accepts (the
+        preemption path). Ranking: fewest victims, then fewest victim
+        cells (evicting a 2x2x2 beats evicting a 4x4x4), then least free
+        exposure, then (origin, orientation) for determinism. Returns
+        ``(block, victims)`` or None; ``victims`` is empty on a clean fit."""
+        best = None
+        best_key = None
+        origins = sorted(self.node_at)
+        cells_of = Counter(self._owner.values())  # owner -> occupied cells
+        for shape_idx, oriented in enumerate(self.orientations(shape)):
+            for origin in origins:
+                if not self.wrap and any(
+                    origin[i] + oriented[i] > self.dims[i] for i in range(3)
+                ):
+                    continue  # block would hang past a mesh edge
+                cells = self._block_cells(origin, oriented)
+                victims: Set[str] = set()
+                feasible = True
+                for cell in cells:
+                    if self._free(cell):
+                        continue
+                    owner = self._owner.get(cell)
+                    if owner is not None and victim_ok is not None and victim_ok(owner):
+                        victims.add(owner)
+                        continue
+                    feasible = False
+                    break
+                if not feasible:
+                    continue
+                victim_cells = sum(cells_of[v] for v in victims)
+                # exposure() is the expensive part of the key (a 6-neighbor
+                # walk over every cell): skip it when the cheap prefix
+                # already loses against the current best
+                if best_key is not None and (len(victims), victim_cells) > best_key[:2]:
+                    continue
+                key = (len(victims), victim_cells, self.exposure(cells), origin, shape_idx)
+                if best_key is None or key < best_key:
+                    best_key = key
+                    best = (Block(origin, oriented, cells, key[2]), frozenset(victims))
+                    if key[:3] == (0, 0, 0):
+                        return best  # a perfectly snug clean fit can't be beaten
+        return best
+
+    # -- scoring -------------------------------------------------------------
+
+    def fragmentation(self) -> float:
+        """External fragmentation of the free space: 1 - (largest free
+        block volume / free hosts), probing cubes clamped to the torus
+        dims (a 2-D pool's probe is a square with unit z — otherwise an
+        empty flat torus would read as fragmented). 0.0 = all free
+        capacity reachable as one block (or nothing free at all); toward
+        1.0 = plenty of free hosts but no contiguous block to place on."""
+        free = self.free_count()
+        if free == 0:
+            return 0.0
+        for side in range(max(self.dims), 0, -1):
+            shape = tuple(min(side, d) for d in self.dims)
+            volume = shape[0] * shape[1] * shape[2]
+            if volume > free:
+                continue
+            for origin in sorted(self.node_at):
+                if all(self._free(c) for c in self._block_cells(origin, shape)):
+                    return round(1.0 - volume / free, 4)
+        # unreachable: the side=1 probe is a single cell, and free > 0
+        # guarantees at least one free cell that is its own 1x1x1 block
+        return 0.0
