@@ -6,6 +6,13 @@ import json
 import ssl
 import urllib.request
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="the webhook cert lifecycle mints real X.509 material",
+)
+
 from tpu_operator.certs import DAY, WebhookCertManager
 from tpu_operator.kube.fake import FakeClient
 from tpu_operator.kube.objects import new_object

@@ -167,6 +167,7 @@ class TestWebhookTLS:
     def test_https_round_trip_with_self_signed_cert(self, tmp_path):
         import ssl as ssl_mod
 
+        pytest.importorskip("cryptography", reason="self-signed serving cert needs x509")
         from tpu_operator.webhook import generate_self_signed_cert
 
         cert, key, ca_b64 = generate_self_signed_cert(str(tmp_path))

@@ -151,6 +151,7 @@ def test_from_kubeconfig_parses_client_cert_auth(tmp_path):
 
     import yaml
 
+    pytest.importorskip("cryptography", reason="minting the client cert pair needs x509")
     from tpu_operator.webhook import generate_self_signed_cert
 
     cert, key, ca_b64 = generate_self_signed_cert(str(tmp_path))

@@ -437,6 +437,7 @@ class TestOperatorUnderEnforcement:
         install flow never exercises secrets/VWC verbs (webhook defaults
         off), so without this the role's secrets/admissionregistration
         slices were untested claims."""
+        pytest.importorskip("cryptography", reason="the cert manager mints real X.509 material")
         from tpu_operator.certs import WebhookCertManager
         from tpu_operator.kube.objects import new_object
 

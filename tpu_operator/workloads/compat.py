@@ -1,0 +1,43 @@
+"""Version-skew shims for the jax workload programs.
+
+The workloads target current jax, but the baked toolchain image can lag
+behind it: ``shard_map`` graduated from ``jax.experimental`` into the
+``jax`` namespace, and its replication/varying-manual-axes check flag was
+renamed ``check_rep`` -> ``check_vma`` along the way. One import site
+owns the skew so every workload reads as if written against today's API
+and still runs on the older release.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # current jax
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — pre-graduation releases
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover — unsignaturable wrapper
+    _SHARD_MAP_PARAMS = None
+
+
+def shard_map(f, /, **kwargs):
+    """``jax.shard_map`` accepting the modern ``check_vma`` kwarg on
+    releases where the same switch is spelled ``check_rep``. When the
+    signature can't be introspected the kwargs pass through untouched —
+    mistranslating on current jax would silently disable type checking."""
+    if (
+        "check_vma" in kwargs
+        and _SHARD_MAP_PARAMS is not None
+        and "check_vma" not in _SHARD_MAP_PARAMS
+    ):
+        kwargs.pop("check_vma")
+        if "check_rep" in _SHARD_MAP_PARAMS:
+            # the old checker miscounts scan-carry replication (its own
+            # error text prescribes check_rep=False as the workaround), so
+            # on these releases the static check is off wholesale; current
+            # jax still honors the caller's check_vma
+            kwargs["check_rep"] = False
+    return _shard_map(f, **kwargs)

@@ -31,6 +31,18 @@ from typing import Mapping, Optional
 RESULT_PREFIX = "MULTIPROC_RESULT:"
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# jaxlib's pre-gloo CPU client raises exactly this when a compiled program
+# contains a cross-process collective
+_CPU_COLLECTIVES_UNSUPPORTED = "Multiprocess computations aren't implemented on the CPU backend"
+
+
+class CpuCollectivesUnsupportedError(RuntimeError):
+    """The installed jaxlib's CPU client cannot execute cross-process
+    collectives: an environment limit, not a gang-wiring failure. The
+    distributed bring-up itself succeeded (initialize connected every
+    worker), so callers degrade to a skip instead of reporting a broken
+    gang contract."""
+
 
 def _worker_checks() -> dict:
     """Runs inside each gang worker process: bring-up + collectives."""
@@ -48,10 +60,7 @@ def _worker_checks() -> dict:
     import jax.numpy as jnp  # noqa: F401  (keeps the jit path warm-importable)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    try:  # jax >= 0.4.35
-        from jax import shard_map
-    except ImportError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map
+    from tpu_operator.workloads.compat import shard_map
 
     from tpu_operator.workloads.ringattention import (
         _ring_attention_local,
@@ -222,6 +231,13 @@ def _launch_workers(worker_envs, devices_per_worker: int, timeout: float):
             )
         workers.append(report)
     if failures:
+        if any(_CPU_COLLECTIVES_UNSUPPORTED in f for f in failures):
+            raise CpuCollectivesUnsupportedError(
+                "this jaxlib's CPU backend cannot execute multiprocess "
+                f"collectives ({_CPU_COLLECTIVES_UNSUPPORTED!r}); the gang "
+                "came up and the program compiled — a newer jax/jaxlib runs "
+                "the check for real"
+            )
         if any("timeout" in f for f in failures):
             # the overwhelmingly common cause: initialize() blocks until
             # EVERY process in the derived world connects, so one missing

@@ -28,10 +28,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35
-    from jax import shard_map
-except ImportError:  # pragma: no cover — older jax
-    from jax.experimental.shard_map import shard_map
+from tpu_operator.workloads.compat import shard_map
 
 
 def make_pp_mesh(devices=None, stages: Optional[int] = None) -> Mesh:
