@@ -33,6 +33,13 @@ echo "== placement smoke: place/evict/re-place churn on a 512-host torus =="
 # (overlap) or an accidentally super-linear block search (blown budget)
 # produce
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --placement-smoke
+echo "== trace smoke: every reconcile yields a complete trace; recorder stays bounded =="
+# flight-recorder gate: install -> Ready through the chaos schedule with
+# full tracing (no orphan spans, >=95% of each reconcile's wall time
+# accounted, retries visible as attempt children), the ring buffer
+# provably wraps, and the 4096-node sim keeps the recorder under its
+# measured memory cap
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --trace-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

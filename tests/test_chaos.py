@@ -686,6 +686,61 @@ class TestChaosSoak:
         _assert_no_orphans(obs["store"], obs["cp_uid"])
 
 
+class TestTraceChaosRider:
+    def test_spans_survive_fault_injection_and_recorder_stays_bounded(self):
+        """ISSUE 6 rider: the flight recorder must tell the truth UNDER
+        the fault schedule — every completed reconcile trace complete
+        (no orphan spans, overflow accounted), retried requests visible
+        as attempt children under one logical api span (scripted PATCH
+        500s make that deterministic: every PATCH is operator traffic,
+        so the retries land inside reconcile spans by construction),
+        injected faults attributed to the reconcile that sent the
+        request, and the ring bounded throughout."""
+        from tpu_operator.kube import trace as trace_mod
+
+        rec = trace_mod.reset_recorder(capacity=64)
+        completed = []
+        rec.add_listener(completed.append)
+        try:
+            director = ChaosDirector.standard(
+                seed=11, outage_at=2.0, outage_duration=2.0, watch_drop_every=2.0,
+            )
+            director.rules = [
+                FaultRule(FAULT_500, rate=1.0, times=3, verbs=("PATCH",)),
+                *director.rules,
+            ]
+            obs = _run_soak(nodes=16, director=director, ready_timeout=90.0)
+            assert obs["became_ready"], "never Ready under the fault schedule"
+
+            assert completed, "no reconcile traces recorded under chaos"
+            incomplete = [t for t in completed if not t.complete()]
+            assert not incomplete, (
+                f"{len(incomplete)} traces with orphan/unaccounted spans, e.g. "
+                + "\n".join(rec._render_trace(incomplete[0]))
+            )
+            bad_accounting = [
+                t for t in completed if t.accounted_fraction() < 0.95
+            ]
+            assert not bad_accounting, "trace components fail to account for wall time"
+            retried = [
+                s
+                for t in completed
+                for s in t.spans
+                if s.name == "api" and int(s.attrs.get("attempts") or 1) > 1
+            ]
+            assert retried, "scripted PATCH 500s produced no retried api span"
+            # the fault log attributes its scripted PATCH hits to traces
+            patch_faults = [r for r in director.fault_log if r.verb == "PATCH"]
+            assert patch_faults and all(r.trace for r in patch_faults)
+            trace_ids = {t.trace_id for t in completed}
+            assert all(r.trace.split("/")[0] in trace_ids for r in patch_faults)
+            # bounded: the ring held its cap while listeners saw everything
+            assert len(rec) <= 64
+            assert rec.traces_recorded == len(completed)
+        finally:
+            trace_mod.reset_recorder()
+
+
 class TestCrashRestartDrill:
     def test_crash_mid_rollout_then_restart_converges_idempotently(self):
         """SIGKILL-equivalent drill: mid-install the apiserver goes away

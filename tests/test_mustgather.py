@@ -87,6 +87,14 @@ def test_bundle_from_live_install(tmp_path):
         placement_txt = (tmp_path / "placement.txt").read_text()
         assert "# placement queue" in placement_txt
         assert "# host assignments" in placement_txt
+        # the flight recorder rides along: this process ran the
+        # reconciles, so traces.txt must hold real reconcile span trees
+        traces_txt = (tmp_path / "traces.txt").read_text()
+        assert "# flight recorder:" in traces_txt
+        assert "controller=clusterpolicy" in traces_txt
+        assert "verb=" in traces_txt  # api spans inside the reconciles
+        slow_txt = (tmp_path / "slow-reconciles.txt").read_text()
+        assert "# slowest" in slow_txt and "controller=" in slow_txt
         pod_name = pod["metadata"]["name"]
         log_text = (tmp_path / "pod-logs" / f"{pod_name}.log").read_text()
         assert "line-1\nline-2\n" in log_text  # multi-container pods get headers
@@ -101,7 +109,7 @@ def test_bundle_from_live_install(tmp_path):
             "nodes.yaml", "node-labels.txt", "node-health.txt", "placement.txt",
             "clusterpolicies.yaml", "tpuslices.yaml",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
-            "events.txt", "pod-logs",
+            "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
         } <= stems
     finally:
         mgr.stop()

@@ -1,0 +1,363 @@
+"""Flight recorder & reconcile tracing (kube/trace.py) — ISSUE 6.
+
+Covers: span mechanics (parent/child, attrs, error capture), the
+bounded ring buffer with overflow aggregation, queue-wait measurement,
+controller-produced traces with api child spans from both clients,
+wire propagation of the trace header into the chaos fault log, the
+breaker fast-fail span, the new histograms, idempotent OperatorMetrics
+construction, and the lint metrics-catalog rule.
+"""
+
+import time
+
+import pytest
+
+from tpu_operator.kube import errors, trace
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.http_client import HttpClient
+from tpu_operator.kube.httpserver import FakeApiServer
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.queue import RateLimitingQueue
+
+
+@pytest.fixture(autouse=True)
+def fresh_recorder():
+    rec = trace.reset_recorder()
+    yield rec
+    trace.reset_recorder()
+
+
+def _cm(name, ns="ns"):
+    return {"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": name, "namespace": ns}}
+
+
+class TestSpans:
+    def test_parent_child_attrs_and_ids(self, fresh_recorder):
+        with trace.start_trace("reconcile", controller="c", request="r") as root:
+            assert trace.active() and trace.current() is root
+            with trace.span("phase", detail=1) as child:
+                assert child.parent_id == root.span_id
+                assert child.trace_id == root.trace_id
+                assert trace.trace_ref() == f"{root.trace_id}/{child.span_id}"
+        assert not trace.active()
+        (t,) = fresh_recorder.traces()
+        assert [s.name for s in t.spans] == ["reconcile", "phase"]
+        assert t.complete()
+        assert all(s.end is not None for s in t.spans)
+
+    def test_span_outside_trace_is_noop(self, fresh_recorder):
+        with trace.span("orphan") as s:
+            assert s is trace.NOOP_SPAN
+            s.set(anything="goes")
+        assert len(fresh_recorder) == 0
+        assert fresh_recorder.spans_started == 0
+
+    def test_exception_recorded_on_span_and_reraised(self, fresh_recorder):
+        with pytest.raises(ValueError):
+            with trace.start_trace("reconcile", controller="c", request="r"):
+                with trace.span("phase"):
+                    raise ValueError("boom")
+        (t,) = fresh_recorder.traces()
+        assert t.complete()
+        assert "boom" in t.spans[1].error
+        assert "boom" in t.root.error
+
+    def test_accounted_fraction_flags_clock_inconsistency(self):
+        root = trace.Span("t", "t", None, "reconcile", {"queue_wait_s": 0.0})
+        t = trace.Trace(root, 16)
+        child = trace.Span("t", "c1", "t", "api", {})
+        t.add(child)
+        child.end = child.start + 0.05
+        root.end = root.start + 0.1
+        assert t.accounted_fraction() > 0.99  # clean nesting
+        # a child recorded far past the root's end is unaccountable time
+        child.end = root.end + 0.5
+        assert t.accounted_fraction() < 0.95
+
+
+class TestFlightRecorder:
+    def test_ring_bound_and_listener_sees_evicted(self):
+        rec = trace.reset_recorder(capacity=8)
+        seen = []
+        rec.add_listener(lambda t: seen.append(t.trace_id))
+        for _ in range(20):
+            with trace.start_trace("reconcile", controller="c", request="r"):
+                pass
+        assert len(rec) == 8  # ring wrapped
+        assert rec.traces_recorded == 20
+        assert len(seen) == 20  # the listener missed nothing
+        assert rec.orphan_spans() == 0
+
+    def test_overflow_aggregates_instead_of_losing(self):
+        rec = trace.reset_recorder(max_spans_per_trace=4)
+        client = FakeClient()
+        for i in range(10):
+            client.create(_cm(f"x{i}"))
+        with trace.start_trace("reconcile", controller="c", request="r"):
+            for i in range(10):
+                client.get("v1", "ConfigMap", f"x{i}", "ns")
+        (t,) = rec.traces()
+        assert len(t.spans) == 4 and t.dropped == 7
+        count, requests, seconds = t.overflow[("api", "get", "ConfigMap")]
+        assert count == 7 and requests == 7 and seconds > 0
+        assert t.complete(), "aggregated overflow must not read as orphan spans"
+        assert "(aggregated)" in rec.dump()
+
+    def test_dump_and_slowest(self, fresh_recorder):
+        for i, sleep in enumerate((0.0, 0.02)):
+            with trace.start_trace("reconcile", controller="c", request=f"r{i}"):
+                time.sleep(sleep)
+        slow = fresh_recorder.dump_slowest(1)
+        assert "request=r1" in slow and "request=r0" not in slow
+        assert "controller=c" in fresh_recorder.dump()
+
+    def test_byte_estimate_bounded_by_capacity(self):
+        rec = trace.reset_recorder(capacity=4, max_spans_per_trace=4)
+        client = FakeClient()
+        client.create(_cm("x"))
+        for _ in range(50):
+            with trace.start_trace("reconcile", controller="c", request="r"):
+                for _ in range(20):
+                    client.get("v1", "ConfigMap", "x", "ns")
+        bound = 4 * (4 * 200 + 4 * 5 * 120 + 8 * 160)
+        assert rec.byte_estimate() <= bound
+
+
+class TestQueueWait:
+    def test_wait_measured_from_readiness(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        time.sleep(0.03)
+        item = q.get(timeout=1.0)
+        assert item == "a"
+        assert 0.02 <= q.wait_of("a") < 5.0
+        q.done("a")
+        assert q.wait_of("a") == 0.0  # cleared
+
+    def test_delayed_add_excludes_planned_delay(self):
+        q = RateLimitingQueue()
+        q.add_after("a", 0.05)
+        item = q.get(timeout=1.0)
+        assert item == "a"
+        # the 50ms planned delay is not queue latency
+        assert q.wait_of("a") < 0.04
+
+    def test_oldest_age_tracks_pending(self):
+        q = RateLimitingQueue()
+        assert q.oldest_age() == 0.0
+        q.add("a")
+        time.sleep(0.02)
+        assert q.oldest_age() >= 0.02
+
+
+class _Reconciler:
+    def __init__(self, client):
+        self.client = client
+        self.seen = []
+
+    def reconcile(self, req: Request) -> Result:
+        self.seen.append(req)
+        self.client.get("v1", "ConfigMap", req.name, "ns")
+        return Result()
+
+
+class TestControllerTracing:
+    def test_reconcile_produces_trace_with_queue_wait_and_api_children(self, fresh_recorder):
+        client = FakeClient()
+        client.create(_cm("obj"))
+        ctrl = Controller("demo", _Reconciler(client))
+        informer = Informer(client, "v1", "ConfigMap")
+        ctrl.watch(informer)
+        informer.start()
+        ctrl.start()
+        try:
+            client.update({**_cm("obj"), "data": {"k": "v"}})
+            deadline = time.time() + 5
+            while time.time() < deadline and len(fresh_recorder) < 1:
+                time.sleep(0.01)
+            traces = fresh_recorder.traces()
+            assert traces, "no trace recorded for the reconcile"
+            t = traces[0]
+            assert t.root.attrs["controller"] == "demo"
+            assert t.root.attrs["request"] == "ns/obj"
+            assert "queue_wait_s" in t.root.attrs
+            api = [s for s in t.spans if s.name == "api"]
+            assert api and api[0].attrs["kind"] == "ConfigMap"
+            assert t.complete()
+        finally:
+            ctrl.stop()
+            informer.stop()
+
+    def test_reconcile_exception_traced_and_histograms_observe(self, fresh_recorder):
+        import prometheus_client
+
+        class Boom:
+            def reconcile(self, req):
+                raise RuntimeError("bang")
+
+        ctrl = Controller("boomer", Boom())
+        ctrl.start()
+        try:
+            before = prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer"}
+            ) or 0.0
+            ctrl.queue.add(Request(name="x"))
+            deadline = time.time() + 5
+            while time.time() < deadline and len(fresh_recorder) < 1:
+                time.sleep(0.01)
+            (t,) = fresh_recorder.traces()[:1]
+            assert "bang" in t.root.error
+            assert t.complete()
+            after = prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer"}
+            )
+            assert after >= before + 1
+            assert prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_workqueue_wait_seconds_count", {"controller": "boomer"}
+            ) >= 1
+        finally:
+            ctrl.stop()
+
+
+class TestWirePropagation:
+    def test_trace_header_attributes_chaos_faults_and_retries_nest(self, fresh_recorder):
+        from tpu_operator.kube.chaos import FAULT_500, ChaosDirector, FaultRule
+
+        store = FakeClient()
+        store.create(_cm("x"))
+        director = ChaosDirector(
+            seed=3, rules=[FaultRule(FAULT_500, rate=1.0, times=2, verbs=("GET",))]
+        )
+        server = FakeApiServer(store, chaos=director).start()
+        client = HttpClient(server.base_url)
+        try:
+            with trace.start_trace("reconcile", controller="c", request="x"):
+                client.get("v1", "ConfigMap", "x", "ns")
+            (t,) = fresh_recorder.traces()
+            api = [s for s in t.spans if s.name == "api"]
+            attempts = [s for s in t.spans if s.name == "attempt"]
+            # one logical call, three attempts under it (two 500s retried)
+            assert len(api) == 1 and api[0].attrs["attempts"] == 3
+            assert len(attempts) == 3
+            assert all(a.parent_id == api[0].span_id for a in attempts)
+            assert t.complete()
+            # the fault log knows WHICH reconcile its injections hit
+            assert len(director.fault_log) == 2
+            for rec_ in director.fault_log:
+                assert rec_.trace.startswith(t.trace_id + "/")
+        finally:
+            server.stop()
+
+    def test_breaker_open_fast_fail_is_recorded(self, fresh_recorder):
+        store = FakeClient()
+        store.create(_cm("x"))
+        server = FakeApiServer(store).start()
+        client = HttpClient(server.base_url)
+        try:
+            client.resilience.breaker._set_state("open")
+            client.resilience.breaker.opened_at = time.monotonic() + 1000
+            with pytest.raises(errors.BreakerOpen):
+                with trace.start_trace("reconcile", controller="c", request="x"):
+                    client.get("v1", "ConfigMap", "x", "ns")
+            (t,) = fresh_recorder.traces()
+            (api,) = [s for s in t.spans if s.name == "api"]
+            assert "BreakerOpen" in api.error
+            assert "attempts" not in api.attrs  # fail-fast: zero wire sends
+            assert not [s for s in t.spans if s.name == "attempt"]
+            assert t.complete()
+        finally:
+            server.stop()
+
+
+class TestInformerLag:
+    def test_lag_histogram_observes_per_event(self):
+        import prometheus_client
+
+        client = FakeClient()
+        informer = Informer(client, "v1", "ConfigMap")
+        informer.start()
+        try:
+            before = prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_informer_event_lag_seconds_count", {"kind": "ConfigMap"}
+            ) or 0.0
+            client.create(_cm("x"))
+            after = prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_informer_event_lag_seconds_count", {"kind": "ConfigMap"}
+            )
+            assert after >= before + 1
+        finally:
+            informer.stop()
+
+
+class TestOperatorMetricsIdempotent:
+    def test_second_construction_reuses_collectors(self):
+        """Regression (ISSUE 6 satellite): a second in-process Manager
+        (crash-recovery drills) constructing OperatorMetrics against the
+        default registry must not trip prometheus duplicate
+        registration."""
+        from tpu_operator.controllers.operator_metrics import OperatorMetrics
+
+        a = OperatorMetrics()
+        b = OperatorMetrics()  # would raise ValueError before the fix
+        assert a.tpu_nodes_total is b.tpu_nodes_total
+        assert a.reconciliation_total is b.reconciliation_total
+        assert a.torus_fragmentation is b.torus_fragmentation
+        # the re-exported process-wide series are singletons too
+        assert a.reconcile_duration is b.reconcile_duration
+        assert a.apiserver_request_duration is b.apiserver_request_duration
+
+    def test_custom_registry_still_gets_private_collectors(self):
+        import prometheus_client
+
+        from tpu_operator.controllers.operator_metrics import OperatorMetrics
+
+        reg = prometheus_client.CollectorRegistry()
+        m = OperatorMetrics(registry=reg)
+        m.tpu_nodes_total.set(3)
+        assert reg.get_sample_value("tpu_operator_tpu_nodes_total") == 3
+
+
+class TestMetricsCatalogLint:
+    def test_repo_catalog_is_in_sync(self):
+        from tpu_operator.lint import metrics_catalog
+
+        assert metrics_catalog.analyze() == []
+
+    def test_undocumented_metric_is_flagged(self, tmp_path):
+        from tpu_operator.lint import metrics_catalog
+
+        src = tmp_path / "code"
+        src.mkdir()
+        (src / "m.py").write_text(
+            "import prometheus_client\n"
+            'g = prometheus_client.Gauge("tpu_operator_phantom_series", "doc")\n'
+        )
+        doc = tmp_path / "COMPONENTS.md"
+        doc.write_text("### Metric catalog\n\n| `tpu_operator_other` | gauge | x |\n")
+        findings = metrics_catalog.analyze(str(src), str(doc))
+        rules = {(f.rule, f.location) for f in findings}
+        assert ("TPUOP-O001", "metric:tpu_operator_phantom_series") in rules
+        assert ("TPUOP-O002", "metric:tpu_operator_other") in rules
+
+    def test_factory_style_registration_is_seen(self, tmp_path):
+        from tpu_operator.lint import metrics_catalog
+
+        src = tmp_path / "code"
+        src.mkdir()
+        (src / "m.py").write_text(
+            "import prometheus_client\n"
+            "def build(factory):\n"
+            '    return factory(prometheus_client.Counter, "tpu_operator_via_factory_total", "doc")\n'
+        )
+        assert "tpu_operator_via_factory_total" in metrics_catalog.registered_metrics(str(src))
+
+    def test_missing_catalog_section_is_an_error(self, tmp_path):
+        from tpu_operator.lint import metrics_catalog
+
+        src = tmp_path / "code"
+        src.mkdir()
+        doc = tmp_path / "COMPONENTS.md"
+        doc.write_text("# nothing here\n")
+        findings = metrics_catalog.analyze(str(src), str(doc))
+        assert findings and findings[0].rule == "TPUOP-O002"

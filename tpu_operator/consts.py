@@ -184,6 +184,17 @@ READY_RESYNC_SECONDS = 60.0
 # bookmark periodically; the in-repo fake heartbeats every ~5 s idle.
 WATCH_STALL_SECONDS = 300.0
 
+# ---------------------------------------------------------------------------
+# Flight recorder (kube/trace.py): every reconcile produces a trace
+# (queue wait + body + every apiserver call inside it); completed traces
+# land in a process-wide ring buffer bounded by these knobs — always-on
+# observability whose memory ceiling is fixed by construction, not by
+# workload behavior. Dumped by `tpuop-cfg must-gather` (traces.txt /
+# slow-reconciles.txt) and aggregated by bench.py's attribution block.
+# ---------------------------------------------------------------------------
+FLIGHT_RECORDER_CAPACITY = 256  # completed traces held (oldest evicted)
+FLIGHT_RECORDER_MAX_SPANS_PER_TRACE = 512  # per-trace span cap (excess counted)
+
 # Container runtimes (reference: getRuntime state_manager.go:714-751).
 RUNTIME_CONTAINERD = "containerd"
 RUNTIME_CRIO = "crio"

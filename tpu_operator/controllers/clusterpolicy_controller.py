@@ -25,6 +25,7 @@ from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.controllers.status import publish_status
 from tpu_operator.kube import errors
 from tpu_operator.kube import retry as kube_retry
+from tpu_operator.kube import trace
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
@@ -106,15 +107,17 @@ class ClusterPolicyReconciler:
             has_tpu_nodes=info.tpu_node_count > 0,
         )
         try:
-            self._label_tpu_nodes(cp)
-            self._apply_psa_labels(cp)
+            with trace.span("label-nodes"):
+                self._label_tpu_nodes(cp)
+                self._apply_psa_labels(cp)
         except errors.ApiError as e:
             log.warning("node labelling failed: %s", e)
             self.metrics.record_failure()
             return Result(requeue=True)
         self.metrics.tpu_nodes_total.set(info.tpu_node_count)
 
-        results = self.state_manager.sync_state(self.client, catalog, owner=obj)
+        with trace.span("sync-states"):
+            results = self.state_manager.sync_state(self.client, catalog, owner=obj)
         not_ready = [n for n, r in results.states.items() if r.state == SyncStates.NOT_READY]
         errored = [n for n, r in results.states.items() if r.state == SyncStates.ERROR]
         self.metrics.operand_states_not_ready.set(len(not_ready) + len(errored))

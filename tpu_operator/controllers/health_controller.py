@@ -38,6 +38,7 @@ from tpu_operator.api.clusterpolicy import (
     HealthMonitorSpec,
 )
 from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import trace
 from tpu_operator.kube import errors
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
@@ -475,7 +476,8 @@ class HealthReconciler:
             self._publish_health_status(req.name, states)
             return Result(requeue_after=interval)
 
-        states = self.repair_manager.apply_state(spec)
+        with trace.span("repair-fsm"):
+            states = self.repair_manager.apply_state(spec)
         degraded = [n for n, s in states.items() if s == consts.HEALTH_DEGRADED]
         quarantined = [n for n, s in states.items() if s == RepairState.QUARANTINED]
         in_repair = [n for n, s in states.items() if s in IN_REPAIR]

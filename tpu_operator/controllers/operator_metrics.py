@@ -14,91 +14,136 @@ import prometheus_client
 _METRICS = None
 
 
+def _get_or_create(kind, name: str, doc: str, labelnames=(), registry=None):
+    """Idempotent collector construction: a second in-process ``Manager``
+    (crash-recovery and leader-failover drills boot one, and so does any
+    embedder that builds its own ``OperatorMetrics``) must not trip the
+    registry's duplicate-registration ValueError — the existing collector
+    is the same series and is simply reused."""
+    reg = registry or prometheus_client.REGISTRY
+    try:
+        return kind(name, doc, labelnames, registry=reg)
+    except ValueError:
+        # prometheus_client indexes counters under the _total-stripped
+        # name; probe both spellings before concluding the clash is real
+        existing = reg._names_to_collectors.get(name)
+        if existing is None and name.endswith("_total"):
+            existing = reg._names_to_collectors.get(name[: -len("_total")])
+        if existing is None:
+            raise
+        return existing
+
+
 class OperatorMetrics:
     def __init__(self, registry=None):
         reg = registry or prometheus_client.REGISTRY
-        self.tpu_nodes_total = prometheus_client.Gauge(
+        self.tpu_nodes_total = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_tpu_nodes_total",
             "Number of nodes with TPUs",
             registry=reg,
         )
-        self.reconciliation_total = prometheus_client.Counter(
+        self.reconciliation_total = _get_or_create(
+            prometheus_client.Counter,
             "tpu_operator_reconciliation_total",
             "Total number of ClusterPolicy reconciliations",
             registry=reg,
         )
-        self.reconciliation_failed = prometheus_client.Counter(
+        self.reconciliation_failed = _get_or_create(
+            prometheus_client.Counter,
             "tpu_operator_reconciliation_failed_total",
             "Number of failed ClusterPolicy reconciliations",
             registry=reg,
         )
-        self.reconciliation_status = prometheus_client.Gauge(
+        self.reconciliation_status = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_reconciliation_status",
             "1 when the last reconciliation was fully successful",
             registry=reg,
         )
-        self.reconciliation_last_success_ts = prometheus_client.Gauge(
+        self.reconciliation_last_success_ts = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_reconciliation_last_success_ts_seconds",
             "Timestamp (seconds since epoch) of the last successful reconciliation",
             registry=reg,
         )
-        self.operand_states_not_ready = prometheus_client.Gauge(
+        self.operand_states_not_ready = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_operand_states_not_ready",
             "Number of operand states not currently Ready",
             registry=reg,
         )
-        self.upgrades_in_progress = prometheus_client.Gauge(
+        self.upgrades_in_progress = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_libtpu_upgrades_in_progress",
             "Nodes currently upgrading libtpu",
             registry=reg,
         )
-        self.upgrades_done = prometheus_client.Gauge(
+        self.upgrades_done = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_libtpu_upgrades_done",
             "Nodes that completed libtpu upgrade",
             registry=reg,
         )
-        self.upgrades_failed = prometheus_client.Gauge(
+        self.upgrades_failed = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_libtpu_upgrades_failed",
             "Nodes in libtpu upgrade-failed state",
             registry=reg,
         )
-        self.unhealthy_nodes = prometheus_client.Gauge(
+        self.unhealthy_nodes = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_unhealthy_nodes",
             "Nodes whose TPU health is degraded, in repair, or quarantined",
             registry=reg,
         )
-        self.quarantined_nodes = prometheus_client.Gauge(
+        self.quarantined_nodes = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_quarantined_nodes",
             "Nodes parked in the quarantined terminal repair state",
             registry=reg,
         )
-        self.remediations_total = prometheus_client.Counter(
+        self.remediations_total = _get_or_create(
+            prometheus_client.Counter,
             "tpu_operator_remediations_total",
             "Health remediation attempts started",
             registry=reg,
         )
-        self.placement_queue_depth = prometheus_client.Gauge(
+        self.placement_queue_depth = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_placement_queue_depth",
             "TPUSlice placement requests not currently Scheduled "
             "(Queued + Unschedulable)",
             registry=reg,
         )
-        self.torus_fragmentation = prometheus_client.Gauge(
+        self.torus_fragmentation = _get_or_create(
+            prometheus_client.Gauge,
             "tpu_operator_torus_fragmentation",
             "External fragmentation of a node pool's host torus "
             "(1 - largest free cube / free hosts)",
             ["pool"],
             registry=reg,
         )
-        # apiserver-client resilience series, owned by the transport
-        # layer (kube/retry.py) the same way apiserver_requests_total is
-        # owned by http_client: process-wide on the default registry —
-        # re-exported here so the operator's metric surface is complete
-        # in one place and served from the manager's :8080 endpoint.
+        # process-wide series owned by the layers that measure them —
+        # transport resilience by kube/retry, wire request counts +
+        # latency by kube/http_client, reconcile/queue/informer timing by
+        # kube/trace — re-exported here so the operator's metric surface
+        # is complete in one place and served from the manager's :8080
+        # endpoint. (These live on the default registry regardless of
+        # ``registry``; a custom registry gets only the operator-owned
+        # series above, same as before.)
         from tpu_operator.kube import retry as _retry
+        from tpu_operator.kube import trace as _trace
+        from tpu_operator.kube.http_client import request_latency_histogram
 
         self.api_retries_total = _retry.retries_counter()
         self.api_breaker_state = _retry.breaker_state_gauge()
+        self.reconcile_duration = _trace.reconcile_duration_histogram()
+        self.workqueue_depth = _trace.queue_depth_gauge()
+        self.workqueue_oldest_age = _trace.queue_oldest_age_gauge()
+        self.workqueue_wait = _trace.queue_wait_histogram()
+        self.informer_event_lag = _trace.informer_lag_histogram()
+        self.apiserver_request_duration = request_latency_histogram()
 
     def record_success(self):
         self.reconciliation_total.inc()

@@ -22,7 +22,7 @@ from typing import List, Optional
 from tpu_operator import consts
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
 from tpu_operator.controllers.operator_metrics import get_metrics
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, trace
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
@@ -47,10 +47,12 @@ class PlacementReconciler:
     def reconcile(self, req: Request) -> Result:
         slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         nodes = self.client.list("v1", "Node")
-        engine = PlacementEngine(slices, nodes)
-        plan = engine.plan()
-        self._apply_labels(plan)
-        statuses_ok = self._publish_statuses(plan, {s["metadata"]["name"]: s for s in slices})
+        with trace.span("plan", slices=len(slices), nodes=len(nodes)):
+            engine = PlacementEngine(slices, nodes)
+            plan = engine.plan()
+        with trace.span("apply-plan", deltas=len(plan.label_deltas)):
+            self._apply_labels(plan)
+            statuses_ok = self._publish_statuses(plan, {s["metadata"]["name"]: s for s in slices})
         self._record_events(plan, engine)
         self.metrics.placement_queue_depth.set(plan.queue_depth)
         for pool, frag in plan.fragmentation.items():

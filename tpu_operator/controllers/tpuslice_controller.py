@@ -25,7 +25,7 @@ from tpu_operator.api.tpuslice import (
 from tpu_operator.catalog import InfoCatalog
 from tpu_operator.controllers.status import publish_status
 from tpu_operator.controllers.tpuslice_validator import ValidationError, validate_node_selectors
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, trace
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
@@ -88,7 +88,8 @@ class TPUSliceReconciler:
             has_tpu_nodes=bool(pools),
         )
         state = TPUSliceLibtpuState(ts)
-        result = state.sync(self.client, catalog, owner=obj)
+        with trace.span("sync-pools", pools=len(pools)):
+            result = state.sync(self.client, catalog, owner=obj)
         if result.state == SyncStates.ERROR:
             self._status(obj, "notReady", error=True, reason="SyncError", message=result.error or "")
             return Result(requeue=True)

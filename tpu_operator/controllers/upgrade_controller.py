@@ -18,7 +18,7 @@ from tpu_operator.api.clusterpolicy import (
     ClusterPolicy,
 )
 from tpu_operator.controllers.operator_metrics import get_metrics
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, trace
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
@@ -54,7 +54,8 @@ class UpgradeReconciler:
         self.metrics.upgrades_in_progress.set(state.count(*IN_PROGRESS))
         self.metrics.upgrades_done.set(state.count(UpgradeState.DONE))
         self.metrics.upgrades_failed.set(state.count(UpgradeState.FAILED))
-        self.state_manager.apply_state(state, policy)
+        with trace.span("upgrade-fsm", nodes=len(state.nodes)):
+            self.state_manager.apply_state(state, policy)
         # apply_state keeps the in-memory state current (every successful
         # transition writes node_state.state), so no re-list is needed
         self._publish_upgrade_status(req.name, state)

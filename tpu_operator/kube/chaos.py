@@ -35,6 +35,7 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 from tpu_operator.kube import errors
+from tpu_operator.kube import trace as trace_mod
 from tpu_operator.kube.client import Client
 
 # fault classes a rule may inject (also the fault-log vocabulary;
@@ -84,6 +85,12 @@ class FaultRecord:
     kind: str
     fault: str
     detail: str = ""
+    # "trace_id/span_id" of the reconcile whose request this fault hit
+    # (from the X-Tpuop-Trace header on the served path, or the caller's
+    # active span for ChaosClient); "" for untraced traffic. Excluded
+    # from equality so same-seed determinism asserts compare the fault
+    # SCHEDULE, not process-random span ids.
+    trace: str = dataclasses.field(default="", compare=False)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,17 +221,19 @@ class ChaosDirector:
 
     # -- decisions -----------------------------------------------------------
 
-    def _log(self, fault: str, verb: str, kind: str, detail: str = "") -> None:
+    def _log(self, fault: str, verb: str, kind: str, detail: str = "", trace: str = "") -> None:
         with self._lock:
             self._seq += 1
-            self.fault_log.append(FaultRecord(self._seq, verb, kind, fault, detail))
+            self.fault_log.append(FaultRecord(self._seq, verb, kind, fault, detail, trace))
 
-    def decide(self, verb: str, kind: str) -> Optional[Injection]:
+    def decide(self, verb: str, kind: str, trace: str = "") -> Optional[Injection]:
         """Consulted once per unary request. Outage windows dominate
         (everything is refused at the connection level); otherwise the
-        first matching rule that fires wins."""
+        first matching rule that fires wins. ``trace`` is the request's
+        propagated trace ref, recorded so the fault log lands inside the
+        right reconcile span."""
         if self.in_outage():
-            self._log(FAULT_OUTAGE, verb, kind, "connection refused")
+            self._log(FAULT_OUTAGE, verb, kind, "connection refused", trace)
             return Injection(FAULT_RESET)
         with self._lock:
             if self._quiesced:
@@ -239,7 +248,7 @@ class ChaosDirector:
                     break
         if rule is None:
             return None
-        self._log(rule.fault, verb, kind)
+        self._log(rule.fault, verb, kind, trace=trace)
         if rule.fault in (FAULT_500, FAULT_503):
             return Injection(
                 rule.fault, code=int(rule.fault),
@@ -294,7 +303,9 @@ class ChaosClient(Client):
         self.director = director.start()
 
     def _maybe_fault(self, verb: str, kind: str) -> None:
-        injection = self.director.decide(_VERB_HTTP[verb], kind)
+        injection = self.director.decide(
+            _VERB_HTTP[verb], kind, trace=trace_mod.trace_ref()
+        )
         if injection is None:
             return
         if injection.fault == FAULT_LATENCY:

@@ -12,8 +12,10 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Callable, List, Optional
 
+from tpu_operator.kube import trace
 from tpu_operator.kube.informer import Informer
 from tpu_operator.kube.objects import ObjectDict
 from tpu_operator.kube.queue import RateLimitingQueue
@@ -69,6 +71,14 @@ class Controller:
         self._watches: List[tuple] = []  # (informer, mapper, predicate)
         self._threads: List[threading.Thread] = []
         self._stopping = threading.Event()
+        # per-controller observability series (process-wide factories in
+        # kube/trace.py, re-exported by controllers.operator_metrics)
+        self._depth_gauge = trace.queue_depth_gauge().labels(name)
+        self._wait_histogram = trace.queue_wait_histogram().labels(name)
+        self._duration_histogram = trace.reconcile_duration_histogram().labels(name)
+        # live at scrape time — a stalled queue's age keeps growing even
+        # though nothing pops to update a plain gauge
+        trace.queue_oldest_age_gauge().labels(name).set_function(self.queue.oldest_age)
 
     def watch(self, informer: Informer, mapper: Mapper = to_self_request, predicate: Optional[Predicate] = None):
         informer.add_handler(self._make_handler(mapper, predicate))
@@ -81,8 +91,15 @@ class Controller:
                 return
             for req in mapper(new):
                 self.queue.add(req)
+            self._set_depth()
 
         return handler
+
+    def _set_depth(self) -> None:
+        try:
+            self._depth_gauge.set(len(self.queue))
+        except Exception:  # noqa: BLE001 — metrics must never break the loop
+            pass
 
     def start(self) -> None:
         for i in range(self.max_concurrent):
@@ -101,10 +118,33 @@ class Controller:
             req = self.queue.get()
             if req is None:
                 return
-            try:
-                result = self.reconciler.reconcile(req) or Result()
-            except Exception:  # noqa: BLE001 — requeue with backoff, like controller-runtime
-                log.exception("[%s] reconcile %s failed", self.name, req)
+            # one trace per reconcile: queue wait rides as a root attr,
+            # the body is the root span, every apiserver call inside it
+            # opens a child (kube/trace.py) — what must-gather dumps and
+            # bench attribution aggregates
+            wait = self.queue.wait_of(req)
+            self._wait_histogram.observe(wait)
+            self._set_depth()
+            ok = False
+            with trace.start_trace(
+                "reconcile",
+                controller=self.name,
+                request=f"{req.namespace + '/' if req.namespace else ''}{req.name}",
+                queue_wait_s=wait,
+            ) as root:
+                t0 = root.start
+                try:
+                    result = self.reconciler.reconcile(req) or Result()
+                    ok = True
+                    if result.requeue_after > 0:
+                        root.set(result=f"requeue_after={result.requeue_after:g}s")
+                    elif result.requeue:
+                        root.set(result="requeue")
+                except Exception as e:  # noqa: BLE001 — requeue with backoff, like controller-runtime
+                    root.error = f"{type(e).__name__}: {e}"
+                    log.exception("[%s] reconcile %s failed", self.name, req)
+            self._duration_histogram.observe(time.monotonic() - t0)
+            if not ok:
                 self.queue.add_rate_limited(req)
                 self.queue.done(req)
                 continue

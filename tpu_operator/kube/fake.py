@@ -9,11 +9,12 @@ no cluster, mirroring SURVEY.md §4's "CPU-only kind cluster" insight.
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import List, Optional
 
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, trace
 from tpu_operator.kube.client import (
     ADDED,
     DELETED,
@@ -31,6 +32,39 @@ from tpu_operator.kube.objects import (
     merge_patch,
     nested_get,
 )
+
+
+def _traced(verb: str):
+    """Trace decorator for FakeClient's Client surface: inside an active
+    reconcile trace each call opens the same logical ``api`` span the
+    HTTP client does; outside a trace the only cost is one thread-local
+    read, which is what lets the cluster sim hammer this client for
+    free. Measurement caveat vs the HTTP client: a write's span here
+    also covers the SYNCHRONOUS watch dispatch ``_notify`` runs on the
+    caller's thread (informer cache updates + handlers) — in-process,
+    that delivery genuinely is part of what the call costs, but it means
+    in-proc api time is not comparable 1:1 with wire latency; attribution
+    at scale therefore runs over the HTTP transport."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            if not trace.active():
+                return fn(self, *args, **kwargs)
+            if verb in ("create", "update", "update_status"):
+                obj = args[0] if args else kwargs["obj"]
+                kind = obj.get("kind", "")
+            elif verb == "evict":
+                kind = "Pod"
+            else:
+                kind = args[1] if len(args) > 1 else kwargs.get("kind", "")
+            with trace.client_span(verb, kind) as span:
+                span.set(attempts=1)  # in-memory: always exactly one
+                return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class _Sub(WatchSubscription):
@@ -118,6 +152,7 @@ class FakeClient(Client):
 
     # -- Client API ---------------------------------------------------------
 
+    @_traced("get")
     def get(self, api_version, kind, name, namespace=None):
         with self._lock:
             obj = self._get_stored(self._key(api_version, kind, name, namespace))
@@ -125,6 +160,7 @@ class FakeClient(Client):
                 raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
             return deep_copy(obj)
 
+    @_traced("list")
     def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
         out: List[ObjectDict] = []
         with self._lock:
@@ -141,6 +177,7 @@ class FakeClient(Client):
         out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
         return out
 
+    @_traced("create")
     def create(self, obj):
         obj = deep_copy(obj)
         md = obj.setdefault("metadata", {})
@@ -163,6 +200,7 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(obj)
 
+    @_traced("update")
     def update(self, obj):
         obj = deep_copy(obj)
         md = obj.setdefault("metadata", {})
@@ -193,6 +231,7 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(obj)
 
+    @_traced("update_status")
     def update_status(self, obj):
         md = obj.get("metadata", {})
         key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
@@ -218,6 +257,7 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(new)
 
+    @_traced("patch")
     def patch(self, api_version, kind, name, patch, namespace=None):
         """RFC 7386 merge patch with apiserver write semantics: object
         identity (name/namespace/uid/creationTimestamp) is immutable, the
@@ -254,6 +294,7 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(obj)
 
+    @_traced("patch_status")
     def patch_status(self, api_version, kind, name, patch, namespace=None):
         """Merge patch scoped to the status subresource: only the body's
         ``status`` key is applied; everything else in the patch is ignored
@@ -277,6 +318,7 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(new)
 
+    @_traced("delete")
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
         # grace_period_seconds is accepted for Client-interface parity; the
         # in-memory store always deletes immediately (no kubelet to wait on)
@@ -289,6 +331,7 @@ class FakeClient(Client):
             self._pending.extend(self._collect_garbage(obj["metadata"].get("uid")))
         self._notify()
 
+    @_traced("evict")
     def evict(self, name, namespace):
         """pods/eviction with PodDisruptionBudget accounting: an eviction
         that would leave a matching PDB below its budget returns 429

@@ -25,7 +25,7 @@ import urllib.request
 from typing import List, Optional
 
 from tpu_operator import consts
-from tpu_operator.kube import errors, retry
+from tpu_operator.kube import errors, retry, trace
 from tpu_operator.kube.client import SYNC, Client, WatchHandler, WatchSubscription
 from tpu_operator.kube.objects import ObjectDict, api_group, is_cluster_scoped, nested_get
 
@@ -50,6 +50,32 @@ def _requests_counter():
 
 
 _REQUESTS_TOTAL = None
+
+
+def request_latency_histogram():
+    """Process-wide per-(verb, kind) apiserver request latency, owned by
+    the wire layer next to ``apiserver_requests_total`` (controller-
+    runtime's rest_client_request_duration_seconds analog). ``verb`` is
+    the Client-surface verb (list vs get, patch vs patch_status — the
+    vocabulary bench attribution decomposes by), observed once per wire
+    attempt so retries are visible as extra samples."""
+    global _REQUEST_LATENCY
+    if _REQUEST_LATENCY is None:
+        import prometheus_client
+
+        _REQUEST_LATENCY = prometheus_client.Histogram(
+            "tpu_operator_apiserver_request_duration_seconds",
+            "Wire latency of one apiserver request attempt",
+            ["verb", "kind"],
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+            ),
+        )
+    return _REQUEST_LATENCY
+
+
+_REQUEST_LATENCY = None
 
 # client-go's pager chunks LISTs at 500 by default; same here
 LIST_PAGE_SIZE = 500
@@ -398,23 +424,43 @@ class HttpClient(Client):
         query: Optional[dict] = None,
         _raw: bool = False,
         content_type: str = "application/json",
+        verb: str = "",
+        kind: str = "",
     ):
         """Resilient request: ``_request_once`` under the circuit breaker,
         with bounded full-jitter retries for idempotent verbs on
         transport errors and answered 5xx/429s (Retry-After honored),
         all inside a per-request wall-clock deadline. Every failed
         attempt — including ones a retry recovers — feeds the client's
-        degraded() signal; only transport failures feed the breaker."""
+        degraded() signal; only transport failures feed the breaker.
+
+        ``verb``/``kind`` label the observability surface: the logical
+        ``api`` trace span covering the whole call (retries ride as
+        ``attempt`` child spans under it; a breaker fast-fail is the
+        logical span erroring with zero attempts) and the per-attempt
+        latency histogram."""
+        with trace.client_span(verb or method.lower(), kind) as api_span:
+            return self._request_resilient(
+                method, path, body, query, _raw, content_type,
+                verb or method.lower(), kind, api_span,
+            )
+
+    def _request_resilient(
+        self, method, path, body, query, _raw, content_type, verb, kind, api_span
+    ):
         res = self.resilience
         deadline = time.monotonic() + self.request_deadline
         attempt = 0
         while True:
             res.breaker.before_request()  # raises BreakerOpen while open
+            attempt_span = trace.span("attempt", n=attempt)
+            attempt_start = time.monotonic()
             try:
-                out = self._request_once(
-                    method, path, body, query,
-                    _resent=attempt > 0, _raw=_raw, content_type=content_type,
-                )
+                with attempt_span:
+                    out = self._request_once(
+                        method, path, body, query,
+                        _resent=attempt > 0, _raw=_raw, content_type=content_type,
+                    )
             except errors.TransportError as e:
                 res.breaker.record_failure()
                 res.note_failure("transport")
@@ -464,6 +510,16 @@ class HttpClient(Client):
             else:
                 res.breaker.record_success()
                 return out
+            finally:
+                # one latency sample + attempts attr per wire attempt,
+                # success or not (retries show up as extra samples)
+                api_span.set(attempts=attempt + 1)
+                try:
+                    request_latency_histogram().labels(verb, kind or "-").observe(
+                        time.monotonic() - attempt_start
+                    )
+                except Exception:  # noqa: BLE001 — metrics must never break IO
+                    pass
             if attempt >= self.retry_budget or time.monotonic() + delay > deadline:
                 raise last_err
             attempt += 1
@@ -496,6 +552,12 @@ class HttpClient(Client):
         token = self._bearer()
         if token:
             headers["Authorization"] = f"Bearer {token}"
+        trace_ref = trace.trace_ref()
+        if trace_ref:
+            # propagate the active (trace, span) ids on the wire so the
+            # served fake apiserver — and chaos fault injection — can
+            # attribute server-side effects to the reconcile that asked
+            headers[trace.TRACE_HEADER] = trace_ref
 
         # Retry policy: ONLY an IDEMPOTENT request that failed on a reused
         # (pooled) connection before any response bytes arrived retries, on
@@ -603,7 +665,9 @@ class HttpClient(Client):
     # -- Client API ----------------------------------------------------------
 
     def get(self, api_version, kind, name, namespace=None):
-        return self._request("GET", self._path(api_version, kind, namespace, name))
+        return self._request(
+            "GET", self._path(api_version, kind, namespace, name), verb="get", kind=kind
+        )
 
     def list(self, api_version, kind, namespace=None, label_selector=None, field_selector=None):
         """Chunked LIST (kube pagination): pages of ``LIST_PAGE_SIZE`` via
@@ -644,7 +708,8 @@ class HttpClient(Client):
             try:
                 while True:
                     result = self._request(
-                        "GET", self._path(api_version, kind, namespace), query=query
+                        "GET", self._path(api_version, kind, namespace), query=query,
+                        verb="list", kind=kind,
                     )
                     for item in result.get("items", []):
                         item.setdefault("apiVersion", api_version)
@@ -668,18 +733,22 @@ class HttpClient(Client):
 
     def create(self, obj):
         md = obj.get("metadata", {})
-        return self._request("POST", self._path(obj["apiVersion"], obj["kind"], md.get("namespace")), body=obj)
+        return self._request(
+            "POST", self._path(obj["apiVersion"], obj["kind"], md.get("namespace")),
+            body=obj, verb="create", kind=obj["kind"],
+        )
 
     def update(self, obj):
         md = obj.get("metadata", {})
         return self._request(
-            "PUT", self._path(obj["apiVersion"], obj["kind"], md.get("namespace"), md["name"]), body=obj
+            "PUT", self._path(obj["apiVersion"], obj["kind"], md.get("namespace"), md["name"]),
+            body=obj, verb="update", kind=obj["kind"],
         )
 
     def update_status(self, obj):
         md = obj.get("metadata", {})
         path = self._path(obj["apiVersion"], obj["kind"], md.get("namespace"), md["name"]) + "/status"
-        return self._request("PUT", path, body=obj)
+        return self._request("PUT", path, body=obj, verb="update_status", kind=obj["kind"])
 
     def patch(self, api_version, kind, name, patch, namespace=None):
         """JSON merge patch (RFC 7386). The O(changes) write: a labels-only
@@ -690,12 +759,14 @@ class HttpClient(Client):
             self._path(api_version, kind, namespace, name),
             body=patch,
             content_type="application/merge-patch+json",
+            verb="patch", kind=kind,
         )
 
     def patch_status(self, api_version, kind, name, patch, namespace=None):
         path = self._path(api_version, kind, namespace, name) + "/status"
         return self._request(
-            "PATCH", path, body=patch, content_type="application/merge-patch+json"
+            "PATCH", path, body=patch, content_type="application/merge-patch+json",
+            verb="patch_status", kind=kind,
         )
 
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
@@ -704,7 +775,10 @@ class HttpClient(Client):
             if grace_period_seconds is not None
             else None
         )
-        self._request("DELETE", self._path(api_version, kind, namespace, name), query=query)
+        self._request(
+            "DELETE", self._path(api_version, kind, namespace, name), query=query,
+            verb="delete", kind=kind,
+        )
 
     def pod_logs(self, name, namespace, container=None, tail_lines=None) -> str:
         """GET pods/<name>/log (plain text, not JSON) — the support-bundle
@@ -720,11 +794,12 @@ class HttpClient(Client):
             self._path("v1", "Pod", namespace, name) + "/log",
             query=query or None,
             _raw=True,
+            verb="pod_logs", kind="Pod",
         )
 
     def server_version(self) -> dict:
         """GET /version (kubectl version's server half)."""
-        return self._request("GET", "/version")
+        return self._request("GET", "/version", verb="server_version")
 
     def evict(self, name, namespace):
         """POST pods/eviction (the drain path the reference's upgrade lib
@@ -738,6 +813,7 @@ class HttpClient(Client):
                 "kind": "Eviction",
                 "metadata": {"name": name, "namespace": namespace},
             },
+            verb="evict", kind="Pod",
         )
 
     # -- watch ---------------------------------------------------------------

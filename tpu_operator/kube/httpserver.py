@@ -36,6 +36,7 @@ from typing import Dict, Optional, Tuple
 
 from tpu_operator.kube import chaos as chaos_mod
 from tpu_operator.kube import errors
+from tpu_operator.kube import trace as trace_mod
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.http_client import plural_of
 from tpu_operator.kube.objects import api_group
@@ -374,7 +375,10 @@ class FakeApiServer:
                     )
                     raise _ChaosReset()
             else:
-                injection = self.chaos.decide(method, kind)
+                injection = self.chaos.decide(
+                    method, kind,
+                    trace=handler.headers.get(trace_mod.TRACE_HEADER, ""),
+                )
                 if injection is not None:
                     if injection.fault == chaos_mod.FAULT_LATENCY:
                         time.sleep(injection.latency)

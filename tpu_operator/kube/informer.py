@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from tpu_operator.kube import trace
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, SYNC, Client
 from tpu_operator.kube.objects import (
     ObjectDict,
@@ -77,6 +78,9 @@ class Informer:
         # serializes start/stop so a late lazy start (a cached read of a
         # new kind on a running manager) can never leak a watch past stop
         self._lifecycle = threading.Lock()
+        # event-to-handler lag (receipt -> all handlers done) per kind:
+        # the "is the informer pipeline itself the bottleneck" series
+        self._lag_histogram = trace.informer_lag_histogram().labels(kind)
 
     def add_handler(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
@@ -189,9 +193,14 @@ class Informer:
     # -- event path ----------------------------------------------------------
 
     def _on_event(self, event_type: str, obj: ObjectDict) -> None:
-        self.last_event_at = time.monotonic()
+        # local receipt stamp for the lag observation below:
+        # last_event_at is shared and resync() deliberately overwrites it,
+        # so measuring against it would record near-zero lag for exactly
+        # the events dispatched during a stall window
+        received = time.monotonic()
+        self.last_event_at = received
         if event_type == SYNC:
-            self.last_sync_at = self.last_event_at
+            self.last_sync_at = received
             self._replace(obj.get("items") or [])
             return
         key = object_key(obj)
@@ -228,6 +237,7 @@ class Informer:
                 )
             except Exception:  # noqa: BLE001 — informer must survive handler bugs
                 log.exception("informer handler failed for %s %s", self.kind, key)
+        self._lag_histogram.observe(time.monotonic() - received)
 
     def _replace(self, items: List[ObjectDict]) -> None:
         """client-go Reflector/DeltaFIFO Replace semantics for a SYNC

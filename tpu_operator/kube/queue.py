@@ -52,6 +52,12 @@ class RateLimitingQueue:
         self._failures: dict = {}
         self._seq = 0
         self._shutdown = False
+        # queue-wait bookkeeping (workqueue latency, client-go's
+        # workqueue_queue_duration_seconds): when each pending item first
+        # became work, and the measured wait of items just handed out.
+        # Both maps are bounded by the queue's own population.
+        self._added_at: dict = {}
+        self._waits: dict = {}
 
     # -- producers ----------------------------------------------------------
 
@@ -64,6 +70,7 @@ class RateLimitingQueue:
                 return
             if item in self._in_queue or item in self._coalescing:
                 return
+            self._added_at.setdefault(item, time.monotonic())
             if self._coalesce > 0:
                 self._coalescing.add(item)
                 self._seq += 1
@@ -122,6 +129,11 @@ class RateLimitingQueue:
                     _, _, item = heapq.heappop(self._delayed)
                     self._coalescing.discard(item)
                     if item not in self._in_queue and item not in self._processing:
+                        # wait is measured from readiness (a planned
+                        # requeue_after delay is not queue latency); a
+                        # coalescing add keeps its original add stamp —
+                        # the coalesce window IS queue latency
+                        self._added_at.setdefault(item, now)
                         self._queue.append(item)
                         self._in_queue.add(item)
                     elif item in self._processing:
@@ -130,6 +142,7 @@ class RateLimitingQueue:
                     item = self._queue.pop(0)
                     self._in_queue.discard(item)
                     self._processing.add(item)
+                    self._waits[item] = now - self._added_at.pop(item, now)
                     return item
                 wait = None
                 if self._delayed:
@@ -141,12 +154,29 @@ class RateLimitingQueue:
                     wait = remaining if wait is None else min(wait, remaining)
                 self._lock.wait(wait)
 
+    def wait_of(self, item: Any) -> float:
+        """Queue wait of the item most recently handed out by ``get``
+        (valid between get and done — the window workers read it in)."""
+        with self._lock:
+            return self._waits.get(item, 0.0)
+
+    def oldest_age(self) -> float:
+        """Age of the oldest pending (ready or coalescing) item — the
+        queue-stall signal: depth > 0 with this growing means nothing is
+        being served."""
+        with self._lock:
+            if not self._added_at:
+                return 0.0
+            return time.monotonic() - min(self._added_at.values())
+
     def done(self, item: Any) -> None:
         with self._lock:
             self._processing.discard(item)
+            self._waits.pop(item, None)
             if item in self._dirty:
                 self._dirty.discard(item)
                 if item not in self._in_queue:
+                    self._added_at.setdefault(item, time.monotonic())
                     self._queue.append(item)
                     self._in_queue.add(item)
                     self._lock.notify()
@@ -155,6 +185,8 @@ class RateLimitingQueue:
         with self._lock:
             self._shutdown = True
             self._failures.clear()
+            self._added_at.clear()
+            self._waits.clear()
             self._lock.notify_all()
 
     def __len__(self) -> int:

@@ -1,0 +1,569 @@
+"""Reconcile tracing + process-wide flight recorder.
+
+controller-runtime ships per-reconcile latency histograms and workqueue
+depth/age metrics as table stakes; the reference gpu-operator exports
+only counters and gauges, so nobody can say *where* a slow reconcile's
+time or its 41 requests went. This module is the missing layer:
+
+- **Spans**: monotonic-clocked intervals with parent/child links and
+  key-value attrs. A trace covers one reconcile end to end — queue wait
+  (measured by the workqueue), the reconcile body, every apiserver call
+  inside it (one logical ``api`` span per call, one ``attempt`` child
+  per wire send, so a retried request reads as children under one
+  logical call), and the controller-declared phase spans (label-nodes,
+  sync-states, plan, …).
+- **Flight recorder**: a process-wide bounded ring buffer of completed
+  traces (``FLIGHT_RECORDER_CAPACITY``, oldest evicted first; each
+  trace additionally caps its span count) — always-on and
+  memory-bounded by construction, dumped by ``tpuop-cfg must-gather``
+  as ``traces.txt`` / ``slow-reconciles.txt`` and aggregated by
+  ``bench.py``'s attribution block.
+- **Propagation**: the active (trace, span) ids ride every HttpClient
+  request as the ``X-Tpuop-Trace`` header, so the served fake apiserver
+  — and the chaos director's fault log — can attribute server-side
+  effects to the reconcile that caused them.
+
+Tracing is transparent when no trace is active: ``span()`` returns a
+shared no-op and client instrumentation costs one thread-local read, so
+the cluster sim and admin-side test traffic pay nothing.
+
+Metric factories (process-wide, default registry — the same ownership
+pattern as ``http_client._requests_counter`` / ``retry.retries_counter``;
+re-exported by ``controllers.operator_metrics`` and served from the
+manager's :8080 endpoint):
+
+- ``tpu_operator_reconcile_duration_seconds{controller}``
+- ``tpu_operator_workqueue_depth{controller}``
+- ``tpu_operator_workqueue_wait_seconds{controller}``
+- ``tpu_operator_informer_event_lag_seconds{kind}``
+
+(the per-(verb, kind) apiserver request latency histogram lives next to
+``apiserver_requests_total`` in ``http_client``, which owns the wire.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+
+# header carrying "trace_id/span_id" on every in-trace HttpClient request
+TRACE_HEADER = "X-Tpuop-Trace"
+
+# histogram buckets sized for a control plane: sub-ms cache reads through
+# multi-second chaos-ridden reconciles
+_DURATION_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+_RECONCILE_DURATION = None
+_QUEUE_DEPTH = None
+_QUEUE_WAIT = None
+_INFORMER_LAG = None
+
+
+def reconcile_duration_histogram():
+    global _RECONCILE_DURATION
+    if _RECONCILE_DURATION is None:
+        import prometheus_client
+
+        _RECONCILE_DURATION = prometheus_client.Histogram(
+            "tpu_operator_reconcile_duration_seconds",
+            "Wall time of one reconcile body, per controller",
+            ["controller"],
+            buckets=_DURATION_BUCKETS,
+        )
+    return _RECONCILE_DURATION
+
+
+def queue_depth_gauge():
+    global _QUEUE_DEPTH
+    if _QUEUE_DEPTH is None:
+        import prometheus_client
+
+        _QUEUE_DEPTH = prometheus_client.Gauge(
+            "tpu_operator_workqueue_depth",
+            "Requests queued (ready + delayed) per controller workqueue",
+            ["controller"],
+        )
+    return _QUEUE_DEPTH
+
+
+def queue_oldest_age_gauge():
+    """Age of the oldest pending request per controller workqueue.
+    Controllers bind each labelled child to the live
+    ``RateLimitingQueue.oldest_age`` via ``set_function``, so the series
+    stays truthful DURING a stall — a gauge only written on queue
+    activity would freeze at its last good value exactly when it
+    matters."""
+    global _QUEUE_OLDEST_AGE
+    if _QUEUE_OLDEST_AGE is None:
+        import prometheus_client
+
+        _QUEUE_OLDEST_AGE = prometheus_client.Gauge(
+            "tpu_operator_workqueue_oldest_age_seconds",
+            "Age of the oldest pending request in a controller workqueue "
+            "(0 when empty); sampled live at scrape time",
+            ["controller"],
+        )
+    return _QUEUE_OLDEST_AGE
+
+
+_QUEUE_OLDEST_AGE = None
+
+
+def queue_wait_histogram():
+    global _QUEUE_WAIT
+    if _QUEUE_WAIT is None:
+        import prometheus_client
+
+        _QUEUE_WAIT = prometheus_client.Histogram(
+            "tpu_operator_workqueue_wait_seconds",
+            "Time a request sat queued before a worker picked it up",
+            ["controller"],
+            buckets=_DURATION_BUCKETS,
+        )
+    return _QUEUE_WAIT
+
+
+def informer_lag_histogram():
+    global _INFORMER_LAG
+    if _INFORMER_LAG is None:
+        import prometheus_client
+
+        _INFORMER_LAG = prometheus_client.Histogram(
+            "tpu_operator_informer_event_lag_seconds",
+            "Delay from watch-event receipt to all handlers having run",
+            ["kind"],
+            buckets=_DURATION_BUCKETS,
+        )
+    return _INFORMER_LAG
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+# span ids: process-random prefix + counter — unique, cheap, seedless
+_ID_PREFIX = f"{random.getrandbits(24):06x}"
+_ID_COUNTER = itertools.count(1)
+
+
+def _new_id() -> str:
+    return f"{_ID_PREFIX}{next(_ID_COUNTER):08x}"
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "attrs", "start", "end", "error")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: Optional[str], name: str, attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = attrs
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+        self.error: Optional[str] = None
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) - self.start
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"Span({self.name} {self.span_id} {self.duration * 1000:.2f}ms {self.attrs})"
+
+
+class _NoopSpan:
+    """Shared do-nothing span handed out when no trace is active, so
+    instrumentation sites never branch on trace presence themselves."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    error = None
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Trace:
+    """One reconcile's spans, root first. The span list is capped
+    (``max_spans``) so the recorder stays memory-bounded no matter what
+    the workload does — a 4096-node label sweep is one reconcile with
+    4096+ api spans. Spans past the cap are not lost: they fold into a
+    bounded per-(name, verb, kind) overflow summary (count, requests,
+    seconds) that attribution and the dump still account for."""
+
+    __slots__ = ("trace_id", "spans", "dropped", "overflow", "max_spans")
+
+    def __init__(self, root: Span, max_spans: int):
+        self.trace_id = root.trace_id
+        self.spans: List[Span] = [root]
+        self.dropped = 0
+        # (span name, verb, kind) -> [spans, wire requests, seconds]
+        self.overflow: Dict[tuple, list] = {}
+        self.max_spans = max_spans
+
+    @property
+    def root(self) -> Span:
+        return self.spans[0]
+
+    def add(self, span: Span) -> bool:
+        """True if the span was stored individually; False once the cap
+        is hit — the closer then routes it to ``note_overflow``."""
+        if len(self.spans) >= self.max_spans:
+            self.dropped += 1
+            return False
+        self.spans.append(span)
+        return True
+
+    def note_overflow(self, span: Span) -> None:
+        key = (span.name, str(span.attrs.get("verb", "")), str(span.attrs.get("kind", "")))
+        entry = self.overflow.setdefault(key, [0, 0, 0.0])
+        entry[0] += 1
+        # no attempts attr = zero wire sends (a breaker fast-fail), not 1
+        entry[1] += int(span.attrs.get("attempts") or 0)
+        entry[2] += span.duration
+
+    def complete(self) -> bool:
+        """Every stored span ended with its parent present, and every
+        capped-out span accounted in the overflow summary — the
+        no-orphan-spans property --trace-smoke gates on. (Hitting the
+        cap is bounded aggregation, not loss: children of an overflowed
+        span overflow too, so parentage inside ``spans`` stays intact.)"""
+        ids = {s.span_id for s in self.spans}
+        for s in self.spans:
+            if s.end is None:
+                return False
+            if s.parent_id is not None and s.parent_id not in ids:
+                return False
+        return self.dropped == sum(e[0] for e in self.overflow.values())
+
+    def accounted_fraction(self) -> float:
+        """How well the trace's components account for its measured wall
+        time: (queue wait + raw direct-child durations + body gap) over
+        (queue wait + root wall). The child sum is UNCLIPPED while the
+        body gap is computed from children clipped to the root window,
+        so the ratio is exactly 1.0 only when every child nests cleanly
+        inside the root — a child recorded past the root's end pushes it
+        above 1, a negative or unclosed child drags it below. Returned
+        folded as 1 - |1 - f| so callers gate one-sidedly (≥0.95 means
+        within 5% either way); an unfinished root reads 0."""
+        root = self.root
+        if root.end is None:
+            return 0.0
+        wall = max(root.duration, 1e-9)
+        queue_wait = float(root.attrs.get("queue_wait_s") or 0.0)
+        child_raw = 0.0
+        child_clipped = 0.0
+        for s in self.spans[1:]:
+            if s.parent_id != root.span_id:
+                continue
+            if s.end is None:
+                # an unclosed direct child is unaccounted time by
+                # definition — it contributes nothing to either sum, so
+                # the body gap silently absorbing it is exactly what the
+                # clipped/raw split prevents: raw omits it too, but
+                # complete() already fails the trace outright
+                continue
+            child_raw += s.end - s.start
+            child_clipped += max(0.0, min(s.end, root.end) - max(s.start, root.start))
+        body_gap = max(0.0, wall - child_clipped)
+        fraction = (queue_wait + child_raw + body_gap) / (queue_wait + wall)
+        return 1.0 - abs(1.0 - fraction)
+
+
+class _TraceCtx:
+    """Context manager for one root span / trace."""
+
+    def __init__(self, name: str, attrs: dict, recorder_: "FlightRecorder"):
+        self._name = name
+        self._attrs = attrs
+        self._recorder = recorder_
+
+    def __enter__(self) -> Span:
+        trace_id = _new_id()
+        root = Span(trace_id, trace_id, None, self._name, self._attrs)
+        trace = Trace(root, self._recorder.max_spans_per_trace)
+        _TLS.trace = trace
+        _TLS.stack = [root]
+        self._recorder._note_span_started()
+        self._trace = trace
+        return root
+
+    def __exit__(self, exc_type, exc, tb):
+        trace = self._trace
+        root = trace.root
+        if exc is not None and root.error is None:
+            root.error = f"{exc_type.__name__}: {exc}"
+        root.end = time.monotonic()
+        _TLS.trace = None
+        _TLS.stack = []
+        self._recorder._note_span_finished()
+        self._recorder.record(trace)
+        return False
+
+
+class _SpanCtx:
+    def __init__(self, name: str, attrs: dict):
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        trace: Trace = _TLS.trace
+        parent: Span = _TLS.stack[-1]
+        span = Span(trace.trace_id, _new_id(), parent.span_id, self._name, self._attrs)
+        self._stored = trace.add(span)
+        self._trace = trace
+        _TLS.stack.append(span)
+        recorder()._note_span_started()
+        self._span = span
+        return span
+
+    def __exit__(self, exc_type, exc, tb):
+        span = self._span
+        if exc is not None and span.error is None:
+            span.error = f"{exc_type.__name__}: {exc}"
+        span.end = time.monotonic()
+        if not self._stored:
+            self._trace.note_overflow(span)
+        stack = _TLS.stack
+        if stack and stack[-1] is span:
+            stack.pop()
+        recorder()._note_span_finished()
+        return False
+
+
+def active() -> bool:
+    """True while the calling thread is inside a trace — the guard
+    instrumentation sites use to skip even argument marshalling."""
+    return bool(getattr(_TLS, "stack", None))
+
+
+def current() -> Optional[Span]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+def trace_ref() -> str:
+    """``trace_id/span_id`` of the active span ('' outside a trace) —
+    the TRACE_HEADER value."""
+    span = current()
+    return f"{span.trace_id}/{span.span_id}" if span is not None else ""
+
+
+def start_trace(name: str, **attrs) -> _TraceCtx:
+    """Open a new root span; on exit the finished trace lands in the
+    flight recorder. Controllers call this once per reconcile."""
+    return _TraceCtx(name, attrs, recorder())
+
+
+def span(name: str, **attrs):
+    """Child span under the current one; a shared no-op when no trace is
+    active (the fast path the sim and admin traffic ride)."""
+    if not getattr(_TLS, "stack", None):
+        return NOOP_SPAN
+    return _SpanCtx(name, attrs)
+
+
+def client_span(verb: str, kind: str):
+    """The logical-apiserver-call span both clients open around one
+    request: ``verb`` is the Client-surface verb (list vs get, patch vs
+    patch_status — what attribution decomposes by), ``kind`` the target
+    kind."""
+    if not getattr(_TLS, "stack", None):
+        return NOOP_SPAN
+    return _SpanCtx("api", {"verb": verb, "kind": kind})
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring buffer of completed traces plus span accounting.
+
+    Listeners (``add_listener``) see EVERY completed trace before ring
+    eviction — bench attribution aggregates there so a bounded ring
+    never loses data. ``spans_started``/``spans_finished`` drift apart
+    exactly when a span leaks (started, never closed): the orphan
+    detector --trace-smoke reads."""
+
+    def __init__(
+        self,
+        capacity: int = consts.FLIGHT_RECORDER_CAPACITY,
+        max_spans_per_trace: int = consts.FLIGHT_RECORDER_MAX_SPANS_PER_TRACE,
+    ):
+        import collections
+
+        self.capacity = capacity
+        self.max_spans_per_trace = max_spans_per_trace
+        self._traces: "collections.deque[Trace]" = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._listeners: list = []
+        self.traces_recorded = 0
+        self.spans_started = 0
+        self.spans_finished = 0
+
+    def _note_span_started(self) -> None:
+        with self._lock:
+            self.spans_started += 1
+
+    def _note_span_finished(self) -> None:
+        with self._lock:
+            self.spans_finished += 1
+
+    def record(self, trace: Trace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+            self.traces_recorded += 1
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener(trace)
+            except Exception:  # noqa: BLE001 — listeners must never break reconciles
+                pass
+
+    def add_listener(self, fn) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def traces(self) -> List[Trace]:
+        with self._lock:
+            return list(self._traces)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def orphan_spans(self) -> int:
+        """Spans started but never finished (a leak; transiently nonzero
+        only while a reconcile is actually in flight)."""
+        with self._lock:
+            return self.spans_started - self.spans_finished
+
+    def byte_estimate(self) -> int:
+        """Rough resident size of the ring: spans x a conservative
+        per-span footprint (slots object + attrs dict). The bound the
+        trace smoke measures under the 4096-node sim."""
+        with self._lock:
+            spans = sum(len(t.spans) for t in self._traces)
+            attrs = sum(len(s.attrs) for t in self._traces for s in t.spans)
+            overflow = sum(len(t.overflow) for t in self._traces)
+        return spans * 200 + attrs * 120 + overflow * 160
+
+    # -- rendering -----------------------------------------------------------
+
+    def _render_trace(self, trace: Trace) -> List[str]:
+        root = trace.root
+        head = (
+            f"=== trace {trace.trace_id} {root.name}"
+            f" controller={root.attrs.get('controller', '-')}"
+            f" request={root.attrs.get('request', '-')}"
+            f" wall={root.duration * 1000:.2f}ms"
+            f" queue_wait={float(root.attrs.get('queue_wait_s') or 0.0) * 1000:.2f}ms"
+        )
+        if root.error:
+            head += f" error={root.error!r}"
+        if trace.dropped:
+            head += f" spans_aggregated={trace.dropped}"
+        lines = [head]
+        children: Dict[str, List[Span]] = {}
+        for s in trace.spans[1:]:
+            children.setdefault(s.parent_id or "", []).append(s)
+
+        def walk(parent_id: str, depth: int) -> None:
+            for s in children.get(parent_id, ()):
+                detail = " ".join(
+                    f"{k}={v}" for k, v in s.attrs.items() if k not in ("controller", "request")
+                )
+                line = f"{'  ' * depth}{s.name:<12s} {s.duration * 1000:9.2f}ms"
+                if detail:
+                    line += f"  {detail}"
+                if s.error:
+                    line += f"  error={s.error!r}"
+                lines.append(line)
+                walk(s.span_id, depth + 1)
+
+        walk(root.span_id, 1)
+        for (name, verb, kind), (count, requests, seconds) in sorted(trace.overflow.items()):
+            detail = f"verb={verb} kind={kind} " if verb or kind else ""
+            lines.append(
+                f"  (aggregated) {name:<12s} x{count}  {detail}"
+                f"requests={requests} total={seconds * 1000:.2f}ms"
+            )
+        return lines
+
+    def dump(self) -> str:
+        """Newest-first rendering of the whole ring (must-gather
+        ``traces.txt``)."""
+        traces = self.traces()
+        out = [
+            f"# flight recorder: {len(traces)} trace(s) held "
+            f"(capacity {self.capacity}), {self.traces_recorded} recorded lifetime, "
+            f"{self.orphan_spans()} span(s) currently open",
+        ]
+        for trace in reversed(traces):
+            out.extend(self._render_trace(trace))
+        return "\n".join(out) + "\n"
+
+    def dump_slowest(self, n: int = 10) -> str:
+        """The slowest N reconciles by wall time (must-gather
+        ``slow-reconciles.txt``) — where 'why was it slow' starts."""
+        traces = sorted(self.traces(), key=lambda t: t.root.duration, reverse=True)[:n]
+        out = [f"# slowest {len(traces)} reconcile(s) of {len(self)} held"]
+        for trace in traces:
+            out.extend(self._render_trace(trace))
+        return "\n".join(out) + "\n"
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+
+
+def recorder() -> FlightRecorder:
+    """Process-wide flight recorder (always on; bounded)."""
+    global _RECORDER
+    if _RECORDER is None:
+        with _RECORDER_LOCK:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder()
+    return _RECORDER
+
+
+def reset_recorder(
+    capacity: int = consts.FLIGHT_RECORDER_CAPACITY,
+    max_spans_per_trace: int = consts.FLIGHT_RECORDER_MAX_SPANS_PER_TRACE,
+) -> FlightRecorder:
+    """Swap in a fresh recorder (bench runs and tests isolate their
+    measurements this way); returns the new one."""
+    global _RECORDER
+    with _RECORDER_LOCK:
+        _RECORDER = FlightRecorder(capacity, max_spans_per_trace)
+    return _RECORDER

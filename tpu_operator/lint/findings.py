@@ -213,6 +213,8 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPUOP-R003": (ERROR, "unknown RBAC verb (not a Kubernetes authorization verb)"),
     "TPUOP-R004": (ERROR, "cluster-scoped resource granted by a namespaced Role (grants nothing)"),
     "TPUOP-R005": (WARNING, "client call site with unresolvable kind (add a tpuop-lint pragma)"),
+    "TPUOP-O001": (ERROR, "metric registered in code but missing from the COMPONENTS.md catalog"),
+    "TPUOP-O002": (ERROR, "COMPONENTS.md catalog lists a metric no code registers"),
     "TPUOP-D001": (ERROR, "shipped CRD schema drifted from the dataclass model"),
     "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
     "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),

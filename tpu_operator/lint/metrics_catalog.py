@@ -1,0 +1,142 @@
+"""Metrics-catalog analyzer: code vs COMPONENTS.md, both directions.
+
+Every Prometheus series the operator family registers
+(``tpu_operator_*`` / ``tpu_exporter_*`` name literals passed to
+``Counter``/``Gauge``/``Histogram``/``Summary`` constructors anywhere in
+the package) must appear in COMPONENTS.md's "Metric catalog" table, and
+every row of that table must correspond to a registered series. Refactors
+that silently drop a series — or docs that advertise one that no longer
+exists — become lint errors instead of dashboard archaeology.
+
+The extraction is AST-based (same approach as ``rbac_static``): a call
+whose callee name ends in one of the collector class names and whose
+first positional argument is a matching string literal registers that
+name. Dynamically-built metric names would need a pragma, but none exist
+today — the codebase's convention is literal names, which is exactly
+what makes this checkable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_operator.lint.findings import ERROR, Finding, make
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+COMPONENTS_MD = os.path.join(REPO_ROOT, "COMPONENTS.md")
+
+_COLLECTOR_CLASSES = {"Counter", "Gauge", "Histogram", "Summary", "Info", "Enum"}
+_METRIC_PREFIXES = ("tpu_operator_", "tpu_exporter_")
+
+# the catalog section marker in COMPONENTS.md; rows are scanned until the
+# next heading
+CATALOG_HEADING = "### Metric catalog"
+
+
+def _callee_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def registered_metrics(source_root: Optional[str] = None) -> Dict[str, str]:
+    """name -> defining file (package-relative) for every metric literal
+    registered in code."""
+    root = source_root or PKG_ROOT
+    out: Dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue
+            rel = os.path.relpath(path, root)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                # direct construction (prometheus_client.Gauge("name", …))
+                # or a factory taking the class as an argument
+                # (operator_metrics._get_or_create(Gauge, "name", …))
+                direct = _callee_name(node) in _COLLECTOR_CLASSES
+                via_factory = any(
+                    (isinstance(a, ast.Attribute) and a.attr in _COLLECTOR_CLASSES)
+                    or (isinstance(a, ast.Name) and a.id in _COLLECTOR_CLASSES)
+                    for a in node.args
+                )
+                if not (direct or via_factory):
+                    continue
+                first = next(
+                    (
+                        a.value
+                        for a in node.args
+                        if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    ),
+                    None,
+                )
+                if first and first.startswith(_METRIC_PREFIXES):
+                    out.setdefault(first, rel)
+    return out
+
+
+def documented_metrics(components_path: Optional[str] = None) -> Set[str]:
+    """Metric names listed in COMPONENTS.md's catalog table (backticked
+    ``tpu_*`` tokens between the catalog heading and the next heading).
+    Label suffixes like ``{pool}`` are stripped."""
+    path = components_path or COMPONENTS_MD
+    try:
+        with open(path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        return set()
+    start = text.find(CATALOG_HEADING)
+    if start < 0:
+        return set()
+    section = text[start + len(CATALOG_HEADING):]
+    end = re.search(r"^#{1,6} ", section, flags=re.MULTILINE)
+    if end:
+        section = section[: end.start()]
+    names = set()
+    for token in re.findall(r"`((?:tpu_operator|tpu_exporter)_[a-z0-9_]+)", section):
+        names.add(token)
+    return names
+
+
+def analyze(
+    source_root: Optional[str] = None, components_path: Optional[str] = None
+) -> List[Finding]:
+    code = registered_metrics(source_root)
+    docs = documented_metrics(components_path)
+    findings: List[Finding] = []
+    if not docs:
+        findings.append(make(
+            "TPUOP-O002", ERROR, "COMPONENTS.md",
+            f"no '{CATALOG_HEADING}' section found — the metric catalog "
+            "table is the contract this rule checks code against",
+        ))
+        return findings
+    for name in sorted(set(code) - docs):
+        findings.append(make(
+            "TPUOP-O001", ERROR, f"metric:{name}",
+            f"metric registered in {code[name]} but missing from the "
+            "COMPONENTS.md metric catalog — document it (or the series "
+            "is invisible to operators)",
+        ))
+    for name in sorted(docs - set(code)):
+        findings.append(make(
+            "TPUOP-O002", ERROR, f"metric:{name}",
+            "COMPONENTS.md metric catalog lists a metric no code "
+            "registers — a refactor dropped the series (or the doc rotted)",
+        ))
+    return findings

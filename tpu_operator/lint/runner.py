@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import yaml
 
-from tpu_operator.lint import drift, manifest_rules, rbac_static
+from tpu_operator.lint import drift, manifest_rules, metrics_catalog, rbac_static
 from tpu_operator.lint.findings import (
     INFO,
     Baseline,
@@ -39,7 +39,7 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REPO_ROOT = os.path.dirname(PKG_ROOT)
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".tpuop-lint-baseline")
 
-ANALYZERS = ("manifest", "rbac", "drift")
+ANALYZERS = ("manifest", "rbac", "drift", "metrics")
 
 
 def manifest_groups() -> List[Tuple[str, List[dict]]]:
@@ -99,6 +99,8 @@ def run_lint(
         findings.extend(rbac_static.analyze())
     if "drift" in selected:
         findings.extend(drift.analyze())
+    if "metrics" in selected:
+        findings.extend(metrics_catalog.analyze())
     findings = dedupe(findings)
 
     baseline = Baseline.load(

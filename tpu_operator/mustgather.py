@@ -214,6 +214,21 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         except Exception as e:  # noqa: BLE001 — never fail the bundle
             emit("api-resilience.txt", f"# collection failed: {e}\n")
 
+    # the flight recorder of THIS process (kube/trace.py): every recent
+    # reconcile's full span tree — queue wait, body phases, each apiserver
+    # call with retry attempts — plus the slowest-N cut. In-process
+    # embedders (tests, `--fake-cluster`, operators collecting their own
+    # bundle) get their live reconcile history; a workstation collection
+    # records its own (mostly empty) recorder, same as api-resilience.txt
+    # records the collecting client.
+    try:
+        from tpu_operator.kube.trace import recorder
+
+        emit("traces.txt", recorder().dump())
+        emit("slow-reconciles.txt", recorder().dump_slowest(10))
+    except Exception as e:  # noqa: BLE001 — never fail the bundle
+        emit("traces.txt", f"# collection failed: {e}\n")
+
     pod_logs = getattr(client, "pod_logs", None)
     if pod_logs is not None:
         try:
