@@ -541,6 +541,8 @@ def _compact_summary(out: dict) -> dict:
         "chaos_converge_s": out.get("chaos_converge_s"),
         "placement_time_to_place_s": out.get("placement", {}).get("time_to_place_s"),
         "placement_fragmentation": out.get("placement", {}).get("fragmentation"),
+        "burnin_step_p50_ms": out.get("telemetry", {}).get("burnin", {}).get("step_p50_ms"),
+        "gang_straggler_ratio": out.get("telemetry", {}).get("gang", {}).get("straggler_ratio"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -779,6 +781,263 @@ def trace_smoke() -> int:
     return 0 if ok else 1
 
 
+def telemetry_block() -> dict:
+    """The data-plane telemetry layer measured for real: a short burn-in
+    under the step-time recorder (compile-vs-execute split, jitter
+    percentiles, achieved TFLOP/s on whatever backend is present) and —
+    when the toolchain supports multi-process CPU collectives — the live
+    2-worker gang's merged artifact with its straggler ratio."""
+    out: dict = {}
+    try:
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh, run_burnin
+
+        result = run_burnin(
+            mesh=make_mesh(), steps=6,
+            cfg=BurninConfig(d_model=128, d_ff=256, seq_len=64, batch=8, n_layers=2),
+            record_telemetry=True, telemetry_host="bench",
+        )
+        t = result["telemetry"]
+        out["burnin"] = {
+            "steps": t["steps"],
+            "compile_s": round(t["compile_s"], 3),
+            "step_p50_ms": round(t["step_p50_s"] * 1e3, 2),
+            "step_p99_ms": round(t["step_p99_s"] * 1e3, 2),
+            "tflops": t.get("tflops"),
+        }
+    except Exception as e:  # noqa: BLE001 — best-effort like every detail
+        out["burnin"] = {"error": str(e)[-300:]}
+    try:
+        from tpu_operator.workloads.multiproc import (
+            CpuCollectivesUnsupportedError,
+            run_multiprocess_check,
+        )
+
+        try:
+            gang = run_multiprocess_check(num_workers=2, devices_per_worker=2)
+            out["gang"] = gang.get("gang_telemetry") or {}
+        except CpuCollectivesUnsupportedError:
+            out["gang"] = {"skipped": "jaxlib CPU backend lacks multiprocess collectives"}
+    except Exception as e:  # noqa: BLE001
+        out["gang"] = {"error": str(e)[-300:]}
+    return out
+
+
+def telemetry_smoke() -> int:
+    """CI gate (scripts/ci.sh): the grey-failure pipeline end to end on a
+    seeded sim. One gang member's matmul probe runs 30% below the
+    generation floor; the gate demands the whole chain fire:
+
+    1. the exporter's sustained-breach detection flips
+       ``tpu_exporter_perf_degraded`` and labels the node,
+    2. the gang's published step-time artifact reads as a straggler and
+       the fleet aggregation emits the PerfDegraded Event + gang series,
+    3. the health FSM walks the grey node cordon -> revalidate (and,
+       once the probe recovers, uncordons it clean),
+    4. the placement engine re-places the gang off the degraded host,
+    5. every new series is live on the scrape endpoints.
+    """
+    import prometheus_client
+
+    from tpu_operator import consts as _consts
+    from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+    from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+    from tpu_operator.api.clusterpolicy import (
+        CLUSTER_POLICY_API_VERSION,
+        CLUSTER_POLICY_KIND,
+        new_cluster_policy,
+    )
+    from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, new_tpu_slice
+    from tpu_operator.controllers.health_controller import HealthReconciler, RepairState
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.objects import new_object
+    from tpu_operator.kube.sim import make_torus_nodes
+    from tpu_operator.perf import default_floors
+    from tpu_operator.placement.engine import PlacementPhase
+    from tpu_operator.upgrade.fsm import DRIVER_POD_COMPONENT, DRIVER_POD_COMPONENT_LABEL
+    from tpu_operator.workloads.telemetry import (
+        StepTimeRecorder,
+        merge_gang_reports,
+        publish_prometheus,
+    )
+
+    ns = "tpu-operator"
+    store = FakeClient()
+    checks: dict = {}
+
+    # an 8-host v4 pool; the gang needs 4, so a re-place off one sick
+    # host always has somewhere to go
+    for node in make_torus_nodes((4, 2, 1), prefix="tel"):
+        node["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    store.create(new_cluster_policy(spec={
+        "healthMonitor": {
+            "interval": 1,
+            "remediation": {"enable": True, "retryLimit": 3,
+                            "timeoutSeconds": 300, "gracePeriodSeconds": 0},
+        },
+    }))
+    store.create(new_tpu_slice("smoke-gang", {"placement": {"shape": "2x2x1"}}))
+
+    placement = PlacementReconciler(store, ns)
+    placement.reconcile(QUEUE_REQUEST)
+
+    def gang_nodes() -> list:
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "smoke-gang")
+        st = (ts.get("status") or {}).get("placement") or {}
+        return list(st.get("nodes") or []), st.get("phase")
+
+    assigned, phase = gang_nodes()
+    checks["placed"] = phase == PlacementPhase.SCHEDULED and len(assigned) == 4
+    slow = assigned[0] if assigned else "tel-0"
+
+    slice_mgr = SliceManagerAgent(store, ns)
+    slice_mgr.reconcile_once()
+    gang_cm_name = None
+    for cm in store.list("v1", "ConfigMap", ns):
+        if cm["metadata"]["name"].endswith("-gang"):
+            gang_cm_name = cm["metadata"]["name"]
+    slice_name = (gang_cm_name or "")[: -len("-gang")] if gang_cm_name else ""
+    checks["gang_materialized"] = bool(gang_cm_name)
+
+    # per-host step telemetry, REAL wall timings: the slow host's step
+    # sleeps 4x longer, so the merged artifact must read straggler
+    exporter_registry = prometheus_client.CollectorRegistry()
+    reports = {}
+    for name in assigned:
+        rec = StepTimeRecorder(host=name)
+        delay = 0.004 if name == slow else 0.001
+        rec.run(lambda d=delay: time.sleep(d), 4)
+        report = rec.report()
+        reports[name] = report.to_dict()
+        publish_prometheus(report, name, registry=exporter_registry)
+    artifact = merge_gang_reports(reports)
+    checks["straggler_detected"] = (
+        artifact["straggler_ratio"] > _consts.GANG_STRAGGLER_RATIO
+        and artifact["slowest_host"] == slow
+    )
+    checks["artifact_published"] = slice_mgr.publish_gang_telemetry(slice_name, artifact)
+
+    # the exporter fleet: every gang member probes; the slow host's
+    # matmul lands 30% BELOW the generation floor, sustained
+    floor = default_floors()["v4"]["matmul_tflops"]
+    roof = floor / 0.7
+    exporters = {
+        name: MetricsExporterAgent(
+            node_name=name, client=store, registry=exporter_registry,
+            floors={"matmul_tflops": floor},
+        )
+        for name in assigned
+    }
+    for _ in range(_consts.PERF_BREACH_SAMPLES):
+        for name, exporter in exporters.items():
+            exporter.observe_probe(
+                "matmul_tflops", floor * 0.7 if name == slow else roof
+            )
+    slow_labels = store.get("v1", "Node", slow)["metadata"].get("labels") or {}
+    checks["perf_label_set"] = (
+        slow_labels.get(_consts.TPU_PERF_LABEL) == _consts.PERF_DEGRADED
+    )
+
+    # health pass: fleet aggregation (gang series + PerfDegraded event)
+    # and the grey-failure FSM entry
+    health = HealthReconciler(store, ns)
+    req = Request(name="cluster-policy")
+
+    def repair_state() -> str:
+        labels = store.get("v1", "Node", slow)["metadata"].get("labels") or {}
+        return labels.get(_consts.REPAIR_STATE_LABEL, "")
+
+    def play_kubelet() -> None:
+        # finalize evictions; keep a Running driver pod on the slow node
+        # so the reinstall step can complete (the drill's kubelet/DS
+        # duties, inlined — bench cannot import tests/)
+        for pod in store.list("v1", "Pod", ns):
+            md = pod["metadata"]
+            if md.get("deletionTimestamp"):
+                try:
+                    store.delete("v1", "Pod", md["name"], ns, grace_period_seconds=0)
+                except Exception:  # noqa: BLE001
+                    pass
+        if store.get_or_none("v1", "Pod", "driver-smoke", ns) is None:
+            pod = new_object(
+                "v1", "Pod", "driver-smoke", ns,
+                labels={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+                spec={"nodeName": slow, "containers": [{"name": "d", "image": "pause:3.9"}]},
+            )
+            pod["status"] = {"phase": "Running"}
+            store.create(pod)
+
+    play_kubelet()
+    states_seen = []
+    recovered = False
+    for _ in range(40):
+        health.reconcile(req)
+        placement.reconcile(QUEUE_REQUEST)
+        slice_mgr.reconcile_once()
+        play_kubelet()
+        state = repair_state()
+        if state and (not states_seen or states_seen[-1] != state):
+            states_seen.append(state)
+        if state == RepairState.REVALIDATE_REQUIRED and not recovered:
+            # the reinstall "fixed" the chip: probes recover, the
+            # exporter clears the label, revalidation may pass
+            exporters[slow].observe_probe("matmul_tflops", roof)
+            recovered = True
+        if recovered and not state:
+            break
+    checks["fsm_cordon_to_revalidate"] = (
+        RepairState.CORDON_REQUIRED in states_seen
+        and RepairState.REVALIDATE_REQUIRED in states_seen
+    )
+    final_node = store.get("v1", "Node", slow)
+    checks["repair_completed"] = (
+        repair_state() == ""
+        and not final_node.get("spec", {}).get("unschedulable")
+        and (final_node["metadata"].get("labels") or {}).get(_consts.TPU_PERF_LABEL) is None
+    )
+    assigned_after, phase_after = gang_nodes()
+    checks["replaced_off_slow_host"] = (
+        phase_after == PlacementPhase.SCHEDULED
+        and len(assigned_after) == 4
+        and slow not in assigned_after
+    )
+    events = [e.get("reason") for e in store.list("v1", "Event")]
+    checks["perf_degraded_event"] = "PerfDegraded" in events
+
+    scrape_exporter = prometheus_client.generate_latest(exporter_registry).decode()
+    scrape_operator = prometheus_client.generate_latest(prometheus_client.REGISTRY).decode()
+    required_exporter = (
+        "tpu_exporter_perf_degraded", "tpu_exporter_perf_floor",
+        "tpu_exporter_probe_baseline", "tpu_exporter_workload_step_seconds",
+        "tpu_exporter_workload_compile_seconds",
+    )
+    required_operator = (
+        "tpu_operator_gang_step_seconds", "tpu_operator_gang_straggler_ratio",
+        "tpu_operator_fleet_healthy_tflops", "tpu_operator_perf_degraded_nodes",
+    )
+    checks["series_present"] = all(
+        s in scrape_exporter for s in required_exporter
+    ) and all(s in scrape_operator for s in required_operator)
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "telemetry_smoke",
+        "ok": ok,
+        "slow_host": slow,
+        "straggler_ratio": artifact["straggler_ratio"],
+        "fsm_states_seen": states_seen,
+        "gang_before": assigned,
+        "gang_after": assigned_after,
+        "checks": checks,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def bench_placement(
     dims=(8, 8, 8),
     seed: int = 20260803,
@@ -941,6 +1200,8 @@ def main() -> None:
         raise SystemExit(placement_smoke())
     if "--trace-smoke" in sys.argv[1:]:
         raise SystemExit(trace_smoke())
+    if "--telemetry-smoke" in sys.argv[1:]:
+        raise SystemExit(telemetry_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -1010,6 +1271,9 @@ def main() -> None:
         placement_block = {"error": f"{type(e).__name__}: {e}"}
     details = tpu_details()
     details["multiprocess_distributed"] = _multiprocess_distributed_details()
+    # data-plane step-time telemetry: burn-in under the recorder +
+    # the live gang's merged artifact (gated by --telemetry-smoke)
+    telemetry = telemetry_block()
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -1037,6 +1301,7 @@ def main() -> None:
         "chaos_converge_s": chaos_block.get("chaos_converge_s"),
         "chaos": chaos_block,
         "placement": placement_block,
+        "telemetry": telemetry,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

@@ -40,6 +40,12 @@ echo "== trace smoke: every reconcile yields a complete trace; recorder stays bo
 # provably wraps, and the 4096-node sim keeps the recorder under its
 # measured memory cap
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --trace-smoke
+echo "== telemetry smoke: grey failure detected, remediated, gang re-placed =="
+# data-plane gate: a gang member's matmul probe 30% below the generation
+# floor must flip tpu_exporter_perf_degraded, read as a straggler in the
+# gang artifact, drive the health FSM cordon->revalidate, re-place the
+# gang off the slow host, and leave every new series on the endpoints
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --telemetry-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

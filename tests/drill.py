@@ -319,18 +319,25 @@ class HealthRepairDrill(UpgradeDrill):
         node = self.client.get("v1", "Node", self.node_name)
         return (node["metadata"].get("labels") or {}).get(consts.REPAIR_STATE_LABEL, "")
 
-    def run_repair(self, max_passes: int = 60, pass_interval: float = 0.2) -> dict:
-        """Full heal loop: degraded -> cordon -> PDB-parked eviction ->
-        relax -> driver reinstall -> agent re-probe heals -> uncordon."""
+    def _drive_repair_loop(
+        self, recover, grace_period_seconds: int = 0,
+        max_passes: int = 60, pass_interval: float = 0.2,
+    ) -> dict:
+        """One shared FSM-walk loop for every entry signal: drive
+        repair passes to completion, asserting PDB-parked eviction on
+        the way, playing the kubelet/DS controller for the synthetic
+        node, and calling ``recover()`` once the FSM reaches
+        revalidation (the drill playing whichever agent owns the
+        triggering signal). Callers set the signal BEFORE calling and
+        read the final node state after."""
         from tpu_operator.api.clusterpolicy import HealthMonitorSpec
         from tpu_operator.controllers.health_controller import NodeRepairManager, RepairState
 
         mgr = NodeRepairManager(self.client, self.ns)
         spec = HealthMonitorSpec.from_dict(
             {"remediation": {"enable": True, "retryLimit": 3, "timeoutSeconds": 300,
-              "gracePeriodSeconds": 0}}
+              "gracePeriodSeconds": grace_period_seconds}}
         )
-        self._set_health(consts.HEALTH_DEGRADED)
         obs = {
             "cordoned": False,
             "parked_passes": 0,
@@ -369,21 +376,34 @@ class HealthRepairDrill(UpgradeDrill):
                 self._create_driver_pod()
                 obs["driver_pod_recreated"] = True
             if state == RepairState.REVALIDATE_REQUIRED and obs["driver_pod_recreated"]:
-                # the reinstall landed: the agent's next probe passes
-                self._set_health(consts.HEALTH_HEALTHY)
+                # the reinstall landed: the owning agent's next probe
+                # passes and the triggering signal clears
+                recover()
             if not state and obs["cordoned"]:
                 break  # repair complete (label cleared)
             time.sleep(pass_interval)
         node = self.client.get("v1", "Node", self.node_name)
         labels = node["metadata"].get("labels") or {}
         obs["final_repair_state"] = labels.get(consts.REPAIR_STATE_LABEL, "")
-        obs["final_health"] = labels.get(consts.TPU_HEALTH_LABEL, "")
         obs["uncordoned"] = not node.get("spec", {}).get("unschedulable")
         obs["retries"] = (node["metadata"].get("annotations") or {}).get(
             consts.REPAIR_RETRIES_ANNOTATION
         )
         obs["workload_evicted"] = (
             self.client.get_or_none("v1", "Pod", self.workload_pod, self.ns) is None
+        )
+        return obs
+
+    def run_repair(self, **loop_kwargs) -> dict:
+        """Full heal loop: degraded -> cordon -> PDB-parked eviction ->
+        relax -> driver reinstall -> agent re-probe heals -> uncordon."""
+        self._set_health(consts.HEALTH_DEGRADED)
+        obs = self._drive_repair_loop(
+            recover=lambda: self._set_health(consts.HEALTH_HEALTHY), **loop_kwargs
+        )
+        node = self.client.get("v1", "Node", self.node_name)
+        obs["final_health"] = (node["metadata"].get("labels") or {}).get(
+            consts.TPU_HEALTH_LABEL, ""
         )
         return obs
 
@@ -429,6 +449,72 @@ class HealthRepairDrill(UpgradeDrill):
             consts.REPAIR_RETRIES_ANNOTATION
         )
         return obs
+
+
+class GreyFailureDrill(HealthRepairDrill):
+    """The grey-failure path: the node enters repair on the metrics
+    exporter's sustained perf-floor breach (``tpu.google.com/perf=
+    degraded``) with NO health verdict at all — a slow-but-alive chip.
+    Same fixture (tainted node, driver DS/pod, PDB-protected TPU
+    workload), same FSM walk; revalidation passes when the exporter
+    clears the label (the drill plays the exporter the way the health
+    drill plays the health agent)."""
+
+    def _set_perf(self, degraded: bool) -> None:
+        """Play the exporter: publish/clear the perf breach label via
+        the same labels-only merge patch the agent sends."""
+        self.client.patch(
+            "v1", "Node", self.node_name,
+            {"metadata": {"labels": {
+                consts.TPU_PERF_LABEL: consts.PERF_DEGRADED if degraded else None
+            }}},
+        )
+
+    def run_grey(self, **loop_kwargs) -> dict:
+        """Full grey heal loop: perf=degraded -> cordon -> PDB-parked
+        eviction -> relax -> driver reinstall -> probe recovers (label
+        clears) -> uncordon. Rides the shared loop with a NONZERO grace
+        period, proving grey entry bypasses it."""
+        self._set_perf(True)
+        obs = self._drive_repair_loop(
+            recover=lambda: self._set_perf(False),
+            grace_period_seconds=300, **loop_kwargs
+        )
+        node = self.client.get("v1", "Node", self.node_name)
+        labels = node["metadata"].get("labels") or {}
+        annotations = node["metadata"].get("annotations") or {}
+        obs["final_perf"] = labels.get(consts.TPU_PERF_LABEL, "")
+        obs["reason_cleared"] = consts.REPAIR_REASON_ANNOTATION not in annotations
+        return obs
+
+
+def run_grey_failure_drill(client, ns: str, **run_kwargs) -> dict:
+    drill = GreyFailureDrill(client, ns)
+    try:
+        drill.setup()
+        return drill.run_grey(**run_kwargs)
+    finally:
+        drill.teardown()
+
+
+def assert_grey_failure_drill_passed(obs: dict) -> None:
+    from tpu_operator.controllers.health_controller import RepairState
+
+    assert obs["final_repair_state"] == "", obs
+    assert obs["final_perf"] == "", obs
+    assert obs["cordoned"] and obs["uncordoned"], obs
+    assert obs["parked_passes"] >= 2, f"PDB never parked the node: {obs}"
+    assert obs["driver_pod_recreated"], obs
+    assert obs["reason_cleared"], obs
+    walked = obs["states_seen"]
+    for expected in (
+        RepairState.CORDON_REQUIRED,
+        RepairState.EVICTION_REQUIRED,
+        RepairState.REINSTALL_REQUIRED,
+        RepairState.REVALIDATE_REQUIRED,
+        RepairState.UNCORDON_REQUIRED,
+    ):
+        assert expected in walked, (expected, walked)
 
 
 def run_health_drill(client, ns: str, **run_kwargs) -> dict:

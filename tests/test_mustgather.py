@@ -87,6 +87,15 @@ def test_bundle_from_live_install(tmp_path):
         placement_txt = (tmp_path / "placement.txt").read_text()
         assert "# placement queue" in placement_txt
         assert "# host assignments" in placement_txt
+        # the data-plane telemetry view: fleet perf rollup + the
+        # operator-published floor table (rendered by pre-requisites in
+        # this live install) + gang artifacts section
+        telemetry_txt = (tmp_path / "telemetry.txt").read_text()
+        assert "# fleet perf" in telemetry_txt
+        assert "tpu-0" in telemetry_txt and "perf=" in telemetry_txt
+        assert "# perf floors (operator-published)" in telemetry_txt
+        assert "matmul_tflops" in telemetry_txt  # the live ConfigMap's table
+        assert "# gang step-time artifacts" in telemetry_txt
         # the flight recorder rides along: this process ran the
         # reconciles, so traces.txt must hold real reconcile span trees
         traces_txt = (tmp_path / "traces.txt").read_text()
@@ -110,6 +119,7 @@ def test_bundle_from_live_install(tmp_path):
             "clusterpolicies.yaml", "tpuslices.yaml",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
+            "telemetry.txt",
         } <= stems
     finally:
         mgr.stop()

@@ -1,0 +1,668 @@
+"""Data-plane telemetry pipeline tests (ISSUE 8 tentpole).
+
+Four layers under test:
+  1. the step-time recorder (compile-vs-execute split, jitter
+     percentiles, achieved TFLOP/s) and the gang merge's straggler
+     ratio (workloads/telemetry.py),
+  2. the exporter's perf-floor baselining + grey-failure detection:
+     sustained breach flips ``tpu_exporter_perf_degraded`` and the
+     ``tpu.google.com/perf`` node label, recovery clears both; probe
+     FAILURE paths stay indeterminate (no verdict flip); collector
+     registration is idempotent against a shared registry,
+  3. the health FSM's grey-failure path: a perf-labelled node walks the
+     same bounded cordon→revalidate→uncordon FSM, proven over the wire
+     by the GreyFailureDrill (PDB-honoring eviction included) and under
+     chaos faults by the rider,
+  4. fleet aggregation: gang series from published artifacts, straggler
+     Events, deliverable-TFLOP/s pricing, stale-series removal.
+"""
+
+import json
+import time
+
+import prometheus_client
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+from tpu_operator.api.clusterpolicy import HealthMonitorSpec, new_cluster_policy
+from tpu_operator.controllers.fleet_telemetry import FleetTelemetryAggregator
+from tpu_operator.controllers.health_controller import NodeRepairManager, RepairState
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.perf import (
+    FLOOR_FRACTION,
+    default_floors,
+    floors_for,
+    floors_json,
+    measured_roofs,
+)
+from tpu_operator.workloads.telemetry import (
+    StepTimeRecorder,
+    StepTimeReport,
+    merge_gang_reports,
+    publish_prometheus,
+)
+
+NS = "tpu-operator"
+
+
+def sample(registry, name, **labels):
+    return registry.get_sample_value(name, labels or None)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the step-time recorder + gang merge
+# ---------------------------------------------------------------------------
+
+
+class TestStepTimeRecorder:
+    def test_compile_split_and_percentiles(self):
+        rec = StepTimeRecorder(host="h0")
+        delays = iter([0.03, 0.001, 0.001, 0.001, 0.004])
+        rec.run(lambda: time.sleep(next(delays)), 5)
+        r = rec.report()
+        # the first (compiling) call never pollutes the distribution
+        assert r.compile_s >= 0.03
+        assert r.step_p50_s < 0.02
+        assert r.step_max_s >= r.step_p99_s >= r.step_p50_s
+        assert r.steps == 5 and r.total_s > 0
+        assert r.host == "h0"
+
+    def test_achieved_tflops(self):
+        rec = StepTimeRecorder(flops_per_step=1e9)
+        rec.run(lambda: time.sleep(0.001), 3)
+        r = rec.report()
+        # 1 GFLOP in ~1ms ≈ 1e12 FLOP/s = 1 TFLOP/s (generous bounds:
+        # CI wall clocks jitter)
+        assert r.tflops is not None and 0.05 < r.tflops < 1.2
+
+    def test_no_steps_raises(self):
+        with pytest.raises(RuntimeError):
+            StepTimeRecorder().report()
+
+    def test_report_roundtrip(self):
+        rec = StepTimeRecorder(flops_per_step=1e9, host="w3")
+        rec.run(lambda: time.sleep(0.001), 3)
+        d = rec.report().to_dict()
+        back = StepTimeReport.from_dict(d)
+        assert back.to_dict() == d
+
+    def test_gang_merge_straggler(self):
+        reports = {
+            f"h{i}": {"step_p50_s": 0.010, "tflops": 10.0} for i in range(3)
+        }
+        reports["h3"] = {"step_p50_s": 0.020, "tflops": 5.0}
+        artifact = merge_gang_reports(reports)
+        assert artifact["hosts"] == 4
+        assert artifact["slowest_host"] == "h3"
+        assert artifact["straggler_ratio"] == pytest.approx(2.0)
+        assert artifact["gang_step_p50_s"] == pytest.approx(0.010)
+        assert artifact["gang_tflops"] == pytest.approx(35.0)
+
+    def test_gang_merge_uniform(self):
+        artifact = merge_gang_reports({f"h{i}": {"step_p50_s": 0.01} for i in range(4)})
+        assert artifact["straggler_ratio"] == pytest.approx(1.0)
+
+    def test_gang_merge_empty_raises(self):
+        with pytest.raises(ValueError):
+            merge_gang_reports({})
+
+    def test_publish_prometheus_idempotent(self):
+        reg = prometheus_client.CollectorRegistry()
+        rec = StepTimeRecorder(flops_per_step=1e9)
+        rec.run(lambda: time.sleep(0.001), 3)
+        publish_prometheus(rec.report(), "n0", registry=reg)
+        # second publish into the SAME registry reuses the collectors
+        publish_prometheus(rec.report(), "n1", registry=reg)
+        for node in ("n0", "n1"):
+            assert sample(reg, "tpu_exporter_workload_step_seconds",
+                          node=node, stat="p50") is not None
+            assert sample(reg, "tpu_exporter_workload_compile_seconds", node=node) is not None
+            assert sample(reg, "tpu_exporter_workload_tflops", node=node) is not None
+
+    def test_burnin_telemetry_block(self):
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh, run_burnin
+
+        result = run_burnin(
+            mesh=make_mesh(), steps=3,
+            cfg=BurninConfig(d_model=64, d_ff=128, seq_len=32, batch=4, n_layers=1),
+            record_telemetry=True, telemetry_host="t0",
+        )
+        t = result["telemetry"]
+        assert t["steps"] == 3 and t["compile_s"] > 0
+        assert t["host"] == "t0"
+        assert t.get("tflops") is not None  # flops estimate wired through
+
+
+# ---------------------------------------------------------------------------
+# the floor table
+# ---------------------------------------------------------------------------
+
+
+class TestPerfFloors:
+    def test_peaks_agree_with_matmul_bench(self):
+        # perf.py carries a jax-free copy of the published peaks; the
+        # two tables must never drift
+        from tpu_operator.perf import PEAK_TFLOPS as local
+        from tpu_operator.workloads.matmul_bench import PEAK_TFLOPS as bench
+
+        assert local == bench
+
+    def test_v5e_keeps_measured_numbers(self):
+        roofs = measured_roofs()
+        assert roofs["v5e"] == {"matmul_tflops": 185.0, "triad_gbps": 665.0}
+
+    def test_floors_are_fraction_of_roofs(self):
+        floors = default_floors()
+        for gen, roof in measured_roofs().items():
+            for probe in roof:
+                assert floors[gen][probe] == pytest.approx(
+                    roof[probe] * FLOOR_FRACTION, rel=0.01
+                )
+
+    def test_floors_for_blob_and_fallbacks(self):
+        assert floors_for("v5e", floors_json())["matmul_tflops"] == pytest.approx(
+            185.0 * FLOOR_FRACTION, rel=0.01
+        )
+        # malformed blob -> built-in defaults, unknown generation -> {}
+        assert floors_for("v5e", "{not json")["matmul_tflops"] > 0
+        assert floors_for("v9x", floors_json()) == {}
+        assert floors_for("", None) == {}
+
+
+# ---------------------------------------------------------------------------
+# layer 2: exporter grey-failure detection
+# ---------------------------------------------------------------------------
+
+
+def make_exporter(store=None, node="tpu-0", floor=100.0, **kw):
+    reg = kw.pop("registry", prometheus_client.CollectorRegistry())
+    return MetricsExporterAgent(
+        node_name=node, client=store, registry=reg,
+        floors={"matmul_tflops": floor} if floor else {}, **kw
+    ), reg
+
+
+class TestGreyFailureDetection:
+    def test_sustained_breach_sets_series_and_label(self):
+        store = FakeClient()
+        store.create(make_tpu_node("tpu-0"))
+        exp, reg = make_exporter(store)
+        for i in range(consts.PERF_BREACH_SAMPLES):
+            labels = store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}
+            assert labels.get(consts.TPU_PERF_LABEL) is None  # not yet
+            exp.observe_probe("matmul_tflops", 60.0)
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="matmul_tflops") == 1
+        labels = store.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[consts.TPU_PERF_LABEL] == consts.PERF_DEGRADED
+
+    def test_one_good_sample_resets_the_count(self):
+        store = FakeClient()
+        store.create(make_tpu_node("tpu-0"))
+        exp, reg = make_exporter(store)
+        for _ in range(consts.PERF_BREACH_SAMPLES - 1):
+            exp.observe_probe("matmul_tflops", 60.0)
+        exp.observe_probe("matmul_tflops", 150.0)  # recovery resets
+        for _ in range(consts.PERF_BREACH_SAMPLES - 1):
+            exp.observe_probe("matmul_tflops", 60.0)
+        labels = store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) is None
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="matmul_tflops") == 0
+
+    def test_recovery_clears_label_and_series(self):
+        store = FakeClient()
+        store.create(make_tpu_node("tpu-0"))
+        exp, reg = make_exporter(store)
+        for _ in range(consts.PERF_BREACH_SAMPLES):
+            exp.observe_probe("matmul_tflops", 60.0)
+        exp.observe_probe("matmul_tflops", 150.0)
+        labels = store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) is None
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="matmul_tflops") == 0
+
+    def test_baseline_and_floor_gauges(self):
+        exp, reg = make_exporter()
+        for v in (100.0, 120.0, 110.0):
+            exp.observe_probe("matmul_tflops", v)
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="matmul_tflops") == 110.0
+        assert sample(reg, "tpu_exporter_perf_floor",
+                      node="tpu-0", probe="matmul_tflops") == 100.0
+
+    def test_no_floor_only_feeds_baseline(self):
+        exp, reg = make_exporter(floor=None)
+        assert exp.observe_probe("mystery_probe", 1.0) is False
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="mystery_probe") == 1.0
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="mystery_probe") is None
+
+    def test_no_client_flips_series_without_label_write(self):
+        exp, reg = make_exporter(store=None)
+        for _ in range(consts.PERF_BREACH_SAMPLES):
+            assert exp.observe_probe("matmul_tflops", 60.0) or True
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="matmul_tflops") == 1
+
+    def test_probe_failure_is_indeterminate_in_auto(self, monkeypatch):
+        """A probe that fails to RUN must not move the verdict: auto
+        mode skips quietly (chip owned by a tenant), and the breach
+        bookkeeping is untouched."""
+        store = FakeClient()
+        store.create(make_tpu_node("tpu-0"))
+        exp, reg = make_exporter(store, active_probes="auto")
+        # push to the edge of breach, then fail the next probe run
+        for _ in range(consts.PERF_BREACH_SAMPLES - 1):
+            exp.observe_probe("matmul_tflops", 60.0)
+
+        def boom(*a, **k):
+            raise RuntimeError("chip busy")
+
+        monkeypatch.setattr(
+            "tpu_operator.workloads.matmul_bench.matmul_tflops", boom
+        )
+        exp.probe_utilization()
+        labels = store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) is None
+        assert sample(reg, "tpu_exporter_collect_errors_total", node="tpu-0") in (None, 0)
+
+    def test_probe_failure_counts_in_on_mode(self, monkeypatch):
+        exp, reg = make_exporter(active_probes="on")
+
+        def boom(*a, **k):
+            raise RuntimeError("broken")
+
+        monkeypatch.setattr("tpu_operator.workloads.kernels.hbm_bandwidth_probe", boom)
+        exp.probe_bandwidth()
+        assert sample(reg, "tpu_exporter_collect_errors_total", node="tpu-0") == 1
+
+    def test_failed_label_write_retries_next_sample(self):
+        """An apiserver hiccup on the label patch must not lose the
+        verdict: the next observe re-derives and re-publishes."""
+        store = FakeClient()  # node does NOT exist yet -> patch 404s
+        exp, _ = make_exporter(store)
+        for _ in range(consts.PERF_BREACH_SAMPLES):
+            exp.observe_probe("matmul_tflops", 60.0)
+        store.create(make_tpu_node("tpu-0"))
+        exp.observe_probe("matmul_tflops", 60.0)  # retry lands
+        labels = store.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[consts.TPU_PERF_LABEL] == consts.PERF_DEGRADED
+
+    def test_restart_does_not_clear_live_label_without_recovery(self):
+        """A restarted exporter (fresh counters) whose FIRST sample is
+        still below floor must NOT clear a pre-existing degraded label:
+        "no sustained breach observed yet" is not recovery, and a
+        premature clear would uncordon a node the FSM is holding at
+        revalidation. An at-floor sample is the evidence that clears."""
+        store = FakeClient()
+        node = make_tpu_node("tpu-0")
+        node["metadata"]["labels"][consts.TPU_PERF_LABEL] = consts.PERF_DEGRADED
+        store.create(node)
+        exp, _ = make_exporter(store)  # the restarted incarnation
+        exp.observe_probe("matmul_tflops", 60.0)  # still slow
+        labels = store.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED
+        exp.observe_probe("matmul_tflops", 150.0)  # genuine recovery
+        labels = store.get("v1", "Node", "tpu-0")["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) is None
+
+    def test_registration_idempotent_against_shared_registry(self):
+        """PR 6 fixed OperatorMetrics only; a second in-process exporter
+        sharing a registry (one per simulated node in the smoke) must
+        reuse the collectors instead of tripping the duplicate-
+        registration ValueError."""
+        reg = prometheus_client.CollectorRegistry()
+        a = MetricsExporterAgent(node_name="n0", registry=reg)
+        b = MetricsExporterAgent(node_name="n1", registry=reg)  # must not raise
+        a.chips.labels("n0").set(4)
+        b.chips.labels("n1").set(4)
+        assert sample(reg, "tpu_exporter_chips", node="n0") == 4
+        assert sample(reg, "tpu_exporter_chips", node="n1") == 4
+        # and against the DEFAULT registry, twice
+        c = MetricsExporterAgent(node_name="n2", registry=prometheus_client.REGISTRY)
+        d = MetricsExporterAgent(node_name="n2", registry=prometheus_client.REGISTRY)
+        assert c.chips is d.chips
+
+    def test_floors_from_env(self, monkeypatch):
+        from tpu_operator.agents.metrics_exporter_agent import floors_from_env
+
+        monkeypatch.setattr(
+            "tpu_operator.workloads.matmul_bench.chip_generation", lambda: "v5e"
+        )
+        monkeypatch.setenv("PERF_FLOORS_JSON", floors_json())
+        floors = floors_from_env()
+        assert floors["matmul_tflops"] == pytest.approx(185.0 * FLOOR_FRACTION, rel=0.01)
+        # off-TPU: no generation -> no floors -> detection off
+        monkeypatch.setattr(
+            "tpu_operator.workloads.matmul_bench.chip_generation", lambda: ""
+        )
+        assert floors_from_env() == {}
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the grey-failure FSM path
+# ---------------------------------------------------------------------------
+
+
+def grey_node(name="grey-0", pool="pool-a"):
+    node = make_tpu_node(name, nodepool=pool)
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    node["metadata"]["labels"][consts.TPU_PERF_LABEL] = consts.PERF_DEGRADED
+    return node
+
+
+class TestGreyFailureFSM:
+    def spec(self, **remediation):
+        base = {"enable": True, "retryLimit": 3, "timeoutSeconds": 300,
+                "gracePeriodSeconds": 300}
+        base.update(remediation)
+        return HealthMonitorSpec.from_dict({"remediation": base})
+
+    def test_perf_label_enters_repair_without_grace(self):
+        """Grey entry bypasses the provisioning grace: the exporter's
+        breach is already debounced over N probe intervals, and a
+        provisioning node has no successful probes to breach."""
+        store = FakeClient()
+        store.create(grey_node())
+        mgr = NodeRepairManager(store, NS)
+        states = mgr.apply_state(self.spec())
+        assert states["grey-0"] == RepairState.CORDON_REQUIRED
+        annotations = store.get("v1", "Node", "grey-0")["metadata"]["annotations"]
+        assert annotations[consts.REPAIR_REASON_ANNOTATION] == consts.REPAIR_REASON_PERF
+
+    def test_health_entry_still_respects_grace(self):
+        store = FakeClient()
+        node = make_tpu_node("h-0")
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_DEGRADED
+        store.create(node)
+        mgr = NodeRepairManager(store, NS)
+        states = mgr.apply_state(self.spec())
+        assert states["h-0"] == consts.HEALTH_DEGRADED  # parked in grace
+
+    def test_revalidate_needs_perf_clear_for_perf_entry(self):
+        store = FakeClient()
+        node = grey_node()
+        node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = RepairState.REVALIDATE_REQUIRED
+        node["metadata"]["annotations"] = {
+            consts.REPAIR_REASON_ANNOTATION: consts.REPAIR_REASON_PERF,
+            consts.REPAIR_STATE_SINCE_ANNOTATION: str(int(time.time())),
+        }
+        node["spec"]["unschedulable"] = True
+        store.create(node)
+        mgr = NodeRepairManager(store, NS)
+        states = mgr.apply_state(self.spec())
+        assert states["grey-0"] == RepairState.REVALIDATE_REQUIRED  # still breached
+        # the exporter clears the label -> revalidation passes
+        store.patch("v1", "Node", "grey-0",
+                    {"metadata": {"labels": {consts.TPU_PERF_LABEL: None}}})
+        states = mgr.apply_state(self.spec())
+        assert states["grey-0"] == RepairState.UNCORDON_REQUIRED
+
+    def test_revalidate_perf_entry_blocked_by_health_degraded(self):
+        """A chip that recovered its speed but now fails health probes
+        must NOT uncordon off the perf reason alone."""
+        store = FakeClient()
+        node = grey_node()
+        del node["metadata"]["labels"][consts.TPU_PERF_LABEL]  # perf cleared
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_DEGRADED
+        node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = RepairState.REVALIDATE_REQUIRED
+        node["metadata"]["annotations"] = {
+            consts.REPAIR_REASON_ANNOTATION: consts.REPAIR_REASON_PERF,
+            consts.REPAIR_STATE_SINCE_ANNOTATION: str(int(time.time())),
+        }
+        node["spec"]["unschedulable"] = True
+        store.create(node)
+        mgr = NodeRepairManager(store, NS)
+        states = mgr.apply_state(self.spec())
+        assert states["grey-0"] == RepairState.REVALIDATE_REQUIRED
+
+    def test_health_entry_unchanged_needs_healthy_verdict(self):
+        """The health path keeps its strict contract: absence of a
+        verdict is indeterminate, not health."""
+        store = FakeClient()
+        node = make_tpu_node("h-0")
+        node["metadata"]["labels"][consts.REPAIR_STATE_LABEL] = RepairState.REVALIDATE_REQUIRED
+        node["metadata"]["annotations"] = {
+            consts.REPAIR_REASON_ANNOTATION: consts.REPAIR_REASON_HEALTH,
+            consts.REPAIR_STATE_SINCE_ANNOTATION: str(int(time.time())),
+        }
+        node["spec"]["unschedulable"] = True
+        store.create(node)
+        mgr = NodeRepairManager(store, NS)
+        states = mgr.apply_state(self.spec())
+        assert states["h-0"] == RepairState.REVALIDATE_REQUIRED
+
+    def test_grey_member_poisons_gang_and_leaves_placement(self):
+        from tpu_operator.placement.engine import labels_unavailable
+
+        assert labels_unavailable({consts.TPU_PERF_LABEL: consts.PERF_DEGRADED})
+        assert not labels_unavailable({})
+        store = FakeClient()
+        store.create(grey_node("g-0", pool="p"))
+        peer = make_tpu_node("g-1", nodepool="p")
+        peer["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        store.create(peer)
+        mgr = NodeRepairManager(store, NS)
+        mgr.apply_state(self.spec())
+        labels = store.get("v1", "Node", "g-1")["metadata"]["labels"]
+        assert labels.get(consts.TPU_SLICE_HEALTH_LABEL) == consts.HEALTH_DEGRADED
+
+    def test_grey_failure_drill_over_the_wire(self):
+        from drill import assert_grey_failure_drill_passed, run_grey_failure_drill
+        from tpu_operator.kube.http_client import HttpClient
+        from tpu_operator.kube.httpserver import FakeApiServer
+
+        store = FakeClient()
+        server = FakeApiServer(store).start()
+        try:
+            client = HttpClient(server.base_url, timeout=10.0)
+            obs = run_grey_failure_drill(client, NS)
+            assert_grey_failure_drill_passed(obs)
+        finally:
+            server.stop()
+
+    def test_grey_failure_drill_chaos_rider(self):
+        """The chaos rider: the same grey drill through a seeded fault
+        director (GET/PATCH 500s + latency) — the retry layer must ride
+        the faults out and the FSM still converge."""
+        from drill import assert_grey_failure_drill_passed, run_grey_failure_drill
+        from tpu_operator.kube.chaos import FAULT_500, ChaosDirector, FaultRule
+        from tpu_operator.kube.http_client import HttpClient
+        from tpu_operator.kube.httpserver import FakeApiServer
+
+        store = FakeClient()
+        director = ChaosDirector(seed=20260803)
+        director.rules = [
+            FaultRule(FAULT_500, rate=1.0, times=2, verbs=("GET",)),
+            FaultRule(FAULT_500, rate=0.05, verbs=("GET", "PATCH")),
+        ]
+        server = FakeApiServer(store, chaos=director).start()
+        try:
+            client = HttpClient(server.base_url, timeout=10.0)
+            obs = run_grey_failure_drill(client, NS)
+            assert_grey_failure_drill_passed(obs)
+        finally:
+            server.stop()
+        assert director.fault_log  # the schedule actually fired
+
+
+# ---------------------------------------------------------------------------
+# layer 4: fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+def tpu_pool_node(name, healthy=True, perf_degraded=False):
+    node = make_tpu_node(name, "tpu-v5-lite-podslice", "4x4")
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    if not healthy:
+        node["metadata"]["labels"][consts.TPU_HEALTH_LABEL] = consts.HEALTH_DEGRADED
+    if perf_degraded:
+        node["metadata"]["labels"][consts.TPU_PERF_LABEL] = consts.PERF_DEGRADED
+    return node
+
+
+def gang_cm(store, slice_name, artifact):
+    cm = new_object(
+        "v1", "ConfigMap", f"{slice_name}-gang", NS,
+        labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+        data={"TPU_WORKER_HOSTNAMES": "x"},
+    )
+    cm["metadata"]["annotations"] = {
+        consts.GANG_TELEMETRY_ANNOTATION: json.dumps(artifact)
+    }
+    store.create(cm)
+    return cm
+
+
+class TestFleetAggregation:
+    def test_gang_series_and_straggler_event(self):
+        store = FakeClient()
+        gang_cm(store, "tpu-slice-a", {
+            "gang_step_p50_s": 0.01, "straggler_ratio": 1.6, "slowest_host": "n3",
+        })
+        gang_cm(store, "tpu-slice-b", {
+            "gang_step_p50_s": 0.02, "straggler_ratio": 1.0, "slowest_host": "n7",
+        })
+        agg = FleetTelemetryAggregator(store, NS)
+        summary = agg.sync()
+        assert summary["gangs"]["tpu-slice-a"]["straggler_ratio"] == 1.6
+        assert summary["stragglers"] == ["tpu-slice-a"]
+        reg = prometheus_client.REGISTRY
+        assert sample(reg, "tpu_operator_gang_step_seconds",
+                      **{"slice": "tpu-slice-a"}) == 0.01
+        assert sample(reg, "tpu_operator_gang_straggler_ratio",
+                      **{"slice": "tpu-slice-b"}) == 1.0
+        events = [e for e in store.list("v1", "Event") if e.get("reason") == "PerfDegraded"]
+        assert len(events) == 1 and "n3" in events[0]["message"]
+        # a second pass must not duplicate the event for the same episode
+        agg.sync()
+        events = [e for e in store.list("v1", "Event") if e.get("reason") == "PerfDegraded"]
+        assert sum(e.get("count", 1) for e in events) <= 2
+
+    def test_stale_gang_series_removed(self):
+        store = FakeClient()
+        cm = gang_cm(store, "tpu-slice-gone", {
+            "gang_step_p50_s": 0.01, "straggler_ratio": 1.0, "slowest_host": "n0",
+        })
+        agg = FleetTelemetryAggregator(store, NS)
+        agg.sync()
+        reg = prometheus_client.REGISTRY
+        assert sample(reg, "tpu_operator_gang_step_seconds",
+                      **{"slice": "tpu-slice-gone"}) == 0.01
+        store.delete("v1", "ConfigMap", cm["metadata"]["name"], NS)
+        agg.sync()
+        assert sample(reg, "tpu_operator_gang_step_seconds",
+                      **{"slice": "tpu-slice-gone"}) is None
+
+    def test_fleet_healthy_tflops_prices_in_service_nodes(self):
+        store = FakeClient()
+        store.create(tpu_pool_node("n0"))
+        store.create(tpu_pool_node("n1"))
+        store.create(tpu_pool_node("n2", healthy=False))
+        store.create(tpu_pool_node("n3", perf_degraded=True))
+        agg = FleetTelemetryAggregator(store, NS)
+        summary = agg.sync()
+        # v5e measured roof x 4 chips x 2 in-service hosts
+        expected = measured_roofs()["v5e"]["matmul_tflops"] * 4 * 2
+        assert summary["fleet_healthy_tflops"] == pytest.approx(expected)
+        assert summary["perf_degraded_nodes"] == ["n3"]
+        reg = prometheus_client.REGISTRY
+        assert sample(reg, "tpu_operator_fleet_healthy_tflops") == pytest.approx(expected)
+        assert sample(reg, "tpu_operator_perf_degraded_nodes") == 1
+
+    def test_malformed_artifact_skipped(self):
+        store = FakeClient()
+        cm = new_object(
+            "v1", "ConfigMap", "bad-gang", NS,
+            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            data={},
+        )
+        cm["metadata"]["annotations"] = {consts.GANG_TELEMETRY_ANNOTATION: "{broken"}
+        store.create(cm)
+        agg = FleetTelemetryAggregator(store, NS)
+        summary = agg.sync()  # must not raise
+        assert summary["gangs"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the slice manager's publication hop
+# ---------------------------------------------------------------------------
+
+
+class TestGangTelemetryPublication:
+    def test_publish_annotates_gang_configmap(self):
+        store = FakeClient()
+        store.create(new_object(
+            "v1", "ConfigMap", "tpu-slice-x-gang", NS,
+            labels={"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            data={"TPU_WORKER_HOSTNAMES": "a,b"},
+        ))
+        agent = SliceManagerAgent(store, NS)
+        artifact = {"gang_step_p50_s": 0.01, "straggler_ratio": 1.0,
+                    "slowest_host": "a", "hosts": 2}
+        assert agent.publish_gang_telemetry("tpu-slice-x", artifact)
+        cm = store.get("v1", "ConfigMap", "tpu-slice-x-gang", NS)
+        stored = json.loads(
+            cm["metadata"]["annotations"][consts.GANG_TELEMETRY_ANNOTATION]
+        )
+        assert stored == artifact
+        # the gang env data is untouched by the annotation patch
+        assert cm["data"]["TPU_WORKER_HOSTNAMES"] == "a,b"
+
+    def test_publish_gone_gang_returns_false(self):
+        agent = SliceManagerAgent(FakeClient(), NS)
+        assert agent.publish_gang_telemetry("tpu-slice-x", {}) is False
+
+
+# ---------------------------------------------------------------------------
+# lint: TPUOP-O003
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusRuleLint:
+    def rule_obj(self, expr, name="r", alert="A"):
+        return {
+            "apiVersion": "monitoring.coreos.com/v1", "kind": "PrometheusRule",
+            "metadata": {"name": name},
+            "spec": {"groups": [{"name": "g", "rules": [{"alert": alert, "expr": expr}]}]},
+        }
+
+    def test_typod_metric_flagged(self):
+        from tpu_operator.lint.metrics_catalog import analyze_rules
+
+        findings = analyze_rules(
+            [("state:x", [self.rule_obj("tpu_operator_nonexistent_series > 0")])]
+        )
+        assert [f.rule for f in findings] == ["TPUOP-O003"]
+        assert "tpu_operator_nonexistent_series" in findings[0].message
+
+    def test_registered_metric_passes(self):
+        from tpu_operator.lint.metrics_catalog import analyze_rules
+
+        findings = analyze_rules(
+            [("state:x", [self.rule_obj(
+                "rate(tpu_operator_reconciliation_total[5m]) "
+                "/ tpu_exporter_perf_degraded > 0"
+            )])]
+        )
+        assert findings == []
+
+    def test_shipped_rules_all_clean(self):
+        """Every PrometheusRule the states actually render references
+        only registered series — the live guarantee the satellite asks
+        for."""
+        from tpu_operator.lint.metrics_catalog import analyze_rules
+        from tpu_operator.lint.runner import manifest_groups
+
+        groups = manifest_groups()
+        assert any(
+            obj.get("kind") == "PrometheusRule"
+            for _, objs in groups for obj in objs
+        )  # the check is not vacuous
+        assert analyze_rules(groups) == []

@@ -238,6 +238,31 @@ class TestPerfFloors:
         monkeypatch.setenv("MIN_TFLOPS", "garbage")
         assert Context.from_env().min_tflops is None
 
+    def test_floor_falls_back_to_published_table(self, monkeypatch):
+        """With no explicit minTflops, the workload floor comes from the
+        operator-published per-generation table (the same floors the
+        exporter's grey-failure detection uses); an explicit spec value
+        always wins."""
+        from tpu_operator.perf import FLOOR_FRACTION, floors_json
+
+        monkeypatch.delenv("MIN_TFLOPS", raising=False)
+        monkeypatch.setenv("PERF_FLOORS_JSON", floors_json())
+        monkeypatch.setattr(
+            "tpu_operator.workloads.matmul_bench.chip_generation", lambda: "v5e"
+        )
+        assert Context.from_env().min_tflops == pytest.approx(
+            185.0 * FLOOR_FRACTION, rel=0.01
+        )
+        # explicit spec floor wins over the table
+        monkeypatch.setenv("MIN_TFLOPS", "42")
+        assert Context.from_env().min_tflops == 42.0
+        # off-TPU: no generation -> no fallback floor
+        monkeypatch.delenv("MIN_TFLOPS", raising=False)
+        monkeypatch.setattr(
+            "tpu_operator.workloads.matmul_bench.chip_generation", lambda: ""
+        )
+        assert Context.from_env().min_tflops is None
+
     def test_workload_pod_carries_floor_env(self, ctx):
         from tpu_operator.validator.main import workload_pod
 

@@ -39,19 +39,42 @@ control this via ``TPU_EXPORTER_ACTIVE_PROBES``:
 
 and ``TPU_EXPORTER_PROBE_INTERVAL`` (seconds between probe bursts,
 default 600).
+
+Grey-failure detection (the data-plane telemetry pipeline's middle
+layer): the exporter compares its measured matmul/triad probes against
+the per-generation perf floors the operator publishes
+(``consts.PERF_FLOORS_CONFIGMAP``, seeded from the measured BENCH roofs
+in ``tpu_operator/perf.py`` and delivered to this pod as the
+``PERF_FLOORS_JSON`` env via configMapKeyRef), maintains a rolling
+baseline per probe, and after ``PERF_BREACH_SAMPLES`` consecutive
+samples below floor publishes ``tpu_exporter_perf_degraded{node,probe}``
+plus the ``tpu.google.com/perf=degraded`` node label — the signal the
+health controller's grey-failure FSM path and the placement engine's
+availability predicate consume, so a slow chip leaves its gang the same
+way a dead one does. The label clears the same way it sets: a sample at
+or above floor resets the breach counter and un-labels the node.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import prometheus_client
 
+from tpu_operator import consts
+from tpu_operator.controllers.operator_metrics import _get_or_create
+from tpu_operator.kube import errors
+
 log = logging.getLogger(__name__)
+
+# rolling-baseline window per probe: enough history that the baseline
+# gauge reflects the node's recent normal, small enough to stay O(1)
+_BASELINE_WINDOW = 20
 
 
 class MetricsExporterAgent:
@@ -63,6 +86,9 @@ class MetricsExporterAgent:
         bandwidth_probe_interval: float = 600.0,
         active_probes: str = "auto",
         registry: Optional[prometheus_client.CollectorRegistry] = None,
+        client=None,
+        floors: Optional[Dict[str, float]] = None,
+        breach_samples: int = consts.PERF_BREACH_SAMPLES,
     ):
         if active_probes not in ("auto", "on", "off"):
             raise ValueError(f"active_probes must be auto/on/off, got {active_probes!r}")
@@ -72,41 +98,73 @@ class MetricsExporterAgent:
         self.bandwidth_probe_interval = bandwidth_probe_interval
         self.active_probes = active_probes
         self.registry = registry or prometheus_client.CollectorRegistry()
-        self.chips = prometheus_client.Gauge(
-            "tpu_exporter_chips", "Visible TPU chips", ["node"], registry=self.registry
+        # optional apiserver client: grey-failure detection publishes the
+        # perf label through it; without one the Prometheus series still
+        # flip but the cluster-side signal stays unpublished
+        self.client = client
+        # {probe: floor} for THIS node's generation (resolved by the
+        # caller / main() from PERF_FLOORS_JSON); empty = detection off
+        self.floors = dict(floors or {})
+        self.breach_samples = max(1, breach_samples)
+        self._probe_history: Dict[str, collections.deque] = {}
+        self._breach_counts: Dict[str, int] = {}
+        self._degraded_probes: set = set()
+        self._perf_label_state: Optional[bool] = None  # last published
+        # collector construction is idempotent against any shared
+        # registry (same _get_or_create contract as OperatorMetrics): a
+        # second in-process exporter — drills boot one per simulated
+        # node into one registry — reuses the series instead of tripping
+        # the duplicate-registration ValueError
+        reg = self.registry
+        self.chips = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_chips", "Visible TPU chips",
+            ["node"], registry=reg,
         )
-        self.hbm_used = prometheus_client.Gauge(
-            "tpu_exporter_hbm_used_bytes", "HBM bytes in use", ["node", "chip"], registry=self.registry
+        self.hbm_used = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_hbm_used_bytes",
+            "HBM bytes in use", ["node", "chip"], registry=reg,
         )
-        self.hbm_limit = prometheus_client.Gauge(
-            "tpu_exporter_hbm_limit_bytes", "HBM bytes capacity", ["node", "chip"], registry=self.registry
+        self.hbm_limit = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_hbm_limit_bytes",
+            "HBM bytes capacity", ["node", "chip"], registry=reg,
         )
-        self.hbm_bandwidth = prometheus_client.Gauge(
-            "tpu_exporter_hbm_bandwidth_gbps",
-            "Measured triad HBM bandwidth",
-            ["node"],
-            registry=self.registry,
+        self.hbm_bandwidth = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_hbm_bandwidth_gbps",
+            "Measured triad HBM bandwidth", ["node"], registry=reg,
         )
-        self.ici_bandwidth = prometheus_client.Gauge(
-            "tpu_exporter_ici_bandwidth_gbps",
+        self.ici_bandwidth = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_ici_bandwidth_gbps",
             "Measured psum all-reduce bus bandwidth per chip (multi-chip hosts)",
-            ["node"],
-            registry=self.registry,
+            ["node"], registry=reg,
         )
-        self.matmul_tflops = prometheus_client.Gauge(
-            "tpu_exporter_matmul_tflops",
-            "Measured bf16 matmul throughput",
-            ["node"],
-            registry=self.registry,
+        self.matmul_tflops = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_matmul_tflops",
+            "Measured bf16 matmul throughput", ["node"], registry=reg,
         )
-        self.mxu_utilization = prometheus_client.Gauge(
-            "tpu_exporter_mxu_utilization_pct",
+        self.mxu_utilization = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_mxu_utilization_pct",
             "Measured matmul throughput as % of the generation's MXU peak",
-            ["node"],
-            registry=self.registry,
+            ["node"], registry=reg,
         )
-        self.collect_errors = prometheus_client.Counter(
-            "tpu_exporter_collect_errors_total", "Collection failures", ["node"], registry=self.registry
+        self.collect_errors = _get_or_create(
+            prometheus_client.Counter, "tpu_exporter_collect_errors_total",
+            "Collection failures", ["node"], registry=reg,
+        )
+        self.perf_floor = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_perf_floor",
+            "Per-generation perf floor this probe is held to",
+            ["node", "probe"], registry=reg,
+        )
+        self.probe_baseline = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_probe_baseline",
+            "Rolling median of recent probe samples (the node's normal)",
+            ["node", "probe"], registry=reg,
+        )
+        self.perf_degraded = _get_or_create(
+            prometheus_client.Gauge, "tpu_exporter_perf_degraded",
+            "1 while the probe has sustained below its floor (N "
+            "consecutive samples) — a grey failure, not a dead chip",
+            ["node", "probe"], registry=reg,
         )
         self._stop = threading.Event()
 
@@ -135,6 +193,84 @@ class MetricsExporterAgent:
             if "bytes_limit" in stats:
                 self.hbm_limit.labels(self.node_name, chip).set(stats["bytes_limit"])
 
+    # -- grey-failure detection ----------------------------------------------
+
+    def observe_probe(self, probe: str, value: float) -> bool:
+        """Feed one measured probe sample through the floor comparison:
+        updates the rolling baseline, counts consecutive below-floor
+        samples, flips ``tpu_exporter_perf_degraded{node,probe}`` on
+        sustained breach, and (re)publishes the node perf label when the
+        node-level verdict changes. Returns True while this probe is in
+        sustained breach. A probe with no configured floor only feeds
+        the baseline."""
+        history = self._probe_history.setdefault(
+            probe, collections.deque(maxlen=_BASELINE_WINDOW)
+        )
+        history.append(value)
+        ordered = sorted(history)
+        self.probe_baseline.labels(self.node_name, probe).set(
+            ordered[len(ordered) // 2]
+        )
+        floor = self.floors.get(probe)
+        if floor is None:
+            return False
+        self.perf_floor.labels(self.node_name, probe).set(floor)
+        if value < floor:
+            self._breach_counts[probe] = self._breach_counts.get(probe, 0) + 1
+        else:
+            self._breach_counts[probe] = 0
+        breached = self._breach_counts[probe] >= self.breach_samples
+        self.perf_degraded.labels(self.node_name, probe).set(1 if breached else 0)
+        if breached and probe not in self._degraded_probes:
+            log.warning(
+                "metrics: %s sustained below floor on %s (%.2f < %.2f for %d samples)",
+                probe, self.node_name, value, floor, self.breach_samples,
+            )
+            self._degraded_probes.add(probe)
+        elif not breached:
+            self._degraded_probes.discard(probe)
+        self._publish_perf_label()
+        return breached
+
+    def _recovery_evidence(self) -> bool:
+        """Whether the sampled history AFFIRMS recovery: at least one
+        floored probe observed, and every observed floored probe's
+        latest sample was at/above floor (breach count 0). A restarted
+        exporter starts with empty counters — "no sustained breach YET"
+        is not recovery, and clearing a live degraded label on a first
+        still-below-floor sample would prematurely uncordon a node the
+        FSM is holding at revalidation."""
+        sampled = [p for p in self.floors if p in self._probe_history]
+        return bool(sampled) and all(self._breach_counts.get(p) == 0 for p in sampled)
+
+    def _publish_perf_label(self) -> None:
+        """Set/clear ``tpu.google.com/perf=degraded`` when the node-level
+        verdict (any probe in sustained breach) changes. A labels-only
+        merge patch, same convention as every other agent writer; a
+        failed write retries on the next probe sample (the verdict is
+        re-derived every pass, nothing is lost). A clear additionally
+        requires positive recovery evidence (see above)."""
+        degraded = bool(self._degraded_probes)
+        if self.client is None or degraded == self._perf_label_state:
+            return
+        if not degraded and not self._recovery_evidence():
+            return
+        try:
+            self.client.patch(
+                "v1", "Node", self.node_name,
+                {"metadata": {"labels": {
+                    consts.TPU_PERF_LABEL: consts.PERF_DEGRADED if degraded else None
+                }}},
+            )
+        except errors.ApiError as e:
+            log.warning("metrics: perf label publish failed: %s", e)
+            return
+        self._perf_label_state = degraded
+        log.info(
+            "metrics: node %s perf label %s", self.node_name,
+            "degraded" if degraded else "cleared",
+        )
+
     def probe_bandwidth(self) -> None:
         """Occasional active probe — the pallas triad — for achievable HBM
         bandwidth (the ICI-bandwidth analog lives in the slice validator)."""
@@ -143,6 +279,11 @@ class MetricsExporterAgent:
 
             report = hbm_bandwidth_probe(size_mb=64, iters=25)
             self.hbm_bandwidth.labels(self.node_name).set(report["bandwidth_gbps"])
+            if not report.get("unstable_timing"):
+                # an unstable slope is a lower bound, not a measurement —
+                # feeding it to the floor comparison would brand relay
+                # noise a grey failure
+                self.observe_probe("triad_gbps", report["bandwidth_gbps"])
         except Exception as e:  # noqa: BLE001
             self._probe_failed("bandwidth", e)
 
@@ -164,6 +305,7 @@ class MetricsExporterAgent:
             self.ici_bandwidth.labels(self.node_name).set(
                 ar["peak_busbw_gbps_per_chip"]
             )
+            self.observe_probe("ici_gbps", ar["peak_busbw_gbps_per_chip"])
         except Exception as e:  # noqa: BLE001
             self._probe_failed("ici", e)
 
@@ -186,6 +328,8 @@ class MetricsExporterAgent:
                 size=8192 if on_tpu else 256, iters=16 if on_tpu else 2
             )
             self.matmul_tflops.labels(self.node_name).set(report["tflops"])
+            if on_tpu and not report.get("unstable_timing"):
+                self.observe_probe("matmul_tflops", report["tflops"])
             # generation from the runtime's device_kind: rendered pods set
             # no generation env var, so an env-only lookup would leave the
             # utilization gauge silently absent in-cluster
@@ -230,6 +374,21 @@ class MetricsExporterAgent:
         self._stop.set()
 
 
+def floors_from_env() -> Dict[str, float]:
+    """Resolve this node's floor map: the PERF_FLOORS_JSON blob the
+    perf-floors ConfigMap delivers (falling back to the built-in
+    defaults) keyed by the runtime's chip generation. Off-TPU (or when
+    the generation is unrecognized) there is nothing to hold a floor
+    to: {} disables detection."""
+    from tpu_operator.perf import floors_for
+    from tpu_operator.workloads.matmul_bench import chip_generation
+
+    gen = chip_generation()
+    if not gen:
+        return {}
+    return floors_for(gen, os.environ.get("PERF_FLOORS_JSON", ""))
+
+
 def main() -> int:
     logging.basicConfig(level=logging.INFO)
     import argparse
@@ -258,11 +417,39 @@ def main() -> int:
             os.environ.get("TPU_EXPORTER_PROBE_INTERVAL"),
         )
         probe_interval = 600.0
+    try:
+        breach_samples = int(
+            os.environ.get("TPU_EXPORTER_BREACH_SAMPLES",
+                           str(consts.PERF_BREACH_SAMPLES)).strip()
+        )
+    except ValueError:
+        log.warning(
+            "invalid TPU_EXPORTER_BREACH_SAMPLES %r; using %d",
+            os.environ.get("TPU_EXPORTER_BREACH_SAMPLES"), consts.PERF_BREACH_SAMPLES,
+        )
+        breach_samples = consts.PERF_BREACH_SAMPLES
+    try:
+        floors = floors_from_env()
+    except Exception as e:  # noqa: BLE001 — detection off, exporter lives
+        log.warning("perf floors unavailable: %s", e)
+        floors = {}
+    # the apiserver client only carries the perf label; a pod that can't
+    # build one (no in-cluster env) still serves every series
+    client = None
+    try:
+        from tpu_operator.kube.http_client import HttpClient
+
+        client = HttpClient.in_cluster()
+    except Exception as e:  # noqa: BLE001
+        log.warning("apiserver client unavailable (perf label off): %s", e)
     MetricsExporterAgent(
         node_name=os.environ.get("NODE_NAME", ""),
         port=port,
         bandwidth_probe_interval=probe_interval,
         active_probes=active,
+        client=client,
+        floors=floors,
+        breach_samples=breach_samples,
     ).run_forever()
     return 0
 

@@ -481,6 +481,34 @@ class SliceManagerAgent:
         )
         self.client.apply(self._own(cm))
 
+    def publish_gang_telemetry(self, slice_name: str, artifact: dict) -> bool:
+        """Publish a gang's merged step-time artifact
+        (``workloads.telemetry.merge_gang_reports``) onto its gang
+        ConfigMap as the ``consts.GANG_TELEMETRY_ANNOTATION`` — the
+        hand-off point between the data plane (workload harnesses
+        measuring their own steps) and the control plane (the operator's
+        fleet aggregation reads the annotation back into the
+        ``tpu_operator_gang_*`` series). An annotation-only merge patch:
+        concurrent hosts publishing the same gang converge, and the gang
+        env data is never touched. Returns False when the gang ConfigMap
+        is gone (torn down between measure and publish)."""
+        import json
+
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", f"{slice_name}-gang", {
+                    "metadata": {"annotations": {
+                        consts.GANG_TELEMETRY_ANNOTATION: json.dumps(
+                            artifact, sort_keys=True
+                        )
+                    }}
+                },
+                self.namespace,
+            )
+        except errors.NotFound:
+            return False
+        return True
+
     def _apply_worker_ids(self, pool: NodePool, node_labels: dict) -> None:
         """Stable worker ids: sorted node order within the pool (reference
         concept: per-node mig.config label loop). A label-only merge patch

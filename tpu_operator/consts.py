@@ -96,11 +96,45 @@ PLACEMENT_TOPOLOGY_LABEL = "tpu.google.com/placement-topology"
 # frees up without any watch event the queue predicate maps)
 PLACEMENT_REPLAN_SECONDS = 15.0
 
+# ---------------------------------------------------------------------------
+# Data-plane telemetry & grey-failure detection. The metrics exporter
+# compares its active probes against per-generation perf floors
+# (published by the operator in the PERF_FLOORS_CONFIGMAP, seeded from
+# the measured BENCH roofs in tpu_operator/perf.py) and on SUSTAINED
+# breach stamps the perf label — a slow-but-alive chip leaves its gang
+# the same way a dead one does (the health FSM's grey-failure path).
+# ---------------------------------------------------------------------------
+TPU_PERF_LABEL = "tpu.google.com/perf"  # degraded while below floor
+PERF_DEGRADED = "degraded"
+# rendered by the pre-requisites state (first in STATE_ORDER, so both
+# consumers — exporter DaemonSet env and validator floors fallback —
+# find it); per-generation JSON floor maps + one "floors.json" blob
+PERF_FLOORS_CONFIGMAP = "tpu-perf-floors"
+PERF_FLOORS_KEY = "floors.json"
+# consecutive probe samples below floor before the exporter declares a
+# sustained breach (one slow sample is noise — a co-tenant burst, a
+# background compaction; N in a row over probe intervals is a grey
+# failure)
+PERF_BREACH_SAMPLES = 3
+# gang step-time artifact: the merged per-host step report the slice
+# manager publishes on the gang ConfigMap; the operator's fleet
+# aggregation reads it back into the gang-level series
+GANG_TELEMETRY_ANNOTATION = "tpu.google.com/gang-telemetry"
+# slowest host's median step vs the gang median above this ratio is a
+# straggler: a PerfDegraded Event fires and the rollup flags the gang
+GANG_STRAGGLER_RATIO = 1.25
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"
 REPAIR_STATE_SINCE_ANNOTATION = "tpu.google.com/tpu.repair-state-since"
 REPAIR_RETRIES_ANNOTATION = "tpu.google.com/tpu.repair-retries"
+# what put the node into repair: "health" (the agent's probe verdict) or
+# "perf" (the exporter's sustained floor breach) — revalidation reads it
+# to know which signal must clear before the node may uncordon
+REPAIR_REASON_ANNOTATION = "tpu.google.com/tpu.repair-reason"
+REPAIR_REASON_HEALTH = "health"
+REPAIR_REASON_PERF = "perf"
 
 # Host path shared between the health agent (writer) and the device plugin
 # (reader): per-chip verdict file consumed by ListAndWatch.

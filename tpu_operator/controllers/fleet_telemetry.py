@@ -1,0 +1,175 @@
+"""Fleet data-plane aggregation: gang rollups + healthy-fleet compute.
+
+The top layer of the telemetry pipeline. Workloads record per-host step
+timing (workloads/telemetry.py), the slice manager publishes each
+gang's merged artifact onto its gang ConfigMap
+(``consts.GANG_TELEMETRY_ANNOTATION``); this aggregator — run from the
+health reconciler's pass, so it rides the same cadence and informer
+caches — reads those artifacts and the node labels back into the
+fleet-level series:
+
+    tpu_operator_gang_step_seconds{slice}      gang-median step time
+    tpu_operator_gang_straggler_ratio{slice}   slowest host vs gang median
+    tpu_operator_fleet_healthy_tflops          deliverable compute now
+    tpu_operator_perf_degraded_nodes           grey failures in the fleet
+
+Straggler detection: a gang whose ratio exceeds
+``consts.GANG_STRAGGLER_RATIO`` gets a ``PerfDegraded`` Event naming
+the slowest host — the operator-side pointer from "this gang is slow"
+to "this is the node to look at", before (or alongside) the exporter's
+own floor breach on that host.
+
+``tpu_operator_fleet_healthy_tflops`` prices each in-service node at
+its generation's MEASURED roof (tpu_operator/perf.py), not published
+peak: the gauge answers "how much compute can this fleet actually
+deliver right now", the calibration input the capacity planner
+(ROADMAP item 4) and serving autoscaler (item 1) consume.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional, Set
+
+from tpu_operator import consts
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.nodeinfo import tpu_info
+from tpu_operator.perf import measured_roofs
+
+log = logging.getLogger(__name__)
+
+# the slice manager stamps this on everything it owns; gang ConfigMaps
+# are found by it (import kept value-only to avoid a module cycle)
+_MANAGED_BY = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+
+
+def node_in_service(labels: dict) -> bool:
+    """Whether a node's chips count toward deliverable fleet compute:
+    not health-degraded, not mid-repair/quarantined, and not flagged by
+    the exporter's perf-floor breach (a slow chip delivers less than its
+    roof by definition — pricing it at the roof would overstate the
+    fleet exactly when a grey failure is eating it)."""
+    from tpu_operator.placement.engine import labels_unavailable
+
+    return not labels_unavailable(labels)
+
+
+class FleetTelemetryAggregator:
+    def __init__(self, client: Client, namespace: str, recorder: Optional[EventRecorder] = None):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = recorder or EventRecorder(client, namespace)
+        self.metrics = get_metrics()
+        self._gang_series: Set[str] = set()  # label values published
+        self._stragglers_flagged: Set[str] = set()  # event dedup per episode
+
+    # -- one aggregation pass ------------------------------------------------
+
+    def sync(self) -> dict:
+        """Read gang artifacts + node labels, publish the fleet series.
+        Returns a summary dict (tests and the telemetry must-gather
+        artifact read it)."""
+        summary = {
+            "gangs": {},
+            "stragglers": [],
+            "fleet_healthy_tflops": 0.0,
+            "perf_degraded_nodes": [],
+        }
+        self._sync_gangs(summary)
+        self._sync_fleet(summary)
+        return summary
+
+    def _sync_gangs(self, summary: dict) -> None:
+        try:
+            cms = self.client.list(
+                "v1", "ConfigMap", self.namespace, label_selector=_MANAGED_BY
+            )
+        except errors.ApiError as e:
+            log.debug("fleet telemetry: gang ConfigMap list failed: %s", e)
+            return
+        live: Set[str] = set()
+        for cm in cms:
+            raw = (cm["metadata"].get("annotations") or {}).get(
+                consts.GANG_TELEMETRY_ANNOTATION
+            )
+            if not raw:
+                continue
+            try:
+                artifact = json.loads(raw)
+            except ValueError:
+                log.warning(
+                    "fleet telemetry: malformed gang artifact on %s",
+                    cm["metadata"]["name"],
+                )
+                continue
+            # gang ConfigMaps are named <slice>-gang; the slice name is
+            # the series key (matches the placement labels' gang id)
+            slice_name = cm["metadata"]["name"]
+            if slice_name.endswith("-gang"):
+                slice_name = slice_name[: -len("-gang")]
+            step = float(artifact.get("gang_step_p50_s") or 0.0)
+            ratio = float(artifact.get("straggler_ratio") or 0.0)
+            self.metrics.gang_step_seconds.labels(slice_name).set(step)
+            self.metrics.gang_straggler_ratio.labels(slice_name).set(ratio)
+            live.add(slice_name)
+            summary["gangs"][slice_name] = {
+                "step_p50_s": step,
+                "straggler_ratio": ratio,
+                "slowest_host": artifact.get("slowest_host", ""),
+            }
+            if ratio > consts.GANG_STRAGGLER_RATIO:
+                summary["stragglers"].append(slice_name)
+                if slice_name not in self._stragglers_flagged:
+                    self.recorder.event(
+                        cm, "Warning", "PerfDegraded",
+                        f"gang {slice_name}: straggler ratio {ratio:.2f} "
+                        f"(> {consts.GANG_STRAGGLER_RATIO}), slowest host "
+                        f"{artifact.get('slowest_host', '?')} — one member is "
+                        "dragging every peer's step time",
+                    )
+                    self._stragglers_flagged.add(slice_name)
+            else:
+                self._stragglers_flagged.discard(slice_name)
+        # a torn-down gang's series goes with it: a frozen last value
+        # would keep a straggler alert firing for a gang that no longer
+        # exists (same discipline as the fragmentation gauge)
+        for gone in self._gang_series - live:
+            try:
+                self.metrics.gang_step_seconds.remove(gone)
+                self.metrics.gang_straggler_ratio.remove(gone)
+            except KeyError:
+                pass
+            self._stragglers_flagged.discard(gone)
+        self._gang_series = live
+
+    def _sync_fleet(self, summary: dict) -> None:
+        roofs = measured_roofs()
+        try:
+            nodes: List[dict] = self.client.list(
+                "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+            )
+        except errors.ApiError as e:
+            log.debug("fleet telemetry: node list failed: %s", e)
+            return
+        total = 0.0
+        degraded: List[str] = []
+        for node in nodes:
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED:
+                degraded.append(node["metadata"]["name"])
+            if not node_in_service(labels):
+                continue
+            info = tpu_info(node)
+            if info is None:
+                continue
+            roof = roofs.get(info.generation, {}).get("matmul_tflops")
+            if roof:
+                total += roof * max(1, info.chips_per_node)
+        self.metrics.fleet_healthy_tflops.set(round(total, 1))
+        self.metrics.perf_degraded_nodes.set(len(degraded))
+        summary["fleet_healthy_tflops"] = round(total, 1)
+        summary["perf_degraded_nodes"] = sorted(degraded)

@@ -88,13 +88,18 @@ class NodeRepairManager(ClusterUpgradeStateManager):
     # -- state bookkeeping ---------------------------------------------------
 
     def repair_nodes(self) -> List[ObjectDict]:
-        """Nodes the FSM cares about: carrying a health verdict or a
+        """Nodes the FSM cares about: carrying a health verdict, a
         repair label (a node whose agent died mid-repair must still
-        finish its walk). Two existence-selector lists instead of a full
+        finish its walk), or the exporter's perf label (grey failures
+        enter the same FSM). Existence-selector lists instead of a full
         node scan: cached reads ride the informer's label-key index, so
         the cost is O(nodes with a verdict), not O(cluster)."""
         seen: Dict[str, ObjectDict] = {}
-        for selector in (consts.TPU_HEALTH_LABEL, consts.REPAIR_STATE_LABEL):
+        for selector in (
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
+        ):
             for node in self.client.list("v1", "Node", label_selector=selector):
                 seen[node["metadata"]["name"]] = node
         return sorted(seen.values(), key=lambda n: n["metadata"]["name"])
@@ -125,6 +130,9 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                 return True
             label_delta[consts.REPAIR_STATE_LABEL] = None
             annotation_delta[consts.REPAIR_STATE_SINCE_ANNOTATION] = None
+            # the trigger record goes with the state: the next episode
+            # stamps its own reason
+            annotation_delta[consts.REPAIR_REASON_ANNOTATION] = None
         body = metadata_patch(labels=label_delta, annotations=annotation_delta)
         try:
             live = self.client.patch("v1", "Node", name, body)
@@ -184,20 +192,60 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         except ValueError:
             return False
 
-    def _begin_or_quarantine(self, node: ObjectDict, remediation) -> str:
+    def _begin_or_quarantine(
+        self, node: ObjectDict, remediation, reason: str = ""
+    ) -> str:
         """Start one repair attempt against the retry budget, or park the
         node in the quarantined terminal state when the budget is spent.
         Used both on fresh degradation and when a revalidation times out
         (re-entering directly keeps the node under FSM ownership — the
-        cordon is never orphaned on a node with no repair state)."""
+        cordon is never orphaned on a node with no repair state).
+        ``reason`` records which signal triggered the attempt ("health"
+        or "perf") so revalidation knows what must clear; re-entries
+        keep the recorded reason."""
         retries = self._retries(node)
         if retries >= max(0, remediation.retry_limit):
             self._set_repair_state(node, RepairState.QUARANTINED)
             self._cordon(node, True)
             return RepairState.QUARANTINED
+        if reason and _annotations(node).get(consts.REPAIR_REASON_ANNOTATION) != reason:
+            try:
+                live = self.client.patch(
+                    "v1", "Node", node["metadata"]["name"],
+                    {"metadata": {"annotations": {consts.REPAIR_REASON_ANNOTATION: reason}}},
+                )
+                node["metadata"] = live["metadata"]
+            except errors.NotFound:
+                return ""
         if self._set_repair_state(node, RepairState.CORDON_REQUIRED, retries=retries + 1):
             get_metrics().remediations_total.inc()
         return RepairState.CORDON_REQUIRED
+
+    @staticmethod
+    def _grey_degraded(labels: dict) -> bool:
+        """The exporter's sustained perf-floor breach: the grey-failure
+        signal that enters the same repair FSM as a failed health
+        probe."""
+        return labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED
+
+    def _revalidated(self, node: ObjectDict) -> bool:
+        """Whether the repair attempt healed what put the node in: a
+        health-triggered repair needs the agent's explicit healthy
+        verdict back (absence is indeterminate, not health); a
+        perf-triggered one needs the exporter's breach label cleared —
+        and neither passes while the OTHER signal reads degraded, so a
+        chip that is now fast but failing probes (or vice versa) never
+        uncordons."""
+        labels = _labels(node)
+        health = labels.get(consts.TPU_HEALTH_LABEL, "")
+        if health == consts.HEALTH_DEGRADED or self._grey_degraded(labels):
+            return False
+        reason = _annotations(node).get(
+            consts.REPAIR_REASON_ANNOTATION, consts.REPAIR_REASON_HEALTH
+        )
+        if reason == consts.REPAIR_REASON_PERF:
+            return True  # perf label cleared, health not degraded
+        return health == consts.HEALTH_HEALTHY
 
     # -- one idempotent pass -------------------------------------------------
 
@@ -230,7 +278,18 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                     if self._in_grace_period(node, remediation):
                         states[name] = health  # provisioning/flap grace
                     else:
-                        states[name] = self._begin_or_quarantine(node, remediation)
+                        states[name] = self._begin_or_quarantine(
+                            node, remediation, reason=consts.REPAIR_REASON_HEALTH
+                        )
+                elif self._grey_degraded(_labels(node)):
+                    # grey failure: the exporter only labels after N
+                    # consecutive probe samples below floor, and a
+                    # provisioning node has no successful probes to
+                    # breach — the signal is pre-debounced, so the
+                    # provisioning grace period does not apply
+                    states[name] = self._begin_or_quarantine(
+                        node, remediation, reason=consts.REPAIR_REASON_PERF
+                    ) or consts.HEALTH_DEGRADED
                 elif health:
                     states[name] = health
                 continue
@@ -275,7 +334,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                     states[name] = state
 
             elif state == RepairState.REVALIDATE_REQUIRED:
-                if health == consts.HEALTH_HEALTHY:
+                if self._revalidated(node):
                     self._set_repair_state(node, RepairState.UNCORDON_REQUIRED)
                     states[name] = RepairState.UNCORDON_REQUIRED
                 elif self._repair_expired(node, remediation.timeout_seconds):
@@ -351,6 +410,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             if (
                 labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_DEGRADED
                 or labels.get(consts.REPAIR_STATE_LABEL)
+                or self._grey_degraded(labels)
             ):
                 pool = labels.get(consts.GKE_NODEPOOL_LABEL)
                 if pool:
@@ -387,9 +447,15 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         states: Dict[str, str] = {}
         nodes = self.repair_nodes()
         for node in nodes:
-            health = _labels(node).get(consts.TPU_HEALTH_LABEL, "")
+            labels = _labels(node)
+            health = labels.get(consts.TPU_HEALTH_LABEL, "")
             if health:
                 states[node["metadata"]["name"]] = health
+            elif self._grey_degraded(labels):
+                # a grey failure counts as degraded in monitoring-only
+                # mode too — the gauges and slice fail-fast labels must
+                # not go blind with remediation off
+                states[node["metadata"]["name"]] = consts.HEALTH_DEGRADED
         self._sync_slice_health(nodes)
         return states
 
@@ -411,7 +477,8 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             state = labels.get(consts.REPAIR_STATE_LABEL)
             slice_label = not keep_slice_labels and consts.TPU_SLICE_HEALTH_LABEL in labels
             retries = consts.REPAIR_RETRIES_ANNOTATION in annotations
-            if not state and not slice_label and not retries:
+            reason = consts.REPAIR_REASON_ANNOTATION in annotations
+            if not state and not slice_label and not retries and not reason:
                 continue
             label_delta: dict = {}
             if state:
@@ -421,6 +488,8 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             annotation_delta: dict = {}
             if consts.REPAIR_STATE_SINCE_ANNOTATION in annotations:
                 annotation_delta[consts.REPAIR_STATE_SINCE_ANNOTATION] = None
+            if reason:
+                annotation_delta[consts.REPAIR_REASON_ANNOTATION] = None
             # the retry budget goes too: "re-enabling starts clean" — a
             # stale count would quarantine the node's first new fault
             if retries:
@@ -446,6 +515,27 @@ class HealthReconciler:
         self.namespace = namespace
         self.repair_manager = NodeRepairManager(client, namespace)
         self.metrics = get_metrics()
+        from tpu_operator.controllers.fleet_telemetry import FleetTelemetryAggregator
+
+        self.fleet_telemetry = FleetTelemetryAggregator(client, namespace)
+
+    def _sync_fleet_telemetry(self) -> None:
+        """Fleet data-plane rollups ride the health cadence: gang
+        step-time/straggler series from the published gang artifacts,
+        deliverable-TFLOP/s and grey-failure counts from node labels.
+        Never fatal to the repair pass — observability must not block
+        remediation."""
+        # setup_with_manager swaps self.client for the CachedReadClient
+        # after construction: re-point the aggregator so its per-pass
+        # ConfigMap/Node lists ride the informer caches, not the wire
+        # (pure reads — unlike the repair manager, nothing here needs
+        # read-your-writes, so cached staleness is harmless)
+        self.fleet_telemetry.client = self.client
+        try:
+            with trace.span("fleet-telemetry"):
+                self.fleet_telemetry.sync()
+        except Exception as e:  # noqa: BLE001
+            log.warning("fleet telemetry sync failed: %s", e)
 
     def reconcile(self, req: Request) -> Result:
         obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)
@@ -454,6 +544,7 @@ class HealthReconciler:
         cp = ClusterPolicy.from_unstructured(obj)
         spec = cp.spec.health_monitor
         interval = float(spec.interval or consts.HEALTH_REPLAN_SECONDS)
+        self._sync_fleet_telemetry()
         if not spec.is_enabled():
             clean = self.repair_manager.remove_repair_labels()
             self._publish_health_status(req.name, {})
@@ -538,7 +629,11 @@ def setup_with_manager(mgr, reconciler: HealthReconciler) -> Controller:
     ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND))
 
     def health_labels_changed(event_type, old, new) -> bool:
-        keys = (consts.TPU_HEALTH_LABEL, consts.REPAIR_STATE_LABEL)
+        keys = (
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
+        )
         if event_type != "MODIFIED" or old is None:
             return any(k in (new["metadata"].get("labels") or {}) for k in keys)
         old_labels = old["metadata"].get("labels") or {}

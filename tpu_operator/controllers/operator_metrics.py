@@ -124,6 +124,40 @@ class OperatorMetrics:
             ["pool"],
             registry=reg,
         )
+        # gang-level data-plane rollups (controllers/fleet_telemetry.py
+        # aggregates the per-gang step-time artifacts the slice manager
+        # publishes, keyed by the placement labels)
+        self.gang_step_seconds = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_gang_step_seconds",
+            "Gang-median workload step time from the last published "
+            "per-gang telemetry artifact",
+            ["slice"],
+            registry=reg,
+        )
+        self.gang_straggler_ratio = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_gang_straggler_ratio",
+            "Slowest gang member's median step over the gang median "
+            "(1.0 = uniform; sustained >1.25 flags a straggler)",
+            ["slice"],
+            registry=reg,
+        )
+        self.fleet_healthy_tflops = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_fleet_healthy_tflops",
+            "Sum of measured-roof bf16 TFLOP/s across chips on nodes "
+            "currently in service (health- and perf-excluded nodes "
+            "subtracted) — the fleet's deliverable compute",
+            registry=reg,
+        )
+        self.perf_degraded_nodes = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_perf_degraded_nodes",
+            "Nodes carrying the exporter's sustained perf-floor-breach "
+            "label (grey failures)",
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

@@ -169,6 +169,7 @@ def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
         keys = (
             consts.TPU_HEALTH_LABEL,
             consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
             consts.TORUS_COORDS_LABEL,
             consts.PLACEMENT_LABEL,
             consts.PLACEMENT_INDEX_LABEL,

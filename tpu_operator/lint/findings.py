@@ -215,6 +215,7 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPUOP-R005": (WARNING, "client call site with unresolvable kind (add a tpuop-lint pragma)"),
     "TPUOP-O001": (ERROR, "metric registered in code but missing from the COMPONENTS.md catalog"),
     "TPUOP-O002": (ERROR, "COMPONENTS.md catalog lists a metric no code registers"),
+    "TPUOP-O003": (ERROR, "PrometheusRule expression references a metric no code registers (the alert can never fire)"),
     "TPUOP-D001": (ERROR, "shipped CRD schema drifted from the dataclass model"),
     "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
     "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),

@@ -8,6 +8,12 @@ every row of that table must correspond to a registered series. Refactors
 that silently drop a series — or docs that advertise one that no longer
 exists — become lint errors instead of dashboard archaeology.
 
+A third direction (TPUOP-O003, ``analyze_rules``): every ``tpu_*``
+series referenced in a shipped PrometheusRule expression must be a
+series some code actually registers. A typo'd metric name in an alert
+expr is the worst kind of bug — the alert silently never fires, and
+nothing else in the system ever evaluates the expression to notice.
+
 The extraction is AST-based (same approach as ``rbac_static``): a call
 whose callee name ends in one of the collector class names and whose
 first positional argument is a matching string literal registers that
@@ -111,6 +117,57 @@ def documented_metrics(components_path: Optional[str] = None) -> Set[str]:
     for token in re.findall(r"`((?:tpu_operator|tpu_exporter)_[a-z0-9_]+)", section):
         names.add(token)
     return names
+
+
+# metric tokens inside a PromQL expression: the same name grammar the
+# registration extraction uses, anchored off identifier context so label
+# values and annotation text never match
+_EXPR_METRIC_RE = re.compile(r"\b((?:tpu_operator|tpu_exporter)_[a-z0-9_]+)\b")
+
+
+def rule_metrics(obj: dict) -> List[Tuple[str, str]]:
+    """(alert name, metric name) pairs referenced by one PrometheusRule
+    object's expressions."""
+    out: List[Tuple[str, str]] = []
+    for group in (obj.get("spec") or {}).get("groups") or []:
+        for rule in group.get("rules") or []:
+            expr = str(rule.get("expr") or "")
+            label = rule.get("alert") or rule.get("record") or "?"
+            for name in _EXPR_METRIC_RE.findall(expr):
+                out.append((label, name))
+    return out
+
+
+def analyze_rules(
+    manifest_groups: List[Tuple[str, List[dict]]],
+    source_root: Optional[str] = None,
+) -> List[Finding]:
+    """TPUOP-O003: every series a shipped PrometheusRule expression
+    references must be registered by code somewhere in the package — a
+    typo'd alert metric silently never fires."""
+    code = set(registered_metrics(source_root))
+    findings: List[Finding] = []
+    seen: set = set()
+    for group, objects in manifest_groups:
+        for obj in objects:
+            if obj.get("kind") != "PrometheusRule":
+                continue
+            rule_name = (obj.get("metadata") or {}).get("name", "?")
+            for alert, metric in rule_metrics(obj):
+                if metric in code:
+                    continue
+                key = (group, rule_name, alert, metric)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(make(
+                    "TPUOP-O003", ERROR,
+                    f"{group}:PrometheusRule/{rule_name}:{alert}",
+                    f"alert expression references `{metric}` but no code "
+                    "registers that series — the alert can never fire "
+                    "(typo, or the metric was renamed/dropped)",
+                ))
+    return findings
 
 
 def analyze(

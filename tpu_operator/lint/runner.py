@@ -92,8 +92,9 @@ def run_lint(
     Returns every finding (suppressed ones marked, not dropped)."""
     selected = set(only or ANALYZERS)
     findings: List[Finding] = []
+    groups = manifest_groups() if selected & {"manifest", "metrics"} else []
     if "manifest" in selected:
-        for group, objects in manifest_groups():
+        for group, objects in groups:
             findings.extend(manifest_rules.lint_group(group, objects))
     if "rbac" in selected:
         findings.extend(rbac_static.analyze())
@@ -101,6 +102,9 @@ def run_lint(
         findings.extend(drift.analyze())
     if "metrics" in selected:
         findings.extend(metrics_catalog.analyze())
+        # O003 rides the same rendered groups the manifest rules lint:
+        # every series a shipped PrometheusRule references must exist
+        findings.extend(metrics_catalog.analyze_rules(groups))
     findings = dedupe(findings)
 
     baseline = Baseline.load(

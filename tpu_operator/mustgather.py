@@ -172,6 +172,51 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("placement.txt", f"# collection failed: {e}\n")
 
     try:
+        # the data-plane telemetry view: fleet rollup (per-node perf
+        # labels + generation/chips), the operator-published floor
+        # table, and every gang's step-time artifact — where "why is
+        # this gang slow" starts (README: Diagnosing a slow gang)
+        from tpu_operator import consts as _consts
+
+        lines = ["# fleet perf"]
+        fleet = []
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if _consts.TPU_PRESENT_LABEL not in labels and _consts.TPU_PERF_LABEL not in labels:
+                continue
+            fleet.append(
+                f"{node['metadata']['name']}  "
+                f"perf={labels.get(_consts.TPU_PERF_LABEL, '-')}  "
+                f"health={labels.get(_consts.TPU_HEALTH_LABEL, '-')}  "
+                f"repair={labels.get(_consts.REPAIR_STATE_LABEL, '-')}  "
+                f"generation={labels.get(_consts.TFD_TPU_GENERATION_LABEL, '-')}  "
+                f"chips={labels.get(_consts.TFD_CHIPS_PER_NODE_LABEL, '-')}"
+            )
+        lines.extend(fleet or ["# none"])
+        lines.append("")
+        lines.append("# perf floors (operator-published)")
+        floors_cm = client.get_or_none(
+            "v1", "ConfigMap", _consts.PERF_FLOORS_CONFIGMAP, namespace
+        )
+        if floors_cm is not None:
+            lines.append((floors_cm.get("data") or {}).get(_consts.PERF_FLOORS_KEY, "# empty"))
+        else:
+            lines.append("# not published")
+        lines.append("")
+        lines.append("# gang step-time artifacts")
+        gangs = []
+        for cm in client.list("v1", "ConfigMap", namespace):
+            raw = (cm["metadata"].get("annotations") or {}).get(
+                _consts.GANG_TELEMETRY_ANNOTATION
+            )
+            if raw:
+                gangs.append(f"{cm['metadata']['name']}  {raw}")
+        lines.extend(gangs or ["# none"])
+        emit("telemetry.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("telemetry.txt", f"# collection failed: {e}\n")
+
+    try:
         # cluster-wide: events for cluster-scoped objects (the CRs) land
         # in "default" per apiserver rules, not the operator namespace
         events = client.list("v1", "Event")

@@ -1,0 +1,109 @@
+"""Per-generation performance roofs and floors.
+
+The measured calibration layer between "published peak" and "alert
+threshold". BENCH measured real sustained rates on v5e (185 bf16
+TFLOP/s of a 197 published peak — 94% MXU utilization — and 665 GB/s
+pallas-triad HBM bandwidth); other generations scale those measured
+fractions onto their published peaks until someone benches them for
+real. The floors the operator publishes (``default_floors``) sit at
+``FLOOR_FRACTION`` of the measured roof: low enough that multi-tenant
+jitter never trips them, high enough that a chip delivering 70% of what
+its generation demonstrably sustains is a grey failure, not noise.
+
+Consumers:
+  - the perf-floors ConfigMap the pre-requisites state renders
+    (``consts.PERF_FLOORS_CONFIGMAP``), read by the metrics exporter
+    (grey-failure detection) and the validator (minTflops fallback);
+  - ``controllers/fleet_telemetry`` (healthy-fleet TFLOP/s rollup);
+  - the ROADMAP's capacity planner, which calibrates its analytical
+    model against these same measured numbers.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+# published dense bf16 peak TFLOP/s per chip. Deliberately a copy of
+# workloads.matmul_bench.PEAK_TFLOPS rather than an import: that module
+# imports jax at module scope and this one is loaded operator-side (the
+# render path has no accelerator runtime). tests/test_telemetry.py pins
+# the two tables equal.
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+# measured on the v5e relay chip (BENCH rounds 3-6): sustained bf16
+# matmul and pallas-triad HBM bandwidth under the two-point timing
+# estimator — the numbers the utilization gauges already report against
+MEASURED_V5E_TFLOPS = 185.0
+MEASURED_V5E_TRIAD_GBPS = 665.0
+
+# published HBM bandwidth per chip (GB/s) — scaled by the measured v5e
+# triad fraction to estimate an achievable roof per generation
+_PEAK_HBM_GBPS = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1638.0}
+
+# the fraction of published peak the v5e measurements demonstrated;
+# applied to every generation's published numbers to seed its roof
+_MXU_FRACTION = MEASURED_V5E_TFLOPS / PEAK_TFLOPS["v5e"]
+_HBM_FRACTION = MEASURED_V5E_TRIAD_GBPS / _PEAK_HBM_GBPS["v5e"]
+
+# floor = this fraction of the measured/derived roof: a sustained 30%
+# shortfall against what the generation demonstrably sustains is a grey
+# failure (the --telemetry-smoke scenario), not multi-tenant jitter
+FLOOR_FRACTION = 0.7
+
+
+def measured_roofs() -> Dict[str, Dict[str, float]]:
+    """Per-generation achievable roofs: measured on v5e, measured-
+    fraction-scaled published peaks elsewhere."""
+    roofs: Dict[str, Dict[str, float]] = {}
+    for gen in PEAK_TFLOPS:
+        roofs[gen] = {
+            "matmul_tflops": round(PEAK_TFLOPS[gen] * _MXU_FRACTION, 1),
+            "triad_gbps": round(_PEAK_HBM_GBPS[gen] * _HBM_FRACTION, 1),
+        }
+    # the one generation with real measurements keeps them exactly
+    roofs["v5e"] = {
+        "matmul_tflops": MEASURED_V5E_TFLOPS,
+        "triad_gbps": MEASURED_V5E_TRIAD_GBPS,
+    }
+    return roofs
+
+
+def default_floors() -> Dict[str, Dict[str, float]]:
+    """The floors the operator publishes: FLOOR_FRACTION of each roof."""
+    return {
+        gen: {probe: round(value * FLOOR_FRACTION, 1) for probe, value in roof.items()}
+        for gen, roof in measured_roofs().items()
+    }
+
+
+def floors_json() -> str:
+    """The ConfigMap's floors.json payload (sorted for stable renders)."""
+    return json.dumps(default_floors(), sort_keys=True)
+
+
+def floors_for(generation: str, floors_blob: Optional[str] = None) -> Dict[str, float]:
+    """The floor map for one generation, from a floors.json blob (env /
+    ConfigMap) falling back to the built-in defaults; {} when the
+    generation is unknown or the blob is malformed (no floor -> no
+    grey-failure detection, never a crash-looping exporter)."""
+    table: Dict[str, Dict[str, float]] = {}
+    if floors_blob:
+        try:
+            parsed = json.loads(floors_blob)
+            if isinstance(parsed, dict):
+                table = parsed
+        except (ValueError, TypeError):
+            table = {}
+    if not table:
+        table = default_floors()
+    entry = table.get(generation)
+    if not isinstance(entry, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for probe, value in entry.items():
+        try:
+            out[str(probe)] = float(value)
+        except (TypeError, ValueError):
+            continue
+    return out

@@ -66,10 +66,16 @@ def _labels(node: ObjectDict) -> dict:
 def labels_unavailable(labels: dict) -> bool:
     """The health-subsystem exclusion predicate, shared with the slice
     manager so the two can never disagree about who is in a gang: a node
-    mid-repair (any repair FSM state, incl. terminal quarantine) or
-    flagged degraded is out of service."""
-    return bool(labels.get(consts.REPAIR_STATE_LABEL)) or (
-        labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_DEGRADED
+    mid-repair (any repair FSM state, incl. terminal quarantine),
+    flagged degraded, or carrying the exporter's sustained perf-floor
+    breach is out of service. The perf clause is the grey-failure path:
+    a slow-but-alive chip gates every peer's collectives, so it leaves
+    the gang (and is never a placement candidate) the same way a dead
+    one does."""
+    return (
+        bool(labels.get(consts.REPAIR_STATE_LABEL))
+        or labels.get(consts.TPU_HEALTH_LABEL) == consts.HEALTH_DEGRADED
+        or labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED
     )
 
 

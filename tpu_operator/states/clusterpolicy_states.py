@@ -74,11 +74,18 @@ def build_render_data(catalog: InfoCatalog) -> dict:
     spec = catalog.cluster_policy.spec
     ds = spec.daemonsets
     sm_enabled = spec.metrics_exporter.service_monitor.is_enabled()
+    from tpu_operator.perf import default_floors, floors_json
+
     return {
         "namespace": catalog.namespace,
         "runtime": catalog.runtime,
         "tpu_resource": consts.TPU_RESOURCE_NAME,
         "validation_dir": consts.VALIDATION_DIR,
+        # per-generation perf floors (pre-requisites renders the
+        # ConfigMap; exporter + validator DaemonSets reference it)
+        "perf_floors_configmap": consts.PERF_FLOORS_CONFIGMAP,
+        "perf_floors": default_floors(),
+        "perf_floors_json": floors_json(),
         "libtpu_ready_file": consts.LIBTPU_READY_FILE,
         "plugin_ready_file": consts.PLUGIN_READY_FILE,
         "workload_ready_file": consts.WORKLOAD_READY_FILE,

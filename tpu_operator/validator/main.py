@@ -62,7 +62,13 @@ class Context:
             install_dir=os.environ.get("LIBTPU_INSTALL_DIR", consts.LIBTPU_INSTALL_DIR),
             validator_image=os.environ.get("VALIDATOR_IMAGE", ""),
             expected_chips=int(os.environ["EXPECTED_CHIPS"]) if os.environ.get("EXPECTED_CHIPS") else None,
-            min_tflops=_float_env("MIN_TFLOPS"),
+            # `is None`, not `or`: an explicit MIN_TFLOPS=0 means "floor
+            # disabled" and must not fall through to the published table
+            min_tflops=(
+                _float_env("MIN_TFLOPS")
+                if os.environ.get("MIN_TFLOPS", "").strip()
+                else _floor_tflops_from_env()
+            ),
             min_psum_gbps_per_chip=_float_env("MIN_PSUM_GBPS_PER_CHIP"),
         )
 
@@ -75,6 +81,28 @@ def _float_env(name: str) -> Optional[float]:
         return float(raw)
     except ValueError:
         log.warning("invalid %s %r; floor disabled", name, raw)
+        return None
+
+
+def _floor_tflops_from_env() -> Optional[float]:
+    """minTflops fallback: the operator-published per-generation floor
+    table (PERF_FLOORS_JSON via the perf-floors ConfigMap — the same
+    floors the exporter's grey-failure detection holds probes to), keyed
+    by this node's runtime generation. None off-TPU, when unset, or on
+    an unrecognized generation: the floor never guesses."""
+    blob = os.environ.get("PERF_FLOORS_JSON", "").strip()
+    if not blob:
+        return None
+    try:
+        from tpu_operator.perf import floors_for
+        from tpu_operator.workloads.matmul_bench import chip_generation
+
+        gen = chip_generation()
+        if not gen:
+            return None
+        return floors_for(gen, blob).get("matmul_tflops")
+    except Exception as e:  # noqa: BLE001 — a bad table disables, never fails
+        log.warning("perf-floor fallback unavailable: %s", e)
         return None
 
 

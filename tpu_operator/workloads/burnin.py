@@ -428,21 +428,49 @@ def build_train_step(mesh: Mesh, cfg: Optional[BurninConfig] = None):
     return step_sharded, params, batch
 
 
-def run_burnin(mesh: Optional[Mesh] = None, steps: int = 3, cfg: Optional[BurninConfig] = None) -> dict:
-    """Run a few train steps; loss must be finite and decreasing-ish."""
+def run_burnin(
+    mesh: Optional[Mesh] = None,
+    steps: int = 3,
+    cfg: Optional[BurninConfig] = None,
+    record_telemetry: bool = False,
+    telemetry_host: str = "",
+) -> dict:
+    """Run a few train steps; loss must be finite and decreasing-ish.
+    ``record_telemetry`` attaches a per-step timing report (compile vs
+    execute split, jitter percentiles, achieved TFLOP/s) — the data-
+    plane observability layer (workloads/telemetry.py)."""
     mesh = mesh or make_mesh()
     cfg = cfg or BurninConfig()
     step, params, batch = build_train_step(mesh, cfg)
+    recorder = None
+    if record_telemetry:
+        from tpu_operator.workloads.telemetry import (
+            StepTimeRecorder,
+            burnin_flops_per_step,
+        )
+
+        recorder = StepTimeRecorder(
+            flops_per_step=burnin_flops_per_step(cfg), host=telemetry_host
+        )
     losses = []
     for _ in range(steps):
-        params, loss = step(params, batch)
-        losses.append(float(loss))
+        if recorder is not None:
+            with recorder.step():
+                params, loss = step(params, batch)
+                loss = float(loss)  # force inside the timed region
+        else:
+            params, loss = step(params, batch)
+            loss = float(loss)
+        losses.append(loss)
     if not all(np.isfinite(losses)):
         raise RuntimeError(f"non-finite loss during burn-in: {losses}")
     if steps >= 2 and not losses[-1] < losses[0]:
         raise RuntimeError(f"loss failed to decrease: {losses}")
-    return {
+    result = {
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
         "losses": losses,
         "ok": True,
     }
+    if recorder is not None:
+        result["telemetry"] = recorder.report().to_dict()
+    return result

@@ -109,6 +109,29 @@ def _worker_checks() -> dict:
     float(chain(arr_x))
     psum_chain_ms = (time.perf_counter() - t0) / iters * 1e3
 
+    # per-host step telemetry over LOCAL compute only: a step containing
+    # a cross-process collective completes for every host when the
+    # slowest finishes, so chain-timed medians are gang-gated and the
+    # merged straggler ratio would read ~1.0 by construction. A local
+    # jitted matmul chain decouples the hosts — each report measures the
+    # host's OWN speed, which is exactly what merge_gang_reports needs
+    from tpu_operator.workloads.telemetry import StepTimeRecorder
+
+    local_x = jnp.ones((256, 256), jnp.float32)
+
+    @partial(jax.jit, static_argnames="n")
+    def local_chain(a, n):
+        def body(i, acc):
+            return acc @ a / jnp.float32(256.0)
+
+        return jax.lax.fori_loop(0, n, body, a).sum()
+
+    recorder = StepTimeRecorder(host=f"worker-{cfg.process_id}")
+    for _ in range(4):
+        with recorder.step():
+            float(local_chain(local_x, 32))
+    telemetry = recorder.report()
+
     # --- ring attention with 'sp' spanning processes --------------------
     b, s_local, h, d = 1, 8, 2, 8
     s_global = s_local * total
@@ -151,6 +174,7 @@ def _worker_checks() -> dict:
         "psum_want": want,
         "psum_ok": psum_ok,
         "psum_chain_ms": psum_chain_ms,
+        "step_telemetry": telemetry.to_dict(),
         "ring_attention_max_err": ring_err,
         "ok": bool(psum_ok and ring_err < 1e-4),
     }
@@ -254,7 +278,7 @@ def _launch_workers(worker_envs, devices_per_worker: int, timeout: float):
 
 
 def _summarize(workers, devices_per_worker: int) -> dict:
-    return {
+    summary = {
         "num_workers": len(workers),
         "devices_per_worker": devices_per_worker,
         "global_devices": workers[0]["global_devices"],
@@ -264,6 +288,19 @@ def _summarize(workers, devices_per_worker: int) -> dict:
         "workers": workers,
         "ok": True,
     }
+    # the gang step-time artifact: per-host timing merged into gang
+    # median + straggler ratio (the shape the slice manager publishes
+    # onto the gang ConfigMap and the fleet rollup reads back)
+    per_host = {
+        w["step_telemetry"].get("host", f"worker-{i}"): w["step_telemetry"]
+        for i, w in enumerate(workers)
+        if w.get("step_telemetry")
+    }
+    if per_host:
+        from tpu_operator.workloads.telemetry import merge_gang_reports
+
+        summary["gang_telemetry"] = merge_gang_reports(per_host)
+    return summary
 
 
 def run_multiprocess_check(

@@ -1,0 +1,271 @@
+"""Workload step-time telemetry: the data-plane observability layer.
+
+The control plane has a flight recorder (kube/trace.py); this is the
+same idea for the layer the operator exists to run. A
+``StepTimeRecorder`` wraps any stepped workload (burn-in train steps,
+bench chains, a gang worker's collective loop) and produces one
+structured report per host:
+
+  - per-step wall time with the compile-vs-execute split (the first
+    call of a jitted program carries XLA compilation; folding it into
+    the step distribution would poison every percentile),
+  - jitter percentiles (p50 / p99 / max) over the executed steps,
+  - achieved TFLOP/s when the caller declares FLOPs per step.
+
+Per-host reports merge into a *gang* artifact (``merge_gang_reports``):
+gang-median step time, per-host medians, and the straggler ratio —
+slowest host median over gang median — the number that finds the
+slow-but-alive chip "Exploration of TPUs for AI Applications" frames as
+the real fleet-resilience problem. The slice manager publishes the
+artifact onto the gang ConfigMap (``consts.GANG_TELEMETRY_ANNOTATION``)
+and the operator's fleet aggregation reads it back into
+``tpu_operator_gang_step_seconds{slice}`` /
+``tpu_operator_gang_straggler_ratio{slice}``.
+
+Reports also publish as node-local Prometheus series
+(``publish_prometheus``) so a single host's step-time history is
+scrapeable without the gang rollup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, int(round(q * (len(sorted_values) - 1)))))
+    return sorted_values[idx]
+
+
+@dataclasses.dataclass
+class StepTimeReport:
+    steps: int
+    compile_s: float  # first (compiling) call, separated from the steps
+    step_p50_s: float
+    step_p99_s: float
+    step_max_s: float
+    step_mean_s: float
+    total_s: float
+    tflops: Optional[float] = None  # achieved, when flops_per_step known
+    host: str = ""
+
+    def to_dict(self) -> dict:
+        out = {
+            "steps": self.steps,
+            "compile_s": round(self.compile_s, 6),
+            "step_p50_s": round(self.step_p50_s, 6),
+            "step_p99_s": round(self.step_p99_s, 6),
+            "step_max_s": round(self.step_max_s, 6),
+            "step_mean_s": round(self.step_mean_s, 6),
+            "total_s": round(self.total_s, 6),
+        }
+        if self.tflops is not None:
+            out["tflops"] = round(self.tflops, 2)
+        if self.host:
+            out["host"] = self.host
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "StepTimeReport":
+        return cls(
+            steps=int(data.get("steps", 0)),
+            compile_s=float(data.get("compile_s", 0.0)),
+            step_p50_s=float(data.get("step_p50_s", 0.0)),
+            step_p99_s=float(data.get("step_p99_s", 0.0)),
+            step_max_s=float(data.get("step_max_s", 0.0)),
+            step_mean_s=float(data.get("step_mean_s", 0.0)),
+            total_s=float(data.get("total_s", 0.0)),
+            tflops=float(data["tflops"]) if data.get("tflops") is not None else None,
+            host=str(data.get("host", "")),
+        )
+
+
+class StepTimeRecorder:
+    """Records one stepped run. Either drive it explicitly::
+
+        rec = StepTimeRecorder(flops_per_step=f)
+        with rec.step():           # first step = compile + execute
+            params, loss = step(params, batch)
+
+    or hand it the whole loop via :meth:`run`. The first recorded step
+    is booked as compile time (jit caches make every later call pure
+    execution); percentiles cover only the executed steps.
+    """
+
+    def __init__(self, flops_per_step: Optional[float] = None, host: str = ""):
+        self.flops_per_step = flops_per_step
+        self.host = host
+        self._durations: List[float] = []
+        self._t0: Optional[float] = None
+
+    class _StepCtx:
+        def __init__(self, rec: "StepTimeRecorder"):
+            self._rec = rec
+
+        def __enter__(self):
+            self._start = time.perf_counter()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc is None:
+                self._rec._durations.append(time.perf_counter() - self._start)
+            return False
+
+    def step(self) -> "StepTimeRecorder._StepCtx":
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        return self._StepCtx(self)
+
+    def run(self, step_fn: Callable[[], None], steps: int) -> StepTimeReport:
+        """Time ``steps`` calls of ``step_fn`` (which must force its own
+        result — an unforced async dispatch would time the enqueue)."""
+        for _ in range(steps):
+            with self.step():
+                step_fn()
+        return self.report()
+
+    def report(self) -> StepTimeReport:
+        if not self._durations:
+            raise RuntimeError("no steps recorded")
+        compile_s = self._durations[0]
+        executed = self._durations[1:] or self._durations[:1]
+        ordered = sorted(executed)
+        mean = sum(executed) / len(executed)
+        tflops = None
+        if self.flops_per_step and mean > 0:
+            tflops = self.flops_per_step / mean / 1e12
+        return StepTimeReport(
+            steps=len(self._durations),
+            compile_s=compile_s,
+            step_p50_s=_percentile(ordered, 0.50),
+            step_p99_s=_percentile(ordered, 0.99),
+            step_max_s=ordered[-1],
+            step_mean_s=mean,
+            total_s=sum(self._durations),
+            tflops=tflops,
+            host=self.host,
+        )
+
+
+# ---------------------------------------------------------------------------
+# gang merge
+# ---------------------------------------------------------------------------
+
+
+def merge_gang_reports(reports: Dict[str, dict]) -> dict:
+    """Merge per-host step reports into the gang artifact the slice
+    manager publishes. ``reports`` maps host name -> report dict
+    (``StepTimeReport.to_dict`` shape). The straggler ratio is the
+    slowest host's median step over the gang median of host medians —
+    1.0 for a uniform gang, >1 when one host drags the collective (in a
+    gang every host's step time is gated by the slowest member's, so
+    the artifact keys off each host's OWN median, which the per-host
+    recorders measured before the collectives coupled them, or which a
+    post-mortem merge reads from their independent runs)."""
+    if not reports:
+        raise ValueError("no per-host reports to merge")
+    medians = {host: float(r.get("step_p50_s", 0.0)) for host, r in reports.items()}
+    ordered = sorted(medians.values())
+    gang_median = _percentile(ordered, 0.50)
+    slowest_host = max(medians, key=lambda h: medians[h])
+    straggler_ratio = (
+        medians[slowest_host] / gang_median if gang_median > 0 else 1.0
+    )
+    tflops = [
+        float(r["tflops"]) for r in reports.values() if r.get("tflops") is not None
+    ]
+    artifact = {
+        "hosts": len(reports),
+        "gang_step_p50_s": round(gang_median, 6),
+        "gang_step_max_s": round(ordered[-1], 6),
+        "straggler_ratio": round(straggler_ratio, 3),
+        "slowest_host": slowest_host,
+        "per_host_step_p50_s": {h: round(m, 6) for h, m in sorted(medians.items())},
+    }
+    if tflops:
+        artifact["gang_tflops"] = round(sum(tflops), 2)
+    return artifact
+
+
+# ---------------------------------------------------------------------------
+# prometheus publication (node-local series, exporter-owned names)
+# ---------------------------------------------------------------------------
+
+_STEP_STATS = ("p50", "p99", "max")
+
+
+def publish_prometheus(report: StepTimeReport, node: str, registry=None) -> dict:
+    """Publish one host report as Prometheus series on ``registry``
+    (default: the process registry). Registration is idempotent — the
+    same ``_get_or_create`` contract as ``OperatorMetrics`` — so every
+    workload run re-publishing into a long-lived exporter registry
+    reuses the collectors. Returns the collectors for callers that keep
+    publishing."""
+    import prometheus_client
+
+    from tpu_operator.controllers.operator_metrics import _get_or_create
+
+    reg = registry or prometheus_client.REGISTRY
+    step_seconds = _get_or_create(
+        prometheus_client.Gauge,
+        "tpu_exporter_workload_step_seconds",
+        "Workload step wall time (stat: p50/p99/max over the last run)",
+        ["node", "stat"],
+        registry=reg,
+    )
+    compile_seconds = _get_or_create(
+        prometheus_client.Gauge,
+        "tpu_exporter_workload_compile_seconds",
+        "First-step compile time of the last workload run",
+        ["node"],
+        registry=reg,
+    )
+    workload_tflops = _get_or_create(
+        prometheus_client.Gauge,
+        "tpu_exporter_workload_tflops",
+        "Achieved workload TFLOP/s over the last run's executed steps",
+        ["node"],
+        registry=reg,
+    )
+    for stat, value in zip(
+        _STEP_STATS, (report.step_p50_s, report.step_p99_s, report.step_max_s)
+    ):
+        step_seconds.labels(node, stat).set(value)
+    compile_seconds.labels(node).set(report.compile_s)
+    if report.tflops is not None:
+        workload_tflops.labels(node).set(report.tflops)
+    return {
+        "step_seconds": step_seconds,
+        "compile_seconds": compile_seconds,
+        "tflops": workload_tflops,
+    }
+
+
+# ---------------------------------------------------------------------------
+# workload FLOP estimates
+# ---------------------------------------------------------------------------
+
+
+def burnin_flops_per_step(cfg) -> float:
+    """Approximate FLOPs of one burn-in train step: 6 x params x tokens
+    (fwd 2, bwd 4 — the standard dense-transformer estimate), attention
+    quadratic term included. Good to ~10%, which is all an achieved-rate
+    gauge needs."""
+    d, f, s, b = cfg.d_model, cfg.d_ff, cfg.seq_len, cfg.batch
+    head = d // cfg.n_heads
+    # qkv + proj + FFN; top-1 MoE routing runs ONE expert's FFN per
+    # token, so the per-token compute matches the dense FFN's
+    per_layer_params = d * cfg.qkv_width + d * d + 2 * d * f
+    params = cfg.n_layers * per_layer_params
+    tokens = b * s
+    dense = 6.0 * params * tokens
+    # attention scores + context: 2 x (2 b s^2 h d_head) fwd, x3 with
+    # bwd — per QUERY head (every query head attends the full sequence;
+    # GQA shrinks the KV projections above, not the attention math)
+    attn = cfg.n_layers * 6.0 * 2.0 * b * s * s * cfg.n_heads * head
+    return dense + attn
