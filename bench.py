@@ -822,6 +822,204 @@ def telemetry_block() -> dict:
     return out
 
 
+def fabric_block() -> dict:
+    """The fabric probe measured for real on the virtual 8-device mesh:
+    per-edge transfer bandwidth and the per-axis allreduce latency
+    matrix of a 2x4x1 block with wrap links — mechanical numbers on
+    CPU, physical ones on a slice; either way the sweep itself (edge
+    enumeration, shard_map axis collectives, numerics check) runs."""
+    try:
+        from tpu_operator.workloads.fabric import run_fabric_probe
+
+        probe = run_fabric_probe("2x4x1", wrap=True, size_mb=0.5, iters=3)
+        bws = sorted(m["bw_gbps"] for m in probe["edges"].values())
+        return {
+            "shape": probe["shape"],
+            "platform": probe["platform"],
+            "edges": len(probe["edges"]),
+            "min_edge_gbps": bws[0],
+            "median_edge_gbps": bws[len(bws) // 2],
+            "axis_allreduce_us": probe["axis_allreduce_us"],
+        }
+    except Exception as e:  # noqa: BLE001 — best-effort like every detail
+        return {"error": str(e)[-300:]}
+
+
+def fabric_smoke() -> int:
+    """CI gate (scripts/ci.sh): edge-aware blame end to end on a seeded
+    sim — the decision the fabric layer exists to make. A placed 8-host
+    gang publishes a fabric matrix with one degraded edge; the gate
+    demands:
+
+    1. the analyzer localizes the LINK (records it in the link-health
+       map; neither endpoint host is labelled or cordoned),
+    2. the straddling gang re-places AROUND the cut edge — and both
+       endpoint hosts remain schedulable (one may well stay in the
+       gang; only the pairing is forbidden),
+    3. a second matrix with multiple degraded edges sharing one
+       endpoint indicts the HOST: perf label set, grey-failure FSM
+       entered, gang re-places off it,
+    4. the ``tpu_operator_ici_link_*`` series are live on the scrape
+       endpoint, and a drained pool takes its series away.
+    """
+    import prometheus_client
+
+    from tpu_operator import consts as _consts
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, new_tpu_slice
+    from tpu_operator.controllers.health_controller import HealthReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import make_torus_nodes
+    from tpu_operator.placement.engine import PlacementPhase
+    from tpu_operator.workloads.fabric import (
+        edge_key,
+        enumerate_block_edges,
+        gang_fabric_artifact,
+    )
+    from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+
+    ns = "tpu-operator"
+    store = FakeClient()
+    checks: dict = {}
+
+    # a 16-host v4 pool; the gang needs 8, so re-placing around a cut
+    # edge (and later off a blamed host) always has somewhere to go
+    for node in make_torus_nodes((4, 4, 1), prefix="fab"):
+        node["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    store.create(new_cluster_policy(spec={
+        "healthMonitor": {
+            "interval": 1,
+            "remediation": {"enable": True, "retryLimit": 3,
+                            "timeoutSeconds": 300, "gracePeriodSeconds": 0},
+        },
+    }))
+    store.create(new_tpu_slice("fab-gang", {"placement": {"shape": "2x4x1"}}))
+
+    placement = PlacementReconciler(store, ns)
+    placement.reconcile(QUEUE_REQUEST)
+    slice_mgr = SliceManagerAgent(store, ns)
+    slice_mgr.reconcile_once()
+
+    def gang() -> tuple:
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "fab-gang")
+        st = (ts.get("status") or {}).get("placement") or {}
+        return list(st.get("nodes") or []), st.get("phase")
+
+    def publish_matrix(hosts, slow_edges) -> dict:
+        """A synthetic fabric matrix over the CURRENT block: uniform
+        40 GB/s with the named host-pair edges at a tenth of that."""
+        edges = {}
+        for at, to, axis, wrap in enumerate_block_edges((2, 4, 1), wrap=True):
+            key = edge_key("-".join(map(str, at)), "-".join(map(str, to)))
+            edges[key] = {"bw_gbps": 40.0, "axis": axis, "wrap": wrap}
+        probe = {"shape": "2x4x1", "edges": edges, "axis_allreduce_us": {"y": 210.0}}
+        artifact = gang_fabric_artifact(probe, hosts)
+        for edge in slow_edges:
+            artifact["edges"][edge]["bw_gbps"] = 4.0
+        ordered = sorted(artifact["edges"].items(), key=lambda kv: kv[1]["bw_gbps"])
+        artifact["worst_edge"] = ordered[0][0]
+        assert slice_mgr.publish_gang_fabric("tpu-slice-fab-gang", artifact)
+        return artifact
+
+    members, phase = gang()
+    checks["placed"] = phase == PlacementPhase.SCHEDULED and len(members) == 8
+
+    # scenario 1: ONE degraded edge -> link blame, re-place around it
+    # (workers 0 and 2 of a 2x4x1 block are y-axis torus neighbors)
+    cut_a, cut_b = members[0], members[2]
+    cut_edge = "|".join(sorted((cut_a, cut_b)))
+    artifact = publish_matrix(members, [cut_edge])
+    health = HealthReconciler(store, ns)
+    req = Request(name="cluster-policy")
+    health.reconcile(req)
+
+    link_cm = store.get_or_none("v1", "ConfigMap", _consts.LINK_HEALTH_CONFIGMAP, ns)
+    recorded = json.dumps((link_cm or {}).get("data") or {})
+    checks["link_blamed"] = cut_edge in recorded
+
+    def in_service(name: str) -> bool:
+        node = store.get("v1", "Node", name)
+        labels = node["metadata"].get("labels") or {}
+        return (
+            not node.get("spec", {}).get("unschedulable")
+            and labels.get(_consts.TPU_PERF_LABEL) is None
+            and not labels.get(_consts.REPAIR_STATE_LABEL)
+        )
+
+    checks["endpoints_in_service"] = in_service(cut_a) and in_service(cut_b)
+
+    placement.reconcile(QUEUE_REQUEST)
+    slice_mgr.reconcile_once()
+    members2, phase2 = gang()
+    checks["replaced_around_link"] = (
+        phase2 == PlacementPhase.SCHEDULED
+        and len(members2) == 8
+        and not (cut_a in members2 and cut_b in members2)
+    )
+    checks["endpoints_schedulable_after"] = in_service(cut_a) and in_service(cut_b)
+    events = [e.get("reason") for e in store.list("v1", "Event")]
+    checks["link_event"] = "IciLinkDegraded" in events
+
+    # scenario 2: multiple degraded edges sharing one endpoint -> HOST
+    # blame, grey-failure FSM entry, gang re-places off the host
+    victim = members2[1]  # worker 1: has x edge to 0 and y edge to 3
+    peers = [m for m in (members2[0], members2[3]) if m != victim]
+    slow = ["|".join(sorted((victim, p))) for p in peers]
+    publish_matrix(members2, slow)
+    health.reconcile(req)
+    victim_labels = store.get("v1", "Node", victim)["metadata"].get("labels") or {}
+    checks["host_blamed"] = (
+        victim_labels.get(_consts.TPU_PERF_LABEL) == _consts.PERF_DEGRADED
+    )
+    health.reconcile(req)  # FSM entry pass
+    victim_labels = store.get("v1", "Node", victim)["metadata"].get("labels") or {}
+    checks["fsm_entered"] = bool(victim_labels.get(_consts.REPAIR_STATE_LABEL))
+    events = [e.get("reason") for e in store.list("v1", "Event")]
+    checks["host_event"] = "IciHostDegraded" in events
+
+    placement.reconcile(QUEUE_REQUEST)
+    members3, phase3 = gang()
+    checks["replaced_off_host"] = (
+        phase3 == PlacementPhase.SCHEDULED
+        and len(members3) == 8
+        and victim not in members3
+    )
+
+    scrape = prometheus_client.generate_latest(prometheus_client.REGISTRY).decode()
+    checks["series_present"] = (
+        "tpu_operator_ici_link_bandwidth_gbps" in scrape
+        and "tpu_operator_ici_link_degraded" in scrape
+    )
+
+    # drain the pool: every node goes, and the series must go with it
+    for node in store.list("v1", "Node"):
+        store.delete("v1", "Node", node["metadata"]["name"])
+    health.reconcile(req)
+    scrape = prometheus_client.generate_latest(prometheus_client.REGISTRY).decode()
+    checks["series_removed_on_drain"] = (
+        "tpu_operator_ici_link_bandwidth_gbps{" not in scrape
+    )
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "fabric_smoke",
+        "ok": ok,
+        "cut_edge": cut_edge,
+        "blamed_host": victim,
+        "gang_initial": members,
+        "gang_after_link": members2,
+        "gang_after_host": members3,
+        "checks": checks,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def telemetry_smoke() -> int:
     """CI gate (scripts/ci.sh): the grey-failure pipeline end to end on a
     seeded sim. One gang member's matmul probe runs 30% below the
@@ -1202,6 +1400,8 @@ def main() -> None:
         raise SystemExit(trace_smoke())
     if "--telemetry-smoke" in sys.argv[1:]:
         raise SystemExit(telemetry_smoke())
+    if "--fabric-smoke" in sys.argv[1:]:
+        raise SystemExit(fabric_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -1274,6 +1474,9 @@ def main() -> None:
     # data-plane step-time telemetry: burn-in under the recorder +
     # the live gang's merged artifact (gated by --telemetry-smoke)
     telemetry = telemetry_block()
+    # ICI fabric sweep: per-edge transfer timing + per-axis allreduce
+    # latency on the virtual mesh (gated by --fabric-smoke)
+    fabric = fabric_block()
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -1302,6 +1505,7 @@ def main() -> None:
         "chaos": chaos_block,
         "placement": placement_block,
         "telemetry": telemetry,
+        "fabric": fabric,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

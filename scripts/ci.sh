@@ -46,6 +46,13 @@ echo "== telemetry smoke: grey failure detected, remediated, gang re-placed =="
 # gang artifact, drive the health FSM cordon->revalidate, re-place the
 # gang off the slow host, and leave every new series on the endpoints
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --telemetry-smoke
+echo "== fabric smoke: degraded edge blamed on the link, gang re-places around it =="
+# edge-aware blame gate: a seeded single-edge degradation must be
+# attributed to the LINK (recorded in the link-health map, both endpoint
+# hosts stay schedulable, the gang re-places around the cut) and a
+# multi-edge-one-endpoint degradation to the HOST (perf label -> FSM);
+# the tpu_operator_ici_link_* series must live and die with their pool
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --fabric-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

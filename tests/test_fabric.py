@@ -1,0 +1,624 @@
+"""ICI fabric observability tests (ISSUE 9 tentpole).
+
+Four layers under test:
+  1. the fabric probe (workloads/fabric.py): edge enumeration over
+     block shapes (wrap vs mesh), the real shard_map/psum sweep on the
+     virtual 8-device mesh, and the coordinate→host translation of
+     ``gang_fabric_artifact``,
+  2. the fabric analyzer (controllers/fabric_telemetry.py): degraded-
+     edge detection against the gang median, LINK blame (recorded map,
+     endpoints stay in service) vs HOST blame (perf label → grey-
+     failure FSM), stale-artifact rejection, record clearing on a
+     healthy re-measure, and series lifecycle incl. pool drain,
+  3. edge-aware placement: a cut edge blocks straddling candidates in
+     ``find_block``, fails ``is_contiguous_block`` (so an intact gang
+     straddling a fresh cut tears down and re-places), counts in the
+     fragmentation probe, and reaches the engine/controller from the
+     link-health ConfigMap — whose changes fire the replan predicate,
+  4. publication: ``publish_gang_fabric`` beside the telemetry
+     annotation.
+"""
+
+import json
+
+import prometheus_client
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.slice_manager_agent import SliceManagerAgent
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, new_tpu_slice
+from tpu_operator.controllers.fabric_telemetry import (
+    FabricTelemetryAggregator,
+    parse_link_map,
+)
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_torus_nodes
+from tpu_operator.placement.engine import PlacementEngine, PlacementPhase
+from tpu_operator.placement.torus import Torus, worker_coords
+from tpu_operator.workloads.fabric import (
+    edge_key,
+    enumerate_block_edges,
+    gang_fabric_artifact,
+    run_fabric_probe,
+)
+
+NS = "tpu-operator"
+
+
+def sample(name, **labels):
+    return prometheus_client.REGISTRY.get_sample_value(name, labels or None)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: the probe
+# ---------------------------------------------------------------------------
+
+
+class TestEdgeEnumeration:
+    def test_mesh_block_edge_count(self):
+        # 2x4x1 mesh: x edges 1*4, y edges 2*3 — no wrap links
+        edges = enumerate_block_edges((2, 4, 1))
+        assert len(edges) == 4 + 6
+        assert all(not wrap for _, _, _, wrap in edges)
+
+    def test_torus_wrap_edges_only_on_long_axes(self):
+        # wrap on the 4-long y axis adds 2 links; the 2-long x axis's
+        # "wrap" IS its interior link and must not double-count
+        edges = enumerate_block_edges((2, 4, 1), wrap=True)
+        assert len(edges) == 4 + 6 + 2
+        wraps = [(a, b) for a, b, _, wrap in edges if wrap]
+        assert wraps == [((0, 3, 0), (0, 0, 0)), ((1, 3, 0), (1, 0, 0))]
+
+    def test_unit_axes_have_no_edges(self):
+        assert enumerate_block_edges((1, 1, 1)) == []
+        assert len(enumerate_block_edges((4, 1, 1), wrap=True)) == 3 + 1
+
+    def test_every_edge_is_torus_adjacent(self):
+        for a, b, axis, wrap in enumerate_block_edges((2, 2, 2), wrap=True):
+            diff = [abs(x - y) for x, y in zip(a, b)]
+            assert sorted(diff) in ([0, 0, 1],)
+
+
+class TestFabricProbe:
+    def test_probe_sweeps_edges_and_axes(self):
+        probe = run_fabric_probe("2x4x1", wrap=True, size_mb=0.1, iters=2)
+        assert probe["ok"] and probe["devices"] == 8
+        assert len(probe["edges"]) == 12  # 4 x + 6 y + 2 y-wrap
+        assert all(m["bw_gbps"] > 0 for m in probe["edges"].values())
+        # per-axis latency matrix covers exactly the multi-host axes
+        assert set(probe["axis_allreduce_us"]) == {"x", "y"}
+        assert all(v > 0 for v in probe["axis_allreduce_us"].values())
+
+    def test_probe_rejects_bad_shape_and_short_devices(self):
+        with pytest.raises(ValueError):
+            run_fabric_probe("not-a-shape")
+        with pytest.raises(ValueError):
+            run_fabric_probe("4x4x4")  # needs 64, the mesh has 8
+
+    def test_artifact_maps_coords_to_hosts_in_worker_order(self):
+        probe = {
+            "shape": "2x2x1",
+            "edges": {
+                edge_key("0-0-0", "1-0-0"): {"bw_gbps": 10.0, "axis": "x", "wrap": False},
+                edge_key("0-0-0", "0-1-0"): {"bw_gbps": 20.0, "axis": "y", "wrap": False},
+                edge_key("1-1-0", "0-1-0"): {"bw_gbps": 5.0, "axis": "x", "wrap": False},
+            },
+            "axis_allreduce_us": {"x": 11.0},
+        }
+        hosts = ["n0", "n1", "n2", "n3"]  # worker order: row-major, x fastest
+        artifact = gang_fabric_artifact(probe, hosts)
+        assert artifact["members"] == hosts
+        assert artifact["edges"][edge_key("n0", "n1")]["axis"] == "x"
+        assert artifact["edges"][edge_key("n0", "n2")]["axis"] == "y"
+        assert artifact["worst_edge"] == edge_key("n2", "n3")
+        assert artifact["min_edge_gbps"] == 5.0
+        assert artifact["median_edge_gbps"] == 10.0
+        assert artifact["axis_allreduce_us"] == {"x": 11.0}
+
+    def test_real_probe_roundtrips_into_artifact(self):
+        probe = run_fabric_probe("2x2x2", wrap=True, size_mb=0.1, iters=2)
+        hosts = [f"h{i}" for i in range(8)]
+        artifact = gang_fabric_artifact(probe, hosts)
+        assert artifact["hosts"] == 8
+        assert len(artifact["edges"]) == len(probe["edges"]) == 12
+        # every edge references two distinct gang members
+        for edge in artifact["edges"]:
+            a, _, b = edge.partition("|")
+            assert a in hosts and b in hosts and a != b
+
+
+# ---------------------------------------------------------------------------
+# layer 3 (units first — the analyzer tests build on them):
+# edge-aware torus + engine
+# ---------------------------------------------------------------------------
+
+
+def _torus(dims=(4, 2, 1), wrap=True):
+    nodes = {}
+    for i in range(dims[0] * dims[1] * dims[2]):
+        nodes[worker_coords(i, dims)] = f"n{i}"
+    return Torus(dims, nodes, wrap=wrap)
+
+
+class TestTorusDegradedEdges:
+    def test_cut_edge_blocks_straddling_candidates(self):
+        torus = _torus()
+        # n0=(0,0,0), n1=(1,0,0): cut their x link
+        torus.set_degraded_edges([("n0", "n1")])
+        found = torus.find_block((2, 1, 1))
+        assert found is not None
+        block, victims = found
+        assert not ({(0, 0, 0), (1, 0, 0)} <= set(block.cells))
+
+    def test_endpoints_stay_individually_placeable(self):
+        torus = _torus(dims=(2, 1, 1), wrap=False)
+        torus.set_degraded_edges([("n0", "n1")])
+        # the pair is forbidden...
+        assert torus.find_block((2, 1, 1)) is None
+        # ...but each endpoint alone still places
+        found = torus.find_block((1, 1, 1))
+        assert found is not None
+
+    def test_contiguity_fails_across_a_cut(self):
+        torus = _torus()
+        cells = [torus.coords_of["n0"], torus.coords_of["n1"]]
+        assert torus.is_contiguous_block(cells, (2, 1, 1))
+        torus.set_degraded_edges([("n0", "n1")])
+        assert not torus.is_contiguous_block(cells, (2, 1, 1))
+
+    def test_unknown_endpoints_ignored(self):
+        torus = _torus()
+        torus.set_degraded_edges([("ghost-a", "ghost-b"), ("n0", "ghost")])
+        assert torus.find_block((4, 2, 1)) is not None  # nothing cut
+
+    def test_fragmentation_counts_severed_edges(self):
+        # an empty 4x1x1 chain reads 0.0 fragmentation; cutting its
+        # middle link halves the largest placeable run
+        torus = _torus(dims=(4, 1, 1), wrap=False)
+        assert torus.fragmentation() == 0.0
+        torus.set_degraded_edges([("n1", "n2")])
+        # largest cut-free block is 2 of 4 free hosts -> 0.5
+        assert torus.fragmentation() == pytest.approx(0.5)
+
+
+class TestEngineDegradedLinks:
+    def _cluster(self, shape="2x2x1"):
+        store = FakeClient()
+        for node in make_torus_nodes((4, 4, 1), prefix="eng"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            store.create(node)
+        store.create(new_tpu_slice("gang-a", {"placement": {"shape": shape}}))
+        return store
+
+    def test_gang_straddling_fresh_cut_tears_down_and_replaces(self):
+        store = self._cluster()
+        pl = PlacementReconciler(store, NS)
+        pl.reconcile(QUEUE_REQUEST)
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "gang-a")
+        members = ts["status"]["placement"]["nodes"]
+        assert len(members) == 4
+        # cut the link between workers 0 and 1 (x neighbors of the block)
+        slices = store.list(TPU_SLICE_API_VERSION, "TPUSlice")
+        nodes = store.list("v1", "Node")
+        engine = PlacementEngine(
+            slices, nodes, degraded_links=[(members[0], members[1])]
+        )
+        plan = engine.plan()
+        assert "gang-a" in plan.teardowns
+        status = plan.statuses["gang-a"]
+        assert status["phase"] == PlacementPhase.SCHEDULED
+        new_members = status["nodes"]
+        assert not (members[0] in new_members and members[1] in new_members)
+
+    def test_unschedulable_when_every_block_is_cut(self):
+        store = FakeClient()
+        for node in make_torus_nodes((2, 1, 1), prefix="tiny"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            store.create(node)
+        store.create(new_tpu_slice("gang-b", {"placement": {"shape": "2x1x1"}}))
+        engine = PlacementEngine(
+            store.list(TPU_SLICE_API_VERSION, "TPUSlice"),
+            store.list("v1", "Node"),
+            degraded_links=[("tiny-0", "tiny-1")],
+        )
+        plan = engine.plan()
+        assert plan.statuses["gang-b"]["phase"] == PlacementPhase.UNSCHEDULABLE
+
+    def test_controller_feeds_engine_from_link_health_configmap(self):
+        store = self._cluster()
+        pl = PlacementReconciler(store, NS)
+        pl.reconcile(QUEUE_REQUEST)
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "gang-a")
+        members = ts["status"]["placement"]["nodes"]
+        edge = edge_key(members[0], members[1])
+        store.create(new_object(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS,
+            data={"pool-x": json.dumps({"edges": {edge: {"bw_gbps": 4.0}}})},
+        ))
+        pl.reconcile(QUEUE_REQUEST)  # teardown pass
+        pl.reconcile(QUEUE_REQUEST)  # re-place pass (teardown requeues)
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "gang-a")
+        st = ts["status"]["placement"]
+        assert st["phase"] == PlacementPhase.SCHEDULED
+        assert not (members[0] in st["nodes"] and members[1] in st["nodes"])
+
+    def test_link_map_predicate_fires_only_on_real_changes(self):
+        """The replan predicate setup_with_manager actually wires: a
+        link-map ADD/data-change replans the queue; unrelated ConfigMap
+        churn and no-op echoes do not."""
+        from tpu_operator.controllers import placement_controller as pc
+        from tpu_operator.kube.manager import Manager
+
+        store = self._cluster()
+        mgr = Manager(store)
+        reconciler = PlacementReconciler(store, NS)
+        ctrl = pc.setup_with_manager(mgr, reconciler)
+        try:
+            # the ConfigMap watch is the last one registered
+            _, _, link_map_changed = ctrl._watches[-1]
+            cm = new_object(
+                "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS,
+                data={"p": "{\"edges\": {}}"},
+            )
+            other = new_object("v1", "ConfigMap", "unrelated", NS, data={"a": "b"})
+            assert link_map_changed("ADDED", None, cm)
+            assert not link_map_changed("ADDED", None, other)
+            changed = json.loads(json.dumps(cm))
+            changed["data"] = {"p": "{\"edges\": {\"a|b\": {}}}"}
+            assert link_map_changed("MODIFIED", cm, changed)
+            assert not link_map_changed("MODIFIED", cm, json.loads(json.dumps(cm)))
+        finally:
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: the analyzer
+# ---------------------------------------------------------------------------
+
+
+def _build_cluster(dims=(4, 4, 1), shape="2x4x1", prefix="fab"):
+    """A placed gang with its plumbing materialized; returns
+    (store, placement reconciler, slice manager, member list)."""
+    store = FakeClient()
+    for node in make_torus_nodes(dims, prefix=prefix):
+        node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    store.create(new_tpu_slice("fab-gang", {"placement": {"shape": shape}}))
+    pl = PlacementReconciler(store, NS)
+    pl.reconcile(QUEUE_REQUEST)
+    sm = SliceManagerAgent(store, NS)
+    sm.reconcile_once()
+    ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "fab-gang")
+    return store, pl, sm, ts["status"]["placement"]["nodes"]
+
+
+def _matrix(members, shape=(2, 4, 1), slow=(), bw=40.0, slow_bw=4.0):
+    """A synthetic fabric artifact over the placed block with the named
+    host-pair edges degraded."""
+    edges = {}
+    for at, to, axis, wrap in enumerate_block_edges(shape, wrap=True):
+        key = edge_key("-".join(map(str, at)), "-".join(map(str, to)))
+        edges[key] = {"bw_gbps": bw, "axis": axis, "wrap": wrap}
+    probe = {
+        "shape": "x".join(map(str, shape)),
+        "edges": edges,
+        "axis_allreduce_us": {"y": 100.0},
+    }
+    artifact = gang_fabric_artifact(probe, members)
+    for edge in slow:
+        artifact["edges"][edge]["bw_gbps"] = slow_bw
+    return artifact
+
+
+class TestFabricAnalyzer:
+    def test_single_slow_edge_blames_link_not_hosts(self, fake_client):
+        store, pl, sm, members = _build_cluster()
+        cut = edge_key(members[0], members[2])  # y-neighbors in 2x4x1
+        assert sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        assert summary["link_blamed"] == [cut]
+        assert summary["host_blamed"] == []
+        # recorded in the per-pool link map
+        cm = store.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        link_map = parse_link_map(cm)
+        (pool, edges), = link_map.items()
+        assert cut in edges and edges[cut]["gang"] == "tpu-slice-fab-gang"
+        # neither endpoint labelled: the cable is the finding
+        for host in cut.split("|"):
+            labels = store.get("v1", "Node", host)["metadata"].get("labels") or {}
+            assert labels.get(consts.TPU_PERF_LABEL) is None
+        reasons = [e.get("reason") for e in store.list("v1", "Event")]
+        assert "IciLinkDegraded" in reasons and "IciHostDegraded" not in reasons
+        # series: bandwidth + degraded flag, keyed by pool and edge
+        assert sample(
+            "tpu_operator_ici_link_degraded", pool=pool, edge=cut
+        ) == 1
+        assert sample(
+            "tpu_operator_ici_link_bandwidth_gbps", pool=pool, edge=cut
+        ) == 4.0
+
+    def test_multi_edge_shared_endpoint_blames_host(self):
+        store, pl, sm, members = _build_cluster(prefix="hb")
+        victim = members[1]  # worker 1: x edge to 0, y edge to 3
+        slow = [edge_key(victim, members[0]), edge_key(victim, members[3])]
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=slow))
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        assert summary["host_blamed"] == [victim]
+        # the host enters the grey-failure path: perf label set
+        labels = store.get("v1", "Node", victim)["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED
+        # the edges that indicted the host are NOT link-blamed
+        assert summary["link_blamed"] == []
+        cm = store.get_or_none("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        assert not parse_link_map(cm)
+        reasons = [e.get("reason") for e in store.list("v1", "Event")]
+        assert "IciHostDegraded" in reasons
+
+    def test_host_blame_enters_fsm_and_gang_replaces(self):
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+        from tpu_operator.controllers.health_controller import HealthReconciler
+        from tpu_operator.kube.controller import Request
+
+        store, pl, sm, members = _build_cluster(prefix="fsm")
+        store.create(new_cluster_policy(spec={
+            "healthMonitor": {
+                "interval": 1,
+                "remediation": {"enable": True, "retryLimit": 3,
+                                "timeoutSeconds": 300, "gracePeriodSeconds": 0},
+            },
+        }))
+        victim = members[1]
+        slow = [edge_key(victim, members[0]), edge_key(victim, members[3])]
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=slow))
+        health = HealthReconciler(store, NS)
+        req = Request(name="cluster-policy")
+        health.reconcile(req)  # fabric blame + FSM entry
+        health.reconcile(req)
+        labels = store.get("v1", "Node", victim)["metadata"].get("labels") or {}
+        assert labels.get(consts.REPAIR_STATE_LABEL)  # the FSM owns it now
+        pl.reconcile(QUEUE_REQUEST)
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "fab-gang")
+        st = ts["status"]["placement"]
+        assert st["phase"] == PlacementPhase.SCHEDULED
+        assert victim not in st["nodes"]
+
+    def test_stale_artifact_skipped_wholesale(self):
+        store, pl, sm, members = _build_cluster(prefix="st")
+        cut = edge_key(members[0], members[2])
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        # the gang re-places before the analyzer runs: strip one member's
+        # assignment labels (what a teardown does)
+        store.patch("v1", "Node", members[0], {"metadata": {"labels": {
+            consts.PLACEMENT_LABEL: None,
+        }}})
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        assert summary["stale_artifacts"] == ["tpu-slice-fab-gang"]
+        assert summary["link_blamed"] == [] and summary["host_blamed"] == []
+        assert store.get_or_none(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS
+        ) is None
+
+    def test_healthy_remeasure_clears_link_record(self):
+        store, pl, sm, members = _build_cluster(prefix="cl")
+        cut = edge_key(members[0], members[2])
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        fab = FabricTelemetryAggregator(store, NS)
+        fab.sync()
+        assert parse_link_map(
+            store.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        )
+        # the cable was re-seated: the same gang re-probes it healthy
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members))
+        summary = fab.sync()
+        assert summary["link_blamed"] == []
+        assert not parse_link_map(
+            store.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        )
+        # degraded flag dropped with the record
+        pool = list(summary["gangs"].values())[0]["pool"]
+        assert sample("tpu_operator_ici_link_degraded", pool=pool, edge=cut) == 0
+
+    def test_pool_drain_removes_records_and_series(self):
+        store, pl, sm, members = _build_cluster(prefix="dr")
+        cut = edge_key(members[0], members[2])
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        pool = list(summary["gangs"].values())[0]["pool"]
+        assert sample("tpu_operator_ici_link_bandwidth_gbps", pool=pool, edge=cut) is not None
+        for node in store.list("v1", "Node"):
+            store.delete("v1", "Node", node["metadata"]["name"])
+        summary = fab.sync()
+        assert summary["link_map"] == {}
+        assert sample("tpu_operator_ici_link_bandwidth_gbps", pool=pool, edge=cut) is None
+        assert sample("tpu_operator_ici_link_degraded", pool=pool, edge=cut) is None
+
+    def test_recorded_link_keeps_firing_without_fresh_measurements(self):
+        store, pl, sm, members = _build_cluster(prefix="kp")
+        cut = edge_key(members[0], members[2])
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        pool = list(summary["gangs"].values())[0]["pool"]
+        # the gang re-places off the cut; its stale artifact is skipped,
+        # so no fresh measurement covers the edge — the RECORD keeps the
+        # alert-driving series alive (the cable is still cut)
+        store.patch("v1", "Node", members[0], {"metadata": {"labels": {
+            consts.PLACEMENT_LABEL: None,
+        }}})
+        summary = fab.sync()
+        assert summary["stale_artifacts"]
+        assert sample("tpu_operator_ici_link_degraded", pool=pool, edge=cut) == 1
+
+    def test_malformed_artifact_and_link_map_are_skipped(self):
+        store, pl, sm, members = _build_cluster(prefix="mal")
+        store.patch("v1", "ConfigMap", "tpu-slice-fab-gang-gang", {
+            "metadata": {"annotations": {consts.GANG_FABRIC_ANNOTATION: "{not json"}}
+        }, NS)
+        store.create(new_object(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS,
+            data={"pool-a": "also not json", "pool-b": json.dumps({"edges": "nope"})},
+        ))
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()  # must not raise
+        assert summary["gangs"] == {}
+        assert parse_link_map(
+            store.get_or_none("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        ) == {}
+
+    def test_failed_link_map_read_aborts_without_erasing_records(self):
+        """A transient apiserver error reading the link map must abort
+        the pass (the caller isolates it), NOT read as "no records" —
+        that would diff {} against the previous pass and overwrite every
+        standing link blame with an empty map."""
+        from tpu_operator.kube import errors
+
+        store, pl, sm, members = _build_cluster(prefix="er")
+        cut = edge_key(members[0], members[2])
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=[cut]))
+        fab = FabricTelemetryAggregator(store, NS)
+        fab.sync()
+        assert parse_link_map(
+            store.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        )
+
+        real_get = store.get
+
+        def flaky_get(api_version, kind, name, namespace=None):
+            if name == consts.LINK_HEALTH_CONFIGMAP:
+                raise errors.ServerError("boom")
+            return real_get(api_version, kind, name, namespace)
+
+        store.get = flaky_get
+        with pytest.raises(errors.ApiError):
+            fab.sync()
+        store.get = real_get
+        # the record survived the outage
+        assert cut in parse_link_map(
+            store.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        ).popitem()[1]
+
+    def test_disjoint_replace_makes_old_artifact_stale(self):
+        """A gang re-placed onto a fully disjoint block nulls every old
+        member's placement label; the old matrix must still read stale
+        (owners=={None} is a torn-down placed gang, not an implicit
+        one) — or the analyzer would re-blame the repaired host every
+        pass."""
+        store, pl, sm, members = _build_cluster(prefix="dj")
+        victim = members[1]
+        slow = [edge_key(victim, members[0]), edge_key(victim, members[3])]
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=slow))
+        # simulate the re-place onto a disjoint block: old members lose
+        # the owner label, other nodes gain it
+        others = [
+            n["metadata"]["name"] for n in store.list("v1", "Node")
+            if n["metadata"]["name"] not in members
+        ]
+        for i, name in enumerate(members):
+            store.patch("v1", "Node", name, {"metadata": {"labels": {
+                consts.PLACEMENT_LABEL: None, consts.PLACEMENT_INDEX_LABEL: None,
+            }}})
+        for i, name in enumerate(others[:8]):
+            store.patch("v1", "Node", name, {"metadata": {"labels": {
+                consts.PLACEMENT_LABEL: "fab-gang",
+                consts.PLACEMENT_INDEX_LABEL: str(i),
+            }}})
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        assert summary["stale_artifacts"] == ["tpu-slice-fab-gang"]
+        assert summary["host_blamed"] == []
+        labels = store.get("v1", "Node", victim)["metadata"].get("labels") or {}
+        assert labels.get(consts.TPU_PERF_LABEL) is None
+
+    def test_second_episode_events_again(self):
+        """Blame -> repair -> label cleared -> a LATER second failure is
+        a new episode: the IciHostDegraded Event must fire again."""
+        store, pl, sm, members = _build_cluster(prefix="ep")
+        victim = members[1]
+        slow = [edge_key(victim, members[0]), edge_key(victim, members[3])]
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=slow))
+        fab = FabricTelemetryAggregator(store, NS)
+        fab.sync()
+
+        def host_events():
+            return [
+                e for e in store.list("v1", "Event")
+                if e.get("reason") == "IciHostDegraded"
+            ]
+
+        first = host_events()
+        assert len(first) == 1
+        # repair completes: label cleared, the gang measures healthy
+        store.patch("v1", "Node", victim, {"metadata": {"labels": {
+            consts.TPU_PERF_LABEL: None,
+        }}})
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members))
+        fab.sync()  # episode closes
+        # second failure, same host
+        sm.publish_gang_fabric("tpu-slice-fab-gang", _matrix(members, slow=slow))
+        fab.sync()
+        second = host_events()
+        # a fresh Event object or a bumped count on the aggregate both
+        # prove the episode surfaced again
+        assert len(second) > 1 or second[0].get("count", 1) > first[0].get("count", 1)
+
+    def test_quiet_pass_writes_nothing(self, fake_client):
+        """An unchanged world must produce zero link-map writes — an
+        every-pass rewrite would echo a watch event into the placement
+        controller's replan predicate on every health cadence."""
+        fake_client.create(new_object(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS, data={}
+        ))
+        fab = FabricTelemetryAggregator(fake_client, NS)
+        cm = fake_client.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        rv = cm["metadata"]["resourceVersion"]
+        fab.sync()
+        fab.sync()
+        cm = fake_client.get("v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS)
+        assert cm["metadata"]["resourceVersion"] == rv
+
+    def test_single_edge_gang_never_self_blames(self):
+        # a 2-host gang has one edge and no peers to compare against:
+        # the median IS the edge, so nothing can read degraded
+        store, pl, sm, members = _build_cluster(
+            dims=(2, 1, 1), shape="2x1x1", prefix="two"
+        )
+        artifact = _matrix(members, shape=(2, 1, 1))
+        for meta in artifact["edges"].values():
+            meta["bw_gbps"] = 0.5  # absurdly slow, but nothing to compare
+        sm.publish_gang_fabric("tpu-slice-fab-gang", artifact)
+        fab = FabricTelemetryAggregator(store, NS)
+        summary = fab.sync()
+        assert summary["degraded_edges"] == []
+
+
+# ---------------------------------------------------------------------------
+# layer 4: publication
+# ---------------------------------------------------------------------------
+
+
+class TestGangFabricPublication:
+    def test_publish_beside_telemetry_annotation(self):
+        store, pl, sm, members = _build_cluster(prefix="pub")
+        assert sm.publish_gang_telemetry("tpu-slice-fab-gang", {"hosts": 8})
+        artifact = _matrix(members)
+        assert sm.publish_gang_fabric("tpu-slice-fab-gang", artifact)
+        cm = store.get("v1", "ConfigMap", "tpu-slice-fab-gang-gang", NS)
+        annotations = cm["metadata"]["annotations"]
+        assert consts.GANG_TELEMETRY_ANNOTATION in annotations
+        published = json.loads(annotations[consts.GANG_FABRIC_ANNOTATION])
+        assert published["edges"] == artifact["edges"]
+        # gang env data untouched by the annotation-only patch
+        assert cm["data"]["TPU_SLICE_HOSTS"] == "8"
+
+    def test_publish_gone_gang_returns_false(self, fake_client):
+        sm = SliceManagerAgent(fake_client, NS)
+        assert not sm.publish_gang_fabric("no-such-slice", {"edges": {}})

@@ -277,6 +277,105 @@ class TestDriftSeededDefects:
 
 
 # ---------------------------------------------------------------------------
+# TPUOP-O004: PrometheusRule alert hygiene.
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusRuleHygiene:
+    def rule_obj(self, rule):
+        return {
+            "apiVersion": "monitoring.coreos.com/v1", "kind": "PrometheusRule",
+            "metadata": {"name": "fix"},
+            "spec": {"groups": [{"name": "g", "rules": [rule]}]},
+        }
+
+    def good_rule(self, **overrides):
+        rule = {
+            "alert": "A", "expr": "up == 0", "for": "5m",
+            "annotations": {"summary": "s", "description": "d"},
+        }
+        rule.update(overrides)
+        return rule
+
+    def analyze(self, rule):
+        from tpu_operator.lint.metrics_catalog import analyze_rule_hygiene
+
+        return analyze_rule_hygiene([("state:x", [self.rule_obj(rule)])])
+
+    def test_clean_alert_passes(self):
+        assert self.analyze(self.good_rule()) == []
+
+    def test_missing_summary_flagged(self):
+        findings = self.analyze(self.good_rule(annotations={"description": "d"}))
+        assert [f.rule for f in findings] == ["TPUOP-O004"]
+        assert "summary" in findings[0].message
+
+    def test_missing_description_flagged(self):
+        findings = self.analyze(
+            self.good_rule(annotations={"summary": "s", "description": "  "})
+        )
+        assert [f.rule for f in findings] == ["TPUOP-O004"]
+        assert "description" in findings[0].message
+
+    @pytest.mark.parametrize("duration", [None, "", "0", "0s", "0m"])
+    def test_missing_or_zero_for_flagged(self, duration):
+        rule = self.good_rule()
+        if duration is None:
+            del rule["for"]
+        else:
+            rule["for"] = duration
+        findings = self.analyze(rule)
+        assert [f.rule for f in findings] == ["TPUOP-O004"]
+        assert "for:" in findings[0].message
+
+    def test_recording_rules_exempt(self):
+        # recording rules page nobody: no annotations/for contract
+        findings = self.analyze({"record": "job:up:sum", "expr": "sum(up)"})
+        assert findings == []
+
+    def test_all_defects_reported_once_each(self):
+        findings = self.analyze({"alert": "A", "expr": "up == 0"})
+        assert sorted(f.rule for f in findings) == ["TPUOP-O004"] * 3
+
+    def test_shipped_rules_all_clean(self):
+        """Every alert the states actually render carries summary +
+        description and a non-zero for: — the live guarantee the
+        satellite asks for, the new fabric alert included."""
+        from tpu_operator.lint.metrics_catalog import analyze_rule_hygiene
+
+        groups = runner.manifest_groups()
+        alerts = [
+            rule.get("alert")
+            for _, objs in groups for obj in objs
+            if obj.get("kind") == "PrometheusRule"
+            for g in (obj.get("spec") or {}).get("groups") or []
+            for rule in g.get("rules") or []
+            if rule.get("alert")
+        ]
+        assert "TPUIciLinkDegraded" in alerts  # the check is not vacuous
+        assert analyze_rule_hygiene(groups) == []
+
+    def test_seeded_defect_in_rendered_group_is_caught(self):
+        """A shipped rule stripped of its for: must fail the gate the
+        way a real regression would — through the same rendered groups
+        run_lint feeds."""
+        from tpu_operator.lint.metrics_catalog import analyze_rule_hygiene
+
+        groups = []
+        for name, objs in runner.manifest_groups():
+            objs = copy.deepcopy(objs)
+            for obj in objs:
+                if obj.get("kind") != "PrometheusRule":
+                    continue
+                for g in (obj.get("spec") or {}).get("groups") or []:
+                    for rule in g.get("rules") or []:
+                        rule.pop("for", None)
+            groups.append((name, objs))
+        findings = analyze_rule_hygiene(groups)
+        assert findings and all(f.rule == "TPUOP-O004" for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # The acceptance gate + CLI.
 # ---------------------------------------------------------------------------
 

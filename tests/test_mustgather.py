@@ -96,6 +96,14 @@ def test_bundle_from_live_install(tmp_path):
         assert "# perf floors (operator-published)" in telemetry_txt
         assert "matmul_tflops" in telemetry_txt  # the live ConfigMap's table
         assert "# gang step-time artifacts" in telemetry_txt
+        # the fabric view: link-health map + gang fabric matrices +
+        # worst-edge cut + blame split, even when all empty on this
+        # install (the sections must exist for support to trust absence)
+        fabric_txt = (tmp_path / "fabric.txt").read_text()
+        assert "# link health (operator-recorded link blame)" in fabric_txt
+        assert "# gang fabric artifacts" in fabric_txt
+        assert "# worst 10 measured edges" in fabric_txt
+        assert "# blame decisions" in fabric_txt
         # the flight recorder rides along: this process ran the
         # reconciles, so traces.txt must hold real reconcile span trees
         traces_txt = (tmp_path / "traces.txt").read_text()
@@ -119,7 +127,7 @@ def test_bundle_from_live_install(tmp_path):
             "clusterpolicies.yaml", "tpuslices.yaml",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
-            "telemetry.txt",
+            "telemetry.txt", "fabric.txt",
         } <= stems
     finally:
         mgr.stop()

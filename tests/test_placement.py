@@ -192,6 +192,32 @@ class TestTorus:
         # only the wrapped pair (3,0,0)+(0,0,0) is free
         assert set(block.cells) == {(3, 0, 0), (0, 0, 0)}
 
+    def test_degraded_wrap_edge_cuts_the_wrapped_block(self):
+        """A severed WRAP link (fabric link blame) must block exactly
+        the candidates that would route it: the wrapped pair is refused,
+        an interior pair still places, and both endpoints remain
+        individually placeable capacity."""
+        torus = Torus.from_nodes(make_torus_nodes((4, 1, 1)))
+        torus.occupy("mid", [(1, 0, 0), (2, 0, 0)])
+        # only the wrapped pair tpu-3+tpu-0 is free — cut their link
+        torus.set_degraded_edges([("tpu-3", "tpu-0")])
+        assert torus.find_block(parse_shape("2x1x1")) is None
+        found = torus.find_block(parse_shape("1x1x1"))
+        assert found is not None  # the endpoints themselves still place
+        fresh = Torus.from_nodes(make_torus_nodes((4, 1, 1)))
+        fresh.set_degraded_edges([("tpu-3", "tpu-0")])
+        found = fresh.find_block(parse_shape("2x1x1"))
+        assert found is not None
+        assert not ({(3, 0, 0), (0, 0, 0)} <= set(found[0].cells))
+
+    def test_degraded_edge_constrains_preemption_candidates(self):
+        """Preemption search must respect cuts too: a victim block that
+        would seat the preemptor across a severed link is no rescue."""
+        torus = Torus.from_nodes(make_torus_nodes((2, 1, 1)))
+        torus.occupy("low", [(0, 0, 0), (1, 0, 0)])
+        torus.set_degraded_edges([("tpu-0", "tpu-1")])
+        assert torus.find_block(parse_shape("2x1x1"), victim_ok=lambda o: True) is None
+
     def test_mesh_pool_never_wraps(self):
         """v5e/v6e are meshes without edge ICI links: a block folding
         around the boundary would advertise a hop that doesn't exist."""

@@ -110,6 +110,57 @@ class TestStepTimeRecorder:
         with pytest.raises(ValueError):
             merge_gang_reports({})
 
+    def test_gang_merge_single_host_reads_uniform(self):
+        """A single-host gang has nobody to straggle behind: the ratio
+        must read exactly 1.0, not divide-by-self noise, and the one
+        host is (trivially) the slowest."""
+        artifact = merge_gang_reports({"solo": {"step_p50_s": 0.042}})
+        assert artifact["hosts"] == 1
+        assert artifact["straggler_ratio"] == 1.0
+        assert artifact["slowest_host"] == "solo"
+        assert artifact["gang_step_p50_s"] == pytest.approx(0.042)
+
+    def test_gang_merge_missing_member_is_reported(self):
+        """A member whose report never arrived is a finding, not a
+        smaller gang: expected_hosts surfaces it as missing_hosts (and
+        the ratio covers only the hosts that measured)."""
+        reports = {"h0": {"step_p50_s": 0.01}, "h1": {"step_p50_s": 0.01}}
+        artifact = merge_gang_reports(
+            reports, expected_hosts=["h0", "h1", "h2", "h3"]
+        )
+        assert artifact["missing_hosts"] == ["h2", "h3"]
+        assert artifact["straggler_ratio"] == pytest.approx(1.0)
+        # a complete gang carries no missing_hosts key at all
+        full = merge_gang_reports(reports, expected_hosts=["h0", "h1"])
+        assert "missing_hosts" not in full
+
+    def test_gang_merge_zero_step_report_excluded_from_ratio(self):
+        """A report with zero recorded steps (0.0 median) must not read
+        as an infinitely fast host — it is excluded from the ratio; the
+        measured hosts still produce an honest artifact."""
+        reports = {
+            "h0": {"step_p50_s": 0.010},
+            "h1": {"step_p50_s": 0.010},
+            "h2": {"step_p50_s": 0.0},  # recorded nothing
+        }
+        artifact = merge_gang_reports(reports)
+        assert artifact["hosts"] == 3  # the gang size is the gang size
+        assert artifact["straggler_ratio"] == pytest.approx(1.0)
+        assert "h2" not in artifact["per_host_step_p50_s"]
+        assert artifact["slowest_host"] in ("h0", "h1")
+
+    def test_gang_merge_all_zero_reports(self):
+        """Every report empty: a shape-correct artifact that cannot fake
+        a measurement (ratio pinned to 1.0, no slowest host)."""
+        artifact = merge_gang_reports(
+            {"h0": {"step_p50_s": 0.0}, "h1": {}},
+            expected_hosts=["h0", "h1", "h2"],
+        )
+        assert artifact["straggler_ratio"] == 1.0
+        assert artifact["slowest_host"] == ""
+        assert artifact["gang_step_p50_s"] == 0.0
+        assert artifact["missing_hosts"] == ["h2"]
+
     def test_publish_prometheus_idempotent(self):
         reg = prometheus_client.CollectorRegistry()
         rec = StepTimeRecorder(flops_per_step=1e9)
@@ -184,6 +235,130 @@ def make_exporter(store=None, node="tpu-0", floor=100.0, **kw):
         node_name=node, client=store, registry=reg,
         floors={"matmul_tflops": floor} if floor else {}, **kw
     ), reg
+
+
+class _FakeDevice:
+    def __init__(self, i):
+        self.id = i
+        self.platform = "cpu"
+
+    def memory_stats(self):
+        return {"bytes_in_use": 1, "bytes_limit": 2}
+
+
+class TestStaleSeriesHygiene:
+    """Regression (ISSUE 9 satellite): the ICI gauge and the per-probe
+    baseline/floor/degraded series used to survive the hardware they
+    measured — node discovery strips the labels, but the exporter kept
+    publishing the last value forever."""
+
+    @staticmethod
+    def _exporter(floors):
+        reg = prometheus_client.CollectorRegistry()
+        return MetricsExporterAgent(node_name="tpu-0", registry=reg, floors=floors), reg
+
+    def _seeded_exporter(self):
+        exp, reg = self._exporter({"matmul_tflops": 100.0, "ici_gbps": 10.0})
+        exp.ici_bandwidth.labels("tpu-0").set(42.0)
+        exp.hbm_bandwidth.labels("tpu-0").set(600.0)
+        exp.matmul_tflops.labels("tpu-0").set(150.0)
+        exp.observe_probe("ici_gbps", 42.0)
+        exp.observe_probe("matmul_tflops", 150.0)
+        assert sample(reg, "tpu_exporter_ici_bandwidth_gbps", node="tpu-0") == 42.0
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="ici_gbps") is not None
+        return exp, reg
+
+    def test_chip_count_drop_to_one_retires_ici_series(self, monkeypatch):
+        exp, reg = self._seeded_exporter()
+        monkeypatch.setattr("jax.local_devices", lambda: [_FakeDevice(0)])
+        exp.collect_device_stats()
+        # no interconnect on one chip: the ICI gauge and its probe's
+        # baseline/floor/degraded series are gone, not frozen
+        assert sample(reg, "tpu_exporter_ici_bandwidth_gbps", node="tpu-0") is None
+        for series in ("tpu_exporter_probe_baseline", "tpu_exporter_perf_floor",
+                       "tpu_exporter_perf_degraded"):
+            assert sample(reg, series, node="tpu-0", probe="ici_gbps") is None
+        # the compute-side series survive: one chip still computes
+        assert sample(reg, "tpu_exporter_matmul_tflops", node="tpu-0") == 150.0
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="matmul_tflops") is not None
+
+    def test_hardware_vanishing_retires_every_probe_series(self, monkeypatch):
+        exp, reg = self._seeded_exporter()
+        monkeypatch.setattr("jax.local_devices", lambda: [])
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_chips", node="tpu-0") == 0
+        assert sample(reg, "tpu_exporter_ici_bandwidth_gbps", node="tpu-0") is None
+        assert sample(reg, "tpu_exporter_hbm_bandwidth_gbps", node="tpu-0") is None
+        assert sample(reg, "tpu_exporter_matmul_tflops", node="tpu-0") is None
+        for probe in ("ici_gbps", "matmul_tflops"):
+            for series in ("tpu_exporter_probe_baseline", "tpu_exporter_perf_floor",
+                           "tpu_exporter_perf_degraded"):
+                assert sample(reg, series, node="tpu-0", probe=probe) is None
+
+    def test_runtime_failure_also_retires(self, monkeypatch):
+        exp, reg = self._seeded_exporter()
+
+        def boom():
+            raise RuntimeError("no runtime")
+
+        monkeypatch.setattr("jax.local_devices", boom)
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_ici_bandwidth_gbps", node="tpu-0") is None
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="matmul_tflops") is None
+
+    def test_healthy_chip_count_keeps_series(self, monkeypatch):
+        exp, reg = self._seeded_exporter()
+        monkeypatch.setattr(
+            "jax.local_devices", lambda: [_FakeDevice(i) for i in range(4)]
+        )
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_ici_bandwidth_gbps", node="tpu-0") == 42.0
+        assert sample(reg, "tpu_exporter_probe_baseline",
+                      node="tpu-0", probe="ici_gbps") is not None
+
+    def test_vanished_chip_hbm_series_retire(self, monkeypatch):
+        """A chip that disappears takes its per-chip HBM series with it:
+        frozen at 95% it would keep the near-capacity alert firing for
+        hardware that no longer exists."""
+        exp, reg = self._exporter({})
+        monkeypatch.setattr(
+            "jax.local_devices", lambda: [_FakeDevice(i) for i in range(4)]
+        )
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_hbm_used_bytes", node="tpu-0", chip="3") == 1
+        monkeypatch.setattr(
+            "jax.local_devices", lambda: [_FakeDevice(i) for i in range(2)]
+        )
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_hbm_used_bytes", node="tpu-0", chip="3") is None
+        assert sample(reg, "tpu_exporter_hbm_limit_bytes", node="tpu-0", chip="3") is None
+        assert sample(reg, "tpu_exporter_hbm_used_bytes", node="tpu-0", chip="1") == 1
+
+        def boom():
+            raise RuntimeError("runtime gone")
+
+        monkeypatch.setattr("jax.local_devices", boom)
+        exp.collect_device_stats()
+        assert sample(reg, "tpu_exporter_hbm_used_bytes", node="tpu-0", chip="1") is None
+
+    def test_detection_state_resets_with_the_series(self, monkeypatch):
+        """A vanished chip's breach counter must not survive into the
+        hardware's replacement: the fresh chip starts clean."""
+        exp, reg = self._exporter({"ici_gbps": 10.0})
+        for _ in range(consts.PERF_BREACH_SAMPLES - 1):
+            exp.observe_probe("ici_gbps", 5.0)  # one short of breach
+        monkeypatch.setattr("jax.local_devices", lambda: [_FakeDevice(0)])
+        exp.collect_device_stats()
+        monkeypatch.setattr(
+            "jax.local_devices", lambda: [_FakeDevice(i) for i in range(4)]
+        )
+        exp.collect_device_stats()
+        exp.observe_probe("ici_gbps", 5.0)  # would have breached before
+        assert sample(reg, "tpu_exporter_perf_degraded",
+                      node="tpu-0", probe="ici_gbps") == 0
 
 
 class TestGreyFailureDetection:

@@ -109,6 +109,7 @@ class MetricsExporterAgent:
         self._probe_history: Dict[str, collections.deque] = {}
         self._breach_counts: Dict[str, int] = {}
         self._degraded_probes: set = set()
+        self._seen_chips: set = set()  # chip ids with live per-chip series
         self._perf_label_state: Optional[bool] = None  # last published
         # collector construction is idempotent against any shared
         # registry (same _get_or_create contract as OperatorMetrics): a
@@ -180,10 +181,15 @@ class MetricsExporterAgent:
             log.warning("metrics: jax runtime unavailable: %s", e)
             self.collect_errors.labels(self.node_name).inc()
             self.chips.labels(self.node_name).set(0)
+            self._retire_stale_series(chips=0)
+            self._retire_vanished_chips(set())
             return
         self.chips.labels(self.node_name).set(len(devices))
+        self._retire_stale_series(chips=len(devices))
+        present = set()
         for dev in devices:
             chip = str(getattr(dev, "id", dev))
+            present.add(chip)
             try:
                 stats = dev.memory_stats() or {}
             except Exception:  # noqa: BLE001 — some platforms expose none
@@ -192,6 +198,63 @@ class MetricsExporterAgent:
                 self.hbm_used.labels(self.node_name, chip).set(stats["bytes_in_use"])
             if "bytes_limit" in stats:
                 self.hbm_limit.labels(self.node_name, chip).set(stats["bytes_limit"])
+        self._retire_vanished_chips(present)
+
+    # -- stale-series hygiene -------------------------------------------------
+
+    def _retire_vanished_chips(self, present: set) -> None:
+        """Per-chip HBM series of chips no longer visible go with the
+        chips: a vanished chip frozen at 95% HBM would keep the
+        near-capacity alert firing for hardware that no longer exists."""
+        for chip in self._seen_chips - present:
+            for gauge in (self.hbm_used, self.hbm_limit):
+                try:
+                    gauge.remove(self.node_name, chip)
+                except KeyError:
+                    pass
+        self._seen_chips = set(present)
+
+    def _remove_probe_series(self, probe: str) -> None:
+        """Drop one probe's floor/baseline/degraded series and its
+        detection state — without touching the node perf label (hardware
+        going away is the health agent's verdict to make, and "the probe
+        can no longer run" is not recovery evidence)."""
+        for gauge in (self.perf_floor, self.probe_baseline, self.perf_degraded):
+            try:
+                gauge.remove(self.node_name, probe)
+            except KeyError:
+                pass
+        self._probe_history.pop(probe, None)
+        self._breach_counts.pop(probe, None)
+        self._degraded_probes.discard(probe)
+
+    def _retire_stale_series(self, chips: int) -> None:
+        """Stale-series hygiene, same discipline as fleet telemetry's
+        torn-down gang series: a gauge that outlives its hardware keeps
+        exporting the last measured value forever (node discovery strips
+        the labels, nothing used to strip the series), which reads as "a
+        healthy link/chip at exactly yesterday's bandwidth" on every
+        dashboard. Chips <= 1 retires the ICI series (no interconnect to
+        measure — a frozen value is a phantom link); chips == 0 retires
+        every probe-derived series (nothing can probe)."""
+        if chips > 1:
+            return
+        try:
+            self.ici_bandwidth.remove(self.node_name)
+        except KeyError:
+            pass
+        self._remove_probe_series("ici_gbps")
+        if chips > 0:
+            return
+        for probe in set(self._probe_history) | set(self.floors):
+            self._remove_probe_series(probe)
+        for gauge in (
+            self.hbm_bandwidth, self.matmul_tflops, self.mxu_utilization
+        ):
+            try:
+                gauge.remove(self.node_name)
+            except KeyError:
+                pass
 
     # -- grey-failure detection ----------------------------------------------
 

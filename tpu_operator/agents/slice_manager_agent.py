@@ -492,15 +492,30 @@ class SliceManagerAgent:
         concurrent hosts publishing the same gang converge, and the gang
         env data is never touched. Returns False when the gang ConfigMap
         is gone (torn down between measure and publish)."""
+        return self._publish_gang_annotation(
+            slice_name, consts.GANG_TELEMETRY_ANNOTATION, artifact
+        )
+
+    def publish_gang_fabric(self, slice_name: str, artifact: dict) -> bool:
+        """Publish a gang's fabric matrix
+        (``workloads.fabric.gang_fabric_artifact``: per-edge ICI
+        bandwidth + per-axis allreduce latency) beside the step-time
+        artifact. The operator's fabric analyzer
+        (``controllers/fabric_telemetry.py``) reads it back into the
+        ``tpu_operator_ici_link_*`` series and runs blame assignment —
+        the layer that tells a slow link from a slow chip."""
+        return self._publish_gang_annotation(
+            slice_name, consts.GANG_FABRIC_ANNOTATION, artifact
+        )
+
+    def _publish_gang_annotation(self, slice_name: str, annotation: str, artifact: dict) -> bool:
         import json
 
         try:
             self.client.patch(
                 "v1", "ConfigMap", f"{slice_name}-gang", {
                     "metadata": {"annotations": {
-                        consts.GANG_TELEMETRY_ANNOTATION: json.dumps(
-                            artifact, sort_keys=True
-                        )
+                        annotation: json.dumps(artifact, sort_keys=True)
                     }}
                 },
                 self.namespace,

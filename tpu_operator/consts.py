@@ -124,6 +124,34 @@ GANG_TELEMETRY_ANNOTATION = "tpu.google.com/gang-telemetry"
 # straggler: a PerfDegraded Event fires and the rollup flags the gang
 GANG_STRAGGLER_RATIO = 1.25
 
+# ---------------------------------------------------------------------------
+# ICI fabric telemetry (workloads/fabric.py -> controllers/
+# fabric_telemetry.py). The fabric probe times every torus-axis link of
+# a placed gang; the slice manager publishes the per-edge matrix beside
+# the step-time artifact; the operator's fabric analyzer ingests it,
+# assigns blame (link vs host), and feeds the placement engine's
+# unavailable-EDGE support so gangs re-place around a bad cable instead
+# of quarantining two healthy hosts.
+# ---------------------------------------------------------------------------
+# per-gang fabric artifact: edge bandwidth matrix + per-axis allreduce
+# latency, published on the gang ConfigMap beside the telemetry artifact
+GANG_FABRIC_ANNOTATION = "tpu.google.com/gang-fabric"
+# per-pool link-health record the fabric analyzer maintains: one data
+# key per pool, JSON {"edges": {"hostA|hostB": {...}}} — the placement
+# controller reads it back as the engine's degraded-link input
+LINK_HEALTH_CONFIGMAP = "tpu-link-health"
+# an edge is degraded when its measured bandwidth falls below this
+# fraction of the gang's median edge bandwidth — pool-relative, so the
+# comparison self-calibrates per generation/payload instead of trusting
+# a published point-to-point number nobody measured
+FABRIC_LINK_DEGRADED_FRACTION = 0.5
+# this many degraded edges sharing one endpoint indict the HOST (its
+# ICI interface / chip, not N independent cables failing at once): the
+# endpoint enters the perf-degraded grey-failure FSM. Below it, the
+# LINK is blamed: recorded in the link-health map, both endpoints stay
+# in service, and gangs straddling the edge re-place around it.
+FABRIC_HOST_BLAME_EDGES = 2
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

@@ -1,0 +1,419 @@
+"""ICI fabric analyzer: link-level series + edge-aware blame.
+
+The top layer of the fabric telemetry pipeline (workloads/fabric.py
+measures, the slice manager publishes, this ingests). Runs from the
+health reconciler's pass — same cadence and informer caches as the
+fleet aggregator — reading each gang's published fabric artifact
+(``consts.GANG_FABRIC_ANNOTATION``) back into:
+
+    tpu_operator_ici_link_bandwidth_gbps{pool,edge}   measured GB/s
+    tpu_operator_ici_link_degraded{pool,edge}         1 while slow/cut
+
+and running **blame assignment**, the decision PR 7 could not make: a
+slow link and a slow chip both read as one straggling host at host
+granularity, so remediation used to quarantine a healthy node while
+the bad cable kept poisoning whichever gang landed across it next.
+With per-edge measurements the two separate:
+
+  - **host blame** — ``consts.FABRIC_HOST_BLAME_EDGES`` or more
+    degraded edges sharing one endpoint indict that host's ICI
+    interface, not N independent cables failing at once: the host gets
+    the ``tpu.google.com/perf=degraded`` label and enters the existing
+    grey-failure repair FSM (cordon → … → revalidate), exactly the
+    PR 7 path a floor-breaching chip takes.
+  - **link blame** — a degraded edge whose endpoints are otherwise
+    healthy indicts the cable: it is recorded in the per-pool
+    link-health ConfigMap (``consts.LINK_HEALTH_CONFIGMAP``), BOTH
+    endpoints stay in service and schedulable, and the placement
+    engine — which consumes the link map as unavailable-edge input —
+    re-places any gang straddling the edge and routes new blocks
+    around it.
+
+A recorded link clears when a later artifact measures that same edge
+healthy again (a re-seated cable proves itself the same way it was
+convicted); its series go when the record does, and a drained pool
+takes every series and record with it. Stale artifacts — a re-placed
+gang's ConfigMap still carries the OLD block's matrix until a fresh
+probe runs — are detected by membership: every artifact member must
+still be placed in that gang, or the matrix describes links the gang
+no longer runs on and is skipped wholesale.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_operator import consts
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import new_object
+from tpu_operator.nodepool import get_node_pools
+
+log = logging.getLogger(__name__)
+
+# the slice manager stamps this on every gang object it owns (kept
+# value-only to avoid a module cycle, same as fleet_telemetry)
+_MANAGED_BY = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+
+
+def parse_link_map(cm: Optional[dict]) -> Dict[str, Dict[str, dict]]:
+    """{pool: {edge: record}} from the link-health ConfigMap; malformed
+    pool entries degrade to empty rather than poisoning the pass."""
+    out: Dict[str, Dict[str, dict]] = {}
+    if cm is None:
+        return out
+    for pool, raw in (cm.get("data") or {}).items():
+        try:
+            parsed = json.loads(raw)
+        except (TypeError, ValueError):
+            log.warning("fabric: malformed link-health entry for pool %s", pool)
+            continue
+        edges = (parsed or {}).get("edges")
+        if isinstance(edges, dict):
+            out[pool] = {str(k): dict(v) for k, v in edges.items() if isinstance(v, dict)}
+    return out
+
+
+class FabricTelemetryAggregator:
+    def __init__(self, client: Client, namespace: str, recorder: Optional[EventRecorder] = None):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = recorder or EventRecorder(
+            client, namespace, component="tpu-fabric-telemetry"
+        )
+        self.metrics = get_metrics()
+        self._link_series: Set[Tuple[str, str]] = set()  # (pool, edge) published
+        self._link_events: Set[str] = set()  # edge keys evented this episode
+        self._host_events: Set[str] = set()
+
+    # -- one analysis pass ---------------------------------------------------
+
+    def sync(self) -> dict:
+        """Ingest every gang fabric artifact, assign blame, maintain the
+        link-health map + series. Returns a summary dict (tests and the
+        fabric must-gather artifact read it)."""
+        summary: dict = {
+            "gangs": {},
+            "degraded_edges": [],
+            "link_blamed": [],
+            "host_blamed": [],
+            "stale_artifacts": [],
+            "link_map": {},
+        }
+        try:
+            nodes = self.client.list(
+                "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+            )
+            cms = self.client.list(
+                "v1", "ConfigMap", self.namespace, label_selector=_MANAGED_BY
+            )
+        except errors.ApiError as e:
+            log.debug("fabric telemetry: list failed: %s", e)
+            return summary
+        node_by_name = {n["metadata"]["name"]: n for n in nodes}
+        pool_of: Dict[str, str] = {}
+        for pool in get_node_pools(nodes):
+            for name in pool.node_names:
+                pool_of[name] = pool.name
+
+        link_map = self._load_link_map()
+        # (pool, edge) -> {"bw_gbps", "degraded", "axis", "gang"}
+        measured: Dict[Tuple[str, str], dict] = {}
+
+        for cm in cms:
+            raw = (cm["metadata"].get("annotations") or {}).get(
+                consts.GANG_FABRIC_ANNOTATION
+            )
+            if not raw:
+                continue
+            slice_name = cm["metadata"]["name"]
+            if slice_name.endswith("-gang"):
+                slice_name = slice_name[: -len("-gang")]
+            try:
+                artifact = json.loads(raw)
+            except ValueError:
+                log.warning("fabric: malformed artifact on %s", cm["metadata"]["name"])
+                continue
+            self._ingest_artifact(
+                slice_name, artifact, node_by_name, pool_of, link_map,
+                measured, summary, cm,
+            )
+
+        self._prune_drained_pools(link_map, set(pool_of.values()))
+        self._store_link_map(link_map)
+        self._publish_series(measured, link_map)
+        # episode bookkeeping: once a blamed host's label clears (repair
+        # completed, or the node left), its Event dedup entry goes too —
+        # a LATER second ICI failure is a new episode and must event
+        # again, the same lifecycle _link_events follows
+        self._host_events = {
+            host for host in self._host_events
+            if (node_by_name.get(host, {}).get("metadata", {}).get("labels") or {})
+            .get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED
+        }
+        summary["link_map"] = {
+            pool: sorted(edges) for pool, edges in sorted(link_map.items())
+        }
+        return summary
+
+    # -- per-gang ingestion --------------------------------------------------
+
+    def _ingest_artifact(
+        self,
+        slice_name: str,
+        artifact: dict,
+        node_by_name: Dict[str, dict],
+        pool_of: Dict[str, str],
+        link_map: Dict[str, Dict[str, dict]],
+        measured: Dict[Tuple[str, str], dict],
+        summary: dict,
+        cm: dict,
+    ) -> None:
+        members = [str(m) for m in (artifact.get("members") or [])]
+        edges = artifact.get("edges") or {}
+        if not members or not isinstance(edges, dict) or not edges:
+            return
+        if self._artifact_stale(slice_name, members, node_by_name):
+            summary["stale_artifacts"].append(slice_name)
+            return
+        pool = pool_of.get(members[0], "")
+        if not pool:
+            return
+        bws = sorted(
+            float(meta.get("bw_gbps") or 0.0) for meta in edges.values()
+        )
+        median = bws[len(bws) // 2]
+        floor = median * consts.FABRIC_LINK_DEGRADED_FRACTION
+        degraded_edges: List[str] = []
+        endpoint_counts: Dict[str, int] = {}
+        for edge, meta in sorted(edges.items()):
+            bw = float(meta.get("bw_gbps") or 0.0)
+            # a one-edge gang has no peers to compare against; the
+            # median of >=2 edges is the pool-relative reference
+            is_degraded = len(edges) >= 2 and bw < floor
+            measured[(pool, edge)] = {
+                "bw_gbps": bw,
+                "degraded": is_degraded,
+                "axis": str(meta.get("axis") or ""),
+                "gang": slice_name,
+            }
+            if is_degraded:
+                degraded_edges.append(edge)
+                for host in edge.split("|"):
+                    endpoint_counts[host] = endpoint_counts.get(host, 0) + 1
+            elif edge in link_map.get(pool, {}):
+                # the cable proved itself healthy again: clear the record
+                del link_map[pool][edge]
+                self._link_events.discard(edge)
+
+        host_blamed = {
+            host for host, count in endpoint_counts.items()
+            if count >= consts.FABRIC_HOST_BLAME_EDGES
+        }
+        for host in sorted(host_blamed):
+            self._blame_host(host, node_by_name.get(host), degraded_edges)
+            summary["host_blamed"].append(host)
+        for edge in degraded_edges:
+            summary["degraded_edges"].append(edge)
+            if any(host in host_blamed for host in edge.split("|")):
+                continue  # the endpoint is the story, not this cable
+            record = {
+                "bw_gbps": measured[(pool, edge)]["bw_gbps"],
+                "median_gbps": round(median, 3),
+                "axis": measured[(pool, edge)]["axis"],
+                "gang": slice_name,
+            }
+            link_map.setdefault(pool, {})[edge] = record
+            summary["link_blamed"].append(edge)
+            if edge not in self._link_events:
+                self.recorder.event(
+                    cm, "Warning", "IciLinkDegraded",
+                    f"gang {slice_name}: ICI link {edge} measured "
+                    f"{record['bw_gbps']:.1f} GB/s against a gang median of "
+                    f"{median:.1f} — blaming the link (single slow edge, both "
+                    "endpoints otherwise healthy); recording it in "
+                    f"{consts.LINK_HEALTH_CONFIGMAP} and re-placing gangs "
+                    "around it. Both endpoint hosts stay in service.",
+                )
+                self._link_events.add(edge)
+        summary["gangs"][slice_name] = {
+            "pool": pool,
+            "edges": len(edges),
+            "median_gbps": round(median, 3),
+            "degraded": sorted(degraded_edges),
+            "worst_edge": artifact.get("worst_edge", ""),
+        }
+
+    @staticmethod
+    def _artifact_stale(
+        slice_name: str, members: List[str], node_by_name: Dict[str, dict]
+    ) -> bool:
+        """A fabric matrix describes the links of the block its gang ran
+        on WHEN PROBED. After a re-place the gang ConfigMap (same name)
+        still carries the old matrix; blaming from it would convict
+        links the gang no longer touches — and an old matrix whose
+        members were ALL torn down (labels nulled) must not sneak back
+        in as an "implicit gang". Freshness test: every member exists;
+        when the slice name maps to a live placement (some node carries
+        its owner label), the artifact's member set must BE that
+        placement's current member set; only a slice with no placement
+        anywhere (a true whole-pool implicit gang) falls back to the
+        existence-only test."""
+        for member in members:
+            if member not in node_by_name:
+                return True
+        # slice names are "tpu-slice-<owner>" for both placed gangs
+        # (owner = the placement label value) and implicit pool gangs
+        # (owner = the pool name, which no node ever carries as a
+        # placement label). Hash-truncated long names fall through to
+        # the implicit branch — conservative, and such names never
+        # collide with a real owner label value anyway.
+        owner = slice_name
+        if owner.startswith("tpu-slice-"):
+            owner = owner[len("tpu-slice-"):]
+        placed = {
+            name for name, node in node_by_name.items()
+            if (node["metadata"].get("labels") or {}).get(consts.PLACEMENT_LABEL)
+            == owner
+        }
+        if placed:
+            return set(members) != placed
+        # no node carries this owner: implicit gang — but members that
+        # belong to some OTHER placement prove the block moved on
+        return any(
+            (node_by_name[m]["metadata"].get("labels") or {}).get(
+                consts.PLACEMENT_LABEL
+            )
+            for m in members
+        )
+
+    def _blame_host(self, host: str, node: Optional[dict], degraded_edges: List[str]) -> None:
+        """Multiple slow edges share this endpoint: indict the host's ICI
+        interface and hand it to the grey-failure FSM via the exporter's
+        own label — the analyzer never clears it; recovery is the repair
+        FSM's job (revalidation demands the perf signal clear), exactly
+        as for a floor-breaching chip. One known asymmetry: after the
+        FSM's reinstall, a restarted exporter with healthy node-LOCAL
+        probes may clear the label even though the ICI interface is
+        still bad — the host then uncordons, the next gang placed on it
+        re-indicts it, and the episode repeats. Each re-entry burns the
+        shared retry budget, so a genuinely bad interface terminates in
+        quarantine (the right call for hardware only a tech can fix)
+        rather than churning forever."""
+        if node is None:
+            return
+        labels = node["metadata"].get("labels") or {}
+        touching = [e for e in degraded_edges if host in e.split("|")]
+        if labels.get(consts.TPU_PERF_LABEL) != consts.PERF_DEGRADED:
+            try:
+                self.client.patch(
+                    "v1", "Node", host,
+                    {"metadata": {"labels": {
+                        consts.TPU_PERF_LABEL: consts.PERF_DEGRADED
+                    }}},
+                )
+            except errors.ApiError as e:
+                log.warning("fabric: host blame label on %s failed: %s", host, e)
+                return
+            # keep the pass's cached node current: the end-of-sync event
+            # bookkeeping reads this same dict and must see the label it
+            # just published, not the pre-patch snapshot
+            node["metadata"].setdefault("labels", {})[
+                consts.TPU_PERF_LABEL
+            ] = consts.PERF_DEGRADED
+        if host not in self._host_events:
+            self.recorder.event(
+                node, "Warning", "IciHostDegraded",
+                f"node {host}: {len(touching)} degraded ICI edges share this "
+                f"endpoint ({', '.join(touching)}) — blaming the host's ICI "
+                "interface, not the cables; entering the grey-failure repair "
+                "FSM.",
+            )
+            self._host_events.add(host)
+
+    # -- link-health map persistence -----------------------------------------
+
+    def _load_link_map(self) -> Dict[str, Dict[str, dict]]:
+        # a failed READ must propagate and abort the pass (sync's caller
+        # isolates it): treating a 500 as "no records" would diff {}
+        # against the previous pass's map and overwrite every standing
+        # link blame with an empty ConfigMap — erasing the cut the
+        # placement engine is routing around. Only NotFound (nothing
+        # ever recorded) means an empty map.
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
+        )
+        self._stored_map = parse_link_map(cm)
+        return {pool: dict(edges) for pool, edges in self._stored_map.items()}
+
+    def _store_link_map(self, link_map: Dict[str, Dict[str, dict]]) -> None:
+        link_map = {pool: edges for pool, edges in link_map.items() if edges}
+        stored = {
+            pool: edges
+            for pool, edges in getattr(self, "_stored_map", {}).items()
+            if edges
+        }
+        if link_map == stored:
+            return  # nothing changed: no write, no watch echo
+        data = {
+            pool: json.dumps({"edges": edges}, sort_keys=True)
+            for pool, edges in sorted(link_map.items())
+        }
+        cm = new_object(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace,
+            labels={"app.kubernetes.io/managed-by": consts.OPERATOR_NAME},
+            data=data,
+        )
+        try:
+            self.client.apply(cm)
+        except errors.ApiError as e:
+            log.warning("fabric: link-health map write failed: %s", e)
+
+    def _prune_drained_pools(
+        self, link_map: Dict[str, Dict[str, dict]], live_pools: Set[str]
+    ) -> None:
+        """A drained pool's records (and series) go with it: a frozen
+        last value would keep the link alert firing for hardware that
+        no longer exists."""
+        for pool in list(link_map):
+            if pool not in live_pools:
+                for edge in link_map[pool]:
+                    self._link_events.discard(edge)
+                del link_map[pool]
+
+    # -- series --------------------------------------------------------------
+
+    def _publish_series(
+        self,
+        measured: Dict[Tuple[str, str], dict],
+        link_map: Dict[str, Dict[str, dict]],
+    ) -> None:
+        live: Set[Tuple[str, str]] = set()
+        for (pool, edge), info in measured.items():
+            self.metrics.ici_link_bandwidth.labels(pool, edge).set(info["bw_gbps"])
+            self.metrics.ici_link_degraded.labels(pool, edge).set(
+                1 if info["degraded"] else 0
+            )
+            live.add((pool, edge))
+        # recorded-but-unmeasured links (no live gang straddles the cut
+        # anymore — that is the point) keep firing from the record
+        for pool, edges in link_map.items():
+            for edge, record in edges.items():
+                if (pool, edge) in live:
+                    continue
+                self.metrics.ici_link_bandwidth.labels(pool, edge).set(
+                    float(record.get("bw_gbps") or 0.0)
+                )
+                self.metrics.ici_link_degraded.labels(pool, edge).set(1)
+                live.add((pool, edge))
+        for pool, edge in self._link_series - live:
+            try:
+                self.metrics.ici_link_bandwidth.remove(pool, edge)
+                self.metrics.ici_link_degraded.remove(pool, edge)
+            except KeyError:
+                pass
+        self._link_series = live

@@ -515,27 +515,37 @@ class HealthReconciler:
         self.namespace = namespace
         self.repair_manager = NodeRepairManager(client, namespace)
         self.metrics = get_metrics()
+        from tpu_operator.controllers.fabric_telemetry import FabricTelemetryAggregator
         from tpu_operator.controllers.fleet_telemetry import FleetTelemetryAggregator
 
         self.fleet_telemetry = FleetTelemetryAggregator(client, namespace)
+        self.fabric_telemetry = FabricTelemetryAggregator(client, namespace)
 
     def _sync_fleet_telemetry(self) -> None:
         """Fleet data-plane rollups ride the health cadence: gang
         step-time/straggler series from the published gang artifacts,
-        deliverable-TFLOP/s and grey-failure counts from node labels.
-        Never fatal to the repair pass — observability must not block
-        remediation."""
+        deliverable-TFLOP/s and grey-failure counts from node labels —
+        and the fabric analyzer's link series + blame pass over the
+        published fabric matrices. Never fatal to the repair pass —
+        observability must not block remediation."""
         # setup_with_manager swaps self.client for the CachedReadClient
-        # after construction: re-point the aggregator so its per-pass
+        # after construction: re-point the aggregators so their per-pass
         # ConfigMap/Node lists ride the informer caches, not the wire
-        # (pure reads — unlike the repair manager, nothing here needs
-        # read-your-writes, so cached staleness is harmless)
+        # (the fabric analyzer's writes — blame label, link map — pass
+        # through the cache client to the wire; its blame decisions are
+        # re-derived every pass, so cached read staleness is harmless)
         self.fleet_telemetry.client = self.client
+        self.fabric_telemetry.client = self.client
         try:
             with trace.span("fleet-telemetry"):
                 self.fleet_telemetry.sync()
         except Exception as e:  # noqa: BLE001
             log.warning("fleet telemetry sync failed: %s", e)
+        try:
+            with trace.span("fabric-telemetry"):
+                self.fabric_telemetry.sync()
+        except Exception as e:  # noqa: BLE001
+            log.warning("fabric telemetry sync failed: %s", e)
 
     def reconcile(self, req: Request) -> Result:
         obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)

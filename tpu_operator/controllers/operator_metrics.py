@@ -158,6 +158,26 @@ class OperatorMetrics:
             "label (grey failures)",
             registry=reg,
         )
+        # ICI fabric series (controllers/fabric_telemetry.py ingests the
+        # per-gang fabric artifacts the slice manager publishes; edge =
+        # "hostA|hostB", the canonical sorted pair)
+        self.ici_link_bandwidth = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_ici_link_bandwidth_gbps",
+            "Measured point-to-point ICI bandwidth of one torus link, "
+            "from the last published gang fabric artifact",
+            ["pool", "edge"],
+            registry=reg,
+        )
+        self.ici_link_degraded = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_ici_link_degraded",
+            "1 while the link's measured bandwidth sits below the "
+            "degraded fraction of its gang's median edge (or the link "
+            "is recorded in the pool's link-health map)",
+            ["pool", "edge"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

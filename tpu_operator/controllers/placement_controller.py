@@ -47,8 +47,9 @@ class PlacementReconciler:
     def reconcile(self, req: Request) -> Result:
         slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         nodes = self.client.list("v1", "Node")
-        with trace.span("plan", slices=len(slices), nodes=len(nodes)):
-            engine = PlacementEngine(slices, nodes)
+        links = self._degraded_links()
+        with trace.span("plan", slices=len(slices), nodes=len(nodes), links=len(links)):
+            engine = PlacementEngine(slices, nodes, degraded_links=links)
             plan = engine.plan()
         with trace.span("apply-plan", deltas=len(plan.label_deltas)):
             self._apply_labels(plan)
@@ -74,6 +75,27 @@ class PlacementReconciler:
             # without any event this controller watches mapping to it
             return Result(requeue_after=consts.PLACEMENT_REPLAN_SECONDS)
         return Result()
+
+    def _degraded_links(self) -> List[tuple]:
+        """Severed ICI edges from the fabric analyzer's link-health map
+        (``consts.LINK_HEALTH_CONFIGMAP``): node-name pairs the engine
+        treats as cutting contiguity. A MISSING or malformed map means
+        no cuts (nothing was ever recorded) — but a failed read
+        propagates and aborts the pass like any other input read:
+        planning with "no cuts" because the apiserver 500'd could seat
+        a fresh gang straight across a known-degraded link."""
+        from tpu_operator.controllers.fabric_telemetry import parse_link_map
+
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
+        )
+        edges = []
+        for pool_edges in parse_link_map(cm).values():
+            for edge in pool_edges:
+                a, _, b = edge.partition("|")
+                if a and b:
+                    edges.append((a, b))
+        return sorted(edges)
 
     # -- plan application ----------------------------------------------------
 
@@ -183,10 +205,27 @@ def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
         new_labels = new["metadata"].get("labels") or {}
         return any(old_labels.get(k) != new_labels.get(k) for k in keys)
 
+    def link_map_changed(event_type, old, new) -> bool:
+        """The fabric analyzer's link-health map is a placement input: a
+        newly severed (or healed) edge must replan the queue — a gang
+        straddling the cut re-places, and a settled Unschedulable slice
+        may fit once a cut heals. Only the one ConfigMap matters; data
+        echoes with no change are dropped."""
+        if (new["metadata"].get("name") != consts.LINK_HEALTH_CONFIGMAP
+                or new["metadata"].get("namespace") != reconciler.namespace):
+            return False
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("data") or {}) != (new.get("data") or {})
+
     ctrl.watch(
         mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
         mapper=map_to_queue, predicate=placement_changed,
     )
     ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_queue, predicate=node_changed)
+    ctrl.watch(
+        mgr.informer_for("v1", "ConfigMap", reconciler.namespace),
+        mapper=map_to_queue, predicate=link_map_changed,
+    )
     mgr.add_controller(ctrl)
     return ctrl

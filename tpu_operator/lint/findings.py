@@ -216,6 +216,7 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPUOP-O001": (ERROR, "metric registered in code but missing from the COMPONENTS.md catalog"),
     "TPUOP-O002": (ERROR, "COMPONENTS.md catalog lists a metric no code registers"),
     "TPUOP-O003": (ERROR, "PrometheusRule expression references a metric no code registers (the alert can never fire)"),
+    "TPUOP-O004": (ERROR, "PrometheusRule alert missing summary/description annotations or a non-zero for: duration"),
     "TPUOP-D001": (ERROR, "shipped CRD schema drifted from the dataclass model"),
     "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
     "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),

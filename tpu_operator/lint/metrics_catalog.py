@@ -170,6 +170,57 @@ def analyze_rules(
     return findings
 
 
+# PromQL-style durations: 0, 0s, 0m, 0h... all mean "fire instantly"
+_ZERO_FOR_RE = re.compile(r"^0+[smhdwy]?$")
+
+
+def analyze_rule_hygiene(
+    manifest_groups: List[Tuple[str, List[dict]]],
+) -> List[Finding]:
+    """TPUOP-O004: every alert in a shipped PrometheusRule must carry
+    ``summary`` and ``description`` annotations and a non-zero ``for:``
+    duration. An annotation-less alert pages a human with a bare metric
+    name at 3am; a zero (or missing) ``for:`` fires on a single scrape
+    blip — both are the kind of rot only review used to catch."""
+    findings: List[Finding] = []
+    seen: set = set()
+
+    def flag(group: str, rule_name: str, alert: str, what: str) -> None:
+        key = (group, rule_name, alert, what)
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(make(
+            "TPUOP-O004", ERROR,
+            f"{group}:PrometheusRule/{rule_name}:{alert}",
+            what,
+        ))
+
+    for group, objects in manifest_groups:
+        for obj in objects:
+            if obj.get("kind") != "PrometheusRule":
+                continue
+            rule_name = (obj.get("metadata") or {}).get("name", "?")
+            for rule_group in (obj.get("spec") or {}).get("groups") or []:
+                for rule in rule_group.get("rules") or []:
+                    alert = rule.get("alert")
+                    if not alert:
+                        continue  # recording rules have no pager contract
+                    annotations = rule.get("annotations") or {}
+                    for required in ("summary", "description"):
+                        if not str(annotations.get(required) or "").strip():
+                            flag(group, rule_name, alert,
+                                 f"alert carries no `{required}` annotation — "
+                                 "the page names a metric, not a meaning")
+                    duration = str(rule.get("for") or "").strip()
+                    if not duration or _ZERO_FOR_RE.match(duration):
+                        flag(group, rule_name, alert,
+                             "alert has no (or zero) `for:` duration — it "
+                             "fires on a single scrape blip instead of a "
+                             "sustained condition")
+    return findings
+
+
 def analyze(
     source_root: Optional[str] = None, components_path: Optional[str] = None
 ) -> List[Finding]:

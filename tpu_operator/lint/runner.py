@@ -102,9 +102,12 @@ def run_lint(
         findings.extend(drift.analyze())
     if "metrics" in selected:
         findings.extend(metrics_catalog.analyze())
-        # O003 rides the same rendered groups the manifest rules lint:
-        # every series a shipped PrometheusRule references must exist
+        # O003/O004 ride the same rendered groups the manifest rules
+        # lint: every series a shipped PrometheusRule references must
+        # exist, and every alert must page with meaning (summary/
+        # description) over a sustained condition (non-zero for:)
         findings.extend(metrics_catalog.analyze_rules(groups))
+        findings.extend(metrics_catalog.analyze_rule_hygiene(groups))
     findings = dedupe(findings)
 
     baseline = Baseline.load(

@@ -217,6 +217,81 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("telemetry.txt", f"# collection failed: {e}\n")
 
     try:
+        # the fabric view: the per-pool link-health map (the analyzer's
+        # standing blame records), every gang's published fabric matrix,
+        # the worst-10 measured edges fleet-wide, and the blame split —
+        # where "slow gang: chip or link?" gets answered (README)
+        import json as _json
+
+        from tpu_operator import consts as _consts
+
+        lines = ["# link health (operator-recorded link blame)"]
+        link_cm = client.get_or_none(
+            "v1", "ConfigMap", _consts.LINK_HEALTH_CONFIGMAP, namespace
+        )
+        recorded_edges = []
+        if link_cm is not None and (link_cm.get("data") or {}):
+            for pool, raw in sorted((link_cm.get("data") or {}).items()):
+                lines.append(f"{pool}  {raw}")
+                try:
+                    for edge, rec in (_json.loads(raw).get("edges") or {}).items():
+                        recorded_edges.append((pool, edge, rec))
+                except ValueError:
+                    pass
+        else:
+            lines.append("# none recorded")
+        lines.append("")
+        lines.append("# gang fabric artifacts")
+        gangs = []
+        measured = []
+        for cm in client.list("v1", "ConfigMap", namespace):
+            raw = (cm["metadata"].get("annotations") or {}).get(
+                _consts.GANG_FABRIC_ANNOTATION
+            )
+            if not raw:
+                continue
+            gangs.append(f"{cm['metadata']['name']}  {raw}")
+            try:
+                artifact = _json.loads(raw)
+                for edge, meta in (artifact.get("edges") or {}).items():
+                    measured.append(
+                        (float(meta.get("bw_gbps") or 0.0), edge,
+                         cm["metadata"]["name"], str(meta.get("axis") or "-"))
+                    )
+            except ValueError:
+                pass
+        lines.extend(gangs or ["# none"])
+        lines.append("")
+        lines.append("# worst 10 measured edges (GB/s ascending)")
+        worst = sorted(measured)[:10]
+        if worst:
+            lines.extend(
+                f"{bw:.3f}  {edge}  axis={axis}  gang={gang}"
+                for bw, edge, gang, axis in worst
+            )
+        else:
+            lines.append("# none measured")
+        lines.append("")
+        lines.append("# blame decisions")
+        blames = [
+            f"link  {edge}  pool={pool}  "
+            f"bw={rec.get('bw_gbps', '?')} median={rec.get('median_gbps', '?')}  "
+            f"gang={rec.get('gang', '-')}"
+            for pool, edge, rec in recorded_edges
+        ]
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(_consts.TPU_PERF_LABEL) == _consts.PERF_DEGRADED:
+                blames.append(
+                    f"host  {node['metadata']['name']}  perf=degraded  "
+                    f"repair={labels.get(_consts.REPAIR_STATE_LABEL, '-')}"
+                )
+        lines.extend(blames or ["# none"])
+        emit("fabric.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("fabric.txt", f"# collection failed: {e}\n")
+
+    try:
         # cluster-wide: events for cluster-scoped objects (the CRs) land
         # in "default" per apiserver rules, not the operator namespace
         events = client.list("v1", "Event")

@@ -169,7 +169,12 @@ class Plan:
 
 
 class PlacementEngine:
-    def __init__(self, slices: Sequence[ObjectDict], nodes: Sequence[ObjectDict]):
+    def __init__(
+        self,
+        slices: Sequence[ObjectDict],
+        nodes: Sequence[ObjectDict],
+        degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+    ):
         self.slices = {s["metadata"]["name"]: s for s in slices}
         self.nodes = {n["metadata"]["name"]: n for n in nodes}
         self.requests: Dict[str, PlacementRequest] = {}
@@ -178,9 +183,13 @@ class PlacementEngine:
             if req is not None:
                 self.requests[req.name] = req
         # pool name -> (NodePool, Torus); unavailable hosts are cells the
-        # allocator can neither place on nor count as preemptable
+        # allocator can neither place on nor count as preemptable, and
+        # degraded links (the fabric analyzer's link-health map, node
+        # name pairs) are edges no block may straddle — a cut through
+        # the torus that removes zero hosts
         self.pools: Dict[str, tuple] = {}
         self.node_pool: Dict[str, str] = {}
+        links = [tuple(edge) for edge in (degraded_links or [])]
         for pool in get_node_pools(list(self.nodes.values())):
             members = [self.nodes[n] for n in pool.node_names]
             torus = Torus.from_nodes(
@@ -194,6 +203,7 @@ class PlacementEngine:
             torus.set_unavailable(
                 [n["metadata"]["name"] for n in members if node_unavailable(n)]
             )
+            torus.set_degraded_edges(links)  # foreign endpoints ignored
             self.pools[pool.name] = (pool, torus)
             for name in pool.node_names:
                 self.node_pool[name] = pool.name
@@ -288,7 +298,8 @@ class PlacementEngine:
                 plan.teardowns.append(req.name)
                 plan.events.append((
                     req.name, "Warning", "PlacementDegraded",
-                    f"gang for {req.name} lost a member or its shape changed; re-placing",
+                    f"gang for {req.name} lost a member, its shape changed, "
+                    "or a fabric link inside its block degraded; re-placing",
                 ))
                 pending.append(req)
 

@@ -141,6 +141,11 @@ class Torus:
         self.coords_of = {n: c for c, n in self.node_at.items()}
         self._owner: Dict[Coord, str] = {}
         self._unavailable: Set[Coord] = set()
+        # severed ICI links (fabric-telemetry link blame): a block may
+        # not contain BOTH endpoints of a cut edge — its collectives
+        # would route the degraded cable — but each endpoint host alone
+        # stays fully placeable
+        self._cut_edges: Set[FrozenSet[Coord]] = set()
 
     # -- construction --------------------------------------------------------
 
@@ -208,6 +213,27 @@ class Torus:
             if at is not None:
                 self._unavailable.add(at)
 
+    def set_degraded_edges(self, edges: Sequence[Tuple[str, str]]) -> None:
+        """Mark ICI links as severed, by endpoint NODE NAMES (the
+        link-health map's vocabulary). Unknown endpoints are ignored —
+        a record can outlive a host. Unlike ``set_unavailable`` this
+        removes no capacity: only block shapes that would straddle the
+        edge become infeasible."""
+        for a, b in edges:
+            at_a, at_b = self.coords_of.get(a), self.coords_of.get(b)
+            if at_a is not None and at_b is not None and at_a != at_b:
+                self._cut_edges.add(frozenset((at_a, at_b)))
+
+    def _edge_cut(self, cells: Sequence[Coord]) -> bool:
+        """Whether a block covering ``cells`` straddles a severed edge:
+        both endpoints inside one block means the block's sub-torus —
+        and the ICI ring order worker ids follow — routes through the
+        degraded link."""
+        if not self._cut_edges:
+            return False
+        block = set(cells)
+        return any(edge <= block for edge in self._cut_edges)
+
     def occupy(self, owner: str, cells: Sequence[Coord]) -> None:
         for cell in cells:
             self._owner[cell] = owner
@@ -266,6 +292,11 @@ class Torus:
         worker ids to follow the ICI wiring."""
         if not cells:
             return False
+        if self._edge_cut(cells):
+            # a severed link inside the block cuts its contiguity: the
+            # cells may be geometrically adjacent, but the gang's
+            # collectives would route the degraded cable — re-place
+            return False
         return any(
             tuple(cells) == self._block_cells(cells[0], oriented)
             for oriented in self.orientations(shape)
@@ -308,6 +339,8 @@ class Torus:
                 ):
                     continue  # block would hang past a mesh edge
                 cells = self._block_cells(origin, oriented)
+                if self._edge_cut(cells):
+                    continue  # the block would straddle a severed link
                 victims: Set[str] = set()
                 feasible = True
                 for cell in cells:
@@ -341,9 +374,12 @@ class Torus:
         """External fragmentation of the free space: 1 - (largest free
         block volume / free hosts), probing cubes clamped to the torus
         dims (a 2-D pool's probe is a square with unit z — otherwise an
-        empty flat torus would read as fragmented). 0.0 = all free
-        capacity reachable as one block (or nothing free at all); toward
-        1.0 = plenty of free hosts but no contiguous block to place on."""
+        empty flat torus would read as fragmented). Severed edges count:
+        a probe block straddling a degraded link is not placeable, so a
+        cut through otherwise-free space reads as fragmentation — which
+        it is. 0.0 = all free capacity reachable as one block (or
+        nothing free at all); toward 1.0 = plenty of free hosts but no
+        contiguous block to place on."""
         free = self.free_count()
         if free == 0:
             return 0.0
@@ -353,7 +389,8 @@ class Torus:
             if volume > free:
                 continue
             for origin in sorted(self.node_at):
-                if all(self._free(c) for c in self._block_cells(origin, shape)):
+                cells = self._block_cells(origin, shape)
+                if all(self._free(c) for c in cells) and not self._edge_cut(cells):
                     return round(1.0 - volume / free, 4)
         # unreachable: the side=1 probe is a single cell, and free > 0
         # guarantees at least one free cell that is its own 1x1x1 block

@@ -9,6 +9,8 @@ CUDA.runWorkload validator/main.go:1232-1308) — with JAX/XLA programs:
                (the BASELINE north-star metric)
     burnin     a sharded transformer train step exercising MXU + ICI +
                HBM simultaneously (gang burn-in for multi-host slices)
+    fabric     per-link ICI bandwidth + per-axis allreduce latency sweep
+               over a placed block's torus (feeds edge-aware blame)
     distributed multi-host / multi-slice jax.distributed bring-up
 
 Everything here runs identically on a virtual CPU mesh

@@ -1,0 +1,266 @@
+"""ICI fabric probe: per-link bandwidth + per-axis collective latency.
+
+The third leg of the observability stack. PR 6 traced the control plane
+and PR 7 measured nodes and gangs — but both stop at host granularity,
+while a slow gang's root cause is as often a *link* as a chip
+("Exploration of TPUs for AI Applications" names interconnect
+degradation the dominant grey-failure mode at pod scale). This probe
+sweeps the placed block's torus axes and times each edge individually,
+so a slow link and a slow chip stop being indistinguishable.
+
+Two measurements per placed gang:
+
+  - **per-edge bandwidth**: for every torus-adjacent device pair of the
+    block (each axis's +1 neighbors, plus the wrap link on axes the
+    generation actually wraps — v4/v5p), a timed round-trip transfer
+    between exactly that pair. Edges are keyed by block coordinate
+    ("0-0-0|1-0-0") and translated to host names by
+    :func:`gang_fabric_artifact` using the block's row-major worker
+    order — the same order the placement engine wires worker ids by.
+  - **per-axis allreduce latency**: a ``shard_map``/``psum`` chain over
+    each mesh axis alone (the neighbor-exchange ring the collective
+    lowers to), timed per iteration — the matrix row a degraded axis
+    shows up in even when no single edge stands out.
+
+Rides :mod:`tpu_operator.workloads.compat` so the shard_map sweep runs
+on both old and current jax. Everything works identically on the
+virtual CPU mesh (where timings are mechanical, not physical — the sim
+and CI gates seed degradation synthetically via
+:func:`gang_fabric_artifact`'s edge map, not wall clocks) and on a real
+slice, where the pairwise transfer rides the ICI DMA path.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_operator.placement.torus import parse_shape, worker_coords
+
+Coord = Tuple[int, int, int]
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def _coord_str(coord: Sequence[int]) -> str:
+    return "-".join(str(c) for c in coord)
+
+
+def edge_key(a: str, b: str) -> str:
+    """Canonical edge id: the two endpoint names sorted and joined by
+    '|', so publisher and analyzer agree on the key regardless of which
+    direction measured it."""
+    return "|".join(sorted((a, b)))
+
+
+def enumerate_block_edges(
+    shape: Coord, wrap: bool = False
+) -> List[Tuple[Coord, Coord, str, bool]]:
+    """Every ICI edge of a block torus: (coord_a, coord_b, axis, is_wrap)
+    for each axis's +1 neighbors, plus the wrap edge on axes longer than
+    2 when ``wrap`` (on a 2-long axis the wrap link IS the interior
+    link — counting it twice would invent a cable). Deterministic order:
+    axis-major, then row-major origin."""
+    edges: List[Tuple[Coord, Coord, str, bool]] = []
+    for axis in range(3):
+        dim = shape[axis]
+        if dim < 2:
+            continue
+        for k in range(shape[2]):
+            for j in range(shape[1]):
+                for i in range(shape[0]):
+                    at = (i, j, k)
+                    if at[axis] < dim - 1:
+                        to = list(at)
+                        to[axis] += 1
+                        edges.append((at, tuple(to), AXIS_NAMES[axis], False))
+                    elif wrap and dim > 2:
+                        to = list(at)
+                        to[axis] = 0
+                        edges.append((at, tuple(to), AXIS_NAMES[axis], True))
+    return edges
+
+
+def _device_grid(devices: List, shape: Coord) -> Dict[Coord, object]:
+    """Row-major (x fastest) layout of devices onto the block shape —
+    the worker-id enumeration order, so device i sits at
+    ``worker_coords(i, shape)``."""
+    return {worker_coords(i, shape): d for i, d in enumerate(devices)}
+
+
+def _time_pair_transfer(dev_a, dev_b, payload, iters: int) -> float:
+    """Seconds per one-way transfer between exactly two devices: a timed
+    chain of round trips (a->b->a counts as two transfers), forced each
+    hop so the clock covers the wire, not the enqueue."""
+    import jax
+
+    x = jax.device_put(payload, dev_a)
+    x.block_until_ready()
+    # warm the transfer path (first hop may allocate / establish DMA)
+    jax.device_put(jax.device_put(x, dev_b), dev_a).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = jax.device_put(x, dev_b)
+        x.block_until_ready()
+        x = jax.device_put(x, dev_a)
+        x.block_until_ready()
+    dt = time.perf_counter() - t0
+    return dt / (2 * iters)
+
+
+def _axis_allreduce_latency(mesh, axis: str, iters: int) -> float:
+    """Microseconds per psum over ONE mesh axis (all other axes manual
+    but unreduced) — the per-axis row of the latency matrix."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.workloads.compat import shard_map
+
+    n = mesh.shape[axis]
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=P(*mesh.axis_names), out_specs=P(*mesh.axis_names),
+        check_vma=False,
+    )
+    def ar_step(x):
+        return jax.lax.psum(x, axis) / n
+
+    @jax.jit
+    def chain(x):
+        return jax.lax.fori_loop(0, iters, lambda i, z: ar_step(z), x)[
+            (0,) * x.ndim
+        ]
+
+    dims = tuple(mesh.shape[name] for name in mesh.axis_names)
+    x = jnp.ones(tuple(d * 4 for d in dims), dtype=jnp.float32)
+    float(chain(x))  # compile + warm the exact program
+    t0 = time.perf_counter()
+    float(chain(x))
+    return (time.perf_counter() - t0) / iters * 1e6
+
+
+def run_fabric_probe(
+    shape: str,
+    devices: Optional[List] = None,
+    wrap: bool = False,
+    size_mb: float = 1.0,
+    iters: int = 4,
+) -> dict:
+    """Sweep the fabric of a block of devices arranged as ``shape``
+    ("2x4x1" hosts / chips — whatever granularity the caller's devices
+    are). Returns the per-edge bandwidth map (block-coordinate keys),
+    the per-axis allreduce latency matrix, and a numerics check (a full
+    psum must still sum correctly — a probe that can't add has no
+    business timing).
+
+    ``wrap`` adds the wraparound edges on axes longer than 2 — only
+    truthful on torus generations (v4/v5p); mesh pools must leave it
+    off or the probe times a link that does not exist.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    dims = parse_shape(shape)
+    if dims is None:
+        raise ValueError(f"unparseable fabric shape {shape!r}")
+    devices = list(devices if devices is not None else jax.devices())
+    need = dims[0] * dims[1] * dims[2]
+    if len(devices) < need:
+        raise ValueError(
+            f"shape {shape} needs {need} devices, have {len(devices)}"
+        )
+    devices = devices[:need]
+    grid = _device_grid(devices, dims)
+    mesh = Mesh(np.array(devices).reshape(dims), AXIS_NAMES)
+
+    # numerics first: psum over the whole mesh through the same
+    # shard_map shim the timed sweep uses
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.workloads.compat import shard_map
+
+    @partial(shard_map, mesh=mesh, in_specs=P(AXIS_NAMES), out_specs=P())
+    def psum_all(x):
+        # the leading dim shards over ALL mesh axes jointly, so one
+        # psum over the full axis tuple is the true global sum
+        return jax.lax.psum(x, AXIS_NAMES)
+
+    probe = jnp.arange(need * 8, dtype=jnp.float32).reshape(need, 8)
+    with mesh:
+        got = np.asarray(psum_all(probe))
+    want = np.asarray(probe).sum(axis=0, keepdims=True)
+    if not np.allclose(got, want, rtol=1e-5):
+        raise RuntimeError("fabric probe psum numerics mismatch")
+
+    # per-edge point-to-point bandwidth
+    payload = jnp.ones((int(size_mb * 1024 * 1024 / 4),), dtype=jnp.float32)
+    payload_bytes = payload.size * 4
+    edges: Dict[str, dict] = {}
+    for at, to, axis, is_wrap in enumerate_block_edges(dims, wrap=wrap):
+        dt = _time_pair_transfer(grid[at], grid[to], payload, iters)
+        edges[edge_key(_coord_str(at), _coord_str(to))] = {
+            "bw_gbps": round(payload_bytes / max(dt, 1e-9) / 1e9, 3),
+            "axis": axis,
+            "wrap": is_wrap,
+        }
+
+    # per-axis allreduce latency matrix
+    axis_allreduce_us: Dict[str, float] = {}
+    with mesh:
+        for axis_idx, name in enumerate(AXIS_NAMES):
+            if dims[axis_idx] < 2:
+                continue
+            axis_allreduce_us[name] = round(
+                _axis_allreduce_latency(mesh, name, iters), 1
+            )
+
+    return {
+        "shape": "x".join(str(d) for d in dims),
+        "devices": need,
+        "platform": devices[0].platform,
+        "wrap": wrap,
+        "edges": edges,
+        "axis_allreduce_us": axis_allreduce_us,
+        "ok": True,
+    }
+
+
+def gang_fabric_artifact(probe: dict, hosts: Sequence[str]) -> dict:
+    """Translate a probe report's block-coordinate edges into the gang
+    artifact the slice manager publishes: host-name edge keys (the
+    block's row-major worker order maps coordinate -> host exactly the
+    way the placement engine wired worker ids), plus the summary fields
+    the analyzer and must-gather read — median / worst edge. ``hosts``
+    is the gang's node-name list in worker-id order."""
+    dims = parse_shape(str(probe.get("shape") or ""))
+    if dims is None:
+        raise ValueError(f"probe carries unparseable shape {probe.get('shape')!r}")
+    host_at = {
+        _coord_str(worker_coords(i, dims)): name for i, name in enumerate(hosts)
+    }
+    edges: Dict[str, dict] = {}
+    for key, meta in (probe.get("edges") or {}).items():
+        a, _, b = key.partition("|")
+        host_a, host_b = host_at.get(a), host_at.get(b)
+        if host_a is None or host_b is None:
+            continue  # probe shape larger than the gang: ignore the overhang
+        edges[edge_key(host_a, host_b)] = dict(meta)
+    ordered = sorted(edges.items(), key=lambda kv: kv[1].get("bw_gbps", 0.0))
+    artifact = {
+        "hosts": len(hosts),
+        "members": list(hosts),
+        "shape": probe.get("shape", ""),
+        "edges": edges,
+        "axis_allreduce_us": dict(probe.get("axis_allreduce_us") or {}),
+    }
+    if ordered:
+        bws = sorted(v.get("bw_gbps", 0.0) for _, v in ordered)
+        artifact["worst_edge"] = ordered[0][0]
+        artifact["min_edge_gbps"] = round(bws[0], 3)
+        artifact["median_edge_gbps"] = round(bws[len(bws) // 2], 3)
+    return artifact
