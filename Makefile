@@ -1,7 +1,7 @@
 # Build/test entrypoints (reference: Makefile + versions.mk targets).
 PYTHON ?= python3
 
-.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render lint native images clean
+.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render lint racecheck native images clean
 
 all: native test
 
@@ -40,9 +40,15 @@ render:
 validate:
 	$(PYTHON) scripts/validate_rendered.py
 
-# static analysis: manifest rules, RBAC least-privilege proof, drift
+# static analysis: manifest rules, RBAC least-privilege proof, drift,
+# metrics catalog, concurrency (lock discipline / deadlock / blocking)
 lint:
 	$(PYTHON) -m tpu_operator.cmd.tpuop_lint
+
+# runtime race harness: the full suite under instrumented locks — any
+# lock-order cycle or mutation-tripwire hit fails the owning test
+racecheck:
+	TPUOP_RACECHECK=1 $(PYTHON) -m pytest tests/ -q -m "not slow"
 
 native:
 	$(MAKE) -C native

@@ -23,6 +23,16 @@ import json
 summary = json.load(open("/tmp/lint-report.json"))["summary"]
 print(f"tpuop-lint: {summary}")
 EOF
+echo "== racecheck: multi-thread drills + compressed chaos soak under instrumented locks =="
+# runtime concurrency gate: the leader-failover and crash-recovery
+# drills plus the bounded chaos soak re-run with TPUOP_RACECHECK=1 —
+# every lock is instrumented (per-thread acquisition order into one
+# global graph) and the informer-cache/FakeClient-store mutation
+# tripwires are armed; any lock-order cycle or concurrent-writer hit
+# fails the owning test via the conftest guard
+TPUOP_RACECHECK=1 python3 -m pytest tests/test_racecheck.py -q
+TPUOP_RACECHECK=1 python3 -m pytest tests/test_chaos.py \
+  -q -m "not slow" -k "Soak or CrashRestart or LeaderFailover"
 echo "== bench smoke: requests-per-reconcile stays flat 64 -> 256 nodes =="
 # O(changes) gate: fails when rpr[256] > 1.5 x rpr[64] — the regression
 # shape a reintroduced full-scan or full-object write produces

@@ -33,3 +33,26 @@ def fake_client():
     from tpu_operator.kube.fake import FakeClient
 
     return FakeClient()
+
+
+@pytest.fixture(autouse=True)
+def _racecheck_guard():
+    """Under TPUOP_RACECHECK=1 every test runs inside the runtime race
+    harness: any lock-order cycle or mutation-tripwire hit recorded
+    during the test fails THAT test (attribution beats a session-end
+    dump). The order graph itself is kept across tests on purpose — an
+    ordering learned in one test legitimately constrains the next; only
+    the violation log position is per-test. A no-op when the harness is
+    off (the default)."""
+    from tpu_operator.kube import racecheck
+
+    if not racecheck.enabled():
+        yield
+        return
+    before = len(racecheck.violations())
+    yield
+    new = racecheck.violations()[before:]
+    assert not new, (
+        "racecheck: %d concurrency violation(s) during this test:\n%s"
+        % (len(new), "\n".join(repr(v) for v in new))
+    )

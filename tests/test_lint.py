@@ -431,3 +431,346 @@ class TestShippedRepoLintsClean:
         keys = [(f.rule, f.location, f.message) for f in deduped]
         assert len(keys) == len(set(keys))
         assert len(deduped) < len(all_findings)
+
+
+# ---------------------------------------------------------------------------
+# Seeded concurrency defects (TPUOP-C rules).
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencySeededDefects:
+    """One minimal module per TPUOP-C rule: the seeded defect fires
+    exactly once, the corrected version is silent, and a baseline entry
+    suppresses the finding (so justified exceptions stay expressible)."""
+
+    def analyze(self, source):
+        from tpu_operator.lint import concurrency
+
+        return concurrency.analyze_source(source, "seeded.py")
+
+    UNGUARDED = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def drop(self, k):
+        self._items.pop(k, None)
+"""
+
+    def test_c001_unguarded_attribute_fires_once(self):
+        findings = self.analyze(self.UNGUARDED)
+        assert [f.rule for f in findings] == ["TPUOP-C001"]
+        assert findings[0].location == "py:seeded.py:Cache._items"
+        assert "drop" in findings[0].message
+
+    def test_c001_consistent_locking_is_clean(self):
+        fixed = self.UNGUARDED.replace(
+            "        self._items.pop(k, None)",
+            "        with self._lock:\n            self._items.pop(k, None)",
+        )
+        assert self.analyze(fixed) == []
+
+    def test_c001_guarded_by_pragma_suppresses(self):
+        """A helper the caller locks for declares it instead of re-locking."""
+        pragmad = self.UNGUARDED.replace(
+            "    def drop(self, k):",
+            "    # tpuop-lint: guarded-by=_lock\n    def drop(self, k):",
+        )
+        assert self.analyze(pragmad) == []
+
+    def test_c001_init_mutations_exempt(self):
+        """Construction precedes sharing: __init__ writes are never
+        'unguarded'."""
+        only_init = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+"""
+        assert self.analyze(only_init) == []
+
+    ABBA = """
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            self._nested()
+
+    def _nested(self):
+        with self._b:
+            pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+    def test_c002_abba_inversion_fires_once_through_call_chain(self):
+        findings = self.analyze(self.ABBA)
+        assert [f.rule for f in findings] == ["TPUOP-C002"]
+        assert findings[0].location.startswith("lockcycle:")
+        assert "AB._a" in findings[0].message and "AB._b" in findings[0].message
+
+    def test_c002_consistent_order_is_clean(self):
+        fixed = self.ABBA.replace(
+            "        with self._b:\n            with self._a:\n                pass",
+            "        with self._a:\n            with self._b:\n                pass",
+        )
+        assert self.analyze(fixed) == []
+
+    SLEEPER = """
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.5)
+            self._n += 1
+"""
+
+    def test_c003_sleep_under_lock_fires_once(self):
+        findings = self.analyze(self.SLEEPER)
+        assert [f.rule for f in findings] == ["TPUOP-C003"]
+        assert findings[0].location == "py:seeded.py:S.slow"
+        assert "time.sleep" in findings[0].message
+
+    def test_c003_sleep_outside_lock_is_clean(self):
+        fixed = """
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def slow(self):
+        time.sleep(0.5)
+        with self._lock:
+            self._n += 1
+"""
+        assert self.analyze(fixed) == []
+
+    LEAKED = """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+"""
+
+    def test_c004_leaked_thread_fires_once(self):
+        findings = self.analyze(self.LEAKED)
+        assert [f.rule for f in findings] == ["TPUOP-C004"]
+        assert findings[0].location == "py:seeded.py:W.start"
+
+    def test_c004_daemon_or_joined_is_clean(self):
+        daemon = self.LEAKED.replace(
+            "threading.Thread(target=self._run)",
+            "threading.Thread(target=self._run, daemon=True)",
+        )
+        assert self.analyze(daemon) == []
+        joined = self.LEAKED + """
+    def stop(self):
+        self._t.join()
+"""
+        assert self.analyze(joined) == []
+
+    @pytest.mark.parametrize(
+        "source,rule,location",
+        [
+            (UNGUARDED, "TPUOP-C001", "py:seeded.py:Cache._items"),
+            (SLEEPER, "TPUOP-C003", "py:seeded.py:S.slow"),
+            (LEAKED, "TPUOP-C004", "py:seeded.py:W.start"),
+        ],
+    )
+    def test_c_rules_are_baseline_suppressible(self, source, rule, location):
+        findings = self.analyze(source)
+        baseline = Baseline.from_text(f"{rule} {location}  # fixture justification\n")
+        applied = baseline.apply(findings)
+        assert all(f.suppressed for f in applied)
+        assert not failing(applied)
+        assert not baseline.unused_entries()
+
+    def test_c002_baseline_suppressible(self):
+        findings = self.analyze(self.ABBA)
+        baseline = Baseline.from_text(
+            "TPUOP-C002 lockcycle:AB._a  # fixture justification\n"
+        )
+        applied = baseline.apply(findings)
+        assert all(f.suppressed for f in applied)
+        assert not failing(applied)
+
+    def test_shipped_tree_concurrency_clean_or_baselined(self):
+        """The acceptance gate for the new family: every TPUOP-C finding
+        in the shipped package is suppressed by a justified baseline
+        entry — the tree carries no unexplained concurrency debt."""
+        findings = runner.run_lint(only=["concurrency"])
+        c_rules = [f for f in findings if f.rule.startswith("TPUOP-C")]
+        unsuppressed = [f for f in c_rules if not f.suppressed]
+        assert not unsuppressed, unsuppressed
+
+
+# ---------------------------------------------------------------------------
+# Seeded gauge-retirement defects (TPUOP-O005).
+# ---------------------------------------------------------------------------
+
+
+class TestGaugeRetirement:
+    def _analyze_tree(self, tmp_path, source):
+        from tpu_operator.lint import metrics_catalog
+
+        (tmp_path / "mod.py").write_text(source)
+        return metrics_catalog.analyze_gauge_retirement(str(tmp_path))
+
+    SEEDED = """
+import prometheus_client
+
+gang_latency = prometheus_client.Gauge(
+    "tpu_operator_gang_decode_latency_seconds", "doc", ["slice"]
+)
+"""
+
+    def test_o005_gauge_without_removal_fires_once(self, tmp_path):
+        findings = self._analyze_tree(tmp_path, self.SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-O005"]
+        assert findings[0].location == "metric:tpu_operator_gang_decode_latency_seconds"
+
+    def test_o005_direct_removal_satisfies(self, tmp_path):
+        fixed = self.SEEDED + """
+def retire(slice_name):
+    gang_latency.remove(slice_name)
+"""
+        assert self._analyze_tree(tmp_path, fixed) == []
+
+    def test_o005_loop_tuple_removal_satisfies(self, tmp_path):
+        """The exporter idiom: several gauges retired through one loop
+        variable over a tuple of attributes."""
+        source = """
+import prometheus_client
+
+class M:
+    def __init__(self):
+        self.link_bw = prometheus_client.Gauge(
+            "tpu_operator_seeded_link_bw", "doc", ["pool", "edge"])
+        self.link_bad = prometheus_client.Gauge(
+            "tpu_operator_seeded_link_bad", "doc", ["pool", "edge"])
+
+    def retire(self, pool, edge):
+        for gauge in (self.link_bw, self.link_bad):
+            gauge.remove(pool, edge)
+"""
+        assert self._analyze_tree(tmp_path, source) == []
+
+    def test_o005_static_label_dimensions_exempt(self, tmp_path):
+        """{controller}/{node}-labelled gauges are fixed for the life of
+        the process — no retirement needed."""
+        source = """
+import prometheus_client
+
+depth = prometheus_client.Gauge(
+    "tpu_operator_seeded_queue_depth", "doc", ["controller"])
+own_node = prometheus_client.Gauge(
+    "tpu_exporter_seeded_chip_total", "doc", ["node"])
+"""
+        assert self._analyze_tree(tmp_path, source) == []
+
+    def test_o005_baseline_suppressible(self, tmp_path):
+        findings = self._analyze_tree(tmp_path, self.SEEDED)
+        baseline = Baseline.from_text(
+            "TPUOP-O005 metric:tpu_operator_gang_decode_latency_seconds  # fixture\n"
+        )
+        applied = baseline.apply(findings)
+        assert all(f.suppressed for f in applied)
+        assert not failing(applied)
+
+    def test_all_shipped_collectors_clean(self):
+        """Every dynamically-labelled gauge the package registers has a
+        reachable retire site — the stale-series class PRs 7 and 8 fixed
+        by hand stays fixed."""
+        from tpu_operator.lint import metrics_catalog
+
+        assert metrics_catalog.analyze_gauge_retirement() == []
+
+
+# ---------------------------------------------------------------------------
+# Lint runner quality-of-life.
+# ---------------------------------------------------------------------------
+
+
+class TestRunnerQoL:
+    def test_json_report_carries_analyzer_wall_time(self, capsys):
+        from tpu_operator.cmd.tpuop_lint import main
+
+        assert main(["--only", "concurrency", "--format", "json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert "concurrency" in report["analyzer_seconds"]
+        assert report["analyzer_seconds"]["concurrency"] >= 0
+
+    def test_only_accepts_rule_ids(self, capsys):
+        """--only TPUOP-C003 runs just the concurrency family and keeps
+        only that rule's rows."""
+        from tpu_operator.cmd.tpuop_lint import main
+
+        assert main(["--only", "TPUOP-C003", "--format", "json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert list(report["analyzer_seconds"]) == ["concurrency"]
+        assert {f["rule"] for f in report["findings"]} <= {"TPUOP-C003", "TPUOP-B001"}
+
+    def test_skip_drops_analyzers_and_rules(self, capsys):
+        from tpu_operator.cmd.tpuop_lint import main
+
+        assert main([
+            "--skip", "manifest,rbac,drift,TPUOP-O005", "--format", "json",
+        ]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert set(report["analyzer_seconds"]) == {"metrics", "concurrency"}
+        assert all(f["rule"] != "TPUOP-O005" for f in report["findings"])
+
+    def test_unknown_selector_token_is_a_usage_error(self, capsys):
+        from tpu_operator.cmd.tpuop_lint import main
+
+        assert main(["--only", "bogus"]) == 2
+        assert main(["--skip", "TPUOP-Z999"]) == 2
+
+    def test_mustgather_lint_report_includes_new_families(self, tmp_path, fake_client):
+        """must-gather's lint-report.json carries the TPUOP-C/O005 rows
+        (suppressed ones included) and the per-analyzer timings."""
+        from tpu_operator import mustgather
+
+        mustgather.collect(fake_client, "tpu-operator", str(tmp_path))
+        report = json.loads((tmp_path / "lint-report.json").read_text())
+        assert "concurrency" in report["analyzer_seconds"]
+        rules = {f["rule"] for f in report["findings"]}
+        assert any(r.startswith("TPUOP-C") for r in rules)

@@ -1,0 +1,332 @@
+"""Runtime race harness (kube/racecheck.py) + regression tests for the
+real findings the concurrency analyzers surfaced in kube/.
+
+The violation-producing tests use PRIVATE Registry instances so the
+suite's own autouse racecheck guard (conftest) never sees a seeded
+deadlock as a real one.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator.kube import racecheck
+from tpu_operator.kube.racecheck import (
+    MutationTripwire,
+    Registry,
+    TrackedCondition,
+    TrackedLock,
+)
+
+
+class TestLockOrderGraph:
+    def test_abba_cycle_detected_without_deadlocking(self):
+        """The classic: T1 takes A then B, T2 takes B then A — detected
+        from the ORDER GRAPH even though this run never interleaves
+        fatally (both acquisitions happen on one thread here)."""
+        reg = Registry()
+        a = TrackedLock("A._lock", registry_=reg)
+        b = TrackedLock("B._lock", registry_=reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        violations = reg.violations()
+        assert len(violations) == 1
+        assert violations[0].kind == "lock-order"
+        assert "A._lock" in violations[0].detail and "B._lock" in violations[0].detail
+
+    def test_consistent_order_is_clean(self):
+        reg = Registry()
+        a = TrackedLock("A", registry_=reg)
+        b = TrackedLock("B", registry_=reg)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert reg.violations() == []
+
+    def test_three_lock_cycle(self):
+        """A->B, B->C, C->A: no pair inverts, the CYCLE is the bug."""
+        reg = Registry()
+        a, b, c = (TrackedLock(n, registry_=reg) for n in ("A", "B", "C"))
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        violations = [v for v in reg.violations() if v.kind == "lock-order"]
+        assert len(violations) == 1
+        assert all(n in violations[0].detail for n in ("A", "B", "C"))
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        reg = Registry()
+        r = TrackedLock("R", reentrant=True, registry_=reg)
+        with r:
+            with r:
+                pass
+        assert reg.violations() == []
+
+    def test_duplicate_cycle_reported_once(self):
+        reg = Registry()
+        a = TrackedLock("A", registry_=reg)
+        b = TrackedLock("B", registry_=reg)
+        for _ in range(4):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert len(reg.violations()) == 1
+
+    def test_cross_thread_edges_combine(self):
+        """Each thread individually uses a consistent nesting, but the
+        two orders are mutually inverted — the shared graph catches what
+        per-thread views cannot."""
+        reg = Registry()
+        a = TrackedLock("A", registry_=reg)
+        b = TrackedLock("B", registry_=reg)
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2)
+        th2.start()
+        th2.join()
+        assert len(reg.violations()) == 1
+
+
+class TestTrackedCondition:
+    def test_wait_releases_the_hold(self):
+        """A waiter parked in Condition.wait is NOT holding: edges from
+        locks the waking thread holds must not point through it."""
+        reg = Registry()
+        cond = TrackedCondition("Q._lock", registry_=reg)
+        other = TrackedLock("X", registry_=reg)
+        woken = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(2.0)
+                woken.set()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        with other:
+            with cond:  # waker holds X then the condition: edge X->Q
+                cond.notify_all()
+        th.join(2.0)
+        assert woken.is_set()
+        assert reg.violations() == []  # no inversion yet
+
+        # now close the loop: Q held while X acquired -> cycle
+        with cond:
+            with other:
+                pass
+        assert len(reg.violations()) == 1
+
+    def test_notify_requires_no_tracking_surprises(self):
+        reg = Registry()
+        cond = TrackedCondition("C", registry_=reg)
+        with cond:
+            cond.notify()
+            cond.notify_all()
+        assert reg.violations() == []
+
+
+class TestMutationTripwire:
+    def test_same_thread_nesting_is_legal(self):
+        reg = Registry()
+        tw = MutationTripwire("cache", registry_=reg)
+        with tw:
+            with tw:  # _replace driving _on_event, delete driving GC
+                pass
+        assert reg.violations() == []
+
+    def test_concurrent_writers_trip(self):
+        reg = Registry()
+        tw = MutationTripwire("cache", registry_=reg)
+        barrier = threading.Barrier(2)
+
+        def writer():
+            barrier.wait()
+            with tw:
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=writer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert any(v.kind == "mutation" for v in reg.violations())
+
+    def test_serialized_writers_are_clean(self):
+        reg = Registry()
+        tw = MutationTripwire("cache", registry_=reg)
+        lock = threading.Lock()
+
+        def writer():
+            for _ in range(50):
+                with lock:
+                    with tw:
+                        pass
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.violations() == []
+
+
+class TestFactories:
+    def test_disabled_returns_plain_primitives(self, monkeypatch):
+        monkeypatch.delenv("TPUOP_RACECHECK", raising=False)
+        assert not racecheck.enabled()
+        assert isinstance(racecheck.lock("x"), type(threading.Lock()))
+        assert isinstance(racecheck.rlock("x"), type(threading.RLock()))
+        assert isinstance(racecheck.condition("x"), threading.Condition)
+        assert racecheck.tripwire("x") is racecheck._NOOP_TRIPWIRE
+
+    def test_enabled_returns_tracked(self, monkeypatch):
+        monkeypatch.setenv("TPUOP_RACECHECK", "1")
+        assert isinstance(racecheck.lock("x"), TrackedLock)
+        assert isinstance(racecheck.rlock("x"), TrackedLock)
+        assert isinstance(racecheck.condition("x"), TrackedCondition)
+        assert isinstance(racecheck.tripwire("x"), MutationTripwire)
+
+    def test_kube_stack_instruments_under_env(self, monkeypatch):
+        """The informer/fake-client stack creates tracked locks when the
+        harness is armed, and a normal create->watch->cache flow records
+        order edges but zero violations."""
+        monkeypatch.setenv("TPUOP_RACECHECK", "1")
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.informer import Informer
+        from tpu_operator.kube.objects import new_object
+
+        before = len(racecheck.violations())
+        client = FakeClient()
+        assert isinstance(client._lock, TrackedLock)
+        assert isinstance(client._tripwire, MutationTripwire)
+        informer = Informer(client, "v1", "Node")
+        assert isinstance(informer._lock, TrackedLock)
+        informer.start()
+        client.create(new_object("v1", "Node", "n1"))
+        client.patch("v1", "Node", "n1", {"metadata": {"labels": {"a": "b"}}})
+        client.delete("v1", "Node", "n1")
+        assert racecheck.violations()[before:] == []
+
+    def test_check_raises_on_violation(self):
+        reg = Registry()
+        a = TrackedLock("A", registry_=reg)
+        b = TrackedLock("B", registry_=reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        with pytest.raises(RuntimeError, match="lock-order"):
+            racecheck.check(registry_=reg)
+        racecheck.check(registry_=Registry())  # clean registry: no raise
+
+
+class TestRealFindingRegressions:
+    """Each real finding the static analyzer surfaced in kube/ got a
+    fix; these pin the fixes so a refactor can't quietly undo them."""
+
+    def test_informer_staleness_stamp_is_guarded(self):
+        """last_event_at was written lock-free in the event path but
+        under _lifecycle in resync(); both writers now share _lock. The
+        analyzer is the spec: zero C001 findings for the informer."""
+        from tpu_operator.lint import concurrency
+
+        with open("tpu_operator/kube/informer.py") as f:
+            findings = concurrency.analyze_source(f.read(), "kube/informer.py")
+        assert not [x for x in findings if x.rule == "TPUOP-C001"], findings
+
+    def test_leader_leading_event_transitions_are_guarded(self):
+        """_leading.clear() in the renew loop's lost-lease branch ran
+        outside _depose_lock while _depose carefully serialized every
+        other transition against the watchdog's deadline re-check."""
+        from tpu_operator.lint import concurrency
+
+        with open("tpu_operator/kube/leader.py") as f:
+            findings = concurrency.analyze_source(f.read(), "kube/leader.py")
+        assert not [x for x in findings if x.rule == "TPUOP-C001"], findings
+
+    def test_manager_stop_releases_lifecycle_before_blocking_teardown(self):
+        """Manager.stop used to join controller workers (5 s timeout
+        each) while HOLDING the lifecycle lock — any worker inside
+        informer_for's creation path would deadlock against its own
+        teardown. stop() now snapshots under the lock and tears down
+        outside it: a component stopped during shutdown can always
+        acquire the lifecycle lock from another thread."""
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.manager import Manager
+
+        manager = Manager(FakeClient())
+        lock_was_free = threading.Event()
+        probe_done = threading.Event()
+
+        class _ProbingController:
+            def start(self):
+                pass
+
+            def stop(self):
+                # from another thread, try to take the manager lifecycle
+                # lock while OUR stop() runs; with the old code the
+                # stop()-calling thread held it and this timed out
+                def probe():
+                    got = manager._lifecycle.acquire(timeout=1.0)
+                    if got:
+                        lock_was_free.set()
+                        manager._lifecycle.release()
+                    probe_done.set()
+
+                t = threading.Thread(target=probe, daemon=True)
+                t.start()
+                t.join(2.0)
+
+        manager.add_controller(_ProbingController())
+        manager.start(wait_for_leader=False)
+        manager.stop()
+        assert probe_done.is_set()
+        assert lock_was_free.is_set(), (
+            "manager.stop() still holds the lifecycle lock across "
+            "component teardown"
+        )
+
+    def test_manager_stop_still_refuses_restart_and_late_informers(self):
+        """The two-phase stop keeps the old guarantees: a stopped
+        manager refuses start(), and an informer created after stop is
+        never started (no leaked watch)."""
+        from tpu_operator.kube.fake import FakeClient
+        from tpu_operator.kube.manager import Manager
+
+        manager = Manager(FakeClient())
+        manager.start(wait_for_leader=False)
+        manager.stop()
+        manager.start(wait_for_leader=False)  # refused, logged
+        assert manager.stopped()
+        informer = manager.informer_for("v1", "Node")
+        assert informer._sub is None  # registered but never started

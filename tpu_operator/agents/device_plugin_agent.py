@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 import grpc
 
+from tpu_operator.kube import racecheck
 from tpu_operator import consts
 from tpu_operator.agents.dpapi import deviceplugin_pb2 as pb
 
@@ -89,7 +90,7 @@ class TPUDevicePlugin:
         # per-stream subscriber queues: a re-dialled ListAndWatch must not
         # have its updates stolen by a zombie predecessor stream
         self._subscribers: List["queue.Queue"] = []
-        self._sub_lock = threading.Lock()
+        self._sub_lock = racecheck.lock("DevicePlugin._sub_lock")
         self._stop = threading.Event()
         # every device ever advertised: a yanked chip must be re-reported
         # as Unhealthy (kubelet keeps it in capacity, stops allocating),

@@ -11,9 +11,9 @@ labels at all.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, Optional
 
+from tpu_operator.kube import racecheck
 from tpu_operator import consts
 from tpu_operator.kube.client import Client
 
@@ -75,7 +75,7 @@ class LiveClusterInfo:
     def __init__(self, client: Client, default_runtime: str = consts.RUNTIME_CONTAINERD):
         self.client = client
         self.default_runtime = default_runtime
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("LiveClusterInfo._lock")
         self._cache: Optional[ClusterInfo] = None
         self._cached_runtime_default = ""
         self._generation = 0  # bumped by invalidate; guards the recompute race

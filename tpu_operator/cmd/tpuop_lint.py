@@ -1,11 +1,19 @@
 """tpuop-lint: static analysis CLI.
 
     tpuop-lint                         # text report, exit 1 on errors
-    tpuop-lint --format json           # machine-readable (CI, must-gather)
+    tpuop-lint --format json           # machine-readable (CI, must-gather;
+                                       # includes per-analyzer wall time)
     tpuop-lint --only rbac,drift       # subset of analyzers
+    tpuop-lint --only TPUOP-C002       # single rule (runs only its family)
+    tpuop-lint --skip concurrency      # everything except one family
+    tpuop-lint --skip TPUOP-M007      # drop one rule's findings
     tpuop-lint --rules                 # print the rule catalog
     tpuop-lint --update-baseline       # rewrite the baseline from current
                                        # error findings (review the diff!)
+
+``--only``/``--skip`` both accept analyzer names and rule ids, mixed;
+rule ids select/deselect their findings and (for --only) imply their
+analyzer family so nothing else runs.
 
 Exit status: 0 clean (warnings/info allowed), 1 when any unsuppressed
 error-severity finding remains, 2 on usage errors.
@@ -59,7 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--only",
         default=None,
-        help=f"comma-separated analyzers to run (default: all of {','.join(runner.ANALYZERS)})",
+        help="comma-separated analyzers and/or rule ids to run "
+             f"(analyzers: {','.join(runner.ANALYZERS)})",
+    )
+    p.add_argument(
+        "--skip",
+        default=None,
+        help="comma-separated analyzers and/or rule ids to exclude",
     )
     p.add_argument(
         "--show-suppressed",
@@ -75,6 +89,27 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_selector(raw: str, flag: str):
+    """Split a --only/--skip value into (analyzer set, rule-id set);
+    None on an unknown token (after printing why)."""
+    analyzers, rules = set(), set()
+    for token in (t.strip() for t in raw.split(",")):
+        if not token:
+            continue
+        if token in runner.ANALYZERS:
+            analyzers.add(token)
+        elif token in RULES:
+            rules.add(token)
+        else:
+            print(
+                f"{flag}: unknown analyzer or rule id '{token}' "
+                f"(analyzers: {', '.join(runner.ANALYZERS)}; rules: see --rules)",
+                file=sys.stderr,
+            )
+            return None
+    return analyzers, rules
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.rules:
@@ -82,19 +117,67 @@ def main(argv=None) -> int:
             print(f"{rule}  {severity:8s} {desc}")
         return 0
     only = None
+    only_rules: set = set()
+    skip_rules: set = set()
     if args.only:
-        only = [a.strip() for a in args.only.split(",") if a.strip()]
-        unknown = [a for a in only if a not in runner.ANALYZERS]
-        if unknown:
-            print(f"unknown analyzer(s): {', '.join(unknown)}", file=sys.stderr)
+        parsed = _parse_selector(args.only, "--only")
+        if parsed is None:
             return 2
+        analyzers, only_rules = parsed
+        # a rule id implies its analyzer family: --only TPUOP-C002 runs
+        # just the concurrency analyzer, then keeps only that rule's rows
+        analyzers |= {runner.family_of_rule(r) for r in only_rules} - {None}
+        if not analyzers:
+            # e.g. --only TPUOP-B001: a valid rule id that no analyzer
+            # produces — running nothing and printing "clean" would be a
+            # lie a CI job happily believes
+            print(
+                "--only: selection matches no analyzer "
+                f"(rule(s) {', '.join(sorted(only_rules))} have no analyzer family)",
+                file=sys.stderr,
+            )
+            return 2
+        only = sorted(analyzers)
+    if args.skip:
+        parsed = _parse_selector(args.skip, "--skip")
+        if parsed is None:
+            return 2
+        skipped_analyzers, skip_rules = parsed
+        only = [a for a in (only or list(runner.ANALYZERS)) if a not in skipped_analyzers]
+    nothing_selected = only is not None and not only
+
+    def apply_rule_filters(found):
+        if only_rules:
+            found = [f for f in found if f.rule in only_rules or f.rule == "TPUOP-B001"]
+        if skip_rules:
+            found = [f for f in found if f.rule not in skip_rules]
+        return found
+
     if args.update_baseline:
-        # run WITHOUT the existing baseline so every current error lands
-        findings = runner.run_lint(baseline_path=os.devnull, only=only)
+        if nothing_selected:
+            print(
+                "--update-baseline with every analyzer excluded would "
+                "erase the baseline; refusing",
+                file=sys.stderr,
+            )
+            return 2
+        # run WITHOUT the existing baseline so every current error lands;
+        # rule filters apply so `--only TPUOP-C003 --update-baseline`
+        # writes only that rule's entries
+        findings = apply_rule_filters(
+            runner.run_lint(baseline_path=os.devnull, only=only)
+        )
         return _write_baseline(args.baseline or runner.DEFAULT_BASELINE, findings)
-    findings = runner.run_lint(baseline_path=args.baseline, only=only)
+    timings: dict = {}
+    if nothing_selected:
+        # --skip excluded every analyzer: run nothing (run_lint would
+        # read an empty list as "default to all" — the exact opposite)
+        findings = []
+    else:
+        findings = runner.run_lint(baseline_path=args.baseline, only=only, timings=timings)
+    findings = apply_rule_filters(findings)
     if args.format == "json":
-        sys.stdout.write(render_json(findings))
+        sys.stdout.write(render_json(findings, timings=timings))
     else:
         sys.stdout.write(render_text(findings, show_suppressed=args.show_suppressed))
     return 1 if failing(findings) else 0

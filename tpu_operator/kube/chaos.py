@@ -30,11 +30,10 @@ from __future__ import annotations
 
 import dataclasses
 import random
-import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, racecheck
 from tpu_operator.kube import trace as trace_mod
 from tpu_operator.kube.client import Client
 
@@ -157,7 +156,7 @@ class ChaosDirector:
         self.watch_hang_after = watch_hang_after
         self.watch_hang_duration = watch_hang_duration
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("ChaosDirector._lock")
         self._t0: Optional[float] = None
         self._seq = 0
         self._quiesced = False

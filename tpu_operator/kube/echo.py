@@ -20,16 +20,16 @@ never a missed one.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Optional
 
+from tpu_operator.kube import racecheck
 from tpu_operator.kube.objects import ObjectDict
 
 
 class WriteEchoFilter:
     def __init__(self, max_entries: int = 8192, ttl_seconds: float = 30.0):
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("WriteEchoFilter._lock")
         self._ttl = ttl_seconds
         self._max = max_entries
         # name -> (expected labels dict, expiry deadline)

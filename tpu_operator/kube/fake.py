@@ -14,7 +14,7 @@ import threading
 import time
 from typing import List, Optional
 
-from tpu_operator.kube import errors, trace
+from tpu_operator.kube import errors, racecheck, trace
 from tpu_operator.kube.client import (
     ADDED,
     DELETED,
@@ -85,7 +85,12 @@ class _Sub(WatchSubscription):
 
 class FakeClient(Client):
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = racecheck.rlock("FakeClient._lock")
+        # writer-epoch tripwire around store mutations (racecheck):
+        # trips when two threads are inside a write section at once —
+        # i.e. a write path stopped taking _lock; no-op when the
+        # harness is off
+        self._tripwire = racecheck.tripwire("FakeClient.store")
         # two-level store: (group, kind) -> {(ns, name): obj}. Listing a
         # kind is O(objects of that kind) — with one flat dict, every LIST
         # scanned the whole cluster (at 4096 nodes × 9 operand DaemonSets
@@ -96,7 +101,7 @@ class FakeClient(Client):
         self._uid = 0
         self._watchers: dict = {}  # (group, kind) -> [_Sub]
         self._pending: list = []  # events awaiting dispatch, in commit order
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = racecheck.lock("FakeClient._dispatch_lock")
         self._dispatcher: Optional[int] = None  # thread id currently draining
 
     # -- internals ----------------------------------------------------------
@@ -108,14 +113,17 @@ class FakeClient(Client):
         kind_key, obj_key = key
         return self._store.get(kind_key, {}).get(obj_key)
 
+    # tpuop-lint: guarded-by=_lock
     def _set_stored(self, key, obj: ObjectDict) -> None:
         kind_key, obj_key = key
         self._store.setdefault(kind_key, {})[obj_key] = obj
 
+    # tpuop-lint: guarded-by=_lock
     def _pop_stored(self, key) -> Optional[ObjectDict]:
         kind_key, obj_key = key
         return self._store.get(kind_key, {}).pop(obj_key, None)
 
+    # tpuop-lint: guarded-by=_lock
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
@@ -184,7 +192,7 @@ class FakeClient(Client):
         key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
         if not md.get("name"):
             raise errors.Invalid("metadata.name required")
-        with self._lock:
+        with self._lock, self._tripwire:
             if self._get_stored(key) is not None:
                 raise errors.AlreadyExists(f"{obj['kind']} {md.get('name')} already exists")
             self._uid += 1
@@ -205,7 +213,7 @@ class FakeClient(Client):
         obj = deep_copy(obj)
         md = obj.setdefault("metadata", {})
         key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
-        with self._lock:
+        with self._lock, self._tripwire:
             existing = self._get_stored(key)
             if existing is None:
                 raise errors.NotFound(f"{obj['kind']} {md.get('name')} not found")
@@ -235,7 +243,7 @@ class FakeClient(Client):
     def update_status(self, obj):
         md = obj.get("metadata", {})
         key = self._key(obj["apiVersion"], obj["kind"], md.get("name", ""), md.get("namespace"))
-        with self._lock:
+        with self._lock, self._tripwire:
             existing = self._get_stored(key)
             if existing is None:
                 raise errors.NotFound(f"{obj['kind']} {md.get('name')} not found")
@@ -266,7 +274,7 @@ class FakeClient(Client):
         a minimal patch never conflicts with concurrent writers of other
         fields — which is the whole point of patching."""
         key = self._key(api_version, kind, name, namespace)
-        with self._lock:
+        with self._lock, self._tripwire:
             existing = self._get_stored(key)
             if existing is None:
                 raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
@@ -300,7 +308,7 @@ class FakeClient(Client):
         ``status`` key is applied; everything else in the patch is ignored
         (real apiserver subresource semantics)."""
         key = self._key(api_version, kind, name, namespace)
-        with self._lock:
+        with self._lock, self._tripwire:
             existing = self._get_stored(key)
             if existing is None:
                 raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
@@ -322,7 +330,7 @@ class FakeClient(Client):
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
         # grace_period_seconds is accepted for Client-interface parity; the
         # in-memory store always deletes immediately (no kubelet to wait on)
-        with self._lock:
+        with self._lock, self._tripwire:
             key = self._key(api_version, kind, name, namespace)
             obj = self._pop_stored(key)
             if obj is None:

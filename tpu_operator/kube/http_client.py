@@ -24,6 +24,7 @@ import urllib.parse
 import urllib.request
 from typing import List, Optional
 
+from tpu_operator.kube import racecheck
 from tpu_operator import consts
 from tpu_operator.kube import errors, retry, trace
 from tpu_operator.kube.client import SYNC, Client, WatchHandler, WatchSubscription
@@ -226,11 +227,11 @@ class HttpClient(Client):
         # keep-alive pool, initialized eagerly: lazy init from two racing
         # first requests would create two different locks guarding it
         self._idle_conns: list = []
-        self._pool_lock = threading.Lock()
+        self._pool_lock = racecheck.lock("HttpClient._pool_lock")
         # per-client wire-request counts by verb (benchable without
         # scraping the process-wide prometheus counter)
         self.request_counts: collections.Counter = collections.Counter()
-        self._stats_lock = threading.Lock()
+        self._stats_lock = racecheck.lock("HttpClient._stats_lock")
 
     def _count_request(self, verb: str) -> None:
         with self._stats_lock:

@@ -34,7 +34,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
-from tpu_operator.kube import chaos as chaos_mod
+from tpu_operator.kube import chaos as chaos_mod, racecheck
 from tpu_operator.kube import errors
 from tpu_operator.kube import trace as trace_mod
 from tpu_operator.kube.client import Client
@@ -183,7 +183,7 @@ class FakeApiServer:
         # first page's resourceVersion; serving later pages from the live
         # view would show a different, possibly inconsistent world)
         self._list_snapshots: "collections.OrderedDict[str, list]" = collections.OrderedDict()
-        self._snapshots_lock = threading.Lock()
+        self._snapshots_lock = racecheck.lock("FakeApiServer._snapshots_lock")
         self.ca_pem: bytes = b""
         server = self
 

@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from tpu_operator.kube import trace
+from tpu_operator.kube import racecheck, trace
 from tpu_operator.kube.client import ADDED, DELETED, MODIFIED, SYNC, Client
 from tpu_operator.kube.objects import (
     ObjectDict,
@@ -64,7 +64,12 @@ class Informer:
         self._label_keys: Dict[str, Set[tuple]] = {}
         self._index_fns: Dict[str, IndexFunc] = {}
         self._indexes: Dict[str, Dict[str, Set[tuple]]] = {}
-        self._lock = threading.RLock()
+        self._lock = racecheck.rlock("Informer._lock")
+        # writer-epoch tripwire around cache/index mutations: under
+        # TPUOP_RACECHECK=1 a mutation reaching the cache without _lock
+        # (a refactor bug the static analyzer can miss through aliasing)
+        # is recorded as a violation; a no-op otherwise
+        self._tripwire = racecheck.tripwire("Informer.cache")
         self._sub = None
         self._synced = threading.Event()
         self._stopped = False
@@ -77,7 +82,7 @@ class Informer:
         self.last_sync_at: Optional[float] = None
         # serializes start/stop so a late lazy start (a cached read of a
         # new kind on a running manager) can never leak a watch past stop
-        self._lifecycle = threading.Lock()
+        self._lifecycle = racecheck.lock("Informer._lifecycle")
         # event-to-handler lag (receipt -> all handlers done) per kind:
         # the "is the informer pipeline itself the bottleneck" series
         self._lag_histogram = trace.informer_lag_histogram().labels(kind)
@@ -92,11 +97,12 @@ class Informer:
         with self._lock:
             if name in self._index_fns:
                 return
-            self._index_fns[name] = fn
-            index = self._indexes.setdefault(name, {})
-            for key, obj in self._cache.items():
-                for value in fn(obj) or ():
-                    index.setdefault(value, set()).add(key)
+            with self._tripwire:
+                self._index_fns[name] = fn
+                index = self._indexes.setdefault(name, {})
+                for key, obj in self._cache.items():
+                    for value in fn(obj) or ():
+                        index.setdefault(value, set()).add(key)
 
     def start(self, sync_timeout: float = 5.0) -> None:
         with self._lifecycle:
@@ -150,8 +156,11 @@ class Informer:
             # the resync itself resets the staleness clock: without this
             # a still-down apiserver would make the stall monitor churn a
             # fresh watch subscription every tick instead of one recovery
-            # attempt per stall window
-            self.last_event_at = time.monotonic()
+            # attempt per stall window. The stamp shares _lock with the
+            # event path's writes (found by the concurrency lint: a
+            # guarded attribute must not also be written lock-free).
+            with self._lock:
+                self.last_event_at = time.monotonic()
             if self._sub is not None:
                 self._sub.stop()
             self._sub = self.client.watch(
@@ -160,6 +169,7 @@ class Informer:
 
     # -- index maintenance (call with self._lock held) -----------------------
 
+    # tpuop-lint: guarded-by=_lock
     def _index_add(self, key, obj: ObjectDict) -> None:
         for k, v in (obj["metadata"].get("labels") or {}).items():
             self._label_pairs.setdefault((k, v), set()).add(key)
@@ -169,6 +179,7 @@ class Informer:
             for value in fn(obj) or ():
                 index.setdefault(value, set()).add(key)
 
+    # tpuop-lint: guarded-by=_lock
     def _index_remove(self, key, obj: ObjectDict) -> None:
         for k, v in (obj["metadata"].get("labels") or {}).items():
             bucket = self._label_pairs.get((k, v))
@@ -198,33 +209,39 @@ class Informer:
         # so measuring against it would record near-zero lag for exactly
         # the events dispatched during a stall window
         received = time.monotonic()
-        self.last_event_at = received
         if event_type == SYNC:
-            self.last_sync_at = received
+            with self._lock:
+                self.last_event_at = received
+                self.last_sync_at = received
             self._replace(obj.get("items") or [])
             return
         key = object_key(obj)
         with self._lock:
+            # stamped inside the mutation-side critical section (shared
+            # with resync's write — the C001 fix) so the hot event path
+            # pays ONE lock round-trip, not two
+            self.last_event_at = received
             old = self._cache.get(key)
-            if event_type == DELETED:
-                if old is not None:
-                    self._index_remove(key, old)
-                self._cache.pop(key, None)
-            else:
-                if old is not None and not _newer(
-                    obj["metadata"].get("resourceVersion"), old["metadata"].get("resourceVersion")
-                ):
-                    # duplicate or stale delivery (list replay after watch,
-                    # or reordered concurrent notifications) — drop
-                    return
-                # the delivered object is stored as-is: both clients hand
-                # each subscriber a private object (FakeClient deep-copies
-                # per delivery, the HTTP watch parses fresh JSON), so no
-                # defensive copy is needed here
-                if old is not None:
-                    self._index_remove(key, old)
-                self._cache[key] = obj
-                self._index_add(key, obj)
+            with self._tripwire:
+                if event_type == DELETED:
+                    if old is not None:
+                        self._index_remove(key, old)
+                    self._cache.pop(key, None)
+                else:
+                    if old is not None and not _newer(
+                        obj["metadata"].get("resourceVersion"), old["metadata"].get("resourceVersion")
+                    ):
+                        # duplicate or stale delivery (list replay after watch,
+                        # or reordered concurrent notifications) — drop
+                        return
+                    # the delivered object is stored as-is: both clients hand
+                    # each subscriber a private object (FakeClient deep-copies
+                    # per delivery, the HTTP watch parses fresh JSON), so no
+                    # defensive copy is needed here
+                    if old is not None:
+                        self._index_remove(key, old)
+                    self._cache[key] = obj
+                    self._index_add(key, obj)
         for handler in self._handlers:
             try:
                 # handlers get the cached objects (read-only convention) —

@@ -13,7 +13,7 @@ import time
 import uuid
 from typing import Optional
 
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, racecheck
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.objects import new_object
 
@@ -51,7 +51,7 @@ class LeaderElector:
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[threading.Thread] = None
         self._last_renew = 0.0  # monotonic of the last SUCCESSFUL renew
-        self._depose_lock = threading.Lock()
+        self._depose_lock = racecheck.lock("LeaderElector._depose_lock")
         self._deposed = False
         # Invoked (once) when leadership is LOST after having been held.
         # client-go treats this as fatal (OnStoppedLeading → exit); the
@@ -158,7 +158,13 @@ class LeaderElector:
                 if was_leading:
                     self._depose()
                     return
-                self._leading.clear()
+                # under _depose_lock like every other _leading transition
+                # (found by the concurrency lint: _depose's deadline
+                # re-check reads _leading for its am-I-still-leading
+                # decision, so a lock-free clear here could interleave
+                # mid-decision)
+                with self._depose_lock:
+                    self._leading.clear()
             self._stop.wait(self.renew_interval)
 
     def _try_acquire_or_renew(self) -> Optional[bool]:

@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from tpu_operator.kube import racecheck
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller
 from tpu_operator.kube.informer import Informer
@@ -55,7 +56,7 @@ class Manager:
         self._started = threading.Event()
         # serializes start/stop/late informer_for so leader-loss teardown can
         # never interleave with an in-progress start
-        self._lifecycle = threading.RLock()
+        self._lifecycle = racecheck.rlock("Manager._lifecycle")
         self._stopping = False
         # optional backstop for silently-stalled watches: a monitor
         # thread resyncs any informer that delivered nothing for this
@@ -117,6 +118,7 @@ class Manager:
         with self._lifecycle:
             self._start_locked(wait_for_leader)
 
+    # tpuop-lint: guarded-by=_lifecycle
     def _start_locked(self, wait_for_leader: bool) -> None:
         if self._stopping:
             log.warning("manager stop() already ran; refusing to start")
@@ -175,18 +177,31 @@ class Manager:
         return not self._started.is_set()
 
     def stop(self) -> None:
+        # Two phases, found by the concurrency lint (C003): flagging
+        # _stopping and snapshotting the component lists happen UNDER
+        # the lifecycle lock (so no start or late informer_for can
+        # interleave — _informer_create re-checks _stopping before
+        # starting anything), but the actual teardown runs OUTSIDE it.
+        # Controller.stop joins worker threads and server.shutdown
+        # blocks on the serve loop; holding the lifecycle lock across
+        # those joins deadlocks any worker that is itself inside
+        # informer_for's creation path waiting for this very lock.
         with self._lifecycle:
             self._stopping = True
             self._stall_stop.set()
-            for controller in list(self._controllers):
-                controller.stop()
-            for informer in list(self._informers.values()):
-                informer.stop()
-            if self._leader:
-                self._leader.stop()
-            for server in self._servers:
-                server.shutdown()
+            controllers = list(self._controllers)
+            informers = list(self._informers.values())
+            leader = self._leader
+            servers = list(self._servers)
             self._started.clear()
+        for controller in controllers:
+            controller.stop()
+        for informer in informers:
+            informer.stop()
+        if leader:
+            leader.stop()
+        for server in servers:
+            server.shutdown()
 
     def __enter__(self):
         self.start()

@@ -17,10 +17,10 @@ from __future__ import annotations
 
 import heapq
 import random
-import threading
 import time
 from typing import Any, Optional
 
+from tpu_operator.kube import racecheck
 from tpu_operator.kube.retry import full_jitter
 
 # bound on the per-item failure map: items that error forever and are
@@ -42,7 +42,7 @@ class RateLimitingQueue:
         self._coalesce = coalesce_window
         # full-jitter backoff needs a private RNG so tests can seed it
         self._rng = rng or random.Random()
-        self._lock = threading.Condition()
+        self._lock = racecheck.condition("RateLimitingQueue._lock")
         self._queue: list = []  # FIFO of ready items
         self._dirty: set = set()  # items added while being processed
         self._processing: set = set()

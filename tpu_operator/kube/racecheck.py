@@ -1,0 +1,420 @@
+"""Runtime race harness: instrumented locks + mutation tripwires.
+
+The static concurrency analyzer (``tpu_operator.lint.concurrency``)
+proves lock DISCIPLINE — what it cannot prove is the dynamic
+acquisition ORDER across instances and threads, or that a refactor
+didn't quietly move a cache mutation out from under its lock. This
+module is the runtime counterpart, opt-in via ``TPUOP_RACECHECK=1``
+(the CI racecheck leg sets it around the leader-failover and
+crash-recovery drills and the compressed chaos soak):
+
+- **Instrumented locks**: the ``lock``/``rlock``/``condition``
+  factories below hand out plain ``threading`` primitives when the
+  harness is off (zero overhead — the production path), and tracked
+  wrappers when it is on. Every tracked acquire records, per thread,
+  which locks were already held and adds held→acquired edges to one
+  process-wide lock-order graph; an edge that closes a cycle is an
+  ABBA deadlock WAITING to happen — recorded as a violation with both
+  acquisition sites, even if this particular run never interleaved
+  fatally. ``Condition.wait`` releases and re-acquires its lock and is
+  tracked accordingly (a wait is not a hold).
+- **Mutation tripwires**: a writer-epoch assertion (deliberately not a
+  full vector clock) wrapped around the informer cache's and the
+  FakeClient store's mutation sections. Two writers inside the same
+  section concurrently — i.e. the guarding lock was dropped or
+  bypassed — trips it even when the interleaving happens to produce a
+  consistent-looking result.
+
+Violations are RECORDED, not raised at the detection site (raising
+inside a third-party lock acquire corrupts the very state being
+debugged): the test suite's autouse guard (tests/conftest.py) fails
+the owning test, and ``check()`` raises for script consumers.
+
+Tracked locks aggregate under the NAME given at construction (e.g.
+``"Informer._lock"``) for reporting, but the order graph is built over
+instances: two distinct informers' caches nested in opposite orders is
+a real deadlock even though both locks share a name, and one RLock
+re-entered by its own thread is not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def enabled() -> bool:
+    """True when the harness is armed for this process. Checked at lock
+    CREATION time: flipping the env var mid-process affects only locks
+    created afterwards."""
+    return os.environ.get("TPUOP_RACECHECK", "") == "1"
+
+
+class Violation:
+    __slots__ = ("kind", "detail", "thread")
+
+    def __init__(self, kind: str, detail: str):
+        self.kind = kind  # "lock-order" | "mutation"
+        self.detail = detail
+        self.thread = threading.current_thread().name
+
+    def __repr__(self) -> str:
+        return f"[{self.kind}] ({self.thread}) {self.detail}"
+
+
+def _site(skip: int = 2) -> str:
+    """Compact acquisition-site tag: file:line of the nearest frame
+    outside this module. Uses sys._getframe (no stack rendering) — it
+    runs on every tracked acquire, so it must stay cheap."""
+    try:
+        frame = sys._getframe(skip)
+        while frame is not None and frame.f_code.co_filename.endswith("racecheck.py"):
+            frame = frame.f_back
+        if frame is not None:
+            return f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
+    return "?"
+
+
+class Registry:
+    """One lock-order graph + violation log. The module-level default
+    registry is what the factories and the conftest guard share; tests
+    of the harness itself construct private registries so their seeded
+    deadlocks never fail the suite's guard."""
+
+    def __init__(self):
+        # registry internals are guarded by a PLAIN lock — the harness
+        # must never instrument itself
+        self._meta = threading.Lock()
+        self._next_id = 0
+        # instance-id -> set of instance-ids acquired while holding it
+        self._edges: Dict[int, Set[int]] = {}
+        # (held id, acquired id) -> (held name@site, acquired name@site)
+        self._edge_sites: Dict[Tuple[int, int], Tuple[str, str]] = {}
+        self._names: Dict[int, str] = {}
+        self._violations: List[Violation] = []
+        self._seen_cycles: Set[frozenset] = set()
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _held(self) -> list:
+        stack = getattr(self._tls, "held", None)
+        if stack is None:
+            stack = self._tls.held = []
+        return stack
+
+    def register(self, name: str) -> int:
+        with self._meta:
+            self._next_id += 1
+            self._names[self._next_id] = name
+            return self._next_id
+
+    def record(self, violation: Violation) -> None:
+        with self._meta:
+            self._violations.append(violation)
+
+    def violations(self) -> List[Violation]:
+        with self._meta:
+            return list(self._violations)
+
+    def reset(self) -> None:
+        """Clear violations AND the order graph (tests only — clearing
+        the graph between unrelated drills keeps an order learned in one
+        from vetoing the other)."""
+        with self._meta:
+            self._violations.clear()
+            self._edges.clear()
+            self._edge_sites.clear()
+            self._seen_cycles.clear()
+
+    # -- graph ---------------------------------------------------------------
+
+    def note_acquired(self, lock_id: int) -> None:
+        held = self._held()
+        site = _site()
+        for held_id, held_site in held:
+            if held_id == lock_id:
+                continue  # RLock re-entry: not an ordering edge
+            cycle = None
+            with self._meta:
+                bucket = self._edges.setdefault(held_id, set())
+                if lock_id in bucket:
+                    continue  # known edge: nothing new to prove
+                bucket.add(lock_id)
+                self._edge_sites[(held_id, lock_id)] = (
+                    f"{self._names[held_id]} @ {held_site}",
+                    f"{self._names[lock_id]} @ {site}",
+                )
+                cycle = self._find_cycle(lock_id, held_id)
+            if cycle is not None:
+                self._note_cycle(cycle)
+        held.append((lock_id, site))
+
+    def note_released(self, lock_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == lock_id:
+                del held[i]
+                return
+
+    def _find_cycle(self, start: int, target: int) -> Optional[List[int]]:
+        """Path start→…→target in the edge graph (call with _meta held):
+        combined with the just-added target→start edge it is a cycle."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == target:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _note_cycle(self, path: List[int]) -> None:
+        with self._meta:
+            key = frozenset(path)
+            if key in self._seen_cycles:
+                return
+            self._seen_cycles.add(key)
+            ring = path + [path[0]]
+            names = " -> ".join(self._names[i] for i in ring)
+            sites = []
+            for a, b in zip(ring, ring[1:]):
+                held_at, acq_at = self._edge_sites.get((a, b), ("?", "?"))
+                sites.append(f"  holding {held_at} acquired {acq_at}")
+            violation = Violation(
+                "lock-order",
+                f"lock acquisition cycle: {names}\n" + "\n".join(sites),
+            )
+            self._violations.append(violation)
+
+
+_DEFAULT = Registry()
+
+
+def registry() -> Registry:
+    return _DEFAULT
+
+
+def violations() -> List[Violation]:
+    return _DEFAULT.violations()
+
+
+def reset() -> None:
+    _DEFAULT.reset()
+
+
+def check(registry_: Optional[Registry] = None) -> None:
+    """Raise on any recorded violation — the script/bench entrypoint."""
+    found = (registry_ or _DEFAULT).violations()
+    if found:
+        raise RuntimeError(
+            "racecheck: %d violation(s):\n%s"
+            % (len(found), "\n".join(repr(v) for v in found))
+        )
+
+
+# ---------------------------------------------------------------------------
+# tracked primitives
+# ---------------------------------------------------------------------------
+
+
+class TrackedLock:
+    """threading.Lock/RLock wrapper feeding the order graph. Reentrant
+    acquires of the same instance (RLock) are counted, not re-recorded."""
+
+    def __init__(self, name: str, reentrant: bool = False, registry_: Optional[Registry] = None):
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._registry = registry_ or _DEFAULT
+        self._id = self._registry.register(name)
+        self.name = name
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            depth = self._depth()
+            if depth == 0:
+                self._registry.note_acquired(self._id)
+            self._tls.depth = depth + 1
+        return got
+
+    def release(self) -> None:
+        depth = self._depth() - 1
+        self._tls.depth = depth
+        if depth == 0:
+            self._registry.note_released(self._id)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+class TrackedCondition:
+    """threading.Condition wrapper: acquire/release tracked like a lock;
+    ``wait`` drops the hold for its duration (a waiter is NOT holding —
+    treating it as held would fabricate order edges from every lock the
+    waker holds)."""
+
+    def __init__(self, name: str, registry_: Optional[Registry] = None):
+        self._inner = threading.Condition()
+        self._registry = registry_ or _DEFAULT
+        self._id = self._registry.register(name)
+        self.name = name
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            if self._depth() == 0:
+                self._registry.note_acquired(self._id)
+            self._tls.depth = self._depth() + 1
+        return got
+
+    def release(self) -> None:
+        self._tls.depth = self._depth() - 1
+        if self._depth() == 0:
+            self._registry.note_released(self._id)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._registry.note_released(self._id)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._registry.note_acquired(self._id)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._registry.note_released(self._id)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._registry.note_acquired(self._id)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+class MutationTripwire:
+    """Writer-epoch assertion for a lock-guarded mutation section.
+
+    Entering bumps a shared epoch and claims ownership; a second thread
+    entering while another owns the section is a concurrent mutation
+    (the guarding lock was dropped), and an epoch that advanced past
+    our own nested entries by exit means a foreign writer interleaved.
+    Same-thread nesting is legal (``_replace`` drives ``_on_event``,
+    ``delete`` drives GC). The tripwire's own fields are racy by
+    design: they are only ever racy when the invariant is ALREADY
+    broken, which is the thing being reported."""
+
+    __slots__ = ("name", "_registry", "_owner", "_depth", "_epoch", "_base", "_entries")
+
+    def __init__(self, name: str, registry_: Optional[Registry] = None):
+        self.name = name
+        self._registry = registry_ or _DEFAULT
+        self._owner: Optional[int] = None
+        self._depth = 0
+        self._epoch = 0
+        self._base = 0
+        self._entries = 0
+
+    def __enter__(self):
+        me = threading.get_ident()
+        owner = self._owner
+        if owner is not None and owner != me:
+            self._registry.record(Violation(
+                "mutation",
+                f"{self.name}: writer entered while thread {owner} was "
+                "still inside the mutation section — the guarding lock "
+                f"was dropped or bypassed (at {_site(2)})",
+            ))
+        if owner != me:
+            self._owner = me
+            self._depth = 0
+            self._base = self._epoch
+            self._entries = 0
+        self._depth += 1
+        self._entries += 1
+        self._epoch += 1
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._depth -= 1
+        if self._depth <= 0:
+            if self._epoch != self._base + self._entries:
+                self._registry.record(Violation(
+                    "mutation",
+                    f"{self.name}: writer epoch advanced by a foreign "
+                    f"thread mid-write (expected {self._base + self._entries}, "
+                    f"found {self._epoch})",
+                ))
+            self._owner = None
+        return False
+
+
+class _NoopTripwire:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_TRIPWIRE = _NoopTripwire()
+
+
+# ---------------------------------------------------------------------------
+# factories — the only surface the kube/ modules touch
+# ---------------------------------------------------------------------------
+
+
+def lock(name: str):
+    """A mutex: plain ``threading.Lock`` normally, tracked under
+    TPUOP_RACECHECK=1. ``name`` should be ``Class._attr`` — it is how
+    cycles read in violation reports."""
+    return TrackedLock(name) if enabled() else threading.Lock()
+
+
+def rlock(name: str):
+    return TrackedLock(name, reentrant=True) if enabled() else threading.RLock()
+
+
+def condition(name: str):
+    return TrackedCondition(name) if enabled() else threading.Condition()
+
+
+def tripwire(name: str):
+    """Mutation tripwire for a guarded section; shared no-op when the
+    harness is off."""
+    return MutationTripwire(name) if enabled() else _NOOP_TRIPWIRE

@@ -30,12 +30,11 @@ from __future__ import annotations
 import collections
 import logging
 import random
-import threading
 import time
 from typing import Optional
 
 from tpu_operator import consts
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, racecheck
 
 log = logging.getLogger(__name__)
 
@@ -90,13 +89,14 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.reset_seconds = reset_seconds
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("CircuitBreaker._lock")
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self.opened_at: Optional[float] = None
         self.open_count = 0  # lifetime open transitions (must-gather)
         self._probe_in_flight = False
 
+    # tpuop-lint: guarded-by=_lock
     def _set_state(self, state: str) -> None:
         self.state = state
         try:
@@ -170,7 +170,7 @@ class ApiResilience:
         self.degraded_window = degraded_window
         self.degraded_threshold = degraded_threshold
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("ApiResilience._lock")
         self.retries = collections.Counter()  # verb -> re-sends
         self.failures = collections.Counter()  # error class -> attempts failed
         self._recent: collections.deque = collections.deque()  # failure timestamps
@@ -193,6 +193,7 @@ class ApiResilience:
             self._recent.append(now)
             self._prune(now)
 
+    # tpuop-lint: guarded-by=_lock
     def _prune(self, now: float) -> None:
         cutoff = now - self.degraded_window
         while self._recent and self._recent[0] < cutoff:

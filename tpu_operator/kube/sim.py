@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from tpu_operator.kube import errors
+from tpu_operator.kube import errors, racecheck
 from tpu_operator.kube.client import DELETED, SYNC, Client
 from tpu_operator.kube.objects import (
     matches_selector,
@@ -56,7 +56,7 @@ class ClusterSim:
         # every 10-20 ms (at 4096 nodes x 9 operands that is ~37k pods
         # deep-copied per DaemonSet per tick); watches make the sim's
         # steady-state cost O(changes) like the operator's
-        self._cache_lock = threading.Lock()
+        self._cache_lock = racecheck.lock("ClusterSim._cache_lock")
         self._nodes: Dict[str, dict] = {}  # name -> node
         self._pods: Dict[str, Dict[str, dict]] = {}  # ds name -> {node: pod}
         self._subs: list = []

@@ -50,6 +50,7 @@ import time
 from typing import Dict, List, Optional
 
 from tpu_operator import consts
+from tpu_operator.kube import racecheck
 
 # header carrying "trace_id/span_id" on every in-trace HttpClient request
 TRACE_HEADER = "X-Tpuop-Trace"
@@ -420,7 +421,7 @@ class FlightRecorder:
         self.capacity = capacity
         self.max_spans_per_trace = max_spans_per_trace
         self._traces: "collections.deque[Trace]" = collections.deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = racecheck.lock("FlightRecorder._lock")
         self._listeners: list = []
         self.traces_recorded = 0
         self.spans_started = 0
@@ -544,7 +545,7 @@ class FlightRecorder:
 
 
 _RECORDER: Optional[FlightRecorder] = None
-_RECORDER_LOCK = threading.Lock()
+_RECORDER_LOCK = racecheck.lock("trace._RECORDER_LOCK")
 
 
 def recorder() -> FlightRecorder:

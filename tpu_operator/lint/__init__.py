@@ -1,20 +1,28 @@
 """tpuop-lint: commit-time static analysis over everything the operator
 ships.
 
-Three analyzers (see COMPONENTS.md §"lint subsystem" for the rule
-catalog):
+Five analyzer families (see COMPONENTS.md §6 for the rule catalog):
 
-    manifest  every rendered operand state, the goldens, the chart
-              output, and the kustomize bases — security posture,
-              image pinning, label/reference integrity, scheduling
-              hygiene (lint/manifest_rules.py)
-    rbac      AST-extracted apiserver call sites per agent/controller
-              diffed against the shipped Roles/ClusterRoles — missing
-              grants fail at runtime as 403s, excess grants are
-              over-privilege (lint/rbac_static.py)
-    drift     shipped CRD YAML vs the dataclass-derived schemas, helm
-              crds/ vs kustomize crd/, goldens vs regeneration
-              (lint/drift.py)
+    manifest     every rendered operand state, the goldens, the chart
+                 output, and the kustomize bases — security posture,
+                 image pinning, label/reference integrity, scheduling
+                 hygiene (lint/manifest_rules.py)
+    rbac         AST-extracted apiserver call sites per agent/controller
+                 diffed against the shipped Roles/ClusterRoles — missing
+                 grants fail at runtime as 403s, excess grants are
+                 over-privilege (lint/rbac_static.py)
+    drift        shipped CRD YAML vs the dataclass-derived schemas, helm
+                 crds/ vs kustomize crd/, goldens vs regeneration
+                 (lint/drift.py)
+    metrics      registered Prometheus series vs the COMPONENTS.md
+                 catalog both directions, PrometheusRule expr/hygiene
+                 checks, and gauge retirement for dynamic label
+                 dimensions (lint/metrics_catalog.py)
+    concurrency  lock discipline over the threaded control plane:
+                 guarded-by inference, lock-order cycle detection,
+                 blocking-under-lock, thread-spawn hygiene
+                 (lint/concurrency.py; runtime counterpart
+                 kube/racecheck.py)
 
 The motivating incident: a missing ``events`` grant that only surfaced
 at runtime via the RBAC-enforcing fake apiserver (TODO.md round 5) — a

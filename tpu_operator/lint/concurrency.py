@@ -1,0 +1,987 @@
+"""Concurrency analyzer: lock discipline proven from the AST.
+
+The control plane is threaded end to end — informers dispatch watch
+events, controllers run worker pools, the leader elector and watchdog
+race the renew loop, the fake apiserver serializes a shared store —
+and every one of those components guards shared state with
+``threading`` primitives by hand. Nothing proved the hand-rolling
+right. This analyzer is that proof, the fifth ``tpuop-lint`` family
+(TPUOP-C rules), sibling to the runtime harness in
+``tpu_operator.kube.racecheck``:
+
+- **Inventory**: every class (or module) that creates a
+  ``Lock``/``RLock``/``Condition`` — directly or through the
+  ``racecheck.lock/rlock/condition`` factories — is a concurrency
+  scope; everything below only looks at those scopes, so
+  single-threaded code pays nothing.
+- **C001 unguarded shared state**: a guarded-by map is inferred from
+  the attributes mutated inside ``with self._lock`` blocks; an
+  attribute mutated both under a lock and outside any (in a
+  non-``__init__`` method) is exactly the "we lock it *almost*
+  everywhere" bug. Helpers that run with a caller's lock held declare
+  it with a ``# tpuop-lint: guarded-by=<attr>`` pragma on (or above)
+  their ``def`` line.
+- **C002 lock-order inversion**: a static acquisition graph — lock A
+  held while lock B is acquired adds edge A→B, across call chains
+  (``self`` calls, module functions, and attribute/local receivers
+  resolved through type annotations) — and any cycle is an ABBA
+  deadlock that needs only the right interleaving. A self-edge on a
+  non-reentrant ``Lock`` (acquire while held) is reported too: if the
+  two acquisitions ever see the same instance, that thread deadlocks
+  against itself.
+- **C003 blocking call under lock**: apiserver round-trips
+  (``self.client.<verb>``), ``time.sleep``, ``Event.wait``,
+  ``Thread.join``, workqueue ``get``, socket/HTTP primitives and
+  ``subprocess`` reachable while any lock is held. One slow call site
+  then stalls every thread that touches the lock — the "why is the
+  whole control plane frozen" class. (``Condition.wait`` on the held
+  lock itself is exempt: waiting releases.)
+- **C004 leaked thread**: every ``threading.Thread`` must either be a
+  daemon or be ``join``-ed on some shutdown path; anything else keeps
+  the process alive and leaks the thread's state between drills.
+
+The analysis is intentionally intra-package and resolution-limited:
+calls it cannot resolve (callbacks, duck-typed receivers) contribute
+no edges. That trades recall for a near-zero false-positive rate —
+same philosophy as ``rbac_static``. The runtime harness covers the
+dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpu_operator.lint.findings import ERROR, WARNING, Finding, make
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# lock constructors: threading primitives and the racecheck factories
+# (the instrumented layer must read as locks, or adopting it would
+# blind this very analyzer)
+_LOCK_CLASSES = {"Lock", "RLock", "Condition"}
+_RACECHECK_FACTORIES = {"lock": "Lock", "rlock": "RLock", "condition": "Condition"}
+_REENTRANT = {"RLock", "Condition"}  # Condition wraps an RLock by default
+
+_EVENT_CLASSES = {"Event"}
+_THREAD_CLASSES = {"Thread"}
+_QUEUE_CLASSES = {"RateLimitingQueue", "Queue", "SimpleQueue"}
+
+# attribute methods that mutate their receiver in place
+_MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "remove", "clear",
+    "update", "setdefault", "pop", "popitem", "popleft", "appendleft",
+    "move_to_end",
+}
+
+# Client-surface verbs: a call on an attribute chain ending in
+# ``client`` with one of these names is an apiserver round-trip
+_CLIENT_VERBS = {
+    "get", "get_or_none", "list", "watch", "create", "update", "apply",
+    "update_status", "patch", "patch_status", "delete", "evict",
+    "pod_logs", "server_version",
+}
+
+# unambiguous blocking primitives by callee name
+_BLOCKING_NAMES = {"urlopen", "getresponse", "sendall", "recv", "create_connection"}
+_SUBPROCESS_NAMES = {"run", "check_call", "check_output", "call"}
+
+_PRAGMA_RE = re.compile(r"#\s*tpuop-lint:\s*guarded-by=([A-Za-z_]\w*)")
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+# a lock node: (module relpath, class name or "" for module scope, attr/var)
+LockNode = Tuple[str, str, str]
+# a function key: (module relpath, class name or "", function name)
+FuncKey = Tuple[str, str, str]
+
+
+class _FuncFacts:
+    """Everything one pass over a function body records."""
+
+    __slots__ = (
+        "key", "acquires", "calls", "mutations", "blocking", "threads_created",
+        "joins", "daemonized",
+    )
+
+    def __init__(self, key: FuncKey):
+        self.key = key
+        # [(lock node, held tuple, lineno)]
+        self.acquires: List[Tuple[LockNode, Tuple[LockNode, ...], int]] = []
+        # [(callee FuncKey, held tuple, lineno)]
+        self.calls: List[Tuple[FuncKey, Tuple[LockNode, ...], int]] = []
+        # [(attr, held tuple, lineno)]
+        self.mutations: List[Tuple[str, Tuple[LockNode, ...], int]] = []
+        # [(description, held tuple, lineno)]
+        self.blocking: List[Tuple[str, Tuple[LockNode, ...], int]] = []
+        # [(binding name or None, daemon bool, lineno, thread label)]
+        self.threads_created: List[Tuple[Optional[str], bool, int, str]] = []
+        # names/attrs .join()ed in this function
+        self.joins: Set[str] = set()
+        # names/attrs with `.daemon = True` assigned
+        self.daemonized: Set[str] = set()
+
+
+class _ClassFacts:
+    __slots__ = ("module", "name", "locks", "events", "threads", "queues",
+                 "thread_lists", "attr_types", "funcs")
+
+    def __init__(self, module: str, name: str):
+        self.module = module
+        self.name = name
+        self.locks: Dict[str, str] = {}   # attr -> lock class (Lock/RLock/Condition)
+        self.events: Set[str] = set()
+        self.threads: Set[str] = set()
+        self.queues: Set[str] = set()
+        self.thread_lists: Set[str] = set()  # attrs that .append(thread)
+        self.attr_types: Dict[str, str] = {}  # attr -> annotated class name
+        self.funcs: Dict[str, _FuncFacts] = {}
+
+
+class Project:
+    """Parsed package: per-module ASTs plus the cross-module indexes the
+    passes resolve calls and types through."""
+
+    def __init__(self):
+        self.modules: Dict[str, ast.Module] = {}
+        self.sources: Dict[str, str] = {}
+        self.classes: Dict[Tuple[str, str], _ClassFacts] = {}  # (module, cls)
+        self.module_funcs: Dict[FuncKey, _FuncFacts] = {}
+        self.module_locks: Dict[Tuple[str, str], str] = {}  # (module, var) -> kind
+        self.class_index: Dict[str, Tuple[str, str]] = {}  # class name -> (module, cls)
+        self.pragmas: Dict[Tuple[str, int], str] = {}  # (module, lineno) -> lock attr
+
+    def add_module(self, relpath: str, source: str) -> None:
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError:
+            return
+        self.modules[relpath] = tree
+        self.sources[relpath] = source
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                self.pragmas[(relpath, lineno)] = m.group(1)
+
+    def pragma_for_def(self, module: str, node) -> Optional[str]:
+        """Method-level guarded-by pragma: on the def line, or on the
+        line directly above the def/its first decorator."""
+        first = min([node.lineno] + [d.lineno for d in node.decorator_list])
+        for lineno in (node.lineno, first - 1):
+            hit = self.pragmas.get((module, lineno))
+            if hit:
+                return hit
+        return None
+
+
+def _call_name(node: ast.Call) -> Tuple[str, str]:
+    """(receiver hint, callee name): 'threading', 'Lock' for
+    threading.Lock(); '', 'Lock' for bare Lock()."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name):
+            return base.id, fn.attr
+        if isinstance(base, ast.Attribute):
+            return base.attr, fn.attr
+        return "", fn.attr
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    return "", ""
+
+
+def _lock_kind_of_call(node: ast.Call) -> Optional[str]:
+    recv, name = _call_name(node)
+    if name in _LOCK_CLASSES and recv in ("threading", ""):
+        return name
+    if name in _RACECHECK_FACTORIES and "racecheck" in recv:
+        return _RACECHECK_FACTORIES[name]
+    return None
+
+
+def _self_attr_target(node) -> Optional[str]:
+    """The self-attribute a store/mutation ultimately lands on:
+    ``self.X``, ``self.X[...]``, ``self.X.get(...).pop(...)`` → X."""
+    while isinstance(node, (ast.Subscript, ast.Call)):
+        node = node.value if isinstance(node, ast.Subscript) else node.func
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        while isinstance(base, (ast.Subscript, ast.Call)):
+            base = base.value if isinstance(base, ast.Subscript) else base.func
+        if isinstance(base, ast.Name) and base.id == "self":
+            return node.attr
+        if isinstance(base, ast.Attribute):
+            # self.X.Y... → the shared attribute is X
+            inner = base
+            while isinstance(inner.value, ast.Attribute):
+                inner = inner.value
+            if isinstance(inner.value, ast.Name) and inner.value.id == "self":
+                return inner.attr
+    return None
+
+
+def _attr_chain(node) -> List[str]:
+    """['self', 'client', 'watch'] for self.client.watch; [] when the
+    chain bottoms out in anything but a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _strip_type(annotation) -> Optional[str]:
+    """Class name out of an annotation: T, Optional[T], List[T],
+    'T' (string form), Dict[K, V] → V."""
+    node = annotation
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+        for pat in (r"Optional\[(.+)\]", r"List\[(.+)\]", r"Dict\[[^,]+,\s*(.+)\]"):
+            m = re.fullmatch(pat, text.strip())
+            if m:
+                text = m.group(1)
+        return text.strip().split(".")[-1] or None
+    if isinstance(node, ast.Subscript):
+        container = node.value
+        cname = container.attr if isinstance(container, ast.Attribute) else (
+            container.id if isinstance(container, ast.Name) else "")
+        inner = node.slice
+        if cname in ("Optional", "List", "Sequence", "Iterable", "Tuple"):
+            return _strip_type(inner if not isinstance(inner, ast.Tuple) else inner.elts[0])
+        if cname == "Dict" and isinstance(inner, ast.Tuple) and len(inner.elts) == 2:
+            return _strip_type(inner.elts[1])
+        return None
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pass 1: inventory (locks, events, threads, types)
+# ---------------------------------------------------------------------------
+
+
+def _inventory(project: Project) -> None:
+    for module, tree in project.modules.items():
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                kind = _lock_kind_of_call(node.value)
+                if kind:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            project.module_locks[(module, target.id)] = kind
+            if isinstance(node, ast.ClassDef):
+                facts = _ClassFacts(module, node.name)
+                project.classes[(module, node.name)] = facts
+                project.class_index.setdefault(node.name, (module, node.name))
+                for item in ast.walk(node):
+                    if isinstance(item, ast.AnnAssign) and item.target is not None:
+                        attr = _self_attr_target(item.target)
+                        if attr:
+                            t = _strip_type(item.annotation)
+                            if t:
+                                facts.attr_types[attr] = t
+                    if not isinstance(item, ast.Assign) or not isinstance(item.value, ast.Call):
+                        continue
+                    attr = None
+                    for target in item.targets:
+                        attr = attr or _self_attr_target(target)
+                    if not attr:
+                        continue
+                    kind = _lock_kind_of_call(item.value)
+                    recv, cname = _call_name(item.value)
+                    if kind:
+                        facts.locks[attr] = kind
+                    elif cname in _EVENT_CLASSES:
+                        facts.events.add(attr)
+                    elif cname in _THREAD_CLASSES:
+                        facts.threads.add(attr)
+                    elif cname in _QUEUE_CLASSES:
+                        facts.queues.add(attr)
+                # AnnAssign with Call value (self.x: T = Thread(...)) — rare;
+                # the AnnAssign loop above already captured the type.
+                for item in ast.walk(node):
+                    if isinstance(item, ast.AnnAssign) and isinstance(item.value, ast.Call):
+                        attr = _self_attr_target(item.target)
+                        if attr:
+                            kind = _lock_kind_of_call(item.value)
+                            if kind:
+                                facts.locks[attr] = kind
+                            else:
+                                _, cname = _call_name(item.value)
+                                if cname in _THREAD_CLASSES:
+                                    facts.threads.add(attr)
+
+
+# ---------------------------------------------------------------------------
+# pass 2: function walk
+# ---------------------------------------------------------------------------
+
+
+class _FuncWalker:
+    """One function body: tracks the held-lock set positionally through
+    with-blocks, records acquisitions, mutations, resolvable calls,
+    blocking ops, and thread hygiene facts."""
+
+    def __init__(self, project: Project, module: str, cls: Optional[_ClassFacts], fn_node):
+        self.project = project
+        self.module = module
+        self.cls = cls
+        name = fn_node.name
+        self.key: FuncKey = (module, cls.name if cls else "", name)
+        self.facts = _FuncFacts(self.key)
+        self.local_types: Dict[str, str] = {}   # var -> class name
+        self.local_threads: Set[str] = set()    # vars bound to Thread(...)
+        base_held: Tuple[LockNode, ...] = ()
+        pragma = project.pragma_for_def(module, fn_node)
+        if pragma and cls is not None:
+            base_held = (self._lock_node_for_attr(pragma),)
+        self.base_held = base_held
+        self.fn_node = fn_node
+
+    # -- resolution helpers --------------------------------------------------
+
+    def _lock_node_for_attr(self, attr: str) -> LockNode:
+        return (self.module, self.cls.name if self.cls else "", attr)
+
+    def _lock_node_of_expr(self, expr) -> Optional[LockNode]:
+        chain = _attr_chain(expr)
+        if len(chain) == 2 and chain[0] == "self" and self.cls is not None:
+            if chain[1] in self.cls.locks:
+                return self._lock_node_for_attr(chain[1])
+        if len(chain) == 1:
+            if (self.module, chain[0]) in self.project.module_locks:
+                return (self.module, "", chain[0])
+        # other.X / self.a.b locks: resolvable only via receiver type
+        if len(chain) == 3 and chain[0] == "self" and self.cls is not None:
+            owner = self.cls.attr_types.get(chain[1])
+            resolved = self.project.class_index.get(owner or "")
+            if resolved and chain[2] in self.project.classes[resolved].locks:
+                return (resolved[0], resolved[1], chain[2])
+        return None
+
+    def _type_of_receiver(self, expr) -> Optional[Tuple[str, str]]:
+        """Class key of a call receiver, through self-attr annotations and
+        constructor-typed locals."""
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        if chain[0] == "self" and self.cls is not None and len(chain) >= 2:
+            t = self.cls.attr_types.get(chain[1])
+            return self.project.class_index.get(t or "")
+        if len(chain) >= 1:
+            t = self.local_types.get(chain[0])
+            return self.project.class_index.get(t or "")
+        return None
+
+    def _resolve_call(self, call: ast.Call) -> Optional[FuncKey]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # bare module function (or a locally-imported name — only
+            # resolved when this module defines it)
+            key = (self.module, "", fn.id)
+            if key in self.project.module_funcs or fn.id in (
+                f.name for f in self.project.modules.get(self.module, ast.Module(body=[], type_ignores=[])).body
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ):
+                return key
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "self" and self.cls is not None:
+            return (self.module, self.cls.name, fn.attr)
+        owner = self._type_of_receiver(base)
+        if owner is not None:
+            return (owner[0], owner[1], fn.attr)
+        return None
+
+    # -- blocking classification ---------------------------------------------
+
+    def _blocking_desc(self, call: ast.Call, held: Tuple[LockNode, ...]) -> Optional[str]:
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        name = fn.attr
+        chain = _attr_chain(fn)
+        recv_chain = chain[:-1]
+        if name == "sleep" and recv_chain and recv_chain[-1] == "time":
+            return "time.sleep"
+        if name in _BLOCKING_NAMES:
+            return f"{name}() (socket/HTTP primitive)"
+        if name in _SUBPROCESS_NAMES and recv_chain and recv_chain[-1] == "subprocess":
+            return f"subprocess.{name}"
+        if name in _CLIENT_VERBS and recv_chain and recv_chain[-1] == "client":
+            return f"client.{name} (apiserver round-trip)"
+        if self.cls is not None and len(recv_chain) == 2 and recv_chain[0] == "self":
+            attr = recv_chain[1]
+            if name == "wait" and attr in self.cls.events:
+                return f"Event self.{attr}.wait"
+            if name == "wait" and attr in self.cls.locks:
+                # Condition.wait releases ONLY the waited-on lock; it is
+                # exempt exactly when it is the sole lock held — waiting
+                # while holding anything else parks the thread with the
+                # other lock still taken
+                node = self._lock_node_for_attr(attr)
+                others = [h for h in held if h != node]
+                if not others:
+                    return None
+                return f"Condition self.{attr}.wait (releases only itself)"
+            if name == "join" and (attr in self.cls.threads or attr in self.cls.thread_lists):
+                return f"Thread self.{attr}.join"
+            if name in ("get", "join") and attr in self.cls.queues:
+                return f"queue self.{attr}.{name}"
+        if name == "join" and len(recv_chain) == 1:
+            var = recv_chain[0]
+            if var in self.local_threads:
+                return f"Thread {var}.join"
+        return None
+
+    # -- the walk ------------------------------------------------------------
+
+    def walk(self) -> _FuncFacts:
+        self._walk_body(self.fn_node.body, self.base_held)
+        return self.facts
+
+    def _statement_held(self, node, held: Tuple[LockNode, ...]) -> Tuple[LockNode, ...]:
+        """A line-level guarded-by pragma extends the held set for that
+        statement only (aliased locks: 'the caller holds X here')."""
+        pragma = self.project.pragmas.get((self.module, getattr(node, "lineno", -1)))
+        if pragma and self.cls is not None:
+            return held + (self._lock_node_for_attr(pragma),)
+        return held
+
+    def _walk_body(self, body: Sequence, held: Tuple[LockNode, ...]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, self._statement_held(stmt, held))
+
+    def _walk_stmt(self, node, held: Tuple[LockNode, ...]) -> None:
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                lock = self._lock_node_of_expr(item.context_expr)
+                if lock is not None:
+                    self.facts.acquires.append((lock, inner, node.lineno))
+                    inner = inner + (lock,)
+                else:
+                    self._scan_expr(item.context_expr, inner)
+            self._walk_body(node.body, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body runs later (callback) — analyze with
+            # an empty held set, under the same function key so thread
+            # hygiene facts still land somewhere findable
+            self._walk_body(node.body, ())
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        # record mutations on assignment statements (no nested bodies)
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                self._record_target(target, held, node.lineno)
+            value = getattr(node, "value", None)
+            if value is not None:
+                self._scan_expr(value, held)
+                self._track_binding(node, value)
+            return
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = _self_attr_target(target)
+                if attr:
+                    self.facts.mutations.append((attr, held, node.lineno))
+            return
+        # loop-var typing BEFORE the body walk — `for t in self._threads:
+        # t.join()` needs t typed as a thread when the body is visited
+        if isinstance(node, ast.For):
+            self._type_loop_var(node)
+            self._scan_expr(node.iter, held)
+        value = getattr(node, "test", None) or getattr(node, "value", None)
+        if value is not None:
+            self._scan_expr(value, held)
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            self._scan_expr(node.exc, held)
+        # statements with nested bodies
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(node, field, None)
+            if sub:
+                self._walk_body(sub, held)
+        for handler in getattr(node, "handlers", ()) or ():
+            self._walk_body(handler.body, held)
+
+    def _type_loop_var(self, node: ast.For) -> None:
+        if not isinstance(node.target, ast.Name) or self.cls is None:
+            return
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) and it.func.id == "list" and it.args:
+            it = it.args[0]
+        chain = _attr_chain(it)
+        # self.attr or self.attr.values()
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) and it.func.attr == "values":
+            chain = _attr_chain(it.func.value)
+        if len(chain) == 2 and chain[0] == "self":
+            attr = chain[1]
+            t = self.cls.attr_types.get(attr)
+            if t:
+                self.local_types[node.target.id] = t
+            if attr in self.cls.thread_lists:
+                self.local_threads.add(node.target.id)
+
+    def _record_target(self, target, held: Tuple[LockNode, ...], lineno: int) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, held, lineno)
+            return
+        attr = _self_attr_target(target)
+        if attr:
+            self.facts.mutations.append((attr, held, lineno))
+            # thread daemonization: self.X.daemon = True handled in binding
+        if isinstance(target, ast.Attribute) and target.attr == "daemon":
+            chain = _attr_chain(target.value)
+            if chain:
+                self.facts.daemonized.add(chain[-1])
+
+    def _track_binding(self, node, value) -> None:
+        """Local type facts: x = ClassName(...), x = Thread(...), and
+        thread-list appends are recorded where assignments happen."""
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        if not isinstance(value, ast.Call):
+            return
+        recv, cname = _call_name(value)
+        for target in targets:
+            if isinstance(target, ast.Name):
+                if cname in _THREAD_CLASSES:
+                    self.local_threads.add(target.id)
+                    self.facts.threads_created.append(
+                        (target.id, _thread_is_daemon(value), value.lineno,
+                         _thread_label(value)))
+                elif cname in self.project.class_index:
+                    self.local_types[target.id] = cname
+            attr = _self_attr_target(target)
+            if attr and cname in _THREAD_CLASSES and self.cls is not None:
+                self.cls.threads.add(attr)
+                self.facts.threads_created.append(
+                    (attr, _thread_is_daemon(value), value.lineno, _thread_label(value)))
+
+    def _scan_expr(self, expr, held: Tuple[LockNode, ...]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, cname = _call_name(node)
+            # unbound Thread(...).start() chains and bare Thread() calls
+            if cname in _THREAD_CLASSES and recv in ("threading", ""):
+                bound = self._call_is_bound(node)
+                if not bound:
+                    self.facts.threads_created.append(
+                        (None, _thread_is_daemon(node), node.lineno, _thread_label(node)))
+            # mutations through method calls: self.X.append(...)
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+                attr = _self_attr_target(node.func.value)
+                if attr and self.cls is not None:
+                    if attr in self.cls.locks:
+                        pass  # lock.acquire-style noise, not state
+                    else:
+                        self.facts.mutations.append((attr, held, node.lineno))
+                    # thread-list bookkeeping: self.X.append(<thread local>)
+                    if node.func.attr in ("append", "extend") and node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Name) and arg.id in self.local_threads:
+                            self.cls.thread_lists.add(attr)
+            # .join() bookkeeping (thread hygiene)
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "join":
+                chain = _attr_chain(node.func.value)
+                if chain:
+                    self.facts.joins.add(chain[-1])
+            blocking = self._blocking_desc(node, held)
+            if blocking is not None and held:
+                self.facts.blocking.append((blocking, held, node.lineno))
+            elif blocking is not None:
+                self.facts.blocking.append((blocking, (), node.lineno))
+            callee = self._resolve_call(node)
+            if callee is not None:
+                self.facts.calls.append((callee, held, node.lineno))
+
+    def _call_is_bound(self, call: ast.Call) -> bool:
+        """True when this Thread(...) call is the value of an assignment
+        (handled by _track_binding) rather than an anonymous chain."""
+        for parent in ast.walk(self.fn_node):
+            if isinstance(parent, (ast.Assign, ast.AnnAssign)) and getattr(parent, "value", None) is call:
+                return True
+        return False
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _thread_label(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+        if kw.arg == "target":
+            chain = _attr_chain(kw.value)
+            if chain:
+                return chain[-1]
+    return "thread"
+
+
+# ---------------------------------------------------------------------------
+# pass 3: cross-function closure
+# ---------------------------------------------------------------------------
+
+
+class _Closure:
+    """Memoized per-function summaries over the call graph: which locks
+    a call may acquire, and which blocking ops it may reach. Bounded
+    depth guards against resolution cycles."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.all_funcs: Dict[FuncKey, _FuncFacts] = {}
+        for facts in project.module_funcs.values():
+            self.all_funcs[facts.key] = facts
+        for cls in project.classes.values():
+            for facts in cls.funcs.values():
+                self.all_funcs[facts.key] = facts
+        self._locks_memo: Dict[FuncKey, Set[LockNode]] = {}
+        self._block_memo: Dict[FuncKey, Set[Tuple[str, FuncKey]]] = {}
+
+    def locks_acquired(self, key: FuncKey, _seen: Optional[set] = None) -> Set[LockNode]:
+        if key in self._locks_memo:
+            return self._locks_memo[key]
+        seen = _seen or set()
+        if key in seen:
+            return set()
+        seen.add(key)
+        facts = self.all_funcs.get(key)
+        out: Set[LockNode] = set()
+        if facts is not None:
+            out.update(lock for lock, _held, _ln in facts.acquires)
+            for callee, _held, _ln in facts.calls:
+                out.update(self.locks_acquired(callee, seen))
+        if _seen is None:
+            self._locks_memo[key] = out
+        return out
+
+    def blocking_reachable(self, key: FuncKey, _seen: Optional[set] = None) -> Set[Tuple[str, FuncKey]]:
+        """(description, defining function) pairs reachable from key,
+        including ops that run with no lock held locally — the caller's
+        held set is what matters."""
+        if key in self._block_memo:
+            return self._block_memo[key]
+        seen = _seen or set()
+        if key in seen:
+            return set()
+        seen.add(key)
+        facts = self.all_funcs.get(key)
+        out: Set[Tuple[str, FuncKey]] = set()
+        if facts is not None:
+            out.update((desc, key) for desc, _held, _ln in facts.blocking)
+            for callee, _held, _ln in facts.calls:
+                out.update(self.blocking_reachable(callee, seen))
+        if _seen is None:
+            self._block_memo[key] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _fmt_lock(node: LockNode) -> str:
+    module, cls, attr = node
+    scope = f"{cls}." if cls else ""
+    return f"{scope}{attr}"
+
+
+def _fmt_func(key: FuncKey) -> str:
+    module, cls, name = key
+    scope = f"{cls}." if cls else ""
+    return f"{scope}{name}"
+
+
+def _c001_unguarded_state(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for (module, cname), cls in sorted(project.classes.items()):
+        if not cls.locks:
+            continue
+        guarded: Dict[str, Set[LockNode]] = {}
+        unguarded: Dict[str, List[Tuple[str, int]]] = {}
+        for fname, facts in cls.funcs.items():
+            if fname in ("__init__", "__new__", "__post_init__"):
+                continue  # construction precedes sharing
+            for attr, held, lineno in facts.mutations:
+                if attr in cls.locks or attr.startswith("__"):
+                    continue
+                if held:
+                    guarded.setdefault(attr, set()).update(held)
+                else:
+                    unguarded.setdefault(attr, []).append((fname, lineno))
+        for attr in sorted(set(guarded) & set(unguarded)):
+            locks = ", ".join(sorted(_fmt_lock(l) for l in guarded[attr]))
+            sites = ", ".join(f"{fn}:{ln}" for fn, ln in sorted(unguarded[attr])[:4])
+            findings.append(make(
+                "TPUOP-C001", ERROR,
+                f"py:{module}:{cname}.{attr}",
+                f"attribute mutated under {locks} but also lock-free at "
+                f"{sites} — either every mutation takes the lock or none "
+                "meaningfully does (add a `# tpuop-lint: guarded-by=` "
+                "pragma if an aliased caller holds it)",
+            ))
+    return findings
+
+
+def _c002_lock_order(project: Project, closure: _Closure) -> List[Finding]:
+    # edge -> example (function, lineno)
+    edges: Dict[Tuple[LockNode, LockNode], Tuple[FuncKey, int]] = {}
+    lock_kinds: Dict[LockNode, str] = {}
+    for (module, var), kind in project.module_locks.items():
+        lock_kinds[(module, "", var)] = kind
+    for (module, cname), cls in project.classes.items():
+        for attr, kind in cls.locks.items():
+            lock_kinds[(module, cname, attr)] = kind
+
+    for key, facts in closure.all_funcs.items():
+        for lock, held, lineno in facts.acquires:
+            for h in held:
+                if h != lock:
+                    edges.setdefault((h, lock), (key, lineno))
+            if held and lock in held and lock_kinds.get(lock) == "Lock":
+                edges.setdefault((lock, lock), (key, lineno))
+        for callee, held, lineno in facts.calls:
+            if not held:
+                continue
+            for inner in closure.locks_acquired(callee):
+                for h in held:
+                    if h == inner:
+                        if lock_kinds.get(inner) == "Lock":
+                            edges.setdefault((inner, inner), (key, lineno))
+                        continue
+                    edges.setdefault((h, inner), (key, lineno))
+
+    graph: Dict[LockNode, Set[LockNode]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    findings: List[Finding] = []
+    reported: Set[frozenset] = set()
+
+    # self-edges on non-reentrant locks: deadlock when both acquisitions
+    # ever see the same instance
+    for (a, b), (fn, lineno) in sorted(edges.items()):
+        if a == b and frozenset((a,)) not in reported:
+            reported.add(frozenset((a,)))
+            findings.append(make(
+                "TPUOP-C002", ERROR,
+                f"lockcycle:{_fmt_lock(a)}",
+                f"non-reentrant Lock {_fmt_lock(a)} can be acquired while "
+                f"already held (via {_fmt_func(fn)}:{lineno}) — same-instance "
+                "re-entry deadlocks the thread against itself",
+            ))
+
+    # cycles of length >= 2: DFS from every node
+    def find_cycle(start: LockNode) -> Optional[List[LockNode]]:
+        stack: List[Tuple[LockNode, List[LockNode]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    return path
+                if nxt in path or nxt == node:
+                    continue
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    for start in sorted(graph):
+        cycle = find_cycle(start)
+        if cycle is None:
+            continue
+        key = frozenset(cycle)
+        if key in reported:
+            continue
+        reported.add(key)
+        ring = cycle + [cycle[0]]
+        names = " -> ".join(_fmt_lock(n) for n in ring)
+        sites = []
+        for a, b in zip(ring, ring[1:]):
+            fn, lineno = edges.get((a, b), (("?", "", "?"), 0))
+            sites.append(f"{_fmt_lock(a)}->{_fmt_lock(b)} at {_fmt_func(fn)}:{lineno}")
+        anchor = min(_fmt_lock(n) for n in cycle)
+        findings.append(make(
+            "TPUOP-C002", ERROR,
+            f"lockcycle:{anchor}",
+            f"lock-order inversion: {names} ({'; '.join(sites)}) — an "
+            "ABBA deadlock needing only the right thread interleaving; "
+            "pick one global order and stick to it",
+        ))
+    return findings
+
+
+def _c003_blocking_under_lock(project: Project, closure: _Closure) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for key, facts in sorted(closure.all_funcs.items()):
+        for desc, held, lineno in facts.blocking:
+            if not held:
+                continue
+            locks = ", ".join(sorted(_fmt_lock(h) for h in held))
+            dedup = (f"py:{key[0]}:{_fmt_func(key)}", desc, locks)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            findings.append(make(
+                "TPUOP-C003", ERROR,
+                f"py:{key[0]}:{_fmt_func(key)}",
+                f"blocking call {desc} at line {lineno} while holding "
+                f"{locks} — every thread touching the lock stalls behind "
+                "this call; move it outside the critical section",
+            ))
+        for callee, held, lineno in facts.calls:
+            if not held:
+                continue
+            for desc, origin in sorted(closure.blocking_reachable(callee)):
+                locks = ", ".join(sorted(_fmt_lock(h) for h in held))
+                dedup = (f"py:{key[0]}:{_fmt_func(key)}", desc, locks)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                findings.append(make(
+                    "TPUOP-C003", ERROR,
+                    f"py:{key[0]}:{_fmt_func(key)}",
+                    f"call at line {lineno} holding {locks} reaches blocking "
+                    f"{desc} (in {_fmt_func(origin)}) — every thread touching "
+                    "the lock stalls behind it; restructure so the blocking "
+                    "step runs outside the critical section",
+                ))
+    return findings
+
+
+def _c004_leaked_threads(project: Project, closure: _Closure) -> List[Finding]:
+    findings: List[Finding] = []
+    # joins and daemonizations are collected per class / module scope
+    for (module, cname), cls in sorted(project.classes.items()):
+        joins: Set[str] = set()
+        daemonized: Set[str] = set()
+        for facts in cls.funcs.values():
+            joins |= facts.joins
+            daemonized |= facts.daemonized
+        for facts in sorted(cls.funcs.values(), key=lambda f: f.key):
+            for binding, daemon, lineno, label in facts.threads_created:
+                if daemon:
+                    continue
+                if binding is not None and (binding in joins or binding in daemonized):
+                    continue
+                findings.append(make(
+                    "TPUOP-C004", ERROR,
+                    f"py:{module}:{cname}.{facts.key[2]}",
+                    f"thread '{label}' created at line {lineno} is neither "
+                    "daemon nor joined on any shutdown path — it outlives "
+                    "stop() and leaks state between runs",
+                ))
+    # joins scoped per module (a join in module B must not excuse a
+    # leaked thread in module A just because the variable names match)
+    joins_by_module: Dict[str, Set[str]] = {}
+    for key, facts in project.module_funcs.items():
+        joins_by_module.setdefault(key[0], set()).update(facts.joins)
+    for key, facts in sorted(project.module_funcs.items()):
+        module_joins = joins_by_module.get(key[0], set())
+        for binding, daemon, lineno, label in facts.threads_created:
+            if daemon:
+                continue
+            if binding is not None and binding in module_joins:
+                continue
+            findings.append(make(
+                "TPUOP-C004", ERROR,
+                f"py:{key[0]}:{_fmt_func(key)}",
+                f"thread '{label}' created at line {lineno} is neither "
+                "daemon nor joined on any shutdown path — it outlives "
+                "shutdown and leaks state between runs",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def build_project(source_root: Optional[str] = None) -> Project:
+    root = source_root or PKG_ROOT
+    project = Project()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path) as f:
+                    project.add_module(rel, f.read())
+            except OSError:
+                continue
+    _analyze_project(project)
+    return project
+
+
+def _analyze_project(project: Project) -> None:
+    _inventory(project)
+    # two walk passes: the first accumulates order-dependent class facts
+    # (thread-list attrs discovered in start() that stop() joins over),
+    # the second records the facts the rules read — so declaration order
+    # inside a class never changes the verdict
+    for final in (False, True):
+        for module, tree in project.modules.items():
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walker = _FuncWalker(project, module, None, node)
+                    if final:
+                        project.module_funcs[walker.key] = walker.walk()
+                    else:
+                        walker.walk()
+                elif isinstance(node, ast.ClassDef):
+                    cls = project.classes[(module, node.name)]
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            walker = _FuncWalker(project, module, cls, item)
+                            if final:
+                                cls.funcs[item.name] = walker.walk()
+                            else:
+                                walker.walk()
+
+
+def analyze_project(project: Project) -> List[Finding]:
+    closure = _Closure(project)
+    findings: List[Finding] = []
+    findings.extend(_c001_unguarded_state(project))
+    findings.extend(_c002_lock_order(project, closure))
+    findings.extend(_c003_blocking_under_lock(project, closure))
+    findings.extend(_c004_leaked_threads(project, closure))
+    return findings
+
+
+def analyze(source_root: Optional[str] = None) -> List[Finding]:
+    """The runner entry point: lint the shipped package tree."""
+    return analyze_project(build_project(source_root))
+
+
+def analyze_source(source: str, relpath: str = "module.py") -> List[Finding]:
+    """Single-module entry point for tests and seeded-defect fixtures."""
+    project = Project()
+    project.add_module(relpath, source)
+    _analyze_project(project)
+    return analyze_project(project)

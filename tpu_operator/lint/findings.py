@@ -178,18 +178,18 @@ def render_text(findings: List[Finding], show_suppressed: bool = False) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_json(findings: List[Finding]) -> str:
-    return (
-        json.dumps(
-            {
-                "findings": [f.to_dict() for f in sort_findings(findings)],
-                "summary": summarize(findings),
-            },
-            indent=2,
-            sort_keys=False,
-        )
-        + "\n"
-    )
+def render_json(
+    findings: List[Finding], timings: Optional[Dict[str, float]] = None
+) -> str:
+    report = {
+        "findings": [f.to_dict() for f in sort_findings(findings)],
+        "summary": summarize(findings),
+    }
+    if timings:
+        report["analyzer_seconds"] = {
+            name: round(seconds, 4) for name, seconds in sorted(timings.items())
+        }
+    return json.dumps(report, indent=2, sort_keys=False) + "\n"
 
 
 def make(rule: str, severity: str, location: str, message: str) -> Finding:
@@ -217,6 +217,11 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPUOP-O002": (ERROR, "COMPONENTS.md catalog lists a metric no code registers"),
     "TPUOP-O003": (ERROR, "PrometheusRule expression references a metric no code registers (the alert can never fire)"),
     "TPUOP-O004": (ERROR, "PrometheusRule alert missing summary/description annotations or a non-zero for: duration"),
+    "TPUOP-O005": (ERROR, "dynamically-labelled gauge with no reachable removal/retire call site (stale series)"),
+    "TPUOP-C001": (ERROR, "shared attribute mutated both under and outside its inferred guarding lock"),
+    "TPUOP-C002": (ERROR, "lock-order inversion: static acquisition-graph cycle (ABBA deadlock)"),
+    "TPUOP-C003": (ERROR, "blocking call (apiserver/sleep/join/Event.wait/socket) reachable while a lock is held"),
+    "TPUOP-C004": (ERROR, "threading.Thread neither daemon nor joined on a shutdown path (leaked thread)"),
     "TPUOP-D001": (ERROR, "shipped CRD schema drifted from the dataclass model"),
     "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
     "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),

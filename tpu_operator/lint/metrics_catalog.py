@@ -221,6 +221,182 @@ def analyze_rule_hygiene(
     return findings
 
 
+# label dimensions whose VALUES are cluster state (slices come and go,
+# pools drain, edges are cut, chips vanish, probes retire with their
+# hardware): a gauge labelled by one of these accretes stale series
+# unless some code path removes them. Dimensions like ``controller`` or
+# ``node`` (a node-local exporter's own name) are fixed for the life of
+# the process and die with it.
+DYNAMIC_LABEL_DIMENSIONS = frozenset({"slice", "pool", "edge", "chip", "probe", "gang"})
+
+
+def _registered_gauges(source_root: Optional[str] = None) -> Dict[str, dict]:
+    """metric name -> {file, labels, attrs} for every labelled Gauge
+    registration (direct or factory style), with the attribute/global
+    names the collector object is bound to."""
+    root = source_root or PKG_ROOT
+    out: Dict[str, dict] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, OSError):
+                continue
+            rel = os.path.relpath(path, root)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                    continue
+                call = node.value
+                callee = _callee_name(call)
+                is_gauge = callee == "Gauge" or any(
+                    (isinstance(a, ast.Attribute) and a.attr == "Gauge")
+                    or (isinstance(a, ast.Name) and a.id == "Gauge")
+                    for a in call.args
+                )
+                if not is_gauge:
+                    continue
+                name = next(
+                    (a.value for a in call.args
+                     if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                     and a.value.startswith(_METRIC_PREFIXES)),
+                    None,
+                )
+                if not name:
+                    continue
+                labels: List[str] = []
+                for a in list(call.args) + [k.value for k in call.keywords]:
+                    if isinstance(a, (ast.List, ast.Tuple)):
+                        labels = [e.value for e in a.elts if isinstance(e, ast.Constant)]
+                if not labels:
+                    continue
+                attrs = set()
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute):
+                        attrs.add(target.attr)
+                    elif isinstance(target, ast.Name):
+                        attrs.add(target.id)
+                entry = out.setdefault(name, {"file": rel, "labels": labels, "attrs": set()})
+                entry["attrs"] |= attrs
+    return out
+
+
+def _retired_attrs(source_root: Optional[str] = None) -> Tuple[Dict[str, Set[str]], Set[str]]:
+    """(attr name -> modules where a ``.remove(...)``/``.clear()`` is
+    called on it, attr names with any non-collector assignment). The
+    for-loop form — several gauges retired through one loop variable
+    over a tuple of attributes, the exporter idiom — is expanded. The
+    ambiguous set guards name collisions: ``.clear()`` on some
+    unrelated dict attr named like a gauge must not count as that
+    gauge's retire site (see ``analyze_gauge_retirement``)."""
+    root = source_root or PKG_ROOT
+    retired: Dict[str, Set[str]] = {}
+    ambiguous: Set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read())
+            except (SyntaxError, OSError):
+                continue
+            rel = os.path.relpath(path, root)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("remove", "clear"):
+                    base = node.func.value
+                    if isinstance(base, ast.Attribute):
+                        retired.setdefault(base.attr, set()).add(rel)
+                    elif isinstance(base, ast.Name):
+                        retired.setdefault(base.id, set()).add(rel)
+                if isinstance(node, ast.For) and isinstance(node.target, ast.Name) \
+                        and isinstance(node.iter, (ast.Tuple, ast.List)):
+                    loop_var = node.target.id
+                    removes = any(
+                        isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Attribute)
+                        and inner.func.attr in ("remove", "clear")
+                        and isinstance(inner.func.value, ast.Name)
+                        and inner.func.value.id == loop_var
+                        for inner in ast.walk(node)
+                    )
+                    if removes:
+                        for elt in node.iter.elts:
+                            if isinstance(elt, ast.Attribute):
+                                retired.setdefault(elt.attr, set()).add(rel)
+                            elif isinstance(elt, ast.Name):
+                                retired.setdefault(elt.id, set()).add(rel)
+                # any assignment of this attr/name to something that is
+                # NOT a collector construction makes the bare name
+                # ambiguous as a cross-module retire witness
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                    is_collector = isinstance(value, ast.Call) and (
+                        _callee_name(value) in _COLLECTOR_CLASSES
+                        or any(
+                            (isinstance(a, ast.Attribute) and a.attr in _COLLECTOR_CLASSES)
+                            or (isinstance(a, ast.Name) and a.id in _COLLECTOR_CLASSES)
+                            for a in value.args
+                        )
+                    )
+                    if not is_collector:
+                        for target in node.targets:
+                            if isinstance(target, ast.Attribute):
+                                ambiguous.add(target.attr)
+                            elif isinstance(target, ast.Name):
+                                ambiguous.add(target.id)
+    return retired, ambiguous
+
+
+def analyze_gauge_retirement(source_root: Optional[str] = None) -> List[Finding]:
+    """TPUOP-O005: every gauge labelled by a dynamic dimension (slice/
+    pool/edge/chip/probe — values that come and go with cluster state)
+    must have a reachable removal/retire call site. A gauge that only
+    ever gains children exports the last value of every identity it has
+    ever seen — the stale-series class PRs 7 and 8 fixed by hand, made
+    a build failure."""
+    findings: List[Finding] = []
+    retired, ambiguous = _retired_attrs(source_root)
+
+    def has_retire_site(info: dict) -> bool:
+        for attr in info["attrs"]:
+            modules = retired.get(attr)
+            if not modules:
+                continue
+            # a retire site in the gauge's own module always counts; a
+            # cross-module one (gang gauges registered in
+            # operator_metrics, removed in fleet_telemetry) counts only
+            # when the name is unambiguously a collector binding —
+            # .clear() on some unrelated dict that happens to share the
+            # name is not a retirement
+            if info["file"] in modules or attr not in ambiguous:
+                return True
+        return False
+
+    for name, info in sorted(_registered_gauges(source_root).items()):
+        dynamic = sorted(set(info["labels"]) & DYNAMIC_LABEL_DIMENSIONS)
+        if not dynamic:
+            continue
+        if has_retire_site(info):
+            continue
+        findings.append(make(
+            "TPUOP-O005", ERROR, f"metric:{name}",
+            f"gauge registered in {info['file']} with dynamic label "
+            f"dimension(s) {', '.join(dynamic)} but no reachable "
+            ".remove()/.clear() call site — series for departed "
+            f"{'/'.join(dynamic)} values live forever and keep alerts "
+            "firing on state that no longer exists",
+        ))
+    return findings
+
+
 def analyze(
     source_root: Optional[str] = None, components_path: Optional[str] = None
 ) -> List[Finding]:

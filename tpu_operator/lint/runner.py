@@ -21,11 +21,18 @@ contribute nothing there (must-gather runs the same code path).
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import yaml
 
-from tpu_operator.lint import drift, manifest_rules, metrics_catalog, rbac_static
+from tpu_operator.lint import (
+    concurrency,
+    drift,
+    manifest_rules,
+    metrics_catalog,
+    rbac_static,
+)
 from tpu_operator.lint.findings import (
     INFO,
     Baseline,
@@ -39,7 +46,24 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REPO_ROOT = os.path.dirname(PKG_ROOT)
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".tpuop-lint-baseline")
 
-ANALYZERS = ("manifest", "rbac", "drift", "metrics")
+ANALYZERS = ("manifest", "rbac", "drift", "metrics", "concurrency")
+
+# which analyzer family owns each rule-id prefix — what lets --only/
+# --skip accept rule ids and still run only the analyzers involved
+RULE_PREFIX_FAMILIES = {
+    "TPUOP-M": "manifest",
+    "TPUOP-R": "rbac",
+    "TPUOP-D": "drift",
+    "TPUOP-O": "metrics",
+    "TPUOP-C": "concurrency",
+}
+
+
+def family_of_rule(rule: str) -> Optional[str]:
+    for prefix, family in RULE_PREFIX_FAMILIES.items():
+        if rule.startswith(prefix):
+            return family
+    return None
 
 
 def manifest_groups() -> List[Tuple[str, List[dict]]]:
@@ -87,27 +111,42 @@ def manifest_groups() -> List[Tuple[str, List[dict]]]:
 def run_lint(
     baseline_path: Optional[str] = None,
     only: Optional[Sequence[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Run the selected analyzers, dedupe, and apply the baseline.
-    Returns every finding (suppressed ones marked, not dropped)."""
+    Returns every finding (suppressed ones marked, not dropped). Pass a
+    dict as ``timings`` to receive per-analyzer wall seconds (the JSON
+    report surfaces them — a slow analyzer is a CI tax everyone pays)."""
     selected = set(only or ANALYZERS)
     findings: List[Finding] = []
+
+    def timed(name: str, fn) -> None:
+        t0 = time.monotonic()
+        findings.extend(fn())
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + (time.monotonic() - t0)
+
     groups = manifest_groups() if selected & {"manifest", "metrics"} else []
     if "manifest" in selected:
-        for group, objects in groups:
-            findings.extend(manifest_rules.lint_group(group, objects))
+        timed("manifest", lambda: [
+            f for group, objects in groups for f in manifest_rules.lint_group(group, objects)
+        ])
     if "rbac" in selected:
-        findings.extend(rbac_static.analyze())
+        timed("rbac", rbac_static.analyze)
     if "drift" in selected:
-        findings.extend(drift.analyze())
+        timed("drift", drift.analyze)
     if "metrics" in selected:
-        findings.extend(metrics_catalog.analyze())
+        timed("metrics", metrics_catalog.analyze)
         # O003/O004 ride the same rendered groups the manifest rules
         # lint: every series a shipped PrometheusRule references must
         # exist, and every alert must page with meaning (summary/
-        # description) over a sustained condition (non-zero for:)
-        findings.extend(metrics_catalog.analyze_rules(groups))
-        findings.extend(metrics_catalog.analyze_rule_hygiene(groups))
+        # description) over a sustained condition (non-zero for:);
+        # O005 proves every dynamically-labelled gauge can retire.
+        timed("metrics", lambda: metrics_catalog.analyze_rules(groups))
+        timed("metrics", lambda: metrics_catalog.analyze_rule_hygiene(groups))
+        timed("metrics", metrics_catalog.analyze_gauge_retirement)
+    if "concurrency" in selected:
+        timed("concurrency", concurrency.analyze)
     findings = dedupe(findings)
 
     baseline = Baseline.load(

@@ -317,7 +317,8 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         from tpu_operator.lint.findings import render_json
         from tpu_operator.lint.runner import run_lint
 
-        emit("lint-report.json", render_json(run_lint()))
+        timings: dict = {}
+        emit("lint-report.json", render_json(run_lint(timings=timings), timings=timings))
     except Exception as e:  # noqa: BLE001 — the bundle must never fail on lint
         emit("lint-report.json", f"# collection failed: {e}\n")
 
