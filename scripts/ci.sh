@@ -33,10 +33,20 @@ echo "== racecheck: multi-thread drills + compressed chaos soak under instrument
 TPUOP_RACECHECK=1 python3 -m pytest tests/test_racecheck.py -q
 TPUOP_RACECHECK=1 python3 -m pytest tests/test_chaos.py \
   -q -m "not slow" -k "Soak or CrashRestart or LeaderFailover"
-echo "== bench smoke: requests-per-reconcile stays flat 64 -> 256 nodes =="
-# O(changes) gate: fails when rpr[256] > 1.5 x rpr[64] — the regression
-# shape a reintroduced full-scan or full-object write produces
+echo "== bench smoke: requests-per-reconcile + write rate stay flat 1024 -> 16384 nodes =="
+# O(changes) gate for the sharded control plane: fails when
+# rpr[16384] > 1.5 x rpr[1024], or when steady writes-per-flip stops
+# being flat — the regression shapes a reintroduced full-scan,
+# full-object write, or broken shard routing produce
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --scale-smoke
+echo "== bench smoke (racecheck leg): sharded scale path under instrumented locks =="
+# the same gate re-run with every lock instrumented (TPUOP_RACECHECK=1)
+# at a compressed scale pair — instrumented acquires cost ~an order of
+# magnitude, so the leg is bounded the same way the chaos soak's
+# racecheck leg is; any lock-order cycle or mutation-tripwire hit fails
+# the gate via the bench's own racecheck.violations() check
+TPUOP_RACECHECK=1 TPUOP_SCALE_SMOKE_NODES="256,1024" \
+  JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --scale-smoke
 echo "== placement smoke: place/evict/re-place churn on a 512-host torus =="
 # topology gate: the full churn cycle must finish inside the budget with
 # ZERO double-booked hosts — the regression shapes a broken allocator

@@ -45,6 +45,12 @@ class StubApiserver:
                     return
                 path = self.path.split("?")[0]
                 if "watch=true" in self.path:
+                    if "sendInitialEvents=true" in self.path:
+                        # pre-WatchList apiserver: reject the streamed-LIST
+                        # probe so the client falls back to LIST+watch
+                        self._send(400, {"reason": "Invalid",
+                                         "message": "sendInitialEvents not supported"})
+                        return
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.end_headers()
@@ -285,6 +291,16 @@ def test_watch_resumes_from_last_rv_without_relist():
                 import urllib.parse as up
 
                 q = up.parse_qs(up.urlsplit(self.path).query)
+                if q.get("sendInitialEvents") == ["true"]:
+                    # pre-WatchList server: 400 the probe (the client
+                    # then falls back to the LIST+watch under test here)
+                    body = _json.dumps({"reason": "Invalid"}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 rv = (q.get("resourceVersion") or [""])[0]
                 watch_rvs.append(rv)
                 self.send_response(200)

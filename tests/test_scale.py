@@ -163,67 +163,102 @@ class TestScaleFlatness:
                 )
 
 
-class TestLabelPatchConflictRetry:
-    class _ConflictOnce(FakeClient):
-        def __init__(self):
-            super().__init__()
-            self.conflicts_left = 1
-            self.patch_calls = 0
+class TestLabellerApplySet:
+    """The labeller's write path is the apply-set (server-side-apply
+    analog): one declaration per node, no resourceVersion, no
+    read-modify-write — so the Conflict class the old patch path had to
+    retry around cannot occur at all, and write failures still requeue."""
 
-        def patch(self, api_version, kind, name, patch, namespace=None):
-            self.patch_calls += 1
-            if kind == "Node" and self.conflicts_left > 0:
-                self.conflicts_left -= 1
-                raise errors.Conflict("storage race")
-            return super().patch(api_version, kind, name, patch, namespace)
-
-    def test_conflicted_label_patch_retries_once_in_place(self):
-        client = self._ConflictOnce()
-        client.create(make_tpu_node("tpu-0"))
-        client.create(new_cluster_policy())
-        rec = ClusterPolicyReconciler(client, NS)
-        rec.reconcile(Request(name="cluster-policy"))
-        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
-        assert labels[consts.TPU_PRESENT_LABEL] == "true"
-        assert client.patch_calls >= 2  # first attempt conflicted, retry landed
-
-    def test_second_conflict_propagates_for_requeue(self):
-        client = self._ConflictOnce()
-        client.conflicts_left = 2
-        client.create(make_tpu_node("tpu-0"))
-        client.create(new_cluster_policy())
-        rec = ClusterPolicyReconciler(client, NS)
-        result = rec.reconcile(Request(name="cluster-policy"))
-        # the old code silently dropped the node; now the reconcile
-        # requeues so the labels converge without waiting for luck
-        assert result.requeue
-
-    def test_concurrent_kubelet_label_churn_is_preserved(self):
-        """Merge-patch vs the race the old full-object update lost: the
-        kubelet stamps its own label between the operator's read and
-        write. No rv travels with the patch, so the write lands AND the
-        kubelet's concurrent label survives."""
+    def test_apply_carries_no_rv_so_storage_races_cannot_conflict(self):
+        """A concurrent writer bumping the node's rv between our cache
+        read and our write is invisible to the apply: it carries no rv
+        to conflict on, and the server merges against current state."""
         client = FakeClient()
         client.create(make_tpu_node("tpu-0"))
         client.create(new_cluster_policy())
         rec = ClusterPolicyReconciler(client, NS)
 
-        real_patch = FakeClient.patch
+        real_apply = FakeClient.apply_set
 
-        def racing_patch(self_, api_version, kind, name, patch, namespace=None):
-            if kind == "Node":
-                # kubelet heartbeat lands first (bumps rv, adds a label)
-                real_patch(
-                    self_, "v1", "Node", name,
-                    {"metadata": {"labels": {"kubelet.example/zone": "a"}}},
-                )
-            return real_patch(self_, api_version, kind, name, patch, namespace)
+        def racing_apply(self_, api_version, kind, name, manager, **kw):
+            # kubelet heartbeat lands first (bumps rv, adds a label)
+            FakeClient.patch(
+                self_, "v1", "Node", name,
+                {"metadata": {"labels": {"kubelet.example/zone": "a"}}},
+            )
+            return real_apply(self_, api_version, kind, name, manager, **kw)
 
-        client.patch = racing_patch.__get__(client, FakeClient)
+        client.apply_set = racing_apply.__get__(client, FakeClient)
         rec.reconcile(Request(name="cluster-policy"))
         labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
         assert labels[consts.TPU_PRESENT_LABEL] == "true"  # our write landed
         assert labels["kubelet.example/zone"] == "a"  # kubelet's survived
+
+    def test_failed_apply_propagates_for_requeue(self):
+        class _Failing(FakeClient):
+            def apply_set(self, *a, **kw):
+                raise errors.ServerError("apiserver 500")
+
+        client = _Failing()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        rec = ClusterPolicyReconciler(client, NS)
+        result = rec.reconcile(Request(name="cluster-policy"))
+        # a failed sweep write must requeue so the labels converge
+        # without waiting for an unrelated event
+        assert result.requeue
+
+    def test_admin_opt_out_value_is_never_stolen(self):
+        """A hand-set \"false\" on a deploy gate survives every sweep:
+        the apply cedes ownership of a foreign value instead of forcing
+        it back (the old delta writer's leave-explicit-values-alone
+        semantics, now enforced server-side)."""
+        client = FakeClient()
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        rec = ClusterPolicyReconciler(client, NS)
+        rec.reconcile(Request(name="cluster-policy"))
+        gate = consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd"
+        client.patch("v1", "Node", "tpu-0", {"metadata": {"labels": {gate: "false"}}})
+        rec.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert labels[gate] == "false"  # the opt-out held
+
+    def test_legacy_gate_on_tpu_node_strips_when_operand_disabled(self):
+        """Upgrade path: a deploy gate stamped by a pre-apply-set
+        operator version (no ownership record) on a still-TPU node must
+        strip when the operand is disabled — the old unconditional
+        removal, preserved through the legacy-strip delta."""
+        client = FakeClient()
+        node = make_tpu_node("tpu-0")
+        gate = consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd"
+        node["metadata"]["labels"][gate] = "true"  # legacy, unowned
+        client.create(node)
+        client.create(new_cluster_policy(spec={"tfd": {"enabled": False}}))
+        rec = ClusterPolicyReconciler(client, NS)
+        rec.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "tpu-0")["metadata"]["labels"]
+        assert gate not in labels
+        assert labels[consts.TPU_PRESENT_LABEL] == "true"  # still a TPU node
+
+    def test_de_tpu_node_comes_clean_even_without_ownership_record(self):
+        """Labels stamped by an operator version that predates the
+        apply-set record still strip off a node that no longer has TPUs
+        (the legacy-cleanup delta)."""
+        from tpu_operator.kube.sim import make_bare_node
+
+        client = FakeClient()
+        bare = make_bare_node("ex-tpu")
+        bare["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        bare["metadata"]["labels"][consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd"] = "true"
+        client.create(bare)
+        client.create(make_tpu_node("tpu-0"))
+        client.create(new_cluster_policy())
+        rec = ClusterPolicyReconciler(client, NS)
+        rec.reconcile(Request(name="cluster-policy"))
+        labels = client.get("v1", "Node", "ex-tpu")["metadata"].get("labels") or {}
+        assert consts.TPU_PRESENT_LABEL not in labels
+        assert consts.COMMON_DEPLOY_LABEL_PREFIX + "tfd" not in labels
 
 
 class TestWriteEchoFilter:

@@ -1,0 +1,606 @@
+"""Pool-sharded control plane: shard keying, per-pool delta feeds,
+sharded queues, apply-set writes, and the streamed-LIST bootstrap.
+
+The contract (ISSUE 11): steady-state control-plane cost is O(changes)
+to 16k sim nodes. These tests pin the mechanisms — (1) the sharded node
+view's per-pool membership is EXACTLY the partition of the global
+snapshot (delta-feed equivalence), (2) a re-pooled node lands in exactly
+one shard and both affected shards hear about it, (3) one wedged shard
+cannot starve another (per-shard queues + workers), (4) apply-set's
+field-ownership semantics (set/adopt/cede/remove, force, no-op-free),
+over both clients, and (5) an informer bootstrapping over HTTP pays ONE
+watch request and zero LIST pages.
+"""
+
+import threading
+import time
+
+import prometheus_client
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.kube import trace
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.http_client import HttpClient
+from tpu_operator.kube.httpserver import FakeApiServer
+from tpu_operator.kube.informer import Informer
+from tpu_operator.kube.objects import apply_set_merge
+from tpu_operator.kube.sharding import UNPOOLED, ShardedNodeView, shard_key
+from tpu_operator.kube.sim import make_bare_node, make_tpu_node
+from tpu_operator.kube.writers import WriteFanout
+from tpu_operator.nodepool import get_node_pools
+
+NS = "tpu-operator"
+
+
+def wait_for(fn, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestShardKey:
+    def test_shard_key_matches_nodepool_partition(self):
+        """shard_key(n) must equal the NodePool.name get_node_pools puts
+        n in — the two partitions can never disagree."""
+        nodes = [
+            make_tpu_node("a0", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a"),
+            make_tpu_node("a1", "tpu-v5-lite-podslice", "4x4", nodepool="pool-a"),
+            make_tpu_node("b0", "tpu-v4-podslice", "2x2x1", nodepool="pool-b"),
+        ]
+        pools = {p.name: set(p.node_names) for p in get_node_pools(nodes)}
+        for node in nodes:
+            shard = shard_key(node)
+            assert node["metadata"]["name"] in pools[shard]
+
+    def test_non_tpu_node_lands_in_unpooled(self):
+        assert shard_key(make_bare_node("plain")) == UNPOOLED
+
+
+class TestShardedNodeView:
+    def _wired(self, *nodes):
+        client = FakeClient()
+        for n in nodes:
+            client.create(n)
+        informer = Informer(client, "v1", "Node")
+        view = ShardedNodeView().attach(informer)
+        informer.start()
+        return client, informer, view
+
+    def test_delta_feed_equivalence_with_global_snapshot(self):
+        """After arbitrary churn, the view's per-shard membership equals
+        partitioning the informer's global snapshot by shard_key — the
+        per-pool delta feed loses and invents nothing."""
+        client, informer, view = self._wired(
+            make_tpu_node("a0", nodepool="pool-a"),
+            make_tpu_node("b0", nodepool="pool-b"),
+        )
+        client.create(make_tpu_node("a1", nodepool="pool-a"))
+        client.create(make_bare_node("plain"))
+        client.patch("v1", "Node", "a0", {"metadata": {"labels": {"x": "1"}}})
+        client.delete("v1", "Node", "b0")
+        client.create(make_tpu_node("b1", nodepool="pool-b"))
+
+        expected: dict = {}
+        for node in informer.cached(copy=False):
+            expected.setdefault(shard_key(node), []).append(node["metadata"]["name"])
+        expected = {s: sorted(m) for s, m in expected.items()}
+        assert view.membership() == expected
+        informer.stop()
+
+    def test_repooled_node_lands_in_exactly_one_shard(self):
+        """A node whose pool labels change moves atomically: DELETED on
+        the old shard, ADDED on the new, never a member of both."""
+        client, informer, view = self._wired(make_tpu_node("n0", nodepool="pool-a"))
+        events = []
+        view.add_handler(lambda shard, et, old, new: events.append((shard, et)))
+        old_shard = view.shard_for("n0")
+        client.patch(
+            "v1", "Node", "n0",
+            {"metadata": {"labels": {"cloud.google.com/gke-nodepool": "pool-b"}}},
+        )
+        new_shard = view.shard_for("n0")
+        assert new_shard != old_shard
+        membership = view.membership()
+        homes = [s for s, members in membership.items() if "n0" in members]
+        assert homes == [new_shard]
+        assert (old_shard, "DELETED") in events
+        assert (new_shard, "ADDED") in events
+        informer.stop()
+
+    def test_node_delete_leaves_no_shard_residue(self):
+        client, informer, view = self._wired(make_tpu_node("n0", nodepool="pool-a"))
+        client.delete("v1", "Node", "n0")
+        assert view.membership() == {}
+        assert view.shard_for("n0") is None
+        informer.stop()
+
+
+class TestShardedControllerFairness:
+    def test_wedged_shard_does_not_starve_others(self):
+        """Shard A's reconciler blocks forever; shard B's requests keep
+        being served (own queue, own worker) — the fairness property a
+        single global queue cannot give."""
+        wedge = threading.Event()
+        served = []
+
+        class R:
+            def reconcile(self, req):
+                if req.shard == "wedged":
+                    wedge.wait(10)
+                served.append(req.shard)
+                return Result()
+
+        ctrl = Controller("fairness", R())
+        ctrl.start()
+        try:
+            ctrl.enqueue(Request(name="q", shard="wedged"))
+            assert wait_for(lambda: not wedge.is_set())  # worker is parked
+            for i in range(3):
+                ctrl.enqueue(Request(name=f"q{i}", shard="healthy"))
+            assert wait_for(lambda: served.count("healthy") == 3), served
+            assert "wedged" not in served
+        finally:
+            wedge.set()
+            ctrl.stop()
+
+    def test_shard_metrics_exist_and_drain_removes_them(self):
+        """Each shard exports its own workqueue series; drain_shard
+        retires them (the O005 contract) and joins the shard's workers."""
+        class R:
+            def reconcile(self, req):
+                return Result()
+
+        ctrl = Controller("drainer", R())
+        ctrl.start()
+        try:
+            ctrl.enqueue(Request(name="x", shard="pool-z"))
+            assert wait_for(
+                lambda: prometheus_client.REGISTRY.get_sample_value(
+                    "tpu_operator_workqueue_depth",
+                    {"controller": "drainer", "shard": "pool-z"},
+                ) is not None
+            )
+            ctrl.drain_shard("pool-z")
+            assert prometheus_client.REGISTRY.get_sample_value(
+                "tpu_operator_workqueue_depth",
+                {"controller": "drainer", "shard": "pool-z"},
+            ) is None
+            assert "pool-z" not in ctrl.shards()
+        finally:
+            ctrl.stop()
+
+    def test_reconcile_trace_carries_shard(self):
+        rec = trace.reset_recorder()
+
+        class R:
+            def reconcile(self, req):
+                return Result()
+
+        ctrl = Controller("traced", R())
+        ctrl.start()
+        try:
+            ctrl.enqueue(Request(name="x", shard="pool-t"))
+            assert wait_for(lambda: len(rec) >= 1)
+            assert rec.traces()[0].root.attrs["shard"] == "pool-t"
+        finally:
+            ctrl.stop()
+            trace.reset_recorder()
+
+
+class TestApplySetSemantics:
+    def _node(self, client):
+        client.create(make_tpu_node("n0"))
+        return lambda: client.get("v1", "Node", "n0")
+
+    def test_set_remove_via_ownership_record(self):
+        client = FakeClient()
+        get = self._node(client)
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1", "b": "2"})
+        labels = get()["metadata"]["labels"]
+        assert labels["a"] == "1" and labels["b"] == "2"
+        # drop b from the declaration: the record removes it server-side
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1"})
+        labels = get()["metadata"]["labels"]
+        assert "b" not in labels and labels["a"] == "1"
+
+    def test_foreign_value_is_not_stolen_and_ownership_cedes(self):
+        client = FakeClient()
+        get = self._node(client)
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"gate": "true"})
+        # admin override
+        client.patch("v1", "Node", "n0", {"metadata": {"labels": {"gate": "false"}}})
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"gate": "true"})
+        assert get()["metadata"]["labels"]["gate"] == "false"
+        # ...and once ceded, undeclaring does NOT remove the admin's value
+        client.apply_set("v1", "Node", "n0", "mgr", labels={})
+        assert get()["metadata"]["labels"]["gate"] == "false"
+
+    def test_force_overrides_foreign_value(self):
+        client = FakeClient()
+        get = self._node(client)
+        client.patch("v1", "Node", "n0", {"metadata": {"labels": {"id": "9"}}})
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"id": "0"}, force=True)
+        assert get()["metadata"]["labels"]["id"] == "0"
+
+    def test_noop_apply_bumps_nothing_and_emits_no_event(self):
+        """The steady-state sweep property: an apply that changes nothing
+        is free — no rv bump, no watch event."""
+        client = FakeClient()
+        get = self._node(client)
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1"})
+        rv = get()["metadata"]["resourceVersion"]
+        events = []
+        client.watch("v1", "Node", lambda et, obj: events.append(et))
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1"})
+        assert get()["metadata"]["resourceVersion"] == rv
+        assert events == []
+
+    def test_concurrent_writer_of_other_fields_never_conflicts(self):
+        """Apply-set conflict semantics: no rv travels, so a concurrent
+        writer bumping the object between read and apply cannot 409 —
+        and both writes survive."""
+        client = FakeClient()
+        get = self._node(client)
+        client.patch("v1", "Node", "n0", {"metadata": {"labels": {"kubelet/zone": "a"}}})
+        client.apply_set("v1", "Node", "n0", "mgr", labels={"mine": "1"})
+        labels = get()["metadata"]["labels"]
+        assert labels["kubelet/zone"] == "a" and labels["mine"] == "1"
+
+    def test_apply_set_merge_is_pure(self):
+        md = {"labels": {"a": "1"}, "annotations": {}}
+        new_labels, new_annotations, changed = apply_set_merge(md, "m", {"b": "2"})
+        assert changed and new_labels == {"a": "1", "b": "2"}
+        assert md["labels"] == {"a": "1"}  # input untouched
+        assert consts.APPLY_SET_ANNOTATION_PREFIX + "m" in new_annotations
+
+    def test_apply_set_over_http(self):
+        """The wire path: one PATCH with the apply-set content type; the
+        server performs the merge; removal works across a fresh client
+        (the record lives on the object, not in the client)."""
+        store = FakeClient()
+        store.create(make_tpu_node("n0"))
+        server = FakeApiServer(store).start()
+        try:
+            client = HttpClient(server.base_url, timeout=5.0)
+            client.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1", "b": "2"})
+            fresh = HttpClient(server.base_url, timeout=5.0)
+            fresh.apply_set("v1", "Node", "n0", "mgr", labels={"a": "1"})
+            labels = store.get("v1", "Node", "n0")["metadata"]["labels"]
+            assert labels["a"] == "1" and "b" not in labels
+            assert client.request_counts["PATCH"] == 1
+        finally:
+            server.stop()
+
+
+class TestStreamedListBootstrap:
+    def test_informer_syncs_with_zero_list_pages(self):
+        """The WatchList analog: informer bootstrap over HTTP is ONE
+        watch request whose stream carries the snapshot — no paginated
+        LIST (at 16k nodes the legacy bootstrap paid 33 pages per
+        (re)connect, discarded)."""
+        store = FakeClient()
+        for i in range(12):
+            store.create(make_tpu_node(f"n{i}"))
+        server = FakeApiServer(store).start()
+        try:
+            client = HttpClient(server.base_url, timeout=5.0)
+            informer = Informer(client, "v1", "Node")
+            informer.start(sync_timeout=10.0)
+            assert informer.has_synced()
+            assert len(informer.cached(copy=False)) == 12
+            assert client.request_counts.get("GET", 0) == 0  # no LIST at all
+            assert client.request_counts.get("WATCH", 0) == 1
+            # live events still flow after the in-stream snapshot
+            store.create(make_tpu_node("late"))
+            assert wait_for(lambda: informer.get("late") is not None)
+            informer.stop()
+        finally:
+            server.stop()
+
+
+class TestWatchListIgnoredFallback:
+    def test_server_that_silently_ignores_option_still_syncs_via_fallback(self):
+        """A server that accepts the watch but IGNORES sendInitialEvents
+        (feature gate off, no 400) streams only plain bookmarks on a
+        quiet resource: the bootstrap deadline must kick the client back
+        to LIST+watch so the informer still syncs."""
+        import json as _json
+        import threading as _threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: A003
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if "watch=true" in self.path:
+                    # ignore sendInitialEvents entirely: plain bookmarks only
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    deadline = time.monotonic() + 8
+                    while time.monotonic() < deadline:
+                        try:
+                            self.wfile.write(
+                                _json.dumps({"type": "BOOKMARK", "object": {}}).encode() + b"\n"
+                            )
+                            self.wfile.flush()
+                        except OSError:
+                            return
+                        time.sleep(0.1)
+                    return
+                body = _json.dumps({
+                    "apiVersion": "v1", "kind": "NodeList",
+                    "metadata": {"resourceVersion": "7"},
+                    "items": [{"metadata": {"name": "n0", "resourceVersion": "5"}}],
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        httpd.daemon_threads = True
+        _threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            client = HttpClient(
+                f"http://127.0.0.1:{httpd.server_address[1]}",
+                timeout=5.0, watch_stall_seconds=1.0,  # 1s bootstrap deadline
+            )
+            informer = Informer(client, "v1", "Node")
+            informer.start(sync_timeout=15.0)
+            assert wait_for(informer.has_synced, timeout=15.0), "fallback never synced"
+            assert informer.get("n0") is not None
+            informer.stop()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestWriteFanout:
+    def test_results_in_order_and_errors_isolated(self):
+        pool = WriteFanout(workers=4)
+        try:
+            def make(i):
+                def call():
+                    if i == 3:
+                        raise ValueError("boom")
+                    return i * 10
+                return call
+
+            results = pool.map([make(i) for i in range(6)])
+            assert [r for r, e in results if e is None] == [0, 10, 20, 40, 50]
+            assert isinstance(results[3][1], ValueError)
+        finally:
+            pool.close()
+
+    def test_batch_is_actually_concurrent(self):
+        pool = WriteFanout(workers=8)
+        try:
+            barrier = threading.Barrier(6, timeout=5)
+
+            def call():
+                barrier.wait()  # deadlocks unless 6 run concurrently
+                return True
+
+            results = pool.map([call] * 6)
+            assert all(r is True and e is None for r, e in results)
+        finally:
+            pool.close()
+
+    def test_small_batches_run_inline(self):
+        pool = WriteFanout(workers=4)
+        try:
+            ident = []
+            results = pool.map([lambda: ident.append(threading.get_ident()) or 1] * 2)
+            assert [r for r, _ in results] == [1, 1]
+            assert set(ident) == {threading.get_ident()}  # caller's thread
+            assert pool.workers == 0  # nothing spawned
+        finally:
+            pool.close()
+
+    def test_batch_records_one_api_span_with_request_count(self):
+        rec = trace.reset_recorder()
+        pool = WriteFanout(workers=4)
+        try:
+            with trace.start_trace("reconcile", controller="c", request="r"):
+                pool.map([lambda: None] * 5, verb="apply_set", kind="Node")
+            (t,) = rec.traces()
+            api = [s for s in t.spans if s.name == "api"]
+            assert len(api) == 1
+            assert api[0].attrs["attempts"] == 5
+            assert api[0].attrs["verb"] == "apply_set"
+            assert t.complete() and t.accounted_fraction() >= 0.95
+        finally:
+            pool.close()
+            trace.reset_recorder()
+
+
+class TestPlacementPoolPass:
+    """Per-pool delta feed equivalence for the placement path: a
+    pool-local change replanned through the pool pass converges to the
+    same labels/status a global replan produces."""
+
+    def _cluster(self):
+        from tpu_operator.api.clusterpolicy import new_cluster_policy
+        from tpu_operator.api.tpuslice import new_tpu_slice
+        from tpu_operator.kube.sim import make_torus_nodes
+
+        store = FakeClient()
+        for node in make_torus_nodes((4, 2, 1), prefix="pa", nodepool="pool-a"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            store.create(node)
+        for node in make_torus_nodes((2, 2, 1), prefix="pb", nodepool="pool-b"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            store.create(node)
+        store.create(new_cluster_policy())
+        store.create(new_tpu_slice("gang-a", {"placement": {"shape": "2x2x1"}}))
+        return store
+
+    def _pool_pass_world(self, store):
+        """Run the same change through the sharded pool pass."""
+        from tpu_operator.controllers.placement_controller import (
+            QUEUE_REQUEST,
+            PlacementReconciler,
+        )
+
+        rec = PlacementReconciler(store, NS)
+        rec.reconcile(QUEUE_REQUEST)  # initial global placement
+        informer = Informer(store, "v1", "Node")
+        view = ShardedNodeView().attach(informer)
+        informer.start()
+        rec.node_view = view
+        return rec, view, informer
+
+    def _snapshot(self, store):
+        from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
+
+        nodes = {
+            n["metadata"]["name"]: {
+                k: v for k, v in (n["metadata"].get("labels") or {}).items()
+                if k.startswith("tpu.google.com/placement")
+            }
+            for n in store.list("v1", "Node")
+        }
+        ts = store.get(TPU_SLICE_API_VERSION, "TPUSlice", "gang-a")
+        status = dict((ts.get("status") or {}).get("placement") or {})
+        status.pop("message", None)  # wording may differ between passes
+        return nodes, status
+
+    def test_pool_pass_equivalent_to_global_replan(self):
+        from tpu_operator.controllers.placement_controller import (
+            QUEUE_REQUEST,
+            PlacementReconciler,
+        )
+        from tpu_operator.kube.controller import Request as KReq
+
+        # world A: pool pass handles the change, draining any requests
+        # it hands to the global queue (what the controller wiring does)
+        store_a = self._cluster()
+        rec_a, view_a, informer_a = self._pool_pass_world(store_a)
+        handed_up = []
+        rec_a._enqueue = handed_up.append
+        ts = store_a.get("tpu.google.com/v1alpha1", "TPUSlice", "gang-a")
+        member = ts["status"]["placement"]["nodes"][0]
+        shard = view_a.shard_for(member)
+        assert shard is not None
+        store_a.patch(
+            "v1", "Node", member,
+            {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}}},
+        )
+        rec_a.reconcile(KReq(name=QUEUE_REQUEST.name, shard=shard))
+        # the teardown re-places on the next passes: pool first, then
+        # whatever the pool pass handed to the global queue
+        rec_a.reconcile(KReq(name=QUEUE_REQUEST.name, shard=shard))
+        for req in list(dict.fromkeys(handed_up)):
+            rec_a.reconcile(req)
+        informer_a.stop()
+
+        # world B: the identical change handled by a global replan
+        store_b = self._cluster()
+        rec_b = PlacementReconciler(store_b, NS)
+        rec_b.reconcile(QUEUE_REQUEST)
+        store_b.patch(
+            "v1", "Node", member,
+            {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}}},
+        )
+        rec_b.reconcile(QUEUE_REQUEST)
+        rec_b.reconcile(QUEUE_REQUEST)
+
+        assert self._snapshot(store_a) == self._snapshot(store_b)
+        nodes, status = self._snapshot(store_a)
+        assert status.get("phase") == "Scheduled"
+        assert member not in (status.get("nodes") or [])
+
+    def test_pool_pass_never_condemns_slice_pinned_elsewhere(self):
+        """A slice pinned to pool B but dragged into pool A's pass by a
+        stale status.pool must NOT be published Unschedulable by A —
+        only the pinned pool's own pass (or the global one) is
+        authoritative for that verdict."""
+        from tpu_operator.api.tpuslice import new_tpu_slice
+        from tpu_operator.controllers.placement_controller import QUEUE_REQUEST
+        from tpu_operator.kube.controller import Request as KReq
+
+        store = self._cluster()
+        rec, view, informer = self._pool_pass_world(store)
+        shard_a = view.shard_for("pa-0")
+        shard_b = view.shard_for("pb-0")
+        # pinned to pool-b's shard, but status claims pool-a (stale)
+        obj = new_tpu_slice("pinned-b", {"placement": {"shape": "2x2x1", "pool": shard_b}})
+        store.create(obj)
+        store.patch_status(
+            "tpu.google.com/v1alpha1", "TPUSlice", "pinned-b",
+            {"status": {"placement": {"phase": "Queued", "pool": shard_a}}},
+        )
+        rec.reconcile(KReq(name=QUEUE_REQUEST.name, shard=shard_a))
+        ts = store.get("tpu.google.com/v1alpha1", "TPUSlice", "pinned-b")
+        phase = ((ts.get("status") or {}).get("placement") or {}).get("phase")
+        assert phase != "Unschedulable", phase
+        informer.stop()
+
+    def test_pool_pass_survives_explicit_null_placement(self):
+        """spec.placement: null (valid YAML for an optional object) must
+        not crash the pool pass."""
+        from tpu_operator.controllers.placement_controller import QUEUE_REQUEST
+        from tpu_operator.kube.controller import Request as KReq
+        from tpu_operator.kube.objects import new_object
+
+        store = self._cluster()
+        rec, view, informer = self._pool_pass_world(store)
+        ts = store.get("tpu.google.com/v1alpha1", "TPUSlice", "gang-a")
+        member = ts["status"]["placement"]["nodes"][0]
+        shard = view.shard_for(member)
+        null_spec = new_object(
+            "tpu.google.com/v1alpha1", "TPUSlice", "null-placement",
+            spec={"placement": None},
+        )
+        store.create(null_spec)
+        rec.reconcile(KReq(name=QUEUE_REQUEST.name, shard=shard))  # must not raise
+        informer.stop()
+
+    def test_pool_pass_leaves_unpinned_pending_slices_to_global(self):
+        """A pool pass never condemns an UNPINNED slice to
+        Unschedulable: a new pending slice is simply not a pool pass's
+        business (its creation event maps to the global queue in the
+        controller wiring), and the global pass places it wherever there
+        is room."""
+        from tpu_operator.api.tpuslice import new_tpu_slice
+        from tpu_operator.controllers.placement_controller import QUEUE_REQUEST
+        from tpu_operator.kube.controller import Request as KReq
+
+        store = self._cluster()
+        rec, view, informer = self._pool_pass_world(store)
+        # a shape only pool-a (4x2x1 grid) can fit; replan pool-b first
+        store.create(new_tpu_slice("gang-late", {"placement": {"shape": "4x1x1"}}))
+        shard_b = view.shard_for("pb-0")
+        rec.reconcile(KReq(name=QUEUE_REQUEST.name, shard=shard_b))
+        ts = store.get("tpu.google.com/v1alpha1", "TPUSlice", "gang-late")
+        phase = ((ts.get("status") or {}).get("placement") or {}).get("phase")
+        assert phase != "Unschedulable"  # untouched, not condemned
+        # the slice's own creation event maps to the global queue:
+        rec.reconcile(QUEUE_REQUEST)
+        ts = store.get("tpu.google.com/v1alpha1", "TPUSlice", "gang-late")
+        assert (ts["status"]["placement"]).get("phase") == "Scheduled"
+        informer.stop()
+
+
+class TestMustGatherSharding:
+    def test_sharding_artifact_collected(self, tmp_path):
+        from tpu_operator.mustgather import collect
+
+        client = FakeClient()
+        client.create(make_tpu_node("n0", nodepool="pool-a"))
+        written = collect(client, NS, str(tmp_path))
+        assert "sharding.txt" in written
+        text = (tmp_path / "sharding.txt").read_text()
+        assert "shard -> pool assignment" in text
+        assert "nodes=1" in text

@@ -200,7 +200,7 @@ class TestControllerTracing:
         ctrl.start()
         try:
             before = prometheus_client.REGISTRY.get_sample_value(
-                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer"}
+                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer", "shard": ""}
             ) or 0.0
             ctrl.queue.add(Request(name="x"))
             deadline = time.time() + 5
@@ -210,11 +210,11 @@ class TestControllerTracing:
             assert "bang" in t.root.error
             assert t.complete()
             after = prometheus_client.REGISTRY.get_sample_value(
-                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer"}
+                "tpu_operator_reconcile_duration_seconds_count", {"controller": "boomer", "shard": ""}
             )
             assert after >= before + 1
             assert prometheus_client.REGISTRY.get_sample_value(
-                "tpu_operator_workqueue_wait_seconds_count", {"controller": "boomer"}
+                "tpu_operator_workqueue_wait_seconds_count", {"controller": "boomer", "shard": ""}
             ) >= 1
         finally:
             ctrl.stop()

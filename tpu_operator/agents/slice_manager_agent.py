@@ -199,14 +199,27 @@ class SliceManagerAgent:
         keep a worker identity label: gang Services select on it, and a
         quarantined node answering slice DNS is exactly the degraded-gang
         hang the exclusion exists to prevent."""
+        from tpu_operator import consts as _consts
+
         members = {name for pool in active for name in pool.node_names}
+        record_key = (
+            _consts.APPLY_SET_ANNOTATION_PREFIX + _consts.APPLY_SET_MANAGER_SLICE
+        )
         for node_name, labels in node_labels.items():
             if node_name in members or WORKER_ID_LABEL not in labels:
                 continue
             try:
+                # one patch nulls the label AND the apply-set ownership
+                # record together (the slice manager only ever declares
+                # the worker id, so the record goes with it): a stale
+                # record claiming a removed label would contradict the
+                # removals-derive-from-the-record contract
                 self.client.patch(
                     "v1", "Node", node_name,
-                    {"metadata": {"labels": {WORKER_ID_LABEL: None}}},
+                    {"metadata": {
+                        "labels": {WORKER_ID_LABEL: None},
+                        "annotations": {record_key: None},
+                    }},
                 )
             except errors.NotFound:
                 pass
@@ -526,17 +539,24 @@ class SliceManagerAgent:
 
     def _apply_worker_ids(self, pool: NodePool, node_labels: dict) -> None:
         """Stable worker ids: sorted node order within the pool (reference
-        concept: per-node mig.config label loop). A label-only merge patch
-        per changed node — the current labels come from the reconcile's own
-        node list (no per-node GET), and rv-free patches let every host's
-        concurrent agent converge instead of Conflict-bouncing."""
+        concept: per-node mig.config label loop). One forced apply-set per
+        changed node — the slice manager is the sole authority for worker
+        identity, so the declaration always wins (kube SSA force), the
+        ownership record makes removals restart-safe, and no rv travels,
+        so every host's concurrent agent converges instead of
+        Conflict-bouncing. The current labels still come from the
+        reconcile's own node list: a settled pool writes nothing."""
+        from tpu_operator import consts as _consts
+
         for worker_id, node_name in enumerate(pool.node_names):
             labels = node_labels.get(node_name, {})
             if labels.get(WORKER_ID_LABEL) != str(worker_id):
                 try:
-                    self.client.patch(
+                    self.client.apply_set(
                         "v1", "Node", node_name,
-                        {"metadata": {"labels": {WORKER_ID_LABEL: str(worker_id)}}},
+                        _consts.APPLY_SET_MANAGER_SLICE,
+                        labels={WORKER_ID_LABEL: str(worker_id)},
+                        force=True,
                     )
                 except errors.NotFound:
                     pass

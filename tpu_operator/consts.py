@@ -173,6 +173,17 @@ HEALTH_VERDICTS_FILE = "verdicts.json"
 # Annotations.
 # ---------------------------------------------------------------------------
 LAST_APPLIED_HASH_ANNOTATION = "tpu.google.com/last-applied-hash"
+# Apply-set ownership record (the server-side-apply analog,
+# kube/objects.py apply_set_merge): one annotation per field manager,
+# ``<prefix><manager>`` -> JSON of the label/annotation key→value maps
+# that manager last applied. Lets a label-sweep writer declare its
+# desired owned set in ONE write — removals derive from the record, not
+# from a read-modify-write loop, and survive operator restarts.
+APPLY_SET_ANNOTATION_PREFIX = "tpu.google.com/apply-set."
+# the node labeller's field-manager identity (clusterpolicy controller)
+APPLY_SET_MANAGER_LABELLER = "tpu-operator-labeller"
+# the slice manager's worker-identity field manager
+APPLY_SET_MANAGER_SLICE = "tpu-slice-manager"
 DRIVER_AUTO_UPGRADE_ANNOTATION = "tpu.google.com/libtpu-auto-upgrade-enabled"
 STATE_LABEL = "tpu.google.com/operator.state"  # ownership label for cleanup
 

@@ -258,69 +258,99 @@ class ClusterPolicyReconciler:
         labels from nodes that no longer have TPUs. Existing explicit values
         (e.g. a hand-set \"false\" opt-out) are left alone.
 
-        Each changed node gets a labels-only JSON merge patch (additions as
-        values, removals as nulls): no deep copy of the Node, a ~100-byte
-        write instead of the whole object, and — because no resourceVersion
-        travels — no Conflict against concurrent kubelet/agent writers of
-        unrelated fields."""
+        Each changed node gets ONE apply-set write (the server-side-apply
+        analog, ``Client.apply_set``): the sweep declares the complete
+        owned label set per node under the labeller's field-manager
+        identity, and the SERVER converges it — removals derive from the
+        on-object ownership record (restart-safe, no read-modify-write),
+        foreign values (a hand-set opt-out) are never stolen, and a no-op
+        apply costs the server nothing. Changed nodes are written through
+        the shared write fan-out pool so the sweep's wall time is the
+        concurrent window, not N serial round-trips — one slow PATCH
+        can't stall the reconcile."""
+        from tpu_operator.kube.objects import apply_set_merge
+        from tpu_operator.kube.writers import shared_fanout
+
         enabled_keys = set(self._enabled_operand_keys(cp))
-        work: List[tuple] = []
+        manager = consts.APPLY_SET_MANAGER_LABELLER
+        calls = []
         for node in self._nodes():
-            # cache snapshots are read-only: compute the delta, never mutate
+            # cache snapshots are read-only: compute the declaration,
+            # never mutate
             labels = node["metadata"].get("labels") or {}
-            delta: dict = {}
+            desired: dict = {}
             if is_tpu_node(node):
-                if labels.get(consts.TPU_PRESENT_LABEL) != "true":
-                    delta[consts.TPU_PRESENT_LABEL] = "true"
-                if consts.TPU_WORKLOAD_CONFIG_LABEL not in labels:
-                    delta[consts.TPU_WORKLOAD_CONFIG_LABEL] = consts.DEFAULT_WORKLOAD_CONFIG
+                desired[consts.TPU_PRESENT_LABEL] = "true"
+                desired[consts.TPU_WORKLOAD_CONFIG_LABEL] = consts.DEFAULT_WORKLOAD_CONFIG
                 workload = labels.get(
                     consts.TPU_WORKLOAD_CONFIG_LABEL, consts.DEFAULT_WORKLOAD_CONFIG
                 )
                 for key in OPERAND_DEPLOY_KEYS.values():
-                    want = key in enabled_keys and workload == consts.WORKLOAD_CONFIG_CONTAINER
-                    if want and key not in labels:
-                        delta[key] = "true"
-                    elif not want and key in labels:
-                        delta[key] = None
-            else:
-                ours = [consts.TPU_PRESENT_LABEL, consts.TPU_WORKLOAD_CONFIG_LABEL, *OPERAND_DEPLOY_KEYS.values()]
-                for key in ours:
-                    if key in labels:
-                        delta[key] = None
-            if delta:
-                after = {k: v for k, v in labels.items() if delta.get(k, v) is not None}
-                after.update({k: v for k, v in delta.items() if v is not None})
-                work.append((node["metadata"]["name"], delta, after))
-        for item in work:
-            self._patch_node_labels(*item)
+                    if key in enabled_keys and workload == consts.WORKLOAD_CONFIG_CONTAINER:
+                        desired[key] = "true"
+            # client-side no-op skip: the cache already reflects the
+            # declaration, so a settled sweep writes nothing (O(changes))
+            new_labels, _, changed = apply_set_merge(
+                node["metadata"], manager, desired
+            )
+            # legacy cleanup: our labels written before the apply-set
+            # record existed carry no ownership the apply can remove —
+            # any undeclared ours-key that survived the apply (a de-TPU'd
+            # node's whole set, or a DISABLED operand's gate stamped by a
+            # pre-record operator version) strips via an explicit delta,
+            # preserving the old unconditional-removal semantics
+            ours = (
+                consts.TPU_PRESENT_LABEL, consts.TPU_WORKLOAD_CONFIG_LABEL,
+                *OPERAND_DEPLOY_KEYS.values(),
+            )
+            leftover = {
+                key: None for key in ours
+                if key in new_labels and key not in desired
+            }
+            if not changed and not leftover:
+                continue
+            name = node["metadata"]["name"]
+            after = {k: v for k, v in new_labels.items() if k not in leftover}
+            # record BEFORE the write: the in-memory client delivers the
+            # watch event synchronously inside the call, so a record made
+            # after would miss its own echo. A failed write leaves a
+            # record for a label state that never materializes — harmless
+            # by the filter's advisory design.
+            self.echo_filter.record(name, after)
+            if changed:
+                calls.append(self._apply_call(name, manager, desired))
+            if leftover:
+                calls.append(self._strip_call(name, leftover))
+        if not calls:
+            return
+        first_error = None
+        for _, err in shared_fanout().map(calls, verb="apply_set", kind="Node"):
+            if err is not None and first_error is None:
+                first_error = err
+        if first_error is not None:
+            # surface ONE failure so the reconcile requeues (the rest of
+            # the sweep still landed — level-triggered repair finishes it)
+            raise first_error
 
-    def _patch_node_labels(self, name: str, delta: dict, labels_after: dict) -> None:
-        """One labels-only merge patch, retried once in place on Conflict
-        (rare for a patch — no rv travels with it — but a real apiserver
-        can still 409 under storage races). The old full-object update
-        dropped the node silently on Conflict and waited for the watch; a
-        second Conflict now propagates so the reconcile requeues instead
-        of losing the write."""
-        body = {"metadata": {"labels": delta}}
-        # record BEFORE the write: the in-memory client delivers the watch
-        # event synchronously inside patch(), so a record made after the
-        # call would miss its own echo. A failed write leaves a record for
-        # a label state that never materializes — harmless by the filter's
-        # advisory design (a foreign event with different labels passes).
-        self.echo_filter.record(name, labels_after)
-        for attempt in (0, 1):
+    def _apply_call(self, name: str, manager: str, desired: dict):
+        def call():
             try:
-                self.client.patch("v1", "Node", name, body)
-                return
+                self.client.apply_set("v1", "Node", name, manager, labels=desired)
             except errors.NotFound:
-                # node deleted while the sweep ran (cache trails the watch):
-                # skip it, the rest of the sweep must still land
-                return
-            except errors.Conflict:
-                if attempt:
-                    raise
-                log.debug("node %s label patch conflicted; retrying once", name)
+                # node deleted while the sweep ran (cache trails the
+                # watch): skip it, the rest of the sweep must still land
+                pass
+
+        return call
+
+    def _strip_call(self, name: str, delta: dict):
+        def call():
+            try:
+                self.client.patch("v1", "Node", name, {"metadata": {"labels": delta}})
+            except errors.NotFound:
+                pass
+
+        return call
 
 
 def node_labels_changed(event_type: str, old: Optional[ObjectDict], new: ObjectDict) -> bool:

@@ -254,11 +254,23 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         post-pass {node: repair state} map (health verdicts included for
         degraded nodes not yet in repair)."""
         remediation = spec.remediation
-        pods_by_node: Dict[str, List[ObjectDict]] = {}
-        for pod in self.client.list("v1", "Pod"):
-            node_name = pod.get("spec", {}).get("nodeName")
-            if node_name and pod.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
-                pods_by_node.setdefault(node_name, []).append(pod)
+        # the pod index loads LAZILY, on the first node that actually
+        # needs eviction/reinstall handling: the walker itself is already
+        # O(sick nodes) via the label-indexed selector lists, and a quiet
+        # pass (every pass, at steady state) must not pay an O(pods)
+        # cluster scan — at 16k nodes that scan was the last O(cluster)
+        # term in the health path
+        pods_index: Dict[str, List[ObjectDict]] = {}
+        pods_loaded = [False]
+
+        def pods_on(node_name: str) -> List[ObjectDict]:
+            if not pods_loaded[0]:
+                pods_loaded[0] = True
+                for pod in self.client.list("v1", "Pod"):
+                    at = pod.get("spec", {}).get("nodeName")
+                    if at and pod.get("status", {}).get("phase") not in ("Succeeded", "Failed"):
+                        pods_index.setdefault(at, []).append(pod)
+            return pods_index.get(node_name, [])
 
         states: Dict[str, str] = {}
         nodes = self.repair_nodes()
@@ -302,7 +314,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             elif state == RepairState.EVICTION_REQUIRED:
                 targets = [
                     p
-                    for p in pods_by_node.get(name, ())
+                    for p in pods_on(name)
                     if not self._is_daemonset_pod(p) and self._consumes_tpu(p)
                 ]
                 blocked = self._evict_pods(targets, force=remediation.force)
@@ -310,7 +322,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                     # entry action for reinstall: kill the node's driver
                     # pods NOW so any Running driver pod seen later is the
                     # DaemonSet's fresh replacement (fresh libtpu install)
-                    self._delete_driver_pods(pods_by_node.get(name, ()))
+                    self._delete_driver_pods(pods_on(name))
                     self._set_repair_state(node, RepairState.REINSTALL_REQUIRED)
                     states[name] = RepairState.REINSTALL_REQUIRED
                 elif self._repair_expired(node, remediation.timeout_seconds):
@@ -321,7 +333,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                     states[name] = state
 
             elif state == RepairState.REINSTALL_REQUIRED:
-                if self._fresh_driver_pod_running(pods_by_node.get(name, ())):
+                if self._fresh_driver_pod_running(pods_on(name)):
                     self._set_repair_state(node, RepairState.REVALIDATE_REQUIRED)
                     states[name] = RepairState.REVALIDATE_REQUIRED
                 elif self._repair_expired(node, remediation.timeout_seconds):

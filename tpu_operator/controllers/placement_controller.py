@@ -1,17 +1,24 @@
 """Placement reconciler: applies the engine's plan to the cluster.
 
-The queue is global (admission order is priority-then-FIFO across ALL
-TPUSlices), so every watch event maps to one synthetic request and each
-reconcile replans the whole queue from cluster state — the same
-level-triggered, recompute-everything shape as the health and upgrade
-walkers. Idempotent: the assignment labels on nodes are the source of
-truth, so a crash between label writes and status writes converges on
-the next pass instead of double-booking.
+Pool-sharded: node events map to a per-pool request (the pool-shard key
+from ``kube/sharding.py``), and a pool request replans ONLY that pool —
+the engine is fed the shard's node set from the sharded node view's
+delta-maintained cache plus just the slices that touch the pool
+(assigned there by labels, pinned there by spec, or last scheduled
+there). Admission order is priority-then-FIFO across ALL TPUSlices, so
+anything a pool pass cannot settle locally (an unpinned slice that
+found no block, a teardown that may re-place elsewhere) defers to the
+GLOBAL pass, which keeps the old recompute-everything shape and runs on
+slice/link events, on the replan heartbeat, and whenever a pool pass
+hands work up. Idempotent either way: the assignment labels on nodes
+are the source of truth, so a crash between label writes and status
+writes converges on the next pass instead of double-booking.
 
-Wire traffic per pass: one cached TPUSlice list, one cached Node list,
-one labels-only merge patch per node whose assignment changed, and one
-key-scoped status patch per slice whose placement block changed —
-O(changes), not O(cluster).
+Wire traffic per pass: one cached TPUSlice list, the pool's cached node
+set (no cluster-wide list on the pool path), one labels-only merge
+patch per node whose assignment changed — fanned out through the shared
+write pool — and one key-scoped status patch per slice whose placement
+block changed: O(changes in the pool), not O(cluster).
 """
 
 from __future__ import annotations
@@ -28,12 +35,29 @@ from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
 from tpu_operator.kube.events import EventRecorder
 from tpu_operator.kube.objects import ObjectDict
-from tpu_operator.placement.engine import PLACEMENT_MANAGER, Plan, PlacementEngine
+from tpu_operator.placement.engine import (
+    PLACEMENT_MANAGER,
+    Plan,
+    PlacementEngine,
+    PlacementPhase,
+)
 
 log = logging.getLogger(__name__)
 
 # the whole queue replans as one unit; every watch event maps here
 QUEUE_REQUEST = Request(name="placement-queue")
+
+# informer index over TPUSlices by the pool they are pinned or last
+# scheduled to — what keeps a pool pass's slice lookup O(matches)
+SLICE_POOL_INDEX = "by-pool"
+
+
+def slice_pool_index(obj: ObjectDict) -> List[str]:
+    """Informer index fn: the pools a TPUSlice is pinned or last
+    scheduled to."""
+    spec_pool = str(((obj.get("spec") or {}).get("placement") or {}).get("pool") or "")
+    status_pool = str(((obj.get("status") or {}).get("placement") or {}).get("pool") or "")
+    return sorted({p for p in (spec_pool, status_pool) if p})
 
 
 class PlacementReconciler:
@@ -42,9 +66,35 @@ class PlacementReconciler:
         self.namespace = namespace
         self.recorder = EventRecorder(client, namespace, component=PLACEMENT_MANAGER)
         self.metrics = get_metrics()
+        # fragmentation-series bookkeeping is shared by the global pass
+        # and every pool-shard worker, which run CONCURRENTLY by design:
+        # its mutations take a dedicated lock (metrics-only — no client
+        # call ever runs under it). The label/status writes themselves
+        # are deliberately NOT serialized across passes: the engine is
+        # built for partial-write states (assignment labels are the
+        # source of truth; crash-between-writes converges), so two
+        # interleaved plans are just another partial state — each label
+        # write is a single-owner assignment (last writer wins), the
+        # losing gang reads as broken on the next pass and re-places,
+        # and the chaos soak's zero-double-booked-hosts-after-quiesce
+        # gate holds exactly because of this level-triggered repair.
+        from tpu_operator.kube import racecheck
+
+        self._frag_lock = racecheck.lock("PlacementReconciler._frag_lock")
         self._frag_pools: set = set()
+        # wired by setup_with_manager: the pool-sharded node view (per-
+        # pool delta-maintained caches) and the controller's enqueue hook
+        # for handing pool-local leftovers to the global pass. Unwired
+        # (direct reconciler use in tests/drills/bench), every request
+        # takes the global path exactly as before.
+        self.node_view = None
+        self._enqueue = None
+        self._drain_shard = None
+        self._slice_informer = None  # pool-indexed TPUSlice cache
 
     def reconcile(self, req: Request) -> Result:
+        if req.shard and self.node_view is not None and self.node_view.synced():
+            return self._reconcile_pool(req.shard)
         slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         nodes = self.client.list("v1", "Node")
         links = self._degraded_links()
@@ -58,13 +108,34 @@ class PlacementReconciler:
         self.metrics.placement_queue_depth.set(plan.queue_depth)
         for pool, frag in plan.fragmentation.items():
             self.metrics.torus_fragmentation.labels(pool).set(frag)
-        for gone in self._frag_pools - set(plan.fragmentation):
+        # tracked set merges with pools the LIVE view still has nodes for:
+        # a pool created after this pass's node snapshot (its pool pass
+        # registered the gauge concurrently) must not be dropped from
+        # tracking by a stale global replace — or its series could leak
+        # forever once the pool later drains (the O005 stale-series class)
+        live_pools = set(self.node_view.shards()) if self.node_view is not None else set()
+        with self._frag_lock:
+            keep = set(plan.fragmentation) | (self._frag_pools & live_pools)
+            gone_pools = self._frag_pools - keep
+            self._frag_pools = keep
+        for gone in gone_pools:
             # a drained/deleted pool must stop exporting its last value
+            # — and its queue shard (workers + labelled series) goes too.
+            # The shard drain is guarded by the LIVE sharded view, not
+            # this pass's (possibly stale) node snapshot: a pool that
+            # (re)appeared mid-pass must keep its queue — and once the
+            # view agrees the pool is empty, any request the drain drops
+            # was a no-op replan of zero nodes anyway.
             try:
                 self.metrics.torus_fragmentation.remove(gone)
             except KeyError:
                 pass
-        self._frag_pools = set(plan.fragmentation)
+            if (
+                self._drain_shard is not None
+                and self.node_view is not None
+                and not self.node_view.nodes(gone)
+            ):
+                self._drain_shard(gone)
         if plan.teardowns or not statuses_ok:
             # a torn-down gang (preempted or degraded) re-places as soon
             # as the world settles; a failed status write retries — once
@@ -75,6 +146,95 @@ class PlacementReconciler:
             # without any event this controller watches mapping to it
             return Result(requeue_after=consts.PLACEMENT_REPLAN_SECONDS)
         return Result()
+
+    def _reconcile_pool(self, shard: str) -> Result:
+        """One pool's replan, fed by the sharded view's delta-maintained
+        cache: same engine, same invariants, scoped inputs. Decisions a
+        pool cannot make alone — admitting an UNPINNED slice that found
+        no local block, re-homing a teardown — defer to the global pass
+        (priority-then-FIFO admission is a cross-pool order)."""
+        nodes = self.node_view.nodes(shard)
+        if not nodes:
+            # the pool drained out from under its shard: the global pass
+            # owns the cleanup (fragmentation series, queue shard)
+            self._request_global()
+            return Result()
+        assigned_here = {
+            (n["metadata"].get("labels") or {}).get(consts.PLACEMENT_LABEL)
+            for n in nodes
+        } - {None, ""}
+        relevant = self._slices_for_pool(shard, assigned_here)
+        links = self._degraded_links()
+        with trace.span(
+            "plan", pool=shard, slices=len(relevant), nodes=len(nodes), links=len(links)
+        ):
+            engine = PlacementEngine(relevant, nodes, degraded_links=links)
+            plan = engine.plan()
+        # a slice this pool couldn't seat may belong elsewhere: only a
+        # slice PINNED TO THIS POOL gets its Unschedulable verdict
+        # published here (the one case where this pool's view is
+        # authoritative); everything else — unpinned, or pinned to a
+        # different pool but dragged in by a stale status.pool — defers
+        # to the global pass, which decides with every pool in view
+        deferred = 0
+        for name in list(plan.statuses):
+            desired = plan.statuses[name]
+            spec_pool = str(
+                (((engine.slices.get(name) or {}).get("spec") or {})
+                 .get("placement") or {}).get("pool") or ""
+            )
+            if (desired and desired.get("phase") == PlacementPhase.UNSCHEDULABLE
+                    and spec_pool != shard):
+                plan.statuses.pop(name)
+                deferred += 1
+        with trace.span("apply-plan", pool=shard, deltas=len(plan.label_deltas)):
+            self._apply_labels(plan)
+            statuses_ok = self._publish_statuses(
+                plan, {s["metadata"]["name"]: s for s in relevant}
+            )
+        self._record_events(plan, engine)
+        for pool, frag in plan.fragmentation.items():
+            self.metrics.torus_fragmentation.labels(pool).set(frag)
+        with self._frag_lock:
+            self._frag_pools.update(plan.fragmentation)
+        if plan.teardowns or deferred:
+            # work only the global order can finish
+            self._request_global()
+        if not statuses_ok:
+            return Result(requeue=True)
+        return Result()
+
+    def _request_global(self) -> None:
+        if self._enqueue is not None:
+            self._enqueue(QUEUE_REQUEST)
+
+    def _slices_for_pool(self, shard: str, assigned_here: set) -> List[ObjectDict]:
+        """The slices a pool pass must see: pinned/last-scheduled to the
+        pool (via the informer's ``by-pool`` index — O(matches), no
+        all-slice scan per node event) plus the owners the pool's node
+        labels name. Falls back to a filtered full list when the indexed
+        informer isn't wired (direct reconciler use)."""
+        informer = self._slice_informer
+        if informer is None or not informer.has_synced():
+            def touches_pool(obj) -> bool:
+                name = obj["metadata"]["name"]
+                spec_pool = str(((obj.get("spec") or {}).get("placement") or {}).get("pool") or "")
+                status_pool = str(((obj.get("status") or {}).get("placement") or {}).get("pool") or "")
+                return name in assigned_here or spec_pool == shard or status_pool == shard
+
+            return [
+                s for s in self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+                if touches_pool(s)
+            ]
+        by_name = {
+            s["metadata"]["name"]: s for s in informer.by_index(SLICE_POOL_INDEX, shard)
+        }
+        for owner in assigned_here:
+            if owner not in by_name:
+                obj = informer.get(owner)
+                if obj is not None:
+                    by_name[owner] = obj
+        return [by_name[name] for name in sorted(by_name)]
 
     def _degraded_links(self) -> List[tuple]:
         """Severed ICI edges from the fabric analyzer's link-health map
@@ -102,15 +262,29 @@ class PlacementReconciler:
     def _apply_labels(self, plan: Plan) -> None:
         # every delta is a real change by construction (assignments only
         # land on previously-free hosts, clears only on labelled ones),
-        # so each is one labels-only merge patch with no read-back
-        for node_name in sorted(plan.label_deltas):
-            try:
-                self.client.patch(
-                    "v1", "Node", node_name,
-                    {"metadata": {"labels": plan.label_deltas[node_name]}},
-                )
-            except errors.NotFound:
-                pass  # node deleted mid-pass; next pass re-plans without it
+        # so each is one labels-only merge patch with no read-back —
+        # fanned out through the shared write pool so a gang-sized sweep
+        # costs one concurrent window, not N serial round-trips
+        from tpu_operator.kube.writers import shared_fanout
+
+        def patch_call(node_name: str, delta: dict):
+            def call():
+                try:
+                    self.client.patch(
+                        "v1", "Node", node_name, {"metadata": {"labels": delta}}
+                    )
+                except errors.NotFound:
+                    pass  # node deleted mid-pass; next pass re-plans without it
+
+            return call
+
+        calls = [
+            patch_call(name, plan.label_deltas[name])
+            for name in sorted(plan.label_deltas)
+        ]
+        for _, err in shared_fanout().map(calls, verb="patch", kind="Node"):
+            if err is not None:
+                raise err
 
     def _publish_statuses(self, plan: Plan, slices: dict) -> bool:
         ok = True
@@ -154,10 +328,14 @@ class PlacementReconciler:
 
 
 def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
+    from tpu_operator.kube.sharding import ShardedNodeView
+
     ctrl = Controller(
         "placement", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
     )
     reconciler.client = CachedReadClient(reconciler.client, mgr)
+    reconciler._enqueue = ctrl.enqueue
+    reconciler._drain_shard = ctrl.drain_shard
 
     def map_to_queue(_obj) -> List[Request]:
         return [QUEUE_REQUEST]
@@ -218,11 +396,23 @@ def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
             return True
         return (old.get("data") or {}) != (new.get("data") or {})
 
-    ctrl.watch(
-        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
-        mapper=map_to_queue, predicate=placement_changed,
-    )
-    ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_queue, predicate=node_changed)
+    slice_informer = mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+    slice_informer.add_index(SLICE_POOL_INDEX, slice_pool_index)
+    reconciler._slice_informer = slice_informer
+    ctrl.watch(slice_informer, mapper=map_to_queue, predicate=placement_changed)
+    # node events route through the sharded view: each event enqueues its
+    # POOL's request (one queue + worker pool per shard), and a node that
+    # moves pools fans out as DELETED-on-old + ADDED-on-new, so both
+    # affected pools replan. The view's per-shard caches are what the
+    # pool pass plans from — per-pool deltas, no global node list.
+    view = ShardedNodeView().attach(mgr.informer_for("v1", "Node"))
+    reconciler.node_view = view
+
+    def on_node_event(shard, event_type, old, new) -> None:
+        if node_changed(event_type, old, new):
+            ctrl.enqueue(Request(name=QUEUE_REQUEST.name, shard=shard))
+
+    view.add_handler(on_node_event)
     ctrl.watch(
         mgr.informer_for("v1", "ConfigMap", reconciler.namespace),
         mapper=map_to_queue, predicate=link_map_changed,

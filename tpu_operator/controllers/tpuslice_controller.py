@@ -105,21 +105,48 @@ class TPUSliceReconciler:
         publish_status(self.client, obj, state, reason, message, error)
 
 
+# fixed shard fan-out for TPUSlice queues: enough for worker isolation,
+# small enough that the per-shard metric children stay bounded
+TPUSLICE_SHARDS = 4
+
+
+def slice_shard(obj: ObjectDict) -> str:
+    """The queue shard a TPUSlice's work rides on: a STABLE hash of the
+    CR name. Deliberately NOT the slice's pool — a slice's pool changes
+    over its life (placement writes status.pool, admins re-pin
+    spec.pool), and a shard key derived from mutable state would let the
+    same slice sit queued on two shards and reconcile CONCURRENTLY
+    (racing DaemonSet creates, last-writer-wins status), with requeues
+    pinned to the stale shard forever. Name-hash routing keeps the old
+    per-name serialization exactly (same name → same queue, always)
+    while one wedged slice's worker can no longer starve the other
+    shards' slices."""
+    import zlib
+
+    name = obj["metadata"]["name"]
+    return f"h{zlib.crc32(name.encode()) % TPUSLICE_SHARDS}"
+
+
 def setup_with_manager(mgr, reconciler: TPUSliceReconciler) -> Controller:
     """reference: SetupWithManager nvidiadriver_controller.go:238+ — watch
     TPUSlice (generation-gated), ClusterPolicy, Nodes, and owned
-    DaemonSets."""
+    DaemonSets. Requests are sharded by a stable name hash (see
+    ``slice_shard``) so slices get isolated queues + workers without
+    ever losing per-name serialization."""
     ctrl = Controller(
         "tpuslice", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
     )
     reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def to_sharded_request(obj: ObjectDict) -> List[Request]:
+        return [Request(name=obj["metadata"]["name"], shard=slice_shard(obj))]
 
     def map_to_all_slices(_obj) -> List[Request]:
         try:
             slices = reconciler.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         except errors.ApiError:
             return []
-        return [Request(name=s["metadata"]["name"]) for s in slices]
+        return [req for s in slices for req in to_sharded_request(s)]
 
     def owned_daemonset(event_type, old, new) -> bool:
         refs = new["metadata"].get("ownerReferences", [])
@@ -130,7 +157,10 @@ def setup_with_manager(mgr, reconciler: TPUSliceReconciler) -> Controller:
             return True
         return old["metadata"].get("labels") != new["metadata"].get("labels")
 
-    ctrl.watch(mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND), predicate=generation_changed)
+    ctrl.watch(
+        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
+        mapper=to_sharded_request, predicate=generation_changed,
+    )
     ctrl.watch(mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND), mapper=map_to_all_slices)
     ctrl.watch(mgr.informer_for("v1", "Node"), mapper=map_to_all_slices, predicate=node_changed)
     ctrl.watch(mgr.informer_for("apps/v1", "DaemonSet"), mapper=map_to_all_slices, predicate=owned_daemonset)

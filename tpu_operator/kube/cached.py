@@ -110,6 +110,16 @@ class CachedReadClient(Client):
     def patch_status(self, api_version, kind, name, patch, namespace=None) -> ObjectDict:
         return self.live.patch_status(api_version, kind, name, patch, namespace)
 
+    def apply_set(
+        self, api_version, kind, name, manager, labels=None, annotations=None,
+        namespace=None, force=False,
+    ) -> ObjectDict:
+        return self.live.apply_set(
+            api_version, kind, name, manager,
+            labels=labels, annotations=annotations, namespace=namespace,
+            force=force,
+        )
+
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None) -> None:
         return self.live.delete(
             api_version, kind, name, namespace, grace_period_seconds=grace_period_seconds

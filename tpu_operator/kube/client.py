@@ -117,6 +117,56 @@ class Client(abc.ABC):
         runs its own competing LIST alongside a snapshot-bearing watch can
         interleave two differently-aged snapshots and corrupt its cache."""
 
+    def apply_set(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        manager: str,
+        labels: Optional[dict] = None,
+        annotations: Optional[dict] = None,
+        namespace: Optional[str] = None,
+        force: bool = False,
+    ) -> ObjectDict:
+        """Server-side-apply analog for metadata (see
+        ``objects.apply_set_merge``): ``manager`` declares the COMPLETE
+        label/annotation sets it owns; the server converges the object —
+        setting declared keys it owns, removing previously-owned keys no
+        longer declared, and never stealing a foreign value. A no-op
+        apply bumps nothing and emits no watch event, so steady-state
+        sweeps cost zero writes. This generic implementation is a
+        read+merge-patch fallback for arbitrary clients; FakeClient and
+        HttpClient override it with a single-request native path."""
+        from tpu_operator.kube.objects import apply_set_merge
+
+        obj = self.get(api_version, kind, name, namespace)
+        md = obj.get("metadata") or {}
+        new_labels, new_annotations, changed = apply_set_merge(
+            md, manager, labels, annotations, force=force
+        )
+        if not changed:
+            return obj
+        delta_labels = {
+            k: v for k, v in new_labels.items() if (md.get("labels") or {}).get(k) != v
+        }
+        for k in (md.get("labels") or {}):
+            if k not in new_labels:
+                delta_labels[k] = None
+        delta_annotations = {
+            k: v
+            for k, v in new_annotations.items()
+            if (md.get("annotations") or {}).get(k) != v
+        }
+        for k in (md.get("annotations") or {}):
+            if k not in new_annotations:
+                delta_annotations[k] = None
+        body: dict = {"metadata": {}}
+        if delta_labels:
+            body["metadata"]["labels"] = delta_labels
+        if delta_annotations:
+            body["metadata"]["annotations"] = delta_annotations
+        return self.patch(api_version, kind, name, body, namespace)
+
     # -- conveniences -------------------------------------------------------
 
     def get_or_none(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None):

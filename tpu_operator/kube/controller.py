@@ -1,10 +1,24 @@
 """Controller: informer-driven reconcile loop (controller-runtime builder).
 
-A Controller owns a rate-limited queue of Requests, a set of watches that
-map events to Requests (with optional predicates), and a Reconciler. Workers
-pop requests and call ``reconcile``; the returned Result drives requeueing.
-MaxConcurrentReconciles defaults to 1, like every reconciler in the
-reference (clusterpolicy_controller.go:354).
+A Controller owns rate-limited queues of Requests, a set of watches that
+map events to Requests (with optional predicates), and a Reconciler.
+Workers pop requests and call ``reconcile``; the returned Result drives
+requeueing. MaxConcurrentReconciles defaults to 1, like every reconciler
+in the reference (clusterpolicy_controller.go:354).
+
+Sharding: a Request may carry a ``shard`` (the pool-shard key from
+``kube/sharding.py``). Each shard gets its OWN queue and its own worker
+pool, created lazily on first use — so one wedged shard (a slow
+apiserver partition, a pathological pool) can never starve the others,
+and the steady-state fan-in cost of a pool-local event is that pool's
+queue, not a global one. Unsharded controllers keep the old shape: every
+request lands on the default shard (``""``) and nothing changes.
+
+Per-shard observability: the workqueue depth/wait/oldest-age series and
+the reconcile-duration histogram carry a ``shard`` label, and the
+reconcile trace root records ``shard`` so bench attribution can name
+per-shard owners. ``drain_shard`` retires a departed shard's queue,
+workers, and metric children (the O005 stale-series contract).
 """
 
 from __future__ import annotations
@@ -13,20 +27,36 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
-from tpu_operator.kube import trace
+from tpu_operator.kube import racecheck, trace
 from tpu_operator.kube.informer import Informer
 from tpu_operator.kube.objects import ObjectDict
 from tpu_operator.kube.queue import RateLimitingQueue
 
 log = logging.getLogger(__name__)
 
+# process-wide registry of live controllers (weak: a dropped controller
+# unregisters itself) — what `tpuop-cfg must-gather` reads to dump the
+# per-shard queue depths of THIS process, mirroring how traces.txt reads
+# the in-process flight recorder
+import weakref  # noqa: E402
+
+_CONTROLLERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_controllers() -> List["Controller"]:
+    return sorted(_CONTROLLERS, key=lambda c: c.name)
+
 
 @dataclasses.dataclass(frozen=True)
 class Request:
     name: str
     namespace: str = ""
+    # pool-shard routing key: requests with different shards ride
+    # different queues/workers. Part of identity on purpose — the same
+    # logical request targeted at two shards is two units of work.
+    shard: str = ""
 
 
 @dataclasses.dataclass
@@ -53,6 +83,25 @@ def to_self_request(obj: ObjectDict) -> List[Request]:
     return [Request(name=md["name"], namespace=md.get("namespace", ""))]
 
 
+class _Shard:
+    """One shard's queue + workers + labelled metric children."""
+
+    def __init__(self, controller: "Controller", name: str):
+        self.name = name
+        self.queue = RateLimitingQueue(coalesce_window=controller._coalesce_window)
+        self.threads: List[threading.Thread] = []
+        self.depth_gauge = trace.queue_depth_gauge().labels(controller.name, name)
+        self.wait_histogram = trace.queue_wait_histogram().labels(controller.name, name)
+        self.duration_histogram = trace.reconcile_duration_histogram().labels(
+            controller.name, name
+        )
+        # live at scrape time — a stalled queue's age keeps growing even
+        # though nothing pops to update a plain gauge
+        trace.queue_oldest_age_gauge().labels(controller.name, name).set_function(
+            self.queue.oldest_age
+        )
+
+
 class Controller:
     def __init__(
         self,
@@ -66,19 +115,48 @@ class Controller:
         # coalesce_window > 0 folds event bursts (a node label sweep fans
         # out one watch event per node, all mapping to the same Request)
         # into one reconcile per window — see RateLimitingQueue
-        self.queue = RateLimitingQueue(coalesce_window=coalesce_window)
+        self._coalesce_window = coalesce_window
         self.max_concurrent = max_concurrent
         self._watches: List[tuple] = []  # (informer, mapper, predicate)
-        self._threads: List[threading.Thread] = []
         self._stopping = threading.Event()
-        # per-controller observability series (process-wide factories in
-        # kube/trace.py, re-exported by controllers.operator_metrics)
-        self._depth_gauge = trace.queue_depth_gauge().labels(name)
-        self._wait_histogram = trace.queue_wait_histogram().labels(name)
-        self._duration_histogram = trace.reconcile_duration_histogram().labels(name)
-        # live at scrape time — a stalled queue's age keeps growing even
-        # though nothing pops to update a plain gauge
-        trace.queue_oldest_age_gauge().labels(name).set_function(self.queue.oldest_age)
+        self._started = False
+        # shard map: "" (the default shard) always exists so unsharded
+        # controllers behave exactly as before. Guarded by _shard_lock;
+        # worker starts/joins happen OUTSIDE it (joining under a lock a
+        # worker might need is the C003 deadlock shape).
+        self._shard_lock = racecheck.lock("Controller._shard_lock")
+        self._shards: Dict[str, _Shard] = {"": _Shard(self, "")}
+        _CONTROLLERS.add(self)
+
+    def shard_depths(self) -> Dict[str, int]:
+        """shard -> queued requests (ready + delayed), the must-gather
+        surface."""
+        with self._shard_lock:
+            shards = dict(self._shards)
+        return {name: len(shard.queue) for name, shard in sorted(shards.items())}
+
+    # back-compat: the default shard's queue is the queue most callers
+    # and tests mean (unsharded controllers have exactly one); the
+    # setter swaps it in place (tests inject seeded-RNG queues)
+    @property
+    def queue(self) -> RateLimitingQueue:
+        return self._shards[""].queue
+
+    @queue.setter
+    def queue(self, queue: RateLimitingQueue) -> None:
+        shard = self._shards[""]
+        old = shard.queue
+        shard.queue = queue
+        trace.queue_oldest_age_gauge().labels(self.name, "").set_function(
+            queue.oldest_age
+        )
+        # wake any worker blocked on the old queue; it re-reads
+        # shard.queue, sees the swap, and serves the new one
+        old.shutdown()
+
+    def shards(self) -> List[str]:
+        with self._shard_lock:
+            return sorted(self._shards)
 
     def watch(self, informer: Informer, mapper: Mapper = to_self_request, predicate: Optional[Predicate] = None):
         informer.add_handler(self._make_handler(mapper, predicate))
@@ -90,47 +168,114 @@ class Controller:
             if predicate is not None and not predicate(event_type, old, new):
                 return
             for req in mapper(new):
-                self.queue.add(req)
-            self._set_depth()
+                self.enqueue(req)
 
         return handler
 
-    def _set_depth(self) -> None:
+    def enqueue(self, req: Request) -> None:
+        """Route a request to its shard's queue (creating the shard —
+        queue, workers, metric children — on first sight). A concurrent
+        ``drain_shard`` can shut the resolved queue down between resolve
+        and add (the add is then silently dropped by the queue's own
+        shutdown contract), so the membership re-check retries onto a
+        freshly-created shard — a pool drained and immediately
+        repopulated never loses its replan event."""
+        while True:
+            shard = self._shard_for(req.shard)
+            shard.queue.add(req)
+            with self._shard_lock:
+                if self._shards.get(req.shard) is shard:
+                    break
+                if self._stopping.is_set():
+                    return  # controller stopping: drops are expected
+        self._set_depth(shard)
+
+    def _shard_for(self, name: str) -> _Shard:
+        with self._shard_lock:
+            shard = self._shards.get(name)
+            if shard is None:
+                shard = self._shards[name] = _Shard(self, name)
+                start_now = self._started and not self._stopping.is_set()
+            else:
+                return shard
+        if start_now:
+            self._start_shard_workers(shard)
+        return shard
+
+    def _start_shard_workers(self, shard: _Shard) -> None:
+        for i in range(self.max_concurrent):
+            t = threading.Thread(
+                target=self._worker,
+                args=(shard,),
+                name=f"{self.name}-worker-{shard.name or 'default'}-{i}",
+                daemon=True,
+            )
+            t.start()
+            shard.threads.append(t)
+
+    def _set_depth(self, shard: _Shard) -> None:
         try:
-            self._depth_gauge.set(len(self.queue))
+            shard.depth_gauge.set(len(shard.queue))
         except Exception:  # noqa: BLE001 — metrics must never break the loop
             pass
 
+    def drain_shard(self, name: str) -> None:
+        """Retire a departed shard: shut its queue down, join its
+        workers, and remove its labelled metric children so the series
+        die with the pool (O005). The default shard never drains."""
+        if not name:
+            return
+        with self._shard_lock:
+            shard = self._shards.pop(name, None)
+        if shard is None:
+            return
+        shard.queue.shutdown()
+        for t in shard.threads:
+            t.join(timeout=5)
+        trace.remove_shard_series(self.name, name)
+
     def start(self) -> None:
-        for i in range(self.max_concurrent):
-            t = threading.Thread(target=self._worker, name=f"{self.name}-worker-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+        with self._shard_lock:
+            self._started = True
+            shards = list(self._shards.values())
+        for shard in shards:
+            self._start_shard_workers(shard)
 
     def stop(self) -> None:
         self._stopping.set()
-        self.queue.shutdown()
-        for t in self._threads:
-            t.join(timeout=5)
+        with self._shard_lock:
+            shards = list(self._shards.values())
+        for shard in shards:
+            shard.queue.shutdown()
+        for shard in shards:
+            for t in shard.threads:
+                t.join(timeout=5)
 
-    def _worker(self) -> None:
+    def _worker(self, shard: _Shard) -> None:
         while not self._stopping.is_set():
-            req = self.queue.get()
+            # re-read per iteration: the back-compat `queue` setter may
+            # swap the default shard's queue under a running worker
+            queue = shard.queue
+            req = queue.get()
             if req is None:
-                return
+                if self._stopping.is_set() or shard.queue is queue:
+                    return  # shutdown: drained for real
+                continue  # queue swapped under us: serve the new one
             # one trace per reconcile: queue wait rides as a root attr,
             # the body is the root span, every apiserver call inside it
             # opens a child (kube/trace.py) — what must-gather dumps and
-            # bench attribution aggregates
-            wait = self.queue.wait_of(req)
-            self._wait_histogram.observe(wait)
-            self._set_depth()
+            # bench attribution aggregates (shard included, so slow
+            # shards have named owners)
+            wait = queue.wait_of(req)
+            shard.wait_histogram.observe(wait)
+            self._set_depth(shard)
             ok = False
             with trace.start_trace(
                 "reconcile",
                 controller=self.name,
                 request=f"{req.namespace + '/' if req.namespace else ''}{req.name}",
                 queue_wait_s=wait,
+                shard=shard.name,
             ) as root:
                 t0 = root.start
                 try:
@@ -143,18 +288,18 @@ class Controller:
                 except Exception as e:  # noqa: BLE001 — requeue with backoff, like controller-runtime
                     root.error = f"{type(e).__name__}: {e}"
                     log.exception("[%s] reconcile %s failed", self.name, req)
-            self._duration_histogram.observe(time.monotonic() - t0)
+            shard.duration_histogram.observe(time.monotonic() - t0)
             if not ok:
-                self.queue.add_rate_limited(req)
-                self.queue.done(req)
+                queue.add_rate_limited(req)
+                queue.done(req)
                 continue
             if result.requeue_after > 0:
-                self.queue.forget(req)
-                self.queue.add_after(req, result.requeue_after)
+                queue.forget(req)
+                queue.add_after(req, result.requeue_after)
             elif result.requeue:
                 # no forget: Requeue=true keeps the per-item backoff growing
                 # toward max_delay, like client-go's AddRateLimited path
-                self.queue.add_rate_limited(req)
+                queue.add_rate_limited(req)
             else:
-                self.queue.forget(req)
-            self.queue.done(req)
+                queue.forget(req)
+            queue.done(req)

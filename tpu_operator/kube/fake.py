@@ -302,6 +302,44 @@ class FakeClient(Client):
         self._notify()
         return deep_copy(obj)
 
+    @_traced("apply_set")
+    def apply_set(
+        self, api_version, kind, name, manager, labels=None, annotations=None,
+        namespace=None, force=False,
+    ):
+        """Native apply-set (see objects.apply_set_merge): ONE store
+        transaction computes the converged label/annotation sets against
+        current state — no read-modify-write, no rv to Conflict on — and
+        a no-op apply returns the object untouched: no rv bump, no watch
+        event, zero steady-state cost."""
+        from tpu_operator.kube.objects import apply_set_merge
+
+        key = self._key(api_version, kind, name, namespace)
+        with self._lock, self._tripwire:
+            existing = self._get_stored(key)
+            if existing is None:
+                raise errors.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            new_labels, new_annotations, changed = apply_set_merge(
+                existing["metadata"], manager, labels, annotations, force=force
+            )
+            if not changed:
+                return deep_copy(existing)
+            new = dict(existing)
+            md = new["metadata"] = dict(existing["metadata"])
+            if new_labels:
+                md["labels"] = new_labels
+            else:
+                md.pop("labels", None)
+            if new_annotations:
+                md["annotations"] = new_annotations
+            else:
+                md.pop("annotations", None)
+            md["resourceVersion"] = self._next_rv()
+            self._set_stored(key, new)
+            self._pending.append((MODIFIED, new))
+        self._notify()
+        return deep_copy(new)
+
     @_traced("patch_status")
     def patch_status(self, api_version, kind, name, patch, namespace=None):
         """Merge patch scoped to the status subresource: only the body's

@@ -148,6 +148,11 @@ def _parse_retry_after(value) -> Optional[float]:
         return None
 
 
+class _WatchListUnsupported(Exception):
+    """The server rejected (or ignored) watch-with-initial-events; the
+    caller falls back to the legacy paginated LIST + watch bootstrap."""
+
+
 class _WatchSub(WatchSubscription):
     def __init__(self):
         self._stopped = threading.Event()
@@ -182,6 +187,9 @@ class HttpClient(Client):
         "update_status": (("update", "status"),),
         "patch": (("patch", None),),
         "patch_status": (("patch", "status"),),
+        # apply-set rides PATCH with its own content type (one request,
+        # server-side field-ownership merge)
+        "apply_set": (("patch", None),),
         "delete": (("delete", None),),
         "evict": (("create", "pods/eviction"),),
         "pod_logs": (("get", "pods/log"),),
@@ -770,6 +778,33 @@ class HttpClient(Client):
             verb="patch_status", kind=kind,
         )
 
+    def apply_set(
+        self, api_version, kind, name, manager, labels=None, annotations=None,
+        namespace=None, force=False,
+    ):
+        """Apply-set over the wire (the server-side-apply analog): ONE
+        PATCH carrying the declared ownership sets; the server performs
+        the field-ownership merge (objects.apply_set_merge) against its
+        own current state — no GET, no Conflict-retry loop, and a no-op
+        apply is free server-side. Idempotent by construction, so the
+        transport's PATCH retry policy applies unchanged."""
+        body: dict = {}
+        if labels is not None:
+            body["labels"] = labels
+        if annotations is not None:
+            body["annotations"] = annotations
+        return self._request(
+            "PATCH",
+            self._path(api_version, kind, namespace, name),
+            body=body,
+            query=(
+                {"fieldManager": manager, "force": "true"}
+                if force else {"fieldManager": manager}
+            ),
+            content_type="application/apply-set+json",
+            verb="apply_set", kind=kind,
+        )
+
     def delete(self, api_version, kind, name, namespace=None, grace_period_seconds=None):
         query = (
             {"gracePeriodSeconds": str(grace_period_seconds)}
@@ -840,13 +875,44 @@ class HttpClient(Client):
     def _watch_loop(self, api_version, kind, handler, namespace, sub: _WatchSub) -> None:
         resource_version = ""
         can_resume = False  # server serves arbitrary-rv watches (real kube)
+        # streamed-LIST bootstrap (client-go WatchList semantics): the
+        # initial snapshot arrives IN the watch stream (sendInitialEvents)
+        # — ONE request — instead of a paginated LIST whose page count
+        # scales with cluster size (16k nodes = 33 pages per informer
+        # (re)connect, all thrown away against snapshot-bearing servers).
+        # A server that rejects or ignores the option drops this flag and
+        # the loop falls back to the legacy LIST+watch for its lifetime.
+        watchlist = True
         while sub.active:
             try:
+                if not resource_version and watchlist:
+                    try:
+                        last_rv, mode = self._stream_watch(
+                            api_version, kind, handler, namespace, sub, "0",
+                            send_initial=True,
+                        )
+                    except (_WatchListUnsupported, TimeoutError):
+                        # rejected, ignored (bootstrap deadline), or the
+                        # stream stalled before delivering a snapshot: a
+                        # watch-list retry loop could starve the informer
+                        # of its sync forever — the legacy LIST+watch is
+                        # always correct, so drop to it for good
+                        log.info(
+                            "watch %s: watch-list bootstrap unavailable; "
+                            "using LIST+watch", kind,
+                        )
+                        watchlist = False
+                        continue
+                    # a bookmark-terminated initial-events stream (real
+                    # apiserver) establishes a resumable rv; the in-repo
+                    # fake's atomic SYNC keeps no history — reconnects
+                    # re-bootstrap, still one request each
+                    can_resume = mode == "bookmark"
+                    resource_version = last_rv if (can_resume and last_rv) else ""
+                    continue
                 if not resource_version:
-                    # (re-)list to establish a consistent start point —
-                    # paged like every other LIST (informer reconnects on
-                    # large clusters are exactly where one giant response
-                    # would hurt most)
+                    # legacy bootstrap: (re-)list to establish a consistent
+                    # start point — paged like every other LIST
                     items, resource_version = self._list_paged(api_version, kind, namespace)
                     can_resume = resource_version != "0"
                     if can_resume:
@@ -866,7 +932,7 @@ class HttpClient(Client):
                     # atomically with watch registration (kube's
                     # resourceVersion=0 semantics) — replaying the list
                     # here too would be a stale second snapshot
-                last_rv = self._stream_watch(
+                last_rv, _ = self._stream_watch(
                     api_version, kind, handler, namespace, sub, resource_version
                 )
                 # clean stream end (apiserver watch timeout): resume from
@@ -898,13 +964,32 @@ class HttpClient(Client):
                 sub._stopped.wait(1.0)
 
     def _stream_watch(
-        self, api_version, kind, handler, namespace, sub, resource_version
-    ) -> Optional[str]:
-        """Run one watch stream; returns the last resourceVersion seen
-        (events and bookmarks) so the loop can resume without re-listing."""
+        self, api_version, kind, handler, namespace, sub, resource_version,
+        send_initial: bool = False,
+    ):
+        """Run one watch stream; returns ``(last_rv, mode)`` — the last
+        resourceVersion seen (events and bookmarks) so the loop can
+        resume without re-listing, and how the initial snapshot arrived
+        (``"sync"`` for a server-native SYNC replay, ``"bookmark"`` for
+        a WatchList initial-events stream, ``None`` otherwise).
+
+        ``send_initial=True`` is the streamed-LIST bootstrap: the server
+        is asked to deliver current state in-stream (kube's
+        ``sendInitialEvents``). A real apiserver streams per-object
+        ADDED events terminated by a bookmark annotated
+        ``k8s.io/initial-events-end``; those are buffered and delivered
+        to the handler as ONE SYNC snapshot (cache consumers need
+        Replace semantics — a reconnect must also convey deletions). The
+        in-repo fake short-circuits this by streaming its SYNC snapshot
+        natively. A server that 400s the option — or ignores it and
+        streams live events — raises ``_WatchListUnsupported`` so the
+        loop falls back to LIST+watch."""
         query = {"watch": "true", "allowWatchBookmarks": "true"}
         if resource_version:
             query["resourceVersion"] = resource_version
+        if send_initial:
+            query["sendInitialEvents"] = "true"
+            query["resourceVersionMatch"] = "NotOlderThan"
         url = self.base_url + self._path(api_version, kind, namespace) + "?" + urllib.parse.urlencode(query)
         req = urllib.request.Request(url)
         self._count_request("WATCH")
@@ -920,15 +1005,44 @@ class HttpClient(Client):
         # the server's idle bookmarks/heartbeats), so a read that times
         # out means the stream silently wedged — the loop re-lists
         last_rv: Optional[str] = resource_version or None
-        with urllib.request.urlopen(
-            req, timeout=self.watch_stall_seconds, context=self._ssl
-        ) as resp:
+        mode: Optional[str] = None
+        initial: Optional[list] = [] if send_initial else None
+        # bootstrap deadline: a server that silently IGNORES
+        # sendInitialEvents keeps the stream alive with plain bookmarks
+        # and live events — without a bound the snapshot would buffer
+        # forever and the informer never sync. Past it, fall back.
+        bootstrap_deadline = (
+            time.monotonic() + min(10.0, self.watch_stall_seconds)
+            if send_initial else None
+        )
+        try:
+            stream = urllib.request.urlopen(
+                req, timeout=self.watch_stall_seconds, context=self._ssl
+            )
+        except urllib.error.HTTPError as e:
+            if send_initial and e.code in (400, 422):
+                raise _WatchListUnsupported() from e
+            raise
+        with stream as resp:
             buffer = b""
             while sub.active:
                 chunk = resp.read1(65536)
                 if not chunk:
-                    return last_rv
+                    if initial is not None:
+                        # the stream ended while the initial snapshot was
+                        # still buffering (no end marker, no SYNC): the
+                        # server either ignored sendInitialEvents or died
+                        # mid-snapshot — either way this subscription has
+                        # no authoritative state; fall back to LIST+watch
+                        raise _WatchListUnsupported()
+                    return last_rv, mode
                 buffer += chunk
+                if (
+                    initial is not None
+                    and bootstrap_deadline is not None
+                    and time.monotonic() > bootstrap_deadline
+                ):
+                    raise _WatchListUnsupported()  # snapshot never completed
                 while b"\n" in buffer:
                     line, buffer = buffer.split(b"\n", 1)
                     if not line.strip():
@@ -938,11 +1052,42 @@ class HttpClient(Client):
                     rv = (obj.get("metadata") or {}).get("resourceVersion")
                     if rv:  # bookmarks carry the server's progress rv too
                         last_rv = rv
+                    if etype == SYNC:
+                        # server-native snapshot (the in-repo fake): the
+                        # streamed-LIST fast path — pass it through
+                        handler(SYNC, obj)
+                        mode, initial = "sync", None
+                        continue
                     if etype == "BOOKMARK":
+                        annotations = (obj.get("metadata") or {}).get("annotations") or {}
+                        if initial is not None and annotations.get(
+                            "k8s.io/initial-events-end"
+                        ) == "true":
+                            # WatchList end marker: flush the buffered
+                            # initial state as one SYNC replace
+                            handler(
+                                SYNC,
+                                {
+                                    "apiVersion": api_version,
+                                    "kind": f"{kind}List",
+                                    "items": initial,
+                                },
+                            )
+                            mode, initial = "bookmark", None
                         continue
                     if etype == "ERROR":
                         raise errors.ApiError(f"watch error event: {obj}")
+                    if initial is not None:
+                        if etype == "ADDED":
+                            obj.setdefault("apiVersion", api_version)
+                            obj.setdefault("kind", kind)
+                            initial.append(obj)
+                            continue
+                        # a non-ADDED event before the end marker means
+                        # the server ignored sendInitialEvents (feature
+                        # off): this stream has no snapshot — fall back
+                        raise _WatchListUnsupported()
                     obj.setdefault("apiVersion", api_version)
                     obj.setdefault("kind", kind)
                     handler(etype, obj)
-        return last_rv
+        return last_rv, mode

@@ -534,9 +534,23 @@ class FakeApiServer:
             updated = self.client.update(obj)
             return handler._send(200, updated or obj)
         if method == "PATCH":
-            # only JSON merge patch is served (what HttpClient sends); the
-            # real apiserver answers other patch types with 415
+            # JSON merge patch plus the apply-set flavor (the
+            # server-side-apply analog); the real apiserver answers other
+            # patch types with 415
             ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+            if ctype == "application/apply-set+json":
+                if sub:
+                    raise errors.Invalid(f"cannot apply-set subresource {sub!r}")
+                manager = (query.get("fieldManager") or ["default"])[0]
+                body = handler._body() or {}
+                applied = self.client.apply_set(
+                    api_version, kind, name, manager,
+                    labels=body.get("labels"),
+                    annotations=body.get("annotations"),
+                    namespace=namespace,
+                    force=(query.get("force") == ["true"]),
+                )
+                return handler._send(200, applied)
             if ctype != "application/merge-patch+json":
                 raise errors.Invalid(f"unsupported patch content type {ctype!r}")
             body = handler._body() or {}

@@ -102,6 +102,87 @@ def metadata_patch(labels: Optional[dict] = None, annotations: Optional[dict] = 
     return {"metadata": metadata} if metadata else None
 
 
+def apply_set_merge(
+    metadata: dict,
+    manager: str,
+    labels: Optional[dict] = None,
+    annotations: Optional[dict] = None,
+    force: bool = False,
+) -> tuple:
+    """Server-side-apply analog over metadata labels/annotations: the
+    ``manager`` declares the COMPLETE set of keys it owns (with values);
+    returns ``(new_labels, new_annotations, changed)`` computed against
+    ``metadata``. Field-ownership semantics per key:
+
+    - absent key → set it; the manager now owns it.
+    - key still carrying the manager's last-applied value → set the new
+      declared value (normal convergence).
+    - key carrying the declared value already → adopt (idempotent).
+    - key carrying a FOREIGN value (an admin override) → left alone and
+      ownership is ceded — the apply never steals a field, which is what
+      preserves the hand-set opt-out semantics the delta writers had.
+      ``force=True`` (kube SSA's force, for sole-authority writers like
+      the slice manager's worker identities) overrides instead.
+    - previously-owned key no longer declared → removed, but only while
+      it still carries the manager's value; a foreign change survives.
+
+    Ownership is recorded ON the object (one annotation per manager,
+    ``consts.APPLY_SET_ANNOTATION_PREFIX + manager``, JSON of the
+    applied key→value maps), so removals survive operator restarts with
+    no cache diffing and no read-modify-write loop. ``changed`` False
+    means the apply is a no-op — clients skip the rv bump and the watch
+    event entirely, which is what makes a steady-state sweep free."""
+    import json as _json
+
+    from tpu_operator import consts as _consts
+
+    record_key = _consts.APPLY_SET_ANNOTATION_PREFIX + manager
+    current_labels = dict(metadata.get("labels") or {})
+    current_annotations = dict(metadata.get("annotations") or {})
+    try:
+        record = _json.loads(current_annotations.get(record_key) or "{}")
+        if not isinstance(record, dict):
+            record = {}
+    except ValueError:
+        record = {}  # corrupt record: treat as owning nothing
+
+    def merge_dim(current: dict, owned: dict, desired: dict) -> tuple:
+        result = dict(current)
+        new_record: dict = {}
+        for key, value in (desired or {}).items():
+            have = current.get(key)
+            if force or key not in current or have == owned.get(key) or have == value:
+                result[key] = value
+                new_record[key] = value
+            # else: foreign value — leave it, cede ownership
+        for key, last_applied in (owned or {}).items():
+            if key in (desired or {}):
+                continue
+            if result.get(key) == last_applied:
+                result.pop(key, None)  # remove only what is still ours
+        return result, new_record
+
+    new_labels, rec_labels = merge_dim(
+        current_labels, record.get("labels") or {}, labels or {}
+    )
+    new_annotations, rec_annotations = merge_dim(
+        current_annotations, record.get("annotations") or {}, annotations or {}
+    )
+    new_record: dict = {}
+    if rec_labels:
+        new_record["labels"] = rec_labels
+    if rec_annotations:
+        new_record["annotations"] = rec_annotations
+    if new_record:
+        new_annotations[record_key] = _json.dumps(
+            new_record, sort_keys=True, separators=(",", ":")
+        )
+    else:
+        new_annotations.pop(record_key, None)
+    changed = new_labels != current_labels or new_annotations != current_annotations
+    return new_labels, new_annotations, changed
+
+
 def merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch, returning the patched value (inputs are
     not mutated): dicts merge recursively, ``None`` deletes a key, any

@@ -1,0 +1,169 @@
+"""Pool-sharding for the control plane: shard keying + a sharded node view.
+
+The scaling contract (ROADMAP item 2): steady-state control-plane cost
+must be O(changes) all the way to 16k+ nodes. The remaining O(nodes)
+terms live in the fan-in — every node event funnels into ONE queue and
+every reconcile rebuilds GLOBAL state. This module supplies the two
+primitives that break that up:
+
+- ``shard_key(node)``: the stable shard a node belongs to — its TPU
+  node pool (the same (accelerator, topology, gke-nodepool) partition
+  ``nodepool.get_node_pools`` computes, via the same ``tpu_info``
+  derivation, so the shard map and the pool map can never disagree).
+  Non-TPU nodes land in the ``UNPOOLED`` shard.
+
+- ``ShardedNodeView``: a per-shard delta feed over one shared node
+  informer. It maintains per-shard member caches and dispatches
+  per-shard handlers with the informer's own deltas, so a consumer (the
+  placement controller) reacts to a pool-local change by touching ONE
+  pool's state instead of re-deriving the cluster. A node whose pool
+  labels change MOVES atomically: the old shard sees DELETED, the new
+  shard sees ADDED, and the node is a member of exactly one shard at
+  every observable point (the cross-shard-move invariant the sharding
+  tests pin).
+
+Handlers run OUTSIDE the view's lock (they may call back into clients);
+the membership flip itself is a single critical section, so two racing
+label updates can never leave a node in two shards.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from tpu_operator.kube import racecheck
+from tpu_operator.kube.client import DELETED
+from tpu_operator.kube.objects import ObjectDict, deep_copy
+
+log = logging.getLogger(__name__)
+
+# shard for nodes that belong to no TPU pool (bare nodes mid-bootstrap,
+# non-TPU workers): they still need a home so controllers that watch all
+# nodes keep level-triggered coverage
+UNPOOLED = "unpooled"
+
+# handler(shard, event_type, old_or_None, new) — same read-only-object
+# convention as informer handlers
+ShardHandler = Callable[[str, str, Optional[ObjectDict], ObjectDict], None]
+
+
+def shard_key(node: ObjectDict) -> str:
+    """The pool-shard a node files under. Derived through the SAME
+    ``tpu_info`` + pool-name path the nodepool partitioner uses, so
+    ``shard_key(n)`` equals the ``NodePool.name`` that
+    ``get_node_pools([...])`` would put ``n`` in."""
+    from tpu_operator.nodeinfo import tpu_info
+    from tpu_operator.nodepool import _pool_name
+
+    info = tpu_info(node)
+    if info is None:
+        return UNPOOLED
+    return _pool_name(info)
+
+
+class ShardedNodeView:
+    """Per-shard membership + delta dispatch over one node informer.
+
+    ``attach(informer)`` registers a handler on the shared informer; the
+    view then tracks every node's shard and re-dispatches each event to
+    the per-shard handlers, translating pool moves into a DELETED on the
+    old shard followed by an ADDED on the new one.
+    """
+
+    def __init__(self):
+        self._lock = racecheck.lock("ShardedNodeView._lock")
+        self._shard_of: Dict[str, str] = {}  # node name -> shard
+        self._members: Dict[str, Dict[str, ObjectDict]] = {}  # shard -> {name: node}
+        self._handlers: List[ShardHandler] = []
+        self._informer = None
+
+    def attach(self, informer) -> "ShardedNodeView":
+        """Wire the view to a node informer (idempotent). Existing cache
+        entries are absorbed immediately; live deltas follow via the
+        handler. The informer dispatches SYNC snapshots as per-item
+        ADDED/DELETED events, so bootstrap and reconnect both arrive as
+        deltas — there is no separate list path to keep consistent."""
+        if self._informer is informer:
+            return self
+        self._informer = informer
+        informer.add_handler(self._on_event)
+        for node in informer.cached(copy=False):
+            self._on_event("ADDED", None, node)
+        return self
+
+    def add_handler(self, handler: ShardHandler) -> None:
+        self._handlers.append(handler)
+
+    # -- event path ----------------------------------------------------------
+
+    def _on_event(self, event_type: str, old: Optional[ObjectDict], new: ObjectDict) -> None:
+        name = new["metadata"]["name"]
+        dispatch: List[tuple] = []  # (shard, event_type, old, new)
+        with self._lock:
+            prev_shard = self._shard_of.get(name)
+            if event_type == DELETED:
+                if prev_shard is not None:
+                    self._shard_of.pop(name, None)
+                    self._drop_member(prev_shard, name)
+                    dispatch.append((prev_shard, DELETED, old, new))
+            else:
+                shard = shard_key(new)
+                if prev_shard is not None and prev_shard != shard:
+                    # pool move: leaves the old shard and joins the new
+                    # one in ONE critical section — never in both
+                    self._drop_member(prev_shard, name)
+                    dispatch.append((prev_shard, DELETED, old, old or new))
+                    self._shard_of[name] = shard
+                    self._members.setdefault(shard, {})[name] = new
+                    dispatch.append((shard, "ADDED", None, new))
+                else:
+                    self._shard_of[name] = shard
+                    self._members.setdefault(shard, {})[name] = new
+                    dispatch.append(
+                        (shard, event_type if prev_shard is None else "MODIFIED", old, new)
+                    )
+        for shard, etype, o, n in dispatch:
+            for handler in self._handlers:
+                try:
+                    handler(shard, etype, o, n)
+                except Exception:  # noqa: BLE001 — the view must survive handler bugs
+                    log.exception("sharded handler failed for shard %s node %s", shard, name)
+
+    # tpuop-lint: guarded-by=_lock
+    def _drop_member(self, shard: str, name: str) -> None:
+        members = self._members.get(shard)
+        if members is not None:
+            members.pop(name, None)
+            if not members:
+                del self._members[shard]
+
+    # -- reads ---------------------------------------------------------------
+
+    def shards(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def shard_for(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._shard_of.get(name)
+
+    def nodes(self, shard: str, copy: bool = False) -> List[ObjectDict]:
+        """Members of one shard. ``copy=False`` (default) returns the
+        cached objects themselves — read-only by the informer
+        convention; the placement engine only reads labels."""
+        with self._lock:
+            members = list(self._members.get(shard, {}).values())
+        return [deep_copy(n) for n in members] if copy else members
+
+    def membership(self) -> Dict[str, List[str]]:
+        """shard -> sorted member names (the equivalence and must-gather
+        surface)."""
+        with self._lock:
+            return {s: sorted(m) for s, m in self._members.items()}
+
+    def synced(self) -> bool:
+        """True once the backing informer has delivered its snapshot
+        (the view applies deltas synchronously inside the informer's
+        dispatch, so informer-synced means view-synced)."""
+        return self._informer is not None and self._informer.has_synced()

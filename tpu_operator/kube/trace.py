@@ -32,10 +32,15 @@ pattern as ``http_client._requests_counter`` / ``retry.retries_counter``;
 re-exported by ``controllers.operator_metrics`` and served from the
 manager's :8080 endpoint):
 
-- ``tpu_operator_reconcile_duration_seconds{controller}``
-- ``tpu_operator_workqueue_depth{controller}``
-- ``tpu_operator_workqueue_wait_seconds{controller}``
+- ``tpu_operator_reconcile_duration_seconds{controller,shard}``
+- ``tpu_operator_workqueue_depth{controller,shard}``
+- ``tpu_operator_workqueue_wait_seconds{controller,shard}``
 - ``tpu_operator_informer_event_lag_seconds{kind}``
+
+The ``shard`` dimension is the pool-sharded control plane's ownership
+label (empty for unsharded controllers). Shards come and go with node
+pools, so the gauges retire their children on shard drain via
+``remove_shard_series`` — the O005 stale-series contract.
 
 (the per-(verb, kind) apiserver request latency histogram lives next to
 ``apiserver_requests_total`` in ``http_client``, which owns the wire.)
@@ -75,8 +80,8 @@ def reconcile_duration_histogram():
 
         _RECONCILE_DURATION = prometheus_client.Histogram(
             "tpu_operator_reconcile_duration_seconds",
-            "Wall time of one reconcile body, per controller",
-            ["controller"],
+            "Wall time of one reconcile body, per controller and shard",
+            ["controller", "shard"],
             buckets=_DURATION_BUCKETS,
         )
     return _RECONCILE_DURATION
@@ -89,8 +94,8 @@ def queue_depth_gauge():
 
         _QUEUE_DEPTH = prometheus_client.Gauge(
             "tpu_operator_workqueue_depth",
-            "Requests queued (ready + delayed) per controller workqueue",
-            ["controller"],
+            "Requests queued (ready + delayed) per controller workqueue shard",
+            ["controller", "shard"],
         )
     return _QUEUE_DEPTH
 
@@ -109,8 +114,8 @@ def queue_oldest_age_gauge():
         _QUEUE_OLDEST_AGE = prometheus_client.Gauge(
             "tpu_operator_workqueue_oldest_age_seconds",
             "Age of the oldest pending request in a controller workqueue "
-            "(0 when empty); sampled live at scrape time",
-            ["controller"],
+            "shard (0 when empty); sampled live at scrape time",
+            ["controller", "shard"],
         )
     return _QUEUE_OLDEST_AGE
 
@@ -126,10 +131,30 @@ def queue_wait_histogram():
         _QUEUE_WAIT = prometheus_client.Histogram(
             "tpu_operator_workqueue_wait_seconds",
             "Time a request sat queued before a worker picked it up",
-            ["controller"],
+            ["controller", "shard"],
             buckets=_DURATION_BUCKETS,
         )
     return _QUEUE_WAIT
+
+
+def remove_shard_series(controller: str, shard: str) -> None:
+    """Retire one drained shard's workqueue/reconcile series (O005: a
+    shard that left with its pool must not export its last values
+    forever). Histograms retire alongside the gauges for hygiene."""
+    for gauge in (_QUEUE_DEPTH, _QUEUE_OLDEST_AGE):
+        if gauge is None:
+            continue
+        try:
+            gauge.remove(controller, shard)
+        except KeyError:
+            pass
+    for histogram in (_QUEUE_WAIT, _RECONCILE_DURATION):
+        if histogram is None:
+            continue
+        try:
+            histogram.remove(controller, shard)
+        except KeyError:
+            pass
 
 
 def informer_lag_histogram():
@@ -367,10 +392,42 @@ def current() -> Optional[Span]:
 
 
 def trace_ref() -> str:
-    """``trace_id/span_id`` of the active span ('' outside a trace) —
-    the TRACE_HEADER value."""
+    """``trace_id/span_id`` of the active span — the TRACE_HEADER value.
+    Outside a trace, a ref carried across a thread handoff (the write
+    fan-out pool) still propagates, so server-side fault attribution
+    keeps naming the reconcile even for pooled writes; '' otherwise."""
     span = current()
-    return f"{span.trace_id}/{span.span_id}" if span is not None else ""
+    if span is not None:
+        return f"{span.trace_id}/{span.span_id}"
+    return getattr(_TLS, "carried_ref", "") or ""
+
+
+class _CarriedRef:
+    """Context manager installing an inherited trace ref on a worker
+    thread (no span accounting — only header propagation)."""
+
+    __slots__ = ("_ref", "_prev")
+
+    def __init__(self, ref: str):
+        self._ref = ref
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "carried_ref", "")
+        _TLS.carried_ref = self._ref
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.carried_ref = self._prev
+        return False
+
+
+def carry_ref(ref: str) -> _CarriedRef:
+    """Carry a trace ref (from ``trace_ref()``) onto another thread: the
+    write fan-out wraps each pooled call in this so the X-Tpuop-Trace
+    header — and with it chaos fault attribution — survives the
+    handoff. Spans are NOT created on the carrying thread; the batch's
+    one logical api span on the submitting thread owns the accounting."""
+    return _CarriedRef(ref)
 
 
 def start_trace(name: str, **attrs) -> _TraceCtx:
@@ -480,6 +537,11 @@ class FlightRecorder:
         return spans * 200 + attrs * 120 + overflow * 160
 
     # -- rendering -----------------------------------------------------------
+
+    def render_trace(self, trace: Trace) -> List[str]:
+        """Public single-trace rendering (must-gather's sharding.txt
+        renders the slowest shard's traces through this)."""
+        return self._render_trace(trace)
 
     def _render_trace(self, trace: Trace) -> List[str]:
         root = trace.root

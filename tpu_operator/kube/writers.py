@@ -1,0 +1,185 @@
+"""Thread-pooled fan-out for hot-path apiserver writes.
+
+A label sweep is N independent writes; issuing them serially makes the
+sweep's wall time N x the slowest PATCH, and one slow apiserver response
+stalls the whole shard's reconcile. This module is the async write path
+the sharded control plane rides: a small process-wide pool of daemon
+workers that executes a batch of independent write thunks concurrently
+and hands the caller every result (or error) once the batch drains.
+
+Trace accounting: the pool threads run OUTSIDE the reconcile's trace
+(spans are thread-local), so ``fanout`` wraps the whole batch in one
+logical ``api`` span on the calling thread — verb/kind labelled, with
+``attempts`` set to the number of writes issued. Attribution then sees
+the batch's true wall time (the concurrent window, which is what the
+reconcile actually paid) and its request count, instead of N serial
+spans whose raw durations would sum past the reconcile wall and break
+the trace-accounting gate. Per-attempt wire retries inside the pool are
+still counted by the transport's own metrics; the trace records the
+logical write count, which is the number attribution's rpr math needs.
+
+Batches below ``FANOUT_MIN`` run inline on the caller: the thread
+handoff costs more than it saves, and inline writes keep their
+individual api spans — small batches stay fully attributed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from tpu_operator.kube import racecheck, trace
+
+log = logging.getLogger(__name__)
+
+# batches smaller than this run inline on the calling thread
+FANOUT_MIN = 4
+
+# pool width: enough to hide per-request latency without turning one
+# operator into an apiserver stampede (client-go's default QPS shaping
+# plays the same moderating role)
+_DEFAULT_WORKERS = min(16, max(4, (os.cpu_count() or 4)))
+
+
+class WriteFanout:
+    """Bounded worker pool executing batches of independent write thunks.
+
+    Workers are daemon threads created lazily on first use and live for
+    the process (the shared pool below is process-wide, like the metric
+    factories); ``close`` drains them for embedders that want a bounded
+    lifetime. Deliberately NOT concurrent.futures.ThreadPoolExecutor:
+    its workers are non-daemon and atexit-joined, so a process-lifetime
+    shared pool would block interpreter exit (and every short-lived test
+    process) unless something remembered to shut it down — daemon
+    workers make the shared singleton safe by construction.
+    """
+
+    def __init__(self, workers: int = _DEFAULT_WORKERS, name: str = "write-fanout"):
+        self._target = max(1, workers)
+        self._name = name
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._lock = racecheck.lock("WriteFanout._lock")
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+
+    @property
+    def workers(self) -> int:
+        with self._lock:
+            return len(self._threads)
+
+    def _ensure_workers(self, needed: int) -> None:
+        to_start: List[threading.Thread] = []
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("WriteFanout is closed")
+            while len(self._threads) < min(self._target, max(needed, 1)):
+                t = threading.Thread(
+                    target=self._worker,
+                    name=f"{self._name}-{len(self._threads)}",
+                    daemon=True,
+                )
+                self._threads.append(t)
+                to_start.append(t)
+        for t in to_start:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            task = self._tasks.get()
+            if task is None:
+                return  # poison pill from close()
+            fn, index, batch, ref = task
+            try:
+                # the submitter's trace ref rides the handoff so the
+                # wire header (and chaos fault attribution) still names
+                # the owning reconcile; no spans open on this thread
+                with trace.carry_ref(ref):
+                    result: Tuple[Optional[object], Optional[BaseException]] = (fn(), None)
+            except BaseException as e:  # noqa: BLE001 — errors travel to the caller
+                result = (None, e)
+            batch.deliver(index, result)
+
+    def map(
+        self,
+        calls: Sequence[Callable[[], object]],
+        verb: str = "",
+        kind: str = "",
+    ) -> List[Tuple[Optional[object], Optional[BaseException]]]:
+        """Run every thunk, concurrently when the batch is big enough;
+        returns ``[(result, error)]`` in input order. Never raises for an
+        individual call — the caller decides which errors matter (a
+        label sweep skips NotFound and requeues on the first ApiError,
+        same as its serial form did)."""
+        if not calls:
+            return []
+        if len(calls) < FANOUT_MIN:
+            out: List[Tuple[Optional[object], Optional[BaseException]]] = []
+            for fn in calls:
+                try:
+                    out.append((fn(), None))
+                except BaseException as e:  # noqa: BLE001
+                    out.append((None, e))
+            return out
+        self._ensure_workers(len(calls))
+        batch = _Batch(len(calls))
+        ref = trace.trace_ref()  # carried onto the workers (header only)
+        # one logical api span for the whole concurrent batch (see module
+        # docstring); a no-op outside a trace
+        with trace.client_span(verb or "write", kind) as span:
+            span.set(attempts=len(calls), fanout=self.workers)
+            for index, fn in enumerate(calls):
+                self._tasks.put((fn, index, batch, ref))
+            batch.wait()
+        return batch.results
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            threads = list(self._threads)
+        for _ in threads:
+            self._tasks.put(None)
+        for t in threads:
+            t.join(timeout=5)
+
+
+class _Batch:
+    """Countdown latch collecting one batch's results."""
+
+    def __init__(self, size: int):
+        self.results: List[Tuple[Optional[object], Optional[BaseException]]] = [
+            (None, None)
+        ] * size
+        self._remaining = size
+        self._lock = racecheck.lock("WriteFanout._Batch._lock")
+        self._done = threading.Event()
+
+    def deliver(self, index: int, result) -> None:
+        with self._lock:
+            self.results[index] = result
+            self._remaining -= 1
+            finished = self._remaining <= 0
+        if finished:
+            self._done.set()
+
+    def wait(self) -> None:
+        self._done.wait()
+
+
+_SHARED: Optional[WriteFanout] = None
+_SHARED_LOCK = racecheck.lock("writers._SHARED_LOCK")
+
+
+def shared_fanout() -> WriteFanout:
+    """Process-wide write pool (the hot controllers all share it — the
+    bound is per-process apiserver pressure, not per-controller)."""
+    global _SHARED
+    if _SHARED is None:
+        with _SHARED_LOCK:
+            if _SHARED is None:
+                _SHARED = WriteFanout()
+    return _SHARED

@@ -227,7 +227,9 @@ def analyze_rule_hygiene(
 # unless some code path removes them. Dimensions like ``controller`` or
 # ``node`` (a node-local exporter's own name) are fixed for the life of
 # the process and die with it.
-DYNAMIC_LABEL_DIMENSIONS = frozenset({"slice", "pool", "edge", "chip", "probe", "gang"})
+DYNAMIC_LABEL_DIMENSIONS = frozenset(
+    {"slice", "pool", "edge", "chip", "probe", "gang", "shard"}
+)
 
 
 def _registered_gauges(source_root: Optional[str] = None) -> Dict[str, dict]:

@@ -361,7 +361,7 @@ def _receiver(func: ast.Attribute) -> str:
 _OBJ_METHODS = {"create", "update", "apply", "update_status"}
 _TYPED_METHODS = {
     "get", "get_or_none", "list", "delete", "watch", "informer_for",
-    "patch", "patch_status",
+    "patch", "patch_status", "apply_set",
 }
 # "v1", "apps/v1", "rbac.authorization.k8s.io/v1", "tpu.google.com/v1alpha1"
 _API_VERSION_RE = re.compile(r"^(v\d+[a-z0-9]*|[a-z0-9.\-]+/v\d+[a-z0-9]*)$")

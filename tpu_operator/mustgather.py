@@ -10,7 +10,9 @@ end to end against the served fake apiserver.
 Artifact layout mirrors the script's: nodes.yaml, node-labels.txt,
 node-health.txt (health/repair labels + TPUHealthy conditions),
 clusterpolicies.yaml, tpuslices.yaml, daemonsets.yaml, pods.yaml,
-services.yaml, configmaps.yaml, events.txt, pod-logs/<pod>.log.
+services.yaml, configmaps.yaml, events.txt, sharding.txt (shard→pool
+assignment, per-shard queue depths, the slowest shard's recent
+traces), pod-logs/<pod>.log.
 """
 
 from __future__ import annotations
@@ -290,6 +292,59 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("fabric.txt", "\n".join(lines) + "\n")
     except errors.ApiError as e:
         emit("fabric.txt", f"# collection failed: {e}\n")
+
+    try:
+        # the sharded control plane's view: shard→pool assignment (the
+        # pool-shard keying over live nodes), per-shard queue depths of
+        # THIS process's controllers (same in-process caveat as
+        # traces.txt), and the slowest shard's recent reconcile traces —
+        # where "which pool is wedging the control plane" starts
+        from tpu_operator.kube.controller import live_controllers
+        from tpu_operator.kube.sharding import shard_key
+        from tpu_operator.kube.trace import recorder as _recorder
+
+        lines = ["# shard -> pool assignment (nodes per shard)"]
+        by_shard: dict = {}
+        for node in client.list("v1", "Node"):
+            by_shard.setdefault(shard_key(node), []).append(node["metadata"]["name"])
+        for shard in sorted(by_shard):
+            members = sorted(by_shard[shard])
+            preview = ",".join(members[:5]) + ("…" if len(members) > 5 else "")
+            lines.append(f"{shard}  nodes={len(members)}  [{preview}]")
+        if not by_shard:
+            lines.append("# none")
+        lines.append("")
+        lines.append("# per-shard queue depths (this process's controllers)")
+        depth_lines = []
+        for ctl in live_controllers():
+            for shard, depth in ctl.shard_depths().items():
+                depth_lines.append(f"{ctl.name}  shard={shard or '-'}  depth={depth}")
+        lines.extend(depth_lines or ["# no live controllers in this process"])
+        lines.append("")
+        lines.append("# slowest shard's last 5 reconcile traces")
+        rec = _recorder()
+        shard_wall: dict = {}
+        for t in rec.traces():
+            key = (t.root.attrs.get("controller", "?"), str(t.root.attrs.get("shard") or ""))
+            shard_wall[key] = shard_wall.get(key, 0.0) + t.root.duration
+        if shard_wall:
+            slow_ctl, slow_shard = max(shard_wall, key=shard_wall.get)
+            lines.append(
+                f"# controller={slow_ctl} shard={slow_shard or '-'} "
+                f"total_wall={shard_wall[(slow_ctl, slow_shard)] * 1000:.2f}ms"
+            )
+            slow_traces = [
+                t for t in rec.traces()
+                if t.root.attrs.get("controller") == slow_ctl
+                and str(t.root.attrs.get("shard") or "") == slow_shard
+            ][-5:]
+            for t in slow_traces:
+                lines.extend(rec.render_trace(t))
+        else:
+            lines.append("# no traces recorded in this process")
+        emit("sharding.txt", "\n".join(lines) + "\n")
+    except Exception as e:  # noqa: BLE001 — never fail the bundle
+        emit("sharding.txt", f"# collection failed: {e}\n")
 
     try:
         # cluster-wide: events for cluster-scoped objects (the CRs) land
