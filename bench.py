@@ -153,8 +153,11 @@ def bench_install_to_ready(
             cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
             if cp.get("status", {}).get("state") == "ready":
                 dses = store.list("apps/v1", "DaemonSet", ns)
-                if len(dses) == 9 and all(
-                    ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
+                # election-gated autotuner: desired/available 0 here
+                if len(dses) == 10 and all(
+                    ds.get("status", {}).get("numberAvailable")
+                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else nodes)
+                    for ds in dses
                 ):
                     elapsed = time.perf_counter() - t0
                     break
@@ -602,6 +605,9 @@ def _compact_summary(out: dict) -> dict:
         "placement_time_to_place_s": out.get("placement", {}).get("time_to_place_s"),
         "placement_fragmentation": out.get("placement", {}).get("fragmentation"),
         "burnin_step_p50_ms": out.get("telemetry", {}).get("burnin", {}).get("step_p50_ms"),
+        "autotune_flash_speedup": out.get("autotune", {}).get("flash", {}).get(
+            "speedup_vs_default"
+        ),
         "gang_straggler_ratio": out.get("telemetry", {}).get("gang", {}).get("straggler_ratio"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
@@ -1336,6 +1342,322 @@ def telemetry_smoke() -> int:
     return 0 if ok else 1
 
 
+def autotune_block() -> dict:
+    """The kernel-autotune sweep measured for real on the local backend:
+    the flash (block_q, block_k) grid and the matmul chain-tiling grid,
+    with the hardcoded default config measured INSIDE the same sweep so
+    'tuned >= default' is an apples-to-apples comparison (the winner is
+    the argmax over a grid containing the default, so equality means
+    the default is proven already-optimal, never that tuning lost).
+    Physical numbers on a chip, mechanical ones on CPU interpret mode —
+    either way the harness (grid, pruning, two-point timing, winner
+    pick) runs for real."""
+    import jax
+
+    from tpu_operator.workloads.autotune import (
+        DEFAULT_FLASH_BLOCK_K,
+        DEFAULT_FLASH_BLOCK_Q,
+        DEFAULT_MATMUL_UNROLL,
+        FLASH_BLOCK_GRID,
+        flash_shape_class,
+        sweep_flash,
+        sweep_matmul,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        seq, heads, dim, iters, reps = 8192, 8, 128, 8, 4
+        flash_grid = FLASH_BLOCK_GRID
+        mm_size, unrolls, mm_iters = 8192, (2, 4, 8, 16), 16
+    else:
+        seq, heads, dim, iters, reps = 512, 2, 64, 1, 1
+        flash_grid = ((128, 128), (128, 256), (256, 256), (512, 512))
+        mm_size, unrolls, mm_iters = 256, (2, 4, 8), 2
+
+    def compare(records, winner, default_config):
+        by_cfg = {tuple(sorted(r.config.items())): r for r in records}
+        default = by_cfg.get(tuple(sorted(default_config.items())))
+        block = {
+            "default": default.to_dict() if default else None,
+            "winner": winner.to_dict() if winner else None,
+            "configs_measured": sum(1 for r in records if not r.pruned and not r.error),
+            "configs_pruned": sum(1 for r in records if r.pruned),
+        }
+        if winner and default and default.rate:
+            # a pruned default was already proven dominated by the probe
+            # pass: the winner beat it by construction
+            block["tuned_ge_default"] = default.pruned or (
+                (winner.rate or 0.0) >= default.rate * 0.999
+            )
+            block["speedup_vs_default"] = round((winner.rate or 0.0) / default.rate, 3)
+        return block
+
+    out: dict = {"platform": "tpu" if on_tpu else jax.devices()[0].platform}
+    try:
+        records, winner = sweep_flash(
+            seq_len=seq, heads=heads, head_dim=dim, configs=flash_grid,
+            iters=iters, reps=reps,
+        )
+        default_cfg = {
+            "block_q": min(DEFAULT_FLASH_BLOCK_Q, seq),
+            "block_k": min(DEFAULT_FLASH_BLOCK_K, seq),
+        }
+        out["flash"] = {
+            "shape_class": flash_shape_class(seq, heads, dim),
+            **compare(records, winner, default_cfg),
+        }
+    except Exception as e:  # noqa: BLE001 — best-effort like every detail
+        out["flash"] = {"error": str(e)[-300:]}
+    try:
+        records, winner = sweep_matmul(
+            size=mm_size, unrolls=unrolls, iters=mm_iters, reps=reps,
+        )
+        out["matmul"] = {
+            "shape_class": f"m{mm_size}",
+            **compare(records, winner, {"unroll": DEFAULT_MATMUL_UNROLL}),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["matmul"] = {"error": str(e)[-300:]}
+    return out
+
+
+def autotune_smoke() -> int:
+    """CI gate (scripts/ci.sh): the closed autotune loop end to end on a
+    seeded sim with TWO generations (v4 + v5e), plus a real (tiny) sweep
+    on the local backend. The gate demands:
+
+    1. the controller elects exactly ONE in-service node per un-swept
+       generation (deterministically), and the sweep runs exactly once
+       per generation fleet-wide;
+    2. results land in the ``tpu-autotune-results`` ConfigMap keyed by
+       (generation, libtpu version), the winners blob is published, and
+       the perf-floors ConfigMap tightens — the folded v5e floor within
+       5% of perf.py's measured roof x FLOOR_FRACTION;
+    3. the exporter hot-reloads the tightened floor (the very next
+       observe_probe comparison uses it, no pod restart);
+    4. a second pass is a cache hit: elections cleared, ZERO apiserver
+       writes from controller and agent;
+    5. a node joining an already-swept generation is never elected and
+       never re-sweeps (still zero writes);
+    6. workloads resolve the published winners (tuned_flash_blocks)
+       and, on the real local sweep, the tuned flash config's achieved
+       rate >= the hardcoded default config's.
+    """
+    from tpu_operator import consts as _consts
+    from tpu_operator.agents.autotune_agent import AutotuneAgent
+    from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+    from tpu_operator.api.clusterpolicy import (
+        ClusterPolicy,
+        new_cluster_policy,
+    )
+    from tpu_operator.controllers.autotune_controller import (
+        AutotuneReconciler,
+        libtpu_version_for,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.objects import new_object
+    from tpu_operator.kube.sim import make_torus_nodes, make_tpu_node
+    from tpu_operator.perf import FLOOR_FRACTION, floors_for, floors_json
+    from tpu_operator.workloads.autotune import tuned_flash_blocks
+
+    ns = "tpu-operator"
+    checks: dict = {}
+
+    class CountingClient:
+        """Write-counting shim over the FakeClient: the zero-write
+        steady-state checks read it."""
+
+        WRITE_VERBS = ("create", "patch", "patch_status", "update",
+                       "update_status", "delete", "apply", "apply_set")
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.writes = 0
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if name in self.WRITE_VERBS and callable(attr):
+                def counted(*a, **kw):
+                    self.writes += 1
+                    return attr(*a, **kw)
+
+                return counted
+            return attr
+
+    store = FakeClient()
+    client = CountingClient(store)
+    # two generations: a 4-host v4 pool and a 2-host v5e pool
+    for node in make_torus_nodes((2, 2, 1), prefix="v4a", accelerator="tpu-v4-podslice"):
+        node["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    for i in range(2):
+        node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "2x4")
+        node["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+        store.create(node)
+    store.create(new_cluster_policy())
+    # the floors CM as pre-requisites renders it (default table)
+    store.create(new_object(
+        "v1", "ConfigMap", _consts.PERF_FLOORS_CONFIGMAP, ns,
+        data={_consts.PERF_FLOORS_KEY: floors_json()},
+    ))
+    cp = ClusterPolicy.from_unstructured(store.get(
+        "tpu.google.com/v1", "ClusterPolicy", "cluster-policy"
+    ))
+    version = libtpu_version_for(cp)
+    # the autotuner DaemonSet pins LIBTPU_VERSION to the libtpu image
+    # tag; the smoke's in-process agents need the same pin so their
+    # recorded fingerprint matches the controller's expectation
+    os.environ["LIBTPU_VERSION"] = version
+
+    reconciler = AutotuneReconciler(client, ns)
+    req = Request(name="cluster-policy")
+    reconciler.reconcile(req)
+
+    def elected_nodes() -> list:
+        return sorted(
+            n["metadata"]["name"] for n in store.list("v1", "Node")
+            if (n["metadata"].get("labels") or {}).get(_consts.AUTOTUNE_ELECTED_LABEL)
+            == _consts.AUTOTUNE_ELECTED
+        )
+
+    elected = elected_nodes()
+    # lexicographically-first in-service node of each generation
+    checks["one_election_per_generation"] = elected == ["v4a-0", "v5e-0"]
+
+    # the elected agents sweep (injected sweep records TPU-measured
+    # rates: v5e at its real measured roof, v4 10% under its scaled
+    # guess — the fold must move BOTH floors to the measured truth)
+    sweeps: dict = {}
+    # v5e at its real measured roof (the 5%-of-perf.py acceptance
+    # check); v4 ABOVE its scaled guess, so the folded floor tightens
+    # upward and catches a shortfall the stale floor missed
+    measured = {"v4": 270.0, "v5e": 185.0}
+
+    def fake_sweep(gen, ver):
+        sweeps[gen] = sweeps.get(gen, 0) + 1
+        flash = {"block_q": 512, "block_k": 1024, "time_ms": 2.0,
+                 "rate": 100.0, "stable": True}
+        return {
+            "generation": gen, "libtpu_version": ver, "platform": "tpu",
+            "results": {
+                "flash_fwd": {"s8192_h8_d128": {"winner": flash, "configs": [flash]}},
+                "flash_fwd_bwd": {"s8192_h8_d128": {"winner": flash, "configs": [flash]}},
+                "matmul": {"m8192": {"winner": {"unroll": 16, "rate": measured[gen],
+                                                "stable": True}, "configs": []}},
+                "int8": {"m8192": {"winner": {"unroll": 8, "rate": measured[gen] * 2,
+                                              "stable": True}, "configs": []}},
+            },
+        }
+
+    agents = {
+        name: AutotuneAgent(client, name, ns, sweep_fn=fake_sweep)
+        for name in elected
+    }
+    outcomes = {name: agent.reconcile_once() for name, agent in agents.items()}
+    checks["sweeps_ran"] = all(o == "swept" for o in outcomes.values())
+
+    # fold pass: elections clear, floors tighten, winners publish
+    reconciler.reconcile(req)
+    checks["elections_cleared"] = elected_nodes() == []
+    results_cm = store.get("v1", "ConfigMap", _consts.AUTOTUNE_RESULTS_CONFIGMAP, ns)
+    data = results_cm.get("data") or {}
+    checks["results_cached"] = "v4.json" in data and "v5e.json" in data
+    winners_raw = data.get(_consts.AUTOTUNE_WINNERS_KEY, "")
+    checks["winners_published"] = '"block_q": 512' in winners_raw
+    floors_cm = store.get("v1", "ConfigMap", _consts.PERF_FLOORS_CONFIGMAP, ns)
+    blob = (floors_cm.get("data") or {}).get(_consts.PERF_FLOORS_KEY, "")
+    folded = json.loads(blob)
+    want_v5e = measured["v5e"] * FLOOR_FRACTION
+    got_v5e = folded.get("v5e", {}).get("matmul_tflops", 0.0)
+    checks["v5e_floor_measured"] = abs(got_v5e - want_v5e) <= 0.05 * want_v5e
+    checks["v4_floor_tightened"] = (
+        folded.get("v4", {}).get("matmul_tflops")
+        == round(measured["v4"] * FLOOR_FRACTION, 1)
+    )
+
+    # exporter hot-reload: the tightened floor bites the VERY NEXT
+    # observe_probe comparison, no pod restart
+    exporter = MetricsExporterAgent(
+        node_name="v4a-0", client=store, namespace=ns, generation="v4",
+        floors=floors_for("v4"),  # the stale built-in table from pod start
+        breach_samples=1,
+    )
+    stale_floor = exporter.floors["matmul_tflops"]
+    probe_value = (stale_floor + folded["v4"]["matmul_tflops"]) / 2.0
+    checks["hot_reload_applied"] = exporter.refresh_floors() and (
+        exporter.floors["matmul_tflops"] == folded["v4"]["matmul_tflops"]
+    )
+    # above the stale floor, below the tightened one -> breach only
+    # because the reload landed
+    checks["hot_reload_bites"] = exporter.observe_probe("matmul_tflops", probe_value)
+
+    # steady state: a third controller pass and re-run agents (now
+    # descheduled — election cleared) — ZERO apiserver writes
+    client.writes = 0
+    reconciler.reconcile(req)
+    outcomes = {name: agent.reconcile_once() for name, agent in agents.items()}
+    checks["steady_agents_descheduled"] = all(o == "not-elected" for o in outcomes.values())
+    checks["steady_zero_writes"] = client.writes == 0
+
+    # a REBOOTED elected node (label re-stamped by an admin race /
+    # controller lag): the valid cache entry reads as a hit — zero
+    # writes, no re-sweep — and the next controller pass re-clears
+    node = store.get("v1", "Node", "v4a-0")
+    node["metadata"]["labels"][_consts.AUTOTUNE_ELECTED_LABEL] = _consts.AUTOTUNE_ELECTED
+    store.update(node)
+    client.writes = 0
+    checks["reboot_cache_hit"] = agents["v4a-0"].reconcile_once() == "cache-hit"
+    checks["reboot_zero_agent_writes"] = client.writes == 0
+    reconciler.reconcile(req)
+    checks["stale_election_cleared"] = elected_nodes() == []
+
+    # a node joining the already-swept v4 generation (sorting FIRST, so
+    # a naive re-election would pick it): never elected, never sweeps
+    joiner = make_tpu_node("a-joiner", "tpu-v4-podslice", "4x4x1")
+    joiner["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+    store.create(joiner)
+    client.writes = 0
+    reconciler.reconcile(req)
+    joined_agent = AutotuneAgent(client, "a-joiner", ns, sweep_fn=fake_sweep)
+    checks["joiner_not_elected"] = (
+        elected_nodes() == [] and joined_agent.reconcile_once() == "not-elected"
+    )
+    checks["joiner_zero_writes"] = client.writes == 0
+    checks["exactly_one_sweep_per_generation"] = sweeps == {"v4": 1, "v5e": 1}
+
+    # consumption: workloads resolve the published winners
+    os.environ["TPU_AUTOTUNE_JSON"] = winners_raw
+    try:
+        os.environ["TPU_GENERATION"] = "v4"
+        checks["winners_resolved"] = tuned_flash_blocks(8192) == (512, 1024)
+        # an un-swept generation falls back to the hand-swept defaults
+        os.environ["TPU_GENERATION"] = "v6e"
+        checks["winners_fallback"] = tuned_flash_blocks(8192) == (1024, 1024)
+    finally:
+        del os.environ["TPU_AUTOTUNE_JSON"]
+        del os.environ["TPU_GENERATION"]
+        del os.environ["LIBTPU_VERSION"]
+
+    # the real (tiny) sweep on the local backend: tuned >= default
+    block = autotune_block()
+    checks["local_flash_tuned_ge_default"] = bool(
+        block.get("flash", {}).get("tuned_ge_default")
+    )
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "autotune_smoke",
+        "ok": ok,
+        "elected": elected,
+        "v5e_floor": got_v5e,
+        "v5e_roof_x_fraction": round(want_v5e, 1),
+        "local_flash": block.get("flash"),
+        "checks": checks,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def bench_placement(
     dims=(8, 8, 8),
     seed: int = 20260803,
@@ -1502,6 +1824,8 @@ def main() -> None:
         raise SystemExit(telemetry_smoke())
     if "--fabric-smoke" in sys.argv[1:]:
         raise SystemExit(fabric_smoke())
+    if "--autotune-smoke" in sys.argv[1:]:
+        raise SystemExit(autotune_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -1586,6 +1910,9 @@ def main() -> None:
     # ICI fabric sweep: per-edge transfer timing + per-axis allreduce
     # latency on the virtual mesh (gated by --fabric-smoke)
     fabric = fabric_block()
+    # kernel-autotune sweep: flash block grid + matmul tilings with the
+    # default config measured in-grid (gated by --autotune-smoke)
+    autotune = autotune_block()
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -1616,6 +1943,7 @@ def main() -> None:
         "placement": placement_block,
         "telemetry": telemetry,
         "fabric": fabric,
+        "autotune": autotune,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

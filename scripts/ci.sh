@@ -73,6 +73,14 @@ echo "== fabric smoke: degraded edge blamed on the link, gang re-places around i
 # multi-edge-one-endpoint degradation to the HOST (perf label -> FSM);
 # the tpu_operator_ici_link_* series must live and die with their pool
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --fabric-smoke
+echo "== autotune smoke: one sweep per generation, floors tighten, cache hits are write-free =="
+# closed-loop autotune gate: seeded two-generation sim — exactly one
+# sweep per generation fleet-wide, results + winners land in the
+# ConfigMaps, the folded v5e floor matches perf.py's measured roof x
+# FLOOR_FRACTION, the exporter hot-reloads it, a second pass and a
+# late-joining node are zero-write cache hits, and the real local
+# flash sweep proves the tuned config >= the hardcoded default
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --autotune-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

@@ -1,65 +1,27 @@
 """Sweep flash-attention block sizes on the real chip.
 
-Times the 8k causal forward (and optionally fwd+bwd) for a grid of
+Thin CLI over ``tpu_operator.workloads.autotune`` — the one sweep
+implementation the ``state-autotuner`` operand also runs (this script
+used to carry its own copy of the timing chain; now there is exactly
+one). Times the causal forward (and optionally fwd+bwd) for a grid of
 (block_q, block_k) configs using the relay-safe two-point estimator and
-prints one JSON line per config. Run on the axon TPU backend (default
-platform); pass --fwd-bwd to add the training path for each config.
+prints one JSON line per config, keeping the historical contract:
+a ``{"platform": ...}`` header, then per-config lines with
+``seq_len``/``block_q``/``block_k``/``fwd_ms``/``fwd_tflops``/
+``stable`` (+ ``fwd_bwd_ms``/``fwd_bwd_stable`` under ``--fwd-bwd``;
+``error`` records for invalid configs). ``--prune-ratio`` enables the
+harness's dominated-config pruning (0 = measure everything, the
+historical behavior).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import sys
-from functools import partial
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 
-from tpu_operator.workloads.flashattention import flash_attention
-from tpu_operator.workloads.timing import attention_grad_chain, two_point_min_timing
-
-
-def time_config(seq_len, heads, head_dim, block_q, block_k, iters, reps,
-                fwd_bwd=False):
-    shape = (1, seq_len, heads, head_dim)
-    keys = jax.random.split(jax.random.PRNGKey(1), 3)
-    q, k, v = (jax.random.normal(key, shape, dtype=jnp.bfloat16) for key in keys)
-    fn = lambda a, kk, vv: flash_attention(
-        a, kk, vv, causal=True, block_q=block_q, block_k=block_k
-    )
-
-    @partial(jax.jit, static_argnames="n")
-    def chain(q, k, v, s, n):
-        def step(i, acc):
-            return fn(acc, k, v).astype(q.dtype)
-
-        out = lax.fori_loop(0, n, step, q * s)
-        return jnp.float32(out.sum())
-
-    timing = two_point_min_timing(
-        lambda s, n: float(chain(q, k, v, s, n)), iters, 4 * iters, reps
-    )
-    t = timing.per_iter_s or timing.inclusive_per_iter_s
-    flops = 2 * 2 * heads * seq_len**2 * head_dim / 2
-    out = {
-        "seq_len": seq_len,
-        "block_q": block_q,
-        "block_k": block_k,
-        "fwd_ms": round(t * 1e3, 3),
-        "fwd_tflops": round(flops / t / 1e12, 1),
-        "stable": timing.per_iter_s is not None,
-    }
-    if fwd_bwd:
-        gchain = attention_grad_chain(fn, q, k, v)
-        gt = two_point_min_timing(
-            lambda s, n: float(gchain(q, k, v, s, n)), iters, 4 * iters, reps
-        )
-        ts = gt.per_iter_s or gt.inclusive_per_iter_s
-        out["fwd_bwd_ms"] = round(ts * 1e3, 3)
-        out["fwd_bwd_stable"] = gt.per_iter_s is not None
-    return out
+from tpu_operator.workloads.autotune import sweep_flash
 
 
 def main():
@@ -71,22 +33,58 @@ def main():
     ap.add_argument("--reps", type=int, default=4)
     ap.add_argument("--fwd-bwd", action="store_true")
     ap.add_argument(
+        "--prune-ratio", type=float, default=0.0,
+        help="skip full timing of configs this factor slower than the "
+        "probe best (0 = measure every config)",
+    )
+    ap.add_argument(
         "--configs",
         default="256x1024,256x512,512x512,512x1024,128x1024,256x2048,512x2048,1024x1024",
         help="comma-separated BQxBK pairs",
     )
     args = ap.parse_args()
-    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+    grid = []
     for cfg in args.configs.split(","):
         bq, bk = (int(x) for x in cfg.split("x"))
-        try:
-            r = time_config(
-                args.seq, args.heads, args.head_dim, bq, bk,
-                args.iters, args.reps, fwd_bwd=args.fwd_bwd,
-            )
-        except Exception as e:  # keep sweeping past an invalid config
-            r = {"block_q": bq, "block_k": bk, "error": f"{type(e).__name__}: {e}"}
-        print(json.dumps(r), flush=True)
+        grid.append((bq, bk))
+    prune = args.prune_ratio if args.prune_ratio > 0 else float("inf")
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+
+    def run(fwd_bwd):
+        records, _ = sweep_flash(
+            seq_len=args.seq, heads=args.heads, head_dim=args.head_dim,
+            configs=grid, iters=args.iters, reps=args.reps,
+            fwd_bwd=fwd_bwd, prune_ratio=prune,
+        )
+        return {(r.config["block_q"], r.config["block_k"]): r for r in records}
+
+    # configs the grid rejects up front (non-dividing blocks) still get
+    # an error record, like the historical per-config try/except
+    swept = run(fwd_bwd=False)
+    bwd = run(fwd_bwd=True) if args.fwd_bwd else {}
+    for bq, bk in grid:
+        r = swept.get((bq, bk))
+        if r is None:
+            out = {"block_q": bq, "block_k": bk,
+                   "error": f"ValueError: blocks do not divide seq {args.seq}"}
+        elif r.error:
+            out = {"block_q": bq, "block_k": bk, "error": r.error}
+        else:
+            out = {
+                "seq_len": args.seq,
+                "block_q": bq,
+                "block_k": bk,
+                "fwd_ms": round(r.time_ms, 3),
+                "fwd_tflops": round(r.rate, 1),
+                "stable": r.stable,
+            }
+            if r.pruned:
+                out["pruned"] = True
+            g = bwd.get((bq, bk))
+            if g is not None and not g.error:
+                out["fwd_bwd_ms"] = round(g.time_ms, 3)
+                out["fwd_bwd_stable"] = g.stable
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -394,6 +394,44 @@ def smoke_entrypoints(wrappers: dict, harness: Harness) -> None:
         raise SystemExit(f"FAIL tpu-health-monitor: bad verdicts file {verdicts}")
     print("ok: tpu-health-monitor published node health over TLS + verdicts file")
 
+    # tpu-autotuner: oneshot pass over TLS — elected node with a valid
+    # cached entry reads as a cache hit (node get + results-ConfigMap
+    # get in-cluster, zero writes; the real sweep is bench's job)
+    import json as _json
+
+    node = harness.store.get("v1", "Node", "tpu-0")
+    node["metadata"]["labels"][consts.AUTOTUNE_ELECTED_LABEL] = consts.AUTOTUNE_ELECTED
+    harness.store.update(node)
+    entry = {
+        "generation": "v5e",
+        "libtpu_version": "smoke",
+        "platform": "tpu",
+        "results": {
+            fam: {"s256_h1_d64": {"winner": {"block_q": 128, "block_k": 128, "rate": 1.0},
+                                  "configs": []}}
+            for fam in ("flash_fwd", "flash_fwd_bwd", "matmul", "int8")
+        },
+    }
+    from tpu_operator.kube.objects import new_object
+
+    harness.store.create(new_object(
+        "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS,
+        data={"v5e.json": _json.dumps(entry)},
+    ))
+    proc = subprocess.run(
+        [sys.executable, "-m", check("tpu-autotuner"), "--oneshot"],
+        env=harness.env(LIBTPU_VERSION="smoke"),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=START_TIMEOUT,
+    )
+    if proc.returncode != 0 or '"cache-hit"' not in proc.stdout:
+        raise SystemExit(
+            f"FAIL tpu-autotuner: rc={proc.returncode}\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    print("ok: tpu-autotuner --oneshot read the sweep cache over TLS (cache hit)")
+
     # tpu-metrics-exporter: serves prometheus metrics
     port = free_port()
     proc = spawn(check("tpu-metrics-exporter"), ["--port", str(port)], harness.env())

@@ -1,0 +1,739 @@
+"""Per-generation kernel autotuning: harness, cache, agent, controller,
+floors folding, workload resolution, and the exporter floors hot-reload.
+
+All on CPU (JAX_PLATFORMS=cpu): the harness tests inject synthetic
+runners (controlled timings, no jax), one integration test runs the
+real cpu-smoke sweep through interpret-mode pallas, and the control-
+plane tests drive the FakeClient.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.autotune_agent import AutotuneAgent
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.controllers.autotune_controller import (
+    AutotuneReconciler,
+    libtpu_version_for,
+)
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.perf import FLOOR_FRACTION, default_floors, floors_for, floors_json
+from tpu_operator.workloads import autotune
+from tpu_operator.workloads.autotune import (
+    ConfigResult,
+    entry_key,
+    entry_valid,
+    merge_winner_floors,
+    parse_entry,
+    sweep,
+    tuned_flash_blocks,
+    tuned_matmul_unroll,
+    winners_blob,
+)
+
+NS = "tpu-operator"
+REQ = Request(name="cluster-policy")
+
+
+# ---------------------------------------------------------------------------
+# The generic harness.
+# ---------------------------------------------------------------------------
+
+
+class TestSweepHarness:
+    def _runner_factory(self, costs):
+        """make_runner over a {config-tuple: seconds} table; invalid
+        configs raise like a real kernel would."""
+        import time
+
+        def make_runner(config):
+            key = tuple(sorted(config.items()))
+            if costs[key] is None:
+                raise ValueError("invalid config")
+
+            def run(seed, n):
+                time.sleep(costs[key] * n)
+
+            return run
+
+        return make_runner
+
+    def test_winner_is_fastest_and_default_grid_measured(self):
+        costs = {
+            (("block", 1),): 0.004,
+            (("block", 2),): 0.001,
+            (("block", 3),): 0.002,
+        }
+        records, winner = sweep(
+            self._runner_factory(costs),
+            [{"block": 1}, {"block": 2}, {"block": 3}],
+            flops_per_iter=1e9, iters=2, reps=1, prune_ratio=100.0,
+        )
+        assert winner.config == {"block": 2}
+        assert len(records) == 3
+        assert all(not r.pruned and not r.error for r in records)
+        # rates order inversely to cost
+        by_block = {r.config["block"]: r.rate for r in records}
+        assert by_block[2] > by_block[3] > by_block[1]
+
+    def test_dominated_configs_pruned_but_recorded(self):
+        costs = {
+            (("block", 1),): 0.001,
+            (("block", 2),): 0.02,  # 20x slower: dominated
+        }
+        records, winner = sweep(
+            self._runner_factory(costs),
+            [{"block": 1}, {"block": 2}],
+            flops_per_iter=1e9, iters=2, reps=1, prune_ratio=1.35,
+        )
+        assert winner.config == {"block": 1}
+        pruned = [r for r in records if r.pruned]
+        assert [r.config for r in pruned] == [{"block": 2}]
+        # pruned keeps the probe-derived estimate, never wins, not stable
+        assert pruned[0].rate is not None and not pruned[0].stable
+
+    def test_invalid_config_recorded_not_fatal(self):
+        costs = {(("block", 1),): 0.001, (("block", 2),): None}
+        records, winner = sweep(
+            self._runner_factory(costs),
+            [{"block": 1}, {"block": 2}],
+            flops_per_iter=1e9, iters=2, reps=1,
+        )
+        assert winner.config == {"block": 1}
+        errored = [r for r in records if r.error]
+        assert len(errored) == 1 and "ValueError" in errored[0].error
+
+    def test_all_configs_invalid_yields_no_winner(self):
+        costs = {(("block", 1),): None}
+        records, winner = sweep(
+            self._runner_factory(costs), [{"block": 1}], 1e9, iters=1, reps=1
+        )
+        assert winner is None and records[0].error
+
+    def test_flash_grid_drops_non_dividing_blocks(self):
+        # grid enumeration: blocks not dividing the sequence never build
+        # a runner (the records they'd produce don't exist)
+        records, winner = autotune.sweep_flash(
+            seq_len=256, heads=1, head_dim=64,
+            configs=((128, 128), (96, 128), (128, 192)),
+            iters=1, reps=1,
+        )
+        assert [r.config for r in records] == [{"block_q": 128, "block_k": 128}]
+        assert winner is not None
+
+
+class TestRealSweepCpu:
+    def test_cpu_smoke_generation_sweep_is_complete(self):
+        entry = autotune.run_generation_sweep("v5e", "test-v")
+        assert entry["platform"] == "cpu"
+        assert entry_valid(entry, "test-v")
+        assert not entry_valid(entry, "other-v")  # toolchain bump invalidates
+        # the winners blob round-trips the winning configs only
+        blob = winners_blob({"v5e": entry})
+        flash = blob["v5e"]["flash_fwd"]["s256_h1_d64"]
+        assert set(flash) <= {"block_q", "block_k"}
+
+
+# ---------------------------------------------------------------------------
+# Cache keying.
+# ---------------------------------------------------------------------------
+
+
+def _entry(gen="v4", version="1.0.0", platform="tpu", matmul_rate=250.0,
+           families=autotune.KERNEL_FAMILIES):
+    flash = {"block_q": 512, "block_k": 1024, "rate": 90.0, "stable": True}
+    results = {}
+    for fam in families:
+        if fam in ("flash_fwd", "flash_fwd_bwd"):
+            results[fam] = {"s8192_h8_d128": {"winner": flash, "configs": [flash]}}
+        elif fam == "matmul":
+            results[fam] = {"m8192": {"winner": {"unroll": 16, "rate": matmul_rate,
+                                                 "stable": True}, "configs": []}}
+        else:
+            results[fam] = {"m8192": {"winner": {"unroll": 8, "rate": matmul_rate * 2,
+                                                 "stable": True}, "configs": []}}
+    return {"generation": gen, "libtpu_version": version, "platform": platform,
+            "results": results}
+
+
+class TestCacheKeying:
+    def test_complete_entry_valid(self):
+        assert entry_valid(_entry(), "1.0.0")
+
+    def test_libtpu_version_invalidates(self):
+        assert not entry_valid(_entry(version="1.0.0"), "1.1.0")
+
+    def test_missing_family_invalid(self):
+        entry = _entry()
+        del entry["results"]["int8"]
+        assert not entry_valid(entry, "1.0.0")
+
+    def test_winnerless_class_invalid(self):
+        entry = _entry()
+        entry["results"]["matmul"]["m8192"]["winner"] = None
+        assert not entry_valid(entry, "1.0.0")
+
+    def test_parse_entry_tolerates_garbage(self):
+        assert parse_entry(None) is None
+        assert parse_entry("") is None
+        assert parse_entry("{not json") is None
+        assert parse_entry('["list"]') is None
+        assert parse_entry('{"a": 1}') == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# Winners -> floors + winners blob.
+# ---------------------------------------------------------------------------
+
+
+class TestWinnerFolding:
+    def test_tpu_entry_replaces_matmul_floor_and_adds_int8(self):
+        floors = merge_winner_floors({"v4": _entry(matmul_rate=270.0)})
+        assert floors["v4"]["matmul_tflops"] == round(270.0 * FLOOR_FRACTION, 1)
+        assert floors["v4"]["int8_tops"] == round(540.0 * FLOOR_FRACTION, 1)
+        # un-swept generations keep the scaled defaults, triad untouched
+        assert floors["v5e"] == default_floors()["v5e"]
+        assert floors["v4"]["triad_gbps"] == default_floors()["v4"]["triad_gbps"]
+
+    def test_cpu_entry_never_folds_floors(self):
+        floors = merge_winner_floors({"v4": _entry(platform="cpu", matmul_rate=0.01)})
+        assert floors["v4"] == default_floors()["v4"]
+
+    def test_winners_blob_strips_measurement_detail(self):
+        blob = winners_blob({"v4": _entry()})
+        assert blob["v4"]["flash_fwd"]["s8192_h8_d128"] == {
+            "block_q": 512, "block_k": 1024,
+        }
+        assert blob["v4"]["matmul"]["m8192"] == {"unroll": 16}
+
+
+# ---------------------------------------------------------------------------
+# Workload config resolution.
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    @pytest.fixture(autouse=True)
+    def _gen(self, monkeypatch):
+        monkeypatch.setenv("TPU_GENERATION", "v4")
+        monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+
+    def _publish(self, monkeypatch, blob):
+        monkeypatch.setenv(autotune.AUTOTUNE_ENV, json.dumps(blob))
+
+    def test_exact_class_resolves(self, monkeypatch):
+        self._publish(monkeypatch, winners_blob({"v4": _entry()}))
+        assert tuned_flash_blocks(8192) == (512, 1024)
+        assert tuned_matmul_unroll(8192) == 16
+        assert tuned_matmul_unroll(8192, int8=True) == 8
+
+    def test_nearest_class_resolves(self, monkeypatch):
+        # a 4k caller rides the 8k winner (nearest swept class)
+        self._publish(monkeypatch, winners_blob({"v4": _entry()}))
+        assert tuned_flash_blocks(4096) == (512, 1024)
+        assert tuned_matmul_unroll(2048) == 16
+
+    def test_unswept_generation_falls_back(self, monkeypatch):
+        self._publish(monkeypatch, winners_blob({"v5e": _entry(gen="v5e")}))
+        assert tuned_flash_blocks(8192) == (1024, 1024)
+        assert tuned_matmul_unroll(8192) == 8
+
+    def test_no_env_falls_back(self, monkeypatch):
+        monkeypatch.delenv(autotune.AUTOTUNE_ENV, raising=False)
+        assert tuned_flash_blocks(8192) == (1024, 1024)
+        assert tuned_flash_blocks(512, default=(256, 256)) == (256, 256)
+
+    def test_malformed_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv(autotune.AUTOTUNE_ENV, "{broken")
+        assert tuned_flash_blocks(8192) == (1024, 1024)
+        monkeypatch.setenv(autotune.AUTOTUNE_ENV, json.dumps(
+            {"v4": {"flash_fwd": {"s8192_h8_d128": {"block_q": "x", "block_k": 5}}}}
+        ))
+        assert tuned_flash_blocks(8192) == (1024, 1024)
+
+    def test_flash_attention_consumes_winner(self, monkeypatch):
+        """The kernel entry point actually runs the published blocks:
+        pin via a winner whose blocks divide the test sequence and
+        check numerics still hold (the resolution path is the same the
+        burn-in/validator callers take)."""
+        import jax.numpy as jnp
+        import jax
+
+        from tpu_operator.workloads.flashattention import flash_attention
+        from tpu_operator.workloads.ringattention import dense_attention
+
+        blob = {"v4": {"flash_fwd": {"s256_h2_d64": {"block_q": 64, "block_k": 128}}}}
+        self._publish(monkeypatch, blob)
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(key, (1, 256, 2, 64), dtype=jnp.bfloat16)
+                   for key in keys)
+        got = flash_attention(q, k, v, causal=True)  # blocks resolved
+        want = dense_attention(q, k, v, causal=True)
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
+        assert err < 2e-2
+
+
+# ---------------------------------------------------------------------------
+# The agent.
+# ---------------------------------------------------------------------------
+
+
+class CountingClient:
+    WRITE_VERBS = ("create", "patch", "patch_status", "update", "update_status",
+                   "delete", "apply", "apply_set")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.writes = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self.WRITE_VERBS and callable(attr):
+            def counted(*a, **kw):
+                self.writes += 1
+                return attr(*a, **kw)
+
+            return counted
+        return attr
+
+
+def _tpu_node(name, accelerator="tpu-v4-podslice", topology="2x2x1", elected=False,
+              extra=None):
+    node = make_tpu_node(name, accelerator, topology)
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    if elected:
+        node["metadata"]["labels"][consts.AUTOTUNE_ELECTED_LABEL] = consts.AUTOTUNE_ELECTED
+    node["metadata"]["labels"].update(extra or {})
+    return node
+
+
+def _fake_sweep(calls=None):
+    def sweep_fn(gen, version):
+        if calls is not None:
+            calls.append(gen)
+        return _entry(gen=gen, version=version)
+
+    return sweep_fn
+
+
+class TestAutotuneAgent:
+    @pytest.fixture(autouse=True)
+    def _pin_version(self, monkeypatch):
+        monkeypatch.setenv("LIBTPU_VERSION", "1.0.0")
+
+    def test_not_elected_is_noop(self):
+        store = FakeClient()
+        store.create(_tpu_node("n-0"))
+        client = CountingClient(store)
+        agent = AutotuneAgent(client, "n-0", NS, sweep_fn=_fake_sweep())
+        assert agent.reconcile_once() == "not-elected"
+        assert client.writes == 0
+
+    def test_elected_sweeps_and_publishes(self):
+        store = FakeClient()
+        store.create(_tpu_node("n-0", elected=True))
+        calls = []
+        agent = AutotuneAgent(store, "n-0", NS, sweep_fn=_fake_sweep(calls))
+        assert agent.reconcile_once() == "swept"
+        assert calls == ["v4"]
+        cm = store.get("v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS)
+        entry = json.loads(cm["data"][entry_key("v4")])
+        assert entry["libtpu_version"] == "1.0.0"
+        assert entry["swept_by"] == "n-0"
+
+    def test_cache_hit_issues_zero_writes(self):
+        store = FakeClient()
+        store.create(_tpu_node("n-0", elected=True))
+        store.create(new_object(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS,
+            data={entry_key("v4"): json.dumps(_entry())},
+        ))
+        client = CountingClient(store)
+        calls = []
+        agent = AutotuneAgent(client, "n-0", NS, sweep_fn=_fake_sweep(calls))
+        assert agent.reconcile_once() == "cache-hit"
+        assert calls == [] and client.writes == 0
+
+    def test_libtpu_bump_re_sweeps(self, monkeypatch):
+        store = FakeClient()
+        store.create(_tpu_node("n-0", elected=True))
+        store.create(new_object(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS,
+            data={entry_key("v4"): json.dumps(_entry(version="0.9.0"))},
+        ))
+        calls = []
+        agent = AutotuneAgent(store, "n-0", NS, sweep_fn=_fake_sweep(calls))
+        assert agent.reconcile_once() == "swept"
+        assert calls == ["v4"]
+        cm = store.get("v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS)
+        assert json.loads(cm["data"][entry_key("v4")])["libtpu_version"] == "1.0.0"
+
+    def test_unrecognizable_generation_never_sweeps(self):
+        store = FakeClient()
+        node = new_object("v1", "Node", "bare-0", labels={
+            consts.AUTOTUNE_ELECTED_LABEL: consts.AUTOTUNE_ELECTED,
+        })
+        store.create(node)
+        agent = AutotuneAgent(store, "bare-0", NS, sweep_fn=_fake_sweep())
+        assert agent.reconcile_once() == "no-generation"
+
+
+# ---------------------------------------------------------------------------
+# The controller.
+# ---------------------------------------------------------------------------
+
+
+def _cluster(nodes, entries=None, floors_cm=True, spec=None):
+    store = FakeClient()
+    for node in nodes:
+        store.create(node)
+    store.create(new_cluster_policy(spec=spec))
+    if entries is not None:
+        store.create(new_object(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS,
+            data={entry_key(g): json.dumps(e) for g, e in entries.items()},
+        ))
+    if floors_cm:
+        store.create(new_object(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS,
+            data={consts.PERF_FLOORS_KEY: floors_json()},
+        ))
+    return store
+
+
+def _elected(store):
+    return sorted(
+        n["metadata"]["name"] for n in store.list("v1", "Node")
+        if (n["metadata"].get("labels") or {}).get(consts.AUTOTUNE_ELECTED_LABEL)
+        == consts.AUTOTUNE_ELECTED
+    )
+
+
+class TestAutotuneController:
+    def test_elects_one_node_per_unswept_generation(self):
+        store = _cluster([
+            _tpu_node("v4-b"), _tpu_node("v4-a"),
+            _tpu_node("v5e-0", "tpu-v5-lite-podslice", "2x4"),
+        ])
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v4-a", "v5e-0"]
+
+    def test_out_of_service_nodes_never_elected(self):
+        store = _cluster([
+            _tpu_node("v4-a", extra={consts.TPU_PERF_LABEL: consts.PERF_DEGRADED}),
+            _tpu_node("v4-b"),
+        ])
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v4-b"]
+
+    def test_election_sticky_while_pending(self):
+        # an election already held is kept even when a lexicographically
+        # earlier node joins: re-electing mid-sweep would waste the run
+        store = _cluster([_tpu_node("v4-z", elected=True), _tpu_node("v4-a")])
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v4-z"]
+
+    def test_dead_elected_node_re_elected(self):
+        store = _cluster([
+            _tpu_node("v4-z", elected=True,
+                      extra={consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}),
+            _tpu_node("v4-a"),
+        ])
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v4-a"]
+
+    def test_swept_generation_clears_and_never_re_elects(self):
+        store = _cluster(
+            [_tpu_node("v4-a", elected=True), _tpu_node("v4-b")],
+            entries={"v4": _entry()},
+        )
+        client = CountingClient(store)
+        rec = AutotuneReconciler(client, NS)
+        rec.reconcile(REQ)
+        assert _elected(store) == []
+        # a joiner sorting first still isn't elected, and the settled
+        # pass issues zero writes
+        store.create(_tpu_node("a-joiner"))
+        client.writes = 0
+        rec.reconcile(REQ)
+        assert _elected(store) == [] and client.writes == 0
+
+    def test_fold_tightens_floors_and_publishes_winners(self):
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry(matmul_rate=270.0)})
+        rec = AutotuneReconciler(store, NS)
+        rec.reconcile(REQ)
+        floors = json.loads(store.get(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS
+        )["data"][consts.PERF_FLOORS_KEY])
+        assert floors["v4"]["matmul_tflops"] == round(270.0 * FLOOR_FRACTION, 1)
+        winners = json.loads(store.get(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS
+        )["data"][consts.AUTOTUNE_WINNERS_KEY])
+        assert winners["v4"]["flash_fwd"]["s8192_h8_d128"]["block_q"] == 512
+        # per-generation data keys stay parseable beside floors.json
+        per_gen = json.loads(store.get(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS
+        )["data"]["v4"])
+        assert per_gen == floors["v4"]
+
+    def test_version_bump_reverts_floors_and_re_elects(self):
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry(version="0.9.0")})
+        rec = AutotuneReconciler(store, NS)
+        rec.reconcile(REQ)
+        # stale-toolchain entry: conservative defaults until re-swept
+        floors = json.loads(store.get(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS
+        )["data"][consts.PERF_FLOORS_KEY])
+        assert floors["v4"] == default_floors()["v4"]
+        assert _elected(store) == ["v4-a"]
+
+    def test_settled_fold_issues_zero_writes(self):
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry()})
+        client = CountingClient(store)
+        rec = AutotuneReconciler(client, NS)
+        rec.reconcile(REQ)
+        client.writes = 0
+        rec.reconcile(REQ)
+        assert client.writes == 0
+
+    def test_missing_floors_cm_is_tolerated(self):
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry()}, floors_cm=False)
+        AutotuneReconciler(store, NS).reconcile(REQ)  # no raise, no create
+        assert store.get_or_none("v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS) is None
+
+    def test_disabled_spec_clears_elections(self):
+        store = _cluster(
+            [_tpu_node("v4-a", elected=True)],
+            spec={"autotuner": {"enabled": False}},
+        )
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == []
+
+    def test_disabled_spec_retires_metrics(self):
+        # run enabled first (roof series live, pending counted), then
+        # disable: frozen gauges would alert on a sweep that will never
+        # happen, and the roof series would export yesterday's number
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry(matmul_rate=270.0)})
+        rec = AutotuneReconciler(store, NS)
+        rec.reconcile(REQ)
+        assert ("v4",) in rec.metrics.autotune_matmul_roof._metrics
+        cp = store.get("tpu.google.com/v1", "ClusterPolicy", "cluster-policy")
+        cp["spec"] = {"autotuner": {"enabled": False}}
+        store.update(cp)
+        rec.reconcile(REQ)
+        assert rec._roof_series == set()
+        assert ("v4",) not in rec.metrics.autotune_matmul_roof._metrics
+
+    def test_orphan_election_cleared_when_node_leaves_generation(self):
+        # an elected node that LOSES its accelerator identity mid-sweep
+        # (TFD misreport, de-TPU) drops out of the generation grouping —
+        # the orphan sweep must still clear its label (and with it the
+        # chip-claiming pod), not hold it forever
+        broken = _tpu_node("v4-z", elected=True)
+        store = _cluster([broken, _tpu_node("v4-a")])
+        node = store.get("v1", "Node", "v4-z")
+        for key in (consts.GKE_TPU_ACCELERATOR_LABEL, consts.GKE_TPU_TOPOLOGY_LABEL):
+            node["metadata"]["labels"].pop(key, None)
+        store.update(node)
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        labels = store.get("v1", "Node", "v4-z")["metadata"].get("labels") or {}
+        assert consts.AUTOTUNE_ELECTED_LABEL not in labels
+        assert _elected(store) == ["v4-a"]
+
+    def test_election_requires_schedulable_chip_claim(self):
+        # the sweep pod claims spec.autotuner.chips google.com/tpu: a
+        # node with fewer chips could never schedule it (Pending
+        # forever), so it is never elected; exact-match hosts win over
+        # surplus hosts (exclusive ownership beats co-tenancy)
+        small = _tpu_node("v5e-small", "tpu-v5-lite-podslice", "2x2")  # 4 chips
+        big = _tpu_node("v5e-big", "tpu-v5-lite-device", "4x8")  # 8/host
+        store = _cluster([small, big], spec={"autotuner": {"chips": 8}})
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v5e-big"]
+
+    def test_no_schedulable_node_elects_nobody(self):
+        store = _cluster([_tpu_node("v4-a")], spec={"autotuner": {"chips": 16}})
+        AutotuneReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == []
+
+    def test_roof_series_retire_with_their_entry(self):
+        store = _cluster([_tpu_node("v4-a")], entries={"v4": _entry(matmul_rate=270.0)})
+        rec = AutotuneReconciler(store, NS)
+        rec.reconcile(REQ)
+        assert rec._roof_series == {"v4"}
+        gauge = rec.metrics.autotune_matmul_roof
+        assert ("v4",) in gauge._metrics
+        # toolchain bump invalidates the entry -> the series goes too
+        cm = store.get("v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS)
+        cm["data"][entry_key("v4")] = json.dumps(_entry(version="0.9.0"))
+        store.update(cm)
+        rec.reconcile(REQ)
+        assert rec._roof_series == set()
+        assert ("v4",) not in gauge._metrics
+
+    def test_libtpu_version_tracks_image_tag(self):
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy(spec={
+            "libtpu": {"repository": "gcr.io/x", "image": "libtpu", "version": "2.3.4"},
+        }))
+        assert libtpu_version_for(cp) == "2.3.4"
+
+
+# ---------------------------------------------------------------------------
+# Exporter floors hot-reload (satellite) + perf.py hardening (satellite).
+# ---------------------------------------------------------------------------
+
+
+class TestExporterFloorsHotReload:
+    def _exporter(self, store, floors):
+        import prometheus_client
+
+        from tpu_operator.agents.metrics_exporter_agent import MetricsExporterAgent
+
+        return MetricsExporterAgent(
+            node_name="n-0", client=store, namespace=NS, generation="v4",
+            floors=floors, breach_samples=1,
+            registry=prometheus_client.CollectorRegistry(),
+        )
+
+    def test_updated_floor_changes_next_observe(self):
+        """The satellite's regression: a tightened floor published to
+        the ConfigMap changes the VERY NEXT observe_probe comparison —
+        no DaemonSet restart."""
+        store = FakeClient()
+        store.create(_tpu_node("n-0"))
+        stale = dict(floors_for("v4"))
+        store.create(new_object(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS,
+            data={consts.PERF_FLOORS_KEY: floors_json()},
+        ))
+        exporter = self._exporter(store, stale)
+        # a sample above the stale floor: no breach
+        probe = stale["matmul_tflops"] + 2.0
+        assert exporter.observe_probe("matmul_tflops", probe) is False
+        # the operator tightens the floor ABOVE that sample
+        tightened = dict(stale, matmul_tflops=probe + 1.0)
+        cm = store.get("v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS)
+        cm["data"][consts.PERF_FLOORS_KEY] = json.dumps({"v4": tightened})
+        store.update(cm)
+        assert exporter.refresh_floors() is True
+        assert exporter.floors["matmul_tflops"] == tightened["matmul_tflops"]
+        assert exporter.observe_probe("matmul_tflops", probe) is True
+
+    def test_refresh_tolerates_missing_cm_and_no_client(self):
+        store = FakeClient()
+        exporter = self._exporter(store, {"matmul_tflops": 100.0})
+        assert exporter.refresh_floors() is False  # CM absent: keep floors
+        assert exporter.floors == {"matmul_tflops": 100.0}
+        exporter.client = None
+        assert exporter.refresh_floors() is False
+        exporter.client = store
+        exporter.generation = ""
+        assert exporter.refresh_floors() is False
+
+    def test_refresh_noop_when_unchanged(self):
+        store = FakeClient()
+        store.create(new_object(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, NS,
+            data={consts.PERF_FLOORS_KEY: floors_json()},
+        ))
+        exporter = self._exporter(store, dict(floors_for("v4")))
+        assert exporter.refresh_floors() is False
+
+
+class TestPerfFloorsHardening:
+    """Satellite: floors_for must degrade to the static table (or {})
+    on any malformed input — the exporter must never crash on a
+    half-written ConfigMap."""
+
+    def test_unknown_generation_returns_empty_not_raise(self):
+        assert floors_for("v99") == {}
+        assert floors_for("v99", floors_json()) == {}
+        assert floors_for("", None) == {}
+
+    def test_malformed_blob_degrades_to_static_table(self):
+        for blob in ("{truncated", '"a string"', "[1,2]", "null", ""):
+            assert floors_for("v4", blob) == default_floors()["v4"], blob
+
+    def test_half_written_entry_degrades(self):
+        # generation key present but not a dict -> {} (detection off)
+        assert floors_for("v4", json.dumps({"v4": 17})) == {}
+        # non-numeric probe values are skipped, numeric ones survive
+        got = floors_for("v4", json.dumps({"v4": {"matmul_tflops": "x", "triad_gbps": 5}}))
+        assert got == {"triad_gbps": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# Rendering / wiring.
+# ---------------------------------------------------------------------------
+
+
+class TestAutotunerState:
+    def _render(self, spec=None):
+        from tpu_operator.catalog import InfoCatalog
+        from tpu_operator.states import new_cluster_policy_states
+
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy(spec=spec))
+        catalog = InfoCatalog(cluster_policy=cp)
+        state = {s.name: s for s in new_cluster_policy_states()}["state-autotuner"]
+        return state.renderer.render_objects(state.get_render_data(catalog))
+
+    def test_daemonset_gates_on_election_label(self):
+        ds = [o for o in self._render() if o["kind"] == "DaemonSet"][0]
+        selector = ds["spec"]["template"]["spec"]["nodeSelector"]
+        assert selector[consts.AUTOTUNE_ELECTED_LABEL] == consts.AUTOTUNE_ELECTED
+        assert selector["tpu.google.com/tpu.deploy.autotuner"] == "true"
+
+    def test_daemonset_claims_chips_not_privilege(self):
+        ds = [o for o in self._render() if o["kind"] == "DaemonSet"][0]
+        ctr = ds["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["resources"]["limits"][consts.TPU_RESOURCE_NAME] == "4"
+        assert "securityContext" not in ctr
+        assert "volumes" not in ds["spec"]["template"]["spec"]
+
+    def test_libtpu_version_env_pins_image_tag(self):
+        ds = [o for o in self._render(spec={
+            "libtpu": {"repository": "gcr.io/x", "image": "libtpu", "version": "9.9.9"},
+        }) if o["kind"] == "DaemonSet"][0]
+        env = {e["name"]: e.get("value") for e in
+               ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["LIBTPU_VERSION"] == "9.9.9"
+
+    def test_chips_knob(self):
+        ds = [o for o in self._render(spec={"autotuner": {"chips": 8}})
+              if o["kind"] == "DaemonSet"][0]
+        ctr = ds["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["resources"]["limits"][consts.TPU_RESOURCE_NAME] == "8"
+
+    def test_winners_env_reaches_consumers(self):
+        """The winners blob is wired as optional TPU_AUTOTUNE_JSON into
+        the validator + exporter DaemonSets and the gang worker pods."""
+        import os
+
+        import yaml
+
+        from tpu_operator.catalog import InfoCatalog
+        from tpu_operator.states import new_cluster_policy_states
+
+        cp = ClusterPolicy.from_unstructured(new_cluster_policy())
+        catalog = InfoCatalog(cluster_policy=cp)
+        states = {s.name: s for s in new_cluster_policy_states()}
+        for name in ("state-operator-validation", "state-metrics-exporter"):
+            state = states[name]
+            rendered = yaml.safe_dump_all(
+                state.renderer.render_objects(state.get_render_data(catalog))
+            )
+            assert "TPU_AUTOTUNE_JSON" in rendered, name
+            assert consts.AUTOTUNE_RESULTS_CONFIGMAP in rendered, name
+        gang_tpl = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tpu_operator", "manifests", "slice-gang", "0100_worker_pod.yaml",
+        )
+        with open(gang_tpl) as f:
+            assert "TPU_AUTOTUNE_JSON" in f.read()

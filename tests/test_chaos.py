@@ -542,7 +542,7 @@ def _assert_no_orphans(store, cp_uid):
     beside the originals) fails here."""
     dses = store.list("apps/v1", "DaemonSet", NS)
     names = [ds["metadata"]["name"] for ds in dses]
-    assert len(names) == len(set(names)) == 9, names
+    assert len(names) == len(set(names)) == 10, names
     for ds in dses:
         refs = ds["metadata"].get("ownerReferences") or []
         assert any(r.get("uid") == cp_uid for r in refs), (
@@ -588,8 +588,11 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
             if (cp or {}).get("status", {}).get("state") != "ready":
                 return False
             dses = store.list("apps/v1", "DaemonSet", NS)
-            return len(dses) == 9 and all(
-                ds.get("status", {}).get("numberAvailable") == nodes for ds in dses
+            # election-gated autotuner: desired/available 0 (no elections)
+            return len(dses) == 10 and all(
+                ds.get("status", {}).get("numberAvailable")
+                == (0 if ds["metadata"]["name"] == "tpu-autotuner" else nodes)
+                for ds in dses
             )
 
         obs["became_ready"] = wait_for(ready, timeout=ready_timeout, interval=0.1)
@@ -790,8 +793,10 @@ class TestCrashRestartDrill:
                 if (cp or {}).get("status", {}).get("state") != "ready":
                     return False
                 dses = store.list("apps/v1", "DaemonSet", NS)
-                return len(dses) == 9 and all(
-                    ds.get("status", {}).get("numberAvailable") == 8 for ds in dses
+                return len(dses) == 10 and all(
+                    ds.get("status", {}).get("numberAvailable")
+                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else 8)
+                    for ds in dses
                 )
 
             assert wait_for(ready, timeout=60.0), "restarted operator never converged"

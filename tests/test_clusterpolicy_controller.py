@@ -144,16 +144,22 @@ class TestEndToEnd:
                 if get_cp(client).get("status", {}).get("state") != "ready":
                     return False
                 dses = client.list("apps/v1", "DaemonSet", NS)
-                return len(dses) == 9 and all(
-                    ds.get("status", {}).get("desiredNumberScheduled") == 4
-                    and ds["status"].get("numberAvailable") == 4
+                # the autotuner schedules only onto controller-elected
+                # nodes — none here, so its desired count is 0
+                return len(dses) == 10 and all(
+                    ds.get("status", {}).get("desiredNumberScheduled")
+                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else 4)
                     for ds in dses
+                ) and all(
+                    ds["status"].get("numberAvailable") == 4
+                    for ds in dses
+                    if ds["metadata"]["name"] != "tpu-autotuner"
                 )
 
             assert wait_for(settled, timeout=15), get_cp(client).get("status")
             # sim created operand pods on every node
             pods = client.list("v1", "Pod", NS)
-            assert len(pods) == 36  # 9 DaemonSets x 4 nodes
+            assert len(pods) == 36  # 9 per-node DaemonSets x 4 nodes
         finally:
             mgr.stop()
             sim.stop()
@@ -179,7 +185,7 @@ class TestEndToEnd:
                 == "true",
                 timeout=10,
             )
-            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 9, timeout=10)
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 10, timeout=10)
         finally:
             mgr.stop()
             sim.stop()

@@ -218,6 +218,42 @@ def exercise_health_monitor(store, client, tmp_path, monkeypatch):
     assert run_health_agent(client, tmp_path, monkeypatch)
 
 
+def exercise_autotuner(store, client, tmp_path, monkeypatch):
+    """The agent's full apiserver surface: node get (election check),
+    results-ConfigMap get + patch (an existing CM from another
+    generation's sweep) — and a second pass proving the cache-hit read
+    path under the same rules."""
+    import json
+
+    from tpu_operator import consts
+    from tpu_operator.agents.autotune_agent import AutotuneAgent
+    from tpu_operator.kube.objects import new_object
+
+    monkeypatch.setenv("LIBTPU_VERSION", "1.0.0")
+    node = make_tpu_node("tpu-0", "tpu-v4-podslice", "2x2x1")
+    node["metadata"]["labels"][consts.AUTOTUNE_ELECTED_LABEL] = consts.AUTOTUNE_ELECTED
+    store.create(node)
+    store.create(new_object(
+        "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS,
+        data={"v5e.json": "{}"},
+    ))
+    flash = {"block_q": 512, "block_k": 1024, "rate": 90.0, "stable": True}
+    entry = {
+        "generation": "v4", "libtpu_version": "1.0.0", "platform": "tpu",
+        "results": {
+            fam: {"s8192_h8_d128": {"winner": flash, "configs": [flash]}}
+            for fam in ("flash_fwd", "flash_fwd_bwd", "matmul", "int8")
+        },
+    }
+    agent = AutotuneAgent(client, "tpu-0", NS, sweep_fn=lambda g, v: dict(entry))
+    assert agent.reconcile_once() == "swept"
+    assert json.loads(
+        store.get("v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, NS)
+        ["data"]["v4.json"]
+    )["generation"] == "v4"
+    assert agent.reconcile_once() == "cache-hit"
+
+
 AGENT_EXERCISES = {
     "state-tpu-feature-discovery": exercise_tfd,
     "state-node-discovery": exercise_node_discovery,
@@ -226,6 +262,7 @@ AGENT_EXERCISES = {
     "state-operator-validation": exercise_validator_plugin,
     "state-node-status-exporter": exercise_node_status_exporter,
     "state-health-monitor": exercise_health_monitor,
+    "state-autotuner": exercise_autotuner,
 }
 
 

@@ -86,8 +86,12 @@ class _Wired:
         if (cp or {}).get("status", {}).get("state") != "ready":
             return False
         dses = self.store.list("apps/v1", "DaemonSet", NS)
-        return len(dses) == 9 and all(
-            ds.get("status", {}).get("numberAvailable") == self.nodes for ds in dses
+        # the autotuner DS schedules only onto controller-elected
+        # nodes: none in these runs, so it is desired/available 0
+        return len(dses) == 10 and all(
+            ds.get("status", {}).get("numberAvailable")
+            == (0 if ds["metadata"]["name"] == "tpu-autotuner" else self.nodes)
+            for ds in dses
         )
 
     def reconciles(self) -> float:

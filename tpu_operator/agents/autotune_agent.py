@@ -1,0 +1,193 @@
+"""tpu-autotuner agent: the elected-node half of the autotune loop.
+
+The autotune controller elects ONE in-service node per un-swept TPU
+generation by stamping ``consts.AUTOTUNE_ELECTED_LABEL`` — and the
+autotuner DaemonSet's nodeSelector includes that label, so this agent
+only ever runs on an elected node, holding the node's chips through the
+``google.com/tpu`` extended resource for exactly the sweep window (no
+privileged container, no hostPath: the device plugin injects the
+devices, and resource ownership guarantees no co-tenant skews the
+measurements).
+
+The loop per tick:
+
+  1. read the own Node (election label + generation labels);
+  2. read the ``tpu-autotune-results`` ConfigMap: a valid cached entry
+     for (generation, libtpu version) — every kernel family swept with
+     a winner — is a CACHE HIT: zero writes, nothing re-runs (the
+     sweep-once fleet-wide contract; a rebooted elected node lands
+     here);
+  3. otherwise run the generation sweep
+     (``workloads.autotune.run_generation_sweep``: flash fwd / fwd+bwd
+     block grid, matmul + int8 chain tilings, dominated configs pruned)
+     and publish the entry as the ``<generation>.json`` data key (a
+     key-scoped merge patch; the ConfigMap is created on first use).
+
+The controller notices the published entry, clears the election label
+(which descheduled this pod), folds the winners into the perf-floors
+pipeline, and publishes the winning configs for workloads.
+
+Off-TPU the sweep still runs (interpret-mode pallas) and publishes
+CONFIG winners, but the entry records its platform — the controller
+never folds non-TPU rates into the floors.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.objects import new_object
+from tpu_operator.nodeinfo import tpu_info
+from tpu_operator.workloads.autotune import (
+    entry_key,
+    entry_valid,
+    parse_entry,
+    run_generation_sweep,
+    runtime_fingerprint,
+)
+
+log = logging.getLogger(__name__)
+
+
+class AutotuneAgent:
+    def __init__(
+        self,
+        client: Client,
+        node_name: str,
+        namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE,
+        interval: float = 60.0,
+        sweep_fn: Optional[Callable[[str, str], dict]] = None,
+        profile: Optional[str] = None,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.namespace = namespace
+        self.interval = interval
+        # injectable for tests/smokes; the default is the real sweep
+        self.sweep_fn = sweep_fn or (
+            lambda gen, version: run_generation_sweep(gen, version, profile=profile)
+        )
+        self._stop = False
+
+    # -- one pass -------------------------------------------------------------
+
+    def reconcile_once(self) -> str:
+        """Returns the pass outcome (tests and logs read it):
+        ``not-elected`` | ``no-generation`` | ``cache-hit`` | ``swept``."""
+        node = self.client.get_or_none("v1", "Node", self.node_name)
+        if node is None:
+            return "not-elected"
+        labels = node["metadata"].get("labels") or {}
+        if labels.get(consts.AUTOTUNE_ELECTED_LABEL) != consts.AUTOTUNE_ELECTED:
+            # the DaemonSet nodeSelector should make this unreachable,
+            # but a just-cleared label can race the pod teardown
+            return "not-elected"
+        info = tpu_info(node)
+        generation = info.generation if info else ""
+        if not generation or generation == "unknown":
+            log.warning("autotune: node %s has no recognizable TPU generation", self.node_name)
+            return "no-generation"
+        version = runtime_fingerprint()
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, self.namespace
+        )
+        entry = parse_entry(((cm or {}).get("data") or {}).get(entry_key(generation)))
+        if entry_valid(entry, version):
+            # sweep-once: the generation is already measured for this
+            # toolchain — a rebooted elected node issues ZERO writes
+            return "cache-hit"
+        log.info(
+            "autotune: sweeping generation %s on %s (libtpu %s)",
+            generation, self.node_name, version,
+        )
+        started = time.monotonic()
+        entry = self.sweep_fn(generation, version)
+        entry["swept_by"] = self.node_name
+        entry["sweep_seconds"] = round(time.monotonic() - started, 2)
+        self._publish(generation, entry, cm_exists=cm is not None)
+        return "swept"
+
+    def _publish(self, generation: str, entry: dict, cm_exists: bool) -> None:
+        """Key-scoped merge patch of this generation's entry; the
+        ConfigMap is created on first use (concurrent creators converge
+        through AlreadyExists -> patch)."""
+        body = {"data": {entry_key(generation): json.dumps(entry, sort_keys=True)}}
+        if not cm_exists:
+            cm = new_object(
+                "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP,
+                self.namespace, labels={"app": "tpu-autotuner"},
+                data=body["data"],
+            )
+            try:
+                self.client.create(cm)
+                return
+            except errors.AlreadyExists:
+                pass  # another generation's agent won the race
+        self.client.patch(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, body,
+            self.namespace,
+        )
+
+    # -- loop -----------------------------------------------------------------
+
+    def run_forever(self) -> None:
+        while not self._stop:
+            try:
+                outcome = self.reconcile_once()
+                log.info("autotune: pass outcome %s", outcome)
+            except errors.ApiError as e:
+                log.warning("autotune: pass failed: %s", e)
+            except Exception:  # noqa: BLE001 — a sweep crash must not kill the pod
+                log.exception("autotune: sweep failed")
+            time.sleep(self.interval)
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)).strip())
+    except ValueError:
+        log.warning("invalid %s %r; using %s", name, os.environ.get(name), default)
+        return default
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-autotuner")
+    p.add_argument(
+        "--oneshot", action="store_true",
+        help="run one reconcile pass and exit (image smoke / debugging)",
+    )
+    args = p.parse_args()
+    from tpu_operator.kube.http_client import HttpClient
+
+    client = HttpClient.in_cluster()
+    agent = AutotuneAgent(
+        client,
+        node_name=os.environ.get("NODE_NAME", ""),
+        namespace=os.environ.get(
+            consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE
+        ),
+        interval=_float_env("AUTOTUNE_INTERVAL", 60.0),
+        profile=os.environ.get("AUTOTUNE_PROFILE") or None,
+    )
+    if args.oneshot:
+        print(json.dumps({"outcome": agent.reconcile_once()}))
+        return 0
+    agent.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -89,6 +89,8 @@ class MetricsExporterAgent:
         client=None,
         floors: Optional[Dict[str, float]] = None,
         breach_samples: int = consts.PERF_BREACH_SAMPLES,
+        namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE,
+        generation: str = "",
     ):
         if active_probes not in ("auto", "on", "off"):
             raise ValueError(f"active_probes must be auto/on/off, got {active_probes!r}")
@@ -103,8 +105,16 @@ class MetricsExporterAgent:
         # flip but the cluster-side signal stays unpublished
         self.client = client
         # {probe: floor} for THIS node's generation (resolved by the
-        # caller / main() from PERF_FLOORS_JSON); empty = detection off
+        # caller / main() from PERF_FLOORS_JSON); empty = detection off.
+        # refresh_floors() re-reads the floors ConfigMap each probe
+        # cycle, so a floor the operator tightens (e.g. the autotune
+        # loop folding measured roofs) applies to the very next
+        # comparison instead of waiting for a DaemonSet restart.
         self.floors = dict(floors or {})
+        self.namespace = namespace
+        # the generation the floors are keyed by (resolved by main()
+        # from the runtime); empty disables hot-reload
+        self.generation = generation
         self.breach_samples = max(1, breach_samples)
         self._probe_history: Dict[str, collections.deque] = {}
         self._breach_counts: Dict[str, int] = {}
@@ -257,6 +267,38 @@ class MetricsExporterAgent:
                 pass
 
     # -- grey-failure detection ----------------------------------------------
+
+    def refresh_floors(self) -> bool:
+        """Hot-reload the floor table from the live perf-floors
+        ConfigMap (the configMapKeyRef env is frozen at pod start —
+        before this, a floor the operator tightened waited for a
+        DaemonSet restart to bite). Reads through the agent's apiserver
+        client; any failure keeps the current floors (stale-but-sane
+        beats detection flapping on apiserver blips). Returns True when
+        the table changed."""
+        if self.client is None or not self.generation:
+            return False
+        from tpu_operator.perf import floors_for
+
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError as e:
+            log.debug("metrics: floors ConfigMap read failed: %s", e)
+            return False
+        if cm is None:
+            return False
+        blob = (cm.get("data") or {}).get(consts.PERF_FLOORS_KEY, "")
+        fresh = floors_for(self.generation, blob)
+        if not fresh or fresh == self.floors:
+            return False
+        log.info(
+            "metrics: perf floors updated for %s: %s -> %s",
+            self.generation, self.floors, fresh,
+        )
+        self.floors = fresh
+        return True
 
     def observe_probe(self, probe: str, value: float) -> bool:
         """Feed one measured probe sample through the floor comparison:
@@ -427,6 +469,7 @@ class MetricsExporterAgent:
                 self.active_probes != "off"
                 and now - last_probe >= self.bandwidth_probe_interval
             ):
+                self.refresh_floors()
                 self.probe_bandwidth()
                 self.probe_utilization()
                 self.probe_ici()
@@ -491,6 +534,13 @@ def main() -> int:
             os.environ.get("TPU_EXPORTER_BREACH_SAMPLES"), consts.PERF_BREACH_SAMPLES,
         )
         breach_samples = consts.PERF_BREACH_SAMPLES
+    generation = ""
+    try:
+        from tpu_operator.workloads.matmul_bench import chip_generation
+
+        generation = chip_generation()
+    except Exception as e:  # noqa: BLE001 — no runtime, hot-reload off
+        log.warning("chip generation unresolvable: %s", e)
     try:
         floors = floors_from_env()
     except Exception as e:  # noqa: BLE001 — detection off, exporter lives
@@ -513,6 +563,10 @@ def main() -> int:
         client=client,
         floors=floors,
         breach_samples=breach_samples,
+        namespace=os.environ.get(
+            consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE
+        ),
+        generation=generation,
     ).run_forever()
     return 0
 

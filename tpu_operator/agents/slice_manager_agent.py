@@ -431,6 +431,7 @@ class SliceManagerAgent:
                 "coordinator_port": self.coordinator_port,
                 "validation_dir": self.validation_dir,
                 "min_psum_gbps_per_chip": self.min_psum_gbps_per_chip,
+                "autotune_results_configmap": consts.AUTOTUNE_RESULTS_CONFIGMAP,
             }
         )
         created = []

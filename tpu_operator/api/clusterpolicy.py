@@ -282,6 +282,26 @@ class HealthMonitorSpec(ComponentCommon):
 
 
 @dataclasses.dataclass
+class AutotunerSpec(ComponentCommon):
+    """Per-generation kernel autotuning (ROADMAP item 5): a sweep
+    operand scheduled onto one ELECTED node per un-swept TPU generation
+    (the autotune controller manages the election label), measuring
+    flash-attention block shapes, matmul chain tilings, and the int8
+    path; winners are cached per (generation, kernel, shape class,
+    libtpu version) and folded into the perf-floors pipeline. No
+    reference analog — NVIDIA tunes kernels inside CUDA libraries; on
+    TPU the block-shape choice lives in the operator's own pallas
+    payloads, so the operator owns the loop."""
+
+    # seconds between agent reconcile passes on an elected node
+    interval: int = field(default=60)
+    # chips the sweep pod claims via the google.com/tpu resource —
+    # exclusive chip ownership for the sweep window (no co-tenant skews
+    # the measurement); match the generation's chips-per-host
+    chips: int = field(default=4)
+
+
+@dataclasses.dataclass
 class MultiSliceSpec(SpecBase):
     """Multi-slice (DCN-connected slices) support: the validator and the
     slice manager wire JAX distributed-coordinator addresses across slices
@@ -324,6 +344,7 @@ class ClusterPolicySpec(SpecBase):
     node_status_exporter: NodeStatusExporterSpec = sub(NodeStatusExporterSpec, json="nodeStatusExporter")
     validator: ValidatorSpec = sub(ValidatorSpec)
     health_monitor: HealthMonitorSpec = sub(HealthMonitorSpec, json="healthMonitor")
+    autotuner: AutotunerSpec = sub(AutotunerSpec)
     multi_slice: MultiSliceSpec = sub(MultiSliceSpec, json="multiSlice")
     psa: PSASpec = sub(PSASpec)
 

@@ -17,6 +17,10 @@ import sys
 import threading
 
 from tpu_operator import consts
+from tpu_operator.controllers.autotune_controller import (
+    AutotuneReconciler,
+    setup_with_manager as setup_autotune,
+)
 from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     setup_with_manager as setup_clusterpolicy,
@@ -115,6 +119,7 @@ def main(argv=None) -> int:
     setup_upgrade(mgr, UpgradeReconciler(client, namespace))
     setup_health(mgr, HealthReconciler(client, namespace))
     setup_placement(mgr, PlacementReconciler(client, namespace))
+    setup_autotune(mgr, AutotuneReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

@@ -152,6 +152,33 @@ FABRIC_LINK_DEGRADED_FRACTION = 0.5
 # in service, and gangs straddling the edge re-place around it.
 FABRIC_HOST_BLAME_EDGES = 2
 
+# ---------------------------------------------------------------------------
+# Per-generation kernel autotuning (workloads/autotune.py ->
+# agents/autotune_agent.py -> controllers/autotune_controller.py). The
+# controller elects ONE in-service node per un-swept TPU generation by
+# label; the autotuner DaemonSet schedules only onto elected nodes (the
+# label is in its nodeSelector, so the pod — and the chips it claims via
+# the google.com/tpu resource — exists only for the sweep window), runs
+# the sweep, and caches results per (generation, kernel family, shape
+# class, libtpu version) in the results ConfigMap so a rebooted node or
+# a late-joining node never re-sweeps. The controller folds measured
+# winners into the perf-floors pipeline and publishes the winning
+# configs for workloads to consume.
+# ---------------------------------------------------------------------------
+AUTOTUNE_ELECTED_LABEL = "tpu.google.com/autotune"
+AUTOTUNE_ELECTED = "elected"
+# per-generation sweep cache + published winners; data keys are
+# "<generation>.json" entries plus the merged winners blob below
+AUTOTUNE_RESULTS_CONFIGMAP = "tpu-autotune-results"
+AUTOTUNE_WINNERS_KEY = "winners.json"
+# the env workloads resolve tuned configs from (configMapKeyRef onto the
+# winners blob; absent -> hand-swept defaults)
+AUTOTUNE_ENV = "TPU_AUTOTUNE_JSON"
+# re-check cadence while any generation is un-swept (the sweep finishes
+# without any watch event the predicate maps once the agent publishes,
+# but a crashed elected node must be re-elected on a timer)
+AUTOTUNE_REPLAN_SECONDS = 30.0
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

@@ -1,0 +1,434 @@
+"""Autotune reconciler: per-generation sweep election + winner folding.
+
+The operator half of the kernel-autotuning loop (ROADMAP item 5; the
+agent half is ``agents/autotune_agent.py``). Each pass:
+
+1. **Elect** — group in-service TPU nodes by generation; for every
+   generation whose cached sweep entry is missing, incomplete, or
+   recorded under a different libtpu version, hold the election label
+   (``consts.AUTOTUNE_ELECTED_LABEL``) on exactly ONE in-service node
+   (lexicographically-first for determinism). The autotuner DaemonSet's
+   nodeSelector includes the label, so electing a node IS scheduling
+   the sweep pod — and clearing it (generation swept, or the elected
+   node went out of service) tears the pod down and frees the chips.
+   A swept generation holds no elections: a node joining it later is
+   never elected and never re-sweeps.
+
+2. **Fold** — parse the per-generation entries in the
+   ``tpu-autotune-results`` ConfigMap and (a) tighten the
+   ``tpu-perf-floors`` pipeline: measured TPU roofs replace
+   ``perf.py``'s scaled guesses for every swept generation
+   (``workloads.autotune.merge_winner_floors``; CPU/interpret entries
+   publish configs but never floors), patched into the floors ConfigMap
+   only when semantically different — the exporter's hot-reload picks
+   the tightened floor up on its next probe cycle without a pod
+   restart; (b) publish the compact winners blob
+   (``winners.json``) that workloads resolve block shapes from via
+   ``TPU_AUTOTUNE_JSON``.
+
+Steady state is O(changes): valid entries everywhere -> no elections,
+floors/winners semantically unchanged -> zero apiserver writes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional
+
+from tpu_operator import consts, images
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+)
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors, trace
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import tpu_info
+from tpu_operator.workloads.autotune import (
+    entry_key,
+    entry_valid,
+    merge_winner_floors,
+    parse_entry,
+    winners_blob,
+)
+
+log = logging.getLogger(__name__)
+
+
+def libtpu_version_for(cp: ClusterPolicy) -> str:
+    """The toolchain version sweeps must match: the libtpu image tag —
+    the same value the autotuner DaemonSet injects as LIBTPU_VERSION, so
+    the agent's recorded fingerprint and this converge; a rolling libtpu
+    upgrade changes the tag and invalidates every cached sweep."""
+    image = images.resolve("libtpu", cp.spec.libtpu)
+    return image.rsplit(":", 1)[1] if ":" in image else image
+
+
+class AutotuneReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = get_metrics()
+        self.recorder = EventRecorder(client, namespace)
+        self._elected_events: set = set()  # (gen, node) election dedup
+        self._roof_series: set = set()  # generations with a live roof gauge
+        self._floors_folded: Dict[str, str] = {}  # gen -> version folded
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(
+            CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name
+        )
+        if obj is None:
+            return Result()
+        cp = ClusterPolicy.from_unstructured(obj)
+        if not cp.spec.autotuner.is_enabled():
+            with trace.span("autotune-elect"):
+                self._clear_all_elections()
+            # stale-series hygiene on disable: frozen gauges would keep
+            # alerting on a sweep that will never happen, and a roof
+            # series would export yesterday's measurement forever
+            self.metrics.autotune_generations_swept.set(0)
+            self.metrics.autotune_generations_pending.set(0)
+            self._update_roof_series({})
+            return Result()
+        desired_version = libtpu_version_for(cp)
+        try:
+            nodes = self.client.list(
+                "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+            )
+        except errors.ApiError as e:
+            log.warning("autotune: node list failed: %s", e)
+            return Result(requeue=True)
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, self.namespace
+        )
+        data = (cm or {}).get("data") or {}
+        groups = self._by_generation(nodes)
+        cached_gens = {
+            k[: -len(".json")]
+            for k in data
+            if k.endswith(".json") and k != consts.AUTOTUNE_WINNERS_KEY
+        }
+        entries = {
+            gen: entry
+            for gen in set(groups) | cached_gens
+            if (entry := parse_entry(data.get(entry_key(gen)))) is not None
+        }
+        with trace.span("autotune-elect"):
+            pending, kept = self._elect(
+                obj, groups, entries, desired_version,
+                claim_chips=max(1, cp.spec.autotuner.chips or 4),
+            )
+            self._clear_orphan_elections(kept)
+        with trace.span("autotune-fold"):
+            self._fold(obj, entries, desired_version, cm)
+        swept = [g for g in groups if entry_valid(entries.get(g), desired_version)]
+        self.metrics.autotune_generations_swept.set(len(swept))
+        self.metrics.autotune_generations_pending.set(len(pending))
+        if pending:
+            # a crashed elected node / a sweep in flight: re-check on a
+            # timer (the published entry also lands as a watch event)
+            return Result(requeue_after=consts.AUTOTUNE_REPLAN_SECONDS)
+        return Result()
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _labels(node: ObjectDict) -> dict:
+        return node["metadata"].get("labels") or {}
+
+    def _by_generation(self, nodes: List[ObjectDict]) -> Dict[str, List[ObjectDict]]:
+        groups: Dict[str, List[ObjectDict]] = {}
+        for node in nodes:
+            info = tpu_info(node)
+            if info is None or not info.generation or info.generation == "unknown":
+                continue
+            groups.setdefault(info.generation, []).append(node)
+        return groups
+
+    def _in_service(self, node: ObjectDict) -> bool:
+        from tpu_operator.placement.engine import labels_unavailable
+
+        return not labels_unavailable(self._labels(node))
+
+    def _set_election(self, node_name: str, elected: bool) -> None:
+        try:
+            self.client.patch(
+                "v1", "Node", node_name,
+                {"metadata": {"labels": {
+                    consts.AUTOTUNE_ELECTED_LABEL:
+                        consts.AUTOTUNE_ELECTED if elected else None
+                }}},
+            )
+        except errors.NotFound:
+            pass  # node left while the pass ran
+
+    def _clear_all_elections(self) -> None:
+        """Autotuner disabled: no node may keep holding the election
+        label (it schedules a chip-claiming pod)."""
+        try:
+            nodes = self.client.list(
+                "v1", "Node",
+                label_selector={consts.AUTOTUNE_ELECTED_LABEL: consts.AUTOTUNE_ELECTED},
+            )
+        except errors.ApiError:
+            return
+        for node in nodes:
+            self._set_election(node["metadata"]["name"], False)
+
+    def _clear_orphan_elections(self, kept: set) -> None:
+        """Clear the election label from any node not designated this
+        pass — a node that LEFT its generation grouping mid-sweep (lost
+        accelerator labels, de-TPU'd) would otherwise hold the label
+        (and its chip-claiming pod) forever, invisible to the
+        per-generation convergence."""
+        try:
+            labelled = self.client.list(
+                "v1", "Node",
+                label_selector={consts.AUTOTUNE_ELECTED_LABEL: consts.AUTOTUNE_ELECTED},
+            )
+        except errors.ApiError:
+            return
+        for node in labelled:
+            name = node["metadata"]["name"]
+            if name not in kept:
+                self._set_election(name, False)
+
+    def _elect(
+        self,
+        cp_obj: ObjectDict,
+        groups: Dict[str, List[ObjectDict]],
+        entries: Dict[str, dict],
+        desired_version: str,
+        claim_chips: int = 4,
+    ):
+        """Converge the election labels; returns (generations still
+        awaiting a sweep, node names whose election is kept)."""
+        pending: List[str] = []
+        kept: set = set()
+        keep: Optional[str]
+        for gen, gen_nodes in sorted(groups.items()):
+            elected = [
+                n for n in gen_nodes
+                if self._labels(n).get(consts.AUTOTUNE_ELECTED_LABEL)
+                == consts.AUTOTUNE_ELECTED
+            ]
+            if entry_valid(entries.get(gen), desired_version):
+                # swept for this toolchain: a late-joining node is never
+                # elected, a lingering election tears its pod down
+                for node in elected:
+                    self._set_election(node["metadata"]["name"], False)
+                continue
+            pending.append(gen)
+
+            def schedulable(node) -> bool:
+                # the sweep pod claims a FIXED google.com/tpu count
+                # (spec.autotuner.chips): a node with fewer chips could
+                # never schedule it, so electing it parks the sweep as
+                # a Pending pod forever
+                info = tpu_info(node)
+                return info is not None and info.chips_per_node >= claim_chips
+
+            def rank(node):
+                # exact chip match first (exclusive ownership: the whole
+                # host is claimed, no co-tenant skews the measurement),
+                # then the smallest surplus, then name for determinism
+                info = tpu_info(node)
+                chips = info.chips_per_node if info else 0
+                return (chips != claim_chips, chips, node["metadata"]["name"])
+
+            eligible = sorted(
+                (n for n in gen_nodes if self._in_service(n) and schedulable(n)),
+                key=rank,
+            )
+            if not eligible:
+                if any(self._in_service(n) for n in gen_nodes):
+                    log.warning(
+                        "autotune: generation %s has no node with >= %d "
+                        "chips; lower spec.autotuner.chips to sweep it",
+                        gen, claim_chips,
+                    )
+                for node in elected:
+                    self._set_election(node["metadata"]["name"], False)
+                continue
+            live = sorted(
+                (n for n in elected if self._in_service(n) and schedulable(n)),
+                key=rank,
+            )
+            if live:
+                keep = live[0]["metadata"]["name"]
+            else:
+                keep = eligible[0]["metadata"]["name"]
+                self._set_election(keep, True)
+                if (gen, keep) not in self._elected_events:
+                    self.recorder.event(
+                        cp_obj, "Normal", "AutotuneElected",
+                        f"elected node {keep} to sweep kernel configs for "
+                        f"generation {gen} (libtpu {desired_version})",
+                    )
+                    self._elected_events.add((gen, keep))
+            kept.add(keep)
+            for node in elected:
+                name = node["metadata"]["name"]
+                if name != keep:
+                    self._set_election(name, False)
+        return pending, kept
+
+    # -- folding --------------------------------------------------------------
+
+    def _fold(
+        self,
+        cp_obj: ObjectDict,
+        entries: Dict[str, dict],
+        desired_version: str,
+        results_cm: Optional[ObjectDict],
+    ) -> None:
+        folded = {
+            gen: entry for gen, entry in entries.items()
+            if entry_valid(entry, desired_version)
+        }
+        self._fold_floors(cp_obj, folded, desired_version)
+        self._publish_winners(entries, results_cm)
+        self._update_roof_series(folded)
+
+    def _fold_floors(
+        self, cp_obj: ObjectDict, folded: Dict[str, dict], desired_version: str
+    ) -> None:
+        floors = merge_winner_floors(folded)
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, self.namespace
+        )
+        if cm is None:
+            return  # pre-requisites has not rendered it yet
+        current_blob = (cm.get("data") or {}).get(consts.PERF_FLOORS_KEY)
+        try:
+            current = json.loads(current_blob) if current_blob else {}
+        except ValueError:
+            current = {}
+        if current == floors:
+            return  # semantically settled: zero writes
+        data = {consts.PERF_FLOORS_KEY: json.dumps(floors, sort_keys=True)}
+        for gen, gen_floors in floors.items():
+            data[gen] = json.dumps(gen_floors, sort_keys=True)
+        self.client.patch(
+            "v1", "ConfigMap", consts.PERF_FLOORS_CONFIGMAP, {"data": data},
+            self.namespace,
+        )
+        for gen, entry in folded.items():
+            if self._floors_folded.get(gen) != entry.get("libtpu_version"):
+                matmul = floors.get(gen, {}).get("matmul_tflops")
+                self.recorder.event(
+                    cp_obj, "Normal", "AutotuneFloorsTightened",
+                    f"generation {gen}: measured sweep roofs replace scaled "
+                    f"guesses (matmul floor now {matmul} TFLOP/s, libtpu "
+                    f"{entry.get('libtpu_version')})",
+                )
+                self._floors_folded[gen] = entry.get("libtpu_version", "")
+
+    def _publish_winners(
+        self, entries: Dict[str, dict], results_cm: Optional[ObjectDict]
+    ) -> None:
+        if results_cm is None or not entries:
+            return
+        blob = winners_blob(entries)
+        current_raw = (results_cm.get("data") or {}).get(consts.AUTOTUNE_WINNERS_KEY)
+        try:
+            current = json.loads(current_raw) if current_raw else None
+        except ValueError:
+            current = None
+        if current == blob:
+            return
+        self.client.patch(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP,
+            {"data": {consts.AUTOTUNE_WINNERS_KEY: json.dumps(blob, sort_keys=True)}},
+            self.namespace,
+        )
+
+    def _update_roof_series(self, folded: Dict[str, dict]) -> None:
+        """Per-generation measured-roof gauge, with stale-series hygiene:
+        an invalidated (toolchain-bumped) or vanished entry takes its
+        series with it rather than exporting yesterday's roof forever."""
+        live: set = set()
+        for gen, entry in folded.items():
+            best = None
+            for packed in (entry.get("results", {}).get("matmul") or {}).values():
+                rate = ((packed or {}).get("winner") or {}).get("rate")
+                if isinstance(rate, (int, float)) and (best is None or rate > best):
+                    best = float(rate)
+            if best is not None and entry.get("platform") == "tpu":
+                self.metrics.autotune_matmul_roof.labels(gen).set(round(best, 1))
+                live.add(gen)
+        for gone in self._roof_series - live:
+            try:
+                self.metrics.autotune_matmul_roof.remove(gone)
+            except KeyError:
+                pass
+        self._roof_series = live
+
+
+def setup_with_manager(mgr, reconciler: AutotuneReconciler) -> Controller:
+    ctrl = Controller(
+        "autotune", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
+    )
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_all_cps(_obj) -> List[Request]:
+        try:
+            cps = reconciler.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=cp["metadata"]["name"]) for cp in cps]
+
+    ctrl.watch(
+        mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND),
+        predicate=generation_changed,
+    )
+
+    def autotune_labels_changed(event_type, old, new) -> bool:
+        """Node events matter when election inputs changed: TPU identity,
+        election state, or in-service state — our own election writes
+        re-deliver, but the reconcile is idempotent and coalesced."""
+        keys = (
+            consts.TPU_PRESENT_LABEL,
+            consts.AUTOTUNE_ELECTED_LABEL,
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
+            consts.GKE_TPU_ACCELERATOR_LABEL,
+            consts.TFD_ACCELERATOR_TYPE_LABEL,
+        )
+        if event_type != "MODIFIED" or old is None:
+            return any(k in (new["metadata"].get("labels") or {}) for k in keys)
+        old_labels = old["metadata"].get("labels") or {}
+        new_labels = new["metadata"].get("labels") or {}
+        return any(old_labels.get(k) != new_labels.get(k) for k in keys)
+
+    ctrl.watch(
+        mgr.informer_for("v1", "Node"),
+        mapper=map_to_all_cps, predicate=autotune_labels_changed,
+    )
+
+    def results_changed(event_type, old, new) -> bool:
+        """Only the results ConfigMap's DATA matters (a published sweep
+        entry); our own winners.json write echoes here, but the next
+        pass settles with zero writes."""
+        if new["metadata"].get("name") != consts.AUTOTUNE_RESULTS_CONFIGMAP:
+            return False
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("data") or {}) != (new.get("data") or {})
+
+    ctrl.watch(
+        mgr.informer_for("v1", "ConfigMap"),
+        mapper=map_to_all_cps, predicate=results_changed,
+    )
+    mgr.add_controller(ctrl)
+    return ctrl

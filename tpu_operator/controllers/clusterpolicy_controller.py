@@ -49,6 +49,7 @@ OPERAND_DEPLOY_KEYS = {
     "state-metrics-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "metrics-exporter",
     "state-node-status-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "node-status-exporter",
     "state-health-monitor": consts.COMMON_DEPLOY_LABEL_PREFIX + "health-monitor",
+    "state-autotuner": consts.COMMON_DEPLOY_LABEL_PREFIX + "autotuner",
 }
 
 

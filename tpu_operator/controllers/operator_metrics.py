@@ -178,6 +178,31 @@ class OperatorMetrics:
             ["pool", "edge"],
             registry=reg,
         )
+        # per-generation kernel autotuning (controllers/
+        # autotune_controller.py folds the cached sweep entries)
+        self.autotune_generations_swept = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_autotune_generations_swept",
+            "TPU generations in the cluster with a valid cached kernel "
+            "sweep for the current libtpu version",
+            registry=reg,
+        )
+        self.autotune_generations_pending = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_autotune_generations_pending",
+            "TPU generations awaiting a kernel sweep (election held or "
+            "no eligible node)",
+            registry=reg,
+        )
+        self.autotune_matmul_roof = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_autotune_matmul_roof_tflops",
+            "Measured bf16 matmul roof from the generation's kernel "
+            "sweep — the number that replaces perf.py's scaled guess "
+            "(series retire when the entry is invalidated)",
+            ["generation"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

@@ -25,6 +25,9 @@ DEFAULTS = {
     # the health agent ships in the validator/agents image (shim:
     # tpu-health-monitor), like the discovery bootstrap
     "health_monitor": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
+    # the autotune sweep agent also ships in the validator/agents image
+    # (shim: tpu-autotuner) — its payloads ARE the validator's kernels
+    "autotuner": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
 }
 
 

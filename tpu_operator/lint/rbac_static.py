@@ -73,6 +73,7 @@ SUBJECT_ROOTS: Dict[str, Sequence[str]] = {
     "state-slice-manager": ("agents/slice_manager_agent.py",),
     "state-health-monitor": ("agents/health_monitor_agent.py",),
     "state-metrics-exporter": ("agents/metrics_exporter_agent.py",),
+    "state-autotuner": ("agents/autotune_agent.py",),
     "state-libtpu": ("agents/libtpu_installer.py",),
     "state-node-status-exporter": ("validator/metrics.py",),
     "state-operator-validation": (

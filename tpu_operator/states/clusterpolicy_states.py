@@ -52,7 +52,12 @@ STATE_ORDER = [
     "state-metrics-exporter",
     "state-node-status-exporter",
     "state-health-monitor",
+    "state-autotuner",
 ]
+
+
+def _image_tag(image: str) -> str:
+    return image.rsplit(":", 1)[1] if ":" in image else image
 
 
 def _component_data(spec, key: str, **extra) -> dict:
@@ -86,6 +91,10 @@ def build_render_data(catalog: InfoCatalog) -> dict:
         "perf_floors_configmap": consts.PERF_FLOORS_CONFIGMAP,
         "perf_floors": default_floors(),
         "perf_floors_json": floors_json(),
+        # published autotune winners (configMapKeyRef, optional: the key
+        # appears once the first generation sweep lands)
+        "autotune_results_configmap": consts.AUTOTUNE_RESULTS_CONFIGMAP,
+        "autotune_winners_key": consts.AUTOTUNE_WINNERS_KEY,
         "libtpu_ready_file": consts.LIBTPU_READY_FILE,
         "plugin_ready_file": consts.PLUGIN_READY_FILE,
         "workload_ready_file": consts.WORKLOAD_READY_FILE,
@@ -139,6 +148,19 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             "health_monitor",
             interval=spec.health_monitor.interval or 30,
             active_probes=spec.health_monitor.active_probes or "auto",
+        ),
+        "autotuner": _component_data(
+            spec.autotuner,
+            "autotuner",
+            interval=spec.autotuner.interval or 60,
+            chips=spec.autotuner.chips or 4,
+            # the sweep-cache invalidation key: the libtpu image tag, the
+            # same value the autotune controller derives — a rolling
+            # libtpu upgrade changes it and re-sweeps every generation
+            libtpu_version=_image_tag(images.resolve("libtpu", spec.libtpu)),
+            results_configmap=consts.AUTOTUNE_RESULTS_CONFIGMAP,
+            elected_label=consts.AUTOTUNE_ELECTED_LABEL,
+            elected_value=consts.AUTOTUNE_ELECTED,
         ),
         "health_dir": consts.HEALTH_DIR,
         "validator": _component_data(
@@ -276,6 +298,20 @@ class HealthMonitorState(ClusterPolicyState):
         return catalog.cluster_policy.spec.health_monitor.is_enabled()
 
 
+class AutotunerState(ClusterPolicyState):
+    """Per-generation kernel autotuning: a DaemonSet whose nodeSelector
+    includes the controller-managed election label, so its pod — and
+    the chips it claims via the google.com/tpu resource — exists only
+    on the one elected node per un-swept generation, for exactly the
+    sweep window."""
+
+    def __init__(self):
+        super().__init__("state-autotuner")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.autotuner.is_enabled()
+
+
 def new_cluster_policy_states() -> List[StateSkel]:
     """reference: addState x19, state_manager.go:791-810."""
     states = [
@@ -290,6 +326,7 @@ def new_cluster_policy_states() -> List[StateSkel]:
         MetricsExporterState(),
         NodeStatusExporterState(),
         HealthMonitorState(),
+        AutotunerState(),
     ]
     assert [s.name for s in states] == STATE_ORDER
     return states

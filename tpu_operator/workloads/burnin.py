@@ -223,15 +223,22 @@ def _flash_ctx(q, k, v, mesh: Optional[Mesh], packed: int = 0):
     on its local slice (the custom VJP differentiates through shard_map).
     ``packed`` > 0 splits the sequence into that many equal documents via
     the kernel's segment_ids path (packed-sequence training)."""
+    from tpu_operator.workloads.autotune import tuned_flash_blocks
     from tpu_operator.workloads.flashattention import flash_attention
 
     s = q.shape[1]
     block = min(s, 256 if s % 256 == 0 else 128)
+    # published per-generation winners override the heuristic block when
+    # the operator has swept this generation (TPU_AUTOTUNE_JSON)
+    block_q, block_k = tuned_flash_blocks(
+        s, heads=q.shape[2], head_dim=q.shape[3], default=(block, block),
+        fwd_bwd=True,
+    )
     seg = _packed_ids(q.shape[0], s, packed) if packed else None
 
     def local(a, b, c, sg=None):
         return flash_attention(
-            a, b, c, causal=True, block_q=block, block_k=block, segment_ids=sg
+            a, b, c, causal=True, block_q=block_q, block_k=block_k, segment_ids=sg
         )
 
     if mesh is None:

@@ -1226,8 +1226,8 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     window: Optional[int] = None,
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
@@ -1248,10 +1248,20 @@ def flash_attention(
     matching keep the unmasked fast path (a min/max reduce on the id
     columns proves uniformity); only blocks straddling a segment
     boundary pay for mask construction (see _dispatch_block and
-    docs/design.md)."""
+    docs/design.md). ``block_q``/``block_k`` left unset resolve from
+    the per-generation autotune winners the operator publishes
+    (``TPU_AUTOTUNE_JSON``, workloads/autotune.py), falling back to the
+    hand-swept 1024x1024 — so burn-in, the gang workloads, and the
+    validator run the measured-best blocks without any caller change."""
     if pltpu is None:  # pragma: no cover — jax build without pallas TPU
         raise RuntimeError("flash_attention needs jax.experimental.pallas.tpu")
     b, s, h, d = q.shape
+    if block_q is None or block_k is None:
+        from tpu_operator.workloads.autotune import tuned_flash_blocks
+
+        tuned_q, tuned_k = tuned_flash_blocks(s, heads=h, head_dim=d)
+        block_q = block_q or tuned_q
+        block_k = block_k or tuned_k
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:

@@ -9,6 +9,7 @@ chip generation's published peak.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,18 +55,12 @@ def chip_generation() -> str:
     )
 
 
-def matmul_tflops(
-    size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5, device=None
-) -> dict:
-    """z = z @ y chained INSIDE one jitted fori_loop: the whole timed
-    region is a single device program, so host dispatch latency (large
-    AND noisy under the remote-relay dev setup) never sits between
-    matmuls. The per-iteration time is the median of per-pair slopes
-    over chains of two lengths (``iters`` and ``6*iters``) — the fixed
-    dispatch overhead cancels within each back-to-back pair
-    (workloads/timing.py). 2*N^3 FLOPs per step; a per-call seed scalar
-    keeps every timed call's inputs distinct so a relay can never serve
-    a cached result."""
+def matmul_chain_runner(size: int, unroll: int = 8, device=None, fetched=None):
+    """The bf16 matmul chain as a ``run(seed, n)`` runner — the shared
+    program between the headline probe below and the autotune sweep's
+    tiling axis (``workloads/autotune.sweep_matmul``), so the two can
+    never measure different kernels. Appends each fetched scalar to
+    ``fetched`` when given (the finiteness check)."""
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
     # scale so the chain neither explodes nor vanishes
@@ -85,11 +80,35 @@ def matmul_tflops(
         # before the work actually runs)
         return jnp.float32(out.sum())
 
-    fetched = []
-
     def run(seed, n):
-        fetched.append(float(chain(x, y, seed, n)))
+        value = float(chain(x, y, seed, n))
+        if fetched is not None:
+            fetched.append(value)
 
+    return run
+
+
+def matmul_tflops(
+    size: int = 8192, iters: int = 16, unroll: Optional[int] = None,
+    reps: int = 5, device=None
+) -> dict:
+    """z = z @ y chained INSIDE one jitted fori_loop: the whole timed
+    region is a single device program, so host dispatch latency (large
+    AND noisy under the remote-relay dev setup) never sits between
+    matmuls. The per-iteration time is the median of per-pair slopes
+    over chains of two lengths (``iters`` and ``6*iters``) — the fixed
+    dispatch overhead cancels within each back-to-back pair
+    (workloads/timing.py). 2*N^3 FLOPs per step; a per-call seed scalar
+    keeps every timed call's inputs distinct so a relay can never serve
+    a cached result. ``unroll=None`` resolves the chain unroll from the
+    published autotune winners (TPU_AUTOTUNE_JSON), falling back to the
+    hand-tuned 8."""
+    if unroll is None:
+        from tpu_operator.workloads.autotune import tuned_matmul_unroll
+
+        unroll = tuned_matmul_unroll(size)
+    fetched: list = []
+    run = matmul_chain_runner(size, unroll=unroll, device=device, fetched=fetched)
     timing = two_point_min_timing(run, iters, 6 * iters, reps)
     if not all(np_isfinite(v) for v in fetched):
         raise RuntimeError(f"matmul chain produced non-finite values: {fetched}")
@@ -105,14 +124,9 @@ def matmul_tflops(
     return report
 
 
-def int8_matmul_tops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: int = 5) -> dict:
-    """Quantized-inference throughput probe: chained int8 x int8 -> int32
-    matmuls (``preferred_element_type``), the MXU's double-rate path on
-    v5e+. Same chain/two-point-timing structure as ``matmul_tflops``;
-    each step requantizes the int32 accumulator back to int8 with an
-    arithmetic shift (VPU work, O(N^2), negligible beside the 2N^3 MACs).
-    Reference analog: none — the GPU operator runs no compute benchmarks;
-    this extends the validator's perf surface the TPU-native way."""
+def int8_chain_runner(size: int, unroll: int = 8):
+    """The int8 chain as a ``run(seed, n)`` runner (shared with the
+    autotune sweep, like ``matmul_chain_runner``)."""
     x = jax.random.randint(jax.random.PRNGKey(0), (size, size), -4, 5, dtype=jnp.int8)
     y = jax.random.randint(jax.random.PRNGKey(1), (size, size), -4, 5, dtype=jnp.int8)
 
@@ -135,6 +149,25 @@ def int8_matmul_tops(size: int = 8192, iters: int = 16, unroll: int = 8, reps: i
     def run(seed, n):
         float(chain(x, y, seed, n))  # the fetch forces execution
 
+    return run
+
+
+def int8_matmul_tops(
+    size: int = 8192, iters: int = 16, unroll: Optional[int] = None, reps: int = 5
+) -> dict:
+    """Quantized-inference throughput probe: chained int8 x int8 -> int32
+    matmuls (``preferred_element_type``), the MXU's double-rate path on
+    v5e+. Same chain/two-point-timing structure as ``matmul_tflops``;
+    each step requantizes the int32 accumulator back to int8 with an
+    arithmetic shift (VPU work, O(N^2), negligible beside the 2N^3 MACs).
+    Reference analog: none — the GPU operator runs no compute benchmarks;
+    this extends the validator's perf surface the TPU-native way.
+    ``unroll=None`` resolves from the published autotune winners."""
+    if unroll is None:
+        from tpu_operator.workloads.autotune import tuned_matmul_unroll
+
+        unroll = tuned_matmul_unroll(size, int8=True)
+    run = int8_chain_runner(size, unroll=unroll)
     timing = two_point_min_timing(run, iters, 6 * iters, reps)
     ops = 2 * size**3
     report = {
