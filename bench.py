@@ -1793,6 +1793,199 @@ def bench_placement(
     }
 
 
+def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
+    """Elastic fault-tolerant training (ISSUE 13): one TPUJob driven
+    through the seeded gang fault schedule — host death, grey failure,
+    link cut, preemption — on a 2x2x1 sim torus, with the in-process
+    gang harness training for real. Returns the BENCH ``training``
+    block: resume latency, lost steps per fault, and the shrink
+    step-time ratio vs the gang-telemetry prediction (fixed global
+    batch ⇒ step time scales ~ hosts_full / hosts_shrunk)."""
+    import statistics as stats
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.tpujob import JobPhase, new_tpu_job
+    from tpu_operator.controllers.job_controller import JobReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import GangFaultSchedule, make_torus_nodes
+    from tpu_operator.workloads.checkpoint import CheckpointStore
+    from tpu_operator.workloads.training import InProcessJobRunner, verify_continuity
+
+    ns = "tpu-operator"
+    client = FakeClient()
+    for node in make_torus_nodes((2, 2, 1), prefix="bench-tj"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    client.create(new_tpu_job("bench-job", {
+        "workload": {"steps": steps},
+        "gang": {"shape": "2x2x1", "minShape": "1x1x1"},
+        "checkpoint": {"everySteps": 5},
+        "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05, "retryLimit": 10},
+    }))
+    job_rec = JobReconciler(client, ns)
+    place_rec = PlacementReconciler(client, ns)
+    runner = InProcessJobRunner(
+        client, ns, "bench-job",
+        CheckpointStore(tempfile.mkdtemp(prefix="bench-tpujob-")), steps_per_sync=3,
+    )
+    schedule = GangFaultSchedule(
+        client, ns, "bench-job-slice", seed=seed, start_at=3, every=10, heal_after=4
+    )
+    t0 = time.monotonic()
+    passes = 0
+    for passes in range(1, 500):
+        job_rec.reconcile(Request(name="bench-job"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        runner.sync()
+        schedule.step()
+        job = client.get("tpu.google.com/v1alpha1", "TPUJob", "bench-job")
+        block = (job.get("status") or {}).get("job") or {}
+        if block.get("phase") == JobPhase.SUCCEEDED:
+            break
+    elapsed = time.monotonic() - t0
+    trainer = runner.trainer
+    report = verify_continuity(trainer.history, trainer.checkpoints, trainer.total_steps)
+    faults = len([r for r in schedule.log if r[1] == "inject"])
+    # lost work: re-executed steps across every rewind
+    executed = [h["step"] for h in trainer.history]
+    lost = len(executed) - len(set(executed))
+    resumes = [r.latency_s for r in trainer.resumes[1:]]  # [0] is cold start
+    # shrink step-time ratio: median executed-step time per world (first
+    # sample per world dropped — it carries the mesh's XLA compile)
+    def world_median(world):
+        times = trainer.step_times.get(world, [])
+        times = times[1:] or times
+        return stats.median(times) if times else 0.0
+
+    worlds = sorted(trainer.step_times)
+    ratio = {}
+    if len(worlds) >= 2:
+        small, full = worlds[0], worlds[-1]
+        measured = world_median(small) / world_median(full) if world_median(full) else 0.0
+        ratio = {
+            "shrunk_world": small,
+            "full_world": full,
+            "measured": round(measured, 3),
+            # the gang-telemetry prediction: fixed global batch, compute-
+            # bound step ⇒ time scales with hosts_full / hosts_shrunk
+            "predicted": round(full / small, 3),
+        }
+    return {
+        "seed": seed,
+        "ok": report["ok"],
+        "phase": block.get("phase"),
+        "passes": passes,
+        "elapsed_s": round(elapsed, 3),
+        "steps": trainer.step,
+        "checkpoint_epochs": len(trainer.checkpoints),
+        "fault_classes": sorted(schedule.fired),
+        "faults_injected": faults,
+        "resizes": [(r["kind"], r["from"], r["to"]) for r in block.get("shrinks") or []],
+        "final_shape": block.get("shape"),
+        "resume_latency_s": round(stats.median(resumes), 3) if resumes else 0.0,
+        "lost_steps_total": lost,
+        "lost_steps_per_fault": round(lost / faults, 3) if faults else 0.0,
+        "max_lost_steps": report["max_lost_steps"],
+        "rewinds": report["rewinds"],
+        "continuity_violations": report["violations"],
+        "shrink_step_time_ratio": ratio,
+    }
+
+
+def job_smoke() -> int:
+    """CI gate (scripts/ci.sh): the chaos acceptance run for elastic
+    training — a seeded schedule mixing host death, grey failure, link
+    cut and preemption against a placed TPUJob must end Succeeded with
+    contiguous epoch history (no step lost beyond the last checkpoint),
+    shrinking only to allocator-ranked blocks and growing back on heal;
+    and a job with an unplaceable min shape must land Failed with an
+    Event instead of crash-looping through the placement queue."""
+    from tpu_operator.api.tpujob import JobPhase, new_tpu_job
+    from tpu_operator.controllers.job_controller import JobReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import GangFaultSchedule, make_torus_nodes
+
+    result = bench_training()
+    checks = {
+        "succeeded": result["phase"] == "Succeeded",
+        "continuity_ok": result["ok"],
+        "all_fault_classes_fired": (
+            set(result["fault_classes"]) == set(GangFaultSchedule.FAULT_CLASSES)
+        ),
+        # the resume guarantee: lost work bounded by the cadence
+        "lost_bounded_by_cadence": result["max_lost_steps"] <= 5,
+        # shrinks landed only on allocator-ranked sub-blocks
+        "shapes_allocator_ranked": all(
+            to in ("2x2x1", "2x1x1", "1x1x1") for _, _, to in result["resizes"]
+        ),
+        "shrank_and_grew": (
+            any(k == "shrink" for k, _, _ in result["resizes"])
+            and any(k == "grow" for k, _, _ in result["resizes"])
+        ),
+        "grew_back_to_desired": result["final_shape"] == "2x2x1",
+        # both worlds produced a measurable step-time series (the ratio
+        # itself is only gated against the hosts-ratio prediction on a
+        # real accelerator: the CPU sim multiplexes every virtual device
+        # onto one host, so a shrunk mesh is NOT compute-bound slower)
+        "shrink_ratio_measured": (
+            bool(result["shrink_step_time_ratio"])
+            and result["shrink_step_time_ratio"]["measured"] > 0.0
+        ),
+        "shrink_ratio_within_prediction_on_tpu": bool(
+            os.environ.get("BENCH_SKIP_DEVICE")
+            or not result["shrink_step_time_ratio"]
+            or 0.8 <= result["shrink_step_time_ratio"]["measured"]
+            <= 4.0 * result["shrink_step_time_ratio"]["predicted"]
+        ),
+    }
+    # the quarantine half: an unplaceable min shape must Fail with an
+    # Event after the budget, not crash-loop
+    ns = "tpu-operator"
+    client = FakeClient()
+    for node in make_torus_nodes((2, 2, 1), prefix="smoke-q"):
+        client.create(node)
+    client.create(new_tpu_job("toobig", {
+        "workload": {"steps": 10},
+        "gang": {"shape": "4x4x4", "minShape": "4x4x1"},
+        "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 2},
+    }))
+    job_rec = JobReconciler(client, ns)
+    place_rec = PlacementReconciler(client, ns)
+    for _ in range(8):
+        job_rec.reconcile(Request(name="toobig"))
+        place_rec.reconcile(QUEUE_REQUEST)
+    job = client.get("tpu.google.com/v1alpha1", "TPUJob", "toobig")
+    block = (job.get("status") or {}).get("job") or {}
+    checks["unplaceable_min_quarantines"] = block.get("phase") == JobPhase.FAILED
+    checks["quarantine_evented"] = any(
+        e.get("reason") == "JobFailed" for e in client.list("v1", "Event", "default")
+    )
+    checks["quarantine_frees_queue_slot"] = (
+        client.get_or_none("tpu.google.com/v1alpha1", "TPUSlice", "toobig-slice") is None
+    )
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "job_smoke",
+        "ok": ok,
+        "checks": checks,
+        **{k: v for k, v in result.items() if k != "continuity_violations"},
+        **({"continuity_violations": result["continuity_violations"]}
+           if result["continuity_violations"] else {}),
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def placement_smoke() -> int:
     """CI gate (scripts/ci.sh): a full place/evict/re-place churn on the
     simulated 512-host torus must finish inside the budget with zero
@@ -1826,6 +2019,8 @@ def main() -> None:
         raise SystemExit(fabric_smoke())
     if "--autotune-smoke" in sys.argv[1:]:
         raise SystemExit(autotune_smoke())
+    if "--job-smoke" in sys.argv[1:]:
+        raise SystemExit(job_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -1913,6 +2108,12 @@ def main() -> None:
     # kernel-autotune sweep: flash block grid + matmul tilings with the
     # default config measured in-grid (gated by --autotune-smoke)
     autotune = autotune_block()
+    # elastic training through the gang fault schedule: resume latency,
+    # lost-steps-per-fault, shrink step-time ratio (gated by --job-smoke)
+    try:
+        training = bench_training()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        training = {"error": f"{type(e).__name__}: {e}"}
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -1944,6 +2145,7 @@ def main() -> None:
         "telemetry": telemetry,
         "fabric": fabric,
         "autotune": autotune,
+        "training": training,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

@@ -81,6 +81,14 @@ echo "== autotune smoke: one sweep per generation, floors tighten, cache hits ar
 # late-joining node are zero-write cache hits, and the real local
 # flash sweep proves the tuned config >= the hardcoded default
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --autotune-smoke
+echo "== job smoke: checkpoint -> shrink -> resume -> grow with epoch continuity =="
+# elastic-training gate: a TPUJob through the seeded gang fault schedule
+# (host death, grey failure, link cut, preemption) must end Succeeded
+# with contiguous epoch history (no step lost beyond the last
+# checkpoint), shrinking only to allocator-ranked blocks and growing
+# back on every heal; an unplaceable-min-shape job must quarantine in
+# Failed with an Event instead of crash-looping the placement queue
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --job-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

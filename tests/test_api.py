@@ -101,9 +101,13 @@ class TestTPUSlice:
 class TestCRDs:
     def test_crds_generate_and_serialize(self):
         crds = all_crds()
-        assert len(crds) == 2
+        assert len(crds) == 3
         names = {c["metadata"]["name"] for c in crds}
-        assert names == {"clusterpolicies.tpu.google.com", "tpuslices.tpu.google.com"}
+        assert names == {
+            "clusterpolicies.tpu.google.com",
+            "tpuslices.tpu.google.com",
+            "tpujobs.tpu.google.com",
+        }
         # must be valid YAML round-trippable structures
         for crd in crds:
             assert yaml.safe_load(yaml.safe_dump(crd)) == crd

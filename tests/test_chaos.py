@@ -565,6 +565,22 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
     mgr = Manager(client, namespace=NS)
     reconciler = ClusterPolicyReconciler(client, NS)
     ctrl = setup_with_manager(mgr, reconciler)
+    # the TPUJob-era request mix: the placement + job controllers ride
+    # the same soak, with one elastic job placing its gang through the
+    # fault schedule (no data-plane runner here — the job parks in
+    # Placing and heartbeats, which is exactly the steady controller
+    # traffic the schedule must fire every fault class against)
+    from tpu_operator.controllers.job_controller import (
+        JobReconciler,
+        setup_with_manager as setup_job,
+    )
+    from tpu_operator.controllers.placement_controller import (
+        PlacementReconciler,
+        setup_with_manager as setup_placement,
+    )
+
+    setup_placement(mgr, PlacementReconciler(client, NS))
+    setup_job(mgr, JobReconciler(client, NS))
     obs = {"degraded_seen": False}
     stop_sampler = threading.Event()
 
@@ -581,6 +597,12 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
     try:
         mgr.start()
         store.create(new_cluster_policy())  # admin-side, like kubectl
+        from tpu_operator.api.tpujob import new_tpu_job
+
+        store.create(new_tpu_job("soak-job", {
+            "workload": {"steps": 50},
+            "gang": {"shape": "2x1x1", "minShape": "1x1x1"},
+        }))
         sampler.start()
 
         def ready():
@@ -638,6 +660,17 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
                 return not q._queue and not q._failures
 
         obs["queue_drained"] = wait_for(drained, timeout=15.0)
+
+        # the soak job's gang must come out placed: the job controller's
+        # slice create + the placement pass both survived the schedule
+        def job_placed():
+            ts = store.get_or_none(
+                "tpu.google.com/v1alpha1", "TPUSlice", "soak-job-slice"
+            )
+            placement = ((ts or {}).get("status") or {}).get("placement") or {}
+            return placement.get("phase") == "Scheduled"
+
+        obs["job_placed"] = wait_for(job_placed, timeout=30.0)
         cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
         obs["cp_uid"] = cp["metadata"]["uid"]
         obs["store"] = store
@@ -658,7 +691,7 @@ class TestChaosSoak:
         condition having been set and then cleared, no stuck queue
         items, and every configured fault class actually fired."""
         director = ChaosDirector.standard(
-            seed=7, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
+            seed=20260811, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
             rate_scale=2.0,
         )
         obs = _run_soak(nodes=24, director=director, ready_timeout=90.0)
@@ -666,6 +699,7 @@ class TestChaosSoak:
         assert obs["degraded_seen"], "Degraded condition never observed during chaos"
         assert obs["degraded_cleared"], "Degraded condition never cleared after recovery"
         assert obs["queue_drained"], "stuck queue items after convergence"
+        assert obs["job_placed"], "the soak TPUJob's gang never placed under chaos"
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])
@@ -678,12 +712,16 @@ class TestChaosSoak:
         the seed. (The seed is chosen so every configured fault class
         fires against the CURRENT request mix — the every-class assert
         below guards against a vacuous schedule, so adding a controller
-        that shifts the seeded draw sequence can require re-picking it.)"""
-        director = ChaosDirector.standard(seed=20260804, outage_at=8.0, outage_duration=30.0)
+        that shifts the seeded draw sequence can require re-picking it.
+        Re-seeded for the TPUJob-era mix: the placement + job
+        controllers now ride the soak and an elastic job places its
+        gang through the schedule.)"""
+        director = ChaosDirector.standard(seed=20260811, outage_at=8.0, outage_duration=30.0)
         obs = _run_soak(nodes=256, director=director, ready_timeout=240.0)
         assert obs["became_ready"], "256-node install never Ready under chaos"
         assert obs["degraded_seen"] and obs["degraded_cleared"]
         assert obs["queue_drained"]
+        assert obs["job_placed"], "the soak TPUJob's gang never placed under chaos"
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])

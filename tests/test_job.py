@@ -1,0 +1,818 @@
+"""Elastic fault-tolerant training: checkpoint store failure modes, the
+shared retry-budget helper, the shrink/grow allocator oracle, the TPUJob
+FSM, the resumable trainer, and the chaos acceptance run (loss-curve
+continuity across host death + grey failure + link cut + preemption).
+
+The over-the-wire drill lives in tests/drill.py (run under the shipped
+RBAC gate in test_rbac_gate.py); the CI gate is `bench.py --job-smoke`.
+"""
+
+import io
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.tpujob import (
+    TPU_JOB_API_VERSION,
+    TPU_JOB_KIND,
+    JobPhase,
+    TPUJob,
+    new_tpu_job,
+)
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.job_controller import JobReconciler
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.kube.backoff import RetryBudget, read_attempts
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import GangFaultSchedule, make_torus_nodes
+from tpu_operator.placement.engine import (
+    largest_placeable_shape,
+    shrink_candidates,
+)
+from tpu_operator.workloads.checkpoint import MANIFEST_NAME, CheckpointStore
+
+NS = "tpu-operator"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint store
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointStore:
+    def _store(self, tmp_path):
+        return CheckpointStore(str(tmp_path / "ckpt"))
+
+    def test_roundtrip_and_epoch_monotonicity(self, tmp_path):
+        store = self._store(tmp_path)
+        a = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones(3)}
+        assert store.save(10, a) == 1
+        assert store.save(20, {"w": a["w"] * 2, "b": a["b"]}) == 2
+        ckpt = store.latest_good()
+        assert ckpt.epoch == 2 and ckpt.step == 20
+        np.testing.assert_array_equal(ckpt.arrays["w"], a["w"] * 2)
+        older = store.load(1)
+        assert older.step == 10
+        np.testing.assert_array_equal(older.arrays["w"], a["w"])
+
+    def test_empty_store(self, tmp_path):
+        assert self._store(tmp_path).latest_good() is None
+
+    def test_torn_blob_falls_back_to_last_good_epoch(self, tmp_path):
+        store = self._store(tmp_path)
+        store.save(10, {"w": np.ones(4)})
+        store.save(20, {"w": np.full(4, 2.0)})
+        # tear the newest blob (partial write / bit rot): checksum fails
+        newest = store.manifest()[-1]["file"]
+        path = os.path.join(store.directory, newest)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        ckpt = store.latest_good()
+        assert ckpt.epoch == 1 and ckpt.step == 10
+        np.testing.assert_array_equal(ckpt.arrays["w"], np.ones(4))
+
+    def test_corrupt_blob_with_valid_size_falls_back(self, tmp_path):
+        store = self._store(tmp_path)
+        store.save(5, {"w": np.ones(2)})
+        store.save(9, {"w": np.zeros(2)})
+        newest = store.manifest()[-1]["file"]
+        path = os.path.join(store.directory, newest)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # same size, flipped byte
+        open(path, "wb").write(bytes(blob))
+        assert store.latest_good().step == 5
+
+    def test_vanished_blob_falls_back(self, tmp_path):
+        store = self._store(tmp_path)
+        store.save(1, {"w": np.ones(1)})
+        store.save(2, {"w": np.ones(1)})
+        os.unlink(os.path.join(store.directory, store.manifest()[-1]["file"]))
+        assert store.latest_good().epoch == 1
+
+    def test_unreadable_manifest_reads_as_empty_store(self, tmp_path):
+        store = self._store(tmp_path)
+        store.save(10, {"w": np.ones(1)})
+        with open(os.path.join(store.directory, MANIFEST_NAME), "w") as f:
+            f.write('{"epochs": [{"epo')  # torn mid-write by a crash
+        assert store.manifest() == []
+        assert store.latest_good() is None
+        # the store recovers: the next save rebuilds a valid manifest
+        assert store.save(11, {"w": np.ones(1)}) == 1
+
+    def test_crash_mid_checkpoint_resumes_from_previous_epoch(self, tmp_path):
+        """Blob published, crash before the manifest names it: the
+        previous epoch stays latest-good, and a post-restart save never
+        collides with the orphan."""
+        store = self._store(tmp_path)
+        store.save(10, {"w": np.ones(2)})
+        # simulate the crash window: the epoch-2 blob exists on disk but
+        # the manifest was never rewritten
+        buf = io.BytesIO()
+        np.savez(buf, w=np.full(2, 9.0))
+        with open(os.path.join(store.directory, store._blob_name(2)), "wb") as f:
+            f.write(buf.getvalue())
+        assert store.latest_good().step == 10  # orphan invisible
+        # post-restart writer reuses epoch 2 cleanly (replace semantics)
+        assert store.save(20, {"w": np.full(2, 3.0)}) == 2
+        ckpt = store.latest_good()
+        assert ckpt.epoch == 2 and ckpt.step == 20
+        np.testing.assert_array_equal(ckpt.arrays["w"], np.full(2, 3.0))
+
+    def test_concurrent_writers_never_publish_half_written_manifest(self, tmp_path):
+        """N threads saving concurrently: every observable manifest state
+        parses, epochs end up distinct and dense, every blob verifies."""
+        store = self._store(tmp_path)
+        errors = []
+
+        def writer(i):
+            try:
+                for j in range(5):
+                    store.save(i * 100 + j, {"w": np.full(3, float(i))})
+                    # readers interleave with writers: every observation
+                    # must be a fully-consistent store state
+                    store.manifest()
+                    ckpt = store.latest_good()
+                    assert ckpt is not None
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        entries = store.manifest()
+        epochs = [e["epoch"] for e in entries]
+        assert epochs == list(range(1, 21))  # dense, no collisions
+        for entry in entries:
+            assert store.load(entry["epoch"]) is not None
+
+    def test_prune_keeps_newest(self, tmp_path):
+        store = self._store(tmp_path)
+        for i in range(5):
+            store.save(i, {"w": np.ones(1)})
+        assert store.prune(keep=2) == 3
+        assert [e["epoch"] for e in store.manifest()] == [4, 5]
+        assert store.latest_good().epoch == 5
+        # pruned blobs are gone from disk
+        assert not os.path.exists(os.path.join(store.directory, store._blob_name(1)))
+
+
+# ---------------------------------------------------------------------------
+# the shared retry budget
+# ---------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_exhaustion_semantics_match_health_controller(self):
+        budget = RetryBudget(retry_limit=2)
+        assert not budget.exhausted(0)
+        assert not budget.exhausted(1)
+        assert budget.exhausted(2)  # attempts-allowed, not attempts+1
+
+    def test_zero_and_negative_limits_quarantine_immediately(self):
+        assert RetryBudget(retry_limit=0).exhausted(0)
+        assert RetryBudget(retry_limit=-3).exhausted(0)
+
+    def test_full_jitter_delay_bounds_and_determinism(self):
+        import random
+
+        budget = RetryBudget(retry_limit=5, base_delay_seconds=1.0, max_delay_seconds=4.0)
+        for attempt in range(1, 6):
+            cap = min(4.0, 1.0 * 2 ** (attempt - 1))
+            for _ in range(20):
+                d = budget.delay(attempt)
+                assert 0.0 <= d <= cap
+        a = [budget.delay(n, random.Random(7)) for n in range(1, 4)]
+        b = [budget.delay(n, random.Random(7)) for n in range(1, 4)]
+        assert a == b  # seeded rng → reproducible schedule
+
+    def test_read_attempts_tolerates_garbage(self):
+        assert read_attempts(None, "k") == 0
+        assert read_attempts({"k": "3"}, "k") == 3
+        assert read_attempts({"k": "banana"}, "k") == 0
+
+
+# ---------------------------------------------------------------------------
+# the shrink/grow allocator oracle
+# ---------------------------------------------------------------------------
+
+
+def torus_cluster(dims=(2, 2, 1), prefix="tj"):
+    client = FakeClient()
+    for node in make_torus_nodes(dims, prefix=prefix):
+        node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        client.create(node)
+    return client
+
+
+class TestShrinkOracle:
+    def test_candidates_largest_first_bounded_by_min_volume(self):
+        cands = shrink_candidates((2, 2, 1), min_volume=2)
+        assert cands[0] == (2, 2, 1)
+        assert all(c[0] * c[1] * c[2] >= 2 for c in cands)
+        volumes = [c[0] * c[1] * c[2] for c in cands]
+        assert volumes == sorted(volumes, reverse=True)
+        assert (1, 1, 1) not in cands  # below the floor
+        # rotations deduped: one canonical (2,1,1)
+        assert cands.count((2, 1, 1)) == 1
+
+    def test_candidates_fit_inside_desired(self):
+        for cand in shrink_candidates((4, 2, 1), min_volume=1):
+            assert tuple(sorted(cand, reverse=True))[1] <= 2
+
+    def test_free_torus_places_desired(self):
+        client = torus_cluster()
+        nodes = client.list("v1", "Node")
+        assert largest_placeable_shape([], nodes, (2, 2, 1), 1) == (2, 2, 1)
+
+    def test_out_of_service_host_forces_shrink(self):
+        client = torus_cluster()
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        nodes = client.list("v1", "Node")
+        best = largest_placeable_shape([], nodes, (2, 2, 1), 1)
+        assert best is not None and best[0] * best[1] * best[2] == 2
+
+    def test_min_volume_floor_returns_none(self):
+        client = torus_cluster()
+        for name in ("tj-0", "tj-1", "tj-2"):
+            client.patch("v1", "Node", name,
+                         {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        nodes = client.list("v1", "Node")
+        assert largest_placeable_shape([], nodes, (2, 2, 1), 2) is None
+
+    def test_exclude_frees_own_assignment(self):
+        """A gang's own cells count as free for its grow check."""
+        client = torus_cluster()
+        place = PlacementReconciler(client, NS)
+        from tests.test_placement import placement_slice
+
+        client.create(placement_slice("mine", "2x2x1"))
+        place.reconcile(QUEUE_REQUEST)
+        slices = client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+        nodes = client.list("v1", "Node")
+        assert largest_placeable_shape(slices, nodes, (2, 2, 1), 4) is None
+        assert largest_placeable_shape(
+            slices, nodes, (2, 2, 1), 4, exclude=["mine"]
+        ) == (2, 2, 1)
+
+    def test_link_cut_constrains_blocks(self):
+        client = torus_cluster()
+        nodes = client.list("v1", "Node")
+        cut = [("tj-0", "tj-1")]
+        best = largest_placeable_shape([], nodes, (2, 2, 1), 1, degraded_links=cut)
+        assert best is not None and best[0] * best[1] * best[2] == 2
+
+
+# ---------------------------------------------------------------------------
+# FSM units (no jax: the gang is simulated through the progress CM)
+# ---------------------------------------------------------------------------
+
+
+def make_job(name="job1", shape="2x2x1", min_shape="1x1x1", steps=40,
+             every=5, retry_limit=3, base=0.0, max_s=0.0):
+    return new_tpu_job(name, {
+        "workload": {"steps": steps},
+        "gang": {"shape": shape, "minShape": min_shape},
+        "checkpoint": {"everySteps": every},
+        "backoff": {"baseSeconds": base, "maxSeconds": max_s, "retryLimit": retry_limit},
+    })
+
+
+def job_block(client, name="job1"):
+    obj = client.get(TPU_JOB_API_VERSION, TPU_JOB_KIND, name)
+    return (obj.get("status") or {}).get("job") or {}
+
+
+def publish_progress(client, name="job1", **kv):
+    data = {k: str(v) for k, v in kv.items()}
+    cm_name = name + consts.JOB_PROGRESS_SUFFIX
+    if client.get_or_none("v1", "ConfigMap", cm_name, NS) is None:
+        client.create(new_object("v1", "ConfigMap", cm_name, NS, data=data))
+    else:
+        client.patch("v1", "ConfigMap", cm_name, {"data": data}, NS)
+
+
+def events_with_reason(client, reason):
+    return [
+        e for e in client.list("v1", "Event", "default")
+        if e.get("reason") == reason
+    ]
+
+
+class TestJobFSM:
+    def _world(self, job=None):
+        client = torus_cluster()
+        client.create(job or make_job())
+        return client, JobReconciler(client, NS), PlacementReconciler(client, NS)
+
+    def test_creates_owned_slice_and_places(self):
+        client, job_rec, place_rec = self._world()
+        job_rec.reconcile(Request(name="job1"))
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice")
+        placement = ts["spec"]["placement"]
+        assert placement["shape"] == "2x2x1"
+        refs = ts["metadata"]["ownerReferences"]
+        assert refs and refs[0]["kind"] == TPU_JOB_KIND and refs[0]["name"] == "job1"
+        assert job_block(client)["phase"] == JobPhase.PLACING
+        place_rec.reconcile(QUEUE_REQUEST)
+        job_rec.reconcile(Request(name="job1"))
+        assert job_block(client)["hosts"] == 4
+
+    def test_running_once_gang_trains_at_world(self):
+        client, job_rec, place_rec = self._world()
+        job_rec.reconcile(Request(name="job1"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        publish_progress(client, step=3, checkpointEpoch=0, checkpointStep=0,
+                         world=4, status="running")
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.RUNNING
+        assert block["step"] == 3
+        assert events_with_reason(client, "JobPlaced")
+
+    def _run_to_running(self, client, job_rec, place_rec, step=6):
+        job_rec.reconcile(Request(name="job1"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        publish_progress(client, step=step, checkpointEpoch=1, checkpointStep=5,
+                         world=4, status="running")
+        job_rec.reconcile(Request(name="job1"))
+        assert job_block(client)["phase"] == JobPhase.RUNNING
+
+    def test_out_of_service_member_shrinks_to_largest_placeable(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_PERF_LABEL: "degraded"}}})
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.SHRINKING
+        assert block["shape"] == "2x1x1"
+        assert block["shrinks"][-1]["kind"] == "shrink"
+        assert "grey-failure" in block["shrinks"][-1]["cause"]
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice")
+        assert ts["spec"]["placement"]["shape"] == "2x1x1"
+        assert events_with_reason(client, "JobShrunk")
+        # the engine re-places the shrunk shape off the sick host
+        place_rec.reconcile(QUEUE_REQUEST)
+        publish_progress(client, step=6, world=2, status="running")
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.RUNNING
+        assert block["hosts"] == 2
+        assert block["restarts"] == 0  # a successful shrink burns no budget
+
+    def test_link_cut_shrinks_with_cause(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice")
+        a, b = sorted(ts["status"]["placement"]["nodes"])[:2]
+        client.create(new_object(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, NS,
+            data={"tpu-pool": json.dumps(
+                {"edges": {"|".join(sorted((a, b))): {"bandwidth_gbps": 0.1}}}
+            )},
+        ))
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.SHRINKING
+        assert "link-cut" in block["shrinks"][-1]["cause"]
+
+    def test_preemption_recorded_as_cause(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        from tests.test_placement import placement_slice
+
+        client.create(placement_slice("boss", "2x2x1", priority=100, policy="PreemptLower"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        # the whole torus is taken: nothing placeable, budget charged
+        assert block["restarts"] == 1
+        assert any("preempt" in c or "unschedulable" in c for c in block["causes"])
+
+    def test_grow_waits_for_checkpoint_barrier(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        # shrink via grey failure, re-place, return to Running at 2 hosts
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_PERF_LABEL: "degraded"}}})
+        job_rec.reconcile(Request(name="job1"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        publish_progress(client, step=8, world=2, status="running")
+        job_rec.reconcile(Request(name="job1"))
+        assert job_block(client)["phase"] == JobPhase.RUNNING
+        # heal: grow must checkpoint FIRST
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_PERF_LABEL: None}}})
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.CHECKPOINTING
+        token = block["barrier"]
+        cm = client.get("v1", "ConfigMap", "job1-progress", NS)
+        assert cm["data"][consts.JOB_CHECKPOINT_REQUEST] == token
+        # slice NOT resized yet — zero steps may be lost to a planned grow
+        ts = client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice")
+        assert ts["spec"]["placement"]["shape"] == "2x1x1"
+        # the gang acks the barrier → the grow lands
+        publish_progress(client, step=9, checkpointEpoch=2, checkpointStep=9,
+                         world=2, status="running", checkpointAck=token)
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.GROWING
+        assert block["shape"] == "2x2x1"
+        assert block["shrinks"][-1]["kind"] == "grow"
+        assert events_with_reason(client, "JobGrown")
+
+    def test_trainer_error_restarts_against_budget(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        publish_progress(client, step=7, world=4, status="error", error="injected")
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.RESUMING
+        assert block["restarts"] == 1
+        assert events_with_reason(client, "JobRestarted")
+        cm = client.get("v1", "ConfigMap", "job1-progress", NS)
+        token = cm["data"][consts.JOB_RESTART_REQUEST]
+        assert token == str(block["totalRestarts"])
+        # the gang acks the restart and trains again: streak resets
+        publish_progress(client, status="running", restartAck=token, world=4, step=7)
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.RUNNING
+        assert block["restarts"] == 0
+
+    def test_retry_budget_exhaustion_quarantines(self):
+        client, job_rec, place_rec = self._world(
+            make_job(retry_limit=2, min_shape="2x2x1")
+        )
+        self._run_to_running(client, job_rec, place_rec)
+        # every host out of service: min shape can never place
+        for node in client.list("v1", "Node"):
+            client.patch("v1", "Node", node["metadata"]["name"],
+                         {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        for _ in range(4):
+            job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.FAILED
+        assert "retry budget exhausted" in block["message"]
+        assert events_with_reason(client, "JobFailed")
+        # quarantine frees the gang's capacity and placement-queue slot
+        assert client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice") is None
+        # terminal: further passes are inert
+        job_rec.reconcile(Request(name="job1"))
+        assert job_block(client)["phase"] == JobPhase.FAILED
+
+    def test_backoff_gate_survives_event_driven_wakeups(self):
+        """Watch-event storms must not burn the budget faster than the
+        backoff schedule: attempts before nextAttemptAt are free."""
+        client, job_rec, place_rec = self._world(
+            make_job(retry_limit=3, min_shape="2x2x1", base=60.0, max_s=60.0)
+        )
+        self._run_to_running(client, job_rec, place_rec)
+        for node in client.list("v1", "Node"):
+            client.patch("v1", "Node", node["metadata"]["name"],
+                         {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        for _ in range(10):  # an event storm
+            job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.PLACING
+        assert block["restarts"] == 1  # one attempt, gate held the rest
+        assert block["nextAttemptAt"] > 0
+
+    def test_invalid_spec_fails_without_retry(self):
+        client, job_rec, _ = self._world(make_job(shape="banana"))
+        job_rec.reconcile(Request(name="job1"))
+        assert job_block(client)["phase"] == JobPhase.FAILED
+        client2, job_rec2, _ = self._world(
+            make_job(shape="1x1x1", min_shape="2x2x1")  # min > desired
+        )
+        job_rec2.reconcile(Request(name="job1"))
+        assert job_block(client2)["phase"] == JobPhase.FAILED
+
+    def test_completion_succeeds_and_frees_capacity(self):
+        client, job_rec, place_rec = self._world(make_job(steps=10))
+        self._run_to_running(client, job_rec, place_rec)
+        publish_progress(client, step=10, checkpointEpoch=2, checkpointStep=10,
+                         world=4, status="complete")
+        job_rec.reconcile(Request(name="job1"))
+        block = job_block(client)
+        assert block["phase"] == JobPhase.SUCCEEDED
+        assert events_with_reason(client, "JobSucceeded")
+        assert client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice") is None
+        obj = client.get(TPU_JOB_API_VERSION, TPU_JOB_KIND, "job1")
+        assert obj["status"]["state"] == JobPhase.SUCCEEDED
+
+    def test_job_deletion_retires_series_and_sweeps_slice(self):
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        import prometheus_client
+
+        sample = prometheus_client.REGISTRY.get_sample_value(
+            "tpu_operator_job_step", {"job": "job1"}
+        )
+        assert sample is not None
+        client.delete(TPU_JOB_API_VERSION, TPU_JOB_KIND, "job1")
+        job_rec.reconcile(Request(name="job1"))
+        assert prometheus_client.REGISTRY.get_sample_value(
+            "tpu_operator_job_step", {"job": "job1"}
+        ) is None
+        assert client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "job1-slice") is None
+
+    def test_foreign_slice_named_like_a_job_is_never_swept(self):
+        """A user's standalone TPUSlice whose name merely ends in
+        '-slice' must survive the job controller's vanished-job cleanup
+        path (review finding: the sweep used to delete it)."""
+        from tests.test_placement import placement_slice
+
+        client = torus_cluster()
+        client.create(placement_slice("inference-slice", "2x1x1"))
+        job_rec = JobReconciler(client, NS)
+        # a request for a job that never existed (e.g. mapped from a
+        # foreign '*-progress' ConfigMap) takes the cleanup path
+        job_rec.reconcile(Request(name="inference"))
+        assert client.get_or_none(
+            TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "inference-slice"
+        ) is not None
+        # while a genuinely owned slice IS swept when its job vanishes
+        client.create(make_job("gone"))
+        job_rec.reconcile(Request(name="gone"))
+        client.delete(TPU_JOB_API_VERSION, TPU_JOB_KIND, "gone")
+        job_rec.reconcile(Request(name="gone"))
+        assert client.get_or_none(
+            TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "gone-slice"
+        ) is None
+
+    def test_grow_barrier_tokens_never_repeat(self):
+        """A stale checkpointAck from an earlier grow must never satisfy
+        a later barrier (review finding: a repeated token skipped the
+        fresh checkpoint and lost up to a cadence of steps on a PLANNED
+        resize) — the persisted sequence makes every token unique."""
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+
+        def shrink_heal_cycle():
+            client.patch("v1", "Node", "tj-0",
+                         {"metadata": {"labels": {consts.TPU_PERF_LABEL: "degraded"}}})
+            job_rec.reconcile(Request(name="job1"))
+            place_rec.reconcile(QUEUE_REQUEST)
+            publish_progress(client, step=8, world=2, status="running")
+            job_rec.reconcile(Request(name="job1"))
+            client.patch("v1", "Node", "tj-0",
+                         {"metadata": {"labels": {consts.TPU_PERF_LABEL: None}}})
+            job_rec.reconcile(Request(name="job1"))  # enters Checkpointing
+            block = job_block(client)
+            assert block["phase"] == JobPhase.CHECKPOINTING
+            token = block["barrier"]
+            publish_progress(client, step=8, checkpointEpoch=2, checkpointStep=8,
+                             world=2, status="running", checkpointAck=token)
+            job_rec.reconcile(Request(name="job1"))  # grow lands
+            place_rec.reconcile(QUEUE_REQUEST)
+            publish_progress(client, step=8, world=4, status="running")
+            job_rec.reconcile(Request(name="job1"))
+            assert job_block(client)["phase"] == JobPhase.RUNNING
+            return token
+
+        first = shrink_heal_cycle()
+        # identical world state (same step, no budget charged): the
+        # second cycle's token must still differ
+        second = shrink_heal_cycle()
+        assert first != second
+
+    def test_status_survives_operator_restart(self):
+        """A fresh reconciler re-derives the same world from cluster
+        state: no in-memory FSM state is load-bearing."""
+        client, job_rec, place_rec = self._world()
+        self._run_to_running(client, job_rec, place_rec)
+        fresh = JobReconciler(client, NS)
+        fresh.reconcile(Request(name="job1"))
+        assert job_block(client)["phase"] == JobPhase.RUNNING
+
+
+class TestMustGatherJobs:
+    def test_jobs_txt_carries_fsm_state_and_history(self, tmp_path):
+        from tpu_operator import mustgather
+
+        client, job_rec, place_rec = TestJobFSM()._world()
+        job_rec.reconcile(Request(name="job1"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        publish_progress(client, step=6, checkpointEpoch=1, checkpointStep=5,
+                         world=4, status="running")
+        job_rec.reconcile(Request(name="job1"))
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        job_rec.reconcile(Request(name="job1"))  # shrink lands in history
+        written = mustgather.collect(client, NS, str(tmp_path / "bundle"))
+        assert "jobs.txt" in written and "tpujobs.yaml" in written
+        text = open(tmp_path / "bundle" / "jobs.txt").read()
+        assert "job1" in text
+        assert "phase=Shrinking" in text
+        assert "checkpointEpoch=1" in text
+        assert "2x2x1 -> 2x1x1" in text
+        assert "host-health" in text
+
+
+# ---------------------------------------------------------------------------
+# resumable trainer + runner (jax)
+# ---------------------------------------------------------------------------
+
+
+class TestResumableTrainer:
+    def test_checkpoint_resume_same_curve_across_worlds(self, tmp_path):
+        from tpu_operator.workloads.training import ResumableTrainer, trainer_config
+
+        cfg = trainer_config()
+        store = CheckpointStore(str(tmp_path / "a"))
+        trainer = ResumableTrainer(store, cfg, total_steps=12, checkpoint_every=4)
+        trainer.resume(hosts=4)
+        trainer.run(8)  # checkpoints at 4 and 8
+        assert trainer.checkpoint_step == 8
+        losses_first = {h["step"]: h["loss"] for h in trainer.history}
+        # a new trainer (fresh process) resumes on a SMALLER world
+        resumed = ResumableTrainer(store, cfg, total_steps=12, checkpoint_every=4)
+        info = resumed.resume(hosts=2)
+        assert info.step == 8 and info.epoch == 2 and info.world <= 2
+        resumed.run(10)
+        assert resumed.done
+        for h in resumed.history:
+            if h["step"] in losses_first:
+                assert h["loss"] == pytest.approx(
+                    losses_first[h["step"]], rel=1e-3, abs=1e-5
+                )
+
+    def test_resume_after_lost_steps_rewinds_to_checkpoint(self, tmp_path):
+        from tpu_operator.workloads.training import (
+            ResumableTrainer,
+            trainer_config,
+            verify_continuity,
+        )
+
+        store = CheckpointStore(str(tmp_path / "b"))
+        trainer = ResumableTrainer(store, trainer_config(), total_steps=10, checkpoint_every=4)
+        trainer.resume(hosts=4)
+        trainer.run(6)  # steps 1-6, checkpoint at 4: steps 5-6 at risk
+        trainer.resume(hosts=2)  # the shrink: rewinds to 4
+        assert trainer.step == 4
+        trainer.run(10)
+        assert trainer.done
+        report = verify_continuity(trainer.history, trainer.checkpoints, 10)
+        assert report["ok"], report
+        assert report["rewinds"] == 1
+        assert report["max_lost_steps"] == 2
+
+    def test_verify_continuity_flags_violations(self):
+        from tpu_operator.workloads.training import verify_continuity
+
+        # a rewind NOT anchored at a checkpoint
+        bad = [{"step": s, "loss": 1.0, "world": 2} for s in (1, 2, 3, 2, 3, 4)]
+        report = verify_continuity(bad, [{"epoch": 1, "step": 3}], 4)
+        assert not report["ok"]
+        # a forward gap
+        gap = [{"step": s, "loss": 1.0, "world": 2} for s in (1, 2, 4)]
+        assert not verify_continuity(gap, [], 4)["ok"]
+        # a loss discontinuity on re-execution
+        wobble = [
+            {"step": 1, "loss": 1.0, "world": 2},
+            {"step": 2, "loss": 0.9, "world": 2},
+            {"step": 2, "loss": 5.0, "world": 1},
+        ]
+        assert not verify_continuity(wobble, [{"epoch": 1, "step": 1}], 2)["ok"]
+
+    def test_injected_fault_raises_once(self, tmp_path):
+        from tpu_operator.workloads.training import (
+            ResumableTrainer,
+            TrainerError,
+            trainer_config,
+        )
+
+        store = CheckpointStore(str(tmp_path / "c"))
+        trainer = ResumableTrainer(
+            store, trainer_config(), total_steps=6, checkpoint_every=2,
+            fail_at_steps=(3,),
+        )
+        trainer.resume(hosts=2)
+        with pytest.raises(TrainerError):
+            trainer.run(6)
+        assert trainer.step == 2
+        trainer.resume(hosts=2)  # restart from the step-2 checkpoint
+        trainer.run(10)
+        assert trainer.done
+
+
+class TestInProcessRunner:
+    def test_paused_until_gang_placed_and_healthy(self, tmp_path):
+        from tpu_operator.workloads.training import InProcessJobRunner
+
+        client = torus_cluster()
+        client.create(make_job())
+        store = CheckpointStore(str(tmp_path / "r"))
+        runner = InProcessJobRunner(client, NS, "job1", store)
+        assert "paused" in runner.sync()  # no slice yet
+        job_rec = JobReconciler(client, NS)
+        place_rec = PlacementReconciler(client, NS)
+        job_rec.reconcile(Request(name="job1"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        acts = runner.sync()
+        assert acts.get("steps")  # placed: training
+        # a member dies: the runner pauses (collectives would hang)
+        client.patch("v1", "Node", "tj-0",
+                     {"metadata": {"labels": {consts.TPU_HEALTH_LABEL: "degraded"}}})
+        assert "paused" in runner.sync()
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance run (the tentpole's proof)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosAcceptance:
+    def drive(self, seed=7):
+        """A TPUJob through the full seeded schedule — host death, grey
+        failure, link cut, preemption — must finish with contiguous
+        epoch history, shrinking only to allocator-ranked blocks and
+        growing back on every heal."""
+        from tpu_operator.workloads.training import (
+            InProcessJobRunner,
+            verify_continuity,
+        )
+
+        client = torus_cluster()
+        client.create(make_job(
+            steps=120, every=5, retry_limit=10, base=0.01, max_s=0.05
+        ))
+        job_rec = JobReconciler(client, NS)
+        place_rec = PlacementReconciler(client, NS)
+        tmp = tempfile.mkdtemp(prefix="tpujob-chaos-")
+        runner = InProcessJobRunner(
+            client, NS, "job1", CheckpointStore(tmp), steps_per_sync=3
+        )
+        schedule = GangFaultSchedule(
+            client, NS, "job1-slice", seed=seed, start_at=3, every=10, heal_after=4
+        )
+        for _ in range(400):
+            job_rec.reconcile(Request(name="job1"))
+            place_rec.reconcile(QUEUE_REQUEST)
+            runner.sync()
+            schedule.step()
+            if job_block(client).get("phase") == JobPhase.SUCCEEDED:
+                break
+        return client, runner, schedule
+
+    def test_loss_curve_continuity_under_chaos(self):
+        from tpu_operator.workloads.training import verify_continuity
+
+        client, runner, schedule = self.drive()
+        block = job_block(client)
+        assert block["phase"] == JobPhase.SUCCEEDED, block
+        assert schedule.done()
+        # every configured fault class actually fired (vacuous-schedule guard)
+        assert schedule.fired == set(GangFaultSchedule.FAULT_CLASSES)
+        trainer = runner.trainer
+        report = verify_continuity(trainer.history, trainer.checkpoints, 120)
+        assert report["ok"], report
+        # lost work bounded by the cadence, the resume guarantee
+        assert report["max_lost_steps"] <= 5
+        # shrinks landed only on allocator-ranked blocks and grew back
+        resizes = block["shrinks"]
+        assert any(r["kind"] == "shrink" for r in resizes)
+        assert any(r["kind"] == "grow" for r in resizes)
+        assert resizes[-1]["to"] == "2x2x1"  # finished at full size
+        for r in resizes:
+            assert r["to"] in ("2x2x1", "2x1x1", "1x1x1")
+        # epoch history contiguous: monotone epochs, steps monotone in epoch
+        epochs = [c["epoch"] for c in trainer.checkpoints]
+        assert epochs == sorted(set(epochs))
+
+    def test_same_seed_same_fault_log(self):
+        _, _, a = self.drive(seed=11)
+        _, _, b = self.drive(seed=11)
+        assert a.log == b.log
+
+    def test_unplaceable_min_shape_quarantines_not_crashloops(self):
+        client = torus_cluster()  # 4 hosts total
+        client.create(make_job(
+            name="toobig", shape="4x4x4", min_shape="4x4x1", retry_limit=2
+        ))
+        job_rec = JobReconciler(client, NS)
+        place_rec = PlacementReconciler(client, NS)
+        for _ in range(8):
+            job_rec.reconcile(Request(name="toobig"))
+            place_rec.reconcile(QUEUE_REQUEST)
+        block = job_block(client, "toobig")
+        assert block["phase"] == JobPhase.FAILED
+        assert events_with_reason(client, "JobFailed")
+        # the dead job holds no placement-queue slot
+        assert client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, "toobig-slice") is None

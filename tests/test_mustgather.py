@@ -144,7 +144,7 @@ def test_bundle_from_live_install(tmp_path):
         assert {
             "version.txt", "all.txt",
             "nodes.yaml", "node-labels.txt", "node-health.txt", "placement.txt",
-            "clusterpolicies.yaml", "tpuslices.yaml",
+            "clusterpolicies.yaml", "tpuslices.yaml", "tpujobs.yaml", "jobs.txt",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
             "telemetry.txt", "fabric.txt",

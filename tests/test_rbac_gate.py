@@ -468,6 +468,41 @@ class TestOperatorUnderEnforcement:
         finally:
             server.stop()
 
+    # the job drill's admin half provisions the TPUJob CR (kubectl
+    # territory on a real cluster); the operator side reads it, patches
+    # its status, and owns the TPUSlice lifecycle end to end
+    JOB_HARNESS_RULES = [
+        {
+            "apiGroups": ["tpu.google.com"],
+            "resources": ["tpujobs"],
+            "verbs": ["create", "delete"],
+        },
+    ]
+
+    def test_job_drill_runs_under_enforcement(self):
+        """The TPUJob controller's whole verb surface — tpujobs reads +
+        status patches, the owned TPUSlice create/patch/delete on
+        shrink/grow/teardown, progress-ConfigMap barrier keys, Events —
+        exercised by the shrink/grow/resume drill over the wire under
+        the shipped operator rules (harness-side node/CR provisioning
+        gets its own slice, as in the other drills)."""
+        from drill import assert_job_drill_passed, run_job_drill
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(
+            shipped_rules() + self.HARNESS_RULES + self.JOB_HARNESS_RULES
+        )
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            obs = run_job_drill(client, NS)
+            assert_job_drill_passed(obs)
+            assert not authorizer.denials, (
+                f"ClusterRole gaps in the job path: {sorted(set(authorizer.denials))}"
+            )
+        finally:
+            server.stop()
+
     def test_cert_lifecycle_under_enforcement(self, tmp_path):
         """The webhook cert manager's full converge path (Secret adopt/
         publish, VWC caBundle patch) runs under the shipped rules — the

@@ -12,7 +12,7 @@ import dataclasses
 import typing
 from typing import Any, Dict, List, Optional, get_args, get_origin
 
-from tpu_operator.api import clusterpolicy, tpuslice
+from tpu_operator.api import clusterpolicy, tpujob, tpuslice
 from tpu_operator.api.common import SpecBase
 
 CRD_API_VERSION = "apiextensions.k8s.io/v1"
@@ -134,5 +134,17 @@ def tpu_slice_crd() -> dict:
     )
 
 
+def tpu_job_crd() -> dict:
+    return _crd(
+        kind=tpujob.TPU_JOB_KIND,
+        plural="tpujobs",
+        singular="tpujob",
+        version="v1alpha1",
+        spec_cls=tpujob.TPUJobSpec,
+        status_cls=tpujob.TPUJobStatus,
+        short_names=["tj"],
+    )
+
+
 def all_crds() -> List[dict]:
-    return [cluster_policy_crd(), tpu_slice_crd()]
+    return [cluster_policy_crd(), tpu_slice_crd(), tpu_job_crd()]

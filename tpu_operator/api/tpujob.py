@@ -1,0 +1,159 @@
+"""TPUJob CRD (tpu.google.com/v1alpha1): elastic fault-tolerant training.
+
+A TPUJob declares a long-running training workload plus its *elasticity
+contract*: the desired gang shape, the smallest shape the workload is
+still viable on, the checkpoint cadence the resume guarantee is bounded
+by, and the restart backoff budget that separates a chaos-buffeted job
+(shrinks, resumes, finishes) from a poisoned one (quarantines in
+``Failed`` instead of crash-looping through the placement queue).
+
+The job controller (``controllers/job_controller.py``) owns the full
+lifecycle as a bounded FSM — Pending → Placing → Running →
+Checkpointing → Shrinking/Growing → Resuming → Succeeded/Failed — by
+driving ONE owned TPUSlice through the placement engine: shrink patches
+the slice's ``spec.placement.shape`` down to the largest sub-block the
+torus allocator ranks placeable, grow patches it back up when capacity
+heals. Checkpoint-epoch bookkeeping lives in ``status.job`` so a
+restarted operator re-derives the same world.
+
+No NVIDIA-reference analog: the gpu-operator stops at provisioning; the
+job layer is where "Exploration of TPUs for AI Applications"-style
+fleet resilience (checkpoint, shrink to what still places, grow back on
+heal) becomes an operator concern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from tpu_operator.api.common import SpecBase, field, sub
+
+TPU_JOB_API_VERSION = "tpu.google.com/v1alpha1"
+TPU_JOB_KIND = "TPUJob"
+
+
+class JobPhase:
+    """The bounded job FSM. ``Succeeded``/``Failed`` are terminal;
+    everything else recomputes from cluster state every pass."""
+
+    PENDING = "Pending"
+    PLACING = "Placing"
+    RUNNING = "Running"
+    CHECKPOINTING = "Checkpointing"
+    SHRINKING = "Shrinking"
+    GROWING = "Growing"
+    RESUMING = "Resuming"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+TERMINAL_PHASES = (JobPhase.SUCCEEDED, JobPhase.FAILED)
+
+
+@dataclasses.dataclass
+class JobWorkloadSpec(SpecBase):
+    """What trains: total step count plus model knobs forwarded to the
+    trainer (``workloads/training.py``; keys follow BurninConfig field
+    names, e.g. ``d_model``, ``seq_len``, ``batch``)."""
+
+    steps: int = field(default=100)
+    config: dict = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class JobGangSpec(SpecBase):
+    """Desired vs minimum viable gang geometry. ``shape`` is the host
+    block requested on the pool's torus (TPUSlice placement grammar);
+    ``minShape`` bounds how far the job may shrink — a sub-block below
+    its volume is not worth resuming on (model doesn't fit, step time
+    unacceptable) and reads as unplaceable instead."""
+
+    shape: str = field(default="")
+    min_shape: str = field(json="minShape", default="")
+    priority: int = field(default=0)
+    preemption_policy: str = field(
+        json="preemptionPolicy", default="Never", enum=["Never", "PreemptLower"]
+    )
+    # optional node-pool pin, forwarded to the owned TPUSlice
+    pool: str = field(default="")
+
+
+@dataclasses.dataclass
+class JobCheckpointSpec(SpecBase):
+    """Checkpoint cadence: the resume guarantee is "no step lost beyond
+    the last checkpoint", so ``everySteps`` IS the blast radius of an
+    unplanned fault. ``dir`` names the store location the gang workers
+    mount (in-sim: a local directory the harness owns)."""
+
+    every_steps: int = field(json="everySteps", default=10)
+    dir: str = field(default="")
+
+
+@dataclasses.dataclass
+class JobBackoffSpec(SpecBase):
+    """Restart backoff knobs: consecutive failed attempts (nothing
+    placeable, trainer error on resume) back off with full jitter and
+    burn the retry budget; a successful return to Running resets the
+    streak. Exhaustion quarantines the job in ``Failed``."""
+
+    base_seconds: float = field(json="baseSeconds", default=1.0)
+    max_seconds: float = field(json="maxSeconds", default=60.0)
+    retry_limit: int = field(json="retryLimit", default=5)
+
+
+@dataclasses.dataclass
+class TPUJobSpec(SpecBase):
+    workload: JobWorkloadSpec = sub(JobWorkloadSpec)
+    gang: JobGangSpec = sub(JobGangSpec)
+    checkpoint: JobCheckpointSpec = sub(JobCheckpointSpec)
+    backoff: JobBackoffSpec = sub(JobBackoffSpec)
+
+
+@dataclasses.dataclass
+class TPUJobStatus(SpecBase):
+    """``state`` mirrors the FSM phase for printer columns; ``job`` is
+    the bookkeeping block (phase, step/epoch watermarks, current vs
+    desired shape, shrink history, last restart causes) the controller
+    publishes as a key-scoped status patch."""
+
+    state: str = field(default="")
+    conditions: List[dict] = field(default_factory=list)
+    job: dict = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TPUJob:
+    metadata: dict
+    spec: TPUJobSpec
+    status: TPUJobStatus
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @classmethod
+    def from_unstructured(cls, obj: dict) -> "TPUJob":
+        return cls(
+            metadata=obj.get("metadata", {}),
+            spec=TPUJobSpec.from_dict(obj.get("spec")),
+            status=TPUJobStatus.from_dict(obj.get("status")),
+        )
+
+    def to_unstructured(self) -> dict:
+        return {
+            "apiVersion": TPU_JOB_API_VERSION,
+            "kind": TPU_JOB_KIND,
+            "metadata": self.metadata,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+def new_tpu_job(name: str, spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": TPU_JOB_API_VERSION,
+        "kind": TPU_JOB_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

@@ -29,6 +29,10 @@ from tpu_operator.controllers.health_controller import (
     HealthReconciler,
     setup_with_manager as setup_health,
 )
+from tpu_operator.controllers.job_controller import (
+    JobReconciler,
+    setup_with_manager as setup_job,
+)
 from tpu_operator.controllers.placement_controller import (
     PlacementReconciler,
     setup_with_manager as setup_placement,
@@ -120,6 +124,7 @@ def main(argv=None) -> int:
     setup_health(mgr, HealthReconciler(client, namespace))
     setup_placement(mgr, PlacementReconciler(client, namespace))
     setup_autotune(mgr, AutotuneReconciler(client, namespace))
+    setup_job(mgr, JobReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

@@ -179,6 +179,51 @@ AUTOTUNE_ENV = "TPU_AUTOTUNE_JSON"
 # but a crashed elected node must be re-elected on a timer)
 AUTOTUNE_REPLAN_SECONDS = 30.0
 
+# ---------------------------------------------------------------------------
+# Elastic fault-tolerant training jobs (api/tpujob.py ->
+# controllers/job_controller.py -> workloads/training.py). The job
+# controller owns one TPUSlice per TPUJob (named <job> + JOB_SLICE_SUFFIX)
+# and drives shrink/grow by patching its placement shape; the data plane
+# (the gang's trainer) and the control plane meet at the job progress
+# ConfigMap (<job> + JOB_PROGRESS_SUFFIX): the trainer publishes step /
+# checkpoint watermarks, the controller reads them into status.job and
+# writes the one key it owns (the pre-grow checkpoint barrier request).
+# ---------------------------------------------------------------------------
+JOB_SLICE_SUFFIX = "-slice"
+JOB_PROGRESS_SUFFIX = "-progress"
+# trainer-owned progress keys
+JOB_PROGRESS_STEP = "step"                      # last completed train step
+JOB_PROGRESS_EPOCH = "checkpointEpoch"          # newest checkpoint epoch
+JOB_PROGRESS_CHECKPOINT_STEP = "checkpointStep"  # step that epoch covers
+JOB_PROGRESS_WORLD = "world"                    # hosts the trainer is sized for
+JOB_PROGRESS_STATUS = "status"                  # running | complete | error
+JOB_PROGRESS_ERROR = "error"                    # last trainer error text
+JOB_PROGRESS_CHECKPOINT_ACK = "checkpointAck"   # echoes the barrier token
+JOB_PROGRESS_RUNNING = "running"
+JOB_PROGRESS_COMPLETE = "complete"
+JOB_PROGRESS_FAILED = "error"
+# controller-owned progress key: the pre-grow checkpoint barrier (the
+# trainer checkpoints and echoes the token into checkpointAck; only then
+# does the controller patch the slice shape up, so a planned grow loses
+# zero steps)
+JOB_CHECKPOINT_REQUEST = "checkpointRequest"
+# controller-owned restart handshake: on a trainer error the controller
+# burns a restart unit and bumps this token; the gang resumes from the
+# newest good checkpoint and echoes it (the in-cluster analog of fresh
+# worker pods replacing crashed ones)
+JOB_RESTART_REQUEST = "restartRequest"
+JOB_PROGRESS_RESTART_ACK = "restartAck"
+# restart-attempt counter persisted on the TPUJob (kube/backoff.py
+# annotation-counter shape, same idea as REPAIR_RETRIES_ANNOTATION):
+# consecutive failed attempts; reset when the job reaches Running
+JOB_RESTARTS_ANNOTATION = "tpu.google.com/job-restarts"
+# re-check cadence while a job is non-terminal: grow opportunities and
+# trainer progress don't always map to a watch event the predicate keeps
+JOB_RESYNC_SECONDS = 5.0
+# status.job history bounds (shrink/grow history, last restart causes)
+JOB_HISTORY_LIMIT = 10
+JOB_CAUSES_LIMIT = 5
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

@@ -40,6 +40,7 @@ from tpu_operator.api.clusterpolicy import (
 from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.kube import trace
 from tpu_operator.kube import errors
+from tpu_operator.kube.backoff import RetryBudget, read_attempts
 from tpu_operator.kube.cached import CachedReadClient
 from tpu_operator.kube.client import Client
 from tpu_operator.kube.controller import Controller, Request, Result
@@ -159,10 +160,7 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             return False
 
     def _retries(self, node: ObjectDict) -> int:
-        try:
-            return int(_annotations(node).get(consts.REPAIR_RETRIES_ANNOTATION, "0"))
-        except ValueError:
-            return 0
+        return read_attempts(_annotations(node), consts.REPAIR_RETRIES_ANNOTATION)
 
     def _in_grace_period(self, node: ObjectDict, remediation) -> bool:
         """A node is left alone until its degradation has persisted past
@@ -202,9 +200,11 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         cordon is never orphaned on a node with no repair state).
         ``reason`` records which signal triggered the attempt ("health"
         or "perf") so revalidation knows what must clear; re-entries
-        keep the recorded reason."""
+        keep the recorded reason. The budget decision rides the shared
+        bounded-retry helper (``kube/backoff.py``) — the same policy
+        shape the TPUJob FSM quarantines through."""
         retries = self._retries(node)
-        if retries >= max(0, remediation.retry_limit):
+        if RetryBudget(retry_limit=remediation.retry_limit).exhausted(retries):
             self._set_repair_state(node, RepairState.QUARANTINED)
             self._cordon(node, True)
             return RepairState.QUARANTINED

@@ -1,0 +1,779 @@
+"""TPUJob reconciler: elastic fault-tolerant training lifecycle.
+
+The job layer over the placement stack (ROADMAP item 3). One TPUJob owns
+one TPUSlice (``<job>-slice``) and the controller drives the whole
+lifecycle as a bounded FSM persisted in ``status.job``::
+
+    Pending → Placing → Running ⇄ Checkpointing → Growing → Resuming
+                  ↑         │
+                  │         └─ gang broken ─→ Shrinking ─→ Resuming
+                  └──────────── nothing placeable (backoff) ──→ Failed
+
+Every decision recomputes from cluster state (the slice's placement
+status, node service labels, the link-health map, the job progress
+ConfigMap), so a restarted operator re-derives the same world — the
+engine-room convention every other controller here follows.
+
+**Shrink** fires on any of the three out-of-service signals (health FSM
+verdict, grey-failure perf label, fabric link cut through the block) or
+on preemption — all of which surface as "the owned slice is no longer
+Scheduled on an in-service gang". The controller asks the torus
+allocator for the largest placeable sub-block of the desired shape
+(clean fit, never preemption — ``placement.engine.largest_placeable_shape``)
+bounded below by ``spec.gang.minShape``, patches the slice's placement
+shape to it, and the gang resumes from the newest good checkpoint on a
+re-derived mesh. **Grow** fires when the desired shape becomes placeable
+again (capacity healed): the controller first drives a checkpoint
+barrier through the progress ConfigMap (zero steps lost on a planned
+resize), then patches the shape back up.
+
+**Quarantine**: attempts that make no progress — nothing placeable at or
+above the min shape, or the trainer erroring on resume — burn a
+full-jitter backoff budget (``kube/backoff.py``, the same bounded-retry
+pattern the health controller quarantines through). The budget resets
+when the job reaches Running; exhaustion parks the job in ``Failed``
+with an Event instead of crash-looping through the placement queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.api.tpujob import (
+    TERMINAL_PHASES,
+    TPU_JOB_API_VERSION,
+    TPU_JOB_KIND,
+    JobPhase,
+    TPUJob,
+)
+from tpu_operator.api.tpuslice import (
+    TPU_SLICE_API_VERSION,
+    TPU_SLICE_KIND,
+    new_tpu_slice,
+)
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors, trace
+from tpu_operator.kube.backoff import RetryBudget
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.placement.engine import (
+    PlacementPhase,
+    labels_unavailable,
+    largest_placeable_shape,
+)
+from tpu_operator.placement.torus import parse_shape
+
+log = logging.getLogger(__name__)
+
+JOB_MANAGER = "tpu-job-controller"
+
+
+def _shape_str(shape: Tuple[int, int, int]) -> str:
+    return "x".join(str(d) for d in shape)
+
+
+def _volume(shape: Tuple[int, int, int]) -> int:
+    return math.prod(shape)
+
+
+class JobReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = EventRecorder(client, namespace, component=JOB_MANAGER)
+        self.metrics = get_metrics()
+        # full-jitter needs a private RNG so tests/drills can seed it
+        self.rng = random.Random()
+        # jobs with live labelled series, so deletion retires them (O005)
+        from tpu_operator.kube import racecheck
+
+        self._series_lock = racecheck.lock("JobReconciler._series_lock")
+        self._job_series: set = set()
+
+    # -- series hygiene ------------------------------------------------------
+
+    def _export(self, job: str, step: int, epoch: int, hosts: int, restarts: int) -> None:
+        with self._series_lock:
+            self._job_series.add(job)
+        self.metrics.job_step.labels(job).set(step)
+        self.metrics.job_epoch.labels(job).set(epoch)
+        self.metrics.job_gang_hosts.labels(job).set(hosts)
+        self.metrics.job_restarts.labels(job).set(restarts)
+
+    def _retire_series(self, job: str) -> None:
+        with self._series_lock:
+            if job not in self._job_series:
+                return
+            self._job_series.discard(job)
+        for gauge in (
+            self.metrics.job_step,
+            self.metrics.job_epoch,
+            self.metrics.job_gang_hosts,
+            self.metrics.job_restarts,
+        ):
+            try:
+                gauge.remove(job)
+            except KeyError:
+                pass
+
+    # -- cluster reads -------------------------------------------------------
+
+    def _progress(self, job: str) -> dict:
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", job + consts.JOB_PROGRESS_SUFFIX, self.namespace
+        )
+        return (cm or {}).get("data") or {}
+
+    def _degraded_links(self) -> List[tuple]:
+        from tpu_operator.controllers.fabric_telemetry import parse_link_map
+
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
+        )
+        edges = []
+        for pool_edges in parse_link_map(cm).values():
+            for edge in pool_edges:
+                a, _, b = edge.partition("|")
+                if a and b:
+                    edges.append((a, b))
+        return sorted(edges)
+
+    # -- slice management ----------------------------------------------------
+
+    def _slice_spec(self, job: TPUJob, shape: str) -> dict:
+        return {
+            "placement": {
+                "shape": shape,
+                "priority": job.spec.gang.priority,
+                "preemptionPolicy": job.spec.gang.preemption_policy,
+                **({"pool": job.spec.gang.pool} if job.spec.gang.pool else {}),
+            }
+        }
+
+    def _ensure_slice(self, obj: ObjectDict, job: TPUJob, shape: str) -> Optional[ObjectDict]:
+        """Create the owned TPUSlice (or converge its placement shape).
+        Returns the live slice, or None when the create/patch must
+        retry."""
+        name = job.name + consts.JOB_SLICE_SUFFIX
+        slice_obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+        if slice_obj is None:
+            body = new_tpu_slice(name, self._slice_spec(job, shape))
+            body["metadata"]["ownerReferences"] = [{
+                "apiVersion": TPU_JOB_API_VERSION,
+                "kind": TPU_JOB_KIND,
+                "name": job.name,
+                "uid": obj["metadata"].get("uid", ""),
+            }]
+            try:
+                return self.client.create(body)  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+            except errors.AlreadyExists:
+                return self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+            except errors.ApiError as e:
+                log.warning("job %s: slice create failed: %s", job.name, e)
+                return None
+        desired_placement = self._slice_spec(job, shape)["placement"]
+        current = (slice_obj.get("spec") or {}).get("placement") or {}
+        if any(current.get(k) != v for k, v in desired_placement.items()):
+            try:
+                self.client.patch(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+                    TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name,
+                    {"spec": self._slice_spec(job, shape)},
+                )
+            except errors.ApiError as e:
+                log.warning("job %s: slice shape patch failed: %s", job.name, e)
+                return None
+            slice_obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+        return slice_obj
+
+    def _delete_slice(self, job_name: str, owned_only: bool = False) -> None:
+        """Tear down the job's owned slice. ``owned_only`` (the
+        job-vanished sweep path) verifies the TPUJob ownerReference
+        first: a request name that never was a job (a foreign
+        ``*-progress`` ConfigMap, a mistyped name) must not delete a
+        user's coincidentally-named TPUSlice."""
+        name = job_name + consts.JOB_SLICE_SUFFIX
+        if owned_only:
+            obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+            if obj is None or not any(
+                ref.get("kind") == TPU_JOB_KIND and ref.get("name") == job_name
+                for ref in obj["metadata"].get("ownerReferences") or []
+            ):
+                return
+        try:
+            self.client.delete(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+                TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name
+            )
+        except errors.NotFound:
+            pass
+        except errors.ApiError as e:
+            log.debug("job %s: slice delete deferred: %s", job_name, e)
+
+    # -- gang health ---------------------------------------------------------
+
+    def _gang_state(self, slice_obj: Optional[ObjectDict], links: List[tuple]) -> dict:
+        """What the owned slice's world looks like: scheduled?, member
+        nodes, out-of-service members (with which signal), a link cut
+        inside the block, a preemption verdict."""
+        state = {
+            "scheduled": False, "nodes": [], "out": {}, "cut": "",
+            "preempted": False, "unschedulable": False, "message": "",
+        }
+        if slice_obj is None:
+            return state
+        placement = (slice_obj.get("status") or {}).get("placement") or {}
+        state["message"] = str(placement.get("message") or "")
+        phase = placement.get("phase")
+        state["scheduled"] = phase == PlacementPhase.SCHEDULED
+        state["unschedulable"] = phase == PlacementPhase.UNSCHEDULABLE
+        state["preempted"] = "preempted" in state["message"]
+        nodes = list(placement.get("nodes") or [])
+        state["nodes"] = nodes
+        members = set(nodes)
+        for name in nodes:
+            node = self.client.get_or_none("v1", "Node", name)
+            if node is None:
+                state["out"][name] = "node-gone"
+                continue
+            labels = node["metadata"].get("labels") or {}
+            if not labels_unavailable(labels):
+                continue
+            if labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED:
+                state["out"][name] = "grey-failure"
+            elif labels.get(consts.REPAIR_STATE_LABEL):
+                state["out"][name] = f"repair:{labels[consts.REPAIR_STATE_LABEL]}"
+            else:
+                state["out"][name] = "host-health"
+        for a, b in links:
+            if a in members and b in members:
+                state["cut"] = f"{a}|{b}"
+                break
+        return state
+
+    @staticmethod
+    def _classify_cause(gang: dict) -> str:
+        if gang["out"]:
+            node, signal = sorted(gang["out"].items())[0]
+            return f"{signal} ({node})"
+        if gang["cut"]:
+            return f"link-cut ({gang['cut']})"
+        if gang["preempted"]:
+            return "preemption"
+        if gang["unschedulable"]:
+            return "unschedulable"
+        return "re-placed"
+
+    # -- status --------------------------------------------------------------
+
+    def _publish(self, obj: ObjectDict, block: dict) -> bool:
+        current = (obj.get("status") or {}).get("job") or {}
+        if current == block:
+            return True
+        body = dict(block)
+        for stale in current:
+            if stale not in body:
+                body[stale] = None  # merge patch: null removes stale keys
+        try:
+            self.client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUJob
+                TPU_JOB_API_VERSION, TPU_JOB_KIND, obj["metadata"]["name"],
+                {"status": {"job": body, "state": block.get("phase", "")}},
+            )
+        except errors.NotFound:
+            return True
+        except errors.ApiError as e:
+            log.debug("job status publish for %s failed: %s", obj["metadata"]["name"], e)
+            return False
+        return True
+
+    def _request_progress_key(self, job_name: str, key: str, token: str) -> bool:
+        """Write one controller-owned key into the progress ConfigMap
+        (the checkpoint/restart handshakes). The gang owns the CM's
+        lifecycle; until it exists there is nobody to handshake with."""
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", job_name + consts.JOB_PROGRESS_SUFFIX,
+                {"data": {key: token}}, self.namespace,
+            )
+        except errors.NotFound:
+            return False
+        except errors.ApiError as e:
+            log.debug("job %s: progress key %s write failed: %s", job_name, key, e)
+            return False
+        return True
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(TPU_JOB_API_VERSION, TPU_JOB_KIND, req.name)
+        if obj is None:
+            # deleted: retire series; the owned slice/progress CM are
+            # GC'd via ownerReferences on a real apiserver, and swept
+            # here for stores without cascade (ownership verified — the
+            # request name may never have been a job)
+            self._retire_series(req.name)
+            self._delete_slice(req.name, owned_only=True)
+            return Result()
+        job = TPUJob.from_unstructured(obj)
+        prior = dict(job.status.job or {})
+        phase = prior.get("phase") or JobPhase.PENDING
+        if phase in TERMINAL_PHASES:
+            return Result()
+
+        # -- validate the elasticity contract once per pass
+        desired = parse_shape(job.spec.gang.shape)
+        min_shape = parse_shape(job.spec.gang.min_shape or job.spec.gang.shape)
+        if desired is None or min_shape is None or _volume(min_shape) > _volume(desired):
+            block = dict(prior)
+            self._fail(
+                obj, block,
+                f"invalid gang spec: shape={job.spec.gang.shape!r} "
+                f"minShape={job.spec.gang.min_shape!r}",
+            )
+            self._export(req.name, self._int(block.get("step")),
+                         self._int(block.get("epoch")), 0,
+                         self._int(block.get("restarts")))
+            return Result(requeue=not self._publish(obj, block))
+        budget = RetryBudget(
+            retry_limit=job.spec.backoff.retry_limit,
+            base_delay_seconds=job.spec.backoff.base_seconds,
+            max_delay_seconds=job.spec.backoff.max_seconds,
+        )
+
+        # -- world state
+        progress = self._progress(job.name)
+        step = self._int(progress.get(consts.JOB_PROGRESS_STEP), self._int(prior.get("step")))
+        epoch = self._int(progress.get(consts.JOB_PROGRESS_EPOCH), self._int(prior.get("epoch")))
+        ckpt_step = self._int(
+            progress.get(consts.JOB_PROGRESS_CHECKPOINT_STEP),
+            self._int(prior.get("checkpointStep")),
+        )
+        world = self._int(progress.get(consts.JOB_PROGRESS_WORLD))
+        pstatus = progress.get(consts.JOB_PROGRESS_STATUS, "")
+
+        block = {
+            "phase": phase,
+            "step": step,
+            "epoch": epoch,
+            "checkpointStep": ckpt_step,
+            "desiredShape": _shape_str(desired),
+            "shape": prior.get("shape") or _shape_str(desired),
+            "hosts": 0,
+            "restarts": self._int(prior.get("restarts")),
+            "totalRestarts": self._int(prior.get("totalRestarts")),
+            "shrinks": list(prior.get("shrinks") or []),
+            "causes": list(prior.get("causes") or []),
+        }
+        if prior.get("nextAttemptAt"):
+            block["nextAttemptAt"] = prior["nextAttemptAt"]
+        if prior.get("message"):
+            block["message"] = prior["message"]
+        if prior.get("barrier"):
+            block["barrier"] = prior["barrier"]
+        if prior.get("barrierSeq"):
+            block["barrierSeq"] = prior["barrierSeq"]
+
+        # -- completion first: a finished job frees its capacity
+        if pstatus == consts.JOB_PROGRESS_COMPLETE and step >= job.spec.workload.steps:
+            block.update(phase=JobPhase.SUCCEEDED, hosts=0, message="")
+            block.pop("nextAttemptAt", None)
+            self._delete_slice(job.name)
+            self.recorder.normal(
+                obj, "JobSucceeded",
+                f"training complete at step {step} (checkpoint epoch {epoch})",
+            )
+            ok = self._publish(obj, block)
+            self._export(job.name, step, epoch, 0, 0)
+            return Result(requeue=not ok)
+
+        # -- converge the owned slice to the current target shape
+        target_str = block["shape"]
+        target = parse_shape(target_str) or desired
+        slice_obj = self._ensure_slice(obj, job, target_str)
+        if slice_obj is None:
+            block["phase"] = JobPhase.PLACING  # create/patch retried next pass
+            self._publish(obj, block)
+            return Result(requeue=True)
+        links = self._degraded_links()
+        gang = self._gang_state(slice_obj, links)
+        healthy = gang["scheduled"] and not gang["out"] and not gang["cut"]
+        block["hosts"] = len(gang["nodes"]) if healthy else 0
+
+        with trace.span(
+            "job-fsm", phase=phase, healthy=healthy, step=step, shape=target_str
+        ):
+            if healthy:
+                result = self._reconcile_healthy(
+                    obj, job, block, budget, desired, target, world, pstatus, progress
+                )
+            else:
+                result = self._reconcile_broken(
+                    obj, job, block, budget, desired, min_shape, gang, links
+                )
+        self._export(
+            job.name, block["step"], block["epoch"], block["hosts"], block["restarts"]
+        )
+        ok = self._publish(obj, block)
+        if not ok:
+            return Result(requeue=True)
+        if block["phase"] in TERMINAL_PHASES:
+            return Result()
+        return result
+
+    # -- the healthy half ----------------------------------------------------
+
+    def _reconcile_healthy(
+        self,
+        obj: ObjectDict,
+        job: TPUJob,
+        block: dict,
+        budget: RetryBudget,
+        desired: Tuple[int, int, int],
+        target: Tuple[int, int, int],
+        world: int,
+        pstatus: str,
+        progress: dict,
+    ) -> Result:
+        phase = block["phase"]
+        hosts = block["hosts"]
+
+        if pstatus == consts.JOB_PROGRESS_FAILED:
+            # the gang is placed but training errored: restart from the
+            # newest good checkpoint, against the budget
+            return self._charge_attempt(
+                obj, job, block, budget,
+                cause=f"trainer-error: {progress.get(consts.JOB_PROGRESS_ERROR, '')}".strip(),
+                restart=True,
+            )
+
+        if phase == JobPhase.CHECKPOINTING:
+            token = str(block.get("barrier") or "")
+            ack = progress.get(consts.JOB_PROGRESS_CHECKPOINT_ACK, "")
+            if not token or target == desired:
+                # lost/landed barrier: drop back to Running (the grow
+                # check re-fires next pass if capacity still allows)
+                block["phase"] = JobPhase.RUNNING
+                block.pop("barrier", None)
+            elif ack == token:
+                # barrier satisfied: grow — zero steps past the barrier.
+                # Re-verify first: capacity may have vanished while the
+                # gang checkpointed, and a blind grow would bounce the
+                # job through Unschedulable for nothing.
+                block.pop("barrier", None)
+                if self._placeable(job, desired, _volume(desired), exclude_self=True):
+                    self._record_resize(
+                        obj, job, block, _shape_str(desired), "grow",
+                        cause="capacity healed",
+                    )
+                else:
+                    block["phase"] = JobPhase.RUNNING
+            return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
+
+        if phase in (
+            JobPhase.PENDING, JobPhase.PLACING, JobPhase.SHRINKING,
+            JobPhase.GROWING, JobPhase.RESUMING,
+        ):
+            # placed; wait for the gang to train at this world size
+            if world == hosts and pstatus == consts.JOB_PROGRESS_RUNNING:
+                if phase != JobPhase.PENDING and block["restarts"]:
+                    self.recorder.normal(
+                        obj, "JobResumed",
+                        f"resumed at step {block['step']} on {hosts} host(s)",
+                    )
+                block["phase"] = JobPhase.RUNNING
+                block["restarts"] = 0  # progress resets the failure streak
+                block.pop("nextAttemptAt", None)
+                block["message"] = ""
+                if phase != JobPhase.RUNNING:
+                    self.recorder.normal(
+                        obj, "JobPlaced",
+                        f"gang of {hosts} host(s) placed as "
+                        f"{_shape_str(target)}; training",
+                    )
+            else:
+                block["phase"] = (
+                    JobPhase.RESUMING
+                    if phase in (JobPhase.SHRINKING, JobPhase.GROWING, JobPhase.RESUMING)
+                    else JobPhase.PLACING
+                )
+            return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
+
+        # phase == RUNNING: look for a grow opportunity
+        if target != desired:
+            grown = self._placeable(job, desired, _volume(desired), exclude_self=True)
+            if grown is not None:
+                # monotonic sequence persisted in status: the token can
+                # never repeat, so a stale checkpointAck from an EARLIER
+                # grow can never satisfy this barrier (ack == token with
+                # no fresh checkpoint would lose up to a cadence of
+                # steps on a planned resize)
+                seq = self._int(block.get("barrierSeq")) + 1
+                token = f"grow-{seq}-{block['step']}"
+                if self._request_progress_key(
+                    job.name, consts.JOB_CHECKPOINT_REQUEST, token
+                ):
+                    block["barrierSeq"] = seq
+                    block["phase"] = JobPhase.CHECKPOINTING
+                    block["barrier"] = token
+                    self.recorder.normal(
+                        obj, "JobGrowing",
+                        f"capacity healed: checkpointing before growing "
+                        f"{_shape_str(target)} -> {_shape_str(desired)}",
+                    )
+        return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
+
+    # -- the broken half -----------------------------------------------------
+
+    def _reconcile_broken(
+        self,
+        obj: ObjectDict,
+        job: TPUJob,
+        block: dict,
+        budget: RetryBudget,
+        desired: Tuple[int, int, int],
+        min_shape: Tuple[int, int, int],
+        gang: dict,
+        links: List[tuple],
+    ) -> Result:
+        cause = self._classify_cause(gang)
+        best = self._placeable(
+            job, desired, _volume(min_shape), exclude_self=True, links=links
+        )
+        if best is None:
+            # nothing at or above the min shape places: burn the budget
+            return self._charge_attempt(
+                obj, job, block, budget,
+                cause=f"{cause}; no placeable block >= {_shape_str(min_shape)}",
+            )
+        best_str = _shape_str(best)
+        target_str = block["shape"]
+        if best_str != target_str:
+            kind = (
+                "shrink"
+                if _volume(best) < _volume(parse_shape(target_str) or desired)
+                else "grow"
+            )
+            self._record_resize(obj, job, block, best_str, kind, cause=cause)
+        elif block["phase"] == JobPhase.PENDING:
+            block["phase"] = JobPhase.PLACING  # fresh job waiting for admission
+        elif block["phase"] != JobPhase.PLACING:
+            # same shape still places: the placement engine re-places it
+            # by itself; just track the transition
+            block["phase"] = JobPhase.PLACING
+            block["message"] = f"re-placing after {cause}"
+            self._note_cause(block, f"step {block['step']}: {cause}")
+        return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
+
+    # -- shared transitions --------------------------------------------------
+
+    def _placeable(
+        self,
+        job: TPUJob,
+        desired: Tuple[int, int, int],
+        min_volume: int,
+        exclude_self: bool = False,
+        links: Optional[List[tuple]] = None,
+    ) -> Optional[Tuple[int, int, int]]:
+        try:
+            slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+            nodes = self.client.list("v1", "Node")
+        except errors.ApiError as e:
+            log.warning("job %s: allocator inputs unreadable: %s", job.name, e)
+            return None
+        return largest_placeable_shape(
+            slices, nodes, desired, min_volume,
+            degraded_links=links if links is not None else self._degraded_links(),
+            pool=job.spec.gang.pool,
+            exclude=[job.name + consts.JOB_SLICE_SUFFIX] if exclude_self else [],
+        )
+
+    def _record_resize(
+        self, obj: ObjectDict, job: TPUJob, block: dict, new_shape: str,
+        kind: str, cause: str,
+    ) -> None:
+        """Patch the owned slice to ``new_shape`` and book the resize in
+        status (shrink history + cause log)."""
+        try:
+            self.client.patch(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+                TPU_SLICE_API_VERSION, TPU_SLICE_KIND,
+                job.name + consts.JOB_SLICE_SUFFIX,
+                {"spec": self._slice_spec(job, new_shape)},
+            )
+        except errors.ApiError as e:
+            log.warning("job %s: %s to %s failed: %s", job.name, kind, new_shape, e)
+            return
+        old = block["shape"]
+        block["shape"] = new_shape
+        block["phase"] = JobPhase.SHRINKING if kind == "shrink" else JobPhase.GROWING
+        block["message"] = ""
+        history = list(block.get("shrinks") or [])
+        history.append({
+            "step": block["step"], "from": old, "to": new_shape,
+            "kind": kind, "cause": cause,
+        })
+        block["shrinks"] = history[-consts.JOB_HISTORY_LIMIT:]
+        if kind == "shrink":
+            self._note_cause(block, f"step {block['step']}: {cause}")
+        event_type = "Warning" if kind == "shrink" else "Normal"
+        self.recorder.event(
+            obj, event_type, "JobShrunk" if kind == "shrink" else "JobGrown",
+            f"{kind} {old} -> {new_shape} ({cause}); resuming from "
+            f"checkpoint epoch {block['epoch']} (step {block['checkpointStep']})",
+        )
+
+    def _note_cause(self, block: dict, cause: str) -> None:
+        causes = list(block.get("causes") or [])
+        if not causes or causes[-1] != cause:
+            causes.append(cause)
+        block["causes"] = causes[-consts.JOB_CAUSES_LIMIT:]
+
+    def _charge_attempt(
+        self,
+        obj: ObjectDict,
+        job: TPUJob,
+        block: dict,
+        budget: RetryBudget,
+        cause: str,
+        restart: bool = False,
+    ) -> Result:
+        """One failed attempt against the retry budget, gated by the
+        persisted next-attempt time so event-driven wakeups can't burn
+        the budget faster than the backoff schedule."""
+        now = time.time()
+        next_at = self._float(block.get("nextAttemptAt"))
+        if now < next_at:
+            return Result(requeue_after=min(next_at - now, consts.JOB_RESYNC_SECONDS))
+        attempts = self._int(block.get("restarts"))
+        if budget.exhausted(attempts):
+            self._fail(
+                obj, block, f"retry budget exhausted ({attempts} attempts): {cause}"
+            )
+            return Result()
+        attempts += 1
+        delay = budget.delay(attempts, self.rng)
+        block["restarts"] = attempts
+        block["totalRestarts"] = self._int(block.get("totalRestarts")) + 1
+        block["nextAttemptAt"] = round(now + delay, 3)
+        block["message"] = cause
+        self._note_cause(block, f"step {block['step']}: {cause}")
+        if restart:
+            token = str(block["totalRestarts"])
+            self._request_progress_key(job.name, consts.JOB_RESTART_REQUEST, token)
+            block["phase"] = JobPhase.RESUMING
+            self.recorder.warning(
+                obj, "JobRestarted",
+                f"restart {attempts}/{budget.retry_limit} after {cause}; "
+                f"resuming from checkpoint epoch {block['epoch']}",
+            )
+        else:
+            block["phase"] = JobPhase.PLACING
+        return Result(requeue_after=max(delay, 0.01))
+
+    def _fail(self, obj: ObjectDict, block: dict, message: str) -> None:
+        """Terminal quarantine: mutate ``block`` to Failed, tear the
+        owned slice down (a dead job never holds capacity or
+        placement-queue slots), and record the Event. The caller's
+        single status publish/export tail does the writing — one
+        tpujobs/status patch per quarantine, not two."""
+        block["phase"] = JobPhase.FAILED
+        block["hosts"] = 0
+        block["message"] = message
+        block.pop("nextAttemptAt", None)
+        block.pop("barrier", None)
+        self._delete_slice(obj["metadata"]["name"])
+        self.recorder.warning(obj, "JobFailed", f"quarantined: {message}")
+
+    @staticmethod
+    def _int(value, default: int = 0) -> int:
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return default
+
+    @staticmethod
+    def _float(value, default: float = 0.0) -> float:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return default
+
+
+def setup_with_manager(mgr, reconciler: JobReconciler) -> Controller:
+    ctrl = Controller("tpujob", reconciler)
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_owned_slice(obj: ObjectDict) -> List[Request]:
+        # ONLY slices carrying a TPUJob ownerReference map back to a
+        # job: a user's standalone TPUSlice that merely happens to end
+        # in "-slice" is not this controller's to reconcile (or sweep)
+        for ref in obj["metadata"].get("ownerReferences") or []:
+            if ref.get("kind") == TPU_JOB_KIND:
+                return [Request(name=ref["name"])]
+        return []
+
+    def placement_status_changed(event_type, old, new) -> bool:
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (
+            (old.get("status") or {}).get("placement")
+            != (new.get("status") or {}).get("placement")
+        )
+
+    def map_progress_cm(obj: ObjectDict) -> List[Request]:
+        name = obj["metadata"]["name"]
+        if not name.endswith(consts.JOB_PROGRESS_SUFFIX):
+            return []
+        return [Request(name=name[: -len(consts.JOB_PROGRESS_SUFFIX)])]
+
+    def progress_changed(event_type, old, new) -> bool:
+        if not new["metadata"]["name"].endswith(consts.JOB_PROGRESS_SUFFIX):
+            return False
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("data") or {}) != (new.get("data") or {})
+
+    def map_to_all_jobs(_obj) -> List[Request]:
+        try:
+            jobs = reconciler.client.list(TPU_JOB_API_VERSION, TPU_JOB_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=j["metadata"]["name"]) for j in jobs]
+
+    def service_labels_changed(event_type, old, new) -> bool:
+        """Node events that can break or heal a gang: the out-of-service
+        signals plus assignment-label churn."""
+        keys = (
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
+            consts.PLACEMENT_LABEL,
+        )
+        if event_type != "MODIFIED" or old is None:
+            return True
+        old_labels = old["metadata"].get("labels") or {}
+        new_labels = new["metadata"].get("labels") or {}
+        return any(old_labels.get(k) != new_labels.get(k) for k in keys)
+
+    ctrl.watch(
+        mgr.informer_for(TPU_JOB_API_VERSION, TPU_JOB_KIND), predicate=generation_changed
+    )
+    ctrl.watch(
+        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
+        mapper=map_owned_slice, predicate=placement_status_changed,
+    )
+    ctrl.watch(
+        mgr.informer_for("v1", "ConfigMap", reconciler.namespace),
+        mapper=map_progress_cm, predicate=progress_changed,
+    )
+    ctrl.watch(
+        mgr.informer_for("v1", "Node"),
+        mapper=map_to_all_jobs, predicate=service_labels_changed,
+    )
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -203,6 +203,39 @@ class OperatorMetrics:
             ["generation"],
             registry=reg,
         )
+        # elastic training jobs (controllers/job_controller.py): per-job
+        # bookkeeping gauges, removed when the TPUJob is deleted (O005)
+        self.job_step = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_job_step",
+            "Last train step the job's gang reported completing",
+            ["job"],
+            registry=reg,
+        )
+        self.job_epoch = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_job_checkpoint_epoch",
+            "Newest checkpoint epoch in the job's store (the resume "
+            "watermark: no step past it is ever lost)",
+            ["job"],
+            registry=reg,
+        )
+        self.job_gang_hosts = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_job_gang_hosts",
+            "Hosts in the job's currently placed gang (0 while the gang "
+            "is broken or being re-placed)",
+            ["job"],
+            registry=reg,
+        )
+        self.job_restarts = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_job_restarts",
+            "Consecutive failed restart/re-place attempts charged against "
+            "the job's retry budget (resets when the job reaches Running)",
+            ["job"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

@@ -1,0 +1,67 @@
+"""Bounded-retry policy: jittered backoff + a retry budget + quarantine.
+
+Two controllers walk the same shape — try, back off, try again, and
+after a bounded number of attempts STOP and hand the object to a human
+instead of crash-looping through the cluster forever:
+
+- the health controller's repair FSM (each repair attempt burns one
+  unit of ``spec.healthMonitor.remediation.retryLimit``; exhaustion
+  parks the node in the ``quarantined`` terminal label), and
+- the TPUJob FSM (each restart/re-place attempt burns one unit of
+  ``spec.backoff.retryLimit``; exhaustion parks the job in ``Failed``
+  with an Event instead of cycling through the placement queue).
+
+This module is that pattern factored once (so there is never a third
+copy): a :class:`RetryBudget` couples the budget decision to the
+full-jitter delay schedule (``kube/retry.full_jitter`` — the same
+AWS-style uniform(0, min(cap, base*2^n)) the workqueue and the HTTP
+client use, so a fleet of backed-off jobs never thundering-herds the
+placement queue in lockstep), plus the annotation-counter helpers both
+controllers persist their attempt counts through (all FSM state lives
+in the cluster and survives operator restarts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Mapping, Optional
+
+from tpu_operator.kube.retry import full_jitter
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryBudget:
+    """A bounded-retry policy: ``retry_limit`` attempts, each backed off
+    by full-jitter exponential delay, then terminal quarantine.
+
+    ``retry_limit`` counts ATTEMPTS ALLOWED, matching the health
+    controller's historical semantics: ``exhausted(attempts)`` is true
+    once ``attempts`` already-spent units meet the limit, so a limit of
+    0 quarantines immediately and a negative limit clamps to 0.
+    """
+
+    retry_limit: int
+    base_delay_seconds: float = 1.0
+    max_delay_seconds: float = 60.0
+
+    def exhausted(self, attempts: int) -> bool:
+        """Whether ``attempts`` already-burned units spend the budget."""
+        return attempts >= max(0, self.retry_limit)
+
+    def delay(self, attempts: int, rng: Optional[random.Random] = None) -> float:
+        """Full-jitter backoff before attempt number ``attempts`` (the
+        first retry passes 1): uniform(0, min(cap, base*2^(n-1)))."""
+        return full_jitter(
+            max(0, attempts - 1), self.base_delay_seconds, self.max_delay_seconds, rng
+        )
+
+
+def read_attempts(annotations: Optional[Mapping[str, str]], key: str) -> int:
+    """Attempt counter persisted as an object annotation (the repair
+    FSM's ``tpu.repair-retries`` shape): absent or mangled reads 0 — a
+    hand-edited counter must degrade to a fresh budget, never a crash."""
+    try:
+        return int((annotations or {}).get(key, "0"))
+    except (TypeError, ValueError):
+        return 0

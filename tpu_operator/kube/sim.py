@@ -377,6 +377,209 @@ def make_bare_node(name: str, extra_labels: Optional[dict] = None) -> dict:
     )
 
 
+class GangFaultSchedule:
+    """Seeded kill/heal schedule against a placed gang: the chaos
+    director for the DATA plane. Where ``kube/chaos.py`` breaks the
+    apiserver conversation, this breaks the WORLD the TPUJob controller
+    manages — one fault class at a time, each healed after a bounded
+    number of passes, so an elastic job must checkpoint → shrink →
+    resume → grow through every out-of-service signal it claims to ride:
+
+    - ``host-death``   — a gang member's health verdict flips degraded
+                         (the health-FSM signal)
+    - ``grey-failure`` — a member takes the exporter's sustained
+                         perf-floor-breach label
+    - ``link-cut``     — a torus edge between two gang members lands in
+                         the link-health map (the fabric-blame signal)
+    - ``preemption``   — a higher-priority TPUSlice arrives with
+                         PreemptLower and takes the gang's block
+
+    Deterministic: same seed + same driving sequence → the same fault
+    log (``self.log``). Driven in passes by the job drill, the chaos
+    rider, and ``bench.py --job-smoke`` between reconcile beats.
+    """
+
+    FAULT_CLASSES = ("host-death", "grey-failure", "link-cut", "preemption")
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        slice_name: str,
+        seed: int = 0,
+        classes=FAULT_CLASSES,
+        start_at: int = 2,
+        every: int = 6,
+        heal_after: int = 3,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.slice_name = slice_name
+        self.seed = seed
+        self.heal_after = heal_after
+        self._rng = random.Random(seed)
+        order = list(classes)
+        self._rng.shuffle(order)
+        self._pending = [(start_at + i * every, cls) for i, cls in enumerate(order)]
+        self._active: Optional[dict] = None
+        self._pass = 0
+        self.log: list = []  # (pass, "inject"|"heal", class, detail)
+        self.fired: set = set()
+
+    # -- gang introspection --------------------------------------------------
+
+    def _members(self) -> list:
+        """Current gang members by worker order, from the assignment
+        labels (the same source of truth the engine reads)."""
+        from tpu_operator import consts as _consts
+
+        members = []
+        for node in self.client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(_consts.PLACEMENT_LABEL) != self.slice_name:
+                continue
+            try:
+                index = int(labels.get(_consts.PLACEMENT_INDEX_LABEL, "0"))
+            except ValueError:
+                index = 0
+            members.append((index, node))
+        return [n for _, n in sorted(members, key=lambda t: (t[0], t[1]["metadata"]["name"]))]
+
+    def done(self) -> bool:
+        return not self._pending and self._active is None
+
+    # -- one pass ------------------------------------------------------------
+
+    def step(self) -> list:
+        """Advance one pass: heal the active fault when due, then inject
+        the next scheduled one (one at a time — the job must fully
+        recover between fault classes or the run can't tell which class
+        broke it). Returns the actions taken this pass."""
+        self._pass += 1
+        actions = []
+        if self._active is not None and self._pass >= self._active["heal_at"]:
+            self._heal(self._active)
+            actions.append(("heal", self._active["class"], self._active["detail"]))
+            self.log.append((self._pass, "heal", self._active["class"], self._active["detail"]))
+            self._active = None
+        if self._active is None and self._pending and self._pass >= self._pending[0][0]:
+            cls = self._pending[0][1]
+            detail = self._inject(cls)
+            if detail is not None:  # gang mid-replace: retry next pass
+                self._pending.pop(0)
+                self._active = {
+                    "class": cls, "detail": detail, "heal_at": self._pass + self.heal_after,
+                }
+                self.fired.add(cls)
+                actions.append(("inject", cls, detail))
+                self.log.append((self._pass, "inject", cls, detail))
+        return actions
+
+    # -- fault application ---------------------------------------------------
+
+    def _patch_node_labels(self, name: str, labels: dict) -> None:
+        try:
+            self.client.patch("v1", "Node", name, {"metadata": {"labels": labels}})
+        except errors.NotFound:
+            pass
+
+    def _inject(self, cls: str) -> Optional[str]:
+        from tpu_operator import consts as _consts
+
+        members = self._members()
+        if cls == "preemption":
+            target = self.client.get_or_none(
+                "tpu.google.com/v1alpha1", "TPUSlice", self.slice_name
+            )
+            placement = ((target or {}).get("status") or {}).get("placement") or {}
+            shape = placement.get("shape")
+            if not members or not shape:
+                return None
+            priority = int(placement.get("priority") or 0) + 100
+            name = f"{self.slice_name}-chaos-preemptor"
+            try:
+                self.client.create({  # tpuop-lint: ignore
+                    "apiVersion": "tpu.google.com/v1alpha1",
+                    "kind": "TPUSlice",
+                    "metadata": {"name": name},
+                    "spec": {"placement": {
+                        "shape": shape, "priority": priority,
+                        "preemptionPolicy": "PreemptLower",
+                    }},
+                })
+            except errors.AlreadyExists:
+                pass
+            return name
+        if not members:
+            return None
+        if cls == "host-death":
+            victim = self._rng.choice(members)["metadata"]["name"]
+            self._patch_node_labels(victim, {_consts.TPU_HEALTH_LABEL: _consts.HEALTH_DEGRADED})
+            return victim
+        if cls == "grey-failure":
+            victim = self._rng.choice(members)["metadata"]["name"]
+            self._patch_node_labels(victim, {_consts.TPU_PERF_LABEL: _consts.PERF_DEGRADED})
+            return victim
+        if cls == "link-cut":
+            if len(members) < 2:
+                return None
+            at = self._rng.randrange(len(members) - 1)
+            a = members[at]["metadata"]["name"]
+            b = members[at + 1]["metadata"]["name"]
+            edge = "|".join(sorted((a, b)))
+            pool = (
+                members[at]["metadata"].get("labels") or {}
+            ).get("cloud.google.com/gke-nodepool") or "chaos"
+            self._write_link_map(pool, {edge: {"bandwidth_gbps": 0.1, "blame": "chaos"}})
+            return edge
+        raise ValueError(f"unknown fault class {cls!r}")
+
+    def _heal(self, active: dict) -> None:
+        from tpu_operator import consts as _consts
+
+        cls, detail = active["class"], active["detail"]
+        if cls == "host-death":
+            self._patch_node_labels(detail, {_consts.TPU_HEALTH_LABEL: _consts.HEALTH_HEALTHY})
+        elif cls == "grey-failure":
+            self._patch_node_labels(detail, {_consts.TPU_PERF_LABEL: None})
+        elif cls == "link-cut":
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", _consts.LINK_HEALTH_CONFIGMAP, self.namespace
+            )
+            for pool in list(((cm or {}).get("data") or {})):
+                self._write_link_map(pool, {})
+        elif cls == "preemption":
+            try:
+                self.client.delete(  # tpuop-lint: ignore
+                    "tpu.google.com/v1alpha1", "TPUSlice", detail
+                )
+            except errors.NotFound:
+                pass
+
+    def _write_link_map(self, pool: str, edges: dict) -> None:
+        import json
+
+        from tpu_operator import consts as _consts
+        from tpu_operator.kube.objects import new_object
+
+        body = json.dumps({"edges": edges}, sort_keys=True)
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", _consts.LINK_HEALTH_CONFIGMAP,
+                {"data": {pool: body}}, self.namespace,
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: ignore
+                    new_object(
+                        "v1", "ConfigMap", _consts.LINK_HEALTH_CONFIGMAP,
+                        self.namespace, data={pool: body},
+                    )
+                )
+            except errors.AlreadyExists:
+                pass
+
+
 class StubKubelet:
     """In-process kubelet device-plugin Registration service (v1beta1) on a
     unix socket, capturing Register calls — the kubelet half of the device

@@ -24,6 +24,7 @@ from typing import List, Tuple
 import yaml
 
 from tpu_operator.api.clusterpolicy import CLUSTER_POLICY_API_VERSION
+from tpu_operator.api.tpujob import TPU_JOB_API_VERSION
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
@@ -35,6 +36,7 @@ _COLLECTIONS: List[Tuple[str, str, str, bool]] = [
     ("nodes", "v1", "Node", False),
     ("clusterpolicies", CLUSTER_POLICY_API_VERSION, "ClusterPolicy", False),
     ("tpuslices", TPU_SLICE_API_VERSION, "TPUSlice", False),
+    ("tpujobs", TPU_JOB_API_VERSION, "TPUJob", False),
     ("daemonsets", "apps/v1", "DaemonSet", True),
     ("pods", "v1", "Pod", True),
     ("services", "v1", "Service", True),
@@ -172,6 +174,42 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("placement.txt", "\n".join(lines) + "\n")
     except errors.ApiError as e:
         emit("placement.txt", f"# collection failed: {e}\n")
+
+    try:
+        # the elastic-training view: per-job FSM state, checkpoint
+        # watermarks, shrink/grow history and the last restart causes —
+        # where "why did my job shrink / why is it Failed" starts
+        from tpu_operator import consts as _consts
+
+        lines = ["# jobs"]
+        rows = []
+        for tj in client.list(TPU_JOB_API_VERSION, "TPUJob"):
+            spec = tj.get("spec") or {}
+            gang = spec.get("gang") or {}
+            job = (tj.get("status") or {}).get("job") or {}
+            rows.append(
+                f"{tj['metadata']['name']}  phase={job.get('phase', '-')}  "
+                f"step={job.get('step', 0)}  "
+                f"checkpointEpoch={job.get('epoch', 0)}  "
+                f"checkpointStep={job.get('checkpointStep', 0)}  "
+                f"shape={job.get('shape', '-')}/{gang.get('shape', '-')}"
+                f"(min={gang.get('minShape', '-')})  "
+                f"hosts={job.get('hosts', 0)}  "
+                f"restarts={job.get('restarts', 0)}/{job.get('totalRestarts', 0)}"
+                + (f"  message={job.get('message')}" if job.get("message") else "")
+            )
+            for entry in job.get("shrinks") or []:
+                rows.append(
+                    f"  resize step={entry.get('step')}  {entry.get('kind')}  "
+                    f"{entry.get('from')} -> {entry.get('to')}  "
+                    f"cause={entry.get('cause')}"
+                )
+            for cause in (job.get("causes") or [])[-_consts.JOB_CAUSES_LIMIT:]:
+                rows.append(f"  cause {cause}")
+        lines.extend(rows or ["# none"])
+        emit("jobs.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("jobs.txt", f"# collection failed: {e}\n")
 
     try:
         # the data-plane telemetry view: fleet rollup (per-node perf

@@ -168,6 +168,63 @@ class Plan:
                 delta[consts.PLACEMENT_TOPOLOGY_LABEL] = None
 
 
+def shrink_candidates(desired: Tuple[int, int, int], min_volume: int) -> List[Tuple[int, int, int]]:
+    """Every sub-shape of ``desired`` worth shrinking to, largest first:
+    shapes that fit inside the desired block (component-wise after
+    sorting — the allocator tries orientations anyway) with volume in
+    [min_volume, desired volume], deduped up to rotation. Largest-first
+    is the elasticity contract: a job shrinks no further than capacity
+    forces it to."""
+    a, b, c = sorted(desired, reverse=True)
+    seen: Dict[Tuple[int, int, int], Tuple[int, int, int]] = {}
+    for x in range(1, a + 1):
+        for y in range(1, b + 1):
+            for z in range(1, c + 1):
+                canon = tuple(sorted((x, y, z), reverse=True))
+                if canon[1] > b or canon[2] > c:
+                    continue  # doesn't fit inside the desired block
+                if not max(1, min_volume) <= x * y * z <= a * b * c:
+                    continue
+                seen.setdefault(canon, canon)
+    # largest volume first; most-cubic (then lexicographic) tiebreak so
+    # every controller replica ranks identically
+    return sorted(
+        seen.values(),
+        key=lambda s: (-(s[0] * s[1] * s[2]), s[0] - s[2], s),
+    )
+
+
+def largest_placeable_shape(
+    slices: Sequence[ObjectDict],
+    nodes: Sequence[ObjectDict],
+    desired: Tuple[int, int, int],
+    min_volume: int,
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+    pool: str = "",
+    exclude: Sequence[str] = (),
+) -> Optional[Tuple[int, int, int]]:
+    """The largest sub-block of ``desired`` the allocator ranks placeable
+    RIGHT NOW — the TPUJob shrink/grow oracle. Clean fits only (an
+    elastic resize never preempts); ``exclude`` names slices whose
+    current assignments count as free (the job's own gang, which moves).
+    The engine's plan() is replayed first so intact foreign gangs occupy
+    their cells, broken/orphaned ones free theirs, and pending requests
+    take the blocks admission would give them — the candidate ranking
+    then sees the same world the next placement pass will."""
+    kept = [s for s in slices if s["metadata"]["name"] not in set(exclude)]
+    engine = PlacementEngine(kept, nodes, degraded_links=degraded_links)
+    engine.plan()
+    pool_names = [pool] if pool else sorted(engine.pools)
+    for shape in shrink_candidates(desired, min_volume):
+        for pool_name in pool_names:
+            entry = engine.pools.get(pool_name)
+            if entry is None:
+                continue
+            if entry[1].find_block(shape) is not None:
+                return shape
+    return None
+
+
 class PlacementEngine:
     def __init__(
         self,

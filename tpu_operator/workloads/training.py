@@ -1,0 +1,476 @@
+"""Resumable elastic training: the TPUJob data plane.
+
+A :class:`ResumableTrainer` wraps the burn-in transformer train step
+(``workloads/burnin.py``) with the two properties elastic training
+needs:
+
+- **checkpoint/resume** through ``workloads/checkpoint.py`` — params
+  leave the device as plain numpy arrays, so a checkpoint taken on one
+  mesh restores onto ANY mesh;
+- **mesh re-derivation** — the trainer is told how many HOSTS its gang
+  currently has and derives a device mesh for that world size. The
+  global batch is fixed, so the loss at step *k* is a pure function of
+  the initial params and *k* — which is exactly what makes loss-curve
+  continuity provable across a shrink: resume from the last checkpoint
+  on a smaller mesh and the curve continues where it left off (modulo
+  reduction-order float noise).
+
+:class:`InProcessJobRunner` is the gang harness drills/bench/CI use: it
+plays the data plane against a (fake or real) apiserver — reads the
+job's placed gang from cluster state, pauses when the gang is broken (a
+real gang's collectives would hang on a dead member), resumes from
+checkpoint when the gang shape changes, honors the controller's
+pre-grow checkpoint barrier, and publishes the job progress ConfigMap
+the controller reads bookkeeping from.
+
+``verify_continuity`` is the acceptance predicate: every rewind in the
+executed-step history must land exactly one past a checkpointed step
+(no step lost beyond the last checkpoint, no step repeated past it),
+the executed set must cover 1..total contiguously, and re-executed
+steps must reproduce their recorded losses.
+
+jax is imported inside functions only: the module is importable
+operator-side (the job controller never trains).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tpu_operator import consts
+from tpu_operator.workloads.checkpoint import CheckpointStore
+
+log = logging.getLogger(__name__)
+
+
+class TrainerError(RuntimeError):
+    """A training step failed (injected fault or a real non-finite
+    loss): the runner publishes ``status=error`` and the controller
+    decides whether to burn a restart or quarantine the job."""
+
+
+def trainer_config(overrides: Optional[dict] = None):
+    """A BurninConfig from a TPUJob's ``spec.workload.config`` dict
+    (keys = BurninConfig field names; unknown keys ignored so a newer CR
+    never crashes an older trainer). The default is a deliberately tiny
+    model — the sim trains on CPU."""
+    from tpu_operator.workloads.burnin import BurninConfig
+
+    base = {
+        "d_model": 32,
+        "n_heads": 2,
+        "d_ff": 64,
+        "seq_len": 16,
+        "batch": 8,
+        "n_layers": 1,
+    }
+    known = {f.name for f in dataclasses.fields(BurninConfig)}
+    for key, value in (overrides or {}).items():
+        if key in known:
+            base[key] = value
+    return BurninConfig(**base)
+
+
+def derive_world(hosts: int, batch: int) -> int:
+    """Device count for a gang of ``hosts``: the largest power of two
+    that fits the hosts, the visible devices, and the fixed global batch
+    (every candidate data-axis size must divide it). Deterministic, so
+    every gang member derives the same mesh."""
+    import jax
+
+    cap = max(1, min(hosts, len(jax.devices()), batch))
+    world = 1
+    while world * 2 <= cap:
+        world *= 2
+    return world
+
+
+@dataclasses.dataclass
+class ResumeInfo:
+    epoch: int  # checkpoint epoch resumed from (0 = from scratch)
+    step: int  # step the trainer restarts at
+    world: int  # devices in the re-derived mesh
+    hosts: int  # gang hosts the world was derived from
+    latency_s: float  # wall time of the whole resume (mesh + load + put)
+
+
+class ResumableTrainer:
+    """One job's stepped training loop, elastically resumable."""
+
+    def __init__(
+        self,
+        store: CheckpointStore,
+        cfg=None,
+        total_steps: int = 40,
+        checkpoint_every: int = 10,
+        fail_at_steps: Sequence[int] = (),
+    ):
+        self.store = store
+        self.cfg = cfg or trainer_config()
+        self.total_steps = int(total_steps)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.step = 0
+        self.hosts = 0
+        self.world = 0
+        self.checkpoint_epoch = 0
+        self.checkpoint_step = 0
+        # executed-step history incl. re-runs after resume: the
+        # continuity evidence (step, loss, world)
+        self.history: List[dict] = []
+        self.checkpoints: List[dict] = []  # {epoch, step}
+        self.step_times: Dict[int, List[float]] = {}  # world -> durations
+        self.resumes: List[ResumeInfo] = []
+        # one-shot injected faults: executing one of these steps raises
+        # TrainerError instead (then arms off, like a transient crash)
+        self._fail_at = set(int(s) for s in fail_at_steps)
+        self._mesh = None
+        self._step_fn = None
+        self._params = None
+        self._batch = None
+
+    @property
+    def done(self) -> bool:
+        return self.step >= self.total_steps
+
+    # -- resume --------------------------------------------------------------
+
+    def resume(self, hosts: int) -> ResumeInfo:
+        """(Re)build the mesh for a gang of ``hosts`` and restore from
+        the newest good checkpoint (or initialize at step 0). Always
+        restarts at the checkpoint step: work past it is re-executed —
+        that is the resume guarantee's cost, bounded by the cadence."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from tpu_operator.workloads.burnin import (
+            build_train_step,
+            make_mesh,
+            param_shardings,
+        )
+
+        t0 = time.perf_counter()
+        world = derive_world(hosts, self.cfg.batch)
+        devices = jax.devices()[:world]
+        mesh = make_mesh(devices)
+        step_fn, params, batch = build_train_step(mesh, self.cfg)
+        ckpt = self.store.latest_good()
+        if ckpt is not None:
+            specs = param_shardings(self.cfg)
+            params = {
+                k: jax.device_put(
+                    np.asarray(ckpt.arrays[k]), NamedSharding(mesh, specs[k])
+                )
+                for k in params
+            }
+            self.step = ckpt.step
+            self.checkpoint_epoch = ckpt.epoch
+            self.checkpoint_step = ckpt.step
+        else:
+            self.step = 0
+        self._mesh, self._step_fn, self._params, self._batch = mesh, step_fn, params, batch
+        self.hosts, self.world = hosts, world
+        info = ResumeInfo(
+            epoch=self.checkpoint_epoch,
+            step=self.step,
+            world=world,
+            hosts=hosts,
+            latency_s=time.perf_counter() - t0,
+        )
+        self.resumes.append(info)
+        return info
+
+    # -- stepping ------------------------------------------------------------
+
+    def run(self, max_steps: int) -> int:
+        """Advance up to ``max_steps`` (stopping at total_steps),
+        checkpointing at the cadence; returns steps executed."""
+        if self._step_fn is None:
+            raise RuntimeError("resume() before run()")
+        executed = 0
+        while executed < max_steps and self.step < self.total_steps:
+            nxt = self.step + 1
+            if nxt in self._fail_at:
+                self._fail_at.discard(nxt)
+                raise TrainerError(f"injected failure at step {nxt}")
+            t0 = time.perf_counter()
+            self._params, loss = self._step_fn(self._params, self._batch)
+            loss = float(loss)
+            duration = time.perf_counter() - t0
+            if not np.isfinite(loss):
+                raise TrainerError(f"non-finite loss at step {nxt}: {loss}")
+            self.step = nxt
+            self.step_times.setdefault(self.world, []).append(duration)
+            self.history.append({"step": nxt, "loss": loss, "world": self.world})
+            executed += 1
+            if self.step % self.checkpoint_every == 0 or self.done:
+                self.checkpoint()
+        return executed
+
+    def checkpoint(self) -> int:
+        """Persist the live params; returns the new epoch. Idempotent at
+        a step: the barrier path may call it with zero new steps."""
+        import jax
+
+        if self._params is None:
+            raise RuntimeError("resume() before checkpoint()")
+        if self.checkpoint_step == self.step and self.checkpoint_epoch:
+            return self.checkpoint_epoch  # nothing new to persist
+        arrays = {k: np.asarray(v) for k, v in jax.device_get(self._params).items()}
+        last_loss = self.history[-1]["loss"] if self.history else None
+        epoch = self.store.save(
+            self.step, arrays,
+            meta={"world": self.world, "hosts": self.hosts, "loss": last_loss},
+        )
+        self.checkpoint_epoch = epoch
+        self.checkpoint_step = self.step
+        self.checkpoints.append({"epoch": epoch, "step": self.step})
+        return epoch
+
+
+# ---------------------------------------------------------------------------
+# continuity verification
+# ---------------------------------------------------------------------------
+
+
+def verify_continuity(
+    history: Sequence[dict],
+    checkpoints: Sequence[dict],
+    total_steps: int,
+    loss_rtol: float = 1e-3,
+) -> dict:
+    """The loss-curve-continuity acceptance predicate over a trainer's
+    executed-step history. Verifies:
+
+    - **coverage**: the executed steps cover 1..total_steps with no gap
+      and the run finished;
+    - **bounded rewinds**: every backward jump lands exactly one past a
+      step some checkpoint covered (work is only ever lost back to the
+      last checkpoint, never an arbitrary distance), and nothing past
+      the newest checkpoint is ever REPEATED without an intervening
+      rewind (monotone within segments);
+    - **loss continuity**: a re-executed step reproduces the loss its
+      first execution recorded (same checkpointed params + fixed batch
+      ⇒ same curve, within reduction-order float noise across meshes).
+
+    Returns {ok, violations, rewinds, max_lost_steps, covered}.
+    """
+    violations: List[str] = []
+    ckpt_steps = {int(c["step"]) for c in checkpoints}
+    seen_loss: Dict[int, float] = {}
+    rewinds = 0
+    max_lost = 0
+    prev = 0
+    covered = set()
+    for record in history:
+        step, loss = int(record["step"]), float(record["loss"])
+        if step <= prev:  # a rewind (resume re-executing lost work)
+            rewinds += 1
+            if (step - 1) not in ckpt_steps and step != 1:
+                violations.append(
+                    f"rewind to step {step} not anchored at a checkpoint"
+                )
+            max_lost = max(max_lost, prev - step + 1)
+        elif step != prev + 1:
+            violations.append(f"forward gap: step {prev} -> {step}")
+        if step in seen_loss:
+            ref = seen_loss[step]
+            if abs(loss - ref) > loss_rtol * (1.0 + abs(ref)):
+                violations.append(
+                    f"loss discontinuity at step {step}: {ref} -> {loss}"
+                )
+        else:
+            seen_loss[step] = loss
+        covered.add(step)
+        prev = step
+    if total_steps and covered != set(range(1, total_steps + 1)):
+        missing = sorted(set(range(1, total_steps + 1)) - covered)[:5]
+        violations.append(f"steps never executed: {missing}")
+    return {
+        "ok": not violations,
+        "violations": violations,
+        "rewinds": rewinds,
+        "max_lost_steps": max_lost,
+        "covered": len(covered),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the in-process gang harness
+# ---------------------------------------------------------------------------
+
+
+class InProcessJobRunner:
+    """Plays a TPUJob's gang against the cluster: the in-process analog
+    of the gang worker pods' training loop, shared by drills, bench and
+    the chaos acceptance run. Each ``sync()`` is one data-plane beat:
+
+    1. read the job + its owned slice; pause (no steps) unless the gang
+       is Scheduled AND every member is in service — a real gang's
+       collectives hang on a dead member, they don't keep stepping;
+    2. when the placed gang's host count differs from the trainer's
+       world, resume from the newest good checkpoint on a re-derived
+       mesh (recording the resume latency);
+    3. honor the controller's pre-grow checkpoint barrier
+       (``checkpointRequest`` → checkpoint now → ``checkpointAck``);
+    4. run a bounded burst of steps (checkpointing at the cadence) and
+       publish the progress ConfigMap.
+    """
+
+    def __init__(
+        self,
+        client,
+        namespace: str,
+        job_name: str,
+        store: CheckpointStore,
+        steps_per_sync: int = 4,
+        fail_at_steps: Sequence[int] = (),
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.job_name = job_name
+        self.store = store
+        self.steps_per_sync = steps_per_sync
+        self._fail_at = tuple(fail_at_steps)
+        self.trainer: Optional[ResumableTrainer] = None
+        self._errored = False
+
+    # -- cluster reads -------------------------------------------------------
+
+    def _job(self) -> Optional[dict]:
+        from tpu_operator.api.tpujob import TPU_JOB_API_VERSION, TPU_JOB_KIND
+
+        return self.client.get_or_none(TPU_JOB_API_VERSION, TPU_JOB_KIND, self.job_name)
+
+    def _gang_hosts(self) -> int:
+        """Hosts of the job's placed gang — 0 unless the owned slice is
+        Scheduled and every member is in service."""
+        from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+        from tpu_operator.placement.engine import node_unavailable
+
+        obj = self.client.get_or_none(
+            TPU_SLICE_API_VERSION, TPU_SLICE_KIND, self.job_name + consts.JOB_SLICE_SUFFIX
+        )
+        if obj is None:
+            return 0
+        placement = (obj.get("status") or {}).get("placement") or {}
+        if placement.get("phase") != "Scheduled":
+            return 0
+        nodes = placement.get("nodes") or []
+        for name in nodes:
+            node = self.client.get_or_none("v1", "Node", name)
+            if node is None or node_unavailable(node):
+                return 0
+        return len(nodes)
+
+    # -- progress publication ------------------------------------------------
+
+    @property
+    def progress_name(self) -> str:
+        return self.job_name + consts.JOB_PROGRESS_SUFFIX
+
+    def _progress(self) -> dict:
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", self.progress_name, self.namespace
+        )
+        return (cm or {}).get("data") or {}
+
+    def _publish(self, data: Dict[str, str]) -> None:
+        """Create-or-patch the runner-owned progress keys; the
+        controller's barrier key is never touched (disjoint key sets on
+        one CM, merge-patch semantics)."""
+        from tpu_operator.kube import errors
+        from tpu_operator.kube.objects import new_object
+
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", self.progress_name, {"data": data}, self.namespace
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                    new_object(
+                        "v1", "ConfigMap", self.progress_name, self.namespace, data=data
+                    )
+                )
+            except errors.AlreadyExists:
+                self.client.patch(
+                    "v1", "ConfigMap", self.progress_name, {"data": data}, self.namespace
+                )
+
+    # -- one beat ------------------------------------------------------------
+
+    def sync(self) -> dict:
+        from tpu_operator.api.tpujob import TERMINAL_PHASES, TPUJob
+
+        actions: dict = {}
+        obj = self._job()
+        if obj is None:
+            return {"paused": "job gone"}
+        job = TPUJob.from_unstructured(obj)
+        if (job.status.job or {}).get("phase") in TERMINAL_PHASES:
+            return {"paused": "terminal"}
+        hosts = self._gang_hosts()
+        if hosts <= 0:
+            return {"paused": "gang not placed/healthy"}
+        if self.trainer is None:
+            self.trainer = ResumableTrainer(
+                self.store,
+                cfg=trainer_config(job.spec.workload.config),
+                total_steps=job.spec.workload.steps,
+                checkpoint_every=job.spec.checkpoint.every_steps,
+                fail_at_steps=self._fail_at,
+            )
+        trainer = self.trainer
+        if trainer.hosts != hosts or trainer._step_fn is None:
+            actions["resumed"] = dataclasses.asdict(trainer.resume(hosts))
+            self._errored = False
+        progress = self._progress()
+        data: Dict[str, str] = {}
+        restart_req = progress.get(consts.JOB_RESTART_REQUEST, "")
+        restart_ack = progress.get(consts.JOB_PROGRESS_RESTART_ACK, "")
+        if restart_req and restart_req != restart_ack:
+            # the controller restarted the job after a trainer error:
+            # resume from the newest good checkpoint, like fresh worker
+            # pods replacing crashed ones
+            actions["restarted"] = dataclasses.asdict(trainer.resume(hosts))
+            self._errored = False
+            data[consts.JOB_PROGRESS_RESTART_ACK] = restart_req
+            data[consts.JOB_PROGRESS_ERROR] = ""
+        request = progress.get(consts.JOB_CHECKPOINT_REQUEST, "")
+        ack = progress.get(consts.JOB_PROGRESS_CHECKPOINT_ACK, "")
+        if request and request != ack:
+            trainer.checkpoint()
+            data[consts.JOB_PROGRESS_CHECKPOINT_ACK] = request
+            actions["checkpointed"] = trainer.checkpoint_epoch
+        status = consts.JOB_PROGRESS_RUNNING
+        if not trainer.done and not self._errored:
+            try:
+                actions["steps"] = trainer.run(self.steps_per_sync)
+            except TrainerError as e:
+                log.warning("trainer for %s failed: %s", self.job_name, e)
+                self._errored = True
+                status = consts.JOB_PROGRESS_FAILED
+                data[consts.JOB_PROGRESS_ERROR] = str(e)
+        if trainer.done:
+            status = consts.JOB_PROGRESS_COMPLETE
+        data.update({
+            consts.JOB_PROGRESS_STEP: str(trainer.step),
+            consts.JOB_PROGRESS_EPOCH: str(trainer.checkpoint_epoch),
+            consts.JOB_PROGRESS_CHECKPOINT_STEP: str(trainer.checkpoint_step),
+            consts.JOB_PROGRESS_WORLD: str(trainer.hosts),
+            consts.JOB_PROGRESS_STATUS: status,
+        })
+        self._publish(data)
+        actions["status"] = status
+        actions["step"] = trainer.step
+        return actions
+
+    def clear_error(self) -> None:
+        """Re-arm after the controller restarts the job (the real gang
+        analog: fresh worker pods replace the crashed ones)."""
+        self._errored = False
