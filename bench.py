@@ -609,6 +609,13 @@ def _compact_summary(out: dict) -> dict:
             "speedup_vs_default"
         ),
         "gang_straggler_ratio": out.get("telemetry", {}).get("gang", {}).get("straggler_ratio"),
+        "serving_tokens_per_s_chip": out.get("serving", {}).get(
+            "tokens_per_s_chip_continuous"
+        ),
+        "serving_continuous_vs_static": out.get("serving", {}).get(
+            "continuous_vs_static_speedup"
+        ),
+        "serving_ttft_p99_s": out.get("serving", {}).get("decode_ttft_p99_s"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -1898,6 +1905,261 @@ def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
     }
 
 
+def bench_serving(seed: int = 20260818) -> dict:
+    """Traffic-driven elastic serving (ISSUE 14), both halves:
+
+    1. the **decode bench** — the real continuous-batching engine vs the
+       static-batch baseline over the same seeded arrival curve and the
+       same int8/flash kernels (tokens/s/chip, occupancy, TTFT);
+    2. the **control-plane drill** — a seeded diurnal sim driving a
+       TPUServing through burst → scale-up (admitted through the
+       placement engine), lull → fragmentation-aware scale-down, and a
+       fabric-degraded replica excluded from routing.
+    """
+    from tpu_operator import consts
+    from tpu_operator.api.tpuserving import new_tpu_serving
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.controllers.serving_controller import ServingReconciler
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.objects import new_object
+    from tpu_operator.kube.sim import (
+        DiurnalTraffic,
+        ServingTrafficSim,
+        make_torus_nodes,
+    )
+    from tpu_operator.placement.engine import PlacementEngine
+    from tpu_operator.workloads.serving import serving_decode_bench
+
+    decode = serving_decode_bench(seed=seed)
+
+    ns = "tpu-operator"
+    slo_ttft = 5.0
+    client = FakeClient()
+    for node in make_torus_nodes((4, 2, 1), prefix="bench-sv"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    client.create(new_tpu_serving("bench-serving", {
+        "model": {"shape": "2x1x1"},
+        "replicas": {"min": 1, "max": 3, "targetRps": 10.0,
+                     "cooldownSeconds": 0.05},
+        "slo": {"ttftP99Seconds": slo_ttft},
+        "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 5},
+    }))
+    rec = ServingReconciler(client, ns)
+    place = PlacementReconciler(client, ns)
+    sim = ServingTrafficSim(
+        client, ns, "bench-serving", DiurnalTraffic(seed=seed), replica_rps=10.0,
+        # window wide enough that the scale-up transient's queued
+        # requests stay inside the p99 sample — the SLO check must
+        # cover the event, not just the scaled steady state
+        window=256,
+    )
+    req = Request(name="bench-serving")
+
+    def block() -> dict:
+        obj = client.get("tpu.google.com/v1alpha1", "TPUServing", "bench-serving")
+        return (obj.get("status") or {}).get("serving") or {}
+
+    def beat() -> None:
+        rec.reconcile(req)
+        place.reconcile(QUEUE_REQUEST)
+        sim.step()
+
+    def fragmentation() -> float:
+        plan = PlacementEngine(
+            client.list("tpu.google.com/v1alpha1", "TPUSlice"),
+            client.list("v1", "Node"),
+        ).plan()
+        return max(plan.fragmentation.values()) if plan.fragmentation else 0.0
+
+    # -- steady low traffic: min replicas hold
+    sim.override_rps = 3.0
+    for _ in range(6):
+        beat()
+    steady = dict(block())
+
+    # -- burst: immediate scale-up, admitted through the placement engine
+    sim.override_rps = 20.0
+    t0 = time.monotonic()
+    burst_passes = 0
+    for burst_passes in range(1, 40):
+        beat()
+        if block().get("ready") == 2:
+            break
+    scale_up_s = time.monotonic() - t0
+    # ride the burst a few more beats so TTFT reflects the scaled fleet
+    for _ in range(6):
+        beat()
+    burst = dict(block())
+    _, burst_ttft_p99 = sim.ttft_percentiles()
+
+    # -- fabric degradation: the replica's own artifact excludes it
+    replicas = sorted((burst.get("replicas") or {}))
+    degraded_replica = replicas[0] if replicas else ""
+    members = []
+    if degraded_replica:
+        obj = client.get("tpu.google.com/v1alpha1", "TPUSlice", degraded_replica)
+        members = ((obj.get("status") or {}).get("placement") or {}).get("nodes") or []
+        artifact = {
+            "hosts": len(members), "members": members,
+            "min_edge_gbps": 5.0, "median_edge_gbps": 100.0,
+            "edges": {},
+        }
+        try:
+            client.create(new_object(
+                "v1", "ConfigMap", f"{degraded_replica}-gang", ns,
+            ))
+        except Exception:  # noqa: BLE001 — exists already
+            pass
+        client.patch(
+            "v1", "ConfigMap", f"{degraded_replica}-gang",
+            {"metadata": {"annotations": {
+                consts.GANG_FABRIC_ANNOTATION: json.dumps(artifact),
+            }}}, ns,
+        )
+    sim.routed = {}
+    for _ in range(5):
+        beat()
+    excluded = dict(block())
+    routed_during_exclusion = dict(sim.routed)
+    # heal: drop the artifact so the lull runs on a clean fleet
+    if degraded_replica:
+        client.patch(
+            "v1", "ConfigMap", f"{degraded_replica}-gang",
+            {"metadata": {"annotations": {consts.GANG_FABRIC_ANNOTATION: None}}},
+            ns,
+        )
+
+    # -- lull: hysteretic scale-down, fragmentation-aware victims
+    frag_before_scale_down = fragmentation()
+    sim.override_rps = 3.0
+    for _ in range(30):
+        beat()
+        time.sleep(0.01)
+        if block().get("desired") == 1 and block().get("ready") == 1:
+            break
+    lull = dict(block())
+    frag_after_scale_down = fragmentation()
+
+    # -- deletion: series retired, owned replicas swept
+    client.delete("tpu.google.com/v1alpha1", "TPUServing", "bench-serving")
+    rec.reconcile(req)
+    slices_left = [
+        s["metadata"]["name"]
+        for s in client.list("tpu.google.com/v1alpha1", "TPUSlice")
+    ]
+
+    return {
+        "seed": seed,
+        "decode": decode,
+        "tokens_per_s_chip_continuous": decode["continuous"]["tokens_per_s_chip"],
+        "tokens_per_s_chip_static": decode["static"]["tokens_per_s_chip"],
+        "continuous_vs_static_speedup": decode["continuous_vs_static_speedup"],
+        "decode_ttft_p50_s": decode["continuous"]["ttft_p50_s"],
+        "decode_ttft_p99_s": decode["continuous"]["ttft_p99_s"],
+        "sim": {
+            "steady": {"phase": steady.get("phase"), "ready": steady.get("ready")},
+            "burst": {
+                "phase": burst.get("phase"), "ready": burst.get("ready"),
+                "desired": burst.get("desired"),
+            },
+            "scale_up_passes": burst_passes,
+            "scale_up_time_to_ready_s": round(scale_up_s, 3),
+            "slo_ttft_p99_s": slo_ttft,
+            "burst_ttft_p99_s": round(burst_ttft_p99, 3),
+            "degraded_replica": degraded_replica,
+            "degraded_replica_members": members,
+            "routed_during_exclusion": routed_during_exclusion,
+            "excluded_phase": excluded.get("phase"),
+            "lull": {
+                "phase": lull.get("phase"), "ready": lull.get("ready"),
+                "desired": lull.get("desired"),
+            },
+            "decisions": lull.get("decisions"),
+            "fragmentation_before_scale_down": frag_before_scale_down,
+            "fragmentation_after_scale_down": frag_after_scale_down,
+            "slices_after_delete": slices_left,
+        },
+    }
+
+
+def serving_smoke() -> int:
+    """CI gate (scripts/ci.sh): the serving acceptance run — continuous
+    batching must beat the static baseline by >= 1.5x tokens/s/chip on
+    the same kernels, the autoscaler must ride the seeded diurnal sim
+    (burst -> scale-up admitted through placement with p99 TTFT inside
+    the SLO, lull -> fragmentation-aware scale-down), a fabric-degraded
+    replica must receive zero routed requests, and every serving series
+    must be live on the scrape endpoint while the CR exists and retired
+    when it is deleted."""
+    import prometheus_client
+
+    result = bench_serving()
+    sim = result["sim"]
+    serving_series = (
+        "tpu_operator_serving_replicas",
+        "tpu_operator_serving_tokens_per_s",
+        "tpu_operator_serving_ttft_p99_seconds",
+        "tpu_operator_serving_queue_depth",
+    )
+    # bench_serving ends with the CR deleted: series must be retired NOW,
+    # and must have been live while it served (gauges still registered)
+    scrape = prometheus_client.generate_latest(prometheus_client.REGISTRY).decode()
+    series_registered = all(name in scrape for name in serving_series)
+    series_retired = all(
+        f'{name}{{serving="bench-serving"}}' not in scrape for name in serving_series
+    )
+    degraded = sim["degraded_replica"]
+    routed = sim["routed_during_exclusion"]
+    checks = {
+        "continuous_1_5x_over_static": result["continuous_vs_static_speedup"] >= 1.5,
+        "decode_ttft_improves": (
+            result["decode"]["continuous"]["ttft_p99_s"]
+            < result["decode"]["static"]["ttft_p99_s"]
+        ),
+        "steady_holds_min": sim["steady"]["ready"] == 1,
+        "burst_scales_up": sim["burst"]["ready"] >= 2 and sim["burst"]["desired"] >= 2,
+        "ttft_within_slo_across_scale_up": (
+            0 < sim["burst_ttft_p99_s"] <= sim["slo_ttft_p99_s"]
+        ),
+        "degraded_fabric_zero_routed": (
+            bool(degraded) and routed.get(degraded, 0) == 0
+            and sum(routed.values()) > 0
+        ),
+        "excluded_reads_degraded": sim["excluded_phase"] == "Degraded",
+        "lull_scales_down": sim["lull"]["ready"] == 1 and sim["lull"]["desired"] == 1,
+        "scale_down_non_increasing_fragmentation": (
+            sim["fragmentation_after_scale_down"]
+            <= sim["fragmentation_before_scale_down"]
+        ),
+        "victim_decisions_recorded": any(
+            d.get("action") == "victim" for d in sim["decisions"] or []
+        ),
+        "delete_sweeps_replicas": sim["slices_after_delete"] == [],
+        "series_live_then_retired": series_registered and series_retired,
+    }
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "serving_smoke",
+        "ok": ok,
+        "checks": checks,
+        "tokens_per_s_chip_continuous": result["tokens_per_s_chip_continuous"],
+        "tokens_per_s_chip_static": result["tokens_per_s_chip_static"],
+        "continuous_vs_static_speedup": result["continuous_vs_static_speedup"],
+        "scale_up_time_to_ready_s": sim["scale_up_time_to_ready_s"],
+        "burst_ttft_p99_s": sim["burst_ttft_p99_s"],
+        "fragmentation_before_after": [
+            sim["fragmentation_before_scale_down"],
+            sim["fragmentation_after_scale_down"],
+        ],
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def job_smoke() -> int:
     """CI gate (scripts/ci.sh): the chaos acceptance run for elastic
     training — a seeded schedule mixing host death, grey failure, link
@@ -2021,6 +2283,8 @@ def main() -> None:
         raise SystemExit(autotune_smoke())
     if "--job-smoke" in sys.argv[1:]:
         raise SystemExit(job_smoke())
+    if "--serving-smoke" in sys.argv[1:]:
+        raise SystemExit(serving_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -2114,6 +2378,12 @@ def main() -> None:
         training = bench_training()
     except Exception as e:  # noqa: BLE001 — same isolation as chaos
         training = {"error": f"{type(e).__name__}: {e}"}
+    # traffic-driven serving: continuous-vs-static decode bench + the
+    # diurnal autoscale drill (gated by --serving-smoke)
+    try:
+        serving = bench_serving()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        serving = {"error": f"{type(e).__name__}: {e}"}
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -2146,6 +2416,7 @@ def main() -> None:
         "fabric": fabric,
         "autotune": autotune,
         "training": training,
+        "serving": serving,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

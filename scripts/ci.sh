@@ -89,6 +89,14 @@ echo "== job smoke: checkpoint -> shrink -> resume -> grow with epoch continuity
 # back on every heal; an unplaceable-min-shape job must quarantine in
 # Failed with an Event instead of crash-looping the placement queue
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --job-smoke
+echo "== serving smoke: burst -> scale-up -> route -> fragmentation-aware scale-down =="
+# traffic-driven serving gate: the continuous-batching decode engine
+# must beat the static-batch baseline >= 1.5x tokens/s/chip on the same
+# kernels; the seeded diurnal sim must scale up through placement with
+# p99 TTFT inside the SLO, exclude a fabric-degraded replica from
+# routing (zero requests), scale down via the fragmentation-aware
+# victim, and retire every serving series when the CR is deleted
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --serving-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

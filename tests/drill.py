@@ -861,6 +861,169 @@ class JobDrill:
         return obs
 
 
+class ServingDrill:
+    """Elastic-serving drill: a 4x2x1 host torus (8 synthetic nodes) and
+    one TPUServing driven over the wire by the real serving + placement
+    reconcilers, with the seeded traffic sim playing the users/router.
+    The load curve bursts (scale-up admitted through the placement
+    engine), then lulls (fragmentation-aware scale-down retires the
+    allocator-chosen victim). The drill plays the admin (nodes, the
+    TPUServing CR) and the traffic side (load ConfigMap demand keys);
+    everything the operator does — TPUSlice create/delete,
+    tpuservings/status patches, the routing key, Events — must ride the
+    shipped ClusterRole."""
+
+    def __init__(self, client, ns: str):
+        self.client = client
+        self.ns = ns
+        suffix = uuid.uuid4().hex[:8]
+        self.prefix = f"tpu-serve-{suffix}"
+        self.serving_name = f"drill-serving-{suffix}"
+        self.node_names: list = []
+
+    def setup(self) -> None:
+        from tpu_operator.api.tpuserving import new_tpu_serving
+        from tpu_operator.kube.sim import make_torus_nodes
+
+        for node in make_torus_nodes((4, 2, 1), prefix=self.prefix):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            self.client.create(node)
+            self.node_names.append(node["metadata"]["name"])
+        self.client.create(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUServing
+            new_tpu_serving(self.serving_name, {
+                "model": {"shape": "2x1x1"},
+                "replicas": {"min": 1, "max": 3, "targetRps": 10.0,
+                             "cooldownSeconds": 0.05},
+                "slo": {"ttftP99Seconds": 5.0},
+                "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05,
+                            "retryLimit": 5},
+            })
+        )
+
+    def teardown(self) -> None:
+        from tpu_operator.api.tpuserving import (
+            TPU_SERVING_API_VERSION,
+            TPU_SERVING_KIND,
+        )
+        from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+
+        try:
+            self.client.delete(
+                TPU_SERVING_API_VERSION, TPU_SERVING_KIND, self.serving_name
+            )
+        except errors.ApiError:
+            pass
+        for index in range(4):
+            try:
+                self.client.delete(
+                    TPU_SLICE_API_VERSION, TPU_SLICE_KIND,
+                    f"{self.serving_name}{consts.SERVING_REPLICA_INFIX}{index}",
+                )
+            except errors.ApiError:
+                pass
+        try:
+            self.client.delete(
+                "v1", "ConfigMap",
+                self.serving_name + consts.SERVING_LOAD_SUFFIX, self.ns,
+            )
+        except errors.ApiError:
+            pass
+        for name in self.node_names:
+            try:
+                self.client.delete("v1", "Node", name)
+            except errors.ApiError:
+                pass
+
+    def _block(self) -> dict:
+        from tpu_operator.api.tpuserving import (
+            TPU_SERVING_API_VERSION,
+            TPU_SERVING_KIND,
+        )
+
+        obj = self.client.get_or_none(
+            TPU_SERVING_API_VERSION, TPU_SERVING_KIND, self.serving_name
+        )
+        return ((obj or {}).get("status") or {}).get("serving") or {}
+
+    def run(self, max_passes: int = 120) -> dict:
+        import json as _json
+        import time as _time
+
+        from tpu_operator.controllers.placement_controller import (
+            QUEUE_REQUEST,
+            PlacementReconciler,
+        )
+        from tpu_operator.controllers.serving_controller import ServingReconciler
+        from tpu_operator.kube.controller import Request
+        from tpu_operator.kube.sim import DiurnalTraffic, ServingTrafficSim
+
+        serve_rec = ServingReconciler(self.client, self.ns)
+        place_rec = PlacementReconciler(self.client, self.ns)
+        sim = ServingTrafficSim(
+            self.client, self.ns, self.serving_name,
+            DiurnalTraffic(seed=7), replica_rps=10.0,
+        )
+        request = Request(name=self.serving_name)
+        obs: dict = {"phases": []}
+
+        def beat(rps: float) -> dict:
+            sim.override_rps = rps
+            serve_rec.reconcile(request)
+            place_rec.reconcile(QUEUE_REQUEST)
+            sim.step()
+            block = self._block()
+            phase = block.get("phase", "")
+            if not obs["phases"] or obs["phases"][-1] != phase:
+                obs["phases"].append(phase)
+            return block
+
+        # steady: the min replica places and routes
+        for _ in range(5):
+            block = beat(3.0)
+        obs["steady_ready"] = block.get("ready")
+        # burst: immediate scale-up through the placement engine
+        for _ in range(max_passes):
+            block = beat(25.0)
+            if block.get("ready", 0) >= 3:
+                break
+        obs["burst_ready"] = block.get("ready")
+        obs["routed_at_burst"] = dict(sim.routed)
+        # lull: hysteretic, fragmentation-aware scale-down
+        deadline = _time.monotonic() + 15.0
+        while _time.monotonic() < deadline:
+            block = beat(2.0)
+            if block.get("ready") == 1 and block.get("desired") == 1:
+                break
+            _time.sleep(0.02)
+        obs["lull_ready"] = block.get("ready")
+        obs["decisions"] = list(block.get("decisions") or [])
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap",
+            self.serving_name + consts.SERVING_LOAD_SUFFIX, self.ns,
+        )
+        routing = ((cm or {}).get("data") or {}).get(consts.SERVING_ROUTING_KEY, "{}")
+        obs["final_routing"] = _json.loads(routing)
+        return obs
+
+
+def run_serving_drill(client, ns: str, **run_kwargs) -> dict:
+    drill = ServingDrill(client, ns)
+    try:
+        drill.setup()
+        return drill.run(**run_kwargs)
+    finally:
+        drill.teardown()
+
+
+def assert_serving_drill_passed(obs: dict) -> None:
+    assert obs["steady_ready"] == 1, obs
+    assert obs["burst_ready"] == 3, obs
+    assert sum(obs["routed_at_burst"].values()) > 0, obs
+    assert obs["lull_ready"] == 1, obs
+    assert any(d.get("action") == "victim" for d in obs["decisions"]), obs
+    assert sum(1 for w in obs["final_routing"].values() if w > 0) == 1, obs
+
+
 def run_job_drill(client, ns: str, **run_kwargs) -> dict:
     drill = JobDrill(client, ns)
     try:

@@ -565,11 +565,11 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
     mgr = Manager(client, namespace=NS)
     reconciler = ClusterPolicyReconciler(client, NS)
     ctrl = setup_with_manager(mgr, reconciler)
-    # the TPUJob-era request mix: the placement + job controllers ride
-    # the same soak, with one elastic job placing its gang through the
-    # fault schedule (no data-plane runner here — the job parks in
-    # Placing and heartbeats, which is exactly the steady controller
-    # traffic the schedule must fire every fault class against)
+    # the serving-era request mix: the placement + job + serving
+    # controllers ride the same soak — one elastic job places its gang
+    # and one TPUServing holds two replicas through the fault schedule
+    # (no data-plane runners here; the steady controller traffic is
+    # exactly what the schedule must fire every fault class against)
     from tpu_operator.controllers.job_controller import (
         JobReconciler,
         setup_with_manager as setup_job,
@@ -578,9 +578,14 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
         PlacementReconciler,
         setup_with_manager as setup_placement,
     )
+    from tpu_operator.controllers.serving_controller import (
+        ServingReconciler,
+        setup_with_manager as setup_serving,
+    )
 
     setup_placement(mgr, PlacementReconciler(client, NS))
     setup_job(mgr, JobReconciler(client, NS))
+    setup_serving(mgr, ServingReconciler(client, NS))
     obs = {"degraded_seen": False}
     stop_sampler = threading.Event()
 
@@ -598,10 +603,17 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
         mgr.start()
         store.create(new_cluster_policy())  # admin-side, like kubectl
         from tpu_operator.api.tpujob import new_tpu_job
+        from tpu_operator.api.tpuserving import new_tpu_serving
 
         store.create(new_tpu_job("soak-job", {
             "workload": {"steps": 50},
             "gang": {"shape": "2x1x1", "minShape": "1x1x1"},
+        }))
+        store.create(new_tpu_serving("soak-serving", {
+            "model": {"shape": "1x1x1"},
+            "replicas": {"min": 2, "max": 2, "targetRps": 10.0},
+            "slo": {"ttftP99Seconds": 5.0},
+            "backoff": {"baseSeconds": 0.1, "maxSeconds": 1.0, "retryLimit": 50},
         }))
         sampler.start()
 
@@ -634,6 +646,51 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
             return director.configured_classes() <= director.fired_classes()
 
         obs["all_classes_fired"] = wait_for(all_classes_fired, timeout=45.0, interval=0.02)
+
+        # a serving replica's host dies MID-SCHEDULE: routing must drain
+        # to the surviving replica, the placement engine must re-place
+        # the broken one, and the serving must come back fully routable
+        import json as _json
+
+        from tpu_operator import consts as _consts
+
+        def _serving_routing() -> dict:
+            cm = store.get_or_none(
+                "v1", "ConfigMap", "soak-serving" + _consts.SERVING_LOAD_SUFFIX, NS
+            )
+            raw = ((cm or {}).get("data") or {}).get(_consts.SERVING_ROUTING_KEY)
+            try:
+                return _json.loads(raw) if raw else {}
+            except ValueError:
+                return {}
+
+        def _replica_nodes(name: str) -> list:
+            ts = store.get_or_none("tpu.google.com/v1alpha1", "TPUSlice", name)
+            placement = ((ts or {}).get("status") or {}).get("placement") or {}
+            return list(placement.get("nodes") or []) if (
+                placement.get("phase") == "Scheduled"
+            ) else []
+
+        def serving_placed():
+            routing = _serving_routing()
+            return sum(1 for w in routing.values() if w > 0) == 2
+
+        obs["serving_placed"] = wait_for(serving_placed, timeout=60.0)
+        victim_node = ""
+        if obs["serving_placed"]:
+            nodes_before = _replica_nodes("soak-serving-replica-0")
+            victim_node = nodes_before[0] if nodes_before else ""
+        if victim_node:
+            store.patch("v1", "Node", victim_node, {"metadata": {"labels": {
+                _consts.TPU_HEALTH_LABEL: _consts.HEALTH_DEGRADED,
+            }}})
+
+            def serving_drained():
+                return _serving_routing().get("soak-serving-replica-0", 1.0) == 0.0
+
+            obs["serving_drained"] = wait_for(serving_drained, timeout=45.0)
+        else:
+            obs["serving_drained"] = False
         director.quiesce()  # the chaos run ends; the cluster must heal
 
         # recovery: once faults stop landing, the Degraded condition must
@@ -671,6 +728,17 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
             return placement.get("phase") == "Scheduled"
 
         obs["job_placed"] = wait_for(job_placed, timeout=30.0)
+
+        # the serving's recovery: replica-0 re-placed OFF the dead host,
+        # both replicas routable again
+        def serving_recovered():
+            nodes_now = _replica_nodes("soak-serving-replica-0")
+            if not nodes_now or victim_node in nodes_now:
+                return False
+            routing = _serving_routing()
+            return sum(1 for w in routing.values() if w > 0) == 2
+
+        obs["serving_recovered"] = wait_for(serving_recovered, timeout=45.0)
         cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
         obs["cp_uid"] = cp["metadata"]["uid"]
         obs["store"] = store
@@ -691,7 +759,7 @@ class TestChaosSoak:
         condition having been set and then cleared, no stuck queue
         items, and every configured fault class actually fired."""
         director = ChaosDirector.standard(
-            seed=20260811, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
+            seed=20260818, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
             rate_scale=2.0,
         )
         obs = _run_soak(nodes=24, director=director, ready_timeout=90.0)
@@ -700,6 +768,13 @@ class TestChaosSoak:
         assert obs["degraded_cleared"], "Degraded condition never cleared after recovery"
         assert obs["queue_drained"], "stuck queue items after convergence"
         assert obs["job_placed"], "the soak TPUJob's gang never placed under chaos"
+        assert obs["serving_placed"], "the soak TPUServing never became fully routable"
+        assert obs["serving_drained"], (
+            "routing never drained off the replica whose host died mid-schedule"
+        )
+        assert obs["serving_recovered"], (
+            "the broken serving replica never re-placed + re-routed after the kill"
+        )
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])
@@ -713,15 +788,20 @@ class TestChaosSoak:
         fires against the CURRENT request mix — the every-class assert
         below guards against a vacuous schedule, so adding a controller
         that shifts the seeded draw sequence can require re-picking it.
-        Re-seeded for the TPUJob-era mix: the placement + job
-        controllers now ride the soak and an elastic job places its
-        gang through the schedule.)"""
-        director = ChaosDirector.standard(seed=20260811, outage_at=8.0, outage_duration=30.0)
+        Re-seeded for the serving-era mix: the placement + job + serving
+        controllers now ride the soak, an elastic job places its gang
+        through the schedule, and a TPUServing survives a replica's host
+        dying mid-schedule.)"""
+        director = ChaosDirector.standard(seed=20260818, outage_at=8.0, outage_duration=30.0)
         obs = _run_soak(nodes=256, director=director, ready_timeout=240.0)
         assert obs["became_ready"], "256-node install never Ready under chaos"
         assert obs["degraded_seen"] and obs["degraded_cleared"]
         assert obs["queue_drained"]
         assert obs["job_placed"], "the soak TPUJob's gang never placed under chaos"
+        assert obs["serving_placed"] and obs["serving_drained"], obs
+        assert obs["serving_recovered"], (
+            "the broken serving replica never re-placed + re-routed after the kill"
+        )
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])

@@ -67,6 +67,27 @@ def test_bundle_from_live_install(tmp_path):
         ] = "line-1\nline-2\n"
         store.update(pod)
 
+        # a TPUServing with live bookkeeping so serving.txt is proven
+        # non-trivially (replica map, SLO attainment, scale decisions)
+        from tpu_operator.api.tpuserving import new_tpu_serving
+
+        store.create(new_tpu_serving("bundle-serving", {
+            "model": {"shape": "1x1x1"},
+            "replicas": {"min": 1, "max": 2, "targetRps": 10.0},
+            "slo": {"ttftP99Seconds": 2.0},
+        }))
+        store.patch_status(
+            "tpu.google.com/v1alpha1", "TPUServing", "bundle-serving",
+            {"status": {"state": "Serving", "serving": {
+                "phase": "Serving", "desired": 2, "ready": 2, "routable": 1,
+                "replicas": {"bundle-serving-replica-0": "Serving",
+                             "bundle-serving-replica-1": "Excluded"},
+                "slo": {"ttftP99": 0.4, "ttftTarget": 2.0, "attained": True},
+                "decisions": [{"step": 3, "action": "scale-up",
+                               "reason": "arrival rate 14.0 rps"}],
+            }}},
+        )
+
         written = collect(client, NS, str(tmp_path))
 
         def collected_state():
@@ -132,6 +153,16 @@ def test_bundle_from_live_install(tmp_path):
         assert "verb=" in traces_txt  # api spans inside the reconciles
         slow_txt = (tmp_path / "slow-reconciles.txt").read_text()
         assert "# slowest" in slow_txt and "controller=" in slow_txt
+        # the serving view: replica map + SLO attainment + scale
+        # decisions with reasons, plus the raw CRs beside it
+        serving_txt = (tmp_path / "serving.txt").read_text()
+        assert "bundle-serving" in serving_txt
+        assert "replicas=2/2(window 1-2)" in serving_txt
+        assert "sloAttained=True" in serving_txt
+        assert "replica bundle-serving-replica-1  Excluded" in serving_txt
+        assert "decision pass=3  scale-up  arrival rate 14.0 rps" in serving_txt
+        servings = list(yaml.safe_load_all((tmp_path / "tpuservings.yaml").read_text()))
+        assert servings[0]["metadata"]["name"] == "bundle-serving"
         pod_name = pod["metadata"]["name"]
         log_text = (tmp_path / "pod-logs" / f"{pod_name}.log").read_text()
         assert "line-1\nline-2\n" in log_text  # multi-container pods get headers
@@ -145,6 +176,7 @@ def test_bundle_from_live_install(tmp_path):
             "version.txt", "all.txt",
             "nodes.yaml", "node-labels.txt", "node-health.txt", "placement.txt",
             "clusterpolicies.yaml", "tpuslices.yaml", "tpujobs.yaml", "jobs.txt",
+            "tpuservings.yaml", "serving.txt",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
             "telemetry.txt", "fabric.txt",

@@ -503,6 +503,43 @@ class TestOperatorUnderEnforcement:
         finally:
             server.stop()
 
+    # the serving drill's admin half provisions the TPUServing CR
+    # (kubectl territory on a real cluster); the operator side reads it,
+    # patches its status, owns the replica TPUSlices, and writes the
+    # routing key into the load ConfigMap
+    SERVING_HARNESS_RULES = [
+        {
+            "apiGroups": ["tpu.google.com"],
+            "resources": ["tpuservings"],
+            "verbs": ["create", "delete"],
+        },
+    ]
+
+    def test_serving_drill_runs_under_enforcement(self):
+        """The TPUServing controller's whole verb surface — tpuservings
+        reads + status patches, replica TPUSlice create/delete on
+        scale-up/scale-down, the routing key on the load ConfigMap,
+        Events — exercised by the burst/route/scale-down drill over the
+        wire under the shipped operator rules (harness-side node/CR/
+        traffic provisioning gets its own slice, as in the other
+        drills)."""
+        from drill import assert_serving_drill_passed, run_serving_drill
+
+        store = FakeClient()
+        authorizer = RbacAuthorizer(
+            shipped_rules() + self.HARNESS_RULES + self.SERVING_HARNESS_RULES
+        )
+        server = FakeApiServer(store, authorize=authorizer).start()
+        client = HttpClient(server.base_url, timeout=10.0)
+        try:
+            obs = run_serving_drill(client, NS)
+            assert_serving_drill_passed(obs)
+            assert not authorizer.denials, (
+                f"ClusterRole gaps in the serving path: {sorted(set(authorizer.denials))}"
+            )
+        finally:
+            server.stop()
+
     def test_cert_lifecycle_under_enforcement(self, tmp_path):
         """The webhook cert manager's full converge path (Secret adopt/
         publish, VWC caBundle patch) runs under the shipped rules — the

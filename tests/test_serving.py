@@ -1,0 +1,774 @@
+"""Traffic-driven elastic serving: the paged-pool decode engine
+(continuous vs static batching, prefill/decode split, tuned-kernel
+resolution), the seeded diurnal traffic sim, the fragmentation-aware
+scale-down oracle, and the TPUServing controller (autoscaler hysteresis,
+routing exclusion, retry-budget quarantine, series lifecycle).
+
+The over-the-wire drill lives in tests/drill.py (run under the shipped
+RBAC gate in test_rbac_gate.py); the CI gate is `bench.py
+--serving-smoke`.
+"""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.tpuserving import (
+    TPU_SERVING_API_VERSION,
+    TPU_SERVING_KIND,
+    ServingPhase,
+    TPUServing,
+    new_tpu_serving,
+)
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.controllers.serving_controller import (
+    ServingReconciler,
+    replica_name,
+)
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import (
+    DiurnalTraffic,
+    ServingTrafficSim,
+    make_torus_nodes,
+)
+from tpu_operator.placement.engine import (
+    PlacementEngine,
+    scale_down_scores,
+    scale_down_victim,
+)
+from tpu_operator.workloads.serving import (
+    DecodeEngine,
+    PagedKVPool,
+    ServingModelConfig,
+    ServingRequest,
+    make_requests,
+    serving_decode_bench,
+)
+
+NS = "tpu-operator"
+
+
+def tiny_cfg(**over) -> ServingModelConfig:
+    base = dict(
+        d_model=16, n_heads=2, head_dim=8, d_ff=32, vocab=64,
+        page_tokens=4, max_pages=32, max_batch=4, max_seq=32,
+        prefill_chunk=4,
+    )
+    base.update(over)
+    return ServingModelConfig(**base)
+
+
+def req(rid: str, prompt_len: int = 4, decode: int = 3, vocab: int = 64) -> ServingRequest:
+    rng = np.random.default_rng(hash(rid) % (2**32))
+    return ServingRequest(
+        rid=rid,
+        prompt=rng.integers(0, vocab, size=prompt_len).astype(np.int32),
+        decode_tokens=decode,
+    )
+
+
+# ---------------------------------------------------------------------------
+# paged KV pool
+# ---------------------------------------------------------------------------
+
+
+class TestPagedKVPool:
+    def test_lazy_allocation_and_free_reuse(self):
+        cfg = tiny_cfg(max_pages=4, max_batch=2)
+        pool = PagedKVPool(cfg)
+        a = pool.alloc_slot()
+        assert pool.ensure(a, 1) and len(pool.pages[a]) == 1
+        assert pool.ensure(a, cfg.page_tokens) and len(pool.pages[a]) == 1
+        assert pool.ensure(a, cfg.page_tokens + 1) and len(pool.pages[a]) == 2
+        b = pool.alloc_slot()
+        assert pool.ensure(b, 2 * cfg.page_tokens)  # takes the last 2 pages
+        assert pool.free_pages == 0
+        assert not pool.ensure(a, 3 * cfg.page_tokens)  # exhausted, no eviction
+        pool.free_slot(b)
+        assert pool.free_pages == 2
+        assert pool.ensure(a, 3 * cfg.page_tokens)  # freed pages reused
+
+    def test_unallocated_entries_point_at_scratch(self):
+        cfg = tiny_cfg()
+        pool = PagedKVPool(cfg)
+        slot = pool.alloc_slot()
+        assert (pool.table[slot] == pool.scratch).all()
+        pool.ensure(slot, 1)
+        assert pool.table[slot][0] != pool.scratch
+        assert (pool.table[slot][1:] == pool.scratch).all()
+
+
+# ---------------------------------------------------------------------------
+# decode engine
+# ---------------------------------------------------------------------------
+
+
+class TestDecodeEngine:
+    def test_submit_rejects_over_capacity_request(self):
+        engine = DecodeEngine(tiny_cfg())
+        with pytest.raises(ValueError):
+            engine.submit(req("big", prompt_len=30, decode=10))
+
+    def test_batched_decode_matches_single_request(self):
+        """Continuous batching must not change any request's tokens:
+        the same request decodes identically alone and in a full batch
+        (padding + paged gather are masked, not approximated)."""
+        cfg = tiny_cfg()
+        alone = DecodeEngine(cfg, seed=7)
+        alone.submit(req("r0", prompt_len=6, decode=5))
+        alone.run_until_drained()
+        together = DecodeEngine(cfg, seed=7)
+        for i in range(4):
+            together.submit(req(f"r{i}", prompt_len=6, decode=5))
+        together.run_until_drained()
+        ref = {r.rid: r.output for r in alone.completed}
+        got = {r.rid: r.output for r in together.completed}
+        assert got["r0"] == ref["r0"]
+
+    def test_continuous_admits_mid_flight_static_drains_first(self):
+        """The batching-policy delta itself: when a short request frees
+        its slot, continuous admits the queued request while the long
+        one still runs; static keeps it queued until the batch drains."""
+        cfg = tiny_cfg(max_batch=2)
+        for static in (False, True):
+            engine = DecodeEngine(cfg, seed=1, static_batch=static)
+            engine.submit(req("a", decode=2))
+            engine.submit(req("b", decode=8))
+            engine.step()
+            engine.submit(req("late", decode=2))
+            for _ in range(4):  # `a` completes in here; `b` keeps going
+                engine.step()
+            if static:
+                assert engine.queue and engine.queue[0].rid == "late"
+            else:
+                assert not engine.queue  # admitted into a's freed slot
+            engine.run_until_drained()
+            assert len(engine.completed) == 3
+
+    def test_continuous_refills_freed_slot_at_step_boundary(self):
+        cfg = tiny_cfg(max_batch=2)
+        engine = DecodeEngine(cfg, seed=1)
+        engine.submit(req("short", decode=1))
+        engine.submit(req("long", decode=10))
+        engine.submit(req("waiting", decode=2))
+        # short: 1 prefill step (emits its only token) -> completes
+        engine.step()
+        assert engine.queue and engine.queue[0].rid == "waiting"
+        engine.step()  # the freed slot admits `waiting` while `long` runs
+        assert not engine.queue
+        assert any(s.request.rid == "waiting" for s in engine.slots.values())
+        assert any(s.request.rid == "long" for s in engine.slots.values())
+
+    def test_chunked_prefill_never_stalls_peers(self):
+        """The prefill/decode split: while a long prompt ingests chunk
+        by chunk, an in-flight request keeps producing a token every
+        step — one long prompt cannot stall the batch."""
+        cfg = tiny_cfg(max_seq=32, prefill_chunk=4)
+        engine = DecodeEngine(cfg, seed=2)
+        engine.submit(req("steady", prompt_len=4, decode=10))
+        engine.step()  # steady prefills (1 chunk) and emits token 1
+        steady = next(iter(engine.slots.values()))
+        assert steady.decoded == 1
+        engine.submit(req("novel", prompt_len=24, decode=2))  # 6 chunks
+        for expected in (2, 3, 4, 5, 6):
+            engine.step()
+            assert steady.decoded == expected  # a token EVERY step
+        novel = next(
+            s for s in engine.slots.values() if s.request.rid == "novel"
+        )
+        assert novel.prefilled == 20  # still mid-prefill after 5 steps
+
+    def test_pool_pressure_pauses_then_preempts_youngest(self):
+        """Two 3-page requests over a 3-page pool: lanes pause while a
+        peer might free a page, and when BOTH are starved (true
+        deadlock) the youngest is preempted back to the queue — the
+        oldest runs to completion, the evictee recomputes after, and
+        both finish."""
+        cfg = tiny_cfg(max_pages=3, max_batch=2, page_tokens=4, max_seq=16)
+        engine = DecodeEngine(cfg, seed=3)
+        engine.submit(req("a", prompt_len=4, decode=8))   # worst case 3 pages
+        engine.submit(req("b", prompt_len=4, decode=8))
+        paused_seen = False
+        for _ in range(80):
+            report = engine.step()
+            paused_seen = paused_seen or report["paused"] > 0
+            if engine.idle:
+                break
+        assert paused_seen, "pool pressure never paused a lane"
+        assert engine.evictions >= 1
+        assert len(engine.completed) == 2  # deadlock broken, both finish
+        # the preempted request regenerated its full budget
+        by_rid = {r.rid: r for r in engine.completed}
+        assert len(by_rid["b"].output) == 8
+
+    def test_ttft_and_occupancy_favor_continuous(self):
+        out = serving_decode_bench(tiny_cfg(max_batch=4), requests=10,
+                                   arrival_ticks=3)
+        assert out["continuous"]["occupancy_mean"] > out["static"]["occupancy_mean"]
+        assert out["continuous"]["ttft_p99_s"] < out["static"]["ttft_p99_s"]
+        assert out["continuous_vs_static_speedup"] > 1.0
+
+    def test_flash_prefill_matches_dense_tokens(self):
+        cfg_dense = tiny_cfg(head_dim=16)
+        cfg_flash = tiny_cfg(head_dim=16, use_flash_prefill=True)
+        outs = []
+        for cfg in (cfg_dense, cfg_flash):
+            engine = DecodeEngine(cfg, seed=5)
+            engine.submit(req("x", prompt_len=8, decode=4))
+            engine.run_until_drained()
+            outs.append(engine.completed[0].output)
+        assert outs[0] == outs[1]
+
+    def test_kernel_configs_resolve_through_autotune_winners(self, monkeypatch):
+        """The PR 12 consumption path: published winners reach the
+        serving engine through TPU_AUTOTUNE_JSON exactly as they reach
+        burn-in — serving runs tuned on every generation."""
+        winners = {"cpu": {"flash_fwd": {"s32_h2_d8": {"block_q": 16, "block_k": 8}}}}
+        monkeypatch.setenv(consts.AUTOTUNE_ENV, json.dumps(winners))
+        monkeypatch.setenv("TPU_GENERATION", "cpu")
+        from tpu_operator.workloads import autotune
+
+        monkeypatch.setattr(autotune, "_gen_cache", (None, ""))
+        engine = DecodeEngine(tiny_cfg())
+        assert tuple(engine.flash_blocks) == (16, 8)
+
+
+# ---------------------------------------------------------------------------
+# seeded traffic
+# ---------------------------------------------------------------------------
+
+
+class TestDiurnalTraffic:
+    def test_same_seed_same_log(self):
+        a = DiurnalTraffic(seed=11)
+        b = DiurnalTraffic(seed=11)
+        for tick in range(100):
+            a.arrivals(tick)
+            b.arrivals(tick)
+        assert a.log == b.log
+        c = DiurnalTraffic(seed=12)
+        for tick in range(100):
+            c.arrivals(tick)
+        assert c.log != a.log
+
+    def test_diurnal_curve_and_bursts(self):
+        t = DiurnalTraffic(seed=0, period_ticks=100, base_rps=2.0,
+                           peak_rps=10.0, burst_every=37, burst_ticks=3,
+                           burst_rps=25.0)
+        assert t.rate(0) == pytest.approx(2.0)       # trough, no tick-0 burst
+        assert t.rate(50) == pytest.approx(10.0)     # peak of the sinusoid
+        assert t.rate(35) == pytest.approx(25.0)     # burst window (34..36)
+        rates = [t.rate(i) for i in range(100)]
+        assert min(rates) >= 2.0 and max(rates) == 25.0
+
+    def test_sim_routes_by_weights_and_publishes_load(self):
+        client = FakeClient()
+        sim = ServingTrafficSim(client, NS, "svc", DiurnalTraffic(seed=3),
+                                replica_rps=50.0)
+        # controller-published weights: replica-1 excluded
+        client.create(new_object(
+            "v1", "ConfigMap", "svc" + consts.SERVING_LOAD_SUFFIX, NS,
+            data={consts.SERVING_ROUTING_KEY: json.dumps(
+                {"svc-replica-0": 1.0, "svc-replica-1": 0.0}
+            )},
+        ))
+        for _ in range(30):
+            sim.step()
+        assert sim.routed.get("svc-replica-0", 0) > 0
+        assert sim.routed.get("svc-replica-1", 0) == 0
+        cm = client.get("v1", "ConfigMap", "svc" + consts.SERVING_LOAD_SUFFIX, NS)
+        data = cm["data"]
+        assert float(data[consts.SERVING_LOAD_ARRIVAL_RATE]) > 0
+        assert consts.SERVING_LOAD_TTFT_P99 in data
+        assert consts.SERVING_LOAD_QUEUE_DEPTH in data
+
+    def test_queue_builds_without_routable_capacity(self):
+        client = FakeClient()
+        sim = ServingTrafficSim(client, NS, "svc", DiurnalTraffic(seed=3))
+        for _ in range(5):
+            sim.step()
+        assert len(sim.queue) > 0
+        assert sim.routed == {}
+
+
+# ---------------------------------------------------------------------------
+# fragmentation-aware scale-down (the allocator oracle)
+# ---------------------------------------------------------------------------
+
+
+def _line_pool(occupied: dict):
+    """A 6x1x1 v5e line (mesh: no wrap links) with hand-placed one-host
+    gangs: ``occupied`` maps slice name -> host index. Returns (slices,
+    nodes)."""
+    nodes = make_torus_nodes(
+        (6, 1, 1), prefix="line", accelerator="tpu-v5-lite-podslice", chips=4
+    )
+    slices = []
+    for name, idx in occupied.items():
+        labels = nodes[idx]["metadata"]["labels"]
+        labels[consts.PLACEMENT_LABEL] = name
+        labels[consts.PLACEMENT_INDEX_LABEL] = "0"
+        slices.append({
+            "apiVersion": TPU_SLICE_API_VERSION, "kind": TPU_SLICE_KIND,
+            "metadata": {"name": name},
+            "spec": {"placement": {"shape": "1x1x1"}},
+        })
+    return slices, nodes
+
+
+class TestScaleDownVictim:
+    def test_victim_most_reduces_fragmentation_on_fragmented_torus(self):
+        """The hand-built pin: R1 at h1 and R2 at h3 checker a 6-host
+        line. Removing R2 merges h2..h5 into one 4-run (frag 0.2);
+        removing R1 only merges h0..h2 (frag 0.4). The victim must be
+        R2, and its removal must be strictly non-increasing on the
+        baseline fragmentation (0.5)."""
+        slices, nodes = _line_pool({"r1": 1, "r2": 3})
+        base = PlacementEngine(slices, nodes).plan()
+        frag_before = max(base.fragmentation.values())
+        assert frag_before == pytest.approx(0.5)
+        scores = scale_down_scores(slices, nodes, ["r1", "r2"])
+        assert scores["r1"][0] == pytest.approx(0.4)
+        assert scores["r2"][0] == pytest.approx(0.2)
+        victim = scale_down_victim(slices, nodes, ["r1", "r2"])
+        assert victim == "r2"
+        assert scores[victim][0] <= frag_before  # strictly non-increasing
+
+    def test_unplaced_candidate_always_wins(self):
+        slices, nodes = _line_pool({"r1": 1})
+        slices.append({
+            "apiVersion": TPU_SLICE_API_VERSION, "kind": TPU_SLICE_KIND,
+            "metadata": {"name": "r-pending"},
+            "spec": {"placement": {"shape": "4x4x4"}},  # never places
+        })
+        assert scale_down_victim(slices, nodes, ["r1", "r-pending"]) == "r-pending"
+
+    def test_deterministic_tiebreak(self):
+        slices, nodes = _line_pool({"a": 0, "b": 5})  # symmetric ends
+        assert scale_down_victim(slices, nodes, ["a", "b"]) == scale_down_victim(
+            list(reversed(slices)), nodes, ["b", "a"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# the TPUServing CRD
+# ---------------------------------------------------------------------------
+
+
+class TestServingCRD:
+    def test_roundtrip_and_defaults(self):
+        sv = TPUServing.from_unstructured(new_tpu_serving("s", {
+            "model": {"shape": "2x2x1", "pool": "p1"},
+            "replicas": {"min": 2, "max": 5, "targetRps": 40.0},
+            "slo": {"ttftP99Seconds": 1.5},
+        }))
+        assert sv.spec.model.shape == "2x2x1"
+        assert sv.spec.replicas.max == 5
+        assert sv.spec.slo.ttft_p99_seconds == 1.5
+        assert sv.spec.backoff.retry_limit == 5  # default
+        assert sv.spec.replicas.cooldown_seconds == 30.0  # default
+        out = sv.to_unstructured()
+        assert out["spec"]["replicas"]["targetRps"] == 40.0
+
+    def test_crd_registered_and_served_by_fake_apiserver(self):
+        from tpu_operator.api.crds import all_crds, tpu_serving_crd
+
+        crd = tpu_serving_crd()
+        assert crd["metadata"]["name"] == "tpuservings.tpu.google.com"
+        assert crd["spec"]["names"]["shortNames"] == ["tsv"]
+        assert any(
+            c["metadata"]["name"] == "tpuservings.tpu.google.com"
+            for c in all_crds()
+        )
+        client = FakeClient()
+        client.create(new_tpu_serving("s1", {"model": {"shape": "1x1x1"}}))
+        got = client.get(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, "s1")
+        assert got["spec"]["model"]["shape"] == "1x1x1"
+
+
+# ---------------------------------------------------------------------------
+# the serving controller
+# ---------------------------------------------------------------------------
+
+
+class Harness:
+    """FakeClient + torus + reconcilers + traffic sim in one beat-driven
+    bundle (the bench/drill loop, test-sized)."""
+
+    def __init__(self, spec=None, dims=(4, 2, 1), name="chat", traffic_seed=1):
+        self.client = FakeClient()
+        self.name = name
+        for node in make_torus_nodes(dims, prefix=f"sv-{name}"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            self.client.create(node)
+        self.client.create(new_tpu_serving(name, spec or {
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 1, "max": 3, "targetRps": 10.0,
+                         "cooldownSeconds": 0.05},
+            "slo": {"ttftP99Seconds": 3.0},
+            "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 5},
+        }))
+        self.rec = ServingReconciler(self.client, NS)
+        self.place = PlacementReconciler(self.client, NS)
+        self.sim = ServingTrafficSim(
+            self.client, NS, name, DiurnalTraffic(seed=traffic_seed),
+            replica_rps=10.0,
+        )
+        self.req = Request(name=name)
+
+    def beat(self, n=1, rps=None):
+        if rps is not None:
+            self.sim.override_rps = rps
+        for _ in range(n):
+            self.rec.reconcile(self.req)
+            self.place.reconcile(QUEUE_REQUEST)
+            self.sim.step()
+
+    def block(self):
+        obj = self.client.get(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, self.name)
+        return (obj.get("status") or {}).get("serving") or {}
+
+    def slices(self):
+        return sorted(
+            s["metadata"]["name"]
+            for s in self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+        )
+
+    def routing(self):
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", self.name + consts.SERVING_LOAD_SUFFIX, NS
+        )
+        raw = ((cm or {}).get("data") or {}).get(consts.SERVING_ROUTING_KEY, "{}")
+        return json.loads(raw)
+
+
+class TestServingController:
+    def test_min_replicas_placed_and_owned(self):
+        h = Harness()
+        h.beat(4, rps=3.0)
+        block = h.block()
+        assert block["phase"] == ServingPhase.SERVING
+        assert block["desired"] == 1 and block["ready"] == 1
+        assert h.slices() == [replica_name("chat", 0)]
+        obj = h.client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND,
+                           replica_name("chat", 0))
+        refs = obj["metadata"]["ownerReferences"]
+        assert refs and refs[0]["kind"] == TPU_SERVING_KIND
+        assert h.routing() == {replica_name("chat", 0): 1.0}
+
+    def test_burst_scales_up_through_placement(self):
+        h = Harness()
+        h.beat(4, rps=3.0)
+        h.beat(8, rps=28.0)
+        block = h.block()
+        assert block["desired"] == 3 and block["ready"] == 3
+        assert len(h.slices()) == 3
+        assert any(d["action"] == "scale-up" for d in block["decisions"])
+        # all three placed by the engine: no double-booked hosts
+        owners = {}
+        for node in h.client.list("v1", "Node"):
+            owner = (node["metadata"].get("labels") or {}).get(consts.PLACEMENT_LABEL)
+            if owner:
+                assert owners.setdefault(node["metadata"]["name"], owner) == owner
+
+    def test_lull_scales_down_with_hysteresis_and_fragmentation_victim(self):
+        h = Harness()
+        h.beat(4, rps=3.0)
+        h.beat(8, rps=28.0)
+        assert h.block()["ready"] == 3
+        # lull: the FIRST pass must NOT scale down (cooldown)
+        h.beat(1, rps=3.0)
+        assert h.block()["desired"] == 3
+        deadline = time.monotonic() + 10.0
+        while h.block()["desired"] != 1 and time.monotonic() < deadline:
+            h.beat(1)
+            time.sleep(0.02)
+        block = h.block()
+        assert block["desired"] == 1 and len(h.slices()) == 1
+        victims = [d for d in block["decisions"] if d["action"] == "victim"]
+        assert victims and "fragmentation delta" in victims[-1]["reason"]
+
+    def test_scale_down_waits_out_cooldown(self):
+        h = Harness(spec={
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 1, "max": 3, "targetRps": 10.0,
+                         "cooldownSeconds": 3600.0},
+            "slo": {"ttftP99Seconds": 3.0},
+        })
+        h.beat(4, rps=3.0)
+        h.beat(8, rps=28.0)
+        assert h.block()["ready"] == 3
+        h.beat(10, rps=3.0)
+        assert h.block()["desired"] == 3  # an hour of lull required
+
+    def test_burst_trailing_edge_does_not_flap(self):
+        """Bursts scale up immediately but their trailing edge must not
+        scale down: the lull clock (lowSince) resets whenever demand
+        re-breaches inside the cooldown."""
+        h = Harness()
+        h.beat(4, rps=3.0)
+        h.beat(6, rps=28.0)
+        assert h.block()["desired"] == 3
+        for _ in range(6):  # oscillating demand inside the cooldown
+            h.beat(1, rps=3.0)
+            h.beat(1, rps=28.0)
+        assert h.block()["desired"] == 3
+        assert not any(
+            d["action"] == "scale-down" for d in h.block()["decisions"]
+        )
+
+    def test_fabric_degraded_replica_excluded_from_routing(self):
+        h = Harness()
+        h.beat(4, rps=3.0)
+        h.beat(8, rps=28.0)
+        replica = replica_name("chat", 0)
+        obj = h.client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, replica)
+        members = obj["status"]["placement"]["nodes"]
+        artifact = {"members": members, "min_edge_gbps": 4.0,
+                    "median_edge_gbps": 100.0}
+        h.client.create(new_object("v1", "ConfigMap", f"{replica}-gang", NS))
+        h.client.patch(
+            "v1", "ConfigMap", f"{replica}-gang",
+            {"metadata": {"annotations": {
+                consts.GANG_FABRIC_ANNOTATION: json.dumps(artifact)}}},
+            NS,
+        )
+        h.sim.routed = {}
+        h.beat(5, rps=28.0)
+        block = h.block()
+        assert block["phase"] == ServingPhase.DEGRADED
+        assert block["replicas"][replica] == "Excluded"
+        assert h.routing()[replica] == 0.0
+        assert h.sim.routed.get(replica, 0) == 0
+        assert sum(h.sim.routed.values()) > 0  # traffic drained to peers
+        assert any(
+            e.get("reason") == "ServingReplicaExcluded"
+            for e in h.client.list("v1", "Event", "default")
+        )
+
+    def test_stale_fabric_artifact_does_not_exclude(self):
+        """A re-placed replica's old artifact (disjoint members) must
+        not exclude the healthy new block — the fabric analyzer's
+        staleness convention."""
+        h = Harness()
+        h.beat(4, rps=3.0)
+        replica = replica_name("chat", 0)
+        artifact = {"members": ["not-a-member-0", "not-a-member-1"],
+                    "min_edge_gbps": 4.0, "median_edge_gbps": 100.0}
+        h.client.create(new_object("v1", "ConfigMap", f"{replica}-gang", NS))
+        h.client.patch(
+            "v1", "ConfigMap", f"{replica}-gang",
+            {"metadata": {"annotations": {
+                consts.GANG_FABRIC_ANNOTATION: json.dumps(artifact)}}},
+            NS,
+        )
+        h.beat(2, rps=3.0)
+        assert h.routing()[replica] == 1.0
+
+    def test_broken_replica_unroutable_and_replaced(self):
+        """A replica's host dying drains its weight to zero; the
+        placement engine re-places the slice and routing recovers."""
+        h = Harness()
+        h.beat(4, rps=3.0)
+        replica = replica_name("chat", 0)
+        obj = h.client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, replica)
+        victim_node = obj["status"]["placement"]["nodes"][0]
+        h.client.patch("v1", "Node", victim_node, {"metadata": {"labels": {
+            consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}}})
+        h.rec.reconcile(h.req)
+        assert h.routing()[replica] == 0.0  # drained before re-place
+        h.beat(4, rps=3.0)
+        obj = h.client.get(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, replica)
+        new_nodes = obj["status"]["placement"]["nodes"]
+        assert victim_node not in new_nodes
+        assert h.routing()[replica] == 1.0
+        assert h.block()["phase"] == ServingPhase.SERVING
+
+    def test_gang_step_time_breach_scales_up(self):
+        h = Harness(spec={
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 1, "max": 2, "targetRps": 1000.0,
+                         "cooldownSeconds": 0.05},
+            "slo": {"ttftP99Seconds": 30.0, "stepSeconds": 0.02},
+        })
+        h.beat(4, rps=3.0)
+        assert h.block()["desired"] == 1
+        replica = replica_name("chat", 0)
+        artifact = {"gang_step_p50_s": 0.5, "straggler_ratio": 1.0}
+        h.client.create(new_object("v1", "ConfigMap", f"{replica}-gang", NS))
+        h.client.patch(
+            "v1", "ConfigMap", f"{replica}-gang",
+            {"metadata": {"annotations": {
+                consts.GANG_TELEMETRY_ANNOTATION: json.dumps(artifact)}}},
+            NS,
+        )
+        h.beat(3, rps=3.0)
+        assert h.block()["desired"] == 2
+
+    # -- retry budget --------------------------------------------------------
+
+    def _unplaceable(self, retry_limit=3, base=60.0):
+        return Harness(spec={
+            "model": {"shape": "8x8x8"},  # never places on 4x2x1
+            "replicas": {"min": 1, "max": 1, "targetRps": 10.0},
+            "slo": {"ttftP99Seconds": 3.0},
+            "backoff": {"baseSeconds": base, "maxSeconds": base,
+                        "retryLimit": retry_limit},
+        })
+
+    def test_watch_storm_cannot_outrun_backoff_gate(self):
+        """The PR 13 pin, serving edition: reconcile storms must not
+        burn the placement retry budget faster than the backoff
+        schedule — attempts before the persisted nextAttemptAt are
+        free."""
+        h = self._unplaceable(retry_limit=3, base=60.0)
+        for _ in range(10):  # an event storm
+            h.rec.reconcile(h.req)
+            h.place.reconcile(QUEUE_REQUEST)
+        block = h.block()
+        assert block["restarts"] == 1  # one charge, nine gated passes
+        assert block["nextAttemptAt"] > time.time()
+        assert block["phase"] != ServingPhase.FAILED
+
+    def test_budget_exhaustion_quarantines_with_event_and_sweep(self):
+        h = self._unplaceable(retry_limit=2, base=0.0)
+        for _ in range(8):
+            h.rec.reconcile(h.req)
+            h.place.reconcile(QUEUE_REQUEST)
+        block = h.block()
+        assert block["phase"] == ServingPhase.FAILED
+        assert "retry budget exhausted" in block["message"]
+        assert h.slices() == []  # quarantine frees the queue slot
+        assert any(
+            e.get("reason") == "ServingFailed"
+            for e in h.client.list("v1", "Event", "default")
+        )
+        # terminal: no further reconcile churn
+        h.rec.reconcile(h.req)
+        assert h.block() == block
+
+    def test_scale_up_shortfall_above_min_never_quarantines(self):
+        """Review pin: a burst wanting more replicas than the torus fits
+        must NOT burn the retry budget while the service is at or above
+        its min floor — exhaustion there would delete healthy,
+        traffic-serving replicas to punish a full cluster. The fleet
+        stays Scaling with the shortfall noted; the budget only charges
+        when ready drops below min."""
+        h = Harness(spec={
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 1, "max": 3, "targetRps": 10.0,
+                         "cooldownSeconds": 3600.0},
+            "slo": {"ttftP99Seconds": 3.0},
+            "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 1},
+        }, dims=(2, 2, 1))  # room for exactly TWO 2x1x1 replicas
+        h.beat(4, rps=3.0)
+        assert h.block()["ready"] == 1
+        h.beat(20, rps=28.0)  # wants 3; the pool fits 2
+        block = h.block()
+        assert block["desired"] == 3
+        assert block["ready"] == 2
+        assert block["phase"] == ServingPhase.SCALING
+        assert block["restarts"] == 0  # nothing charged against the budget
+        assert "capacity short" in block["message"]
+        assert len([s for s in h.slices() if "replica" in s]) == 3
+
+    def test_budget_resets_when_fleet_becomes_ready(self):
+        h = Harness(spec={
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 2, "max": 2, "targetRps": 10.0},
+            "slo": {"ttftP99Seconds": 3.0},
+            "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 50},
+        }, dims=(2, 1, 1))
+        # only one 2x1x1 block fits a 2-host pool: replica 1 starves
+        for _ in range(4):
+            h.rec.reconcile(h.req)
+            h.place.reconcile(QUEUE_REQUEST)
+        assert h.block()["restarts"] >= 1
+        # capacity heals: 2 more hosts join, the second replica places
+        for node in make_torus_nodes((2, 1, 1), prefix="heal", nodepool="pool-b"):
+            node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+            h.client.create(node)
+        for _ in range(6):
+            h.rec.reconcile(h.req)
+            h.place.reconcile(QUEUE_REQUEST)
+        block = h.block()
+        assert block["ready"] == 2
+        assert block["restarts"] == 0
+        assert "nextAttemptAt" not in block
+
+    # -- spec validation / lifecycle -----------------------------------------
+
+    def test_invalid_spec_fails_terminally(self):
+        h = Harness(spec={
+            "model": {"shape": "not-a-shape"},
+            "replicas": {"min": 1, "max": 1},
+        })
+        h.rec.reconcile(h.req)
+        block = h.block()
+        assert block["phase"] == ServingPhase.FAILED
+        assert "invalid serving spec" in block["message"]
+
+    def test_restart_safety_rederives_from_status(self):
+        """A fresh reconciler (operator restart) must re-derive the same
+        desired count from status instead of snapping back to min."""
+        h = Harness()
+        h.beat(4, rps=3.0)
+        h.beat(8, rps=28.0)
+        assert h.block()["desired"] == 3
+        fresh = ServingReconciler(h.client, NS)
+        fresh.reconcile(h.req)
+        assert h.block()["desired"] == 3
+        assert len(h.slices()) == 3
+
+    def test_deletion_sweeps_only_owned_slices(self):
+        h = Harness()
+        h.beat(4, rps=3.0)
+        # a user's standalone slice that merely looks like a replica
+        h.client.create({
+            "apiVersion": TPU_SLICE_API_VERSION, "kind": TPU_SLICE_KIND,
+            "metadata": {"name": "chat-replica-99"},
+            "spec": {"placement": {"shape": "1x1x1"}},
+        })
+        h.client.delete(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, "chat")
+        h.rec.reconcile(h.req)
+        assert h.slices() == ["chat-replica-99"]
+
+    def test_metrics_exported_and_retired_on_deletion(self):
+        import prometheus_client
+
+        h = Harness(name="metrics-sv")
+        h.beat(4, rps=3.0)
+        scrape = prometheus_client.generate_latest(
+            prometheus_client.REGISTRY
+        ).decode()
+        assert 'tpu_operator_serving_replicas{serving="metrics-sv"} 1.0' in scrape
+        assert 'tpu_operator_serving_queue_depth{serving="metrics-sv"}' in scrape
+        h.client.delete(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, "metrics-sv")
+        h.rec.reconcile(h.req)
+        scrape = prometheus_client.generate_latest(
+            prometheus_client.REGISTRY
+        ).decode()
+        assert 'serving="metrics-sv"' not in scrape
+
+    def test_scale_to_zero_window(self):
+        h = Harness(spec={
+            "model": {"shape": "2x1x1"},
+            "replicas": {"min": 0, "max": 2, "targetRps": 10.0,
+                         "cooldownSeconds": 0.01},
+            "slo": {"ttftP99Seconds": 3.0},
+        })
+        h.rec.reconcile(h.req)
+        block = h.block()
+        assert block["desired"] == 0
+        assert block["phase"] == ServingPhase.SERVING
+        assert h.slices() == []

@@ -12,7 +12,7 @@ import dataclasses
 import typing
 from typing import Any, Dict, List, Optional, get_args, get_origin
 
-from tpu_operator.api import clusterpolicy, tpujob, tpuslice
+from tpu_operator.api import clusterpolicy, tpujob, tpuserving, tpuslice
 from tpu_operator.api.common import SpecBase
 
 CRD_API_VERSION = "apiextensions.k8s.io/v1"
@@ -146,5 +146,17 @@ def tpu_job_crd() -> dict:
     )
 
 
+def tpu_serving_crd() -> dict:
+    return _crd(
+        kind=tpuserving.TPU_SERVING_KIND,
+        plural="tpuservings",
+        singular="tpuserving",
+        version="v1alpha1",
+        spec_cls=tpuserving.TPUServingSpec,
+        status_cls=tpuserving.TPUServingStatus,
+        short_names=["tsv"],
+    )
+
+
 def all_crds() -> List[dict]:
-    return [cluster_policy_crd(), tpu_slice_crd(), tpu_job_crd()]
+    return [cluster_policy_crd(), tpu_slice_crd(), tpu_job_crd(), tpu_serving_crd()]

@@ -1,0 +1,157 @@
+"""TPUServing CRD (tpu.google.com/v1alpha1): traffic-driven elastic serving.
+
+A TPUServing declares a *model footprint* (the gang shape one inference
+replica needs, plus an optional generation/pool pin), a replica window
+(min/max), and the SLO the autoscaler defends (p99 time-to-first-token
+and decode step time). The serving controller
+(``controllers/serving_controller.py``) owns one TPUSlice per replica
+and drives the replica count from observed demand: arrival rate and
+queue depth from the load ConfigMap the traffic side publishes, step
+time from the PR 7 gang telemetry artifacts. Scale-ups are admitted
+priority-then-FIFO through the placement engine; scale-downs pick the
+victim whose removal most *reduces* torus fragmentation (the allocator's
+own scoring, replayed minus each candidate); routing weights exclude
+replicas whose fabric artifact shows degraded ICI edges.
+
+The inference payload itself is ``workloads/serving.py``: a
+continuous-batching decode engine over the int8 matmul +
+flash-attention kernels, running the per-generation autotune winners.
+
+No NVIDIA-reference analog: the gpu-operator stops at provisioning;
+the serving layer is where demand drives the placement stack
+(PAPERS.md: "Fine-Tuning and Serving Gemma 4 31B on Google Cloud TPU").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from tpu_operator.api.common import SpecBase, field, sub
+
+TPU_SERVING_API_VERSION = "tpu.google.com/v1alpha1"
+TPU_SERVING_KIND = "TPUServing"
+
+
+class ServingPhase:
+    """The serving FSM. ``Failed`` is terminal (retry budget exhausted on
+    placement); everything else recomputes from cluster state every pass."""
+
+    PENDING = "Pending"
+    SCALING = "Scaling"   # desired != ready: replicas placing or draining
+    SERVING = "Serving"   # every desired replica placed and routable
+    DEGRADED = "Degraded"  # serving, but some replica excluded/unplaced
+    FAILED = "Failed"
+
+
+SERVING_TERMINAL_PHASES = (ServingPhase.FAILED,)
+
+
+@dataclasses.dataclass
+class ServingModelSpec(SpecBase):
+    """What one replica runs: the host-block ``shape`` a replica's gang
+    needs on the pool's torus (TPUSlice placement grammar), an optional
+    accelerator-generation hint (documentation + the autotune winners
+    the decode engine resolves), and an optional node-pool pin forwarded
+    to every replica slice."""
+
+    shape: str = field(default="")
+    generation: str = field(default="")
+    pool: str = field(default="")
+    priority: int = field(default=0)
+
+
+@dataclasses.dataclass
+class ServingReplicasSpec(SpecBase):
+    """The replica window the autoscaler moves inside. ``targetRps`` is
+    one replica's sustainable request rate — the capacity denominator
+    demand is divided by; keep it at or below the measured decode-bench
+    throughput so the SLO check has headroom."""
+
+    min: int = field(default=1)
+    max: int = field(default=1)
+    target_rps: float = field(json="targetRps", default=10.0)
+    # scale-down hysteresis: demand must fit the shrunk set for this
+    # long (and this long since the last scale action) before a replica
+    # is retired — bursts scale up instantly, lulls shrink slowly
+    cooldown_seconds: float = field(json="cooldownSeconds", default=30.0)
+
+
+@dataclasses.dataclass
+class ServingSLOSpec(SpecBase):
+    """The targets the autoscaler defends: measured p99 TTFT above
+    ``ttftP99Seconds`` or a gang-median decode step above
+    ``stepSeconds`` reads as an overloaded fleet and scales up even when
+    the rate math alone still fits."""
+
+    ttft_p99_seconds: float = field(json="ttftP99Seconds", default=2.0)
+    step_seconds: float = field(json="stepSeconds", default=0.0)
+
+
+@dataclasses.dataclass
+class ServingBackoffSpec(SpecBase):
+    """Placement-retry budget: consecutive autoscaler passes in which a
+    wanted replica stays unplaceable burn the budget (full-jitter
+    delays, ``kube/backoff.py``); exhaustion quarantines the serving in
+    ``Failed`` with an Event instead of hammering the placement queue."""
+
+    base_seconds: float = field(json="baseSeconds", default=1.0)
+    max_seconds: float = field(json="maxSeconds", default=60.0)
+    retry_limit: int = field(json="retryLimit", default=5)
+
+
+@dataclasses.dataclass
+class TPUServingSpec(SpecBase):
+    model: ServingModelSpec = sub(ServingModelSpec)
+    replicas: ServingReplicasSpec = sub(ServingReplicasSpec)
+    slo: ServingSLOSpec = sub(ServingSLOSpec)
+    backoff: ServingBackoffSpec = sub(ServingBackoffSpec)
+
+
+@dataclasses.dataclass
+class TPUServingStatus(SpecBase):
+    """``state`` mirrors the FSM phase for printer columns; ``serving``
+    is the bookkeeping block (phase, desired/ready replicas, per-replica
+    lifecycle, routing weights, last scale decisions with reasons, SLO
+    attainment) the controller publishes as a key-scoped status patch."""
+
+    state: str = field(default="")
+    conditions: List[dict] = field(default_factory=list)
+    serving: dict = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TPUServing:
+    metadata: dict
+    spec: TPUServingSpec
+    status: TPUServingStatus
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @classmethod
+    def from_unstructured(cls, obj: dict) -> "TPUServing":
+        return cls(
+            metadata=obj.get("metadata", {}),
+            spec=TPUServingSpec.from_dict(obj.get("spec")),
+            status=TPUServingStatus.from_dict(obj.get("status")),
+        )
+
+    def to_unstructured(self) -> dict:
+        return {
+            "apiVersion": TPU_SERVING_API_VERSION,
+            "kind": TPU_SERVING_KIND,
+            "metadata": self.metadata,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+def new_tpu_serving(name: str, spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": TPU_SERVING_API_VERSION,
+        "kind": TPU_SERVING_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

@@ -37,6 +37,10 @@ from tpu_operator.controllers.placement_controller import (
     PlacementReconciler,
     setup_with_manager as setup_placement,
 )
+from tpu_operator.controllers.serving_controller import (
+    ServingReconciler,
+    setup_with_manager as setup_serving,
+)
 from tpu_operator.controllers.tpuslice_controller import (
     TPUSliceReconciler,
     setup_with_manager as setup_tpuslice,
@@ -125,6 +129,7 @@ def main(argv=None) -> int:
     setup_placement(mgr, PlacementReconciler(client, namespace))
     setup_autotune(mgr, AutotuneReconciler(client, namespace))
     setup_job(mgr, JobReconciler(client, namespace))
+    setup_serving(mgr, ServingReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

@@ -224,6 +224,44 @@ JOB_RESYNC_SECONDS = 5.0
 JOB_HISTORY_LIMIT = 10
 JOB_CAUSES_LIMIT = 5
 
+# ---------------------------------------------------------------------------
+# Traffic-driven elastic serving (api/tpuserving.py ->
+# controllers/serving_controller.py -> workloads/serving.py). The
+# serving controller owns one TPUSlice per replica (named <serving> +
+# SERVING_REPLICA_INFIX + index) and scales the replica set through the
+# placement engine from observed demand. Demand and the controller's
+# routing decision meet at the load ConfigMap (<serving> +
+# SERVING_LOAD_SUFFIX): the traffic side (router/sim) publishes arrival
+# rate, queue depth and measured TTFT; the controller reads them into
+# status.serving and writes the one key it owns (the routing-weight
+# map, which the router consumes on its next tick).
+# ---------------------------------------------------------------------------
+SERVING_REPLICA_INFIX = "-replica-"
+SERVING_LOAD_SUFFIX = "-load"
+# traffic-side load keys
+SERVING_LOAD_ARRIVAL_RATE = "arrivalRate"     # requests/s (EWMA over ticks)
+SERVING_LOAD_QUEUE_DEPTH = "queueDepth"       # requests waiting for a slot
+SERVING_LOAD_TTFT_P50 = "ttftP50"             # measured, seconds
+SERVING_LOAD_TTFT_P99 = "ttftP99"             # measured, seconds
+SERVING_LOAD_TOKENS_PER_S = "tokensPerS"      # aggregate decode throughput
+# controller-owned load key: JSON {replica slice name: weight}; the
+# router routes only to weight > 0 (degraded-fabric and unplaced
+# replicas are excluded here, not by every router re-deriving blame)
+SERVING_ROUTING_KEY = "routing"
+# autoscaler cadence while a serving is non-terminal (demand moves
+# without any watch event the predicate maps)
+SERVING_RESYNC_SECONDS = 5.0
+# hysteresis: scale-ups are immediate (a burst is exactly when capacity
+# is needed); scale-downs wait until demand has sat below the shrunk
+# capacity for a full cooldown — a diurnal lull shrinks the fleet, a
+# burst's trailing edge doesn't flap it
+SERVING_SCALE_DOWN_COOLDOWN_SECONDS = 30.0
+# scale down only when demand fits the shrunk replica set at this
+# utilization (head-room so the next tick's noise doesn't re-breach)
+SERVING_SCALE_DOWN_HEADROOM = 0.8
+# status.serving scale-decision history bound (last N with reasons)
+SERVING_DECISIONS_LIMIT = 5
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

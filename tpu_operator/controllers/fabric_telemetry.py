@@ -78,6 +78,27 @@ def parse_link_map(cm: Optional[dict]) -> Dict[str, Dict[str, dict]]:
     return out
 
 
+def degraded_link_pairs(client, namespace: str) -> List[Tuple[str, str]]:
+    """Severed ICI edges from the link-health ConfigMap as sorted
+    node-name pairs — the degraded-links input every consumer (placement
+    replan, TPUJob gang state, TPUServing routing/victim scoring) feeds
+    the engine. A MISSING or malformed map means no cuts (nothing was
+    ever recorded) — but a failed READ propagates and aborts the
+    caller's pass like any other input read: planning with "no cuts"
+    because the apiserver 500'd could seat a fresh gang straight across
+    a known-degraded link."""
+    cm = client.get_or_none(
+        "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, namespace
+    )
+    edges = []
+    for pool_edges in parse_link_map(cm).values():
+        for edge in pool_edges:
+            a, _, b = edge.partition("|")
+            if a and b:
+                edges.append((a, b))
+    return sorted(edges)
+
+
 class FabricTelemetryAggregator:
     def __init__(self, client: Client, namespace: str, recorder: Optional[EventRecorder] = None):
         self.client = client

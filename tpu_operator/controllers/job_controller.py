@@ -133,18 +133,9 @@ class JobReconciler:
         return (cm or {}).get("data") or {}
 
     def _degraded_links(self) -> List[tuple]:
-        from tpu_operator.controllers.fabric_telemetry import parse_link_map
+        from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
 
-        cm = self.client.get_or_none(
-            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
-        )
-        edges = []
-        for pool_edges in parse_link_map(cm).values():
-            for edge in pool_edges:
-                a, _, b = edge.partition("|")
-                if a and b:
-                    edges.append((a, b))
-        return sorted(edges)
+        return degraded_link_pairs(self.client, self.namespace)
 
     # -- slice management ----------------------------------------------------
 

@@ -236,6 +236,41 @@ class OperatorMetrics:
             ["job"],
             registry=reg,
         )
+        # traffic-driven serving (controllers/serving_controller.py):
+        # per-serving rollups from the load ConfigMap + replica states,
+        # removed when the TPUServing is deleted (O005)
+        self.serving_replicas = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_replicas",
+            "Ready replicas of the serving (placed, in-service gangs; "
+            "0 while every replica is placing or broken)",
+            ["serving"],
+            registry=reg,
+        )
+        self.serving_tokens_per_s = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_tokens_per_s",
+            "Aggregate decode throughput the serving's router last "
+            "reported into the load ConfigMap",
+            ["serving"],
+            registry=reg,
+        )
+        self.serving_ttft_p99 = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_ttft_p99_seconds",
+            "Measured p99 time-to-first-token from the load ConfigMap "
+            "(the SLO the autoscaler defends)",
+            ["serving"],
+            registry=reg,
+        )
+        self.serving_queue_depth = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_queue_depth",
+            "Requests waiting for a decode slot across the serving's "
+            "replicas (sustained depth is the scale-up signal)",
+            ["serving"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

@@ -237,25 +237,13 @@ class PlacementReconciler:
         return [by_name[name] for name in sorted(by_name)]
 
     def _degraded_links(self) -> List[tuple]:
-        """Severed ICI edges from the fabric analyzer's link-health map
-        (``consts.LINK_HEALTH_CONFIGMAP``): node-name pairs the engine
-        treats as cutting contiguity. A MISSING or malformed map means
-        no cuts (nothing was ever recorded) — but a failed read
-        propagates and aborts the pass like any other input read:
-        planning with "no cuts" because the apiserver 500'd could seat
-        a fresh gang straight across a known-degraded link."""
-        from tpu_operator.controllers.fabric_telemetry import parse_link_map
+        """Severed ICI edges the engine treats as cutting contiguity
+        (``fabric_telemetry.degraded_link_pairs`` — shared with the job
+        and serving controllers so the three can never diverge on the
+        link-map encoding)."""
+        from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
 
-        cm = self.client.get_or_none(
-            "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
-        )
-        edges = []
-        for pool_edges in parse_link_map(cm).values():
-            for edge in pool_edges:
-                a, _, b = edge.partition("|")
-                if a and b:
-                    edges.append((a, b))
-        return sorted(edges)
+        return degraded_link_pairs(self.client, self.namespace)
 
     # -- plan application ----------------------------------------------------
 

@@ -580,6 +580,221 @@ class GangFaultSchedule:
                 pass
 
 
+class DiurnalTraffic:
+    """Seeded request-arrival schedule: the demand half of the serving
+    drill. A diurnal sinusoid between ``base_rps`` and ``peak_rps``
+    (period ``period_ticks`` virtual seconds) with seeded burst windows
+    riding on top — the "millions of users" load curve compressed to
+    sim scale. Deterministic the same way :class:`GangFaultSchedule`
+    is: same seed + same driving sequence → the same arrival log
+    (``self.log``)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        period_ticks: int = 120,
+        base_rps: float = 2.0,
+        peak_rps: float = 12.0,
+        burst_every: int = 37,
+        burst_ticks: int = 3,
+        burst_rps: float = 30.0,
+    ):
+        self.seed = seed
+        self.period_ticks = max(2, period_ticks)
+        self.base_rps = base_rps
+        self.peak_rps = peak_rps
+        self.burst_every = burst_every
+        self.burst_ticks = burst_ticks
+        self.burst_rps = burst_rps
+        self._rng = random.Random(seed)
+        self.log: list = []  # (tick, arrivals)
+
+    def rate(self, tick: int) -> float:
+        """The intended request rate at ``tick`` (pure — no rng): the
+        diurnal curve, with the burst rate during burst windows."""
+        import math
+
+        phase = 2.0 * math.pi * (tick % self.period_ticks) / self.period_ticks
+        rate = self.base_rps + (self.peak_rps - self.base_rps) * 0.5 * (1.0 - math.cos(phase))
+        # bursts land mid-window, never at tick 0 — a schedule that
+        # bursts before the first routing pass exists would just measure
+        # cold start
+        if self.burst_every and (
+            tick % self.burst_every >= self.burst_every - self.burst_ticks
+        ):
+            rate = max(rate, self.burst_rps)
+        return rate
+
+    def arrivals(self, tick: int) -> int:
+        """Arrivals this tick: the rate with seeded stochastic rounding
+        (the fractional part lands as one extra request at its own
+        probability). Must be driven sequentially — the draw order IS
+        the determinism contract."""
+        rate = self.rate(tick)
+        whole = int(rate)
+        count = whole + (1 if self._rng.random() < (rate - whole) else 0)
+        self.log.append((tick, count))
+        return count
+
+
+class ServingTrafficSim:
+    """The user-facing half of a TPUServing drill: seeded arrivals
+    (:class:`DiurnalTraffic`) routed to the serving's replicas by the
+    routing weights the controller publishes into the load ConfigMap,
+    a per-replica service-rate queue model, and the load publication
+    the autoscaler reads back. One ``step()`` = one virtual second.
+
+    This is the serving analog of the ``InProcessJobRunner`` beat: the
+    controller and the traffic meet ONLY at the load ConfigMap
+    (traffic-owned demand keys, controller-owned ``routing`` key), so
+    the same sim drives the fake apiserver, the wire drill, and the
+    chaos soak."""
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        serving_name: str,
+        traffic: Optional[DiurnalTraffic] = None,
+        replica_rps: float = 10.0,
+        tokens_per_request: int = 16,
+        service_latency_s: float = 0.05,
+        window: int = 64,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.serving_name = serving_name
+        self.traffic = traffic or DiurnalTraffic()
+        self.replica_rps = replica_rps
+        self.tokens_per_request = tokens_per_request
+        self.service_latency_s = service_latency_s
+        self.window = window
+        # bench hook: force a burst/lull phase instead of riding the
+        # sinusoid (None = use the schedule)
+        self.override_rps: Optional[float] = None
+        self._tick = 0
+        self._rate_ewma = 0.0
+        self._served_credit = 0.0
+        self.queue: list = []  # arrival ticks of waiting requests
+        self.routed: Dict[str, int] = {}  # replica slice -> requests routed
+        self.ttfts: list = []  # completed-request TTFTs, virtual seconds
+
+    @property
+    def load_name(self) -> str:
+        from tpu_operator import consts as _consts
+
+        return self.serving_name + _consts.SERVING_LOAD_SUFFIX
+
+    def _weights(self) -> Dict[str, float]:
+        """The controller-published routing map; absent/malformed reads
+        as no routable capacity (the queue builds, which is itself the
+        scale-up signal)."""
+        import json
+
+        from tpu_operator import consts as _consts
+
+        cm = self.client.get_or_none("v1", "ConfigMap", self.load_name, self.namespace)
+        raw = ((cm or {}).get("data") or {}).get(_consts.SERVING_ROUTING_KEY)
+        if not raw:
+            return {}
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            return {}
+        out = {}
+        for name, weight in (parsed or {}).items():
+            try:
+                w = float(weight)
+            except (TypeError, ValueError):
+                continue
+            if w > 0:
+                out[str(name)] = w
+        return out
+
+    def step(self) -> dict:
+        """One virtual second: admit arrivals, serve from the weighted
+        replicas, publish the load ConfigMap."""
+        tick = self._tick
+        self._tick += 1
+        if self.override_rps is not None:
+            rate = self.override_rps
+            whole = int(rate)
+            arrivals = whole + (1 if self.traffic._rng.random() < (rate - whole) else 0)
+        else:
+            arrivals = self.traffic.arrivals(tick)
+            rate = self.traffic.rate(tick)
+        self.queue.extend([tick] * arrivals)
+        self._rate_ewma = 0.3 * rate + 0.7 * (self._rate_ewma or rate)
+        weights = self._weights()
+        capacity = self.replica_rps * len(weights)
+        if not weights:
+            # zero routable replicas serve nothing — banked credit from
+            # a previously-healthy fleet must not fake capacity
+            self._served_credit = 0.0
+        else:
+            self._served_credit = min(  # unused credit does not bank forever
+                self._served_credit + capacity, capacity + self.replica_rps
+            )
+        served = min(len(self.queue), int(self._served_credit))
+        self._served_credit -= served
+        for _ in range(served):
+            arrived = self.queue.pop(0)
+            # deterministic weighted fairness: the replica with the most
+            # undeserved credit takes the next request; zero-weight
+            # replicas (excluded by the controller) never appear
+            replica = max(
+                weights,
+                key=lambda r: (weights[r] / (self.routed.get(r, 0) + 1), r),
+            )
+            self.routed[replica] = self.routed.get(replica, 0) + 1
+            self.ttfts.append((tick - arrived) + self.service_latency_s)
+        self.ttfts = self.ttfts[-self.window:]
+        report = {
+            "tick": tick,
+            "arrivals": arrivals,
+            "served": served,
+            "queue_depth": len(self.queue),
+            "replicas_routable": len(weights),
+        }
+        self._publish(served)
+        return report
+
+    def ttft_percentiles(self) -> tuple:
+        if not self.ttfts:
+            return (0.0, 0.0)
+        ordered = sorted(self.ttfts)
+        p50 = ordered[min(len(ordered) - 1, int(round(0.5 * (len(ordered) - 1))))]
+        p99 = ordered[min(len(ordered) - 1, int(round(0.99 * (len(ordered) - 1))))]
+        return (p50, p99)
+
+    def _publish(self, served: int) -> None:
+        from tpu_operator import consts as _consts
+
+        p50, p99 = self.ttft_percentiles()
+        data = {
+            _consts.SERVING_LOAD_ARRIVAL_RATE: f"{self._rate_ewma:.3f}",
+            _consts.SERVING_LOAD_QUEUE_DEPTH: str(len(self.queue)),
+            _consts.SERVING_LOAD_TTFT_P50: f"{p50:.3f}",
+            _consts.SERVING_LOAD_TTFT_P99: f"{p99:.3f}",
+            _consts.SERVING_LOAD_TOKENS_PER_S: str(served * self.tokens_per_request),
+        }
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", self.load_name, {"data": data}, self.namespace
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: ignore
+                    new_object(
+                        "v1", "ConfigMap", self.load_name, self.namespace, data=data
+                    )
+                )
+            except errors.AlreadyExists:
+                pass
+        except errors.ApiError:
+            pass  # chaos rider: a dropped publish retries next tick
+
+
 class StubKubelet:
     """In-process kubelet device-plugin Registration service (v1beta1) on a
     unix socket, capturing Register calls — the kubelet half of the device

@@ -25,6 +25,7 @@ import yaml
 
 from tpu_operator.api.clusterpolicy import CLUSTER_POLICY_API_VERSION
 from tpu_operator.api.tpujob import TPU_JOB_API_VERSION
+from tpu_operator.api.tpuserving import TPU_SERVING_API_VERSION
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
 from tpu_operator.kube import errors
 from tpu_operator.kube.client import Client
@@ -37,6 +38,7 @@ _COLLECTIONS: List[Tuple[str, str, str, bool]] = [
     ("clusterpolicies", CLUSTER_POLICY_API_VERSION, "ClusterPolicy", False),
     ("tpuslices", TPU_SLICE_API_VERSION, "TPUSlice", False),
     ("tpujobs", TPU_JOB_API_VERSION, "TPUJob", False),
+    ("tpuservings", TPU_SERVING_API_VERSION, "TPUServing", False),
     ("daemonsets", "apps/v1", "DaemonSet", True),
     ("pods", "v1", "Pod", True),
     ("services", "v1", "Service", True),
@@ -210,6 +212,41 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("jobs.txt", "\n".join(lines) + "\n")
     except errors.ApiError as e:
         emit("jobs.txt", f"# collection failed: {e}\n")
+
+    try:
+        # the serving view: per-serving replica map (which replica is
+        # routable and why not), SLO attainment, and the last scale
+        # decisions with their reasons — where "why did my serving
+        # shrink / why is this replica getting no traffic" starts
+        lines = ["# servings"]
+        rows = []
+        for sv in client.list(TPU_SERVING_API_VERSION, "TPUServing"):
+            spec = sv.get("spec") or {}
+            replicas_spec = spec.get("replicas") or {}
+            block = (sv.get("status") or {}).get("serving") or {}
+            slo = block.get("slo") or {}
+            rows.append(
+                f"{sv['metadata']['name']}  phase={block.get('phase', '-')}  "
+                f"replicas={block.get('ready', 0)}/{block.get('desired', 0)}"
+                f"(window {replicas_spec.get('min', '-')}-"
+                f"{replicas_spec.get('max', '-')})  "
+                f"routable={block.get('routable', 0)}  "
+                f"ttftP99={slo.get('ttftP99', '-')}s"
+                f"/{slo.get('ttftTarget', '-')}s  "
+                f"sloAttained={slo.get('attained', '-')}"
+                + (f"  message={block.get('message')}" if block.get("message") else "")
+            )
+            for name, state in sorted((block.get("replicas") or {}).items()):
+                rows.append(f"  replica {name}  {state}")
+            for decision in block.get("decisions") or []:
+                rows.append(
+                    f"  decision pass={decision.get('step')}  "
+                    f"{decision.get('action')}  {decision.get('reason')}"
+                )
+        lines.extend(rows or ["# none"])
+        emit("serving.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("serving.txt", f"# collection failed: {e}\n")
 
     try:
         # the data-plane telemetry view: fleet rollup (per-node perf

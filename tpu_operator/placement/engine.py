@@ -225,6 +225,72 @@ def largest_placeable_shape(
     return None
 
 
+def scale_down_scores(
+    slices: Sequence[ObjectDict],
+    nodes: Sequence[ObjectDict],
+    candidates: Sequence[str],
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Dict[str, Tuple[float, float]]:
+    """Fragmentation impact of removing each candidate slice: candidate
+    name -> (frag_after, frag_delta) for the pool the candidate's gang
+    occupies, with the engine replayed minus that candidate — the same
+    see-the-next-pass convention as :func:`largest_placeable_shape`, so
+    pending requests re-admitted into the freed block count. Candidates
+    not currently placed score (-1.0, -1.0): deleting an unplaced
+    replica frees a queue slot and cannot fragment anything, so it is
+    always the cheapest victim."""
+    base_engine = PlacementEngine(slices, nodes, degraded_links=degraded_links)
+    base_plan = base_engine.plan()
+    pool_of: Dict[str, str] = {}
+    for name in candidates:
+        status = base_plan.statuses.get(name)
+        if status is None:
+            # intact gangs keep their status only when re-derived; fall
+            # back to the object's own status block
+            obj = base_engine.slices.get(name) or {}
+            status = (obj.get("status") or {}).get("placement") or {}
+        if status.get("phase") == PlacementPhase.SCHEDULED and status.get("pool"):
+            pool_of[name] = str(status["pool"])
+    scores: Dict[str, Tuple[float, float]] = {}
+    for name in candidates:
+        pool = pool_of.get(name)
+        if pool is None:
+            scores[name] = (-1.0, -1.0)
+            continue
+        kept = [s for s in slices if s["metadata"]["name"] != name]
+        plan = PlacementEngine(kept, nodes, degraded_links=degraded_links).plan()
+        after = plan.fragmentation.get(pool, 0.0)
+        scores[name] = (after, round(after - base_plan.fragmentation.get(pool, 0.0), 4))
+    return scores
+
+
+def pick_scale_down_victim(scores: Dict[str, Tuple[float, float]]) -> Optional[str]:
+    """The selection rule over :func:`scale_down_scores` output, factored
+    out so the serving controller and the oracle tests can never diverge
+    on it: smallest fragmentation delta first (unplaced candidates'
+    -1.0 wins outright), then smallest resulting fragmentation, then
+    name — deterministic, so every controller replica picks the same
+    victim."""
+    if not scores:
+        return None
+    return min(scores, key=lambda n: (scores[n][1], scores[n][0], n))
+
+
+def scale_down_victim(
+    slices: Sequence[ObjectDict],
+    nodes: Sequence[ObjectDict],
+    candidates: Sequence[str],
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Optional[str]:
+    """The candidate whose removal most *reduces* its pool's torus
+    fragmentation (the fleet-level perf optimization: a lull should give
+    back the block that reopens the biggest contiguous run, not whatever
+    replica happens to be newest)."""
+    return pick_scale_down_victim(
+        scale_down_scores(slices, nodes, candidates, degraded_links=degraded_links)
+    )
+
+
 class PlacementEngine:
     def __init__(
         self,

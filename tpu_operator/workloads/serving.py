@@ -1,0 +1,710 @@
+"""Continuous-batching decode engine: the TPUServing data plane.
+
+The inference hot path the serving layer exists to feed (ROADMAP item 1;
+PAPERS.md "Fine-Tuning and Serving Gemma 4 31B on Google Cloud TPU").
+One :class:`DecodeEngine` runs a single-layer transformer decode loop
+with the three properties production serving needs:
+
+- **paged KV cache**: every request's K/V lives in page-granular slots
+  of one shared pool (:class:`PagedKVPool`) — pages allocate lazily as a
+  request's context grows and return to the free list at completion, so
+  the pool never externally fragments and admission is bounded by real
+  memory, not worst-case reservations. A request that cannot get its
+  next page *pauses* for the step (its peers keep decoding); only when
+  every lane is page-starved at once — a true pool deadlock — is the
+  youngest lane preempted back to the queue to recompute later (the
+  vLLM preempt-by-recompute move), so the oldest requests always run to
+  completion.
+- **continuous batching**: new requests are admitted into the in-flight
+  batch at *step boundaries* — the naive static-batch baseline
+  (:class:`DecodeEngine` with ``static_batch=True``) must drain the
+  whole batch before refilling, which is exactly the occupancy gap the
+  BENCH ``serving`` block measures. Decode compute is padded to
+  ``max_batch`` (the memory-bound regime: weights dominate the traffic,
+  so a fuller batch is ~free), which is why tokens/s/chip tracks
+  occupancy.
+- **prefill/decode split**: prompt ingestion is chunked
+  (``prefill_chunk`` tokens per engine step per request) and interleaved
+  with decode, so one long prompt can never stall the in-flight batch.
+
+Kernels: the decode MLP runs the int8 MXU path (``lax.dot_general`` with
+int8 operands and ``preferred_element_type=int32`` — the same
+double-rate path ``matmul_bench.int8_chain_runner`` probes and the
+autotune sweep tunes); chunked prefill attention runs the repo's
+flash-attention kernel (``flash_attention_with_lse`` with global
+positions, the ring-attention building block) when
+``use_flash_prefill`` is set. Block sizes resolve through the PR 12
+``TPU_AUTOTUNE_JSON`` winners (``tuned_flash_blocks``), so serving runs
+tuned on every generation without any caller change.
+
+jax is imported inside functions only: the module is importable
+operator-side (the serving controller never decodes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_operator.workloads.telemetry import StepTimeRecorder, _percentile
+
+
+@dataclasses.dataclass
+class ServingModelConfig:
+    """The decode model + pool geometry. The default is a deliberately
+    tiny model — the sim decodes on CPU; a real deployment scales the
+    widths and keeps the loop."""
+
+    d_model: int = 32
+    n_heads: int = 2
+    head_dim: int = 16
+    d_ff: int = 64
+    vocab: int = 128
+    page_tokens: int = 8      # KV page granularity (tokens per page)
+    max_pages: int = 64       # shared pool capacity, in pages
+    max_batch: int = 8        # decode slots (the in-flight batch)
+    max_seq: int = 64         # per-request context cap (prompt + decoded)
+    prefill_chunk: int = 8    # prompt tokens ingested per step per request
+    use_flash_prefill: bool = False  # pallas flash kernel for prefill attention
+    int8_mlp: bool = True     # int8 MXU path for the MLP matmuls
+
+    @property
+    def pages_per_slot(self) -> int:
+        return -(-self.max_seq // self.page_tokens)
+
+
+@dataclasses.dataclass
+class ServingRequest:
+    """One inference request: a prompt to ingest and a decode budget.
+    TTFT timestamps are stamped by the engine."""
+
+    rid: str
+    prompt: np.ndarray          # (prompt_len,) int32 token ids
+    decode_tokens: int
+    arrived_s: float = 0.0      # wall clock at submit()
+    first_token_s: Optional[float] = None
+    done_s: Optional[float] = None
+    output: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_s is None:
+            return None
+        return self.first_token_s - self.arrived_s
+
+
+class PagedKVPool:
+    """Page-table bookkeeping over the shared KV pool: slots hold
+    page-id lists into one (max_pages + 1) page array (the extra page is
+    the scratch row inactive lanes write to). Pure python/numpy — the
+    device arrays live in the engine; this owns WHO holds WHICH page."""
+
+    def __init__(self, cfg: ServingModelConfig):
+        self.cfg = cfg
+        self.scratch = cfg.max_pages  # the dump row for masked lanes
+        self._free_pages = list(range(cfg.max_pages - 1, -1, -1))  # pop() = lowest last
+        self._free_slots = list(range(cfg.max_batch - 1, -1, -1))
+        # slot -> page ids (dense prefix of pages_per_slot entries)
+        self.pages: Dict[int, List[int]] = {}
+        self.table = np.full(
+            (cfg.max_batch, cfg.pages_per_slot), self.scratch, dtype=np.int32
+        )
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    def alloc_slot(self) -> Optional[int]:
+        if not self._free_slots:
+            return None
+        slot = self._free_slots.pop()
+        self.pages[slot] = []
+        self.table[slot, :] = self.scratch
+        return slot
+
+    def ensure(self, slot: int, tokens: int) -> bool:
+        """Grow ``slot`` to hold ``tokens`` total tokens; allocates pages
+        lazily. False = pool exhausted (caller pauses the request for
+        this step — nobody is evicted)."""
+        need = -(-tokens // self.cfg.page_tokens)
+        held = self.pages[slot]
+        while len(held) < need:
+            if not self._free_pages:
+                return False
+            page = self._free_pages.pop()
+            self.table[slot, len(held)] = page
+            held.append(page)
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        for page in self.pages.pop(slot, []):
+            self._free_pages.append(page)
+        self._free_pages.sort(reverse=True)
+        self.table[slot, :] = self.scratch
+        self._free_slots.append(slot)
+        self._free_slots.sort(reverse=True)
+
+
+class _SlotState:
+    """Engine-side per-slot request state."""
+
+    def __init__(self, request: ServingRequest, slot: int, seq: int = 0):
+        self.request = request
+        self.slot = slot
+        self.seq = seq                # admission order (eviction picks youngest)
+        self.prefilled = 0            # prompt tokens already ingested
+        self.length = 0               # KV length (prompt + decoded so far)
+        self.decoded = 0
+        self.last_token = 0           # next decode input
+        self.paused = False           # page-starved this step
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.request.prompt.shape[0])
+
+    @property
+    def prefilling(self) -> bool:
+        return self.prefilled < self.prompt_len
+
+    @property
+    def done(self) -> bool:
+        return (not self.prefilling) and self.decoded >= self.request.decode_tokens
+
+
+def _build_params(cfg: ServingModelConfig, seed: int):
+    """Seeded model weights; the MLP mats ship pre-quantized to int8
+    with per-tensor scales when ``int8_mlp`` (weight-only quantization —
+    activations quantize dynamically in-graph)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    d, h, hd, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+
+    def mat(*shape, scale=0.3):
+        return (rng.standard_normal(shape) * scale / np.sqrt(shape[0])).astype(np.float32)
+
+    params = {
+        "embed": jnp.asarray(mat(cfg.vocab, d, scale=1.0)),
+        "wq": jnp.asarray(mat(d, h * hd)),
+        "wk": jnp.asarray(mat(d, h * hd)),
+        "wv": jnp.asarray(mat(d, h * hd)),
+        "wo": jnp.asarray(mat(h * hd, d)),
+    }
+    w1 = mat(d, f)
+    w2 = mat(f, d)
+    if cfg.int8_mlp:
+        s1 = float(np.max(np.abs(w1))) / 127.0 or 1.0
+        s2 = float(np.max(np.abs(w2))) / 127.0 or 1.0
+        params["w1_q"] = jnp.asarray(np.clip(np.round(w1 / s1), -127, 127).astype(np.int8))
+        params["w2_q"] = jnp.asarray(np.clip(np.round(w2 / s2), -127, 127).astype(np.int8))
+        params["w1_s"] = jnp.float32(s1)
+        params["w2_s"] = jnp.float32(s2)
+    else:
+        params["w1"] = jnp.asarray(w1)
+        params["w2"] = jnp.asarray(w2)
+    return params
+
+
+def _int8_matmul(x, w_q, w_scale):
+    """Weight-only-quantized matmul on the MXU's int8 double-rate path:
+    dynamic per-tensor activation quantization, int8 x int8 -> int32
+    accumulation (``preferred_element_type``, the idiom
+    ``matmul_bench.int8_chain_runner`` rate-probes), dequantized by the
+    two scales."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    a_scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-8)
+    x_q = jnp.clip(jnp.round(x / a_scale), -127, 127).astype(jnp.int8)
+    acc = lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * (a_scale * w_scale)
+
+
+def _mlp(cfg: ServingModelConfig, params, x):
+    import jax.numpy as jnp
+
+    if cfg.int8_mlp:
+        hidden = jnp.maximum(_int8_matmul(x, params["w1_q"], params["w1_s"]), 0.0)
+        return _int8_matmul(hidden, params["w2_q"], params["w2_s"])
+    hidden = jnp.maximum(x @ params["w1"], 0.0)
+    return hidden @ params["w2"]
+
+
+class DecodeEngine:
+    """The continuous-batching decode loop (or, with
+    ``static_batch=True``, the drain-before-refill baseline). Drive it
+    with :meth:`submit` + :meth:`step`; every step is recorded by a
+    :class:`~tpu_operator.workloads.telemetry.StepTimeRecorder`."""
+
+    def __init__(
+        self,
+        cfg: Optional[ServingModelConfig] = None,
+        seed: int = 0,
+        static_batch: bool = False,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg or ServingModelConfig()
+        self.static_batch = static_batch
+        self.params = _build_params(self.cfg, seed)
+        self.pool = PagedKVPool(self.cfg)
+        c = self.cfg
+        kv_shape = (c.max_pages + 1, c.page_tokens, c.n_heads, c.head_dim)
+        self._pool_k = jnp.zeros(kv_shape, dtype=jnp.float32)
+        self._pool_v = jnp.zeros(kv_shape, dtype=jnp.float32)
+        self.queue: List[ServingRequest] = []
+        self.slots: Dict[int, _SlotState] = {}
+        self.completed: List[ServingRequest] = []
+        self.recorder = StepTimeRecorder()
+        self.steps = 0
+        self.decoded_tokens = 0
+        self.evictions = 0
+        self._admit_seq = 0
+        self._starved = False  # a lane was page-starved last step
+        self._occupancy: List[float] = []
+        # kernel configs resolve through the autotune winners path
+        # (TPU_AUTOTUNE_JSON): the operator's published per-generation
+        # sweep reaches serving exactly the way it reaches burn-in
+        from tpu_operator.workloads.autotune import tuned_flash_blocks
+
+        self.flash_blocks = tuned_flash_blocks(c.max_seq, heads=c.n_heads,
+                                               head_dim=c.head_dim)
+        self._decode_fn = self._build_decode_fn()
+        self._prefill_fns: Dict[int, object] = {}  # static prefix -> jitted fn
+        self._chips = max(1, jax.device_count())
+
+    # -- compiled steps ------------------------------------------------------
+
+    def _build_decode_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        P, T = cfg.page_tokens, cfg.max_seq
+        scratch = cfg.max_pages
+
+        def decode(params, pool_k, pool_v, table, lengths, active, tokens):
+            # one token for every active lane, padded to max_batch — the
+            # memory-bound decode regime: cost is occupancy-independent
+            x = params["embed"][tokens]                      # (B, d)
+            q = (x @ params["wq"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+            k = (x @ params["wk"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+            v = (x @ params["wv"]).reshape(-1, cfg.n_heads, cfg.head_dim)
+            # write this token's K/V at position `length` of each lane's
+            # paged context; masked lanes write the scratch page
+            page = jnp.take_along_axis(
+                table, (lengths // P)[:, None], axis=1
+            )[:, 0]
+            page = jnp.where(active, page, scratch)
+            off = lengths % P
+            pool_k = pool_k.at[page, off].set(k)
+            pool_v = pool_v.at[page, off].set(v)
+            # gather each lane's pages back as a dense (B, T) context
+            ctx_k = pool_k[table].reshape(-1, T, cfg.n_heads, cfg.head_dim)
+            ctx_v = pool_v[table].reshape(-1, T, cfg.n_heads, cfg.head_dim)
+            pos = jnp.arange(T)[None, :]
+            mask = pos <= lengths[:, None]                   # incl. this token
+            scores = jnp.einsum("bhd,bthd->bht", q, ctx_k) / np.sqrt(cfg.head_dim)
+            scores = jnp.where(mask[:, None, :], scores, -1e30)
+            attn = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bht,bthd->bhd", attn, ctx_v).reshape(
+                -1, cfg.n_heads * cfg.head_dim
+            )
+            y = x + ctx @ params["wo"]
+            y = y + _mlp(cfg, params, y)
+            logits = y @ params["embed"].T
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lengths = lengths + active.astype(jnp.int32)
+            return nxt, pool_k, pool_v, lengths
+
+        return jax.jit(decode)
+
+    def _prefill_fn(self, prefix: int):
+        """The chunked-prefill step for a statically-known prefix
+        length: ingest up to ``prefill_chunk`` prompt tokens (K/V into
+        the lane's pages) and return the chunk's attention output row
+        for the final token — first-token logits when the chunk
+        completes the prompt. Distinct prefixes compile distinct kernels
+        (bounded by max_seq / prefill_chunk)."""
+        fn = self._prefill_fns.get(prefix)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        C, P, T = cfg.prefill_chunk, cfg.page_tokens, cfg.max_seq
+        scratch = cfg.max_pages
+        use_flash = cfg.use_flash_prefill
+        block_q, block_k = self.flash_blocks
+
+        def prefill(params, pool_k, pool_v, table_row, tokens, valid):
+            # tokens: (C,) padded chunk; valid: how many are real
+            x = params["embed"][tokens]                      # (C, d)
+            q = (x @ params["wq"]).reshape(C, cfg.n_heads, cfg.head_dim)
+            k = (x @ params["wk"]).reshape(C, cfg.n_heads, cfg.head_dim)
+            v = (x @ params["wv"]).reshape(C, cfg.n_heads, cfg.head_dim)
+            idx = jnp.arange(C)
+            live = idx < valid
+            pos = prefix + idx
+            page = jnp.where(live, table_row[pos // P], scratch)
+            pool_k = pool_k.at[page, pos % P].set(k)
+            pool_v = pool_v.at[page, pos % P].set(v)
+            ctx_k = pool_k[table_row].reshape(T, cfg.n_heads, cfg.head_dim)
+            ctx_v = pool_v[table_row].reshape(T, cfg.n_heads, cfg.head_dim)
+            if use_flash:
+                # the flash kernel with global positions (the ring
+                # building block): causal masking against q_start covers
+                # both the real prefix and the padded tail
+                from tpu_operator.workloads.flashattention import (
+                    flash_attention_with_lse,
+                )
+
+                out, _ = flash_attention_with_lse(
+                    q[None], ctx_k[None], ctx_v[None], causal=True,
+                    block_q=block_q, block_k=block_k, q_start=prefix,
+                )
+                ctx = out[0]                                 # (C, h, hd)
+            else:
+                kpos = jnp.arange(T)[None, :]
+                mask = kpos <= pos[:, None]
+                scores = jnp.einsum(
+                    "chd,thd->cht", q, ctx_k
+                ) / np.sqrt(cfg.head_dim)
+                scores = jnp.where(mask[:, None, :], scores, -1e30)
+                attn = jax.nn.softmax(scores, axis=-1)
+                ctx = jnp.einsum("cht,thd->chd", attn, ctx_v)
+            last = valid - 1
+            y = x[last] + ctx.reshape(C, -1)[last] @ params["wo"]
+            y = y + _mlp(cfg, params, y)
+            logits = y @ params["embed"].T
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return pool_k, pool_v, first
+
+        fn = jax.jit(prefill)
+        self._prefill_fns[prefix] = fn
+        return fn
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, request: ServingRequest) -> None:
+        if request.prompt.shape[0] + request.decode_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"request {request.rid}: prompt + decode budget exceeds "
+                f"max_seq {self.cfg.max_seq}"
+            )
+        request.arrived_s = time.perf_counter()
+        self.queue.append(request)
+
+    def _admit(self) -> None:
+        """Step-boundary admission. Continuous batching admits whenever
+        a slot AND a first page are free; the static baseline only
+        refills an EMPTY engine — the whole batch must drain first,
+        which is the occupancy (and TTFT) cost the bench measures."""
+        if self.static_batch and self.slots:
+            return
+        if self._starved:
+            # a lane is waiting on a page: freed pages must reach the
+            # in-flight batch first, or a re-admitted request steals
+            # them back and the pool livelocks
+            return
+        while self.queue and self.pool.free_slots and self.pool.free_pages:
+            slot = self.pool.alloc_slot()
+            if slot is None:
+                break
+            self._admit_seq += 1
+            request = self.queue.pop(0)
+            request.output = []  # a re-admitted evictee regenerates
+            self.slots[slot] = _SlotState(request, slot, seq=self._admit_seq)
+            if self.static_batch and self.pool.free_slots == 0:
+                break
+
+    # -- one engine step -----------------------------------------------------
+
+    def step(self) -> dict:
+        """One step boundary: admit, chunk-prefill every ingesting lane,
+        one batched decode for every decoding lane, retire completions."""
+        with self.recorder.step():
+            report = self._step_body()
+        self.steps += 1
+        return report
+
+    def _step_body(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        self._admit()
+        now_first: List[_SlotState] = []
+        prefilled = 0
+        for state in self.slots.values():
+            state.paused = False
+            if not state.prefilling:
+                continue
+            take = min(cfg.prefill_chunk, state.prompt_len - state.prefilled)
+            if not self.pool.ensure(state.slot, state.prefilled + take):
+                state.paused = True  # page-starved: peers keep going
+                continue
+            chunk = np.zeros((cfg.prefill_chunk,), dtype=np.int32)
+            chunk[:take] = state.request.prompt[
+                state.prefilled:state.prefilled + take
+            ]
+            fn = self._prefill_fn(state.prefilled)
+            self._pool_k, self._pool_v, first = fn(
+                self.params, self._pool_k, self._pool_v,
+                jnp.asarray(self.pool.table[state.slot]),
+                jnp.asarray(chunk), jnp.int32(take),
+            )
+            state.prefilled += take
+            state.length += take
+            prefilled += take
+            if not state.prefilling:
+                # prompt complete: this chunk's final row IS the first
+                # decoded token (prefill emits it; decode continues)
+                token = int(first)
+                self._record_token(state, token)
+                now_first.append(state)
+        decoding = [
+            s for s in self.slots.values()
+            if not s.prefilling and not s.done and not s.paused
+            and s not in now_first
+        ]
+        # lanes whose context crosses a page boundary need a page now
+        ready: List[_SlotState] = []
+        for state in decoding:
+            if self.pool.ensure(state.slot, state.length + 1):
+                ready.append(state)
+            else:
+                state.paused = True
+        if ready:
+            tokens = np.zeros((cfg.max_batch,), dtype=np.int32)
+            lengths = np.zeros((cfg.max_batch,), dtype=np.int32)
+            active = np.zeros((cfg.max_batch,), dtype=bool)
+            for state in ready:
+                tokens[state.slot] = state.last_token
+                lengths[state.slot] = state.length
+                active[state.slot] = True
+            nxt, self._pool_k, self._pool_v, _ = self._decode_fn(
+                self.params, self._pool_k, self._pool_v,
+                jnp.asarray(self.pool.table), jnp.asarray(lengths),
+                jnp.asarray(active), jnp.asarray(tokens),
+            )
+            nxt = np.asarray(nxt)
+            for state in ready:
+                state.length += 1
+                self._record_token(state, int(nxt[state.slot]))
+        progressed = bool(ready) or bool(now_first) or prefilled > 0
+        if not progressed and self.slots and all(
+            s.paused for s in self.slots.values()
+        ):
+            # pool deadlock: every lane needs a page and nobody can ever
+            # free one. Evict the YOUNGEST lane to the queue front (the
+            # vLLM preempt-by-recompute move): its pages return, the
+            # oldest lanes run to completion, and the evictee
+            # re-prefills on re-admission. Deterministic decode means it
+            # regenerates the identical tokens; its first-token stamp is
+            # kept — the client was first served then.
+            victim = max(self.slots.values(), key=lambda s: s.seq)
+            self.decoded_tokens -= victim.decoded  # will be re-counted
+            self.pool.free_slot(victim.slot)
+            del self.slots[victim.slot]
+            self.queue.insert(0, victim.request)
+            self.evictions += 1
+        in_flight = len(self.slots)
+        self._occupancy.append(in_flight / cfg.max_batch)
+        self._starved = any(s.paused for s in self.slots.values())
+        for slot in [s for s, st in self.slots.items() if st.done]:
+            state = self.slots.pop(slot)
+            state.request.done_s = time.perf_counter()
+            self.pool.free_slot(slot)
+            self.completed.append(state.request)
+        return {
+            "in_flight": in_flight,
+            "queued": len(self.queue),
+            "prefilled_tokens": prefilled,
+            "decoded_tokens": len(ready) + len(now_first),
+            "paused": sum(1 for s in self.slots.values() if s.paused),
+        }
+
+    def _record_token(self, state: _SlotState, token: int) -> None:
+        if state.request.first_token_s is None:
+            state.request.first_token_s = time.perf_counter()
+        state.request.output.append(token)
+        state.last_token = token
+        state.decoded += 1
+        self.decoded_tokens += 1
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self, prompt_len: int) -> None:
+        """Compile the decode + prefill programs outside the timed loop
+        (all-masked lanes: every write lands on the scratch page, so the
+        live pools are untouched). A serving process compiles once at
+        boot; folding XLA compile into a load-curve measurement would
+        poison both engines equally but dilute the batching signal."""
+        import jax.numpy as jnp
+
+        c = self.cfg
+        self._decode_fn(
+            self.params, self._pool_k, self._pool_v,
+            jnp.asarray(self.pool.table),
+            jnp.zeros((c.max_batch,), jnp.int32),
+            jnp.zeros((c.max_batch,), bool),
+            jnp.zeros((c.max_batch,), jnp.int32),
+        )
+        row = jnp.full((c.pages_per_slot,), c.max_pages, jnp.int32)
+        chunk = jnp.zeros((c.prefill_chunk,), jnp.int32)
+        for prefix in range(0, min(prompt_len, c.max_seq), c.prefill_chunk):
+            take = min(c.prefill_chunk, prompt_len - prefix)
+            self._prefill_fn(prefix)(
+                self.params, self._pool_k, self._pool_v, row, chunk,
+                jnp.int32(take),
+            )
+
+    # -- draining ------------------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.slots
+
+    def run_until_drained(self, max_steps: int = 10000) -> int:
+        steps = 0
+        while not self.idle and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self) -> dict:
+        """The engine's slice of the BENCH ``serving`` block: throughput
+        per chip, batch occupancy, TTFT percentiles over completed
+        requests, and the step-time recorder's percentiles."""
+        elapsed = sum(self.recorder._durations)
+        ttfts = sorted(
+            r.ttft_s for r in self.completed if r.ttft_s is not None
+        )
+        out = {
+            "mode": "static" if self.static_batch else "continuous",
+            "steps": self.steps,
+            "requests_completed": len(self.completed),
+            "decoded_tokens": self.decoded_tokens,
+            "elapsed_s": round(elapsed, 4),
+            "tokens_per_s": round(self.decoded_tokens / elapsed, 2) if elapsed else 0.0,
+            "tokens_per_s_chip": (
+                round(self.decoded_tokens / elapsed / self._chips, 2) if elapsed else 0.0
+            ),
+            "occupancy_mean": (
+                round(sum(self._occupancy) / len(self._occupancy), 3)
+                if self._occupancy else 0.0
+            ),
+            "ttft_p50_s": round(_percentile(ttfts, 0.50), 4),
+            "ttft_p99_s": round(_percentile(ttfts, 0.99), 4),
+            "flash_blocks": list(self.flash_blocks),
+            "int8_mlp": self.cfg.int8_mlp,
+            "flash_prefill": self.cfg.use_flash_prefill,
+        }
+        if self.steps >= 2:
+            rec = self.recorder.report()
+            out["step_p50_s"] = rec.step_p50_s
+            out["step_p99_s"] = rec.step_p99_s
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the continuous-vs-static bench
+# ---------------------------------------------------------------------------
+
+
+def make_requests(
+    count: int,
+    seed: int = 0,
+    prompt_len: int = 8,
+    decode_min: int = 6,
+    decode_max: int = 32,
+    long_fraction: float = 0.25,
+    vocab: int = 128,
+) -> List[ServingRequest]:
+    """A seeded request mix with skewed (bimodal) decode lengths — most
+    requests are short, a tail runs to ``decode_max``. The skew is real
+    chat traffic's shape, and it is what makes drain-before-refill bleed
+    occupancy: short requests finish and their slots sit idle while the
+    batch's straggler runs out its budget."""
+    rng = np.random.default_rng(seed)
+    short_max = decode_min + max(1, (decode_max - decode_min) // 4)
+    out = []
+    for i in range(count):
+        if rng.random() < long_fraction:
+            decode = decode_max
+        else:
+            decode = int(rng.integers(decode_min, short_max + 1))
+        out.append(ServingRequest(
+            rid=f"req-{i}",
+            prompt=rng.integers(0, vocab, size=prompt_len).astype(np.int32),
+            decode_tokens=decode,
+        ))
+    return out
+
+
+def serving_decode_bench(
+    cfg: Optional[ServingModelConfig] = None,
+    seed: int = 20260818,
+    requests: int = 24,
+    arrival_ticks: int = 6,
+) -> dict:
+    """Continuous vs static batching under the same arrival curve: the
+    seeded request mix arrives spread over ``arrival_ticks`` step
+    boundaries (front-loaded like a burst's leading edge); both engines
+    run the identical model/kernels and the identical requests; the
+    delta is pure batching policy. Reports both engines plus the
+    headline speedup the BENCH gate pins (>= 1.5x tokens/s/chip)."""
+    cfg = cfg or ServingModelConfig()
+    prompt_len = min(cfg.prefill_chunk, cfg.max_seq // 4)
+    base = make_requests(requests, seed=seed, vocab=cfg.vocab,
+                         prompt_len=prompt_len,
+                         decode_max=min(32, cfg.max_seq // 2))
+    # arrival schedule: which step boundary each request lands at
+    rng = np.random.default_rng(seed + 1)
+    arrival_at = sorted(int(rng.integers(0, arrival_ticks)) for _ in base)
+    results = {}
+    for static in (False, True):
+        engine = DecodeEngine(cfg, seed=seed, static_batch=static)
+        engine.warmup(prompt_len)
+        batch = [dataclasses.replace(
+            r, prompt=r.prompt.copy(), output=[],
+            arrived_s=0.0, first_token_s=None, done_s=None,
+        ) for r in base]
+        tick = 0
+        pending = list(zip(arrival_at, batch))
+        while pending or not engine.idle:
+            while pending and pending[0][0] <= tick:
+                engine.submit(pending.pop(0)[1])
+            engine.step()
+            tick += 1
+        results["static" if static else "continuous"] = engine.report()
+    cont, stat = results["continuous"], results["static"]
+    speedup = (
+        cont["tokens_per_s_chip"] / stat["tokens_per_s_chip"]
+        if stat["tokens_per_s_chip"] else 0.0
+    )
+    return {
+        "seed": seed,
+        "requests": requests,
+        "continuous": cont,
+        "static": stat,
+        "continuous_vs_static_speedup": round(speedup, 3),
+        "occupancy_gain": round(
+            cont["occupancy_mean"] / stat["occupancy_mean"], 3
+        ) if stat["occupancy_mean"] else 0.0,
+    }
