@@ -1,7 +1,7 @@
 # Build/test entrypoints (reference: Makefile + versions.mk targets).
 PYTHON ?= python3
 
-.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render lint racecheck native images clean
+.PHONY: all test unit-test e2e bench golden chart-crds chart-verify validate-generated-assets crds render lint racecheck defrag-smoke native images clean
 
 all: native test
 
@@ -49,6 +49,12 @@ lint:
 # lock-order cycle or mutation-tripwire hit fails the owning test
 racecheck:
 	TPUOP_RACECHECK=1 $(PYTHON) -m pytest tests/ -q -m "not slow"
+
+# capacity-planning gate: fragmented-torus rescue + policy comparison,
+# plain and under the race harness (the scripts/ci.sh pair)
+defrag-smoke:
+	JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 $(PYTHON) bench.py --defrag-smoke
+	TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 $(PYTHON) bench.py --defrag-smoke
 
 native:
 	$(MAKE) -C native

@@ -616,6 +616,14 @@ def _compact_summary(out: dict) -> dict:
             "continuous_vs_static_speedup"
         ),
         "serving_ttft_p99_s": out.get("serving", {}).get("decode_ttft_p99_s"),
+        "fleet_sim_utilization_pct": out.get("fleet_sim", {}).get(
+            "defrag-aware", {}
+        ).get("utilization_pct"),
+        "fleet_sim_p99_place_s": {
+            policy: out.get("fleet_sim", {}).get(policy, {}).get("time_to_place_p99_s")
+            for policy in ("best-fit", "defrag-aware")
+        },
+        "plan_model_ratio": out.get("fleet_sim", {}).get("model", {}).get("ratio"),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -933,6 +941,114 @@ def telemetry_block() -> dict:
     except Exception as e:  # noqa: BLE001
         out["gang"] = {"error": str(e)[-300:]}
     return out
+
+
+def bench_fleet_sim(seed: int = 20260804, hosts_dims=(16, 16, 16)) -> dict:
+    """Capacity planning measured (ISSUE 15): the fleet simulator's
+    best-fit vs defrag-aware comparison at 4096 sim hosts under the
+    seeded churn schedule, plus the analytical model validated
+    calibrate-then-predict against the recorded step-time artifacts
+    (the PR 7 recorder's own output from this run). The CPU-sim series
+    gate at CPU_SIM_TOLERANCE_FACTOR (3x); the 1.5x gate is reserved
+    for real TPU, the PR 13 only-binds-on-TPU convention."""
+    from tpu_operator.kube.sim import GangChurnSchedule
+    from tpu_operator.planning.sim import FleetSimulator
+
+    def schedule():
+        # sized to press the 4096-host torus to ~75-90% mid-run so the
+        # policies actually differentiate: big gangs must WAIT for
+        # capacity, and what they wait on is fragmentation
+        return GangChurnSchedule(
+            seed=seed, ticks=120, arrivals_per_tick=2.2,
+            shapes=(
+                ((2, 2, 2), 4.0), ((4, 2, 2), 3.0), ((4, 4, 2), 2.0),
+                ((4, 4, 4), 1.2), ((8, 4, 4), 0.5),
+            ),
+            min_lifetime=40, max_lifetime=110,
+        )
+
+    out: dict = {"seed": seed, "hosts": hosts_dims[0] * hosts_dims[1] * hosts_dims[2]}
+    for policy in ("best-fit", "defrag-aware"):
+        t0 = time.perf_counter()
+        # sim ticks are coarse (one tick ~ a whole live placement pass
+        # + cooldown window), so the background half runs every idle
+        # tick here; the live controller's wall-clock knobs stay at the
+        # conservative consts.DEFRAG_* values
+        sim = FleetSimulator(
+            dims=hosts_dims, policy=policy,
+            migration_cooldown_ticks=2, defrag_every=1,
+        )
+        report = sim.run(schedule(), drain_ticks=30)
+        report["sim_wall_s"] = round(time.perf_counter() - t0, 1)
+        out[policy] = report
+    out["model"] = _model_validation_block()
+    return out
+
+
+def _model_validation_block() -> dict:
+    """Calibrate the analytical model on one recorded burn-in artifact,
+    predict a DIFFERENT burn-in shape, and compare against what the
+    recorder measured for it — the SCALE-Sim-style validation loop run
+    on whatever backend is present."""
+    from tpu_operator.planning.model import (
+        CPU_SIM_TOLERANCE_FACTOR,
+        TPU_TOLERANCE_FACTOR,
+        calibrated_roofs,
+        effective_compute_roof,
+        predict_step_time,
+        validate_prediction,
+    )
+    from tpu_operator.workloads.descriptor import burnin_descriptor
+
+    try:
+        from tpu_operator.workloads.burnin import BurninConfig, make_mesh, run_burnin
+
+        def measure(cfg):
+            result = run_burnin(
+                mesh=make_mesh(), steps=6, cfg=cfg,
+                record_telemetry=True, telemetry_host="bench",
+            )
+            return result["telemetry"]
+
+        import jax
+
+        platform = jax.devices()[0].platform
+        generation = "v5e"  # the calibration row; CPU overrides the roof anyway
+        tolerance = (
+            TPU_TOLERANCE_FACTOR if platform == "tpu" else CPU_SIM_TOLERANCE_FACTOR
+        )
+        # probe = calibration x2 along the LAYER axis: FLOPs double
+        # exactly and the per-layer launch overhead amortizes the same
+        # way, so the linear roofline is the right model on CPU too —
+        # scaling d_model instead would mostly measure dispatch overhead
+        # at these sim sizes and bias the ratio against the prediction
+        cal_cfg = BurninConfig(d_model=128, d_ff=256, seq_len=64, batch=8, n_layers=2)
+        probe_cfg = BurninConfig(d_model=128, d_ff=256, seq_len=64, batch=8, n_layers=4)
+        cal_t = measure(cal_cfg)
+        probe_t = measure(probe_cfg)
+        cal_desc = burnin_descriptor(cal_cfg)
+        probe_desc = burnin_descriptor(probe_cfg)
+        chips = len(jax.devices())
+        effective = effective_compute_roof(
+            cal_desc, cal_t["step_p50_s"], hosts=1, chips_per_host=chips
+        )
+        roofs = calibrated_roofs(generation, effective)
+        prediction = predict_step_time(
+            probe_desc, generation, (1, 1, 1), chips_per_host=chips, roofs=roofs
+        )
+        verdict = validate_prediction(
+            prediction.step_seconds, probe_t["step_p50_s"], tolerance
+        )
+        return {
+            "platform": platform,
+            "calibration_step_s": round(cal_t["step_p50_s"], 6),
+            "measured_step_s": round(probe_t["step_p50_s"], 6),
+            "predicted_step_s": round(prediction.step_seconds, 6),
+            "effective_tflops_per_chip": round(effective or 0.0, 4),
+            **verdict,
+        }
+    except Exception as e:  # noqa: BLE001 — best-effort like every detail
+        return {"error": str(e)[-300:]}
 
 
 def fabric_block() -> dict:
@@ -2248,6 +2364,292 @@ def job_smoke() -> int:
     return 0 if ok else 1
 
 
+def defrag_smoke() -> int:
+    """CI gate (scripts/ci.sh): scheduled defragmentation end to end on
+    the seeded fragmented 512-host torus —
+
+    1. a mixed churn leaves the torus fragmented enough that a 4x4x4
+       gang is Unschedulable;
+    2. while a PLACEABLE slice is queued (placement in flight) the
+       defrag controller proposes ZERO migrations;
+    3. once idle, defrag migrates (serving replicas via the
+       drain-then-re-place path), the 4x4x4 lands, and the realized
+       fragmentation strictly decreases (`DefragMigrated` evidence);
+    4. the TPUJob checkpoint-barrier path moves a Running job's gang
+       with its step watermark intact (defragRequest → `defrag-` token
+       → checkpoint ack → teardown → re-place → Resuming);
+    5. the fleet simulator's defrag-aware policy beats best-fit on p99
+       time-to-place AND ends with strictly lower fragmentation under
+       the seeded churn schedule.
+
+    ci.sh runs the whole gate twice — plain and TPUOP_RACECHECK=1 (the
+    instrumented-locks leg, failed by racecheck.violations())."""
+    import random as random_mod
+
+    from tpu_operator import consts
+    from tpu_operator.api.tpujob import JobPhase, new_tpu_job
+    from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, new_tpu_slice
+    from tpu_operator.controllers.defrag_controller import (
+        DEFRAG_REQUEST,
+        DefragReconciler,
+    )
+    from tpu_operator.controllers.job_controller import JobReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.objects import new_object
+    from tpu_operator.kube.sim import GangChurnSchedule, make_torus_nodes
+    from tpu_operator.planning.sim import FleetSimulator
+
+    ns = "tpu-operator"
+    checks: dict = {}
+
+    def build_fragmented(prefix: str):
+        """The seeded fragmented 512-host torus: 32 serving-owned pair
+        gangs placed, half deleted (seed pinned: same churn, same
+        holes). Returns (client, placement reconciler)."""
+        client = FakeClient()
+        for node in make_torus_nodes((8, 8, 8), prefix=prefix):
+            client.create(node)
+        rng = random_mod.Random(0)
+        place = PlacementReconciler(client, ns)
+        shapes = ["2x2x2", "4x2x2", "4x4x2", "2x2x1"]
+        names = []
+        for i in range(32):
+            body = new_tpu_slice(
+                f"g{i}", {"placement": {"shape": rng.choice(shapes)}}
+            )
+            body["metadata"]["ownerReferences"] = [{
+                "apiVersion": "tpu.google.com/v1alpha1", "kind": "TPUServing",
+                "name": f"svc{i // 2}", "uid": f"u{i // 2}",
+            }]
+            client.create(body)
+            names.append(f"g{i}")
+        place.reconcile(QUEUE_REQUEST)
+        for name in rng.sample(names, 16):
+            client.delete(TPU_SLICE_API_VERSION, "TPUSlice", name)
+        place.reconcile(QUEUE_REQUEST)
+        place.reconcile(QUEUE_REQUEST)
+        return client, place
+
+    def phase_on(client, name: str) -> str:
+        obj = client.get_or_none(TPU_SLICE_API_VERSION, "TPUSlice", name)
+        return (((obj or {}).get("status") or {}).get("placement") or {}).get(
+            "phase", ""
+        )
+
+    def decisions_on(client) -> list:
+        cm = client.get_or_none(
+            "v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP, ns
+        )
+        raw = ((cm or {}).get("data") or {}).get(consts.DEFRAG_STATE_KEY, "")
+        try:
+            return (json.loads(raw) or {}).get("decisions", [])
+        except ValueError:
+            return []
+
+    def controller_on(client):
+        defrag = DefragReconciler(client, ns)
+        clock = [1000.0]
+        defrag._now = lambda: clock[0]
+        return defrag, clock
+
+    # -- part 1: pure consolidation — with NO pending demand, a defrag
+    # migration must strictly reduce the pool's measured fragmentation
+    # (predicted delta must match realized)
+    client_a, place_a = build_fragmented("da")
+    defrag_a, clock_a = controller_on(client_a)
+    defrag_a.reconcile(DEFRAG_REQUEST)   # proposes + executes
+    place_a.reconcile(QUEUE_REQUEST)     # re-places the drained gang
+    defrag_a.reconcile(DEFRAG_REQUEST)   # settles realized frag
+    settled_a = [d for d in decisions_on(client_a) if d.get("realized_frag") is not None]
+    checks["pure_defrag_reduces_fragmentation"] = bool(settled_a) and all(
+        d["realized_frag"] < d["frag_before"] for d in settled_a
+    )
+    checks["predicted_matches_realized"] = bool(settled_a) and all(
+        abs(d["realized_frag"] - d["predicted_frag"]) < 1e-9 for d in settled_a
+    )
+    frag_before = settled_a[0]["frag_before"] if settled_a else None
+
+    # -- parts 2+3: the rescue scenario — a 4x4x4 is Unschedulable; zero
+    # migrations while a PLACEABLE slice is queued; then defrag reclaims
+    # the capacity and the 4x4x4 lands (defrag-off stays stuck)
+    client, place = build_fragmented("df")
+    client.create(new_tpu_slice("wanted", {"placement": {"shape": "4x4x4"}}))
+    place.reconcile(QUEUE_REQUEST)
+    checks["wanted_unplaceable_before_defrag"] = (
+        phase_on(client, "wanted") == "Unschedulable"
+    )
+    defrag, clock = controller_on(client)
+
+    client.create(new_tpu_slice("queued-probe", {"placement": {"shape": "2x2x1"}}))
+    defrag.reconcile(DEFRAG_REQUEST)  # probe is un-placed: placement in flight
+    checks["zero_migrations_while_queued"] = not any(
+        d.get("executed_at") is not None for d in decisions_on(client)
+    )
+    place.reconcile(QUEUE_REQUEST)  # seat the probe
+    # probe done: free its block so the fragmented scenario is untouched
+    client.delete(TPU_SLICE_API_VERSION, "TPUSlice", "queued-probe")
+    place.reconcile(QUEUE_REQUEST)  # back to idle
+
+    for _ in range(3):
+        place.reconcile(QUEUE_REQUEST)
+    checks["defrag_off_stays_unschedulable"] = (
+        phase_on(client, "wanted") == "Unschedulable"
+    )
+    landed = False
+    for round_no in range(6):
+        clock[0] += consts.DEFRAG_COOLDOWN_SECONDS + 1.0
+        defrag.reconcile(DEFRAG_REQUEST)
+        place.reconcile(QUEUE_REQUEST)
+        defrag.reconcile(DEFRAG_REQUEST)  # settle pass books realized frag
+        if phase_on(client, "wanted") == "Scheduled":
+            landed = True
+            break
+    decisions = decisions_on(client)
+    checks["wanted_lands_after_defrag"] = landed
+    checks["migrations_executed"] = any(
+        d.get("executed_at") is not None for d in decisions
+    )
+    # the rescue decision explicitly reclaimed capacity for the parked
+    # gang (the seated 64-host block raises the residual-free-space
+    # fragmentation number — reclaimed capacity, not regression; the
+    # strict-decrease gate is part 1's, where no pending gang lands)
+    checks["rescue_decision_seats_wanted"] = any(
+        "wanted" in (d.get("lands_pending") or []) for d in decisions
+    )
+    events = [e.get("reason") for e in client.list("v1", "Event", "default")]
+    checks["defrag_migrated_evented"] = "DefragMigrated" in events
+
+    # -- part 4: the TPUJob checkpoint-barrier migration path ----------------
+    jc = FakeClient()
+    for node in make_torus_nodes((4, 2, 1), prefix="jb"):
+        jc.create(node)
+    jc.create(new_tpu_job("tj", {
+        "workload": {"steps": 1000},
+        "gang": {"shape": "2x2x1", "minShape": "2x2x1"},
+    }))
+    job_rec = JobReconciler(jc, ns)
+    place_j = PlacementReconciler(jc, ns)
+    progress_name = "tj" + consts.JOB_PROGRESS_SUFFIX
+
+    def fake_trainer() -> None:
+        """The scripted gang side: publish running progress and echo any
+        checkpoint barrier token (the InProcessJobRunner contract,
+        compressed to what the barrier needs)."""
+        cm = jc.get_or_none("v1", "ConfigMap", progress_name, ns)
+        if cm is None:
+            jc.create(new_object("v1", "ConfigMap", progress_name, ns, data={}))
+            cm = jc.get("v1", "ConfigMap", progress_name, ns)
+        slice_obj = jc.get_or_none(TPU_SLICE_API_VERSION, "TPUSlice", "tj-slice")
+        placement = ((slice_obj or {}).get("status") or {}).get("placement") or {}
+        hosts = len(placement.get("nodes") or [])
+        data = {
+            consts.JOB_PROGRESS_STEP: "42",
+            consts.JOB_PROGRESS_CHECKPOINT_STEP: "40",
+            consts.JOB_PROGRESS_EPOCH: "4",
+            consts.JOB_PROGRESS_WORLD: str(hosts),
+            consts.JOB_PROGRESS_STATUS: consts.JOB_PROGRESS_RUNNING,
+        }
+        request = (cm.get("data") or {}).get(consts.JOB_CHECKPOINT_REQUEST, "")
+        if request:
+            data[consts.JOB_PROGRESS_CHECKPOINT_ACK] = request
+        jc.patch("v1", "ConfigMap", progress_name, {"data": data}, ns)
+
+    for _ in range(4):
+        job_rec.reconcile(Request(name="tj"))
+        place_j.reconcile(QUEUE_REQUEST)
+        fake_trainer()
+    job = jc.get("tpu.google.com/v1alpha1", "TPUJob", "tj")
+    block = (job.get("status") or {}).get("job") or {}
+    checks["job_running_before_migration"] = block.get("phase") == JobPhase.RUNNING
+    source_nodes = set()
+    for n in jc.list("v1", "Node"):
+        if (n["metadata"].get("labels") or {}).get(consts.PLACEMENT_LABEL) == "tj-slice":
+            source_nodes.add(n["metadata"]["name"])
+    # the defrag controller's execution primitive: its one owned key
+    jc.patch(
+        "v1", "ConfigMap", progress_name,
+        {"data": {consts.JOB_DEFRAG_REQUEST: "defrag-smoke-1"}}, ns,
+    )
+    phases_seen = []
+    for _ in range(8):
+        job_rec.reconcile(Request(name="tj"))
+        job = jc.get("tpu.google.com/v1alpha1", "TPUJob", "tj")
+        phases_seen.append(((job.get("status") or {}).get("job") or {}).get("phase"))
+        place_j.reconcile(QUEUE_REQUEST)
+        fake_trainer()
+    block = (job.get("status") or {}).get("job") or {}
+    checks["job_checkpointed_before_move"] = JobPhase.CHECKPOINTING in phases_seen
+    checks["job_back_running_after_move"] = block.get("phase") == JobPhase.RUNNING
+    checks["job_step_watermark_intact"] = block.get("step") == 42
+    checks["job_defrag_token_honored"] = block.get("defragHandled") == "defrag-smoke-1"
+    job_events = [e.get("reason") for e in jc.list("v1", "Event", "default")]
+    checks["job_migrating_evented"] = "JobMigrating" in job_events
+    # idempotency: the same token never migrates twice
+    barriers_before = block.get("barrierSeq")
+    for _ in range(3):
+        job_rec.reconcile(Request(name="tj"))
+        fake_trainer()
+    job = jc.get("tpu.google.com/v1alpha1", "TPUJob", "tj")
+    block = (job.get("status") or {}).get("job") or {}
+    checks["job_stale_token_ignored"] = block.get("barrierSeq") == barriers_before
+
+    # -- part 5: fleet sim — defrag-aware beats best-fit ---------------------
+    def schedule():
+        return GangChurnSchedule(
+            seed=11, ticks=140, arrivals_per_tick=1.1,
+            shapes=(
+                ((2, 2, 1), 4.0), ((2, 2, 2), 3.0), ((4, 2, 2), 2.0),
+                ((4, 4, 2), 1.0), ((4, 4, 4), 0.6),
+            ),
+            min_lifetime=25, max_lifetime=70,
+        )
+
+    reports = {}
+    for policy in ("best-fit", "defrag-aware"):
+        sim = FleetSimulator(
+            dims=(8, 8, 8), policy=policy,
+            migration_cooldown_ticks=6, defrag_every=3,
+        )
+        reports[policy] = sim.run(schedule(), drain_ticks=30)
+    checks["sim_defrag_beats_best_fit_p99"] = (
+        reports["defrag-aware"]["time_to_place_p99_s"]
+        < reports["best-fit"]["time_to_place_p99_s"]
+    )
+    checks["sim_defrag_lower_end_fragmentation"] = (
+        reports["defrag-aware"]["fragmentation"]
+        < reports["best-fit"]["fragmentation"]
+    )
+    checks["sim_migrations_happened"] = reports["defrag-aware"]["migrations"] >= 1
+
+    violations = []
+    if os.environ.get("TPUOP_RACECHECK") == "1":
+        from tpu_operator.kube import racecheck
+
+        violations = [repr(v) for v in racecheck.violations()]
+    checks["racecheck_clean"] = not violations
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "defrag_smoke",
+        "ok": ok,
+        "checks": checks,
+        "frag_before": frag_before,
+        "decisions": decisions[-3:],
+        "fleet_sim": {
+            p: {k: r[k] for k in (
+                "utilization_pct", "time_to_place_p50_s", "time_to_place_p99_s",
+                "migrations", "fragmentation",
+            )} for p, r in reports.items()
+        },
+        "racecheck_violations": violations,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def placement_smoke() -> int:
     """CI gate (scripts/ci.sh): a full place/evict/re-place churn on the
     simulated 512-host torus must finish inside the budget with zero
@@ -2285,6 +2687,8 @@ def main() -> None:
         raise SystemExit(job_smoke())
     if "--serving-smoke" in sys.argv[1:]:
         raise SystemExit(serving_smoke())
+    if "--defrag-smoke" in sys.argv[1:]:
+        raise SystemExit(defrag_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -2384,6 +2788,13 @@ def main() -> None:
         serving = bench_serving()
     except Exception as e:  # noqa: BLE001 — same isolation as chaos
         serving = {"error": f"{type(e).__name__}: {e}"}
+    # capacity planning: best-fit vs defrag-aware at 4096 sim hosts +
+    # the analytical model's calibrate-then-predict validation (gated
+    # by --defrag-smoke)
+    try:
+        fleet_sim = bench_fleet_sim()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        fleet_sim = {"error": f"{type(e).__name__}: {e}"}
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -2417,6 +2828,7 @@ def main() -> None:
         "autotune": autotune,
         "training": training,
         "serving": serving,
+        "fleet_sim": fleet_sim,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

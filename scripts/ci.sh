@@ -97,6 +97,17 @@ echo "== serving smoke: burst -> scale-up -> route -> fragmentation-aware scale-
 # routing (zero requests), scale down via the fragmentation-aware
 # victim, and retire every serving series when the CR is deleted
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --serving-smoke
+echo "== defrag smoke: fragmented torus -> migration -> the 4x4x4 lands =="
+# capacity-planning gate: on the seeded fragmented 512-host torus the
+# defrag controller must land a previously-unplaceable 4x4x4 gang with
+# fragmentation strictly decreasing (serving replicas drain-then-
+# re-place; a TPUJob gang moves behind the checkpoint barrier with its
+# step watermark intact), propose ZERO migrations while any placement
+# is queued, and the fleet simulator's defrag-aware policy must beat
+# best-fit on p99 time-to-place under the seeded churn schedule
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --defrag-smoke
+echo "== defrag smoke (racecheck leg): the same gate under instrumented locks =="
+TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --defrag-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

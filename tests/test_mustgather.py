@@ -128,6 +128,12 @@ def test_bundle_from_live_install(tmp_path):
         placement_txt = (tmp_path / "placement.txt").read_text()
         assert "# placement queue" in placement_txt
         assert "# host assignments" in placement_txt
+        # the capacity-planning view rides over the wire as well:
+        # per-pool posture, defrag decision history, admission what-ifs
+        plan_txt = (tmp_path / "plan.txt").read_text()
+        assert "# pools" in plan_txt
+        assert "# defrag decisions" in plan_txt
+        assert "# admission what-ifs" in plan_txt
         # the data-plane telemetry view: fleet perf rollup + the
         # operator-published floor table (rendered by pre-requisites in
         # this live install) + gang artifacts section

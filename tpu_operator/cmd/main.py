@@ -25,6 +25,10 @@ from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     setup_with_manager as setup_clusterpolicy,
 )
+from tpu_operator.controllers.defrag_controller import (
+    DefragReconciler,
+    setup_with_manager as setup_defrag,
+)
 from tpu_operator.controllers.health_controller import (
     HealthReconciler,
     setup_with_manager as setup_health,
@@ -130,6 +134,7 @@ def main(argv=None) -> int:
     setup_autotune(mgr, AutotuneReconciler(client, namespace))
     setup_job(mgr, JobReconciler(client, namespace))
     setup_serving(mgr, ServingReconciler(client, namespace))
+    setup_defrag(mgr, DefragReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

@@ -130,6 +130,55 @@ def cmd_must_gather(args) -> int:
     return 0
 
 
+def cmd_plan(args) -> int:
+    """Capacity planning report: pool posture (utilization /
+    fragmentation), the analytical model's per-generation predictions,
+    admission answers for queued shapes, and an optional what-if
+    (`--shape 8x8x8 --within 600`: "can this land, and what would
+    defrag have to move?"). Same client resolution as must-gather."""
+    import os
+
+    from tpu_operator import consts
+    from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
+    from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
+    from tpu_operator.kube import errors as kube_errors
+    from tpu_operator.kube.http_client import HttpClient
+    from tpu_operator.planning.whatif import plan_report
+
+    if os.environ.get("KUBERNETES_SERVICE_HOST") and not args.kubeconfig:
+        client = HttpClient.in_cluster()
+    else:
+        client = HttpClient.from_kubeconfig(args.kubeconfig or None)
+    ns = args.namespace or os.environ.get(
+        consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE
+    )
+    slices = client.list(TPU_SLICE_API_VERSION, "TPUSlice")
+    nodes = client.list("v1", "Node")
+    try:
+        links = degraded_link_pairs(client, ns)
+    except kube_errors.ApiError:
+        links = []
+    entries = None
+    try:
+        cm = client.get_or_none(
+            "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, ns
+        )
+        if cm is not None:
+            from tpu_operator.workloads.autotune import cached_entries
+
+            entries = cached_entries(cm.get("data"))
+    except kube_errors.ApiError:
+        entries = None
+    sys.stdout.write(
+        plan_report(
+            slices, nodes, shape=args.shape, pool=args.pool,
+            horizon_seconds=args.within, degraded_links=links,
+            autotune_entries=entries,
+        )
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpuop-cfg")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -149,6 +198,19 @@ def main(argv=None) -> int:
     mg.add_argument("--namespace", default="")
     mg.add_argument("--kubeconfig", default="")
     mg.set_defaults(fn=cmd_must_gather)
+    pl = sub.add_parser(
+        "plan", help="capacity report + admission what-ifs (the planning engine)"
+    )
+    pl.add_argument("--shape", default="", help="what-if gang shape, e.g. 8x8x8")
+    pl.add_argument("--pool", default="", help="pin the what-if to one pool")
+    pl.add_argument(
+        "--within", type=float, default=600.0,
+        help="admission horizon in seconds (defrag migrations are priced "
+        "at the cooldown)",
+    )
+    pl.add_argument("--namespace", default="")
+    pl.add_argument("--kubeconfig", default="")
+    pl.set_defaults(fn=cmd_plan)
     args = p.parse_args(argv)
     return args.fn(args)
 

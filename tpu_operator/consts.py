@@ -262,6 +262,38 @@ SERVING_SCALE_DOWN_HEADROOM = 0.8
 # status.serving scale-decision history bound (last N with reasons)
 SERVING_DECISIONS_LIMIT = 5
 
+# ---------------------------------------------------------------------------
+# Capacity planning & scheduled defragmentation (tpu_operator/planning/
+# + controllers/defrag_controller.py). The defrag controller proposes at
+# most one migration per pass, only inside an idle window (no placement
+# in flight, fleet demand below the headroom fraction), and executes it
+# through the owning workload's own safe path: a TPUJob gang migrates
+# behind the PR 13 checkpoint barrier (the defrag-owned progress-CM
+# request key below), a TPUServing replica through the drain-then-
+# re-place path (its router weight drops to zero the moment the gang is
+# torn down, and the engine re-seats it). Gangs owned by neither are
+# never touched. Budget + cooldown below are what make thrash
+# structurally impossible: a migration costs a checkpoint/drain, so the
+# controller must never spend more than the budget per window no matter
+# how the fragmentation series wiggles.
+# ---------------------------------------------------------------------------
+DEFRAG_STATE_CONFIGMAP = "tpu-defrag-state"   # decision history + budget ledger
+DEFRAG_STATE_KEY = "state.json"
+DEFRAG_REPLAN_SECONDS = 30.0                  # pass cadence while idle
+DEFRAG_COOLDOWN_SECONDS = 300.0               # min gap between migrations
+DEFRAG_MIGRATION_BUDGET = 2                   # max migrations per window
+DEFRAG_BUDGET_WINDOW_SECONDS = 1800.0
+DEFRAG_UTILIZATION_HEADROOM = 0.9             # no defrag above this utilization
+DEFRAG_MIN_FRAG_GAIN = 0.02                   # deltas below this are noise
+DEFRAG_DECISIONS_LIMIT = 5                    # state-CM history bound
+# defrag-controller-owned progress-CM key (disjoint from the job
+# controller's checkpointRequest/restartRequest and the trainer's acks):
+# a new token here asks the job controller to checkpoint-barrier and
+# re-place the gang at the barrier — the job controller records the
+# token it honored in status.job.defragHandled so a token is never
+# executed twice
+JOB_DEFRAG_REQUEST = "defragRequest"
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

@@ -1,0 +1,538 @@
+"""Scheduled defragmentation: background gang migrations in idle windows.
+
+The execution half of the capacity planner (``tpu_operator/planning/``).
+Fragmentation is a measured series (``tpu_operator_torus_fragmentation``)
+and the proposer math is the engine's own replay-minus-candidate helper
+(``placement/engine.migration_scores`` — the SAME primitive the serving
+controller's scale-down victim rides), so a proposal here is exactly
+"what the next placement pass would do if this gang's assignment went
+away". What this controller adds is the *discipline* around executing
+one:
+
+- **Idle windows only.** A pass proposes nothing while the placement
+  engine has work in flight (any label delta or teardown in the
+  replayed plan — a Queued gang, a broken gang, an orphaned label). An
+  ``Unschedulable`` request does NOT block defrag: a parked gang is the
+  *beneficiary* — a migration that seats one wins outright.
+- **Demand headroom.** No migrations above
+  ``consts.DEFRAG_UTILIZATION_HEADROOM`` fleet utilization: near-full
+  is exactly when a checkpoint/drain cycle hurts most and helps least.
+- **Budget + cooldown.** At most ``DEFRAG_MIGRATION_BUDGET`` migrations
+  per ``DEFRAG_BUDGET_WINDOW_SECONDS``, never two within
+  ``DEFRAG_COOLDOWN_SECONDS``, and never a second while one is in
+  flight — defrag can slow down, it can never thrash.
+- **Owner-safe execution.** A TPUJob gang migrates through the PR 13
+  checkpoint barrier: this controller writes its one owned key
+  (``consts.JOB_DEFRAG_REQUEST``) into the job's progress ConfigMap and
+  the job controller checkpoints, tears the gang down, and resumes on
+  the re-placed block. A TPUServing replica takes the drain-then-
+  re-place path (assignment labels cleared; the serving router zeroes
+  its weight the same pass, the engine re-seats it) — and only while
+  the serving has another routable replica. Gangs owned by neither are
+  NEVER touched.
+- **Link-cut aware.** Every replay carries the fabric analyzer's
+  link-health map, so a proposal can never seat a gang across a
+  recorded cut.
+
+Decisions (last ``DEFRAG_DECISIONS_LIMIT``, with predicted-vs-realized
+fragmentation deltas) persist in the ``tpu-defrag-state`` ConfigMap —
+restart-safe budget accounting, and the must-gather ``plan.txt``
+evidence trail. Completed migrations emit a ``DefragMigrated`` Event
+naming the source and destination blocks.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.api.tpujob import TPU_JOB_API_VERSION, TPU_JOB_KIND, JobPhase
+from tpu_operator.api.tpuserving import TPU_SERVING_KIND
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors, trace
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict, new_object
+from tpu_operator.placement.engine import (
+    PlacementEngine,
+    PlacementPhase,
+    migration_scores,
+    pick_migration,
+)
+from tpu_operator.planning.model import predict_step_time
+from tpu_operator.workloads.descriptor import reference_descriptor
+
+log = logging.getLogger(__name__)
+
+DEFRAG_MANAGER = "tpu-defrag-controller"
+
+# the one request the whole pass maps to (the placement queue's shape)
+DEFRAG_REQUEST = Request(name="defrag-pass")
+
+# an in-flight migration whose gang never re-placed within this window
+# is recorded failed (realized=None -> "abandoned") and stops blocking
+IN_FLIGHT_TIMEOUT_SECONDS = 600.0
+
+
+class DefragReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = EventRecorder(client, namespace, component=DEFRAG_MANAGER)
+        self.metrics = get_metrics()
+        self._now = time.time  # tests pin the clock
+        from tpu_operator.kube import racecheck
+
+        self._series_lock = racecheck.lock("DefragReconciler._series_lock")
+        self._util_pools: set = set()
+        self._pred_generations: set = set()
+
+    # -- series hygiene ------------------------------------------------------
+
+    def _export_utilization(self, engine: PlacementEngine) -> Dict[str, float]:
+        utilization: Dict[str, float] = {}
+        for pool_name, (_, torus) in sorted(engine.pools.items()):
+            utilization[pool_name] = torus.utilization()
+            self.metrics.fleet_utilization.labels(pool_name).set(utilization[pool_name])
+        with self._series_lock:
+            gone = self._util_pools - set(utilization)
+            self._util_pools = set(utilization)
+        for pool_name in gone:
+            try:
+                self.metrics.fleet_utilization.remove(pool_name)
+            except KeyError:
+                pass
+        return utilization
+
+    def _export_predictions(self, engine: PlacementEngine) -> Dict[str, float]:
+        """The analytical model's reference prediction per generation
+        present in the fleet — the live calibration surface `tpuop-cfg
+        plan` and dashboards read. Autotune winners fold in exactly as
+        they do for the floors pipeline."""
+        entries = self._autotune_entries()
+        descriptor = reference_descriptor()
+        predictions: Dict[str, float] = {}
+        for pool, _ in engine.pools.values():
+            gen = pool.info.generation
+            if gen in predictions:
+                continue
+            prediction = predict_step_time(
+                descriptor, gen, (2, 2, 1),
+                chips_per_host=max(1, pool.info.chips_per_node),
+                autotune_entries=entries,
+            )
+            predictions[gen] = round(prediction.step_seconds, 6)
+            self.metrics.plan_predicted_step.labels(gen).set(predictions[gen])
+        with self._series_lock:
+            gone = self._pred_generations - set(predictions)
+            self._pred_generations = set(predictions)
+        for gen in gone:
+            try:
+                self.metrics.plan_predicted_step.remove(gen)
+            except KeyError:
+                pass
+        return predictions
+
+    def _autotune_entries(self) -> Optional[dict]:
+        """The cached per-generation sweep entries (calibration input);
+        None when the results CM is absent/unreadable — the model falls
+        back to the static table, never raises."""
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.AUTOTUNE_RESULTS_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError:
+            return None
+        if cm is None:
+            return None
+        from tpu_operator.workloads.autotune import cached_entries
+
+        return cached_entries(cm.get("data"))
+
+    # -- persisted state -----------------------------------------------------
+
+    def _read_state(self) -> Optional[dict]:
+        """The budget/cooldown ledger. A transient READ failure returns
+        None and the caller aborts the pass — a flaky apiserver must
+        fail CLOSED, not reset the ledger and hand back the whole
+        migration budget. Only a genuinely malformed blob (which a
+        retry can never fix) starts fresh."""
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError as e:
+            log.warning("defrag: state CM unreadable, pass aborted: %s", e)
+            return None
+        raw = ((cm or {}).get("data") or {}).get(consts.DEFRAG_STATE_KEY)
+        if not raw:
+            return {"decisions": []}
+        try:
+            state = json.loads(raw)
+        except ValueError:
+            state = None  # malformed: start fresh, never crash the pass
+        if not isinstance(state, dict) or not isinstance(state.get("decisions"), list):
+            return {"decisions": []}
+        return state
+
+    def _write_state(self, state: dict) -> None:
+        state["decisions"] = state.get("decisions", [])[-consts.DEFRAG_DECISIONS_LIMIT:]
+        data = {consts.DEFRAG_STATE_KEY: json.dumps(state, sort_keys=True)}
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP,
+                {"data": data}, self.namespace,
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                    new_object(
+                        "v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP,
+                        self.namespace, data=data,
+                    )
+                )
+            except (errors.AlreadyExists, errors.ApiError) as e:
+                log.debug("defrag state write raced/failed: %s", e)
+        except errors.ApiError as e:
+            log.debug("defrag state write failed: %s", e)
+
+    # -- the pass ------------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+            nodes = self.client.list("v1", "Node")
+        except errors.ApiError as e:
+            log.debug("defrag pass inputs unreadable: %s", e)
+            return Result(requeue_after=consts.DEFRAG_REPLAN_SECONDS)
+        links = self._degraded_links()
+        if links is None:
+            # a failed link-map read aborts the pass (the placement
+            # controller's rule): proposing with "no cuts" could migrate
+            # a gang ONTO a known-degraded link
+            return Result(requeue_after=consts.DEFRAG_REPLAN_SECONDS)
+        with trace.span("defrag-plan", slices=len(slices), nodes=len(nodes)):
+            engine = PlacementEngine(slices, nodes, degraded_links=links)
+            plan = engine.plan()
+        utilization = self._export_utilization(engine)
+        self._export_predictions(engine)
+        if not engine.pools:
+            return Result(requeue_after=consts.DEFRAG_REPLAN_SECONDS)
+
+        state = self._read_state()
+        if state is None:
+            # ledger unreadable: fail closed (proposing against an empty
+            # ledger would hand the whole migration budget back)
+            return Result(requeue_after=consts.DEFRAG_REPLAN_SECONDS)
+        now = self._now()
+        slices_by_name = {s["metadata"]["name"]: s for s in slices}
+        in_flight, dirty = self._settle_in_flight(state, plan, slices_by_name, now)
+
+        busy = bool(plan.label_deltas or plan.teardowns)
+        over_headroom = any(
+            u >= consts.DEFRAG_UTILIZATION_HEADROOM for u in utilization.values()
+        )
+        if not (busy or over_headroom or in_flight) and self._budget_allows(state, now):
+            with trace.span("defrag-propose"):
+                proposal = self._propose(slices, nodes, slices_by_name, links)
+            if proposal is not None:
+                dirty = self._execute(proposal, slices_by_name, state, now) or dirty
+        if dirty:
+            # a quiet pass writes nothing (the fabric analyzer's rule):
+            # an every-pass state rewrite would be a steady write load
+            # for a controller that is idle almost always
+            self._write_state(state)
+        return Result(requeue_after=consts.DEFRAG_REPLAN_SECONDS)
+
+    def _degraded_links(self) -> Optional[List[tuple]]:
+        from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
+
+        try:
+            return degraded_link_pairs(self.client, self.namespace)
+        except errors.ApiError as e:
+            log.warning("defrag: link-health map unreadable, pass aborted: %s", e)
+            return None
+
+    # -- budget --------------------------------------------------------------
+
+    def _budget_allows(self, state: dict, now: float) -> bool:
+        executed = [
+            d for d in state.get("decisions", []) if d.get("executed_at") is not None
+        ]
+        if executed:
+            last = max(d["executed_at"] for d in executed)
+            if now - last < consts.DEFRAG_COOLDOWN_SECONDS:
+                return False
+        window_start = now - consts.DEFRAG_BUDGET_WINDOW_SECONDS
+        recent = sum(1 for d in executed if d["executed_at"] >= window_start)
+        return recent < consts.DEFRAG_MIGRATION_BUDGET
+
+    def _settle_in_flight(
+        self, state: dict, plan, slices_by_name: dict, now: float
+    ) -> Tuple[bool, bool]:
+        """Book the realized outcome of the newest unsettled decision.
+        Returns (in_flight, state_changed): in_flight blocks proposing
+        while a migration is still moving."""
+        changed = False
+        decisions = state.get("decisions", [])
+        for decision in reversed(decisions):
+            if decision.get("settled"):
+                continue
+            name = decision.get("slice", "")
+            obj = slices_by_name.get(name)
+            status = ((obj or {}).get("status") or {}).get("placement") or {}
+            scheduled = status.get("phase") == PlacementPhase.SCHEDULED
+            moved = scheduled and (
+                (str(status.get("origin") or ""), status.get("pool"))
+                != (decision.get("source_origin"), decision.get("pool"))
+                or list(status.get("nodes") or [])
+                != list(decision.get("source_nodes") or [])
+            )
+            if moved:
+                # realized on the SOURCE pool — the same pool the
+                # proposal's predicted_frag was scored on (a cross-pool
+                # re-seat must never difference two pools' numbers)
+                realized = plan.fragmentation.get(
+                    str(decision.get("pool") or ""), 0.0
+                )
+                changed = True
+                decision["settled"] = True
+                decision["realized_frag"] = realized
+                decision["realized_delta"] = round(
+                    realized - float(decision.get("frag_before") or 0.0), 4
+                )
+                decision["dest_origin"] = str(status.get("origin") or "")
+                if obj is not None:
+                    self.recorder.event(
+                        obj, "Normal", "DefragMigrated",
+                        f"gang {name} migrated from block "
+                        f"{decision.get('source_origin') or '?'} to block "
+                        f"{decision.get('dest_origin') or '?'} in pool "
+                        f"{status.get('pool') or decision.get('pool') or '?'}; "
+                        f"fragmentation {decision.get('frag_before')} -> {realized} "
+                        f"(predicted {decision.get('predicted_frag')})",
+                    )
+                continue
+            if obj is None or now - float(decision.get("executed_at") or 0.0) \
+                    > IN_FLIGHT_TIMEOUT_SECONDS:
+                changed = True
+                decision["settled"] = True
+                decision["realized_frag"] = None
+                decision["abandoned"] = True
+                continue
+            return True, changed  # still moving: never overlap migrations
+        return False, changed
+
+    # -- proposing -----------------------------------------------------------
+
+    def _migratable(self, slices_by_name: dict) -> Dict[str, Tuple[str, str]]:
+        """slice name -> (owner kind, owner name) for every placed gang
+        defrag may legally move: TPUJob-owned gangs whose job is Running
+        with a live progress CM (somebody must answer the checkpoint
+        barrier), and TPUServing replicas with at least one OTHER placed,
+        in-service sibling (never drain the last routable replica).
+        Everything else — no owner, foreign owner — is untouchable."""
+        out: Dict[str, Tuple[str, str]] = {}
+        for name, obj in slices_by_name.items():
+            status = (obj.get("status") or {}).get("placement") or {}
+            if status.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            owner = self._owner_of(obj)
+            if owner is None:
+                continue
+            kind, owner_name = owner
+            if kind == TPU_JOB_KIND and self._job_migratable(owner_name):
+                out[name] = owner
+            elif kind == TPU_SERVING_KIND and self._serving_sibling_placed(
+                name, owner_name, slices_by_name
+            ):
+                out[name] = owner
+        return out
+
+    @staticmethod
+    def _owner_of(obj: ObjectDict) -> Optional[Tuple[str, str]]:
+        for ref in obj["metadata"].get("ownerReferences") or []:
+            if ref.get("kind") in (TPU_JOB_KIND, TPU_SERVING_KIND) and ref.get("name"):
+                return (str(ref["kind"]), str(ref["name"]))
+        return None
+
+    def _job_migratable(self, job_name: str) -> bool:
+        job = self.client.get_or_none(TPU_JOB_API_VERSION, TPU_JOB_KIND, job_name)
+        if job is None:
+            return False
+        block = (job.get("status") or {}).get("job") or {}
+        if block.get("phase") != JobPhase.RUNNING:
+            return False
+        progress = self.client.get_or_none(
+            "v1", "ConfigMap", job_name + consts.JOB_PROGRESS_SUFFIX, self.namespace
+        )
+        return progress is not None
+
+    def _serving_sibling_placed(
+        self, name: str, serving: str, slices_by_name: dict
+    ) -> bool:
+        """True when another replica of the same serving is placed AND
+        in service (every member node healthy) — draining a gang whose
+        only sibling is placed-but-dying would leave the serving with
+        zero routable replicas for the whole re-place window. (A
+        sibling whose router exclusion comes ONLY from a not-yet-blamed
+        fabric artifact can slip through for one analyzer cadence; the
+        analyzer's link/host blame lands in the link map / node labels,
+        which this check and the replay both honor.)"""
+        from tpu_operator.placement.engine import labels_unavailable
+
+        for other_name, other in slices_by_name.items():
+            if other_name == name:
+                continue
+            owner = self._owner_of(other)
+            if owner != (TPU_SERVING_KIND, serving):
+                continue
+            status = (other.get("status") or {}).get("placement") or {}
+            if status.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            members_healthy = True
+            for node_name in status.get("nodes") or []:
+                node = self.client.get_or_none("v1", "Node", node_name)
+                if node is None or labels_unavailable(
+                    node["metadata"].get("labels") or {}
+                ):
+                    members_healthy = False
+                    break
+            if members_healthy:
+                return True
+        return False
+
+    def _propose(
+        self, slices, nodes, slices_by_name: dict, links
+    ) -> Optional[dict]:
+        migratable = self._migratable(slices_by_name)
+        if not migratable:
+            return None
+        scores = migration_scores(
+            slices, nodes, sorted(migratable), degraded_links=links
+        )
+        best = pick_migration(scores)
+        if best is None:
+            return None
+        entry = scores[best]
+        if not entry["lands_pending"] and entry["frag_delta"] > -consts.DEFRAG_MIN_FRAG_GAIN:
+            return None  # the improvement is noise: not worth a checkpoint
+        kind, owner_name = migratable[best]
+        return {"slice": best, "owner_kind": kind, "owner_name": owner_name, **entry}
+
+    # -- executing -----------------------------------------------------------
+
+    def _execute(
+        self, proposal: dict, slices_by_name: dict, state: dict, now: float
+    ) -> bool:
+        """Returns True when the migration was requested and booked
+        into the state ledger (the caller's write-needed signal)."""
+        name = proposal["slice"]
+        obj = slices_by_name.get(name)
+        status = ((obj or {}).get("status") or {}).get("placement") or {}
+        decision = {
+            "slice": name,
+            "owner_kind": proposal["owner_kind"],
+            "owner_name": proposal["owner_name"],
+            "pool": proposal["pool"],
+            "dest_pool": proposal.get("dest_pool") or proposal["pool"],
+            "frag_before": proposal["frag_before"],
+            "predicted_frag": proposal["frag_after"],
+            "predicted_delta": proposal["frag_delta"],
+            "lands_pending": proposal["lands_pending"],
+            "source_origin": str(status.get("origin") or ""),
+            "source_nodes": list(status.get("nodes") or []),
+            "predicted_dest_origin": proposal["origin"],
+            "executed_at": None,
+            "settled": False,
+        }
+        if proposal["owner_kind"] == TPU_JOB_KIND:
+            ok = self._request_job_migration(proposal["owner_name"], state, now)
+        else:
+            ok = self._drain_serving_replica(decision["source_nodes"])
+        if not ok:
+            return False
+        decision["executed_at"] = now
+        state.setdefault("decisions", []).append(decision)
+        self.metrics.defrag_migrations.inc()
+        if obj is not None:
+            self.recorder.event(
+                obj, "Normal", "DefragProposed",
+                f"migrating gang {name} off block "
+                f"{decision['source_origin'] or '?'} (pool {proposal['pool']}): "
+                f"predicted fragmentation {proposal['frag_before']} -> "
+                f"{proposal['frag_after']}"
+                + (
+                    f"; seats pending {', '.join(proposal['lands_pending'])}"
+                    if proposal["lands_pending"] else ""
+                ),
+            )
+        return True
+
+    def _request_job_migration(self, job_name: str, state: dict, now: float) -> bool:
+        """The checkpoint-barrier path: bump our one owned key in the
+        job's progress CM; the job controller drives checkpoint →
+        teardown → re-place → resume and records the token it honored
+        in status.job.defragHandled."""
+        token = f"defrag-{int(now)}-{state.get('serial', 0)}"
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", job_name + consts.JOB_PROGRESS_SUFFIX,
+                {"data": {consts.JOB_DEFRAG_REQUEST: token}}, self.namespace,
+            )
+        except (errors.NotFound, errors.ApiError) as e:
+            log.debug("defrag: job %s migration request failed: %s", job_name, e)
+            return False
+        # bumped only on success: a failed request mutates nothing, so
+        # the caller's skip-the-write-when-clean rule stays sound
+        state["serial"] = int(state.get("serial", 0)) + 1
+        return True
+
+    def _drain_serving_replica(self, gang_nodes: List[str]) -> bool:
+        """The drain-then-re-place path: clear the replica gang's
+        assignment labels (the engine's source of truth). The serving
+        router zeroes the replica's weight the moment it reads as
+        unplaced, and the placement pass re-seats it into the replay's
+        predicted block. A PARTIAL clear still counts executed (the
+        engine finishes the teardown — level-triggered repair), but a
+        sweep that cleared NOTHING must not book a migration, spend
+        budget, or block defrag behind a phantom in-flight decision."""
+        from tpu_operator.controllers.placement_controller import (
+            clear_assignment_labels,
+        )
+
+        return clear_assignment_labels(self.client, gang_nodes) > 0
+
+
+def setup_with_manager(mgr, reconciler: DefragReconciler) -> Controller:
+    ctrl = Controller(
+        "defrag", reconciler, coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS
+    )
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_pass(_obj) -> List[Request]:
+        return [DEFRAG_REQUEST]
+
+    def placement_changed(event_type, old, new) -> bool:
+        """Only placement-status movement matters: the pass re-derives
+        everything else, and its own state-CM writes must not re-enqueue
+        it (the CM watch below is name-filtered to the link map)."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (
+            ((old.get("status") or {}).get("placement") or {})
+            != ((new.get("status") or {}).get("placement") or {})
+        )
+
+    ctrl.watch(
+        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
+        mapper=map_to_pass, predicate=placement_changed,
+    )
+    mgr.add_controller(ctrl)
+    return ctrl

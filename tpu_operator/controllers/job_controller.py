@@ -369,6 +369,13 @@ class JobReconciler:
             block["barrier"] = prior["barrier"]
         if prior.get("barrierSeq"):
             block["barrierSeq"] = prior["barrierSeq"]
+        # defrag-migration bookkeeping: the request token last honored
+        # (so a stale defragRequest never re-migrates) and the one a
+        # barrier is currently in flight for
+        if prior.get("defragHandled"):
+            block["defragHandled"] = prior["defragHandled"]
+        if prior.get("defragPending"):
+            block["defragPending"] = prior["defragPending"]
 
         # -- completion first: a finished job frees its capacity
         if pstatus == consts.JOB_PROGRESS_COMPLETE and step >= job.spec.workload.steps:
@@ -401,7 +408,8 @@ class JobReconciler:
         ):
             if healthy:
                 result = self._reconcile_healthy(
-                    obj, job, block, budget, desired, target, world, pstatus, progress
+                    obj, job, block, budget, desired, target, world, pstatus,
+                    progress, gang["nodes"],
                 )
             else:
                 result = self._reconcile_broken(
@@ -430,6 +438,7 @@ class JobReconciler:
         world: int,
         pstatus: str,
         progress: dict,
+        gang_nodes: List[str],
     ) -> Result:
         phase = block["phase"]
         hosts = block["hosts"]
@@ -446,11 +455,29 @@ class JobReconciler:
         if phase == JobPhase.CHECKPOINTING:
             token = str(block.get("barrier") or "")
             ack = progress.get(consts.JOB_PROGRESS_CHECKPOINT_ACK, "")
+            if token.startswith("defrag-"):
+                # the defrag controller's migration barrier: checkpoint
+                # first, THEN tear the gang down so the placement engine
+                # re-seats it — the move loses zero steps, exactly like
+                # a planned grow
+                if ack == token:
+                    self._teardown_gang(gang_nodes)
+                    block["defragHandled"] = str(block.pop("defragPending", "") or "")
+                    block.pop("barrier", None)
+                    block["phase"] = JobPhase.RESUMING
+                    block["message"] = ""
+                    self.recorder.normal(
+                        obj, "JobMigrating",
+                        f"defrag migration: checkpointed at step {block['step']}, "
+                        "gang torn down for re-placement",
+                    )
+                return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
             if not token or target == desired:
                 # lost/landed barrier: drop back to Running (the grow
                 # check re-fires next pass if capacity still allows)
                 block["phase"] = JobPhase.RUNNING
                 block.pop("barrier", None)
+                block.pop("defragPending", None)
             elif ack == token:
                 # barrier satisfied: grow — zero steps past the barrier.
                 # Re-verify first: capacity may have vanished while the
@@ -517,7 +544,44 @@ class JobReconciler:
                         f"capacity healed: checkpointing before growing "
                         f"{_shape_str(target)} -> {_shape_str(desired)}",
                     )
+        # still RUNNING (no grow barrier fired): honor a pending defrag
+        # migration request — same barrier machinery, same monotonic
+        # sequence, `defrag-` token prefix routes the ack to the
+        # teardown-and-re-place arm instead of the slice-shape patch.
+        # A token already honored (status.job.defragHandled) is stale:
+        # executing it twice would checkpoint-cycle the gang for nothing.
+        defrag_req = str(progress.get(consts.JOB_DEFRAG_REQUEST, "") or "")
+        if (
+            block["phase"] == JobPhase.RUNNING
+            and defrag_req
+            and defrag_req != str(block.get("defragHandled") or "")
+        ):
+            seq = self._int(block.get("barrierSeq")) + 1
+            token = f"defrag-{seq}-{block['step']}"
+            if self._request_progress_key(
+                job.name, consts.JOB_CHECKPOINT_REQUEST, token
+            ):
+                block["barrierSeq"] = seq
+                block["phase"] = JobPhase.CHECKPOINTING
+                block["barrier"] = token
+                block["defragPending"] = defrag_req
+                self.recorder.normal(
+                    obj, "JobMigrating",
+                    "defrag migration requested: checkpointing before "
+                    "re-placing the gang",
+                )
         return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
+
+    def _teardown_gang(self, gang_nodes: List[str]) -> None:
+        """Clear the gang's assignment labels so the placement engine
+        re-seats it (labels are the source of truth; a partial clear is
+        a broken gang the next pass finishes tearing down — the same
+        level-triggered repair the engine is built on)."""
+        from tpu_operator.controllers.placement_controller import (
+            clear_assignment_labels,
+        )
+
+        clear_assignment_labels(self.client, gang_nodes)
 
     # -- the broken half -----------------------------------------------------
 
@@ -533,6 +597,16 @@ class JobReconciler:
         links: List[tuple],
     ) -> Result:
         cause = self._classify_cause(gang)
+        # a broken gang re-places regardless, which IS a migration: any
+        # defrag request outstanding or mid-barrier is thereby satisfied
+        # (without this, a fault during the barrier window would replay
+        # the migration — a spurious checkpoint cycle — once healthy)
+        defrag_req = str(
+            self._progress(job.name).get(consts.JOB_DEFRAG_REQUEST, "") or ""
+        )
+        if defrag_req:
+            block["defragHandled"] = defrag_req
+        block.pop("defragPending", None)
         best = self._placeable(
             job, desired, _volume(min_shape), exclude_self=True, links=links
         )
@@ -677,6 +751,7 @@ class JobReconciler:
         block["message"] = message
         block.pop("nextAttemptAt", None)
         block.pop("barrier", None)
+        block.pop("defragPending", None)
         self._delete_slice(obj["metadata"]["name"])
         self.recorder.warning(obj, "JobFailed", f"quarantined: {message}")
 

@@ -271,6 +271,37 @@ class OperatorMetrics:
             ["serving"],
             registry=reg,
         )
+        # capacity planning & scheduled defragmentation (controllers/
+        # defrag_controller.py rides the planning package): per-pool
+        # utilization and the analytical model's reference prediction,
+        # both retired with their pool/generation (O005)
+        self.fleet_utilization = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_fleet_utilization",
+            "Occupied fraction of a node pool's in-service hosts "
+            "(out-of-service capacity is subtracted from the "
+            "denominator) — the defrag controller's headroom signal",
+            ["pool"],
+            registry=reg,
+        )
+        self.defrag_migrations = _get_or_create(
+            prometheus_client.Counter,
+            "tpu_operator_defrag_migrations_total",
+            "Gang migrations the defrag controller has executed "
+            "(checkpoint-barrier moves for TPUJob gangs, "
+            "drain-then-re-place for TPUServing replicas)",
+            registry=reg,
+        )
+        self.plan_predicted_step = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_plan_predicted_step_seconds",
+            "Analytical-model step-time prediction for the reference "
+            "workload on one 2x2x1 block of the generation — the "
+            "what-if engine's live calibration surface (series retire "
+            "when the generation leaves the fleet)",
+            ["generation"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

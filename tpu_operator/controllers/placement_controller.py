@@ -52,6 +52,34 @@ QUEUE_REQUEST = Request(name="placement-queue")
 SLICE_POOL_INDEX = "by-pool"
 
 
+def clear_assignment_labels(client: Client, node_names) -> int:
+    """Tear nodes out of their gang by clearing the assignment labels
+    (``engine.assignment_clear_delta`` — the one spelling every
+    teardown path shares): the job controller's checkpoint-barrier
+    teardown and the defrag controller's drain-then-re-place both call
+    here. Returns how many nodes no longer carry an assignment; the
+    first real ApiError stops the sweep. A PARTIAL clear is safe (the
+    engine reads it as a broken gang and finishes the teardown next
+    pass) but ZERO progress is a failure the caller must not book as
+    an executed migration. A vanished node counts as cleared — it
+    holds no assignment anymore."""
+    from tpu_operator.placement.engine import assignment_clear_delta
+
+    delta = assignment_clear_delta()
+    cleared = 0
+    for node in node_names:
+        try:
+            client.patch("v1", "Node", node, {"metadata": {"labels": delta}})
+        except errors.NotFound:
+            cleared += 1
+            continue
+        except errors.ApiError as e:
+            log.debug("assignment clear on %s failed: %s", node, e)
+            return cleared
+        cleared += 1
+    return cleared
+
+
 def slice_pool_index(obj: ObjectDict) -> List[str]:
     """Informer index fn: the pools a TPUSlice is pinned or last
     scheduled to."""

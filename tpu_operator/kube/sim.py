@@ -580,6 +580,69 @@ class GangFaultSchedule:
                 pass
 
 
+class GangChurnSchedule:
+    """Seeded mixed-shape gang churn: the arrival half of the fleet
+    simulator (``tpu_operator/planning/sim.py``). Each tick draws gang
+    arrivals from a weighted shape mix with seeded lifetimes — a fleet's
+    worth of training jobs and serving replicas coming and going.
+    Deterministic the same way :class:`GangFaultSchedule` and
+    :class:`DiurnalTraffic` are: the whole log is drawn at construction
+    (same seed → same arrival log, regardless of how the consumer
+    drives it), readable as ``self.log``.
+
+    ``shapes`` is a list of ((x, y, z), weight) pairs; lifetimes are
+    uniform in [min_lifetime, max_lifetime] ticks from placement (a
+    gang's capacity frees when its work finishes, not when it arrives).
+    """
+
+    DEFAULT_SHAPES = (
+        ((2, 2, 1), 4.0),   # small fine-tune / serving replica
+        ((2, 2, 2), 3.0),   # one-cube training job
+        ((4, 2, 2), 2.0),   # mid-size job
+        ((4, 4, 2), 1.0),   # large job
+        ((4, 4, 4), 0.5),   # the pod-scale gang defrag exists for
+    )
+
+    def __init__(
+        self,
+        seed: int = 0,
+        ticks: int = 200,
+        arrivals_per_tick: float = 0.5,
+        shapes=DEFAULT_SHAPES,
+        min_lifetime: int = 20,
+        max_lifetime: int = 80,
+        priority_levels: int = 2,
+    ):
+        self.seed = seed
+        self.ticks = ticks
+        rng = random.Random(seed)
+        weights = [w for _, w in shapes]
+        self.log: list = []  # (tick, name, shape, priority, lifetime)
+        serial = 0
+        for tick in range(ticks):
+            whole = int(arrivals_per_tick)
+            count = whole + (
+                1 if rng.random() < (arrivals_per_tick - whole) else 0
+            )
+            for _ in range(count):
+                shape = rng.choices([s for s, _ in shapes], weights=weights)[0]
+                lifetime = rng.randint(min_lifetime, max_lifetime)
+                priority = rng.randrange(max(1, priority_levels))
+                self.log.append(
+                    (tick, f"gang-{serial}", tuple(shape), priority, lifetime)
+                )
+                serial += 1
+
+    def arrivals(self, tick: int) -> list:
+        """The gangs arriving at ``tick``: (name, shape, priority,
+        lifetime) tuples. Pure read over the pre-drawn log."""
+        return [
+            (name, shape, priority, lifetime)
+            for t, name, shape, priority, lifetime in self.log
+            if t == tick
+        ]
+
+
 class DiurnalTraffic:
     """Seeded request-arrival schedule: the demand half of the serving
     drill. A diurnal sinusoid between ``base_rps`` and ``peak_rps``

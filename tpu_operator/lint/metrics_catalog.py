@@ -228,7 +228,10 @@ def analyze_rule_hygiene(
 # ``node`` (a node-local exporter's own name) are fixed for the life of
 # the process and die with it.
 DYNAMIC_LABEL_DIMENSIONS = frozenset(
-    {"slice", "pool", "edge", "chip", "probe", "gang", "shard", "job", "serving"}
+    {
+        "slice", "pool", "edge", "chip", "probe", "gang", "shard", "job",
+        "serving", "generation",
+    }
 )
 
 

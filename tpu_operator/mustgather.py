@@ -249,6 +249,83 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("serving.txt", f"# collection failed: {e}\n")
 
     try:
+        # the capacity-planning view: per-pool fragmentation/utilization
+        # (the defrag controller's own replay), the last defrag
+        # decisions with predicted-vs-realized deltas, and the what-if
+        # engine's admission answer for every queued shape — where "when
+        # will my gang land / what did defrag actually buy us" starts
+        import json as _json
+
+        from tpu_operator import consts as _consts
+        from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
+        from tpu_operator.placement.engine import PlacementEngine
+        from tpu_operator.planning.whatif import admission_answer, queued_shapes
+
+        slices = client.list(TPU_SLICE_API_VERSION, "TPUSlice")
+        nodes = client.list("v1", "Node")
+        try:
+            # recorded link cuts are a placement input: answering "now"
+            # for a block straddling one would contradict the CLI and
+            # the engine itself
+            links = degraded_link_pairs(client, namespace)
+        except errors.ApiError:
+            links = []
+        engine = PlacementEngine(slices, nodes, degraded_links=links)
+        plan = engine.plan()
+        lines = ["# pools"]
+        for pool_name in sorted(engine.pools):
+            _, torus = engine.pools[pool_name]
+            lines.append(
+                f"{pool_name}  fragmentation={plan.fragmentation.get(pool_name, 0.0)}  "
+                f"utilization={torus.utilization()}  "
+                f"free={torus.free_count()}/{torus.in_service_count()}"
+            )
+        lines.append("")
+        lines.append("# defrag decisions (newest last; predicted vs realized)")
+        state_cm = client.get_or_none(
+            "v1", "ConfigMap", _consts.DEFRAG_STATE_CONFIGMAP, namespace
+        )
+        raw = ((state_cm or {}).get("data") or {}).get(_consts.DEFRAG_STATE_KEY)
+        decisions = []
+        if raw:
+            try:
+                decisions = (_json.loads(raw) or {}).get("decisions") or []
+            except ValueError:
+                lines.append("# state.json malformed")
+        for d in decisions[-_consts.DEFRAG_DECISIONS_LIMIT:]:
+            realized = d.get("realized_frag")
+            lines.append(
+                f"{d.get('slice', '?')}  owner={d.get('owner_kind', '?')}/"
+                f"{d.get('owner_name', '?')}  pool={d.get('pool', '?')}  "
+                f"block {d.get('source_origin') or '?'} -> "
+                f"{d.get('dest_origin') or d.get('predicted_dest_origin') or '?'}  "
+                f"frag {d.get('frag_before')} -> predicted "
+                f"{d.get('predicted_frag')} / realized "
+                f"{'(abandoned)' if d.get('abandoned') else realized if realized is not None else '(in flight)'}"
+                + (f"  seats={','.join(d.get('lands_pending') or [])}"
+                   if d.get("lands_pending") else "")
+            )
+        if not decisions:
+            lines.append("# none")
+        lines.append("")
+        lines.append("# admission what-ifs for queued shapes")
+        queued = queued_shapes(slices)
+        for name, shape in sorted(queued.items()):
+            answer = admission_answer(
+                slices, nodes, shape, degraded_links=links, for_slice=name
+            )
+            lines.append(
+                f"{name}  shape={shape}  answer={answer['answer']}  "
+                f"migrations={answer['migrations']}  "
+                f"eta={answer['eta_seconds']}  {answer['detail']}"
+            )
+        if not queued:
+            lines.append("# none queued")
+        emit("plan.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("plan.txt", f"# collection failed: {e}\n")
+
+    try:
         # the data-plane telemetry view: fleet rollup (per-node perf
         # labels + generation/chips), the operator-published floor
         # table, and every gang's step-time artifact — where "why is

@@ -47,6 +47,24 @@ from tpu_operator.placement.torus import (
 
 PLACEMENT_MANAGER = "tpu-placement"
 
+# the label triple that IS a gang assignment (the engine's source of
+# truth). Every teardown path — the engine's own clears, the job
+# controller's checkpoint-barrier teardown, the defrag controller's
+# drain-then-re-place, the replay helper's virtual strip — derives from
+# this one tuple, so adding an assignment label can never leave one
+# path half-stripping gangs.
+ASSIGNMENT_LABELS: Tuple[str, ...] = (
+    consts.PLACEMENT_LABEL,
+    consts.PLACEMENT_INDEX_LABEL,
+    consts.PLACEMENT_TOPOLOGY_LABEL,
+)
+
+
+def assignment_clear_delta() -> Dict[str, Optional[str]]:
+    """The labels-only merge-patch delta that tears one node out of its
+    gang (None values clear)."""
+    return {label: None for label in ASSIGNMENT_LABELS}
+
 
 class PlacementPhase:
     QUEUED = "Queued"
@@ -225,6 +243,58 @@ def largest_placeable_shape(
     return None
 
 
+def strip_assignments(
+    nodes: Sequence[ObjectDict], owners: Sequence[str]
+) -> List[ObjectDict]:
+    """Copies of ``nodes`` with the assignment labels of ``owners``
+    cleared — the world after those gangs are torn down but before the
+    engine re-places anything. Only metadata.labels is copied; the rest
+    of each node object is shared (the engine reads, never writes)."""
+    drop = set(owners)
+    out: List[ObjectDict] = []
+    for node in nodes:
+        labels = node["metadata"].get("labels") or {}
+        if labels.get(consts.PLACEMENT_LABEL) not in drop:
+            out.append(node)
+            continue
+        stripped = {k: v for k, v in labels.items() if k not in ASSIGNMENT_LABELS}
+        copy = dict(node)
+        copy["metadata"] = dict(node["metadata"])
+        copy["metadata"]["labels"] = stripped
+        out.append(copy)
+    return out
+
+
+def replay_minus_candidate(
+    slices: Sequence[ObjectDict],
+    nodes: Sequence[ObjectDict],
+    candidate: str,
+    migrate: bool = False,
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Plan:
+    """THE replay-minus-candidate primitive every victim/migration score
+    derives from, factored once so the serving controller's scale-down
+    math and the defrag proposer can never diverge. Replays the engine
+    over a world without the candidate's current assignment:
+
+    - ``migrate=False`` (scale-down semantics): the candidate slice is
+      gone entirely — its cells free up and nothing re-places it.
+    - ``migrate=True`` (defrag semantics): the candidate keeps its
+      placement request but loses its current assignment labels, so the
+      replay re-admits it and the plan shows where the NEXT placement
+      pass would seat it — the post-migration world.
+
+    Either way pending requests re-admit into the freed space (the same
+    see-the-next-pass convention as :func:`largest_placeable_shape`)."""
+    if migrate:
+        kept = list(slices)
+        world = strip_assignments(nodes, [candidate])
+    else:
+        kept = [s for s in slices if s["metadata"]["name"] != candidate]
+        world = list(nodes)
+    return PlacementEngine(kept, world, degraded_links=degraded_links).plan()
+
+
 def scale_down_scores(
     slices: Sequence[ObjectDict],
     nodes: Sequence[ObjectDict],
@@ -233,35 +303,123 @@ def scale_down_scores(
 ) -> Dict[str, Tuple[float, float]]:
     """Fragmentation impact of removing each candidate slice: candidate
     name -> (frag_after, frag_delta) for the pool the candidate's gang
-    occupies, with the engine replayed minus that candidate — the same
-    see-the-next-pass convention as :func:`largest_placeable_shape`, so
-    pending requests re-admitted into the freed block count. Candidates
+    occupies, with the engine replayed minus that candidate
+    (:func:`replay_minus_candidate`, ``migrate=False``). Candidates
     not currently placed score (-1.0, -1.0): deleting an unplaced
     replica frees a queue slot and cannot fragment anything, so it is
     always the cheapest victim."""
     base_engine = PlacementEngine(slices, nodes, degraded_links=degraded_links)
     base_plan = base_engine.plan()
-    pool_of: Dict[str, str] = {}
-    for name in candidates:
-        status = base_plan.statuses.get(name)
-        if status is None:
-            # intact gangs keep their status only when re-derived; fall
-            # back to the object's own status block
-            obj = base_engine.slices.get(name) or {}
-            status = (obj.get("status") or {}).get("placement") or {}
-        if status.get("phase") == PlacementPhase.SCHEDULED and status.get("pool"):
-            pool_of[name] = str(status["pool"])
+    pool_of = _scheduled_pools(base_engine, base_plan, candidates)
     scores: Dict[str, Tuple[float, float]] = {}
     for name in candidates:
         pool = pool_of.get(name)
         if pool is None:
             scores[name] = (-1.0, -1.0)
             continue
-        kept = [s for s in slices if s["metadata"]["name"] != name]
-        plan = PlacementEngine(kept, nodes, degraded_links=degraded_links).plan()
+        plan = replay_minus_candidate(
+            slices, nodes, name, migrate=False, degraded_links=degraded_links
+        )
         after = plan.fragmentation.get(pool, 0.0)
         scores[name] = (after, round(after - base_plan.fragmentation.get(pool, 0.0), 4))
     return scores
+
+
+def _scheduled_pools(
+    base_engine: "PlacementEngine", base_plan: Plan, candidates: Sequence[str]
+) -> Dict[str, str]:
+    """candidate -> pool for the candidates the base replay ranks
+    currently Scheduled (falling back to the object's own status block
+    for intact gangs the replay didn't re-derive)."""
+    pool_of: Dict[str, str] = {}
+    for name in candidates:
+        status = base_plan.statuses.get(name)
+        if status is None:
+            obj = base_engine.slices.get(name) or {}
+            status = (obj.get("status") or {}).get("placement") or {}
+        if status.get("phase") == PlacementPhase.SCHEDULED and status.get("pool"):
+            pool_of[name] = str(status["pool"])
+    return pool_of
+
+
+def migration_scores(
+    slices: Sequence[ObjectDict],
+    nodes: Sequence[ObjectDict],
+    candidates: Sequence[str],
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+) -> Dict[str, dict]:
+    """Defrag proposer scoring: for each currently-placed candidate,
+    what the world looks like after migrating it — its assignment
+    stripped and the engine replayed (:func:`replay_minus_candidate`,
+    ``migrate=True``), so the candidate re-places by the allocator's own
+    ranking and every pending request re-admits into the freed space.
+    candidate name -> {pool (the SOURCE pool — frag_before/after/delta
+    are all scored there, since the freed space that consolidates is
+    the source's; a cross-pool re-seat must not difference two pools'
+    unrelated numbers), dest_pool, frag_before, frag_after, frag_delta,
+    lands_pending (names of previously-unplaced requests the replay now
+    seats), nodes (the re-placed gang's member list), origin}.
+    Candidates the base replay does not rank Scheduled, or whose replay
+    fails to re-seat them, are omitted — a migration that loses the gang
+    is not a proposal."""
+    base_engine = PlacementEngine(slices, nodes, degraded_links=degraded_links)
+    base_plan = base_engine.plan()
+    pool_of = _scheduled_pools(base_engine, base_plan, candidates)
+    unplaced_before = {
+        name for name, status in base_plan.statuses.items()
+        if status.get("phase") in (PlacementPhase.QUEUED, PlacementPhase.UNSCHEDULABLE)
+    }
+    scores: Dict[str, dict] = {}
+    for name in candidates:
+        pool = pool_of.get(name)
+        if pool is None:
+            continue
+        plan = replay_minus_candidate(
+            slices, nodes, name, migrate=True, degraded_links=degraded_links
+        )
+        status = plan.statuses.get(name) or {}
+        if status.get("phase") != PlacementPhase.SCHEDULED:
+            continue  # the replay could not re-seat the gang: never propose
+        after = plan.fragmentation.get(pool, 0.0)
+        before = base_plan.fragmentation.get(pool, 0.0)
+        scores[name] = {
+            "pool": pool,
+            "dest_pool": str(status.get("pool") or pool),
+            "frag_before": before,
+            "frag_after": after,
+            "frag_delta": round(after - before, 4),
+            "lands_pending": sorted(
+                n for n in unplaced_before
+                if (plan.statuses.get(n) or {}).get("phase") == PlacementPhase.SCHEDULED
+            ),
+            "nodes": list(status.get("nodes") or []),
+            "origin": str(status.get("origin") or ""),
+        }
+    return scores
+
+
+def pick_migration(scores: Dict[str, dict]) -> Optional[str]:
+    """The defrag selection rule over :func:`migration_scores` output,
+    factored out beside :func:`pick_scale_down_victim` for the same
+    reason — one place, no divergence: a migration that seats a
+    previously-unplaceable request wins outright (most pending landings
+    first), then the largest fragmentation reduction, then name for
+    determinism. Returns None when nothing improves."""
+    improving = {
+        name: entry for name, entry in scores.items()
+        if entry["lands_pending"] or entry["frag_delta"] < 0.0
+    }
+    if not improving:
+        return None
+    return min(
+        improving,
+        key=lambda n: (
+            -len(improving[n]["lands_pending"]),
+            improving[n]["frag_delta"],
+            improving[n]["frag_after"],
+            n,
+        ),
+    )
 
 
 def pick_scale_down_victim(scores: Dict[str, Tuple[float, float]]) -> Optional[str]:
@@ -297,7 +455,13 @@ class PlacementEngine:
         slices: Sequence[ObjectDict],
         nodes: Sequence[ObjectDict],
         degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+        scorer=None,
     ):
+        # optional placement-policy hook threaded into every clean-fit
+        # find_block call (torus.find_block's scorer slot) — the fleet
+        # simulator's defrag-aware policy rides it; None keeps the
+        # allocator's stock best-fit ranking
+        self.scorer = scorer
         self.slices = {s["metadata"]["name"]: s for s in slices}
         self.nodes = {n["metadata"]["name"]: n for n in nodes}
         self.requests: Dict[str, PlacementRequest] = {}
@@ -456,7 +620,7 @@ class PlacementEngine:
         best = None
         for pool_name in pools:
             _, torus = self.pools[pool_name]
-            found = torus.find_block(shape)
+            found = torus.find_block(shape, scorer=self.scorer)
             if found is None:
                 continue
             block, _ = found

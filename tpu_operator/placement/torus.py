@@ -256,6 +256,23 @@ class Torus:
     def free_count(self) -> int:
         return sum(1 for cell in self.node_at if self._free(cell))
 
+    def in_service_count(self) -> int:
+        """Hosts the allocator could ever place on: registered cells the
+        health subsystem has not taken out of service."""
+        return sum(1 for cell in self.node_at if cell not in self._unavailable)
+
+    def utilization(self) -> float:
+        """Occupied fraction of the pool's in-service capacity — the
+        ``tpu_operator_fleet_utilization{pool}`` series. Out-of-service
+        hosts are subtracted from the denominator (capacity the fleet
+        cannot deliver is not capacity going idle); an empty or fully
+        quarantined pool reads 0.0."""
+        in_service = self.in_service_count()
+        if in_service == 0:
+            return 0.0
+        occupied = sum(1 for cell in self._owner if cell not in self._unavailable)
+        return round(occupied / in_service, 4)
+
     # -- allocation ----------------------------------------------------------
 
     def _wrap(self, cell: Coord) -> Coord:
@@ -302,11 +319,14 @@ class Torus:
             for oriented in self.orientations(shape)
         )
 
-    def exposure(self, cells: Sequence[Coord]) -> int:
+    def exposure(self, cells: Sequence[Coord], cap: Optional[int] = None) -> int:
         """Free cells adjacent (6-neighbor, wraparound) to the block but
         outside it — the new free surface this placement would create.
         Lower is snugger: flush against occupied/unavailable cells or
-        closing a pocket, which is what keeps big contiguous runs alive."""
+        closing a pocket, which is what keeps big contiguous runs alive.
+        ``cap`` is the allocator's pruning hook: once the count exceeds
+        it the candidate has already lost, so the walk stops and any
+        value > cap is returned (exactness only matters below the cap)."""
         block = set(cells)
         touched: Set[Coord] = set()
         for cell in block:
@@ -314,26 +334,39 @@ class Torus:
                 at = self._wrap((cell[0] + step[0], cell[1] + step[1], cell[2] + step[2]))
                 if at not in block and self._free(at):
                     touched.add(at)
+            if cap is not None and len(touched) > cap:
+                return len(touched)
         return len(touched)
 
     def find_block(
         self,
         shape: Coord,
         victim_ok: Optional[Callable[[str], bool]] = None,
+        scorer: Optional[Callable[[Coord, Coord, Tuple[Coord, ...]], float]] = None,
     ) -> Optional[Tuple[Block, FrozenSet[str]]]:
         """Best placement for ``shape``: tries every orientation at every
         origin, requiring each covered cell to be free — or, when
         ``victim_ok`` is given, occupied by an owner it accepts (the
         preemption path). Ranking: fewest victims, then fewest victim
         cells (evicting a 2x2x2 beats evicting a 4x4x4), then least free
-        exposure, then (origin, orientation) for determinism. Returns
-        ``(block, victims)`` or None; ``victims`` is empty on a clean fit."""
+        exposure, then (origin, orientation) for determinism. ``scorer``
+        (the policy hook the capacity planner's defrag-aware scoring
+        rides) ranks between victim cells and exposure — a candidate a
+        scorer prefers wins even at worse exposure, but never at the
+        cost of extra preemption. Returns ``(block, victims)`` or None;
+        ``victims`` is empty on a clean fit."""
         best = None
         best_key = None
         origins = sorted(self.node_at)
         cells_of = Counter(self._owner.values())  # owner -> occupied cells
         for shape_idx, oriented in enumerate(self.orientations(shape)):
             for origin in origins:
+                if victim_ok is None and not self._free(origin):
+                    # clean-fit fast path: the origin is always a member
+                    # cell, so an occupied origin kills the candidate
+                    # before the full cell walk (what keeps the 4096-host
+                    # fleet sim's per-placement cost bounded)
+                    continue
                 if not self.wrap and any(
                     origin[i] + oriented[i] > self.dims[i] for i in range(3)
                 ):
@@ -360,13 +393,41 @@ class Torus:
                 # already loses against the current best
                 if best_key is not None and (len(victims), victim_cells) > best_key[:2]:
                     continue
-                key = (len(victims), victim_cells, self.exposure(cells), origin, shape_idx)
+                policy = scorer(origin, oriented, cells) if scorer is not None else 0.0
+                if best_key is not None and (len(victims), victim_cells, policy) > best_key[:3]:
+                    continue  # lost before the expensive exposure walk
+                # when the cheap prefix TIES the best, exposure decides —
+                # and only values at or below the best's can win, so the
+                # walk may stop early past that cap
+                cap = (
+                    best_key[3]
+                    if best_key is not None
+                    and (len(victims), victim_cells, policy) == best_key[:3]
+                    else None
+                )
+                exposure = self.exposure(cells, cap=cap)
+                key = (len(victims), victim_cells, policy, exposure, origin, shape_idx)
                 if best_key is None or key < best_key:
                     best_key = key
-                    best = (Block(origin, oriented, cells, key[2]), frozenset(victims))
-                    if key[:3] == (0, 0, 0):
+                    best = (Block(origin, oriented, cells, exposure), frozenset(victims))
+                    if scorer is None and key[:4] == (0, 0, 0.0, 0):
                         return best  # a perfectly snug clean fit can't be beaten
         return best
+
+    def pack_scorer(self) -> Callable[[Coord, Coord, Tuple[Coord, ...]], float]:
+        """The defrag-aware policy scorer: prefer placements packed
+        toward the origin corner (Chebyshev distance of the block's
+        farthest unwrapped extent). Best-fit's exposure ranking keeps
+        blocks snug against *each other*; corner packing additionally
+        keeps the free space consolidated at one end of the torus, which
+        is what holds a large contiguous run open for the next big gang.
+        Returned as a closure so callers can hand it straight to
+        ``find_block(scorer=...)``."""
+
+        def score(origin: Coord, oriented: Coord, _cells) -> float:
+            return float(max(origin[i] + oriented[i] for i in range(3)))
+
+        return score
 
     # -- scoring -------------------------------------------------------------
 

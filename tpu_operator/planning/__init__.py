@@ -1,0 +1,23 @@
+"""Capacity planning: the analytical what-if layer over the fleet.
+
+Three connected pieces (ROADMAP item 4):
+
+- :mod:`tpu_operator.planning.model` — a SCALE-Sim-style roofline
+  predictor: workload descriptor + (generation, topology) placement →
+  predicted step time, calibrated from the measured roofs
+  (``tpu_operator/perf.py``), the autotune sweep winners, and the PR 8
+  per-axis ICI latency matrices.
+- :mod:`tpu_operator.planning.sim` — a fleet simulator replaying a
+  seeded queue of mixed-shape gangs against candidate placement
+  policies (best-fit vs defrag-aware), reporting utilization and
+  p50/p99 time-to-place at 4096 sim hosts under churn.
+- :mod:`tpu_operator.planning.whatif` — admission what-ifs ("can this
+  8x8x8 gang land within N minutes?") answered by replaying the real
+  engine plus the defrag proposer's migration budget.
+
+Everything here is PURE — no client calls, no jax: the inputs are
+object lists and recorded artifacts, so the same code runs in the
+defrag controller, `tpuop-cfg plan`, must-gather, bench, and tests.
+The execution side (actually migrating gangs) lives in
+``controllers/defrag_controller.py``.
+"""

@@ -1,0 +1,311 @@
+"""Analytical step-time model: roofline + per-axis collective terms.
+
+A SCALE-Sim-style predictor (PAPERS.md: "SCALE-Sim TPU: Validating and
+Extending SCALE-Sim for TPUs"): given a workload descriptor (FLOPs per
+step, HBM bytes per step, collective bytes per torus axis) and a
+(generation, topology) placement, predict the step time from the
+calibrated roofs —
+
+- compute term:    FLOPs / (chips × matmul roof), the MXU roof the
+  autotune sweep measured for the generation (falling back to
+  ``perf.measured_roofs()``'s table: v5e's real 185 bf16 TFLOP/s,
+  measured-fraction-scaled published peaks elsewhere);
+- memory term:     bytes / (chips × triad roof), the 665 GB/s-class
+  pallas-triad bandwidth the same table carries;
+- collective term: per torus axis, a ring-allreduce bandwidth model
+  (2(n-1)/n × bytes / link bandwidth) with the measured per-axis
+  latency from a PR 8 gang fabric artifact as the floor when one is
+  supplied — a degraded axis predicts slow because it *measured* slow.
+
+``step = max(compute, memory) + Σ collective`` — the roofline overlap
+assumption (compute hides memory or vice versa; collectives modeled
+unoverlapped, which makes predictions conservative for workloads
+without comms/compute overlap and a stated-tolerance estimate for
+those with).
+
+Input hardening mirrors the ``perf.floors_for`` contract: malformed or
+absent autotune winners, empty fabric matrices, and unknown
+generations all fall back to the static roof table — the model NEVER
+raises on bad calibration inputs, it degrades to the table and records
+which fallbacks it took (``StepPrediction.fallbacks``).
+
+Validation: ``effective_compute_roof`` derives an achieved-rate roof
+from a recorded step-time artifact, so the CPU-sim series can be
+calibrated-then-predicted (``CPU_SIM_TOLERANCE_FACTOR``); the tighter
+``TPU_TOLERANCE_FACTOR`` gate is reserved for real accelerators, the
+same only-binds-on-TPU convention as PR 13's shrink-ratio gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+from tpu_operator.perf import measured_roofs
+
+# Per-link, per-direction ICI bandwidth (GB/s) by generation — published
+# interconnect numbers scaled by the same measured-fraction discipline
+# perf.py applies to HBM. These seed the collective term until a gang
+# fabric artifact supplies measured per-axis latencies.
+DEFAULT_ICI_GBPS = {"v4": 45.0, "v5e": 40.0, "v5p": 90.0, "v6e": 90.0}
+
+# Per-hop ICI latency floor (seconds): even a zero-byte collective pays
+# a hop per ring step. Order-of-magnitude; the measured fabric artifact
+# replaces it whenever one is supplied.
+ICI_HOP_LATENCY_S = 1e-6
+
+# prediction-vs-measured tolerance: |log-ratio| bounded by these factors
+# (a 3.0 means predicted within [measured/3, measured×3]). The CPU sim
+# multiplexes virtual devices onto host cores, so only the wide gate
+# binds there; the tight one is reserved for real TPU runs.
+CPU_SIM_TOLERANCE_FACTOR = 3.0
+TPU_TOLERANCE_FACTOR = 1.5
+
+# the generation whose roofs are real measurements — the fallback row
+# for unknown generations (conservative: the smallest measured roof)
+_FALLBACK_GENERATION = "v5e"
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadDescriptor:
+    """What one training/serving step costs, placement-independent.
+
+    ``collective_bytes_per_axis`` is the payload each step moves over
+    each torus axis of the placement (x, y, z) — e.g. a data-parallel
+    gradient allreduce sharded over the x axis puts its 2×params×dtype
+    bytes there and zero on y/z. Axes the placement doesn't have (unit
+    dims) contribute nothing regardless of the descriptor."""
+
+    name: str
+    flops_per_step: float
+    bytes_per_step: float = 0.0
+    collective_bytes_per_axis: Tuple[float, float, float] = (0.0, 0.0, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepPrediction:
+    step_seconds: float
+    compute_seconds: float
+    memory_seconds: float
+    collective_seconds: float
+    bound: str  # "compute" | "memory" | "collective"
+    generation: str
+    hosts: int
+    chips: int
+    roofs: Dict[str, float]
+    fallbacks: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        for key in ("step_seconds", "compute_seconds", "memory_seconds",
+                    "collective_seconds"):
+            out[key] = round(out[key], 9)
+        out["fallbacks"] = list(self.fallbacks)
+        return out
+
+
+def _positive(value, default: float = 0.0) -> float:
+    """Coerce an untrusted calibration number; anything non-numeric or
+    non-positive reads as ``default`` (the never-raise contract)."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return default
+    return v if v > 0.0 else default
+
+
+def generation_roofs(
+    generation: str,
+    autotune_entries: Optional[dict] = None,
+) -> Tuple[Dict[str, float], Tuple[str, ...]]:
+    """The calibrated roofs for one generation: the static measured
+    table, with the autotune sweep's TPU-measured matmul winner folded
+    in when a valid one exists (the same platform=="tpu" discipline as
+    ``workloads.autotune.merge_winner_floors`` — a CPU/interpret sweep
+    entry publishes configs, never roofs). Returns (roofs, fallbacks):
+    every degraded input is recorded, never raised."""
+    fallbacks = []
+    table = measured_roofs()
+    entry = table.get(generation)
+    if entry is None:
+        fallbacks.append(f"unknown-generation:{generation or '?'}")
+        entry = table[_FALLBACK_GENERATION]
+    roofs = dict(entry)
+    roofs["ici_gbps"] = DEFAULT_ICI_GBPS.get(
+        generation, DEFAULT_ICI_GBPS[_FALLBACK_GENERATION]
+    )
+    if autotune_entries is not None:
+        if not isinstance(autotune_entries, dict):
+            fallbacks.append("malformed-autotune-entries")
+        else:
+            tuned = autotune_entries.get(generation)
+            if tuned is not None:
+                measured = _tuned_matmul_roof(tuned)
+                if measured is None:
+                    fallbacks.append(f"unusable-autotune-entry:{generation}")
+                else:
+                    roofs["matmul_tflops"] = measured
+    return roofs, tuple(fallbacks)
+
+
+def _tuned_matmul_roof(entry) -> Optional[float]:
+    """The TPU-measured matmul roof from one cached sweep entry, or
+    None when the entry is malformed / not TPU-measured (half-written
+    blobs, interpret-mode sweeps)."""
+    if not isinstance(entry, dict) or entry.get("platform") != "tpu":
+        return None
+    try:
+        from tpu_operator.workloads.autotune import _best_rate
+
+        best = _best_rate(entry, "matmul")
+    except Exception:  # the never-raise contract: a torn blob is a miss
+        return None
+    return _positive(best, 0.0) or None
+
+
+def _axis_latency_floor(
+    fabric_artifact: Optional[dict], axis: str
+) -> Optional[float]:
+    """The measured per-axis allreduce latency (seconds) from a PR 8
+    gang fabric artifact, or None when absent/malformed — an empty
+    matrix is a calibration gap, not an error."""
+    if not isinstance(fabric_artifact, dict):
+        return None
+    matrix = fabric_artifact.get("axis_allreduce_us")
+    if not isinstance(matrix, dict):
+        return None
+    micros = _positive(matrix.get(axis), 0.0)
+    return micros * 1e-6 if micros > 0.0 else None
+
+
+def predict_step_time(
+    descriptor: WorkloadDescriptor,
+    generation: str,
+    shape: Tuple[int, int, int],
+    chips_per_host: int = 4,
+    autotune_entries: Optional[dict] = None,
+    fabric_artifact: Optional[dict] = None,
+    roofs: Optional[Dict[str, float]] = None,
+) -> StepPrediction:
+    """Predict one step's wall time for ``descriptor`` placed as a
+    ``shape`` host block of ``generation``. ``roofs`` overrides the
+    whole calibration (the calibrate-then-predict path); otherwise the
+    table + autotune winners supply it. Never raises on malformed
+    calibration inputs — degraded inputs append to ``fallbacks``."""
+    fallbacks: Tuple[str, ...] = ()
+    if roofs is None:
+        roofs, fallbacks = generation_roofs(generation, autotune_entries)
+    hosts = max(1, int(shape[0]) * int(shape[1]) * int(shape[2]))
+    chips = hosts * max(1, chips_per_host)
+
+    matmul = _positive(roofs.get("matmul_tflops"), 1.0)
+    triad = _positive(roofs.get("triad_gbps"), 1.0)
+    ici = _positive(roofs.get("ici_gbps"), DEFAULT_ICI_GBPS[_FALLBACK_GENERATION])
+
+    compute_s = _positive(descriptor.flops_per_step) / (chips * matmul * 1e12)
+    memory_s = _positive(descriptor.bytes_per_step) / (chips * triad * 1e9)
+
+    collective_s = 0.0
+    axes = ("x", "y", "z")
+    per_axis = tuple(descriptor.collective_bytes_per_axis or (0.0, 0.0, 0.0))[:3]
+    per_axis = per_axis + (0.0,) * (3 - len(per_axis))
+    for i, axis in enumerate(axes):
+        n = max(1, int(shape[i]))
+        payload = _positive(per_axis[i])
+        if n <= 1 or payload <= 0.0:
+            continue
+        # ring allreduce over the axis: 2(n-1)/n of the payload crosses
+        # each link, plus a per-ring-step hop latency
+        bw_term = (2.0 * (n - 1) / n) * payload / (ici * 1e9)
+        hop_term = 2.0 * (n - 1) * ICI_HOP_LATENCY_S
+        axis_s = bw_term + hop_term
+        measured = _axis_latency_floor(fabric_artifact, axis)
+        if measured is not None:
+            # the artifact measured this axis's allreduce directly (for
+            # its probe payload): a degraded axis measures SLOW, and the
+            # floor carries that into the prediction
+            axis_s = max(axis_s, measured)
+        collective_s += axis_s
+
+    step_s = max(compute_s, memory_s) + collective_s
+    terms = {
+        "compute": compute_s, "memory": memory_s, "collective": collective_s,
+    }
+    bound = max(terms, key=lambda k: terms[k])
+    return StepPrediction(
+        step_seconds=step_s,
+        compute_seconds=compute_s,
+        memory_seconds=memory_s,
+        collective_seconds=collective_s,
+        bound=bound,
+        generation=generation,
+        hosts=hosts,
+        chips=chips,
+        roofs=dict(roofs),
+        fallbacks=fallbacks,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Calibrate-then-predict (the validation harness path).
+# ---------------------------------------------------------------------------
+
+
+def effective_compute_roof(
+    descriptor: WorkloadDescriptor,
+    measured_step_seconds: float,
+    hosts: int = 1,
+    chips_per_host: int = 1,
+) -> Optional[float]:
+    """The achieved TFLOP/s-per-chip a recorded step time implies for
+    ``descriptor`` — the calibration step that lets the model predict
+    OTHER placements of the same platform (on the CPU sim, the only
+    honest roof is the one the platform just demonstrated). None when
+    the measurement is unusable."""
+    step = _positive(measured_step_seconds)
+    flops = _positive(descriptor.flops_per_step)
+    if step <= 0.0 or flops <= 0.0:
+        return None
+    chips = max(1, hosts) * max(1, chips_per_host)
+    return flops / step / chips / 1e12
+
+
+def calibrated_roofs(
+    generation: str,
+    effective_matmul_tflops: Optional[float],
+    autotune_entries: Optional[dict] = None,
+) -> Dict[str, float]:
+    """The roof table with a measured effective compute roof folded in
+    — scale the memory/ICI roofs by the same achieved fraction so a
+    platform delivering 1% of the MXU roof (the CPU sim) doesn't
+    predict memory-bound for everything."""
+    roofs, _ = generation_roofs(generation, autotune_entries)
+    effective = _positive(effective_matmul_tflops, 0.0)
+    if effective > 0.0:
+        fraction = effective / roofs["matmul_tflops"]
+        roofs = {
+            "matmul_tflops": effective,
+            "triad_gbps": roofs["triad_gbps"] * fraction,
+            "ici_gbps": roofs["ici_gbps"] * fraction,
+        }
+    return roofs
+
+
+def validate_prediction(
+    predicted_seconds: float,
+    measured_seconds: float,
+    tolerance_factor: float = CPU_SIM_TOLERANCE_FACTOR,
+) -> dict:
+    """The acceptance predicate: prediction within ``tolerance_factor``
+    of the measurement in either direction. Degenerate inputs fail
+    closed (ok=False) rather than raising."""
+    predicted = _positive(predicted_seconds)
+    measured = _positive(measured_seconds)
+    if predicted <= 0.0 or measured <= 0.0:
+        return {"ok": False, "ratio": 0.0, "tolerance_factor": tolerance_factor}
+    ratio = predicted / measured
+    return {
+        "ok": (1.0 / tolerance_factor) <= ratio <= tolerance_factor,
+        "ratio": round(ratio, 4),
+        "tolerance_factor": tolerance_factor,
+    }

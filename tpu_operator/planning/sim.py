@@ -1,0 +1,269 @@
+"""Fleet simulator: seeded gang churn against candidate policies.
+
+Replays a :class:`~tpu_operator.kube.sim.GangChurnSchedule` (same
+seeded-schedule convention as the fault and traffic sims) against one
+pool's host torus under a placement policy:
+
+- ``best-fit``     — the production allocator exactly as the placement
+  engine runs it (victims/exposure ranking, no background work);
+- ``defrag-aware`` — the same allocator with the corner-packing scorer
+  (``Torus.pack_scorer``) threaded into every placement, plus the
+  defrag proposer's background migrations during idle ticks (queue
+  empty, budget + cooldown respected — the same safety rules the live
+  defrag controller enforces).
+
+The report carries what a fleet operator actually plans against:
+utilization %, p50/p99 time-to-place, preemption and migration counts.
+Deterministic: the schedule is pre-drawn and the simulator itself draws
+no randomness, so same seed → same report, bit for bit.
+
+Pure — no client, no jax. The torus here is the real allocator
+(``placement/torus.py``), not a model of it: a policy that wins here
+wins because the production search ranks it better.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator.placement.torus import Torus
+
+Coord = Tuple[int, int, int]
+
+# defrag knobs (sim-tick units; the live controller's wall-clock
+# equivalents live in consts.DEFRAG_*)
+DEFRAG_EVERY_TICKS = 4
+DEFRAG_CANDIDATES = 3  # most-exposed gangs evaluated per idle window
+
+
+@dataclasses.dataclass
+class _Gang:
+    name: str
+    shape: Coord
+    priority: int
+    lifetime: int
+    arrived: int
+    placed_at: Optional[int] = None
+    depart_at: Optional[int] = None
+    ever_placed: bool = False
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+class FleetSimulator:
+    """One pool's torus under churn. Drive with :meth:`run`, or tick
+    manually with :meth:`step` for scenario tests."""
+
+    def __init__(
+        self,
+        dims: Coord = (16, 16, 16),
+        wrap: bool = True,
+        policy: str = "best-fit",
+        tick_seconds: float = 1.0,
+        defrag_every: int = DEFRAG_EVERY_TICKS,
+        migration_cooldown_ticks: int = 8,
+        migration_budget: int = 1000,
+    ):
+        if policy not in ("best-fit", "defrag-aware"):
+            raise ValueError(f"unknown policy {policy!r}")
+        node_at = {}
+        index = 0
+        for z in range(dims[2]):
+            for y in range(dims[1]):
+                for x in range(dims[0]):
+                    node_at[(x, y, z)] = f"sim-{index}"
+                    index += 1
+        self.torus = Torus(dims, node_at, wrap=wrap)
+        self.policy = policy
+        self.tick_seconds = tick_seconds
+        self.defrag_every = max(1, defrag_every)
+        self.migration_cooldown_ticks = migration_cooldown_ticks
+        self.migration_budget = migration_budget
+        self._scorer = self.torus.pack_scorer() if policy == "defrag-aware" else None
+        self._gangs: Dict[str, _Gang] = {}
+        self._queue: List[str] = []  # names awaiting placement
+        self._tick = 0
+        self._last_migration_tick = -(10 ** 9)
+        self.migrations = 0
+        self.preemptions = 0
+        self._placements_total = 0
+        self._utilization_samples: List[float] = []
+        # seconds-from-arrival of every FIRST placement, recorded at
+        # place time so departed gangs keep counting toward the
+        # percentiles (a preempted gang's eventual re-place does not
+        # re-count — its user saw capacity at first placement)
+        self._waits: List[float] = []
+
+    # -- one tick ------------------------------------------------------------
+
+    def step(self, arrivals=()) -> None:
+        """Advance one tick: departures → arrivals → admission →
+        (defrag-aware only) background migration → utilization sample.
+        ``arrivals`` is the schedule's (name, shape, priority, lifetime)
+        list for this tick."""
+        tick = self._tick
+        for gang in list(self._gangs.values()):
+            if gang.depart_at is not None and gang.depart_at <= tick:
+                self.torus.release(gang.name)
+                del self._gangs[gang.name]
+        for name, shape, priority, lifetime in arrivals:
+            self._gangs[name] = _Gang(
+                name=name, shape=tuple(shape), priority=priority,
+                lifetime=lifetime, arrived=tick,
+            )
+            self._queue.append(name)
+        placed_before = self._placements_total
+        self._admit(tick)
+        # the live controller's idle rule: gangs the allocator CANNOT
+        # seat right now (the sim's Unschedulable analog) don't block
+        # defrag — they are its beneficiaries. Only a tick that actually
+        # placed something counts as placement-in-flight (a tick that
+        # both placed and drained the queue is still busy — the live
+        # busy gate forbids proposing during placement activity).
+        idle = self._placements_total == placed_before
+        if self.policy == "defrag-aware" and idle:
+            self._maybe_defrag(tick)
+        in_service = self.torus.in_service_count()
+        occupied = in_service - self.torus.free_count()
+        self._utilization_samples.append(occupied / in_service if in_service else 0.0)
+        self._tick = tick + 1
+
+    def _admit(self, tick: int) -> None:
+        """Priority-then-FIFO admission, the engine's own order; a
+        higher-priority gang that finds no clean fit preempts
+        strictly-lower-priority placements (minimal-victim ranking is
+        the allocator's)."""
+        self._queue.sort(
+            key=lambda n: (-self._gangs[n].priority, self._gangs[n].arrived, n)
+        )
+        remaining: List[str] = []
+        # a shape that found no block stays unplaceable until occupancy
+        # changes (placements only SHRINK free space; preemption both
+        # frees and takes, so any success clears the memo) — the memo
+        # keeps an oversaturated queue from re-scanning the full torus
+        # once per waiting gang per tick
+        failed: set = set()
+        for name in self._queue:
+            gang = self._gangs[name]
+            memo_key = (gang.shape, gang.priority)
+            if memo_key in failed:
+                remaining.append(name)
+                continue
+            found = self.torus.find_block(gang.shape, scorer=self._scorer)
+            victims: frozenset = frozenset()
+            if found is None and gang.priority > 0:
+                def victim_ok(owner: str) -> bool:
+                    other = self._gangs.get(owner)
+                    return other is not None and other.priority < gang.priority
+
+                found = self.torus.find_block(gang.shape, victim_ok=victim_ok)
+                victims = found[1] if found is not None else frozenset()
+            if found is None:
+                failed.add(memo_key)
+                remaining.append(name)
+                continue
+            failed.clear()
+            block, _ = found
+            for victim in sorted(victims):
+                self.torus.release(victim)
+                loser = self._gangs[victim]
+                loser.placed_at = None
+                loser.depart_at = None
+                remaining.append(victim)
+                self.preemptions += 1
+            self.torus.occupy(name, block.cells)
+            self._placements_total += 1
+            if not gang.ever_placed:
+                self._waits.append((tick - gang.arrived) * self.tick_seconds)
+                gang.ever_placed = True
+            gang.placed_at = tick
+            gang.depart_at = tick + gang.lifetime
+        self._queue = remaining
+
+    def _maybe_defrag(self, tick: int) -> None:
+        """One background migration, the proposer's sim analog: during
+        an idle window (empty queue — checked by the caller), evaluate
+        the most-exposed placed gangs and move the one whose re-placement
+        the packing scorer ranks strictly better. Budget + cooldown are
+        hard gates, exactly like the live controller's."""
+        if tick % self.defrag_every:
+            return
+        if self.migrations >= self.migration_budget:
+            return
+        if tick - self._last_migration_tick < self.migration_cooldown_ticks:
+            return
+        scored = []
+        for name in self.torus.owners():
+            cells = self.torus.owner_cells(name)
+            scored.append((self.torus.exposure(cells), name))
+        scored.sort(reverse=True)
+        scorer = self._scorer or self.torus.pack_scorer()
+        for _, name in scored[:DEFRAG_CANDIDATES]:
+            gang = self._gangs.get(name)
+            if gang is None:
+                continue
+            old_cells = self.torus.owner_cells(name)
+            old_score = (
+                max(max(c[i] for c in old_cells) + 1 for i in range(3)),
+                self.torus.exposure(old_cells),
+            )
+            self.torus.release(name)
+            found = self.torus.find_block(gang.shape, scorer=scorer)
+            if found is None:  # cannot happen (its own block is free) — restore
+                self.torus.occupy(name, old_cells)
+                continue
+            block, _ = found
+            new_score = (
+                max(block.origin[i] + block.shape[i] for i in range(3)),
+                self.torus.exposure(block.cells),
+            )
+            if new_score < old_score and tuple(block.cells) != tuple(old_cells):
+                self.torus.occupy(name, block.cells)
+                self.migrations += 1
+                self._last_migration_tick = tick
+                return
+            self.torus.occupy(name, old_cells)
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self, schedule, drain_ticks: int = 0) -> dict:
+        """Replay ``schedule`` (a GangChurnSchedule) end to end, plus
+        ``drain_ticks`` empty ticks so late arrivals get a fair chance
+        to place. Returns the fleet_sim report block."""
+        for tick in range(schedule.ticks + drain_ticks):
+            self.step(schedule.arrivals(tick) if tick < schedule.ticks else ())
+        waits = list(self._waits)
+        return {
+            "policy": self.policy,
+            "hosts": len(self.torus.node_at),
+            "gangs_arrived": len(schedule.log),
+            "gangs_placed": len(waits),
+            "gangs_waiting": len(self._queue),
+            "utilization_pct": round(
+                100.0 * sum(self._utilization_samples)
+                / max(1, len(self._utilization_samples)), 2,
+            ),
+            "time_to_place_p50_s": round(_percentile(waits, 0.50), 3),
+            "time_to_place_p99_s": round(_percentile(waits, 0.99), 3),
+            "preemptions": self.preemptions,
+            "migrations": self.migrations,
+            "fragmentation": self.torus.fragmentation(),
+        }
+
+
+def compare_policies(schedule_factory, dims: Coord = (16, 16, 16), **kwargs) -> dict:
+    """best-fit vs defrag-aware over the SAME schedule (the factory is
+    called once per policy so each replays an identical arrival log) —
+    the `tpuop-cfg plan` / BENCH fleet_sim comparison."""
+    out = {}
+    for policy in ("best-fit", "defrag-aware"):
+        sim = FleetSimulator(dims=dims, policy=policy, **kwargs)
+        out[policy] = sim.run(schedule_factory())
+    return out

@@ -1,0 +1,240 @@
+"""Admission what-ifs: "can this gang land, and what would it take?"
+
+Answers are replays of the REAL placement engine over the live object
+lists (the same see-the-next-pass convention every score in
+``placement/engine.py`` follows), extended by the defrag proposer's
+own migration math:
+
+1. replay the engine as-is — does the shape fit right now?
+2. if not, apply the best defrag migration
+   (``engine.migration_scores`` / ``pick_migration``) to a virtual
+   copy of the world and re-check, up to the controller's migration
+   budget — "lands after k migrations", with the ETA priced from the
+   defrag cooldown (each migration costs at least one cooldown).
+3. otherwise: does not land within the horizon.
+
+Pure — callers (the defrag controller, `tpuop-cfg plan`, must-gather)
+supply the object lists; nothing here talks to an apiserver. Degraded
+links are honored end to end: a replay can never answer "yes" with a
+block straddling a recorded cut.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_operator import consts
+from tpu_operator.placement.engine import (
+    PlacementEngine,
+    migration_scores,
+    pick_migration,
+    strip_assignments,
+)
+from tpu_operator.placement.torus import parse_shape
+
+
+def _fits_now(
+    slices,
+    nodes,
+    shape: Tuple[int, int, int],
+    pool: str,
+    degraded_links,
+    for_slice: Optional[str] = None,
+) -> Optional[str]:
+    """The pool a clean ``shape`` block fits in after replaying the
+    engine (pending admissions included), or None. ``for_slice`` asks
+    about an EXISTING request: the replay seats it itself, so the
+    answer is that slice's replayed status — searching for a *second*
+    free block of the same shape would double-count the capacity and
+    report "no" for a gang the very next pass would place."""
+    engine = PlacementEngine(slices, nodes, degraded_links=degraded_links)
+    plan = engine.plan()
+    if for_slice is not None:
+        status = plan.statuses.get(for_slice) or {}
+        if status.get("phase") == "Scheduled" and (
+            not pool or str(status.get("pool") or "") == pool
+        ):
+            return str(status.get("pool") or "")
+        return None
+    pool_names = [pool] if pool else sorted(engine.pools)
+    for name in pool_names:
+        entry = engine.pools.get(name)
+        if entry is not None and entry[1].find_block(shape) is not None:
+            return name
+    return None
+
+
+def admission_answer(
+    slices: Sequence[dict],
+    nodes: Sequence[dict],
+    shape_str: str,
+    pool: str = "",
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+    migratable: Optional[Sequence[str]] = None,
+    horizon_seconds: float = 600.0,
+    for_slice: Optional[str] = None,
+) -> dict:
+    """The `tpuop-cfg plan` admission verdict for one shape. Returns
+    {shape, answer: "now"|"after-defrag"|"no", pool, migrations,
+    eta_seconds, detail}. ``migratable`` limits which placed gangs the
+    virtual defrag may move (the controller's owner-gating rule —
+    defaults to every placed slice, the simulator's optimistic bound).
+    ``for_slice`` names an existing queued request the question is
+    about, so the replay's own seating of it IS the answer (a
+    hypothetical new gang needs a block beyond everything already
+    queued; an existing one doesn't compete with itself)."""
+    shape = parse_shape(str(shape_str))
+    if shape is None:
+        return {
+            "shape": shape_str, "answer": "no", "pool": "",
+            "migrations": 0, "eta_seconds": None,
+            "detail": f"unparseable shape {shape_str!r}",
+        }
+    links = degraded_links or []
+    fit_pool = _fits_now(slices, nodes, shape, pool, links, for_slice=for_slice)
+    if fit_pool is not None:
+        return {
+            "shape": shape_str, "answer": "now", "pool": fit_pool,
+            "migrations": 0, "eta_seconds": 0.0,
+            "detail": f"a free {shape_str} block exists in pool {fit_pool}",
+        }
+    # virtual defrag: apply the proposer's best migration to a copy of
+    # the world (the candidate's labels stripped — the engine re-places
+    # it on the next replay, exactly as the live controller would) and
+    # re-check, bounded by the migration budget
+    world_nodes: List[dict] = list(nodes)
+    moved: List[str] = []
+    candidates = list(migratable) if migratable is not None else None
+    for round_no in range(1, consts.DEFRAG_MIGRATION_BUDGET + 1):
+        eta = round_no * consts.DEFRAG_COOLDOWN_SECONDS
+        if eta > horizon_seconds:
+            break
+        pool_candidates = candidates
+        if pool_candidates is None:
+            engine = PlacementEngine(slices, world_nodes, degraded_links=links)
+            plan = engine.plan()
+            pool_candidates = sorted(
+                name for name, status in plan.statuses.items()
+                if status.get("phase") == "Scheduled"
+            ) or sorted(
+                owner for _, torus in engine.pools.values()
+                for owner in torus.owners()
+            )
+        scores = migration_scores(
+            slices, world_nodes, pool_candidates, degraded_links=links
+        )
+        best = pick_migration(scores)
+        if best is None:
+            break
+        moved.append(best)
+        world_nodes = strip_assignments(world_nodes, [best])
+        fit_pool = _fits_now(
+            slices, world_nodes, shape, pool, links, for_slice=for_slice
+        )
+        if fit_pool is not None:
+            return {
+                "shape": shape_str, "answer": "after-defrag", "pool": fit_pool,
+                "migrations": round_no, "eta_seconds": eta,
+                "detail": (
+                    f"lands in pool {fit_pool} after migrating "
+                    f"{', '.join(moved)} (~{int(eta)}s at the defrag cooldown)"
+                ),
+            }
+    return {
+        "shape": shape_str, "answer": "no", "pool": "",
+        "migrations": len(moved), "eta_seconds": None,
+        "detail": (
+            f"no {shape_str} block within the {int(horizon_seconds)}s horizon"
+            + (f" even after migrating {', '.join(moved)}" if moved else "")
+        ),
+    }
+
+
+def plan_report(
+    slices: Sequence[dict],
+    nodes: Sequence[dict],
+    shape: str = "",
+    pool: str = "",
+    horizon_seconds: float = 600.0,
+    degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
+    autotune_entries: Optional[dict] = None,
+) -> str:
+    """The `tpuop-cfg plan` report: per-pool capacity posture, the
+    analytical model's per-generation reference predictions, admission
+    answers for every queued shape, and (when ``shape`` is given) the
+    operator's own what-if. Pure — the CLI supplies the object lists."""
+    from tpu_operator.planning.model import predict_step_time
+    from tpu_operator.workloads.descriptor import reference_descriptor
+
+    links = degraded_links or []
+    engine = PlacementEngine(slices, nodes, degraded_links=links)
+    plan = engine.plan()
+    lines = ["# capacity posture"]
+    generations = {}
+    for pool_name in sorted(engine.pools):
+        pool_obj, torus = engine.pools[pool_name]
+        generations.setdefault(
+            pool_obj.info.generation, max(1, pool_obj.info.chips_per_node)
+        )
+        lines.append(
+            f"pool {pool_name}: generation={pool_obj.info.generation}  "
+            f"hosts={torus.in_service_count()}  free={torus.free_count()}  "
+            f"utilization={torus.utilization()}  "
+            f"fragmentation={plan.fragmentation.get(pool_name, 0.0)}"
+        )
+    if not engine.pools:
+        lines.append("# no TPU pools")
+    lines.append("")
+    lines.append("# analytical model: reference step-time predictions (2x2x1 block)")
+    descriptor = reference_descriptor()
+    for gen in sorted(generations):
+        prediction = predict_step_time(
+            descriptor, gen, (2, 2, 1),
+            chips_per_host=generations[gen],
+            autotune_entries=autotune_entries,
+        )
+        lines.append(
+            f"{gen}: predicted_step={prediction.step_seconds:.6f}s  "
+            f"bound={prediction.bound}"
+            + (f"  fallbacks={','.join(prediction.fallbacks)}"
+               if prediction.fallbacks else "")
+        )
+    lines.append("")
+    lines.append("# queued placements")
+    queued = queued_shapes(slices)
+    for name, queued_shape in sorted(queued.items()):
+        answer = admission_answer(
+            slices, nodes, queued_shape,
+            degraded_links=links, horizon_seconds=horizon_seconds,
+            for_slice=name,
+        )
+        lines.append(
+            f"{name} ({queued_shape}): {answer['answer']} — {answer['detail']}"
+        )
+    if not queued:
+        lines.append("# none")
+    if shape:
+        lines.append("")
+        lines.append(f"# what-if: {shape} within {int(horizon_seconds)}s")
+        answer = admission_answer(
+            slices, nodes, shape, pool=pool,
+            degraded_links=links, horizon_seconds=horizon_seconds,
+        )
+        lines.append(f"{answer['answer']} — {answer['detail']}")
+    return "\n".join(lines) + "\n"
+
+
+def queued_shapes(slices: Sequence[dict]) -> Dict[str, str]:
+    """slice name -> requested shape for every placement request not
+    currently Scheduled — the shapes must-gather's plan.txt answers
+    admission for."""
+    out: Dict[str, str] = {}
+    for obj in slices:
+        placement = (obj.get("spec") or {}).get("placement") or {}
+        shape = str(placement.get("shape") or "")
+        if not shape:
+            continue
+        status = (obj.get("status") or {}).get("placement") or {}
+        if status.get("phase") != "Scheduled":
+            out[obj["metadata"]["name"]] = shape
+    return out

@@ -354,6 +354,24 @@ def run_generation_sweep(
 # ---------------------------------------------------------------------------
 
 
+def cached_entries(cm_data: Optional[dict]) -> Dict[str, dict]:
+    """Every parseable per-generation sweep entry in a results-CM data
+    map: {generation: entry} for each ``<gen>.json`` key (the winners
+    blob excluded), half-written blobs skipped — the one place the
+    cache layout is decoded for read-everything consumers (the defrag
+    controller's model calibration, `tpuop-cfg plan`)."""
+    from tpu_operator import consts
+
+    out: Dict[str, dict] = {}
+    for key, blob in (cm_data or {}).items():
+        if not key.endswith(".json") or key == consts.AUTOTUNE_WINNERS_KEY:
+            continue
+        parsed = parse_entry(blob)
+        if parsed is not None:
+            out[key[: -len(".json")]] = parsed
+    return out
+
+
 def entry_key(generation: str) -> str:
     """The ConfigMap data key one generation's entry lives under."""
     return f"{generation}.json"

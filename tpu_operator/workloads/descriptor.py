@@ -1,0 +1,133 @@
+"""Workload descriptor extraction: what one step costs, for the planner.
+
+The analytical model (``tpu_operator/planning/model.py``) predicts step
+time from a :class:`~tpu_operator.planning.model.WorkloadDescriptor` —
+FLOPs, HBM bytes, and collective payload per step. This module derives
+those numbers from the repo's own workload configs, so the planner and
+the workloads can never disagree about what a step is:
+
+- :func:`burnin_descriptor` — the burn-in transformer train step,
+  riding the same ``telemetry.burnin_flops_per_step`` estimate the
+  achieved-TFLOP/s gauge already trusts;
+- :func:`transformer_descriptor` — any dense transformer by dims (the
+  `tpuop-cfg plan` entry point for "my model is roughly this big");
+- :func:`serving_decode_descriptor` — one continuous-batching decode
+  step of the serving engine (weights-bandwidth dominated).
+
+Importable operator-side: numpy/jax never load at module scope (the
+same contract as ``workloads/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+from tpu_operator.planning.model import WorkloadDescriptor
+
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2, "int8": 1}
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(str(dtype), 2)
+
+
+def transformer_params(
+    d_model: int, d_ff: int, n_layers: int, qkv_width: int = 0
+) -> float:
+    """Dense-transformer parameter count (per-layer qkv + proj + FFN) —
+    the same shape ``telemetry.burnin_flops_per_step`` integrates."""
+    qkv = qkv_width or 3 * d_model
+    per_layer = d_model * qkv + d_model * d_model + 2 * d_model * d_ff
+    return float(n_layers * per_layer)
+
+
+def transformer_descriptor(
+    name: str,
+    d_model: int,
+    d_ff: int,
+    n_layers: int,
+    n_heads: int,
+    seq_len: int,
+    batch: int,
+    dtype: str = "bfloat16",
+    dp_axes: tuple = (True, False, False),
+) -> WorkloadDescriptor:
+    """One train step of a dense transformer. FLOPs follow the standard
+    6×params×tokens estimate plus the quadratic attention term; HBM
+    bytes are the parameter traffic of a train step (read params + read
+    grads + optimizer update ≈ 3 passes over params, plus activation
+    traffic ≈ 2 passes over the token activations); the collective
+    payload is the data-parallel gradient allreduce (2 bytes-of-grads
+    per step, fp32 master grads) over the axes ``dp_axes`` marks —
+    split evenly when more than one axis is data-parallel."""
+    params = transformer_params(d_model, d_ff, n_layers)
+    tokens = float(batch * seq_len)
+    head = d_model // max(1, n_heads)
+    dense_flops = 6.0 * params * tokens
+    attn_flops = n_layers * 6.0 * 2.0 * batch * seq_len * seq_len * n_heads * head
+    pbytes = _dtype_bytes(dtype)
+    hbm = 3.0 * params * pbytes + 2.0 * tokens * d_model * n_layers * pbytes
+    grad_bytes = 2.0 * params * pbytes
+    axes = [bool(a) for a in (tuple(dp_axes) + (False, False, False))[:3]]
+    n_dp = sum(axes) or 1
+    collective = tuple(grad_bytes / n_dp if a else 0.0 for a in axes)
+    return WorkloadDescriptor(
+        name=name,
+        flops_per_step=dense_flops + attn_flops,
+        bytes_per_step=hbm,
+        collective_bytes_per_axis=collective,
+    )
+
+
+def reference_descriptor() -> WorkloadDescriptor:
+    """The canonical what-if workload the defrag controller prices per
+    generation (``tpu_operator_plan_predicted_step_seconds``): a 1B-class
+    dense transformer train step. Pure arithmetic — safe operator-side
+    (no jax import, unlike :func:`burnin_descriptor`)."""
+    return transformer_descriptor(
+        "plan-reference",
+        d_model=2048, d_ff=8192, n_layers=16, n_heads=16,
+        seq_len=2048, batch=8,
+    )
+
+
+def burnin_descriptor(cfg=None) -> WorkloadDescriptor:
+    """The burn-in transformer step, FLOPs from the exact estimator the
+    telemetry recorder publishes achieved-TFLOP/s against (one source of
+    truth for "how big is a burn-in step")."""
+    from tpu_operator.workloads.burnin import BurninConfig
+    from tpu_operator.workloads.telemetry import burnin_flops_per_step
+
+    cfg = cfg or BurninConfig()
+    params = transformer_params(cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.qkv_width)
+    pbytes = _dtype_bytes(cfg.dtype)
+    tokens = float(cfg.batch * cfg.seq_len)
+    return WorkloadDescriptor(
+        name="burnin",
+        flops_per_step=burnin_flops_per_step(cfg),
+        bytes_per_step=3.0 * params * pbytes + 2.0 * tokens * cfg.d_model * cfg.n_layers * pbytes,
+        collective_bytes_per_axis=(2.0 * params * pbytes, 0.0, 0.0),
+    )
+
+
+def serving_decode_descriptor(
+    name: str,
+    d_model: int,
+    d_ff: int,
+    n_layers: int,
+    batch: int,
+    kv_len: int = 1024,
+    dtype: str = "int8",
+) -> WorkloadDescriptor:
+    """One decode step of the continuous-batching engine: every weight
+    is read once per step (the bandwidth-bound regime that makes decode
+    batch-size sensitive), FLOPs are 2×params per token plus attention
+    over the KV cache, and there is no gradient collective (per-replica
+    serving shards nothing across hosts)."""
+    params = transformer_params(d_model, d_ff, n_layers)
+    pbytes = _dtype_bytes(dtype)
+    kv_bytes = 2.0 * n_layers * kv_len * d_model * _dtype_bytes("bfloat16")
+    return WorkloadDescriptor(
+        name=name,
+        flops_per_step=2.0 * params * batch + 2.0 * n_layers * batch * kv_len * d_model,
+        bytes_per_step=params * pbytes + batch * kv_bytes,
+        collective_bytes_per_axis=(0.0, 0.0, 0.0),
+    )
