@@ -616,6 +616,24 @@ def _compact_summary(out: dict) -> dict:
             "continuous_vs_static_speedup"
         ),
         "serving_ttft_p99_s": out.get("serving", {}).get("decode_ttft_p99_s"),
+        "pod_warm_ttft_p50_s": out.get("pods", {}).get("affinity", {}).get(
+            "warm_ttft_p50_s"
+        ),
+        "pod_cold_ttft_p50_s": out.get("pods", {}).get("affinity", {}).get(
+            "cold_ttft_p50_s"
+        ),
+        "pod_kv_hit_ratio": out.get("pods", {}).get("affinity", {}).get(
+            "kv_hit_ratio"
+        ),
+        "pod_kv_handoff_bytes": out.get("pods", {}).get("disagg", {}).get(
+            "handoff_bytes"
+        ),
+        "pod_prefill_replicas": out.get("pods", {}).get("disagg", {}).get(
+            "prefill_desired"
+        ),
+        "pod_decode_replicas": out.get("pods", {}).get("disagg", {}).get(
+            "decode_desired"
+        ),
         "fleet_sim_utilization_pct": out.get("fleet_sim", {}).get(
             "defrag-aware", {}
         ).get("utilization_pct"),
@@ -1917,13 +1935,17 @@ def bench_placement(
 
 
 def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
-    """Elastic fault-tolerant training (ISSUE 13): one TPUJob driven
-    through the seeded gang fault schedule — host death, grey failure,
-    link cut, preemption — on a 2x2x1 sim torus, with the in-process
-    gang harness training for real. Returns the BENCH ``training``
-    block: resume latency, lost steps per fault, and the shrink
-    step-time ratio vs the gang-telemetry prediction (fixed global
-    batch ⇒ step time scales ~ hosts_full / hosts_shrunk)."""
+    """Elastic fault-tolerant training (ISSUE 13, re-run through the
+    pod data plane of ISSUE 16): one TPUJob driven through the seeded
+    gang fault schedule — host death, grey failure, link cut,
+    preemption — on a 2x2x1 sim torus. The job controller renders one
+    worker pod per gang member; the sim kubelet runs the pod mains
+    (rendezvous-gated chief training for real); every re-place rolls a
+    new pod generation. Returns the BENCH ``training`` block: resume
+    latency, lost steps per fault, and the shrink step-time ratio vs
+    the gang-telemetry prediction (fixed global batch ⇒ step time
+    scales ~ hosts_full / hosts_shrunk) — continuity verified over the
+    CONCATENATED chief histories across pod generations."""
     import statistics as stats
     import tempfile
 
@@ -1936,27 +1958,30 @@ def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
     )
     from tpu_operator.kube.controller import Request
     from tpu_operator.kube.fake import FakeClient
-    from tpu_operator.kube.sim import GangFaultSchedule, make_torus_nodes
-    from tpu_operator.workloads.checkpoint import CheckpointStore
-    from tpu_operator.workloads.training import InProcessJobRunner, verify_continuity
+    from tpu_operator.kube.sim import (
+        GangFaultSchedule,
+        PodKubelet,
+        make_torus_nodes,
+    )
+    from tpu_operator.workloads.training import verify_continuity
 
     ns = "tpu-operator"
     client = FakeClient()
     for node in make_torus_nodes((2, 2, 1), prefix="bench-tj"):
         node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
         client.create(node)
+    # the checkpoint dir is pinned so every pod generation resumes from
+    # the SAME store (the spec contract real multi-pod jobs rely on)
+    store_dir = tempfile.mkdtemp(prefix="bench-tpujob-")
     client.create(new_tpu_job("bench-job", {
         "workload": {"steps": steps},
         "gang": {"shape": "2x2x1", "minShape": "1x1x1"},
-        "checkpoint": {"everySteps": 5},
+        "checkpoint": {"everySteps": 5, "dir": store_dir},
         "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05, "retryLimit": 10},
     }))
     job_rec = JobReconciler(client, ns)
     place_rec = PlacementReconciler(client, ns)
-    runner = InProcessJobRunner(
-        client, ns, "bench-job",
-        CheckpointStore(tempfile.mkdtemp(prefix="bench-tpujob-")), steps_per_sync=3,
-    )
+    kubelet = PodKubelet(client, ns)
     schedule = GangFaultSchedule(
         client, ns, "bench-job-slice", seed=seed, start_at=3, every=10, heal_after=4
     )
@@ -1965,28 +1990,46 @@ def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
     for passes in range(1, 500):
         job_rec.reconcile(Request(name="bench-job"))
         place_rec.reconcile(QUEUE_REQUEST)
-        runner.sync()
+        kubelet.step()
         schedule.step()
         job = client.get("tpu.google.com/v1alpha1", "TPUJob", "bench-job")
         block = (job.get("status") or {}).get("job") or {}
         if block.get("phase") == JobPhase.SUCCEEDED:
             break
     elapsed = time.monotonic() - t0
-    trainer = runner.trainer
-    report = verify_continuity(trainer.history, trainer.checkpoints, trainer.total_steps)
+    trainers = kubelet.job_trainers("bench-job")
+    kubelet.stop()
+    worker_pods_left = [
+        p["metadata"]["name"]
+        for p in client.list("v1", "Pod", ns)
+        if p["metadata"]["name"].startswith("bench-job" + consts.JOB_WORKER_INFIX)
+    ]
+    history = [h for t in trainers for h in t.history]
+    checkpoints = [c for t in trainers for c in t.checkpoints]
+    total_steps = trainers[-1].total_steps if trainers else steps
+    report = verify_continuity(history, checkpoints, total_steps)
     faults = len([r for r in schedule.log if r[1] == "inject"])
     # lost work: re-executed steps across every rewind
-    executed = [h["step"] for h in trainer.history]
+    executed = [h["step"] for h in history]
     lost = len(executed) - len(set(executed))
-    resumes = [r.latency_s for r in trainer.resumes[1:]]  # [0] is cold start
+    resumes = []
+    for gen, t in enumerate(trainers):
+        latencies = [r.latency_s for r in t.resumes]
+        # the first generation's [0] is the cold start; every later
+        # generation's [0] is its resume-from-checkpoint under a new pod
+        resumes.extend(latencies[1:] if gen == 0 else latencies)
+    step_times: dict = {}
+    for t in trainers:
+        for world, times in t.step_times.items():
+            step_times.setdefault(world, []).extend(times)
     # shrink step-time ratio: median executed-step time per world (first
     # sample per world dropped — it carries the mesh's XLA compile)
     def world_median(world):
-        times = trainer.step_times.get(world, [])
+        times = step_times.get(world, [])
         times = times[1:] or times
         return stats.median(times) if times else 0.0
 
-    worlds = sorted(trainer.step_times)
+    worlds = sorted(step_times)
     ratio = {}
     if len(worlds) >= 2:
         small, full = worlds[0], worlds[-1]
@@ -2005,8 +2048,10 @@ def bench_training(seed: int = 20260811, steps: int = 120) -> dict:
         "phase": block.get("phase"),
         "passes": passes,
         "elapsed_s": round(elapsed, 3),
-        "steps": trainer.step,
-        "checkpoint_epochs": len(trainer.checkpoints),
+        "steps": trainers[-1].step if trainers else 0,
+        "checkpoint_epochs": len(checkpoints),
+        "pod_generations": len(trainers),
+        "worker_pods_after": worker_pods_left,
         "fault_classes": sorted(schedule.fired),
         "faults_injected": faults,
         "resizes": [(r["kind"], r["from"], r["to"]) for r in block.get("shrinks") or []],
@@ -2044,6 +2089,7 @@ def bench_serving(seed: int = 20260818) -> dict:
     from tpu_operator.kube.objects import new_object
     from tpu_operator.kube.sim import (
         DiurnalTraffic,
+        PodKubelet,
         ServingTrafficSim,
         make_torus_nodes,
     )
@@ -2067,6 +2113,7 @@ def bench_serving(seed: int = 20260818) -> dict:
     }))
     rec = ServingReconciler(client, ns)
     place = PlacementReconciler(client, ns)
+    kubelet = PodKubelet(client, ns)
     sim = ServingTrafficSim(
         client, ns, "bench-serving", DiurnalTraffic(seed=seed), replica_rps=10.0,
         # window wide enough that the scale-up transient's queued
@@ -2083,6 +2130,7 @@ def bench_serving(seed: int = 20260818) -> dict:
     def beat() -> None:
         rec.reconcile(req)
         place.reconcile(QUEUE_REQUEST)
+        kubelet.step()  # the data plane rides along: one pod per replica
         sim.step()
 
     def fragmentation() -> float:
@@ -2111,6 +2159,7 @@ def bench_serving(seed: int = 20260818) -> dict:
     for _ in range(6):
         beat()
     burst = dict(block())
+    worker_pods_at_burst = len(kubelet.serving_workers("bench-serving"))
     _, burst_ttft_p99 = sim.ttft_percentiles()
 
     # -- fabric degradation: the replica's own artifact excludes it
@@ -2161,13 +2210,16 @@ def bench_serving(seed: int = 20260818) -> dict:
     lull = dict(block())
     frag_after_scale_down = fragmentation()
 
-    # -- deletion: series retired, owned replicas swept
+    # -- deletion: series retired, owned replicas AND worker pods swept
     client.delete("tpu.google.com/v1alpha1", "TPUServing", "bench-serving")
     rec.reconcile(req)
+    kubelet.step()  # retire the swept pods' mains
     slices_left = [
         s["metadata"]["name"]
         for s in client.list("tpu.google.com/v1alpha1", "TPUSlice")
     ]
+    worker_pods_after_delete = len(kubelet.serving_workers("bench-serving"))
+    kubelet.stop()
 
     return {
         "seed": seed,
@@ -2187,6 +2239,8 @@ def bench_serving(seed: int = 20260818) -> dict:
             "scale_up_time_to_ready_s": round(scale_up_s, 3),
             "slo_ttft_p99_s": slo_ttft,
             "burst_ttft_p99_s": round(burst_ttft_p99, 3),
+            "worker_pods_at_burst": worker_pods_at_burst,
+            "worker_pods_after_delete": worker_pods_after_delete,
             "degraded_replica": degraded_replica,
             "degraded_replica_members": members,
             "routed_during_exclusion": routed_during_exclusion,
@@ -2204,14 +2258,16 @@ def bench_serving(seed: int = 20260818) -> dict:
 
 
 def serving_smoke() -> int:
-    """CI gate (scripts/ci.sh): the serving acceptance run — continuous
-    batching must beat the static baseline by >= 1.5x tokens/s/chip on
-    the same kernels, the autoscaler must ride the seeded diurnal sim
-    (burst -> scale-up admitted through placement with p99 TTFT inside
-    the SLO, lull -> fragmentation-aware scale-down), a fabric-degraded
-    replica must receive zero routed requests, and every serving series
-    must be live on the scrape endpoint while the CR exists and retired
-    when it is deleted."""
+    """CI gate (scripts/ci.sh): the serving acceptance run, with the
+    pod data plane riding along — continuous batching must beat the
+    static baseline by >= 1.5x tokens/s/chip on the same kernels, the
+    autoscaler must ride the seeded diurnal sim (burst -> scale-up
+    admitted through placement with p99 TTFT inside the SLO, one
+    sim-kubelet worker pod per ready replica, lull ->
+    fragmentation-aware scale-down), a fabric-degraded replica must
+    receive zero routed requests, and every serving series must be live
+    on the scrape endpoint while the CR exists and retired when it is
+    deleted (worker pods swept with it)."""
     import prometheus_client
 
     result = bench_serving()
@@ -2239,6 +2295,12 @@ def serving_smoke() -> int:
         ),
         "steady_holds_min": sim["steady"]["ready"] == 1,
         "burst_scales_up": sim["burst"]["ready"] >= 2 and sim["burst"]["desired"] >= 2,
+        # the pod data plane: one worker pod per ready replica at the
+        # burst, all of them swept with the CR
+        "worker_pods_ride_replicas": (
+            sim["worker_pods_at_burst"] == sim["burst"]["ready"]
+        ),
+        "delete_sweeps_worker_pods": sim["worker_pods_after_delete"] == 0,
         "ttft_within_slo_across_scale_up": (
             0 < sim["burst_ttft_p99_s"] <= sim["slo_ttft_p99_s"]
         ),
@@ -2276,14 +2338,301 @@ def serving_smoke() -> int:
     return 0 if ok else 1
 
 
+def bench_pods(seed: int = 20260806) -> dict:
+    """The pod data plane end to end (ISSUE 16): worker pods under the
+    sim kubelet, the KV-aware router, and disaggregated prefill/decode
+    pools.
+
+    1. **KV affinity** — warm multi-turn sessions (router session
+       affinity + engine session-KV retention: follow-up turns
+       delta-prefill from the held context) vs cold single-shot prompts
+       of the SAME lengths, paced by the same seeded
+       :class:`DiurnalTraffic` arrivals (equal load): warm TTFT must
+       beat cold TTFT.
+    2. **disaggregation** — ``spec.disaggregation`` splits the serving
+       into a prefill pool scaled on ITS signal (prefill TTFT p99 vs
+       the SLO) and a decode pool scaled on ITS signal (tokens/s
+       floor), bridged by paged-KV handoffs the router collects.
+    """
+    from tpu_operator import consts
+    from tpu_operator.api.tpuserving import new_tpu_serving
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.controllers.serving_controller import ServingReconciler
+    from tpu_operator.dataplane.router import KVAwareRouter
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import DiurnalTraffic, PodKubelet, make_torus_nodes
+    from tpu_operator.workloads.serving import ServingRequest
+
+    import numpy as np
+
+    ns = "tpu-operator"
+    rng = np.random.default_rng(seed)
+
+    def ttft_p50(requests: list) -> float:
+        ttfts = sorted(r.ttft_s for r in requests if r.ttft_s is not None)
+        if not ttfts:
+            return 0.0
+        return ttfts[len(ttfts) // 2]
+
+    # ---- part 1: session affinity (aggregated serving, 2 replicas) ---------
+    client = FakeClient()
+    for node in make_torus_nodes((4, 2, 1), prefix="bench-pd"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    client.create(new_tpu_serving("bench-pods", {
+        "model": {"shape": "2x1x1"},
+        "replicas": {"min": 2, "max": 2, "targetRps": 100.0},
+        "slo": {"ttftP99Seconds": 30.0},
+        "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 5},
+    }))
+    rec = ServingReconciler(client, ns)
+    place = PlacementReconciler(client, ns)
+    kubelet = PodKubelet(client, ns)
+    req = Request(name="bench-pods")
+    for _ in range(10):
+        rec.reconcile(req)
+        place.reconcile(QUEUE_REQUEST)
+        kubelet.step()
+        if len(kubelet.serving_workers("bench-pods")) == 2:
+            break
+    worker_pods = len(kubelet.serving_workers("bench-pods"))
+    router = KVAwareRouter(client, ns, "bench-pods")
+    traffic = DiurnalTraffic(seed=seed)
+
+    # 4 warm conversations x 3 turns; every turn is mirrored by a cold
+    # single-shot request of the SAME prompt length submitted in the
+    # same tick — equal load, the only delta is the session tag
+    sessions = 4
+    turn_plens = [16, 28, 40]
+    decode = 4
+
+    def warm_prompt(j: int, turn: int) -> np.ndarray:
+        # one growing conversation per session: turn k's prompt extends
+        # turn k-1's context (prompt + its decoded tokens)
+        r = np.random.default_rng(seed + 100 + j)
+        return r.integers(0, 128, size=turn_plens[turn]).astype(np.int32)
+
+    tick = 0
+    completed_rids: set = set()
+    for turn in range(len(turn_plens)):
+        pairs = []
+        for j in range(sessions):
+            pairs.append((
+                ServingRequest(
+                    rid=f"warm-{j}-t{turn}", prompt=warm_prompt(j, turn),
+                    decode_tokens=decode, session=f"conv-{j}",
+                ),
+                ServingRequest(
+                    rid=f"cold-{j}-t{turn}",
+                    prompt=rng.integers(0, 128, size=turn_plens[turn]).astype(np.int32),
+                    decode_tokens=decode,
+                ),
+            ))
+        # pace this turn's pairs by the seeded arrival curve, then drain
+        # the round fully: a session's next turn resumes its RETAINED
+        # context, so turns never overlap in flight
+        for _ in range(400):
+            if pairs:
+                for _n in range(max(1, traffic.arrivals(tick))):
+                    if not pairs:
+                        break
+                    warm, cold = pairs.pop(0)
+                    router.submit(warm)
+                    router.submit(cold)
+            router.sync_workers(kubelet.mains())
+            router.tick()
+            kubelet.step()
+            tick += 1
+            done = {r.rid for r in router.completed_requests()}
+            if not pairs and all(
+                f"warm-{j}-t{turn}" in done and f"cold-{j}-t{turn}" in done
+                for j in range(sessions)
+            ):
+                break
+    finished = router.completed_requests()
+    # turn 0 is every conversation's cold start — the affinity win is
+    # turns >= 1, where the warm side delta-prefills the held context
+    warm_done = [r for r in finished
+                 if r.rid.startswith("warm-") and not r.rid.endswith("-t0")]
+    cold_done = [r for r in finished
+                 if r.rid.startswith("cold-") and not r.rid.endswith("-t0")]
+    affinity = {
+        "worker_pods": worker_pods,
+        "warm_requests": len(warm_done),
+        "cold_requests": len(cold_done),
+        "warm_ttft_p50_s": round(ttft_p50(warm_done), 5),
+        "cold_ttft_p50_s": round(ttft_p50(cold_done), 5),
+        "kv_hit_ratio": round(router.kv_hit_ratio, 4),
+        "prefix_routed": router.prefix_routed,
+        "routed": dict(router.routed),
+    }
+    client.delete("tpu.google.com/v1alpha1", "TPUServing", "bench-pods")
+    rec.reconcile(req)
+    kubelet.step()
+    affinity["worker_pods_after_delete"] = len(
+        kubelet.serving_workers("bench-pods"))
+    kubelet.stop()
+
+    # ---- part 2: disaggregated prefill/decode pools ------------------------
+    client2 = FakeClient()
+    for node in make_torus_nodes((4, 2, 1), prefix="bench-dg"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client2.create(node)
+    client2.create(new_tpu_serving("bench-disagg", {
+        "model": {"shape": "1x1x1"},
+        # targetRps far above offered load: any decode scale-up is the
+        # floor signal's, not the arrival-rate autoscaler's
+        "replicas": {"min": 1, "max": 3, "targetRps": 1000.0,
+                     "cooldownSeconds": 0.0},
+        # any real prefill breaches 10 ms: the prefill pool must scale
+        # on ITS OWN signal while decode holds
+        "slo": {"ttftP99Seconds": 0.01},
+        "disaggregation": {"enabled": True, "prefillMin": 1, "prefillMax": 2,
+                           "decodeTokensPerSFloor": 1e9},
+        "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 5},
+    }))
+    rec2 = ServingReconciler(client2, ns)
+    place2 = PlacementReconciler(client2, ns)
+    kubelet2 = PodKubelet(client2, ns)
+    router2 = KVAwareRouter(client2, ns, "bench-disagg")
+    req2 = Request(name="bench-disagg")
+
+    def disagg_block() -> dict:
+        obj2 = client2.get(
+            "tpu.google.com/v1alpha1", "TPUServing", "bench-disagg")
+        return (obj2.get("status") or {}).get("serving") or {}
+
+    rid = 0
+    for _ in range(80):
+        rec2.reconcile(req2)
+        place2.reconcile(QUEUE_REQUEST)
+        kubelet2.step()
+        router2.sync_workers(kubelet2.mains())
+        if router2.prefill_workers:
+            for _ in range(2):
+                router2.submit(ServingRequest(
+                    rid=f"dg-{rid}",
+                    prompt=rng.integers(0, 128, size=24).astype(np.int32),
+                    decode_tokens=4,
+                    session=f"dg-conv-{rid % 3}",
+                ))
+                rid += 1
+        router2.tick()
+        b = disagg_block()
+        pools_now = b.get("pools") or {}
+        if (
+            (pools_now.get("prefill") or {}).get("desired", 0) >= 2
+            and (pools_now.get("decode") or {}).get("desired", 0) >= 2
+            and router2.handoffs > 0
+            and router2.completed_requests()
+        ):
+            break
+    # drain what's still in flight so "completed" reflects the pools
+    for _ in range(40):
+        if not (router2.queue or any(
+                not m.engine.idle for m in list(router2.workers.values())
+                + list(router2.prefill_workers.values()))):
+            break
+        kubelet2.step()
+        router2.sync_workers(kubelet2.mains())
+        router2.tick()
+    block2 = disagg_block()
+    pools = block2.get("pools") or {}
+    decisions = block2.get("decisions") or []
+    disagg = {
+        "pools": pools,
+        "prefill_desired": (pools.get("prefill") or {}).get("desired", 0),
+        "prefill_ready": (pools.get("prefill") or {}).get("ready", 0),
+        "decode_desired": (pools.get("decode") or {}).get("desired", 0),
+        "decode_ready": (pools.get("decode") or {}).get("ready", 0),
+        "handoffs": router2.handoffs,
+        "handoff_bytes": router2.handoff_bytes,
+        "completed": len(router2.completed_requests()),
+        "submitted": rid,
+        "prefill_scale_decisions": [
+            d.get("reason") for d in decisions
+            if d.get("action") == "prefill-scale"
+        ],
+        "decode_floor_decisions": [
+            d.get("reason") for d in decisions
+            if "decode throughput" in (d.get("reason") or "")
+        ],
+    }
+    client2.delete("tpu.google.com/v1alpha1", "TPUServing", "bench-disagg")
+    rec2.reconcile(req2)
+    kubelet2.step()
+    disagg["worker_pods_after_delete"] = len(
+        kubelet2.serving_workers("bench-disagg"))
+    kubelet2.stop()
+
+    return {"seed": seed, "affinity": affinity, "disagg": disagg}
+
+
+def pod_smoke() -> int:
+    """CI gate (scripts/ci.sh): the pod data plane acceptance run —
+    worker pods under the sim kubelet with the KV-aware router must
+    show the session-affinity win (warm-session TTFT strictly below
+    cold-session TTFT at equal load on the seeded DiurnalTraffic), the
+    disaggregated pools must each scale on their OWN signal (prefill on
+    prefill TTFT p99, decode on the tokens/s floor) with paged-KV
+    handoffs flowing between them, and deleting the CRs must sweep
+    every worker pod. ci.sh runs the gate twice — plain and
+    TPUOP_RACECHECK=1 (failed by racecheck.violations())."""
+    result = bench_pods()
+    aff, dg = result["affinity"], result["disagg"]
+    checks = {
+        "workers_attached": aff["worker_pods"] == 2,
+        "equal_load": (
+            aff["warm_requests"] == aff["cold_requests"]
+            and aff["warm_requests"] > 0
+        ),
+        "warm_ttft_beats_cold": (
+            0 < aff["warm_ttft_p50_s"] < aff["cold_ttft_p50_s"]
+        ),
+        "session_affinity_hits": aff["kv_hit_ratio"] >= 0.5,
+        "affinity_delete_sweeps_pods": aff["worker_pods_after_delete"] == 0,
+        "prefill_pool_scaled_on_ttft": (
+            dg["prefill_desired"] >= 2 and bool(dg["prefill_scale_decisions"])
+        ),
+        "decode_pool_scaled_on_floor": (
+            dg["decode_desired"] >= 2 and bool(dg["decode_floor_decisions"])
+        ),
+        "kv_handoff_flowed": dg["handoffs"] > 0 and dg["handoff_bytes"] > 0,
+        "requests_completed_through_pools": dg["completed"] > 0,
+        "disagg_delete_sweeps_pods": dg["worker_pods_after_delete"] == 0,
+    }
+    violations = []
+    if os.environ.get("TPUOP_RACECHECK") == "1":
+        from tpu_operator.kube import racecheck
+
+        violations = [repr(v) for v in racecheck.violations()]
+    checks["racecheck_clean"] = not violations
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "pod_smoke",
+        "ok": ok,
+        "checks": checks,
+        "affinity": aff,
+        "disagg": {k: v for k, v in dg.items() if k != "pools"},
+        "racecheck_violations": violations,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def job_smoke() -> int:
     """CI gate (scripts/ci.sh): the chaos acceptance run for elastic
-    training — a seeded schedule mixing host death, grey failure, link
-    cut and preemption against a placed TPUJob must end Succeeded with
-    contiguous epoch history (no step lost beyond the last checkpoint),
-    shrinking only to allocator-ranked blocks and growing back on heal;
-    and a job with an unplaceable min shape must land Failed with an
-    Event instead of crash-looping through the placement queue."""
+    training, end to end through sim-kubelet worker pods — a seeded
+    schedule mixing host death, grey failure, link cut and preemption
+    against a placed TPUJob must end Succeeded with contiguous epoch
+    history across pod generations (no step lost beyond the last
+    checkpoint), shrinking only to allocator-ranked blocks and growing
+    back on heal, sweeping the gang's pods on success; and a job with
+    an unplaceable min shape must land Failed with an Event instead of
+    crash-looping through the placement queue."""
     from tpu_operator.api.tpujob import JobPhase, new_tpu_job
     from tpu_operator.controllers.job_controller import JobReconciler
     from tpu_operator.controllers.placement_controller import (
@@ -2298,6 +2647,11 @@ def job_smoke() -> int:
     checks = {
         "succeeded": result["phase"] == "Succeeded",
         "continuity_ok": result["ok"],
+        # the pod data plane: every re-place rolled a new worker-pod
+        # generation (the faults guarantee at least one), and success
+        # swept the gang's worker pods
+        "pod_generations_rolled": result["pod_generations"] >= 2,
+        "workers_swept_on_success": result["worker_pods_after"] == [],
         "all_fault_classes_fired": (
             set(result["fault_classes"]) == set(GangFaultSchedule.FAULT_CLASSES)
         ),
@@ -2687,6 +3041,8 @@ def main() -> None:
         raise SystemExit(job_smoke())
     if "--serving-smoke" in sys.argv[1:]:
         raise SystemExit(serving_smoke())
+    if "--pod-smoke" in sys.argv[1:]:
+        raise SystemExit(pod_smoke())
     if "--defrag-smoke" in sys.argv[1:]:
         raise SystemExit(defrag_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
@@ -2788,6 +3144,12 @@ def main() -> None:
         serving = bench_serving()
     except Exception as e:  # noqa: BLE001 — same isolation as chaos
         serving = {"error": f"{type(e).__name__}: {e}"}
+    # the pod data plane: KV-affinity routing over worker pods + the
+    # disaggregated prefill/decode pools (gated by --pod-smoke)
+    try:
+        pods = bench_pods()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        pods = {"error": f"{type(e).__name__}: {e}"}
     # capacity planning: best-fit vs defrag-aware at 4096 sim hosts +
     # the analytical model's calibrate-then-predict validation (gated
     # by --defrag-smoke)
@@ -2828,6 +3190,7 @@ def main() -> None:
         "autotune": autotune,
         "training": training,
         "serving": serving,
+        "pods": pods,
         "fleet_sim": fleet_sim,
         "details": details,
     }

@@ -97,6 +97,17 @@ echo "== serving smoke: burst -> scale-up -> route -> fragmentation-aware scale-
 # routing (zero requests), scale down via the fragmentation-aware
 # victim, and retire every serving series when the CR is deleted
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --serving-smoke
+echo "== pod smoke: KV-affinity routing over worker pods + disaggregated pools =="
+# pod data-plane gate: worker pods under the sim kubelet with the
+# KV-aware router — warm multi-turn sessions must beat cold single-shot
+# TTFT at equal load on the seeded diurnal arrivals (session affinity +
+# delta-prefill), the disaggregated prefill/decode pools must each
+# scale on their OWN signal (prefill TTFT p99 vs SLO; decode tokens/s
+# floor) with paged-KV handoffs flowing between them, and deleting the
+# CRs must sweep every worker pod
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --pod-smoke
+echo "== pod smoke (racecheck leg): the same gate under instrumented locks =="
+TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --pod-smoke
 echo "== defrag smoke: fragmented torus -> migration -> the 4x4x4 lands =="
 # capacity-planning gate: on the seeded fragmented 512-host torus the
 # defrag controller must land a previously-unplaceable 4x4x4 gang with

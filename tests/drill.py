@@ -748,6 +748,8 @@ class JobDrill:
         self._store_dir = None
 
     def setup(self) -> None:
+        import tempfile
+
         from tpu_operator.api.tpujob import new_tpu_job
         from tpu_operator.kube.sim import make_torus_nodes
 
@@ -755,11 +757,14 @@ class JobDrill:
             node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
             self.client.create(node)
             self.node_names.append(node["metadata"]["name"])
+        # the spec pins the checkpoint store so every worker-pod
+        # generation resumes from the SAME store
+        self._store_dir = tempfile.mkdtemp(prefix="tpujob-drill-")
         self.client.create(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUJob
             new_tpu_job(self.job_name, {
                 "workload": {"steps": 24},
                 "gang": {"shape": "2x2x1", "minShape": "1x1x1"},
-                "checkpoint": {"everySteps": 4},
+                "checkpoint": {"everySteps": 4, "dir": self._store_dir},
                 "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05, "retryLimit": 10},
             })
         )
@@ -776,6 +781,14 @@ class JobDrill:
         ):
             try:
                 self.client.delete(api_version, kind, name, ns)
+            except errors.ApiError:
+                pass
+        for index in range(4):
+            try:
+                self.client.delete(
+                    "v1", "Pod",
+                    f"{self.job_name}{consts.JOB_WORKER_INFIX}{index}", self.ns,
+                )
             except errors.ApiError:
                 pass
         for name in self.node_names:
@@ -799,8 +812,6 @@ class JobDrill:
         return ""
 
     def run(self, max_passes: int = 200) -> dict:
-        import tempfile
-
         from tpu_operator.api.tpujob import JobPhase
         from tpu_operator.controllers.job_controller import JobReconciler
         from tpu_operator.controllers.placement_controller import (
@@ -808,25 +819,22 @@ class JobDrill:
             PlacementReconciler,
         )
         from tpu_operator.kube.controller import Request
-        from tpu_operator.workloads.checkpoint import CheckpointStore
-        from tpu_operator.workloads.training import (
-            InProcessJobRunner,
-            verify_continuity,
-        )
+        from tpu_operator.kube.sim import PodKubelet
+        from tpu_operator.workloads.training import verify_continuity
 
         job_rec = JobReconciler(self.client, self.ns)
         place_rec = PlacementReconciler(self.client, self.ns)
-        self._store_dir = tempfile.mkdtemp(prefix="tpujob-drill-")
-        runner = InProcessJobRunner(
-            self.client, self.ns, self.job_name,
-            CheckpointStore(self._store_dir), steps_per_sync=3,
-        )
+        # the data plane: the controller renders one worker Pod per gang
+        # member and the sim kubelet runs their mains in threads — each
+        # re-place is a fresh pod generation resuming from the shared
+        # checkpoint store
+        kubelet = PodKubelet(self.client, self.ns)
         obs: dict = {"phases": [], "victim": "", "healed": False}
         request = Request(name=self.job_name)
         for _ in range(max_passes):
             job_rec.reconcile(request)
             place_rec.reconcile(QUEUE_REQUEST)
-            runner.sync()
+            kubelet.step()
             block = self._block()
             phase = block.get("phase", "")
             if not obs["phases"] or obs["phases"][-1] != phase:
@@ -851,10 +859,21 @@ class JobDrill:
                 break
         block = self._block()
         obs["final"] = block
-        trainer = runner.trainer
-        obs["continuity"] = verify_continuity(
-            trainer.history, trainer.checkpoints, trainer.total_steps
-        ) if trainer is not None else {"ok": False, "violations": ["never trained"]}
+        # continuity across POD GENERATIONS: each re-place retired the
+        # old gang's pods and started fresh mains resuming from the
+        # shared store — the concatenated chief histories must still
+        # satisfy the loss-curve continuity predicate
+        trainers = kubelet.job_trainers(self.job_name)
+        kubelet.stop()
+        obs["generations"] = len(trainers)
+        if trainers:
+            history = [r for t in trainers for r in t.history]
+            checkpoints = [c for t in trainers for c in t.checkpoints]
+            obs["continuity"] = verify_continuity(
+                history, checkpoints, trainers[-1].total_steps
+            )
+        else:
+            obs["continuity"] = {"ok": False, "violations": ["never trained"]}
         obs["resizes"] = [
             (r.get("kind"), r.get("from"), r.get("to")) for r in block.get("shrinks") or []
         ]
@@ -921,6 +940,14 @@ class ServingDrill:
                 )
             except errors.ApiError:
                 pass
+            for infix in (consts.SERVING_DECODE_INFIX, consts.SERVING_PREFILL_INFIX):
+                try:
+                    self.client.delete(
+                        "v1", "Pod",
+                        f"{self.serving_name}{infix}{index}", self.ns,
+                    )
+                except errors.ApiError:
+                    pass
         try:
             self.client.delete(
                 "v1", "ConfigMap",
@@ -955,7 +982,7 @@ class ServingDrill:
         )
         from tpu_operator.controllers.serving_controller import ServingReconciler
         from tpu_operator.kube.controller import Request
-        from tpu_operator.kube.sim import DiurnalTraffic, ServingTrafficSim
+        from tpu_operator.kube.sim import DiurnalTraffic, PodKubelet, ServingTrafficSim
 
         serve_rec = ServingReconciler(self.client, self.ns)
         place_rec = PlacementReconciler(self.client, self.ns)
@@ -963,6 +990,9 @@ class ServingDrill:
             self.client, self.ns, self.serving_name,
             DiurnalTraffic(seed=7), replica_rps=10.0,
         )
+        # the data plane: the controller renders one worker Pod per ready
+        # replica and the sim kubelet runs their engine mains in threads
+        kubelet = PodKubelet(self.client, self.ns)
         request = Request(name=self.serving_name)
         obs: dict = {"phases": []}
 
@@ -971,6 +1001,7 @@ class ServingDrill:
             serve_rec.reconcile(request)
             place_rec.reconcile(QUEUE_REQUEST)
             sim.step()
+            kubelet.step()
             block = self._block()
             phase = block.get("phase", "")
             if not obs["phases"] or obs["phases"][-1] != phase:
@@ -988,6 +1019,8 @@ class ServingDrill:
                 break
         obs["burst_ready"] = block.get("ready")
         obs["routed_at_burst"] = dict(sim.routed)
+        obs["worker_pods_at_burst"] = len(
+            kubelet.serving_workers(self.serving_name))
         # lull: hysteretic, fragmentation-aware scale-down
         deadline = _time.monotonic() + 15.0
         while _time.monotonic() < deadline:
@@ -1003,6 +1036,8 @@ class ServingDrill:
         )
         routing = ((cm or {}).get("data") or {}).get(consts.SERVING_ROUTING_KEY, "{}")
         obs["final_routing"] = _json.loads(routing)
+        obs["final_worker_pods"] = len(kubelet.serving_workers(self.serving_name))
+        kubelet.stop()
         return obs
 
 
@@ -1019,6 +1054,7 @@ def assert_serving_drill_passed(obs: dict) -> None:
     assert obs["steady_ready"] == 1, obs
     assert obs["burst_ready"] == 3, obs
     assert sum(obs["routed_at_burst"].values()) > 0, obs
+    assert obs["worker_pods_at_burst"] == 3, obs
     assert obs["lull_ready"] == 1, obs
     assert any(d.get("action") == "victim" for d in obs["decisions"]), obs
     assert sum(1 for w in obs["final_routing"].values() if w > 0) == 1, obs
@@ -1038,6 +1074,9 @@ def assert_job_drill_passed(obs: dict) -> None:
 
     assert obs["final"].get("phase") == JobPhase.SUCCEEDED, obs
     assert obs["victim"] and obs["healed"], obs
+    # the fault + heal each replaced the gang's pods: at least the
+    # initial, shrunk, and regrown generations trained
+    assert obs.get("generations", 0) >= 2, obs
     assert ("shrink", "2x2x1", "2x1x1") in obs["resizes"], obs
     assert ("grow", "2x1x1", "2x2x1") in obs["resizes"], obs
     assert obs["continuity"]["ok"], obs["continuity"]

@@ -1,0 +1,482 @@
+"""The pod data plane (tpu_operator/dataplane/): worker-pod rendering
+and ownership, the rendezvous handshake, the sim kubelet's pod
+lifecycle, and the KV-aware router's scoring/admission/handoff logic.
+
+Router tests run against stub engines (pure python) so the scoring
+policy is pinned independently of the jax decode engine; the engine
+integration is covered by bench.py --pod-smoke and tests/test_serving.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.dataplane.pods import (
+    WorkerPodSet,
+    job_worker_name,
+    rendezvous_state,
+    serving_worker_name,
+)
+from tpu_operator.dataplane.router import KVAwareRouter
+from tpu_operator.dataplane.worker import (
+    register_pod_main,
+    resolve_pod_main,
+)
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.sim import PodKubelet
+
+NS = "tpu-operator"
+
+
+# -- naming + rendezvous ------------------------------------------------------
+
+
+def test_worker_names_carry_the_documented_infixes():
+    assert job_worker_name("train", 3) == "train-worker-3"
+    assert serving_worker_name("chat", consts.SERVING_POOL_PREFILL, 0) == (
+        "chat-prefill-0"
+    )
+    assert serving_worker_name("chat", consts.SERVING_POOL_DECODE, 1) == (
+        "chat-decode-1"
+    )
+    assert serving_worker_name("chat", "", 2) == "chat-decode-2"  # aggregated
+
+
+def test_rendezvous_complete_only_when_every_index_holds_current_hash():
+    data = {
+        f"{consts.JOB_RENDEZVOUS_PREFIX}0": "g2",
+        f"{consts.JOB_RENDEZVOUS_PREFIX}1": "g2",
+        f"{consts.JOB_RENDEZVOUS_PREFIX}2": "g1",  # prior generation draining
+    }
+    state = rendezvous_state(data, 3, "g2")
+    assert state["checked_in"] == [0, 1]
+    assert state["stale"] == [2]
+    assert not state["complete"]
+    data[f"{consts.JOB_RENDEZVOUS_PREFIX}2"] = "g2"
+    assert rendezvous_state(data, 3, "g2")["complete"]
+
+
+def test_rendezvous_empty_gang_is_never_complete():
+    assert not rendezvous_state({}, 0, "g1")["complete"]
+    assert not rendezvous_state(None, 2, "g1")["complete"]
+
+
+# -- WorkerPodSet: render, converge, ownership --------------------------------
+
+
+def _owner(kind: str, name: str) -> dict:
+    return {
+        "apiVersion": "tpu.google.com/v1alpha1",
+        "kind": kind,
+        "metadata": {"name": name, "uid": f"uid-{name}"},
+    }
+
+
+def _workers(n: int, env_extra=None):
+    return [
+        {"name": f"train{consts.JOB_WORKER_INFIX}{i}",
+         "env": {consts.WORKER_ENV_JOB_NAME: "train",
+                 consts.WORKER_ENV_WORKER_INDEX: str(i),
+                 **(env_extra or {})}}
+        for i in range(n)
+    ]
+
+
+def test_converge_creates_owned_hashed_pods():
+    client = FakeClient()
+    pods = WorkerPodSet(client, NS)
+    report = pods.converge(_owner("TPUJob", "train"), consts.POD_MAIN_JOB_WORKER,
+                           _workers(2))
+    assert report["created"] == ["train-worker-0", "train-worker-1"]
+    pod = client.get("v1", "Pod", "train-worker-0", NS)
+    meta = pod["metadata"]
+    assert meta["labels"][consts.POD_MAIN_LABEL] == consts.POD_MAIN_JOB_WORKER
+    assert meta["annotations"][consts.WORKER_HASH_ANNOTATION]
+    refs = meta["ownerReferences"]
+    assert refs[0]["kind"] == "TPUJob" and refs[0]["name"] == "train"
+    env = {e["name"]: e.get("value", "")
+           for e in pod["spec"]["containers"][0]["env"]}
+    assert env[consts.WORKER_ENV_JOB_NAME] == "train"
+
+
+def test_converge_is_idempotent_and_replaces_on_spec_change():
+    client = FakeClient()
+    pods = WorkerPodSet(client, NS)
+    owner = _owner("TPUJob", "train")
+    pods.converge(owner, consts.POD_MAIN_JOB_WORKER, _workers(1))
+    again = pods.converge(owner, consts.POD_MAIN_JOB_WORKER, _workers(1))
+    assert again["kept"] == ["train-worker-0"] and not again["created"]
+    # an env change (new gang hash) is a delete+recreate, not a patch
+    changed = pods.converge(
+        owner, consts.POD_MAIN_JOB_WORKER,
+        _workers(1, env_extra={consts.WORKER_ENV_GANG_HASH: "g2"}))
+    assert changed["replaced"] == ["train-worker-0"]
+
+
+def test_converge_never_adopts_a_foreign_pod_with_the_same_name():
+    client = FakeClient()
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "train-worker-0", "namespace": NS},
+        "spec": {"containers": [{"name": "user"}]},
+    })
+    pods = WorkerPodSet(client, NS)
+    report = pods.converge(_owner("TPUJob", "train"),
+                           consts.POD_MAIN_JOB_WORKER, _workers(1))
+    assert report["foreign"] == ["train-worker-0"]
+    pod = client.get("v1", "Pod", "train-worker-0", NS)
+    assert "ownerReferences" not in pod["metadata"]  # untouched
+    assert pod["spec"]["containers"][0]["name"] == "user"
+
+
+def test_sweep_deletes_owned_only_standalone_worker_names_survive():
+    """The PR 13/15 ownership pin, extended to pods: a user's standalone
+    pod whose name collides with <job>-worker-<i> / <serving>-prefill-<i>
+    is NEVER deleted by the sweep — even when it spoofs the managed-by
+    label — because only the controller ownerReference licenses it."""
+    client = FakeClient()
+    pods = WorkerPodSet(client, NS)
+    pods.converge(_owner("TPUJob", "train"), consts.POD_MAIN_JOB_WORKER,
+                  _workers(2))
+    # standalone pods: one bare, one spoofing the managed-by label
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "train-worker-9", "namespace": NS},
+        "spec": {},
+    })
+    client.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "chat-prefill-0", "namespace": NS,
+                     "labels": {"app.kubernetes.io/managed-by":
+                                "tpu-workload-dataplane"}},
+        "spec": {},
+    })
+    deleted = pods.sweep("TPUJob", "train")
+    assert sorted(deleted) == ["train-worker-0", "train-worker-1"]
+    names = {p["metadata"]["name"] for p in client.list("v1", "Pod", NS)}
+    assert {"train-worker-9", "chat-prefill-0"} <= names
+
+
+def test_sweep_scopes_to_the_live_set_for_shrink():
+    client = FakeClient()
+    pods = WorkerPodSet(client, NS)
+    owner = _owner("TPUJob", "train")
+    pods.converge(owner, consts.POD_MAIN_JOB_WORKER, _workers(3))
+    deleted = pods.sweep("TPUJob", "train",
+                         live=["train-worker-0", "train-worker-1"])
+    assert deleted == ["train-worker-2"]
+
+
+def test_route_weight_patch_reports_a_vanished_pod():
+    client = FakeClient()
+    pods = WorkerPodSet(client, NS)
+    pods.converge(_owner("TPUServing", "chat"),
+                  consts.POD_MAIN_SERVING_WORKER,
+                  [{"name": "chat-decode-0", "env": {}}])
+    assert pods.patch_route_weight("chat-decode-0", 0.5)
+    pod = client.get("v1", "Pod", "chat-decode-0", NS)
+    assert pod["metadata"]["annotations"][
+        consts.WORKER_ROUTE_WEIGHT_ANNOTATION] == "0.5"
+    assert not pods.patch_route_weight("chat-decode-9", 1.0)
+
+
+# -- PodKubelet: the sim's fake-kubelet mode ----------------------------------
+
+
+class _ScriptedMain:
+    """A registered pod main whose step() follows a script: int n = run
+    n beats then succeed; "crash" = raise on the first beat."""
+
+    def __init__(self, client, namespace, env):
+        self.env = env
+        self.beats = 0
+        self.script = env.get("SCRIPT", "1")
+
+    def step(self) -> bool:
+        if self.script == "crash":
+            raise RuntimeError("scripted crash")
+        self.beats += 1
+        return self.beats >= int(self.script)
+
+
+@pytest.fixture()
+def scripted_main_kind():
+    kind = "test-scripted-main"
+    register_pod_main(kind, _ScriptedMain)
+    return kind
+
+
+def _scripted_pod(name: str, kind: str, script: str, spec_hash: str = "h1"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": NS,
+            "labels": {consts.POD_MAIN_LABEL: kind},
+            "annotations": {consts.WORKER_HASH_ANNOTATION: spec_hash},
+        },
+        "spec": {"containers": [{"name": "worker", "env": [
+            {"name": "SCRIPT", "value": script},
+        ]}]},
+    }
+
+
+def test_kubelet_runs_main_to_succeeded(scripted_main_kind):
+    client = FakeClient()
+    client.create(_scripted_pod("w-0", scripted_main_kind, "2"))
+    kubelet = PodKubelet(client, NS)
+    try:
+        first = kubelet.step()
+        assert first["pods"] == 1 and first["stepped"] == 1
+        phase = (client.get("v1", "Pod", "w-0", NS).get("status") or {}).get("phase")
+        assert phase == "Running"
+        kubelet.step()  # second beat: the script finishes
+        kubelet.step()  # terminal phase reported once
+        phase = (client.get("v1", "Pod", "w-0", NS).get("status") or {}).get("phase")
+        assert phase == "Succeeded"
+        # terminal pods are never restarted
+        assert kubelet.step()["stepped"] == 0
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_fails_pod_on_crash_and_unknown_kind(scripted_main_kind):
+    client = FakeClient()
+    client.create(_scripted_pod("w-crash", scripted_main_kind, "crash"))
+    client.create(_scripted_pod("w-alien", "no-such-main", "1"))
+    kubelet = PodKubelet(client, NS)
+    try:
+        kubelet.step()
+        kubelet.step()
+        phases = {
+            n: (client.get("v1", "Pod", n, NS).get("status") or {}).get("phase")
+            for n in ("w-crash", "w-alien")
+        }
+        assert phases == {"w-crash": "Failed", "w-alien": "Failed"}
+    finally:
+        kubelet.stop()
+
+
+def test_kubelet_hash_change_retires_the_old_generation(scripted_main_kind):
+    client = FakeClient()
+    client.create(_scripted_pod("w-0", scripted_main_kind, "99", spec_hash="g1"))
+    kubelet = PodKubelet(client, NS)
+    try:
+        kubelet.step()
+        gen1 = kubelet.mains()["w-0"]
+        # the owning controller replaces the pod (new spec hash)
+        client.delete("v1", "Pod", "w-0", NS)
+        client.create(_scripted_pod("w-0", scripted_main_kind, "99",
+                                    spec_hash="g2"))
+        kubelet.step()
+        gen2 = kubelet.mains()["w-0"]
+        assert gen2 is not gen1
+        assert [name for name, _ in kubelet.retired] == ["w-0"]
+    finally:
+        kubelet.stop()
+    # stop() retires the live generation too
+    assert len(kubelet.retired) == 2 and not kubelet.mains()
+
+
+def test_kubelet_deleted_pod_stops_its_main(scripted_main_kind):
+    client = FakeClient()
+    client.create(_scripted_pod("w-0", scripted_main_kind, "99"))
+    kubelet = PodKubelet(client, NS)
+    try:
+        kubelet.step()
+        client.delete("v1", "Pod", "w-0", NS)
+        report = kubelet.step()
+        assert report["pods"] == 0 and not kubelet.mains()
+        assert [name for name, _ in kubelet.retired] == ["w-0"]
+    finally:
+        kubelet.stop()
+
+
+def test_registry_resolves_the_shipped_mains():
+    assert resolve_pod_main(consts.POD_MAIN_JOB_WORKER) is not None
+    assert resolve_pod_main(consts.POD_MAIN_SERVING_WORKER) is not None
+    assert resolve_pod_main("bogus") is None
+
+
+# -- KVAwareRouter: scoring, admission, handoff -------------------------------
+
+
+class _StubEngine:
+    def __init__(self, sessions=(), prefix_tokens=0, load=0,
+                 prefilling=0, max_batch=8):
+        self._sessions = set(sessions)
+        self._prefix_tokens = prefix_tokens
+        self.slots = {i: None for i in range(load)}
+        self.queue = []
+        self.prefilling_lanes = prefilling
+        self.completed = []
+        self.decoded_tokens = 0
+        self.prefilled_done = []
+
+        class _Cfg:
+            pass
+
+        self.cfg = _Cfg()
+        self.cfg.max_batch = max_batch
+
+    def has_session(self, session):
+        return session in self._sessions
+
+    def cached_prefix_tokens(self, prompt):
+        return min(self._prefix_tokens, int(prompt.shape[0]))
+
+
+class _StubMain:
+    def __init__(self, serving_name, replica, pool="", **engine_kw):
+        self.serving_name = serving_name
+        self.replica = replica
+        self.pool = pool
+        self.engine = _StubEngine(**engine_kw)
+        self.submitted = []
+        self.handed_off = []
+
+    def submit(self, request):
+        self.submitted.append(request)
+        self.engine.queue.append(request)
+
+    def submit_prefilled(self, request, kv):
+        self.handed_off.append((request, kv))
+
+
+class _Req:
+    def __init__(self, rid, plen=16, session=""):
+        self.rid = rid
+        self.prompt = np.zeros((plen,), dtype=np.int32)
+        self.session = session
+
+
+def _router(client=None):
+    return KVAwareRouter(client or FakeClient(), NS, "chat")
+
+
+def test_sync_workers_splits_pools_and_filters_other_servings():
+    router = _router()
+    router.sync_workers({
+        "chat-decode-0": _StubMain("chat", "chat-replica-0"),
+        "chat-prefill-0": _StubMain("chat", "chat-replica-1",
+                                    pool=consts.SERVING_POOL_PREFILL),
+        "other-decode-0": _StubMain("other", "other-replica-0"),
+    })
+    assert set(router.workers) == {"chat-decode-0"}
+    assert set(router.prefill_workers) == {"chat-prefill-0"}
+
+
+def test_session_affinity_outscores_an_emptier_replica():
+    router = _router()
+    holder = _StubMain("chat", "chat-replica-0", sessions={"conv-1"}, load=3)
+    empty = _StubMain("chat", "chat-replica-1")
+    router.sync_workers({"chat-decode-0": holder, "chat-decode-1": empty})
+    router.submit(_Req("r1", session="conv-1"))
+    router.tick()
+    assert holder.submitted and not empty.submitted
+    assert router.kv_hit_ratio == 0.0  # first routing SETS the map
+    router.submit(_Req("r2", session="conv-1"))
+    router.tick()
+    assert router.kv_hit_ratio == 0.5  # second lands on the holder: a hit
+
+
+def test_prefix_cache_bonus_breaks_the_tie():
+    router = _router()
+    cached = _StubMain("chat", "chat-replica-0", prefix_tokens=16)
+    cold = _StubMain("chat", "chat-replica-1")
+    router.sync_workers({"chat-decode-0": cached, "chat-decode-1": cold})
+    router.submit(_Req("r1", plen=16))
+    router.tick()
+    assert cached.submitted and not cold.submitted
+    assert router.prefix_routed == 1
+
+
+def test_admission_holds_when_every_replica_is_prefill_saturated():
+    router = _router()
+    busy = _StubMain("chat", "chat-replica-0", prefilling=2)  # at the cap
+    router.sync_workers({"chat-decode-0": busy})
+    router.submit(_Req("r1"))
+    report = router.tick()
+    assert report["admitted"] == 0 and report["queued"] == 1
+    assert not busy.submitted
+    busy.engine.prefilling_lanes = 0  # headroom frees next tick
+    assert router.tick()["admitted"] == 1
+
+
+def test_zero_weight_replica_is_excluded_from_routing():
+    client = FakeClient()
+    client.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "chat" + consts.SERVING_LOAD_SUFFIX,
+                     "namespace": NS},
+        "data": {consts.SERVING_ROUTING_KEY:
+                 '{"chat-replica-0": 0.0, "chat-replica-1": 1.0}'},
+    })
+    router = _router(client)
+    excluded = _StubMain("chat", "chat-replica-0")
+    routable = _StubMain("chat", "chat-replica-1", load=5)  # busier, but legal
+    router.sync_workers({"chat-decode-0": excluded, "chat-decode-1": routable})
+    router.submit(_Req("r1"))
+    router.tick()
+    assert routable.submitted and not excluded.submitted
+
+
+def test_handoff_moves_prefilled_kv_to_decode_and_meters_bytes():
+    router = _router()
+    prefill = _StubMain("chat", "chat-replica-p0",
+                        pool=consts.SERVING_POOL_PREFILL)
+    decode = _StubMain("chat", "chat-replica-0")
+    request = _Req("r1", session="conv-1")
+    kv = {"k": np.zeros((2, 8, 4), dtype=np.float32),
+          "v": np.zeros((2, 8, 4), dtype=np.float32)}
+    prefill.engine.prefilled_done.append({"request": request, "kv": kv})
+    router.sync_workers({"chat-prefill-0": prefill, "chat-decode-0": decode})
+    report = router.tick()
+    assert report["handoffs"] == 1
+    assert decode.handed_off[0][0] is request
+    assert router.handoff_bytes == kv["k"].nbytes + kv["v"].nbytes
+    # the session now lives on the DECODE replica the KV landed on
+    assert router.sessions["conv-1"] == "chat-replica-0"
+
+
+def test_handoff_waits_when_the_decode_pool_is_saturated():
+    router = _router()
+    prefill = _StubMain("chat", "chat-replica-p0",
+                        pool=consts.SERVING_POOL_PREFILL)
+    decode = _StubMain("chat", "chat-replica-0", prefilling=2)
+    prefill.engine.prefilled_done.append(
+        {"request": _Req("r1"),
+         "kv": {"k": np.zeros((1,), np.float32),
+                "v": np.zeros((1,), np.float32)}})
+    router.sync_workers({"chat-prefill-0": prefill, "chat-decode-0": decode})
+    assert router.tick()["handoffs"] == 0
+    assert prefill.engine.prefilled_done  # still queued on the prefill side
+    decode.engine.prefilling_lanes = 0
+    assert router.tick()["handoffs"] == 1
+
+
+def test_publish_writes_kv_telemetry_and_pool_signals():
+    client = FakeClient()
+    router = _router(client)
+    prefill = _StubMain("chat", "chat-replica-p0",
+                        pool=consts.SERVING_POOL_PREFILL)
+    decode = _StubMain("chat", "chat-replica-0")
+    decode.engine.decoded_tokens = 40
+    router.sync_workers({"chat-prefill-0": prefill, "chat-decode-0": decode})
+    router.publish()
+    data = client.get("v1", "ConfigMap", "chat" + consts.SERVING_LOAD_SUFFIX,
+                      NS)["data"]
+    assert consts.SERVING_LOAD_KV_HIT_RATIO in data
+    assert consts.SERVING_LOAD_HANDOFF_BYTES in data
+    assert float(data[consts.SERVING_LOAD_DECODE_TOKENS_PER_S]) > 0
+    assert consts.SERVING_LOAD_PREFILL_TTFT_P99 in data
+
+
+def test_publish_omits_pool_signals_for_aggregated_serving():
+    client = FakeClient()
+    router = _router(client)
+    router.sync_workers({"chat-decode-0": _StubMain("chat", "chat-replica-0")})
+    router.publish()
+    data = client.get("v1", "ConfigMap", "chat" + consts.SERVING_LOAD_SUFFIX,
+                      NS)["data"]
+    assert consts.SERVING_LOAD_PREFILL_TTFT_P99 not in data
+    assert consts.SERVING_LOAD_DECODE_TOKENS_PER_S not in data

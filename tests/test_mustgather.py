@@ -88,6 +88,34 @@ def test_bundle_from_live_install(tmp_path):
             }}},
         )
 
+        # a rendered worker pod + published router weights so pods.txt
+        # (the data-plane view) is proven non-trivially
+        from tpu_operator import consts
+
+        store.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "bundle-serving-decode-0", "namespace": NS,
+                "labels": {consts.POD_MAIN_LABEL: consts.POD_MAIN_SERVING_WORKER},
+                "annotations": {
+                    consts.WORKER_HASH_ANNOTATION: "abc123def456",
+                    consts.WORKER_ROUTE_WEIGHT_ANNOTATION: "1.0",
+                },
+            },
+            "spec": {"containers": [{"name": "worker", "env": []}]},
+            "status": {"phase": "Running"},
+        })
+        store.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": "bundle-serving" + consts.SERVING_LOAD_SUFFIX,
+                "namespace": NS,
+            },
+            "data": {
+                consts.SERVING_ROUTING_KEY: '{"bundle-serving-replica-0": 1.0}',
+            },
+        })
+
         written = collect(client, NS, str(tmp_path))
 
         def collected_state():
@@ -169,6 +197,17 @@ def test_bundle_from_live_install(tmp_path):
         assert "decision pass=3  scale-up  arrival rate 14.0 rps" in serving_txt
         servings = list(yaml.safe_load_all((tmp_path / "tpuservings.yaml").read_text()))
         assert servings[0]["metadata"]["name"] == "bundle-serving"
+        # the data-plane view: rendered worker pods with generation hash
+        # + route weight, rendezvous handshake state, router weights
+        pods_txt = (tmp_path / "pods.txt").read_text()
+        assert "# worker pods" in pods_txt
+        assert (
+            "bundle-serving-decode-0  main=tpu-serving-worker  phase=Running"
+            "  hash=abc123def456  routeWeight=1.0" in pods_txt
+        )
+        assert "# job rendezvous (progress ConfigMap handshake)" in pods_txt
+        assert "# serving router weights (load ConfigMap)" in pods_txt
+        assert "'bundle-serving-replica-0': 1.0" in pods_txt
         pod_name = pod["metadata"]["name"]
         log_text = (tmp_path / "pod-logs" / f"{pod_name}.log").read_text()
         assert "line-1\nline-2\n" in log_text  # multi-container pods get headers
@@ -182,7 +221,7 @@ def test_bundle_from_live_install(tmp_path):
             "version.txt", "all.txt",
             "nodes.yaml", "node-labels.txt", "node-health.txt", "placement.txt",
             "clusterpolicies.yaml", "tpuslices.yaml", "tpujobs.yaml", "jobs.txt",
-            "tpuservings.yaml", "serving.txt",
+            "tpuservings.yaml", "serving.txt", "pods.txt",
             "daemonsets.yaml", "pods.yaml", "services.yaml", "configmaps.yaml",
             "events.txt", "pod-logs", "traces.txt", "slow-reconciles.txt",
             "telemetry.txt", "fabric.txt",

@@ -101,11 +101,33 @@ class ServingBackoffSpec(SpecBase):
 
 
 @dataclasses.dataclass
+class ServingDisaggregationSpec(SpecBase):
+    """Disaggregated prefill/decode pools. When ``enabled``, the replica
+    window (``spec.replicas``) governs the *decode* pool and a separate
+    prefill pool of ``prefillMin``..``prefillMax`` replicas chunk-prefills
+    prompts and hands the paged KV to decode replicas. Each pool scales
+    on its own signal: prefill on measured prefill TTFT p99 against the
+    SLO, decode on the rate math plus ``decodeTokensPerSFloor`` (scale up
+    when aggregate decode throughput sags below the floor under load).
+    ``prefillShape``/``prefillPool`` override the model shape/pool pin
+    for prefill replicas (compute-rich blocks on a different pool)."""
+
+    enabled: bool = field(default=False)
+    prefill_min: int = field(json="prefillMin", default=1)
+    prefill_max: int = field(json="prefillMax", default=1)
+    prefill_shape: str = field(json="prefillShape", default="")
+    prefill_pool: str = field(json="prefillPool", default="")
+    decode_tokens_per_s_floor: float = field(
+        json="decodeTokensPerSFloor", default=0.0)
+
+
+@dataclasses.dataclass
 class TPUServingSpec(SpecBase):
     model: ServingModelSpec = sub(ServingModelSpec)
     replicas: ServingReplicasSpec = sub(ServingReplicasSpec)
     slo: ServingSLOSpec = sub(ServingSLOSpec)
     backoff: ServingBackoffSpec = sub(ServingBackoffSpec)
+    disaggregation: ServingDisaggregationSpec = sub(ServingDisaggregationSpec)
 
 
 @dataclasses.dataclass

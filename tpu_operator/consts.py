@@ -225,6 +225,47 @@ JOB_HISTORY_LIMIT = 10
 JOB_CAUSES_LIMIT = 5
 
 # ---------------------------------------------------------------------------
+# Pod data plane (tpu_operator/dataplane/). The job and serving
+# controllers render one worker Pod per gang member / per replica
+# through the same manifest-render + hash-converge machinery the slice
+# manager agent uses for its gang pods. A worker pod's main is selected
+# by POD_MAIN_LABEL; the sim kubelet (kube/sim.py PodKubelet) resolves
+# the label value against the dataplane worker registry and runs the
+# main in a thread, so the whole data plane proves out on the CPU sim.
+# Workers rendezvous through the job progress ConfigMap: each member
+# publishes rendezvous.<index> = its gang hash, and the chief gates
+# training until every expected index has checked in with the same
+# hash (a stale hash is a worker from a previous generation).
+# ---------------------------------------------------------------------------
+POD_MAIN_LABEL = "tpu.google.com/pod-main"
+POD_MAIN_JOB_WORKER = "tpu-job-worker"
+POD_MAIN_SERVING_WORKER = "tpu-serving-worker"
+# spec-hash annotation on rendered worker pods (same delete+recreate
+# convergence as GANG_HASH_ANNOTATION on the slice manager's gang pods)
+WORKER_HASH_ANNOTATION = "tpu.google.com/worker-hash"
+# router-weight annotation the serving controller patches onto decode
+# worker pods so the data-plane router can read its weights from the
+# pods themselves (the load-CM routing key stays authoritative)
+WORKER_ROUTE_WEIGHT_ANNOTATION = "tpu.google.com/route-weight"
+# worker env contract (rendered into the pod spec, read by pod mains)
+WORKER_ENV_JOB_NAME = "TPU_JOB_NAME"
+WORKER_ENV_WORKER_INDEX = "TPU_WORKER_INDEX"
+WORKER_ENV_WORKER_COUNT = "TPU_WORKER_COUNT"
+WORKER_ENV_GANG_HASH = "TPU_GANG_HASH"
+WORKER_ENV_CHECKPOINT_DIR = "TPU_CHECKPOINT_DIR"
+WORKER_ENV_SERVING_NAME = "TPU_SERVING_NAME"
+WORKER_ENV_REPLICA_NAME = "TPU_REPLICA_NAME"
+WORKER_ENV_POOL = "TPU_POOL"
+WORKER_ENV_NAMESPACE = "TPU_NAMESPACE"
+WORKER_ENV_STEPS_PER_SYNC = "TPU_STEPS_PER_SYNC"
+# worker pod name shapes: <job> + JOB_WORKER_INFIX + <member index>,
+# <serving> + SERVING_PREFILL_INFIX/SERVING_DECODE_INFIX + <index>
+JOB_WORKER_INFIX = "-worker-"
+# worker-owned progress-CM key prefix (disjoint from the trainer's and
+# the controllers' keys): rendezvous.<index> = gang hash
+JOB_RENDEZVOUS_PREFIX = "rendezvous."
+
+# ---------------------------------------------------------------------------
 # Traffic-driven elastic serving (api/tpuserving.py ->
 # controllers/serving_controller.py -> workloads/serving.py). The
 # serving controller owns one TPUSlice per replica (named <serving> +
@@ -261,6 +302,27 @@ SERVING_SCALE_DOWN_COOLDOWN_SECONDS = 30.0
 SERVING_SCALE_DOWN_HEADROOM = 0.8
 # status.serving scale-decision history bound (last N with reasons)
 SERVING_DECISIONS_LIMIT = 5
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode pools (spec.disaggregation on TPUServing).
+# Prefill replicas (compute-rich shapes) chunk-prefill prompts and hand
+# the paged KV to a decode replica; each pool autoscales on its own
+# signal — prefill on TTFT p99 against the SLO, decode on tokens/s
+# demand — published under its own load-CM keys so neither pool's
+# controller re-derives the other's blame.
+# ---------------------------------------------------------------------------
+SERVING_PREFILL_INFIX = "-prefill-"
+SERVING_DECODE_INFIX = "-decode-"
+SERVING_POOL_PREFILL = "prefill"
+SERVING_POOL_DECODE = "decode"
+# traffic-side per-pool load keys (alongside the aggregate keys above)
+SERVING_LOAD_PREFILL_TTFT_P99 = "prefillTtftP99"   # seconds, prefill pool only
+SERVING_LOAD_DECODE_TOKENS_PER_S = "decodeTokensPerS"  # decode pool throughput
+SERVING_LOAD_KV_HIT_RATIO = "kvHitRatio"           # router KV reuse [0,1]
+SERVING_LOAD_HANDOFF_BYTES = "handoffBytes"        # cumulative prefill->decode KV bytes
+# controller-owned load key: JSON {pool name: replica count} so the
+# router and must-gather see the pool split without listing slices
+SERVING_POOLS_KEY = "pools"
 
 # ---------------------------------------------------------------------------
 # Capacity planning & scheduled defragmentation (tpu_operator/planning/

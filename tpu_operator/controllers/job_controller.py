@@ -97,6 +97,60 @@ class JobReconciler:
 
         self._series_lock = racecheck.lock("JobReconciler._series_lock")
         self._job_series: set = set()
+        self._pod_set = None  # lazy: the manager swaps the client post-init
+
+    @property
+    def pods(self):
+        """The worker-pod converger (the pod data plane's control-plane
+        half), bound to whatever client the reconciler currently holds."""
+        from tpu_operator.dataplane.pods import WorkerPodSet
+
+        if self._pod_set is None or self._pod_set.client is not self.client:
+            self._pod_set = WorkerPodSet(self.client, self.namespace)
+        return self._pod_set
+
+    # -- worker pods ---------------------------------------------------------
+
+    def _converge_workers(
+        self, obj: ObjectDict, job: TPUJob, gang_nodes: List[str], shape: str
+    ) -> None:
+        """One worker Pod per gang member, pinned to its node. The gang
+        hash (job + shape + member set) rides every worker's env: a
+        re-place renders different hashes, the convergence loop replaces
+        the pods, and the new generation re-runs the rendezvous — stale
+        check-ins from the old generation can never complete it."""
+        from tpu_operator.dataplane.pods import job_worker_name
+        from tpu_operator.utils import object_hash
+
+        gang_hash = object_hash(
+            {"job": job.name, "shape": shape, "nodes": list(gang_nodes)}
+        )[:12]
+        count = len(gang_nodes)
+        workers = []
+        for index, node_name in enumerate(gang_nodes):
+            env = {
+                consts.WORKER_ENV_JOB_NAME: job.name,
+                consts.WORKER_ENV_WORKER_INDEX: str(index),
+                consts.WORKER_ENV_WORKER_COUNT: str(count),
+                consts.WORKER_ENV_GANG_HASH: gang_hash,
+                consts.WORKER_ENV_NAMESPACE: self.namespace,
+            }
+            if job.spec.checkpoint.dir:
+                env[consts.WORKER_ENV_CHECKPOINT_DIR] = job.spec.checkpoint.dir
+            node = self.client.get_or_none("v1", "Node", node_name)
+            chips = self._int(
+                (((node or {}).get("status") or {}).get("capacity") or {})
+                .get(consts.TPU_RESOURCE_NAME)
+            )
+            workers.append({
+                "name": job_worker_name(job.name, index),
+                "env": env,
+                "node": node_name,
+                "chips": chips,
+            })
+        self.pods.converge(obj, consts.POD_MAIN_JOB_WORKER, workers)
+        # a shrink leaves high-index workers behind: sweep them (owned only)
+        self.pods.sweep(TPU_JOB_KIND, job.name, live=[w["name"] for w in workers])
 
     # -- series hygiene ------------------------------------------------------
 
@@ -310,6 +364,7 @@ class JobReconciler:
             # request name may never have been a job)
             self._retire_series(req.name)
             self._delete_slice(req.name, owned_only=True)
+            self.pods.sweep(TPU_JOB_KIND, req.name)
             return Result()
         job = TPUJob.from_unstructured(obj)
         prior = dict(job.status.job or {})
@@ -382,6 +437,7 @@ class JobReconciler:
             block.update(phase=JobPhase.SUCCEEDED, hosts=0, message="")
             block.pop("nextAttemptAt", None)
             self._delete_slice(job.name)
+            self.pods.sweep(TPU_JOB_KIND, job.name)
             self.recorder.normal(
                 obj, "JobSucceeded",
                 f"training complete at step {step} (checkpoint epoch {epoch})",
@@ -442,6 +498,11 @@ class JobReconciler:
     ) -> Result:
         phase = block["phase"]
         hosts = block["hosts"]
+
+        # a healthy placed gang always has its worker pods converged —
+        # idempotent (hash match = no-op), and any generation change
+        # (re-place, resize) re-renders them with a fresh gang hash
+        self._converge_workers(obj, job, gang_nodes, _shape_str(target))
 
         if pstatus == consts.JOB_PROGRESS_FAILED:
             # the gang is placed but training errored: restart from the
@@ -753,6 +814,7 @@ class JobReconciler:
         block.pop("barrier", None)
         block.pop("defragPending", None)
         self._delete_slice(obj["metadata"]["name"])
+        self.pods.sweep(TPU_JOB_KIND, obj["metadata"]["name"])
         self.recorder.warning(obj, "JobFailed", f"quarantined: {message}")
 
     @staticmethod

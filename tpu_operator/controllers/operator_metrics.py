@@ -271,6 +271,35 @@ class OperatorMetrics:
             ["serving"],
             registry=reg,
         )
+        # pod data plane (tpu_operator/dataplane/): router KV reuse and
+        # disaggregated pool sizes, removed with the TPUServing (O005)
+        self.serving_kv_hit_ratio = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_kv_hit_ratio",
+            "Fraction of routed requests that re-landed on a replica "
+            "already holding their session or prefix KV pages (the "
+            "KV-aware router's reuse signal, from the load ConfigMap)",
+            ["serving"],
+            registry=reg,
+        )
+        self.serving_pool_replicas = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_pool_replicas",
+            "Ready replicas of one disaggregated pool of the serving "
+            "(pool = prefill | decode; absent while disaggregation is "
+            "off)",
+            ["serving", "pool"],
+            registry=reg,
+        )
+        self.serving_kv_handoff_bytes = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_serving_kv_handoff_bytes",
+            "Cumulative paged-KV bytes handed from the serving's prefill "
+            "pool to its decode replicas, as last reported into the "
+            "load ConfigMap",
+            ["serving"],
+            registry=reg,
+        )
         # capacity planning & scheduled defragmentation (controllers/
         # defrag_controller.py rides the planning package): per-pool
         # utilization and the analytical model's reference prediction,

@@ -95,12 +95,25 @@ class ServingReconciler:
 
         self._series_lock = racecheck.lock("ServingReconciler._series_lock")
         self._serving_series: set = set()
+        self._pod_set = None  # lazy: the manager swaps the client post-init
+
+    @property
+    def pods(self):
+        """The worker-pod converger (the pod data plane's control-plane
+        half), bound to whatever client the reconciler currently holds."""
+        from tpu_operator.dataplane.pods import WorkerPodSet
+
+        if self._pod_set is None or self._pod_set.client is not self.client:
+            self._pod_set = WorkerPodSet(self.client, self.namespace)
+        return self._pod_set
 
     # -- series hygiene ------------------------------------------------------
 
     def _export(
         self, serving: str, replicas: int, tokens_per_s: float,
         ttft_p99: float, queue_depth: int,
+        kv_hit_ratio: float = 0.0, handoff_bytes: float = 0.0,
+        pools: Optional[Dict[str, int]] = None,
     ) -> None:
         with self._series_lock:
             self._serving_series.add(serving)
@@ -108,6 +121,14 @@ class ServingReconciler:
         self.metrics.serving_tokens_per_s.labels(serving).set(tokens_per_s)
         self.metrics.serving_ttft_p99.labels(serving).set(ttft_p99)
         self.metrics.serving_queue_depth.labels(serving).set(queue_depth)
+        self.metrics.serving_kv_hit_ratio.labels(serving).set(kv_hit_ratio)
+        self.metrics.serving_kv_handoff_bytes.labels(serving).set(handoff_bytes)
+        # both pool series always exist (0 with disaggregation off), so
+        # retirement can remove a fixed label set
+        pools = pools or {}
+        for pool in (consts.SERVING_POOL_PREFILL, consts.SERVING_POOL_DECODE):
+            self.metrics.serving_pool_replicas.labels(serving, pool).set(
+                pools.get(pool, 0))
 
     def _retire_series(self, serving: str) -> None:
         with self._series_lock:
@@ -119,9 +140,16 @@ class ServingReconciler:
             self.metrics.serving_tokens_per_s,
             self.metrics.serving_ttft_p99,
             self.metrics.serving_queue_depth,
+            self.metrics.serving_kv_hit_ratio,
+            self.metrics.serving_kv_handoff_bytes,
         ):
             try:
                 gauge.remove(serving)
+            except KeyError:
+                pass
+        for pool in (consts.SERVING_POOL_PREFILL, consts.SERVING_POOL_DECODE):
+            try:
+                self.metrics.serving_pool_replicas.remove(serving, pool)
             except KeyError:
                 pass
 
@@ -138,9 +166,14 @@ class ServingReconciler:
 
         return degraded_link_pairs(self.client, self.namespace)
 
-    def _owned_replicas(self, serving: str) -> List[ObjectDict]:
+    def _owned_replicas(
+        self, serving: str, infix: Optional[str] = None
+    ) -> List[ObjectDict]:
         """Every TPUSlice carrying a TPUServing ownerReference naming
-        this serving — index order, so scale decisions are stable."""
+        this serving — index order, so scale decisions are stable.
+        ``infix`` narrows to one pool's slices (``-replica-`` for the
+        decode/aggregated set, ``-prefill-`` for the prefill pool); the
+        default returns them all (the deletion sweep)."""
         try:
             slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         except errors.ApiError:
@@ -151,8 +184,11 @@ class ServingReconciler:
                 ref.get("kind") == TPU_SERVING_KIND and ref.get("name") == serving
                 for ref in obj["metadata"].get("ownerReferences") or []
             ):
+                if infix is not None and not obj["metadata"]["name"].startswith(
+                        serving + infix):
+                    continue
                 owned.append(obj)
-        prefix = serving + consts.SERVING_REPLICA_INFIX
+        prefix = serving + (infix or consts.SERVING_REPLICA_INFIX)
 
         def index_of(obj: ObjectDict) -> int:
             name = obj["metadata"]["name"]
@@ -267,6 +303,21 @@ class ServingReconciler:
             reason = (
                 f"SLO breach (ttft_p99 {ttft_p99:.2f}s, queue {queue_depth})"
             )
+        disagg = serving.spec.disaggregation
+        dec_tps = self._float(load.get(consts.SERVING_LOAD_DECODE_TOKENS_PER_S))
+        if (
+            disagg.enabled and disagg.decode_tokens_per_s_floor > 0
+            and 0 < dec_tps < disagg.decode_tokens_per_s_floor
+            and ready >= current and current + 1 > need
+        ):
+            # the decode pool's own signal: aggregate decode throughput
+            # sagging below the floor under load adds a decode replica
+            # even when the arrival-rate math still fits
+            need = min(hi, current + 1)
+            reason = (
+                f"decode throughput {dec_tps:.1f} tok/s below floor "
+                f"{disagg.decode_tokens_per_s_floor:g}"
+            )
         if need > current:
             block.pop("lowSince", None)
             return need, f"scale up {current} -> {need}: {reason}"
@@ -312,14 +363,14 @@ class ServingReconciler:
             }
         }
 
-    def _create_replica(self, obj: ObjectDict, serving: TPUServing, index: int) -> bool:
-        body = new_tpu_slice(
-            replica_name(serving.name, index), self._slice_spec(serving)
-        )
+    def _create_slice(
+        self, obj: ObjectDict, serving_name: str, name: str, spec: dict
+    ) -> bool:
+        body = new_tpu_slice(name, spec)
         body["metadata"]["ownerReferences"] = [{
             "apiVersion": TPU_SERVING_API_VERSION,
             "kind": TPU_SERVING_KIND,
-            "name": serving.name,
+            "name": serving_name,
             "uid": obj["metadata"].get("uid", ""),
         }]
         try:
@@ -327,9 +378,15 @@ class ServingReconciler:
         except errors.AlreadyExists:
             return True
         except errors.ApiError as e:
-            log.warning("serving %s: replica create failed: %s", serving.name, e)
+            log.warning("serving %s: replica create failed: %s", serving_name, e)
             return False
         return True
+
+    def _create_replica(self, obj: ObjectDict, serving: TPUServing, index: int) -> bool:
+        return self._create_slice(
+            obj, serving.name,
+            replica_name(serving.name, index), self._slice_spec(serving),
+        )
 
     def _delete_replica(self, name: str) -> bool:
         try:
@@ -342,6 +399,79 @@ class ServingReconciler:
             log.warning("serving replica %s delete failed: %s", name, e)
             return False
         return True
+
+    # -- the prefill pool (disaggregation) -----------------------------------
+
+    def _prefill_slice_spec(self, serving: TPUServing) -> dict:
+        model = serving.spec.model
+        disagg = serving.spec.disaggregation
+        pool = disagg.prefill_pool or model.pool
+        return {
+            "placement": {
+                "shape": disagg.prefill_shape or model.shape,
+                "priority": model.priority,
+                "preemptionPolicy": "Never",
+                **({"pool": pool} if pool else {}),
+            }
+        }
+
+    def _reconcile_prefill(
+        self, obj: ObjectDict, serving: TPUServing, block: dict,
+        load: dict, links: List[tuple], now: float,
+    ) -> List[dict]:
+        """Converge the prefill pool on ITS OWN signal: the router's
+        measured prefill TTFT p99 against the SLO target. A breach adds
+        a prefill replica immediately; TTFT sitting comfortably inside
+        (half the target) retires the highest-index one per cooldown —
+        the decode pool's rate/throughput math never touches this count."""
+        disagg = serving.spec.disaggregation
+        lo = max(0, disagg.prefill_min)
+        hi = max(max(1, lo), disagg.prefill_max)
+        current = self._int(block.get("prefillDesired"), -1)
+        current = min(max(current if current >= 0 else lo, lo), hi)
+        ttft = self._float(load.get(consts.SERVING_LOAD_PREFILL_TTFT_P99))
+        target = serving.spec.slo.ttft_p99_seconds
+        desired = current
+        reason = ""
+        if ttft > target and current < hi:
+            desired = current + 1
+            reason = (f"prefill scale up {current} -> {desired}: prefill "
+                      f"TTFT p99 {ttft:.3f}s > {target:g}s")
+        elif ttft and ttft < 0.5 * target and current > lo:
+            cooldown = max(0.0, serving.spec.replicas.cooldown_seconds)
+            if now - self._float(block.get("lastPrefillScaleAt")) >= cooldown:
+                desired = current - 1
+                reason = (f"prefill scale down {current} -> {desired}: "
+                          f"prefill TTFT p99 {ttft:.3f}s well inside {target:g}s")
+        if reason:
+            block["lastPrefillScaleAt"] = round(now, 3)
+            self._note_decision(block, "prefill-scale", reason)
+            self.recorder.normal(obj, "ServingPrefillScaled", reason)
+        block["prefillDesired"] = desired
+        replicas = self._owned_replicas(
+            serving.name, infix=consts.SERVING_PREFILL_INFIX)
+        if len(replicas) < desired:
+            have = {o["metadata"]["name"] for o in replicas}
+            for index in range(hi):
+                if len(have) >= desired:
+                    break
+                name = f"{serving.name}{consts.SERVING_PREFILL_INFIX}{index}"
+                if name in have:
+                    continue
+                if not self._create_slice(
+                        obj, serving.name, name, self._prefill_slice_spec(serving)):
+                    break
+                have.add(name)
+            replicas = self._owned_replicas(
+                serving.name, infix=consts.SERVING_PREFILL_INFIX)
+        elif len(replicas) > desired:
+            # one per pass, highest index first (prefill replicas hold no
+            # session KV, so victim choice is free — keep indexes dense)
+            victim = replicas[-1]["metadata"]["name"]
+            if self._delete_replica(victim):
+                self._note_decision(block, "prefill-victim", f"retired {victim}")
+                replicas = replicas[:-1]
+        return [self._replica_state(o, links) for o in replicas]
 
     def _sweep_owned(self, serving: str) -> None:
         """Deleted serving: tear down every ownerRef-verified replica
@@ -367,6 +497,58 @@ class ServingReconciler:
         scores = scale_down_scores(slices, nodes, candidates, degraded_links=links)
         return pick_scale_down_victim(scores), scores
 
+    # -- worker pods ---------------------------------------------------------
+
+    @staticmethod
+    def _replica_index(slice_name: str) -> int:
+        try:
+            return int(slice_name.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+
+    def _converge_workers(
+        self, obj: ObjectDict, serving: TPUServing,
+        states: List[dict], prefill_states: List[dict],
+    ) -> Dict[str, str]:
+        """One worker Pod per ready replica, pinned to the replica's
+        first gang node. Returns {replica slice name: pod name}. A
+        replica that stops being ready loses its pod on the next pass
+        (swept — its engine's KV dies with the gang, which is exactly
+        what a real node loss costs)."""
+        from tpu_operator.dataplane.pods import serving_worker_name
+
+        disagg = serving.spec.disaggregation
+        workers: List[dict] = []
+        pod_names: Dict[str, str] = {}
+
+        def add(state: dict, pool: str, pool_env: str) -> None:
+            name = serving_worker_name(
+                serving.name, pool, self._replica_index(state["name"]))
+            pod_names[state["name"]] = name
+            workers.append({
+                "name": name,
+                "env": {
+                    consts.WORKER_ENV_SERVING_NAME: serving.name,
+                    consts.WORKER_ENV_REPLICA_NAME: state["name"],
+                    consts.WORKER_ENV_POOL: pool_env,
+                    consts.WORKER_ENV_NAMESPACE: self.namespace,
+                },
+                "node": state["nodes"][0] if state["nodes"] else "",
+            })
+
+        for state in states:
+            if state["ready"]:
+                add(state, consts.SERVING_POOL_DECODE,
+                    consts.SERVING_POOL_DECODE if disagg.enabled else "")
+        for state in prefill_states:
+            if state["ready"]:
+                add(state, consts.SERVING_POOL_PREFILL,
+                    consts.SERVING_POOL_PREFILL)
+        self.pods.converge(obj, consts.POD_MAIN_SERVING_WORKER, workers)
+        self.pods.sweep(
+            TPU_SERVING_KIND, serving.name, live=[w["name"] for w in workers])
+        return pod_names
+
     # -- status --------------------------------------------------------------
 
     def _publish(self, obj: ObjectDict, block: dict) -> bool:
@@ -390,8 +572,11 @@ class ServingReconciler:
             return False
         return True
 
-    def _publish_routing(self, serving: str, routing: Dict[str, float]) -> None:
-        """The controller-owned load-CM key the router consumes. Created
+    def _publish_routing(
+        self, serving: str, routing: Dict[str, float],
+        pools: Optional[Dict[str, dict]] = None,
+    ) -> None:
+        """The controller-owned load-CM keys the router consumes. Created
         on first use so routing exists before the first traffic tick;
         the traffic side owns the demand keys (disjoint sets on one CM,
         merge-patch semantics — the job progress CM convention)."""
@@ -399,6 +584,8 @@ class ServingReconciler:
 
         name = serving + consts.SERVING_LOAD_SUFFIX
         data = {consts.SERVING_ROUTING_KEY: json.dumps(routing, sort_keys=True)}
+        if pools is not None:
+            data[consts.SERVING_POOLS_KEY] = json.dumps(pools, sort_keys=True)
         try:
             self.client.patch("v1", "ConfigMap", name, {"data": data}, self.namespace)
         except errors.NotFound:
@@ -426,6 +613,7 @@ class ServingReconciler:
         block["message"] = message
         block.pop("nextAttemptAt", None)
         self._sweep_owned(obj["metadata"]["name"])
+        self.pods.sweep(TPU_SERVING_KIND, obj["metadata"]["name"])
         self.recorder.warning(obj, "ServingFailed", f"quarantined: {message}")
 
     # -- reconcile -----------------------------------------------------------
@@ -435,6 +623,7 @@ class ServingReconciler:
         if obj is None:
             self._retire_series(req.name)
             self._sweep_owned(req.name)
+            self.pods.sweep(TPU_SERVING_KIND, req.name)
             return Result()
         serving = TPUServing.from_unstructured(obj)
         prior = dict(serving.status.serving or {})
@@ -484,7 +673,8 @@ class ServingReconciler:
         # -- world state
         load = self._load(serving.name)
         links = self._degraded_links()
-        replicas = self._owned_replicas(serving.name)
+        replicas = self._owned_replicas(
+            serving.name, infix=consts.SERVING_REPLICA_INFIX)
         states = [self._replica_state(o, links) for o in replicas]
         now = time.time()
 
@@ -496,11 +686,21 @@ class ServingReconciler:
                 obj, serving, block, budget, load, links, replicas, states, now
             )
         ttft_p99 = self._float(load.get(consts.SERVING_LOAD_TTFT_P99))
+        pools_block = block.get("pools") or {}
         self._export(
             serving.name, block["ready"],
             self._float(load.get(consts.SERVING_LOAD_TOKENS_PER_S)),
             ttft_p99,
             self._int(load.get(consts.SERVING_LOAD_QUEUE_DEPTH)),
+            kv_hit_ratio=self._float(load.get(consts.SERVING_LOAD_KV_HIT_RATIO)),
+            handoff_bytes=self._float(load.get(consts.SERVING_LOAD_HANDOFF_BYTES)),
+            pools={
+                consts.SERVING_POOL_PREFILL: self._int(
+                    (pools_block.get(consts.SERVING_POOL_PREFILL) or {}).get("ready")),
+                consts.SERVING_POOL_DECODE: self._int(
+                    (pools_block.get(consts.SERVING_POOL_DECODE) or {}).get("ready"),
+                    block["ready"]),
+            },
         )
         ok = self._publish(obj, block)
         if not ok:
@@ -558,17 +758,52 @@ class ServingReconciler:
                 replicas = [o for o in replicas if o["metadata"]["name"] != victim]
                 states = [s for s in states if s["name"] != victim]
 
-        # -- routing: ready replicas minus fabric-excluded ones
+        # -- the prefill pool converges on its own signal
+        disagg = serving.spec.disaggregation
+        prefill_states: List[dict] = []
+        if disagg.enabled:
+            prefill_states = self._reconcile_prefill(
+                obj, serving, block, load, links, now)
+        else:
+            block.pop("prefillDesired", None)
+            block.pop("lastPrefillScaleAt", None)
+
+        # -- worker pods: one per placed replica, in both pools
+        pod_names = self._converge_workers(obj, serving, states, prefill_states)
+
+        # -- routing: ready replicas minus fabric-excluded ones; a worker
+        # pod the kubelet has marked Failed is unroutable even when its
+        # replica slice is healthy (the engine behind it is dead)
+        phases = self.pods.worker_phases(TPU_SERVING_KIND, serving.name)
         routing: Dict[str, float] = {}
         for state in states:
-            routing[state["name"]] = 1.0 if state["routable"] else 0.0
+            weight = 1.0 if state["routable"] else 0.0
+            if phases.get(pod_names.get(state["name"], "")) == "Failed":
+                weight = 0.0
+            routing[state["name"]] = weight
             if state["fabric_degraded"]:
                 self.recorder.warning(
                     obj, "ServingReplicaExcluded",
                     f"replica {state['name']} excluded from routing: fabric "
                     f"artifact shows a degraded ICI edge",
                 )
-        self._publish_routing(serving.name, routing)
+        prefill_ready = sum(1 for s in prefill_states if s["ready"])
+        pools = None
+        if disagg.enabled:
+            pools = {
+                consts.SERVING_POOL_PREFILL: {
+                    "desired": self._int(block.get("prefillDesired")),
+                    "ready": prefill_ready,
+                },
+                consts.SERVING_POOL_DECODE: {
+                    "desired": self._int(block.get("desired")),
+                    "ready": sum(1 for s in states if s["ready"]),
+                },
+            }
+            block["pools"] = pools
+        else:
+            block.pop("pools", None)
+        self._publish_routing(serving.name, routing, pools)
         ready = sum(1 for s in states if s["ready"])
         routable = sum(1 for s in states if s["routable"])
         block["ready"] = ready

@@ -1,0 +1,20 @@
+"""The pod data plane: worker Pods for TPUJob gangs and TPUServing
+replicas, the pod mains the sim kubelet runs in threads, and the
+KV-aware serving router.
+
+Layering (mirrors the control-plane/data-plane split on a real
+cluster, and keeps the RBAC closure honest):
+
+- ``pods.py`` — control-plane side. Imported by the job and serving
+  controllers; renders/converges/sweeps worker Pods through the same
+  manifest + hash machinery the slice manager uses. Every apiserver
+  verb it sends is attributed to the operator ClusterRole by
+  ``lint/rbac_static.py``.
+- ``worker.py`` — data-plane side. The pod mains (job gang member,
+  serving replica) plus the registry the sim kubelet resolves
+  POD_MAIN_LABEL values against. Runs under the workload's own
+  credentials, never the operator's.
+- ``router.py`` — data-plane side. The KV-aware router: session
+  affinity, prefix-cache scoring, chunked-prefill admission, and the
+  prefill->decode paged-KV handoff.
+"""

@@ -1,0 +1,236 @@
+"""Control-plane side of the pod data plane: render, converge and sweep
+the worker Pods the job and serving controllers own.
+
+Reuses the operand rendering machinery (``render.Renderer`` over
+``manifests/workload-worker/``) and the slice manager's convergence
+idiom: the rendered pod's spec hash is stamped into an annotation, an
+existing pod with the same hash is left alone, a different hash is
+delete+recreated (pods are immutable where it matters — env, node
+pinning — so convergence IS replacement, exactly the DaemonSet
+controller's own model).
+
+Ownership discipline (the PR 13/15 pin, extended to pods): every pod
+rendered here carries a controller ownerReference to its TPUJob /
+TPUServing, and the sweep deletes ONLY pods that carry it. A user's
+standalone pod whose name merely collides with ``<job>-worker-<i>`` or
+``<serving>-prefill-<i>`` is never touched.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Iterable, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.render import Renderer
+from tpu_operator.utils import object_hash
+
+log = logging.getLogger(__name__)
+
+MANAGED_BY = {"app.kubernetes.io/managed-by": "tpu-workload-dataplane"}
+
+WORKER_MANIFEST_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "manifests", "workload-worker",
+)
+
+
+def job_worker_name(job_name: str, index: int) -> str:
+    return f"{job_name}{consts.JOB_WORKER_INFIX}{index}"
+
+
+def serving_worker_name(serving_name: str, pool: str, index: int) -> str:
+    infix = (
+        consts.SERVING_PREFILL_INFIX
+        if pool == consts.SERVING_POOL_PREFILL
+        else consts.SERVING_DECODE_INFIX
+    )
+    return f"{serving_name}{infix}{index}"
+
+
+def _owned_by(pod: dict, owner_kind: str, owner_name: str) -> bool:
+    """True when the pod carries a controller ownerReference to the
+    named CR — the ONLY license to delete it."""
+    for ref in (pod.get("metadata", {}).get("ownerReferences") or []):
+        if ref.get("kind") == owner_kind and ref.get("name") == owner_name:
+            return True
+    return False
+
+
+class WorkerPodSet:
+    """Converges the worker Pods one owning CR wants against what
+    exists, and sweeps what it no longer wants. One instance per
+    reconciler (the renderer caches its templates)."""
+
+    def __init__(self, client: Client, namespace: str,
+                 image: str = "tpu-operator-worker",
+                 image_pull_policy: str = "IfNotPresent"):
+        self.client = client
+        self.namespace = namespace
+        self.image = image
+        self.image_pull_policy = image_pull_policy
+        self._renderer = Renderer([WORKER_MANIFEST_DIR])
+
+    # -- render + converge --------------------------------------------------
+
+    def converge(self, owner: dict, pod_main: str,
+                 workers: List[dict]) -> Dict[str, List[str]]:
+        """Make the owner's worker pods match ``workers``.
+
+        ``owner`` is the owning CR (apiVersion/kind/metadata read for
+        the ownerReference); ``workers`` is a list of dicts with keys
+        ``name``, ``env`` (str->str), and optional ``node``, ``chips``,
+        ``labels``. Returns {created, replaced, kept} pod-name lists;
+        pods whose name exists but is NOT owned by this CR are left
+        untouched (reported under ``foreign``)."""
+        app = owner["metadata"]["name"]
+        rendered = self._renderer.render_objects({
+            "workers": [
+                {
+                    "name": w["name"],
+                    "env": w.get("env") or {},
+                    "node": w.get("node", ""),
+                    "chips": w.get("chips", 0),
+                    "labels": w.get("labels") or {},
+                }
+                for w in workers
+            ],
+            "namespace": self.namespace,
+            "app": app,
+            "managed_by": MANAGED_BY["app.kubernetes.io/managed-by"],
+            "pod_main_label": consts.POD_MAIN_LABEL,
+            "pod_main": pod_main,
+            "tpu_resource": consts.TPU_RESOURCE_NAME,
+            "image": self.image,
+            "image_pull_policy": self.image_pull_policy,
+        })
+        report: Dict[str, List[str]] = {
+            "created": [], "replaced": [], "kept": [], "foreign": [],
+        }
+        for pod in rendered:
+            name = pod["metadata"]["name"]
+            # hash BEFORE the ownerReference lands: the owner uid is
+            # metadata, and folding it into the hash would delete+
+            # recreate every worker on operator reinstall
+            spec_hash = object_hash(pod)
+            pod["metadata"]["ownerReferences"] = [{
+                "apiVersion": owner["apiVersion"],
+                "kind": owner["kind"],
+                "name": owner["metadata"]["name"],
+                "uid": owner["metadata"].get("uid", ""),
+                "controller": True,
+            }]
+            pod["metadata"].setdefault("annotations", {})[
+                consts.WORKER_HASH_ANNOTATION] = spec_hash
+            existing = self.client.get_or_none("v1", "Pod", name, self.namespace)
+            if existing is not None:
+                if not _owned_by(existing, owner["kind"], owner["metadata"]["name"]):
+                    log.warning(
+                        "worker pod name %s/%s is taken by a pod this %s does "
+                        "not own; leaving it alone", self.namespace, name,
+                        owner["kind"])
+                    report["foreign"].append(name)
+                    continue
+                if (existing.get("metadata", {}).get("annotations") or {}).get(
+                        consts.WORKER_HASH_ANNOTATION) == spec_hash:
+                    report["kept"].append(name)
+                    continue
+                try:
+                    self.client.delete(
+                        "v1", "Pod", name, self.namespace,
+                        grace_period_seconds=0)
+                except errors.NotFound:
+                    pass
+                report["replaced"].append(name)
+            else:
+                report["created"].append(name)
+            try:
+                self.client.create(pod)  # tpuop-lint: kinds=v1/Pod
+            except (errors.AlreadyExists, errors.Conflict):
+                pass  # raced another pass; next reconcile converges
+        return report
+
+    # -- sweep --------------------------------------------------------------
+
+    def sweep(self, owner_kind: str, owner_name: str,
+              live: Iterable[str] = ()) -> List[str]:
+        """Delete the owner's worker pods that are not in ``live``
+        (empty ``live`` = tear down everything it owns). Only pods
+        carrying the owner's controller ownerReference are candidates —
+        a same-named standalone pod survives."""
+        keep = set(live)
+        deleted: List[str] = []
+        for pod in self.client.list(
+                "v1", "Pod", self.namespace, label_selector=dict(MANAGED_BY)):
+            name = pod["metadata"]["name"]
+            if name in keep:
+                continue
+            if not _owned_by(pod, owner_kind, owner_name):
+                continue
+            try:
+                self.client.delete(
+                    "v1", "Pod", name, self.namespace, grace_period_seconds=0)
+                deleted.append(name)
+            except errors.NotFound:
+                pass
+        return deleted
+
+    # -- observation + routing ----------------------------------------------
+
+    def owned_pods(self, owner_kind: str, owner_name: str) -> List[dict]:
+        return [
+            pod
+            for pod in self.client.list(
+                "v1", "Pod", self.namespace, label_selector=dict(MANAGED_BY))
+            if _owned_by(pod, owner_kind, owner_name)
+        ]
+
+    def worker_phases(self, owner_kind: str, owner_name: str) -> Dict[str, str]:
+        """{pod name: status.phase} for the owner's workers ("" until
+        the kubelet reports)."""
+        return {
+            pod["metadata"]["name"]: (pod.get("status") or {}).get("phase", "")
+            for pod in self.owned_pods(owner_kind, owner_name)
+        }
+
+    def patch_route_weight(self, name: str, weight: float) -> bool:
+        """Stamp the router-weight annotation on one worker pod (the
+        data-plane router reads its weight from the pod itself; the
+        load-CM routing key stays authoritative). Returns False when
+        the pod is gone — the caller's next converge recreates it."""
+        try:
+            self.client.patch(
+                "v1", "Pod", name,
+                {"metadata": {"annotations": {
+                    consts.WORKER_ROUTE_WEIGHT_ANNOTATION: f"{weight:g}"}}},
+                self.namespace,
+            )
+            return True
+        except errors.NotFound:
+            return False
+
+
+def rendezvous_state(progress_data: Optional[dict], expected: int,
+                     gang_hash: str) -> dict:
+    """Evaluate the rendezvous handshake from the progress-CM data:
+    which of the ``expected`` member indexes have published
+    ``rendezvous.<i>`` = the CURRENT gang hash (a stale hash is a
+    worker from a previous generation still draining)."""
+    data = progress_data or {}
+    checked_in = []
+    stale = []
+    for index in range(expected):
+        value = data.get(f"{consts.JOB_RENDEZVOUS_PREFIX}{index}")
+        if value == gang_hash:
+            checked_in.append(index)
+        elif value:
+            stale.append(index)
+    return {
+        "expected": expected,
+        "checked_in": checked_in,
+        "stale": stale,
+        "complete": len(checked_in) == expected and expected > 0,
+    }

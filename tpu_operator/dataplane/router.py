@@ -1,0 +1,290 @@
+"""The KV-aware serving router: the data-plane component between
+traffic and the replica worker pods.
+
+Routing is a scored choice over the routable replica set (the
+controller's routing weights stay authoritative — weight 0 excludes a
+replica here exactly as in the dumb round-robin sim):
+
+1. **session affinity** — a multi-turn conversation re-lands on the
+   replica already holding its KV pages (the engine delta-prefills only
+   the new turn instead of re-ingesting the whole conversation);
+2. **prefix-cache awareness** — replicas holding a cached page-aligned
+   prefix of the prompt (shared system prompts) score higher,
+   proportional to how much of the prompt the cache covers;
+3. **chunked-prefill admission** — replicas saturated with prefill
+   lanes are skipped so one burst of long prompts cannot starve every
+   replica's decode lanes at once; requests wait in the router queue
+   until some replica has prefill headroom (admission coordinated
+   ACROSS replicas, which no per-engine policy can do);
+4. **load** — ties break toward the emptier engine.
+
+With disaggregation, prompts route to the prefill pool
+(least-saturated replica), and each finished prefill's paged KV hands
+off to a scored decode replica (``DecodeEngine.submit_prefilled``).
+
+The router publishes its KV telemetry (``kvHitRatio``,
+``handoffBytes``, ``prefillTtftP99``, ``decodeTokensPerS``) into the
+serving's load ConfigMap — the signals the controller's per-pool
+autoscalers read.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+
+# skip a replica whose engine already ingests this many prompts at once
+PREFILL_ADMISSION_CAP = 2
+SESSION_AFFINITY_BONUS = 3.0
+PREFIX_BONUS = 1.0
+LOAD_PENALTY = 0.5
+
+
+class KVAwareRouter:
+    """One serving's router. Workers attach/detach as the kubelet
+    starts/stops their pods; ``tick()`` is one routing beat (admit
+    queued requests, collect prefill handoffs, publish telemetry)."""
+
+    def __init__(self, client, namespace: str, serving_name: str,
+                 prefill_admission_cap: int = PREFILL_ADMISSION_CAP):
+        self.client = client
+        self.namespace = namespace
+        self.serving_name = serving_name
+        self.prefill_admission_cap = prefill_admission_cap
+        self.workers: Dict[str, object] = {}          # decode/aggregated mains
+        self.prefill_workers: Dict[str, object] = {}  # prefill-pool mains
+        self.queue: List[object] = []                 # awaiting admission
+        self.sessions: Dict[str, str] = {}            # session -> last replica
+        self.routed: Dict[str, int] = {}
+        self.session_total = 0
+        self.session_hits = 0
+        self.prefix_routed = 0
+        self.handoffs = 0
+        self.handoff_bytes = 0
+        self._t0 = time.perf_counter()
+        self._decode_counts: Dict[str, int] = {}      # tokens at last publish
+
+    # -- worker attachment ---------------------------------------------------
+
+    def sync_workers(self, workers: Dict[str, object]) -> None:
+        """Adopt the kubelet's live serving workers for this serving
+        (replica name -> ServingWorkerMain). Called every tick — pod
+        churn (scale-down, hash replacement) drops out naturally."""
+        self.workers = {}
+        self.prefill_workers = {}
+        for name, main in workers.items():
+            if getattr(main, "serving_name", "") != self.serving_name:
+                continue
+            if getattr(main, "pool", "") == consts.SERVING_POOL_PREFILL:
+                self.prefill_workers[name] = main
+            else:
+                self.workers[name] = main
+
+    # -- controller state ----------------------------------------------------
+
+    def _load_cm(self) -> Optional[dict]:
+        return self.client.get_or_none(
+            "v1", "ConfigMap",
+            self.serving_name + consts.SERVING_LOAD_SUFFIX, self.namespace,
+        )
+
+    def weights(self) -> Dict[str, float]:
+        """The controller's routing weights over decode/aggregated
+        replica SLICES; a worker pod maps to its slice by the replica
+        name its env carries. Unlisted replicas default routable (the
+        controller has not spoken yet)."""
+        data = (self._load_cm() or {}).get("data") or {}
+        try:
+            return {
+                k: float(v)
+                for k, v in json.loads(
+                    data.get(consts.SERVING_ROUTING_KEY, "{}")).items()
+            }
+        except (ValueError, TypeError):
+            return {}
+
+    # -- routing -------------------------------------------------------------
+
+    def submit(self, request) -> None:
+        self.queue.append(request)
+
+    def _routable(self, pool: Dict[str, object]) -> Dict[str, object]:
+        weights = self.weights()
+        out = {}
+        for name, main in pool.items():
+            replica = getattr(main, "replica", name)
+            if weights and weights.get(replica, 1.0) <= 0.0:
+                continue
+            out[name] = main
+        return out
+
+    def _score(self, main, request) -> float:
+        engine = main.engine
+        score = 0.0
+        if request.session:
+            holder = self.sessions.get(request.session)
+            if holder == getattr(main, "replica", "") or engine.has_session(
+                    request.session):
+                score += SESSION_AFFINITY_BONUS
+        plen = max(1, int(request.prompt.shape[0]))
+        score += PREFIX_BONUS * (engine.cached_prefix_tokens(request.prompt) / plen)
+        load = (len(engine.slots) + len(engine.queue)) / max(1, engine.cfg.max_batch)
+        score -= LOAD_PENALTY * load
+        return score
+
+    def _admit(self) -> int:
+        """Route queued requests. Chunked-prefill admission: a request
+        only lands on a replica with prefill headroom; when every
+        routable replica is saturated the queue holds (coordinated
+        backpressure, re-tried next tick)."""
+        admitted = 0
+        while self.queue:
+            request = self.queue[0]
+            if self.prefill_workers:
+                target = self._pick_prefill()
+            else:
+                target = self._pick_decode(request)
+            if target is None:
+                break  # no headroom anywhere: hold the line
+            name, main = target
+            self.queue.pop(0)
+            main.submit(request)
+            self.routed[name] = self.routed.get(name, 0) + 1
+            if request.session:
+                self.session_total += 1
+                if self.sessions.get(request.session) == getattr(
+                        main, "replica", name):
+                    self.session_hits += 1
+                self.sessions[request.session] = getattr(main, "replica", name)
+            if main.engine.cached_prefix_tokens(request.prompt) > 0:
+                self.prefix_routed += 1
+            admitted += 1
+        return admitted
+
+    def _pick_decode(self, request):
+        candidates = [
+            (name, main)
+            for name, main in self._routable(self.workers).items()
+            if main.engine.prefilling_lanes < self.prefill_admission_cap
+        ]
+        if not candidates:
+            return None
+        return max(
+            candidates,
+            key=lambda item: (self._score(item[1], request), item[0]),
+        )
+
+    def _pick_prefill(self):
+        candidates = [
+            (name, main)
+            for name, main in self.prefill_workers.items()
+            if main.engine.prefilling_lanes < self.prefill_admission_cap
+        ]
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda item: (item[1].engine.prefilling_lanes, item[0]),
+        )
+
+    def _collect_handoffs(self) -> int:
+        """Drain finished prefills into scored decode replicas (the
+        paged-KV handoff)."""
+        moved = 0
+        for main in self.prefill_workers.values():
+            while main.engine.prefilled_done:
+                entry = main.engine.prefilled_done[0]
+                target = self._pick_decode(entry["request"])
+                if target is None:
+                    break  # decode pool saturated: handoff waits
+                main.engine.prefilled_done.pop(0)
+                name, decode_main = target
+                request, kv = entry["request"], entry["kv"]
+                decode_main.submit_prefilled(request, kv)
+                self.handoffs += 1
+                self.handoff_bytes += kv["k"].nbytes + kv["v"].nbytes
+                if request.session:
+                    self.sessions[request.session] = getattr(
+                        decode_main, "replica", name)
+                moved += 1
+        return moved
+
+    # -- telemetry -----------------------------------------------------------
+
+    @property
+    def kv_hit_ratio(self) -> float:
+        if not self.session_total:
+            return 0.0
+        return self.session_hits / self.session_total
+
+    def _prefill_ttft_p99(self) -> float:
+        ttfts = sorted(
+            r.ttft_s
+            for main in self.prefill_workers.values()
+            for r in main.engine.completed
+            if r.ttft_s is not None
+        )
+        if not ttfts:
+            return 0.0
+        from tpu_operator.workloads.telemetry import _percentile
+
+        return _percentile(ttfts, 0.99)
+
+    def _decode_tokens_per_s(self) -> float:
+        total = sum(
+            main.engine.decoded_tokens for main in self.workers.values()
+        )
+        elapsed = time.perf_counter() - self._t0
+        return total / elapsed if elapsed > 0 else 0.0
+
+    def publish(self) -> None:
+        """Best-effort KV telemetry into the load CM (traffic-side keys;
+        the controller's pool autoscalers read them)."""
+        data = {
+            consts.SERVING_LOAD_KV_HIT_RATIO: f"{self.kv_hit_ratio:.4f}",
+            consts.SERVING_LOAD_HANDOFF_BYTES: str(self.handoff_bytes),
+        }
+        if self.prefill_workers:
+            data[consts.SERVING_LOAD_PREFILL_TTFT_P99] = (
+                f"{self._prefill_ttft_p99():.4f}")
+            data[consts.SERVING_LOAD_DECODE_TOKENS_PER_S] = (
+                f"{self._decode_tokens_per_s():.2f}")
+        name = self.serving_name + consts.SERVING_LOAD_SUFFIX
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", name, {"data": data}, self.namespace)
+        except errors.NotFound:
+            from tpu_operator.kube.objects import new_object
+
+            try:
+                self.client.create(  # tpuop-lint: ignore
+                    new_object("v1", "ConfigMap", name, self.namespace,
+                               data=data))
+            except errors.ApiError:
+                pass
+        except errors.ApiError:
+            pass
+
+    def tick(self) -> dict:
+        """One routing beat: collect finished prefills, admit queued
+        requests, publish telemetry."""
+        moved = self._collect_handoffs()
+        admitted = self._admit()
+        self.publish()
+        return {
+            "admitted": admitted,
+            "handoffs": moved,
+            "queued": len(self.queue),
+            "kv_hit_ratio": round(self.kv_hit_ratio, 4),
+        }
+
+    def completed_requests(self) -> List[object]:
+        """Every finished request across the decode/aggregated workers
+        (prefill completions are transport, not answers)."""
+        return [
+            r for main in self.workers.values() for r in main.engine.completed
+        ]

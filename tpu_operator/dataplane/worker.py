@@ -1,0 +1,198 @@
+"""Data-plane side of the pod runtime: the worker-pod mains.
+
+A worker pod's container command is opaque to the sim; what the sim
+kubelet (``kube/sim.py`` :class:`PodKubelet`) actually runs is the
+*pod main* resolved from the pod's ``POD_MAIN_LABEL`` value through
+the registry here. A main is a tiny object with one contract:
+
+- ``step() -> bool`` — one data-plane beat on the kubelet's thread;
+  True means the pod's work is finished (phase ``Succeeded``). An
+  exception fails the pod (phase ``Failed``).
+
+Two mains exist:
+
+- :class:`JobWorkerMain` — one TPUJob gang member. Every member
+  publishes ``rendezvous.<index> = <gang hash>`` into the job's
+  progress ConfigMap; the chief (index 0) wraps the proven
+  :class:`~tpu_operator.workloads.training.InProcessJobRunner` and
+  gates training until every expected index has checked in with the
+  CURRENT gang hash (a stale hash is a worker from a previous
+  generation still draining). Checkpoint/restart barriers ride the
+  same progress CM unchanged.
+- :class:`ServingWorkerMain` — one TPUServing replica. Owns a
+  :class:`~tpu_operator.workloads.serving.DecodeEngine`; the KV-aware
+  router feeds it and reads its KV-affinity state. The ``TPU_POOL``
+  env selects aggregated serving, a prefill-pool replica
+  (``prefill_only`` engine) or a decode-pool replica (handoff
+  importer with session retention).
+
+This module is never imported by the controllers (it is workload-side
+code running under the workload's credentials); the control-plane
+helpers live in ``dataplane/pods.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Dict, Optional
+
+from tpu_operator import consts
+from tpu_operator.dataplane.pods import rendezvous_state
+
+# pod-main registry: POD_MAIN_LABEL value -> factory(client, namespace, env)
+_POD_MAINS: Dict[str, Callable] = {}
+
+
+def register_pod_main(kind: str, factory: Callable) -> None:
+    _POD_MAINS[kind] = factory
+
+
+def resolve_pod_main(kind: str) -> Optional[Callable]:
+    return _POD_MAINS.get(kind)
+
+
+def default_checkpoint_dir(namespace: str, job_name: str) -> str:
+    """Deterministic fallback store location when the TPUJob spec does
+    not pin one — every gang generation of one job must resume from the
+    SAME store or checkpoint-resume silently becomes restart-from-zero."""
+    return os.path.join(
+        tempfile.gettempdir(), f"tpuop-ckpt-{namespace}-{job_name}"
+    )
+
+
+class JobWorkerMain:
+    """One gang member's training loop (chief) or rendezvous heartbeat
+    (non-chief)."""
+
+    def __init__(self, client, namespace: str, env: Dict[str, str]):
+        self.client = client
+        self.namespace = env.get(consts.WORKER_ENV_NAMESPACE) or namespace
+        self.job_name = env[consts.WORKER_ENV_JOB_NAME]
+        self.index = int(env.get(consts.WORKER_ENV_WORKER_INDEX, "0"))
+        self.count = int(env.get(consts.WORKER_ENV_WORKER_COUNT, "1"))
+        self.gang_hash = env.get(consts.WORKER_ENV_GANG_HASH, "")
+        self.checkpoint_dir = (
+            env.get(consts.WORKER_ENV_CHECKPOINT_DIR)
+            or default_checkpoint_dir(self.namespace, self.job_name)
+        )
+        self.steps_per_sync = int(env.get(consts.WORKER_ENV_STEPS_PER_SYNC, "3"))
+        self.runner = None  # chief-only, built on first step
+        self.rendezvous: dict = {}
+
+    @property
+    def is_chief(self) -> bool:
+        return self.index == 0
+
+    @property
+    def trainer(self):
+        """The chief's trainer (history/checkpoints harvested by bench
+        and drills across pod generations); None on non-chiefs."""
+        return self.runner.trainer if self.runner is not None else None
+
+    def _progress_name(self) -> str:
+        return self.job_name + consts.JOB_PROGRESS_SUFFIX
+
+    def _progress(self) -> dict:
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", self._progress_name(), self.namespace
+        )
+        return (cm or {}).get("data") or {}
+
+    def _publish(self, data: Dict[str, str]) -> None:
+        from tpu_operator.kube import errors
+        from tpu_operator.kube.objects import new_object
+
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", self._progress_name(), {"data": data},
+                self.namespace,
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                    new_object("v1", "ConfigMap", self._progress_name(),
+                               self.namespace, data=data)
+                )
+            except errors.AlreadyExists:
+                self.client.patch(
+                    "v1", "ConfigMap", self._progress_name(), {"data": data},
+                    self.namespace,
+                )
+
+    def step(self) -> bool:
+        progress = self._progress()
+        # check in (idempotent): rendezvous.<index> = this generation's
+        # gang hash — the CM may have been recreated, so re-verify
+        key = f"{consts.JOB_RENDEZVOUS_PREFIX}{self.index}"
+        if progress.get(key) != self.gang_hash:
+            self._publish({key: self.gang_hash})
+            progress = dict(progress, **{key: self.gang_hash})
+        status = progress.get(consts.JOB_PROGRESS_STATUS, "")
+        if status == consts.JOB_PROGRESS_COMPLETE:
+            return True  # training finished (possibly by a prior chief)
+        if not self.is_chief:
+            return False  # heartbeat only; the pod runs until swept
+        self.rendezvous = rendezvous_state(progress, self.count, self.gang_hash)
+        if not self.rendezvous["complete"]:
+            return False  # gate training until the whole gang checked in
+        if self.runner is None:
+            from tpu_operator.workloads.checkpoint import CheckpointStore
+            from tpu_operator.workloads.training import InProcessJobRunner
+
+            self.runner = InProcessJobRunner(
+                self.client, self.namespace, self.job_name,
+                CheckpointStore(self.checkpoint_dir),
+                steps_per_sync=self.steps_per_sync,
+            )
+        self.runner.sync()
+        trainer = self.runner.trainer
+        return trainer is not None and trainer.done
+
+
+class ServingWorkerMain:
+    """One serving replica: a decode engine beating under the kubelet.
+    The router holds a reference (via the kubelet's worker registry)
+    and submits/harvests requests between beats."""
+
+    def __init__(self, client, namespace: str, env: Dict[str, str],
+                 cfg=None, seed: int = 0):
+        from tpu_operator.workloads.serving import DecodeEngine, ServingModelConfig
+
+        self.client = client
+        self.namespace = env.get(consts.WORKER_ENV_NAMESPACE) or namespace
+        self.serving_name = env.get(consts.WORKER_ENV_SERVING_NAME, "")
+        self.replica = env.get(consts.WORKER_ENV_REPLICA_NAME, "")
+        self.pool = env.get(consts.WORKER_ENV_POOL, "")
+        cfg = cfg or ServingModelConfig()
+        prefill = self.pool == consts.SERVING_POOL_PREFILL
+        self.engine = DecodeEngine(
+            cfg, seed=seed,
+            prefill_only=prefill,
+            # decode + aggregated replicas keep session KV warm; a
+            # prefill replica's lanes retire at the first token, so
+            # retention would only pin dead pages
+            retain_sessions=not prefill,
+        )
+        self.engine.warmup(min(cfg.prefill_chunk, cfg.max_seq // 4))
+
+    def submit(self, request) -> None:
+        self.engine.submit(request)
+
+    def submit_prefilled(self, request, kv: dict) -> None:
+        self.engine.submit_prefilled(request, kv)
+
+    def step(self) -> bool:
+        if not self.engine.idle:
+            self.engine.step()
+        return False  # a serving worker runs until its pod is swept
+
+
+register_pod_main(
+    consts.POD_MAIN_JOB_WORKER,
+    lambda client, namespace, env: JobWorkerMain(client, namespace, env),
+)
+register_pod_main(
+    consts.POD_MAIN_SERVING_WORKER,
+    lambda client, namespace, env: ServingWorkerMain(client, namespace, env),
+)

@@ -858,6 +858,235 @@ class ServingTrafficSim:
             pass  # chaos rider: a dropped publish retries next tick
 
 
+class _PodWorker:
+    """One worker pod's main, running on its own thread but *pulsed* by
+    the kubelet: each ``begin_beat``/``wait_beat`` pair executes exactly
+    one ``main.step()`` on the worker thread. Threads give the data
+    plane its real concurrency shape (racecheck sees every interleaving
+    hazard); the pulse keeps the sim deterministic — one beat per
+    kubelet step, in lockstep with the reconcilers driving it."""
+
+    def __init__(self, name: str, spec_hash: str, main):
+        self.name = name
+        self.spec_hash = spec_hash
+        self.main = main
+        self.finished = False
+        self.error: Optional[Exception] = None
+        self.reported = False  # terminal phase written to the pod
+        self._go = threading.Event()
+        self._done = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"pod-main-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            self._go.wait()
+            self._go.clear()
+            if self._stop.is_set():
+                self._done.set()
+                return
+            try:
+                if not self.finished:
+                    self.finished = bool(self.main.step())
+            except Exception as exc:  # a crashed main fails the pod
+                self.error = exc
+                self.finished = True
+            self._done.set()
+
+    def begin_beat(self) -> None:
+        self._done.clear()
+        self._go.set()
+
+    def wait_beat(self, timeout: float) -> bool:
+        return self._done.wait(timeout)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._go.set()
+        self._thread.join(timeout)
+
+
+class PodKubelet:
+    """Fake kubelet mode for the pod data plane: watches the namespace
+    for worker pods carrying ``POD_MAIN_LABEL``, resolves each label
+    value through the dataplane worker registry, and runs the pod main
+    on a thread (phase ``Running`` while stepping, ``Succeeded`` when
+    the main returns True, ``Failed`` on an exception — reported
+    through the same minimal ``update_status`` writes a real kubelet
+    sends).
+
+    Convergence mirrors the controllers' hash discipline: a pod whose
+    ``WORKER_HASH_ANNOTATION`` changed (delete+recreate by the owning
+    controller) retires the old main and starts a fresh one; a deleted
+    pod stops its thread. Retired mains are KEPT (``self.retired``) so
+    bench/drills can harvest trainer histories across pod generations
+    — exactly what checkpoint-resume continuity must survive."""
+
+    def __init__(self, client: Client, namespace: str, beat_timeout: float = 60.0):
+        self.client = client
+        self.namespace = namespace
+        self.beat_timeout = beat_timeout
+        self._lock = racecheck.lock("PodKubelet._lock")
+        self.workers: Dict[str, _PodWorker] = {}
+        self.retired: list = []  # (pod name, main), in retirement order
+
+    # -- pod observation -----------------------------------------------------
+
+    def _worker_pods(self) -> Dict[str, dict]:
+        import tpu_operator.consts as _consts
+
+        out = {}
+        for pod in self.client.list("v1", "Pod", self.namespace):
+            labels = pod["metadata"].get("labels") or {}
+            if _consts.POD_MAIN_LABEL in labels:
+                out[pod["metadata"]["name"]] = pod
+        return out
+
+    def _set_phase(self, name: str, phase: str) -> None:
+        try:
+            self.client.update_status({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": name, "namespace": self.namespace},
+                "status": {"phase": phase},
+            })
+        except errors.ApiError:
+            pass  # the pod raced away; the next step re-observes
+
+    def _build_main(self, pod: dict):
+        """Resolve + construct the pod's main (None = unknown kind or a
+        constructor crash — the pod fails, like a bad image would)."""
+        import tpu_operator.consts as _consts
+        from tpu_operator.dataplane.worker import resolve_pod_main
+
+        kind = (pod["metadata"].get("labels") or {})[_consts.POD_MAIN_LABEL]
+        factory = resolve_pod_main(kind)
+        if factory is None:
+            return None
+        containers = (pod.get("spec") or {}).get("containers") or [{}]
+        env = {
+            e.get("name"): e.get("value", "")
+            for e in (containers[0].get("env") or [])
+        }
+        try:
+            return factory(self.client, self.namespace, env)
+        except Exception:
+            return None
+
+    # -- one kubelet step ----------------------------------------------------
+
+    def step(self) -> dict:
+        import tpu_operator.consts as _consts
+
+        pods = self._worker_pods()
+        with self._lock:
+            tracked = dict(self.workers)
+        # retire workers whose pod is gone or was hash-replaced
+        for name, worker in tracked.items():
+            pod = pods.get(name)
+            current_hash = (
+                ((pod or {}).get("metadata") or {}).get("annotations") or {}
+            ).get(_consts.WORKER_HASH_ANNOTATION, "")
+            if pod is None or worker.spec_hash != current_hash:
+                worker.stop()
+                with self._lock:
+                    self.workers.pop(name, None)
+                self.retired.append((name, worker.main))
+        # start mains for new (or replaced) pods
+        for name, pod in pods.items():
+            with self._lock:
+                known = name in self.workers
+            if known:
+                continue
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue  # terminal: a real kubelet restarts nothing here
+            main = self._build_main(pod)
+            if main is None:
+                self._set_phase(name, "Failed")
+                continue
+            spec_hash = (pod["metadata"].get("annotations") or {}).get(
+                _consts.WORKER_HASH_ANNOTATION, "")
+            self._set_phase(name, "Running")
+            with self._lock:
+                self.workers[name] = _PodWorker(name, spec_hash, main)
+        # one beat for every live main — all threads step concurrently,
+        # the kubelet waits for the whole generation to finish the beat
+        with self._lock:
+            live = [w for w in self.workers.values() if not w.finished]
+        for worker in live:
+            worker.begin_beat()
+        for worker in live:
+            worker.wait_beat(self.beat_timeout)
+        # report terminal phases once
+        finished = succeeded = failed = 0
+        with self._lock:
+            current = list(self.workers.values())
+        for worker in current:
+            if worker.finished:
+                finished += 1
+                if not worker.reported:
+                    worker.reported = True
+                    self._set_phase(
+                        worker.name,
+                        "Failed" if worker.error is not None else "Succeeded",
+                    )
+                if worker.error is not None:
+                    failed += 1
+                else:
+                    succeeded += 1
+        return {
+            "pods": len(current),
+            "stepped": len(live),
+            "finished": finished,
+            "succeeded": succeeded,
+            "failed": failed,
+        }
+
+    def stop(self) -> None:
+        with self._lock:
+            workers = list(self.workers.items())
+            self.workers.clear()
+        for name, worker in workers:
+            worker.stop()
+            self.retired.append((name, worker.main))
+
+    # -- harvesting (bench / drills) -----------------------------------------
+
+    def mains(self) -> Dict[str, object]:
+        with self._lock:
+            return {name: w.main for name, w in self.workers.items()}
+
+    def serving_workers(self, serving_name: str) -> Dict[str, object]:
+        """Live serving-replica mains for one TPUServing, keyed by pod
+        name (what the KV router adopts each tick)."""
+        return {
+            name: main
+            for name, main in self.mains().items()
+            if getattr(main, "serving_name", "") == serving_name
+        }
+
+    def job_trainers(self, job_name: str) -> list:
+        """Chief trainers for one TPUJob across ALL pod generations
+        (retired first, then live) — concatenating their histories and
+        checkpoints is the pod-mode input to ``verify_continuity``."""
+        out = []
+        with self._lock:
+            live = [(n, w.main) for n, w in self.workers.items()]
+        for _, main in list(self.retired) + live:
+            if getattr(main, "job_name", "") != job_name:
+                continue
+            if not getattr(main, "is_chief", False):
+                continue
+            trainer = getattr(main, "trainer", None)
+            if trainer is not None:
+                out.append(trainer)
+        return out
+
+
 class StubKubelet:
     """In-process kubelet device-plugin Registration service (v1beta1) on a
     unix socket, capturing Register calls — the kubelet half of the device

@@ -249,6 +249,78 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("serving.txt", f"# collection failed: {e}\n")
 
     try:
+        # the data-plane view: every worker pod the controllers rendered
+        # (phase + generation hash + route weight), each job's rendezvous
+        # handshake keys, and each serving's published router weights —
+        # where "why is worker 3 stuck / why does this replica get no
+        # traffic even though it's ready" starts
+        import json as _json
+
+        from tpu_operator import consts as _consts
+
+        lines = ["# worker pods"]
+        rows = []
+        for pod in client.list("v1", "Pod", namespace):
+            meta = pod.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            main = labels.get(_consts.POD_MAIN_LABEL)
+            if not main:
+                continue
+            ann = meta.get("annotations") or {}
+            weight = ann.get(_consts.WORKER_ROUTE_WEIGHT_ANNOTATION)
+            rows.append(
+                f"{meta.get('name')}  main={main}  "
+                f"phase={(pod.get('status') or {}).get('phase', '-')}  "
+                f"hash={ann.get(_consts.WORKER_HASH_ANNOTATION, '-')}"
+                + (f"  routeWeight={weight}" if weight is not None else "")
+            )
+        lines.extend(sorted(rows) or ["# none"])
+
+        lines.append("")
+        lines.append("# job rendezvous (progress ConfigMap handshake)")
+        rows = []
+        for tj in client.list(TPU_JOB_API_VERSION, "TPUJob"):
+            name = tj["metadata"]["name"]
+            cm = client.get_or_none(
+                "v1", "ConfigMap", name + _consts.JOB_PROGRESS_SUFFIX, namespace
+            )
+            data = (cm or {}).get("data") or {}
+            rdv = {
+                k[len(_consts.JOB_RENDEZVOUS_PREFIX):]: v
+                for k, v in sorted(data.items())
+                if k.startswith(_consts.JOB_RENDEZVOUS_PREFIX)
+            }
+            rows.append(
+                f"{name}  status={data.get(_consts.JOB_PROGRESS_STATUS, '-')}  "
+                f"step={data.get(_consts.JOB_PROGRESS_STEP, '-')}  "
+                f"rendezvous={rdv if rdv else '-'}"
+            )
+        lines.extend(rows or ["# none"])
+
+        lines.append("")
+        lines.append("# serving router weights (load ConfigMap)")
+        rows = []
+        for sv in client.list(TPU_SERVING_API_VERSION, "TPUServing"):
+            name = sv["metadata"]["name"]
+            cm = client.get_or_none(
+                "v1", "ConfigMap", name + _consts.SERVING_LOAD_SUFFIX, namespace
+            )
+            data = (cm or {}).get("data") or {}
+            routing = data.get(_consts.SERVING_ROUTING_KEY)
+            pools = data.get(_consts.SERVING_POOLS_KEY)
+            try:
+                routing = _json.loads(routing) if routing else {}
+            except ValueError:
+                routing = "<malformed>"
+            rows.append(f"{name}  routing={routing if routing else '-'}")
+            if pools:
+                rows.append(f"  pools={pools}")
+        lines.extend(rows or ["# none"])
+        emit("pods.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("pods.txt", f"# collection failed: {e}\n")
+
+    try:
         # the capacity-planning view: per-pool fragmentation/utilization
         # (the defrag controller's own replay), the last defrag
         # decisions with predicted-vs-realized deltas, and the what-if
