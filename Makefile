@@ -41,7 +41,9 @@ validate:
 	$(PYTHON) scripts/validate_rendered.py
 
 # static analysis: manifest rules, RBAC least-privilege proof, drift,
-# metrics catalog, concurrency (lock discipline / deadlock / blocking)
+# metrics catalog, concurrency (lock discipline / deadlock / blocking),
+# reconcile contracts (ownership-checked deletes, shared-CM key map,
+# fail-closed reads, publish-once status, gated retry charges)
 lint:
 	$(PYTHON) -m tpu_operator.cmd.tpuop_lint
 

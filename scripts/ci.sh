@@ -12,6 +12,9 @@ TPU_OPERATOR_SKIP_IMAGE_SMOKE_TEST=1 python3 -m pytest tests/ -q -m "not slow"
 echo "== rendered chart lints clean =="
 python3 scripts/validate_rendered.py
 echo "== tpuop-lint static analysis (error severity fails the build) =="
+# all six families: manifest, rbac, drift, metrics, concurrency, and the
+# reconcile-contract rules (TPUOP-K: ownership-checked deletes, shared-CM
+# key ownership, fail-closed reads, publish-once status, gated charges).
 # JSON to a file for artifact upload AND a human-readable echo on failure
 if ! python3 -m tpu_operator.cmd.tpuop_lint --format json > /tmp/lint-report.json; then
   python3 -m tpu_operator.cmd.tpuop_lint --format text || true

@@ -501,6 +501,94 @@ class TestRemediationFSM:
             node["metadata"].get("annotations") or {}
         )
 
+    def test_driver_pod_sweep_requires_daemonset_owner(self):
+        """TPUOP-K001 regression (PR 17): the reinstall entry action
+        selects driver pods by component label, and a label alone is
+        spoofable — a user pod wearing it must never be collateral. Only
+        pods carrying the DaemonSet ownerReference are ours to bounce."""
+        from tpu_operator.upgrade.fsm import (
+            DRIVER_POD_COMPONENT,
+            DRIVER_POD_COMPONENT_LABEL,
+        )
+
+        client = FakeClient()
+        owned = new_object(
+            "v1", "Pod", "libtpu-tpu-0", NS,
+            labels={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        )
+        owned["metadata"]["ownerReferences"] = [{
+            "apiVersion": "apps/v1", "kind": "DaemonSet",
+            "name": "tpu-libtpu-installer", "uid": "ds-uid-1",
+        }]
+        imposter = new_object(
+            "v1", "Pod", "libtpu-imposter", NS,
+            labels={DRIVER_POD_COMPONENT_LABEL: DRIVER_POD_COMPONENT},
+            spec={"nodeName": "tpu-0", "containers": []},
+            status={"phase": "Running"},
+        )
+        client.create(owned)
+        client.create(imposter)
+        NodeRepairManager(client, NS)._delete_driver_pods([owned, imposter])
+        assert client.get_or_none("v1", "Pod", "libtpu-tpu-0", NS) is None
+        assert client.get_or_none("v1", "Pod", "libtpu-imposter", NS) is not None
+
+    def test_retry_charge_rides_persisted_backoff_gate(self):
+        """TPUOP-K005 regression (PR 17): a watch-event storm (or a
+        crash-looping operator) redelivers the same degradation many
+        times per second; each delivery used to burn one retry, so a
+        burst could quarantine a node the backoff schedule says still
+        has budget. The charge now stamps a persisted nextAttemptAt
+        annotation in the same atomic patch, and early arrivals leave
+        the node untouched."""
+        client = FakeClient()
+        self.seed(client)
+        mgr = NodeRepairManager(client, NS)
+        remediation = self.spec(retryLimit=5).remediation
+
+        node = client.get("v1", "Node", "tpu-0")
+        assert mgr._begin_or_quarantine(node, remediation) == RepairState.CORDON_REQUIRED
+        ann = client.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert ann[consts.REPAIR_RETRIES_ANNOTATION] == "1"
+        # the gate rides the same patch as the counter
+        assert float(ann[consts.REPAIR_NEXT_ATTEMPT_ANNOTATION]) >= 0
+
+        # the storm: redeliveries inside the backoff window charge nothing
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["annotations"][
+            consts.REPAIR_NEXT_ATTEMPT_ANNOTATION
+        ] = str(time.time() + 3600)
+        client.update(node)
+        for _ in range(5):
+            node = client.get("v1", "Node", "tpu-0")
+            # early arrival: current state reported, no new charge
+            assert mgr._begin_or_quarantine(node, remediation) == RepairState.CORDON_REQUIRED
+        ann = client.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert ann[consts.REPAIR_RETRIES_ANNOTATION] == "1"
+
+        # once the stamp elapses the next attempt charges normally
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["annotations"][
+            consts.REPAIR_NEXT_ATTEMPT_ANNOTATION
+        ] = str(time.time() - 1)
+        client.update(node)
+        node = client.get("v1", "Node", "tpu-0")
+        mgr._begin_or_quarantine(node, remediation)
+        ann = client.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert ann[consts.REPAIR_RETRIES_ANNOTATION] == "2"
+
+        # a hand-mangled stamp degrades to "no gate", never a crash
+        node = client.get("v1", "Node", "tpu-0")
+        node["metadata"]["annotations"][
+            consts.REPAIR_NEXT_ATTEMPT_ANNOTATION
+        ] = "not-a-timestamp"
+        client.update(node)
+        node = client.get("v1", "Node", "tpu-0")
+        mgr._begin_or_quarantine(node, remediation)
+        ann = client.get("v1", "Node", "tpu-0")["metadata"]["annotations"]
+        assert ann[consts.REPAIR_RETRIES_ANNOTATION] == "3"
+
     def test_quarantined_node_keeps_cordon_when_disabled(self):
         client = FakeClient()
         node = self.seed(client)

@@ -12,6 +12,7 @@ Three layers:
 import copy
 import json
 import os
+import time
 
 import pytest
 
@@ -755,7 +756,7 @@ class TestRunnerQoL:
             "--skip", "manifest,rbac,drift,TPUOP-O005", "--format", "json",
         ]) == 0
         report = json.loads(capsys.readouterr().out)
-        assert set(report["analyzer_seconds"]) == {"metrics", "concurrency"}
+        assert set(report["analyzer_seconds"]) == {"metrics", "concurrency", "reconcile"}
         assert all(f["rule"] != "TPUOP-O005" for f in report["findings"])
 
     def test_unknown_selector_token_is_a_usage_error(self, capsys):
@@ -766,11 +767,571 @@ class TestRunnerQoL:
 
     def test_mustgather_lint_report_includes_new_families(self, tmp_path, fake_client):
         """must-gather's lint-report.json carries the TPUOP-C/O005 rows
-        (suppressed ones included) and the per-analyzer timings."""
+        (suppressed ones included) and the per-analyzer timings — the K
+        family rides the same registration, so its timing row appears
+        without any must-gather change."""
         from tpu_operator import mustgather
 
         mustgather.collect(fake_client, "tpu-operator", str(tmp_path))
         report = json.loads((tmp_path / "lint-report.json").read_text())
         assert "concurrency" in report["analyzer_seconds"]
+        assert "reconcile" in report["analyzer_seconds"]
         rules = {f["rule"] for f in report["findings"]}
         assert any(r.startswith("TPUOP-C") for r in rules)
+
+    def test_run_lint_rejects_unknown_analyzer_names(self):
+        """runner.run_lint(only=...) with a bogus family silently
+        selected nothing (every family skipped, empty report, exit 0) —
+        it must raise and name the valid families instead. The CLI's
+        --only/--skip path already exits 2 via _parse_selector; this
+        covers the library entry point every other caller uses."""
+        with pytest.raises(ValueError) as exc:
+            runner.run_lint(only=["bogus"])
+        for name in runner.ANALYZERS:
+            assert name in str(exc.value)
+        assert "bogus" in str(exc.value)
+
+    def test_lint_suite_wall_time_budget(self):
+        """The whole lint suite (all six families) stays under a stated
+        wall-time budget, so analyzer growth can't silently double CI
+        time. The budget is deliberately loose (CI boxes are slow); the
+        point is catching an accidental O(n^2) or a new family that
+        re-renders the chart per rule."""
+        timings: dict = {}
+        t0 = time.monotonic()
+        runner.run_lint(timings=timings)
+        elapsed = time.monotonic() - t0
+        assert set(timings) == set(runner.ANALYZERS)
+        assert elapsed < 60.0, (
+            f"lint suite took {elapsed:.1f}s (budget 60s): {timings}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Seeded reconcile-contract defects (TPUOP-K rules).
+# ---------------------------------------------------------------------------
+
+
+class TestReconcileContractSeededDefects:
+    """One minimal module per TPUOP-K rule: the seeded defect fires
+    exactly once, the corrected variant is silent, and both pragma and
+    baseline suppression are proven per rule."""
+
+    def analyze(self, source, relpath="controllers/seeded.py"):
+        from tpu_operator.lint import reconcile_contracts
+
+        return reconcile_contracts.analyze_source(source, relpath)
+
+    # -- K001: pattern/label-selected delete needs an ownership check --------
+
+    K001_SEEDED = """
+DRIVER_LABEL = "example.com/component"
+
+class Sweeper:
+    def sweep(self, pods):
+        for pod in pods:
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(DRIVER_LABEL) != "driver":
+                continue
+            self.client.delete("v1", "Pod", pod["metadata"]["name"])
+"""
+
+    def test_k001_ownerless_label_sweep_fires_once(self):
+        findings = self.analyze(self.K001_SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-K001"]
+        assert findings[0].location == "py:controllers/seeded.py:Sweeper.sweep"
+
+    def test_k001_owner_checked_sweep_is_clean(self):
+        fixed = self.K001_SEEDED.replace(
+            '            self.client.delete("v1", "Pod", pod["metadata"]["name"])',
+            '            if not any(r.get("kind") == "DaemonSet"\n'
+            '                       for r in pod["metadata"].get("ownerReferences", [])):\n'
+            "                continue\n"
+            '            self.client.delete("v1", "Pod", pod["metadata"]["name"])',
+        )
+        assert self.analyze(fixed) == []
+
+    def test_k001_pragma_suppresses(self):
+        pragma = self.K001_SEEDED.replace(
+            'self.client.delete("v1", "Pod", pod["metadata"]["name"])',
+            'self.client.delete("v1", "Pod", pod["metadata"]["name"])'
+            "  # tpuop-lint: ignore=K001",
+        )
+        assert self.analyze(pragma) == []
+
+    # -- K002: shared-CM key ownership ---------------------------------------
+
+    K002_SEEDED = {
+        "controllers/a.py": """
+from tpu_operator import consts
+
+class A:
+    def write(self):
+        self.client.patch("v1", "ConfigMap", "x-progress",
+                          {"data": {consts.JOB_PROGRESS_STATUS: "running"}})
+""",
+        "workloads/b.py": """
+from tpu_operator import consts
+
+class B:
+    def write(self):
+        self.client.patch("v1", "ConfigMap", "x-progress",
+                          {"data": {consts.JOB_PROGRESS_STATUS: "done"}})
+""",
+    }
+
+    def analyze_many(self, sources, handshakes=None):
+        from tpu_operator.lint import reconcile_contracts
+
+        return reconcile_contracts.analyze_sources(sources, handshakes)
+
+    def test_k002_two_writer_key_fires_once(self):
+        findings = self.analyze_many(self.K002_SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-K002"]
+        assert findings[0].location == "py:workloads/b.py:B.write"
+        assert "'status'" in findings[0].message
+
+    def test_k002_disjoint_keys_are_clean(self):
+        clean = dict(self.K002_SEEDED)
+        clean["workloads/b.py"] = clean["workloads/b.py"].replace(
+            "JOB_PROGRESS_STATUS", "JOB_PROGRESS_RESTART_ACK"
+        )
+        assert self.analyze_many(clean) == []
+
+    def test_k002_declared_handshake_is_legal(self):
+        assert self.analyze_many(
+            self.K002_SEEDED,
+            handshakes={"status": frozenset({"controllers/a", "workloads/b"})},
+        ) == []
+
+    def test_k002_pragma_suppresses(self):
+        pragma = dict(self.K002_SEEDED)
+        pragma["workloads/b.py"] = pragma["workloads/b.py"].replace(
+            '{"data": {consts.JOB_PROGRESS_STATUS: "done"}})',
+            '{"data": {consts.JOB_PROGRESS_STATUS: "done"}})'
+            "  # tpuop-lint: ignore=K002",
+        )
+        assert self.analyze_many(pragma) == []
+
+    # -- K003: destructive-gating reads fail closed --------------------------
+
+    K003_SEEDED = """
+from tpu_operator.kube import errors
+
+class R:
+    def _read(self):
+        try:
+            return self.client.get("v1", "ConfigMap", "state")
+        except errors.ApiError:
+            return {}
+
+    def reconcile(self, req):
+        state = self._read()
+        if not state:
+            self.client.delete("v1", "Thing", "x")
+"""
+
+    def test_k003_fail_open_read_fires_once(self):
+        findings = self.analyze(self.K003_SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-K003"]
+        assert findings[0].location == "py:controllers/seeded.py:R._read"
+
+    def test_k003_fail_closed_read_is_clean(self):
+        assert self.analyze(self.K003_SEEDED.replace("return {}", "return None")) == []
+
+    def test_k003_without_destructive_caller_is_clean(self):
+        """The same fail-open shape in a watch mapper (no delete/charge
+        in any caller's closure) is legal — only destructive gating
+        demands fail-closed."""
+        harmless = self.K003_SEEDED.replace(
+            '            self.client.delete("v1", "Thing", "x")',
+            "            return None",
+        )
+        assert self.analyze(harmless) == []
+
+    def test_k003_malformed_payload_branch_stays_legal(self):
+        """A ValueError (malformed JSON) branch may start fresh — a
+        retry can never fix a corrupt payload, so fresh-start is the
+        only sane answer there."""
+        source = """
+import json
+
+from tpu_operator.kube import errors
+
+class R:
+    def _read(self):
+        try:
+            raw = self.client.get("v1", "ConfigMap", "state")
+        except errors.ApiError:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return {}
+
+    def reconcile(self, req):
+        state = self._read()
+        if not state:
+            self.client.delete("v1", "Thing", "x")
+"""
+        assert self.analyze(source) == []
+
+    def test_k003_pragma_suppresses(self):
+        pragma = self.K003_SEEDED.replace(
+            "return {}", "return {}  # tpuop-lint: ignore=K003"
+        )
+        assert self.analyze(pragma) == []
+
+    # -- K004: one status-patch site per kind per reconcile pass -------------
+
+    K004_SEEDED = """
+class C:
+    def reconcile(self, req):
+        self.client.patch_status("v1", "Widget", "a", {"status": {}})
+        self._publish()
+
+    def _publish(self):
+        self.client.patch_status("v1", "Widget", "b", {"status": {}})
+"""
+
+    def test_k004_double_publish_fires_once(self):
+        findings = self.analyze(self.K004_SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-K004"]
+        assert findings[0].location == "py:controllers/seeded.py:C.reconcile"
+        assert "Widget" in findings[0].message
+
+    def test_k004_single_publisher_is_clean(self):
+        fixed = self.K004_SEEDED.replace(
+            '        self.client.patch_status("v1", "Widget", "a", {"status": {}})\n', ""
+        )
+        assert self.analyze(fixed) == []
+
+    def test_k004_distinct_kinds_are_clean(self):
+        """One publish per kind is the contract — a reconcile touching
+        two kinds may patch each once."""
+        fixed = self.K004_SEEDED.replace(
+            '"Widget", "a"', '"Gadget", "a"'
+        )
+        assert self.analyze(fixed) == []
+
+    def test_k004_pragma_suppresses(self):
+        pragma = self.K004_SEEDED.replace(
+            '        self.client.patch_status("v1", "Widget", "a", {"status": {}})',
+            '        self.client.patch_status("v1", "Widget", "a", {"status": {}})'
+            "  # tpuop-lint: ignore=K004",
+        )
+        assert self.analyze(pragma) == []
+
+    # -- K005: budget charges behind a persisted gate ------------------------
+
+    K005_SEEDED = """
+class J:
+    def charge(self, block, budget):
+        attempts = int(block.get("restarts") or 0)
+        if budget.exhausted(attempts):
+            return True
+        block["restarts"] = attempts + 1
+        return False
+"""
+
+    def test_k005_ungated_charge_fires_once(self):
+        findings = self.analyze(self.K005_SEEDED)
+        assert [f.rule for f in findings] == ["TPUOP-K005"]
+        assert findings[0].location == "py:controllers/seeded.py:J.charge"
+
+    def test_k005_next_attempt_gate_is_clean(self):
+        gated = self.K005_SEEDED.replace(
+            "    def charge(self, block, budget):\n",
+            "    def charge(self, block, budget, now):\n"
+            '        if now < float(block.get("nextAttemptAt") or 0):\n'
+            "            return True\n",
+        )
+        assert self.analyze(gated) == []
+
+    def test_k005_pragma_suppresses(self):
+        pragma = self.K005_SEEDED.replace(
+            'block["restarts"] = attempts + 1',
+            'block["restarts"] = attempts + 1  # tpuop-lint: ignore=K005',
+        )
+        assert self.analyze(pragma) == []
+
+    # -- baseline suppression, per rule --------------------------------------
+
+    def test_k_rules_are_baseline_suppressible(self):
+        cases = [
+            (self.K001_SEEDED, "TPUOP-K001", "py:controllers/seeded.py:Sweeper.sweep"),
+            (self.K003_SEEDED, "TPUOP-K003", "py:controllers/seeded.py:R._read"),
+            (self.K004_SEEDED, "TPUOP-K004", "py:controllers/seeded.py:C.reconcile"),
+            (self.K005_SEEDED, "TPUOP-K005", "py:controllers/seeded.py:J.charge"),
+        ]
+        for source, rule, location in cases:
+            findings = self.analyze(source)
+            baseline = Baseline.from_text(f"{rule} {location}  # fixture justification\n")
+            applied = baseline.apply(findings)
+            assert all(f.suppressed for f in applied), (rule, applied)
+            assert not failing(applied)
+            assert not baseline.unused_entries()
+
+    def test_k002_baseline_suppressible(self):
+        findings = self.analyze_many(self.K002_SEEDED)
+        baseline = Baseline.from_text(
+            "TPUOP-K002 py:workloads/b.py:B.write  # fixture justification\n"
+        )
+        applied = baseline.apply(findings)
+        assert all(f.suppressed for f in applied)
+        assert not failing(applied)
+
+    # -- the acceptance gate -------------------------------------------------
+
+    def test_shipped_tree_reconcile_contracts_clean(self):
+        """The shipped tree is K-clean with zero baseline entries: every
+        real finding the analyzer surfaced (the ownerless driver-pod
+        sweep, the fail-open replica list, the ungated repair charge)
+        was fixed outright, each pinned by a regression test."""
+        findings = runner.run_lint(only=["reconcile"])
+        k_rules = [f for f in findings if f.rule.startswith("TPUOP-K")]
+        assert not k_rules, [(f.rule, f.location) for f in k_rules]
+
+
+class TestReconcileContractReplays:
+    """Acceptance criterion: replaying the analyzer against pre-fix
+    reconstructions of real PR 13–16 hardening bugs proves each would
+    have been a build failure, not a review catch."""
+
+    def analyze(self, source, relpath):
+        from tpu_operator.lint import reconcile_contracts
+
+        return reconcile_contracts.analyze_source(source, relpath)
+
+    def test_pr13_ownerless_slice_sweep_would_have_been_caught(self):
+        """PR 13's hardening batch: the job sweep deleted every TPUSlice
+        named ``<job>-slice*`` — including a user's standalone look-alike
+        — until review added the ownerReference check. K001 makes the
+        pre-fix shape a build failure."""
+        pre_fix = """
+SLICE_SUFFIX = "-slice"
+
+class JobReconciler:
+    def _sweep_slices(self, job_name):
+        for obj in self.client.list("tpu.google.com/v1alpha1", "TPUSlice"):
+            if not obj["metadata"]["name"].startswith(job_name + SLICE_SUFFIX):
+                continue
+            self.client.delete(
+                "tpu.google.com/v1alpha1", "TPUSlice", obj["metadata"]["name"])
+"""
+        findings = self.analyze(pre_fix, "controllers/job_controller.py")
+        assert [f.rule for f in findings] == ["TPUOP-K001"]
+        assert findings[0].location == (
+            "py:controllers/job_controller.py:JobReconciler._sweep_slices"
+        )
+
+    def test_pr15_fail_open_defrag_ledger_would_have_been_caught(self):
+        """PR 15's hardening batch: ``_read_state`` answered a transient
+        ApiError with the fresh ``{"decisions": []}`` ledger, handing the
+        defrag controller a reset migration budget on every apiserver
+        blip — until review made it fail closed. K003 makes the pre-fix
+        shape a build failure (while the shipped fail-closed version and
+        its malformed-payload branch stay clean)."""
+        pre_fix = """
+import json
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+
+
+class DefragController:
+    def _read_state(self):
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP)
+        except errors.ApiError:
+            return {"decisions": []}
+        raw = ((cm or {}).get("data") or {}).get(consts.DEFRAG_STATE_KEY)
+        if not raw:
+            return {"decisions": []}
+        return json.loads(raw)
+
+    def _write_state(self, state):
+        body = {"data": {consts.DEFRAG_STATE_KEY: json.dumps(state, sort_keys=True)}}
+        self.client.patch("v1", "ConfigMap", consts.DEFRAG_STATE_CONFIGMAP, body)
+
+    def reconcile(self, req):
+        state = self._read_state()
+        state["decisions"] = state.get("decisions", [])[-10:]
+        self._write_state(state)
+"""
+        findings = self.analyze(pre_fix, "controllers/defrag_controller.py")
+        assert [f.rule for f in findings] == ["TPUOP-K003"]
+        assert findings[0].location == (
+            "py:controllers/defrag_controller.py:DefragController._read_state"
+        )
+
+    def test_pr16_label_spoofed_driver_pod_sweep_would_have_been_caught(self):
+        """The driver-pod bounce selected victims by component label
+        alone — the exact shape this PR fixed in the health controller
+        (now requiring a DaemonSet ownerReference)."""
+        pre_fix = """
+DRIVER_POD_COMPONENT_LABEL = "app.kubernetes.io/component"
+
+class NodeRepairManager:
+    def _delete_driver_pods(self, node_pods):
+        for pod in node_pods:
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(DRIVER_POD_COMPONENT_LABEL) != "tpu-driver":
+                continue
+            md = pod["metadata"]
+            self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
+"""
+        findings = self.analyze(pre_fix, "controllers/health_controller.py")
+        assert [f.rule for f in findings] == ["TPUOP-K001"]
+
+
+# ---------------------------------------------------------------------------
+# C004 dict-held threads (the PR 16 pod-kubelet idiom).
+# ---------------------------------------------------------------------------
+
+
+class TestDictHeldThreads:
+    """PR 16's pod data plane holds worker threads in dicts keyed by pod
+    name (``kube/sim.PodKubelet``); the C004 inventory must see through
+    that idiom."""
+
+    def analyze(self, source):
+        from tpu_operator.lint import concurrency
+
+        return concurrency.analyze_source(source, "seeded.py")
+
+    LEAKED = """
+import threading
+
+class Kubelet:
+    def __init__(self):
+        self.workers = {}
+
+    def start(self, name):
+        self.workers[name] = threading.Thread(target=self._run, name=name)
+        self.workers[name].start()
+
+    def _run(self):
+        pass
+"""
+
+    def test_dict_held_leaked_thread_fires_once(self):
+        findings = self.analyze(self.LEAKED)
+        assert [f.rule for f in findings] == ["TPUOP-C004"]
+        assert findings[0].location == "py:seeded.py:Kubelet.start"
+
+    def test_dict_held_daemon_is_clean(self):
+        daemon = self.LEAKED.replace(
+            "threading.Thread(target=self._run, name=name)",
+            "threading.Thread(target=self._run, name=name, daemon=True)",
+        )
+        assert self.analyze(daemon) == []
+
+    def test_values_loop_join_is_clean(self):
+        joined = self.LEAKED + """
+    def stop(self):
+        for t in self.workers.values():
+            t.join()
+"""
+        assert self.analyze(joined) == []
+
+    def test_items_loop_join_of_local_thread_is_clean(self):
+        source = """
+import threading
+
+class Kubelet:
+    def __init__(self):
+        self.workers = {}
+
+    def start(self, name):
+        t = threading.Thread(target=self._run, name=name)
+        self.workers[name] = t
+        t.start()
+
+    def stop(self):
+        for name, t in self.workers.items():
+            t.join()
+
+    def _run(self):
+        pass
+"""
+        assert self.analyze(source) == []
+
+    def test_shipped_pod_kubelet_stays_clean(self):
+        """The real PodKubelet (daemon pod threads, joined in stop)
+        must not regress under the extended inventory."""
+        findings = runner.run_lint(only=["concurrency"])
+        sim = [
+            f for f in findings
+            if f.rule == "TPUOP-C004" and "sim.py" in f.location and not f.suppressed
+        ]
+        assert not sim, sim
+
+
+# ---------------------------------------------------------------------------
+# lint/baseline.py: the factored-out suppression plumbing.
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineModule:
+    def test_reexport_is_the_same_class(self):
+        """findings.Baseline stayed importable (every analyzer test and
+        the CLI import it from there) and is the one implementation."""
+        from tpu_operator.lint import baseline as baseline_mod
+        from tpu_operator.lint import findings as findings_mod
+
+        assert findings_mod.Baseline is baseline_mod.Baseline
+        assert findings_mod.BaselineEntry is baseline_mod.BaselineEntry
+
+    def test_dead_entry_is_a_warning_not_info(self):
+        """An unused baseline entry warns in every family: WARNING rides
+        into the text/JSON reports prominently but still exits 0 (only
+        unsuppressed ERRORs fail builds)."""
+        from tpu_operator.lint.baseline import unused_entry_findings
+
+        baseline = Baseline.from_text(
+            "TPUOP-C003 py:nowhere.py:gone  # stale\n", path="/tmp/b"
+        )
+        found = unused_entry_findings(
+            baseline, set(runner.ANALYZERS), runner.family_of_rule, full_run=True
+        )
+        assert [f.rule for f in found] == ["TPUOP-B001"]
+        assert found[0].severity == "warning"
+        assert not failing(found)
+
+    def test_partial_run_judges_only_selected_families(self):
+        """--only concurrency can condemn a dead TPUOP-C entry (that
+        family DID run and the entry still matched nothing) but must not
+        condemn a manifest entry it never gave a chance to match."""
+        from tpu_operator.lint.baseline import unused_entry_findings
+
+        baseline = Baseline.from_text(
+            "TPUOP-C003 py:nowhere.py:gone  # stale\n"
+            "TPUOP-M001 ds:nowhere/ctr:x  # not judged on this run\n",
+            path="/tmp/b",
+        )
+        found = unused_entry_findings(
+            baseline, {"concurrency"}, runner.family_of_rule, full_run=False
+        )
+        assert len(found) == 1
+        assert "TPUOP-C003" in found[0].message
+
+    def test_partial_run_through_runner_reports_dead_family_entries(self, tmp_path):
+        """End to end: run_lint(only=['concurrency']) with a dead C
+        entry in the baseline yields the B001 warning even though the
+        run was partial."""
+        bl = tmp_path / "baseline"
+        bl.write_text("TPUOP-C003 py:nowhere.py:gone  # stale\n")
+        findings = runner.run_lint(baseline_path=str(bl), only=["concurrency"])
+        dead = [f for f in findings if f.rule == "TPUOP-B001"]
+        assert len(dead) == 1
+        assert dead[0].severity == "warning"
+
+    def test_unclaimed_rule_entries_judged_only_on_full_runs(self, tmp_path):
+        bl = tmp_path / "baseline"
+        bl.write_text("TPUOP-Z999 somewhere  # rule no family claims\n")
+        partial = runner.run_lint(baseline_path=str(bl), only=["concurrency"])
+        assert not [f for f in partial if f.rule == "TPUOP-B001"]
+        full = runner.run_lint(baseline_path=str(bl))
+        assert [f for f in full if f.rule == "TPUOP-B001"]

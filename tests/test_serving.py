@@ -33,6 +33,7 @@ from tpu_operator.controllers.serving_controller import (
     ServingReconciler,
     replica_name,
 )
+from tpu_operator.kube import errors
 from tpu_operator.kube.controller import Request
 from tpu_operator.kube.fake import FakeClient
 from tpu_operator.kube.objects import new_object
@@ -772,3 +773,57 @@ class TestServingController:
         assert block["desired"] == 0
         assert block["phase"] == ServingPhase.SERVING
         assert h.slices() == []
+
+
+class TestFailClosedOwnedReads:
+    """TPUOP-K003 regressions (PR 17): ``_owned_replicas`` gates replica
+    deletion and the deleted-serving sweep. It used to swallow a
+    transient list ``ApiError`` into ``[]`` — an impersonated "no
+    replicas" — so a single flaky LIST during the deleted-CR sweep
+    reported the sweep complete and leaked every replica forever (the
+    serving was gone; nothing would ever retrigger it). The read now
+    fails closed: ``None`` aborts the pass and the caller requeues."""
+
+    @staticmethod
+    def _flake_slice_lists(client):
+        """Shadow the bound ``list`` with one that 500s TPUSlice LISTs;
+        ``del client.list`` restores the real method."""
+        real = FakeClient.list
+
+        def flaky(api_version, kind, *a, **kw):
+            if kind == TPU_SLICE_KIND:
+                raise errors.ApiError("transient 500")
+            return real(client, api_version, kind, *a, **kw)
+
+        client.list = flaky
+
+    def test_deleted_serving_sweep_requeues_on_list_failure(self):
+        h = Harness(name="sweep-sv")
+        h.beat(4, rps=3.0)
+        assert h.slices() == [replica_name("sweep-sv", 0)]
+        h.client.delete(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, "sweep-sv")
+
+        self._flake_slice_lists(h.client)
+        res = h.rec.reconcile(h.req)
+        # the flaky read must NOT read as "nothing left to sweep"
+        assert res.requeue
+
+        # the flake heals: the requeued pass completes the sweep
+        del h.client.list
+        res = h.rec.reconcile(h.req)
+        assert not res.requeue
+        assert h.slices() == []
+
+    def test_live_pass_aborts_scale_decisions_on_list_failure(self):
+        h = Harness(name="abort-sv")
+        h.beat(4, rps=3.0)
+        before = h.slices()
+        assert before == [replica_name("abort-sv", 0)]
+
+        self._flake_slice_lists(h.client)
+        res = h.rec.reconcile(h.req)
+        assert res.requeue
+
+        # no scale decision ran against the impersonated empty world
+        del h.client.list
+        assert h.slices() == before

@@ -361,6 +361,11 @@ JOB_DEFRAG_REQUEST = "defragRequest"
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"
 REPAIR_STATE_SINCE_ANNOTATION = "tpu.google.com/tpu.repair-state-since"
 REPAIR_RETRIES_ANNOTATION = "tpu.google.com/tpu.repair-retries"
+# earliest unix time the next repair attempt may charge the retry
+# budget: persisted alongside the counter so a watch-event storm (or an
+# operator crash-loop) cannot burn the budget faster than the backoff
+# schedule — the same nextAttemptAt gate the TPUJob FSM rides
+REPAIR_NEXT_ATTEMPT_ANNOTATION = "tpu.google.com/tpu.repair-next-attempt-at"
 # what put the node into repair: "health" (the agent's probe verdict) or
 # "perf" (the exporter's sustained floor breach) — revalidation reads it
 # to know which signal must clear before the node may uncordon

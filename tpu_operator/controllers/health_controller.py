@@ -106,7 +106,11 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         return sorted(seen.values(), key=lambda n: n["metadata"]["name"])
 
     def _set_repair_state(
-        self, node: ObjectDict, new_state: str, retries: Optional[int] = None
+        self,
+        node: ObjectDict,
+        new_state: str,
+        retries: Optional[int] = None,
+        next_attempt_at: Optional[float] = None,
     ) -> bool:
         """One atomic node write: state label + transition timestamp (+
         the retry counter when an attempt begins). Sent as a labels/
@@ -119,6 +123,12 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         label_delta: dict = {}
         if retries is not None:
             annotation_delta[consts.REPAIR_RETRIES_ANNOTATION] = str(retries)
+        if next_attempt_at is not None:
+            # rides the same atomic patch as the counter: the charge and
+            # its backoff gate can never be observed apart
+            annotation_delta[consts.REPAIR_NEXT_ATTEMPT_ANNOTATION] = str(
+                round(next_attempt_at, 3)
+            )
         if new_state:
             if labels.get(consts.REPAIR_STATE_LABEL) == new_state and retries is None:
                 return True
@@ -204,10 +214,24 @@ class NodeRepairManager(ClusterUpgradeStateManager):
         bounded-retry helper (``kube/backoff.py``) — the same policy
         shape the TPUJob FSM quarantines through."""
         retries = self._retries(node)
-        if RetryBudget(retry_limit=remediation.retry_limit).exhausted(retries):
+        budget = RetryBudget(retry_limit=remediation.retry_limit)
+        if budget.exhausted(retries):
             self._set_repair_state(node, RepairState.QUARANTINED)
             self._cordon(node, True)
             return RepairState.QUARANTINED
+        # persisted backoff gate: a watch-event storm (or a crash-looping
+        # operator) redelivers the same degradation many times per second;
+        # without this stamp every delivery would burn one attempt and a
+        # burst of duplicates could quarantine a node the schedule says
+        # still has budget. Attempts arriving early leave the node in its
+        # current state — the next pass after the stamp re-enters.
+        next_at_raw = _annotations(node).get(consts.REPAIR_NEXT_ATTEMPT_ANNOTATION)
+        if next_at_raw is not None:
+            try:
+                if time.time() < float(next_at_raw):
+                    return _labels(node).get(consts.REPAIR_STATE_LABEL, "")
+            except ValueError:
+                pass  # mangled stamp degrades to "no gate", never a crash
         if reason and _annotations(node).get(consts.REPAIR_REASON_ANNOTATION) != reason:
             try:
                 live = self.client.patch(
@@ -217,7 +241,12 @@ class NodeRepairManager(ClusterUpgradeStateManager):
                 node["metadata"] = live["metadata"]
             except errors.NotFound:
                 return ""
-        if self._set_repair_state(node, RepairState.CORDON_REQUIRED, retries=retries + 1):
+        if self._set_repair_state(
+            node,
+            RepairState.CORDON_REQUIRED,
+            retries=retries + 1,
+            next_attempt_at=time.time() + budget.delay(retries + 1),
+        ):
             get_metrics().remediations_total.inc()
         return RepairState.CORDON_REQUIRED
 
@@ -381,6 +410,14 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             if labels.get(DRIVER_POD_COMPONENT_LABEL) != DRIVER_POD_COMPONENT:
                 continue
             md = pod["metadata"]
+            # label match alone is spoofable: only the DaemonSet's own
+            # pods are ours to bounce (a user pod wearing the component
+            # label must never be collateral)
+            if not any(
+                ref.get("kind") == "DaemonSet"
+                for ref in md.get("ownerReferences", [])
+            ):
+                continue
             try:
                 self.client.delete("v1", "Pod", md["name"], md.get("namespace"))
             except errors.NotFound:
@@ -490,7 +527,8 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             slice_label = not keep_slice_labels and consts.TPU_SLICE_HEALTH_LABEL in labels
             retries = consts.REPAIR_RETRIES_ANNOTATION in annotations
             reason = consts.REPAIR_REASON_ANNOTATION in annotations
-            if not state and not slice_label and not retries and not reason:
+            next_at = consts.REPAIR_NEXT_ATTEMPT_ANNOTATION in annotations
+            if not state and not slice_label and not retries and not reason and not next_at:
                 continue
             label_delta: dict = {}
             if state:
@@ -506,6 +544,8 @@ class NodeRepairManager(ClusterUpgradeStateManager):
             # stale count would quarantine the node's first new fault
             if retries:
                 annotation_delta[consts.REPAIR_RETRIES_ANNOTATION] = None
+            if consts.REPAIR_NEXT_ATTEMPT_ANNOTATION in annotations:
+                annotation_delta[consts.REPAIR_NEXT_ATTEMPT_ANNOTATION] = None
             try:
                 self.client.patch(
                     "v1", "Node", node["metadata"]["name"],

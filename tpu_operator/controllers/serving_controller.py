@@ -168,16 +168,22 @@ class ServingReconciler:
 
     def _owned_replicas(
         self, serving: str, infix: Optional[str] = None
-    ) -> List[ObjectDict]:
+    ) -> Optional[List[ObjectDict]]:
         """Every TPUSlice carrying a TPUServing ownerReference naming
         this serving — index order, so scale decisions are stable.
         ``infix`` narrows to one pool's slices (``-replica-`` for the
         decode/aggregated set, ``-prefill-`` for the prefill pool); the
-        default returns them all (the deletion sweep)."""
+        default returns them all (the deletion sweep).
+
+        Fails CLOSED: a transient list failure returns ``None`` (callers
+        abort the pass and requeue), never the empty list — this read
+        gates replica deletion and the deleted-serving sweep, and an
+        impersonated "no replicas" would leak every owned slice forever
+        (sweep sees nothing, and no requeue would ever retry)."""
         try:
             slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         except errors.ApiError:
-            return []
+            return None
         owned = []
         for obj in slices:
             if any(
@@ -450,6 +456,10 @@ class ServingReconciler:
         block["prefillDesired"] = desired
         replicas = self._owned_replicas(
             serving.name, infix=consts.SERVING_PREFILL_INFIX)
+        if replicas is None:
+            # fail closed: no create/retire against an unreadable pool
+            # (the resync pass retries with a real view)
+            return []
         if len(replicas) < desired:
             have = {o["metadata"]["name"] for o in replicas}
             for index in range(hi):
@@ -462,8 +472,10 @@ class ServingReconciler:
                         obj, serving.name, name, self._prefill_slice_spec(serving)):
                     break
                 have.add(name)
-            replicas = self._owned_replicas(
+            refreshed = self._owned_replicas(
                 serving.name, infix=consts.SERVING_PREFILL_INFIX)
+            if refreshed is not None:
+                replicas = refreshed
         elif len(replicas) > desired:
             # one per pass, highest index first (prefill replicas hold no
             # session KV, so victim choice is free — keep indexes dense)
@@ -473,13 +485,19 @@ class ServingReconciler:
                 replicas = replicas[:-1]
         return [self._replica_state(o, links) for o in replicas]
 
-    def _sweep_owned(self, serving: str) -> None:
+    def _sweep_owned(self, serving: str) -> bool:
         """Deleted serving: tear down every ownerRef-verified replica
         slice (real apiservers cascade via ownerReferences; the fake
         store is swept here — ownership verified, so a user's standalone
-        TPUSlice can never be collateral)."""
-        for obj in self._owned_replicas(serving):
+        TPUSlice can never be collateral). Returns False when the owned
+        set was unreadable — the caller must requeue, or the replicas
+        leak with nothing left to retrigger the sweep."""
+        owned = self._owned_replicas(serving)
+        if owned is None:
+            return False
+        for obj in owned:
             self._delete_replica(obj["metadata"]["name"])
+        return True
 
     def _pick_victim(
         self, serving: TPUServing, replicas: List[ObjectDict], links: List[tuple]
@@ -622,9 +640,11 @@ class ServingReconciler:
         obj = self.client.get_or_none(TPU_SERVING_API_VERSION, TPU_SERVING_KIND, req.name)
         if obj is None:
             self._retire_series(req.name)
-            self._sweep_owned(req.name)
+            swept = self._sweep_owned(req.name)
             self.pods.sweep(TPU_SERVING_KIND, req.name)
-            return Result()
+            # an unreadable owned set MUST requeue: the serving is gone,
+            # so nothing else will ever retrigger this sweep
+            return Result(requeue=not swept)
         serving = TPUServing.from_unstructured(obj)
         prior = dict(serving.status.serving or {})
         phase = prior.get("phase") or ServingPhase.PENDING
@@ -675,6 +695,11 @@ class ServingReconciler:
         links = self._degraded_links()
         replicas = self._owned_replicas(
             serving.name, infix=consts.SERVING_REPLICA_INFIX)
+        if replicas is None:
+            # transient list failure: abort before any scale decision —
+            # acting on an impersonated empty set would delete/recreate
+            # replicas against a world that isn't real
+            return Result(requeue=True)
         states = [self._replica_state(o, links) for o in replicas]
         now = time.time()
 

@@ -1,7 +1,7 @@
 """tpuop-lint: commit-time static analysis over everything the operator
 ships.
 
-Five analyzer families (see COMPONENTS.md §6 for the rule catalog):
+Six analyzer families (see COMPONENTS.md §6 for the rule catalog):
 
     manifest     every rendered operand state, the goldens, the chart
                  output, and the kustomize bases — security posture,
@@ -23,6 +23,12 @@ Five analyzer families (see COMPONENTS.md §6 for the rule catalog):
                  blocking-under-lock, thread-spawn hygiene
                  (lint/concurrency.py; runtime counterpart
                  kube/racecheck.py)
+    reconcile    reconcile-loop contracts over controllers/, dataplane/,
+                 workloads/: ownership-checked pattern deletes, the
+                 shared-ConfigMap key ownership map, fail-closed reads
+                 gating destructive actions, publish-once status, and
+                 persisted-gate retry charges
+                 (lint/reconcile_contracts.py)
 
 The motivating incident: a missing ``events`` grant that only surfaced
 at runtime via the RBAC-enforcing fake apiserver (TODO.md round 5) — a

@@ -1,0 +1,132 @@
+"""Baseline (suppression) file handling, shared by every analyzer family.
+
+One module owns the load/match/unused-entry logic so each family gets
+identical semantics: prefix matching stops at path boundaries, every
+suppression is recorded (not dropped), and an entry that matched
+nothing is itself a finding — per family, so even a partial
+``--only`` run reports the dead entries of the families it ran.
+
+Baseline format (``.tpuop-lint-baseline`` at the repo root), one entry
+per line:
+
+    RULE-ID  location-prefix  # one-line justification
+
+An entry suppresses every finding whose rule matches exactly and whose
+location starts with the given prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Set
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (findings re-exports us)
+    from tpu_operator.lint.findings import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    location_prefix: str
+    justification: str
+    lineno: int
+
+    def matches(self, finding: "Finding") -> bool:
+        """Prefix match on a path boundary: 'vol:dev' must not swallow
+        'vol:device-plugins'."""
+        if finding.rule != self.rule:
+            return False
+        loc, prefix = finding.location, self.location_prefix
+        if loc == prefix:
+            return True
+        if not loc.startswith(prefix):
+            return False
+        return prefix.endswith(("/", ":")) or loc[len(prefix)] in "/:["
+
+
+class Baseline:
+    """Parsed suppression file."""
+
+    def __init__(self, entries: List[BaselineEntry], path: str = ""):
+        self.entries = entries
+        self.path = path
+        self._hits: Dict[BaselineEntry, int] = {e: 0 for e in entries}
+
+    @classmethod
+    def from_text(cls, text: str, path: str = "") -> "Baseline":
+        entries: List[BaselineEntry] = []
+        for lineno, raw in enumerate(text.splitlines(), start=1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, justification = line.partition("#")
+            parts = body.split()
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{path or 'baseline'}:{lineno}: expected "
+                    f"'RULE location-prefix  # justification', got {raw!r}"
+                )
+            entries.append(
+                BaselineEntry(
+                    rule=parts[0],
+                    location_prefix=parts[1],
+                    justification=justification.strip(),
+                    lineno=lineno,
+                )
+            )
+        return cls(entries, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path) as f:
+                return cls.from_text(f.read(), path)
+        except FileNotFoundError:
+            return cls([], path)
+
+    def apply(self, findings: List["Finding"]) -> List["Finding"]:
+        """Mark suppressed findings; suppression is recorded (not
+        dropped) so reports can show what the baseline is absorbing."""
+        out: List["Finding"] = []
+        for f in findings:
+            entry = next((e for e in self.entries if e.matches(f)), None)
+            if entry is not None:
+                self._hits[entry] += 1
+                f = dataclasses.replace(f, suppressed=True)
+            out.append(f)
+        return out
+
+    def unused_entries(self) -> List[BaselineEntry]:
+        return [e for e, hits in self._hits.items() if hits == 0]
+
+
+def unused_entry_findings(
+    baseline: Baseline,
+    selected_families: Set[str],
+    family_of_rule: Callable[[str], Optional[str]],
+    full_run: bool = True,
+) -> List["Finding"]:
+    """TPUOP-B001 findings for entries that matched nothing, judged per
+    family: an entry is dead only if the analyzer family owning its
+    rule actually ran this invocation (a ``--only manifest`` run must
+    not condemn the concurrency entries it never gave a chance to
+    match). Entries whose rule no family claims are judged only on a
+    full run."""
+    from tpu_operator.lint.findings import WARNING, make
+
+    out: List["Finding"] = []
+    for entry in baseline.unused_entries():
+        family = family_of_rule(entry.rule)
+        if family is None:
+            if not full_run:
+                continue
+        elif family not in selected_families:
+            continue
+        out.append(make(
+            "TPUOP-B001", WARNING,
+            f"baseline:{os.path.basename(baseline.path)}:{entry.lineno}",
+            f"baseline entry '{entry.rule} {entry.location_prefix}' matched "
+            "nothing — delete it (dead exceptions hide real regressions)",
+        ))
+    return out

@@ -129,7 +129,7 @@ class _FuncFacts:
 
 class _ClassFacts:
     __slots__ = ("module", "name", "locks", "events", "threads", "queues",
-                 "thread_lists", "attr_types", "funcs")
+                 "thread_lists", "thread_dicts", "attr_types", "funcs")
 
     def __init__(self, module: str, name: str):
         self.module = module
@@ -139,6 +139,7 @@ class _ClassFacts:
         self.threads: Set[str] = set()
         self.queues: Set[str] = set()
         self.thread_lists: Set[str] = set()  # attrs that .append(thread)
+        self.thread_dicts: Set[str] = set()  # attrs with self.X[k] = thread
         self.attr_types: Dict[str, str] = {}  # attr -> annotated class name
         self.funcs: Dict[str, _FuncFacts] = {}
 
@@ -342,6 +343,9 @@ class _FuncWalker:
         self.facts = _FuncFacts(self.key)
         self.local_types: Dict[str, str] = {}   # var -> class name
         self.local_threads: Set[str] = set()    # vars bound to Thread(...)
+        # loop var -> the thread-dict/list attr it iterates, so a join on
+        # the var credits the holding attribute (dict-held pod threads)
+        self.local_thread_sources: Dict[str, str] = {}
         base_held: Tuple[LockNode, ...] = ()
         pragma = project.pragma_for_def(module, fn_node)
         if pragma and cls is not None:
@@ -437,7 +441,9 @@ class _FuncWalker:
                 if not others:
                     return None
                 return f"Condition self.{attr}.wait (releases only itself)"
-            if name == "join" and (attr in self.cls.threads or attr in self.cls.thread_lists):
+            if name == "join" and (attr in self.cls.threads
+                                   or attr in self.cls.thread_lists
+                                   or attr in self.cls.thread_dicts):
                 return f"Thread self.{attr}.join"
             if name in ("get", "join") and attr in self.cls.queues:
                 return f"queue self.{attr}.{name}"
@@ -520,22 +526,32 @@ class _FuncWalker:
             self._walk_body(handler.body, held)
 
     def _type_loop_var(self, node: ast.For) -> None:
-        if not isinstance(node.target, ast.Name) or self.cls is None:
+        if self.cls is None:
             return
         it = node.iter
         if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) and it.func.id == "list" and it.args:
             it = it.args[0]
+        target = node.target
         chain = _attr_chain(it)
-        # self.attr or self.attr.values()
-        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) and it.func.attr == "values":
-            chain = _attr_chain(it.func.value)
+        # self.attr or self.attr.values(); `for name, t in self.X.items()`
+        # types the VALUE element (the dict-held pod-thread shape)
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute):
+            if it.func.attr == "values":
+                chain = _attr_chain(it.func.value)
+            elif it.func.attr == "items" and isinstance(target, ast.Tuple) \
+                    and len(target.elts) == 2 and isinstance(target.elts[1], ast.Name):
+                chain = _attr_chain(it.func.value)
+                target = target.elts[1]
+        if not isinstance(target, ast.Name):
+            return
         if len(chain) == 2 and chain[0] == "self":
             attr = chain[1]
             t = self.cls.attr_types.get(attr)
             if t:
-                self.local_types[node.target.id] = t
-            if attr in self.cls.thread_lists:
-                self.local_threads.add(node.target.id)
+                self.local_types[target.id] = t
+            if attr in self.cls.thread_lists or attr in self.cls.thread_dicts:
+                self.local_threads.add(target.id)
+                self.local_thread_sources[target.id] = attr
 
     def _record_target(self, target, held: Tuple[LockNode, ...], lineno: int) -> None:
         if isinstance(target, (ast.Tuple, ast.List)):
@@ -553,8 +569,25 @@ class _FuncWalker:
 
     def _track_binding(self, node, value) -> None:
         """Local type facts: x = ClassName(...), x = Thread(...), and
-        thread-list appends are recorded where assignments happen."""
+        thread-list/dict stores are recorded where assignments happen."""
         targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        # dict-held threads (the pod-kubelet shape): self.X[key] = Thread(...)
+        # or self.X[key] = <local thread> marks X as a thread dict
+        if self.cls is not None:
+            for target in targets:
+                if not isinstance(target, ast.Subscript):
+                    continue
+                attr = _self_attr_target(target)
+                if not attr:
+                    continue
+                if isinstance(value, ast.Name) and value.id in self.local_threads:
+                    self.cls.thread_dicts.add(attr)
+                elif isinstance(value, ast.Call):
+                    # creation itself is recorded by the self-attr branch
+                    # below (_self_attr_target unwraps the subscript)
+                    _recv, cname = _call_name(value)
+                    if cname in _THREAD_CLASSES:
+                        self.cls.thread_dicts.add(attr)
         if not isinstance(value, ast.Call):
             return
         recv, cname = _call_name(value)
@@ -597,11 +630,15 @@ class _FuncWalker:
                         arg = node.args[0]
                         if isinstance(arg, ast.Name) and arg.id in self.local_threads:
                             self.cls.thread_lists.add(attr)
-            # .join() bookkeeping (thread hygiene)
+            # .join() bookkeeping (thread hygiene); joining a loop var
+            # drawn from a thread dict/list credits the holding attr too
             if isinstance(node.func, ast.Attribute) and node.func.attr == "join":
                 chain = _attr_chain(node.func.value)
                 if chain:
                     self.facts.joins.add(chain[-1])
+                    source = self.local_thread_sources.get(chain[-1])
+                    if source:
+                        self.facts.joins.add(source)
             blocking = self._blocking_desc(node, held)
             if blocking is not None and held:
                 self.facts.blocking.append((blocking, held, node.lineno))

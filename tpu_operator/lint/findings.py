@@ -1,4 +1,5 @@
-"""Finding model + baseline (suppression) file handling.
+"""Finding model (+ the baseline re-export every family suppresses
+through).
 
 A finding is (rule id, severity, location, message). Locations are
 stable, source-independent keys — ``DaemonSet/tpu-device-plugin/ctr:x``
@@ -7,14 +8,9 @@ several render paths (state render, golden snapshot, chart output)
 deduplicates to one finding, and a baseline entry written against one
 path keeps suppressing it through all of them.
 
-Baseline format (``.tpuop-lint-baseline`` at the repo root), one entry
-per line:
-
-    RULE-ID  location-prefix  # one-line justification
-
-An entry suppresses every finding whose rule matches exactly and whose
-location starts with the given prefix. Unused entries are themselves
-reported (info) so the baseline can't accrete dead exceptions.
+Baseline load/match/unused-entry logic lives in ``lint/baseline.py``
+(one implementation for every analyzer family); ``Baseline`` and
+``BaselineEntry`` stay importable from here for compatibility.
 """
 
 from __future__ import annotations
@@ -22,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 import json
 from typing import Dict, List, Optional, Tuple
+
+from tpu_operator.lint.baseline import Baseline, BaselineEntry  # noqa: F401 (re-export)
 
 ERROR = "error"
 WARNING = "warning"
@@ -48,82 +46,6 @@ class Finding:
         if self.suppressed:
             d["suppressed"] = True
         return d
-
-
-@dataclasses.dataclass(frozen=True)
-class BaselineEntry:
-    rule: str
-    location_prefix: str
-    justification: str
-    lineno: int
-
-    def matches(self, finding: Finding) -> bool:
-        """Prefix match on a path boundary: 'vol:dev' must not swallow
-        'vol:device-plugins'."""
-        if finding.rule != self.rule:
-            return False
-        loc, prefix = finding.location, self.location_prefix
-        if loc == prefix:
-            return True
-        if not loc.startswith(prefix):
-            return False
-        return prefix.endswith(("/", ":")) or loc[len(prefix)] in "/:["
-
-
-class Baseline:
-    """Parsed suppression file."""
-
-    def __init__(self, entries: List[BaselineEntry], path: str = ""):
-        self.entries = entries
-        self.path = path
-        self._hits: Dict[BaselineEntry, int] = {e: 0 for e in entries}
-
-    @classmethod
-    def from_text(cls, text: str, path: str = "") -> "Baseline":
-        entries: List[BaselineEntry] = []
-        for lineno, raw in enumerate(text.splitlines(), start=1):
-            line = raw.strip()
-            if not line or line.startswith("#"):
-                continue
-            body, _, justification = line.partition("#")
-            parts = body.split()
-            if len(parts) != 2:
-                raise ValueError(
-                    f"{path or 'baseline'}:{lineno}: expected "
-                    f"'RULE location-prefix  # justification', got {raw!r}"
-                )
-            entries.append(
-                BaselineEntry(
-                    rule=parts[0],
-                    location_prefix=parts[1],
-                    justification=justification.strip(),
-                    lineno=lineno,
-                )
-            )
-        return cls(entries, path)
-
-    @classmethod
-    def load(cls, path: str) -> "Baseline":
-        try:
-            with open(path) as f:
-                return cls.from_text(f.read(), path)
-        except FileNotFoundError:
-            return cls([], path)
-
-    def apply(self, findings: List[Finding]) -> List[Finding]:
-        """Mark suppressed findings; suppression is recorded (not
-        dropped) so reports can show what the baseline is absorbing."""
-        out: List[Finding] = []
-        for f in findings:
-            entry = next((e for e in self.entries if e.matches(f)), None)
-            if entry is not None:
-                self._hits[entry] += 1
-                f = dataclasses.replace(f, suppressed=True)
-            out.append(f)
-        return out
-
-    def unused_entries(self) -> List[BaselineEntry]:
-        return [e for e, hits in self._hits.items() if hits == 0]
 
 
 def dedupe(findings: List[Finding]) -> List[Finding]:
@@ -226,5 +148,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPUOP-D002": (ERROR, "helm crds/ and kustomize crd/ disagree"),
     "TPUOP-D003": (ERROR, "golden render snapshot stale (run scripts/update_golden.py)"),
     "TPUOP-D004": (ERROR, "kustomize tree stale (run scripts/update_kustomize.py)"),
-    "TPUOP-B001": (INFO, "baseline entry matched nothing — delete it"),
+    "TPUOP-K001": (ERROR, "pattern/label-selected delete with no ownerReference (or ownership-annotation) check in its call closure"),
+    "TPUOP-K002": (ERROR, "shared-ConfigMap key written by two components outside a declared handshake (disjoint-key convention)"),
+    "TPUOP-K003": (ERROR, "read gating a destructive/budget-charging action fails open: ApiError caught and treated as the empty/fresh-start result"),
+    "TPUOP-K004": (ERROR, "more than one status patch site per kind reachable in one reconcile pass (mutate-then-publish-once convention)"),
+    "TPUOP-K005": (ERROR, "retry-budget charge site with no persisted nextAttemptAt gate (watch storms can burn the budget)"),
+    "TPUOP-B001": (WARNING, "baseline entry matched nothing — delete it"),
 }

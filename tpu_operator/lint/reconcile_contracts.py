@@ -1,0 +1,916 @@
+"""TPUOP-K: static reconcile-contract rules over the control/data plane.
+
+Every PR since 13 shipped a late review-hardening batch fixing the same
+bug classes by hand: a sweep deleting a user's look-alike object because
+no ownerReference was verified (the PR 13 ``*-slice`` sweep, the PR 16
+label-spoofed pods), two components writing one shared-ConfigMap key, a
+transient read failure treated as "empty" handing back a destructive
+budget (the PR 15 defrag ledger), a reconcile publishing the same status
+twice (the PR 13 ``_fail``), and a retry budget charged per watch event
+instead of per backoff interval. This analyzer makes each class a build
+failure, the way TPUOP-C made lock races one.
+
+The pass covers ``controllers/``, ``dataplane/``, and ``workloads/`` —
+the modules that participate in reconcile loops or write the shared
+handshake ConfigMaps — with the same call-closure resolution the
+concurrency analyzer uses: self-calls, bare and imported module
+functions, and attribute receivers typed by annotation or constructor
+assignment.
+
+Rules (all error severity):
+
+- **K001** — a ``client.delete``/``evict`` whose candidates are selected
+  by name pattern or label must be dominated by an ownerReference (or
+  recorded-ownership annotation) check somewhere in its call closure.
+  A look-alike user object must never be collateral.
+- **K002** — shared-ConfigMap key ownership: every key written into the
+  ``*-progress``/``*-load``/routing/defrag-state/autotune/perf-floors
+  CMs is inventoried per writer component (module); a key with two
+  writer components outside a declared handshake is an error (the
+  controller-owned/trainer-owned disjoint-key convention).
+- **K003** — a read whose result gates a destructive or budget-charging
+  action (delete, label clear, retry charge, ledger reset) must fail
+  *closed*: catching ``ApiError`` and returning the empty/fresh-start
+  value is an error. Malformed-payload branches (ValueError/TypeError)
+  stay legal — a retry can never fix those.
+- **K004** — at most one status-patch call *site* per kind reachable
+  from one ``reconcile`` pass (mutate the block, publish once).
+- **K005** — every retry-budget charge site (``attempts/retries + 1``
+  persisted against a ``RetryBudget``) must sit behind a persisted
+  ``nextAttemptAt``-style gate, so watch-event storms cannot burn the
+  budget faster than the backoff schedule.
+
+Suppression: a finding line may carry ``# tpuop-lint: ignore=K001``
+(comma-separated rule ids, ``TPUOP-`` prefix optional), and every rule
+honors the shared baseline file through the runner.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tpu_operator.lint.findings import ERROR, Finding, make
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the reconcile-contract surface: control loops, the pod/router data
+# plane running under operator credentials, and the workload mains that
+# write the shared handshake ConfigMaps
+SCAN_ROOTS = ("controllers", "dataplane", "workloads")
+
+# (module relpath, class name or "" for module scope, function name)
+FuncKey = Tuple[str, str, str]
+
+_PRAGMA_RE = re.compile(r"#\s*tpuop-lint:\s*ignore=([A-Za-z0-9,\-\s]+)")
+
+_CLIENT_WRITE_VERBS = {"create", "update", "apply", "apply_set", "patch"}
+_DELETE_VERBS = {"delete", "evict"}
+_CHARGE_NAME_RE = re.compile(r"attempt|retr|restart", re.IGNORECASE)
+_GATE_RE = re.compile(r"next_?attempt", re.IGNORECASE)
+# identifier suffixes that mark name-pattern construction or label
+# selection (the consts naming convention: *_SUFFIX/*_INFIX/*_PREFIX
+# build derived object names; *_LABEL keys select by label)
+_SELECTOR_IDENT_RE = re.compile(r"(_LABEL|_SUFFIX|_INFIX|_PREFIX)$")
+_OWNER_IDENT_RE = re.compile(r"owner", re.IGNORECASE)
+
+# keys of the shared handshake ConfigMaps, resolved from consts so the
+# inventory can never drift from the constants the components write
+_SHARED_KEY_CONST_NAMES = (
+    "JOB_PROGRESS_STEP", "JOB_PROGRESS_EPOCH", "JOB_PROGRESS_CHECKPOINT_STEP",
+    "JOB_PROGRESS_WORLD", "JOB_PROGRESS_STATUS", "JOB_PROGRESS_ERROR",
+    "JOB_PROGRESS_CHECKPOINT_ACK", "JOB_PROGRESS_RESTART_ACK",
+    "JOB_CHECKPOINT_REQUEST", "JOB_RESTART_REQUEST", "JOB_DEFRAG_REQUEST",
+    "SERVING_LOAD_ARRIVAL_RATE", "SERVING_LOAD_QUEUE_DEPTH",
+    "SERVING_LOAD_TTFT_P50", "SERVING_LOAD_TTFT_P99",
+    "SERVING_LOAD_TOKENS_PER_S", "SERVING_LOAD_PREFILL_TTFT_P99",
+    "SERVING_LOAD_DECODE_TOKENS_PER_S", "SERVING_LOAD_KV_HIT_RATIO",
+    "SERVING_LOAD_HANDOFF_BYTES",
+    "SERVING_ROUTING_KEY", "SERVING_POOLS_KEY",
+    "DEFRAG_STATE_KEY", "AUTOTUNE_WINNERS_KEY", "PERF_FLOORS_KEY",
+)
+_SHARED_KEY_PREFIX_NAMES = ("JOB_RENDEZVOUS_PREFIX",)
+
+# declared handshake sets: a shared key listed here may be written by
+# exactly the named components (both sides of one protocol on one CM).
+# The shipped tree keeps every key single-writer — the handshake rides
+# DISJOINT keys (request vs ack) by convention — so this starts empty;
+# a legitimate multi-writer key must be declared here with its writers.
+DECLARED_HANDSHAKES: Dict[str, FrozenSet[str]] = {}
+
+
+def _shared_key_universe() -> Tuple[Dict[str, str], Dict[str, str]]:
+    from tpu_operator import consts
+
+    keys: Dict[str, str] = {}
+    prefixes: Dict[str, str] = {}
+    for name in _SHARED_KEY_CONST_NAMES:
+        value = getattr(consts, name, None)
+        if isinstance(value, str) and value:
+            keys[value] = name
+    for name in _SHARED_KEY_PREFIX_NAMES:
+        value = getattr(consts, name, None)
+        if isinstance(value, str) and value:
+            prefixes[value] = name
+    return keys, prefixes
+
+
+_SHARED_KEYS, _SHARED_PREFIXES = _shared_key_universe()
+
+
+def _is_shared_key(key: str) -> bool:
+    if key in _SHARED_KEYS:
+        return True
+    base = key[:-1] if key.endswith("*") else key
+    return any(base.startswith(p) or p.startswith(base) and base
+               for p in _SHARED_PREFIXES)
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['self', 'client', 'delete'] for self.client.delete; [] when the
+    chain passes through a call/subscript (not a simple receiver)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _idents(node: ast.AST) -> Set[str]:
+    """Every identifier and string constant in a subtree — the textual
+    basis for the charge/gate/selector token matches."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.add(sub.value)
+    return out
+
+
+def _contains_none(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Constant) and sub.value is None
+        for sub in ast.walk(node)
+    )
+
+
+def _fresh_start_return(expr: Optional[ast.AST]) -> bool:
+    """Whether a ``return`` value is the empty/fresh-start shape: a
+    container literal (or empty string / no-arg dict()/list()/set())
+    with no None sentinel anywhere. ``return None`` / bare return /
+    returning a name are the fail-closed shapes and stay legal."""
+    if expr is None:
+        return False
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set)):
+        return not _contains_none(expr)
+    if isinstance(expr, ast.Constant):
+        return expr.value == ""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+        return expr.func.id in ("dict", "list", "set", "tuple") and not expr.args
+    if isinstance(expr, ast.Tuple):
+        return bool(expr.elts) and all(_fresh_start_return(e) for e in expr.elts)
+    return False
+
+
+class _ModuleScope:
+    """Per-module name resolution: module-level string constants, names
+    imported from :mod:`tpu_operator.consts`, aliases of the consts
+    module itself, and in-package function imports (for cross-module
+    call resolution)."""
+
+    def __init__(self) -> None:
+        self.str_consts: Dict[str, str] = {}
+        self.consts_aliases: Set[str] = set()
+        self.func_imports: Dict[str, Tuple[str, str]] = {}  # local -> (module relpath, name)
+
+    def collect(self, tree: ast.Module) -> None:
+        from tpu_operator import consts as consts_mod
+
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if (isinstance(target, ast.Name)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    self.str_consts[target.id] = node.value.value
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom) or not node.module:
+                continue
+            if node.module == "tpu_operator" or node.module.endswith(".consts"):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if node.module.endswith(".consts"):
+                        value = getattr(consts_mod, alias.name, None)
+                        if isinstance(value, str):
+                            self.str_consts[local] = value
+                    elif alias.name == "consts":
+                        self.consts_aliases.add(local)
+                continue
+            if node.module.startswith("tpu_operator."):
+                rel = node.module[len("tpu_operator."):].replace(".", "/") + ".py"
+                for alias in node.names:
+                    self.func_imports[alias.asname or alias.name] = (rel, alias.name)
+
+    def resolve_str(
+        self, expr: ast.AST, local_strs: Optional[Dict[str, str]] = None
+    ) -> Optional[str]:
+        """A best-effort constant string for an expression. Partial
+        f-string/concat resolution yields ``"<prefix>*"`` so prefix
+        families (``rendezvous.<i>``) stay in the inventory."""
+        from tpu_operator import consts as consts_mod
+
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            if local_strs and expr.id in local_strs:
+                return local_strs[expr.id]
+            return self.str_consts.get(expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id in self.consts_aliases or expr.value.id == "consts":
+                value = getattr(consts_mod, expr.attr, None)
+                if isinstance(value, str):
+                    return value
+            return None
+        if isinstance(expr, ast.JoinedStr):
+            prefix = ""
+            for part in expr.values:
+                piece: Optional[str] = None
+                if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                    piece = part.value
+                elif isinstance(part, ast.FormattedValue):
+                    piece = self.resolve_str(part.value, local_strs)
+                if piece is None or piece.endswith("*"):
+                    return prefix + "*" if prefix else None
+                prefix += piece
+            return prefix
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self.resolve_str(expr.left, local_strs)
+            if left is None or left.endswith("*"):
+                return None
+            right = self.resolve_str(expr.right, local_strs)
+            return left + right if right is not None else left + "*"
+        return None
+
+
+class _FuncFacts:
+    """What one function does, recorded once and closed over the call
+    graph by the rules."""
+
+    __slots__ = (
+        "key", "calls", "deletes", "owner_check", "selector", "client_write",
+        "cm_writes", "param_cm_writes", "params", "fail_open", "status_sites",
+        "charges", "budget", "gate", "label_clear", "ledger_write",
+    )
+
+    def __init__(self, key: FuncKey):
+        self.key = key
+        # (callee FuncKey, resolved positional str args, resolved kw str args, lineno)
+        self.calls: List[Tuple[FuncKey, List[Optional[str]], Dict[str, Optional[str]], int]] = []
+        self.deletes: List[int] = []
+        self.owner_check = False
+        self.selector = False
+        self.client_write = False
+        self.cm_writes: List[Tuple[str, int]] = []       # (shared key, lineno)
+        self.param_cm_writes: Set[str] = set()           # params used as data keys
+        self.params: List[str] = []
+        self.fail_open: List[int] = []                   # ApiError -> fresh-start returns
+        self.status_sites: List[Tuple[Tuple[str, ...], int]] = []  # (kinds, lineno)
+        self.charges: List[int] = []
+        self.budget = False
+        self.gate = False
+        self.label_clear = False
+        self.ledger_write = False
+
+
+class Project:
+    """Parsed modules plus the indexes call resolution needs."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ast.Module] = {}
+        self.sources: Dict[str, List[str]] = {}
+        self.scopes: Dict[str, _ModuleScope] = {}
+        self.funcs: Dict[FuncKey, _FuncFacts] = {}
+        self.class_index: Dict[str, Tuple[str, str]] = {}  # class name -> (module, class)
+        self.attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}  # (module, cls) -> attr -> class
+
+    def add_module(self, relpath: str, source: str) -> None:
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError:
+            return
+        self.modules[relpath] = tree
+        self.sources[relpath] = source.splitlines()
+        scope = _ModuleScope()
+        scope.collect(tree)
+        self.scopes[relpath] = scope
+
+    def pragma_ignores(self, module: str, lineno: int) -> Set[str]:
+        lines = self.sources.get(module) or []
+        if not 1 <= lineno <= len(lines):
+            return set()
+        m = _PRAGMA_RE.search(lines[lineno - 1])
+        if not m:
+            return set()
+        out = set()
+        for token in m.group(1).split(","):
+            token = token.strip()
+            if token.startswith("TPUOP-"):
+                token = token[len("TPUOP-"):]
+            if token:
+                out.add(token)
+        return out
+
+
+def _inventory(project: Project) -> None:
+    """Class index + attribute types (annotations and constructor
+    assignments) — what lets ``self.pods.sweep(...)`` resolve into
+    :mod:`dataplane.pods` without annotations on every attribute."""
+    for module, tree in project.modules.items():
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            project.class_index.setdefault(node.name, (module, node.name))
+            attr_types = project.attr_types.setdefault((module, node.name), {})
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    cls_name = _strip_type(stmt.annotation)
+                    if cls_name:
+                        attr_types[stmt.target.id] = cls_name
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                    continue
+                target = sub.targets[0]
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and isinstance(sub.value, ast.Call)):
+                    callee = sub.value.func
+                    name = callee.attr if isinstance(callee, ast.Attribute) else (
+                        callee.id if isinstance(callee, ast.Name) else "")
+                    if name and name[0].isupper():
+                        attr_types.setdefault(target.attr, name)
+
+
+def _strip_type(annotation: ast.AST) -> Optional[str]:
+    if isinstance(annotation, ast.Constant) and isinstance(annotation.value, str):
+        name = annotation.value
+        return name.split("[")[0].split(".")[-1] or None
+    if isinstance(annotation, ast.Name):
+        return annotation.id
+    if isinstance(annotation, ast.Attribute):
+        return annotation.attr
+    if isinstance(annotation, ast.Subscript):
+        base = _strip_type(annotation.value)
+        if base in ("Optional", "List", "Sequence", "Iterable"):
+            inner = annotation.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[-1]
+            return _strip_type(inner)
+        return base
+    return None
+
+
+class _FuncWalker(ast.NodeVisitor):
+    def __init__(self, project: Project, module: str, cls: str,
+                 fn: ast.FunctionDef):
+        self.project = project
+        self.module = module
+        self.cls = cls
+        self.scope = project.scopes[module]
+        self.facts = _FuncFacts((module, cls, fn.name))
+        args = fn.args
+        self.facts.params = [
+            a.arg for a in (args.posonlyargs + args.args) if a.arg != "self"
+        ]
+        self.local_strs: Dict[str, str] = {}
+        self.local_types: Dict[str, str] = {}
+        self._in_data_value = 0
+
+    # -- resolution helpers ------------------------------------------------
+
+    def _resolve_call(self, node: ast.Call) -> Optional[FuncKey]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            key = (self.module, "", func.id)
+            if key in self.project.funcs or self._module_has_func(self.module, "", func.id):
+                return key
+            imported = self.scope.func_imports.get(func.id)
+            if imported:
+                return (imported[0], "", imported[1])
+            return None
+        chain = _attr_chain(func)
+        if not chain:
+            return None
+        if len(chain) == 2 and chain[0] == "self" and self.cls:
+            return (self.module, self.cls, chain[1])
+        if len(chain) == 2:
+            receiver, method = chain
+            cls_name = self.local_types.get(receiver)
+            if cls_name:
+                loc = self.project.class_index.get(cls_name)
+                if loc:
+                    return (loc[0], loc[1], method)
+            imported = self.scope.func_imports.get(receiver)
+            if imported:
+                # `from tpu_operator.controllers import status` + status.f()
+                return (imported[0].replace(".py", "") + "/" + imported[1] + ".py",
+                        "", method) if False else None
+            return None
+        if len(chain) == 3 and chain[0] == "self" and self.cls:
+            attr_types = self.project.attr_types.get((self.module, self.cls), {})
+            cls_name = attr_types.get(chain[1])
+            if cls_name:
+                loc = self.project.class_index.get(cls_name)
+                if loc:
+                    return (loc[0], loc[1], chain[2])
+        return None
+
+    def _module_has_func(self, module: str, cls: str, name: str) -> bool:
+        tree = self.project.modules.get(module)
+        if tree is None:
+            return False
+        for node in tree.body:
+            if cls == "" and isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name == name:
+                    return True
+        return False
+
+    def _resolved_args(self, node: ast.Call) -> Tuple[List[Optional[str]], Dict[str, Optional[str]]]:
+        pos = [self.scope.resolve_str(a, self.local_strs) for a in node.args]
+        kw = {
+            k.arg: self.scope.resolve_str(k.value, self.local_strs)
+            for k in node.keywords if k.arg
+        }
+        return pos, kw
+
+    # -- statement/expression visits ---------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs: walk their bodies as part of this function
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            value = self.scope.resolve_str(node.value, self.local_strs)
+            if value is not None:
+                self.local_strs[name] = value
+            if isinstance(node.value, ast.Call):
+                callee = node.value.func
+                cname = callee.attr if isinstance(callee, ast.Attribute) else (
+                    callee.id if isinstance(callee, ast.Name) else "")
+                if cname and cname[0].isupper() and cname in self.project.class_index:
+                    self.local_types[name] = cname
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Subscript):
+            target = node.targets[0]
+            key_expr = target.slice
+            # subscript stores count only through a named constant (the
+            # house idiom writes shared keys via consts.*); a raw string
+            # literal here is some other dict ("status", "spec", ...)
+            if not isinstance(key_expr, ast.Constant):
+                key = self.scope.resolve_str(key_expr, self.local_strs)
+                if key and _is_shared_key(key):
+                    self._record_cm_write(key, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, ast.Add) and isinstance(node.value, ast.Constant) \
+                and node.value.value == 1:
+            if any(_CHARGE_NAME_RE.search(i) for i in _idents(node.target)):
+                self.facts.charges.append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.Add) and isinstance(node.right, ast.Constant) \
+                and node.right.value == 1:
+            if any(_CHARGE_NAME_RE.search(i) for i in _idents(node.left)):
+                self.facts.charges.append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if handler.type is not None and self._catches_api_error(handler.type):
+                for sub in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+                    if isinstance(sub, ast.Return) and _fresh_start_return(sub.value):
+                        self.facts.fail_open.append(sub.lineno)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _catches_api_error(type_expr: ast.AST) -> bool:
+        names = set()
+        for sub in ast.walk(type_expr):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                names.add(sub.attr)
+        return "ApiError" in names
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        in_data_child: List[ast.Dict] = []
+        for key_expr, value in zip(node.keys, node.values):
+            if key_expr is None:
+                continue
+            key = self.scope.resolve_str(key_expr, self.local_strs)
+            if key == "data" and isinstance(value, ast.Dict):
+                in_data_child.append(value)
+            # label-clear: {<*_LABEL>: None}
+            idents = _idents(key_expr) if not isinstance(key_expr, ast.Constant) else set()
+            if (any(_SELECTOR_IDENT_RE.search(i) and i.endswith("_LABEL") for i in idents)
+                    and isinstance(value, ast.Constant) and value.value is None):
+                self.facts.label_clear = True
+            if self._in_data_value:
+                # inside a {"data": {...}} literal every resolvable key
+                # counts, literal strings included
+                if key and _is_shared_key(key):
+                    self._record_cm_write(key, node.lineno)
+                elif key is None and isinstance(key_expr, ast.Name) \
+                        and key_expr.id in self.facts.params:
+                    self.facts.param_cm_writes.add(key_expr.id)
+            elif not isinstance(key_expr, ast.Constant):
+                # outside a data-literal only *named* keys count (raw
+                # "status"/"step" literals are ordinary patch bodies)
+                if key and _is_shared_key(key):
+                    self._record_cm_write(key, node.lineno)
+                elif isinstance(key_expr, ast.Name) and key_expr.id in self.facts.params:
+                    self.facts.param_cm_writes.add(key_expr.id)
+        for key_expr, value in zip(node.keys, node.values):
+            if value in in_data_child:
+                self._in_data_value += 1
+                self.visit(value)
+                self._in_data_value -= 1
+            else:
+                if key_expr is not None:
+                    self.visit(key_expr)
+                self.visit(value)
+
+    def _record_cm_write(self, key: str, lineno: int) -> None:
+        self.facts.cm_writes.append((key, lineno))
+        from tpu_operator import consts
+        if key == getattr(consts, "DEFRAG_STATE_KEY", "state.json"):
+            self.facts.ledger_write = True
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        chain = _attr_chain(func)
+        verb = chain[-1] if chain else ""
+        receiver = chain[-2] if len(chain) >= 2 else ""
+        is_client = "client" in receiver.lower() if receiver else False
+        if is_client and verb in _DELETE_VERBS:
+            self.facts.deletes.append(node.lineno)
+        elif is_client and verb in _CLIENT_WRITE_VERBS:
+            self.facts.client_write = True
+        elif chain and verb == "evict" and receiver == "self":
+            pass
+        if is_client and verb in ("patch_status", "update_status"):
+            self.facts.status_sites.append(
+                (self._status_kinds(node), node.lineno)
+            )
+        if verb in ("startswith", "endswith"):
+            self.facts.selector = True
+        if verb == "exhausted":
+            self.facts.budget = True
+        if isinstance(func, ast.Name) and func.id == "RetryBudget":
+            self.facts.budget = True
+        for kw in node.keywords:
+            if kw.arg in ("label_selector", "labelSelector"):
+                self.facts.selector = True
+        callee = self._resolve_call(node)
+        if callee is not None:
+            pos, kw = self._resolved_args(node)
+            self.facts.calls.append((callee, pos, kw, node.lineno))
+        self.generic_visit(node)
+
+    def _status_kinds(self, node: ast.Call) -> Tuple[str, ...]:
+        """The kind(s) a status-patch site targets: the call line's
+        ``kinds=`` pragma (normalized to the bare Kind) when present,
+        else the resolvable kind argument; unresolvable sites get a
+        site-unique kind so they can never be miscounted together."""
+        lines = self.project.sources.get(self.module) or []
+        if 1 <= node.lineno <= len(lines):
+            m = re.search(r"#\s*tpuop-lint:\s*kinds=([\w\./,\-]+)", lines[node.lineno - 1])
+            if m:
+                return tuple(
+                    k.strip().rsplit("/", 1)[-1]
+                    for k in m.group(1).split(",") if k.strip()
+                )
+        if len(node.args) >= 2:
+            kind = self.scope.resolve_str(node.args[1], self.local_strs)
+            if kind:
+                return (kind,)
+        return (f"?{self.module}:{node.lineno}",)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Name):
+            if node.id == "RetryBudget":
+                self.facts.budget = True
+            if _GATE_RE.search(node.id):
+                self.facts.gate = True
+            if _SELECTOR_IDENT_RE.search(node.id):
+                self.facts.selector = True
+            if node.id == "ownerReferences" or (
+                    _OWNER_IDENT_RE.search(node.id) and node.id.isupper()):
+                self.facts.owner_check = True
+        elif isinstance(node, ast.Attribute):
+            if node.attr == "ownerReferences":
+                self.facts.owner_check = True
+            if _GATE_RE.search(node.attr):
+                self.facts.gate = True
+            if _SELECTOR_IDENT_RE.search(node.attr):
+                self.facts.selector = True
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value == "ownerReferences":
+                self.facts.owner_check = True
+            if _GATE_RE.search(node.value):
+                self.facts.gate = True
+        super().generic_visit(node)
+
+
+def build_project(source_root: Optional[str] = None) -> Project:
+    root = source_root or PKG_ROOT
+    project = Project()
+    for scan in SCAN_ROOTS:
+        scan_dir = os.path.join(root, scan)
+        if not os.path.isdir(scan_dir):
+            continue
+        for dirpath, dirnames, filenames in os.walk(scan_dir):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root)
+                with open(path) as f:
+                    project.add_module(rel, f.read())
+    return project
+
+
+def _walk_functions(project: Project) -> None:
+    for module, tree in project.modules.items():
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walker = _FuncWalker(project, module, "", node)
+                for stmt in node.body:
+                    walker.visit(stmt)
+                project.funcs[walker.facts.key] = walker.facts
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        walker = _FuncWalker(project, module, node.name, item)
+                        for stmt in item.body:
+                            walker.visit(stmt)
+                        project.funcs[walker.facts.key] = walker.facts
+
+
+class _Closure:
+    def __init__(self, project: Project):
+        self.project = project
+        self._memo: Dict[FuncKey, Set[FuncKey]] = {}
+
+    def keys(self, key: FuncKey) -> Set[FuncKey]:
+        if key in self._memo:
+            return self._memo[key]
+        seen: Set[FuncKey] = set()
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            facts = self.project.funcs.get(k)
+            if facts is None:
+                continue
+            for callee, _pos, _kw, _ln in facts.calls:
+                if callee not in seen:
+                    stack.append(callee)
+        self._memo[key] = seen
+        return seen
+
+    def any_fact(self, key: FuncKey, attr: str) -> bool:
+        return any(
+            getattr(self.project.funcs[k], attr)
+            for k in self.keys(key) if k in self.project.funcs
+        )
+
+    def destructive(self, key: FuncKey) -> bool:
+        for k in self.keys(key):
+            facts = self.project.funcs.get(k)
+            if facts is None:
+                continue
+            if facts.deletes or facts.charges or facts.label_clear or facts.ledger_write:
+                return True
+        return False
+
+
+def _fmt(key: FuncKey) -> str:
+    module, cls, name = key
+    return f"py:{module}:{cls + '.' if cls else ''}{name}"
+
+
+def _component(module: str) -> str:
+    return module[:-3] if module.endswith(".py") else module
+
+
+def _analyze_project(
+    project: Project,
+    handshakes: Optional[Dict[str, FrozenSet[str]]] = None,
+) -> List[Finding]:
+    _inventory(project)
+    _walk_functions(project)
+    closure = _Closure(project)
+    handshakes = DECLARED_HANDSHAKES if handshakes is None else handshakes
+    findings: List[Finding] = []
+
+    def suppressed(module: str, lineno: int, rule_suffix: str) -> bool:
+        return rule_suffix in project.pragma_ignores(module, lineno)
+
+    # reverse reachability: every function whose closure contains key
+    callers_of: Dict[FuncKey, Set[FuncKey]] = {k: set() for k in project.funcs}
+    for root in project.funcs:
+        for member in closure.keys(root):
+            if member in callers_of:
+                callers_of[member].add(root)
+
+    # -- K001: pattern/label-selected delete needs an ownership check --------
+    for key, facts in project.funcs.items():
+        for lineno in facts.deletes:
+            bad = False
+            for root in callers_of[key]:
+                if closure.any_fact(root, "selector") and not closure.any_fact(root, "owner_check"):
+                    bad = True
+                    break
+            if bad and not suppressed(key[0], lineno, "K001"):
+                findings.append(make(
+                    "TPUOP-K001", ERROR, _fmt(key),
+                    f"delete at line {lineno} tears down an object selected by "
+                    "name pattern or label with no ownerReference (or "
+                    "ownership-annotation) check anywhere in its call closure — "
+                    "a look-alike user object would be collateral; verify "
+                    "ownership before deleting, or annotate the contract with "
+                    "# tpuop-lint: ignore=K001",
+                ))
+
+    # -- K002: shared-CM key ownership map -----------------------------------
+    writers: Dict[str, Dict[str, List[Tuple[FuncKey, int]]]] = {}
+
+    def record_write(key: str, func_key: FuncKey, lineno: int) -> None:
+        writers.setdefault(key, {}).setdefault(
+            _component(func_key[0]), []
+        ).append((func_key, lineno))
+
+    for key, facts in project.funcs.items():
+        if not (facts.cm_writes or facts.param_cm_writes):
+            continue
+        if not (facts.client_write or closure.any_fact(key, "client_write")):
+            continue
+        for shared_key, lineno in facts.cm_writes:
+            record_write(shared_key, key, lineno)
+    # one-level constant propagation: a helper writing `{"data": {key:
+    # v}}` for a `key` parameter attributes the write to each caller
+    # that passes a resolvable shared key (the `_request_progress_key`
+    # idiom)
+    for key, facts in project.funcs.items():
+        for callee, pos, kw, lineno in facts.calls:
+            target = project.funcs.get(callee)
+            if target is None or not target.param_cm_writes:
+                continue
+            if not (target.client_write or closure.any_fact(callee, "client_write")):
+                continue
+            bound: Dict[str, Optional[str]] = dict(zip(target.params, pos))
+            bound.update(kw)
+            for param in target.param_cm_writes:
+                value = bound.get(param)
+                if value and _is_shared_key(value):
+                    record_write(value, key, lineno)
+
+    for shared_key in sorted(writers):
+        components = writers[shared_key]
+        if len(components) <= 1:
+            continue
+        allowed = handshakes.get(shared_key)
+        if allowed is not None and set(components) <= set(allowed):
+            continue
+        ordered = sorted(components)
+        # fire once per key, anchored at the second component's first
+        # write site (the first writer in sorted order is the "owner")
+        func_key, lineno = sorted(components[ordered[1]])[0]
+        if suppressed(func_key[0], lineno, "K002"):
+            continue
+        findings.append(make(
+            "TPUOP-K002", ERROR, _fmt(func_key),
+            f"shared ConfigMap key '{shared_key}' is written by "
+            f"{len(ordered)} components ({', '.join(ordered)}) — the "
+            "disjoint-key convention gives every key one writer; declare "
+            "a handshake in lint/reconcile_contracts.py if both sides of "
+            "one protocol legitimately own it",
+        ))
+
+    # -- K003: destructive-gating reads must fail closed ---------------------
+    for key, facts in project.funcs.items():
+        if not facts.fail_open:
+            continue
+        gated = any(closure.destructive(root) for root in callers_of[key])
+        if not gated:
+            continue
+        for lineno in facts.fail_open:
+            if suppressed(key[0], lineno, "K003"):
+                continue
+            findings.append(make(
+                "TPUOP-K003", ERROR, _fmt(key),
+                f"ApiError caught at line {lineno} and answered with the "
+                "empty/fresh-start value, but this read gates a destructive "
+                "or budget-charging action in a caller — a transient "
+                "apiserver failure must abort the pass (return None/raise), "
+                "not impersonate the empty state; only malformed-payload "
+                "branches may start fresh",
+            ))
+
+    # -- K004: one status-patch site per kind per reconcile pass -------------
+    for key, facts in project.funcs.items():
+        if key[2] != "reconcile":
+            continue
+        by_kind: Dict[str, List[Tuple[FuncKey, int]]] = {}
+        for member in closure.keys(key):
+            mfacts = project.funcs.get(member)
+            if mfacts is None:
+                continue
+            for kinds, lineno in mfacts.status_sites:
+                for kind in kinds:
+                    by_kind.setdefault(kind, []).append((member, lineno))
+        for kind in sorted(by_kind):
+            sites = sorted(set(by_kind[kind]))
+            if len(sites) <= 1:
+                continue
+            for site_key, lineno in sites[1:]:
+                if suppressed(site_key[0], lineno, "K004"):
+                    continue
+                findings.append(make(
+                    "TPUOP-K004", ERROR, _fmt(site_key),
+                    f"status patch for kind {kind} at line {lineno} is the "
+                    f"second of {len(sites)} sites reachable from "
+                    f"{_fmt(key)} — one reconcile pass publishes each "
+                    "kind's status exactly once (mutate the block, publish "
+                    "at the tail); fold this write into the single "
+                    "publisher",
+                ))
+
+    # -- K005: budget charges ride a persisted nextAttemptAt gate ------------
+    for key, facts in project.funcs.items():
+        if not facts.charges:
+            continue
+        if not (facts.budget or closure.any_fact(key, "budget")):
+            continue
+        if closure.any_fact(key, "gate"):
+            continue
+        for lineno in facts.charges:
+            if suppressed(key[0], lineno, "K005"):
+                continue
+            findings.append(make(
+                "TPUOP-K005", ERROR, _fmt(key),
+                f"retry-budget charge at line {lineno} has no persisted "
+                "nextAttemptAt-style gate in its call closure — every watch "
+                "delivery can burn one attempt, so an event storm exhausts "
+                "the budget in seconds; persist the next allowed attempt "
+                "time and skip charges that arrive early",
+            ))
+
+    return findings
+
+
+def analyze(
+    source_root: Optional[str] = None,
+    handshakes: Optional[Dict[str, FrozenSet[str]]] = None,
+) -> List[Finding]:
+    return _analyze_project(build_project(source_root), handshakes)
+
+
+def analyze_source(
+    source: str,
+    relpath: str = "controllers/module.py",
+    handshakes: Optional[Dict[str, FrozenSet[str]]] = None,
+) -> List[Finding]:
+    """Single-module entry point for tests."""
+    return analyze_sources({relpath: source}, handshakes)
+
+
+def analyze_sources(
+    sources: Dict[str, str],
+    handshakes: Optional[Dict[str, FrozenSet[str]]] = None,
+) -> List[Finding]:
+    """Multi-module entry point (K002's writer inventory spans
+    components, so its fixtures need more than one module)."""
+    project = Project()
+    for relpath, source in sources.items():
+        project.add_module(relpath, source)
+    return _analyze_project(project, handshakes)

@@ -32,13 +32,13 @@ from tpu_operator.lint import (
     manifest_rules,
     metrics_catalog,
     rbac_static,
+    reconcile_contracts,
 )
+from tpu_operator.lint.baseline import unused_entry_findings
 from tpu_operator.lint.findings import (
-    INFO,
     Baseline,
     Finding,
     dedupe,
-    make,
     sort_findings,
 )
 
@@ -46,7 +46,7 @@ PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REPO_ROOT = os.path.dirname(PKG_ROOT)
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, ".tpuop-lint-baseline")
 
-ANALYZERS = ("manifest", "rbac", "drift", "metrics", "concurrency")
+ANALYZERS = ("manifest", "rbac", "drift", "metrics", "concurrency", "reconcile")
 
 # which analyzer family owns each rule-id prefix — what lets --only/
 # --skip accept rule ids and still run only the analyzers involved
@@ -56,6 +56,7 @@ RULE_PREFIX_FAMILIES = {
     "TPUOP-D": "drift",
     "TPUOP-O": "metrics",
     "TPUOP-C": "concurrency",
+    "TPUOP-K": "reconcile",
 }
 
 
@@ -118,6 +119,12 @@ def run_lint(
     dict as ``timings`` to receive per-analyzer wall seconds (the JSON
     report surfaces them — a slow analyzer is a CI tax everyone pays)."""
     selected = set(only or ANALYZERS)
+    unknown = selected - set(ANALYZERS)
+    if unknown:
+        raise ValueError(
+            f"unknown analyzer name(s): {', '.join(sorted(unknown))} "
+            f"(valid: {', '.join(ANALYZERS)})"
+        )
     findings: List[Finding] = []
 
     def timed(name: str, fn) -> None:
@@ -147,19 +154,18 @@ def run_lint(
         timed("metrics", metrics_catalog.analyze_gauge_retirement)
     if "concurrency" in selected:
         timed("concurrency", concurrency.analyze)
+    if "reconcile" in selected:
+        timed("reconcile", reconcile_contracts.analyze)
     findings = dedupe(findings)
 
     baseline = Baseline.load(
         DEFAULT_BASELINE if baseline_path is None else baseline_path
     )
     findings = baseline.apply(findings)
-    if selected != set(ANALYZERS):
-        return sort_findings(findings)  # partial run: can't judge dead entries
-    for entry in baseline.unused_entries():
-        findings.append(make(
-            "TPUOP-B001", INFO,
-            f"baseline:{os.path.basename(baseline.path)}:{entry.lineno}",
-            f"baseline entry '{entry.rule} {entry.location_prefix}' matched "
-            "nothing — delete it (dead exceptions hide real regressions)",
-        ))
+    # dead-entry warnings are judged per family, so even a partial
+    # --only run condemns the unmatched entries of the families it ran
+    findings.extend(unused_entry_findings(
+        baseline, selected, family_of_rule,
+        full_run=selected == set(ANALYZERS),
+    ))
     return sort_findings(findings)
