@@ -153,10 +153,10 @@ def bench_install_to_ready(
             cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
             if cp.get("status", {}).get("state") == "ready":
                 dses = store.list("apps/v1", "DaemonSet", ns)
-                # election-gated autotuner: desired/available 0 here
-                if len(dses) == 10 and all(
+                # election-gated autotuner + compile-cache: desired/available 0 here
+                if len(dses) == 11 and all(
                     ds.get("status", {}).get("numberAvailable")
-                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else nodes)
+                    == (0 if ds["metadata"]["name"] in ("tpu-autotuner", "tpu-compile-cache") else nodes)
                     for ds in dses
                 ):
                     elapsed = time.perf_counter() - t0
@@ -642,6 +642,11 @@ def _compact_summary(out: dict) -> dict:
             for policy in ("best-fit", "defrag-aware")
         },
         "plan_model_ratio": out.get("fleet_sim", {}).get("model", {}).get("ratio"),
+        "compile_warm_ttft_s": out.get("compile", {}).get("compile_warm_ttft_s"),
+        "compile_cold_ttft_s": out.get("compile", {}).get("compile_cold_ttft_s"),
+        "compile_cache_hit_ratio": out.get("compile", {}).get(
+            "compile_cache_hit_ratio"
+        ),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -1795,6 +1800,307 @@ def autotune_smoke() -> int:
         "v5e_roof_x_fraction": round(want_v5e, 1),
         "local_flash": block.get("flash"),
         "checks": checks,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
+def compile_block() -> dict:
+    """Warm-vs-cold warm-start on the local backend: a first replica of
+    a (generation, topology, model) key pays the cold XLA compile and
+    publishes the measured duration; a second replica resolves the
+    record and warms from the in-process executable cache. Cold is
+    measured FIRST — the jit cache would otherwise hide it."""
+    from tpu_operator.workloads import compilecache
+    from tpu_operator.workloads.compilecache import CompileCacheStore
+    from tpu_operator.workloads.serving import DecodeEngine, ServingModelConfig
+    from tpu_operator.kube.fake import FakeClient
+
+    compilecache.reset_stats()
+    store = CompileCacheStore(FakeClient(), "tpu-operator", libtpu_version="bench")
+    # distinct dims: this key's executables are this block's alone
+    cfg = ServingModelConfig(max_seq=48)
+    outcome_cold, cold_s = store.warm_start(
+        DecodeEngine(cfg), "v5e", "2x4", serving="bench")
+    outcome_warm, warm_s = store.warm_start(
+        DecodeEngine(cfg), "v5e", "2x4", serving="bench")
+    stats = compilecache.stats()
+    hits = sum(stats["hits"].values())
+    misses = sum(stats["misses"].values())
+    return {
+        "compile_cold_ttft_s": round(cold_s, 4),
+        "compile_warm_ttft_s": round(warm_s, 4),
+        "warm_speedup": round(cold_s / warm_s, 1) if warm_s > 0 else None,
+        "compile_cache_hit_ratio": round(hits / (hits + misses), 3)
+        if hits + misses else 0.0,
+        "outcomes": [outcome_cold, outcome_warm],
+    }
+
+
+def compile_smoke() -> int:
+    """CI gate (scripts/ci.sh): the fleet compile cache end to end on
+    the local backend + a seeded sim. The gate demands:
+
+    1. hit vs miss is measured, not assumed: the first replica of a key
+       pays the cold compile (miss, record published), the second
+       resolves the record and its measured warmup is FAR below the
+       first's; a third warm start issues zero apiserver writes;
+    2. the AOT prewarm handshake closes: the serving controller
+       publishes a request for the uncached key (idempotently), the
+       compile-cache controller elects exactly one in-service node of
+       the generation, the agent compiles + acks, election and request
+       both clear, and the worker that then boots starts WARM — its
+       time-to-ready beats the un-prewarmed baseline;
+    3. steady state (everything cached) is ZERO writes across the
+       serving controller, compile-cache controller, and agent;
+    4. a simulated libtpu bump deletes exactly the affected generations'
+       entries and the re-prewarm compiles exactly once per generation
+       with demand;
+    5. planning prices the compile: the warm what-if ETA is strictly
+       below the cold ETA for the same shape.
+    """
+    from tpu_operator import consts as _consts
+    from tpu_operator.agents.compilecache_agent import (
+        CompileCacheAgent,
+        default_warm_fn,
+    )
+    from tpu_operator.api.clusterpolicy import new_cluster_policy
+    from tpu_operator.api.tpuserving import TPUServing, new_tpu_serving
+    from tpu_operator.controllers.autotune_controller import libtpu_version_for
+    from tpu_operator.controllers.compilecache_controller import (
+        CompileCacheReconciler,
+    )
+    from tpu_operator.controllers.serving_controller import ServingReconciler
+    from tpu_operator.api.clusterpolicy import ClusterPolicy
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import make_torus_nodes, make_tpu_node
+    from tpu_operator.planning.model import compile_cost_seconds
+    from tpu_operator.planning.whatif import admission_answer
+    from tpu_operator.workloads import compilecache
+    from tpu_operator.workloads.compilecache import (
+        CompileCacheStore,
+        cached_entries,
+        entry_key,
+        model_descriptor_hash,
+        parse_requests,
+        request_id,
+    )
+    from tpu_operator.workloads.serving import DecodeEngine, ServingModelConfig
+
+    ns = "tpu-operator"
+    checks: dict = {}
+    compilecache.reset_stats()
+
+    class CountingClient:
+        """Write-counting shim over the FakeClient (the autotune-smoke
+        pattern) plus a call log for the exactly-one-patch checks."""
+
+        WRITE_VERBS = ("create", "patch", "patch_status", "update",
+                       "update_status", "delete", "apply", "apply_set")
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.writes = 0
+            self.calls = []
+
+        def __getattr__(self, name):
+            attr = getattr(self._inner, name)
+            if name in self.WRITE_VERBS and callable(attr):
+                def counted(*a, **kw):
+                    self.writes += 1
+                    self.calls.append((name,) + tuple(
+                        x for x in a[:3] if isinstance(x, str)))
+                    return attr(*a, **kw)
+
+                return counted
+            return attr
+
+    store_fake = FakeClient()
+    client = CountingClient(store_fake)
+    for i in range(2):
+        node = make_tpu_node(f"v5e-{i}", "tpu-v5-lite-podslice", "2x4")
+        node["metadata"]["labels"][_consts.TPU_PRESENT_LABEL] = "true"
+        store_fake.create(node)
+    store_fake.create(new_cluster_policy())
+    cp = ClusterPolicy.from_unstructured(store_fake.get(
+        "tpu.google.com/v1", "ClusterPolicy", "cluster-policy"
+    ))
+    version = libtpu_version_for(cp)
+    # the DaemonSet pins LIBTPU_VERSION; in-process stores need the same
+    os.environ["LIBTPU_VERSION"] = version
+
+    def cache_data() -> dict:
+        cm = store_fake.get_or_none(
+            "v1", "ConfigMap", _consts.COMPILE_CACHE_CONFIGMAP, ns)
+        return (cm or {}).get("data") or {}
+
+    # -- part 1: warm-start hit vs miss, measured ---------------------------
+    # cold FIRST, on dims no other scenario compiles — the in-process
+    # jit cache would otherwise hide the cold cost
+    cfg_a = ServingModelConfig(max_seq=32)
+    store = CompileCacheStore(client, ns)
+    o1, cold_s = store.warm_start(DecodeEngine(cfg_a), "v5e", "2x4", serving="smoke")
+    checks["first_replica_misses"] = o1 == "miss"
+    checks["miss_published_record"] = entry_key("v5e") in cache_data()
+    o2, warm_s = store.warm_start(DecodeEngine(cfg_a), "v5e", "2x4", serving="smoke")
+    checks["second_replica_hits"] = o2 == "hit"
+    checks["warm_ttft_beats_cold"] = warm_s < cold_s * 0.5
+    client.writes = 0
+    o3, _ = store.warm_start(DecodeEngine(cfg_a), "v5e", "2x4", serving="smoke")
+    checks["steady_hit_zero_writes"] = o3 == "hit" and client.writes == 0
+
+    # -- part 2: the AOT prewarm handshake ----------------------------------
+    serving_obj = new_tpu_serving("svc", {
+        "model": {"shape": "2x4", "generation": "v5e"},
+        "minReplicas": 1, "maxReplicas": 2,
+    })
+    serving = TPUServing.from_unstructured(serving_obj)
+    model_hash = model_descriptor_hash()
+    rid = request_id("v5e", "2x4", model_hash)
+    sr = ServingReconciler(client, ns)
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    requested = parse_requests(
+        cache_data().get(_consts.COMPILE_PREWARM_REQUEST_KEY))
+    checks["prewarm_requested"] = rid in requested
+    client.writes = 0
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    checks["request_idempotent"] = client.writes == 0
+
+    def elected_nodes() -> list:
+        return sorted(
+            n["metadata"]["name"] for n in store_fake.list("v1", "Node")
+            if (n["metadata"].get("labels") or {}).get(
+                _consts.COMPILE_CACHE_ELECTED_LABEL)
+            == _consts.COMPILE_CACHE_ELECTED
+        )
+
+    ccr = CompileCacheReconciler(client, ns)
+    req = Request(name="cluster-policy")
+    ccr.reconcile(req)
+    checks["one_node_elected"] = elected_nodes() == ["v5e-0"]
+
+    warm_calls = []
+
+    def counting_warm(request, ver):
+        warm_calls.append(request.get("generation"))
+        return default_warm_fn(request, ver)
+
+    agent = CompileCacheAgent(client, "v5e-0", ns, warm_fn=counting_warm)
+    checks["agent_prewarmed"] = agent.reconcile_once() == "prewarmed"
+    acks = (compilecache.parse_entry(
+        cache_data().get(_consts.COMPILE_PREWARM_ACK_KEY)) or {}).get("acks") or {}
+    checks["agent_acked"] = rid in acks
+    ccr.reconcile(req)
+    checks["election_cleared"] = elected_nodes() == []
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    checks["request_cleared"] = parse_requests(
+        cache_data().get(_consts.COMPILE_PREWARM_REQUEST_KEY)) == {}
+
+    # steady state: everything cached — zero writes anywhere
+    client.writes = 0
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    ccr.reconcile(req)
+    checks["steady_agent_descheduled"] = agent.reconcile_once() == "not-elected"
+    checks["steady_zero_writes"] = client.writes == 0
+
+    # the prewarmed worker boots warm: its measured warmup is far below
+    # both the agent's recorded compile and part 1's un-prewarmed cold
+    agent_record = (compilecache.parse_entry(
+        cache_data().get(entry_key("v5e"))) or {}).get("records", {}).get(
+        f"2x4/{model_hash}") or {}
+    agent_compile_s = float(agent_record.get("seconds") or 0.0)
+    ow, prewarmed_ttft = store.warm_start(
+        DecodeEngine(ServingModelConfig()), "v5e", "2x4", serving="svc")
+    checks["prewarmed_worker_hits"] = ow == "hit"
+    checks["prewarmed_beats_agent_compile"] = (
+        0.0 < prewarmed_ttft < agent_compile_s * 0.5
+    )
+    checks["prewarmed_scaleup_beats_unprewarmed"] = prewarmed_ttft < cold_s * 0.5
+
+    # -- part 3: libtpu bump invalidates exactly the affected entries -------
+    # a second generation's record so the bump provably sweeps ALL
+    # stale entries, one key-scoped patch each
+    store.publish("v4", "4x4x1", "fakehash0001", 1.25, source="prewarm")
+    checks["two_generations_cached"] = set(cached_entries(cache_data())) == {
+        "v4", "v5e"}
+    store_fake.patch(
+        "tpu.google.com/v1", "ClusterPolicy", "cluster-policy",
+        {"spec": {"libtpu": {"repository": "gcr.io/tpu-operator",
+                             "image": "libtpu", "version": "9.9.9-smoke"}}},
+    )
+    os.environ["LIBTPU_VERSION"] = "9.9.9-smoke"
+    client.calls = []
+    ccr.reconcile(req)
+    invalidation_patches = [
+        c for c in client.calls
+        if c[0] == "patch" and _consts.COMPILE_CACHE_CONFIGMAP in c
+    ]
+    checks["bump_invalidates_all_affected"] = cached_entries(cache_data()) == {}
+    checks["one_patch_per_affected_generation"] = len(invalidation_patches) == 2
+    # the serving's key re-requests, re-elects, re-compiles ONCE
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    ccr.reconcile(req)
+    checks["bump_reelects"] = elected_nodes() == ["v5e-0"]
+    checks["bump_agent_reprewarmed"] = agent.reconcile_once() == "prewarmed"
+    checks["one_recompile_per_generation"] = warm_calls == ["v5e", "v5e"]
+    ccr.reconcile(req)
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    client.writes = 0
+    ccr.reconcile(req)
+    sr._reconcile_prewarm(serving_obj, serving, {})
+    checks["post_bump_steady_zero_writes"] = client.writes == 0
+
+    # -- part 4: planning prices the compile --------------------------------
+    warm_cost, warm_flag = compile_cost_seconds(
+        "v5e", "1x1x1", "mhash", entries={
+            "v5e": {"generation": "v5e", "libtpu_version": version,
+                    "records": {"1x1x1/mhash": {"seconds": 2.0}}},
+        }, libtpu_version=version)
+    cold_cost, cold_flag = compile_cost_seconds(
+        "v5e", "1x1x1", "mhash", entries={}, libtpu_version=version)
+    checks["model_warm_strictly_below_cold"] = (
+        warm_flag and not cold_flag and 0.0 < warm_cost < cold_cost
+    )
+    plan_nodes = make_torus_nodes(
+        (2, 2, 1), prefix="plan", accelerator="tpu-v5-lite-podslice")
+    warm_entries = {
+        "v5e": {"generation": "v5e", "libtpu_version": version,
+                "records": {"1x1x1/mhash": {"seconds": 2.0}}},
+    }
+    warm_ans = admission_answer(
+        [], plan_nodes, "1x1x1",
+        compile_entries=warm_entries, libtpu_version=version,
+        model_hash="mhash")
+    cold_ans = admission_answer(
+        [], plan_nodes, "1x1x1",
+        compile_entries={}, libtpu_version=version, model_hash="mhash")
+    legacy_ans = admission_answer([], plan_nodes, "1x1x1")
+    checks["whatif_warm_eta_strictly_below_cold"] = (
+        warm_ans["answer"] == "now" and cold_ans["answer"] == "now"
+        and warm_ans["eta_seconds"] < cold_ans["eta_seconds"]
+    )
+    checks["whatif_legacy_eta_unpriced"] = legacy_ans["eta_seconds"] == 0.0
+
+    del os.environ["LIBTPU_VERSION"]
+
+    violations = []
+    if os.environ.get("TPUOP_RACECHECK") == "1":
+        from tpu_operator.kube import racecheck
+
+        violations = [repr(v) for v in racecheck.violations()]
+    checks["racecheck_clean"] = not violations
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "compile_smoke",
+        "ok": ok,
+        "cold_ttft_s": round(cold_s, 4),
+        "warm_ttft_s": round(warm_s, 4),
+        "prewarmed_ttft_s": round(prewarmed_ttft, 4),
+        "agent_compile_s": round(agent_compile_s, 4),
+        "warm_eta_s": warm_ans.get("eta_seconds"),
+        "cold_eta_s": cold_ans.get("eta_seconds"),
+        "checks": checks,
+        "racecheck_violations": violations,
     }, separators=(",", ":")))
     return 0 if ok else 1
 
@@ -3045,6 +3351,8 @@ def main() -> None:
         raise SystemExit(pod_smoke())
     if "--defrag-smoke" in sys.argv[1:]:
         raise SystemExit(defrag_smoke())
+    if "--compile-smoke" in sys.argv[1:]:
+        raise SystemExit(compile_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -3157,6 +3465,12 @@ def main() -> None:
         fleet_sim = bench_fleet_sim()
     except Exception as e:  # noqa: BLE001 — same isolation as chaos
         fleet_sim = {"error": f"{type(e).__name__}: {e}"}
+    # fleet compile cache: warm-vs-cold warm-start on the local backend
+    # (gated by --compile-smoke)
+    try:
+        compile_cache = compile_block()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        compile_cache = {"error": f"{type(e).__name__}: {e}"}
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -3192,6 +3506,7 @@ def main() -> None:
         "serving": serving,
         "pods": pods,
         "fleet_sim": fleet_sim,
+        "compile": compile_cache,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

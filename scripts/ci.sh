@@ -122,6 +122,17 @@ echo "== defrag smoke: fragmented torus -> migration -> the 4x4x4 lands =="
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --defrag-smoke
 echo "== defrag smoke (racecheck leg): the same gate under instrumented locks =="
 TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --defrag-smoke
+echo "== compile smoke: warm scale-ups via the fleet compile cache =="
+# compile-cache gate: the first replica of a (generation, topology,
+# model) key pays the measured cold XLA compile and publishes it; the
+# second resolves the record and starts FAR warmer; the AOT prewarm
+# handshake (serving request -> election -> agent compile -> ack) closes
+# with zero steady-state writes; a simulated libtpu bump invalidates
+# exactly the stale entries and re-compiles once per generation with
+# demand; the what-if warm ETA prices strictly below the cold one
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --compile-smoke
+echo "== compile smoke (racecheck leg): the same gate under instrumented locks =="
+TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --compile-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

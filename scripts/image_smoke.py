@@ -432,6 +432,48 @@ def smoke_entrypoints(wrappers: dict, harness: Harness) -> None:
         )
     print("ok: tpu-autotuner --oneshot read the sweep cache over TLS (cache hit)")
 
+    # tpu-compile-cache: oneshot pass over TLS — elected node whose
+    # requested executable already has a valid cached record reads as a
+    # cache hit (node get + cache-ConfigMap get in-cluster, zero
+    # writes; the real prewarm compile is bench's job)
+    node = harness.store.get("v1", "Node", "tpu-0")
+    node["metadata"]["labels"][consts.COMPILE_CACHE_ELECTED_LABEL] = (
+        consts.COMPILE_CACHE_ELECTED
+    )
+    harness.store.update(node)
+    cache_entry = {
+        "generation": "v5e",
+        "libtpu_version": "smoke",
+        "records": {"2x4/smokehash": {"seconds": 1.0, "source": "prewarm"}},
+    }
+    prewarm_requests = {
+        "requests": {
+            "v5e/2x4/smokehash": {
+                "generation": "v5e", "topology": "2x4", "model": "smokehash",
+            }
+        }
+    }
+    harness.store.create(new_object(
+        "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS,
+        data={
+            "v5e.json": _json.dumps(cache_entry),
+            consts.COMPILE_PREWARM_REQUEST_KEY: _json.dumps(prewarm_requests),
+        },
+    ))
+    proc = subprocess.run(
+        [sys.executable, "-m", check("tpu-compile-cache"), "--oneshot"],
+        env=harness.env(LIBTPU_VERSION="smoke"),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=START_TIMEOUT,
+    )
+    if proc.returncode != 0 or '"cache-hit"' not in proc.stdout:
+        raise SystemExit(
+            f"FAIL tpu-compile-cache: rc={proc.returncode}\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    print("ok: tpu-compile-cache --oneshot read the compile cache over TLS (cache hit)")
+
     # tpu-metrics-exporter: serves prometheus metrics
     port = free_port()
     proc = spawn(check("tpu-metrics-exporter"), ["--port", str(port)], harness.env())

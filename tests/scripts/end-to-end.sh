@@ -42,9 +42,9 @@ client.create(cp)
 
 def ready():
     o = client.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
-    return o.get("status", {}).get("state") == "ready" and len(client.list("apps/v1", "DaemonSet", NS)) == 10
+    return o.get("status", {}).get("state") == "ready" and len(client.list("apps/v1", "DaemonSet", NS)) == 11
 wait(ready, what="install -> Ready")
-print("STEP 1 OK: install -> ClusterPolicy Ready, 10 operand DaemonSets")
+print("STEP 1 OK: install -> ClusterPolicy Ready, 11 operand DaemonSets")
 
 # 2. TPU workload (the smoke payload the validator schedules) on whatever
 # accelerator is attached (the one real-device step; everything else is

@@ -542,7 +542,7 @@ def _assert_no_orphans(store, cp_uid):
     beside the originals) fails here."""
     dses = store.list("apps/v1", "DaemonSet", NS)
     names = [ds["metadata"]["name"] for ds in dses]
-    assert len(names) == len(set(names)) == 10, names
+    assert len(names) == len(set(names)) == 11, names
     for ds in dses:
         refs = ds["metadata"].get("ownerReferences") or []
         assert any(r.get("uid") == cp_uid for r in refs), (
@@ -623,9 +623,9 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
                 return False
             dses = store.list("apps/v1", "DaemonSet", NS)
             # election-gated autotuner: desired/available 0 (no elections)
-            return len(dses) == 10 and all(
+            return len(dses) == 11 and all(
                 ds.get("status", {}).get("numberAvailable")
-                == (0 if ds["metadata"]["name"] == "tpu-autotuner" else nodes)
+                == (0 if ds["metadata"]["name"] in ("tpu-autotuner", "tpu-compile-cache") else nodes)
                 for ds in dses
             )
 
@@ -911,9 +911,9 @@ class TestCrashRestartDrill:
                 if (cp or {}).get("status", {}).get("state") != "ready":
                     return False
                 dses = store.list("apps/v1", "DaemonSet", NS)
-                return len(dses) == 10 and all(
+                return len(dses) == 11 and all(
                     ds.get("status", {}).get("numberAvailable")
-                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else 8)
+                    == (0 if ds["metadata"]["name"] in ("tpu-autotuner", "tpu-compile-cache") else 8)
                     for ds in dses
                 )
 

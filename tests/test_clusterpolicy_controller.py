@@ -146,14 +146,14 @@ class TestEndToEnd:
                 dses = client.list("apps/v1", "DaemonSet", NS)
                 # the autotuner schedules only onto controller-elected
                 # nodes — none here, so its desired count is 0
-                return len(dses) == 10 and all(
+                return len(dses) == 11 and all(
                     ds.get("status", {}).get("desiredNumberScheduled")
-                    == (0 if ds["metadata"]["name"] == "tpu-autotuner" else 4)
+                    == (0 if ds["metadata"]["name"] in ("tpu-autotuner", "tpu-compile-cache") else 4)
                     for ds in dses
                 ) and all(
                     ds["status"].get("numberAvailable") == 4
                     for ds in dses
-                    if ds["metadata"]["name"] != "tpu-autotuner"
+                    if ds["metadata"]["name"] not in ("tpu-autotuner", "tpu-compile-cache")
                 )
 
             assert wait_for(settled, timeout=15), get_cp(client).get("status")
@@ -185,7 +185,7 @@ class TestEndToEnd:
                 == "true",
                 timeout=10,
             )
-            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 10, timeout=10)
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 11, timeout=10)
         finally:
             mgr.stop()
             sim.stop()

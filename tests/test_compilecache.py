@@ -1,0 +1,659 @@
+"""Fleet compile cache: keying/invalidation (the test_autotune matrix),
+the warm-start path, the AOT prewarm handshake (serving controller ->
+compile-cache controller election -> agent -> ack), and the planning
+layer's warm-vs-cold compile pricing."""
+
+import json
+import time
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.agents.compilecache_agent import CompileCacheAgent
+from tpu_operator.api.clusterpolicy import ClusterPolicy, new_cluster_policy
+from tpu_operator.api.tpuserving import TPUServing, new_tpu_serving
+from tpu_operator.controllers.compilecache_controller import CompileCacheReconciler
+from tpu_operator.controllers.serving_controller import ServingReconciler
+from tpu_operator.kube import errors
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import make_tpu_node
+from tpu_operator.planning.model import compile_cost_seconds
+from tpu_operator.planning.whatif import admission_answer
+from tpu_operator.workloads import compilecache
+from tpu_operator.workloads.compilecache import (
+    WARM_FRACTION,
+    CompileCacheStore,
+    cache_record,
+    cached_entries,
+    entry_key,
+    entry_valid,
+    model_descriptor_hash,
+    parse_entry,
+    parse_requests,
+    record_key,
+    request_id,
+)
+
+NS = "tpu-operator"
+REQ = Request(name="cluster-policy")
+
+
+def _record(seconds=3.2, source="worker", serving="svc", node="n-0"):
+    return {"seconds": seconds, "source": source, "serving": serving, "node": node}
+
+
+def _centry(gen="v5e", version="1.0.0", records=None):
+    if records is None:
+        records = {record_key("2x4", "mhash"): _record()}
+    return {"generation": gen, "libtpu_version": version, "records": records}
+
+
+class StubEngine:
+    """warm_start only needs ``cfg`` (the content address) and a
+    ``warmup`` to time — a stub keeps the matrix off the compiler."""
+
+    def __init__(self, cfg=None, delay=0.0):
+        from tpu_operator.workloads.serving import ServingModelConfig
+
+        self.cfg = cfg or ServingModelConfig()
+        self.delay = delay
+        self.warmups = 0
+
+    def warmup(self, prompt_len):
+        self.warmups += 1
+        if self.delay:
+            time.sleep(self.delay)
+
+
+class CountingClient:
+    WRITE_VERBS = ("create", "patch", "patch_status", "update", "update_status",
+                   "delete", "apply", "apply_set")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.writes = 0
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self.WRITE_VERBS and callable(attr):
+            def counted(*a, **kw):
+                self.writes += 1
+                return attr(*a, **kw)
+
+            return counted
+        return attr
+
+
+class DownClient:
+    """Every call raises — the K003 'apiserver unreachable' shape."""
+
+    def __getattr__(self, name):
+        def down(*a, **kw):
+            raise errors.ApiError("apiserver down")
+
+        return down
+
+
+def _v5e_node(name, elected=False, extra=None):
+    node = make_tpu_node(name, "tpu-v5-lite-podslice", "2x4")
+    node["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+    if elected:
+        node["metadata"]["labels"][consts.COMPILE_CACHE_ELECTED_LABEL] = (
+            consts.COMPILE_CACHE_ELECTED
+        )
+    node["metadata"]["labels"].update(extra or {})
+    return node
+
+
+def _cache_cm(entries=None, requests=None):
+    data = {}
+    for gen, entry in (entries or {}).items():
+        data[entry_key(gen)] = json.dumps(entry)
+    if requests is not None:
+        data[consts.COMPILE_PREWARM_REQUEST_KEY] = json.dumps(
+            {"requests": requests})
+    return new_object("v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS,
+                      data=data)
+
+
+def _cluster(nodes, entries=None, requests=None, spec=None):
+    store = FakeClient()
+    for node in nodes:
+        store.create(node)
+    store.create(new_cluster_policy(spec=spec))
+    if entries is not None or requests is not None:
+        store.create(_cache_cm(entries, requests))
+    return store
+
+
+def _elected(store):
+    return sorted(
+        n["metadata"]["name"] for n in store.list("v1", "Node")
+        if (n["metadata"].get("labels") or {}).get(
+            consts.COMPILE_CACHE_ELECTED_LABEL) == consts.COMPILE_CACHE_ELECTED
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cache keying + invalidation (mirrors test_autotune's TestCacheKeying).
+# ---------------------------------------------------------------------------
+
+
+class TestCacheKeying:
+    def test_complete_entry_valid(self):
+        assert entry_valid(_centry(), "1.0.0")
+
+    def test_libtpu_version_invalidates(self):
+        assert not entry_valid(_centry(version="1.0.0"), "1.1.0")
+
+    def test_empty_record_map_invalid(self):
+        assert not entry_valid(_centry(records={}), "1.0.0")
+
+    def test_record_resolves_only_its_content_address(self):
+        entry = _centry()
+        assert cache_record(entry, "2x4", "mhash", "1.0.0") == _record()
+        # a different topology or model hash is a different executable
+        assert cache_record(entry, "4x4", "mhash", "1.0.0") is None
+        assert cache_record(entry, "2x4", "other", "1.0.0") is None
+        assert cache_record(entry, "2x4", "mhash", "2.0.0") is None
+
+    def test_parse_entry_tolerates_garbage(self):
+        assert parse_entry(None) is None
+        assert parse_entry("") is None
+        assert parse_entry("{not json") is None
+        assert parse_entry('["list"]') is None
+        assert parse_entry('{"a": 1}') == {"a": 1}
+
+    def test_parse_requests_tolerates_garbage(self):
+        assert parse_requests(None) == {}
+        assert parse_requests("{torn") == {}
+        assert parse_requests('{"requests": ["not", "a", "map"]}') == {}
+        assert parse_requests(
+            '{"requests": {"rid": {"generation": "v5e"}, "bad": 3}}'
+        ) == {"rid": {"generation": "v5e"}}
+
+    def test_cached_entries_skips_handshake_keys_and_torn_blobs(self):
+        data = {
+            entry_key("v5e"): json.dumps(_centry()),
+            entry_key("v4"): "{torn",
+            consts.COMPILE_PREWARM_REQUEST_KEY: json.dumps({"requests": {}}),
+            consts.COMPILE_PREWARM_ACK_KEY: json.dumps({"acks": {}}),
+            "not-an-entry": "x",
+        }
+        assert set(cached_entries(data)) == {"v5e"}
+
+    def test_model_hash_tracks_model_geometry(self):
+        from tpu_operator.workloads.serving import ServingModelConfig
+
+        base = ServingModelConfig()
+        assert model_descriptor_hash(base) == model_descriptor_hash(
+            ServingModelConfig())
+        assert model_descriptor_hash(base) != model_descriptor_hash(
+            ServingModelConfig(max_seq=32))
+
+    def test_request_id_composition(self):
+        assert request_id("v5e", "2x4", "mhash") == "v5e/2x4/mhash"
+        assert request_id("v5e", "", "mhash") == "v5e/any/mhash"
+
+
+# ---------------------------------------------------------------------------
+# The worker warm-start path.
+# ---------------------------------------------------------------------------
+
+
+class TestWarmStart:
+    def _store(self, client):
+        return CompileCacheStore(client, NS, libtpu_version="1.0.0")
+
+    def test_miss_measures_and_publishes(self):
+        compilecache.reset_stats()
+        store = self._store(FakeClient())
+        engine = StubEngine(delay=0.01)
+        outcome, seconds = store.warm_start(engine, "v5e", "2x4", serving="svc")
+        assert outcome == "miss" and engine.warmups == 1
+        assert seconds >= 0.01
+        entry = parse_entry(store.read_data()[entry_key("v5e")])
+        record = cache_record(
+            entry, "2x4", model_descriptor_hash(engine.cfg), "1.0.0")
+        assert record["source"] == "worker" and record["serving"] == "svc"
+        assert record["seconds"] == pytest.approx(seconds, abs=0.01)
+        assert compilecache.stats()["misses"] == {"v5e": 1}
+
+    def test_hit_replays_recorded_cost_and_writes_nothing(self):
+        # on the CPU sim a hit REPLAYS the recorded cold cost at the warm
+        # fraction (there is no executable store to deserialize from) —
+        # hit-vs-miss stays an observable, benchable quantity
+        compilecache.reset_stats()
+        inner = FakeClient()
+        store = self._store(inner)
+        cold = store.warm_start(StubEngine(delay=0.02), "v5e", "2x4")[1]
+        client = CountingClient(inner)
+        store = self._store(client)
+        outcome, warm = store.warm_start(StubEngine(delay=0.02), "v5e", "2x4")
+        assert outcome == "hit" and client.writes == 0
+        assert warm == pytest.approx(cold * WARM_FRACTION, abs=0.01)
+        assert warm < cold
+        assert compilecache.stats()["hits"] == {"v5e": 1}
+
+    def test_unkeyed_engine_skips_cache(self):
+        compilecache.reset_stats()
+        client = CountingClient(FakeClient())
+        outcome, _ = self._store(client).warm_start(StubEngine(), "", "2x4")
+        assert outcome == "unkeyed" and client.writes == 0
+        assert compilecache.stats()["hits"] == {}
+        assert compilecache.stats()["misses"] == {}
+
+    def test_unreachable_api_compiles_cold_without_raising(self):
+        # resolve on a dead apiserver counts a miss (compiling is safe,
+        # merely cold) and the best-effort publish swallows the failure
+        compilecache.reset_stats()
+        engine = StubEngine()
+        outcome, _ = self._store(DownClient()).warm_start(engine, "v5e", "2x4")
+        assert outcome == "miss" and engine.warmups == 1
+
+    def test_read_data_distinguishes_missing_from_unreachable(self):
+        assert self._store(FakeClient()).read_data() == {}
+        assert self._store(DownClient()).read_data() is None  # K003
+
+    def test_version_bump_replaces_stale_entry_wholesale(self):
+        inner = FakeClient()
+        inner.create(_cache_cm(entries={"v5e": _centry(version="0.9.0")}))
+        store = self._store(inner)
+        store.publish("v5e", "4x4", "newhash", 2.0)
+        entry = parse_entry(store.read_data()[entry_key("v5e")])
+        assert entry["libtpu_version"] == "1.0.0"
+        # the stale toolchain's records did not survive into the rewrite
+        assert list(entry["records"]) == [record_key("4x4", "newhash")]
+
+    def test_publish_keeps_sibling_records_for_same_toolchain(self):
+        inner = FakeClient()
+        store = self._store(inner)
+        store.publish("v5e", "2x4", "a", 1.0)
+        store.publish("v5e", "4x4", "b", 2.0)
+        entry = parse_entry(store.read_data()[entry_key("v5e")])
+        assert set(entry["records"]) == {
+            record_key("2x4", "a"), record_key("4x4", "b")}
+
+
+# ---------------------------------------------------------------------------
+# The serving controller's prewarm scheduling.
+# ---------------------------------------------------------------------------
+
+
+def _serving(name="svc", generation="v5e", shape="2x4"):
+    obj = new_tpu_serving(name, {
+        "model": {"shape": shape, "generation": generation},
+        "minReplicas": 1, "maxReplicas": 2,
+    })
+    return obj, TPUServing.from_unstructured(obj)
+
+
+class TestServingPrewarm:
+    def test_uncached_key_requests_prewarm(self):
+        store = FakeClient()
+        obj, serving = _serving()
+        ServingReconciler(store, NS)._reconcile_prewarm(obj, serving, {})
+        cm = store.get("v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS)
+        requests = parse_requests(cm["data"][consts.COMPILE_PREWARM_REQUEST_KEY])
+        rid = request_id("v5e", "2x4", model_descriptor_hash())
+        assert requests[rid]["serving"] == "svc"
+        assert requests[rid]["generation"] == "v5e"
+
+    def test_request_is_idempotent(self):
+        store = FakeClient()
+        obj, serving = _serving()
+        sr = ServingReconciler(store, NS)
+        sr._reconcile_prewarm(obj, serving, {})
+        client = CountingClient(store)
+        ServingReconciler(client, NS)._reconcile_prewarm(obj, serving, {})
+        assert client.writes == 0
+
+    def test_cached_key_clears_its_request(self):
+        rid = request_id("v5e", "2x4", model_descriptor_hash())
+        store = FakeClient()
+        store.create(_cache_cm(
+            entries={"v5e": _centry(records={
+                record_key("2x4", model_descriptor_hash()): _record()})},
+            requests={rid: {"generation": "v5e", "topology": "2x4",
+                            "model": model_descriptor_hash(), "serving": "svc"}},
+        ))
+        obj, serving = _serving()
+        ServingReconciler(store, NS)._reconcile_prewarm(obj, serving, {})
+        cm = store.get("v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS)
+        assert parse_requests(cm["data"][consts.COMPILE_PREWARM_REQUEST_KEY]) == {}
+
+    def test_unreadable_cache_fails_closed(self):
+        # K003: the cache read GATES the request write — unreachable
+        # apiserver means unknown state, so no prewarm is scheduled
+        # (a duplicate compile is cheap; the rule is the point)
+        obj, serving = _serving()
+        client = CountingClient(DownClient())
+        ServingReconciler(client, NS)._reconcile_prewarm(obj, serving, {})
+        assert client.writes == 0
+
+    def test_generationless_serving_never_requests(self):
+        obj, serving = _serving(generation="")
+        client = CountingClient(FakeClient())
+        ServingReconciler(client, NS)._reconcile_prewarm(obj, serving, {})
+        assert client.writes == 0
+
+
+class _pinned_version:
+    def __init__(self, version):
+        self.version = version
+
+    def __enter__(self):
+        import os
+
+        self._old = os.environ.get("LIBTPU_VERSION")
+        os.environ["LIBTPU_VERSION"] = self.version
+        return self
+
+    def __exit__(self, *exc):
+        import os
+
+        if self._old is None:
+            os.environ.pop("LIBTPU_VERSION", None)
+        else:
+            os.environ["LIBTPU_VERSION"] = self._old
+
+
+# ---------------------------------------------------------------------------
+# The agent (mirrors test_autotune's TestAutotuneAgent).
+# ---------------------------------------------------------------------------
+
+
+def _request(gen="v5e", topology="2x4", model="mhash", serving="svc"):
+    return {"generation": gen, "topology": topology, "model": model,
+            "serving": serving}
+
+
+def _fake_warm(calls=None, seconds=1.5):
+    def warm_fn(request, version):
+        if calls is not None:
+            calls.append(request.get("generation"))
+        return seconds
+
+    return warm_fn
+
+
+class TestCompileCacheAgent:
+    @pytest.fixture(autouse=True)
+    def _pin(self, monkeypatch):
+        monkeypatch.setenv("LIBTPU_VERSION", "1.0.0")
+
+    def test_not_elected_is_noop(self):
+        store = FakeClient()
+        store.create(_v5e_node("n-0"))
+        client = CountingClient(store)
+        agent = CompileCacheAgent(client, "n-0", NS, warm_fn=_fake_warm())
+        assert agent.reconcile_once() == "not-elected"
+        assert client.writes == 0
+
+    def test_elected_compiles_publishes_and_acks(self):
+        store = FakeClient()
+        store.create(_v5e_node("n-0", elected=True))
+        store.create(_cache_cm(requests={
+            request_id("v5e", "2x4", "mhash"): _request()}))
+        calls = []
+        agent = CompileCacheAgent(store, "n-0", NS, warm_fn=_fake_warm(calls))
+        assert agent.reconcile_once() == "prewarmed"
+        assert calls == ["v5e"]
+        data = store.get(
+            "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS)["data"]
+        record = cache_record(
+            parse_entry(data[entry_key("v5e")]), "2x4", "mhash", "1.0.0")
+        assert record["seconds"] == 1.5 and record["source"] == "prewarm"
+        assert record["node"] == "n-0" and record["serving"] == "svc"
+        acks = parse_entry(data[consts.COMPILE_PREWARM_ACK_KEY])["acks"]
+        assert acks[request_id("v5e", "2x4", "mhash")]["outcome"] == "prewarmed"
+
+    def test_satisfied_request_is_zero_write_cache_hit(self):
+        store = FakeClient()
+        store.create(_v5e_node("n-0", elected=True))
+        store.create(_cache_cm(
+            entries={"v5e": _centry()},
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        ))
+        client = CountingClient(store)
+        calls = []
+        agent = CompileCacheAgent(client, "n-0", NS, warm_fn=_fake_warm(calls))
+        assert agent.reconcile_once() == "cache-hit"
+        assert calls == [] and client.writes == 0
+
+    def test_other_generations_requests_are_not_mine(self):
+        store = FakeClient()
+        store.create(_v5e_node("n-0", elected=True))
+        store.create(_cache_cm(requests={
+            request_id("v4", "4x4x4", "mhash"): _request(gen="v4",
+                                                         topology="4x4x4")}))
+        agent = CompileCacheAgent(store, "n-0", NS, warm_fn=_fake_warm())
+        assert agent.reconcile_once() == "no-requests"
+
+    def test_stale_entry_recompiles(self):
+        store = FakeClient()
+        store.create(_v5e_node("n-0", elected=True))
+        store.create(_cache_cm(
+            entries={"v5e": _centry(version="0.9.0")},
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        ))
+        calls = []
+        agent = CompileCacheAgent(store, "n-0", NS, warm_fn=_fake_warm(calls))
+        assert agent.reconcile_once() == "prewarmed"
+        assert calls == ["v5e"]
+
+
+# ---------------------------------------------------------------------------
+# The controller (mirrors test_autotune's TestAutotuneController).
+# ---------------------------------------------------------------------------
+
+
+class TestCompileCacheController:
+    def test_elects_one_node_per_generation_with_demand(self):
+        v4 = make_tpu_node("v4-b", "tpu-v4-podslice", "2x2x1")
+        v4["metadata"]["labels"][consts.TPU_PRESENT_LABEL] = "true"
+        store = _cluster(
+            [_v5e_node("v5e-b"), _v5e_node("v5e-a"), v4],
+            requests={
+                request_id("v5e", "2x4", "mhash"): _request(),
+                request_id("v4", "2x2x1", "mhash"): _request(
+                    gen="v4", topology="2x2x1"),
+            },
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v4-b", "v5e-a"]
+
+    def test_satisfied_demand_holds_no_election(self):
+        store = _cluster(
+            [_v5e_node("v5e-a")],
+            entries={"v5e": _centry()},
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == []
+
+    def test_out_of_service_nodes_never_elected(self):
+        store = _cluster(
+            [
+                _v5e_node("v5e-a",
+                          extra={consts.TPU_PERF_LABEL: consts.PERF_DEGRADED}),
+                _v5e_node("v5e-b"),
+            ],
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v5e-b"]
+
+    def test_election_sticky_while_pending(self):
+        store = _cluster(
+            [_v5e_node("v5e-z", elected=True), _v5e_node("v5e-a")],
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == ["v5e-z"]
+
+    def test_orphan_election_cleared_when_demand_vanishes(self):
+        store = _cluster([_v5e_node("v5e-a", elected=True)])
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == []
+
+    def test_settled_pass_issues_zero_writes(self):
+        store = _cluster(
+            [_v5e_node("v5e-a")],
+            entries={"v5e": _centry()},
+            requests={request_id("v5e", "2x4", "mhash"): _request()},
+        )
+        client = CountingClient(store)
+        rec = CompileCacheReconciler(client, NS)
+        rec.reconcile(REQ)
+        client.writes = 0
+        rec.reconcile(REQ)
+        assert client.writes == 0
+
+    def test_libtpu_bump_invalidates_exactly_the_stale_generation(self):
+        store = _cluster(
+            [_v5e_node("v5e-a")],
+            entries={"v5e": _centry(), "v4": _centry(gen="v4", version="0.9.0")},
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        data = store.get(
+            "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS)["data"]
+        assert entry_key("v4") not in data  # stale: deleted
+        assert entry_key("v5e") in data  # current toolchain: untouched
+
+    def test_invalidated_key_re_elects_and_recompiles_once(self):
+        # the full bump loop: stale entry deleted -> the standing request
+        # is unsatisfied again -> election -> ONE recompile
+        rid = request_id("v5e", "2x4", "mhash")
+        store = _cluster(
+            [_v5e_node("v5e-a")],
+            entries={"v5e": _centry(version="0.9.0")},
+            requests={rid: _request()},
+        )
+        rec = CompileCacheReconciler(store, NS)
+        rec.reconcile(REQ)
+        assert _elected(store) == ["v5e-a"]
+        calls = []
+        with _pinned_version("1.0.0"):
+            agent = CompileCacheAgent(store, "v5e-a", NS,
+                                      warm_fn=_fake_warm(calls))
+            assert agent.reconcile_once() == "prewarmed"
+            assert calls == ["v5e"]
+            # a re-run while still elected (rebooted elected node) is a
+            # zero-write cache hit — compile-once, fleet-wide
+            client = CountingClient(store)
+            rerun = CompileCacheAgent(client, "v5e-a", NS,
+                                      warm_fn=_fake_warm(calls))
+            assert rerun.reconcile_once() == "cache-hit"
+            assert calls == ["v5e"] and client.writes == 0
+            # the record satisfies the demand: the election clears
+            rec.reconcile(REQ)
+            assert _elected(store) == []
+            assert rerun.reconcile_once() == "not-elected"
+
+    def test_disabled_spec_clears_elections(self):
+        store = _cluster(
+            [_v5e_node("v5e-a", elected=True)],
+            spec={"compileCache": {"enabled": False}},
+        )
+        CompileCacheReconciler(store, NS).reconcile(REQ)
+        assert _elected(store) == []
+
+    def test_compile_series_retire_with_their_entry(self):
+        store = _cluster(
+            [_v5e_node("v5e-a")],
+            entries={"v5e": _centry(records={
+                record_key("2x4", "mhash"): _record(serving="retire-me")})},
+        )
+        rec = CompileCacheReconciler(store, NS)
+        rec.reconcile(REQ)
+        assert ("retire-me", "v5e") in rec.metrics.compile_seconds._metrics
+        # toolchain bump invalidates the entry -> the series goes too
+        cm = store.get("v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, NS)
+        cm["data"][entry_key("v5e")] = json.dumps(_centry(
+            version="0.9.0",
+            records={record_key("2x4", "mhash"): _record(serving="retire-me")},
+        ))
+        store.update(cm)
+        rec.reconcile(REQ)
+        assert ("retire-me", "v5e") not in rec.metrics.compile_seconds._metrics
+
+    def test_hit_miss_counters_export_and_retire(self):
+        compilecache.reset_stats()
+        store = _cluster([_v5e_node("v5e-a")], entries={"v5e": _centry()})
+        cstore = CompileCacheStore(FakeClient(), NS, libtpu_version="1.0.0")
+        cstore.resolve("v5e", "2x4", "mhash")  # miss on the empty store
+        rec = CompileCacheReconciler(store, NS)
+        rec.reconcile(REQ)
+        assert ("v5e",) in rec.metrics.compile_cache_misses._metrics
+        compilecache.reset_stats()
+        rec.reconcile(REQ)
+        assert ("v5e",) not in rec.metrics.compile_cache_misses._metrics
+
+
+# ---------------------------------------------------------------------------
+# Planning prices the compile.
+# ---------------------------------------------------------------------------
+
+
+class TestPlanningCompileCost:
+    def test_warm_strictly_below_cold(self):
+        entries = {"v5e": _centry(records={
+            record_key("2x4", "mhash"): _record(seconds=40.0)})}
+        warm, warm_flag = compile_cost_seconds(
+            "v5e", "2x4", "mhash", entries=entries, libtpu_version="1.0.0")
+        cold, cold_flag = compile_cost_seconds(
+            "v5e", "2x4", "mhash", entries={}, libtpu_version="1.0.0")
+        assert warm_flag and not cold_flag
+        assert 0.0 < warm < cold
+        # the measured record, not the generation default, is the base
+        assert warm == pytest.approx(40.0 * WARM_FRACTION)
+
+    def test_stale_record_prices_cold(self):
+        entries = {"v5e": _centry(version="0.9.0")}
+        cost, warm = compile_cost_seconds(
+            "v5e", "2x4", "mhash", entries=entries, libtpu_version="1.0.0")
+        assert not warm and cost == compile_cost_seconds(
+            "v5e", "2x4", "mhash", entries={}, libtpu_version="1.0.0")[0]
+
+    def test_whatif_eta_folds_compile(self):
+        from tpu_operator.kube.sim import make_torus_nodes
+
+        nodes = make_torus_nodes((2, 2, 1), prefix="plan",
+                                 accelerator="tpu-v5-lite-podslice")
+        entries = {"v5e": _centry(records={
+            record_key("1x1x1", "mhash"): _record(seconds=40.0)})}
+        warm = admission_answer([], nodes, "1x1x1", compile_entries=entries,
+                                libtpu_version="1.0.0", model_hash="mhash")
+        cold = admission_answer([], nodes, "1x1x1", compile_entries={},
+                                libtpu_version="1.0.0", model_hash="mhash")
+        assert warm["answer"] == "now" and cold["answer"] == "now"
+        assert warm["compile_warm"] and not cold["compile_warm"]
+        assert warm["eta_seconds"] < cold["eta_seconds"]
+        assert "compile" in warm["detail"]
+
+    def test_plan_report_threads_compile_pricing(self):
+        from tpu_operator.kube.sim import make_torus_nodes
+        from tpu_operator.planning.whatif import plan_report
+
+        nodes = make_torus_nodes((2, 2, 1), prefix="plan",
+                                 accelerator="tpu-v5-lite-podslice")
+        entries = {"v5e": _centry(records={
+            record_key("1x1x1", "mhash"): _record(seconds=40.0)})}
+        warm = plan_report([], nodes, shape="1x1x1", compile_entries=entries,
+                           libtpu_version="1.0.0", model_hash="mhash")
+        cold = plan_report([], nodes, shape="1x1x1", compile_entries={},
+                           libtpu_version="1.0.0", model_hash="mhash")
+        assert "warm compile" in warm
+        assert "cold compile" in cold
+
+    def test_whatif_without_entries_stays_unpriced(self):
+        from tpu_operator.kube.sim import make_torus_nodes
+
+        nodes = make_torus_nodes((2, 2, 1), prefix="plan",
+                                 accelerator="tpu-v5-lite-podslice")
+        legacy = admission_answer([], nodes, "1x1x1")
+        assert legacy["answer"] == "now"
+        assert "compile_seconds" not in legacy

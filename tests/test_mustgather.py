@@ -144,7 +144,7 @@ def test_bundle_from_live_install(tmp_path):
         assert {n["metadata"]["name"] for n in nodes} == {"tpu-0", "tpu-1"}
         assert collected_state() == "ready"
         dses = list(yaml.safe_load_all((tmp_path / "daemonsets.yaml").read_text()))
-        assert len(dses) == 10
+        assert len(dses) == 11
         labels_txt = (tmp_path / "node-labels.txt").read_text()
         assert "tpu.google.com/tpu.present=true" in labels_txt
         # the health subsystem's per-node view rides in the bundle

@@ -287,7 +287,7 @@ class TestBootstrapEndToEnd:
                 )
                 == "true"
             )
-            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 10), [
+            assert wait_for(lambda: len(client.list("apps/v1", "DaemonSet", NS)) == 11), [
                 d["metadata"]["name"] for d in client.list("apps/v1", "DaemonSet", NS)
             ]
             labels = client.get("v1", "Node", "selfmanaged-0")["metadata"]["labels"]

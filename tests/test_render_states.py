@@ -80,8 +80,8 @@ class TestStateRendering:
                 else:
                     assert deploy_keys, (name, sel)
         # discovery, libtpu, plugin, validation, tfd, slice-mgr, metrics,
-        # node-status, health-monitor, autotuner
-        assert found == 10
+        # node-status, health-monitor, autotuner, compile-cache
+        assert found == 11
 
     def test_perf_floor_envs_render_into_operand_daemonsets(self):
         """spec.validator.minTflops reaches the workload-validation init

@@ -88,9 +88,9 @@ class _Wired:
         dses = self.store.list("apps/v1", "DaemonSet", NS)
         # the autotuner DS schedules only onto controller-elected
         # nodes: none in these runs, so it is desired/available 0
-        return len(dses) == 10 and all(
+        return len(dses) == 11 and all(
             ds.get("status", {}).get("numberAvailable")
-            == (0 if ds["metadata"]["name"] == "tpu-autotuner" else self.nodes)
+            == (0 if ds["metadata"]["name"] in ("tpu-autotuner", "tpu-compile-cache") else self.nodes)
             for ds in dses
         )
 

@@ -1,0 +1,204 @@
+"""tpu-compile-cache agent: the elected-node half of the prewarm loop.
+
+The compile-cache controller elects ONE in-service node per generation
+with unsatisfied prewarm demand by stamping
+``consts.COMPILE_CACHE_ELECTED_LABEL`` — and the prewarm DaemonSet's
+nodeSelector includes that label, so this agent only ever runs on an
+elected node, holding the node's chips through the ``google.com/tpu``
+extended resource for exactly the compile window.
+
+The loop per tick:
+
+  1. read the own Node (election label + generation labels);
+  2. read the ``tpu-compile-cache`` ConfigMap: prewarm requests for this
+     generation whose content address already has a valid record for
+     (generation, topology, model hash, libtpu version) are CACHE HITS:
+     zero writes, nothing re-compiles (the compile-once fleet-wide
+     contract; a rebooted elected node lands here);
+  3. otherwise compile: bind JAX's persistent compilation cache (real
+     TPU — the executable serializes to the node cache directory), run
+     the serving engine's warmup step, and publish the measured
+     duration as the generation's record plus a prewarm ack.
+
+The controller notices the published record, clears the election label
+(which descheduled this pod), and the serving controller clears its
+satisfied request — the new replica's worker pod then resolves a cache
+hit in its own warmup step and starts warm.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.client import Client
+from tpu_operator.nodeinfo import tpu_info
+from tpu_operator.workloads.autotune import runtime_fingerprint
+from tpu_operator.workloads.compilecache import (
+    CompileCacheStore,
+    bind_persistent_cache,
+    cache_record,
+    entry_key,
+    parse_entry,
+    parse_requests,
+)
+
+log = logging.getLogger(__name__)
+
+
+def default_warm_fn(request: dict, version: str) -> float:
+    """The real prewarm: compile the serving engine's programs (decode +
+    chunked prefill + page gather — exactly the warmup step a worker
+    runs) and return the measured duration. On real TPU the persistent
+    cache directory keeps the serialized executables; on the CPU sim the
+    measured duration IS the asset."""
+    from tpu_operator.workloads.serving import DecodeEngine, ServingModelConfig
+
+    bind_persistent_cache()
+    cfg = ServingModelConfig()
+    engine = DecodeEngine(cfg)
+    started = time.perf_counter()
+    engine.warmup(min(cfg.prefill_chunk, cfg.max_seq // 4))
+    return time.perf_counter() - started
+
+
+class CompileCacheAgent:
+    def __init__(
+        self,
+        client: Client,
+        node_name: str,
+        namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE,
+        interval: float = 60.0,
+        warm_fn: Optional[Callable[[dict, str], float]] = None,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.namespace = namespace
+        self.interval = interval
+        # injectable for tests/smokes; the default is the real compile
+        self.warm_fn = warm_fn or default_warm_fn
+        self._stop = False
+
+    # -- one pass -------------------------------------------------------------
+
+    def reconcile_once(self) -> str:
+        """Returns the pass outcome (tests and logs read it):
+        ``not-elected`` | ``no-generation`` | ``no-requests`` |
+        ``cache-hit`` | ``prewarmed``."""
+        node = self.client.get_or_none("v1", "Node", self.node_name)
+        if node is None:
+            return "not-elected"
+        labels = node["metadata"].get("labels") or {}
+        if labels.get(consts.COMPILE_CACHE_ELECTED_LABEL) != consts.COMPILE_CACHE_ELECTED:
+            # the DaemonSet nodeSelector should make this unreachable,
+            # but a just-cleared label can race the pod teardown
+            return "not-elected"
+        info = tpu_info(node)
+        generation = info.generation if info else ""
+        if not generation or generation == "unknown":
+            log.warning(
+                "compilecache: node %s has no recognizable TPU generation",
+                self.node_name,
+            )
+            return "no-generation"
+        version = runtime_fingerprint()
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, self.namespace
+        )
+        data = (cm or {}).get("data") or {}
+        requests = parse_requests(data.get(consts.COMPILE_PREWARM_REQUEST_KEY))
+        mine = {
+            rid: r for rid, r in requests.items()
+            if r.get("generation") == generation
+        }
+        if not mine:
+            return "no-requests"
+        entry = parse_entry(data.get(entry_key(generation)))
+        pending = {
+            rid: r for rid, r in mine.items()
+            if cache_record(
+                entry, r.get("topology", ""), r.get("model", ""), version
+            ) is None
+        }
+        if not pending:
+            # compile-once: every requested executable is already cached
+            # for this toolchain — a rebooted elected node issues ZERO
+            # writes
+            return "cache-hit"
+        store = CompileCacheStore(self.client, self.namespace, version)
+        for rid in sorted(pending):
+            request = pending[rid]
+            log.info(
+                "compilecache: prewarming %s on %s (libtpu %s)",
+                rid, self.node_name, version,
+            )
+            seconds = self.warm_fn(request, version)
+            store.publish(
+                generation, request.get("topology", ""),
+                request.get("model", ""), seconds,
+                source="prewarm", serving=request.get("serving", ""),
+                node=self.node_name,
+            )
+            store.ack(rid, self.node_name, seconds, "prewarmed")
+        return "prewarmed"
+
+    # -- loop -----------------------------------------------------------------
+
+    def run_forever(self) -> None:
+        while not self._stop:
+            try:
+                outcome = self.reconcile_once()
+                log.info("compilecache: pass outcome %s", outcome)
+            except errors.ApiError as e:
+                log.warning("compilecache: pass failed: %s", e)
+            except Exception:  # noqa: BLE001 — a compile crash must not kill the pod
+                log.exception("compilecache: prewarm failed")
+            time.sleep(self.interval)
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)).strip())
+    except ValueError:
+        log.warning("invalid %s %r; using %s", name, os.environ.get(name), default)
+        return default
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    import argparse
+
+    p = argparse.ArgumentParser("tpu-compile-cache")
+    p.add_argument(
+        "--oneshot", action="store_true",
+        help="run one reconcile pass and exit (image smoke / debugging)",
+    )
+    args = p.parse_args()
+    from tpu_operator.kube.http_client import HttpClient
+
+    client = HttpClient.in_cluster()
+    agent = CompileCacheAgent(
+        client,
+        node_name=os.environ.get("NODE_NAME", ""),
+        namespace=os.environ.get(
+            consts.OPERATOR_NAMESPACE_ENV, consts.DEFAULT_OPERATOR_NAMESPACE
+        ),
+        interval=_float_env("COMPILE_CACHE_INTERVAL", 60.0),
+    )
+    if args.oneshot:
+        print(json.dumps({"outcome": agent.reconcile_once()}))
+        return 0
+    agent.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

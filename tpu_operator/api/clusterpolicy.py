@@ -302,6 +302,29 @@ class AutotunerSpec(ComponentCommon):
 
 
 @dataclasses.dataclass
+class CompileCacheSpec(ComponentCommon):
+    """Persistent XLA compile cache + AOT prewarm (ROADMAP item 4): a
+    prewarm operand scheduled onto one ELECTED node per generation with
+    unsatisfied prewarm demand (the compile-cache controller manages the
+    election label). Compiled-executable records are content-addressed
+    by (generation, topology, model hash, libtpu version); entries
+    invalidate on libtpu image-tag change exactly like the autotune
+    results, so a rolling upgrade re-compiles each generation once. No
+    reference analog — CUDA kernels ship precompiled; XLA recompiles per
+    (program, topology), so warm scale-ups need the operator to own the
+    cache."""
+
+    # seconds between agent reconcile passes on an elected node
+    interval: int = field(default=60)
+    # chips the prewarm pod claims via the google.com/tpu resource — the
+    # compile must lower against the real device topology
+    chips: int = field(default=4)
+    # node-local persistent compilation cache directory (hostPath): the
+    # serialized executables survive the prewarm pod
+    cache_dir: str = field(json="cacheDir", default="/var/cache/tpu-compile")
+
+
+@dataclasses.dataclass
 class MultiSliceSpec(SpecBase):
     """Multi-slice (DCN-connected slices) support: the validator and the
     slice manager wire JAX distributed-coordinator addresses across slices
@@ -345,6 +368,7 @@ class ClusterPolicySpec(SpecBase):
     validator: ValidatorSpec = sub(ValidatorSpec)
     health_monitor: HealthMonitorSpec = sub(HealthMonitorSpec, json="healthMonitor")
     autotuner: AutotunerSpec = sub(AutotunerSpec)
+    compile_cache: CompileCacheSpec = sub(CompileCacheSpec, json="compileCache")
     multi_slice: MultiSliceSpec = sub(MultiSliceSpec, json="multiSlice")
     psa: PSASpec = sub(PSASpec)
 

@@ -25,6 +25,10 @@ from tpu_operator.controllers.clusterpolicy_controller import (
     ClusterPolicyReconciler,
     setup_with_manager as setup_clusterpolicy,
 )
+from tpu_operator.controllers.compilecache_controller import (
+    CompileCacheReconciler,
+    setup_with_manager as setup_compilecache,
+)
 from tpu_operator.controllers.defrag_controller import (
     DefragReconciler,
     setup_with_manager as setup_defrag,
@@ -135,6 +139,7 @@ def main(argv=None) -> int:
     setup_job(mgr, JobReconciler(client, namespace))
     setup_serving(mgr, ServingReconciler(client, namespace))
     setup_defrag(mgr, DefragReconciler(client, namespace))
+    setup_compilecache(mgr, CompileCacheReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

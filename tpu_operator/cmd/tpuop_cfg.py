@@ -169,11 +169,35 @@ def cmd_plan(args) -> int:
             entries = cached_entries(cm.get("data"))
     except kube_errors.ApiError:
         entries = None
+    compile_entries = None
+    try:
+        cm = client.get_or_none(
+            "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, ns
+        )
+        if cm is not None:
+            from tpu_operator.workloads import compilecache
+
+            compile_entries = compilecache.cached_entries(cm.get("data"))
+    except kube_errors.ApiError:
+        compile_entries = None
+    from tpu_operator.workloads.autotune import runtime_fingerprint
+
+    # price the what-if against the model serving workers actually run
+    # (the same default-config hash their warm_start publishes under)
+    try:
+        from tpu_operator.workloads.compilecache import model_descriptor_hash
+
+        model_hash = model_descriptor_hash()
+    except Exception:  # noqa: BLE001 — pricing is optional; no jax, no hash
+        model_hash = ""
     sys.stdout.write(
         plan_report(
             slices, nodes, shape=args.shape, pool=args.pool,
             horizon_seconds=args.within, degraded_links=links,
             autotune_entries=entries,
+            compile_entries=compile_entries,
+            libtpu_version=runtime_fingerprint(),
+            model_hash=model_hash,
         )
     )
     return 0

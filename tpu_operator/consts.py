@@ -180,6 +180,41 @@ AUTOTUNE_ENV = "TPU_AUTOTUNE_JSON"
 AUTOTUNE_REPLAN_SECONDS = 30.0
 
 # ---------------------------------------------------------------------------
+# Persistent XLA compile cache + AOT prewarm (workloads/compilecache.py
+# -> agents/compilecache_agent.py -> controllers/compilecache_controller
+# .py). Compiled-executable records are content-addressed by
+# (generation, topology, model descriptor hash, libtpu version): on real
+# TPU the record fronts JAX's persistent compilation cache directory; on
+# the CPU sim it records and replays measured warmup durations so cache
+# hit vs miss stays an observable, benchable quantity. The serving
+# controller writes prewarm REQUESTS (the one key it owns here) when an
+# imminent scale-up implies an uncached key; the compile-cache
+# controller elects one in-service node per generation with unsatisfied
+# demand (the autotune election idiom — the label is in the DaemonSet's
+# nodeSelector, so the prewarm pod exists only for the compile window);
+# the elected agent compiles, publishes the record, and ACKs. Entries
+# invalidate on libtpu image-tag change exactly like
+# tpu-autotune-results; steady state is zero writes.
+# ---------------------------------------------------------------------------
+COMPILE_CACHE_ELECTED_LABEL = "tpu.google.com/compile-cache"
+COMPILE_CACHE_ELECTED = "elected"
+# per-generation compiled-executable records; data keys are
+# "<generation>.json" entries plus the two handshake keys below
+COMPILE_CACHE_CONFIGMAP = "tpu-compile-cache"
+# prewarm handshake rides DISJOINT keys (the K002 convention): the
+# serving controller owns the request map, the prewarm agent (via the
+# workloads/compilecache publish helper) owns the ack map
+COMPILE_PREWARM_REQUEST_KEY = "prewarm-requests.json"
+COMPILE_PREWARM_ACK_KEY = "prewarm-acks.json"
+# the directory JAX's persistent compilation cache is bound to on real
+# TPU nodes (hostPath-backed on the DaemonSet; env-overridable)
+COMPILE_CACHE_DIR_ENV = "TPU_COMPILE_CACHE_DIR"
+COMPILE_CACHE_DIR_DEFAULT = "/var/cache/tpu-compile"
+# re-check cadence while any prewarm demand is unsatisfied (a crashed
+# elected node must be re-elected on a timer, like autotune)
+COMPILE_CACHE_REPLAN_SECONDS = 30.0
+
+# ---------------------------------------------------------------------------
 # Elastic fault-tolerant training jobs (api/tpujob.py ->
 # controllers/job_controller.py -> workloads/training.py). The job
 # controller owns one TPUSlice per TPUJob (named <job> + JOB_SLICE_SUFFIX)
@@ -258,6 +293,11 @@ WORKER_ENV_REPLICA_NAME = "TPU_REPLICA_NAME"
 WORKER_ENV_POOL = "TPU_POOL"
 WORKER_ENV_NAMESPACE = "TPU_NAMESPACE"
 WORKER_ENV_STEPS_PER_SYNC = "TPU_STEPS_PER_SYNC"
+# compile-cache addressing for serving workers: the replica's chip
+# generation and topology (shape string), so the worker's warmup step
+# can resolve — and on a miss, publish — its compile-cache record
+WORKER_ENV_GENERATION = "TPU_GENERATION"
+WORKER_ENV_TOPOLOGY = "TPU_TOPOLOGY"
 # worker pod name shapes: <job> + JOB_WORKER_INFIX + <member index>,
 # <serving> + SERVING_PREFILL_INFIX/SERVING_DECODE_INFIX + <index>
 JOB_WORKER_INFIX = "-worker-"

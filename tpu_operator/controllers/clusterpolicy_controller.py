@@ -50,6 +50,7 @@ OPERAND_DEPLOY_KEYS = {
     "state-node-status-exporter": consts.COMMON_DEPLOY_LABEL_PREFIX + "node-status-exporter",
     "state-health-monitor": consts.COMMON_DEPLOY_LABEL_PREFIX + "health-monitor",
     "state-autotuner": consts.COMMON_DEPLOY_LABEL_PREFIX + "autotuner",
+    "state-compile-cache": consts.COMMON_DEPLOY_LABEL_PREFIX + "compile-cache",
 }
 
 

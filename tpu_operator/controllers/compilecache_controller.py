@@ -1,0 +1,401 @@
+"""Compile-cache reconciler: prewarm election + entry invalidation.
+
+The operator half of the fleet compile cache (ROADMAP item 4; the store
+vocabulary is ``workloads/compilecache.py``, the elected-node half is
+``agents/compilecache_agent.py``). Each pass:
+
+1. **Invalidate** — entries in the ``tpu-compile-cache`` ConfigMap
+   recorded under a different libtpu version than the ClusterPolicy's
+   current image tag are DELETED (one key-scoped patch per affected
+   generation, exactly like ``tpu-autotune-results`` invalidation): a
+   rolling libtpu upgrade makes every cached executable unloadable, and
+   a deleted entry reads as a miss everywhere — the serving controller
+   re-requests, the elected agent re-compiles ONCE per generation.
+
+2. **Elect** — for every generation with unsatisfied prewarm demand
+   (prewarm requests the serving controller published whose content
+   address has no valid record), hold the election label
+   (``consts.COMPILE_CACHE_ELECTED_LABEL``) on exactly one in-service
+   node (the autotune election idiom: the prewarm DaemonSet's
+   nodeSelector includes the label, so electing IS scheduling — and the
+   pod, with the chips it claims, exists only for the compile window).
+   Satisfied demand holds no election; orphaned elections are cleared.
+
+3. **Export** — ``tpu_operator_compile_seconds{serving,generation}``
+   from the valid cached records and the per-generation
+   ``tpu_operator_compile_cache_{hits,misses}_total`` counters from the
+   store's in-process accounting, with stale-series hygiene (O005): a
+   record that leaves the cache takes its series with it.
+
+Steady state is O(changes): every request satisfied -> no elections, no
+stale entries -> zero apiserver writes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Set, Tuple
+
+from tpu_operator import consts
+from tpu_operator.api.clusterpolicy import (
+    CLUSTER_POLICY_API_VERSION,
+    CLUSTER_POLICY_KIND,
+    ClusterPolicy,
+)
+from tpu_operator.controllers.autotune_controller import libtpu_version_for
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors, trace
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result, generation_changed
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict
+from tpu_operator.nodeinfo import tpu_info
+from tpu_operator.workloads import compilecache
+from tpu_operator.workloads.compilecache import (
+    cache_record,
+    entry_key,
+    entry_valid,
+    parse_entry,
+    parse_requests,
+)
+
+log = logging.getLogger(__name__)
+
+
+class CompileCacheReconciler:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE):
+        self.client = client
+        self.namespace = namespace
+        self.metrics = get_metrics()
+        self.recorder = EventRecorder(client, namespace)
+        self._elected_events: set = set()  # (gen, node) election dedup
+        self._compile_series: Set[Tuple[str, str]] = set()  # (serving, gen)
+        self._hit_series: Set[str] = set()
+        self._miss_series: Set[str] = set()
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Result:
+        obj = self.client.get_or_none(
+            CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name
+        )
+        if obj is None:
+            return Result()
+        cp = ClusterPolicy.from_unstructured(obj)
+        if not cp.spec.compile_cache.is_enabled():
+            with trace.span("compilecache-elect"):
+                self._clear_all_elections()
+            # stale-series hygiene on disable: a frozen compile gauge
+            # would export yesterday's cost forever
+            self._update_series({})
+            self._update_counter_series()
+            return Result()
+        desired_version = libtpu_version_for(cp)
+        try:
+            nodes = self.client.list(
+                "v1", "Node", label_selector={consts.TPU_PRESENT_LABEL: "true"}
+            )
+        except errors.ApiError as e:
+            log.warning("compilecache: node list failed: %s", e)
+            return Result(requeue=True)
+        cm = self.client.get_or_none(
+            "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, self.namespace
+        )
+        data = (cm or {}).get("data") or {}
+        groups = self._by_generation(nodes)
+        entries = compilecache.cached_entries(data)
+        with trace.span("compilecache-invalidate"):
+            entries = self._invalidate_stale(obj, entries, desired_version)
+        requests = parse_requests(data.get(consts.COMPILE_PREWARM_REQUEST_KEY))
+        demand = self._unsatisfied(requests, entries, desired_version)
+        with trace.span("compilecache-elect"):
+            pending, kept = self._elect(obj, groups, demand, desired_version)
+            self._clear_orphan_elections(kept)
+        self._update_series(
+            {g: e for g, e in entries.items() if entry_valid(e, desired_version)}
+        )
+        self._update_counter_series()
+        if pending:
+            # a crashed elected node / a compile in flight: re-check on
+            # a timer (the published record also lands as a watch event)
+            return Result(requeue_after=consts.COMPILE_CACHE_REPLAN_SECONDS)
+        return Result()
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _labels(node: ObjectDict) -> dict:
+        return node["metadata"].get("labels") or {}
+
+    def _by_generation(self, nodes: List[ObjectDict]) -> Dict[str, List[ObjectDict]]:
+        groups: Dict[str, List[ObjectDict]] = {}
+        for node in nodes:
+            info = tpu_info(node)
+            if info is None or not info.generation or info.generation == "unknown":
+                continue
+            groups.setdefault(info.generation, []).append(node)
+        return groups
+
+    def _in_service(self, node: ObjectDict) -> bool:
+        from tpu_operator.placement.engine import labels_unavailable
+
+        return not labels_unavailable(self._labels(node))
+
+    def _invalidate_stale(
+        self, cp_obj: ObjectDict, entries: Dict[str, dict], desired_version: str
+    ) -> Dict[str, dict]:
+        """Delete entries recorded under a different libtpu version —
+        ONE key-scoped patch per affected generation, so a rolling
+        upgrade costs exactly one invalidation (and, downstream, one
+        re-compile) per generation; valid entries are untouched."""
+        live: Dict[str, dict] = {}
+        for gen, entry in entries.items():
+            if entry.get("libtpu_version") == desired_version:
+                live[gen] = entry
+                continue
+            try:
+                self.client.patch(
+                    "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP,
+                    {"data": {entry_key(gen): None}}, self.namespace,
+                )
+            except errors.ApiError as e:
+                log.warning("compilecache: invalidation of %s failed: %s", gen, e)
+                continue
+            self.recorder.event(
+                cp_obj, "Normal", "CompileCacheInvalidated",
+                f"generation {gen}: cached executables recorded under libtpu "
+                f"{entry.get('libtpu_version')} invalidated (current "
+                f"{desired_version})",
+            )
+        return live
+
+    @staticmethod
+    def _unsatisfied(
+        requests: Dict[str, dict], entries: Dict[str, dict], desired_version: str
+    ) -> Dict[str, List[dict]]:
+        """Prewarm requests whose content address has no valid record,
+        grouped by generation — the election demand."""
+        out: Dict[str, List[dict]] = {}
+        for _rid, request in sorted(requests.items()):
+            gen = request.get("generation") or ""
+            if not gen:
+                continue
+            record = cache_record(
+                entries.get(gen), request.get("topology", ""),
+                request.get("model", ""), desired_version,
+            )
+            if record is None:
+                out.setdefault(gen, []).append(request)
+        return out
+
+    def _set_election(self, node_name: str, elected: bool) -> None:
+        try:
+            self.client.patch(
+                "v1", "Node", node_name,
+                {"metadata": {"labels": {
+                    consts.COMPILE_CACHE_ELECTED_LABEL:
+                        consts.COMPILE_CACHE_ELECTED if elected else None
+                }}},
+            )
+        except errors.NotFound:
+            pass  # node left while the pass ran
+
+    def _clear_all_elections(self) -> None:
+        try:
+            nodes = self.client.list(
+                "v1", "Node",
+                label_selector={
+                    consts.COMPILE_CACHE_ELECTED_LABEL: consts.COMPILE_CACHE_ELECTED
+                },
+            )
+        except errors.ApiError:
+            return
+        for node in nodes:
+            self._set_election(node["metadata"]["name"], False)
+
+    def _clear_orphan_elections(self, kept: set) -> None:
+        """Clear the election label from any node not designated this
+        pass — a node that left its generation grouping mid-compile
+        would otherwise hold the label (and its chip-claiming prewarm
+        pod) forever."""
+        try:
+            labelled = self.client.list(
+                "v1", "Node",
+                label_selector={
+                    consts.COMPILE_CACHE_ELECTED_LABEL: consts.COMPILE_CACHE_ELECTED
+                },
+            )
+        except errors.ApiError:
+            return
+        for node in labelled:
+            name = node["metadata"]["name"]
+            if name not in kept:
+                self._set_election(name, False)
+
+    def _elect(
+        self,
+        cp_obj: ObjectDict,
+        groups: Dict[str, List[ObjectDict]],
+        demand: Dict[str, List[dict]],
+        desired_version: str,
+    ):
+        """Converge election labels over generations with unsatisfied
+        prewarm demand; returns (pending generations, kept node names).
+        The autotune idiom: keep a live election if one exists, else
+        elect the lexicographically-first in-service node."""
+        pending: List[str] = []
+        kept: set = set()
+        for gen in sorted(demand):
+            gen_nodes = groups.get(gen) or []
+            elected = [
+                n for n in gen_nodes
+                if self._labels(n).get(consts.COMPILE_CACHE_ELECTED_LABEL)
+                == consts.COMPILE_CACHE_ELECTED
+            ]
+            eligible = sorted(
+                (n for n in gen_nodes if self._in_service(n)),
+                key=lambda n: n["metadata"]["name"],
+            )
+            if not eligible:
+                # demand with no node to serve it: requests outlive the
+                # generation's nodes (drained pool) — hold no election
+                for node in elected:
+                    self._set_election(node["metadata"]["name"], False)
+                continue
+            pending.append(gen)
+            live = [n for n in elected if self._in_service(n)]
+            if live:
+                keep = sorted(
+                    live, key=lambda n: n["metadata"]["name"]
+                )[0]["metadata"]["name"]
+            else:
+                keep = eligible[0]["metadata"]["name"]
+                self._set_election(keep, True)
+                if (gen, keep) not in self._elected_events:
+                    self.recorder.event(
+                        cp_obj, "Normal", "CompilePrewarmElected",
+                        f"elected node {keep} to prewarm {len(demand[gen])} "
+                        f"compile(s) for generation {gen} (libtpu "
+                        f"{desired_version})",
+                    )
+                    self._elected_events.add((gen, keep))
+            kept.add(keep)
+            for node in elected:
+                name = node["metadata"]["name"]
+                if name != keep:
+                    self._set_election(name, False)
+        # elections held by generations whose demand vanished are
+        # cleared by _clear_orphan_elections (they are not in `kept`)
+        return pending, kept
+
+    # -- metric export --------------------------------------------------------
+
+    def _update_series(self, valid: Dict[str, dict]) -> None:
+        """``compile_seconds{serving,generation}`` from the valid cached
+        records, with stale-series hygiene: an invalidated or vanished
+        record takes its series with it (O005)."""
+        live: Set[Tuple[str, str]] = set()
+        for gen, entry in valid.items():
+            for record in (entry.get("records") or {}).values():
+                if not isinstance(record, dict):
+                    continue
+                seconds = record.get("seconds")
+                if not isinstance(seconds, (int, float)):
+                    continue
+                serving = record.get("serving") or record.get("source") or "prewarm"
+                self.metrics.compile_seconds.labels(serving, gen).set(float(seconds))
+                live.add((serving, gen))
+        for gone in self._compile_series - live:
+            try:
+                self.metrics.compile_seconds.remove(*gone)
+            except KeyError:
+                pass
+        self._compile_series = live
+
+    def _update_counter_series(self) -> None:
+        """Per-generation hit/miss counters from the store's in-process
+        accounting (the sim runs workers in-process; on a real cluster
+        the workers' own endpoints carry these), retiring series for
+        generations whose counters reset away (O005)."""
+        stats = compilecache.stats()
+        live_hits: Set[str] = set()
+        for gen, count in stats.get("hits", {}).items():
+            self.metrics.compile_cache_hits.labels(gen).set(count)
+            live_hits.add(gen)
+        for gone in self._hit_series - live_hits:
+            try:
+                self.metrics.compile_cache_hits.remove(gone)
+            except KeyError:
+                pass
+        self._hit_series = live_hits
+        live_misses: Set[str] = set()
+        for gen, count in stats.get("misses", {}).items():
+            self.metrics.compile_cache_misses.labels(gen).set(count)
+            live_misses.add(gen)
+        for gone in self._miss_series - live_misses:
+            try:
+                self.metrics.compile_cache_misses.remove(gone)
+            except KeyError:
+                pass
+        self._miss_series = live_misses
+
+
+def setup_with_manager(mgr, reconciler: CompileCacheReconciler) -> Controller:
+    ctrl = Controller(
+        "compilecache", reconciler,
+        coalesce_window=consts.NODE_EVENT_COALESCE_SECONDS,
+    )
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_all_cps(_obj) -> List[Request]:
+        try:
+            cps = reconciler.client.list(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND)
+        except errors.ApiError:
+            return []
+        return [Request(name=cp["metadata"]["name"]) for cp in cps]
+
+    ctrl.watch(
+        mgr.informer_for(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND),
+        predicate=generation_changed,
+    )
+
+    def election_labels_changed(event_type, old, new) -> bool:
+        """Node events matter when election inputs changed: TPU
+        identity, election state, or in-service state."""
+        keys = (
+            consts.TPU_PRESENT_LABEL,
+            consts.COMPILE_CACHE_ELECTED_LABEL,
+            consts.TPU_HEALTH_LABEL,
+            consts.REPAIR_STATE_LABEL,
+            consts.TPU_PERF_LABEL,
+            consts.GKE_TPU_ACCELERATOR_LABEL,
+            consts.TFD_ACCELERATOR_TYPE_LABEL,
+        )
+        if event_type != "MODIFIED" or old is None:
+            return any(k in (new["metadata"].get("labels") or {}) for k in keys)
+        old_labels = old["metadata"].get("labels") or {}
+        new_labels = new["metadata"].get("labels") or {}
+        return any(old_labels.get(k) != new_labels.get(k) for k in keys)
+
+    ctrl.watch(
+        mgr.informer_for("v1", "Node"),
+        mapper=map_to_all_cps, predicate=election_labels_changed,
+    )
+
+    def cache_changed(event_type, old, new) -> bool:
+        """Only the cache ConfigMap's DATA matters (a published record
+        or a new prewarm request); our own invalidation writes echo
+        here, but the next pass settles with zero writes."""
+        if new["metadata"].get("name") != consts.COMPILE_CACHE_CONFIGMAP:
+            return False
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("data") or {}) != (new.get("data") or {})
+
+    ctrl.watch(
+        mgr.informer_for("v1", "ConfigMap"),
+        mapper=map_to_all_cps, predicate=cache_changed,
+    )
+    mgr.add_controller(ctrl)
+    return ctrl

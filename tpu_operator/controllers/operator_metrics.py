@@ -203,6 +203,34 @@ class OperatorMetrics:
             ["generation"],
             registry=reg,
         )
+        # persistent compile cache (controllers/compilecache_controller
+        # .py exports from the cached entries; series retire when the
+        # record — or the generation — leaves the cache, O005)
+        self.compile_seconds = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_compile_seconds",
+            "Measured XLA compile (warmup) seconds recorded in the "
+            "fleet compile cache for a serving's model on a generation "
+            "(series retire when the record is invalidated)",
+            ["serving", "generation"],
+            registry=reg,
+        )
+        self.compile_cache_hits = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_compile_cache_hits_total",
+            "Compile-cache hits observed per generation (warm starts: "
+            "the warmup step resolved a cached executable record)",
+            ["generation"],
+            registry=reg,
+        )
+        self.compile_cache_misses = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_compile_cache_misses_total",
+            "Compile-cache misses observed per generation (cold starts "
+            "that paid — and then published — the full compile)",
+            ["generation"],
+            registry=reg,
+        )
         # elastic training jobs (controllers/job_controller.py): per-job
         # bookkeeping gauges, removed when the TPUJob is deleted (O005)
         self.job_step = _get_or_create(

@@ -550,6 +550,10 @@ class ServingReconciler:
                     consts.WORKER_ENV_REPLICA_NAME: state["name"],
                     consts.WORKER_ENV_POOL: pool_env,
                     consts.WORKER_ENV_NAMESPACE: self.namespace,
+                    # compile-cache addressing: the worker's warmup step
+                    # resolves (and on a miss, publishes) its record
+                    consts.WORKER_ENV_GENERATION: serving.spec.model.generation or "",
+                    consts.WORKER_ENV_TOPOLOGY: serving.spec.model.shape or "",
                 },
                 "node": state["nodes"][0] if state["nodes"] else "",
             })
@@ -615,6 +619,102 @@ class ServingReconciler:
                 pass
         except errors.ApiError as e:
             log.debug("serving %s: routing publish failed: %s", serving, e)
+
+    # -- AOT prewarm ---------------------------------------------------------
+
+    def _compile_cache_data(self) -> Optional[dict]:
+        """The compile-cache CM's data; {} before first use, None when
+        the API is unreachable — prewarm scheduling FAILS CLOSED on
+        None (no decisions against an impersonated empty cache)."""
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError:
+            return None
+        return (cm or {}).get("data") or {}
+
+    def _write_prewarm_requests(self, requests: Dict[str, dict]) -> None:
+        """The one compile-cache key this controller owns: the prewarm
+        request map (the agent acks under its own disjoint key)."""
+        from tpu_operator.kube.objects import new_object
+
+        data = {consts.COMPILE_PREWARM_REQUEST_KEY: json.dumps(
+            {"requests": requests}, sort_keys=True)}
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP,
+                {"data": data}, self.namespace,
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                    new_object("v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP,
+                               self.namespace, data=data)
+                )
+            except (errors.AlreadyExists, errors.ApiError):
+                pass
+        except errors.ApiError as e:
+            log.debug("serving: prewarm request publish failed: %s", e)
+
+    def _reconcile_prewarm(
+        self, obj: ObjectDict, serving: TPUServing, block: dict
+    ) -> None:
+        """AOT prewarm scheduling: this serving's replicas imply an
+        imminent (generation, shape, model) — when the fleet compile
+        cache has no record for it, publish a prewarm request so the
+        elected agent compiles BEFORE the next replica's worker boots
+        (its warmup step then resolves a cache hit). Idempotent:
+        an already-requested or already-cached key writes nothing, so
+        steady state is zero writes; a satisfied request is cleared
+        once (the request map is this controller's key)."""
+        from tpu_operator.workloads.compilecache import (
+            entry_key,
+            model_descriptor_hash,
+            parse_entry,
+            parse_requests,
+            record_key,
+            request_id,
+        )
+
+        generation = serving.spec.model.generation
+        if not generation:
+            return  # no generation hint: nothing to address the cache by
+        topology = serving.spec.model.shape
+        model_hash = model_descriptor_hash()
+        data = self._compile_cache_data()
+        if data is None:
+            return  # fail closed (K003): unreadable cache schedules nothing
+        rid = request_id(generation, topology, model_hash)
+        requests = parse_requests(data.get(consts.COMPILE_PREWARM_REQUEST_KEY))
+        entry = parse_entry(data.get(entry_key(generation)))
+        # presence-based: the compile-cache controller DELETES entries
+        # invalidated by a libtpu bump, so presence converges on
+        # validity — and a stale record is re-requested right after
+        records = (entry or {}).get("records")
+        cached = isinstance(records, dict) and record_key(topology, model_hash) in records
+        if cached:
+            if rid in requests:
+                remaining = {k: v for k, v in requests.items() if k != rid}
+                self._write_prewarm_requests(remaining)
+                self._note_decision(
+                    block, "prewarm", f"{rid} cached; prewarm request cleared")
+            return
+        if rid in requests:
+            return  # requested, compile in flight: zero writes
+        requests[rid] = {
+            "generation": generation,
+            "topology": topology,
+            "model": model_hash,
+            "serving": serving.name,
+        }
+        self._write_prewarm_requests(requests)
+        detail = (
+            f"requested compile prewarm for {rid} (cold cache: the next "
+            f"replica would pay the full XLA compile)"
+        )
+        self._note_decision(block, "prewarm", detail)
+        self.recorder.normal(obj, "ServingPrewarmRequested", detail)
 
     def _note_decision(self, block: dict, action: str, detail: str) -> None:
         decisions = list(block.get("decisions") or [])
@@ -782,6 +882,10 @@ class ServingReconciler:
                 self.recorder.normal(obj, "ServingScaledDown", detail)
                 replicas = [o for o in replicas if o["metadata"]["name"] != victim]
                 states = [s for s in states if s["name"] != victim]
+
+        # -- AOT prewarm: make sure the compile this serving's next
+        # replica needs is already in the fleet cache
+        self._reconcile_prewarm(obj, serving, block)
 
         # -- the prefill pool converges on its own signal
         disagg = serving.spec.disaggregation

@@ -164,6 +164,11 @@ class ServingWorkerMain:
         self.serving_name = env.get(consts.WORKER_ENV_SERVING_NAME, "")
         self.replica = env.get(consts.WORKER_ENV_REPLICA_NAME, "")
         self.pool = env.get(consts.WORKER_ENV_POOL, "")
+        # compile-cache addressing: the controller renders the replica's
+        # generation + topology into the pod env; absent (older specs,
+        # unit fixtures) the warmup runs unkeyed and the cache is inert
+        self.generation = env.get(consts.WORKER_ENV_GENERATION, "")
+        self.topology = env.get(consts.WORKER_ENV_TOPOLOGY, "")
         cfg = cfg or ServingModelConfig()
         prefill = self.pool == consts.SERVING_POOL_PREFILL
         self.engine = DecodeEngine(
@@ -174,7 +179,16 @@ class ServingWorkerMain:
             # retention would only pin dead pages
             retain_sessions=not prefill,
         )
-        self.engine.warmup(min(cfg.prefill_chunk, cfg.max_seq // 4))
+        from tpu_operator.workloads.compilecache import CompileCacheStore
+
+        store = CompileCacheStore(client, self.namespace)
+        # warmup resolves through the fleet compile cache: a hit means a
+        # prior replica (or an AOT prewarm) already paid this compile;
+        # a miss measures and publishes it so the next replica is warm
+        self.compile_outcome, self.warmup_seconds = store.warm_start(
+            self.engine, self.generation, self.topology,
+            serving=self.serving_name,
+        )
 
     def submit(self, request) -> None:
         self.engine.submit(request)

@@ -28,6 +28,9 @@ DEFAULTS = {
     # the autotune sweep agent also ships in the validator/agents image
     # (shim: tpu-autotuner) — its payloads ARE the validator's kernels
     "autotuner": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
+    # the compile prewarm agent ships in the validator/agents image too
+    # (shim: tpu-compile-cache) — it compiles the serving payloads
+    "compile_cache": ("VALIDATOR_IMAGE", "gcr.io/tpu-operator/tpu-operator-validator:1.0.0"),
 }
 
 

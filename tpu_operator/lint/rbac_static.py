@@ -74,6 +74,12 @@ SUBJECT_ROOTS: Dict[str, Sequence[str]] = {
     "state-health-monitor": ("agents/health_monitor_agent.py",),
     "state-metrics-exporter": ("agents/metrics_exporter_agent.py",),
     "state-autotuner": ("agents/autotune_agent.py",),
+    # the agent's ConfigMap writes (record publish + prewarm ack) live
+    # in the store module — the single write site K002 attributes
+    "state-compile-cache": (
+        "agents/compilecache_agent.py",
+        "workloads/compilecache.py",
+    ),
     "state-libtpu": ("agents/libtpu_installer.py",),
     "state-node-status-exporter": ("validator/metrics.py",),
     "state-operator-validation": (
@@ -481,14 +487,19 @@ def _foreign_roots(subject: str) -> Set[str]:
 
 
 def subject_modules(subject: str) -> List[str]:
-    """Reachable-module closure for one subject (see module docstring)."""
+    """Reachable-module closure for one subject (see module docstring).
+    An explicitly-listed root bypasses EXCLUDED_MODULES: the exclusion
+    list prunes the *import closure* (infra / workload-side code that
+    does not normally run under a subject's ServiceAccount), while a
+    named root is a deliberate attribution — e.g. the compile-cache
+    store, workload-side code the operand agent executes."""
     own = set(_roots_for(subject))
     foreign = _foreign_roots(subject) - own
     seen: Set[str] = set()
-    queue = [r for r in own if not _excluded(r)]
+    queue = list(own)
     while queue:
         rel = queue.pop()
-        if rel in seen or _excluded(rel) or rel in foreign:
+        if rel in seen or rel in foreign or (_excluded(rel) and rel not in own):
             continue
         seen.add(rel)
         path = os.path.join(PKG_ROOT, rel)

@@ -90,6 +90,7 @@ _SHARED_KEY_CONST_NAMES = (
     "SERVING_LOAD_HANDOFF_BYTES",
     "SERVING_ROUTING_KEY", "SERVING_POOLS_KEY",
     "DEFRAG_STATE_KEY", "AUTOTUNE_WINNERS_KEY", "PERF_FLOORS_KEY",
+    "COMPILE_PREWARM_REQUEST_KEY", "COMPILE_PREWARM_ACK_KEY",
 )
 _SHARED_KEY_PREFIX_NAMES = ("JOB_RENDEZVOUS_PREFIX",)
 

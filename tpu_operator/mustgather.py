@@ -443,6 +443,84 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("telemetry.txt", f"# collection failed: {e}\n")
 
     try:
+        # the fleet compile cache: per-generation compiled-executable
+        # records, the prewarm handshake in flight, and this process's
+        # hit/miss counters — where "why was that scale-up cold" starts
+        from tpu_operator import consts as _consts
+        from tpu_operator.workloads import compilecache
+
+        lines = ["# compile cache (per-generation records)"]
+        cache_cm = client.get_or_none(
+            "v1", "ConfigMap", _consts.COMPILE_CACHE_CONFIGMAP, namespace
+        )
+        data = (cache_cm or {}).get("data") or {}
+        entries = compilecache.cached_entries(data)
+        for gen in sorted(entries):
+            entry = entries[gen]
+            records = entry.get("records") or {}
+            lines.append(
+                f"{gen}  libtpu={entry.get('libtpu_version', '?')}  "
+                f"records={len(records)}"
+            )
+            for key in sorted(records):
+                rec = records[key] if isinstance(records[key], dict) else {}
+                lines.append(
+                    f"  {key}  seconds={rec.get('seconds', '?')}  "
+                    f"source={rec.get('source', '?')}"
+                    + (f"  serving={rec['serving']}" if rec.get("serving") else "")
+                    + (f"  node={rec['node']}" if rec.get("node") else "")
+                )
+        if not entries:
+            lines.append("# none")
+        lines.append("")
+        lines.append("# prewarm requests in flight")
+        requests = compilecache.parse_requests(
+            data.get(_consts.COMPILE_PREWARM_REQUEST_KEY)
+        )
+        for rid in sorted(requests):
+            req = requests[rid]
+            lines.append(f"{rid}  serving={req.get('serving', '?')}")
+        if not requests:
+            lines.append("# none")
+        lines.append("")
+        lines.append("# prewarm acks")
+        acks = (compilecache.parse_entry(
+            data.get(_consts.COMPILE_PREWARM_ACK_KEY)
+        ) or {}).get("acks")
+        acks = acks if isinstance(acks, dict) else {}
+        for rid in sorted(acks):
+            ack = acks[rid] if isinstance(acks[rid], dict) else {}
+            lines.append(
+                f"{rid}  node={ack.get('node', '?')}  "
+                f"seconds={ack.get('seconds', '?')}  "
+                f"outcome={ack.get('outcome', '?')}"
+            )
+        if not acks:
+            lines.append("# none")
+        lines.append("")
+        lines.append("# this process's warm-start traffic")
+        cstats = compilecache.stats()
+        for gen in sorted(set(cstats["hits"]) | set(cstats["misses"])):
+            lines.append(
+                f"{gen}  hits={cstats['hits'].get(gen, 0)}  "
+                f"misses={cstats['misses'].get(gen, 0)}"
+            )
+        if not (cstats["hits"] or cstats["misses"]):
+            lines.append("# none")
+        lines.append("")
+        lines.append("# last warm-start/prewarm decisions")
+        for d in cstats["decisions"]:
+            lines.append(
+                f"{d.get('outcome', '?')}  generation={d.get('generation', '?')}  "
+                f"{d.get('detail', '')}"
+            )
+        if not cstats["decisions"]:
+            lines.append("# none")
+        emit("compile-cache.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("compile-cache.txt", f"# collection failed: {e}\n")
+
+    try:
         # the fabric view: the per-pool link-health map (the analyzer's
         # standing blame records), every gang's published fabric matrix,
         # the worst-10 measured edges fleet-wide, and the blame split —

@@ -65,6 +65,45 @@ TPU_TOLERANCE_FACTOR = 1.5
 # for unknown generations (conservative: the smallest measured roof)
 _FALLBACK_GENERATION = "v5e"
 
+# Cold XLA compile cost (seconds) by generation: what a fresh serving
+# replica pays lowering its decode/prefill programs before the first
+# token, used when the fleet compile cache has no measured record for
+# the key. Order-of-magnitude priors — a published record replaces them.
+COLD_COMPILE_SECONDS = {"v4": 90.0, "v5e": 60.0, "v5p": 120.0, "v6e": 120.0}
+_COLD_COMPILE_DEFAULT = 90.0
+
+
+def compile_cost_seconds(
+    generation: str,
+    topology: str = "",
+    model_hash: str = "",
+    entries: Optional[dict] = None,
+    libtpu_version: str = "",
+) -> Tuple[float, bool]:
+    """The compile term a scale-up ETA pays for one (generation,
+    topology, model) key: ``(seconds, warm)``. A valid fleet-cache
+    record makes the key WARM — the replica deserializes instead of
+    re-lowering, priced at ``WARM_FRACTION`` of the cold compile it
+    skips, so a warm ETA is strictly smaller than the cold ETA for the
+    same shape. Cold cost is the record's measured duration when one
+    exists for the key (wrong-version records don't count) and the
+    per-generation prior otherwise. ``entries`` is the parsed
+    ``cached_entries`` map; None/{} prices everything cold."""
+    from tpu_operator.workloads.compilecache import WARM_FRACTION, cache_record
+
+    cold = _positive(
+        COLD_COMPILE_SECONDS.get(generation), _COLD_COMPILE_DEFAULT
+    )
+    record = cache_record(
+        (entries or {}).get(generation), topology, model_hash, libtpu_version
+    )
+    if record is not None:
+        measured = _positive(record.get("seconds"), 0.0)
+        if measured > 0.0:
+            cold = measured
+        return round(cold * WARM_FRACTION, 4), True
+    return round(cold, 4), False
+
 
 @dataclasses.dataclass(frozen=True)
 class WorkloadDescriptor:

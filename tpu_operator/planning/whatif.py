@@ -73,6 +73,9 @@ def admission_answer(
     migratable: Optional[Sequence[str]] = None,
     horizon_seconds: float = 600.0,
     for_slice: Optional[str] = None,
+    compile_entries: Optional[dict] = None,
+    libtpu_version: str = "",
+    model_hash: str = "",
 ) -> dict:
     """The `tpuop-cfg plan` admission verdict for one shape. Returns
     {shape, answer: "now"|"after-defrag"|"no", pool, migrations,
@@ -82,7 +85,15 @@ def admission_answer(
     ``for_slice`` names an existing queued request the question is
     about, so the replay's own seating of it IS the answer (a
     hypothetical new gang needs a block beyond everything already
-    queued; an existing one doesn't compete with itself)."""
+    queued; an existing one doesn't compete with itself).
+
+    ``compile_entries`` (the parsed compile-cache ``cached_entries``
+    map) opts the ETA into the XLA compile term: a landing block still
+    pays the compile before its first token, warm (cache hit for this
+    key under ``libtpu_version``) or cold. None — the legacy
+    placement-only ETA."""
+    from tpu_operator.planning.model import compile_cost_seconds
+
     shape = parse_shape(str(shape_str))
     if shape is None:
         return {
@@ -91,13 +102,32 @@ def admission_answer(
             "detail": f"unparseable shape {shape_str!r}",
         }
     links = degraded_links or []
+
+    def _fold_compile(result: dict) -> dict:
+        if compile_entries is None or result["answer"] == "no":
+            return result
+        engine = PlacementEngine(slices, nodes, degraded_links=links)
+        entry = engine.pools.get(result["pool"])
+        generation = entry[0].info.generation if entry is not None else ""
+        seconds, warm = compile_cost_seconds(
+            generation, topology=str(shape_str), model_hash=model_hash,
+            entries=compile_entries, libtpu_version=libtpu_version,
+        )
+        result["eta_seconds"] = round((result["eta_seconds"] or 0.0) + seconds, 4)
+        result["compile_seconds"] = seconds
+        result["compile_warm"] = warm
+        result["detail"] += (
+            f"; +~{seconds:.1f}s {'warm' if warm else 'cold'} compile"
+        )
+        return result
+
     fit_pool = _fits_now(slices, nodes, shape, pool, links, for_slice=for_slice)
     if fit_pool is not None:
-        return {
+        return _fold_compile({
             "shape": shape_str, "answer": "now", "pool": fit_pool,
             "migrations": 0, "eta_seconds": 0.0,
             "detail": f"a free {shape_str} block exists in pool {fit_pool}",
-        }
+        })
     # virtual defrag: apply the proposer's best migration to a copy of
     # the world (the candidate's labels stripped — the engine re-places
     # it on the next replay, exactly as the live controller would) and
@@ -132,14 +162,14 @@ def admission_answer(
             slices, world_nodes, shape, pool, links, for_slice=for_slice
         )
         if fit_pool is not None:
-            return {
+            return _fold_compile({
                 "shape": shape_str, "answer": "after-defrag", "pool": fit_pool,
                 "migrations": round_no, "eta_seconds": eta,
                 "detail": (
                     f"lands in pool {fit_pool} after migrating "
                     f"{', '.join(moved)} (~{int(eta)}s at the defrag cooldown)"
                 ),
-            }
+            })
     return {
         "shape": shape_str, "answer": "no", "pool": "",
         "migrations": len(moved), "eta_seconds": None,
@@ -158,6 +188,9 @@ def plan_report(
     horizon_seconds: float = 600.0,
     degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
     autotune_entries: Optional[dict] = None,
+    compile_entries: Optional[dict] = None,
+    libtpu_version: str = "",
+    model_hash: str = "",
 ) -> str:
     """The `tpuop-cfg plan` report: per-pool capacity posture, the
     analytical model's per-generation reference predictions, admission
@@ -207,6 +240,8 @@ def plan_report(
             slices, nodes, queued_shape,
             degraded_links=links, horizon_seconds=horizon_seconds,
             for_slice=name,
+            compile_entries=compile_entries, libtpu_version=libtpu_version,
+            model_hash=model_hash,
         )
         lines.append(
             f"{name} ({queued_shape}): {answer['answer']} — {answer['detail']}"
@@ -219,6 +254,8 @@ def plan_report(
         answer = admission_answer(
             slices, nodes, shape, pool=pool,
             degraded_links=links, horizon_seconds=horizon_seconds,
+            compile_entries=compile_entries, libtpu_version=libtpu_version,
+            model_hash=model_hash,
         )
         lines.append(f"{answer['answer']} — {answer['detail']}")
     return "\n".join(lines) + "\n"

@@ -53,6 +53,7 @@ STATE_ORDER = [
     "state-node-status-exporter",
     "state-health-monitor",
     "state-autotuner",
+    "state-compile-cache",
 ]
 
 
@@ -161,6 +162,22 @@ def build_render_data(catalog: InfoCatalog) -> dict:
             results_configmap=consts.AUTOTUNE_RESULTS_CONFIGMAP,
             elected_label=consts.AUTOTUNE_ELECTED_LABEL,
             elected_value=consts.AUTOTUNE_ELECTED,
+        ),
+        "compile_cache": _component_data(
+            spec.compile_cache,
+            "compile_cache",
+            interval=spec.compile_cache.interval or 60,
+            chips=spec.compile_cache.chips or 4,
+            # the record-invalidation key: the libtpu image tag, the
+            # same value the compile-cache controller derives — a
+            # rolling libtpu upgrade changes it and re-compiles each
+            # generation once
+            libtpu_version=_image_tag(images.resolve("libtpu", spec.libtpu)),
+            cache_configmap=consts.COMPILE_CACHE_CONFIGMAP,
+            cache_dir=spec.compile_cache.cache_dir or consts.COMPILE_CACHE_DIR_DEFAULT,
+            cache_dir_env=consts.COMPILE_CACHE_DIR_ENV,
+            elected_label=consts.COMPILE_CACHE_ELECTED_LABEL,
+            elected_value=consts.COMPILE_CACHE_ELECTED,
         ),
         "health_dir": consts.HEALTH_DIR,
         "validator": _component_data(
@@ -312,6 +329,22 @@ class AutotunerState(ClusterPolicyState):
         return catalog.cluster_policy.spec.autotuner.is_enabled()
 
 
+class CompileCacheState(ClusterPolicyState):
+    """Persistent compile cache prewarm: a DaemonSet whose nodeSelector
+    includes the controller-managed election label, so its pod — and
+    the chips it claims via the google.com/tpu resource — exists only
+    on the one elected node per generation with unsatisfied prewarm
+    demand, for exactly the compile window. The node-local cache
+    directory (hostPath) keeps the serialized executables after the
+    pod is descheduled."""
+
+    def __init__(self):
+        super().__init__("state-compile-cache")
+
+    def is_enabled(self, catalog: InfoCatalog) -> bool:
+        return catalog.cluster_policy.spec.compile_cache.is_enabled()
+
+
 def new_cluster_policy_states() -> List[StateSkel]:
     """reference: addState x19, state_manager.go:791-810."""
     states = [
@@ -327,6 +360,7 @@ def new_cluster_policy_states() -> List[StateSkel]:
         NodeStatusExporterState(),
         HealthMonitorState(),
         AutotunerState(),
+        CompileCacheState(),
     ]
     assert [s.name for s in states] == STATE_ORDER
     return states

@@ -1,0 +1,387 @@
+"""Persistent XLA compile cache: the fleet-wide compiled-executable
+records and the warm-start vocabulary.
+
+ROADMAP item 4. A fresh serving replica pays the full XLA compile of its
+decode/prefill programs before its first token, and that cost lands
+exactly during the traffic ramp when time-to-Ready matters most. This
+module makes compilation a fleet asset with the PR 12 sweep-once /
+cache-hit / invalidate-on-upgrade discipline:
+
+  - the cache vocabulary: compiled-executable records are
+    content-addressed by (generation, topology, model descriptor hash,
+    libtpu version) in the ``tpu-compile-cache`` ConfigMap (one
+    ``<generation>.json`` data key holding the generation's record map),
+    so a second replica of an already-compiled (shape, model) never
+    pays the cold compile (``entry_valid``/``cache_record``);
+  - on real TPU, ``bind_persistent_cache`` fronts JAX's persistent
+    compilation cache directory (the actual executables live on the
+    node; the ConfigMap records that — and how long — a key compiled,
+    the same only-binds-on-TPU convention as the PR 13/15 tolerances);
+  - on the CPU sim, records carry the **measured warmup duration**, so
+    cache hit vs miss stays an observable, benchable quantity
+    (``--compile-smoke`` asserts on it) and the planning layer can
+    replay the measured cost into scale-up ETAs;
+  - the warm-start path (``CompileCacheStore.warm_start``): a serving
+    worker resolves its record before running the engine's warmup step,
+    counts the hit or miss, and on a miss publishes the measured
+    duration back — a single write-site module, so TPUOP-K K002 sees
+    exactly one writer per shared key;
+  - the prewarm handshake: the serving controller writes prewarm
+    REQUESTS under ``prewarm-requests.json`` (its key), the elected
+    agent compiles and ACKs under ``prewarm-acks.json`` (this module's
+    key) — disjoint keys, no shared-writer exception needed.
+
+jax is imported inside functions only: the module is importable
+operator-side (the compile-cache controller never compiles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.kube import errors, racecheck
+from tpu_operator.workloads.autotune import runtime_fingerprint
+
+# warm replay fraction: a persistent-cache hit still pays executable
+# deserialization + buffer donation setup, empirically ~a tenth of the
+# cold lowering it skips — the planning layer prices a warm scale-up at
+# this fraction of the recorded cold compile, never exactly zero
+WARM_FRACTION = 0.1
+
+
+def entry_key(generation: str) -> str:
+    """The ConfigMap data key one generation's record map lives under."""
+    return f"{generation}.json"
+
+
+def record_key(topology: str, model_hash: str) -> str:
+    """The content address of one compiled executable inside a
+    generation entry: topology (the shape string the replica placed as)
+    x model descriptor hash — the generation and libtpu version are the
+    entry's axes."""
+    return f"{topology or 'any'}/{model_hash}"
+
+
+def model_descriptor_hash(cfg=None) -> str:
+    """A stable content hash of the model geometry that determines the
+    compiled program (every ``ServingModelConfig`` field — a different
+    ``max_seq`` or ``int8_mlp`` is a different executable). ``None``
+    hashes the default config serving workers run."""
+    from tpu_operator.workloads.serving import ServingModelConfig
+
+    fields = dataclasses.asdict(cfg or ServingModelConfig())
+    blob = json.dumps(fields, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def parse_entry(blob: Optional[str]) -> Optional[dict]:
+    """A ``<generation>.json`` payload, or None when absent/malformed —
+    a half-written entry reads as a cache miss, never a crash."""
+    if not blob:
+        return None
+    try:
+        entry = json.loads(blob)
+    except ValueError:
+        return None
+    return entry if isinstance(entry, dict) else None
+
+
+def entry_valid(entry: Optional[dict], libtpu_version: str) -> bool:
+    """Whether a cached entry is usable under the CURRENT toolchain:
+    recorded libtpu version matching and a non-empty record map — a
+    version bump (rolling libtpu upgrade) invalidates the whole
+    generation exactly like ``tpu-autotune-results``."""
+    if not entry or entry.get("libtpu_version") != libtpu_version:
+        return False
+    records = entry.get("records")
+    return isinstance(records, dict) and bool(records)
+
+
+def cache_record(
+    entry: Optional[dict], topology: str, model_hash: str, libtpu_version: str
+) -> Optional[dict]:
+    """The compiled-executable record for one content address, or None
+    (invalid entry, wrong version, or simply never compiled)."""
+    if not entry_valid(entry, libtpu_version):
+        return None
+    record = (entry.get("records") or {}).get(record_key(topology, model_hash))
+    return record if isinstance(record, dict) else None
+
+
+def cached_entries(cm_data: Optional[dict]) -> Dict[str, dict]:
+    """Every parseable per-generation entry in a compile-cache data map:
+    {generation: entry} for each ``<gen>.json`` key (the handshake keys
+    excluded), half-written blobs skipped."""
+    skip = (consts.COMPILE_PREWARM_REQUEST_KEY, consts.COMPILE_PREWARM_ACK_KEY)
+    out: Dict[str, dict] = {}
+    for key, blob in (cm_data or {}).items():
+        if not key.endswith(".json") or key in skip:
+            continue
+        parsed = parse_entry(blob)
+        if parsed is not None:
+            out[key[: -len(".json")]] = parsed
+    return out
+
+
+def parse_requests(blob: Optional[str]) -> Dict[str, dict]:
+    """The prewarm request map ({request id: request}), {} on
+    absent/malformed — a torn handshake key never crashes a reconcile."""
+    parsed = parse_entry(blob)
+    requests = (parsed or {}).get("requests")
+    if not isinstance(requests, dict):
+        return {}
+    return {k: v for k, v in requests.items() if isinstance(v, dict)}
+
+
+def request_id(generation: str, topology: str, model_hash: str) -> str:
+    return f"{generation}/{record_key(topology, model_hash)}"
+
+
+def bind_persistent_cache(cache_dir: Optional[str] = None) -> bool:
+    """On real TPU, front JAX's persistent compilation cache: point
+    ``jax_compilation_cache_dir`` at the node-local cache directory (the
+    DaemonSet hostPath) so every lowered executable is serialized once
+    per node and every later process deserializes it. Off TPU this is a
+    no-op returning False — the CPU sim replays *measured durations*
+    instead of real executables (same convention as the PR 13/15
+    platform-scaled tolerances)."""
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return False
+        path = (
+            cache_dir
+            or os.environ.get(consts.COMPILE_CACHE_DIR_ENV, "").strip()
+            or consts.COMPILE_CACHE_DIR_DEFAULT
+        )
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 — cache binding must never break serving
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# In-process hit/miss accounting (read by must-gather and the bench).
+# ---------------------------------------------------------------------------
+
+_stats_lock = racecheck.lock("compilecache.stats")
+_HITS: Dict[str, int] = {}
+_MISSES: Dict[str, int] = {}
+_DECISIONS: list = []  # last prewarm/warm-start decisions, bounded
+_DECISIONS_LIMIT = 20
+
+
+def _note(outcome: str, generation: str, detail: str) -> None:
+    with _stats_lock:
+        if outcome == "hit":
+            _HITS[generation] = _HITS.get(generation, 0) + 1
+        elif outcome == "miss":
+            _MISSES[generation] = _MISSES.get(generation, 0) + 1
+        _DECISIONS.append({"outcome": outcome, "generation": generation,
+                           "detail": detail})
+        del _DECISIONS[:-_DECISIONS_LIMIT]
+
+
+def stats() -> dict:
+    """A snapshot of this process's cache traffic: per-generation hit /
+    miss counters and the last warm-start/prewarm decisions (the
+    must-gather ``compile-cache.txt`` source)."""
+    with _stats_lock:
+        return {
+            "hits": dict(_HITS),
+            "misses": dict(_MISSES),
+            "decisions": list(_DECISIONS),
+        }
+
+
+def reset_stats() -> None:
+    """Test/bench hook: forget this process's counters."""
+    with _stats_lock:
+        _HITS.clear()
+        _MISSES.clear()
+        del _DECISIONS[:]
+
+
+# ---------------------------------------------------------------------------
+# The store: resolve / publish / ack against the shared ConfigMap.
+# ---------------------------------------------------------------------------
+
+
+class CompileCacheStore:
+    """One namespace's view of the ``tpu-compile-cache`` ConfigMap: the
+    worker- and agent-side read/resolve/publish path. All ConfigMap
+    writes (record publication and prewarm acks) live HERE, so the
+    TPUOP-K writer-ownership inventory sees one writer module per key."""
+
+    def __init__(self, client=None, namespace: str = "", libtpu_version: str = ""):
+        self.client = client
+        self.namespace = namespace
+        self.libtpu_version = libtpu_version or runtime_fingerprint()
+
+    # -- reads ------------------------------------------------------------
+
+    def read_data(self) -> Optional[dict]:
+        """The cache CM's data map; {} when the CM does not exist yet,
+        None when the API is unreachable — callers gating actions on the
+        cache must treat None as 'unknown', never as 'empty' (K003)."""
+        if self.client is None:
+            return {}
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError:
+            return None
+        return (cm or {}).get("data") or {}
+
+    def resolve(self, generation: str, topology: str, model_hash: str) -> Optional[dict]:
+        """The record for one content address, counting the hit or miss
+        (an unreadable API counts as a miss here — the worker just
+        compiles, which is safe, merely cold)."""
+        data = self.read_data() or {}
+        entry = parse_entry(data.get(entry_key(generation)))
+        record = cache_record(entry, topology, model_hash, self.libtpu_version)
+        key = record_key(topology, model_hash)
+        _note("hit" if record else "miss", generation, key)
+        return record
+
+    # -- writes (the module's single write site) ---------------------------
+
+    def _merge(self, data: Dict[str, str]) -> None:
+        """Merge-patch data keys into the cache CM, creating it on first
+        use (the autotune agent's patch -> create -> AlreadyExists ->
+        patch idiom)."""
+        from tpu_operator.kube.objects import new_object
+
+        body = {"data": data}
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, body,
+                self.namespace,
+            )
+        except errors.NotFound:
+            cm = new_object(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP,
+                self.namespace, labels={"app": "tpu-compile-cache"},
+                data=dict(data),
+            )
+            try:
+                self.client.create(cm)
+                return
+            except errors.AlreadyExists:
+                pass  # a concurrent publisher won the race
+            self.client.patch(
+                "v1", "ConfigMap", consts.COMPILE_CACHE_CONFIGMAP, body,
+                self.namespace,
+            )
+
+    def publish(
+        self,
+        generation: str,
+        topology: str,
+        model_hash: str,
+        seconds: float,
+        source: str = "worker",
+        serving: str = "",
+        node: str = "",
+    ) -> dict:
+        """Record one measured compile: read-modify-write the
+        generation's entry (records under other content addresses are
+        kept when still valid for this toolchain; an invalid entry is
+        replaced wholesale — that IS the invalidation)."""
+        if self.client is None:
+            raise RuntimeError("compile-cache publish requires a client")
+        data = self.read_data() or {}
+        entry = parse_entry(data.get(entry_key(generation)))
+        if not entry_valid(entry, self.libtpu_version):
+            entry = {
+                "generation": generation,
+                "libtpu_version": self.libtpu_version,
+                "records": {},
+            }
+        record = {
+            "seconds": round(max(0.0, float(seconds)), 4),
+            "source": source,
+            "serving": serving,
+            "node": node,
+        }
+        entry["records"][record_key(topology, model_hash)] = record
+        self._merge({entry_key(generation): json.dumps(entry, sort_keys=True)})
+        return record
+
+    def ack(self, rid: str, node: str, seconds: float, outcome: str) -> None:
+        """Publish one prewarm ack (the agent's half of the handshake —
+        the serving controller clears its request once the record shows
+        up; the ack is the audit trail must-gather collects)."""
+        data = self.read_data() or {}
+        parsed = parse_entry(data.get(consts.COMPILE_PREWARM_ACK_KEY)) or {}
+        acks = parsed.get("acks")
+        if not isinstance(acks, dict):
+            acks = {}
+        acks[rid] = {
+            "node": node,
+            "seconds": round(max(0.0, float(seconds)), 4),
+            "outcome": outcome,
+        }
+        self._merge({consts.COMPILE_PREWARM_ACK_KEY: json.dumps(
+            {"acks": acks}, sort_keys=True)})
+
+    # -- the worker warm-start path ---------------------------------------
+
+    def warm_start(
+        self,
+        engine,
+        generation: str,
+        topology: str,
+        serving: str = "",
+        prompt_len: Optional[int] = None,
+        node: str = "",
+    ) -> Tuple[str, float]:
+        """Run an engine's warmup step through the cache: resolve the
+        record first (hit/miss is counted and observable), bind the
+        persistent cache on real TPU so a hit deserializes instead of
+        re-lowering, run the warmup, and on a miss publish the measured
+        duration so the NEXT replica of this key starts warm. Returns
+        (outcome, measured warmup seconds); outcome is "hit", "miss" or
+        "unkeyed" (no generation — cache skipped entirely)."""
+        cfg = engine.cfg
+        if prompt_len is None:
+            prompt_len = min(cfg.prefill_chunk, cfg.max_seq // 4)
+        if not generation:
+            t0 = time.perf_counter()
+            engine.warmup(prompt_len)
+            return "unkeyed", time.perf_counter() - t0
+        model_hash = model_descriptor_hash(cfg)
+        record = self.resolve(generation, topology, model_hash)
+        bound = bind_persistent_cache()
+        t0 = time.perf_counter()
+        engine.warmup(prompt_len)
+        seconds = time.perf_counter() - t0
+        if record is not None:
+            if not bound:
+                # CPU sim: there is no executable store to deserialize
+                # from, so the hit replays the recorded cold cost at the
+                # warm fraction (the measured wall clock here re-lowered
+                # everything a real hit would skip); real TPU returns
+                # the genuinely-measured deserialize-and-run time
+                recorded = record.get("seconds")
+                if isinstance(recorded, (int, float)) and recorded > 0.0:
+                    seconds = min(seconds, round(recorded * WARM_FRACTION, 4))
+            return "hit", seconds
+        if self.client is not None:
+            try:
+                self.publish(
+                    generation, topology, model_hash, seconds,
+                    source="worker", serving=serving, node=node,
+                )
+            except errors.ApiError:
+                pass  # publication is best-effort; the compile happened
+        return "miss", seconds

@@ -283,6 +283,10 @@ class DecodeEngine:
         self.slots: Dict[int, _SlotState] = {}
         self.completed: List[ServingRequest] = []
         self.recorder = StepTimeRecorder()
+        # the warmup (compile) step gets its OWN recorder series: compile
+        # is quarantined out of the step-time percentiles above, but the
+        # cost is real — the compile-cache layer reads it back here
+        self.warmup_recorder = StepTimeRecorder()
         self.steps = 0
         self.decoded_tokens = 0
         self.evictions = 0
@@ -811,9 +815,15 @@ class DecodeEngine:
         (all-masked lanes: every write lands on the scratch page, so the
         live pools are untouched). A serving process compiles once at
         boot; folding XLA compile into a load-curve measurement would
-        poison both engines equally but dilute the batching signal."""
+        poison both engines equally but dilute the batching signal. The
+        whole step is recorded on ``warmup_recorder`` — warmup duration
+        is the compile-cache layer's hit-vs-miss observable."""
         import jax.numpy as jnp
 
+        with self.warmup_recorder.step():
+            self._warmup_body(prompt_len, jnp)
+
+    def _warmup_body(self, prompt_len: int, jnp) -> None:
         c = self.cfg
         self._decode_fn(
             self.params, self._pool_k, self._pool_v,
@@ -837,6 +847,12 @@ class DecodeEngine:
         self._gather_pages(
             self._pool_k, jnp.zeros((npages,), jnp.int32)
         ).block_until_ready()
+
+    @property
+    def warmup_seconds(self) -> Optional[float]:
+        """Total measured warmup (compile) time, None before warmup."""
+        durations = self.warmup_recorder._durations
+        return sum(durations) if durations else None
 
     # -- draining ------------------------------------------------------------
 
@@ -887,6 +903,8 @@ class DecodeEngine:
             "handoff_bytes": self.handoff_bytes,
             "imported_bytes": self.imported_bytes,
         }
+        if self.warmup_seconds is not None:
+            out["warmup_s"] = round(self.warmup_seconds, 4)
         if self.steps >= 2:
             rec = self.recorder.report()
             out["step_p50_s"] = rec.step_p50_s
