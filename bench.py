@@ -647,6 +647,15 @@ def _compact_summary(out: dict) -> dict:
         "compile_cache_hit_ratio": out.get("compile", {}).get(
             "compile_cache_hit_ratio"
         ),
+        "predict_planned_lost_steps": out.get("predict", {}).get(
+            "planned_lost_steps"
+        ),
+        "predict_unplanned_lost_steps": out.get("predict", {}).get(
+            "unplanned_lost_steps"
+        ),
+        "predict_false_positive_migrations": out.get("predict", {}).get(
+            "false_positive_migrations"
+        ),
         "scale_64node_s": out.get("scale_64node_s"),
         "scale_256node_s": out.get("scale_256node_s"),
         "scale_1024node_s": out.get("scale_1024node_s"),
@@ -3310,6 +3319,382 @@ def defrag_smoke() -> int:
     return 0 if ok else 1
 
 
+def _predict_training_run(predictive: bool, seed: int = 20260807) -> dict:
+    """One seeded host-death-with-precursors run of a real TPUJob (pod
+    data plane, real trainers), with the risk scorer either driven
+    (``predictive=True``) or absent. Same seed → same schedule → the
+    SAME pre-chosen victim and kill pass either way, so the pair
+    isolates exactly what prediction buys: the planned checkpoint-
+    barrier migration walks the gang off the dying host for zero lost
+    steps, while the reactive run rewinds to the last cadence
+    checkpoint."""
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.tpujob import JobPhase, new_tpu_job
+    from tpu_operator.controllers.job_controller import JobReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.controllers.risk import RiskScorer
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import GangFaultSchedule, PodKubelet, make_torus_nodes
+    from tpu_operator.workloads.training import verify_continuity
+
+    ns = "tpu-operator"
+    client = FakeClient()
+    for node in make_torus_nodes((2, 2, 2), prefix="bench-pr"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    store_dir = tempfile.mkdtemp(prefix="bench-predict-")
+    client.create(new_tpu_job("pred-job", {
+        "workload": {"steps": 120},
+        "gang": {"shape": "2x2x1", "minShape": "1x1x1"},
+        "checkpoint": {"everySteps": 10, "dir": store_dir},
+        "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05, "retryLimit": 10},
+    }))
+    job_rec = JobReconciler(client, ns)
+    place_rec = PlacementReconciler(client, ns)
+    kubelet = PodKubelet(client, ns)
+    # kill at pass 16, precursors (rising straggler ratio naming the
+    # pre-chosen victim) over passes 8..15 — window enough for score to
+    # cross threshold AND the barrier round-trip to land before the kill
+    schedule = GangFaultSchedule(
+        client, ns, "pred-job-slice", seed=seed, classes=("host-death",),
+        start_at=16, every=10, heal_after=4, precursor_passes=8,
+    )
+    risk = RiskScorer(client, ns)
+    clock = [0.0]
+    risk._now = lambda: clock[0]
+    phases_seen = set()
+    passes = 0
+    block: dict = {}
+    for passes in range(1, 400):
+        job_rec.reconcile(Request(name="pred-job"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        kubelet.step()
+        schedule.step()
+        if predictive:
+            # 10 s/pass: the kill lands ~7 passes after the planned
+            # migration, INSIDE the settle grace window, so the
+            # prediction books realized=true instead of false-alarming
+            clock[0] += 10.0
+            risk.sync()
+        job = client.get("tpu.google.com/v1alpha1", "TPUJob", "pred-job")
+        block = (job.get("status") or {}).get("job") or {}
+        phases_seen.add(block.get("phase"))
+        if block.get("phase") == JobPhase.SUCCEEDED:
+            break
+    trainers = kubelet.job_trainers("pred-job")
+    kubelet.stop()
+    history = [h for t in trainers for h in t.history]
+    checkpoints = [c for t in trainers for c in t.checkpoints]
+    total_steps = trainers[-1].total_steps if trainers else 120
+    report = verify_continuity(history, checkpoints, total_steps)
+    executed = [h["step"] for h in history]
+    victim = next(
+        (r[3] for r in schedule.log if r[1] == "inject" and r[2] == "host-death"),
+        "",
+    )
+    migrations = []
+    if predictive:
+        from tpu_operator.controllers.risk import read_node_risk  # noqa: F401
+
+        cm = client.get_or_none("v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, ns)
+        raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY, "")
+        try:
+            migrations = (json.loads(raw) or {}).get("migrations", [])
+        except ValueError:
+            migrations = []
+    return {
+        "predictive": predictive,
+        "seed": seed,
+        "phase": block.get("phase"),
+        "passes": passes,
+        "lost_steps": len(executed) - len(set(executed)),
+        "continuity_ok": report["ok"],
+        "failed_seen": "Failed" in phases_seen,
+        "premigrated": bool(block.get("riskHandled")),
+        "victim": victim,
+        "kill_pass": next(
+            (r[0] for r in schedule.log if r[1] == "inject"), None
+        ),
+        "pod_generations": len(trainers),
+        "migrations": migrations,
+    }
+
+
+def _predict_false_alarm_run(seed: int = 20260807) -> dict:
+    """The governance leg: a seeded precursor window with NO kill
+    behind it (``false_alarm_at``). The scorer may migrate the gang at
+    most ONCE (the budget's nextAttemptAt gate), must settle the
+    prediction ``realized=false`` once the risk subsides past the grace
+    window, release the host's budget — and the job must never see a
+    Failed transition."""
+    import tempfile
+
+    from tpu_operator import consts
+    from tpu_operator.api.tpujob import new_tpu_job
+    from tpu_operator.controllers.job_controller import JobReconciler
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.controllers.risk import RiskScorer
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.sim import GangFaultSchedule, PodKubelet, make_torus_nodes
+
+    ns = "tpu-operator"
+    client = FakeClient()
+    for node in make_torus_nodes((2, 2, 2), prefix="bench-fa"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    store_dir = tempfile.mkdtemp(prefix="bench-falarm-")
+    client.create(new_tpu_job("fa-job", {
+        "workload": {"steps": 400},
+        "gang": {"shape": "2x2x1", "minShape": "1x1x1"},
+        "checkpoint": {"everySteps": 10, "dir": store_dir},
+        "backoff": {"baseSeconds": 0.01, "maxSeconds": 0.05, "retryLimit": 10},
+    }))
+    job_rec = JobReconciler(client, ns)
+    place_rec = PlacementReconciler(client, ns)
+    kubelet = PodKubelet(client, ns)
+    schedule = GangFaultSchedule(
+        client, ns, "fa-job-slice", seed=seed + 1, classes=(),
+        precursor_passes=6, false_alarm_at=[6],
+    )
+    risk = RiskScorer(client, ns)
+    clock = [0.0]
+    risk._now = lambda: clock[0]
+    phases_seen = set()
+    for _ in range(30):
+        job_rec.reconcile(Request(name="fa-job"))
+        place_rec.reconcile(QUEUE_REQUEST)
+        kubelet.step()
+        schedule.step()
+        clock[0] += 30.0
+        risk.sync()
+        job = client.get("tpu.google.com/v1alpha1", "TPUJob", "fa-job")
+        block = (job.get("status") or {}).get("job") or {}
+        phases_seen.add(block.get("phase"))
+    kubelet.stop()
+    cm = client.get_or_none("v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, ns)
+    raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY, "")
+    try:
+        state = json.loads(raw) or {}
+    except ValueError:
+        state = {}
+    migrations = state.get("migrations", [])
+    false_positives = [
+        m for m in migrations if m.get("settled") and m.get("realized") is False
+    ]
+    budget_entries = {
+        h: e for h, e in (state.get("hosts") or {}).items()
+        if e.get("attempts") or e.get("nextAttemptAt")
+    }
+    return {
+        "migrations": len(migrations),
+        "false_positives": len(false_positives),
+        "settled": all(m.get("settled") for m in migrations),
+        "budget_released": not budget_entries,
+        "failed_seen": "Failed" in phases_seen,
+    }
+
+
+def _predict_serving_drain() -> dict:
+    """The serving half: a risky host under one replica takes the PR 14
+    drain-then-re-place path — the replica re-seats AWAY from the risky
+    host (the engine's risk-aware scorer) and the serving keeps at
+    least one ready replica through the whole window."""
+    from tpu_operator import consts
+    from tpu_operator.api.tpuserving import new_tpu_serving
+    from tpu_operator.controllers.placement_controller import (
+        QUEUE_REQUEST,
+        PlacementReconciler,
+    )
+    from tpu_operator.controllers.risk import RiskScorer
+    from tpu_operator.controllers.serving_controller import ServingReconciler
+    from tpu_operator.kube.controller import Request
+    from tpu_operator.kube.fake import FakeClient
+    from tpu_operator.kube.objects import new_object
+    from tpu_operator.kube.sim import make_torus_nodes
+
+    ns = "tpu-operator"
+    client = FakeClient()
+    for node in make_torus_nodes((4, 2, 1), prefix="bench-rs"):
+        node["metadata"]["labels"]["tpu.google.com/tpu.present"] = "true"
+        client.create(node)
+    client.create(new_tpu_serving("risk-svc", {
+        "model": {"shape": "2x1x1"},
+        "replicas": {"min": 2, "max": 2, "targetRps": 10.0,
+                     "cooldownSeconds": 0.0},
+        "backoff": {"baseSeconds": 0.0, "maxSeconds": 0.0, "retryLimit": 5},
+    }))
+    rec = ServingReconciler(client, ns)
+    place = PlacementReconciler(client, ns)
+    req = Request(name="risk-svc")
+    risk = RiskScorer(client, ns)
+    clock = [0.0]
+    risk._now = lambda: clock[0]
+
+    def block() -> dict:
+        obj = client.get("tpu.google.com/v1alpha1", "TPUServing", "risk-svc")
+        return (obj.get("status") or {}).get("serving") or {}
+
+    for _ in range(8):
+        rec.reconcile(req)
+        place.reconcile(QUEUE_REQUEST)
+        if block().get("ready") == 2:
+            break
+    placed_before = dict(block())
+    replicas = sorted(placed_before.get("replicas") or {})
+    target = replicas[0] if replicas else ""
+    members = []
+    if target:
+        obj = client.get("tpu.google.com/v1alpha1", "TPUSlice", target)
+        members = ((obj.get("status") or {}).get("placement") or {}).get("nodes") or []
+    risky_host = members[0] if members else ""
+    if risky_host:
+        # a straggler artifact naming the replica's host: the risk
+        # scorer's job, not the schedule's — serving gangs have no
+        # trainer loop, so the precursor is seeded directly
+        artifact = json.dumps({
+            "hosts": len(members), "gang_step_p50_s": 1.0,
+            "straggler_ratio": 2.0, "slowest_host": risky_host,
+        })
+        try:
+            client.create(new_object("v1", "ConfigMap", f"{target}-gang", ns))
+        except Exception:  # noqa: BLE001 — exists already
+            pass
+        client.patch(
+            "v1", "ConfigMap", f"{target}-gang",
+            {"metadata": {
+                "labels": {"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+                "annotations": {consts.GANG_TELEMETRY_ANNOTATION: artifact},
+            }}, ns,
+        )
+    min_ready = 2
+    drained = False
+    for _ in range(12):
+        clock[0] += 30.0
+        risk.sync()
+        rec.reconcile(req)
+        place.reconcile(QUEUE_REQUEST)
+        ready = int(block().get("ready") or 0)
+        min_ready = min(min_ready, ready)
+        cm = client.get_or_none("v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, ns)
+        raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY, "")
+        try:
+            drained = drained or bool((json.loads(raw) or {}).get("migrations"))
+        except ValueError:
+            pass
+        if drained and ready == 2:
+            break
+    after = dict(block())
+    final_nodes = []
+    if target:
+        obj = client.get("tpu.google.com/v1alpha1", "TPUSlice", target)
+        final_nodes = ((obj.get("status") or {}).get("placement") or {}).get("nodes") or []
+    return {
+        "risky_host": risky_host,
+        "drained": drained,
+        "ready_before": placed_before.get("ready"),
+        "ready_after": after.get("ready"),
+        "min_ready_during_drain": min_ready,
+        "replica_nodes_after": final_nodes,
+        "re_placed_off_risky_host": bool(final_nodes) and risky_host not in final_nodes,
+    }
+
+
+def bench_predict(seed: int = 20260807) -> dict:
+    """Predictive health (ISSUE 19): the planned-vs-unplanned pair on
+    the SAME seeded host-death schedule (the measurable win), the
+    false-alarm governance leg, and the serving drain leg."""
+    planned = _predict_training_run(True, seed)
+    unplanned = _predict_training_run(False, seed)
+    false_alarm = _predict_false_alarm_run(seed)
+    serving = _predict_serving_drain()
+    return {
+        "seed": seed,
+        "planned": planned,
+        "unplanned": unplanned,
+        "false_alarm": false_alarm,
+        "serving": serving,
+        "planned_lost_steps": planned["lost_steps"],
+        "unplanned_lost_steps": unplanned["lost_steps"],
+        "false_positive_migrations": false_alarm["false_positives"],
+    }
+
+
+def predict_smoke() -> int:
+    """CI gate (scripts/ci.sh): predictive health end to end —
+
+    1. on the same seeded schedule (same pre-chosen victim, same kill
+       pass) the predictive run walks the job off the dying host behind
+       the checkpoint barrier for ZERO lost steps, while the reactive
+       run rewinds to the last cadence checkpoint (>= 1 lost);
+    2. the prediction is booked realized=true in the state CM;
+    3. a seeded false alarm triggers at most ONE budget-gated migration,
+       settles realized=false, releases the budget, and never drives the
+       job through Failed;
+    4. a risky serving host drains via the PR 14 path without the
+       serving ever dropping below one ready replica, and the replica
+       re-seats off the risky host.
+
+    ci.sh runs the gate twice — plain and TPUOP_RACECHECK=1."""
+    result = bench_predict()
+    planned, unplanned = result["planned"], result["unplanned"]
+    fa, serving = result["false_alarm"], result["serving"]
+    checks = {
+        "planned_succeeded": planned["phase"] == "Succeeded",
+        "planned_zero_lost_steps": planned["lost_steps"] == 0,
+        "planned_continuity_ok": planned["continuity_ok"],
+        "job_premigrated": planned["premigrated"],
+        "planned_never_failed": not planned["failed_seen"],
+        "prediction_realized": any(
+            m.get("settled") and m.get("realized") is True
+            for m in planned["migrations"]
+        ),
+        "same_schedule": (
+            bool(planned["victim"])
+            and planned["victim"] == unplanned["victim"]
+            and planned["kill_pass"] == unplanned["kill_pass"]
+        ),
+        "unplanned_succeeded": unplanned["phase"] == "Succeeded",
+        "unplanned_lost_steps": unplanned["lost_steps"] >= 1,
+        "false_alarm_at_most_one_migration": fa["migrations"] <= 1,
+        "false_alarm_settled_unrealized": fa["migrations"] == fa["false_positives"],
+        "false_alarm_budget_released": fa["budget_released"],
+        "false_alarm_never_failed": not fa["failed_seen"],
+        "serving_drained": serving["drained"],
+        "serving_never_unroutable": serving["min_ready_during_drain"] >= 1,
+        "serving_re_placed_off_risky_host": serving["re_placed_off_risky_host"],
+    }
+    violations = []
+    if os.environ.get("TPUOP_RACECHECK") == "1":
+        from tpu_operator.kube import racecheck
+
+        violations = [repr(v) for v in racecheck.violations()]
+    checks["racecheck_clean"] = not violations
+    ok = all(checks.values())
+    print(json.dumps({
+        "metric": "predict_smoke",
+        "ok": ok,
+        "checks": checks,
+        "planned_lost_steps": result["planned_lost_steps"],
+        "unplanned_lost_steps": result["unplanned_lost_steps"],
+        "false_positive_migrations": result["false_positive_migrations"],
+        "victim": planned["victim"],
+        "kill_pass": planned["kill_pass"],
+        "serving": serving,
+        "racecheck_violations": violations,
+    }, separators=(",", ":")))
+    return 0 if ok else 1
+
+
 def placement_smoke() -> int:
     """CI gate (scripts/ci.sh): a full place/evict/re-place churn on the
     simulated 512-host torus must finish inside the budget with zero
@@ -3353,6 +3738,8 @@ def main() -> None:
         raise SystemExit(defrag_smoke())
     if "--compile-smoke" in sys.argv[1:]:
         raise SystemExit(compile_smoke())
+    if "--predict-smoke" in sys.argv[1:]:
+        raise SystemExit(predict_smoke())
     runs = [bench_install_to_ready() for _ in range(3)]
     value = statistics.median(runs)
     http_runs = [bench_install_to_ready(transport="http") for _ in range(3)]
@@ -3471,6 +3858,13 @@ def main() -> None:
         compile_cache = compile_block()
     except Exception as e:  # noqa: BLE001 — same isolation as chaos
         compile_cache = {"error": f"{type(e).__name__}: {e}"}
+    # predictive health: planned-vs-unplanned lost steps on the same
+    # seeded precursor schedule + false-alarm governance (gated by
+    # --predict-smoke)
+    try:
+        predict = bench_predict()
+    except Exception as e:  # noqa: BLE001 — same isolation as chaos
+        predict = {"error": f"{type(e).__name__}: {e}"}
     out = {
         "metric": "clusterpolicy_install_to_ready",
         "value": round(value, 3),
@@ -3507,6 +3901,7 @@ def main() -> None:
         "pods": pods,
         "fleet_sim": fleet_sim,
         "compile": compile_cache,
+        "predict": predict,
         "details": details,
     }
     detail_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")

@@ -133,6 +133,17 @@ echo "== compile smoke: warm scale-ups via the fleet compile cache =="
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --compile-smoke
 echo "== compile smoke (racecheck leg): the same gate under instrumented locks =="
 TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --compile-smoke
+echo "== predict smoke: risk-scored host walked off before it dies =="
+# predictive-health gate: on the SAME seeded host-death schedule (same
+# pre-chosen victim, same kill pass) the risk scorer's planned
+# checkpoint-barrier migration must lose ZERO steps while the reactive
+# run rewinds to the last cadence checkpoint; a seeded false alarm may
+# trigger at most ONE budget-gated migration, settles realized=false
+# and releases the budget; a risky serving host drains without the
+# serving ever dropping below one ready replica
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --predict-smoke
+echo "== predict smoke (racecheck leg): the same gate under instrumented locks =="
+TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --predict-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

@@ -739,6 +739,85 @@ def _run_soak(nodes, director, ready_timeout, client_kw=None):
             return sum(1 for w in routing.values() if w > 0) == 2
 
         obs["serving_recovered"] = wait_for(serving_recovered, timeout=45.0)
+
+        # predictive-era leg: a SCHEDULED host death announced by a
+        # precursor window (rising straggler telemetry on the eventual
+        # victim). The risk scorer must walk the soak job off the dying
+        # host BEFORE the kill lands — checkpoint-barrier migration, the
+        # same machinery a defrag move uses — and the kill then hits a
+        # host the gang already left. Runs admin-side against the store
+        # (like the serving kill above) so the chaos director's seeded
+        # draw sequence is untouched.
+        from tpu_operator.controllers.risk import RiskScorer
+        from tpu_operator.kube.sim import GangFaultSchedule
+
+        sched = GangFaultSchedule(
+            store, NS, "soak-job-slice", seed=20260807,
+            classes=("host-death",), start_at=8, every=1000, heal_after=4,
+            precursor_passes=6,
+        )
+        risk = RiskScorer(store, NS)
+        progress_name = "soak-job" + _consts.JOB_PROGRESS_SUFFIX
+
+        def trainer_tick():
+            # minimal data-plane stand-in: publish running progress and
+            # echo any checkpoint-barrier token (the soak has no real
+            # runners; the controllers provide everything else)
+            cm = store.get_or_none("v1", "ConfigMap", progress_name, NS)
+            if cm is None:
+                from tpu_operator.kube.objects import new_object
+                store.create(new_object("v1", "ConfigMap", progress_name, NS, data={}))
+                cm = store.get("v1", "ConfigMap", progress_name, NS)
+            nodes_now = _replica_nodes("soak-job-slice")
+            data = {
+                _consts.JOB_PROGRESS_STEP: "42",
+                _consts.JOB_PROGRESS_CHECKPOINT_STEP: "40",
+                _consts.JOB_PROGRESS_EPOCH: "4",
+                _consts.JOB_PROGRESS_WORLD: str(len(nodes_now)),
+                _consts.JOB_PROGRESS_STATUS: _consts.JOB_PROGRESS_RUNNING,
+            }
+            request = (cm.get("data") or {}).get(_consts.JOB_CHECKPOINT_REQUEST, "")
+            if request:
+                data[_consts.JOB_PROGRESS_CHECKPOINT_ACK] = request
+            store.patch("v1", "ConfigMap", progress_name, {"data": data}, NS)
+
+        def _job_block() -> dict:
+            job = store.get_or_none("tpu.google.com/v1alpha1", "TPUJob", "soak-job")
+            return ((job or {}).get("status") or {}).get("job") or {}
+
+        # open the precursor window (passes 0..7; the kill lands on 8)
+        for _ in range(8):
+            sched.step()
+            trainer_tick()
+            risk.sync()
+            time.sleep(0.15)
+
+        def premigrated():
+            trainer_tick()
+            risk.sync()
+            return str(_job_block().get("riskHandled") or "").startswith("risk-")
+
+        obs["job_premigrated"] = wait_for(premigrated, timeout=30.0, interval=0.1)
+        gang_before_kill = set(_replica_nodes("soak-job-slice"))
+        sched.step()  # the predicted death fires — on the PRE-CHOSEN host
+        kills = [e for e in sched.log if e[1] == "inject"]
+        obs["predicted_kill_fired"] = len(kills) == 1
+        victim = kills[0][3] if kills else ""
+        obs["job_walked_off_before_kill"] = bool(victim) and victim not in gang_before_kill
+
+        def job_healthy_after_kill():
+            trainer_tick()
+            block = _job_block()
+            if block.get("phase") == "Failed":
+                return False
+            return (
+                block.get("phase") == "Running"
+                and victim not in _replica_nodes("soak-job-slice")
+            )
+
+        obs["job_survived_predicted_death"] = wait_for(
+            job_healthy_after_kill, timeout=30.0, interval=0.1
+        )
         cp = store.get(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, "cluster-policy")
         obs["cp_uid"] = cp["metadata"]["uid"]
         obs["store"] = store
@@ -757,7 +836,10 @@ class TestChaosSoak:
         bursts, 410s, resets, a watch drop every 2s, one 3s full outage
         — and the install must come out Ready with the Degraded
         condition having been set and then cleared, no stuck queue
-        items, and every configured fault class actually fired."""
+        items, and every configured fault class actually fired. The
+        predictive-era rider then schedules a host death WITH a
+        precursor window: the job must walk off the dying host before
+        the kill (job_premigrated) and stay healthy through it."""
         director = ChaosDirector.standard(
             seed=20260818, outage_at=2.0, outage_duration=3.0, watch_drop_every=2.0,
             rate_scale=2.0,
@@ -775,6 +857,16 @@ class TestChaosSoak:
         assert obs["serving_recovered"], (
             "the broken serving replica never re-placed + re-routed after the kill"
         )
+        assert obs["job_premigrated"], (
+            "the risk scorer never migrated the job ahead of the scheduled death"
+        )
+        assert obs["predicted_kill_fired"], "the scheduled host death never landed"
+        assert obs["job_walked_off_before_kill"], (
+            "the kill still found the gang on the predicted host"
+        )
+        assert obs["job_survived_predicted_death"], (
+            "the job did not come back Running off the dead host"
+        )
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])
@@ -791,7 +883,9 @@ class TestChaosSoak:
         Re-seeded for the serving-era mix: the placement + job + serving
         controllers now ride the soak, an elastic job places its gang
         through the schedule, and a TPUServing survives a replica's host
-        dying mid-schedule.)"""
+        dying mid-schedule. The predictive-era rider adds a scheduled
+        host death with a precursor window: the job pre-migrates behind
+        the checkpoint barrier and the kill lands on an empty host.)"""
         director = ChaosDirector.standard(seed=20260818, outage_at=8.0, outage_duration=30.0)
         obs = _run_soak(nodes=256, director=director, ready_timeout=240.0)
         assert obs["became_ready"], "256-node install never Ready under chaos"
@@ -802,6 +896,9 @@ class TestChaosSoak:
         assert obs["serving_recovered"], (
             "the broken serving replica never re-placed + re-routed after the kill"
         )
+        assert obs["job_premigrated"] and obs["predicted_kill_fired"], obs
+        assert obs["job_walked_off_before_kill"], obs
+        assert obs["job_survived_predicted_death"], obs
         missed = director.configured_classes() - director.fired_classes()
         assert not missed, f"configured fault classes never fired: {missed}"
         _assert_no_orphans(obs["store"], obs["cp_uid"])

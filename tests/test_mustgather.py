@@ -162,6 +162,13 @@ def test_bundle_from_live_install(tmp_path):
         assert "# pools" in plan_txt
         assert "# defrag decisions" in plan_txt
         assert "# admission what-ifs" in plan_txt
+        # the predictive-health view: per-host risk scores (empty on
+        # this healthy install — the section must still exist so
+        # support can trust absence) + the planned-migration ledger
+        risk_txt = (tmp_path / "risk.txt").read_text()
+        assert "# per-host risk" in risk_txt
+        assert "# none at risk" in risk_txt
+        assert "planned migrations" in risk_txt
         # the data-plane telemetry view: fleet perf rollup + the
         # operator-published floor table (rendered by pre-requisites in
         # this live install) + gang artifacts section

@@ -581,6 +581,37 @@ class TestEngine:
             delta = plan.label_deltas[node["metadata"]["name"]]
             assert delta[consts.PLACEMENT_LABEL] is None
 
+    def test_risk_scores_steer_placement_off_hazardous_hosts(self):
+        nodes = make_torus_nodes((4, 2, 1))
+        slices = [placement_slice("g", "2x2x1", created="2026-01-01T00:00:01Z")]
+        baseline = PlacementEngine(slices, nodes).plan()
+        risky = baseline.statuses["g"]["nodes"][0]
+        plan = PlacementEngine(
+            slices, nodes, node_risk={risky: 0.9}
+        ).plan()
+        assert plan.statuses["g"]["phase"] == PlacementPhase.SCHEDULED
+        assert risky not in plan.statuses["g"]["nodes"]
+        assert_no_double_booking(plan.statuses, nodes)
+
+    def test_risk_is_a_bias_not_a_gate(self):
+        # every host risky: the shape still lands (advisory, never blocks)
+        nodes = make_torus_nodes((2, 2, 1))
+        risk = {n["metadata"]["name"]: 1.0 for n in nodes}
+        slices = [placement_slice("g", "2x2x1", created="2026-01-01T00:00:01Z")]
+        plan = PlacementEngine(slices, nodes, node_risk=risk).plan()
+        assert plan.statuses["g"]["phase"] == PlacementPhase.SCHEDULED
+
+    def test_empty_risk_map_is_byte_identical_to_stock(self):
+        nodes = make_torus_nodes((4, 4, 2))
+        slices = [
+            placement_slice("a", "2x2x2", created="2026-01-01T00:00:01Z"),
+            placement_slice("b", "4x2x1", created="2026-01-01T00:00:02Z"),
+        ]
+        stock = PlacementEngine(slices, nodes).plan()
+        hooked = PlacementEngine(slices, nodes, node_risk={}).plan()
+        assert stock.statuses == hooked.statuses
+        assert stock.label_deltas == hooked.label_deltas
+
     @staticmethod
     def _apply(plan, nodes, slices):
         """Apply a plan back onto the in-memory objects, the way the

@@ -1,0 +1,541 @@
+"""Predictive health: per-host risk scoring + proactive migration
+(ISSUE 19).
+
+Three layers under test:
+
+- the scorer's signal folding (`controllers/risk.py`): absent /
+  malformed / STALE telemetry is no-signal, fresh straggler + grey +
+  repair signals fold, healed risk decays back to zero and releases the
+  migration budget, the gauge retires with the host, and every
+  action-gating read fails CLOSED;
+- the action layer: owner-safe execution (jobs behind the checkpoint
+  barrier, serving replicas drain-then-re-place, unowned gangs never
+  touched), the persisted per-host budget, predicted-vs-realized
+  settlement;
+- the job controller's `risk-` barrier arm: request → checkpoint →
+  teardown → resume with the step watermark intact, and token
+  redelivery never migrating twice.
+"""
+
+import json
+
+import pytest
+
+from tpu_operator import consts
+from tpu_operator.api.tpujob import JobPhase, new_tpu_job
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, new_tpu_slice
+from tpu_operator.controllers.job_controller import JobReconciler
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.controllers.risk import RiskScorer, read_node_risk
+from tpu_operator.kube import errors
+from tpu_operator.kube.controller import Request
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import GangFaultSchedule, make_torus_nodes
+
+NS = "tpu-operator"
+
+
+def _scorer(client, at=1000.0):
+    risk = RiskScorer(client, NS)
+    clock = [at]
+    risk._now = lambda: clock[0]
+    return risk, clock
+
+
+def _gang_artifact(client, slice_name, artifact):
+    """Create-or-patch the slice-manager-owned gang ConfigMap with a
+    telemetry artifact (dict → JSON; str → written raw, for the
+    malformed cases)."""
+    name = f"{slice_name}-gang"
+    raw = artifact if isinstance(artifact, str) else json.dumps(artifact)
+    if client.get_or_none("v1", "ConfigMap", name, NS) is None:
+        obj = new_object("v1", "ConfigMap", name, NS, data={})
+        obj["metadata"]["labels"] = {
+            "app.kubernetes.io/managed-by": "tpu-slice-manager"
+        }
+        client.create(obj)
+    client.patch(
+        "v1", "ConfigMap", name,
+        {"metadata": {
+            "labels": {"app.kubernetes.io/managed-by": "tpu-slice-manager"},
+            "annotations": {consts.GANG_TELEMETRY_ANNOTATION: raw},
+        }}, NS,
+    )
+
+
+def _placed_slice(client, name="g1", owner=None, shape="2x2x1"):
+    body = new_tpu_slice(name, {"placement": {"shape": shape}})
+    if owner:
+        kind, owner_name = owner
+        body["metadata"]["ownerReferences"] = [{
+            "apiVersion": "tpu.google.com/v1alpha1", "kind": kind,
+            "name": owner_name, "uid": "u-" + owner_name,
+        }]
+    client.create(body)
+    PlacementReconciler(client, NS).reconcile(QUEUE_REQUEST)
+    obj = client.get(TPU_SLICE_API_VERSION, "TPUSlice", name)
+    return ((obj.get("status") or {}).get("placement") or {}).get("nodes") or []
+
+
+def _state(client):
+    cm = client.get_or_none("v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, NS)
+    raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY, "")
+    try:
+        return json.loads(raw) or {}
+    except ValueError:
+        return {}
+
+
+def _cluster(dims=(4, 4, 1), prefix="rk"):
+    client = FakeClient()
+    for node in make_torus_nodes(dims, prefix=prefix):
+        client.create(node)
+    return client
+
+
+class TestRiskSignals:
+    def test_no_telemetry_is_no_signal(self):
+        client = _cluster()
+        risk, _ = _scorer(client)
+        summary = risk.sync()
+        assert summary["scores"] == {}
+        assert summary["migrated"] == []
+        # a quiet pass writes nothing
+        assert client.get_or_none(
+            "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, NS
+        ) is None
+
+    @pytest.mark.parametrize("artifact", [
+        "not json {",
+        json.dumps(["a", "list"]),
+        json.dumps({"straggler_ratio": 2.0}),          # no slowest_host
+        json.dumps({"slowest_host": "rk-0"}),          # no ratio
+        json.dumps({"slowest_host": "rk-0", "straggler_ratio": "NaNsense"}),
+    ])
+    def test_malformed_artifacts_are_no_signal(self, artifact):
+        client = _cluster()
+        _placed_slice(client, "g1")
+        _gang_artifact(client, "g1", artifact)
+        risk, _ = _scorer(client)
+        assert risk.sync()["scores"] == {}
+
+    def test_stale_artifact_is_no_signal(self):
+        """The fabric analyzer's staleness convention: a re-placed
+        gang's old artifact must not convict a host the gang no longer
+        runs on."""
+        client = _cluster()
+        members = _placed_slice(client, "g1")
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, _ = _scorer(client)
+        assert risk.sync()["scores"].get(members[0], 0.0) > 0.0
+        # the gang moves away: same CM, same artifact — now stale
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(consts.PLACEMENT_LABEL) == "g1":
+                client.patch("v1", "Node", node["metadata"]["name"], {
+                    "metadata": {"labels": {
+                        consts.PLACEMENT_LABEL: None,
+                        consts.PLACEMENT_INDEX_LABEL: None,
+                    }}})
+        client2 = client
+        risk2, _ = _scorer(client2)
+        summary = risk2.sync()
+        assert "g1" in summary["stale"]
+        assert "straggler" not in (summary["signals"].get(members[0]) or {})
+
+    def test_fresh_signals_fold_and_cap(self):
+        client = _cluster()
+        members = _placed_slice(client, "g1")
+        host = members[0]
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 1.6, "slowest_host": host,
+        })
+        client.patch("v1", "Node", host, {"metadata": {
+            "labels": {consts.TPU_PERF_LABEL: consts.PERF_DEGRADED},
+            "annotations": {consts.REPAIR_RETRIES_ANNOTATION: "4"},
+        }})
+        risk, _ = _scorer(client)
+        summary = risk.sync()
+        parts = summary["signals"][host]
+        assert parts["straggler"] == pytest.approx(0.6)
+        assert parts["grey"] == pytest.approx(consts.RISK_WEIGHT_GREY)
+        assert parts["repair"] == pytest.approx(consts.RISK_WEIGHT_REPAIR_CAP)
+        assert summary["scores"][host] == pytest.approx(
+            min(1.0, 0.6 + consts.RISK_WEIGHT_GREY + consts.RISK_WEIGHT_REPAIR_CAP)
+        )
+
+    def test_healed_straggler_decays_to_zero_and_releases_budget(self):
+        client = _cluster()
+        members = _placed_slice(client, "g1")  # unowned: scored, never acted on
+        host = members[0]
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 2.0, "slowest_host": host,
+        })
+        risk, clock = _scorer(client)
+        assert risk.sync()["scores"][host] == pytest.approx(1.0)
+        # seed a spent budget entry, as a real migration would have
+        state = _state(client)
+        state["hosts"][host].update({"attempts": 1, "nextAttemptAt": 9999.0})
+        client.patch("v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, {
+            "data": {consts.RISK_STATE_KEY: json.dumps(state)}}, NS)
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 1.0, "slowest_host": host,  # healed
+        })
+        clock[0] += 30.0
+        summary = risk.sync()
+        assert summary["scores"][host] == pytest.approx(1.0 * consts.RISK_DECAY)
+        # 0.7 is still over the threshold: the budget stays spent
+        assert _state(client)["hosts"][host]["attempts"] == 1
+        clock[0] += 30.0
+        summary = risk.sync()  # 0.49 < threshold: budget handed back
+        entry = _state(client)["hosts"][host]
+        assert "attempts" not in entry and "nextAttemptAt" not in entry
+        scores = [1.0 * consts.RISK_DECAY, summary["scores"][host]]
+        for _ in range(12):
+            clock[0] += 30.0
+            summary = risk.sync()
+            if host not in summary["scores"]:
+                break
+            scores.append(summary["scores"][host])
+        assert host not in summary["scores"]  # below the floor: retired
+        assert scores == sorted(scores, reverse=True)
+
+    def test_gauge_retired_when_node_leaves_fleet(self):
+        client = _cluster()
+        members = _placed_slice(client, "g1")
+        host = members[0]
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 2.0, "slowest_host": host,
+        })
+        risk, clock = _scorer(client)
+        risk.sync()
+        assert host in risk._risk_series
+        client.delete("v1", "Node", host)
+        clock[0] += 30.0
+        summary = risk.sync()
+        assert host not in summary["scores"]
+        assert host not in risk._risk_series
+        assert host not in (_state(client).get("hosts") or {})
+
+    def test_unreadable_state_cm_fails_closed(self, monkeypatch):
+        client = _cluster()
+        members = _placed_slice(client, "g1", owner=("TPUJob", "tj"))
+        _gang_artifact(client, "g1", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, _ = _scorer(client)
+        real = client.get_or_none
+
+        def flaky(api_version, kind, name, namespace=None, **kw):
+            if name == consts.RISK_STATE_CONFIGMAP:
+                raise errors.ApiError("etcd sneezed")
+            return real(api_version, kind, name, namespace, **kw)
+
+        monkeypatch.setattr(client, "get_or_none", flaky)
+        summary = risk.sync()
+        assert summary["migrated"] == []
+        assert summary["scores"] == {}
+
+    def test_unreadable_inputs_fail_closed(self, monkeypatch):
+        client = _cluster()
+        risk, _ = _scorer(client)
+        monkeypatch.setattr(
+            client, "list",
+            lambda *a, **kw: (_ for _ in ()).throw(errors.ApiError("down")),
+        )
+        summary = risk.sync()
+        assert summary == {
+            "scores": {}, "signals": {}, "stale": [],
+            "migrated": [], "migrations": [],
+        }
+
+    def test_malformed_state_cm_never_crashes(self):
+        client = _cluster()
+        client.create(new_object(
+            "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, NS,
+            data={consts.RISK_STATE_KEY: "{not json"},
+        ))
+        risk, _ = _scorer(client)
+        risk.sync()  # fresh ledger, no crash
+        assert read_node_risk(client, NS) == {}
+
+
+class TestRiskActions:
+    def test_unowned_gang_never_touched(self):
+        client = _cluster()
+        members = _placed_slice(client, "bare")
+        _gang_artifact(client, "bare", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, _ = _scorer(client)
+        summary = risk.sync()
+        assert summary["scores"][members[0]] >= consts.RISK_THRESHOLD
+        assert summary["migrated"] == []
+        assert not _state(client).get("migrations")
+
+    def test_last_routable_serving_replica_never_drained(self):
+        client = _cluster()
+        members = _placed_slice(client, "solo-0", owner=("TPUServing", "solo"))
+        _gang_artifact(client, "solo-0", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, _ = _scorer(client)
+        assert risk.sync()["migrated"] == []
+        # the gang keeps its assignment labels
+        node = client.get("v1", "Node", members[0])
+        assert (node["metadata"]["labels"] or {}).get(
+            consts.PLACEMENT_LABEL
+        ) == "solo-0"
+
+    def test_serving_with_healthy_sibling_drains(self):
+        client = _cluster()
+        members = _placed_slice(client, "svc-0", owner=("TPUServing", "svc"))
+        _placed_slice(client, "svc-1", owner=("TPUServing", "svc"))
+        _gang_artifact(client, "svc-0", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, _ = _scorer(client)
+        summary = risk.sync()
+        assert summary["migrated"] == [members[0]]
+        node = client.get("v1", "Node", members[0])
+        assert not (node["metadata"].get("labels") or {}).get(
+            consts.PLACEMENT_LABEL
+        )
+        migrations = _state(client)["migrations"]
+        assert len(migrations) == 1
+        assert migrations[0]["owner_kind"] == "TPUServing"
+        assert migrations[0]["settled"] is False
+
+    def test_budget_gate_charges_and_blocks_inside_window(self):
+        risk, _ = _scorer(FakeClient())
+        entry = {}
+        assert risk._charge_attempt(entry, 1000.0)
+        assert entry["attempts"] == 1
+        # a second alarm inside the window never fires (floored at base)
+        assert entry["nextAttemptAt"] >= 1000.0 + consts.RISK_MIGRATION_BASE_SECONDS
+        assert not risk._charge_attempt(entry, 1001.0)
+        assert entry["attempts"] == 1
+        # the budget exhausts after the retry limit
+        now = 1000.0
+        for _ in range(consts.RISK_MIGRATION_RETRY_LIMIT * 2):
+            now = float(entry["nextAttemptAt"]) + 1.0
+            risk._charge_attempt(entry, now)
+        assert entry["attempts"] == consts.RISK_MIGRATION_RETRY_LIMIT
+
+    def test_settlement_books_realized_and_false_alarms(self):
+        client = _cluster()
+        members = _placed_slice(client, "svc-0", owner=("TPUServing", "svc"))
+        _placed_slice(client, "svc-1", owner=("TPUServing", "svc"))
+        _gang_artifact(client, "svc-0", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, clock = _scorer(client)
+        risk.sync()
+        assert _state(client)["migrations"][0]["realized"] is None
+        # the host dies: prediction realized
+        client.patch("v1", "Node", members[0], {"metadata": {"labels": {
+            consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}}})
+        clock[0] += 30.0
+        risk.sync()
+        m = _state(client)["migrations"][0]
+        assert m["settled"] and m["realized"] is True
+
+    def test_false_alarm_settles_unrealized_after_grace(self):
+        client = _cluster()
+        members = _placed_slice(client, "svc-0", owner=("TPUServing", "svc"))
+        _placed_slice(client, "svc-1", owner=("TPUServing", "svc"))
+        _gang_artifact(client, "svc-0", {
+            "straggler_ratio": 2.0, "slowest_host": members[0],
+        })
+        risk, clock = _scorer(client)
+        risk.sync()  # drains svc-0 → its artifact goes stale → decay
+        for _ in range(20):
+            clock[0] += consts.RISK_SETTLE_GRACE_SECONDS / 3.0
+            risk.sync()
+            migrations = _state(client).get("migrations") or []
+            if migrations and migrations[0].get("settled"):
+                break
+        m = _state(client)["migrations"][0]
+        assert m["settled"] and m["realized"] is False
+        # budget released with the verdict
+        entry = (_state(client).get("hosts") or {}).get(members[0]) or {}
+        assert "attempts" not in entry and "nextAttemptAt" not in entry
+
+
+class TestJobRiskBarrier:
+    def _world(self):
+        client = FakeClient()
+        for node in make_torus_nodes((4, 2, 1), prefix="jb"):
+            client.create(node)
+        client.create(new_tpu_job("tj", {
+            "workload": {"steps": 1000}, "gang": {"shape": "2x2x1"},
+        }))
+        job_rec = JobReconciler(client, NS)
+        place = PlacementReconciler(client, NS)
+        name = "tj" + consts.JOB_PROGRESS_SUFFIX
+
+        def trainer():
+            cm = client.get_or_none("v1", "ConfigMap", name, NS)
+            if cm is None:
+                client.create(new_object("v1", "ConfigMap", name, NS, data={}))
+                cm = client.get("v1", "ConfigMap", name, NS)
+            slice_obj = client.get_or_none(
+                TPU_SLICE_API_VERSION, "TPUSlice", "tj-slice"
+            )
+            placement = ((slice_obj or {}).get("status") or {}).get("placement") or {}
+            data = {
+                consts.JOB_PROGRESS_STEP: "42",
+                consts.JOB_PROGRESS_CHECKPOINT_STEP: "40",
+                consts.JOB_PROGRESS_EPOCH: "4",
+                consts.JOB_PROGRESS_WORLD: str(len(placement.get("nodes") or [])),
+                consts.JOB_PROGRESS_STATUS: consts.JOB_PROGRESS_RUNNING,
+            }
+            request = (cm.get("data") or {}).get(consts.JOB_CHECKPOINT_REQUEST, "")
+            if request:
+                data[consts.JOB_PROGRESS_CHECKPOINT_ACK] = request
+            client.patch("v1", "ConfigMap", name, {"data": data}, NS)
+
+        for _ in range(4):
+            job_rec.reconcile(Request(name="tj"))
+            place.reconcile(QUEUE_REQUEST)
+            trainer()
+        return client, job_rec, place, trainer
+
+    def _block(self, client):
+        job = client.get("tpu.google.com/v1alpha1", "TPUJob", "tj")
+        return (job.get("status") or {}).get("job") or {}
+
+    def test_risk_request_drives_barrier_teardown_resume(self):
+        client, job_rec, place, trainer = self._world()
+        assert self._block(client).get("phase") == JobPhase.RUNNING
+        client.patch(
+            "v1", "ConfigMap", "tj" + consts.JOB_PROGRESS_SUFFIX,
+            {"data": {consts.JOB_RISK_MIGRATE_REQUEST: "risk-t1"}}, NS,
+        )
+        job_rec.reconcile(Request(name="tj"))
+        block = self._block(client)
+        assert block["phase"] == JobPhase.CHECKPOINTING
+        assert str(block.get("barrier", "")).startswith("risk-")
+        trainer()  # ack the barrier
+        job_rec.reconcile(Request(name="tj"))
+        block = self._block(client)
+        assert block["phase"] in (JobPhase.RESUMING, JobPhase.PLACING)
+        assert block.get("riskHandled") == "risk-t1"
+        # the honored barrier key is lifted for the next generation
+        progress = client.get(
+            "v1", "ConfigMap", "tj" + consts.JOB_PROGRESS_SUFFIX, NS
+        )
+        assert not (progress.get("data") or {}).get(consts.JOB_CHECKPOINT_REQUEST)
+        for _ in range(4):
+            place.reconcile(QUEUE_REQUEST)
+            trainer()
+            job_rec.reconcile(Request(name="tj"))
+        block = self._block(client)
+        assert block["phase"] == JobPhase.RUNNING
+        assert block["step"] == 42  # watermark intact across the move
+
+    def test_redelivered_token_never_migrates_twice(self):
+        client, job_rec, place, trainer = self._world()
+        client.patch(
+            "v1", "ConfigMap", "tj" + consts.JOB_PROGRESS_SUFFIX,
+            {"data": {consts.JOB_RISK_MIGRATE_REQUEST: "risk-t1"}}, NS,
+        )
+        for _ in range(6):
+            job_rec.reconcile(Request(name="tj"))
+            place.reconcile(QUEUE_REQUEST)
+            trainer()
+        seq = self._block(client).get("barrierSeq")
+        assert self._block(client).get("riskHandled") == "risk-t1"
+        # redelivery: the scorer's key still carries the honored token
+        client.patch(
+            "v1", "ConfigMap", "tj" + consts.JOB_PROGRESS_SUFFIX,
+            {"data": {consts.JOB_RISK_MIGRATE_REQUEST: "risk-t1"}}, NS,
+        )
+        for _ in range(3):
+            job_rec.reconcile(Request(name="tj"))
+            trainer()
+        assert self._block(client).get("barrierSeq") == seq
+        assert self._block(client).get("phase") == JobPhase.RUNNING
+
+    def test_broken_gang_auto_satisfies_risk_request(self):
+        client, job_rec, place, trainer = self._world()
+        client.patch(
+            "v1", "ConfigMap", "tj" + consts.JOB_PROGRESS_SUFFIX,
+            {"data": {consts.JOB_RISK_MIGRATE_REQUEST: "risk-t2"}}, NS,
+        )
+        # a member dies before the barrier closes: the re-place IS the
+        # migration, and the token must not replay once healthy
+        for node in client.list("v1", "Node"):
+            labels = node["metadata"].get("labels") or {}
+            if labels.get(consts.PLACEMENT_LABEL) == "tj-slice":
+                client.patch("v1", "Node", node["metadata"]["name"], {
+                    "metadata": {"labels": {
+                        consts.TPU_HEALTH_LABEL: consts.HEALTH_DEGRADED}}})
+                break
+        job_rec.reconcile(Request(name="tj"))
+        assert self._block(client).get("riskHandled") == "risk-t2"
+
+
+class TestSimPrecursors:
+    def test_default_schedule_unchanged(self):
+        """precursor_passes=0 must reproduce the historical log byte
+        for byte — same seed, same driving sequence."""
+        logs = []
+        for _ in range(2):
+            client = _cluster(prefix="sp")
+            _placed_slice(client, "sp-slice")
+            sched = GangFaultSchedule(
+                client, NS, "sp-slice", seed=7, start_at=2, every=4, heal_after=2
+            )
+            for _ in range(25):
+                sched.step()
+            logs.append(list(sched.log))
+        assert logs[0] == logs[1]
+        assert not any(entry[1].startswith("precursor") for entry in logs[0])
+
+    def test_precursor_window_names_the_eventual_victim(self):
+        client = _cluster(prefix="pw")
+        _placed_slice(client, "pw-slice")
+        sched = GangFaultSchedule(
+            client, NS, "pw-slice", seed=3, classes=("host-death",),
+            start_at=8, every=6, heal_after=2, precursor_passes=4,
+        )
+        for _ in range(10):
+            sched.step()
+        precursors = [e for e in sched.log if e[1] == "precursor"]
+        kills = [e for e in sched.log if e[1] == "inject"]
+        assert len(precursors) == 4 and len(kills) == 1
+        victim = kills[0][3]
+        assert all(e[3].startswith(victim + " ") for e in precursors)
+        assert all(e[0] < kills[0][0] for e in precursors)
+        # the artifact the window left behind is real gang telemetry
+        cm = client.get("v1", "ConfigMap", "pw-slice-gang", NS)
+        artifact = json.loads(
+            cm["metadata"]["annotations"][consts.GANG_TELEMETRY_ANNOTATION]
+        )
+        assert artifact["slowest_host"] == victim
+
+    def test_false_alarm_window_heals_without_killing(self):
+        client = _cluster(prefix="fw")
+        _placed_slice(client, "fw-slice")
+        sched = GangFaultSchedule(
+            client, NS, "fw-slice", seed=3, classes=(),
+            precursor_passes=3, false_alarm_at=[2],
+        )
+        for _ in range(8):
+            sched.step()
+        kinds = [e[1] for e in sched.log]
+        assert "inject" not in kinds
+        assert kinds.count("precursor") == 3
+        assert kinds.count("precursor-heal") == 1
+        cm = client.get("v1", "ConfigMap", "fw-slice-gang", NS)
+        artifact = json.loads(
+            cm["metadata"]["annotations"][consts.GANG_TELEMETRY_ANNOTATION]
+        )
+        assert artifact["straggler_ratio"] == 1.0  # healed at window end

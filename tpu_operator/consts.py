@@ -396,6 +396,55 @@ DEFRAG_DECISIONS_LIMIT = 5                    # state-CM history bound
 # executed twice
 JOB_DEFRAG_REQUEST = "defragRequest"
 
+# ---------------------------------------------------------------------------
+# Predictive health (PR 19): per-host risk scoring + proactive migration.
+# The PR 7/8 telemetry precedes hard failures — a straggling host's
+# gang-artifact ratio climbs, its ICI edges decay into the link-health
+# map, the exporter's perf verdict flips — so the risk scorer folds
+# those precursors (plus the repair FSM's retry history) into one
+# per-host score. Over RISK_THRESHOLD the controller moves work off
+# the host while it is still alive: a TPUJob gang behind the SAME
+# checkpoint barrier the defrag path rides (zero lost steps), a
+# TPUServing replica through drain-then-re-place (never the last
+# routable sibling). Scores decay multiplicatively per pass once the
+# signal clears, so a false alarm releases its budget instead of
+# pinning the host risky forever. Stale artifacts (publisher no longer
+# placed where the artifact says) score as NO signal — the same
+# convention the fabric analyzer applies before blaming a host.
+# ---------------------------------------------------------------------------
+RISK_STATE_CONFIGMAP = "tpu-node-risk"        # scores + budget + migration log
+RISK_STATE_KEY = "risk.json"
+RISK_THRESHOLD = 0.6                          # act at/above this score
+RISK_DECAY = 0.7                              # per-pass multiplicative decay
+RISK_SCORE_FLOOR = 0.05                       # below this the host leaves the ledger
+RISK_WEIGHT_STRAGGLER = 1.0                   # x (ratio - 1.0), capped at 1.0
+RISK_WEIGHT_FABRIC_EDGE = 0.25                # per degraded ICI edge touching the host
+RISK_WEIGHT_GREY = 0.5                        # exporter perf verdict (grey failure)
+RISK_WEIGHT_REPAIR = 0.15                     # per recorded repair retry, capped
+RISK_WEIGHT_REPAIR_CAP = 0.3
+# per-host migration budget: a noisy scorer must never thrash a gang
+# with repeated planned migrations — each request charges the host's
+# RetryBudget and persists nextAttemptAt in the state CM (K005), and a
+# host whose risk subsides without dying settles realized=false and
+# releases the budget
+RISK_MIGRATION_RETRY_LIMIT = 3
+RISK_MIGRATION_BASE_SECONDS = 60.0
+RISK_MIGRATION_MAX_SECONDS = 900.0
+RISK_MIGRATIONS_LIMIT = 5                     # state-CM migration-log bound
+# predicted-vs-realized settlement: a prediction may settle FALSE only
+# once the score has subsided AND the grace window passed (the kill the
+# precursor announced needs time to land — settling false the pass
+# after the gang walks away would mislabel every correct prediction,
+# because migrating away is exactly what makes the signal go stale);
+# an unsettled prediction expires false at the timeout either way
+RISK_SETTLE_GRACE_SECONDS = 120.0
+RISK_SETTLE_TIMEOUT_SECONDS = 1800.0          # unsettled predictions expire false
+# risk-controller-owned progress-CM key (disjoint from defragRequest and
+# the job controller's own keys): a new token asks the job controller to
+# checkpoint-barrier and re-place the gang — honored tokens land in
+# status.job.riskHandled so redelivery never migrates twice
+JOB_RISK_MIGRATE_REQUEST = "riskMigrateRequest"
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

@@ -569,9 +569,11 @@ class HealthReconciler:
         self.metrics = get_metrics()
         from tpu_operator.controllers.fabric_telemetry import FabricTelemetryAggregator
         from tpu_operator.controllers.fleet_telemetry import FleetTelemetryAggregator
+        from tpu_operator.controllers.risk import RiskScorer
 
         self.fleet_telemetry = FleetTelemetryAggregator(client, namespace)
         self.fabric_telemetry = FabricTelemetryAggregator(client, namespace)
+        self.risk_scorer = RiskScorer(client, namespace)
 
     def _sync_fleet_telemetry(self) -> None:
         """Fleet data-plane rollups ride the health cadence: gang
@@ -588,6 +590,7 @@ class HealthReconciler:
         # re-derived every pass, so cached read staleness is harmless)
         self.fleet_telemetry.client = self.client
         self.fabric_telemetry.client = self.client
+        self.risk_scorer.client = self.client
         try:
             with trace.span("fleet-telemetry"):
                 self.fleet_telemetry.sync()
@@ -598,6 +601,14 @@ class HealthReconciler:
                 self.fabric_telemetry.sync()
         except Exception as e:  # noqa: BLE001
             log.warning("fabric telemetry sync failed: %s", e)
+        # predictive health rides the same cadence, AFTER the fabric
+        # pass so this pass's blame (perf labels, link-map edges) is
+        # already folded into the scores it acts on
+        try:
+            with trace.span("risk-scorer"):
+                self.risk_scorer.sync()
+        except Exception as e:  # noqa: BLE001
+            log.warning("risk scorer sync failed: %s", e)
 
     def reconcile(self, req: Request) -> Result:
         obj = self.client.get_or_none(CLUSTER_POLICY_API_VERSION, CLUSTER_POLICY_KIND, req.name)

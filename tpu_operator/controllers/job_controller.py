@@ -431,6 +431,12 @@ class JobReconciler:
             block["defragHandled"] = prior["defragHandled"]
         if prior.get("defragPending"):
             block["defragPending"] = prior["defragPending"]
+        # the risk scorer's planned-migration twin (riskMigrateRequest):
+        # same ledger shape, same stale-token rule
+        if prior.get("riskHandled"):
+            block["riskHandled"] = prior["riskHandled"]
+        if prior.get("riskPending"):
+            block["riskPending"] = prior["riskPending"]
 
         # -- completion first: a finished job frees its capacity
         if pstatus == consts.JOB_PROGRESS_COMPLETE and step >= job.spec.workload.steps:
@@ -516,20 +522,44 @@ class JobReconciler:
         if phase == JobPhase.CHECKPOINTING:
             token = str(block.get("barrier") or "")
             ack = progress.get(consts.JOB_PROGRESS_CHECKPOINT_ACK, "")
-            if token.startswith("defrag-"):
-                # the defrag controller's migration barrier: checkpoint
-                # first, THEN tear the gang down so the placement engine
-                # re-seats it — the move loses zero steps, exactly like
-                # a planned grow
+            if token.startswith(("defrag-", "risk-")):
+                # a planned-migration barrier — the defrag controller's
+                # consolidation move or the risk scorer's walk-off-the-
+                # dying-host move: checkpoint first, THEN tear the gang
+                # down so the placement engine re-seats it — the move
+                # loses zero steps, exactly like a planned grow
                 if ack == token:
                     self._teardown_gang(gang_nodes)
-                    block["defragHandled"] = str(block.pop("defragPending", "") or "")
+                    # tear the data plane down in the SAME pass: the
+                    # re-place can land this very pass, and a surviving
+                    # old-generation worker would otherwise run (and the
+                    # next generation re-execute) steps past the barrier
+                    # checkpoint — lost work on a planned move
+                    self._converge_workers(obj, job, [], _shape_str(target))
+                    # lift the barrier key: the runner HOLDS at a
+                    # planned-migration barrier (zero steps past the
+                    # checkpoint), and the next pod generation reads the
+                    # same CM — a stale token would hold it at a barrier
+                    # nobody owns
+                    self._request_progress_key(
+                        job.name, consts.JOB_CHECKPOINT_REQUEST, ""
+                    )
+                    if token.startswith("defrag-"):
+                        block["defragHandled"] = str(
+                            block.pop("defragPending", "") or ""
+                        )
+                        why = "defrag migration"
+                    else:
+                        block["riskHandled"] = str(
+                            block.pop("riskPending", "") or ""
+                        )
+                        why = "predicted-failure migration"
                     block.pop("barrier", None)
                     block["phase"] = JobPhase.RESUMING
                     block["message"] = ""
                     self.recorder.normal(
                         obj, "JobMigrating",
-                        f"defrag migration: checkpointed at step {block['step']}, "
+                        f"{why}: checkpointed at step {block['step']}, "
                         "gang torn down for re-placement",
                     )
                 return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
@@ -539,6 +569,7 @@ class JobReconciler:
                 block["phase"] = JobPhase.RUNNING
                 block.pop("barrier", None)
                 block.pop("defragPending", None)
+                block.pop("riskPending", None)
             elif ack == token:
                 # barrier satisfied: grow — zero steps past the barrier.
                 # Re-verify first: capacity may have vanished while the
@@ -631,6 +662,31 @@ class JobReconciler:
                     "defrag migration requested: checkpointing before "
                     "re-placing the gang",
                 )
+        # ... and the risk scorer's predicted-failure migration — the
+        # SAME barrier machinery with a `risk-` token prefix, so a host
+        # the telemetry says is dying is walked away from with zero
+        # lost steps. Honored tokens land in status.job.riskHandled;
+        # redelivery of one is stale and never migrates twice.
+        risk_req = str(progress.get(consts.JOB_RISK_MIGRATE_REQUEST, "") or "")
+        if (
+            block["phase"] == JobPhase.RUNNING
+            and risk_req
+            and risk_req != str(block.get("riskHandled") or "")
+        ):
+            seq = self._int(block.get("barrierSeq")) + 1
+            token = f"risk-{seq}-{block['step']}"
+            if self._request_progress_key(
+                job.name, consts.JOB_CHECKPOINT_REQUEST, token
+            ):
+                block["barrierSeq"] = seq
+                block["phase"] = JobPhase.CHECKPOINTING
+                block["barrier"] = token
+                block["riskPending"] = risk_req
+                self.recorder.normal(
+                    obj, "JobMigrating",
+                    "predicted host failure: checkpointing before "
+                    "re-placing the gang off the risky host",
+                )
         return Result(requeue_after=consts.JOB_RESYNC_SECONDS)
 
     def _teardown_gang(self, gang_nodes: List[str]) -> None:
@@ -659,15 +715,25 @@ class JobReconciler:
     ) -> Result:
         cause = self._classify_cause(gang)
         # a broken gang re-places regardless, which IS a migration: any
-        # defrag request outstanding or mid-barrier is thereby satisfied
-        # (without this, a fault during the barrier window would replay
-        # the migration — a spurious checkpoint cycle — once healthy)
-        defrag_req = str(
-            self._progress(job.name).get(consts.JOB_DEFRAG_REQUEST, "") or ""
-        )
+        # defrag or risk request outstanding or mid-barrier is thereby
+        # satisfied (without this, a fault during the barrier window
+        # would replay the migration — a spurious checkpoint cycle —
+        # once healthy)
+        progress = self._progress(job.name)
+        defrag_req = str(progress.get(consts.JOB_DEFRAG_REQUEST, "") or "")
         if defrag_req:
             block["defragHandled"] = defrag_req
         block.pop("defragPending", None)
+        risk_req = str(progress.get(consts.JOB_RISK_MIGRATE_REQUEST, "") or "")
+        if risk_req:
+            block["riskHandled"] = risk_req
+        block.pop("riskPending", None)
+        barrier_req = str(progress.get(consts.JOB_CHECKPOINT_REQUEST, "") or "")
+        if barrier_req.startswith(("defrag-", "risk-")):
+            # the runner holds at a planned-migration barrier; with the
+            # gang broken the re-place satisfies it, so lift the key or
+            # the next generation parks at a barrier nobody owns
+            self._request_progress_key(job.name, consts.JOB_CHECKPOINT_REQUEST, "")
         best = self._placeable(
             job, desired, _volume(min_shape), exclude_self=True, links=links
         )
@@ -813,6 +879,7 @@ class JobReconciler:
         block.pop("nextAttemptAt", None)
         block.pop("barrier", None)
         block.pop("defragPending", None)
+        block.pop("riskPending", None)
         self._delete_slice(obj["metadata"]["name"])
         self.pods.sweep(TPU_JOB_KIND, obj["metadata"]["name"])
         self.recorder.warning(obj, "JobFailed", f"quarantined: {message}")

@@ -359,6 +359,30 @@ class OperatorMetrics:
             ["generation"],
             registry=reg,
         )
+        # predictive health (controllers/risk.py): the per-host risk
+        # score folded from the precursor telemetry, retired when the
+        # host leaves the fleet or its risk decays away (O005), plus
+        # the planned-migration counter (the predictive twin of
+        # defrag_migrations)
+        self.node_risk = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_node_risk",
+            "Per-host failure-risk score in [0, 1] folded from the "
+            "precursor telemetry (gang straggler ratio, degraded ICI "
+            "edges, grey-failure perf verdict, repair history) — the "
+            "predictive-migration trigger at RISK_THRESHOLD",
+            ["node"],
+            registry=reg,
+        )
+        self.risk_migrations = _get_or_create(
+            prometheus_client.Counter,
+            "tpu_operator_risk_migrations_total",
+            "Planned migrations the risk scorer has requested off "
+            "hosts over the risk threshold (checkpoint-barrier moves "
+            "for TPUJob gangs, drain-then-re-place for TPUServing "
+            "replicas)",
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

@@ -24,7 +24,7 @@ block changed: O(changes in the pool), not O(cluster).
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tpu_operator import consts
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
@@ -126,8 +126,11 @@ class PlacementReconciler:
         slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
         nodes = self.client.list("v1", "Node")
         links = self._degraded_links()
+        risk = self._node_risk()
         with trace.span("plan", slices=len(slices), nodes=len(nodes), links=len(links)):
-            engine = PlacementEngine(slices, nodes, degraded_links=links)
+            engine = PlacementEngine(
+                slices, nodes, degraded_links=links, node_risk=risk
+            )
             plan = engine.plan()
         with trace.span("apply-plan", deltas=len(plan.label_deltas)):
             self._apply_labels(plan)
@@ -193,10 +196,13 @@ class PlacementReconciler:
         } - {None, ""}
         relevant = self._slices_for_pool(shard, assigned_here)
         links = self._degraded_links()
+        risk = self._node_risk()
         with trace.span(
             "plan", pool=shard, slices=len(relevant), nodes=len(nodes), links=len(links)
         ):
-            engine = PlacementEngine(relevant, nodes, degraded_links=links)
+            engine = PlacementEngine(
+                relevant, nodes, degraded_links=links, node_risk=risk
+            )
             plan = engine.plan()
         # a slice this pool couldn't seat may belong elsewhere: only a
         # slice PINNED TO THIS POOL gets its Unschedulable verdict
@@ -272,6 +278,18 @@ class PlacementReconciler:
         from tpu_operator.controllers.fabric_telemetry import degraded_link_pairs
 
         return degraded_link_pairs(self.client, self.namespace)
+
+    def _node_risk(self) -> Dict[str, float]:
+        """Per-host risk scores for the engine's risk-aware ranking
+        hook (the risk scorer's published state CM). ADVISORY, unlike
+        the link map: an unreadable or absent ledger reads as no bias —
+        placing without it only costs optimality, never safety, so this
+        read must not abort the pass (K003 applies to reads that gate
+        destructive actions; ranking between equally-legal blocks is
+        not one)."""
+        from tpu_operator.controllers.risk import read_node_risk
+
+        return read_node_risk(self.client, self.namespace) or {}
 
     # -- plan application ----------------------------------------------------
 

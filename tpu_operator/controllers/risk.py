@@ -1,0 +1,649 @@
+"""Predictive health: per-host risk scoring + proactive migration.
+
+Every other fault path in this operator is reactive — the job shrinks
+*after* the host dies, the serving router excludes a replica *after*
+the fabric verdict lands — and a hard failure costs a TPUJob up to a
+full checkpoint cadence of lost steps. But the PR 7/8 telemetry
+*precedes* hard failures: a dying host's straggler ratio climbs in the
+gang artifact, its ICI edges decay into the link-health map, the
+exporter's perf verdict flips, the repair FSM's retry counter grows.
+This scorer — run from the health reconciler's pass like the fleet and
+fabric aggregators, so it rides the same cadence and informer caches —
+folds those precursors into one per-host score in [0, 1]:
+
+    score = max(clamped sum of live signals, previous * RISK_DECAY)
+
+    straggler   RISK_WEIGHT_STRAGGLER * (ratio - 1.0), capped at 1.0,
+                only while the artifact is FRESH (the named slowest
+                host still carries the publishing gang's placement
+                label — the fabric analyzer's staleness convention:
+                a re-placed gang's old artifact scores as NO signal)
+    fabric      RISK_WEIGHT_FABRIC_EDGE per recorded degraded ICI edge
+                touching the host
+    grey        RISK_WEIGHT_GREY while the exporter's perf verdict is
+                degraded
+    repair      RISK_WEIGHT_REPAIR per recorded repair retry, capped
+
+and publishes it to the ``tpu-node-risk`` ConfigMap (scores + budget
+ledger + predicted-vs-realized migration log — restart-safe, and the
+must-gather ``risk.txt`` evidence trail) and the
+``tpu_operator_node_risk{node}`` gauge (retired when the host leaves
+the fleet or its risk decays away).
+
+Over ``RISK_THRESHOLD`` the scorer moves work off the host while it is
+still alive, through the owners' own safe paths (the defrag
+controller's execution discipline, re-used move for move):
+
+- a TPUJob gang migrates behind the PR 13 checkpoint barrier: this
+  controller writes its one owned progress-CM key
+  (``consts.JOB_RISK_MIGRATE_REQUEST``) and the job controller drives
+  checkpoint -> teardown -> re-place -> resume, so a *predicted*
+  failure loses ZERO steps;
+- a TPUServing replica takes the drain-then-re-place path — and only
+  while another placed, in-service sibling keeps the serving routable;
+- gangs owned by neither are NEVER touched.
+
+False-positive governance: each planned migration charges the host's
+persisted :class:`RetryBudget` (``attempts`` + ``nextAttemptAt`` in the
+state CM — K005), at most one migration per pass fleet-wide, and never
+a second while one is still settling. A host whose risk subsides
+without dying settles ``realized=false`` and RELEASES its budget — a
+noisy scorer decays back to quiet instead of thrashing a gang. Every
+read that gates an action fails CLOSED (K003): an unreadable state CM
+or input list aborts the pass, it never resets the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator import consts
+from tpu_operator.api.tpujob import TPU_JOB_API_VERSION, TPU_JOB_KIND, JobPhase
+from tpu_operator.api.tpuserving import TPU_SERVING_KIND
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.backoff import RetryBudget, read_attempts
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.kube.objects import ObjectDict, new_object
+from tpu_operator.placement.engine import PlacementPhase, labels_unavailable
+
+log = logging.getLogger(__name__)
+
+RISK_MANAGER = "tpu-risk-scorer"
+
+# the slice manager stamps this on every gang ConfigMap it owns (kept
+# value-only to avoid a module cycle, same as fleet_telemetry)
+_MANAGED_BY = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+
+
+class RiskScorer:
+    def __init__(self, client: Client, namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE,
+                 recorder: Optional[EventRecorder] = None):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = recorder or EventRecorder(client, namespace, component=RISK_MANAGER)
+        self.metrics = get_metrics()
+        self._now = time.time  # tests pin the clock
+        self.rng = random.Random()  # jitter only; decisions never ride it
+        from tpu_operator.kube import racecheck
+
+        self._series_lock = racecheck.lock("RiskScorer._series_lock")
+        self._risk_series: set = set()
+
+    @staticmethod
+    def _float(raw) -> float:
+        try:
+            return float(raw or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    # -- one scoring pass ----------------------------------------------------
+
+    def sync(self) -> dict:
+        """Read the precursor telemetry, fold the per-host scores,
+        publish series + state, and move work off hosts over the
+        threshold. Returns a summary dict (tests and the risk
+        must-gather artifact read it)."""
+        summary: dict = {
+            "scores": {}, "signals": {}, "stale": [],
+            "migrated": [], "migrations": [],
+        }
+        try:
+            nodes = self.client.list("v1", "Node")
+            cms = self.client.list(
+                "v1", "ConfigMap", self.namespace, label_selector=_MANAGED_BY
+            )
+            slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+        except errors.ApiError as e:
+            # inputs unreadable: fail closed — no rescore, no action
+            log.debug("risk: pass inputs unreadable: %s", e)
+            return summary
+        link_map = self._link_map()
+        if link_map is None:
+            return summary
+        node_by_name = {n["metadata"]["name"]: n for n in nodes}
+        slices_by_name = {s["metadata"]["name"]: s for s in slices}
+
+        state = self._read_state()
+        if state is None:
+            # ledger unreadable: fail closed (acting against an empty
+            # ledger would hand back every host's migration budget)
+            return summary
+        now = self._now()
+        signals = self._collect_signals(cms, node_by_name, link_map, summary)
+        changed = self._rescore(state, signals, node_by_name)
+        scores = {
+            host: self._float(entry.get("score"))
+            for host, entry in (state.get("hosts") or {}).items()
+        }
+        self._publish_series(scores)
+        summary["scores"] = scores
+        summary["signals"] = signals
+        in_flight, settled = self._settle(state, scores, node_by_name, now)
+        changed = settled or changed
+        if not in_flight:
+            # never overlap planned migrations: the fleet absorbs one
+            # checkpoint/drain at a time, and settlement is what tells
+            # predicted from false alarm
+            changed = self._act(
+                state, scores, slices_by_name, node_by_name, now, summary
+            ) or changed
+        if changed:
+            # a quiet pass writes nothing (the fabric analyzer's rule)
+            self._write_state(state)
+        summary["migrations"] = list(state.get("migrations") or [])
+        return summary
+
+    def _link_map(self) -> Optional[dict]:
+        """The fabric analyzer's recorded per-edge verdicts. A missing
+        map means no cuts; a failed READ returns None and aborts the
+        pass (degraded edges both raise scores and gate where a
+        re-placed gang may land — scoring without them fails open)."""
+        from tpu_operator.controllers.fabric_telemetry import parse_link_map
+
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.LINK_HEALTH_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError as e:
+            log.warning("risk: link-health map unreadable, pass aborted: %s", e)
+            return None
+        return parse_link_map(cm)
+
+    # -- signals -------------------------------------------------------------
+
+    def _collect_signals(
+        self, cms: List[dict], node_by_name: Dict[str, dict],
+        link_map: Dict[str, Dict[str, dict]], summary: dict,
+    ) -> Dict[str, Dict[str, float]]:
+        """host -> {signal: contribution} from the live telemetry.
+        Absent, malformed, and STALE artifacts contribute nothing — a
+        missing precursor is "no signal", never "crash" or "guess"."""
+        signals: Dict[str, Dict[str, float]] = {}
+
+        def add(host: str, key: str, value: float) -> None:
+            if value <= 0.0 or host not in node_by_name:
+                return
+            parts = signals.setdefault(host, {})
+            parts[key] = round(parts.get(key, 0.0) + value, 4)
+
+        for cm in cms:
+            raw = (cm["metadata"].get("annotations") or {}).get(
+                consts.GANG_TELEMETRY_ANNOTATION
+            )
+            if not raw:
+                continue
+            try:
+                artifact = json.loads(raw)
+            except ValueError:
+                continue  # malformed: no signal (fleet telemetry warns)
+            if not isinstance(artifact, dict):
+                continue
+            slice_name = cm["metadata"]["name"]
+            if slice_name.endswith("-gang"):
+                slice_name = slice_name[: -len("-gang")]
+            slowest = str(artifact.get("slowest_host") or "")
+            ratio = self._float(artifact.get("straggler_ratio"))
+            if not slowest or ratio <= consts.GANG_STRAGGLER_RATIO:
+                continue
+            if self._straggler_stale(slice_name, slowest, node_by_name):
+                summary["stale"].append(slice_name)
+                continue
+            add(
+                slowest, "straggler",
+                min(1.0, consts.RISK_WEIGHT_STRAGGLER * (ratio - 1.0)),
+            )
+        for pool_edges in link_map.values():
+            for edge in pool_edges:
+                a, _, b = edge.partition("|")
+                add(a, "fabric", consts.RISK_WEIGHT_FABRIC_EDGE)
+                add(b, "fabric", consts.RISK_WEIGHT_FABRIC_EDGE)
+        for name, node in node_by_name.items():
+            meta = node["metadata"]
+            labels = meta.get("labels") or {}
+            if labels.get(consts.TPU_PERF_LABEL) == consts.PERF_DEGRADED:
+                add(name, "grey", consts.RISK_WEIGHT_GREY)
+            retries = read_attempts(
+                meta.get("annotations"), consts.REPAIR_RETRIES_ANNOTATION
+            )
+            if retries:
+                add(name, "repair", min(
+                    consts.RISK_WEIGHT_REPAIR_CAP,
+                    consts.RISK_WEIGHT_REPAIR * retries,
+                ))
+        return signals
+
+    @staticmethod
+    def _straggler_stale(
+        slice_name: str, slowest: str, node_by_name: Dict[str, dict]
+    ) -> bool:
+        """The fabric analyzer's staleness convention applied to the
+        gang artifact: after a re-place the gang ConfigMap (same name)
+        still carries the old rollup, and scoring a host from it would
+        convict a node the gang no longer runs on. Fresh iff the named
+        slowest host exists AND still carries the publishing gang's
+        placement label (gang CM names are ``<owner>-gang`` with the
+        slice manager's ``tpu-slice-`` prefix ahead of the owner)."""
+        node = node_by_name.get(slowest)
+        if node is None:
+            return True
+        owner = slice_name
+        if owner.startswith("tpu-slice-"):
+            owner = owner[len("tpu-slice-"):]
+        labels = node["metadata"].get("labels") or {}
+        return labels.get(consts.PLACEMENT_LABEL) != owner
+
+    # -- scoring -------------------------------------------------------------
+
+    def _rescore(
+        self, state: dict, signals: Dict[str, Dict[str, float]],
+        node_by_name: Dict[str, dict],
+    ) -> bool:
+        """Fold this pass's signals into the persisted ledger:
+        score = max(instant, previous * RISK_DECAY). A host below the
+        floor (or gone from the fleet) leaves the ledger — and a host
+        whose risk subsides below the threshold without dying releases
+        its migration budget (the false-alarm decay contract)."""
+        hosts: Dict[str, dict] = state.setdefault("hosts", {})
+        changed = False
+        for host in sorted(set(signals) | set(hosts)):
+            if host not in node_by_name:
+                if hosts.pop(host, None) is not None:
+                    changed = True
+                continue
+            parts = signals.get(host) or {}
+            instant = min(1.0, round(sum(parts.values()), 4))
+            entry = hosts.get(host)
+            prev = self._float((entry or {}).get("score"))
+            score = round(max(instant, prev * consts.RISK_DECAY), 4)
+            if score < consts.RISK_SCORE_FLOOR:
+                if hosts.pop(host, None) is not None:
+                    changed = True
+                continue
+            if entry is None:
+                entry = hosts[host] = {}
+                changed = True
+            if entry.get("score") != score or entry.get("signals") != parts:
+                entry["score"] = score
+                entry["signals"] = parts
+                changed = True
+            if score < consts.RISK_THRESHOLD and (
+                entry.get("attempts") or entry.get("nextAttemptAt")
+            ):
+                entry.pop("attempts", None)
+                entry.pop("nextAttemptAt", None)
+                changed = True
+        return changed
+
+    def _publish_series(self, scores: Dict[str, float]) -> None:
+        """tpu_operator_node_risk{node}, retired with the ledger entry:
+        a frozen last value would keep a dead or healed host reading
+        risky forever (same discipline as the gang series)."""
+        for host, score in sorted(scores.items()):
+            self.metrics.node_risk.labels(host).set(score)
+        with self._series_lock:
+            gone = self._risk_series - set(scores)
+            self._risk_series = set(scores)
+        for host in gone:
+            try:
+                self.metrics.node_risk.remove(host)
+            except KeyError:
+                pass
+
+    # -- persisted state -----------------------------------------------------
+
+    def _read_state(self) -> Optional[dict]:
+        """Scores + budget ledger + migration log. A transient READ
+        failure returns None and the caller aborts the pass — a flaky
+        apiserver must fail CLOSED, not reset the ledger and hand back
+        every host's migration budget. Only a genuinely malformed blob
+        (which a retry can never fix) starts fresh."""
+        try:
+            cm = self.client.get_or_none(
+                "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, self.namespace
+            )
+        except errors.ApiError as e:
+            log.warning("risk: state CM unreadable, pass aborted: %s", e)
+            return None
+        raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY)
+        if not raw:
+            return {"hosts": {}, "migrations": []}
+        try:
+            state = json.loads(raw)
+        except ValueError:
+            state = None  # malformed: start fresh, never crash the pass
+        if not isinstance(state, dict) or not isinstance(state.get("hosts"), dict):
+            return {"hosts": {}, "migrations": []}
+        state.setdefault("migrations", [])
+        if not isinstance(state["migrations"], list):
+            state["migrations"] = []
+        return state
+
+    def _write_state(self, state: dict) -> None:
+        state["migrations"] = state.get("migrations", [])[-consts.RISK_MIGRATIONS_LIMIT:]
+        data = {consts.RISK_STATE_KEY: json.dumps(state, sort_keys=True)}
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP,
+                {"data": data}, self.namespace,
+            )
+        except errors.NotFound:
+            try:
+                self.client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                    new_object(
+                        "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP,
+                        self.namespace, data=data,
+                    )
+                )
+            except (errors.AlreadyExists, errors.ApiError) as e:
+                log.debug("risk state write raced/failed: %s", e)
+        except errors.ApiError as e:
+            log.debug("risk state write failed: %s", e)
+
+    # -- settlement ----------------------------------------------------------
+
+    def _settle(
+        self, state: dict, scores: Dict[str, float],
+        node_by_name: Dict[str, dict], now: float,
+    ) -> Tuple[bool, bool]:
+        """Book predicted-vs-realized for every outstanding planned
+        migration. Realized TRUE when the host did die (gone, or out of
+        service); FALSE when its risk subsided past the grace window —
+        which also releases the host's budget — or the prediction
+        expired unresolved. Returns (in_flight, state_changed)."""
+        changed = False
+        in_flight = False
+        for m in state.get("migrations", []):
+            if m.get("settled"):
+                continue
+            host = str(m.get("host") or "")
+            node = node_by_name.get(host)
+            labels = ((node or {}).get("metadata") or {}).get("labels") or {}
+            age = now - self._float(m.get("requested_at"))
+            if node is None or labels_unavailable(labels):
+                m["settled"] = True
+                m["realized"] = True
+                changed = True
+                if node is not None:
+                    self.recorder.event(
+                        node, "Normal", "RiskRealized",
+                        f"predicted failure of {host} realized "
+                        f"{round(age, 1)}s after the planned migration of "
+                        f"{m.get('owner_kind')}/{m.get('owner_name')} "
+                        f"(score {m.get('score')})",
+                    )
+                continue
+            subsided = scores.get(host, 0.0) < consts.RISK_THRESHOLD
+            if subsided and age >= consts.RISK_SETTLE_GRACE_SECONDS:
+                m["settled"] = True
+                m["realized"] = False
+                changed = True
+                entry = (state.get("hosts") or {}).get(host)
+                if entry:
+                    entry.pop("attempts", None)
+                    entry.pop("nextAttemptAt", None)
+                self.recorder.event(
+                    node, "Normal", "RiskFalseAlarm",
+                    f"{host} outlived its risk signal (score "
+                    f"{scores.get(host, 0.0)}); migration budget released",
+                )
+                continue
+            if age > consts.RISK_SETTLE_TIMEOUT_SECONDS:
+                m["settled"] = True
+                m["realized"] = False
+                changed = True
+                continue
+            in_flight = True
+        return in_flight, changed
+
+    # -- acting --------------------------------------------------------------
+
+    def _act(
+        self, state: dict, scores: Dict[str, float], slices_by_name: dict,
+        node_by_name: Dict[str, dict], now: float, summary: dict,
+    ) -> bool:
+        """Move work off the riskiest eligible host — AT MOST ONE
+        planned migration per pass, through the owner's own safe path,
+        charged against the host's persisted budget."""
+        risky = sorted(
+            (h for h, s in scores.items() if s >= consts.RISK_THRESHOLD),
+            key=lambda h: (-scores[h], h),
+        )
+        for host in risky:
+            placed = self._slice_on(host, slices_by_name)
+            if placed is None:
+                continue
+            slice_name, obj = placed
+            owner = self._owner_of(obj)
+            if owner is None:
+                continue  # gangs owned by neither kind are never touched
+            kind, owner_name = owner
+            if kind == TPU_JOB_KIND:
+                if not self._job_migratable(owner_name):
+                    continue
+            elif kind == TPU_SERVING_KIND:
+                if not self._serving_sibling_placed(
+                    slice_name, owner_name, slices_by_name
+                ):
+                    continue  # never drain the last routable replica
+            else:
+                continue
+            entry = state.setdefault("hosts", {}).setdefault(host, {})
+            if not self._charge_attempt(entry, now):
+                continue
+            # the charge is persisted whether or not the request lands:
+            # the nextAttemptAt gate is exactly what keeps a failing
+            # patch from being retried at watch-storm speed
+            token = ""
+            if kind == TPU_JOB_KIND:
+                token = f"risk-{int(now)}-{int(state.get('serial', 0))}"
+                ok = self._request_job_migration(owner_name, token)
+                if ok:
+                    state["serial"] = int(state.get("serial", 0)) + 1
+            else:
+                status = (obj.get("status") or {}).get("placement") or {}
+                ok = self._drain_serving_replica(list(status.get("nodes") or []))
+            if ok:
+                state.setdefault("migrations", []).append({
+                    "host": host,
+                    "slice": slice_name,
+                    "owner_kind": kind,
+                    "owner_name": owner_name,
+                    "token": token,
+                    "score": scores[host],
+                    "signals": dict(
+                        ((state.get("hosts") or {}).get(host) or {}).get("signals")
+                        or {}
+                    ),
+                    "requested_at": now,
+                    "settled": False,
+                    "realized": None,
+                })
+                self.metrics.risk_migrations.inc()
+                summary["migrated"].append(host)
+                self.recorder.event(
+                    obj, "Normal",
+                    "RiskMigrating" if kind == TPU_JOB_KIND else "RiskDraining",
+                    f"host {host} risk {scores[host]} >= "
+                    f"{consts.RISK_THRESHOLD}: moving {kind}/{owner_name} "
+                    f"gang {slice_name} off it while it is still alive",
+                )
+            else:
+                log.debug("risk: migration request for %s off %s failed",
+                          owner_name, host)
+            return True  # charged (and possibly moved): state is dirty
+        return False
+
+    def _charge_attempt(self, entry: dict, now: float) -> bool:
+        """One unit of the host's migration budget. The persisted
+        nextAttemptAt gate (floored at the base delay so two alarms in
+        one precursor window can never both fire) is checked BEFORE the
+        charge and re-armed with it — a watch-event storm or a
+        crash-looping operator cannot burn the budget faster than the
+        backoff schedule (K005)."""
+        budget = RetryBudget(
+            consts.RISK_MIGRATION_RETRY_LIMIT,
+            consts.RISK_MIGRATION_BASE_SECONDS,
+            consts.RISK_MIGRATION_MAX_SECONDS,
+        )
+        if now < self._float(entry.get("nextAttemptAt")):
+            return False
+        attempts = int(entry.get("attempts") or 0)
+        if budget.exhausted(attempts):
+            return False
+        entry["attempts"] = attempts + 1
+        delay = max(
+            budget.base_delay_seconds, budget.delay(attempts + 1, self.rng)
+        )
+        entry["nextAttemptAt"] = round(now + delay, 3)
+        return True
+
+    # -- owner-safe execution (the defrag controller's discipline) -----------
+
+    def _slice_on(
+        self, host: str, slices_by_name: dict
+    ) -> Optional[Tuple[str, ObjectDict]]:
+        for name in sorted(slices_by_name):
+            obj = slices_by_name[name]
+            status = (obj.get("status") or {}).get("placement") or {}
+            if status.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            if host in (status.get("nodes") or []):
+                return name, obj
+        return None
+
+    @staticmethod
+    def _owner_of(obj: ObjectDict) -> Optional[Tuple[str, str]]:
+        for ref in obj["metadata"].get("ownerReferences") or []:
+            if ref.get("kind") in (TPU_JOB_KIND, TPU_SERVING_KIND) and ref.get("name"):
+                return (str(ref["kind"]), str(ref["name"]))
+        return None
+
+    def _job_migratable(self, job_name: str) -> bool:
+        """Somebody must answer the checkpoint barrier: the job is
+        Running and its progress CM is live."""
+        job = self.client.get_or_none(TPU_JOB_API_VERSION, TPU_JOB_KIND, job_name)
+        if job is None:
+            return False
+        block = (job.get("status") or {}).get("job") or {}
+        if block.get("phase") != JobPhase.RUNNING:
+            return False
+        progress = self.client.get_or_none(
+            "v1", "ConfigMap", job_name + consts.JOB_PROGRESS_SUFFIX, self.namespace
+        )
+        return progress is not None
+
+    def _serving_sibling_placed(
+        self, name: str, serving: str, slices_by_name: dict
+    ) -> bool:
+        """True when another replica of the same serving is placed AND
+        in service — draining a gang whose only sibling is
+        placed-but-dying would leave the serving unroutable for the
+        whole re-place window (the defrag controller's exact rule)."""
+        for other_name, other in slices_by_name.items():
+            if other_name == name:
+                continue
+            if self._owner_of(other) != (TPU_SERVING_KIND, serving):
+                continue
+            status = (other.get("status") or {}).get("placement") or {}
+            if status.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            members_healthy = True
+            for node_name in status.get("nodes") or []:
+                node = self.client.get_or_none("v1", "Node", node_name)
+                if node is None or labels_unavailable(
+                    node["metadata"].get("labels") or {}
+                ):
+                    members_healthy = False
+                    break
+            if members_healthy:
+                return True
+        return False
+
+    def _request_job_migration(self, job_name: str, token: str) -> bool:
+        """The checkpoint-barrier path: bump our one owned key in the
+        job's progress CM; the job controller drives checkpoint ->
+        teardown -> re-place -> resume and records the token it honored
+        in status.job.riskHandled (redelivery never migrates twice)."""
+        try:
+            self.client.patch(
+                "v1", "ConfigMap", job_name + consts.JOB_PROGRESS_SUFFIX,
+                {"data": {consts.JOB_RISK_MIGRATE_REQUEST: token}}, self.namespace,
+            )
+        except (errors.NotFound, errors.ApiError) as e:
+            log.debug("risk: job %s migration request failed: %s", job_name, e)
+            return False
+        return True
+
+    def _drain_serving_replica(self, gang_nodes: List[str]) -> bool:
+        """The drain-then-re-place path: clear the replica gang's
+        assignment labels; the serving router zeroes its weight the
+        same pass and the engine re-seats it — away from the risky
+        host, because the engine's risk-aware scorer reads the same
+        state CM this controller writes. A sweep that cleared NOTHING
+        must not book a migration or spend budget."""
+        from tpu_operator.controllers.placement_controller import (
+            clear_assignment_labels,
+        )
+
+        return clear_assignment_labels(self.client, gang_nodes) > 0
+
+
+def read_node_risk(client: Client, namespace: str) -> Optional[Dict[str, float]]:
+    """The published per-host scores, for ADVISORY consumers (the
+    placement engine's risk-aware scoring hook). Missing or malformed
+    state reads as no scores; a failed READ returns None so callers
+    that also gate destructive work can abort — the placement
+    controller itself treats None as "place without risk bias", which
+    only ever costs optimality, never safety."""
+    try:
+        cm = client.get_or_none(
+            "v1", "ConfigMap", consts.RISK_STATE_CONFIGMAP, namespace
+        )
+    except errors.ApiError:
+        return None
+    raw = ((cm or {}).get("data") or {}).get(consts.RISK_STATE_KEY)
+    if not raw:
+        return {}
+    try:
+        state = json.loads(raw)
+    except ValueError:
+        return {}
+    hosts = state.get("hosts") if isinstance(state, dict) else None
+    if not isinstance(hosts, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for host, entry in hosts.items():
+        try:
+            score = float((entry or {}).get("score") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if score > 0.0:
+            out[str(host)] = score
+    return out

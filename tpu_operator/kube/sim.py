@@ -397,6 +397,19 @@ class GangFaultSchedule:
     Deterministic: same seed + same driving sequence → the same fault
     log (``self.log``). Driven in passes by the job drill, the chaos
     rider, and ``bench.py --job-smoke`` between reconcile beats.
+
+    **Precursor windows** (``precursor_passes > 0``): a scheduled
+    host-death announces itself before it lands — for the window's
+    passes the doomed member (pre-chosen with the schedule's own RNG at
+    window open, so the kill targets the SAME node whether or not
+    anything reacts) is published as a rising straggler in the gang's
+    telemetry artifact, exactly the precursor a real dying host emits.
+    The kill then hits the pre-chosen node even if the gang already
+    walked off it — which is the predictive-health win the window
+    exists to measure. ``false_alarm_at`` schedules windows with NO
+    kill behind them (the artifact heals to ratio 1.0 at window end):
+    the false-positive-governance probe. Default 0 windows reproduces
+    the historical pass-for-pass log byte for byte.
     """
 
     FAULT_CLASSES = ("host-death", "grey-failure", "link-cut", "preemption")
@@ -411,20 +424,26 @@ class GangFaultSchedule:
         start_at: int = 2,
         every: int = 6,
         heal_after: int = 3,
+        precursor_passes: int = 0,
+        false_alarm_at=(),
     ):
         self.client = client
         self.namespace = namespace
         self.slice_name = slice_name
         self.seed = seed
         self.heal_after = heal_after
+        self.precursor_passes = precursor_passes
         self._rng = random.Random(seed)
         order = list(classes)
         self._rng.shuffle(order)
         self._pending = [(start_at + i * every, cls) for i, cls in enumerate(order)]
         self._active: Optional[dict] = None
         self._pass = 0
-        self.log: list = []  # (pass, "inject"|"heal", class, detail)
+        self.log: list = []  # (pass, "inject"|"heal"|"precursor"|..., class, detail)
         self.fired: set = set()
+        self._victim_next: Optional[str] = None  # pre-chosen host-death target
+        self._false_alarms = sorted(false_alarm_at or [])  # window-start passes
+        self._fa_active: Optional[dict] = None
 
     # -- gang introspection --------------------------------------------------
 
@@ -462,6 +481,8 @@ class GangFaultSchedule:
             actions.append(("heal", self._active["class"], self._active["detail"]))
             self.log.append((self._pass, "heal", self._active["class"], self._active["detail"]))
             self._active = None
+        if self.precursor_passes > 0 or self._false_alarms or self._fa_active:
+            self._emit_precursors(actions)
         if self._active is None and self._pending and self._pass >= self._pending[0][0]:
             cls = self._pending[0][1]
             detail = self._inject(cls)
@@ -474,6 +495,91 @@ class GangFaultSchedule:
                 actions.append(("inject", cls, detail))
                 self.log.append((self._pass, "inject", cls, detail))
         return actions
+
+    # -- precursor windows ---------------------------------------------------
+
+    def _emit_precursors(self, actions: list) -> None:
+        """Publish the rising-straggler artifact for any open precursor
+        window. Real windows precede a scheduled host-death; false-alarm
+        windows have no kill behind them and heal at window end."""
+        if (
+            self.precursor_passes > 0
+            and self._active is None
+            and self._pending
+            and self._pending[0][1] == "host-death"
+        ):
+            due = self._pending[0][0]
+            if due - self.precursor_passes <= self._pass < due:
+                if self._victim_next is None:
+                    members = self._members()
+                    if members:  # gang mid-replace: pick on a later pass
+                        self._victim_next = self._rng.choice(members)["metadata"]["name"]
+                if self._victim_next is not None:
+                    k = self._pass - (due - self.precursor_passes) + 1
+                    ratio = self._emit_straggler_artifact(self._victim_next, k)
+                    actions.append(("precursor", "host-death", self._victim_next))
+                    self.log.append((
+                        self._pass, "precursor", "host-death",
+                        f"{self._victim_next} ratio={ratio}",
+                    ))
+        if self._fa_active is None and self._false_alarms and self._pass >= self._false_alarms[0]:
+            start = self._false_alarms.pop(0)
+            members = self._members()
+            if members:
+                self._fa_active = {
+                    "victim": self._rng.choice(members)["metadata"]["name"],
+                    "start": start,
+                    "end": start + max(1, self.precursor_passes),
+                }
+            # no members: the window is skipped, not deferred — a false
+            # alarm against a gang that isn't placed predicts nothing
+        if self._fa_active is not None:
+            if self._pass < self._fa_active["end"]:
+                k = self._pass - self._fa_active["start"] + 1
+                ratio = self._emit_straggler_artifact(self._fa_active["victim"], k)
+                actions.append(("precursor", "false-alarm", self._fa_active["victim"]))
+                self.log.append((
+                    self._pass, "precursor", "false-alarm",
+                    f"{self._fa_active['victim']} ratio={ratio}",
+                ))
+            else:
+                victim = self._fa_active["victim"]
+                self._fa_active = None
+                self._emit_straggler_artifact(victim, 0)
+                actions.append(("precursor-heal", "false-alarm", victim))
+                self.log.append((self._pass, "precursor-heal", "false-alarm", victim))
+
+    def _emit_straggler_artifact(self, victim: str, k: int) -> float:
+        """Write the gang telemetry artifact a slower-every-step host
+        produces: pass ``k`` of the window ramps the straggler ratio so
+        the risk score crosses threshold partway through; ``k == 0``
+        writes the healed (ratio 1.0) artifact."""
+        import json
+
+        from tpu_operator import consts as _consts
+        from tpu_operator.kube.objects import new_object
+
+        ratio = 1.0 if k <= 0 else round(min(3.0, 1.4 + 0.4 * (k - 1)), 3)
+        members = self._members()
+        artifact = json.dumps({
+            "hosts": len(members),
+            "gang_step_p50_s": round(0.5 * ratio, 3),
+            "straggler_ratio": ratio,
+            "slowest_host": victim,
+        }, sort_keys=True)
+        name = f"{self.slice_name}-gang"
+        patch = {"metadata": {"annotations": {_consts.GANG_TELEMETRY_ANNOTATION: artifact}}}
+        try:
+            self.client.patch("v1", "ConfigMap", name, patch, self.namespace)
+        except errors.NotFound:
+            obj = new_object("v1", "ConfigMap", name, self.namespace, data={})
+            obj["metadata"]["labels"] = {"app.kubernetes.io/managed-by": "tpu-slice-manager"}
+            obj["metadata"]["annotations"] = {_consts.GANG_TELEMETRY_ANNOTATION: artifact}
+            try:
+                self.client.create(obj)  # tpuop-lint: ignore
+            except errors.AlreadyExists:
+                pass
+        return ratio
 
     # -- fault application ---------------------------------------------------
 
@@ -510,12 +616,22 @@ class GangFaultSchedule:
             except errors.AlreadyExists:
                 pass
             return name
-        if not members:
-            return None
         if cls == "host-death":
-            victim = self._rng.choice(members)["metadata"]["name"]
+            # A precursor window pre-chooses the victim at window open;
+            # the kill then lands on that node even if the gang already
+            # migrated off it (that escape IS the predictive-health win,
+            # and skipping the re-draw keeps the RNG stream identical
+            # whether or not anything reacted to the precursors).
+            victim = self._victim_next
+            self._victim_next = None
+            if victim is None:
+                if not members:
+                    return None
+                victim = self._rng.choice(members)["metadata"]["name"]
             self._patch_node_labels(victim, {_consts.TPU_HEALTH_LABEL: _consts.HEALTH_DEGRADED})
             return victim
+        if not members:
+            return None
         if cls == "grey-failure":
             victim = self._rng.choice(members)["metadata"]["name"]
             self._patch_node_labels(victim, {_consts.TPU_PERF_LABEL: _consts.PERF_DEGRADED})
@@ -540,6 +656,10 @@ class GangFaultSchedule:
         cls, detail = active["class"], active["detail"]
         if cls == "host-death":
             self._patch_node_labels(detail, {_consts.TPU_HEALTH_LABEL: _consts.HEALTH_HEALTHY})
+            if self.precursor_passes > 0:
+                # retire the precursor artifact with the host, else the
+                # stale straggler blame pins risk on a healed node
+                self._emit_straggler_artifact(detail, 0)
         elif cls == "grey-failure":
             self._patch_node_labels(detail, {_consts.TPU_PERF_LABEL: None})
         elif cls == "link-cut":

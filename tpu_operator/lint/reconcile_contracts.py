@@ -83,13 +83,15 @@ _SHARED_KEY_CONST_NAMES = (
     "JOB_PROGRESS_WORLD", "JOB_PROGRESS_STATUS", "JOB_PROGRESS_ERROR",
     "JOB_PROGRESS_CHECKPOINT_ACK", "JOB_PROGRESS_RESTART_ACK",
     "JOB_CHECKPOINT_REQUEST", "JOB_RESTART_REQUEST", "JOB_DEFRAG_REQUEST",
+    "JOB_RISK_MIGRATE_REQUEST",
     "SERVING_LOAD_ARRIVAL_RATE", "SERVING_LOAD_QUEUE_DEPTH",
     "SERVING_LOAD_TTFT_P50", "SERVING_LOAD_TTFT_P99",
     "SERVING_LOAD_TOKENS_PER_S", "SERVING_LOAD_PREFILL_TTFT_P99",
     "SERVING_LOAD_DECODE_TOKENS_PER_S", "SERVING_LOAD_KV_HIT_RATIO",
     "SERVING_LOAD_HANDOFF_BYTES",
     "SERVING_ROUTING_KEY", "SERVING_POOLS_KEY",
-    "DEFRAG_STATE_KEY", "AUTOTUNE_WINNERS_KEY", "PERF_FLOORS_KEY",
+    "DEFRAG_STATE_KEY", "RISK_STATE_KEY", "AUTOTUNE_WINNERS_KEY",
+    "PERF_FLOORS_KEY",
     "COMPILE_PREWARM_REQUEST_KEY", "COMPILE_PREWARM_ACK_KEY",
 )
 _SHARED_KEY_PREFIX_NAMES = ("JOB_RENDEZVOUS_PREFIX",)

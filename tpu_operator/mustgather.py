@@ -398,6 +398,67 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("plan.txt", f"# collection failed: {e}\n")
 
     try:
+        # the predictive-health view: every host the risk scorer is
+        # currently tracking (score + which signals put it there + the
+        # state of its migration budget) and the last planned
+        # migrations with their predicted-vs-realized verdicts — where
+        # "why did my job just move / should I trust the scorer" starts
+        import json as _json
+
+        from tpu_operator import consts as _consts
+
+        lines = ["# per-host risk (score over threshold => proactive migration)"]
+        lines.append(f"# threshold={_consts.RISK_THRESHOLD}  decay={_consts.RISK_DECAY}")
+        state_cm = client.get_or_none(
+            "v1", "ConfigMap", _consts.RISK_STATE_CONFIGMAP, namespace
+        )
+        raw = ((state_cm or {}).get("data") or {}).get(_consts.RISK_STATE_KEY)
+        state = {}
+        if raw:
+            try:
+                state = _json.loads(raw) or {}
+            except ValueError:
+                lines.append("# risk.json malformed")
+        hosts = state.get("hosts") or {}
+        for host in sorted(hosts):
+            entry = hosts[host] or {}
+            parts = entry.get("signals") or {}
+            signal_txt = " ".join(
+                f"{k}={parts[k]}" for k in sorted(parts)
+            ) or "(decaying; no fresh signal)"
+            budget = ""
+            if entry.get("attempts"):
+                budget = (
+                    f"  budget: attempts={entry.get('attempts')}"
+                    f" nextAttemptAt={entry.get('nextAttemptAt')}"
+                )
+            lines.append(f"{host}  score={entry.get('score')}  {signal_txt}{budget}")
+        if not hosts:
+            lines.append("# none at risk")
+        lines.append("")
+        lines.append(
+            f"# last {_consts.RISK_MIGRATIONS_LIMIT} planned migrations "
+            "(newest last; predicted vs realized)"
+        )
+        migrations = state.get("migrations") or []
+        for m in migrations[-_consts.RISK_MIGRATIONS_LIMIT:]:
+            if m.get("settled"):
+                verdict = "realized" if m.get("realized") else "false-alarm"
+            else:
+                verdict = "(in flight)"
+            lines.append(
+                f"{m.get('host', '?')}  owner={m.get('owner_kind', '?')}/"
+                f"{m.get('owner_name', '?')}  slice={m.get('slice', '?')}  "
+                f"score={m.get('score')}  token={m.get('token') or '(drain)'}  "
+                f"requested_at={m.get('requested_at')}  {verdict}"
+            )
+        if not migrations:
+            lines.append("# none")
+        emit("risk.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("risk.txt", f"# collection failed: {e}\n")
+
+    try:
         # the data-plane telemetry view: fleet rollup (per-node perf
         # labels + generation/chips), the operator-published floor
         # table, and every gang's step-time artifact — where "why is

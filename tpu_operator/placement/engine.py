@@ -456,12 +456,21 @@ class PlacementEngine:
         nodes: Sequence[ObjectDict],
         degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
         scorer=None,
+        node_risk: Optional[Dict[str, float]] = None,
     ):
         # optional placement-policy hook threaded into every clean-fit
         # find_block call (torus.find_block's scorer slot) — the fleet
         # simulator's defrag-aware policy rides it; None keeps the
         # allocator's stock best-fit ranking
         self.scorer = scorer
+        # risk-aware scoring (the predictive-health hook): per-host
+        # scores from the risk scorer's state CM. A candidate block's
+        # summed member risk ranks AHEAD of the policy/exposure key both
+        # within and across pools, so a new gang avoids high-risk hosts
+        # whenever a clean alternative exists — but risk never makes a
+        # placeable shape unplaceable (a risky block still beats no
+        # block). Empty/None reproduces the stock ranking exactly.
+        self.node_risk = dict(node_risk or {})
         self.slices = {s["metadata"]["name"]: s for s in slices}
         self.nodes = {n["metadata"]["name"]: n for n in nodes}
         self.requests: Dict[str, PlacementRequest] = {}
@@ -607,6 +616,28 @@ class PlacementEngine:
             return [req.pool] if req.pool in self.pools else []
         return sorted(self.pools)
 
+    def _block_risk(self, torus, cells) -> float:
+        return round(
+            sum(self.node_risk.get(torus.node_at[c], 0.0) for c in cells), 6
+        )
+
+    def _pool_scorer(self, torus):
+        """The per-pool find_block scorer with the risk bias folded in:
+        candidates rank by summed member risk FIRST, then whatever the
+        policy hook says (tuple-valued scores are legal — find_block
+        only ever compares scores from the same call). With no risk
+        scores the stock hook passes through untouched, preserving the
+        allocator's snug-clean-fit early exit."""
+        if not self.node_risk:
+            return self.scorer
+        base = self.scorer
+
+        def score(origin, oriented, cells):
+            hazard = self._block_risk(torus, cells)
+            return (hazard, base(origin, oriented, cells) if base else 0.0)
+
+        return score
+
     def _try_place(self, req: PlacementRequest, plan: Plan, scheduled: Dict[str, str]) -> None:
         shape = parse_shape(req.shape)
         if shape is None:
@@ -616,15 +647,17 @@ class PlacementEngine:
             )
             return
         pools = self._candidate_pools(req)
-        # clean fit first: ranked across pools by the allocator's own key
+        # clean fit first: ranked across pools by summed member risk
+        # (the predictive-health bias — 0.0 everywhere when no scores
+        # are loaded), then the allocator's own key
         best = None
         for pool_name in pools:
             _, torus = self.pools[pool_name]
-            found = torus.find_block(shape, scorer=self.scorer)
+            found = torus.find_block(shape, scorer=self._pool_scorer(torus))
             if found is None:
                 continue
             block, _ = found
-            key = (block.exposure, pool_name)
+            key = (self._block_risk(torus, block.cells), block.exposure, pool_name)
             if best is None or key < best[0]:
                 best = (key, pool_name, block)
         victims: frozenset = frozenset()

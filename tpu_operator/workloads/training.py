@@ -338,6 +338,7 @@ class InProcessJobRunner:
         self._fail_at = tuple(fail_at_steps)
         self.trainer: Optional[ResumableTrainer] = None
         self._errored = False
+        self._migration_acked = False  # this generation is being moved
 
     # -- cluster reads -------------------------------------------------------
 
@@ -447,8 +448,23 @@ class InProcessJobRunner:
             trainer.checkpoint()
             data[consts.JOB_PROGRESS_CHECKPOINT_ACK] = request
             actions["checkpointed"] = trainer.checkpoint_epoch
+        # hold at a planned-MIGRATION barrier (defrag-/risk- tokens): the
+        # controller is about to tear this gang down, and any step run
+        # past the acked checkpoint would be re-executed by the next pod
+        # generation — exactly the lost work the barrier exists to avoid.
+        # The controller clears the key when it honors the barrier (or
+        # when a fault auto-satisfies it) for the NEXT generation; this
+        # generation stays held for the rest of its life (the re-placed
+        # gang can come up before this pod is reaped, and a zombie
+        # worker must not steal steps past its own barrier checkpoint).
+        # Grow barriers don't hold — the resize lands without a teardown.
+        if request.startswith(("defrag-", "risk-")):
+            self._migration_acked = True
+        hold = self._migration_acked
+        if hold:
+            actions["held"] = request
         status = consts.JOB_PROGRESS_RUNNING
-        if not trainer.done and not self._errored:
+        if not hold and not trainer.done and not self._errored:
             try:
                 actions["steps"] = trainer.run(self.steps_per_sync)
             except TrainerError as e:
