@@ -144,6 +144,16 @@ echo "== predict smoke: risk-scored host walked off before it dies =="
 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --predict-smoke
 echo "== predict smoke (racecheck leg): the same gate under instrumented locks =="
 TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --predict-smoke
+echo "== tenant smoke: fair-share bounds the small team the stock order starves =="
+# multi-tenant fairness gate: on the seeded two-tenant contention
+# schedule (512 sim hosts) the stock priority-then-FIFO order starves
+# the small team (p99 time-to-place at least doubles the fair run's,
+# or gangs never place); equal guaranteed TPUQuotas bound the small
+# team's p99 and place every gang, at no fleet-utilization cost vs the
+# untagged single-tenant baseline; zero TPUQuota stays byte-identical
+JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --tenant-smoke
+echo "== tenant smoke (racecheck leg): the same gate under instrumented locks =="
+TPUOP_RACECHECK=1 JAX_PLATFORMS=cpu BENCH_SKIP_DEVICE=1 python3 bench.py --tenant-smoke
 echo "== chaos smoke: install -> Ready through the seeded fault schedule =="
 # bounded chaos-soak gate: converge through 5xx/429/410/resets, periodic
 # watch drops, and a full-outage window; fails if any configured fault

@@ -354,7 +354,7 @@ class TestChart:
             values = yaml.safe_load(f)
         objs = render_chart(values)
         kinds = [o["kind"] for o in objs]
-        assert kinds.count("CustomResourceDefinition") == 4
+        assert kinds.count("CustomResourceDefinition") == 5
         for kind in ("Namespace", "ServiceAccount", "ClusterRole", "ClusterRoleBinding",
                      "Deployment", "ClusterPolicy"):
             assert kind in kinds, kind
@@ -405,7 +405,8 @@ class TestTpuopCfg:
         docs = list(yaml.safe_load_all(capsys.readouterr().out))
         assert {d["metadata"]["name"] for d in docs} == {
             "clusterpolicies.tpu.google.com", "tpuslices.tpu.google.com",
-            "tpujobs.tpu.google.com", "tpuservings.tpu.google.com"}
+            "tpujobs.tpu.google.com", "tpuservings.tpu.google.com",
+            "tpuquotas.tpu.google.com"}
 
     def test_render(self, capsys):
         assert tpuop_cfg.main(["render", "--values", "deploy/values.yaml"]) == 0

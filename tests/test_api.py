@@ -101,13 +101,14 @@ class TestTPUSlice:
 class TestCRDs:
     def test_crds_generate_and_serialize(self):
         crds = all_crds()
-        assert len(crds) == 4
+        assert len(crds) == 5
         names = {c["metadata"]["name"] for c in crds}
         assert names == {
             "clusterpolicies.tpu.google.com",
             "tpuslices.tpu.google.com",
             "tpujobs.tpu.google.com",
             "tpuservings.tpu.google.com",
+            "tpuquotas.tpu.google.com",
         }
         # must be valid YAML round-trippable structures
         for crd in crds:

@@ -1,0 +1,557 @@
+"""Multi-tenant fairness (ISSUE 20): TPUQuota parsing fails closed, the
+DRF fair-share model (hierarchy rollup, ordering, preemption legality),
+the placement engine's preemption economy and its zero-TPUQuota
+byte-identity contract, the tenancy ledger, the tenancy controller's
+accounting/series lifecycle, and the fleet-sim fairness drills the
+bench gates replay (``bench.py --tenant-smoke``).
+"""
+
+import copy
+import dataclasses
+import json
+
+import prometheus_client
+
+from tpu_operator import consts
+from tpu_operator.api.tpuquota import (
+    TPU_QUOTA_API_VERSION,
+    TPU_QUOTA_KIND,
+    new_tpu_quota,
+)
+from tpu_operator.api.tpuslice import new_tpu_slice
+from tpu_operator.controllers.placement_controller import (
+    QUEUE_REQUEST,
+    PlacementReconciler,
+)
+from tpu_operator.controllers.tenancy_controller import (
+    TENANCY_REQUEST,
+    TenancyReconciler,
+)
+from tpu_operator.kube import errors
+from tpu_operator.kube.fake import FakeClient
+from tpu_operator.kube.objects import new_object
+from tpu_operator.kube.sim import GangChurnSchedule, make_torus_nodes
+from tpu_operator.placement.engine import (
+    PlacementEngine,
+    PlacementPhase,
+    PreemptionPolicy,
+)
+from tpu_operator.tenancy import ledger as ledger_mod
+from tpu_operator.tenancy.fairshare import (
+    FairSharePolicy,
+    capacity_by_generation,
+    parse_quota,
+    policy_from_objects,
+    resolve_tenant,
+    usage_from_slices,
+)
+
+NS = "tpu-operator"
+
+
+def quota(name, tenant, weight=1.0, guaranteed=None):
+    return new_tpu_quota(
+        name,
+        {"tenant": tenant, "weight": weight, "guaranteed": guaranteed or {}},
+    )
+
+
+def tenant_slice(name, shape, tenant="", priority=0, policy="Never", created=""):
+    obj = new_tpu_slice(
+        name,
+        {"placement": {
+            "shape": shape, "priority": priority, "preemptionPolicy": policy,
+        }},
+    )
+    obj["metadata"]["creationTimestamp"] = created or "2026-01-01T00:00:00Z"
+    if tenant:
+        obj["metadata"].setdefault("labels", {})[consts.TENANT_LABEL] = tenant
+    return obj
+
+
+def apply_plan(plan, nodes, slices):
+    """Apply a plan back onto the in-memory objects, the way the
+    controller would against the apiserver."""
+    by_name = {n["metadata"]["name"]: n for n in nodes}
+    for node_name, delta in plan.label_deltas.items():
+        labels = by_name[node_name]["metadata"].setdefault("labels", {})
+        for key, value in delta.items():
+            if value is None:
+                labels.pop(key, None)
+            else:
+                labels[key] = value
+    for s in slices:
+        if s["metadata"]["name"] in plan.statuses:
+            s.setdefault("status", {})["placement"] = plan.statuses[s["metadata"]["name"]]
+
+
+# ---------------------------------------------------------------------------
+# TPUQuota parsing: malformed grants nothing
+# ---------------------------------------------------------------------------
+
+
+class TestParseQuota:
+    def test_well_formed(self):
+        entry = parse_quota(quota("q", "acme.search", weight=2.0, guaranteed={"v4": 8}))
+        assert entry is not None
+        assert entry.tenant == "acme.search"
+        assert entry.weight == 2.0
+        assert entry.guaranteed_map == {"v4": 8}
+        assert entry.name == "q"
+
+    def test_defaults(self):
+        entry = parse_quota(new_tpu_quota("q", {"tenant": "acme"}))
+        assert entry is not None and entry.weight == 1.0 and entry.guaranteed == ()
+
+    def test_tenant_normalizes(self):
+        assert parse_quota(quota("q", "  acme. ")).tenant == "acme"
+
+    def test_malformed_specs_parse_to_none(self):
+        bad = [
+            new_tpu_quota("q"),                                   # no tenant
+            quota("q", ""),                                       # empty tenant
+            quota("q", "a", weight=0),                            # zero weight
+            quota("q", "a", weight=-2.0),                         # negative weight
+            quota("q", "a", weight="nan"),                        # non-finite weight
+            quota("q", "a", weight="heavy"),                      # non-numeric weight
+            quota("q", "a", guaranteed={"v4": -4}),               # negative chips
+            quota("q", "a", guaranteed={"v4": True}),             # bool chips
+            quota("q", "a", guaranteed={"v4": "lots"}),           # non-int chips
+            {"metadata": {"name": "q"}, "spec": {"tenant": "a", "guaranteed": [4]}},
+            {"metadata": {"name": "q"}, "spec": "yes please"},    # spec not a map
+        ]
+        for obj in bad:
+            assert parse_quota(obj) is None, obj
+
+    def test_policy_from_objects_fails_closed(self):
+        cap = {"v4": 32}
+        assert policy_from_objects([], cap) is None
+        assert policy_from_objects([quota("q", "")], cap) is None
+        # a malformed quota next to a valid one grants nothing itself
+        policy = policy_from_objects([quota("bad", ""), quota("ok", "acme")], cap)
+        assert policy is not None and set(policy.quotas) == {"acme"}
+
+    def test_duplicate_tenants_resolve_to_first_source_object(self):
+        policy = FairSharePolicy(
+            [parse_quota(quota("zz", "acme", weight=5.0)),
+             parse_quota(quota("aa", "acme", weight=2.0))],
+            {"v4": 32},
+        )
+        assert policy.quotas["acme"].name == "aa"
+
+    def test_resolve_tenant_precedence(self):
+        obj = tenant_slice("s", "2x2x1", tenant="from-label")
+        obj["spec"]["placement"]["tenant"] = "from-spec"
+        assert resolve_tenant(obj) == "from-label"
+        del obj["metadata"]["labels"][consts.TENANT_LABEL]
+        assert resolve_tenant(obj) == "from-spec"
+        del obj["spec"]["placement"]["tenant"]
+        assert resolve_tenant(obj) == ""
+
+
+# ---------------------------------------------------------------------------
+# the DRF model: hierarchy rollup, ordering, legality
+# ---------------------------------------------------------------------------
+
+
+class TestHierarchy:
+    def _policy(self):
+        return policy_from_objects(
+            [quota("q-org", "acme", weight=2.0, guaranteed={"v4": 16}),
+             quota("q-team", "acme.search", weight=1.0, guaranteed={"v4": 8})],
+            {"v4": 32},
+        )
+
+    def test_usage_rolls_up_to_ancestors(self):
+        policy = self._policy()
+        used = {"acme.search": {"v4": 6}, "acme.ads": {"v4": 4}}
+        assert policy.level_usage(used, "acme") == {"v4": 10}
+        assert policy.level_usage(used, "acme.search") == {"v4": 6}
+
+    def test_headroom_is_the_tightest_declared_level(self):
+        policy = self._policy()
+        used = {"acme.search": {"v4": 6}, "acme.ads": {"v4": 4}}
+        # own level leaves 2, the org level leaves 6: the min binds
+        assert policy.guaranteed_headroom("acme.search", used, "v4") == 2
+        # no team quota: only the org guarantee binds
+        assert policy.guaranteed_headroom("acme.ads", used, "v4") == 6
+        # nothing declared anywhere: an undeclared tenant only borrows
+        assert policy.guaranteed_headroom("freeloader", used, "v4") == 0
+
+    def test_weight_comes_from_the_nearest_declared_level(self):
+        policy = self._policy()
+        assert policy.weight("acme.search") == 1.0
+        assert policy.weight("acme.ads") == 2.0  # inherits the org weight
+        assert policy.weight("freeloader") == 1.0
+
+    def test_borrowed_chips(self):
+        policy = self._policy()
+        used = {"acme.search": {"v4": 10}, "acme.ads": {"v4": 4}}
+        assert policy.borrowed_chips("acme.search", used) == 2  # 10 held, 8 owned
+        # declared ancestry but no own quota: everything it holds is borrowed
+        assert policy.borrowed_chips("acme.ads", used) == 4
+
+    def test_order_key_tiers(self):
+        policy = self._policy()
+        used = {"acme.search": {"v4": 6}, "beta": {"v4": 16}}
+        demand = (("v4", 2),)
+
+        def key(tenant, priority=0, created="t0", name="g"):
+            return policy.order_key(tenant, used, demand, priority, created, name)
+
+        # guaranteed headroom admits before any borrower, share regardless
+        assert key("acme.search") < key("beta", priority=9)
+        # among borrowers the smaller weighted dominant share goes first:
+        # beta holds 16/32 at weight 1; acme holds 6/32 at weight 2
+        big = (("v4", 30),)  # fits nobody's guarantee
+        assert (policy.order_key("acme", used, big, 0, "t0", "g")
+                < policy.order_key("beta", used, big, 0, "t0", "g"))
+        # equal tenant: priority then FIFO
+        assert key("beta", priority=5) < key("beta", priority=1)
+        assert key("beta", created="t1") < key("beta", created="t2")
+
+    def test_preemption_legality_table(self):
+        policy = self._policy()
+        demand = (("v4", 8),)
+        # victim's owner is borrowing: fair game
+        used = {"acme.search": {"v4": 10}, "beta": {"v4": 4}}
+        assert policy.preemption_legal("beta", "acme.search", used, demand)
+        # victim protected, preemptor lands inside ITS guarantee: legal
+        used = {"acme.search": {"v4": 6}, "acme.ads": {"v4": 0}}
+        assert policy.preemption_legal("acme.ads", "acme.search", used, (("v4", 2),))
+        # victim protected, preemptor would borrow: NEVER (the pinned row)
+        used = {"acme.search": {"v4": 6}, "beta": {"v4": 0}}
+        assert not policy.preemption_legal("beta", "acme.search", used, demand)
+        # a victim with no declared quota anywhere is never protected
+        used = {"freeloader": {"v4": 2}, "beta": {"v4": 0}}
+        assert policy.preemption_legal("beta", "freeloader", used, demand)
+
+
+# ---------------------------------------------------------------------------
+# the engine: zero TPUQuota is byte-identical, the economy reclaims
+# borrowers and never protected gangs
+# ---------------------------------------------------------------------------
+
+
+class TestEngineTenancy:
+    def test_no_quota_plans_byte_identical(self):
+        nodes = make_torus_nodes((2, 2, 2))
+        slices = [
+            tenant_slice("a", "2x2x1", tenant="acme", created="2026-01-01T00:00:01Z"),
+            tenant_slice("b", "2x2x2", tenant="beta", priority=3,
+                         policy=PreemptionPolicy.PREEMPT_LOWER,
+                         created="2026-01-01T00:00:02Z"),
+            tenant_slice("c", "2x2x1", created="2026-01-01T00:00:03Z"),
+        ]
+        stock = PlacementEngine(copy.deepcopy(slices), copy.deepcopy(nodes)).plan()
+        # malformed-only quota set: policy is None, the engine takes the
+        # stock path — the fail-closed contract, not merely similar output
+        policy = policy_from_objects([quota("junk", "")], capacity_by_generation(nodes))
+        assert policy is None
+        tenanted = PlacementEngine(
+            copy.deepcopy(slices), copy.deepcopy(nodes), tenancy=policy
+        ).plan()
+        assert dataclasses.asdict(tenanted) == dataclasses.asdict(stock)
+
+    def _seat(self, slices, nodes, policy=None):
+        engine = PlacementEngine(slices, nodes, tenancy=policy)
+        plan = engine.plan()
+        apply_plan(plan, nodes, slices)
+        return plan
+
+    def test_borrow_then_reclaim(self):
+        # 8-host v4 cube, 4 chips/host = 32 chips. team-a is guaranteed
+        # 8 but seats a 16-chip gang: 8 chips borrowed. team-b's
+        # priority-1 pod-filling gang reclaims them.
+        nodes = make_torus_nodes((2, 2, 2))
+        cap = capacity_by_generation(nodes)
+        policy = policy_from_objects(
+            [quota("qa", "team-a", guaranteed={"v4": 8}),
+             quota("qb", "team-b", guaranteed={"v4": 16})], cap,
+        )
+        borrower = tenant_slice("gang-a", "2x2x1", tenant="team-a",
+                                created="2026-01-01T00:00:01Z")
+        self._seat([borrower], nodes, policy)
+        reclaimer = tenant_slice("gang-b", "2x2x2", tenant="team-b", priority=1,
+                                 policy=PreemptionPolicy.PREEMPT_LOWER,
+                                 created="2026-01-01T00:00:02Z")
+        plan = PlacementEngine([borrower, reclaimer], nodes, tenancy=policy).plan()
+        assert plan.statuses["gang-b"]["phase"] == PlacementPhase.SCHEDULED
+        assert plan.statuses["gang-a"]["phase"] == PlacementPhase.QUEUED
+        assert plan.teardowns == ["gang-a"]
+        assert len(plan.preemption_decisions) == 1
+        decision = plan.preemption_decisions[0]
+        assert decision["victim"] == "gang-a"
+        assert decision["victimTenant"] == "team-a"
+        assert decision["preemptor"] == "gang-b"
+        assert decision["preemptorTenant"] == "team-b"
+        assert decision["borrowed"] is True  # the ledger's reclaim marker
+
+    def test_protected_gang_never_feeds_a_borrower(self):
+        # the pinned acceptance row: team-a sits wholly inside its
+        # guarantee; team-b (no guarantee) out-prioritizes it. The stock
+        # engine evicts; the economy refuses.
+        nodes = make_torus_nodes((2, 2, 2))
+        cap = capacity_by_generation(nodes)
+        policy = policy_from_objects(
+            [quota("qa", "team-a", guaranteed={"v4": 16}),
+             quota("qb", "team-b", weight=4.0)], cap,
+        )
+        protected = tenant_slice("gang-a", "2x2x1", tenant="team-a",
+                                 created="2026-01-01T00:00:01Z")
+        self._seat([protected], nodes, policy)
+        contender = tenant_slice("gang-b", "2x2x2", tenant="team-b", priority=9,
+                                 policy=PreemptionPolicy.PREEMPT_LOWER,
+                                 created="2026-01-01T00:00:02Z")
+        stock = PlacementEngine(
+            copy.deepcopy([protected, contender]), copy.deepcopy(nodes)
+        ).plan()
+        assert stock.statuses["gang-b"]["phase"] == PlacementPhase.SCHEDULED
+        assert stock.statuses["gang-a"]["phase"] == PlacementPhase.QUEUED
+        fair = PlacementEngine([protected, contender], nodes, tenancy=policy).plan()
+        assert fair.statuses["gang-a"]["phase"] == PlacementPhase.SCHEDULED
+        assert fair.statuses["gang-b"]["phase"] != PlacementPhase.SCHEDULED
+        assert fair.teardowns == []
+        assert fair.preemption_decisions == []
+
+
+# ---------------------------------------------------------------------------
+# the ledger: bounded, auditable, fail-closed
+# ---------------------------------------------------------------------------
+
+
+class _Outage(FakeClient):
+    """Every ConfigMap verb 500s — the apiserver outage the K003
+    fail-closed contract is about."""
+
+    def get(self, api_version, kind, name, namespace=None):
+        if kind == "ConfigMap":
+            raise errors.ApiError("cm get: 500")
+        return super().get(api_version, kind, name, namespace)
+
+    def patch(self, api_version, kind, name, patch, namespace=None):
+        if kind == "ConfigMap":
+            raise errors.ApiError("cm patch: 500")
+        return super().patch(api_version, kind, name, patch, namespace)
+
+
+class TestLedger:
+    def test_missing_cm_is_a_fresh_ledger(self):
+        ledger = ledger_mod.read_ledger(FakeClient(), NS)
+        assert ledger == {"decisions": [], "placements": {}}
+
+    def test_garbage_payload_starts_fresh_not_crash(self):
+        client = FakeClient()
+        client.create(new_object(
+            "v1", "ConfigMap", consts.TENANCY_LEDGER_CONFIGMAP, NS,
+            data={
+                consts.TENANCY_DECISIONS_KEY: "not json {",
+                consts.TENANCY_PLACEMENTS_KEY: json.dumps({"a": "not-a-ring"}),
+            },
+        ))
+        ledger = ledger_mod.read_ledger(client, NS)
+        assert ledger == {"decisions": [], "placements": {}}
+
+    def test_unreadable_ledger_fails_closed(self):
+        client = _Outage()
+        assert ledger_mod.read_ledger(client, NS) is None
+        ledger = {"decisions": [], "placements": {}}
+        booked = ledger_mod.book(
+            client, NS, ledger, decisions=[{"victim": "g"}], now=1.0
+        )
+        assert booked is False  # caller requeues; the eviction stays auditable
+
+    def test_book_appends_and_bounds(self):
+        client = FakeClient()
+        ledger = ledger_mod.read_ledger(client, NS)
+        decisions = [
+            {"victim": f"g{i}", "victimTenant": "a", "preemptor": "p",
+             "preemptorTenant": "b"}
+            for i in range(consts.TENANCY_DECISIONS_LIMIT + 5)
+        ]
+        assert ledger_mod.book(client, NS, ledger, decisions=decisions, now=9.0)
+        reread = ledger_mod.read_ledger(client, NS)
+        assert len(reread["decisions"]) == consts.TENANCY_DECISIONS_LIMIT
+        assert reread["decisions"][-1]["victim"] == decisions[-1]["victim"]
+        assert reread["decisions"][-1]["at"] == 9.0
+        newest = ledger_mod.last_decisions(reread, count=2)
+        assert [d["victim"] for d in newest] == [
+            decisions[-1]["victim"], decisions[-2]["victim"]
+        ]
+
+    def test_sample_ring_bounds_and_p99(self):
+        client = FakeClient()
+        ledger = ledger_mod.read_ledger(client, NS)
+        samples = [("acme", float(s)) for s in range(
+            consts.TENANCY_PLACEMENT_SAMPLES_LIMIT + 10
+        )]
+        assert ledger_mod.book(client, NS, ledger, samples=samples)
+        reread = ledger_mod.read_ledger(client, NS)
+        ring = reread["placements"]["acme"]
+        assert len(ring) == consts.TENANCY_PLACEMENT_SAMPLES_LIMIT
+        assert ledger_mod.place_p99(reread, "acme") >= ring[-2]
+        assert ledger_mod.place_p99(reread, "nobody") is None
+
+
+# ---------------------------------------------------------------------------
+# the tenancy controller: accounting, Invalid fail-closed, O005 series
+# retirement, fail-closed inputs
+# ---------------------------------------------------------------------------
+
+
+def _tenant_series(metric_name):
+    for metric in prometheus_client.REGISTRY.collect():
+        if metric.name == metric_name:
+            return {s.labels.get("tenant"): s.value for s in metric.samples}
+    return {}
+
+
+class TestTenancyController:
+    def _cluster(self):
+        client = FakeClient()
+        nodes = make_torus_nodes((2, 2, 1))  # 4 hosts x 4 chips = 16 v4 chips
+        for node in nodes:
+            client.create(node)
+        from tpu_operator.nodepool import get_node_pools
+
+        pool = get_node_pools(nodes)[0].name
+        seated = tenant_slice("gang-a", "2x2x1", tenant="acme.search")
+        seated["status"] = {"placement": {
+            "phase": "Scheduled", "pool": pool,
+            "nodes": [n["metadata"]["name"] for n in nodes],
+        }}
+        client.create(seated)
+        return client, nodes
+
+    def test_accounting_publishes_to_status(self):
+        client, _ = self._cluster()
+        client.create(quota("q-org", "acme", weight=2.0, guaranteed={"v4": 16}))
+        client.create(quota("q-team", "acme.search", guaranteed={"v4": 8}))
+        rec = TenancyReconciler(client, NS)
+        rec.reconcile(TENANCY_REQUEST)
+        org = client.get(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND, "q-org")["status"]
+        team = client.get(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND, "q-team")["status"]
+        assert org["state"] == "Active" and team["state"] == "Active"
+        # the 16-chip gang rolls up to both levels; the team is 8 over
+        # its own guarantee (borrowing), the org is exactly full
+        assert org["tenancy"]["usedChips"] == 16
+        assert org["tenancy"]["borrowedChips"] == 0
+        assert org["tenancy"]["withinGuarantee"] is True
+        assert team["tenancy"]["usedChips"] == 16
+        assert team["tenancy"]["borrowedChips"] == 8
+        assert team["tenancy"]["withinGuarantee"] is False
+        assert team["tenancy"]["dominantShare"] == 1.0  # 16/16 v4 chips
+
+    def test_malformed_quota_goes_invalid_and_grants_nothing(self):
+        client, _ = self._cluster()
+        client.create(quota("q-bad", "acme", weight=-1.0))
+        rec = TenancyReconciler(client, NS)
+        rec.reconcile(TENANCY_REQUEST)
+        status = client.get(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND, "q-bad")["status"]
+        assert status["state"] == "Invalid"
+        assert "malformed" in status["tenancy"]["reason"]
+
+    def test_deleted_quota_retires_its_series(self):
+        client, _ = self._cluster()
+        client.create(quota("q-team", "acme.search", guaranteed={"v4": 8}))
+        rec = TenancyReconciler(client, NS)
+        rec.reconcile(TENANCY_REQUEST)
+        assert _tenant_series("tpu_operator_tenant_used_chips").get(
+            "acme.search"
+        ) == 16.0
+        client.delete(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND, "q-team")
+        client.delete("tpu.google.com/v1alpha1", "TPUSlice", "gang-a")
+        rec.reconcile(TENANCY_REQUEST)
+        # O005: a deleted tenant must not export its last value forever
+        assert "acme.search" not in _tenant_series("tpu_operator_tenant_used_chips")
+        assert "acme.search" not in _tenant_series("tpu_operator_tenant_fair_share")
+
+    def test_unlistable_inputs_abort_the_pass(self):
+        class Down(FakeClient):
+            def list(self, api_version, kind, namespace=None,
+                     label_selector=None, field_selector=None):
+                raise errors.ApiError("apiserver down")
+
+        result = TenancyReconciler(Down(), NS).reconcile(TENANCY_REQUEST)
+        assert result.requeue is True
+
+
+# ---------------------------------------------------------------------------
+# placement controller: the pass books its economy into the ledger
+# ---------------------------------------------------------------------------
+
+
+class TestPlacementBooking:
+    def test_pass_books_samples_and_decisions(self):
+        client = FakeClient()
+        for node in make_torus_nodes((2, 2, 2)):
+            client.create(node)
+        client.create(quota("qa", "team-a", guaranteed={"v4": 8}))
+        client.create(quota("qb", "team-b", guaranteed={"v4": 16}))
+        client.create(tenant_slice("gang-a", "2x2x1", tenant="team-a",
+                                   created="2026-01-01T00:00:01Z"))
+        rec = PlacementReconciler(client, NS)
+        rec.reconcile(QUEUE_REQUEST)
+        ledger = ledger_mod.read_ledger(client, NS)
+        assert list(ledger["placements"]) == ["team-a"]  # time-to-place sample
+        assert ledger["decisions"] == []
+        client.create(tenant_slice("gang-b", "2x2x2", tenant="team-b", priority=1,
+                                   policy=PreemptionPolicy.PREEMPT_LOWER,
+                                   created="2026-01-01T00:00:02Z"))
+        rec.reconcile(QUEUE_REQUEST)
+        ledger = ledger_mod.read_ledger(client, NS)
+        assert [d["victim"] for d in ledger["decisions"]] == ["gang-a"]
+        assert ledger["decisions"][0]["borrowed"] is True
+        assert "team-b" in ledger["placements"]
+
+
+# ---------------------------------------------------------------------------
+# the fleet-sim drills: tag isolation, no-quota identity, weight tracking
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSimFairness:
+    def test_tenant_tags_ride_a_separate_rng_stream(self):
+        untagged = GangChurnSchedule(seed=7, ticks=40, arrivals_per_tick=1.0)
+        tagged = GangChurnSchedule(seed=7, ticks=40, arrivals_per_tick=1.0,
+                                   tenants=(("big", 3.0), ("small", 1.0)))
+        assert [e[:5] for e in tagged.log] == untagged.log
+        assert {e[5] for e in tagged.log} == {"big", "small"}
+
+    def test_no_quota_report_identical_to_stock(self):
+        from tpu_operator.planning.sim import FleetSimulator
+
+        def run(tagged):
+            sim = FleetSimulator(dims=(4, 4, 4), policy="defrag-aware",
+                                 migration_cooldown_ticks=2, defrag_every=1)
+            return sim.run(GangChurnSchedule(
+                seed=11, ticks=40, arrivals_per_tick=0.8,
+                shapes=(((2, 2, 1), 3.0), ((2, 2, 2), 1.0)),
+                min_lifetime=10, max_lifetime=30,
+                tenants=(("x", 1.0), ("y", 1.0)) if tagged else None,
+            ), drain_ticks=10)
+
+        with_tags = run(True)
+        with_tags.pop("tenants")  # the only addition tags may make
+        assert with_tags == run(False)
+
+    def test_realized_share_tracks_quota_weights(self):
+        from tpu_operator.planning.sim import FleetSimulator
+
+        # equal offered demand, 3:1 weights, zero guarantees: the
+        # steady-state occupancy split (tail half — the fill-from-empty
+        # transient starts 50/50 regardless of policy) must track the
+        # 75/25 weight-implied split within 10 points
+        sim = FleetSimulator(dims=(8, 8, 8), policy="defrag-aware",
+                             migration_cooldown_ticks=2, defrag_every=1,
+                             quotas={"gold": (3.0, 0), "bronze": (1.0, 0)})
+        report = sim.run(GangChurnSchedule(
+            seed=20260807, ticks=200, arrivals_per_tick=5.0,
+            shapes=(((2, 2, 1), 4.0), ((2, 2, 2), 3.0), ((4, 2, 2), 1.5)),
+            min_lifetime=20, max_lifetime=50, priority_levels=1,
+            tenants=(("gold", 1.0), ("bronze", 1.0)),
+        ), drain_ticks=20)
+        gold = report["tenants"]["gold"]["steady_share_pct"]
+        bronze = report["tenants"]["bronze"]["steady_share_pct"]
+        assert 65.0 <= gold <= 85.0, report["tenants"]
+        assert abs(gold + bronze - 100.0) < 0.1

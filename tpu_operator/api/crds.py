@@ -12,7 +12,7 @@ import dataclasses
 import typing
 from typing import Any, Dict, List, Optional, get_args, get_origin
 
-from tpu_operator.api import clusterpolicy, tpujob, tpuserving, tpuslice
+from tpu_operator.api import clusterpolicy, tpujob, tpuquota, tpuserving, tpuslice
 from tpu_operator.api.common import SpecBase
 
 CRD_API_VERSION = "apiextensions.k8s.io/v1"
@@ -158,5 +158,23 @@ def tpu_serving_crd() -> dict:
     )
 
 
+def tpu_quota_crd() -> dict:
+    return _crd(
+        kind=tpuquota.TPU_QUOTA_KIND,
+        plural="tpuquotas",
+        singular="tpuquota",
+        version="v1alpha1",
+        spec_cls=tpuquota.TPUQuotaSpec,
+        status_cls=tpuquota.TPUQuotaStatus,
+        short_names=["tq"],
+    )
+
+
 def all_crds() -> List[dict]:
-    return [cluster_policy_crd(), tpu_slice_crd(), tpu_job_crd(), tpu_serving_crd()]
+    return [
+        cluster_policy_crd(),
+        tpu_slice_crd(),
+        tpu_job_crd(),
+        tpu_serving_crd(),
+        tpu_quota_crd(),
+    ]

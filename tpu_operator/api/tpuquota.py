@@ -1,0 +1,98 @@
+"""TPUQuota CRD (tpu.google.com/v1alpha1): hierarchical multi-tenant quotas.
+
+A TPUQuota declares one level of the tenant hierarchy — ``spec.tenant``
+is a dotted path ("acme", "acme.search", "acme.search.training": org →
+team → workload class; "/" is illegal in a k8s label value, so the
+hierarchy separator is "."). Each level carries a fair-share ``weight``
+and a ``guaranteed`` map of chips per TPU generation (v4/v5e/v5p/v6e —
+the ``nodeinfo`` generation key). Workloads resolve to a tenant via the
+``tpu.google.com/tenant`` label on TPUSlice/TPUJob/TPUServing (job and
+serving controllers propagate the label onto the slices they own).
+
+Semantics (``tenancy/fairshare.py``):
+
+- Guarantees roll up the hierarchy: "acme.search" usage counts against
+  both its own guarantee and "acme"'s.
+- Borrowing idle capacity beyond the guarantee is allowed, but borrowed
+  chips are reclaimable — a borrower outside every guarantee is a legal
+  cross-tenant preemption victim; a gang inside its owner's guaranteed
+  quota never is (while the preemptor's tenant is over its own).
+- With zero TPUQuota objects in the cluster, placement admission is
+  byte-identical to stock priority-then-FIFO.
+
+The tenancy controller (``controllers/tenancy_controller.py``) publishes
+per-tenant usage/share/borrow accounting into ``status.tenancy`` and the
+``tpu_operator_tenant_*`` gauges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from tpu_operator.api.common import SpecBase, field
+
+TPU_QUOTA_API_VERSION = "tpu.google.com/v1alpha1"
+TPU_QUOTA_KIND = "TPUQuota"
+
+
+@dataclasses.dataclass
+class TPUQuotaSpec(SpecBase):
+    """One hierarchy level. ``tenant`` is the dotted path this quota
+    binds to; ``weight`` scales the tenant's dominant share in the
+    fair-share ordering (2.0 = entitled to twice the share of a
+    weight-1.0 tenant before sorting behind it); ``guaranteed`` maps TPU
+    generation → chips the tenant may hold un-preemptably."""
+
+    tenant: str = field(default="")
+    weight: float = field(default=1.0)
+    guaranteed: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TPUQuotaStatus(SpecBase):
+    """``state`` is Active or Invalid (malformed spec — the quota grants
+    nothing, fail closed); ``tenancy`` is the controller's accounting
+    block: used/guaranteed/borrowed chips per generation, weighted
+    dominant share, and fair-share attainment."""
+
+    state: str = field(default="")
+    conditions: List[dict] = field(default_factory=list)
+    tenancy: dict = field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TPUQuota:
+    metadata: dict
+    spec: TPUQuotaSpec
+    status: TPUQuotaStatus
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @classmethod
+    def from_unstructured(cls, obj: dict) -> "TPUQuota":
+        return cls(
+            metadata=obj.get("metadata", {}),
+            spec=TPUQuotaSpec.from_dict(obj.get("spec")),
+            status=TPUQuotaStatus.from_dict(obj.get("status")),
+        )
+
+    def to_unstructured(self) -> dict:
+        return {
+            "apiVersion": TPU_QUOTA_API_VERSION,
+            "kind": TPU_QUOTA_KIND,
+            "metadata": self.metadata,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+def new_tpu_quota(name: str, spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": TPU_QUOTA_API_VERSION,
+        "kind": TPU_QUOTA_KIND,
+        "metadata": {"name": name},
+        "spec": spec or {},
+    }

@@ -49,6 +49,10 @@ from tpu_operator.controllers.serving_controller import (
     ServingReconciler,
     setup_with_manager as setup_serving,
 )
+from tpu_operator.controllers.tenancy_controller import (
+    TenancyReconciler,
+    setup_with_manager as setup_tenancy,
+)
 from tpu_operator.controllers.tpuslice_controller import (
     TPUSliceReconciler,
     setup_with_manager as setup_tpuslice,
@@ -140,6 +144,7 @@ def main(argv=None) -> int:
     setup_serving(mgr, ServingReconciler(client, namespace))
     setup_defrag(mgr, DefragReconciler(client, namespace))
     setup_compilecache(mgr, CompileCacheReconciler(client, namespace))
+    setup_tenancy(mgr, TenancyReconciler(client, namespace))
 
     stop = threading.Event()
     webhook_holder: dict = {}

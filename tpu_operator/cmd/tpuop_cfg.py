@@ -154,6 +154,14 @@ def cmd_plan(args) -> int:
     )
     slices = client.list(TPU_SLICE_API_VERSION, "TPUSlice")
     nodes = client.list("v1", "Node")
+    quotas = None
+    if args.tenant:
+        from tpu_operator.api.tpuquota import TPU_QUOTA_API_VERSION
+
+        try:
+            quotas = client.list(TPU_QUOTA_API_VERSION, "TPUQuota")
+        except kube_errors.ApiError:
+            quotas = None  # headroom annotation degrades, verdict stands
     try:
         links = degraded_link_pairs(client, ns)
     except kube_errors.ApiError:
@@ -198,6 +206,7 @@ def cmd_plan(args) -> int:
             compile_entries=compile_entries,
             libtpu_version=runtime_fingerprint(),
             model_hash=model_hash,
+            tenant=args.tenant, quotas=quotas,
         )
     )
     return 0
@@ -227,6 +236,11 @@ def main(argv=None) -> int:
     )
     pl.add_argument("--shape", default="", help="what-if gang shape, e.g. 8x8x8")
     pl.add_argument("--pool", default="", help="pin the what-if to one pool")
+    pl.add_argument(
+        "--tenant", default="",
+        help="ask the what-if on behalf of this tenant: folds TPUQuota "
+        "guaranteed headroom into the verdict (inside quota vs borrow)",
+    )
     pl.add_argument(
         "--within", type=float, default=600.0,
         help="admission horizon in seconds (defrag migrations are priced "

@@ -445,6 +445,28 @@ RISK_SETTLE_TIMEOUT_SECONDS = 1800.0          # unsettled predictions expire fal
 # status.job.riskHandled so redelivery never migrates twice
 JOB_RISK_MIGRATE_REQUEST = "riskMigrateRequest"
 
+# ---------------------------------------------------------------------------
+# Multi-tenant fairness (PR 20): TPUQuota + DRF fair-share + the
+# preemption economy. Tenancy is resolved from TENANT_LABEL on
+# TPUSlice/TPUJob/TPUServing (dotted hierarchy, e.g. "acme.search" —
+# "/" is illegal in a label value); TPUQuota objects declare per-level
+# guaranteed chips × generation and a fair-share weight. With zero
+# TPUQuota objects the placement engine's admission stays byte-identical
+# to stock priority-then-FIFO (the node_risk empty-map convention).
+# Preemption decisions and per-tenant time-to-place samples are booked
+# into the controller-owned ledger CM; an unreadable ledger fails the
+# pass CLOSED (K003) — a quota-blind write could mask a cross-tenant
+# eviction from the audit trail.
+# ---------------------------------------------------------------------------
+TENANT_LABEL = "tpu.google.com/tenant"        # dotted tenant path (org.team.class)
+TENANT_DEFAULT = "default"                    # untenanted workloads account here
+TENANCY_LEDGER_CONFIGMAP = "tpu-tenancy-ledger"
+TENANCY_DECISIONS_KEY = "decisions.json"      # bounded preemption-decision log
+TENANCY_PLACEMENTS_KEY = "placements.json"    # per-tenant time-to-place samples
+TENANCY_DECISIONS_LIMIT = 50                  # ledger decision-log bound
+TENANCY_PLACEMENT_SAMPLES_LIMIT = 64          # per-tenant sample-ring bound
+TENANCY_RESYNC_SECONDS = 30.0                 # tenancy controller resync cadence
+
 # Repair FSM state (cordon → evict → reinstall → revalidate → uncordon,
 # terminal: quarantined), persisted on the node like the upgrade FSM's.
 REPAIR_STATE_LABEL = "tpu.google.com/tpu.repair-state"

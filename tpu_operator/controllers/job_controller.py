@@ -209,8 +209,13 @@ class JobReconciler:
         retry."""
         name = job.name + consts.JOB_SLICE_SUFFIX
         slice_obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+        tenant = (obj["metadata"].get("labels") or {}).get(consts.TENANT_LABEL) or ""
         if slice_obj is None:
             body = new_tpu_slice(name, self._slice_spec(job, shape))
+            if tenant:
+                # the job's tenant rides onto the owned slice so the
+                # fair-share engine accounts the gang to the right quota
+                body["metadata"].setdefault("labels", {})[consts.TENANT_LABEL] = tenant
             body["metadata"]["ownerReferences"] = [{
                 "apiVersion": TPU_JOB_API_VERSION,
                 "kind": TPU_JOB_KIND,
@@ -236,6 +241,18 @@ class JobReconciler:
                 log.warning("job %s: slice shape patch failed: %s", job.name, e)
                 return None
             slice_obj = self.client.get_or_none(TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name)
+        if slice_obj is not None:
+            held = (slice_obj["metadata"].get("labels") or {}).get(consts.TENANT_LABEL) or ""
+            if held != tenant:
+                # re-tenanted job: converge the slice label (None clears)
+                try:
+                    self.client.patch(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUSlice
+                        TPU_SLICE_API_VERSION, TPU_SLICE_KIND, name,
+                        {"metadata": {"labels": {consts.TENANT_LABEL: tenant or None}}},
+                    )
+                except errors.ApiError as e:
+                    log.warning("job %s: slice tenant patch failed: %s", job.name, e)
+                    return None
         return slice_obj
 
     def _delete_slice(self, job_name: str, owned_only: bool = False) -> None:

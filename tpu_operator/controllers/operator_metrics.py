@@ -383,6 +383,46 @@ class OperatorMetrics:
             "replicas)",
             registry=reg,
         )
+        # multi-tenant fairness (controllers/tenancy_controller.py):
+        # per-tenant accounting over the fleet's TPUQuota objects —
+        # series retire when a tenant's quota is deleted and no usage
+        # remains (O005)
+        self.tenant_used_chips = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_tenant_used_chips",
+            "Chips a tenant currently holds across every generation "
+            "(rollup of the tenant's level plus all descendants, from "
+            "published placement statuses)",
+            ["tenant"],
+            registry=reg,
+        )
+        self.tenant_fair_share = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_tenant_fair_share",
+            "Weighted dominant share (max over generations of "
+            "used/capacity, divided by the tenant's TPUQuota weight) — "
+            "the DRF quantity the admission queue equalizes",
+            ["tenant"],
+            registry=reg,
+        )
+        self.tenant_borrowed_chips = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_tenant_borrowed_chips",
+            "Chips a tenant holds beyond its own guaranteed quota — "
+            "reclaimable by cross-tenant preemption under the economy's "
+            "legality rule",
+            ["tenant"],
+            registry=reg,
+        )
+        self.tenant_place_p99 = _get_or_create(
+            prometheus_client.Gauge,
+            "tpu_operator_tenant_p99_place_seconds",
+            "p99 time-to-place over the tenant's recent gang placements "
+            "(the tpu-tenancy-ledger sample ring) — the starvation "
+            "signal the fair-share ordering bounds",
+            ["tenant"],
+            registry=reg,
+        )
         # process-wide series owned by the layers that measure them —
         # transport resilience by kube/retry, wire request counts +
         # latency by kube/http_client, reconcile/queue/informer timing by

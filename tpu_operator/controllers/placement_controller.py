@@ -23,10 +23,13 @@ block changed: O(changes in the pool), not O(cluster).
 
 from __future__ import annotations
 
+import calendar
 import logging
+import time
 from typing import Dict, List, Optional
 
 from tpu_operator import consts
+from tpu_operator.api.tpuquota import TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
 from tpu_operator.controllers.operator_metrics import get_metrics
 from tpu_operator.kube import errors, trace
@@ -50,6 +53,14 @@ QUEUE_REQUEST = Request(name="placement-queue")
 # informer index over TPUSlices by the pool they are pinned or last
 # scheduled to — what keeps a pool pass's slice lookup O(matches)
 SLICE_POOL_INDEX = "by-pool"
+
+
+def _parse_k8s_time(stamp: str) -> Optional[float]:
+    """metadata timestamps ("%Y-%m-%dT%H:%M:%SZ") → unix seconds."""
+    try:
+        return float(calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")))
+    except (TypeError, ValueError):
+        return None
 
 
 def clear_assignment_labels(client: Client, node_names) -> int:
@@ -94,6 +105,7 @@ class PlacementReconciler:
         self.namespace = namespace
         self.recorder = EventRecorder(client, namespace, component=PLACEMENT_MANAGER)
         self.metrics = get_metrics()
+        self._now = time.time  # tests pin the tenancy-ledger clock
         # fragmentation-series bookkeeping is shared by the global pass
         # and every pool-shard worker, which run CONCURRENTLY by design:
         # its mutations take a dedicated lock (metrics-only — no client
@@ -127,15 +139,17 @@ class PlacementReconciler:
         nodes = self.client.list("v1", "Node")
         links = self._degraded_links()
         risk = self._node_risk()
+        tenancy = self._tenancy(nodes)
         with trace.span("plan", slices=len(slices), nodes=len(nodes), links=len(links)):
             engine = PlacementEngine(
-                slices, nodes, degraded_links=links, node_risk=risk
+                slices, nodes, degraded_links=links, node_risk=risk, tenancy=tenancy
             )
             plan = engine.plan()
         with trace.span("apply-plan", deltas=len(plan.label_deltas)):
             self._apply_labels(plan)
             statuses_ok = self._publish_statuses(plan, {s["metadata"]["name"]: s for s in slices})
         self._record_events(plan, engine)
+        tenancy_ok = self._book_tenancy(plan, engine, tenancy)
         self.metrics.placement_queue_depth.set(plan.queue_depth)
         for pool, frag in plan.fragmentation.items():
             self.metrics.torus_fragmentation.labels(pool).set(frag)
@@ -167,10 +181,11 @@ class PlacementReconciler:
                 and not self.node_view.nodes(gone)
             ):
                 self._drain_shard(gone)
-        if plan.teardowns or not statuses_ok:
+        if plan.teardowns or not statuses_ok or not tenancy_ok:
             # a torn-down gang (preempted or degraded) re-places as soon
-            # as the world settles; a failed status write retries — once
-            # the labels have converged nothing else would re-enqueue it
+            # as the world settles; a failed status or ledger write
+            # retries — once the labels have converged nothing else
+            # would re-enqueue it
             return Result(requeue=True)
         if plan.queue_depth:
             # pending work but nothing actionable: capacity can free up
@@ -197,11 +212,16 @@ class PlacementReconciler:
         relevant = self._slices_for_pool(shard, assigned_here)
         links = self._degraded_links()
         risk = self._node_risk()
+        # pool-scoped policy: capacity/usage seen through this shard's
+        # node set only. Ordering decisions a single pool cannot make
+        # fairly (cross-pool dominant shares) defer to the global pass
+        # the same way unpinned Unschedulable verdicts do
+        tenancy = self._tenancy(nodes)
         with trace.span(
             "plan", pool=shard, slices=len(relevant), nodes=len(nodes), links=len(links)
         ):
             engine = PlacementEngine(
-                relevant, nodes, degraded_links=links, node_risk=risk
+                relevant, nodes, degraded_links=links, node_risk=risk, tenancy=tenancy
             )
             plan = engine.plan()
         # a slice this pool couldn't seat may belong elsewhere: only a
@@ -227,6 +247,7 @@ class PlacementReconciler:
                 plan, {s["metadata"]["name"]: s for s in relevant}
             )
         self._record_events(plan, engine)
+        tenancy_ok = self._book_tenancy(plan, engine, tenancy)
         for pool, frag in plan.fragmentation.items():
             self.metrics.torus_fragmentation.labels(pool).set(frag)
         with self._frag_lock:
@@ -234,7 +255,7 @@ class PlacementReconciler:
         if plan.teardowns or deferred:
             # work only the global order can finish
             self._request_global()
-        if not statuses_ok:
+        if not statuses_ok or not tenancy_ok:
             return Result(requeue=True)
         return Result()
 
@@ -290,6 +311,61 @@ class PlacementReconciler:
         from tpu_operator.controllers.risk import read_node_risk
 
         return read_node_risk(self.client, self.namespace) or {}
+
+    def _tenancy(self, nodes: List[ObjectDict]):
+        """The cluster's fair-share policy, built from its TPUQuota
+        objects over the pass's node capacity (None with zero
+        well-formed quotas — the byte-identical stock-admission path).
+        UNLIKE the advisory risk read this fails CLOSED: a quota-blind
+        pass could seat borrowers ahead of guaranteed tenants or evict
+        a protected gang, so an ApiError propagates and the pass
+        retries — the same contract as the slice/node lists."""
+        from tpu_operator.tenancy.fairshare import (
+            capacity_by_generation,
+            policy_from_objects,
+        )
+
+        quotas = self.client.list(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND)
+        return policy_from_objects(quotas, capacity_by_generation(nodes))
+
+    def _book_tenancy(self, plan: Plan, engine: PlacementEngine, policy) -> bool:
+        """Book the pass's preemption-economy decisions plus every
+        newly-Scheduled gang's per-tenant time-to-place sample into the
+        tpu-tenancy-ledger CM. Fail CLOSED (K003): an unreadable ledger
+        returns False and the caller requeues — a cross-tenant eviction
+        must never vanish from the audit trail. No-op without an active
+        policy (the ledger only exists alongside quotas)."""
+        if policy is None:
+            return True
+        from tpu_operator.tenancy.fairshare import resolve_tenant
+        from tpu_operator.tenancy.ledger import book, read_ledger
+
+        now = self._now()
+        samples = []
+        for name in sorted(plan.statuses):
+            desired = plan.statuses[name] or {}
+            if desired.get("phase") != PlacementPhase.SCHEDULED:
+                continue
+            obj = engine.slices.get(name)
+            if obj is None:
+                continue
+            prior = (obj.get("status") or {}).get("placement") or {}
+            if prior.get("phase") == PlacementPhase.SCHEDULED:
+                continue  # already seated: not a fresh time-to-place
+            created = _parse_k8s_time(obj["metadata"].get("creationTimestamp", ""))
+            if created is None:
+                continue
+            tenant = resolve_tenant(obj) or consts.TENANT_DEFAULT
+            samples.append((tenant, max(0.0, now - created)))
+        if not plan.preemption_decisions and not samples:
+            return True
+        ledger = read_ledger(self.client, self.namespace)
+        if ledger is None:
+            return False
+        return book(
+            self.client, self.namespace, ledger,
+            decisions=plan.preemption_decisions, samples=samples, now=now,
+        )
 
     # -- plan application ----------------------------------------------------
 
@@ -430,10 +506,24 @@ def setup_with_manager(mgr, reconciler: PlacementReconciler) -> Controller:
             return True
         return (old.get("data") or {}) != (new.get("data") or {})
 
+    def quota_changed(event_type, old, new) -> bool:
+        """TPUQuota events replan the queue when the quota itself
+        changed (spec) or the object appeared/went away — the tenancy
+        controller's status-accounting echoes must not."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("spec") or {}) != (new.get("spec") or {})
+
     slice_informer = mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
     slice_informer.add_index(SLICE_POOL_INDEX, slice_pool_index)
     reconciler._slice_informer = slice_informer
     ctrl.watch(slice_informer, mapper=map_to_queue, predicate=placement_changed)
+    # fair-share inputs: adding/editing/deleting a TPUQuota reorders the
+    # whole queue (and zero-quota clusters must replan back to stock)
+    ctrl.watch(
+        mgr.informer_for(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND),
+        mapper=map_to_queue, predicate=quota_changed,
+    )
     # node events route through the sharded view: each event enqueues its
     # POOL's request (one queue + worker pool per shard), and a node that
     # moves pools fans out as DELETED-on-old + ADDED-on-new, so both

@@ -373,6 +373,11 @@ class ServingReconciler:
         self, obj: ObjectDict, serving_name: str, name: str, spec: dict
     ) -> bool:
         body = new_tpu_slice(name, spec)
+        tenant = (obj["metadata"].get("labels") or {}).get(consts.TENANT_LABEL) or ""
+        if tenant:
+            # the serving's tenant rides onto every replica slice so the
+            # fair-share engine accounts replicas to the right quota
+            body["metadata"].setdefault("labels", {})[consts.TENANT_LABEL] = tenant
         body["metadata"]["ownerReferences"] = [{
             "apiVersion": TPU_SERVING_API_VERSION,
             "kind": TPU_SERVING_KIND,

@@ -1,0 +1,247 @@
+"""Tenancy reconciler: TPUQuota accounting, status, and observability.
+
+The placement engine *enforces* fairness (the DRF admission order and
+the preemption economy live in ``placement/engine.py`` +
+``tenancy/fairshare.py``); this controller makes it *visible*. One
+fleet-wide pass per quota/placement change:
+
+- parses every TPUQuota (malformed specs go ``Invalid`` and grant
+  nothing — fail closed), builds the same :class:`FairSharePolicy` the
+  engine plans with,
+- accounts per-tenant usage from published placement statuses
+  (``tenancy.fairshare.usage_from_slices`` — the same rollup the engine
+  recomputes mid-pass from its own plan),
+- publishes each quota's accounting block (used/guaranteed/borrowed
+  chips, weighted dominant share, protection state) as a key-scoped
+  status patch, and
+- exports the ``tpu_operator_tenant_*`` gauges, retiring a tenant's
+  series when its quota is deleted and no usage remains (O005 — a
+  deleted tenant must not export its last value forever).
+
+The p99 time-to-place gauge reads the ``tpu-tenancy-ledger`` sample
+ring the placement controller books. That read is ADVISORY here — an
+unreadable ledger only skips the p99 export this pass; the fail-closed
+K003 contract binds the ledger's *writer* (a booking that cannot read
+the ledger must not reset the audit trail), not this gauge.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from tpu_operator import consts
+from tpu_operator.api.tpuquota import TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND
+from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION, TPU_SLICE_KIND
+from tpu_operator.controllers.operator_metrics import get_metrics
+from tpu_operator.kube import errors
+from tpu_operator.kube.cached import CachedReadClient
+from tpu_operator.kube.client import Client
+from tpu_operator.kube.controller import Controller, Request, Result
+from tpu_operator.kube.events import EventRecorder
+from tpu_operator.tenancy.fairshare import (
+    FairSharePolicy,
+    parse_quota,
+    capacity_by_generation,
+    usage_from_slices,
+)
+from tpu_operator.tenancy.ledger import place_p99, read_ledger
+
+log = logging.getLogger(__name__)
+
+TENANCY_MANAGER = "tpu-tenancy"
+
+# the whole fleet accounts as one unit; every watch event maps here
+TENANCY_REQUEST = Request(name="tenancy-accounting")
+
+
+class TenancyReconciler:
+    def __init__(
+        self,
+        client: Client,
+        namespace: str = consts.DEFAULT_OPERATOR_NAMESPACE,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.recorder = recorder or EventRecorder(
+            client, namespace, component=TENANCY_MANAGER
+        )
+        self.metrics = get_metrics()
+        self._now = time.time
+        from tpu_operator.kube import racecheck
+
+        # gauge-series bookkeeping shares the reconciler across the
+        # controller's workers and the metrics endpoint
+        self._series_lock = racecheck.lock("TenancyReconciler._series_lock")
+        self._tenant_series: set = set()
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            quotas = self.client.list(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND)
+            slices = self.client.list(TPU_SLICE_API_VERSION, TPU_SLICE_KIND)
+            nodes = self.client.list("v1", "Node")
+        except errors.ApiError as e:
+            # fail closed: partial inputs would publish wrong accounting
+            # (a missing slice list reads as a tenant holding nothing)
+            log.warning("tenancy: input list failed, pass aborted: %s", e)
+            return Result(requeue=True)
+        entries = {}
+        for obj in quotas:
+            entries[obj["metadata"]["name"]] = parse_quota(obj)
+        valid = [e for e in entries.values() if e is not None]
+        policy = FairSharePolicy(valid, capacity_by_generation(nodes)) if valid else None
+        used = usage_from_slices(slices, nodes)
+        ledger = read_ledger(self.client, self.namespace)  # advisory here
+        statuses_ok = True
+        for obj in quotas:
+            desired = self._desired_status(obj, entries[obj["metadata"]["name"]], policy, used)
+            if not self._publish_status(obj, desired):
+                statuses_ok = False
+        self._publish_series(policy, used, ledger)
+        if not statuses_ok:
+            return Result(requeue=True)
+        # placements move without any quota/slice spec event mapping
+        # here (label-only re-tenanting, node churn shifting capacity)
+        return Result(requeue_after=consts.TENANCY_RESYNC_SECONDS)
+
+    # -- status --------------------------------------------------------------
+
+    def _desired_status(
+        self,
+        obj: dict,
+        entry,
+        policy: Optional[FairSharePolicy],
+        used: Dict[str, Dict[str, int]],
+    ) -> dict:
+        if entry is None or policy is None:
+            return {
+                "state": "Invalid",
+                "tenancy": {
+                    "reason": "malformed spec: tenant must be non-empty, weight "
+                              "positive and finite, guaranteed a map of "
+                              "generation to non-negative integer chips",
+                },
+            }
+        tenant = entry.tenant
+        return {
+            "state": "Active",
+            "tenancy": {
+                "tenant": tenant,
+                "weight": entry.weight,
+                "guaranteed": entry.guaranteed_map,
+                "used": policy.level_usage(used, tenant),
+                "usedChips": sum(policy.level_usage(used, tenant).values()),
+                "borrowedChips": policy.borrowed_chips(tenant, used),
+                "dominantShare": round(policy.dominant_share(tenant, used), 6),
+                "weightedShare": round(policy.weighted_share(tenant, used), 6),
+                "withinGuarantee": policy.within_guarantee(tenant, used),
+            },
+        }
+
+    def _publish_status(self, obj: dict, desired: dict) -> bool:
+        name = obj["metadata"]["name"]
+        current = obj.get("status") or {}
+        if (current.get("state"), current.get("tenancy") or {}) == (
+            desired["state"], desired["tenancy"]
+        ):
+            return True
+        if desired["state"] == "Invalid" and current.get("state") != "Invalid":
+            self.recorder.event(
+                obj, "Warning", "TPUQuotaInvalid",
+                "TPUQuota spec is malformed and grants nothing (fail closed): "
+                + str(desired["tenancy"].get("reason") or ""),
+            )
+        try:
+            self.client.patch_status(  # tpuop-lint: kinds=tpu.google.com/v1alpha1/TPUQuota
+                TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND, name,
+                {"status": desired},
+            )
+        except errors.NotFound:
+            return True  # deleted mid-pass; the delete event re-enqueues
+        except errors.ApiError as e:
+            log.debug("tenancy status publish for %s failed: %s", name, e)
+            return False
+        return True
+
+    # -- metrics -------------------------------------------------------------
+
+    def _publish_series(
+        self,
+        policy: Optional[FairSharePolicy],
+        used: Dict[str, Dict[str, int]],
+        ledger: Optional[dict],
+    ) -> None:
+        """Per-tenant gauges for every declared tenant plus every tenant
+        actually holding chips; series no longer in that set retire
+        (O005) — deleting the last TPUQuota retires everything."""
+        live: set = set()
+        if policy is not None:
+            live.update(policy.quotas)
+            live.update(used)
+        for tenant in sorted(live):
+            self.metrics.tenant_used_chips.labels(tenant).set(
+                sum(policy.level_usage(used, tenant).values())
+            )
+            self.metrics.tenant_fair_share.labels(tenant).set(
+                round(policy.weighted_share(tenant, used), 6)
+            )
+            self.metrics.tenant_borrowed_chips.labels(tenant).set(
+                policy.borrowed_chips(tenant, used)
+            )
+            p99 = place_p99(ledger, tenant) if ledger else None
+            if p99 is not None:
+                self.metrics.tenant_place_p99.labels(tenant).set(p99)
+        with self._series_lock:
+            gone = self._tenant_series - live
+            self._tenant_series = live
+        for tenant in gone:
+            for gauge in (
+                self.metrics.tenant_used_chips,
+                self.metrics.tenant_fair_share,
+                self.metrics.tenant_borrowed_chips,
+                self.metrics.tenant_place_p99,
+            ):
+                try:
+                    gauge.remove(tenant)
+                except KeyError:
+                    pass
+
+
+def setup_with_manager(mgr, reconciler: TenancyReconciler) -> Controller:
+    ctrl = Controller("tenancy", reconciler)
+    reconciler.client = CachedReadClient(reconciler.client, mgr)
+
+    def map_to_pass(_obj) -> List[Request]:
+        return [TENANCY_REQUEST]
+
+    def quota_changed(event_type, old, new) -> bool:
+        """Re-account when the quota itself changed (or appeared/went
+        away) — this controller's own status echoes must not loop."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        return (old.get("spec") or {}) != (new.get("spec") or {})
+
+    def placement_changed(event_type, old, new) -> bool:
+        """Slice events matter when the published placement block moved
+        (usage changed) or the slice was re-tenanted."""
+        if event_type != "MODIFIED" or old is None:
+            return True
+        if ((old.get("status") or {}).get("placement")
+                != (new.get("status") or {}).get("placement")):
+            return True
+        old_tenant = (old["metadata"].get("labels") or {}).get(consts.TENANT_LABEL)
+        new_tenant = (new["metadata"].get("labels") or {}).get(consts.TENANT_LABEL)
+        return old_tenant != new_tenant
+
+    ctrl.watch(
+        mgr.informer_for(TPU_QUOTA_API_VERSION, TPU_QUOTA_KIND),
+        mapper=map_to_pass, predicate=quota_changed,
+    )
+    ctrl.watch(
+        mgr.informer_for(TPU_SLICE_API_VERSION, TPU_SLICE_KIND),
+        mapper=map_to_pass, predicate=placement_changed,
+    )
+    mgr.add_controller(ctrl)
+    return ctrl

@@ -713,6 +713,16 @@ class GangChurnSchedule:
     ``shapes`` is a list of ((x, y, z), weight) pairs; lifetimes are
     uniform in [min_lifetime, max_lifetime] ticks from placement (a
     gang's capacity frees when its work finishes, not when it arrives).
+
+    ``tenants`` — a list of (name, demand_weight) pairs — tags each
+    arrival with a seeded tenant draw (the multi-tenant churn the
+    fairness gates replay); the demand weight shapes how much load the
+    tenant OFFERS, independent of any quota weight the fair-share
+    scheduler grants it. None (the default) keeps the log untagged and
+    the rng sequence byte-identical to the single-tenant schedule: the
+    tenant draw happens after each arrival's stock draws, so the
+    shapes/lifetimes/priorities of ``tenants=[...]`` match the
+    untagged run with the same seed exactly.
     """
 
     DEFAULT_SHAPES = (
@@ -732,12 +742,17 @@ class GangChurnSchedule:
         min_lifetime: int = 20,
         max_lifetime: int = 80,
         priority_levels: int = 2,
+        tenants=None,
     ):
         self.seed = seed
         self.ticks = ticks
         rng = random.Random(seed)
+        # tenant tags ride a separate seeded stream so tagging a
+        # schedule never perturbs the stock draws: same seed, same
+        # gangs, with or without tenants
+        trng = random.Random(f"{seed}/tenants") if tenants else None
         weights = [w for _, w in shapes]
-        self.log: list = []  # (tick, name, shape, priority, lifetime)
+        self.log: list = []  # (tick, name, shape, priority, lifetime[, tenant])
         serial = 0
         for tick in range(ticks):
             whole = int(arrivals_per_tick)
@@ -748,19 +763,20 @@ class GangChurnSchedule:
                 shape = rng.choices([s for s, _ in shapes], weights=weights)[0]
                 lifetime = rng.randint(min_lifetime, max_lifetime)
                 priority = rng.randrange(max(1, priority_levels))
-                self.log.append(
-                    (tick, f"gang-{serial}", tuple(shape), priority, lifetime)
-                )
+                entry = (tick, f"gang-{serial}", tuple(shape), priority, lifetime)
+                if trng is not None:
+                    entry += (trng.choices(
+                        [t for t, _ in tenants],
+                        weights=[w for _, w in tenants],
+                    )[0],)
+                self.log.append(entry)
                 serial += 1
 
     def arrivals(self, tick: int) -> list:
         """The gangs arriving at ``tick``: (name, shape, priority,
-        lifetime) tuples. Pure read over the pre-drawn log."""
-        return [
-            (name, shape, priority, lifetime)
-            for t, name, shape, priority, lifetime in self.log
-            if t == tick
-        ]
+        lifetime) tuples — plus a trailing tenant tag when the schedule
+        was drawn with ``tenants``. Pure read over the pre-drawn log."""
+        return [entry[1:] for entry in self.log if entry[0] == tick]
 
 
 class DiurnalTraffic:

@@ -230,7 +230,7 @@ def analyze_rule_hygiene(
 DYNAMIC_LABEL_DIMENSIONS = frozenset(
     {
         "slice", "pool", "edge", "chip", "probe", "gang", "shard", "job",
-        "serving", "generation",
+        "serving", "generation", "tenant",
     }
 )
 

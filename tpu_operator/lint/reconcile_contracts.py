@@ -57,9 +57,9 @@ from tpu_operator.lint.findings import ERROR, Finding, make
 PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # the reconcile-contract surface: control loops, the pod/router data
-# plane running under operator credentials, and the workload mains that
-# write the shared handshake ConfigMaps
-SCAN_ROOTS = ("controllers", "dataplane", "workloads")
+# plane running under operator credentials, the workload mains that
+# write the shared handshake ConfigMaps, and the tenancy ledger writer
+SCAN_ROOTS = ("controllers", "dataplane", "workloads", "tenancy")
 
 # (module relpath, class name or "" for module scope, function name)
 FuncKey = Tuple[str, str, str]
@@ -93,6 +93,7 @@ _SHARED_KEY_CONST_NAMES = (
     "DEFRAG_STATE_KEY", "RISK_STATE_KEY", "AUTOTUNE_WINNERS_KEY",
     "PERF_FLOORS_KEY",
     "COMPILE_PREWARM_REQUEST_KEY", "COMPILE_PREWARM_ACK_KEY",
+    "TENANCY_DECISIONS_KEY", "TENANCY_PLACEMENTS_KEY",
 )
 _SHARED_KEY_PREFIX_NAMES = ("JOB_RENDEZVOUS_PREFIX",)
 

@@ -25,6 +25,7 @@ import yaml
 
 from tpu_operator.api.clusterpolicy import CLUSTER_POLICY_API_VERSION
 from tpu_operator.api.tpujob import TPU_JOB_API_VERSION
+from tpu_operator.api.tpuquota import TPU_QUOTA_API_VERSION
 from tpu_operator.api.tpuserving import TPU_SERVING_API_VERSION
 from tpu_operator.api.tpuslice import TPU_SLICE_API_VERSION
 from tpu_operator.kube import errors
@@ -39,6 +40,7 @@ _COLLECTIONS: List[Tuple[str, str, str, bool]] = [
     ("tpuslices", TPU_SLICE_API_VERSION, "TPUSlice", False),
     ("tpujobs", TPU_JOB_API_VERSION, "TPUJob", False),
     ("tpuservings", TPU_SERVING_API_VERSION, "TPUServing", False),
+    ("tpuquotas", TPU_QUOTA_API_VERSION, "TPUQuota", False),
     ("daemonsets", "apps/v1", "DaemonSet", True),
     ("pods", "v1", "Pod", True),
     ("services", "v1", "Service", True),
@@ -396,6 +398,69 @@ def collect(client: Client, namespace: str, outdir: str, log_tail: int = 2000) -
         emit("plan.txt", "\n".join(lines) + "\n")
     except errors.ApiError as e:
         emit("plan.txt", f"# collection failed: {e}\n")
+
+    try:
+        # the multi-tenant fairness view: every tenant's usage vs its
+        # declared quota, fair-share attainment (weighted dominant
+        # share + measured p99 time-to-place), and the last preemption
+        # decisions the economy booked — where "why did team X's gang
+        # wait / who evicted whom and was it borrowing" starts
+        from tpu_operator.tenancy import fairshare
+        from tpu_operator.tenancy import ledger as tenancy_ledger
+
+        quotas = client.list(TPU_QUOTA_API_VERSION, "TPUQuota")
+        slices = client.list(TPU_SLICE_API_VERSION, "TPUSlice")
+        nodes = client.list("v1", "Node")
+        policy = fairshare.policy_from_objects(
+            quotas, fairshare.capacity_by_generation(nodes)
+        )
+        used = fairshare.usage_from_slices(slices, nodes)
+        ledger = tenancy_ledger.read_ledger(client, namespace)
+        lines = ["# per-tenant usage vs quota (fair-share attainment)"]
+        if policy is None:
+            lines.append(
+                "# no well-formed TPUQuota — stock (single-tenant) admission"
+            )
+            for tenant in sorted(used):
+                held = fairshare.FairSharePolicy.level_usage(used, tenant)
+                rendered = " ".join(f"{g}={c}" for g, c in sorted(held.items()))
+                lines.append(f"{tenant}  used: {rendered}")
+        else:
+            for tenant in sorted(set(policy.quotas) | set(used)):
+                held = policy.level_usage(used, tenant)
+                quota = policy.quotas.get(tenant)
+                rendered = " ".join(
+                    f"{g}={c}" for g, c in sorted(held.items())
+                ) or "(idle)"
+                guaranteed = " ".join(
+                    f"{g}={c}" for g, c in sorted(quota.guaranteed_map.items())
+                ) if quota is not None else "(undeclared)"
+                p99 = tenancy_ledger.place_p99(ledger, tenant) if ledger else None
+                lines.append(
+                    f"{tenant}  used: {rendered}  guaranteed: {guaranteed}  "
+                    f"weight={policy.weight(tenant)}  "
+                    f"weighted_share={round(policy.weighted_share(tenant, used), 6)}  "
+                    f"borrowed={policy.borrowed_chips(tenant, used)}  "
+                    f"within_guarantee={policy.within_guarantee(tenant, used)}"
+                    + (f"  p99_place_s={round(p99, 3)}" if p99 is not None else "")
+                )
+        lines.append("")
+        lines.append("# last 5 preemption decisions (newest first)")
+        decisions = tenancy_ledger.last_decisions(ledger) if ledger else []
+        for d in decisions:
+            lines.append(
+                f"{d.get('preemptor', '?')} (tenant {d.get('preemptorTenant', '?')}) "
+                f"evicted {d.get('victim', '?')} "
+                f"(tenant {d.get('victimTenant', '?')}, "
+                f"{'borrowed' if d.get('borrowed') else 'owned'})  "
+                f"pool={d.get('pool', '?')}  fragDelta={d.get('fragDelta')}  "
+                f"at={d.get('at')}"
+            )
+        if not decisions:
+            lines.append("# none booked")
+        emit("tenants.txt", "\n".join(lines) + "\n")
+    except errors.ApiError as e:
+        emit("tenants.txt", f"# collection failed: {e}\n")
 
     try:
         # the predictive-health view: every host the risk scorer is

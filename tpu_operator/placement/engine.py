@@ -44,6 +44,7 @@ from tpu_operator.placement.torus import (
     host_grid_dims,
     parse_shape,
 )
+from tpu_operator.tenancy.fairshare import resolve_tenant
 
 PLACEMENT_MANAGER = "tpu-placement"
 
@@ -130,6 +131,11 @@ class PlacementRequest:
     policy: str
     pool: str  # optional pool pin
     created: str  # creationTimestamp for FIFO within a priority band
+    # dotted tenant path from the tpu.google.com/tenant label (or
+    # spec.placement.tenant); "" = untenanted — accounts under the
+    # default tenant when a fair-share policy is active, ignored
+    # entirely when none is
+    tenant: str = ""
 
     @classmethod
     def from_slice(cls, obj: ObjectDict) -> Optional["PlacementRequest"]:
@@ -148,6 +154,7 @@ class PlacementRequest:
             policy=str(placement.get("preemptionPolicy") or PreemptionPolicy.NEVER),
             pool=str(placement.get("pool") or ""),
             created=obj["metadata"].get("creationTimestamp", ""),
+            tenant=resolve_tenant(obj),
         )
 
 
@@ -164,6 +171,11 @@ class Plan:
     # slices whose gang was torn down this pass (preempted or lost a
     # member): the controller requeues promptly so they re-place
     teardowns: List[str] = dataclasses.field(default_factory=list)
+    # preemption-economy audit records (victim, victimTenant, preemptor,
+    # preemptorTenant, fragDelta, borrowed, pool) the controller books
+    # into the tpu-tenancy-ledger CM; populated only when a fair-share
+    # policy is active — the stock path never writes here
+    preemption_decisions: List[dict] = dataclasses.field(default_factory=list)
 
     def _delta(self, node: str) -> Dict[str, Optional[str]]:
         return self.label_deltas.setdefault(node, {})
@@ -457,7 +469,16 @@ class PlacementEngine:
         degraded_links: Optional[Sequence[Tuple[str, str]]] = None,
         scorer=None,
         node_risk: Optional[Dict[str, float]] = None,
+        tenancy=None,
     ):
+        # multi-tenant fair-share policy (tenancy.fairshare.FairSharePolicy,
+        # built from the cluster's TPUQuota objects). None — the cluster
+        # has no quotas — keeps every admission/preemption code path
+        # byte-identical to stock priority-then-FIFO (the node_risk
+        # empty-map convention); set, it swaps the pending sort for the
+        # DRF weighted fair-share order and gates preemption through the
+        # economy's legality + cheapest-victim-first rules.
+        self.tenancy = tenancy
         # optional placement-policy hook threaded into every clean-fit
         # find_block call (torus.find_block's scorer slot) — the fleet
         # simulator's defrag-aware policy rides it; None keeps the
@@ -486,6 +507,9 @@ class PlacementEngine:
         self.pools: Dict[str, tuple] = {}
         self.node_pool: Dict[str, str] = {}
         links = [tuple(edge) for edge in (degraded_links or [])]
+        # kept for the preemption economy's replay-minus-candidate
+        # victim scoring (the replays must see the same cut fabric)
+        self._degraded_links = links
         for pool in get_node_pools(list(self.nodes.values())):
             members = [self.nodes[n] for n in pool.node_names]
             torus = Torus.from_nodes(
@@ -599,10 +623,14 @@ class PlacementEngine:
                 ))
                 pending.append(req)
 
-        # 3. admit pending in priority-then-FIFO order
-        pending.sort(key=lambda r: (-r.priority, r.created, r.name))
-        for req in pending:
-            self._try_place(req, plan, scheduled)
+        # 3. admit pending: priority-then-FIFO, or — when TPUQuota
+        #    objects exist — the DRF weighted fair-share order
+        if self.tenancy is None:
+            pending.sort(key=lambda r: (-r.priority, r.created, r.name))
+            for req in pending:
+                self._try_place(req, plan, scheduled)
+        else:
+            self._admit_fair(pending, plan, scheduled)
 
         plan.queue_depth = sum(
             1 for name in self.requests if name not in scheduled
@@ -615,6 +643,68 @@ class PlacementEngine:
         if req.pool:
             return [req.pool] if req.pool in self.pools else []
         return sorted(self.pools)
+
+    # -- multi-tenant fair share ---------------------------------------------
+
+    def _req_tenant(self, req: PlacementRequest) -> str:
+        return req.tenant or consts.TENANT_DEFAULT
+
+    def _tenant_usage(self, scheduled: Dict[str, str]) -> Dict[str, Dict[str, int]]:
+        """{tenant: {generation: chips}} accounted from the engine's own
+        placed-plan so far this pass — intact gangs plus everything
+        admission has seated, valued at the occupying cells (a shrunk
+        gang charges what it actually holds)."""
+        used: Dict[str, Dict[str, int]] = {}
+        for name, pool_name in scheduled.items():
+            req = self.requests.get(name)
+            if req is None:
+                continue
+            pool, torus = self.pools[pool_name]
+            chips = len(torus.owner_cells(name)) * pool.info.chips_per_node
+            if chips <= 0:
+                continue
+            gens = used.setdefault(self._req_tenant(req), {})
+            gen = pool.info.generation
+            gens[gen] = gens.get(gen, 0) + chips
+        return used
+
+    def _demand_options(self, req: PlacementRequest, shape) -> List[Tuple[str, int]]:
+        """The candidate footprints one request could land as — (TPU
+        generation, chips) per candidate pool, deduped — what the quota
+        headroom / legality checks measure against."""
+        volume = math.prod(shape)
+        options: List[Tuple[str, int]] = []
+        seen = set()
+        for pool_name in self._candidate_pools(req):
+            pool, _ = self.pools[pool_name]
+            item = (pool.info.generation, volume * pool.info.chips_per_node)
+            if item not in seen:
+                seen.add(item)
+                options.append(item)
+        return options
+
+    def _admit_fair(
+        self, pending: List[PlacementRequest], plan: Plan, scheduled: Dict[str, str]
+    ) -> None:
+        """DRF weighted fair-share admission: re-rank the whole queue
+        after every seating (each placement moves its tenant's dominant
+        share, which can demote that tenant's next request behind
+        another tenant's) by (fits-inside-guaranteed-headroom, weighted
+        dominant share, priority, FIFO) — so no tenant starves and
+        borrowing only happens once guaranteed demand is seated."""
+        queue = list(pending)
+        while queue:
+            used = self._tenant_usage(scheduled)
+
+            def key(r: PlacementRequest) -> tuple:
+                shape = parse_shape(r.shape)
+                demands = self._demand_options(r, shape) if shape else []
+                return self.tenancy.order_key(
+                    self._req_tenant(r), used, demands, r.priority, r.created, r.name
+                )
+
+            queue.sort(key=key)
+            self._try_place(queue.pop(0), plan, scheduled)
 
     def _block_risk(self, torus, cells) -> float:
         return round(
@@ -661,8 +751,14 @@ class PlacementEngine:
             if best is None or key < best[0]:
                 best = (key, pool_name, block)
         victims: frozenset = frozenset()
+        decisions: List[dict] = []
         if best is None and req.policy == PreemptionPolicy.PREEMPT_LOWER:
-            best, victims = self._find_with_preemption(req, shape, pools)
+            if self.tenancy is None:
+                best, victims = self._find_with_preemption(req, shape, pools)
+            else:
+                best, victims, decisions = self._find_with_preemption_fair(
+                    req, shape, pools, scheduled
+                )
         if best is None:
             plan.statuses[req.name] = self._status(
                 PlacementPhase.UNSCHEDULABLE, req,
@@ -710,6 +806,8 @@ class PlacementEngine:
             f"placed {req.shape} block at {block.origin_str} in pool {pool_name}"
             + (f" preempting {len(victims)} gang(s)" if victims else ""),
         ))
+        if decisions:
+            plan.preemption_decisions.extend(decisions)
 
     def _find_with_preemption(self, req: PlacementRequest, shape, pools: List[str]):
         """Minimal-victim search across pools: only strictly-lower-priority
@@ -733,6 +831,106 @@ class PlacementEngine:
                 best = (key, pool_name, block)
                 best_victims = victims
         return best, best_victims
+
+    def _find_with_preemption_fair(
+        self,
+        req: PlacementRequest,
+        shape,
+        pools: List[str],
+        scheduled: Dict[str, str],
+    ):
+        """The preemption economy (fair-share policy active). Differs
+        from the stock minimal-victim search in two rule changes:
+
+        - **Legality**: still strictly-lower-priority only, but a victim
+          whose owner tenant is wholly inside its guaranteed quota may
+          never be evicted while the preemptor's tenant is (or would go)
+          over its own — protected capacity never feeds a borrower.
+        - **Cheapest-victim-first**: legal victims rank by replay-minus-
+          candidate fragmentation cost (scale_down_scores' frag_delta,
+          then frag_after, then name — pick_scale_down_victim's order)
+          and are released in that order until a clean block opens, so
+          the economy pays the smallest fragmentation price, not the
+          smallest victim count. Victims placed earlier this same pass
+          carry no assignment labels yet, score (-1.0, -1.0), and are
+          therefore the cheapest of all — evicting a seat the pass
+          itself just granted undoes nothing already published.
+
+        The torus is left exactly as found: chosen victims are released
+        (and their statuses/teardowns booked) by the caller's stock
+        path, so the two economies can never diverge on teardown
+        bookkeeping. Returns (best, victims, decision records)."""
+        used = self._tenant_usage(scheduled)
+        demands = self._demand_options(req, shape)
+        preemptor = self._req_tenant(req)
+        legal: List[str] = []
+        for victim in sorted(scheduled):
+            if victim == req.name or scheduled[victim] not in pools:
+                continue
+            other = self.requests.get(victim)
+            if other is None or other.priority >= req.priority:
+                continue
+            if not self.tenancy.preemption_legal(
+                preemptor, self._req_tenant(other), used, demands
+            ):
+                continue
+            legal.append(victim)
+        if not legal:
+            return None, frozenset(), []
+        costs = scale_down_scores(
+            list(self.slices.values()),
+            list(self.nodes.values()),
+            legal,
+            degraded_links=self._degraded_links,
+        )
+        order = sorted(legal, key=lambda v: (costs[v][1], costs[v][0], v))
+        best = None
+        best_victims: frozenset = frozenset()
+        best_decisions: List[dict] = []
+        for pool_name in pools:
+            _, torus = self.pools[pool_name]
+            released: List[str] = []
+            saved: Dict[str, list] = {}
+            found = None
+            for victim in (v for v in order if scheduled[v] == pool_name):
+                saved[victim] = list(torus.owner_cells(victim))
+                torus.release(victim)
+                released.append(victim)
+                found = torus.find_block(shape, scorer=self._pool_scorer(torus))
+                if found is not None:
+                    break
+            # restore the torus either way; only overlap with the found
+            # block makes a released victim actually needed
+            needed: List[str] = []
+            if found is not None:
+                cells = set(found[0].cells)
+                needed = [v for v in released if cells & set(saved[v])]
+            for victim in reversed(released):
+                torus.occupy(victim, saved[victim])
+            if found is None:
+                continue
+            block, _ = found
+            cost = round(sum(costs[v][1] for v in needed), 4)
+            key = (cost, len(needed), block.exposure, pool_name)
+            if best is None or key < best[0]:
+                best = (key, pool_name, block)
+                best_victims = frozenset(needed)
+                best_decisions = [
+                    {
+                        "victim": v,
+                        "victimTenant": self._req_tenant(self.requests[v]),
+                        "preemptor": req.name,
+                        "preemptorTenant": preemptor,
+                        "fragDelta": costs[v][1],
+                        "fragAfter": costs[v][0],
+                        "borrowed": not self.tenancy.within_guarantee(
+                            self._req_tenant(self.requests[v]), used
+                        ),
+                        "pool": pool_name,
+                    }
+                    for v in sorted(needed)
+                ]
+        return best, best_victims, best_decisions
 
     def _status(
         self,

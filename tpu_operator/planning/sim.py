@@ -27,7 +27,9 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+from tpu_operator import consts
 from tpu_operator.placement.torus import Torus
+from tpu_operator.tenancy.fairshare import FairSharePolicy, QuotaEntry
 
 Coord = Tuple[int, int, int]
 
@@ -35,6 +37,10 @@ Coord = Tuple[int, int, int]
 # equivalents live in consts.DEFRAG_*)
 DEFRAG_EVERY_TICKS = 4
 DEFRAG_CANDIDATES = 3  # most-exposed gangs evaluated per idle window
+
+# the sim torus is one pool of one generation; quota math runs in host
+# units under this synthetic generation key
+SIM_GENERATION = "sim"
 
 
 @dataclasses.dataclass
@@ -44,6 +50,7 @@ class _Gang:
     priority: int
     lifetime: int
     arrived: int
+    tenant: str = ""
     placed_at: Optional[int] = None
     depart_at: Optional[int] = None
     ever_placed: bool = False
@@ -70,6 +77,7 @@ class FleetSimulator:
         defrag_every: int = DEFRAG_EVERY_TICKS,
         migration_cooldown_ticks: int = 8,
         migration_budget: int = 1000,
+        quotas: Optional[Dict[str, Tuple[float, int]]] = None,
     ):
         if policy not in ("best-fit", "defrag-aware"):
             raise ValueError(f"unknown policy {policy!r}")
@@ -100,6 +108,26 @@ class FleetSimulator:
         # percentiles (a preempted gang's eventual re-place does not
         # re-count — its user saw capacity at first placement)
         self._waits: List[float] = []
+        # ``quotas`` opts admission into the fair-share order — the REAL
+        # FairSharePolicy (tenancy/fairshare.py), in host units under
+        # the synthetic ``sim`` generation: {tenant: (weight,
+        # guaranteed_hosts)}. None (the default) is the stock
+        # priority-then-FIFO simulator, byte-identical.
+        self._policy: Optional[FairSharePolicy] = None
+        if quotas:
+            self._policy = FairSharePolicy(
+                [
+                    QuotaEntry(
+                        tenant=tenant, weight=float(weight),
+                        guaranteed=((SIM_GENERATION, int(hosts)),),
+                        name=tenant,
+                    )
+                    for tenant, (weight, hosts) in sorted(quotas.items())
+                ],
+                {SIM_GENERATION: self.torus.in_service_count()},
+            )
+        self._waits_by_tenant: Dict[str, List[float]] = {}
+        self._held_samples: List[Dict[str, int]] = []
 
     # -- one tick ------------------------------------------------------------
 
@@ -107,16 +135,19 @@ class FleetSimulator:
         """Advance one tick: departures → arrivals → admission →
         (defrag-aware only) background migration → utilization sample.
         ``arrivals`` is the schedule's (name, shape, priority, lifetime)
-        list for this tick."""
+        list for this tick — with a trailing tenant tag when the
+        schedule was drawn multi-tenant."""
         tick = self._tick
         for gang in list(self._gangs.values()):
             if gang.depart_at is not None and gang.depart_at <= tick:
                 self.torus.release(gang.name)
                 del self._gangs[gang.name]
-        for name, shape, priority, lifetime in arrivals:
+        for arrival in arrivals:
+            name, shape, priority, lifetime = arrival[:4]
             self._gangs[name] = _Gang(
                 name=name, shape=tuple(shape), priority=priority,
                 lifetime=lifetime, arrived=tick,
+                tenant=arrival[4] if len(arrival) > 4 else "",
             )
             self._queue.append(name)
         placed_before = self._placements_total
@@ -133,13 +164,45 @@ class FleetSimulator:
         in_service = self.torus.in_service_count()
         occupied = in_service - self.torus.free_count()
         self._utilization_samples.append(occupied / in_service if in_service else 0.0)
+        if self._policy is not None or self._waits_by_tenant:
+            self._held_samples.append({
+                tenant: gens.get(SIM_GENERATION, 0)
+                for tenant, gens in self._usage().items()
+            })
         self._tick = tick + 1
+
+    def _usage(self) -> Dict[str, Dict[str, int]]:
+        """Hosts currently held per tenant (the fairshare Usage shape,
+        in host units under the sim generation)."""
+        used: Dict[str, Dict[str, int]] = {}
+        for name in self.torus.owners():
+            gang = self._gangs.get(name)
+            if gang is None:
+                continue
+            tenant = gang.tenant or consts.TENANT_DEFAULT
+            gens = used.setdefault(tenant, {})
+            gens[SIM_GENERATION] = (
+                gens.get(SIM_GENERATION, 0) + len(self.torus.owner_cells(name))
+            )
+        return used
+
+    def _record_wait(self, gang: _Gang, tick: int) -> None:
+        if not gang.ever_placed:
+            wait = (tick - gang.arrived) * self.tick_seconds
+            self._waits.append(wait)
+            if gang.tenant:
+                self._waits_by_tenant.setdefault(gang.tenant, []).append(wait)
+            gang.ever_placed = True
 
     def _admit(self, tick: int) -> None:
         """Priority-then-FIFO admission, the engine's own order; a
         higher-priority gang that finds no clean fit preempts
         strictly-lower-priority placements (minimal-victim ranking is
-        the allocator's)."""
+        the allocator's). With ``quotas`` the sort and the preemption
+        legality come from the fair-share policy instead."""
+        if self._policy is not None:
+            self._admit_fair(tick)
+            return
         self._queue.sort(
             key=lambda n: (-self._gangs[n].priority, self._gangs[n].arrived, n)
         )
@@ -180,11 +243,87 @@ class FleetSimulator:
                 self.preemptions += 1
             self.torus.occupy(name, block.cells)
             self._placements_total += 1
-            if not gang.ever_placed:
-                self._waits.append((tick - gang.arrived) * self.tick_seconds)
-                gang.ever_placed = True
+            self._record_wait(gang, tick)
             gang.placed_at = tick
             gang.depart_at = tick + gang.lifetime
+        self._queue = remaining
+
+    def _admit_fair(self, tick: int) -> None:
+        """Fair-share admission: the queue re-sorts by the policy's
+        ``order_key`` (quota headroom, weighted dominant share,
+        priority, FIFO) after EVERY placement — shares move as gangs
+        land, exactly as the engine's ``_admit_fair`` replays them —
+        and preemption is gated by ``preemption_legal`` on top of the
+        strictly-lower-priority rule."""
+        policy = self._policy
+        queue = list(self._queue)
+        remaining: List[str] = []
+        failed: set = set()
+        used = self._usage()
+
+        def order(n: str) -> tuple:
+            g = self._gangs[n]
+            volume = g.shape[0] * g.shape[1] * g.shape[2]
+            return policy.order_key(
+                g.tenant or consts.TENANT_DEFAULT, used,
+                ((SIM_GENERATION, volume),),
+                g.priority, f"{g.arrived:08d}", n,
+            )
+
+        # shares only move when occupancy moves, so the queue re-sorts
+        # after each PLACEMENT (usage changed), not after every pop — a
+        # saturated queue of memo'd failures costs one sort, not O(q²)
+        queue.sort(key=order)
+        index = 0
+        while index < len(queue):
+            name = queue[index]
+            index += 1
+            gang = self._gangs[name]
+            tenant = gang.tenant or consts.TENANT_DEFAULT
+            memo_key = (gang.shape, gang.priority, tenant)
+            if memo_key in failed:
+                remaining.append(name)
+                continue
+            found = self.torus.find_block(gang.shape, scorer=self._scorer)
+            victims: frozenset = frozenset()
+            if found is None and gang.priority > 0:
+                volume = gang.shape[0] * gang.shape[1] * gang.shape[2]
+                demands = ((SIM_GENERATION, volume),)
+
+                def victim_ok(owner: str) -> bool:
+                    other = self._gangs.get(owner)
+                    return (
+                        other is not None
+                        and other.priority < gang.priority
+                        and policy.preemption_legal(
+                            tenant, other.tenant or consts.TENANT_DEFAULT,
+                            used, demands,
+                        )
+                    )
+
+                found = self.torus.find_block(gang.shape, victim_ok=victim_ok)
+                victims = found[1] if found is not None else frozenset()
+            if found is None:
+                failed.add(memo_key)
+                remaining.append(name)
+                continue
+            failed.clear()
+            block, _ = found
+            for victim in sorted(victims):
+                self.torus.release(victim)
+                loser = self._gangs[victim]
+                loser.placed_at = None
+                loser.depart_at = None
+                remaining.append(victim)
+                self.preemptions += 1
+            self.torus.occupy(name, block.cells)
+            self._placements_total += 1
+            self._record_wait(gang, tick)
+            gang.placed_at = tick
+            gang.depart_at = tick + gang.lifetime
+            used = self._usage()
+            queue = sorted(queue[index:], key=order)
+            index = 0
         self._queue = remaining
 
     def _maybe_defrag(self, tick: int) -> None:
@@ -240,7 +379,7 @@ class FleetSimulator:
         for tick in range(schedule.ticks + drain_ticks):
             self.step(schedule.arrivals(tick) if tick < schedule.ticks else ())
         waits = list(self._waits)
-        return {
+        report = {
             "policy": self.policy,
             "hosts": len(self.torus.node_at),
             "gangs_arrived": len(schedule.log),
@@ -256,6 +395,49 @@ class FleetSimulator:
             "migrations": self.migrations,
             "fragmentation": self.torus.fragmentation(),
         }
+        if self._waits_by_tenant or self._policy is not None:
+            # realized share = a tenant's average fraction of OCCUPIED
+            # hosts over the run (what it actually got of the contended
+            # capacity — the number acceptance checks against quota
+            # weights); waits are per-tenant first placements
+            tenants: Dict[str, dict] = {}
+            names = set(self._waits_by_tenant)
+            if self._policy is not None:
+                names |= set(self._policy.quotas)
+            # the steady-state share drops the fill-from-empty transient
+            # (the first lifetimes' worth of samples start 50/50 no
+            # matter the weights) — it's what "tracks quota weights"
+            # gates against
+            tail = self._held_samples[len(self._held_samples) // 2:]
+            for tenant in sorted(names):
+                tenant_waits = self._waits_by_tenant.get(tenant, [])
+                shares = [
+                    held.get(tenant, 0) / total
+                    for held in self._held_samples
+                    if (total := sum(held.values()))
+                ]
+                tail_shares = [
+                    held.get(tenant, 0) / total
+                    for held in tail
+                    if (total := sum(held.values()))
+                ]
+                tenants[tenant] = {
+                    "gangs_placed": len(tenant_waits),
+                    "time_to_place_p50_s": round(
+                        _percentile(tenant_waits, 0.50), 3
+                    ),
+                    "time_to_place_p99_s": round(
+                        _percentile(tenant_waits, 0.99), 3
+                    ),
+                    "realized_share_pct": round(
+                        100.0 * sum(shares) / max(1, len(shares)), 2
+                    ),
+                    "steady_share_pct": round(
+                        100.0 * sum(tail_shares) / max(1, len(tail_shares)), 2
+                    ),
+                }
+            report["tenants"] = tenants
+        return report
 
 
 def compare_policies(schedule_factory, dims: Coord = (16, 16, 16), **kwargs) -> dict:

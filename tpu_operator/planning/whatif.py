@@ -76,6 +76,8 @@ def admission_answer(
     compile_entries: Optional[dict] = None,
     libtpu_version: str = "",
     model_hash: str = "",
+    tenant: str = "",
+    quotas: Optional[Sequence[dict]] = None,
 ) -> dict:
     """The `tpuop-cfg plan` admission verdict for one shape. Returns
     {shape, answer: "now"|"after-defrag"|"no", pool, migrations,
@@ -91,7 +93,13 @@ def admission_answer(
     map) opts the ETA into the XLA compile term: a landing block still
     pays the compile before its first token, warm (cache hit for this
     key under ``libtpu_version``) or cold. None — the legacy
-    placement-only ETA."""
+    placement-only ETA.
+
+    ``tenant`` + ``quotas`` (TPUQuota objects) opt the answer into the
+    fair-share view: the result gains the tenant's guaranteed headroom
+    and whether this gang lands inside it or would borrow — "can team
+    X land an 8x8x8 INSIDE ITS QUOTA within 10 min?". The physical
+    verdict is unchanged (borrowing is legal; it's just reclaimable)."""
     from tpu_operator.planning.model import compile_cost_seconds
 
     shape = parse_shape(str(shape_str))
@@ -121,13 +129,52 @@ def admission_answer(
         )
         return result
 
+    def _fold_tenant(result: dict) -> dict:
+        if not tenant or quotas is None:
+            return result
+        from tpu_operator.tenancy.fairshare import (
+            capacity_by_generation,
+            policy_from_objects,
+            usage_from_slices,
+        )
+
+        policy = policy_from_objects(quotas, capacity_by_generation(nodes))
+        if policy is None:
+            return result
+        used = usage_from_slices(slices, nodes)
+        headroom = {
+            gen: policy.guaranteed_headroom(tenant, used, gen)
+            for gen in sorted(policy.capacity)
+        }
+        result["tenant"] = tenant
+        result["quota_headroom_chips"] = headroom
+        if result["answer"] == "no":
+            return result
+        engine = PlacementEngine(slices, nodes, degraded_links=links)
+        entry = engine.pools.get(result["pool"])
+        generation = entry[0].info.generation if entry is not None else ""
+        chips_per_node = (
+            max(1, entry[0].info.chips_per_node) if entry is not None else 1
+        )
+        demand = shape[0] * shape[1] * shape[2] * chips_per_node
+        room = headroom.get(generation, 0)
+        result["would_borrow"] = demand > room
+        result["detail"] += (
+            f"; tenant {tenant}: {room} guaranteed {generation or '?'} chips "
+            "of headroom — "
+            + (f"this {demand}-chip gang would BORROW (reclaimable)"
+               if demand > room
+               else f"lands inside quota ({demand} chips)")
+        )
+        return result
+
     fit_pool = _fits_now(slices, nodes, shape, pool, links, for_slice=for_slice)
     if fit_pool is not None:
-        return _fold_compile({
+        return _fold_tenant(_fold_compile({
             "shape": shape_str, "answer": "now", "pool": fit_pool,
             "migrations": 0, "eta_seconds": 0.0,
             "detail": f"a free {shape_str} block exists in pool {fit_pool}",
-        })
+        }))
     # virtual defrag: apply the proposer's best migration to a copy of
     # the world (the candidate's labels stripped — the engine re-places
     # it on the next replay, exactly as the live controller would) and
@@ -162,22 +209,22 @@ def admission_answer(
             slices, world_nodes, shape, pool, links, for_slice=for_slice
         )
         if fit_pool is not None:
-            return _fold_compile({
+            return _fold_tenant(_fold_compile({
                 "shape": shape_str, "answer": "after-defrag", "pool": fit_pool,
                 "migrations": round_no, "eta_seconds": eta,
                 "detail": (
                     f"lands in pool {fit_pool} after migrating "
                     f"{', '.join(moved)} (~{int(eta)}s at the defrag cooldown)"
                 ),
-            })
-    return {
+            }))
+    return _fold_tenant({
         "shape": shape_str, "answer": "no", "pool": "",
         "migrations": len(moved), "eta_seconds": None,
         "detail": (
             f"no {shape_str} block within the {int(horizon_seconds)}s horizon"
             + (f" even after migrating {', '.join(moved)}" if moved else "")
         ),
-    }
+    })
 
 
 def plan_report(
@@ -191,11 +238,15 @@ def plan_report(
     compile_entries: Optional[dict] = None,
     libtpu_version: str = "",
     model_hash: str = "",
+    tenant: str = "",
+    quotas: Optional[Sequence[dict]] = None,
 ) -> str:
     """The `tpuop-cfg plan` report: per-pool capacity posture, the
     analytical model's per-generation reference predictions, admission
     answers for every queued shape, and (when ``shape`` is given) the
-    operator's own what-if. Pure — the CLI supplies the object lists."""
+    operator's own what-if — asked on behalf of ``tenant`` when set,
+    with its TPUQuota headroom folded into the verdict. Pure — the CLI
+    supplies the object lists."""
     from tpu_operator.planning.model import predict_step_time
     from tpu_operator.workloads.descriptor import reference_descriptor
 
@@ -250,12 +301,15 @@ def plan_report(
         lines.append("# none")
     if shape:
         lines.append("")
-        lines.append(f"# what-if: {shape} within {int(horizon_seconds)}s")
+        lines.append(
+            f"# what-if: {shape} within {int(horizon_seconds)}s"
+            + (f" for tenant {tenant}" if tenant else "")
+        )
         answer = admission_answer(
             slices, nodes, shape, pool=pool,
             degraded_links=links, horizon_seconds=horizon_seconds,
             compile_entries=compile_entries, libtpu_version=libtpu_version,
-            model_hash=model_hash,
+            model_hash=model_hash, tenant=tenant, quotas=quotas,
         )
         lines.append(f"{answer['answer']} — {answer['detail']}")
     return "\n".join(lines) + "\n"

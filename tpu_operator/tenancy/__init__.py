@@ -1,0 +1,8 @@
+"""Multi-tenant fairness: hierarchical TPUQuota accounting, DRF weighted
+fair-share admission ordering, and the preemption-economy legality rule.
+
+``fairshare.py`` is the pure policy model (no client, no I/O) the
+placement engine, the tenancy controller, the what-if planner, and the
+fleet simulator all share; ``ledger.py`` owns the ``tpu-tenancy-ledger``
+ConfigMap every preemption decision and per-tenant time-to-place sample
+is booked into (fail-closed on ApiError — the K003 discipline)."""

@@ -1,0 +1,339 @@
+"""DRF weighted fair-share over hierarchical TPU quotas — the pure model.
+
+Tenants are dotted paths ("acme", "acme.search", "acme.search.training":
+org → team → workload class; "/" is illegal in a k8s label value, so "."
+separates levels). A :class:`TPUQuota <tpu_operator.api.tpuquota>` binds
+one level to a fair-share ``weight`` and a ``guaranteed`` chips-per-
+generation map. Usage at a level is the rollup of that level plus every
+descendant, so "acme.search" chips count against both its own guarantee
+and "acme"'s.
+
+The three rules everything else derives from:
+
+- **Ordering** (:meth:`FairSharePolicy.order_key`): the pending queue
+  sorts by (fits-inside-guaranteed-headroom, weighted dominant share,
+  -priority, FIFO). A tenant with guaranteed headroom for the gang
+  always admits before borrowers; among equals the smallest weighted
+  dominant share (max over generations of used/capacity, divided by the
+  tenant's weight — classic DRF) goes first, so no tenant starves and a
+  weight-2 tenant converges to twice the share of a weight-1 tenant.
+- **Borrowing**: idle capacity beyond the guarantee is free to take —
+  nothing here caps usage — but borrowed chips are reclaimable: a tenant
+  over its guarantee (at any declared level) exposes its gangs as legal
+  cross-tenant preemption victims.
+- **Legality** (:meth:`FairSharePolicy.preemption_legal`): a victim
+  whose owner is wholly inside its guaranteed quota may only be
+  preempted by a request that itself fits inside ITS tenant's
+  guaranteed headroom — never to feed a borrower.
+
+Malformed TPUQuota specs parse to None and grant nothing (fail closed);
+with zero well-formed quotas :func:`policy_from_objects` returns None
+and the placement engine's admission stays byte-identical to stock
+priority-then-FIFO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from tpu_operator import consts
+from tpu_operator.nodepool import get_node_pools
+
+TENANT_SEP = "."
+
+# {tenant: {generation: chips}} — direct charges per resolved tenant
+# string; rollups to ancestor levels are computed, never stored
+Usage = Dict[str, Dict[str, int]]
+# [(generation, chips)] — the candidate footprints one request could
+# land as (one per candidate pool generation)
+Demands = Sequence[Tuple[str, int]]
+
+
+def _normalize(tenant: object) -> str:
+    return str(tenant or "").strip().strip(TENANT_SEP)
+
+
+def resolve_tenant(obj: Mapping) -> str:
+    """The tenant a TPUSlice/TPUJob/TPUServing belongs to: the
+    ``tpu.google.com/tenant`` label first (what the job/serving
+    controllers propagate onto owned slices), then a ``tenant`` field on
+    ``spec.placement`` or ``spec``. Empty string = untenanted (accounts
+    under ``consts.TENANT_DEFAULT`` when a policy is active)."""
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    tenant = _normalize(labels.get(consts.TENANT_LABEL))
+    if tenant:
+        return tenant
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        return ""
+    placement = spec.get("placement")
+    if isinstance(placement, dict):
+        tenant = _normalize(placement.get("tenant"))
+        if tenant:
+            return tenant
+    return _normalize(spec.get("tenant"))
+
+
+@dataclasses.dataclass(frozen=True)
+class QuotaEntry:
+    """One parsed, well-formed TPUQuota level."""
+
+    tenant: str
+    weight: float
+    guaranteed: Tuple[Tuple[str, int], ...]  # sorted (generation, chips)
+    name: str = ""  # source object name (duplicate-tenant tiebreak)
+
+    @property
+    def guaranteed_map(self) -> Dict[str, int]:
+        return dict(self.guaranteed)
+
+
+def parse_quota(obj: Mapping) -> Optional[QuotaEntry]:
+    """Parse one TPUQuota object; None on ANY malformation (empty
+    tenant, non-positive/non-finite weight, non-integer or negative
+    guarantee) — a garbage quota must grant nothing, not something."""
+    spec = obj.get("spec") or {}
+    if not isinstance(spec, dict):
+        return None
+    tenant = _normalize(spec.get("tenant"))
+    if not tenant:
+        return None
+    try:
+        weight = float(spec.get("weight") if spec.get("weight") is not None else 1.0)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(weight) or weight <= 0:
+        return None
+    raw = spec.get("guaranteed")
+    if raw is None:
+        raw = {}
+    if not isinstance(raw, dict):
+        return None
+    guaranteed: Dict[str, int] = {}
+    for gen, chips in raw.items():
+        if isinstance(chips, bool):
+            return None
+        try:
+            n = int(chips)
+        except (TypeError, ValueError):
+            return None
+        if n < 0:
+            return None
+        if n:
+            guaranteed[str(gen)] = n
+    return QuotaEntry(
+        tenant=tenant,
+        weight=weight,
+        guaranteed=tuple(sorted(guaranteed.items())),
+        name=str((obj.get("metadata") or {}).get("name") or ""),
+    )
+
+
+class FairSharePolicy:
+    """The quota set + fleet capacity, with every fairness question the
+    engine/controller/planner asks answered off one ``Usage`` snapshot.
+    Stateless across calls — callers recompute usage each decision."""
+
+    def __init__(self, entries: Iterable[QuotaEntry], capacity: Mapping[str, int]):
+        # duplicate tenant declarations resolve deterministically to the
+        # lexicographically-first source object
+        self.quotas: Dict[str, QuotaEntry] = {}
+        for entry in sorted(entries, key=lambda e: (e.tenant, e.name)):
+            self.quotas.setdefault(entry.tenant, entry)
+        self.capacity: Dict[str, int] = {
+            str(gen): int(chips)
+            for gen, chips in (capacity or {}).items()
+            if int(chips) > 0
+        }
+
+    # -- hierarchy -----------------------------------------------------------
+
+    @staticmethod
+    def ancestry(tenant: str) -> List[str]:
+        """Leaf-to-root levels: "a.b.c" -> ["a.b.c", "a.b", "a"]."""
+        parts = _normalize(tenant).split(TENANT_SEP)
+        return [TENANT_SEP.join(parts[:i]) for i in range(len(parts), 0, -1)]
+
+    def declared_levels(self, tenant: str) -> List[str]:
+        return [level for level in self.ancestry(tenant) if level in self.quotas]
+
+    def weight(self, tenant: str) -> float:
+        """Nearest declared level's weight (self first, then ancestors);
+        a tenant with no quota anywhere weighs 1.0 — a plain borrower."""
+        for level in self.declared_levels(tenant):
+            return self.quotas[level].weight
+        return 1.0
+
+    @staticmethod
+    def level_usage(used: Usage, level: str) -> Dict[str, int]:
+        """Rollup: chips per generation held at ``level`` — the level's
+        own charges plus every descendant's."""
+        prefix = level + TENANT_SEP
+        out: Dict[str, int] = {}
+        for tenant, gens in used.items():
+            if tenant != level and not tenant.startswith(prefix):
+                continue
+            for gen, chips in gens.items():
+                out[gen] = out.get(gen, 0) + int(chips)
+        return out
+
+    # -- DRF -----------------------------------------------------------------
+
+    def dominant_share(self, tenant: str, used: Usage) -> float:
+        share = 0.0
+        for gen, chips in self.level_usage(used, tenant).items():
+            cap = self.capacity.get(gen)
+            if cap:
+                share = max(share, chips / cap)
+        return share
+
+    def weighted_share(self, tenant: str, used: Usage) -> float:
+        return self.dominant_share(tenant, used) / self.weight(tenant)
+
+    def guaranteed_headroom(self, tenant: str, used: Usage, generation: str) -> int:
+        """Chips of ``generation`` the tenant can still place inside its
+        guarantee: the tightest remaining room across every declared
+        ancestry level (its own AND its org's). 0 when nothing in the
+        ancestry declares a quota — an undeclared tenant only borrows."""
+        declared = self.declared_levels(tenant)
+        if not declared:
+            return 0
+        room: Optional[int] = None
+        for level in declared:
+            have = self.quotas[level].guaranteed_map.get(generation, 0)
+            holding = self.level_usage(used, level).get(generation, 0)
+            left = have - holding
+            room = left if room is None else min(room, left)
+        return max(0, room or 0)
+
+    def fits_guarantee(self, tenant: str, used: Usage, demands: Demands) -> bool:
+        """Whether ANY candidate footprint of a request lands inside the
+        tenant's remaining guaranteed headroom."""
+        return any(
+            0 < chips <= self.guaranteed_headroom(tenant, used, gen)
+            for gen, chips in demands
+        )
+
+    def within_guarantee(self, tenant: str, used: Usage) -> bool:
+        """Tenant-granular protection predicate: True iff every declared
+        level in the ancestry holds no more than its guarantee (so NONE
+        of the tenant's chips are borrowed). A tenant with no declared
+        quota anywhere is never protected. Legality is tenant-granular
+        on purpose: a tenant over its guarantee exposes its gangs to
+        reclamation rather than forcing a per-gang attribution of which
+        exact chips are the borrowed ones."""
+        declared = self.declared_levels(tenant)
+        if not declared:
+            return False
+        for level in declared:
+            have = self.quotas[level].guaranteed_map
+            for gen, chips in self.level_usage(used, level).items():
+                if chips > have.get(gen, 0):
+                    return False
+        return True
+
+    def borrowed_chips(self, tenant: str, used: Usage) -> int:
+        """Chips held beyond the tenant's own declared guarantee (total
+        usage when nothing in the ancestry declares one)."""
+        mine = self.level_usage(used, tenant)
+        quota = self.quotas.get(tenant)
+        if quota is None:
+            if not self.declared_levels(tenant):
+                return sum(mine.values())
+            quota_map: Dict[str, int] = {}
+        else:
+            quota_map = quota.guaranteed_map
+        return sum(
+            max(0, chips - quota_map.get(gen, 0)) for gen, chips in mine.items()
+        )
+
+    # -- the two decision rules ----------------------------------------------
+
+    def order_key(
+        self,
+        tenant: str,
+        used: Usage,
+        demands: Demands,
+        priority: int,
+        created: str,
+        name: str,
+    ) -> tuple:
+        """The fair-share admission sort key: (quota headroom, weighted
+        dominant share, priority, FIFO). Shares round to 9 places so the
+        ordering is replica-deterministic."""
+        return (
+            0 if self.fits_guarantee(tenant, used, demands) else 1,
+            round(self.weighted_share(tenant, used), 9),
+            -int(priority),
+            created,
+            name,
+        )
+
+    def preemption_legal(
+        self, preemptor_tenant: str, victim_tenant: str, used: Usage, demands: Demands
+    ) -> bool:
+        """The economy's legality gate: a victim inside its owner's
+        guaranteed quota may never be evicted while the preemptor's
+        tenant is (or would go) over its own — protected capacity never
+        feeds a borrower. Victims whose owner is already borrowing are
+        fair game for any higher-priority request."""
+        if not self.within_guarantee(victim_tenant, used):
+            return True
+        return self.fits_guarantee(preemptor_tenant, used, demands)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+
+def policy_from_objects(
+    quota_objs: Sequence[Mapping], capacity: Mapping[str, int]
+) -> Optional[FairSharePolicy]:
+    """None when no WELL-FORMED TPUQuota exists — the byte-identical
+    stock-admission contract (malformed ones grant nothing)."""
+    entries = [e for e in (parse_quota(o) for o in quota_objs or []) if e is not None]
+    if not entries:
+        return None
+    return FairSharePolicy(entries, capacity)
+
+
+def capacity_by_generation(nodes: Sequence[Mapping]) -> Dict[str, int]:
+    """Fleet chips per TPU generation — the DRF share denominator.
+    Declarative pool size (unavailable hosts still count: a guarantee is
+    an entitlement, not a health report)."""
+    cap: Dict[str, int] = {}
+    for pool in get_node_pools(list(nodes)):
+        gen = pool.info.generation
+        cap[gen] = cap.get(gen, 0) + len(pool.node_names) * pool.info.chips_per_node
+    return cap
+
+
+def add_usage(used: Usage, tenant: str, generation: str, chips: int) -> None:
+    gens = used.setdefault(tenant, {})
+    gens[generation] = gens.get(generation, 0) + int(chips)
+
+
+def usage_from_slices(slices: Sequence[Mapping], nodes: Sequence[Mapping]) -> Usage:
+    """{tenant: {generation: chips}} from published ``status.placement``
+    blocks — the controller/CLI-side accounting (the engine recomputes
+    its own mid-pass from the plan it is building). "Scheduled" is the
+    engine's PlacementPhase.SCHEDULED, spelled literally to keep this
+    module import-free of the engine (which imports us)."""
+    pools = {p.name: p for p in get_node_pools(list(nodes))}
+    used: Usage = {}
+    for obj in slices:
+        status = (obj.get("status") or {}).get("placement") or {}
+        if status.get("phase") != "Scheduled":
+            continue
+        pool = pools.get(str(status.get("pool") or ""))
+        if pool is None:
+            continue
+        chips = len(status.get("nodes") or []) * pool.info.chips_per_node
+        if chips <= 0:
+            continue
+        tenant = resolve_tenant(obj) or consts.TENANT_DEFAULT
+        add_usage(used, tenant, pool.info.generation, chips)
+    return used

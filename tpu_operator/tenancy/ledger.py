@@ -1,0 +1,127 @@
+"""The ``tpu-tenancy-ledger`` ConfigMap: every preemption-economy
+decision and per-tenant time-to-place sample, booked by the placement
+controller (the single K002 writer of both keys) and read by the tenancy
+controller's p99 gauge, must-gather, and the audit trail.
+
+K003 discipline: a transient READ failure returns None and the caller
+aborts the booking pass — a flaky apiserver must fail CLOSED, not
+silently drop a cross-tenant eviction from the audit trail. Only a
+genuinely malformed blob (which a retry can never fix) starts fresh.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_operator import consts
+from tpu_operator.kube import errors
+from tpu_operator.kube.objects import new_object
+
+log = logging.getLogger("tpu-operator.tenancy")
+
+
+def _loads(raw: object, default):
+    if not raw:
+        return default
+    try:
+        value = json.loads(str(raw))
+    except ValueError:
+        return default  # malformed: start fresh, never crash the pass
+    return value if isinstance(value, type(default)) else default
+
+
+def read_ledger(client, namespace: str) -> Optional[dict]:
+    """{"decisions": [...], "placements": {tenant: [seconds...]}} — or
+    None when the CM is unreadable (caller aborts and requeues; fail
+    closed). A missing CM is a fresh ledger, not an error."""
+    try:
+        cm = client.get_or_none(
+            "v1", "ConfigMap", consts.TENANCY_LEDGER_CONFIGMAP, namespace
+        )
+    except errors.ApiError as e:
+        log.warning("tenancy: ledger CM unreadable, pass aborted: %s", e)
+        return None
+    data = (cm or {}).get("data") or {}
+    decisions = _loads(data.get(consts.TENANCY_DECISIONS_KEY), [])
+    placements = _loads(data.get(consts.TENANCY_PLACEMENTS_KEY), {})
+    return {
+        "decisions": [d for d in decisions if isinstance(d, dict)],
+        "placements": {
+            str(tenant): [float(s) for s in ring if isinstance(s, (int, float))]
+            for tenant, ring in placements.items()
+            if isinstance(ring, list)
+        },
+    }
+
+
+def book(
+    client,
+    namespace: str,
+    ledger: dict,
+    decisions: Sequence[dict] = (),
+    samples: Sequence[Tuple[str, float]] = (),
+    now: float = 0.0,
+) -> bool:
+    """Append ``decisions`` (each stamped with the booking time) and
+    per-tenant time-to-place ``samples`` onto a ledger previously
+    returned by :func:`read_ledger`, then write it back (bounded:
+    TENANCY_DECISIONS_LIMIT decisions, TENANCY_PLACEMENT_SAMPLES_LIMIT
+    samples per tenant). Returns False when the write fails so the
+    caller requeues — a booked-but-unwritten eviction must retry."""
+    changed = False
+    for decision in decisions:
+        entry = dict(decision)
+        entry["at"] = round(float(now), 3)
+        ledger["decisions"].append(entry)
+        changed = True
+    del ledger["decisions"][: -consts.TENANCY_DECISIONS_LIMIT]
+    for tenant, seconds in samples:
+        ring = ledger["placements"].setdefault(str(tenant), [])
+        ring.append(round(float(seconds), 3))
+        del ring[: -consts.TENANCY_PLACEMENT_SAMPLES_LIMIT]
+        changed = True
+    if not changed:
+        return True
+    data = {
+        consts.TENANCY_DECISIONS_KEY: json.dumps(ledger["decisions"], sort_keys=True),
+        consts.TENANCY_PLACEMENTS_KEY: json.dumps(ledger["placements"], sort_keys=True),
+    }
+    try:
+        client.patch(
+            "v1", "ConfigMap", consts.TENANCY_LEDGER_CONFIGMAP,
+            {"data": data}, namespace,
+        )
+    except errors.NotFound:
+        try:
+            client.create(  # tpuop-lint: kinds=v1/ConfigMap
+                new_object(
+                    "v1", "ConfigMap", consts.TENANCY_LEDGER_CONFIGMAP,
+                    namespace, data=data,
+                )
+            )
+        except (errors.AlreadyExists, errors.ApiError) as e:
+            log.warning("tenancy: ledger create raced/failed: %s", e)
+            return False
+    except errors.ApiError as e:
+        log.warning("tenancy: ledger write failed: %s", e)
+        return False
+    return True
+
+
+def place_p99(ledger: dict, tenant: str) -> Optional[float]:
+    """p99 time-to-place over the tenant's sample ring (None with no
+    samples) — the starvation gauge the tenancy controller exports."""
+    ring = sorted((ledger.get("placements") or {}).get(tenant) or [])
+    if not ring:
+        return None
+    rank = max(0, min(len(ring) - 1, int(round(0.99 * (len(ring) - 1)))))
+    return ring[rank]
+
+
+def last_decisions(ledger: dict, count: int = 5) -> List[Dict]:
+    """The newest ``count`` preemption decisions, newest first — the
+    must-gather ``tenants.txt`` view."""
+    decisions = ledger.get("decisions") or []
+    return list(reversed(decisions[-count:]))
